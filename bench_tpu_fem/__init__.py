@@ -1,0 +1,22 @@
+"""bench-tpu-fem: a TPU-native matrix-free high-order FEM benchmark framework.
+
+A ground-up JAX/XLA/Pallas re-design of the capabilities of
+ukri-bench/benchmark-dolfinx (reference: /root/reference/src): the Poisson
+equation -div(kappa grad u) = f on a hexahedral mesh of the unit cube,
+discretised with degree 1-7 tensor-product Lagrange elements, applied
+matrix-free with sum factorisation, timed as bare operator action or
+unpreconditioned CG, reporting GDoF/s, with an assembled-CSR oracle
+(`--mat_comp`) as the correctness check.
+
+Layer map (mirrors SURVEY.md section 1):
+  elements/  L0 1D quadrature + Lagrange tabulation     (ref: basix usage, laplacian.hpp:123-212)
+  mesh/      L1 structured box mesh + tensor dofmap     (ref: mesh.cpp)
+  fem/       L2 assembled oracle: CSR, RHS, geometry    (ref: csr.hpp, forms.cpp, geometry_cpu.hpp)
+  ops/       L4 matrix-free operator (jnp + Pallas)     (ref: laplacian_gpu.hpp, laplacian.hpp)
+  la/        L3/L5 vector math + CG                     (ref: vector.hpp, cg.hpp)
+  dist/      SPMD domain decomposition over a TPU mesh  (ref: MPI scatter in vector.hpp, mesh.cpp:26-114)
+  bench/     L6 benchmark driver + JSON reporting       (ref: laplacian_solver.cpp, main.cpp)
+  cli.py     L7 command line interface                  (ref: main.cpp:144-183)
+"""
+
+__version__ = "0.1.0"

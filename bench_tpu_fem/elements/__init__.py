@@ -1,0 +1,31 @@
+"""1D quadrature rules and Lagrange element tabulation (layer L0).
+
+Replaces the reference's use of Basix (`basix::quadrature::make_quadrature`,
+`basix::create_element`, `basix::compute_interpolation_operator`; see
+/root/reference/src/laplacian.hpp:123-212) with a pure-numpy implementation.
+All tables are computed host-side in float64 and shipped to the device as
+compile-time constants of the jitted operator.
+"""
+
+from .quadrature import (
+    gauss_points_weights,
+    gll_points_weights,
+    make_quadrature_1d,
+    num_points_for_degree,
+    quadrature_degree,
+)
+from .lagrange import gll_nodes, lagrange_eval, lagrange_eval_deriv
+from .tables import OperatorTables, build_operator_tables
+
+__all__ = [
+    "gauss_points_weights",
+    "gll_points_weights",
+    "make_quadrature_1d",
+    "num_points_for_degree",
+    "quadrature_degree",
+    "gll_nodes",
+    "lagrange_eval",
+    "lagrange_eval_deriv",
+    "OperatorTables",
+    "build_operator_tables",
+]

@@ -1,0 +1,65 @@
+"""Lagrange basis tabulation on arbitrary 1D node sets.
+
+Replaces `basix::create_element(...).tabulate` and
+`basix::compute_interpolation_operator` (/root/reference/src/laplacian.hpp:
+161-212). The reference's "gll_warped" Lagrange variant is, on an interval,
+simply the Lagrange basis through the GLL points; we use the sorted point set
+directly since this framework owns its dof numbering (grid-lexicographic,
+see bench_tpu_fem.mesh.dofmap) rather than Basix's vertex-first ordering.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .quadrature import gauss_points_weights, gll_points_weights
+
+
+def gll_nodes(degree: int) -> np.ndarray:
+    """Nodes of the degree-P GLL-warped Lagrange element on [0, 1], sorted."""
+    pts, _ = gll_points_weights(degree + 1)
+    return pts
+
+
+def gl_nodes(degree: int) -> np.ndarray:
+    """Nodes of the degree-P Gauss-point (gl_warped) element on [0, 1]."""
+    pts, _ = gauss_points_weights(degree + 1)
+    return pts
+
+
+def lagrange_eval(nodes: np.ndarray, x: np.ndarray) -> np.ndarray:
+    """Tabulate phi[q, i] = L_i(x_q) for the Lagrange basis through `nodes`.
+
+    Uses the direct product form; node counts here are <= 10, where this is
+    accurate to a few ulp in float64.
+    """
+    nodes = np.asarray(nodes, dtype=np.float64)
+    x = np.asarray(x, dtype=np.float64)
+    n = len(nodes)
+    phi = np.ones((len(x), n))
+    for i in range(n):
+        for j in range(n):
+            if j != i:
+                phi[:, i] *= (x - nodes[j]) / (nodes[i] - nodes[j])
+    return phi
+
+
+def lagrange_eval_deriv(nodes: np.ndarray, x: np.ndarray) -> np.ndarray:
+    """Tabulate dphi[q, i] = L_i'(x_q).
+
+    L_i'(x) = sum_{m != i} 1/(x_i - x_m) * prod_{j != i,m} (x - x_j)/(x_i - x_j).
+    """
+    nodes = np.asarray(nodes, dtype=np.float64)
+    x = np.asarray(x, dtype=np.float64)
+    n = len(nodes)
+    dphi = np.zeros((len(x), n))
+    for i in range(n):
+        for m in range(n):
+            if m == i:
+                continue
+            term = np.full(len(x), 1.0 / (nodes[i] - nodes[m]))
+            for j in range(n):
+                if j != i and j != m:
+                    term *= (x - nodes[j]) / (nodes[i] - nodes[j])
+            dphi[:, i] += term
+    return dphi

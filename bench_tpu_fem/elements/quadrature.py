@@ -1,0 +1,85 @@
+"""1D Gauss-Legendre and Gauss-Lobatto-Legendre quadrature on [0, 1].
+
+Capability parity with `basix::quadrature::make_quadrature` as used by the
+reference operator setup (/root/reference/src/laplacian.hpp:125-146,166-175):
+the reference requests a rule by *polynomial exactness degree* via
+    GLL:   qdeg(p) = 2p-2 for p > 2 else 2p-1
+    Gauss: qdeg(p) = 2p
+with p = element_degree + qmode, and Basix returns the minimal-point rule.
+Both maps resolve to nq = p + 1 points in 1D, which is also how the reference
+dispatches its kernels (Q = P+1 for qmode=0, Q = P+2 for qmode=1,
+/root/reference/src/laplacian.hpp:361-398).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+from numpy.polynomial import legendre as npleg
+
+
+def gauss_points_weights(n: int) -> tuple[np.ndarray, np.ndarray]:
+    """n-point Gauss-Legendre rule on [0, 1] (exact for degree 2n-1)."""
+    if n < 1:
+        raise ValueError("need n >= 1 quadrature points")
+    x, w = npleg.leggauss(n)
+    return (x + 1.0) / 2.0, w / 2.0
+
+
+def gll_points_weights(n: int) -> tuple[np.ndarray, np.ndarray]:
+    """n-point Gauss-Lobatto-Legendre rule on [0, 1] (exact for degree 2n-3).
+
+    Points are the endpoints plus the roots of L'_{n-1}; weights are
+    w_i = 2 / (n (n-1) L_{n-1}(x_i)^2) on [-1, 1], halved for [0, 1].
+    """
+    if n < 2:
+        raise ValueError("GLL rule needs n >= 2 points")
+    if n == 2:
+        x = np.array([-1.0, 1.0])
+    else:
+        # Roots of the derivative of the (n-1)-th Legendre polynomial.
+        c = np.zeros(n)
+        c[n - 1] = 1.0
+        dc = npleg.legder(c)
+        interior = np.sort(npleg.legroots(dc).real)
+        # Polish with Newton iterations on L'_{n-1} for full f64 accuracy.
+        d2c = npleg.legder(dc)
+        for _ in range(3):
+            interior = interior - npleg.legval(interior, dc) / npleg.legval(interior, d2c)
+        x = np.concatenate(([-1.0], interior, [1.0]))
+    Ln = npleg.legval(x, np.eye(n)[n - 1])
+    w = 2.0 / (n * (n - 1) * Ln**2)
+    return (x + 1.0) / 2.0, w / 2.0
+
+
+def quadrature_degree(rule: str, p: int) -> int:
+    """Polynomial exactness degree requested by the reference for parameter p.
+
+    Mirrors the q_map lambdas in /root/reference/src/laplacian.hpp:128-133 and
+    the form tables in /root/reference/src/poisson64.py:19-20.
+    """
+    if rule == "gauss":
+        return 2 * p
+    if rule == "gll":
+        return 2 * p - 2 if p > 2 else 2 * p - 1
+    raise ValueError(f"unknown quadrature rule '{rule}'")
+
+
+def num_points_for_degree(rule: str, qdeg: int) -> int:
+    """Minimal number of 1D points whose rule is exact to degree `qdeg`."""
+    if rule == "gauss":
+        # n points exact to 2n-1
+        return (qdeg + 2) // 2
+    if rule == "gll":
+        # n points exact to 2n-3
+        return max(2, (qdeg + 4) // 2)
+    raise ValueError(f"unknown quadrature rule '{rule}'")
+
+
+def make_quadrature_1d(rule: str, degree: int, qmode: int) -> tuple[np.ndarray, np.ndarray]:
+    """1D rule for an operator of element degree `degree` and quadrature mode
+    `qmode` (0 or 1). Resolves to degree + qmode + 1 points for both rules."""
+    nq = num_points_for_degree(rule, quadrature_degree(rule, degree + qmode))
+    assert nq == degree + qmode + 1, (rule, degree, qmode, nq)
+    if rule == "gauss":
+        return gauss_points_weights(nq)
+    return gll_points_weights(nq)

@@ -1,0 +1,92 @@
+"""Operator constant tables: interpolation matrix phi0 and the 1D
+collocation derivative matrix dphi1.
+
+Mirrors the table construction in the reference operator constructors
+(/root/reference/src/laplacian.hpp:123-212):
+
+- element0: 1D Lagrange of degree P with nodes at the GLL points (the
+  "gll_warped" variant) -- always GLL-noded, for both quadrature types.
+- quadrature: nq = P + qmode + 1 points (GLL or Gauss rule).
+- element1: 1D Lagrange of degree nq-1 whose nodes *are* the quadrature
+  points, so its dofs collocate with quadrature ("discontinuous" in the
+  reference; node placement is all that matters here).
+- phi0[q, i]  = element0 basis i evaluated at quadrature point q, i.e. the
+  interpolation matrix element0 -> element1. Identity iff qmode == 0 with
+  GLL quadrature (enforced, as in laplacian.hpp:197-198).
+- dphi1[q, i] = element1 basis i derivative at quadrature point q (a square
+  spectral differentiation matrix).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+from .lagrange import gll_nodes, lagrange_eval, lagrange_eval_deriv
+from .quadrature import make_quadrature_1d
+
+
+@dataclass(frozen=True)
+class OperatorTables:
+    degree: int
+    qmode: int
+    rule: str  # "gll" or "gauss"
+    nd: int  # dofs per direction = degree + 1
+    nq: int  # quadrature points per direction = degree + qmode + 1
+    pts1d: np.ndarray  # (nq,) quadrature points on [0, 1]
+    wts1d: np.ndarray  # (nq,) quadrature weights
+    nodes1d: np.ndarray  # (nd,) element0 nodes (sorted GLL points)
+    phi0: np.ndarray  # (nq, nd) interpolation matrix element0 -> quadrature
+    dphi1: np.ndarray  # (nq, nq) collocation derivative matrix
+    is_identity: bool  # phi0 is the identity (qmode=0, GLL)
+
+
+def _snap_small(mat: np.ndarray) -> np.ndarray:
+    """Zero entries below 5 eps, as the reference does before the identity
+    check (/root/reference/src/laplacian.hpp:188-193)."""
+    out = mat.copy()
+    out[np.abs(out) < 5 * np.finfo(np.float64).eps] = 0.0
+    return out
+
+
+def _matrix_is_identity(mat: np.ndarray) -> bool:
+    if mat.shape[0] != mat.shape[1]:
+        return False
+    eps = np.finfo(np.float64).eps
+    return bool(np.all(np.abs(mat - np.eye(mat.shape[0])) <= 5 * eps))
+
+
+def build_operator_tables(degree: int, qmode: int, rule: str = "gll") -> OperatorTables:
+    if not 1 <= degree <= 8:
+        raise ValueError(f"unsupported degree {degree} (expected 1..8)")
+    if qmode not in (0, 1):
+        raise ValueError("Invalid qmode.")
+    if rule not in ("gll", "gauss"):
+        raise ValueError(f"unknown quadrature rule '{rule}'")
+
+    pts, wts = make_quadrature_1d(rule, degree, qmode)
+    nodes = gll_nodes(degree)
+
+    phi0 = _snap_small(lagrange_eval(nodes, pts))
+    is_identity = _matrix_is_identity(phi0)
+    if qmode == 0 and not is_identity:
+        # Same constraint as laplacian.hpp:197-198: qmode=0 requires the
+        # quadrature points to collocate with the element nodes (GLL only).
+        raise ValueError("Expecting identity interpolation matrix for qmode=0")
+
+    dphi1 = lagrange_eval_deriv(pts, pts)
+
+    return OperatorTables(
+        degree=degree,
+        qmode=qmode,
+        rule=rule,
+        nd=degree + 1,
+        nq=len(pts),
+        pts1d=pts,
+        wts1d=wts,
+        nodes1d=nodes,
+        phi0=phi0,
+        dphi1=dphi1,
+        is_identity=is_identity,
+    )

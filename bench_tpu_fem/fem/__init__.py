@@ -1,0 +1,27 @@
+"""Host-side reference FEM path (layer L2): geometry factors, assembled CSR
+stiffness matrix, RHS vector — the correctness oracle.
+
+Replaces the reference's FFCx-generated element kernels + DOLFINx CPU assembly
+used by `--mat_comp` (/root/reference/src/laplacian_solver.cpp:151-227,
+csr.hpp) and the RHS form assembly (forms.cpp, laplacian_solver.cpp:100-105).
+Everything here is numpy/scipy and deliberately *independent* of the
+sum-factorised device path in bench_tpu_fem.ops: the element matrices are
+built from full 3D basis-gradient tables, never from the 1D factorised chain.
+"""
+
+from .geometry import geometry_factors
+from .assemble import (
+    assemble_csr,
+    assemble_rhs,
+    element_stiffness_matrices,
+)
+from .source import default_source, interpolate
+
+__all__ = [
+    "geometry_factors",
+    "assemble_csr",
+    "assemble_rhs",
+    "element_stiffness_matrices",
+    "default_source",
+    "interpolate",
+]

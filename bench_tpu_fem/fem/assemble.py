@@ -1,0 +1,105 @@
+"""Assembled-matrix oracle and RHS assembly (numpy/scipy).
+
+Replaces the reference's `--mat_comp` path: DOLFINx CPU CSR assembly with
+FFCx-generated element kernels plus Dirichlet handling
+(/root/reference/src/laplacian_solver.cpp:151-227, csr.hpp) and the RHS
+`b = L(f)` assembly (laplacian_solver.cpp:100-105). The element stiffness
+matrices here are computed from *full 3D* basis-gradient tables — an
+independent discretisation path from the sum-factorised operator in
+bench_tpu_fem.ops, so agreement at machine precision is a real check.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import scipy.sparse as sp
+
+from ..elements.lagrange import lagrange_eval, lagrange_eval_deriv
+from ..elements.tables import OperatorTables
+
+
+def _grad_tables_3d(tables: OperatorTables) -> np.ndarray:
+    """D[a, q, i]: derivative along reference axis a of 3D basis function i at
+    3D quadrature point q (q and i in row-major (x, y, z) order)."""
+    phi = lagrange_eval(tables.nodes1d, tables.pts1d)  # (nq, nd)
+    dphi = lagrange_eval_deriv(tables.nodes1d, tables.pts1d)  # (nq, nd)
+    Dx = np.einsum("qi,rj,sk->qrsijk", dphi, phi, phi)
+    Dy = np.einsum("qi,rj,sk->qrsijk", phi, dphi, phi)
+    Dz = np.einsum("qi,rj,sk->qrsijk", phi, phi, dphi)
+    nq3 = tables.nq**3
+    nd3 = tables.nd**3
+    return np.stack([D.reshape(nq3, nd3) for D in (Dx, Dy, Dz)])
+
+
+def element_stiffness_matrices(
+    tables: OperatorTables, G: np.ndarray, kappa: float
+) -> np.ndarray:
+    """A_e[c, i, j] = kappa * sum_q sum_ab G[c, ab, q] D[a, q, i] D[b, q, j].
+
+    G is the packed 6-component geometry tensor from
+    bench_tpu_fem.fem.geometry.geometry_factors, shape (ncells, 6, nq, nq, nq).
+    """
+    D = _grad_tables_3d(tables)  # (3, nq3, nd3)
+    ncells = G.shape[0]
+    nq3 = tables.nq**3
+    Gp = G.reshape(ncells, 6, nq3)
+    # Unpack symmetric 6 -> (3, 3)
+    idx = np.array([[0, 1, 2], [1, 3, 4], [2, 4, 5]])
+    Gfull = Gp[:, idx, :]  # (ncells, 3, 3, nq3)
+    # flux[c, a, q, j] = sum_b G[c,a,b,q] D[b,q,j]
+    flux = np.einsum("cabq,bqj->caqj", Gfull, D)
+    A = kappa * np.einsum("aqi,caqj->cij", D, flux)
+    return A
+
+
+def assemble_csr(
+    element_matrices: np.ndarray, dofmap: np.ndarray, bc_marker_flat: np.ndarray
+) -> sp.csr_matrix:
+    """Assemble global CSR with Dirichlet rows/columns zeroed and unit
+    diagonal on constrained dofs.
+
+    Matches DOLFINx semantics used by the oracle: `assemble_matrix(..., {bc})`
+    skips insertion on constrained rows/columns and `set_diagonal` then places
+    1.0 there (/root/reference/src/laplacian_solver.cpp:182-184).
+    """
+    ncells, nd3, _ = element_matrices.shape
+    rows = np.repeat(dofmap, nd3, axis=1).ravel()
+    cols = np.tile(dofmap, (1, nd3)).ravel()
+    vals = element_matrices.ravel().copy()
+    keep = ~(bc_marker_flat[rows] | bc_marker_flat[cols])
+    A = sp.coo_matrix(
+        (vals[keep], (rows[keep], cols[keep])),
+        shape=(len(bc_marker_flat), len(bc_marker_flat)),
+    ).tocsr()
+    bc_idx = np.flatnonzero(bc_marker_flat)
+    A += sp.coo_matrix(
+        (np.ones(len(bc_idx)), (bc_idx, bc_idx)), shape=A.shape
+    ).tocsr()
+    return A
+
+
+def assemble_rhs(
+    tables: OperatorTables,
+    wdetJ: np.ndarray,
+    dofmap: np.ndarray,
+    f_dofs_flat: np.ndarray,
+    bc_marker_flat: np.ndarray,
+) -> np.ndarray:
+    """Assemble b_i = sum_cells sum_q w*detJ(q) * f_h(q) * Phi_i(q), then set
+    b = 0 on Dirichlet dofs.
+
+    f_h is the finite-element interpolant of f (dof values `f_dofs_flat`).
+    Mirrors `assemble_vector(b, L)` + `bc.set(b)` in
+    /root/reference/src/laplacian_solver.cpp:100-105 for the mass form
+    L = inner(w0, v)*dx (/root/reference/src/poisson64.py:66).
+    """
+    phi = lagrange_eval(tables.nodes1d, tables.pts1d)  # (nq, nd)
+    Phi = np.einsum("qi,rj,sk->qrsijk", phi, phi, phi).reshape(
+        tables.nq**3, tables.nd**3
+    )
+    fq = np.einsum("qi,ci->cq", Phi, f_dofs_flat[dofmap])
+    be = np.einsum("cq,cq,qi->ci", wdetJ.reshape(len(dofmap), -1), fq, Phi)
+    b = np.zeros(len(bc_marker_flat), dtype=be.dtype)
+    np.add.at(b, dofmap.ravel(), be.ravel())
+    b[bc_marker_flat] = 0.0
+    return b

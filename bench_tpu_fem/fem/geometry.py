@@ -1,0 +1,75 @@
+"""Geometry factors at quadrature points (numpy reference implementation).
+
+Computes, per cell and quadrature point, the symmetric weighted geometry
+tensor used by the weak Laplacian,
+
+    G = w * adj(J) adj(J)^T / det(J),        J_ij = dx_i / dxi_j,
+
+stored as its 6 upper-triangular entries, plus w*det(J) for mass/RHS forms.
+Mirrors `geometry_computation_cpu` (/root/reference/src/geometry_cpu.hpp:
+25-112): K = adj(J) has rows K[a, :] = cross(J[:, a+1], J[:, a+2]) (cyclic),
+and G_ab = K[a, :] . K[b, :] * w / detJ. The trilinear coordinate map means
+J at a quadrature point is a small contraction over the 8 cell corners.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def _shape1d(pts: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+    """Linear 1D shape functions and derivatives at points: (nq, 2) each."""
+    pts = np.asarray(pts)
+    N = np.stack([1.0 - pts, pts], axis=1)
+    D = np.broadcast_to(np.array([-1.0, 1.0]), (len(pts), 2)).copy()
+    return N, D
+
+
+def jacobians(corners: np.ndarray, pts1d: np.ndarray) -> np.ndarray:
+    """J[cell, qx, qy, qz, i, a] = dx_i/dxi_a for trilinearly-mapped hexes.
+
+    corners: (..., 2, 2, 2, 3) cell corner coordinates indexed (a, b, c)
+    along the (x, y, z) reference axes.
+    """
+    N, D = _shape1d(pts1d)
+    # For derivative along axis 0: D(q0) x N(q1) x N(q2) contracted with corners.
+    tab = {0: (D, N, N), 1: (N, D, N), 2: (N, N, D)}
+    Js = []
+    for a in range(3):
+        A, B, C = tab[a]
+        Js.append(np.einsum("...abci,xa,yb,zc->...xyzi", corners, A, B, C))
+    # Stack as J[..., i, a]
+    return np.stack(Js, axis=-1)
+
+
+def geometry_factors(
+    corners: np.ndarray, pts1d: np.ndarray, wts1d: np.ndarray
+) -> tuple[np.ndarray, np.ndarray]:
+    """Return (G, wdetJ).
+
+    G:     (ncells, 6, nq, nq, nq) with components ordered
+           (G00, G01, G02, G11, G12, G22) — same packing as the reference
+           (geometry_cpu.hpp:92-109).
+    wdetJ: (ncells, nq, nq, nq) = quadrature weight * det(J).
+    """
+    corners = np.asarray(corners).reshape(-1, 2, 2, 2, 3)
+    J = jacobians(corners, pts1d)  # (ncells, nq, nq, nq, 3, 3)
+    cols = [J[..., :, a] for a in range(3)]
+    K = np.stack(
+        [
+            np.cross(cols[1], cols[2]),
+            np.cross(cols[2], cols[0]),
+            np.cross(cols[0], cols[1]),
+        ],
+        axis=-2,
+    )  # K[..., a, i] = adj(J) rows
+    detJ = np.einsum("...i,...i->...", cols[0], K[..., 0, :])
+    w = np.asarray(wts1d)
+    w3 = w[:, None, None] * w[None, :, None] * w[None, None, :]
+    scale = w3[None] / detJ
+    pairs = [(0, 0), (0, 1), (0, 2), (1, 1), (1, 2), (2, 2)]
+    G = np.stack(
+        [np.einsum("...i,...i->...", K[..., a, :], K[..., b, :]) * scale for a, b in pairs],
+        axis=1,
+    )
+    return G, w3[None] * detJ

@@ -1,0 +1,23 @@
+"""Source term and nodal interpolation.
+
+The benchmark's source is a Gaussian bump in (x, y),
+f = 1000 * exp(-((x-0.5)^2 + (y-0.5)^2) / 0.02)
+(/root/reference/src/main.cpp:81-92), interpolated into the FE space by
+evaluation at the dof coordinates.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def default_source(x: np.ndarray) -> np.ndarray:
+    """f(x) for coordinate array of shape (..., 3)."""
+    dx = (x[..., 0] - 0.5) ** 2
+    dy = (x[..., 1] - 0.5) ** 2
+    return 1000.0 * np.exp(-(dx + dy) / 0.02)
+
+
+def interpolate(fn, dof_coords: np.ndarray) -> np.ndarray:
+    """Evaluate `fn` at every dof coordinate; returns the dof-grid array."""
+    return np.asarray(fn(dof_coords))

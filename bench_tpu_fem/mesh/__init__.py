@@ -1,0 +1,27 @@
+"""Structured box hex mesh, tensor-product dofmap and boundary data (layer L1).
+
+Replaces DOLFINx mesh creation/partitioning and dofmap machinery used by the
+reference (/root/reference/src/mesh.cpp). Because the domain is a structured
+unit-cube box of hexahedra, vertex coordinates, cell connectivity, dofmaps and
+boundary-dof markers are all closed-form — there is no graph partitioner; the
+distributed layer (bench_tpu_fem.dist) uses a block partition of the cell grid.
+"""
+
+from .sizing import compute_mesh_size
+from .box import BoxMesh, create_box_mesh
+from .dofmap import (
+    cell_dofmap,
+    dof_grid_shape,
+    boundary_dof_marker,
+    dof_coordinates,
+)
+
+__all__ = [
+    "compute_mesh_size",
+    "BoxMesh",
+    "create_box_mesh",
+    "cell_dofmap",
+    "dof_grid_shape",
+    "boundary_dof_marker",
+    "dof_coordinates",
+]

@@ -1,0 +1,60 @@
+"""Structured hexahedral mesh of the unit cube.
+
+Replaces `dolfinx::mesh::create_box` + the vertex-ghost-layer repartition
+(/root/reference/src/mesh.cpp:190-218, 26-114). Vertices live on an
+(nx+1, ny+1, nz+1) grid; cell (cx, cy, cz) has its 8 corners at grid points
+(cx+a, cy+b, cz+c). The optional geometry perturbation randomly shifts vertex
+x-coordinates by up to `fact * (1/nx)` with a fixed seed (mesh.cpp:199-207) —
+it exists to harden correctness checks against accidentally-regular geometry.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import cached_property
+
+import numpy as np
+
+
+@dataclass(frozen=True)
+class BoxMesh:
+    n: tuple[int, int, int]  # cells per direction
+    vertices: np.ndarray  # (nx+1, ny+1, nz+1, 3) float64 vertex coordinates
+
+    @property
+    def ncells(self) -> int:
+        return self.n[0] * self.n[1] * self.n[2]
+
+    @cached_property
+    def cell_corners(self) -> np.ndarray:
+        """(nx, ny, nz, 2, 2, 2, 3): corner coordinates of every cell,
+        indexed by local corner offsets (a, b, c) along (x, y, z)."""
+        v = self.vertices
+        nx, ny, nz = self.n
+        out = np.empty((nx, ny, nz, 2, 2, 2, 3), dtype=v.dtype)
+        for a in range(2):
+            for b in range(2):
+                for c in range(2):
+                    out[:, :, :, a, b, c, :] = v[a : nx + a, b : ny + b, c : nz + c, :]
+        return out
+
+
+def create_box_mesh(
+    n: tuple[int, int, int], geom_perturb_fact: float = 0.0, seed: int = 42
+) -> BoxMesh:
+    nx, ny, nz = (int(v) for v in n)
+    if min(nx, ny, nz) < 1:
+        raise ValueError(f"invalid mesh size {n}")
+    xs = np.linspace(0.0, 1.0, nx + 1)
+    ys = np.linspace(0.0, 1.0, ny + 1)
+    zs = np.linspace(0.0, 1.0, nz + 1)
+    verts = np.stack(np.meshgrid(xs, ys, zs, indexing="ij"), axis=-1)
+    if geom_perturb_fact != 0.0:
+        # Deterministic perturbation of vertex x-coordinates, generated over
+        # the *global* vertex set so results are partition-independent.
+        perturb = geom_perturb_fact / nx
+        rng = np.random.RandomState(seed)
+        shift = rng.uniform(-perturb, perturb, size=verts.shape[:3])
+        verts = verts.copy()
+        verts[..., 0] += shift
+    return BoxMesh(n=(nx, ny, nz), vertices=verts)

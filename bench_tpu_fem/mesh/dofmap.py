@@ -1,0 +1,91 @@
+"""Closed-form tensor-product dofmap for degree-P Lagrange on a box mesh.
+
+Replaces the DOLFINx dofmap/IndexMap machinery the reference leans on
+(`V.dofmap()->map()` shipped to the device in
+/root/reference/src/laplacian.hpp:106-113, built in tensor-product order via
+`basix::create_tp_element` / `tp_dof_ordering`, mesh.cpp:90-94).
+
+Dofs live on a grid of shape (nx*P+1, ny*P+1, nz*P+1); the dof at grid point
+(gx, gy, gz) has id gx*NY*NZ + gy*NZ + gz (row-major). Cell (cx, cy, cz)
+owns the (P+1)^3 dofs at grid points (cx*P + i, cy*P + j, cz*P + k), in
+lexicographic local order — the 1D element nodes are the *sorted* GLL points,
+so grid position along each axis is also the 1D node index.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def dof_grid_shape(n: tuple[int, int, int], degree: int) -> tuple[int, int, int]:
+    return tuple(int(ni) * degree + 1 for ni in n)
+
+
+def cell_dofmap(n: tuple[int, int, int], degree: int) -> np.ndarray:
+    """(ncells, (P+1)^3) int32 dofmap; cells in (cx, cy, cz) row-major order,
+    local dofs in (i, j, k) row-major order."""
+    nx, ny, nz = n
+    NX, NY, NZ = dof_grid_shape(n, degree)
+    nd = degree + 1
+    gx = (np.arange(nx) * degree)[:, None] + np.arange(nd)[None, :]  # (nx, nd)
+    gy = (np.arange(ny) * degree)[:, None] + np.arange(nd)[None, :]
+    gz = (np.arange(nz) * degree)[:, None] + np.arange(nd)[None, :]
+    # dof id = gx*NY*NZ + gy*NZ + gz, broadcast to (nx,ny,nz,nd,nd,nd)
+    ids = (
+        gx[:, None, None, :, None, None].astype(np.int64) * (NY * NZ)
+        + gy[None, :, None, None, :, None] * NZ
+        + gz[None, None, :, None, None, :]
+    )
+    if ids.max() > np.iinfo(np.int32).max:
+        raise ValueError("dof ids exceed int32 range")
+    return ids.reshape(nx * ny * nz, nd * nd * nd).astype(np.int32)
+
+
+def boundary_dof_marker(n: tuple[int, int, int], degree: int) -> np.ndarray:
+    """(NX, NY, NZ) bool grid marking dofs on the exterior boundary of the
+    cube (homogeneous Dirichlet on all exterior facets, as located in
+    /root/reference/src/main.cpp:94-102)."""
+    NX, NY, NZ = dof_grid_shape(n, degree)
+    marker = np.zeros((NX, NY, NZ), dtype=bool)
+    marker[0, :, :] = marker[-1, :, :] = True
+    marker[:, 0, :] = marker[:, -1, :] = True
+    marker[:, :, 0] = marker[:, :, -1] = True
+    return marker
+
+
+def dof_coordinates(
+    vertices: np.ndarray, degree: int, nodes1d: np.ndarray
+) -> np.ndarray:
+    """(NX, NY, NZ, 3) physical coordinates of every dof grid point, obtained
+    by pushing the reference element nodes through each cell's trilinear map.
+
+    Equivalent to DOLFINx's interpolation-point pushforward used by
+    `f->interpolate` (/root/reference/src/main.cpp:81-92). Grid points shared
+    between neighbouring cells get identical coordinates from either side
+    (the trilinear map is continuous across faces), so attributing each grid
+    point to the lower-index cell is exact.
+    """
+    P = degree
+    n = tuple(s - 1 for s in vertices.shape[:3])
+    t = np.asarray(nodes1d, dtype=np.float64)  # (P+1,) reference nodes
+
+    def axis_split(N_axis: int, ncells_axis: int):
+        g = np.arange(N_axis)
+        c = np.minimum(g // P, ncells_axis - 1)
+        w = t[g - c * P]  # local reference coordinate in [0, 1]
+        return c, w
+
+    cx, wx = axis_split(n[0] * P + 1, n[0])
+    cy, wy = axis_split(n[1] * P + 1, n[1])
+    cz, wz = axis_split(n[2] * P + 1, n[2])
+
+    out = np.zeros((len(cx), len(cy), len(cz), 3), dtype=vertices.dtype)
+    for a in (0, 1):
+        fx = (wx if a else 1.0 - wx)[:, None, None, None]
+        for b in (0, 1):
+            fy = (wy if b else 1.0 - wy)[None, :, None, None]
+            for c in (0, 1):
+                fz = (wz if c else 1.0 - wz)[None, None, :, None]
+                corner = vertices[np.ix_(cx + a, cy + b, cz + c)]
+                out += fx * fy * fz * corner
+    return out

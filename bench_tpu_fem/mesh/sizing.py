@@ -1,0 +1,32 @@
+"""Mesh sizing: pick (nx, ny, nz) to hit a requested global dof count.
+
+Behavioural parity with `benchdolfinx::compute_mesh_size`
+(/root/reference/src/mesh.cpp:117-152): start from the cube-root estimate and
+brute-force search +/-5 cells in each direction for the best fit of
+(nx*p+1)(ny*p+1)(nz*p+1) to ndofs_global.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def compute_mesh_size(ndofs_global: int, degree: int) -> tuple[int, int, int]:
+    nx_approx = (ndofs_global ** (1.0 / 3.0) - 1.0) / degree
+    n0 = int(nx_approx + 0.5)
+    lo = max(1, n0 - 5)
+    cand = np.arange(lo, n0 + 6, dtype=np.int64)
+    ndofs_1d = cand * degree + 1
+    misfit = np.abs(
+        ndofs_1d[:, None, None] * ndofs_1d[None, :, None] * ndofs_1d[None, None, :]
+        - ndofs_global
+    )
+    best0 = (n0 * degree + 1) ** 3 - ndofs_global
+    best = (n0, n0, n0)
+    # Match the reference's scan order (first strict improvement wins).
+    flat = misfit.reshape(-1)
+    idx = int(np.argmin(flat))
+    if flat[idx] < abs(best0):
+        i, j, k = np.unravel_index(idx, misfit.shape)
+        best = (int(cand[i]), int(cand[j]), int(cand[k]))
+    return best

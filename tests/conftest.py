@@ -1,0 +1,14 @@
+"""Test configuration: force JAX onto the host CPU platform with 8 virtual
+devices (the TPU analogue of the reference CI's oversubscribed `mpirun -n 2`,
+see .github/workflows/ci.yml:100-106 there), and enable x64 so the f64
+correctness oracle runs at full precision."""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("JAX_ENABLE_X64", "1")

@@ -1,0 +1,72 @@
+import numpy as np
+import pytest
+
+from bench_tpu_fem.elements import (
+    build_operator_tables,
+    gll_nodes,
+    lagrange_eval,
+    lagrange_eval_deriv,
+)
+
+
+@pytest.mark.parametrize("p", range(1, 8))
+def test_lagrange_delta_and_partition_of_unity(p):
+    nodes = gll_nodes(p)
+    x = np.linspace(0, 1, 23)
+    phi = lagrange_eval(nodes, x)
+    np.testing.assert_allclose(phi.sum(axis=1), 1.0, atol=1e-12)
+    np.testing.assert_allclose(lagrange_eval(nodes, nodes), np.eye(p + 1), atol=1e-12)
+
+
+@pytest.mark.parametrize("p", range(1, 8))
+def test_lagrange_derivative_exact_for_polynomials(p):
+    nodes = gll_nodes(p)
+    x = np.linspace(0, 1, 17)
+    dphi = lagrange_eval_deriv(nodes, x)
+    for k in range(p + 1):
+        vals_at_nodes = nodes**k
+        deriv = dphi @ vals_at_nodes
+        expected = k * x ** (k - 1) if k > 0 else np.zeros_like(x)
+        np.testing.assert_allclose(deriv, expected, atol=1e-10)
+
+
+def test_tables_qmode0_gll_is_identity():
+    t = build_operator_tables(3, 0, "gll")
+    assert t.is_identity
+    np.testing.assert_array_equal(t.phi0, np.eye(4))
+    assert t.nq == 4 and t.nd == 4
+
+
+def test_tables_qmode1_not_identity():
+    t = build_operator_tables(3, 1, "gll")
+    assert not t.is_identity
+    assert t.phi0.shape == (5, 4)
+    assert t.nq == 5
+
+
+def test_tables_gauss_qmode0_raises():
+    # Gauss points never collocate with GLL nodes -> reference throws
+    # (laplacian.hpp:197-198); we mirror that.
+    with pytest.raises(ValueError):
+        build_operator_tables(3, 0, "gauss")
+
+
+@pytest.mark.parametrize("rule", ["gll", "gauss"])
+@pytest.mark.parametrize("p", range(1, 8))
+def test_dphi1_is_exact_collocation_derivative(p, rule):
+    qmode = 1 if rule == "gauss" else 0
+    t = build_operator_tables(p, qmode, rule)
+    # dphi1 differentiates any polynomial of degree < nq exactly at the points.
+    for k in range(t.nq):
+        deriv = t.dphi1 @ t.pts1d**k
+        expected = k * t.pts1d ** (k - 1) if k > 0 else np.zeros_like(t.pts1d)
+        np.testing.assert_allclose(deriv, expected, atol=1e-9)
+
+
+@pytest.mark.parametrize("p", range(1, 8))
+def test_phi0_interpolates_polynomials(p):
+    t = build_operator_tables(p, 1, "gll")
+    # phi0 maps dof values of any degree-<=P polynomial to its values at the
+    # quadrature points.
+    for k in range(p + 1):
+        np.testing.assert_allclose(t.phi0 @ t.nodes1d**k, t.pts1d**k, atol=1e-11)

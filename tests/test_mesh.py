@@ -1,0 +1,87 @@
+import numpy as np
+import pytest
+
+from bench_tpu_fem.elements import gll_nodes
+from bench_tpu_fem.mesh import (
+    boundary_dof_marker,
+    cell_dofmap,
+    compute_mesh_size,
+    create_box_mesh,
+    dof_coordinates,
+    dof_grid_shape,
+)
+
+
+def test_compute_mesh_size_golden_config():
+    # degree 3, 1000 dofs -> 3x3x3 cells with exactly (3*3+1)^3 = 1000 dofs
+    assert compute_mesh_size(1000, 3) == (3, 3, 3)
+
+
+@pytest.mark.parametrize("ndofs,degree", [(10**5, 3), (10**6, 6), (5000, 2)])
+def test_compute_mesh_size_reasonable(ndofs, degree):
+    n = compute_mesh_size(ndofs, degree)
+    got = np.prod([ni * degree + 1 for ni in n])
+    assert abs(got - ndofs) / ndofs < 0.2
+
+
+def test_box_mesh_vertices():
+    m = create_box_mesh((2, 3, 4))
+    assert m.vertices.shape == (3, 4, 5, 3)
+    np.testing.assert_allclose(m.vertices[-1, -1, -1], [1, 1, 1])
+    c = m.cell_corners
+    assert c.shape == (2, 3, 4, 2, 2, 2, 3)
+    np.testing.assert_allclose(c[1, 2, 3, 1, 1, 1], [1, 1, 1])
+    np.testing.assert_allclose(c[0, 0, 0, 0, 0, 0], [0, 0, 0])
+
+
+def test_box_mesh_perturbation_deterministic_and_x_only():
+    m1 = create_box_mesh((3, 3, 3), geom_perturb_fact=0.2)
+    m2 = create_box_mesh((3, 3, 3), geom_perturb_fact=0.2)
+    m0 = create_box_mesh((3, 3, 3))
+    np.testing.assert_array_equal(m1.vertices, m2.vertices)
+    assert np.any(m1.vertices[..., 0] != m0.vertices[..., 0])
+    np.testing.assert_array_equal(m1.vertices[..., 1:], m0.vertices[..., 1:])
+    assert np.max(np.abs(m1.vertices[..., 0] - m0.vertices[..., 0])) <= 0.2 / 3
+
+
+def test_cell_dofmap_structure():
+    n, p = (2, 2, 2), 2
+    dm = cell_dofmap(n, p)
+    assert dm.shape == (8, 27)
+    N = dof_grid_shape(n, p)
+    assert N == (5, 5, 5)
+    # Every dof appears; shared dofs appear in multiple cells.
+    assert set(dm.ravel()) == set(range(125))
+    # Cell (0,0,0) first dof is grid origin; last dof is grid centre point.
+    assert dm[0, 0] == 0
+    assert dm[0, -1] == 2 * 25 + 2 * 5 + 2
+
+
+def test_boundary_marker_count():
+    n, p = (3, 3, 3), 3
+    marker = boundary_dof_marker(n, p)
+    N = 3 * 3 + 1
+    assert marker.shape == (N, N, N)
+    assert marker.sum() == N**3 - (N - 2) ** 3
+
+
+def test_dof_coordinates_unperturbed():
+    n, p = (2, 3, 1), 3
+    m = create_box_mesh(n)
+    nodes = gll_nodes(p)
+    x = dof_coordinates(m.vertices, p, nodes)
+    assert x.shape == (*dof_grid_shape(n, p), 3)
+    #
+
+    # Unperturbed: coordinates are the tensor grid of per-cell mapped nodes.
+    expect_x = np.concatenate([(c + nodes[:-1]) / n[0] for c in range(n[0])] + [[1.0]])
+    np.testing.assert_allclose(x[:, 0, 0, 0], expect_x, atol=1e-14)
+    np.testing.assert_allclose(x[0, :, 0, 1], np.concatenate([(c + nodes[:-1]) / n[1] for c in range(n[1])] + [[1.0]]), atol=1e-14)
+
+
+def test_dof_coordinates_shared_points_consistent_when_perturbed():
+    n, p = (2, 2, 2), 2
+    m = create_box_mesh(n, geom_perturb_fact=0.3)
+    x = dof_coordinates(m.vertices, p, gll_nodes(p))
+    # Grid point at a cell interface equals the vertex coordinate there.
+    np.testing.assert_allclose(x[p, p, p], m.vertices[1, 1, 1], atol=1e-14)

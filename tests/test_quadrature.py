@@ -1,0 +1,50 @@
+import numpy as np
+import pytest
+
+from bench_tpu_fem.elements import (
+    gauss_points_weights,
+    gll_points_weights,
+    make_quadrature_1d,
+    num_points_for_degree,
+    quadrature_degree,
+)
+
+
+def test_gll_3pt_known_values():
+    pts, wts = gll_points_weights(3)
+    np.testing.assert_allclose(pts, [0.0, 0.5, 1.0], atol=1e-15)
+    np.testing.assert_allclose(wts, [1 / 6, 4 / 6, 1 / 6], atol=1e-15)
+
+
+def test_gll_4pt_known_values():
+    pts, _ = gll_points_weights(4)
+    interior = (np.array([-1, 1]) / np.sqrt(5) + 1) / 2
+    np.testing.assert_allclose(pts, [0.0, interior[0], interior[1], 1.0], atol=1e-15)
+
+
+@pytest.mark.parametrize("n", range(2, 10))
+def test_gll_exactness(n):
+    pts, wts = gll_points_weights(n)
+    np.testing.assert_allclose(wts.sum(), 1.0, rtol=1e-14)
+    for k in range(2 * n - 2):  # exact through degree 2n-3
+        exact = 1.0 / (k + 1)
+        np.testing.assert_allclose(wts @ pts**k, exact, rtol=1e-13, err_msg=f"x^{k}")
+
+
+@pytest.mark.parametrize("n", range(1, 10))
+def test_gauss_exactness(n):
+    pts, wts = gauss_points_weights(n)
+    for k in range(2 * n):  # exact through degree 2n-1
+        np.testing.assert_allclose(wts @ pts**k, 1.0 / (k + 1), rtol=1e-13)
+
+
+@pytest.mark.parametrize("degree", range(1, 8))
+@pytest.mark.parametrize("qmode", [0, 1])
+@pytest.mark.parametrize("rule", ["gll", "gauss"])
+def test_point_count_matches_reference_dispatch(degree, qmode, rule):
+    # The reference dispatches Q = P+1 (qmode 0) or P+2 (qmode 1):
+    # /root/reference/src/laplacian.hpp:361-398.
+    qdeg = quadrature_degree(rule, degree + qmode)
+    assert num_points_for_degree(rule, qdeg) == degree + qmode + 1
+    pts, _ = make_quadrature_1d(rule, degree, qmode)
+    assert len(pts) == degree + qmode + 1
