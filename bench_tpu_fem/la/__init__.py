@@ -1,0 +1,13 @@
+"""Vector math and the CG solver (layers L3/L5).
+
+Replaces the reference's thrust BLAS-1 + `MPI_Allreduce` dots
+(/root/reference/src/vector.hpp:159-292, cg.hpp:21-79) with jnp reductions,
+and `cg_solve` (cg.hpp:89-169) with a single jitted `lax.fori_loop` — the
+whole CG iteration (halo exchange, operator, two dots, three axpys) is one
+XLA computation with no host round-trips.
+"""
+
+from .cg import cg_solve
+from .vector import inner_product, norm
+
+__all__ = ["cg_solve", "inner_product", "norm"]

@@ -1,0 +1,17 @@
+"""Vector reductions. Single-chip versions; the distributed layer wraps these
+with `lax.psum` over the device mesh (the ICI replacement for MPI_Allreduce,
+/root/reference/src/vector.hpp:173, cg.hpp:76)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def inner_product(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    return jnp.vdot(a, b)
+
+
+def norm(a: jnp.ndarray) -> jnp.ndarray:
+    """L2 norm (the reference reports dolfinx::la::norm l2, e.g.
+    laplacian_solver.cpp:130-131)."""
+    return jnp.sqrt(jnp.vdot(a, a))

@@ -1,0 +1,23 @@
+"""Matrix-free sum-factorised Laplacian operator (layer L4), TPU-native.
+
+Re-designs the reference's CUDA/HIP kernels (`stiffness_operator_gpu`,
+/root/reference/src/laplacian_gpu.hpp:91-426; `geometry_computation_gpu`,
+geometry_gpu.hpp:26-133) as batched tensor contractions over all cells at
+once: where the GPU version launches one thread block per cell with shared-
+memory scratch and an atomicAdd scatter, the TPU version expresses each
+sum-factorisation stage as one large (nq x nd) x (cells * nd^2) matmul that
+XLA tiles onto the MXU, and replaces scatter-add entirely with a structured
+per-axis "fold" (the tensor-product dofmap on a box mesh makes cell->dof
+overlap a regular stencil; cf. SURVEY.md section 7 "Scatter-add").
+"""
+
+from .geometry import geometry_factors_jax
+from .laplacian import Laplacian, build_laplacian, gather_cells, fold_cells
+
+__all__ = [
+    "geometry_factors_jax",
+    "Laplacian",
+    "build_laplacian",
+    "gather_cells",
+    "fold_cells",
+]

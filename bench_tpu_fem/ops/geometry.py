@@ -1,0 +1,50 @@
+"""Geometry precompute on device (jnp): batched over all cells.
+
+TPU-native equivalent of `geometry_computation_gpu`
+(/root/reference/src/geometry_gpu.hpp:26-133): one einsum per Jacobian
+column instead of one thread block per cell. Returns the same packed
+6-component tensor G and w*detJ as the numpy oracle
+(bench_tpu_fem.fem.geometry), against which it is tested.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+
+def geometry_factors_jax(
+    corners: jnp.ndarray, pts1d: np.ndarray, wts1d: np.ndarray, dtype=None
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """corners: (ncells, 2, 2, 2, 3) -> (G (ncells,6,nq,nq,nq), wdetJ).
+
+    Computation is carried out in the dtype of `corners` (float64 host mesh
+    data should be cast by the caller for f32 runs *after* this computes, or
+    passed as f32 directly to trade precision for speed; the benchmark driver
+    computes in f64-on-host precision only for the oracle path).
+    """
+    corners = jnp.asarray(corners, dtype=dtype)
+    rdtype = corners.dtype
+    pts = np.asarray(pts1d)
+    N = jnp.asarray(np.stack([1.0 - pts, pts], axis=1), dtype=rdtype)  # (nq, 2)
+    D = jnp.asarray(np.broadcast_to([-1.0, 1.0], (len(pts), 2)), dtype=rdtype)
+    tab = {0: (D, N, N), 1: (N, D, N), 2: (N, N, D)}
+    cols = [
+        jnp.einsum("eabci,xa,yb,zc->exyzi", corners, *tab[a]) for a in range(3)
+    ]  # J columns: dx/dxi_a at (nq,nq,nq) points
+    K = [
+        jnp.cross(cols[1], cols[2]),
+        jnp.cross(cols[2], cols[0]),
+        jnp.cross(cols[0], cols[1]),
+    ]  # adjugate rows
+    detJ = jnp.einsum("...i,...i->...", cols[0], K[0])
+    w = np.asarray(wts1d)
+    w3 = jnp.asarray(
+        w[:, None, None] * w[None, :, None] * w[None, None, :], dtype=rdtype
+    )
+    scale = w3[None] / detJ
+    pairs = [(0, 0), (0, 1), (0, 2), (1, 1), (1, 2), (2, 2)]
+    G = jnp.stack(
+        [jnp.einsum("...i,...i->...", K[a], K[b]) * scale for a, b in pairs], axis=1
+    )
+    return G, w3[None] * detJ

@@ -1,0 +1,72 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+import scipy.sparse.linalg as spla
+
+from bench_tpu_fem.elements import build_operator_tables
+from bench_tpu_fem.fem import (
+    assemble_csr,
+    element_stiffness_matrices,
+    geometry_factors,
+)
+from bench_tpu_fem.la import cg_solve
+from bench_tpu_fem.mesh import boundary_dof_marker, cell_dofmap, create_box_mesh
+from bench_tpu_fem.ops import build_laplacian
+
+jax.config.update("jax_enable_x64", True)
+
+
+def test_cg_solves_spd_system():
+    rng = np.random.RandomState(0)
+    M = rng.randn(40, 40)
+    A = M @ M.T + 40 * np.eye(40)
+    b = rng.randn(40)
+    Aj = jnp.asarray(A)
+    x = cg_solve(lambda v: Aj @ v, jnp.asarray(b), jnp.zeros(40), max_iter=200)
+    np.testing.assert_allclose(np.asarray(x), np.linalg.solve(A, b), rtol=1e-8)
+
+
+def test_cg_fixed_iterations_matches_csr_cg():
+    """CG on the matfree operator after k iterations must match CG on the
+    assembled CSR operator after the same k iterations (the --cg --mat_comp
+    protocol, laplacian_solver.cpp:199-205)."""
+    n, degree, qmode = (2, 2, 2), 3, 1
+    mesh = create_box_mesh(n, geom_perturb_fact=0.1)
+    t = build_operator_tables(degree, qmode)
+    G, _ = geometry_factors(mesh.cell_corners.reshape(-1, 2, 2, 2, 3), t.pts1d, t.wts1d)
+    dm = cell_dofmap(n, degree)
+    bc = boundary_dof_marker(n, degree)
+    A = assemble_csr(element_stiffness_matrices(t, G, 2.0), dm, bc.ravel())
+    op = build_laplacian(mesh, degree, qmode)
+
+    rng = np.random.RandomState(5)
+    b = rng.randn(*bc.shape)
+    b[bc] = 0.0
+
+    k = 20
+    x_mf = cg_solve(op.apply, jnp.asarray(b), jnp.zeros_like(jnp.asarray(b)), k)
+
+    # Same CG, same iteration count, on the CSR matrix.
+    def apply_csr(v):
+        return (A @ np.asarray(v).ravel()).reshape(bc.shape)
+
+    x, r = np.zeros_like(b), b.copy()
+    p = r.copy()
+    rnorm = float((p.ravel() @ r.ravel()))
+    for _ in range(k):
+        y = apply_csr(p)
+        alpha = rnorm / float(p.ravel() @ y.ravel())
+        x = x + alpha * p
+        r = r - alpha * y
+        rnorm_new = float(r.ravel() @ r.ravel())
+        beta = rnorm_new / rnorm
+        rnorm = rnorm_new
+        p = beta * p + r
+    np.testing.assert_allclose(np.asarray(x_mf), x, rtol=1e-9, atol=1e-12)
+
+
+def test_cg_rtol_early_freeze():
+    A = jnp.eye(5) * 2.0
+    b = jnp.ones(5)
+    x = cg_solve(lambda v: A @ v, b, jnp.zeros(5), max_iter=50, rtol=1e-12)
+    np.testing.assert_allclose(np.asarray(x), 0.5 * np.ones(5), rtol=1e-10)
