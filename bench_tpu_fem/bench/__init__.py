@@ -1,0 +1,10 @@
+"""Benchmark orchestration (layer L6): build the problem, time the operator
+or CG, verify against the CSR oracle, report results.
+
+Replaces `laplace_action_gpu/cpu` (/root/reference/src/laplacian_solver.cpp)
+and the JSON assembly in main.cpp:122-132."""
+
+from .driver import BenchConfig, BenchmarkResults, run_benchmark
+from .reporting import banner, results_json
+
+__all__ = ["BenchConfig", "BenchmarkResults", "run_benchmark", "banner", "results_json"]

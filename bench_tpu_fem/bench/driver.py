@@ -1,0 +1,178 @@
+"""Benchmark driver: the equivalent of `laplace_action_gpu/cpu`
+(/root/reference/src/laplacian_solver.cpp:65-230,265-391).
+
+Protocol (identical to the reference):
+1. assemble b = L(f) for the Gaussian-bump source, zero Dirichlet rows;
+   u <- b  (laplacian_solver.cpp:100-109)
+2. timed region: nreps x (y = A u)  or  cg_solve(A, y, u, nreps, rtol=0)
+   (laplacian_solver.cpp:119-127)
+3. report ||u||, ||y||, wall time, GDoF/s = ndofs_global*nreps/(1e9*t)
+4. --mat_comp: same applies/CG through the assembled CSR oracle -> z,
+   report ||z|| and ||y - z|| (laplacian_solver.cpp:151-227)
+
+One deliberate deviation: the operator is compiled (jitted) *before* the
+timed region. The reference's kernels are compiled at build time, so its
+timed region also contains no compilation; including XLA compile time would
+measure the toolchain, not the hardware.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ..elements.tables import build_operator_tables
+from ..fem.assemble import (
+    assemble_csr,
+    assemble_rhs,
+    element_stiffness_matrices,
+)
+from ..fem.geometry import geometry_factors
+from ..fem.source import default_source
+from ..la.cg import cg_solve
+from ..mesh.box import create_box_mesh
+from ..mesh.dofmap import (
+    boundary_dof_marker,
+    cell_dofmap,
+    dof_coordinates,
+    dof_grid_shape,
+)
+from ..ops.laplacian import build_laplacian
+from ..utils.timing import Timer
+
+
+@dataclass(frozen=True)
+class BenchConfig:
+    """Mirrors the reference CLI flag set (/root/reference/src/main.cpp:145-183)."""
+
+    ndofs_global: int = 1000
+    degree: int = 3
+    qmode: int = 1
+    float_bits: int = 64
+    nreps: int = 1000
+    use_cg: bool = False
+    mat_comp: bool = False
+    use_gauss: bool = False
+    geom_perturb_fact: float = 0.0
+    platform: str = "auto"  # "auto" | "tpu" | "cpu": jax default device
+    ndevices: int = 1  # chips to shard over (1 = single-chip path)
+
+
+@dataclass
+class BenchmarkResults:
+    """Same fields as benchdolfinx::BenchmarkResults
+    (/root/reference/src/laplacian_solver.hpp:13-20) plus mesh metadata."""
+
+    mat_free_time: float = 0.0
+    unorm: float = 0.0
+    ynorm: float = 0.0
+    znorm: float = 0.0
+    enorm: float = 0.0
+    ncells_global: int = 0
+    ndofs_global: int = 0
+    nreps: int = 0
+    gdof_per_second: float = 0.0
+    extra: dict = field(default_factory=dict)
+
+
+def _setup_problem(cfg: BenchConfig):
+    """Shared host-side setup: mesh, tables, RHS (the oracle-precision f64
+    path, as the reference assembles its RHS on the CPU)."""
+    from ..mesh.sizing import compute_mesh_size
+
+    n = compute_mesh_size(cfg.ndofs_global, cfg.degree)
+    rule = "gauss" if cfg.use_gauss else "gll"
+    t = build_operator_tables(cfg.degree, cfg.qmode, rule)
+    mesh = create_box_mesh(n, geom_perturb_fact=cfg.geom_perturb_fact)
+    grid_shape = dof_grid_shape(n, cfg.degree)
+    bc_grid = boundary_dof_marker(n, cfg.degree)
+
+    with Timer("% Assemble RHS (host)"):
+        coords = dof_coordinates(mesh.vertices, cfg.degree, t.nodes1d)
+        f = default_source(coords).ravel()
+        dm = cell_dofmap(n, cfg.degree)
+        G_host, wdetJ = geometry_factors(
+            mesh.cell_corners.reshape(-1, 2, 2, 2, 3), t.pts1d, t.wts1d
+        )
+        b = assemble_rhs(t, wdetJ, dm, f, bc_grid.ravel()).reshape(grid_shape)
+
+    return n, rule, t, mesh, grid_shape, bc_grid, dm, b, G_host
+
+
+def run_benchmark(cfg: BenchConfig) -> BenchmarkResults:
+    import jax
+    import jax.numpy as jnp
+
+    if cfg.float_bits == 64:
+        jax.config.update("jax_enable_x64", True)
+    elif cfg.float_bits != 32:
+        raise ValueError("Invalid float size. Must be 32 or 64.")
+    dtype = jnp.float64 if cfg.float_bits == 64 else jnp.float32
+
+    n, rule, t, mesh, grid_shape, bc_grid, dm, b_host, G_host = _setup_problem(cfg)
+    ndofs_global = int(np.prod(grid_shape))
+    res = BenchmarkResults(
+        ncells_global=mesh.ncells, ndofs_global=ndofs_global, nreps=cfg.nreps
+    )
+
+    if cfg.ndevices > 1:
+        try:
+            from ..dist.driver import run_distributed
+        except ImportError as exc:
+            raise NotImplementedError(
+                "multi-device path requires bench_tpu_fem.dist"
+            ) from exc
+        return run_distributed(cfg, n, rule, t, mesh, bc_grid, b_host, res, dtype)
+
+    with Timer("% Create matfree operator"):
+        op = build_laplacian(mesh, cfg.degree, cfg.qmode, rule, kappa=2.0, dtype=dtype, tables=t)
+        u = jnp.asarray(b_host, dtype=dtype)
+        # AOT-compile outside the timed region (see module docstring).
+        if cfg.use_cg:
+            fn = jax.jit(
+                lambda b, x0: cg_solve(op.apply, b, x0, cfg.nreps)
+            ).lower(u, jnp.zeros_like(u)).compile()
+        else:
+            fn = jax.jit(op.apply).lower(u).compile()
+
+    t0 = time.perf_counter()
+    if cfg.use_cg:
+        y = fn(u, jnp.zeros_like(u))
+    else:
+        y = jnp.zeros_like(u)
+        for _ in range(cfg.nreps):
+            y = fn(u)
+    y.block_until_ready()
+    elapsed = time.perf_counter() - t0
+
+    res.mat_free_time = elapsed
+    res.unorm = float(jnp.linalg.norm(u))
+    res.ynorm = float(jnp.linalg.norm(y))
+    res.gdof_per_second = ndofs_global * cfg.nreps / (1e9 * elapsed)
+
+    if cfg.mat_comp:
+        z = _mat_comp_oracle(cfg, t, dm, bc_grid, b_host, G_host)
+        e = np.asarray(y, dtype=np.float64) - z
+        res.znorm = float(np.linalg.norm(z))
+        res.enorm = float(np.linalg.norm(e))
+    return res
+
+
+def _mat_comp_oracle(cfg, t, dm, bc_grid, b_host, G_host) -> np.ndarray:
+    """Assembled-CSR comparison path (laplacian_solver.cpp:151-227): same
+    number of operator applications or CG iterations through scipy CSR."""
+    from ..fem.assemble import csr_cg_reference
+
+    with Timer("% Assemble CSR (oracle)"):
+        A = assemble_csr(
+            element_stiffness_matrices(t, G_host, 2.0), dm, bc_grid.ravel()
+        )
+    u = b_host.ravel()
+    with Timer("% CSR Matvec"):
+        if cfg.use_cg:
+            z = csr_cg_reference(A, u, cfg.nreps)
+        else:
+            z = A @ u
+    return z.reshape(b_host.shape)

@@ -1,0 +1,53 @@
+"""Banner and JSON output matching the reference contract
+(/root/reference/src/main.cpp:242-270, 296-307)."""
+
+from __future__ import annotations
+
+import json
+
+from .driver import BenchConfig, BenchmarkResults
+
+
+def banner(cfg: BenchConfig, device_info: str) -> str:
+    lines = [
+        device_info,
+        "-----------------------------------",
+        f"Platform: {cfg.platform}",
+        f"Polynomial degree : {cfg.degree}",
+        f"Number of devices : {cfg.ndevices}",
+        f"Requested number of global DoFs : {cfg.ndofs_global}",
+        f"Number of repetitions : {cfg.nreps}",
+        f"Scalar Type: {cfg.float_bits}",
+        f"Use Gauss-Jacobi: {int(cfg.use_gauss)}",
+        f"Compare to matrix: {int(cfg.mat_comp)}",
+        "-----------------------------------",
+    ]
+    return "\n".join(lines)
+
+
+def results_json(cfg: BenchConfig, res: BenchmarkResults) -> str:
+    """Same two-level {"input": ..., "output": ...} schema as the reference
+    (main.cpp:262-270 for input echo, main.cpp:122-132 for output)."""
+    root = {
+        "input": {
+            "p": cfg.degree,
+            "ndevices": cfg.ndevices,
+            "ndofs_local_requested": cfg.ndofs_global // max(cfg.ndevices, 1),
+            "nreps": cfg.nreps,
+            "scalar_size": cfg.float_bits,
+            "use_gauss": cfg.use_gauss,
+            "mat_comp": cfg.mat_comp,
+            "qmode": cfg.qmode,
+            "cg": cfg.use_cg,
+        },
+        "output": {
+            "ncells_global": res.ncells_global,
+            "ndofs_global": res.ndofs_global,
+            "mat_free_time": res.mat_free_time,
+            "u_norm": res.unorm,
+            "y_norm": res.ynorm,
+            "z_norm": res.znorm,
+            "gdof_per_second": res.gdof_per_second,
+        },
+    }
+    return json.dumps(root)

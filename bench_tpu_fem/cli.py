@@ -1,0 +1,140 @@
+"""Command line interface (layer L7).
+
+Flag-for-flag reproduction of the reference binary's options
+(/root/reference/src/main.cpp:144-183), with TPU-relevant additions
+(--ndevices for multi-chip sharding). `--ndofs` is per device, `--ndofs_global`
+total; specifying both non-default values is an error (main.cpp:192-196).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="bench-tpu-fem",
+        description=(
+            "TPU FEM benchmark\n-----------------\n"
+            "Finite Element Operator Action Benchmark which computes\n"
+            "the Laplacian operator on a cube mesh of hexahedral elements."
+        ),
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    p.add_argument("--platform", default="auto", help="Compute platform (auto, tpu or cpu)")
+    p.add_argument("--float", dest="float_bits", type=int, default=64,
+                   help="Float size (bits). 32 or 64.")
+    p.add_argument("--ndofs", type=int, default=None,
+                   help="Number of degrees-of-freedom per device (default 1000)")
+    p.add_argument("--ndofs_global", type=int, default=None,
+                   help="Number of global degrees-of-freedom")
+    p.add_argument("--qmode", type=int, default=1,
+                   help="Quadrature mode (0 or 1): qmode=0 has P+1 points in each "
+                        "direction, qmode=1 has P+2 points in each direction.")
+    p.add_argument("--cg", action="store_true",
+                   help="Do CG iterations, rather than simple operator action")
+    p.add_argument("--nreps", type=int, default=1000, help="Number of repetitions")
+    p.add_argument("--degree", type=int, default=3, help='Polynomial degree "P" (1-7)')
+    p.add_argument("--mat_comp", action="store_true",
+                   help="Compare result to matrix operator (slow with large ndofs)")
+    p.add_argument("--geom_perturb_fact", type=float, default=0.0,
+                   help="Randomly perturb the geometry (useful to check correctness)")
+    p.add_argument("--use_gauss", action="store_true",
+                   help="Use Gauss quadrature rather than GLL quadrature")
+    p.add_argument("--json", default="", help="Filename for JSON output")
+    p.add_argument("--ndevices", type=int, default=0,
+                   help="Devices to shard over (0 = all visible devices)")
+    p.add_argument("--log-level", default="info")
+    return p
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+
+    if args.float_bits not in (32, 64):
+        raise SystemExit("Invalid float size. Must be 32 or 64.")
+    if args.qmode not in (0, 1):
+        raise SystemExit("Invalid qmode.")
+
+    # Reject any run where both options are explicitly specified, matching
+    # the reference (main.cpp:192-196) — even if a value equals its default.
+    if args.ndofs is not None and args.ndofs_global is not None:
+        raise SystemExit("Conflicting options 'ndofs' and 'ndofs_global'")
+
+    from .utils.logging import init_logging
+
+    init_logging(args.log_level)
+
+    # x64 must be configured before device arrays exist.
+    import jax
+
+    if args.float_bits == 64:
+        jax.config.update("jax_enable_x64", True)
+    if args.platform in ("cpu", "tpu"):
+        try:
+            jax.config.update("jax_platforms", args.platform)
+        except Exception as exc:
+            import warnings
+
+            warnings.warn(
+                f"could not select platform '{args.platform}' ({exc}); "
+                f"continuing on the default JAX backend"
+            )
+
+    devices = jax.devices()
+    ndevices = args.ndevices or len(devices)
+
+    if args.ndofs_global is not None:
+        ndofs_global = args.ndofs_global
+    else:
+        ndofs_global = (args.ndofs if args.ndofs is not None else 1000) * ndevices
+
+    from .bench.driver import BenchConfig, run_benchmark
+    from .bench.reporting import banner, results_json
+    from .utils.timing import timer_report
+
+    cfg = BenchConfig(
+        ndofs_global=ndofs_global,
+        degree=args.degree,
+        qmode=args.qmode,
+        float_bits=args.float_bits,
+        nreps=args.nreps,
+        use_cg=args.cg,
+        mat_comp=args.mat_comp,
+        use_gauss=args.use_gauss,
+        geom_perturb_fact=args.geom_perturb_fact,
+        platform=args.platform,
+        ndevices=ndevices,
+    )
+
+    dev = devices[0]
+    info = f"Device: {dev.platform}:{dev.device_kind} x{len(devices)}"
+    print(banner(cfg, info))
+
+    res = run_benchmark(cfg)
+
+    comp_type = "CG" if cfg.use_cg else "Action"
+    print(f"Computation time ({comp_type}): {res.mat_free_time}s")
+    print(f"Computation rate (Gdofs/s): {res.gdof_per_second}")
+    print(f"Norm of u = {res.unorm}")
+    print(f"Norm of y = {res.ynorm}")
+    if cfg.mat_comp:
+        print(f"Norm of z = {res.znorm}")
+        print(f"Norm of error = {res.enorm}")
+        print(f"Relative norm of error = {res.enorm / res.znorm if res.znorm else float('nan')}")
+
+    out = results_json(cfg, res)
+    if args.json:
+        with open(args.json, "w") as fh:
+            fh.write(out + "\n")
+        print(f"*** Writing output to: {args.json}")
+    else:
+        print(out)
+
+    print(timer_report())
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

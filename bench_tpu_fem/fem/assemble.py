@@ -78,6 +78,25 @@ def assemble_csr(
     return A
 
 
+def csr_cg_reference(A: sp.csr_matrix, b: np.ndarray, niter: int) -> np.ndarray:
+    """Fixed-iteration unpreconditioned CG through the assembled matrix — the
+    oracle counterpart of the device CG, same recurrence as the reference
+    `cg_solve` (/root/reference/src/cg.hpp:89-169) with rtol = 0."""
+    x, r = np.zeros_like(b), b.copy()
+    p = r.copy()
+    rnorm = float(p @ r)
+    for _ in range(niter):
+        y = A @ p
+        alpha = rnorm / float(p @ y)
+        x = x + alpha * p
+        r = r - alpha * y
+        rnorm_new = float(r @ r)
+        beta = rnorm_new / rnorm
+        rnorm = rnorm_new
+        p = beta * p + r
+    return x
+
+
 def assemble_rhs(
     tables: OperatorTables,
     wdetJ: np.ndarray,

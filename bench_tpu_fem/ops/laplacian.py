@@ -29,7 +29,7 @@ import numpy as np
 
 from ..elements.tables import OperatorTables, build_operator_tables
 from ..mesh.box import BoxMesh
-from ..mesh.dofmap import boundary_dof_marker, dof_grid_shape
+from ..mesh.dofmap import boundary_dof_marker
 from .geometry import geometry_factors_jax
 
 

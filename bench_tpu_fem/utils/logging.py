@@ -1,0 +1,18 @@
+"""Logging setup (replaces spdlog + dolfinx init_logging,
+/root/reference/src/main.cpp:229, util.cpp)."""
+
+from __future__ import annotations
+
+import logging
+
+
+def init_logging(level: str = "info") -> logging.Logger:
+    logger = logging.getLogger("bench_tpu_fem")
+    if not logger.handlers:
+        handler = logging.StreamHandler()
+        handler.setFormatter(
+            logging.Formatter("[%(asctime)s] [%(levelname)s] %(message)s", "%H:%M:%S")
+        )
+        logger.addHandler(handler)
+    logger.setLevel(getattr(logging, level.upper(), logging.INFO))
+    return logger

@@ -1,0 +1,54 @@
+"""Named wall-clock timer registry.
+
+Replaces `dolfinx::common::Timer` + `list_timings` (MPI_MAX aggregated table,
+/root/reference/src/main.cpp:314, laplacian_solver.cpp:90,174-198). Timers
+accumulate by name in a process-local registry; `timer_report` renders the
+table (in a multi-host deployment the driver max-reduces across hosts before
+printing; single-controller JAX runs have one registry).
+"""
+
+from __future__ import annotations
+
+import time
+from collections import defaultdict
+
+_registry: dict[str, list[float]] = defaultdict(list)
+
+
+class Timer:
+    """Context manager: `with Timer("% assemble"): ...`"""
+
+    def __init__(self, name: str):
+        self.name = name
+        self.elapsed = 0.0
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self.elapsed = time.perf_counter() - self._t0
+        _registry[self.name].append(self.elapsed)
+        return False
+
+
+def timings() -> dict[str, dict[str, float]]:
+    return {
+        name: {
+            "count": len(vals),
+            "total": sum(vals),
+            "max": max(vals),
+        }
+        for name, vals in _registry.items()
+    }
+
+
+def timer_report() -> str:
+    rows = [f"{'Timer':<40s} {'count':>6s} {'total (s)':>12s} {'max (s)':>12s}"]
+    for name, t in sorted(timings().items()):
+        rows.append(f"{name:<40s} {t['count']:>6d} {t['total']:>12.4f} {t['max']:>12.4f}")
+    return "\n".join(rows)
+
+
+def reset_timers() -> None:
+    _registry.clear()

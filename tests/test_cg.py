@@ -22,7 +22,13 @@ def test_cg_solves_spd_system():
     A = M @ M.T + 40 * np.eye(40)
     b = rng.randn(40)
     Aj = jnp.asarray(A)
-    x = cg_solve(lambda v: Aj @ v, jnp.asarray(b), jnp.zeros(40), max_iter=200)
+    # rtol freezes the iteration once converged; running a small system for
+    # many more iterations than its dimension would otherwise reach an exact
+    # zero residual and a 0/0 alpha (the reference CG shares this property —
+    # its rtol=0 benchmark mode never runs to exact convergence).
+    x = cg_solve(
+        lambda v: Aj @ v, jnp.asarray(b), jnp.zeros(40), max_iter=200, rtol=1e-12
+    )
     np.testing.assert_allclose(np.asarray(x), np.linalg.solve(A, b), rtol=1e-8)
 
 
@@ -47,21 +53,9 @@ def test_cg_fixed_iterations_matches_csr_cg():
     x_mf = cg_solve(op.apply, jnp.asarray(b), jnp.zeros_like(jnp.asarray(b)), k)
 
     # Same CG, same iteration count, on the CSR matrix.
-    def apply_csr(v):
-        return (A @ np.asarray(v).ravel()).reshape(bc.shape)
+    from bench_tpu_fem.fem.assemble import csr_cg_reference
 
-    x, r = np.zeros_like(b), b.copy()
-    p = r.copy()
-    rnorm = float((p.ravel() @ r.ravel()))
-    for _ in range(k):
-        y = apply_csr(p)
-        alpha = rnorm / float(p.ravel() @ y.ravel())
-        x = x + alpha * p
-        r = r - alpha * y
-        rnorm_new = float(r.ravel() @ r.ravel())
-        beta = rnorm_new / rnorm
-        rnorm = rnorm_new
-        p = beta * p + r
+    x = csr_cg_reference(A, b.ravel(), k).reshape(bc.shape)
     np.testing.assert_allclose(np.asarray(x_mf), x, rtol=1e-9, atol=1e-12)
 
 

@@ -1,0 +1,62 @@
+"""End-to-end driver/CLI tests mirroring the reference CI assertions
+(/root/reference/src/test_output.py + .github/workflows/ci.yml there)."""
+
+import json
+
+import numpy as np
+import pytest
+
+from bench_tpu_fem.bench.driver import BenchConfig, run_benchmark
+from bench_tpu_fem.bench.reporting import results_json
+
+
+def test_golden_e2e_action():
+    cfg = BenchConfig(
+        ndofs_global=1000, degree=3, qmode=0, nreps=1, mat_comp=True, ndevices=1
+    )
+    res = run_benchmark(cfg)
+    assert res.ndofs_global == 1000
+    assert np.isclose(res.ynorm, res.znorm)
+    assert np.isclose(res.ynorm, 9.912865833415553)
+    data = json.loads(results_json(cfg, res))
+    assert data["output"]["ndofs_global"] == 1000
+    assert np.isclose(data["output"]["y_norm"], 9.912865833415553)
+
+
+def test_e2e_cg_mat_comp_agrees():
+    cfg = BenchConfig(
+        ndofs_global=1000,
+        degree=2,
+        qmode=1,
+        nreps=4,
+        use_cg=True,
+        mat_comp=True,
+        geom_perturb_fact=0.1,
+        ndevices=1,
+    )
+    res = run_benchmark(cfg)
+    assert res.enorm / res.znorm < 1e-12
+
+
+def test_e2e_float32_runs():
+    cfg = BenchConfig(
+        ndofs_global=1000, degree=3, qmode=1, float_bits=32, nreps=2, ndevices=1
+    )
+    res = run_benchmark(cfg)
+    assert res.ynorm > 0 and np.isfinite(res.ynorm)
+
+
+def test_cli_conflicting_dof_flags():
+    from bench_tpu_fem.cli import main
+
+    with pytest.raises(SystemExit):
+        main(["--ndofs", "5000", "--ndofs_global", "100000"])
+    # Explicitly-passed default value still conflicts (main.cpp:192-196).
+    with pytest.raises(SystemExit):
+        main(["--ndofs", "1000", "--ndofs_global", "100000"])
+
+
+def test_nreps_zero_action_returns_zero_vector():
+    cfg = BenchConfig(ndofs_global=1000, degree=2, qmode=1, nreps=0, ndevices=1)
+    res = run_benchmark(cfg)
+    assert res.ynorm == 0.0
