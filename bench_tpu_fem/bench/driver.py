@@ -77,12 +77,14 @@ class BenchmarkResults:
     extra: dict = field(default_factory=dict)
 
 
-def _setup_problem(cfg: BenchConfig):
+def _setup_problem(cfg: BenchConfig, n: tuple[int, int, int] | None = None):
     """Shared host-side setup: mesh, tables, RHS (the oracle-precision f64
-    path, as the reference assembles its RHS on the CPU)."""
+    path, as the reference assembles its RHS on the CPU). The host geometry
+    tensor G is only materialised when the mat_comp oracle needs it."""
     from ..mesh.sizing import compute_mesh_size
 
-    n = compute_mesh_size(cfg.ndofs_global, cfg.degree)
+    if n is None:
+        n = compute_mesh_size(cfg.ndofs_global, cfg.degree)
     rule = "gauss" if cfg.use_gauss else "gll"
     t = build_operator_tables(cfg.degree, cfg.qmode, rule)
     mesh = create_box_mesh(n, geom_perturb_fact=cfg.geom_perturb_fact)
@@ -94,7 +96,10 @@ def _setup_problem(cfg: BenchConfig):
         f = default_source(coords).ravel()
         dm = cell_dofmap(n, cfg.degree)
         G_host, wdetJ = geometry_factors(
-            mesh.cell_corners.reshape(-1, 2, 2, 2, 3), t.pts1d, t.wts1d
+            mesh.cell_corners.reshape(-1, 2, 2, 2, 3),
+            t.pts1d,
+            t.wts1d,
+            compute_G=cfg.mat_comp,
         )
         b = assemble_rhs(t, wdetJ, dm, f, bc_grid.ravel()).reshape(grid_shape)
 
@@ -111,20 +116,17 @@ def run_benchmark(cfg: BenchConfig) -> BenchmarkResults:
         raise ValueError("Invalid float size. Must be 32 or 64.")
     dtype = jnp.float64 if cfg.float_bits == 64 else jnp.float32
 
+    if cfg.ndevices > 1:
+        from ..dist.driver import run_distributed
+
+        res = BenchmarkResults(nreps=cfg.nreps)
+        return run_distributed(cfg, res, dtype)
+
     n, rule, t, mesh, grid_shape, bc_grid, dm, b_host, G_host = _setup_problem(cfg)
     ndofs_global = int(np.prod(grid_shape))
     res = BenchmarkResults(
         ncells_global=mesh.ncells, ndofs_global=ndofs_global, nreps=cfg.nreps
     )
-
-    if cfg.ndevices > 1:
-        try:
-            from ..dist.driver import run_distributed
-        except ImportError as exc:
-            raise NotImplementedError(
-                "multi-device path requires bench_tpu_fem.dist"
-            ) from exc
-        return run_distributed(cfg, n, rule, t, mesh, bc_grid, b_host, res, dtype)
 
     with Timer("% Create matfree operator"):
         op = build_laplacian(mesh, cfg.degree, cfg.qmode, rule, kappa=2.0, dtype=dtype, tables=t)

@@ -1,0 +1,132 @@
+"""Distributed benchmark driver: the multi-chip `laplace_action`
+(/root/reference/src/laplacian_solver.cpp:65-230 under `mpirun`, one rank per
+GPU). Owns its setup because the mesh size must be divisible by the device
+grid (weak scaling: `--ndofs` is per device, main.cpp:237-240)."""
+
+from __future__ import annotations
+
+import time
+from functools import partial
+
+import numpy as np
+
+from ..la.cg import cg_solve
+from ..utils.timing import Timer
+from .halo import masked_dot, owned_mask
+from .mesh import AXIS_NAMES, compute_mesh_size_sharded, make_device_grid
+from .operator import (
+    build_dist_laplacian,
+    shard_grid_blocks,
+    unshard_grid_blocks,
+)
+
+
+def make_sharded_fns(op, dgrid, nreps: int):
+    """Build jittable sharded callables: one operator apply, one full CG
+    solve, and a masked global norm — each a single shard_map computation."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    spec = P(*AXIS_NAMES)
+    rep = P()
+
+    def _local(a):
+        return a[0, 0, 0]
+
+    @partial(
+        jax.shard_map,
+        mesh=dgrid.mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )
+    def apply_fn(x, G, bc):
+        y = op.apply_local(_local(x), _local(G), _local(bc))
+        return y[None, None, None]
+
+    @partial(
+        jax.shard_map,
+        mesh=dgrid.mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )
+    def cg_fn(b, G, bc):
+        bl, Gl, bcl = _local(b), _local(G), _local(bc)
+        mask = owned_mask(bl.shape)
+        x = cg_solve(
+            lambda v: op.apply_local(v, Gl, bcl),
+            bl,
+            jnp.zeros_like(bl),
+            nreps,
+            dot=lambda u, v: masked_dot(u, v, mask),
+        )
+        return x[None, None, None]
+
+    @partial(
+        jax.shard_map,
+        mesh=dgrid.mesh,
+        in_specs=spec,
+        out_specs=rep,
+    )
+    def norm_fn(x):
+        xl = _local(x)
+        mask = owned_mask(xl.shape)
+        return jnp.sqrt(masked_dot(xl, xl, mask))
+
+    return apply_fn, cg_fn, norm_fn
+
+
+def run_distributed(cfg, res, dtype):
+    """Multi-device benchmark. Fills and returns `res` (BenchmarkResults)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ..bench.driver import _setup_problem
+
+    dgrid = make_device_grid(cfg.ndevices)
+    n = compute_mesh_size_sharded(cfg.ndofs_global, cfg.degree, dgrid.dshape)
+    n, rule, t, mesh, grid_shape, bc_grid, dm, b_host, G_host = _setup_problem(cfg, n)
+
+    res.ncells_global = mesh.ncells
+    res.ndofs_global = int(np.prod(grid_shape))
+
+    with Timer("% Create matfree operator"):
+        op = build_dist_laplacian(mesh, dgrid, cfg.degree, t, kappa=2.0, dtype=dtype)
+        sharding = NamedSharding(dgrid.mesh, P(*AXIS_NAMES))
+        u_blocks = shard_grid_blocks(b_host, n, cfg.degree, dgrid.dshape)
+        u = jax.device_put(jnp.asarray(u_blocks, dtype=dtype), sharding)
+
+        apply_fn, cg_fn, norm_fn = make_sharded_fns(op, dgrid, cfg.nreps)
+        if cfg.use_cg:
+            fn = jax.jit(cg_fn).lower(u, op.G, op.bc_mask).compile()
+        else:
+            fn = jax.jit(apply_fn).lower(u, op.G, op.bc_mask).compile()
+        norm_c = jax.jit(norm_fn).lower(u).compile()
+
+    t0 = time.perf_counter()
+    if cfg.use_cg:
+        y = fn(u, op.G, op.bc_mask)
+    else:
+        y = jnp.zeros_like(u)
+        for _ in range(cfg.nreps):
+            y = fn(u, op.G, op.bc_mask)
+    y.block_until_ready()
+    elapsed = time.perf_counter() - t0
+
+    res.mat_free_time = elapsed
+    res.unorm = float(norm_c(u))
+    res.ynorm = float(norm_c(y))
+    res.gdof_per_second = res.ndofs_global * cfg.nreps / (1e9 * elapsed)
+
+    if cfg.mat_comp:
+        from ..bench.driver import _mat_comp_oracle
+
+        z = _mat_comp_oracle(cfg, t, dm, bc_grid, b_host, G_host)
+        y_global = unshard_grid_blocks(
+            np.asarray(y, dtype=np.float64), n, cfg.degree, dgrid.dshape
+        )
+        e = y_global - z
+        res.znorm = float(np.linalg.norm(z))
+        res.enorm = float(np.linalg.norm(e))
+    return res

@@ -1,0 +1,103 @@
+"""Device mesh construction and cell-grid block partitioning.
+
+Replaces ParMETIS partitioning + the custom vertex-ghost repartitioner
+(/root/reference/src/mesh.cpp:26-114): on a structured box the partition is a
+closed-form block decomposition, so "partitioning" a 19B-dof mesh is free
+(the reference spends minutes in ParMETIS at that scale, examples/slurm.out).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+AXIS_NAMES = ("dx", "dy", "dz")
+
+
+def factor_devices(n: int) -> tuple[int, int, int]:
+    """Factor a device count into a near-cubic 3D mesh shape (descending)."""
+    if n < 1:
+        raise ValueError("need at least one device")
+    best = (n, 1, 1)
+    best_cost = None
+    for a in range(1, n + 1):
+        if n % a:
+            continue
+        m = n // a
+        for b in range(1, m + 1):
+            if m % b:
+                continue
+            c = m // b
+            dims = tuple(sorted((a, b, c), reverse=True))
+            cost = max(dims) / min(dims)
+            if best_cost is None or cost < best_cost:
+                best, best_cost = dims, cost
+    return best
+
+
+@dataclass(frozen=True)
+class DeviceGrid:
+    """A 3D jax.sharding.Mesh over the devices plus partition bookkeeping."""
+
+    mesh: object  # jax.sharding.Mesh with axes ("dx","dy","dz")
+    dshape: tuple[int, int, int]
+
+    @property
+    def ndevices(self) -> int:
+        return int(np.prod(self.dshape))
+
+
+def make_device_grid(
+    n_devices: int | None = None,
+    dshape: tuple[int, int, int] | None = None,
+    devices=None,
+) -> DeviceGrid:
+    import jax
+    from jax.sharding import Mesh
+
+    if devices is None:
+        devices = jax.devices()
+    if dshape is None:
+        dshape = factor_devices(n_devices or len(devices))
+    nd = int(np.prod(dshape))
+    if nd > len(devices):
+        raise ValueError(f"device mesh {dshape} needs {nd} devices, have {len(devices)}")
+    dev_array = np.array(devices[:nd]).reshape(dshape)
+    return DeviceGrid(mesh=Mesh(dev_array, AXIS_NAMES), dshape=tuple(dshape))
+
+
+def shard_cells(n: tuple[int, int, int], dshape: tuple[int, int, int]) -> tuple[int, int, int]:
+    """Cells per shard along each axis; requires exact divisibility (the
+    distributed mesh-sizing search guarantees it)."""
+    out = []
+    for ni, di in zip(n, dshape):
+        if ni % di:
+            raise ValueError(f"mesh size {n} not divisible by device mesh {dshape}")
+        out.append(ni // di)
+    return tuple(out)
+
+
+def compute_mesh_size_sharded(
+    ndofs_global: int, degree: int, dshape: tuple[int, int, int]
+) -> tuple[int, int, int]:
+    """Like mesh.sizing.compute_mesh_size (/root/reference/src/mesh.cpp:117-152)
+    but constrained to cell counts divisible by the device-mesh shape."""
+    nx_approx = (ndofs_global ** (1.0 / 3.0) - 1.0) / degree
+    n0 = max(1, int(nx_approx + 0.5))
+    best, best_misfit = None, None
+    cands = []
+    for di in dshape:
+        base = max(di, (n0 // di) * di)
+        c = sorted(
+            {max(di, base + k * di) for k in range(-5, 7)}
+        )
+        cands.append(c)
+    for cx in cands[0]:
+        for cy in cands[1]:
+            for cz in cands[2]:
+                ndofs = (cx * degree + 1) * (cy * degree + 1) * (cz * degree + 1)
+                misfit = abs(ndofs - ndofs_global)
+                if best_misfit is None or misfit < best_misfit:
+                    best, best_misfit = (cx, cy, cz), misfit
+    return best

@@ -1,0 +1,185 @@
+"""Distributed matrix-free Laplacian: per-shard state + shard-level apply.
+
+The distributed analogue of `MatFreeLaplacianGPU` (/root/reference/src/
+laplacian.hpp:89-447). Each shard holds the geometry tensor for its own cell
+block and the local slice of the Dirichlet marker; `apply_local` runs inside
+`jax.shard_map` and performs
+
+    halo_refresh -> gather -> sum-factorised kernel -> fold -> reverse_scatter
+
+which is the reference's scatter_fwd / lcell+bcell compute / atomicAdd
+pipeline collapsed into per-axis ICI neighbour collectives (see dist/halo.py).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..elements.tables import OperatorTables
+from ..mesh.box import BoxMesh
+from ..mesh.dofmap import boundary_dof_marker
+from ..ops.laplacian import _sumfact_cell_apply, fold_cells, gather_cells
+from .halo import halo_refresh, masked_dot, owned_mask, reverse_scatter_add
+from .mesh import shard_cells
+
+
+@partial(
+    jax.tree_util.register_dataclass,
+    data_fields=["G", "phi0", "dphi1", "bc_mask", "kappa"],
+    meta_fields=["n_local", "degree", "is_identity", "dshape"],
+)
+@dataclass(frozen=True)
+class DistLaplacian:
+    """Stacked per-shard operator state. Array leading axes (Dx, Dy, Dz) are
+    sharded over the device grid; `apply_local` sees one shard's block."""
+
+    G: jnp.ndarray  # (Dx,Dy,Dz, ncells_local, 6, nq,nq,nq)
+    phi0: jnp.ndarray  # (nq, nd) replicated
+    dphi1: jnp.ndarray  # (nq, nq) replicated
+    bc_mask: jnp.ndarray  # (Dx,Dy,Dz, Lx,Ly,Lz) bool
+    kappa: jnp.ndarray  # scalar
+    n_local: tuple[int, int, int]  # cells per shard
+    degree: int
+    is_identity: bool
+    dshape: tuple[int, int, int]
+
+    def apply_local(self, x_local: jnp.ndarray, G_local, bc_local) -> jnp.ndarray:
+        """y = A x for one shard's block (call inside shard_map)."""
+        x = halo_refresh(x_local)
+        xm = jnp.where(bc_local, 0, x)
+        u = gather_cells(xm, self.n_local, self.degree)
+        y = _sumfact_cell_apply(
+            u, G_local, self.phi0, self.dphi1, self.kappa, self.is_identity
+        )
+        y_grid = fold_cells(y, self.n_local, self.degree)
+        y_grid = reverse_scatter_add(y_grid)
+        return jnp.where(bc_local, x, y_grid)
+
+
+def local_grid_shape(n_local: tuple[int, int, int], degree: int) -> tuple[int, int, int]:
+    """Local dof block shape: owned planes plus the leading ghost plane."""
+    return tuple(ni * degree + 1 for ni in n_local)
+
+
+def shard_grid_blocks(
+    grid: np.ndarray, n: tuple[int, int, int], degree: int, dshape: tuple[int, int, int]
+) -> np.ndarray:
+    """Slice a global dof grid (NX, NY, NZ[, ...]) into overlapping local
+    blocks, stacked as (Dx, Dy, Dz, Lx, Ly, Lz[, ...])."""
+    P = degree
+    ncl = shard_cells(n, dshape)
+    L = local_grid_shape(ncl, degree)
+    out = np.empty((*dshape, *L, *grid.shape[3:]), dtype=grid.dtype)
+    for i in range(dshape[0]):
+        for j in range(dshape[1]):
+            for k in range(dshape[2]):
+                x0, y0, z0 = i * ncl[0] * P, j * ncl[1] * P, k * ncl[2] * P
+                out[i, j, k] = grid[
+                    x0 : x0 + L[0], y0 : y0 + L[1], z0 : z0 + L[2]
+                ]
+    return out
+
+
+def unshard_grid_blocks(
+    blocks: np.ndarray, n: tuple[int, int, int], degree: int, dshape: tuple[int, int, int]
+) -> np.ndarray:
+    """Inverse of shard_grid_blocks: reassemble the global grid from owned
+    planes (ghost plane 0 of non-first shards is dropped)."""
+    P = degree
+    ncl = shard_cells(n, dshape)
+    N = tuple(ni * degree + 1 for ni in n)
+    out = np.empty(N, dtype=blocks.dtype)
+    for i in range(dshape[0]):
+        for j in range(dshape[1]):
+            for k in range(dshape[2]):
+                blk = blocks[i, j, k]
+                sx = 0 if i == 0 else 1
+                sy = 0 if j == 0 else 1
+                sz = 0 if k == 0 else 1
+                x0, y0, z0 = i * ncl[0] * P, j * ncl[1] * P, k * ncl[2] * P
+                out[
+                    x0 + sx : x0 + blk.shape[0],
+                    y0 + sy : y0 + blk.shape[1],
+                    z0 + sz : z0 + blk.shape[2],
+                ] = blk[sx:, sy:, sz:]
+    return out
+
+
+def shard_cell_corners(
+    mesh: BoxMesh, dshape: tuple[int, int, int]
+) -> np.ndarray:
+    """(Dx, Dy, Dz, ncells_local, 2, 2, 2, 3) per-shard cell corners."""
+    ncl = shard_cells(mesh.n, dshape)
+    corners = mesh.cell_corners  # (nx, ny, nz, 2,2,2,3)
+    out = np.empty((*dshape, int(np.prod(ncl)), 2, 2, 2, 3), dtype=corners.dtype)
+    for i in range(dshape[0]):
+        for j in range(dshape[1]):
+            for k in range(dshape[2]):
+                blk = corners[
+                    i * ncl[0] : (i + 1) * ncl[0],
+                    j * ncl[1] : (j + 1) * ncl[1],
+                    k * ncl[2] : (k + 1) * ncl[2],
+                ]
+                out[i, j, k] = blk.reshape(-1, 2, 2, 2, 3)
+    return out
+
+
+def build_dist_laplacian(
+    mesh: BoxMesh,
+    dgrid,
+    degree: int,
+    tables: OperatorTables,
+    kappa: float = 2.0,
+    dtype=jnp.float64,
+) -> DistLaplacian:
+    """Build stacked per-shard operator state. The geometry tensor is computed
+    *on device, per shard* inside shard_map (each shard einsums only its own
+    cells — the distributed analogue of `compute_geometry`,
+    laplacian.hpp:238-272)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ..ops.geometry import geometry_factors_jax
+    from .mesh import AXIS_NAMES
+
+    t = tables
+    dshape = dgrid.dshape
+    corners_host = shard_cell_corners(mesh, dshape).astype(
+        np.float64 if dtype == jnp.float64 else np.float32
+    )
+    spec = P(*AXIS_NAMES)
+    sharding = NamedSharding(dgrid.mesh, spec)
+    corners = jax.device_put(jnp.asarray(corners_host), sharding)
+
+    @partial(
+        jax.shard_map,
+        mesh=dgrid.mesh,
+        in_specs=spec,
+        out_specs=spec,
+    )
+    def shard_geometry(c):
+        G, _ = geometry_factors_jax(c[0, 0, 0], t.pts1d, t.wts1d)
+        return G[None, None, None]
+
+    G = shard_geometry(corners)
+
+    ncl = shard_cells(mesh.n, dshape)
+    bc_global = boundary_dof_marker(mesh.n, degree)
+    bc_blocks = shard_grid_blocks(bc_global, mesh.n, degree, dshape)
+    bc = jax.device_put(jnp.asarray(bc_blocks), sharding)
+
+    return DistLaplacian(
+        G=G,
+        phi0=jnp.asarray(t.phi0, dtype=dtype),
+        dphi1=jnp.asarray(t.dphi1, dtype=dtype),
+        bc_mask=bc,
+        kappa=jnp.asarray(kappa, dtype=dtype),
+        n_local=ncl,
+        degree=degree,
+        is_identity=t.is_identity,
+        dshape=dshape,
+    )

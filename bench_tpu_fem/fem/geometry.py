@@ -43,13 +43,14 @@ def jacobians(corners: np.ndarray, pts1d: np.ndarray) -> np.ndarray:
 
 
 def geometry_factors(
-    corners: np.ndarray, pts1d: np.ndarray, wts1d: np.ndarray
-) -> tuple[np.ndarray, np.ndarray]:
+    corners: np.ndarray, pts1d: np.ndarray, wts1d: np.ndarray, compute_G: bool = True
+) -> tuple[np.ndarray | None, np.ndarray]:
     """Return (G, wdetJ).
 
     G:     (ncells, 6, nq, nq, nq) with components ordered
            (G00, G01, G02, G11, G12, G22) — same packing as the reference
-           (geometry_cpu.hpp:92-109).
+           (geometry_cpu.hpp:92-109); None when compute_G is False (the RHS
+           mass form needs only wdetJ, and G is ~6x its size).
     wdetJ: (ncells, nq, nq, nq) = quadrature weight * det(J).
     """
     corners = np.asarray(corners).reshape(-1, 2, 2, 2, 3)
@@ -66,6 +67,8 @@ def geometry_factors(
     detJ = np.einsum("...i,...i->...", cols[0], K[..., 0, :])
     w = np.asarray(wts1d)
     w3 = w[:, None, None] * w[None, :, None] * w[None, None, :]
+    if not compute_G:
+        return None, w3[None] * detJ
     scale = w3[None] / detJ
     pairs = [(0, 0), (0, 1), (0, 2), (1, 1), (1, 2), (2, 2)]
     G = np.stack(
