@@ -1,0 +1,76 @@
+#!/usr/bin/env python
+"""Benchmark entry point for the driver: runs the flagship configuration on
+the available hardware and prints ONE JSON line:
+
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Flagship config (BASELINE.md): the reference's Q3 benchmark — degree 3,
+qmode 1, CG — measured as per-chip GDoF/s. The published reference number is
+4.02 GDoF/s per GPU (64x GH200, Q3-300M, f64, examples/Q3-300M.json in the
+reference repo); vs_baseline = value / 4.02.
+
+TPU note: the headline run uses f32 (TPU MXU/VPU native width; the reference
+uses f64, which TPUs only emulate). The mat_comp correctness oracle runs in
+f64 elsewhere (tests/, CLI --mat_comp); this file measures throughput.
+Problem size adapts downward if the chip's HBM cannot hold the default.
+"""
+
+import json
+import sys
+import time
+
+
+BASELINE_GDOF_PER_GPU = 4.02  # GH200 per-GPU, Q3-300M, reference examples/
+DEGREE, QMODE = 3, 1
+NREPS = 100  # CG iterations in the timed region (GDoF/s normalises by nreps)
+
+
+def run(ndofs: int) -> dict:
+    import jax
+
+    from bench_tpu_fem.bench.driver import BenchConfig, run_benchmark
+
+    ndev = len(jax.devices())
+    cfg = BenchConfig(
+        ndofs_global=ndofs * ndev,
+        degree=DEGREE,
+        qmode=QMODE,
+        float_bits=32,
+        nreps=NREPS,
+        use_cg=True,
+        ndevices=ndev,
+    )
+    res = run_benchmark(cfg)
+    per_chip = res.gdof_per_second / ndev
+    return {
+        "metric": "cg_gdof_per_s_per_chip_q3_f32",
+        "value": round(per_chip, 4),
+        "unit": "GDoF/s",
+        "vs_baseline": round(per_chip / BASELINE_GDOF_PER_GPU, 4),
+    }
+
+
+def main() -> int:
+    # Adaptive sizing: start at 50M dofs/chip, halve on OOM.
+    ndofs = int(sys.argv[1]) if len(sys.argv) > 1 else 50_000_000
+    last_err = None
+    while ndofs >= 500_000:
+        try:
+            out = run(ndofs)
+            print(json.dumps(out))
+            return 0
+        except (RuntimeError, MemoryError) as exc:  # XLA OOM surfaces as RuntimeError
+            msg = str(exc)
+            if "RESOURCE_EXHAUSTED" in msg or "Out of memory" in msg or "OOM" in msg.lower():
+                last_err = msg
+                ndofs //= 2
+                continue
+            raise
+    print(json.dumps({"metric": "cg_gdof_per_s_per_chip_q3_f32", "value": 0.0,
+                      "unit": "GDoF/s", "vs_baseline": 0.0,
+                      "error": f"could not fit problem: {last_err}"}))
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -81,23 +81,8 @@ def shard_cells(n: tuple[int, int, int], dshape: tuple[int, int, int]) -> tuple[
 def compute_mesh_size_sharded(
     ndofs_global: int, degree: int, dshape: tuple[int, int, int]
 ) -> tuple[int, int, int]:
-    """Like mesh.sizing.compute_mesh_size (/root/reference/src/mesh.cpp:117-152)
-    but constrained to cell counts divisible by the device-mesh shape."""
-    nx_approx = (ndofs_global ** (1.0 / 3.0) - 1.0) / degree
-    n0 = max(1, int(nx_approx + 0.5))
-    best, best_misfit = None, None
-    cands = []
-    for di in dshape:
-        base = max(di, (n0 // di) * di)
-        c = sorted(
-            {max(di, base + k * di) for k in range(-5, 7)}
-        )
-        cands.append(c)
-    for cx in cands[0]:
-        for cy in cands[1]:
-            for cz in cands[2]:
-                ndofs = (cx * degree + 1) * (cy * degree + 1) * (cz * degree + 1)
-                misfit = abs(ndofs - ndofs_global)
-                if best_misfit is None or misfit < best_misfit:
-                    best, best_misfit = (cx, cy, cz), misfit
-    return best
+    """Mesh sizing constrained to cell counts divisible by the device-mesh
+    shape (delegates to the shared search in mesh.sizing)."""
+    from ..mesh.sizing import compute_mesh_size
+
+    return compute_mesh_size(ndofs_global, degree, dshape)

@@ -22,7 +22,7 @@ import numpy as np
 
 from ..elements.tables import OperatorTables
 from ..mesh.box import BoxMesh
-from ..mesh.dofmap import boundary_dof_marker
+from ..mesh.dofmap import boundary_dof_marker, dof_grid_shape
 from ..ops.laplacian import _sumfact_cell_apply, fold_cells, gather_cells
 from .halo import halo_refresh, masked_dot, owned_mask, reverse_scatter_add
 from .mesh import shard_cells
@@ -31,7 +31,7 @@ from .mesh import shard_cells
 @partial(
     jax.tree_util.register_dataclass,
     data_fields=["G", "phi0", "dphi1", "bc_mask", "kappa"],
-    meta_fields=["n_local", "degree", "is_identity", "dshape"],
+    meta_fields=["n_local", "degree", "is_identity"],
 )
 @dataclass(frozen=True)
 class DistLaplacian:
@@ -46,7 +46,6 @@ class DistLaplacian:
     n_local: tuple[int, int, int]  # cells per shard
     degree: int
     is_identity: bool
-    dshape: tuple[int, int, int]
 
     def apply_local(self, x_local: jnp.ndarray, G_local, bc_local) -> jnp.ndarray:
         """y = A x for one shard's block (call inside shard_map)."""
@@ -62,8 +61,9 @@ class DistLaplacian:
 
 
 def local_grid_shape(n_local: tuple[int, int, int], degree: int) -> tuple[int, int, int]:
-    """Local dof block shape: owned planes plus the leading ghost plane."""
-    return tuple(ni * degree + 1 for ni in n_local)
+    """Local dof block shape: owned planes plus the leading ghost plane
+    (numerically the same formula as the global dof_grid_shape)."""
+    return dof_grid_shape(n_local, degree)
 
 
 def shard_grid_blocks(
@@ -92,7 +92,7 @@ def unshard_grid_blocks(
     planes (ghost plane 0 of non-first shards is dropped)."""
     P = degree
     ncl = shard_cells(n, dshape)
-    N = tuple(ni * degree + 1 for ni in n)
+    N = dof_grid_shape(n, degree)
     out = np.empty(N, dtype=blocks.dtype)
     for i in range(dshape[0]):
         for j in range(dshape[1]):
@@ -181,5 +181,4 @@ def build_dist_laplacian(
         n_local=ncl,
         degree=degree,
         is_identity=t.is_identity,
-        dshape=dshape,
     )

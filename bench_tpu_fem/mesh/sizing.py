@@ -11,22 +11,33 @@ from __future__ import annotations
 import numpy as np
 
 
-def compute_mesh_size(ndofs_global: int, degree: int) -> tuple[int, int, int]:
+def compute_mesh_size(
+    ndofs_global: int, degree: int, dshape: tuple[int, int, int] = (1, 1, 1)
+) -> tuple[int, int, int]:
+    """With dshape != (1,1,1), cell counts are constrained to multiples of the
+    device-mesh shape so the distributed block partition divides evenly; the
+    search is the same +/-5-steps-per-axis scan, in device-mesh strides."""
     nx_approx = (ndofs_global ** (1.0 / 3.0) - 1.0) / degree
     n0 = int(nx_approx + 0.5)
-    lo = max(1, n0 - 5)
-    cand = np.arange(lo, n0 + 6, dtype=np.int64)
-    ndofs_1d = cand * degree + 1
+
+    def candidates(d: int) -> np.ndarray:
+        base = max(d, round(max(1, n0) / d) * d)
+        return np.array(sorted({max(d, base + k * d) for k in range(-5, 6)}), dtype=np.int64)
+
+    cx, cy, cz = (candidates(d) for d in dshape)
+    ndx, ndy, ndz = (c * degree + 1 for c in (cx, cy, cz))
     misfit = np.abs(
-        ndofs_1d[:, None, None] * ndofs_1d[None, :, None] * ndofs_1d[None, None, :]
-        - ndofs_global
+        ndx[:, None, None] * ndy[None, :, None] * ndz[None, None, :] - ndofs_global
     )
-    best0 = (n0 * degree + 1) ** 3 - ndofs_global
-    best = (n0, n0, n0)
-    # Match the reference's scan order (first strict improvement wins).
-    flat = misfit.reshape(-1)
-    idx = int(np.argmin(flat))
-    if flat[idx] < abs(best0):
-        i, j, k = np.unravel_index(idx, misfit.shape)
-        best = (int(cand[i]), int(cand[j]), int(cand[k]))
-    return best
+    if dshape == (1, 1, 1):
+        # Match the reference's scan order (first strict improvement over the
+        # initial (n0, n0, n0) guess wins; ties keep the guess).
+        best0 = abs((n0 * degree + 1) ** 3 - ndofs_global)
+        flat = misfit.reshape(-1)
+        idx = int(np.argmin(flat))
+        if flat[idx] >= best0:
+            return (n0, n0, n0)
+    else:
+        idx = int(np.argmin(misfit.reshape(-1)))
+    i, j, k = np.unravel_index(idx, misfit.shape)
+    return (int(cx[i]), int(cy[j]), int(cz[k]))
