@@ -58,6 +58,7 @@ class BenchConfig:
     geom_perturb_fact: float = 0.0
     platform: str = "auto"  # "auto" | "tpu" | "cpu": jax default device
     ndevices: int = 1  # chips to shard over (1 = single-chip path)
+    backend: str = "auto"  # operator kernel: "auto" | "xla" | "pallas"
 
 
 @dataclass
@@ -106,6 +107,19 @@ def _setup_problem(cfg: BenchConfig, n: tuple[int, int, int] | None = None):
     return n, rule, t, mesh, grid_shape, bc_grid, dm, b, G_host
 
 
+def resolve_backend(backend: str, float_bits: int) -> str:
+    """'auto' -> Pallas kernel on a TPU f32 run, XLA otherwise (Mosaic has no
+    f64 path; CPU runs use the einsum path, interpret-mode Pallas is for
+    tests)."""
+    import jax
+
+    if backend != "auto":
+        return backend
+    if float_bits == 32 and jax.default_backend() == "tpu":
+        return "pallas"
+    return "xla"
+
+
 def run_benchmark(cfg: BenchConfig) -> BenchmarkResults:
     import jax
     import jax.numpy as jnp
@@ -129,7 +143,16 @@ def run_benchmark(cfg: BenchConfig) -> BenchmarkResults:
     )
 
     with Timer("% Create matfree operator"):
-        op = build_laplacian(mesh, cfg.degree, cfg.qmode, rule, kappa=2.0, dtype=dtype, tables=t)
+        op = build_laplacian(
+            mesh,
+            cfg.degree,
+            cfg.qmode,
+            rule,
+            kappa=2.0,
+            dtype=dtype,
+            tables=t,
+            backend=resolve_backend(cfg.backend, cfg.float_bits),
+        )
         u = jnp.asarray(b_host, dtype=dtype)
         # AOT-compile outside the timed region (see module docstring).
         if cfg.use_cg:

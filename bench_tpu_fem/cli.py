@@ -45,6 +45,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--json", default="", help="Filename for JSON output")
     p.add_argument("--ndevices", type=int, default=0,
                    help="Devices to shard over (0 = all visible devices)")
+    p.add_argument("--backend", default="auto", choices=["auto", "xla", "pallas"],
+                   help="Operator kernel backend (auto: Pallas on TPU f32)")
     p.add_argument("--log-level", default="info")
     return p
 
@@ -106,6 +108,7 @@ def main(argv: list[str] | None = None) -> int:
         geom_perturb_fact=args.geom_perturb_fact,
         platform=args.platform,
         ndevices=ndevices,
+        backend=args.backend,
     )
 
     dev = devices[0]

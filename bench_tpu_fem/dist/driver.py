@@ -92,7 +92,12 @@ def run_distributed(cfg, res, dtype):
     res.ndofs_global = int(np.prod(grid_shape))
 
     with Timer("% Create matfree operator"):
-        op = build_dist_laplacian(mesh, dgrid, cfg.degree, t, kappa=2.0, dtype=dtype)
+        from ..bench.driver import resolve_backend
+
+        op = build_dist_laplacian(
+            mesh, dgrid, cfg.degree, t, kappa=2.0, dtype=dtype,
+            backend=resolve_backend(cfg.backend, cfg.float_bits),
+        )
         sharding = NamedSharding(dgrid.mesh, P(*AXIS_NAMES))
         u_blocks = shard_grid_blocks(b_host, n, cfg.degree, dgrid.dshape)
         u = jax.device_put(jnp.asarray(u_blocks, dtype=dtype), sharding)

@@ -23,7 +23,7 @@ import numpy as np
 from ..elements.tables import OperatorTables
 from ..mesh.box import BoxMesh
 from ..mesh.dofmap import boundary_dof_marker, dof_grid_shape
-from ..ops.laplacian import _sumfact_cell_apply, fold_cells, gather_cells
+from ..ops.laplacian import cell_apply, fold_cells, gather_cells
 from .halo import halo_refresh, masked_dot, owned_mask, reverse_scatter_add
 from .mesh import shard_cells
 
@@ -31,7 +31,7 @@ from .mesh import shard_cells
 @partial(
     jax.tree_util.register_dataclass,
     data_fields=["G", "phi0", "dphi1", "bc_mask", "kappa"],
-    meta_fields=["n_local", "degree", "is_identity"],
+    meta_fields=["n_local", "degree", "is_identity", "backend"],
 )
 @dataclass(frozen=True)
 class DistLaplacian:
@@ -46,14 +46,16 @@ class DistLaplacian:
     n_local: tuple[int, int, int]  # cells per shard
     degree: int
     is_identity: bool
+    backend: str = "xla"
 
     def apply_local(self, x_local: jnp.ndarray, G_local, bc_local) -> jnp.ndarray:
         """y = A x for one shard's block (call inside shard_map)."""
         x = halo_refresh(x_local)
         xm = jnp.where(bc_local, 0, x)
         u = gather_cells(xm, self.n_local, self.degree)
-        y = _sumfact_cell_apply(
-            u, G_local, self.phi0, self.dphi1, self.kappa, self.is_identity
+        y = cell_apply(
+            u, G_local, self.phi0, self.dphi1, self.kappa, self.is_identity,
+            backend=self.backend, g_cells_last=self.backend == "pallas",
         )
         y_grid = fold_cells(y, self.n_local, self.degree)
         y_grid = reverse_scatter_add(y_grid)
@@ -136,6 +138,7 @@ def build_dist_laplacian(
     tables: OperatorTables,
     kappa: float = 2.0,
     dtype=jnp.float64,
+    backend: str = "xla",
 ) -> DistLaplacian:
     """Build stacked per-shard operator state. The geometry tensor is computed
     *on device, per shard* inside shard_map (each shard einsums only its own
@@ -163,6 +166,10 @@ def build_dist_laplacian(
     )
     def shard_geometry(c):
         G, _ = geometry_factors_jax(c[0, 0, 0], t.pts1d, t.wts1d)
+        if backend == "pallas":
+            from ..ops.pallas_laplacian import cells_last_G
+
+            G = cells_last_G(G)
         return G[None, None, None]
 
     G = shard_geometry(corners)
@@ -181,4 +188,5 @@ def build_dist_laplacian(
         n_local=ncl,
         degree=degree,
         is_identity=t.is_identity,
+        backend=backend,
     )

@@ -80,6 +80,40 @@ def fold_cells(
     return a.transpose(2, 0, 1)
 
 
+def cell_apply(
+    u_cells: jnp.ndarray,
+    G: jnp.ndarray,
+    phi0: jnp.ndarray,
+    dphi1: jnp.ndarray,
+    kappa,
+    is_identity: bool,
+    backend: str = "xla",
+    g_cells_last: bool = False,
+) -> jnp.ndarray:
+    """Per-cell stiffness apply, dispatching to the XLA einsum chain or the
+    Pallas TPU kernel (ops.pallas_laplacian). Operators built with
+    backend='pallas' store G cells-last (g_cells_last=True)."""
+    if backend == "pallas":
+        from .pallas_laplacian import pallas_cell_apply
+
+        return pallas_cell_apply(
+            u_cells,
+            G,
+            phi0,
+            dphi1,
+            jnp.asarray(kappa),
+            nd=u_cells.shape[-1],
+            nq=phi0.shape[0],
+            is_identity=is_identity,
+            g_cells_last=g_cells_last,
+        )
+    if backend != "xla":
+        raise ValueError(f"unknown operator backend '{backend}'")
+    if g_cells_last:
+        G = jnp.moveaxis(G, -1, 0)
+    return _sumfact_cell_apply(u_cells, G, phi0, dphi1, kappa, is_identity)
+
+
 def _sumfact_cell_apply(
     u: jnp.ndarray,
     G: jnp.ndarray,
@@ -120,13 +154,16 @@ def _sumfact_cell_apply(
 @partial(
     jax.tree_util.register_dataclass,
     data_fields=["G", "phi0", "dphi1", "bc_mask", "kappa"],
-    meta_fields=["n", "degree", "is_identity"],
+    meta_fields=["n", "degree", "is_identity", "backend"],
 )
 @dataclass(frozen=True)
 class Laplacian:
-    """Matrix-free Laplacian operator state (a pytree; `n`, `degree` and
-    `is_identity` are static so `apply` specialises per configuration, like
-    the reference's template dispatch)."""
+    """Matrix-free Laplacian operator state (a pytree; `n`, `degree`,
+    `is_identity` and `backend` are static so `apply` specialises per
+    configuration, like the reference's template dispatch).
+
+    backend: "xla" (batched einsums, any dtype) or "pallas" (TPU kernel,
+    f32/bf16; see ops.pallas_laplacian)."""
 
     G: jnp.ndarray  # (ncells, 6, nq, nq, nq) weighted geometry tensor
     phi0: jnp.ndarray  # (nq, nd) interpolation matrix
@@ -136,13 +173,15 @@ class Laplacian:
     n: tuple[int, int, int]
     degree: int
     is_identity: bool
+    backend: str = "xla"
 
     def apply(self, x_grid: jnp.ndarray) -> jnp.ndarray:
         """y = A @ x on the dof grid, with Dirichlet pass-through rows."""
         xm = jnp.where(self.bc_mask, 0, x_grid)
         u = gather_cells(xm, self.n, self.degree)
-        y = _sumfact_cell_apply(
-            u, self.G, self.phi0, self.dphi1, self.kappa, self.is_identity
+        y = cell_apply(
+            u, self.G, self.phi0, self.dphi1, self.kappa, self.is_identity,
+            backend=self.backend, g_cells_last=self.backend == "pallas",
         )
         y_grid = fold_cells(y, self.n, self.degree)
         return jnp.where(self.bc_mask, x_grid, y_grid)
@@ -156,6 +195,7 @@ def build_laplacian(
     kappa: float = 2.0,
     dtype=jnp.float64,
     tables: OperatorTables | None = None,
+    backend: str = "xla",
 ) -> Laplacian:
     """Assemble operator state from a mesh: tables host-side (f64), geometry
     tensor on device (mirrors MatFreeLaplacianGPU's constructor,
@@ -163,6 +203,10 @@ def build_laplacian(
     t = tables or build_operator_tables(degree, qmode, rule)
     corners = jnp.asarray(mesh.cell_corners.reshape(-1, 2, 2, 2, 3), dtype=dtype)
     G, _ = geometry_factors_jax(corners, t.pts1d, t.wts1d)
+    if backend == "pallas":
+        from .pallas_laplacian import cells_last_G
+
+        G = cells_last_G(G)
     bc = jnp.asarray(boundary_dof_marker(mesh.n, degree))
     return Laplacian(
         G=G,
@@ -173,4 +217,5 @@ def build_laplacian(
         n=mesh.n,
         degree=degree,
         is_identity=t.is_identity,
+        backend=backend,
     )

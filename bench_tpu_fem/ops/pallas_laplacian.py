@@ -1,0 +1,193 @@
+"""Pallas TPU kernel for the per-cell sum-factorised stiffness apply.
+
+TPU re-design of `stiffness_operator_gpu` (/root/reference/src/
+laplacian_gpu.hpp:91-426). The GPU kernel maps one thread block per cell with
+Q^3 threads and shared-memory scratch; on TPU a single cell's (P+1)^3 working
+set is microscopic next to the 8x128 vector lanes, so instead:
+
+- cells are batched along the 128-wide *lane* axis (`NC` cells per grid
+  step), with the tensor-product index occupying the sublane axis;
+- every sum-factorisation stage is then one (small x small) @ (small x
+  big-batch) matmul streaming over the lane dimension — MXU work with all
+  intermediates held in VMEM (the analogue of the GPU kernel's shared-memory
+  scratch, but for hundreds of cells at once);
+- the geometry tensor G is streamed HBM -> VMEM once per block, which is the
+  dominant memory traffic (6 * Q^3 values/cell), exactly as in the reference.
+
+The kernel computes gathered-cell -> per-cell-contribution; the structured
+gather/fold (dofmap application) stays outside in XLA (see ops.laplacian).
+float64 is not supported by Mosaic — callers fall back to the XLA einsum path.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+DEFAULT_BLOCK_CELLS = 512
+_VMEM_BUDGET_BYTES = 10 * 1024 * 1024  # leave headroom in the ~16 MB VMEM
+
+
+def pick_block_cells(nd: int, nq: int, itemsize: int = 4) -> int:
+    """Largest 128-multiple cell-batch whose per-block VMEM working set
+    (G: 6*nq^3, intermediates: ~8*nq^3, u/y: 2*nd^3 values per cell) fits
+    the budget, capped at DEFAULT_BLOCK_CELLS."""
+    per_cell = (6 * nq**3 + 8 * nq**3 + 2 * nd**3) * itemsize
+    nc = (_VMEM_BUDGET_BYTES // per_cell) // 128 * 128
+    return int(max(128, min(DEFAULT_BLOCK_CELLS, nc)))
+
+
+def cells_last_G(G: jnp.ndarray) -> jnp.ndarray:
+    """Re-lay the geometry tensor (C, 6, nq, nq, nq) -> (6, nq, nq, nq, C)
+    once at operator build time, so the per-iteration apply streams it
+    without a transposing copy (G is the dominant HBM traffic)."""
+    return jnp.moveaxis(G, 0, -1)
+
+
+def _stage(mat: jnp.ndarray, arr: jnp.ndarray, axis: int, nd3: tuple[int, int, int], nc: int):
+    """Contract `mat` (m, n) against tensor axis `axis` of `arr`, which is
+    laid out (n0, n1, n2, NC) with cells last. Returns the new array with
+    that axis replaced by m. The contraction is expressed as a single 2D
+    matmul (m, n) @ (n, rest*NC) after rotating `axis` to the front."""
+    n0, n1, n2 = nd3
+    if axis == 0:
+        a2 = arr.reshape(n0, n1 * n2 * nc)
+        out = jnp.dot(mat, a2, preferred_element_type=arr.dtype)
+        return out.reshape(mat.shape[0], n1, n2, nc)
+    if axis == 1:
+        a = jnp.moveaxis(arr, 1, 0).reshape(n1, n0 * n2 * nc)
+        out = jnp.dot(mat, a, preferred_element_type=arr.dtype)
+        return jnp.moveaxis(out.reshape(mat.shape[0], n0, n2, nc), 0, 1)
+    a = jnp.moveaxis(arr, 2, 0).reshape(n2, n0 * n1 * nc)
+    out = jnp.dot(mat, a, preferred_element_type=arr.dtype)
+    return jnp.moveaxis(out.reshape(mat.shape[0], n0, n1, nc), 0, 2)
+
+
+def _make_kernel(nd: int, nq: int, nc: int, is_identity: bool):
+    def kernel(u_ref, g_ref, phi0_ref, dphi1_ref, kappa_ref, out_ref):
+        u = u_ref[...]  # (nd, nd, nd, NC)
+        phi0 = phi0_ref[...]
+        dphi1 = dphi1_ref[...]
+        kappa = kappa_ref[0, 0]
+
+        if not is_identity:
+            u = _stage(phi0, u, 0, (nd, nd, nd), nc)
+            u = _stage(phi0, u, 1, (nq, nd, nd), nc)
+            u = _stage(phi0, u, 2, (nq, nq, nd), nc)
+
+        q3 = (nq, nq, nq)
+        du0 = _stage(dphi1, u, 0, q3, nc)
+        du1 = _stage(dphi1, u, 1, q3, nc)
+        du2 = _stage(dphi1, u, 2, q3, nc)
+
+        G = g_ref[...]  # (6, nq, nq, nq, NC)
+        f0 = kappa * (G[0] * du0 + G[1] * du1 + G[2] * du2)
+        f1 = kappa * (G[1] * du0 + G[3] * du1 + G[4] * du2)
+        f2 = kappa * (G[2] * du0 + G[4] * du1 + G[5] * du2)
+
+        dphi1_t = dphi1.T
+        y = _stage(dphi1_t, f0, 0, q3, nc)
+        y = y + _stage(dphi1_t, f1, 1, q3, nc)
+        y = y + _stage(dphi1_t, f2, 2, q3, nc)
+
+        if not is_identity:
+            phi0_t = phi0.T
+            y = _stage(phi0_t, y, 0, (nq, nq, nq), nc)
+            y = _stage(phi0_t, y, 1, (nd, nq, nq), nc)
+            y = _stage(phi0_t, y, 2, (nd, nd, nq), nc)
+
+        out_ref[...] = y
+
+    return kernel
+
+
+_warned_interpret = False
+
+
+def _use_interpret() -> bool:
+    """Interpret mode when not on a TPU backend (tests on CPU). Warns once:
+    interpret mode is a numerics tool, orders of magnitude slower than the
+    XLA path — never a benchmark configuration."""
+    global _warned_interpret
+    if jax.default_backend() != "tpu":
+        if not _warned_interpret:
+            import warnings
+
+            warnings.warn(
+                "Pallas backend on a non-TPU host runs in interpret mode "
+                "(testing only, very slow); use backend='xla' for CPU runs"
+            )
+            _warned_interpret = True
+        return True
+    return False
+
+
+@partial(
+    jax.jit,
+    static_argnames=(
+        "nd", "nq", "is_identity", "g_cells_last", "block_cells", "interpret"
+    ),
+)
+def pallas_cell_apply(
+    u_cells: jnp.ndarray,  # (C, nd, nd, nd)
+    G: jnp.ndarray,  # (C, 6, nq, nq, nq) or cells-last (6, nq, nq, nq, C)
+    phi0: jnp.ndarray,  # (nq, nd)
+    dphi1: jnp.ndarray,  # (nq, nq)
+    kappa: jnp.ndarray,  # scalar
+    nd: int,
+    nq: int,
+    is_identity: bool,
+    g_cells_last: bool = False,
+    block_cells: int | None = None,
+    interpret: bool | None = None,
+) -> jnp.ndarray:
+    """Drop-in replacement for ops.laplacian._sumfact_cell_apply backed by the
+    Pallas kernel. Pads the cell count to a block multiple, transposes to the
+    cells-last layout, and grids over cell blocks. Pass G pre-transposed
+    (g_cells_last=True, see cells_last_G) to keep the per-apply hot path free
+    of layout copies."""
+    C = u_cells.shape[0]
+    dtype = u_cells.dtype
+    if block_cells is None:
+        block_cells = pick_block_cells(nd, nq, np.dtype(dtype).itemsize)
+    nc = min(block_cells, max(128, 1 << (C - 1).bit_length()))
+    nblocks = pl.cdiv(C, nc)
+    Cp = nblocks * nc
+
+    u = jnp.moveaxis(u_cells, 0, -1)  # (nd, nd, nd, C)
+    g = G if g_cells_last else jnp.moveaxis(G, 0, -1)  # (6, nq, nq, nq, C)
+    if Cp != C:
+        u = jnp.pad(u, [(0, 0)] * 3 + [(0, Cp - C)])
+        g = jnp.pad(g, [(0, 0)] * 4 + [(0, Cp - C)])
+
+    kernel = _make_kernel(nd, nq, nc, is_identity)
+    out = pl.pallas_call(
+        kernel,
+        grid=(nblocks,),
+        in_specs=[
+            pl.BlockSpec(
+                (nd, nd, nd, nc), lambda i: (0, 0, 0, i), memory_space=pltpu.VMEM
+            ),
+            pl.BlockSpec(
+                (6, nq, nq, nq, nc),
+                lambda i: (0, 0, 0, 0, i),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.SMEM),
+        ],
+        out_specs=pl.BlockSpec(
+            (nd, nd, nd, nc), lambda i: (0, 0, 0, i), memory_space=pltpu.VMEM
+        ),
+        out_shape=jax.ShapeDtypeStruct((nd, nd, nd, Cp), dtype),
+        interpret=_use_interpret() if interpret is None else interpret,
+    )(u, g, phi0.astype(dtype), dphi1.astype(dtype), kappa.reshape(1, 1).astype(dtype))
+
+    out = jnp.moveaxis(out, -1, 0)[:C]
+    return out
