@@ -92,17 +92,27 @@ def _setup_problem(cfg: BenchConfig, n: tuple[int, int, int] | None = None):
     grid_shape = dof_grid_shape(n, cfg.degree)
     bc_grid = boundary_dof_marker(n, cfg.degree)
 
+    from ..fem import native
+
     with Timer("% Assemble RHS (host)"):
         coords = dof_coordinates(mesh.vertices, cfg.degree, t.nodes1d)
         f = default_source(coords).ravel()
         dm = cell_dofmap(n, cfg.degree)
-        G_host, wdetJ = geometry_factors(
-            mesh.cell_corners.reshape(-1, 2, 2, 2, 3),
-            t.pts1d,
-            t.wts1d,
-            compute_G=cfg.mat_comp,
-        )
-        b = assemble_rhs(t, wdetJ, dm, f, bc_grid.ravel()).reshape(grid_shape)
+        corners = mesh.cell_corners.reshape(-1, 2, 2, 2, 3)
+        bc_flat = bc_grid.ravel()
+        if native.available():
+            # C++ host path (native/benchfem_native.cpp) — same results as
+            # the numpy oracle (tests/test_native.py), without the large
+            # einsum intermediates.
+            G_host, wdetJ = native.geometry_factors(
+                corners, t.pts1d, t.wts1d, compute_G=cfg.mat_comp
+            )
+            b = native.assemble_rhs(t, wdetJ, dm, f, bc_flat).reshape(grid_shape)
+        else:
+            G_host, wdetJ = geometry_factors(
+                corners, t.pts1d, t.wts1d, compute_G=cfg.mat_comp
+            )
+            b = assemble_rhs(t, wdetJ, dm, f, bc_flat).reshape(grid_shape)
 
     return n, rule, t, mesh, grid_shape, bc_grid, dm, b, G_host
 
@@ -188,16 +198,21 @@ def run_benchmark(cfg: BenchConfig) -> BenchmarkResults:
 def _mat_comp_oracle(cfg, t, dm, bc_grid, b_host, G_host) -> np.ndarray:
     """Assembled-CSR comparison path (laplacian_solver.cpp:151-227): same
     number of operator applications or CG iterations through scipy CSR."""
+    from ..fem import native
     from ..fem.assemble import csr_cg_reference
 
+    use_native = native.available()
     with Timer("% Assemble CSR (oracle)"):
-        A = assemble_csr(
-            element_stiffness_matrices(t, G_host, 2.0), dm, bc_grid.ravel()
-        )
+        if use_native:
+            A = native.assemble_csr(t, G_host, 2.0, dm, bc_grid.ravel())
+        else:
+            A = assemble_csr(
+                element_stiffness_matrices(t, G_host, 2.0), dm, bc_grid.ravel()
+            )
     u = b_host.ravel()
     with Timer("% CSR Matvec"):
         if cfg.use_cg:
-            z = csr_cg_reference(A, u, cfg.nreps)
+            z = native.csr_cg(A, u, cfg.nreps) if use_native else csr_cg_reference(A, u, cfg.nreps)
         else:
-            z = A @ u
+            z = native.csr_spmv(A, u) if use_native else A @ u
     return z.reshape(b_host.shape)

@@ -13,6 +13,7 @@ from .geometry import geometry_factors
 from .assemble import (
     assemble_csr,
     assemble_rhs,
+    csr_cg_reference,
     element_stiffness_matrices,
 )
 from .source import default_source, interpolate
@@ -21,6 +22,7 @@ __all__ = [
     "geometry_factors",
     "assemble_csr",
     "assemble_rhs",
+    "csr_cg_reference",
     "element_stiffness_matrices",
     "default_source",
     "interpolate",

@@ -1,0 +1,193 @@
+"""ctypes bindings for the native (C++) runtime library.
+
+The reference's host runtime is C++ (mesh glue, DOLFINx CSR assembly, CPU
+geometry kernels); `native/benchfem_native.cpp` provides the equivalent
+pieces here, and this module exposes them behind the same signatures as the
+numpy implementations in bench_tpu_fem.fem. If the shared library has not
+been built (`make -C native`), callers fall back to numpy transparently via
+`available()`.
+
+Why native matters on the host path: the numpy oracle materialises the full
+(ncells, nd^3, nd^3) element-matrix batch plus ~3x that again in COO index/
+value arrays (~32 B per pre-merge entry); the C++ assembler computes element
+matrices cell-by-cell and buffers one 16-byte (col, value) pair per entry in
+a single build pass, roughly halving peak memory and skipping the big einsum
+temporaries on the way to the reference's nnz < 2^31 oracle limit
+(laplacian_solver.cpp:170-172).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+
+import numpy as np
+import scipy.sparse as sp
+
+_LIB = None
+_SEARCHED = False
+
+
+def _lib_path() -> str | None:
+    here = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    for cand in (
+        os.path.join(here, "native", "libbenchfem_native.so"),
+        os.path.join(here, "native", "build", "libbenchfem_native.so"),
+    ):
+        if os.path.exists(cand):
+            return cand
+    return None
+
+
+def _load():
+    global _LIB, _SEARCHED
+    if _SEARCHED:
+        return _LIB
+    _SEARCHED = True
+    path = _lib_path()
+    if path is None:
+        return None
+    lib = ctypes.CDLL(path)
+    f64p = ctypes.POINTER(ctypes.c_double)
+    i32p = ctypes.POINTER(ctypes.c_int32)
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    u8p = ctypes.POINTER(ctypes.c_uint8)
+
+    lib.geometry_factors_f64.argtypes = [
+        f64p, f64p, f64p, ctypes.c_int64, ctypes.c_int, ctypes.c_int,
+        f64p, f64p,
+    ]
+    lib.csr_build_f64.argtypes = [
+        f64p, f64p, i32p, u8p, ctypes.c_double, ctypes.c_int64,
+        ctypes.c_int, ctypes.c_int, ctypes.c_int64, i64p,
+    ]
+    lib.csr_build_f64.restype = ctypes.c_void_p
+    lib.csr_fill_f64.argtypes = [ctypes.c_void_p, i64p, i32p, f64p]
+    lib.csr_free_f64.argtypes = [ctypes.c_void_p]
+    lib.assemble_rhs_f64.argtypes = [
+        f64p, f64p, i32p, u8p, f64p, ctypes.c_int64, ctypes.c_int,
+        ctypes.c_int, ctypes.c_int64, f64p,
+    ]
+    lib.csr_spmv_f64.argtypes = [i64p, i32p, f64p, f64p, ctypes.c_int64, f64p]
+    lib.csr_cg_f64.argtypes = [
+        i64p, i32p, f64p, f64p, ctypes.c_int64, ctypes.c_int, f64p,
+    ]
+    _LIB = lib
+    return _LIB
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def _ptr(a: np.ndarray, ctype):
+    return a.ctypes.data_as(ctypes.POINTER(ctype))
+
+
+def geometry_factors(corners, pts1d, wts1d, compute_G: bool = True):
+    """Native twin of fem.geometry.geometry_factors (G is None when
+    compute_G is False — it is ~6x the size of wdetJ)."""
+    lib = _load()
+    corners = np.ascontiguousarray(corners, dtype=np.float64).reshape(-1, 2, 2, 2, 3)
+    pts = np.ascontiguousarray(pts1d, dtype=np.float64)
+    wts = np.ascontiguousarray(wts1d, dtype=np.float64)
+    ncells, nq = corners.shape[0], len(pts)
+    G = np.empty((ncells, 6, nq, nq, nq)) if compute_G else None
+    wdetj = np.empty((ncells, nq, nq, nq))
+    lib.geometry_factors_f64(
+        _ptr(corners, ctypes.c_double), _ptr(pts, ctypes.c_double),
+        _ptr(wts, ctypes.c_double), ncells, nq, int(compute_G),
+        _ptr(G, ctypes.c_double) if compute_G else None,
+        _ptr(wdetj, ctypes.c_double),
+    )
+    return G, wdetj
+
+
+def assemble_csr(tables, G, kappa, dofmap, bc_marker_flat) -> sp.csr_matrix:
+    """Native twin of fem.assemble.assemble_csr (which takes precomputed
+    element matrices; this one builds them cell-by-cell from the gradient
+    tables — assembly runs exactly once, then the CSR arrays are filled from
+    the build handle)."""
+    from .assemble import _grad_tables_3d
+
+    lib = _load()
+    D = np.ascontiguousarray(_grad_tables_3d(tables))
+    nq3, nd3 = tables.nq**3, tables.nd**3
+    G = np.ascontiguousarray(G, dtype=np.float64).reshape(-1, 6, nq3)
+    dofmap = np.ascontiguousarray(dofmap, dtype=np.int32)
+    bc = np.ascontiguousarray(bc_marker_flat, dtype=np.uint8)
+    ncells, nrows = dofmap.shape[0], len(bc)
+
+    nnz = np.zeros(1, dtype=np.int64)
+    handle = lib.csr_build_f64(
+        _ptr(G, ctypes.c_double), _ptr(D, ctypes.c_double),
+        _ptr(dofmap, ctypes.c_int32), _ptr(bc, ctypes.c_uint8),
+        float(kappa), ncells, nq3, nd3, nrows, _ptr(nnz, ctypes.c_int64),
+    )
+    row_ptr = np.empty(nrows + 1, dtype=np.int64)
+    cols = np.empty(int(nnz[0]), dtype=np.int32)
+    vals = np.empty(int(nnz[0]), dtype=np.float64)
+    lib.csr_fill_f64(
+        handle, _ptr(row_ptr, ctypes.c_int64), _ptr(cols, ctypes.c_int32),
+        _ptr(vals, ctypes.c_double),
+    )
+    return sp.csr_matrix((vals, cols, row_ptr), shape=(nrows, nrows))
+
+
+def csr_spmv(A: sp.csr_matrix, x: np.ndarray) -> np.ndarray:
+    """Native twin of the oracle SpMV (y = A x, cf. reference csr.hpp)."""
+    lib = _load()
+    row_ptr = np.ascontiguousarray(A.indptr, dtype=np.int64)
+    cols = np.ascontiguousarray(A.indices, dtype=np.int32)
+    vals = np.ascontiguousarray(A.data, dtype=np.float64)
+    x = np.ascontiguousarray(x, dtype=np.float64)
+    y = np.empty(A.shape[0], dtype=np.float64)
+    lib.csr_spmv_f64(
+        _ptr(row_ptr, ctypes.c_int64), _ptr(cols, ctypes.c_int32),
+        _ptr(vals, ctypes.c_double), _ptr(x, ctypes.c_double),
+        A.shape[0], _ptr(y, ctypes.c_double),
+    )
+    return y
+
+
+def assemble_rhs(tables, wdetJ, dofmap, f_dofs_flat, bc_marker_flat) -> np.ndarray:
+    """Native streaming twin of fem.assemble.assemble_rhs."""
+    from ..elements.lagrange import lagrange_eval
+
+    lib = _load()
+    phi = lagrange_eval(tables.nodes1d, tables.pts1d)
+    Phi = np.ascontiguousarray(
+        np.einsum("qi,rj,sk->qrsijk", phi, phi, phi).reshape(
+            tables.nq**3, tables.nd**3
+        )
+    )
+    wdetj = np.ascontiguousarray(wdetJ, dtype=np.float64).reshape(
+        -1, tables.nq**3
+    )
+    dofmap = np.ascontiguousarray(dofmap, dtype=np.int32)
+    bc = np.ascontiguousarray(bc_marker_flat, dtype=np.uint8)
+    f = np.ascontiguousarray(f_dofs_flat, dtype=np.float64)
+    b = np.empty(len(bc), dtype=np.float64)
+    lib.assemble_rhs_f64(
+        _ptr(wdetj, ctypes.c_double), _ptr(Phi, ctypes.c_double),
+        _ptr(dofmap, ctypes.c_int32), _ptr(bc, ctypes.c_uint8),
+        _ptr(f, ctypes.c_double), dofmap.shape[0], tables.nq**3,
+        tables.nd**3, len(bc), _ptr(b, ctypes.c_double),
+    )
+    return b
+
+
+def csr_cg(A: sp.csr_matrix, b: np.ndarray, niter: int) -> np.ndarray:
+    """Native twin of fem.assemble.csr_cg_reference."""
+    lib = _load()
+    row_ptr = np.ascontiguousarray(A.indptr, dtype=np.int64)
+    cols = np.ascontiguousarray(A.indices, dtype=np.int32)
+    vals = np.ascontiguousarray(A.data, dtype=np.float64)
+    b = np.ascontiguousarray(b, dtype=np.float64)
+    x = np.empty_like(b)
+    lib.csr_cg_f64(
+        _ptr(row_ptr, ctypes.c_int64), _ptr(cols, ctypes.c_int32),
+        _ptr(vals, ctypes.c_double), _ptr(b, ctypes.c_double),
+        len(b), niter, _ptr(x, ctypes.c_double),
+    )
+    return x

@@ -1,0 +1,323 @@
+#include <algorithm>
+// Native runtime components for bench-tpu-fem.
+//
+// The reference implements its host-side runtime in C++ (mesh/dofmap glue:
+// /root/reference/src/mesh.cpp; CSR assembly via DOLFINx; geometry kernels:
+// geometry_cpu.hpp). This library provides the equivalent native pieces for
+// the TPU framework's host path, exposed through a C ABI consumed with
+// ctypes (no pybind11 in the image):
+//
+//   - per-cell geometry factors (G tensor, w*detJ) from trilinear hex corners
+//   - streaming element-stiffness + CSR assembly (never materialises the
+//     (ncells, nd^3, nd^3) element-matrix batch the numpy oracle builds)
+//   - streaming RHS (mass-form) assembly
+//   - CSR SpMV and fixed-iteration CG for the oracle comparison path
+//
+// Everything is plain C++17 + OpenMP-free (single-thread determinism, same
+// as the reference's serial CPU assembly path).
+
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// Geometry: per cell and quadrature point, J = dx/dxi of the trilinear map,
+// K = adj(J), G = w * K K^T / detJ packed as 6 components, plus w*detJ.
+// Mirrors geometry_computation_cpu (/root/reference/src/geometry_cpu.hpp:
+// 25-112) with the same component packing; layouts here are
+//   corners: (ncells, 2, 2, 2, 3) row-major, offsets (a, b, c) on (x, y, z)
+//   G:       (ncells, 6, nq3)
+//   wdetj:   (ncells, nq3)
+// ---------------------------------------------------------------------------
+void geometry_factors_f64(const double* corners, const double* pts1d,
+                          const double* wts1d, int64_t ncells, int nq,
+                          int compute_G, double* G, double* wdetj)
+{
+  const int nq3 = nq * nq * nq;
+  std::vector<double> N(2 * nq), D(2);
+  for (int q = 0; q < nq; ++q)
+  {
+    N[2 * q + 0] = 1.0 - pts1d[q];
+    N[2 * q + 1] = pts1d[q];
+  }
+  D[0] = -1.0;
+  D[1] = 1.0;
+
+  for (int64_t c = 0; c < ncells; ++c)
+  {
+    const double* X = corners + c * 8 * 3; // (a,b,cc,dim)
+    for (int qx = 0; qx < nq; ++qx)
+      for (int qy = 0; qy < nq; ++qy)
+        for (int qz = 0; qz < nq; ++qz)
+        {
+          const int iq = (qx * nq + qy) * nq + qz;
+          // J[i][a] = sum_{abc} X[a][b][c][i] * (D or N) per axis
+          double J[3][3] = {{0}};
+          for (int a = 0; a < 2; ++a)
+            for (int b = 0; b < 2; ++b)
+              for (int cc = 0; cc < 2; ++cc)
+              {
+                const double* x = X + ((a * 2 + b) * 2 + cc) * 3;
+                const double n0 = N[2 * qx + a], n1 = N[2 * qy + b],
+                             n2 = N[2 * qz + cc];
+                const double d0 = D[a] * n1 * n2;
+                const double d1 = n0 * D[b] * n2;
+                const double d2 = n0 * n1 * D[cc];
+                for (int i = 0; i < 3; ++i)
+                {
+                  J[i][0] += x[i] * d0;
+                  J[i][1] += x[i] * d1;
+                  J[i][2] += x[i] * d2;
+                }
+              }
+          // K rows = cross products of J columns (adjugate)
+          double K[3][3];
+          for (int a = 0; a < 3; ++a)
+          {
+            const int a1 = (a + 1) % 3, a2 = (a + 2) % 3;
+            K[a][0] = J[1][a1] * J[2][a2] - J[2][a1] * J[1][a2];
+            K[a][1] = J[2][a1] * J[0][a2] - J[0][a1] * J[2][a2];
+            K[a][2] = J[0][a1] * J[1][a2] - J[1][a1] * J[0][a2];
+          }
+          const double detJ
+              = J[0][0] * K[0][0] + J[1][0] * K[0][1] + J[2][0] * K[0][2];
+          const double w = wts1d[qx] * wts1d[qy] * wts1d[qz];
+          if (compute_G)
+          {
+            const double s = w / detJ;
+            double* g = G + (c * 6) * nq3 + iq;
+            const int pairs[6][2] = {{0, 0}, {0, 1}, {0, 2},
+                                     {1, 1}, {1, 2}, {2, 2}};
+            for (int p = 0; p < 6; ++p)
+            {
+              const int a = pairs[p][0], b = pairs[p][1];
+              g[p * nq3] = s
+                           * (K[a][0] * K[b][0] + K[a][1] * K[b][1]
+                              + K[a][2] * K[b][2]);
+            }
+          }
+          wdetj[c * nq3 + iq] = w * detJ;
+        }
+  }
+}
+
+// ---------------------------------------------------------------------------
+// CSR assembly of the stiffness matrix, single build.
+//
+// Element matrices A_e[i,j] = kappa * sum_q sum_ab G[ab](q) D_a[q,i] D_b[q,j]
+// are computed one cell at a time from the 3D gradient tables D (3, nq3, nd3)
+// — the (ncells, nd3, nd3) element batch is never materialised (the numpy
+// oracle's peak is ~32 B per pre-merge entry across its element/COO arrays;
+// this build holds one 16-byte pair per entry). Dirichlet handling matches
+// DOLFINx assemble_matrix + set_diagonal
+// (/root/reference/src/laplacian_solver.cpp:182-184): constrained rows and
+// columns are skipped, then the diagonal is set to 1.
+//
+// Protocol (assembly runs once): csr_build_f64 returns an opaque handle and
+// the total nnz; the caller allocates row_ptr/cols/vals and calls
+// csr_fill_f64, which also frees the handle.
+// ---------------------------------------------------------------------------
+struct CsrBuild
+{
+  std::vector<std::vector<std::pair<int32_t, double>>> rows;
+};
+
+void* csr_build_f64(const double* G, const double* Dtab,
+                    const int32_t* dofmap, const uint8_t* bc, double kappa,
+                    int64_t ncells, int nq3, int nd3, int64_t nrows,
+                    int64_t* nnz_out)
+{
+  auto* build = new CsrBuild;
+  auto& rows = build->rows;
+  rows.resize(nrows);
+
+  std::vector<double> Ae(nd3 * nd3), flux(3 * nd3);
+  for (int64_t c = 0; c < ncells; ++c)
+  {
+    const int32_t* dofs = dofmap + c * nd3;
+    const double* g = G + c * 6 * nq3;
+    // A_e = sum_q D^T (G(q) D) * kappa
+    std::fill(Ae.begin(), Ae.end(), 0.0);
+    for (int q = 0; q < nq3; ++q)
+    {
+      const double g0 = g[0 * nq3 + q], g1 = g[1 * nq3 + q],
+                   g2 = g[2 * nq3 + q], g3 = g[3 * nq3 + q],
+                   g4 = g[4 * nq3 + q], g5 = g[5 * nq3 + q];
+      const double* D0 = Dtab + (0 * nq3 + q) * nd3;
+      const double* D1 = Dtab + (1 * nq3 + q) * nd3;
+      const double* D2 = Dtab + (2 * nq3 + q) * nd3;
+      for (int j = 0; j < nd3; ++j)
+      {
+        flux[0 * nd3 + j] = g0 * D0[j] + g1 * D1[j] + g2 * D2[j];
+        flux[1 * nd3 + j] = g1 * D0[j] + g3 * D1[j] + g4 * D2[j];
+        flux[2 * nd3 + j] = g2 * D0[j] + g4 * D1[j] + g5 * D2[j];
+      }
+      for (int i = 0; i < nd3; ++i)
+      {
+        const double d0 = D0[i], d1 = D1[i], d2 = D2[i];
+        double* arow = Ae.data() + i * nd3;
+        const double* f0 = flux.data();
+        const double* f1 = flux.data() + nd3;
+        const double* f2 = flux.data() + 2 * nd3;
+        for (int j = 0; j < nd3; ++j)
+          arow[j] += d0 * f0[j] + d1 * f1[j] + d2 * f2[j];
+      }
+    }
+    for (int i = 0; i < nd3; ++i)
+    {
+      const int32_t r = dofs[i];
+      if (bc[r])
+        continue;
+      auto& row = rows[r];
+      for (int j = 0; j < nd3; ++j)
+      {
+        const int32_t cdof = dofs[j];
+        if (bc[cdof])
+          continue;
+        row.emplace_back(cdof, kappa * Ae[i * nd3 + j]);
+      }
+    }
+  }
+  // Unit diagonal on constrained dofs.
+  for (int64_t r = 0; r < nrows; ++r)
+    if (bc[r])
+      rows[r].emplace_back((int32_t)r, 1.0);
+
+  // Merge duplicates per row (sort by column, accumulate).
+  for (int64_t r = 0; r < nrows; ++r)
+  {
+    auto& row = rows[r];
+    std::sort(row.begin(), row.end(),
+              [](auto& a, auto& b) { return a.first < b.first; });
+    int64_t w = 0;
+    for (int64_t k = 0; k < (int64_t)row.size(); ++k)
+    {
+      if (w > 0 && row[w - 1].first == row[k].first)
+        row[w - 1].second += row[k].second;
+      else
+        row[w++] = row[k];
+    }
+    row.resize(w);
+  }
+
+  int64_t nnz = 0;
+  for (int64_t r = 0; r < nrows; ++r)
+    nnz += (int64_t)rows[r].size();
+  *nnz_out = nnz;
+  return build;
+}
+
+void csr_fill_f64(void* handle, int64_t* row_ptr, int32_t* cols, double* vals)
+{
+  auto* build = static_cast<CsrBuild*>(handle);
+  int64_t off = 0;
+  row_ptr[0] = 0;
+  int64_t r = 0;
+  for (auto& row : build->rows)
+  {
+    for (auto& [cdof, v] : row)
+    {
+      cols[off] = cdof;
+      vals[off] = v;
+      ++off;
+    }
+    row_ptr[++r] = off;
+  }
+  delete build;
+}
+
+void csr_free_f64(void* handle) { delete static_cast<CsrBuild*>(handle); }
+
+// ---------------------------------------------------------------------------
+// Streaming RHS (mass form) assembly:
+// b[dof_i] += sum_q wdetj(q) * Phi[q,i] * (sum_j Phi[q,j] f[dof_j]),
+// then b = 0 on Dirichlet dofs (bc.set with g=0,
+// /root/reference/src/laplacian_solver.cpp:100-105).
+// ---------------------------------------------------------------------------
+void assemble_rhs_f64(const double* wdetj, const double* Phi,
+                      const int32_t* dofmap, const uint8_t* bc,
+                      const double* f, int64_t ncells, int nq3, int nd3,
+                      int64_t ndofs, double* b)
+{
+  std::memset(b, 0, sizeof(double) * ndofs);
+  std::vector<double> fe(nd3), fq(nq3);
+  for (int64_t c = 0; c < ncells; ++c)
+  {
+    const int32_t* dofs = dofmap + c * nd3;
+    for (int i = 0; i < nd3; ++i)
+      fe[i] = f[dofs[i]];
+    const double* w = wdetj + c * nq3;
+    for (int q = 0; q < nq3; ++q)
+    {
+      const double* p = Phi + q * nd3;
+      double acc = 0;
+      for (int j = 0; j < nd3; ++j)
+        acc += p[j] * fe[j];
+      fq[q] = w[q] * acc;
+    }
+    for (int i = 0; i < nd3; ++i)
+    {
+      double acc = 0;
+      for (int q = 0; q < nq3; ++q)
+        acc += Phi[q * nd3 + i] * fq[q];
+      b[dofs[i]] += acc;
+    }
+  }
+  for (int64_t d = 0; d < ndofs; ++d)
+    if (bc[d])
+      b[d] = 0.0;
+}
+
+// ---------------------------------------------------------------------------
+// CSR SpMV: y = A x  (oracle operator apply, cf. csr.hpp spmv_impl)
+// ---------------------------------------------------------------------------
+void csr_spmv_f64(const int64_t* row_ptr, const int32_t* cols,
+                  const double* vals, const double* x, int64_t nrows,
+                  double* y)
+{
+  for (int64_t r = 0; r < nrows; ++r)
+  {
+    double acc = 0;
+    for (int64_t k = row_ptr[r]; k < row_ptr[r + 1]; ++k)
+      acc += vals[k] * x[cols[k]];
+    y[r] = acc;
+  }
+}
+
+// ---------------------------------------------------------------------------
+// Fixed-iteration unpreconditioned CG on CSR (oracle CG,
+// same recurrence as /root/reference/src/cg.hpp:89-169 with rtol = 0).
+// ---------------------------------------------------------------------------
+void csr_cg_f64(const int64_t* row_ptr, const int32_t* cols,
+                const double* vals, const double* b, int64_t n, int niter,
+                double* x)
+{
+  std::vector<double> r(b, b + n), p(b, b + n), y(n);
+  std::memset(x, 0, sizeof(double) * n);
+  double rnorm = 0;
+  for (int64_t i = 0; i < n; ++i)
+    rnorm += r[i] * r[i];
+  for (int it = 0; it < niter; ++it)
+  {
+    csr_spmv_f64(row_ptr, cols, vals, p.data(), n, y.data());
+    double py = 0;
+    for (int64_t i = 0; i < n; ++i)
+      py += p[i] * y[i];
+    const double alpha = rnorm / py;
+    double rnorm_new = 0;
+    for (int64_t i = 0; i < n; ++i)
+    {
+      x[i] += alpha * p[i];
+      r[i] -= alpha * y[i];
+      rnorm_new += r[i] * r[i];
+    }
+    const double beta = rnorm_new / rnorm;
+    rnorm = rnorm_new;
+    for (int64_t i = 0; i < n; ++i)
+      p[i] = beta * p[i] + r[i];
+  }
+}
+
+} // extern "C"

@@ -1,0 +1,76 @@
+"""Native (C++/ctypes) runtime vs numpy oracle parity. Skipped when the
+shared library hasn't been built (`make -C native`)."""
+
+import numpy as np
+import pytest
+
+from bench_tpu_fem.elements import build_operator_tables
+from bench_tpu_fem.fem import (
+    assemble_csr,
+    assemble_rhs,
+    csr_cg_reference,
+    default_source,
+    element_stiffness_matrices,
+    geometry_factors,
+)
+from bench_tpu_fem.fem import native
+from bench_tpu_fem.mesh import (
+    boundary_dof_marker,
+    cell_dofmap,
+    create_box_mesh,
+    dof_coordinates,
+)
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native library not built"
+)
+
+
+@pytest.fixture(scope="module")
+def problem():
+    n, degree, qmode = (2, 3, 2), 3, 1
+    mesh = create_box_mesh(n, geom_perturb_fact=0.2)
+    t = build_operator_tables(degree, qmode)
+    corners = mesh.cell_corners.reshape(-1, 2, 2, 2, 3)
+    dm = cell_dofmap(n, degree)
+    bc = boundary_dof_marker(n, degree).ravel()
+    return n, degree, mesh, t, corners, dm, bc
+
+
+def test_native_geometry_matches_numpy(problem):
+    _, _, _, t, corners, _, _ = problem
+    G_np, w_np = geometry_factors(corners, t.pts1d, t.wts1d)
+    G_c, w_c = native.geometry_factors(corners, t.pts1d, t.wts1d)
+    np.testing.assert_allclose(G_c, G_np, rtol=1e-13, atol=1e-15)
+    np.testing.assert_allclose(w_c, np.broadcast_to(w_np, w_c.shape), rtol=1e-13)
+
+
+def test_native_csr_assembly_matches_numpy(problem):
+    _, _, _, t, corners, dm, bc = problem
+    G, _ = geometry_factors(corners, t.pts1d, t.wts1d)
+    A_np = assemble_csr(element_stiffness_matrices(t, G, 2.0), dm, bc)
+    A_c = native.assemble_csr(t, G, 2.0, dm, bc)
+    d = abs(A_np - A_c)
+    assert d.max() < 1e-11 * max(1.0, abs(A_np).max())
+
+
+def test_native_rhs_matches_numpy(problem):
+    n, degree, mesh, t, corners, dm, bc = problem
+    _, wdetJ = geometry_factors(corners, t.pts1d, t.wts1d)
+    coords = dof_coordinates(mesh.vertices, degree, t.nodes1d)
+    f = default_source(coords).ravel()
+    b_np = assemble_rhs(t, wdetJ, dm, f, bc)
+    b_c = native.assemble_rhs(t, np.broadcast_to(wdetJ, (len(dm), t.nq, t.nq, t.nq)), dm, f, bc)
+    np.testing.assert_allclose(b_c, b_np, rtol=1e-12, atol=1e-15)
+
+
+def test_native_cg_matches_numpy(problem):
+    _, _, _, t, corners, dm, bc = problem
+    G, _ = geometry_factors(corners, t.pts1d, t.wts1d)
+    A = assemble_csr(element_stiffness_matrices(t, G, 2.0), dm, bc)
+    rng = np.random.RandomState(1)
+    b = rng.randn(A.shape[0])
+    b[bc] = 0.0
+    x_np = csr_cg_reference(A, b, 15)
+    x_c = native.csr_cg(A, b, 15)
+    np.testing.assert_allclose(x_c, x_np, rtol=1e-10, atol=1e-13)
