@@ -68,6 +68,18 @@ def main(argv: list[str] | None = None) -> int:
 
     init_logging(args.log_level)
 
+    # Hermetic CPU runs (multi-device sharding on one host, CI) must pin the
+    # host platform before any backend initialises — see utils.hermetic for
+    # why plain JAX_PLATFORMS=cpu is not enough under the axon TPU tunnel.
+    import os
+
+    if args.platform == "cpu" or (
+        args.platform == "auto" and os.environ.get("JAX_PLATFORMS", "") == "cpu"
+    ):
+        from .utils.hermetic import force_host_cpu_devices
+
+        force_host_cpu_devices(max(args.ndevices, 1))
+
     # x64 must be configured before device arrays exist.
     import jax
 

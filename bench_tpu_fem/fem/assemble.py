@@ -18,6 +18,15 @@ from ..elements.lagrange import lagrange_eval, lagrange_eval_deriv
 from ..elements.tables import OperatorTables
 
 
+def _phi_table_3d(tables: OperatorTables) -> np.ndarray:
+    """Phi[q, i]: 3D basis function i at 3D quadrature point q (both in
+    row-major (x, y, z) order)."""
+    phi = lagrange_eval(tables.nodes1d, tables.pts1d)  # (nq, nd)
+    return np.einsum("qi,rj,sk->qrsijk", phi, phi, phi).reshape(
+        tables.nq**3, tables.nd**3
+    )
+
+
 def _grad_tables_3d(tables: OperatorTables) -> np.ndarray:
     """D[a, q, i]: derivative along reference axis a of 3D basis function i at
     3D quadrature point q (q and i in row-major (x, y, z) order)."""
@@ -112,10 +121,7 @@ def assemble_rhs(
     /root/reference/src/laplacian_solver.cpp:100-105 for the mass form
     L = inner(w0, v)*dx (/root/reference/src/poisson64.py:66).
     """
-    phi = lagrange_eval(tables.nodes1d, tables.pts1d)  # (nq, nd)
-    Phi = np.einsum("qi,rj,sk->qrsijk", phi, phi, phi).reshape(
-        tables.nq**3, tables.nd**3
-    )
+    Phi = _phi_table_3d(tables)
     fq = np.einsum("qi,ci->cq", Phi, f_dofs_flat[dofmap])
     be = np.einsum("cq,cq,qi->ci", wdetJ.reshape(len(dofmap), -1), fq, Phi)
     b = np.zeros(len(bc_marker_flat), dtype=be.dtype)

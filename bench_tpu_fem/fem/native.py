@@ -124,13 +124,20 @@ def assemble_csr(tables, G, kappa, dofmap, bc_marker_flat) -> sp.csr_matrix:
         _ptr(dofmap, ctypes.c_int32), _ptr(bc, ctypes.c_uint8),
         float(kappa), ncells, nq3, nd3, nrows, _ptr(nnz, ctypes.c_int64),
     )
-    row_ptr = np.empty(nrows + 1, dtype=np.int64)
-    cols = np.empty(int(nnz[0]), dtype=np.int32)
-    vals = np.empty(int(nnz[0]), dtype=np.float64)
-    lib.csr_fill_f64(
-        handle, _ptr(row_ptr, ctypes.c_int64), _ptr(cols, ctypes.c_int32),
-        _ptr(vals, ctypes.c_double),
-    )
+    try:
+        row_ptr = np.empty(nrows + 1, dtype=np.int64)
+        cols = np.empty(int(nnz[0]), dtype=np.int32)
+        vals = np.empty(int(nnz[0]), dtype=np.float64)
+        lib.csr_fill_f64(
+            handle, _ptr(row_ptr, ctypes.c_int64), _ptr(cols, ctypes.c_int32),
+            _ptr(vals, ctypes.c_double),
+        )
+    except BaseException:
+        # csr_fill_f64 frees the handle on success; on an allocation failure
+        # here the handle (holding the whole pre-merged matrix) would leak —
+        # exactly when memory is scarcest.
+        lib.csr_free_f64(handle)
+        raise
     return sp.csr_matrix((vals, cols, row_ptr), shape=(nrows, nrows))
 
 
@@ -152,15 +159,10 @@ def csr_spmv(A: sp.csr_matrix, x: np.ndarray) -> np.ndarray:
 
 def assemble_rhs(tables, wdetJ, dofmap, f_dofs_flat, bc_marker_flat) -> np.ndarray:
     """Native streaming twin of fem.assemble.assemble_rhs."""
-    from ..elements.lagrange import lagrange_eval
+    from .assemble import _phi_table_3d
 
     lib = _load()
-    phi = lagrange_eval(tables.nodes1d, tables.pts1d)
-    Phi = np.ascontiguousarray(
-        np.einsum("qi,rj,sk->qrsijk", phi, phi, phi).reshape(
-            tables.nq**3, tables.nd**3
-        )
-    )
+    Phi = np.ascontiguousarray(_phi_table_3d(tables))
     wdetj = np.ascontiguousarray(wdetJ, dtype=np.float64).reshape(
         -1, tables.nq**3
     )

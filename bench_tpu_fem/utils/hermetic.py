@@ -1,0 +1,60 @@
+"""Force JAX onto the host-CPU platform with N virtual devices.
+
+This is the TPU analogue of the reference CI's oversubscribed ``mpirun -n 2``
+(reference .github/workflows/ci.yml:100-106): multi-chip logic is exercised on
+one host by splitting the CPU into N XLA devices via
+``--xla_force_host_platform_device_count``.
+
+Complication: the axon TPU-tunnel PJRT plugin registers itself in every Python
+process via sitecustomize (which runs before any of our code) and monkeypatches
+JAX's backend selection so the axon backend is consulted even under
+``JAX_PLATFORMS=cpu``; if the tunnel is wedged, any JAX computation then hangs.
+Hermetic CPU runs (tests, the driver's multi-chip dry-run) must surgically undo
+the hook — the original function is held in the wrapper's closure — drop the
+axon backend factory, and pin the config to CPU before any backend initialises.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def force_host_cpu_devices(n_devices: int) -> None:
+    """Pin this process to the CPU platform with ``n_devices`` XLA devices.
+
+    Must be called before any JAX backend initialises (i.e. before the first
+    ``jax.devices()`` / jit execution). Safe to call when JAX is already
+    imported, as long as no backend client exists yet.
+    """
+    import re
+
+    os.environ["JAX_PLATFORMS"] = "cpu"  # also inherited by subprocesses
+    flags = os.environ.get("XLA_FLAGS", "")
+    # Never lower an existing count (a stale exported flag must not shrink the
+    # requested device mesh); raise it when the caller needs more devices.
+    m = re.search(r"--xla_force_host_platform_device_count=(\d+)", flags)
+    if m is None:
+        flags = (flags + f" --xla_force_host_platform_device_count={n_devices}").strip()
+    elif int(m.group(1)) < n_devices:
+        flags = (
+            flags[: m.start()]
+            + f"--xla_force_host_platform_device_count={n_devices}"
+            + flags[m.end():]
+        )
+    os.environ["XLA_FLAGS"] = flags
+
+    import jax
+    from jax._src import xla_bridge as _xb
+
+    hook = _xb._get_backend_uncached
+    if getattr(hook, "__name__", "") == "_axon_get_backend_uncached" and hook.__closure__:
+        for cell in hook.__closure__:
+            try:
+                v = cell.cell_contents
+            except ValueError:
+                continue
+            if callable(v) and getattr(v, "__name__", "") == "_get_backend_uncached":
+                _xb._get_backend_uncached = v
+                break
+    _xb._backend_factories.pop("axon", None)
+    jax.config.update("jax_platforms", "cpu")

@@ -1,39 +1,16 @@
 """Test configuration: force JAX onto the host CPU platform with 8 virtual
-devices (the TPU analogue of the reference CI's oversubscribed `mpirun -n 2`,
-see .github/workflows/ci.yml:100-106 there), and enable x64 so the f64
-correctness oracle runs at full precision.
-
-The axon TPU-tunnel PJRT plugin registers itself in every Python process via
-sitecustomize (which runs *before* conftest) and monkeypatches JAX's backend
-selection so the axon backend is consulted even under JAX_PLATFORMS=cpu; if
-the tunnel is wedged, any JAX computation then hangs. Tests must be hermetic,
-so we surgically undo the hook (the original function is held in the wrapper's
-closure), drop the axon backend factory, and pin the config to CPU before any
-backend initialises."""
+devices (see bench_tpu_fem.utils.hermetic for the mechanism and why), and
+enable x64 so the f64 correctness oracle runs at full precision."""
 
 import os
+import sys
 
-os.environ["JAX_PLATFORMS"] = "cpu"  # for any subprocesses tests spawn
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from bench_tpu_fem.utils.hermetic import force_host_cpu_devices  # noqa: E402
+
+force_host_cpu_devices(8)
 
 import jax  # noqa: E402
-from jax._src import xla_bridge as _xb  # noqa: E402
 
-_hook = _xb._get_backend_uncached
-if getattr(_hook, "__name__", "") == "_axon_get_backend_uncached" and _hook.__closure__:
-    for _cell in _hook.__closure__:
-        try:
-            _v = _cell.cell_contents
-        except ValueError:
-            continue
-        if callable(_v) and getattr(_v, "__name__", "") == "_get_backend_uncached":
-            _xb._get_backend_uncached = _v
-            break
-_xb._backend_factories.pop("axon", None)
-
-jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
