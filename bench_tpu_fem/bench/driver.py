@@ -164,22 +164,35 @@ def run_benchmark(cfg: BenchConfig) -> BenchmarkResults:
             backend=resolve_backend(cfg.backend, cfg.float_bits),
         )
         u = jnp.asarray(b_host, dtype=dtype)
-        # AOT-compile outside the timed region (see module docstring).
+        # AOT-compile outside the timed region (see module docstring). The
+        # operator is a pytree *argument*, not a closure capture: closed-over
+        # arrays become HLO constants, and the geometry tensor G (hundreds of
+        # MB at benchmark sizes) must stay an HBM-resident parameter.
         if cfg.use_cg:
             fn = jax.jit(
-                lambda b, x0: cg_solve(op.apply, b, x0, cfg.nreps)
-            ).lower(u, jnp.zeros_like(u)).compile()
+                lambda A, b, x0: cg_solve(A.apply, b, x0, cfg.nreps)
+            ).lower(op, u, jnp.zeros_like(u)).compile()
+            warm = fn(op, u, jnp.zeros_like(u))
         else:
-            fn = jax.jit(op.apply).lower(u).compile()
+            fn = jax.jit(lambda A, x: A.apply(x)).lower(op, u).compile()
+            warm = fn(op, u)
+        # One warm-up execution (fenced): first execution pays one-time
+        # transfer/initialisation costs that are not operator throughput.
+        float(warm[(0,) * warm.ndim])
+        del warm
 
     t0 = time.perf_counter()
     if cfg.use_cg:
-        y = fn(u, jnp.zeros_like(u))
+        y = fn(op, u, jnp.zeros_like(u))
     else:
         y = jnp.zeros_like(u)
         for _ in range(cfg.nreps):
-            y = fn(u)
+            y = fn(op, u)
     y.block_until_ready()
+    # Under the axon PJRT tunnel block_until_ready can return before the
+    # device work drains; fetching a scalar of the result is a hard fence
+    # (4-byte transfer, one slice kernel — negligible vs the timed work).
+    float(y[(0,) * y.ndim])
     elapsed = time.perf_counter() - t0
 
     res.mat_free_time = elapsed

@@ -23,7 +23,13 @@ import numpy as np
 from ..elements.tables import OperatorTables
 from ..mesh.box import BoxMesh
 from ..mesh.dofmap import boundary_dof_marker, dof_grid_shape
-from ..ops.laplacian import cell_apply, fold_cells, gather_cells
+from ..ops.laplacian import (
+    cell_apply,
+    fold_cells,
+    freeze_table,
+    gather_cells,
+    pallas_grid_apply,
+)
 from .halo import halo_refresh, masked_dot, owned_mask, reverse_scatter_add
 from .mesh import shard_cells
 
@@ -31,14 +37,14 @@ from .mesh import shard_cells
 @partial(
     jax.tree_util.register_dataclass,
     data_fields=["G", "phi0", "dphi1", "bc_mask", "kappa"],
-    meta_fields=["n_local", "degree", "is_identity", "backend"],
+    meta_fields=["n_local", "degree", "is_identity", "backend", "phi0_c", "dphi1_c"],
 )
 @dataclass(frozen=True)
 class DistLaplacian:
     """Stacked per-shard operator state. Array leading axes (Dx, Dy, Dz) are
     sharded over the device grid; `apply_local` sees one shard's block."""
 
-    G: jnp.ndarray  # (Dx,Dy,Dz, ncells_local, 6, nq,nq,nq)
+    G: jnp.ndarray  # (Dx,Dy,Dz, ncells_local, 6, nq,nq,nq); block-major for pallas
     phi0: jnp.ndarray  # (nq, nd) replicated
     dphi1: jnp.ndarray  # (nq, nq) replicated
     bc_mask: jnp.ndarray  # (Dx,Dy,Dz, Lx,Ly,Lz) bool
@@ -47,17 +53,25 @@ class DistLaplacian:
     degree: int
     is_identity: bool
     backend: str = "xla"
+    phi0_c: tuple | None = None
+    dphi1_c: tuple | None = None
 
     def apply_local(self, x_local: jnp.ndarray, G_local, bc_local) -> jnp.ndarray:
         """y = A x for one shard's block (call inside shard_map)."""
         x = halo_refresh(x_local)
         xm = jnp.where(bc_local, 0, x)
-        u = gather_cells(xm, self.n_local, self.degree)
-        y = cell_apply(
-            u, G_local, self.phi0, self.dphi1, self.kappa, self.is_identity,
-            backend=self.backend, g_cells_last=self.backend == "pallas",
-        )
-        y_grid = fold_cells(y, self.n_local, self.degree)
+        if self.backend == "pallas":
+            y_grid = pallas_grid_apply(
+                xm, self.n_local, self.degree, G_local, self.kappa,
+                self.phi0_c, self.dphi1_c, self.is_identity,
+            )
+        else:
+            u = gather_cells(xm, self.n_local, self.degree)
+            y = cell_apply(
+                u, G_local, self.phi0, self.dphi1, self.kappa, self.is_identity,
+                backend=self.backend,
+            )
+            y_grid = fold_cells(y, self.n_local, self.degree)
         y_grid = reverse_scatter_add(y_grid)
         return jnp.where(bc_local, x, y_grid)
 
@@ -167,9 +181,11 @@ def build_dist_laplacian(
     def shard_geometry(c):
         G, _ = geometry_factors_jax(c[0, 0, 0], t.pts1d, t.wts1d)
         if backend == "pallas":
-            from ..ops.pallas_laplacian import cells_last_G
+            from ..ops.pallas_laplacian import blocked_G, pick_lanes
 
-            G = cells_last_G(G)
+            G = blocked_G(
+                G, pick_lanes(degree + 1, t.nq, np.dtype(dtype).itemsize)
+            )
         return G[None, None, None]
 
     G = shard_geometry(corners)
@@ -189,4 +205,6 @@ def build_dist_laplacian(
         degree=degree,
         is_identity=t.is_identity,
         backend=backend,
+        phi0_c=freeze_table(t.phi0),
+        dphi1_c=freeze_table(t.dphi1),
     )

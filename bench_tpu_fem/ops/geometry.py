@@ -23,6 +23,8 @@ def geometry_factors_jax(
     passed as f32 directly to trade precision for speed; the benchmark driver
     computes in f64-on-host precision only for the oracle path).
     """
+    import jax
+
     corners = jnp.asarray(corners, dtype=dtype)
     rdtype = corners.dtype
     pts = np.asarray(pts1d)
@@ -30,7 +32,14 @@ def geometry_factors_jax(
     D = jnp.asarray(np.broadcast_to([-1.0, 1.0], (len(pts), 2)), dtype=rdtype)
     tab = {0: (D, N, N), 1: (N, D, N), 2: (N, N, D)}
     cols = [
-        jnp.einsum("eabci,xa,yb,zc->exyzi", corners, *tab[a]) for a in range(3)
+        # precision: TPU matmuls default to bf16 passes; the geometry tensor
+        # feeds every operator apply, so compute it at full width (one-time,
+        # build-time cost).
+        jnp.einsum(
+            "eabci,xa,yb,zc->exyzi", corners, *tab[a],
+            precision=jax.lax.Precision.HIGHEST,
+        )
+        for a in range(3)
     ]  # J columns: dx/dxi_a at (nq,nq,nq) points
     K = [
         jnp.cross(cols[1], cols[2]),

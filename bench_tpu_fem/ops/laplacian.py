@@ -33,6 +33,16 @@ from ..mesh.dofmap import boundary_dof_marker
 from .geometry import geometry_factors_jax
 
 
+def _window_axis0(x: jnp.ndarray, nc: int, P: int) -> jnp.ndarray:
+    """(nc*P + 1, ...) -> (nc, P+1, ...) overlapping cell windows along axis
+    0: window c holds entries c*P .. c*P+P. Pure reshape + strided slice +
+    concat — no XLA gather (dynamic indexing is slow on TPU; the structured
+    box makes the dofmap a static stencil)."""
+    main = x[: nc * P].reshape(nc, P, *x.shape[1:])
+    last = x[P :: P][:, None]
+    return jnp.concatenate([main, last], axis=1)
+
+
 def gather_cells(x_grid: jnp.ndarray, n: tuple[int, int, int], degree: int) -> jnp.ndarray:
     """(NX, NY, NZ) grid -> (ncells, nd, nd, nd) per-cell dof values.
 
@@ -40,27 +50,58 @@ def gather_cells(x_grid: jnp.ndarray, n: tuple[int, int, int], degree: int) -> j
     bench_tpu_fem.mesh.dofmap.cell_dofmap.
     """
     P = degree
-    nd = P + 1
     nx, ny, nz = n
-    ix = (np.arange(nx)[:, None] * P + np.arange(nd)[None, :]).astype(np.int32)
-    iy = (np.arange(ny)[:, None] * P + np.arange(nd)[None, :]).astype(np.int32)
-    iz = (np.arange(nz)[:, None] * P + np.arange(nd)[None, :]).astype(np.int32)
-    u = jnp.take(x_grid, jnp.asarray(ix), axis=0)  # (nx, nd, NY, NZ)
-    u = jnp.take(u, jnp.asarray(iy), axis=2)  # (nx, nd, ny, nd, NZ)
-    u = jnp.take(u, jnp.asarray(iz), axis=4)  # (nx, nd, ny, nd, nz, nd)
+    u = _windows_6d(x_grid, n, degree)
     u = u.transpose(0, 2, 4, 1, 3, 5)
-    return u.reshape(nx * ny * nz, nd, nd, nd)
+    return u.reshape(nx * ny * nz, P + 1, P + 1, P + 1)
+
+
+def _windows_6d(x_grid: jnp.ndarray, n: tuple[int, int, int], degree: int) -> jnp.ndarray:
+    """(NX, NY, NZ) grid -> (nx, nd, ny, nd, nz, nd) overlapping cell windows."""
+    P = degree
+    nx, ny, nz = n
+    u = _window_axis0(x_grid, nx, P)  # (nx, nd, NY, NZ)
+    u = jnp.moveaxis(_window_axis0(jnp.moveaxis(u, 2, 0), ny, P), (0, 1), (2, 3))
+    u = jnp.moveaxis(_window_axis0(jnp.moveaxis(u, 4, 0), nz, P), (0, 1), (4, 5))
+    return u
+
+
+def gather_cells_lanes(
+    x_grid: jnp.ndarray, n: tuple[int, int, int], degree: int
+) -> jnp.ndarray:
+    """(NX, NY, NZ) grid -> (nd, nd, nd, ncells) with cells on the trailing
+    (lane) axis — the layout the Pallas kernel consumes directly."""
+    nx, ny, nz = n
+    nd = degree + 1
+    u = _windows_6d(x_grid, n, degree)
+    u = u.transpose(1, 3, 5, 0, 2, 4)
+    return u.reshape(nd, nd, nd, nx * ny * nz)
 
 
 def _fold_last(a: jnp.ndarray, P: int) -> jnp.ndarray:
     """Overlap-add along the trailing (nc, nd) axis pair: (..., nc, nd) ->
-    (..., nc*P + 1), where entry (c, i) lands at position c*P + i."""
+    (..., nc*P + 1), where entry (c, i) lands at position c*P + i.
+
+    Entry (c, P) coincides with entry (c+1, 0); shift the i=P slab one cell
+    right and add it to the i=0 slab — static slices and one concat, no XLA
+    scatter (the inverse of the _window_axis0 stencil)."""
     *lead, nc, nd = a.shape
     assert nd == P + 1
-    main = a[..., :, :P].reshape(*lead, nc * P)
-    out = jnp.concatenate([main, jnp.zeros((*lead, 1), dtype=a.dtype)], axis=-1)
-    idx = (np.arange(nc, dtype=np.int32) + 1) * P
-    return out.at[..., idx].add(a[..., :, P])
+    seam = a[..., :, P]  # (..., nc): right-face value of each cell
+    first = a[..., :, :P]
+    carried = first.at[..., 1:, 0].add(seam[..., :-1]) if nc > 1 else first
+    main = carried.reshape(*lead, nc * P)
+    return jnp.concatenate([main, seam[..., -1:]], axis=-1)
+
+
+def _fold_6d(a: jnp.ndarray, degree: int) -> jnp.ndarray:
+    """(nx, nd, ny, nd, nz, nd) windows -> (NX, NY, NZ) grid overlap-add."""
+    a = _fold_last(a, degree)  # (nx, nd, ny, nd, NZ)
+    a = jnp.moveaxis(a, -1, 0)  # (NZ, nx, nd, ny, nd)
+    a = _fold_last(a, degree)  # (NZ, nx, nd, NY)
+    a = jnp.moveaxis(a, -1, 0)  # (NY, NZ, nx, nd)
+    a = _fold_last(a, degree)  # (NY, NZ, NX)
+    return a.transpose(2, 0, 1)
 
 
 def fold_cells(
@@ -72,12 +113,18 @@ def fold_cells(
     nx, ny, nz = n
     nd = degree + 1
     a = cells.reshape(nx, ny, nz, nd, nd, nd).transpose(0, 3, 1, 4, 2, 5)
-    a = _fold_last(a, degree)  # (nx, nd, ny, nd, NZ')
-    a = jnp.moveaxis(a, -1, 0)  # (NZ, nx, nd, ny, nd)
-    a = _fold_last(a, degree)  # (NZ, nx, nd, NY)
-    a = jnp.moveaxis(a, -1, 0)  # (NY, NZ, nx, nd)
-    a = _fold_last(a, degree)  # (NY, NZ, NX)
-    return a.transpose(2, 0, 1)
+    return _fold_6d(a, degree)
+
+
+def fold_cells_lanes(
+    cells: jnp.ndarray, n: tuple[int, int, int], degree: int
+) -> jnp.ndarray:
+    """(nd, nd, nd, ncells) cells-last contributions -> (NX, NY, NZ) grid
+    (inverse layout of gather_cells_lanes)."""
+    nx, ny, nz = n
+    nd = degree + 1
+    a = cells.reshape(nd, nd, nd, nx, ny, nz).transpose(3, 0, 4, 1, 5, 2)
+    return _fold_6d(a, degree)
 
 
 def cell_apply(
@@ -88,11 +135,12 @@ def cell_apply(
     kappa,
     is_identity: bool,
     backend: str = "xla",
-    g_cells_last: bool = False,
 ) -> jnp.ndarray:
     """Per-cell stiffness apply, dispatching to the XLA einsum chain or the
-    Pallas TPU kernel (ops.pallas_laplacian). Operators built with
-    backend='pallas' store G cells-last (g_cells_last=True)."""
+    Pallas TPU kernel (ops.pallas_laplacian). For the pallas backend
+    phi0/dphi1 must be concrete (they become kernel compile-time constants);
+    the jitted hot path goes through Laplacian.apply, which carries them as
+    static metadata."""
     if backend == "pallas":
         from .pallas_laplacian import pallas_cell_apply
 
@@ -103,14 +151,11 @@ def cell_apply(
             dphi1,
             jnp.asarray(kappa),
             nd=u_cells.shape[-1],
-            nq=phi0.shape[0],
+            nq=np.shape(phi0)[0],
             is_identity=is_identity,
-            g_cells_last=g_cells_last,
         )
     if backend != "xla":
         raise ValueError(f"unknown operator backend '{backend}'")
-    if g_cells_last:
-        G = jnp.moveaxis(G, -1, 0)
     return _sumfact_cell_apply(u_cells, G, phi0, dphi1, kappa, is_identity)
 
 
@@ -126,35 +171,75 @@ def _sumfact_cell_apply(
 
     The contraction chain of laplacian_gpu.hpp:174-421 (interpolate ->
     collocation gradient -> geometry scaling -> transpose gradient ->
-    back-interpolate) as batched einsums.
+    back-interpolate) as batched einsums. precision=HIGHEST: TPU matmuls
+    default to bf16 passes, which costs ~3 decimal digits — fatal to the
+    mat_comp oracle contract (the Pallas backend is exact-f32 VPU work and
+    needs no such override).
     """
+    hi = jax.lax.Precision.HIGHEST
     if not is_identity:
-        u = jnp.einsum("qi,eijk->eqjk", phi0, u)
-        u = jnp.einsum("rj,eqjk->eqrk", phi0, u)
-        u = jnp.einsum("sk,eqrk->eqrs", phi0, u)
-    du0 = jnp.einsum("xi,eijk->exjk", dphi1, u)
-    du1 = jnp.einsum("yj,eijk->eiyk", dphi1, u)
-    du2 = jnp.einsum("zk,eijk->eijz", dphi1, u)
+        u = jnp.einsum("qi,eijk->eqjk", phi0, u, precision=hi)
+        u = jnp.einsum("rj,eqjk->eqrk", phi0, u, precision=hi)
+        u = jnp.einsum("sk,eqrk->eqrs", phi0, u, precision=hi)
+    du0 = jnp.einsum("xi,eijk->exjk", dphi1, u, precision=hi)
+    du1 = jnp.einsum("yj,eijk->eiyk", dphi1, u, precision=hi)
+    du2 = jnp.einsum("zk,eijk->eijz", dphi1, u, precision=hi)
     G0, G1, G2, G3, G4, G5 = (G[:, c] for c in range(6))
     f0 = kappa * (G0 * du0 + G1 * du1 + G2 * du2)
     f1 = kappa * (G1 * du0 + G3 * du1 + G4 * du2)
     f2 = kappa * (G2 * du0 + G4 * du1 + G5 * du2)
     y = (
-        jnp.einsum("qi,eqjk->eijk", dphi1, f0)
-        + jnp.einsum("qj,eiqk->eijk", dphi1, f1)
-        + jnp.einsum("qk,eijq->eijk", dphi1, f2)
+        jnp.einsum("qi,eqjk->eijk", dphi1, f0, precision=hi)
+        + jnp.einsum("qj,eiqk->eijk", dphi1, f1, precision=hi)
+        + jnp.einsum("qk,eijq->eijk", dphi1, f2, precision=hi)
     )
     if not is_identity:
-        y = jnp.einsum("qi,eqjk->eijk", phi0, y)
-        y = jnp.einsum("qj,eiqk->eijk", phi0, y)
-        y = jnp.einsum("qk,eijq->eijk", phi0, y)
+        y = jnp.einsum("qi,eqjk->eijk", phi0, y, precision=hi)
+        y = jnp.einsum("qj,eiqk->eijk", phi0, y, precision=hi)
+        y = jnp.einsum("qk,eijq->eijk", phi0, y, precision=hi)
     return y
+
+
+def pallas_grid_apply(
+    xm: jnp.ndarray,
+    n: tuple[int, int, int],
+    degree: int,
+    G: jnp.ndarray,
+    kappa,
+    phi0_c: tuple,
+    dphi1_c: tuple,
+    is_identity: bool,
+) -> jnp.ndarray:
+    """Masked dof grid -> operator contribution grid via the Pallas kernel:
+    the blocked-layout handshake (gather -> block -> kernel -> unblock ->
+    fold) shared by the single-device and distributed operators."""
+    from .pallas_laplacian import (
+        block_cells_lanes,
+        pallas_cell_apply_blocked,
+        unblock_cells_lanes,
+    )
+
+    C = int(np.prod(n))
+    nl = G.shape[-1]
+    u = block_cells_lanes(gather_cells_lanes(xm, n, degree), nl)
+    y = pallas_cell_apply_blocked(
+        u, G, kappa,
+        np.asarray(phi0_c, np.float64),
+        np.asarray(dphi1_c, np.float64),
+        is_identity,
+    )
+    return fold_cells_lanes(unblock_cells_lanes(y, C), n, degree)
+
+
+def freeze_table(a: np.ndarray) -> tuple:
+    """numpy table -> hashable nested tuple (for pytree meta fields)."""
+    return tuple(tuple(float(v) for v in row) for row in np.asarray(a, np.float64))
 
 
 @partial(
     jax.tree_util.register_dataclass,
     data_fields=["G", "phi0", "dphi1", "bc_mask", "kappa"],
-    meta_fields=["n", "degree", "is_identity", "backend"],
+    meta_fields=["n", "degree", "is_identity", "backend", "phi0_c", "dphi1_c"],
 )
 @dataclass(frozen=True)
 class Laplacian:
@@ -163,9 +248,12 @@ class Laplacian:
     configuration, like the reference's template dispatch).
 
     backend: "xla" (batched einsums, any dtype) or "pallas" (TPU kernel,
-    f32/bf16; see ops.pallas_laplacian)."""
+    f32/bf16; see ops.pallas_laplacian). The pallas path needs the basis
+    tables as *compile-time constants* (they are baked into the kernel as
+    immediates), so they are carried twice: as arrays (phi0/dphi1, the XLA
+    operands) and as hashable tuples (phi0_c/dphi1_c, static metadata)."""
 
-    G: jnp.ndarray  # (ncells, 6, nq, nq, nq) weighted geometry tensor
+    G: jnp.ndarray  # (ncells, 6, nq, nq, nq); block-major (see blocked_G) for pallas
     phi0: jnp.ndarray  # (nq, nd) interpolation matrix
     dphi1: jnp.ndarray  # (nq, nq) collocation derivative
     bc_mask: jnp.ndarray  # (NX, NY, NZ) bool Dirichlet marker
@@ -174,16 +262,24 @@ class Laplacian:
     degree: int
     is_identity: bool
     backend: str = "xla"
+    phi0_c: tuple | None = None
+    dphi1_c: tuple | None = None
 
     def apply(self, x_grid: jnp.ndarray) -> jnp.ndarray:
         """y = A @ x on the dof grid, with Dirichlet pass-through rows."""
         xm = jnp.where(self.bc_mask, 0, x_grid)
-        u = gather_cells(xm, self.n, self.degree)
-        y = cell_apply(
-            u, self.G, self.phi0, self.dphi1, self.kappa, self.is_identity,
-            backend=self.backend, g_cells_last=self.backend == "pallas",
-        )
-        y_grid = fold_cells(y, self.n, self.degree)
+        if self.backend == "pallas":
+            y_grid = pallas_grid_apply(
+                xm, self.n, self.degree, self.G, self.kappa,
+                self.phi0_c, self.dphi1_c, self.is_identity,
+            )
+        else:
+            u = gather_cells(xm, self.n, self.degree)
+            y = cell_apply(
+                u, self.G, self.phi0, self.dphi1, self.kappa, self.is_identity,
+                backend=self.backend,
+            )
+            y_grid = fold_cells(y, self.n, self.degree)
         return jnp.where(self.bc_mask, x_grid, y_grid)
 
 
@@ -204,9 +300,9 @@ def build_laplacian(
     corners = jnp.asarray(mesh.cell_corners.reshape(-1, 2, 2, 2, 3), dtype=dtype)
     G, _ = geometry_factors_jax(corners, t.pts1d, t.wts1d)
     if backend == "pallas":
-        from .pallas_laplacian import cells_last_G
+        from .pallas_laplacian import blocked_G, pick_lanes
 
-        G = cells_last_G(G)
+        G = blocked_G(G, pick_lanes(degree + 1, t.nq, np.dtype(dtype).itemsize))
     bc = jnp.asarray(boundary_dof_marker(mesh.n, degree))
     return Laplacian(
         G=G,
@@ -218,4 +314,6 @@ def build_laplacian(
         degree=degree,
         is_identity=t.is_identity,
         backend=backend,
+        phi0_c=freeze_table(t.phi0),
+        dphi1_c=freeze_table(t.dphi1),
     )
