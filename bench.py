@@ -64,11 +64,19 @@ def main() -> int:
             return 0
         except (RuntimeError, MemoryError) as exc:  # XLA OOM surfaces as RuntimeError
             msg = str(exc)
-            if "RESOURCE_EXHAUSTED" in msg or "Out of memory" in msg or "OOM" in msg.lower():
-                last_err = msg
-                ndofs //= 2
-                continue
-            raise
+            if not ("RESOURCE_EXHAUSTED" in msg or "Out of memory" in msg
+                    or "OOM" in msg.lower()):
+                raise
+            last_err = msg
+            ndofs //= 2
+        # Out of the except block (so exc/traceback no longer pin the failed
+        # attempt's device arrays): free them before the halved retry.
+        import gc
+
+        import jax
+
+        gc.collect()
+        jax.clear_caches()
     print(json.dumps({"metric": "cg_gdof_per_s_per_chip_q3_f32", "value": 0.0,
                       "unit": "GDoF/s", "vs_baseline": 0.0,
                       "error": f"could not fit problem: {last_err}"}))

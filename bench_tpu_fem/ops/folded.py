@@ -1,0 +1,411 @@
+"""Folded vector layout: the TPU-native dof storage for the hot path.
+
+The grid layout (NX, NY, NZ) forces every operator apply through two large
+strided transposes (gather to per-cell layout, overlap-add back) that XLA
+executes far below DMA speed. This module instead stores a dof vector the
+way the kernel consumes it:
+
+    X[i, j, k, c]   i, j, k in [0, P)   c = (cx*npy + cy)*npz + cz
+
+where (cx, cy, cz) ranges over the real cells *plus one ghost column per
+axis* (np_a = n_a + 1). Grid point (cx*P+i, ...) maps bijectively: the final
+boundary plane of each axis lives in the ghost column's i=0 slot; the
+remaining ghost slots are structural zeros. The payoffs:
+
+- a cell's (P+1)^3 window is its own (P,P,P) block plus 7 slabs at
+  *constant* flat-c shifts (+Sz=1, +Sy=npz, +Sx=npy*npz and their sums) —
+  so "gather" is 7 contiguous-slice reads, and "scatter-add" (the
+  reference's atomicAdd, laplacian_gpu.hpp:425) is 7 shifted adds;
+- ghost cells get zero geometry rows, so they mask themselves: no bounds
+  logic anywhere in the kernel;
+- CG vector algebra runs unchanged on the flat arrays (structural zeros are
+  preserved by every linear operation).
+
+The kernel (standard pallas_call, fully pipelined BlockSpecs) processes
+B = 8*NL cells per grid step: window slabs are DMA'd as (..., B) lane-major
+blocks, relaid in-register to the (..., 8, NL) vreg cross-section of
+ops.pallas_laplacian, contracted with the compile-time basis tables, and
+written back as one main block plus 7 seam outputs.
+
+Cites: stiffness_operator_gpu /root/reference/src/laplacian_gpu.hpp:91-426
+(the per-cell math), MatFreeLaplacianGPU::apply laplacian.hpp:281-403
+(operator protocol, Dirichlet pass-through laplacian_gpu.hpp:163-169).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..elements.tables import OperatorTables, build_operator_tables
+from ..mesh.box import BoxMesh
+from ..mesh.dofmap import boundary_dof_marker
+from .pallas_laplacian import (
+    SUBLANES,
+    _use_interpret,
+    pick_lanes,
+    sumfact_window_apply,
+)
+
+
+# ---------------------------------------------------------------------------
+# Layout geometry
+# ---------------------------------------------------------------------------
+
+@dataclass(frozen=True)
+class FoldedLayout:
+    """Shape bookkeeping for the folded layout of one box mesh."""
+
+    n: tuple[int, int, int]  # real cells per axis
+    degree: int
+    nl: int  # lanes per kernel block
+
+    @property
+    def np3(self) -> tuple[int, int, int]:
+        return (self.n[0] + 1, self.n[1] + 1, self.n[2] + 1)
+
+    @property
+    def shifts(self) -> tuple[int, int, int]:
+        """Flat-c shift to the +x/+y/+z neighbour cell."""
+        npx, npy, npz = self.np3
+        return (npy * npz, npz, 1)
+
+    @property
+    def cg(self) -> int:
+        npx, npy, npz = self.np3
+        return npx * npy * npz
+
+    @property
+    def block(self) -> int:
+        return SUBLANES * self.nl
+
+    @property
+    def nblocks(self) -> int:
+        return -(-self.cg // self.block)
+
+    @property
+    def lv(self) -> int:
+        """Padded flat-c vector length (whole number of kernel blocks)."""
+        return self.nblocks * self.block
+
+    @property
+    def vec_shape(self) -> tuple[int, int, int, int]:
+        P = self.degree
+        return (P, P, P, self.lv)
+
+
+def make_layout(n: tuple[int, int, int], degree: int, nq: int,
+                itemsize: int = 4, nl: int | None = None) -> FoldedLayout:
+    """nl override exists for tests (small nl forces multi-block grids on
+    meshes that fit interpret mode)."""
+    return FoldedLayout(n=tuple(n), degree=degree,
+                        nl=nl or pick_lanes(degree + 1, nq, itemsize))
+
+
+def _grid_to_cell_indices(layout: FoldedLayout):
+    """Per grid point: (i, j, k, c) indices into the folded vector."""
+    P = layout.degree
+    nx, ny, nz = layout.n
+    npx, npy, npz = layout.np3
+    X = np.arange(nx * P + 1)
+    Y = np.arange(ny * P + 1)
+    Z = np.arange(nz * P + 1)
+    cx, i = X // P, X % P
+    cy, j = Y // P, Y % P
+    cz, k = Z // P, Z % P
+    c = (
+        (cx[:, None, None] * npy + cy[None, :, None]) * npz
+        + cz[None, None, :]
+    )
+    ii = np.broadcast_to(i[:, None, None], c.shape)
+    jj = np.broadcast_to(j[None, :, None], c.shape)
+    kk = np.broadcast_to(k[None, None, :], c.shape)
+    return ii, jj, kk, c
+
+
+def fold_vector(grid: np.ndarray, layout: FoldedLayout) -> np.ndarray:
+    """(NX, NY, NZ) grid -> folded (P, P, P, Lv); structural slots zero."""
+    ii, jj, kk, c = _grid_to_cell_indices(layout)
+    out = np.zeros(layout.vec_shape, dtype=grid.dtype)
+    out[ii, jj, kk, c] = grid
+    return out
+
+
+def unfold_vector(folded: np.ndarray, layout: FoldedLayout) -> np.ndarray:
+    """Folded (P, P, P, Lv) -> (NX, NY, NZ) grid (inverse of fold_vector)."""
+    ii, jj, kk, c = _grid_to_cell_indices(layout)
+    return np.asarray(folded)[ii, jj, kk, c]
+
+
+def real_cell_flat_indices(layout: FoldedLayout) -> np.ndarray:
+    """Flat-c index of each real cell, in (cx, cy, cz) row-major order —
+    the cell order of mesh.cell_corners and the geometry tensor."""
+    nx, ny, nz = layout.n
+    npx, npy, npz = layout.np3
+    cx, cy, cz = np.meshgrid(
+        np.arange(nx), np.arange(ny), np.arange(nz), indexing="ij"
+    )
+    return ((cx * npy + cy) * npz + cz).ravel()
+
+
+# ---------------------------------------------------------------------------
+# Kernel
+# ---------------------------------------------------------------------------
+
+def _r8(a: jnp.ndarray, nl: int) -> jnp.ndarray:
+    """(..., B) lane-major -> (..., 8, nl) vreg cross-section (in-register
+    relayout; cheap next to the contraction work)."""
+    return a.reshape(*a.shape[:-1], SUBLANES, nl)
+
+
+def _rb(a: jnp.ndarray) -> jnp.ndarray:
+    """Inverse of _r8."""
+    return a.reshape(*a.shape[:-2], a.shape[-2] * a.shape[-1])
+
+
+def _assemble_window(c000, cx, cy, cz, cxy, cxz, cyz, cxyz):
+    """Build the (nd, nd, nd, 8, nl) cell window cube from the 8 shift-class
+    slabs (each already in vreg layout). Pure concatenation on vreg-indexed
+    axes — register naming, no data movement."""
+    A = jnp.concatenate([c000, cz[:, :, None]], axis=2)  # (P, P, nd, ...)
+    By = jnp.concatenate([cy, cyz[:, None]], axis=1)  # (P, nd, ...)
+    A = jnp.concatenate([A, By[:, None]], axis=1)  # (P, nd, nd, ...)
+    Bx = jnp.concatenate([cx, cxz[:, None]], axis=1)  # (P, nd, ...)
+    Cx = jnp.concatenate([cxy, cxyz[None]], axis=0)  # (nd, ...)
+    Bx = jnp.concatenate([Bx, Cx[None]], axis=0)  # (nd, nd, ...)
+    return jnp.concatenate([A, Bx[None]], axis=0)  # (nd, nd, nd, ...)
+
+
+def _make_folded_kernel(P: int, nl: int, is_identity: bool,
+                        phi0: np.ndarray, dphi1: np.ndarray):
+    def kernel(u000_ref, ux_ref, uy_ref, uz_ref, uxy_ref, uxz_ref, uyz_ref,
+               uxyz_ref, g_ref, kappa_ref,
+               y_ref, yx_ref, yy_ref, yz_ref, yxy_ref, yxz_ref, yyz_ref,
+               yxyz_ref):
+        r8 = lambda r: _r8(r[...], nl)  # noqa: E731
+        u = _assemble_window(
+            r8(u000_ref), r8(ux_ref), r8(uy_ref), r8(uz_ref),
+            r8(uxy_ref), r8(uxz_ref), r8(uyz_ref), r8(uxyz_ref),
+        )
+        y = sumfact_window_apply(
+            u, g_ref[0], kappa_ref[0, 0], phi0, dphi1, is_identity
+        )
+
+        y_ref[...] = _rb(y[:P, :P, :P])
+        yx_ref[...] = _rb(y[P, :P, :P])
+        yy_ref[...] = _rb(y[:P, P, :P])
+        yz_ref[...] = _rb(y[:P, :P, P])
+        yxy_ref[...] = _rb(y[P, P, :P])
+        yxz_ref[...] = _rb(y[P, :P, P])
+        yyz_ref[...] = _rb(y[:P, P, P])
+        yxyz_ref[...] = _rb(y[P, P, P])
+
+    return kernel
+
+
+def folded_cell_apply(
+    xm: jnp.ndarray,  # (P, P, P, Lv) masked folded vector
+    G: jnp.ndarray,  # (nblocks, 6, nq, nq, nq, 8, nl) c-space blocked
+    kappa: jnp.ndarray,
+    layout: FoldedLayout,
+    phi0: np.ndarray,
+    dphi1: np.ndarray,
+    is_identity: bool,
+    interpret: bool | None = None,
+) -> jnp.ndarray:
+    """One operator contribution pass: returns the un-bc'd result vector."""
+    P = layout.degree
+    nq = phi0.shape[0]
+    nl, B, nb, Lv = layout.nl, layout.block, layout.nblocks, layout.lv
+    Sx, Sy, Sz = layout.shifts
+    S7 = Sx + Sy + Sz
+    dtype = xm.dtype
+
+    xp = jnp.pad(xm, [(0, 0)] * 3 + [(0, S7)])
+    ux = jax.lax.slice(xp[0], (0, 0, Sx), (P, P, Sx + Lv))
+    uy = jax.lax.slice(xp[:, 0], (0, 0, Sy), (P, P, Sy + Lv))
+    uz = jax.lax.slice(xp[:, :, 0], (0, 0, Sz), (P, P, Sz + Lv))
+    uxy = jax.lax.slice(xp[0, 0], (0, Sx + Sy), (P, Sx + Sy + Lv))
+    uxz = jax.lax.slice(xp[0, :, 0], (0, Sx + Sz), (P, Sx + Sz + Lv))
+    uyz = jax.lax.slice(xp[:, 0, 0], (0, Sy + Sz), (P, Sy + Sz + Lv))
+    uxyz = jax.lax.slice(xp[0, 0, 0], (S7,), (S7 + Lv,))
+
+    spec = lambda *lead: pl.BlockSpec(  # noqa: E731
+        (*lead, B), lambda i, _n=len(lead): (0,) * _n + (i,),
+        memory_space=pltpu.VMEM,
+    )
+    kernel = _make_folded_kernel(
+        P, nl, is_identity,
+        np.asarray(phi0, np.float64), np.asarray(dphi1, np.float64),
+    )
+    outs = pl.pallas_call(
+        kernel,
+        grid=(nb,),
+        in_specs=[
+            spec(P, P, P), spec(P, P), spec(P, P), spec(P, P),
+            spec(P), spec(P), spec(P), spec(),
+            pl.BlockSpec(
+                (1, 6, nq, nq, nq, SUBLANES, nl),
+                lambda i: (i, 0, 0, 0, 0, 0, 0), memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.SMEM),
+        ],
+        out_specs=[
+            spec(P, P, P), spec(P, P), spec(P, P), spec(P, P),
+            spec(P), spec(P), spec(P), spec(),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((P, P, P, Lv), dtype),
+            jax.ShapeDtypeStruct((P, P, Lv), dtype),
+            jax.ShapeDtypeStruct((P, P, Lv), dtype),
+            jax.ShapeDtypeStruct((P, P, Lv), dtype),
+            jax.ShapeDtypeStruct((P, Lv), dtype),
+            jax.ShapeDtypeStruct((P, Lv), dtype),
+            jax.ShapeDtypeStruct((P, Lv), dtype),
+            jax.ShapeDtypeStruct((Lv,), dtype),
+        ],
+        interpret=_use_interpret() if interpret is None else interpret,
+    )(xm, ux, uy, uz, uxy, uxz, uyz, uxyz, G,
+      kappa.reshape(1, 1).astype(dtype))
+
+    Y, Yx, Yy, Yz, Yxy, Yxz, Yyz, Yxyz = outs
+    # Seam accumulation: the i/j/k = P faces of each cell window coincide
+    # with the i/j/k = 0 slots of the +x/+y/+z neighbour (the structured
+    # replacement for atomicAdd scatter).
+    Y = Y.at[0, :, :, Sx:].add(Yx[:, :, : Lv - Sx])
+    Y = Y.at[:, 0, :, Sy:].add(Yy[:, :, : Lv - Sy])
+    Y = Y.at[:, :, 0, Sz:].add(Yz[:, :, : Lv - Sz])
+    Y = Y.at[0, 0, :, Sx + Sy:].add(Yxy[:, : Lv - Sx - Sy])
+    Y = Y.at[0, :, 0, Sx + Sz:].add(Yxz[:, : Lv - Sx - Sz])
+    Y = Y.at[:, 0, 0, Sy + Sz:].add(Yyz[:, : Lv - Sy - Sz])
+    Y = Y.at[0, 0, 0, S7:].add(Yxyz[: Lv - S7])
+    return Y
+
+
+# ---------------------------------------------------------------------------
+# Operator
+# ---------------------------------------------------------------------------
+
+@partial(
+    jax.tree_util.register_dataclass,
+    data_fields=["G", "bc_mask", "kappa"],
+    meta_fields=["n", "degree", "nl", "is_identity", "phi0_c", "dphi1_c"],
+)
+@dataclass(frozen=True)
+class FoldedLaplacian:
+    """Matrix-free Laplacian on folded vectors (the TPU fast path)."""
+
+    G: jnp.ndarray  # (nblocks, 6, nq, nq, nq, 8, nl)
+    bc_mask: jnp.ndarray  # (P, P, P, Lv) bool Dirichlet marker (folded)
+    kappa: jnp.ndarray
+    n: tuple[int, int, int]
+    degree: int
+    nl: int
+    is_identity: bool
+    phi0_c: tuple = ()
+    dphi1_c: tuple = ()
+
+    @property
+    def layout(self) -> FoldedLayout:
+        return FoldedLayout(n=self.n, degree=self.degree, nl=self.nl)
+
+    def apply(self, x: jnp.ndarray) -> jnp.ndarray:
+        """y = A @ x on folded vectors, Dirichlet rows pass through."""
+        xm = jnp.where(self.bc_mask, 0, x)
+        y = folded_cell_apply(
+            xm, self.G, self.kappa, self.layout,
+            np.asarray(self.phi0_c, np.float64),
+            np.asarray(self.dphi1_c, np.float64),
+            self.is_identity,
+        )
+        return jnp.where(self.bc_mask, x, y)
+
+
+_BUILD_CHUNK_BLOCKS = 64  # cells per geometry-build chunk = 64 * block
+
+
+def _build_G_chunked(corners_cs: np.ndarray, mask_cs: np.ndarray,
+                     layout: FoldedLayout, t: OperatorTables, dtype) -> jnp.ndarray:
+    """Device-side geometry build in chunks with a donated accumulator, so
+    peak HBM is final-G + one chunk (a monolithic build needs ~3x final-G,
+    which is the capacity limit at benchmark sizes)."""
+    from .geometry import geometry_factors_jax
+
+    nq = t.nq
+    nb, B, nl = layout.nblocks, layout.block, layout.nl
+    ch = min(_BUILD_CHUNK_BLOCKS, nb)
+
+    @partial(jax.jit, donate_argnums=0, static_argnames="nbc")
+    def fill(acc, corners, mask, start, nbc):
+        Gc, _ = geometry_factors_jax(corners, t.pts1d, t.wts1d)
+        Gc = Gc * mask[:, None, None, None, None]
+        Gc = Gc.reshape(nbc, SUBLANES, nl, 6, nq, nq, nq)
+        Gc = Gc.transpose(0, 3, 4, 5, 6, 1, 2)
+        return jax.lax.dynamic_update_slice(
+            acc, Gc, (start, 0, 0, 0, 0, 0, 0)
+        )
+
+    acc = jnp.zeros((nb, 6, nq, nq, nq, SUBLANES, nl), dtype=dtype)
+    for b0 in range(0, nb, ch):
+        nbc = min(ch, nb - b0)
+        c0, c1 = b0 * B, (b0 + nbc) * B
+        acc = fill(
+            acc,
+            jnp.asarray(corners_cs[c0:c1], dtype=dtype),
+            jnp.asarray(mask_cs[c0:c1], dtype=dtype),
+            b0,
+            nbc=nbc,
+        )
+    return acc
+
+
+def build_folded_laplacian(
+    mesh: BoxMesh,
+    degree: int,
+    qmode: int,
+    rule: str = "gll",
+    kappa: float = 2.0,
+    dtype=jnp.float32,
+    tables: OperatorTables | None = None,
+    nl: int | None = None,
+) -> FoldedLaplacian:
+    """Build the folded-layout operator (geometry computed on device, in
+    chunks over c-space; ghost/pad cells get unit-cube corners so the
+    Jacobian stays invertible, then a zero mask)."""
+    from .laplacian import freeze_table
+
+    t = tables or build_operator_tables(degree, qmode, rule)
+    layout = make_layout(mesh.n, degree, t.nq, np.dtype(dtype).itemsize, nl=nl)
+
+    unit = np.zeros((2, 2, 2, 3))
+    g = np.arange(2, dtype=np.float64)
+    unit[..., 0], unit[..., 1], unit[..., 2] = (
+        g[:, None, None], g[None, :, None], g[None, None, :],
+    )
+    corners_cs = np.broadcast_to(unit, (layout.lv, 2, 2, 2, 3)).copy()
+    mask_cs = np.zeros(layout.lv)
+    idx = real_cell_flat_indices(layout)
+    corners_cs[idx] = mesh.cell_corners.reshape(-1, 2, 2, 2, 3)
+    mask_cs[idx] = 1.0
+
+    G = _build_G_chunked(corners_cs, mask_cs, layout, t, dtype)
+    bc = fold_vector(
+        np.asarray(boundary_dof_marker(mesh.n, degree)), layout
+    )
+    return FoldedLaplacian(
+        G=G,
+        bc_mask=jnp.asarray(bc),
+        kappa=jnp.asarray(kappa, dtype=dtype),
+        n=mesh.n,
+        degree=degree,
+        nl=layout.nl,
+        is_identity=t.is_identity,
+        phi0_c=freeze_table(t.phi0),
+        dphi1_c=freeze_table(t.dphi1),
+    )

@@ -96,6 +96,35 @@ def _stage(mat: np.ndarray, arr, axis: int):
     return jnp.stack(slabs, axis=axis)
 
 
+def sumfact_window_apply(u, G, kappa, phi0: np.ndarray, dphi1: np.ndarray,
+                         is_identity: bool):
+    """The per-cell contraction chain on one VMEM-resident cell block:
+    window cube u (nd, nd, nd, 8, NL) x geometry G (6, nq, nq, nq, 8, NL)
+    -> contribution cube (nd, nd, nd, 8, NL). Shared by the cells-layout and
+    folded-layout kernels — it is the numerically sensitive core
+    (laplacian_gpu.hpp:174-421) and must exist exactly once."""
+    if not is_identity:
+        u = _stage(phi0, u, 0)
+        u = _stage(phi0, u, 1)
+        u = _stage(phi0, u, 2)
+
+    du0 = _stage(dphi1, u, 0)
+    du1 = _stage(dphi1, u, 1)
+    du2 = _stage(dphi1, u, 2)
+
+    f0 = kappa * (G[0] * du0 + G[1] * du1 + G[2] * du2)
+    f1 = kappa * (G[1] * du0 + G[3] * du1 + G[4] * du2)
+    f2 = kappa * (G[2] * du0 + G[4] * du1 + G[5] * du2)
+
+    y = _stage(dphi1.T, f0, 0) + _stage(dphi1.T, f1, 1) + _stage(dphi1.T, f2, 2)
+
+    if not is_identity:
+        y = _stage(phi0.T, y, 0)
+        y = _stage(phi0.T, y, 1)
+        y = _stage(phi0.T, y, 2)
+    return y
+
+
 def _make_kernel(nd: int, nq: int, is_identity: bool,
                  phi0: np.ndarray, dphi1: np.ndarray):
     """Kernel body for one cell block; phi0/dphi1 are numpy compile-time
@@ -103,33 +132,9 @@ def _make_kernel(nd: int, nq: int, is_identity: bool,
     template-specialised kernels)."""
 
     def kernel(u_ref, g_ref, kappa_ref, out_ref):
-        u = u_ref[0]  # (nd, nd, nd, 8, NL)
-        kappa = kappa_ref[0, 0]
-
-        if not is_identity:
-            u = _stage(phi0, u, 0)
-            u = _stage(phi0, u, 1)
-            u = _stage(phi0, u, 2)
-
-        du0 = _stage(dphi1, u, 0)
-        du1 = _stage(dphi1, u, 1)
-        du2 = _stage(dphi1, u, 2)
-
-        G = g_ref[0]  # (6, nq, nq, nq, 8, NL)
-        f0 = kappa * (G[0] * du0 + G[1] * du1 + G[2] * du2)
-        f1 = kappa * (G[1] * du0 + G[3] * du1 + G[4] * du2)
-        f2 = kappa * (G[2] * du0 + G[4] * du1 + G[5] * du2)
-
-        y = _stage(dphi1.T, f0, 0)
-        y = y + _stage(dphi1.T, f1, 1)
-        y = y + _stage(dphi1.T, f2, 2)
-
-        if not is_identity:
-            y = _stage(phi0.T, y, 0)
-            y = _stage(phi0.T, y, 1)
-            y = _stage(phi0.T, y, 2)
-
-        out_ref[0] = y
+        out_ref[0] = sumfact_window_apply(
+            u_ref[0], g_ref[0], kappa_ref[0, 0], phi0, dphi1, is_identity
+        )
 
     return kernel
 
