@@ -158,9 +158,8 @@ def run_benchmark(cfg: BenchConfig) -> BenchmarkResults:
         if folded:
             # The folded vector layout is the TPU fast path (see ops.folded):
             # no per-apply gather/fold transposes, ~2x the grid-layout rate.
-            # Single-device only so far — the ndevices>1 branch above still
-            # runs the grid-layout pallas operator per shard; migrating the
-            # distributed path to folded shards is tracked work.
+            # The ndevices>1 branch above routes pallas runs through the
+            # distributed folded path (dist.folded) the same way.
             from ..ops.folded import build_folded_laplacian, fold_vector
 
             op = build_folded_laplacian(

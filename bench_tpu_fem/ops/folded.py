@@ -330,28 +330,79 @@ class FoldedLaplacian:
 _BUILD_CHUNK_BLOCKS = 64  # cells per geometry-build chunk = 64 * block
 
 
+def ghost_corner_arrays(
+    layout: FoldedLayout, cell_corners: np.ndarray
+) -> tuple[np.ndarray, np.ndarray]:
+    """Host-side c-space geometry inputs: (corners_cs (Lv, 2,2,2,3),
+    mask_cs (Lv,)). Ghost/pad cells get unit-cube corners — an invertible
+    Jacobian, so the geometry math stays finite — and a zero mask that then
+    zeroes their G rows (the self-masking that replaces all ghost bounds
+    logic). The single source of the ghost-cell convention, shared by the
+    single-device and distributed builders."""
+    unit = np.zeros((2, 2, 2, 3))
+    g = np.arange(2, dtype=np.float64)
+    unit[..., 0], unit[..., 1], unit[..., 2] = (
+        g[:, None, None], g[None, :, None], g[None, None, :],
+    )
+    corners_cs = np.broadcast_to(unit, (layout.lv, 2, 2, 2, 3)).copy()
+    mask_cs = np.zeros(layout.lv)
+    idx = real_cell_flat_indices(layout)
+    corners_cs[idx] = cell_corners.reshape(-1, 2, 2, 2, 3)
+    mask_cs[idx] = 1.0
+    return corners_cs, mask_cs
+
+
+def chunk_blocked_G(corners, mask, layout: FoldedLayout, t: OperatorTables,
+                    nbc: int) -> jnp.ndarray:
+    """Traced: geometry for one chunk of nbc blocks, in blocked layout
+    (nbc, 6, nq, nq, nq, 8, nl). Shared by both builders so the blocking
+    transform exists exactly once."""
+    from .geometry import geometry_factors_jax
+
+    nq = t.nq
+    Gc, _ = geometry_factors_jax(corners, t.pts1d, t.wts1d)
+    Gc = Gc * mask[:, None, None, None, None]
+    Gc = Gc.reshape(nbc, SUBLANES, layout.nl, 6, nq, nq, nq)
+    return Gc.transpose(0, 3, 4, 5, 6, 1, 2)
+
+
+def blocked_G_traced(corners_cs, mask_cs, layout: FoldedLayout,
+                     t: OperatorTables) -> jnp.ndarray:
+    """Traced chunked build (for use inside an enclosing jit/shard_map):
+    the dynamic-update-slice chain forces sequential chunk evaluation, so
+    XLA's liveness analysis reuses the chunk temporaries instead of holding
+    ~3x final-G live at once."""
+    nq = t.nq
+    nb, B = layout.nblocks, layout.block
+    ch = min(_BUILD_CHUNK_BLOCKS, nb)
+    acc = jnp.zeros(
+        (nb, 6, nq, nq, nq, SUBLANES, layout.nl), dtype=corners_cs.dtype
+    )
+    for b0 in range(0, nb, ch):
+        nbc = min(ch, nb - b0)
+        c0, c1 = b0 * B, (b0 + nbc) * B
+        Gc = chunk_blocked_G(corners_cs[c0:c1], mask_cs[c0:c1], layout, t, nbc)
+        acc = jax.lax.dynamic_update_slice(acc, Gc, (b0, 0, 0, 0, 0, 0, 0))
+    return acc
+
+
 def _build_G_chunked(corners_cs: np.ndarray, mask_cs: np.ndarray,
                      layout: FoldedLayout, t: OperatorTables, dtype) -> jnp.ndarray:
     """Device-side geometry build in chunks with a donated accumulator, so
     peak HBM is final-G + one chunk (a monolithic build needs ~3x final-G,
     which is the capacity limit at benchmark sizes)."""
-    from .geometry import geometry_factors_jax
-
-    nq = t.nq
-    nb, B, nl = layout.nblocks, layout.block, layout.nl
+    nb, B = layout.nblocks, layout.block
     ch = min(_BUILD_CHUNK_BLOCKS, nb)
 
     @partial(jax.jit, donate_argnums=0, static_argnames="nbc")
     def fill(acc, corners, mask, start, nbc):
-        Gc, _ = geometry_factors_jax(corners, t.pts1d, t.wts1d)
-        Gc = Gc * mask[:, None, None, None, None]
-        Gc = Gc.reshape(nbc, SUBLANES, nl, 6, nq, nq, nq)
-        Gc = Gc.transpose(0, 3, 4, 5, 6, 1, 2)
+        Gc = chunk_blocked_G(corners, mask, layout, t, nbc)
         return jax.lax.dynamic_update_slice(
             acc, Gc, (start, 0, 0, 0, 0, 0, 0)
         )
 
-    acc = jnp.zeros((nb, 6, nq, nq, nq, SUBLANES, nl), dtype=dtype)
+    nq = t.nq
+    acc = jnp.zeros((nb, 6, nq, nq, nq, SUBLANES, layout.nl), dtype=dtype)
     for b0 in range(0, nb, ch):
         nbc = min(ch, nb - b0)
         c0, c1 = b0 * B, (b0 + nbc) * B
@@ -382,18 +433,7 @@ def build_folded_laplacian(
 
     t = tables or build_operator_tables(degree, qmode, rule)
     layout = make_layout(mesh.n, degree, t.nq, np.dtype(dtype).itemsize, nl=nl)
-
-    unit = np.zeros((2, 2, 2, 3))
-    g = np.arange(2, dtype=np.float64)
-    unit[..., 0], unit[..., 1], unit[..., 2] = (
-        g[:, None, None], g[None, :, None], g[None, None, :],
-    )
-    corners_cs = np.broadcast_to(unit, (layout.lv, 2, 2, 2, 3)).copy()
-    mask_cs = np.zeros(layout.lv)
-    idx = real_cell_flat_indices(layout)
-    corners_cs[idx] = mesh.cell_corners.reshape(-1, 2, 2, 2, 3)
-    mask_cs[idx] = 1.0
-
+    corners_cs, mask_cs = ghost_corner_arrays(layout, mesh.cell_corners)
     G = _build_G_chunked(corners_cs, mask_cs, layout, t, dtype)
     bc = fold_vector(
         np.asarray(boundary_dof_marker(mesh.n, degree)), layout
