@@ -277,15 +277,31 @@ def folded_cell_apply(
     Y, Yx, Yy, Yz, Yxy, Yxz, Yyz, Yxyz = outs
     # Seam accumulation: the i/j/k = P faces of each cell window coincide
     # with the i/j/k = 0 slots of the +x/+y/+z neighbour (the structured
-    # replacement for atomicAdd scatter).
-    Y = Y.at[0, :, :, Sx:].add(Yx[:, :, : Lv - Sx])
-    Y = Y.at[:, 0, :, Sy:].add(Yy[:, :, : Lv - Sy])
-    Y = Y.at[:, :, 0, Sz:].add(Yz[:, :, : Lv - Sz])
-    Y = Y.at[0, 0, :, Sx + Sy:].add(Yxy[:, : Lv - Sx - Sy])
-    Y = Y.at[0, :, 0, Sx + Sz:].add(Yxz[:, : Lv - Sx - Sz])
-    Y = Y.at[:, 0, 0, Sy + Sz:].add(Yyz[:, : Lv - Sy - Sz])
-    Y = Y.at[0, 0, 0, S7:].add(Yxyz[: Lv - S7])
-    return Y
+    # replacement for atomicAdd scatter). Everything is expressed as
+    # zero-pads + adds — XLA fuses those into one elementwise pass, where
+    # the equivalent .at[...].add chain costs a full-array copy per seam.
+
+    def shift(a, S):
+        """a[..., c] -> contribution at c + S (front zero-pad)."""
+        return jnp.pad(a[..., : Lv - S], [(0, 0)] * (a.ndim - 1) + [(S, 0)])
+
+    def lift(a, axis):
+        """Insert a size-P axis holding `a` at index 0, zeros elsewhere."""
+        pads = [(0, 0)] * (a.ndim + 1)
+        pads[axis] = (0, P - 1)
+        return jnp.pad(jnp.expand_dims(a, axis), pads)
+
+    # Fold edge/corner contributions into the face slabs first (small
+    # arrays), then the three faces into the main block in one fused add.
+    Yx = Yx + lift(shift(Yxy, Sy), 0) + lift(shift(Yxz, Sz), 1) \
+        + lift(lift(shift(Yxyz, Sy + Sz), 0), 1)
+    Yy = Yy + lift(shift(Yyz, Sz), 1)
+    return (
+        Y
+        + lift(shift(Yx, Sx), 0)
+        + lift(shift(Yy, Sy), 1)
+        + lift(shift(Yz, Sz), 2)
+    )
 
 
 # ---------------------------------------------------------------------------
