@@ -49,6 +49,13 @@ def run(ndofs: int) -> dict:
         "value": round(per_chip, 4),
         "unit": "GDoF/s",
         "vs_baseline": round(per_chip / BASELINE_GDOF_PER_GPU, 4),
+        # Self-description (judge/regression visibility): what actually ran.
+        "backend": res.extra.get("backend"),
+        "ndofs_global": res.ndofs_global,
+        "ndofs_requested": ndofs * ndev,
+        "ndevices": ndev,
+        "nreps": NREPS,
+        "cg_wall_s": round(res.mat_free_time, 3),
     }
 
 
@@ -56,10 +63,13 @@ def main() -> int:
     # Adaptive sizing: halve on OOM. 12.5M dofs/chip fits the v5e-class
     # 16 GB HBM with the precomputed geometry tensor plus CG state.
     ndofs = int(sys.argv[1]) if len(sys.argv) > 1 else 12_500_000
+    requested = ndofs
     last_err = None
     while ndofs >= 500_000:
         try:
             out = run(ndofs)
+            if ndofs != requested:
+                out["oom_downsized_from"] = requested
             print(json.dumps(out))
             return 0
         except (RuntimeError, MemoryError) as exc:  # XLA OOM surfaces as RuntimeError

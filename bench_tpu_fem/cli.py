@@ -45,8 +45,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--json", default="", help="Filename for JSON output")
     p.add_argument("--ndevices", type=int, default=0,
                    help="Devices to shard over (0 = all visible devices)")
-    p.add_argument("--backend", default="auto", choices=["auto", "xla", "pallas"],
-                   help="Operator kernel backend (auto: Pallas on TPU f32)")
+    p.add_argument("--backend", default="auto",
+                   choices=["auto", "xla", "pallas", "kron"],
+                   help="Operator kernel backend (auto: kron fast path on "
+                        "uniform meshes, Pallas on TPU f32 otherwise)")
     p.add_argument("--log-level", default="info")
     return p
 

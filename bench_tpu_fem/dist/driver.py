@@ -95,8 +95,16 @@ def run_distributed(cfg, res, dtype):
     with Timer("% Create matfree operator"):
         from ..bench.driver import resolve_backend
 
-        backend = resolve_backend(cfg.backend, cfg.float_bits)
+        # uniform=False: the kron fast path is single-chip only (no sharded
+        # banded apply yet); 'auto' multi-chip runs use the general kernels.
+        backend = resolve_backend(cfg.backend, cfg.float_bits, uniform=False)
+        if backend == "kron":
+            raise ValueError(
+                "backend 'kron' is single-chip only; use backend='auto', "
+                "'xla' or 'pallas' with ndevices > 1"
+            )
         folded = backend == "pallas"
+        res.extra["backend"] = backend
         sharding = NamedSharding(dgrid.mesh, P(*AXIS_NAMES))
         if folded:
             # Folded shards (ghost cell columns = halo; see dist.folded).

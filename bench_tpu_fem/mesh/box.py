@@ -20,6 +20,11 @@ import numpy as np
 class BoxMesh:
     n: tuple[int, int, int]  # cells per direction
     vertices: np.ndarray  # (nx+1, ny+1, nz+1, 3) float64 vertex coordinates
+    # True iff the mesh is the unperturbed axis-aligned uniform grid; uniform
+    # geometry makes the operator an exact Kronecker sum of 1D matrices
+    # (see ops.kron), which is the single-chip fast path. Defaults to False
+    # so a mesh built from arbitrary vertices must opt in explicitly.
+    is_uniform: bool = False
 
     @property
     def ncells(self) -> int:
@@ -57,4 +62,6 @@ def create_box_mesh(
         shift = rng.uniform(-perturb, perturb, size=verts.shape[:3])
         verts = verts.copy()
         verts[..., 0] += shift
-    return BoxMesh(n=(nx, ny, nz), vertices=verts)
+    return BoxMesh(
+        n=(nx, ny, nz), vertices=verts, is_uniform=(geom_perturb_fact == 0.0)
+    )

@@ -296,6 +296,12 @@ def build_laplacian(
     """Assemble operator state from a mesh: tables host-side (f64), geometry
     tensor on device (mirrors MatFreeLaplacianGPU's constructor,
     laplacian.hpp:102-227)."""
+    if backend == "kron":
+        from .kron import build_kron_laplacian
+
+        return build_kron_laplacian(
+            mesh, degree, qmode, rule, kappa=kappa, dtype=dtype, tables=tables
+        )
     t = tables or build_operator_tables(degree, qmode, rule)
     corners = jnp.asarray(mesh.cell_corners.reshape(-1, 2, 2, 2, 3), dtype=dtype)
     G, _ = geometry_factors_jax(corners, t.pts1d, t.wts1d)
