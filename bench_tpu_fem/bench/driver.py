@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import time
 from dataclasses import dataclass, field
+from functools import partial
 
 import numpy as np
 
@@ -58,7 +59,9 @@ class BenchConfig:
     geom_perturb_fact: float = 0.0
     platform: str = "auto"  # "auto" | "tpu" | "cpu": jax default device
     ndevices: int = 1  # chips to shard over (1 = single-chip path)
-    backend: str = "auto"  # operator kernel: "auto" | "xla" | "pallas"
+    # operator kernel: "auto" | "kron" | "xla" | "pallas" (auto resolves to
+    # kron on uniform single-chip meshes; see resolve_backend)
+    backend: str = "auto"
 
 
 @dataclass
@@ -78,10 +81,8 @@ class BenchmarkResults:
     extra: dict = field(default_factory=dict)
 
 
-def _setup_problem(cfg: BenchConfig, n: tuple[int, int, int] | None = None):
-    """Shared host-side setup: mesh, tables, RHS (the oracle-precision f64
-    path, as the reference assembles its RHS on the CPU). The host geometry
-    tensor G is only materialised when the mat_comp oracle needs it."""
+def _mesh_setup(cfg: BenchConfig, n: tuple[int, int, int] | None = None):
+    """Sizing, tables and mesh — O(ncells) host work, no dof-sized arrays."""
     from ..mesh.sizing import compute_mesh_size
 
     if n is None:
@@ -89,6 +90,14 @@ def _setup_problem(cfg: BenchConfig, n: tuple[int, int, int] | None = None):
     rule = "gauss" if cfg.use_gauss else "gll"
     t = build_operator_tables(cfg.degree, cfg.qmode, rule)
     mesh = create_box_mesh(n, geom_perturb_fact=cfg.geom_perturb_fact)
+    return n, rule, t, mesh
+
+
+def _setup_problem(cfg: BenchConfig, n: tuple[int, int, int] | None = None):
+    """Shared host-side setup: mesh, tables, RHS (the oracle-precision f64
+    path, as the reference assembles its RHS on the CPU). The host geometry
+    tensor G is only materialised when the mat_comp oracle needs it."""
+    n, rule, t, mesh = _mesh_setup(cfg, n)
     grid_shape = dof_grid_shape(n, cfg.degree)
     bc_grid = boundary_dof_marker(n, cfg.degree)
 
@@ -154,21 +163,43 @@ def run_benchmark(cfg: BenchConfig) -> BenchmarkResults:
         res = BenchmarkResults(nreps=cfg.nreps)
         return run_distributed(cfg, res, dtype)
 
-    n, rule, t, mesh, grid_shape, bc_grid, dm, b_host, G_host = _setup_problem(cfg)
-    ndofs_global = int(np.prod(grid_shape))
+    n, rule, t, mesh = _mesh_setup(cfg)
+    backend = resolve_backend(cfg.backend, cfg.float_bits, uniform=mesh.is_uniform)
+    ndofs_global = int(np.prod(dof_grid_shape(n, cfg.degree)))
     res = BenchmarkResults(
         ncells_global=mesh.ncells, ndofs_global=ndofs_global, nreps=cfg.nreps
     )
-
-    backend = resolve_backend(cfg.backend, cfg.float_bits, uniform=mesh.is_uniform)
     res.extra["backend"] = backend
+
+    device_setup = backend == "kron" and not cfg.mat_comp
+    if not device_setup:
+        # Host-side RHS/oracle setup (O(ndofs) host arrays; needed by the
+        # mat_comp oracle and the general-geometry backends).
+        _, _, _, _, grid_shape, bc_grid, dm, b_host, G_host = _setup_problem(
+            cfg, n
+        )
+
+    folded = backend == "pallas"
     with Timer("% Create matfree operator"):
-        folded = backend == "pallas"
-        if folded:
-            # The folded vector layout is the TPU fast path (see ops.folded):
-            # no per-apply gather/fold transposes, ~2x the grid-layout rate.
-            # The ndevices>1 branch above routes pallas runs through the
-            # distributed folded path (dist.folded) the same way.
+        if device_setup:
+            # Uniform-mesh fast path: RHS built on device from separable 1D
+            # factors (ops.kron.device_rhs_uniform) — no O(ndofs) host
+            # arrays anywhere, so problem size is capped by HBM, not host
+            # RAM (the reference's 300M-dofs-per-device configs fit).
+            from ..ops.kron import device_rhs_uniform
+
+            op = build_laplacian(
+                mesh, cfg.degree, cfg.qmode, rule, kappa=2.0, dtype=dtype,
+                tables=t, backend="kron",
+            )
+            u = jax.jit(
+                lambda: device_rhs_uniform(t, mesh.n, dtype)
+            )()
+        elif folded:
+            # The folded vector layout is the TPU fast path for general
+            # geometry (see ops.folded): no per-apply gather/fold
+            # transposes, ~2x the grid-layout rate. The ndevices>1 branch
+            # above routes pallas runs through dist.folded the same way.
             from ..ops.folded import build_folded_laplacian, fold_vector
 
             op = build_folded_laplacian(
@@ -192,7 +223,24 @@ def run_benchmark(cfg: BenchConfig) -> BenchmarkResults:
             ).lower(op, u, jnp.zeros_like(u)).compile()
             warm = fn(op, u, jnp.zeros_like(u))
         else:
-            fn = jax.jit(lambda A, x: A.apply(x)).lower(op, u).compile()
+            # All nreps applies in one jitted fori_loop: same semantics as
+            # the reference's per-rep launches (y = A u each rep, same input,
+            # laplacian_solver.cpp:119-127) but with no host dispatch in the
+            # timed region — the reference's launch cost is ~us, while a
+            # host round-trip through the axon tunnel is ~60 ms and would
+            # measure the tunnel, not the operator. The optimization_barrier
+            # ties the apply's input to the loop carry so no present or
+            # future XLA pass can hoist the loop-invariant apply out of the
+            # timed loop (a zero-cost compiler fence, no data movement).
+            def _rep(i, y, A, x):
+                xx, _ = jax.lax.optimization_barrier((x, y))
+                return A.apply(xx)
+
+            fn = jax.jit(
+                lambda A, x: jax.lax.fori_loop(
+                    0, cfg.nreps, partial(_rep, A=A, x=x), jnp.zeros_like(x)
+                )
+            ).lower(op, u).compile()
             warm = fn(op, u)
         # One warm-up execution (fenced): first execution pays one-time
         # transfer/initialisation costs that are not operator throughput.
@@ -203,9 +251,7 @@ def run_benchmark(cfg: BenchConfig) -> BenchmarkResults:
     if cfg.use_cg:
         y = fn(op, u, jnp.zeros_like(u))
     else:
-        y = jnp.zeros_like(u)
-        for _ in range(cfg.nreps):
-            y = fn(op, u)
+        y = fn(op, u)
     y.block_until_ready()
     # Under the axon PJRT tunnel block_until_ready can return before the
     # device work drains; fetching a scalar of the result is a hard fence

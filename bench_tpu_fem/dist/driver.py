@@ -143,7 +143,20 @@ def run_distributed(cfg, res, dtype):
             fn = jax.jit(cg_fn).lower(u, *cg_args).compile()
             run_args = cg_args
         else:
-            fn = jax.jit(apply_fn).lower(u, *apply_args).compile()
+            # One jitted fori_loop over all reps (same rationale as the
+            # single-chip driver: reference per-rep semantics, no host
+            # dispatch in the timed region; the optimization_barrier ties
+            # the input to the loop carry so the invariant apply can never
+            # be hoisted out of the timed loop).
+            def _rep(i, y, x, a):
+                xx, _ = jax.lax.optimization_barrier((x, y))
+                return apply_fn(xx, *a)
+
+            fn = jax.jit(
+                lambda x, *a: jax.lax.fori_loop(
+                    0, cfg.nreps, partial(_rep, x=x, a=a), jnp.zeros_like(x)
+                )
+            ).lower(u, *apply_args).compile()
             run_args = apply_args
         norm_c = jax.jit(norm_fn).lower(u, *norm_args).compile()
         warm = fn(u, *run_args)
@@ -151,12 +164,7 @@ def run_distributed(cfg, res, dtype):
         del warm
 
     t0 = time.perf_counter()
-    if cfg.use_cg:
-        y = fn(u, *run_args)
-    else:
-        y = jnp.zeros_like(u)
-        for _ in range(cfg.nreps):
-            y = fn(u, *run_args)
+    y = fn(u, *run_args)
     y.block_until_ready()
     float(y[(0,) * y.ndim])  # tunnel fence (see bench.driver)
     elapsed = time.perf_counter() - t0

@@ -15,7 +15,9 @@ from bench_tpu_fem.ops.pallas_laplacian import pallas_cell_apply
 jax.config.update("jax_enable_x64", True)
 
 
-@pytest.mark.parametrize("degree,qmode", [(1, 0), (3, 0), (3, 1), (6, 1)])
+@pytest.mark.parametrize(
+    "degree,qmode", [(1, 0), (3, 0), (3, 1), (5, 1), (6, 1), (7, 1)]
+)
 def test_pallas_cell_apply_matches_xla(degree, qmode):
     n = (2, 2, 2)
     mesh = create_box_mesh(n, geom_perturb_fact=0.2)
