@@ -155,13 +155,16 @@ def banded_apply(u: jnp.ndarray, diags: jnp.ndarray, axis: int) -> jnp.ndarray:
 @partial(
     jax.tree_util.register_dataclass,
     data_fields=["Kd", "Md", "notbc1d", "kappa"],
-    meta_fields=["n", "degree"],
+    meta_fields=["n", "degree", "impl"],
 )
 @dataclass(frozen=True)
 class KronLaplacian:
     """Uniform-mesh Laplacian as an exact Kronecker sum (pytree operator,
     same `apply` contract as ops.laplacian.Laplacian: dof-grid vectors in,
-    Dirichlet rows pass through)."""
+    Dirichlet rows pass through).
+
+    impl: 'auto' (Pallas banded kernels for f32 on TPU, XLA otherwise),
+    'xla', or 'pallas' (tests force interpret mode on CPU)."""
 
     Kd: tuple  # 3x (2P+1, N_a) banded diagonals of K_a diag(m_a)
     Md: tuple  # 3x (2P+1, N_a) banded diagonals of M_a diag(m_a)
@@ -169,9 +172,27 @@ class KronLaplacian:
     kappa: jnp.ndarray
     n: tuple[int, int, int]
     degree: int
+    impl: str = "auto"
 
     def apply(self, x_grid: jnp.ndarray) -> jnp.ndarray:
         """y = A @ x on the (NX, NY, NZ) dof grid."""
+        impl = self.impl
+        if impl == "auto":
+            impl = (
+                "pallas"
+                if (
+                    jax.default_backend() == "tpu"
+                    and x_grid.dtype == jnp.float32
+                )
+                else "xla"
+            )
+        if impl == "pallas":
+            from .kron_pallas import kron_apply_pallas
+
+            return kron_apply_pallas(
+                x_grid, self.Kd, self.Md, self.notbc1d, self.kappa,
+                self.degree,
+            )
         Kx, Ky, Kz = self.Kd
         Mx, My, Mz = self.Md
         aKz = banded_apply(x_grid, Kz, 2)
