@@ -86,27 +86,70 @@ def run_distributed(cfg, res, dtype):
 
     dgrid = make_device_grid(cfg.ndevices)
     n = compute_mesh_size_sharded(cfg.ndofs_global, cfg.degree, dgrid.dshape)
-    n, rule, t, mesh, grid_shape, bc_grid, dm, b_host, G_host = _setup_problem(cfg, n)
 
-    res.ncells_global = mesh.ncells
-    res.ndofs_global = int(np.prod(grid_shape))
+    from ..bench.driver import resolve_backend
+    from ..mesh.dofmap import dof_grid_shape
 
-    backend = None
+    backend = resolve_backend(
+        cfg.backend, cfg.float_bits, uniform=cfg.geom_perturb_fact == 0.0
+    )
+    res.extra["backend"] = backend
+    kron = backend == "kron"
+    if kron and cfg.geom_perturb_fact != 0.0:
+        # Mirror build_kron_laplacian's single-chip guard: an explicit
+        # backend='kron' must not silently time the wrong (uniform) operator
+        # on a perturbed mesh.
+        raise ValueError(
+            "kron backend requires an unperturbed (uniform) box mesh; "
+            "use the xla/pallas backends for perturbed geometry"
+        )
+    folded = backend == "pallas"
+    res.ncells_global = int(np.prod(n))
+    res.ndofs_global = int(np.prod(dof_grid_shape(n, cfg.degree)))
+
+    # The kron flagship needs no O(global-dofs) host arrays at all: operator
+    # state is three 1D assemblies and the RHS is built per shard on device
+    # (the reference's per-rank setup, mesh.cpp:190-218 +
+    # laplacian_solver.cpp:100-114, with the 'per-rank' part made closed-form
+    # by the structured box). The host path remains for the general backends
+    # and for the mat_comp oracle.
+    if kron and not cfg.mat_comp:
+        from ..elements.tables import build_operator_tables
+
+        rule = "gauss" if cfg.use_gauss else "gll"
+        t = build_operator_tables(cfg.degree, cfg.qmode, rule)
+        b_host = G_host = dm = bc_grid = None
+    else:
+        n, rule, t, mesh, grid_shape, bc_grid, dm, b_host, G_host = (
+            _setup_problem(cfg, n)
+        )
+
     with Timer("% Create matfree operator"):
-        from ..bench.driver import resolve_backend
-
-        # uniform=False: the kron fast path is single-chip only (no sharded
-        # banded apply yet); 'auto' multi-chip runs use the general kernels.
-        backend = resolve_backend(cfg.backend, cfg.float_bits, uniform=False)
-        if backend == "kron":
-            raise ValueError(
-                "backend 'kron' is single-chip only; use backend='auto', "
-                "'xla' or 'pallas' with ndevices > 1"
-            )
-        folded = backend == "pallas"
-        res.extra["backend"] = backend
         sharding = NamedSharding(dgrid.mesh, P(*AXIS_NAMES))
-        if folded:
+        if kron:
+            from .kron import (
+                build_dist_kron,
+                make_kron_rhs_fn,
+                make_kron_sharded_fns,
+            )
+
+            op = build_dist_kron(
+                n, dgrid, cfg.degree, cfg.qmode, rule, kappa=2.0,
+                dtype=dtype, tables=t,
+            )
+            apply_fn, cg_fn, norm_fn = make_kron_sharded_fns(
+                op, dgrid, cfg.nreps
+            )
+            if b_host is not None:
+                # mat_comp: feed the oracle-precision host RHS to both paths.
+                u_blocks = shard_grid_blocks(b_host, n, cfg.degree, dgrid.dshape)
+                u = jax.device_put(jnp.asarray(u_blocks, dtype=dtype), sharding)
+            else:
+                u = jax.jit(make_kron_rhs_fn(op, dgrid, t))()
+            cg_args = (op,)
+            apply_args = (op,)
+            norm_args = ()
+        elif folded:
             # Folded shards (ghost cell columns = halo; see dist.folded).
             from .folded import (
                 build_dist_folded,

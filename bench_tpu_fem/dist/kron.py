@@ -1,0 +1,362 @@
+"""Distributed Kronecker (uniform-mesh) fast path: the flagship operator
+sharded over the device grid.
+
+The banded Kronecker apply (ops.kron) is a pure stencil on the (NX, NY, NZ)
+dof grid — row i of each 1D factor touches only rows i-P..i+P — so unlike
+the general cell-based operator it needs *no* reverse scatter-add at shard
+seams: each shard can compute every one of its rows exactly, given P planes
+of neighbour input per side per axis. That is the whole distribution
+protocol: 2 `lax.ppermute`s per axis per stage, nothing else (the analogue
+of the reference's ghost scatter, /root/reference/src/vector.hpp:31-149,
+with the scatter-back leg structurally eliminated).
+
+Comm/compute overlap (the reference's lcell/bcell split,
+/root/reference/src/laplacian.hpp:286-347, mapped to XLA): each banded
+stage computes the *zero-padded local* apply — the single-chip kernel,
+unchanged, covering all rows but missing halo contributions in the first
+and last P planes — while the ppermutes are in flight; the received planes
+enter only the recomputation of those 2P boundary planes (an O(P * face)
+epilogue). Because the main kernel has no data dependency on the
+collective, XLA's scheduler is free to run the P-plane exchange behind the
+full-volume compute; only the boundary epilogue waits on it.
+
+Shard layout follows dist.operator: local dof blocks of shape
+(c_a P + 1) per axis where plane 0 duplicates the left neighbour's last
+plane (ghost everywhere but on shard 0). Ghost planes stay *consistent*
+through CG without any exchange: both owners of a seam plane recompute it
+as a full banded row in canonical ascending-diagonal order (_edge_rows)
+from bitwise-identical inputs, and CG's axpys use globally psum-reduced
+scalars — so the duplicate entries remain bit-identical by induction
+(asserted in tests). Reductions count owned planes once via
+dist.halo.owned_mask.
+
+Per-shard 1D coefficients are dynamic-sliced from the replicated global
+banded diagonals ((2P+1, N_a) — kilobytes) by `lax.axis_index`; there is no
+O(global-dofs) host or device setup anywhere in this path (the RHS is built
+per shard from the same separable 1D factors as ops.kron.device_rhs_uniform).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..elements.tables import OperatorTables, build_operator_tables
+from ..ops.kron import axis_matrices_1d, banded_apply, banded_diags
+from .halo import _shift_from_left, _shift_from_right, masked_dot, owned_mask
+from .mesh import AXIS_NAMES, shard_cells
+
+
+def halo_slabs(x: jnp.ndarray, axis: int, name: str, P: int):
+    """Receive P planes of neighbour input from each side along `axis`
+    (zeros at domain edges). With the shared-plane block layout the left
+    neighbour's rows [L-1-P, L-1) are this shard's global rows g0-P..g0-1,
+    and the right neighbour's rows [1, P+1) are rows g0+L..g0+L+P-1."""
+    L = x.shape[axis]
+    to_left = lax.slice_in_dim(x, 1, P + 1, axis=axis)
+    halo_r = _shift_from_right(to_left, name)
+    to_right = lax.slice_in_dim(x, L - 1 - P, L - 1, axis=axis)
+    halo_l = _shift_from_left(to_right, name)
+    return halo_l, halo_r
+
+
+def _edge_rows(x, halo_l, halo_r, dloc, axis: int, P: int):
+    """Recompute the P boundary output planes on each side as full banded
+    rows over the halo-extended window, summing strictly in ascending
+    diagonal order. Both owners of a duplicated seam plane execute this
+    identical term sequence on bitwise-identical inputs, so the duplicates
+    stay *bit-identical* through CG with no ghost refresh — the invariant
+    tests/test_dist_kron.py asserts. Zero halos at global domain edges meet
+    the zero boundary rows of the banded storage, so edge shards need no
+    special casing."""
+    L = dloc.shape[1]
+
+    def plane(h, j):
+        return lax.index_in_dim(h, j, axis=axis, keepdims=True)
+
+    # Extended windows: ext_l[j] = global row g0 - P + j  (extent 3P),
+    # ext_r[j] = global row g0 + L - 2P + j (extent 3P).
+    ext_l = jnp.concatenate(
+        [halo_l, lax.slice_in_dim(x, 0, 2 * P, axis=axis)], axis=axis
+    )
+    ext_r = jnp.concatenate(
+        [lax.slice_in_dim(x, L - 2 * P, L, axis=axis), halo_r], axis=axis
+    )
+    left = []
+    for i in range(P):
+        acc = None
+        for di in range(2 * P + 1):
+            term = dloc[di, i] * plane(ext_l, i + di)
+            acc = term if acc is None else acc + term
+        left.append(acc)
+    right = []
+    for j in range(P):
+        i = L - P + j
+        acc = None
+        for di in range(2 * P + 1):
+            term = dloc[di, i] * plane(ext_r, j + di)
+            acc = term if acc is None else acc + term
+        right.append(acc)
+    return (
+        jnp.concatenate(left, axis=axis),
+        jnp.concatenate(right, axis=axis),
+    )
+
+
+def _replace_edges(y, rows_l, rows_r, axis: int, P: int):
+    L = y.shape[axis]
+    mid = lax.slice_in_dim(y, P, L - P, axis=axis)
+    return jnp.concatenate([rows_l, mid, rows_r], axis=axis)
+
+
+@partial(
+    jax.tree_util.register_dataclass,
+    data_fields=["Kd", "Md", "notbc1d", "kappa"],
+    meta_fields=["n", "L", "dshape", "degree", "impl"],
+)
+@dataclass(frozen=True)
+class DistKronLaplacian:
+    """Sharded uniform-mesh Kronecker operator. All array state is the
+    *global* 1D coefficient set (replicated — kilobytes); per-shard slices
+    are cut inside shard_map by device position."""
+
+    Kd: tuple  # 3x (2P+1, N_a) global banded diagonals of K_a diag(m_a)
+    Md: tuple  # 3x (2P+1, N_a)
+    notbc1d: tuple  # 3x (N_a,)
+    kappa: jnp.ndarray
+    n: tuple[int, int, int]  # global cells per axis
+    L: tuple[int, int, int]  # local dof block shape (c_a * P + 1)
+    dshape: tuple[int, int, int]
+    degree: int
+    impl: str = "auto"
+
+    def local_coeffs(self):
+        """Per-shard coefficient slices (call inside shard_map, once per
+        jitted computation — hoisted out of the CG loop)."""
+        P = self.degree
+        Kloc, Mloc, nbloc = [], [], []
+        for ax, name in enumerate(AXIS_NAMES):
+            La = self.L[ax]
+            g0 = lax.axis_index(name) * (La - 1)
+            z0 = jnp.zeros((), dtype=g0.dtype)
+            Kloc.append(lax.dynamic_slice(self.Kd[ax], (z0, g0), (2 * P + 1, La)))
+            Mloc.append(lax.dynamic_slice(self.Md[ax], (z0, g0), (2 * P + 1, La)))
+            nbloc.append(lax.dynamic_slice(self.notbc1d[ax], (g0,), (La,)))
+        return Kloc, Mloc, nbloc
+
+    def resolve_impl(self) -> str:
+        if self.impl != "auto":
+            return self.impl
+        return (
+            "pallas"
+            if (
+                jax.default_backend() == "tpu"
+                and self.kappa.dtype == jnp.float32
+            )
+            else "xla"
+        )
+
+    def apply_local(self, x, coeffs=None):
+        """y = A x for one shard's (Lx, Ly, Lz) dof block (inside shard_map)."""
+        P = self.degree
+        impl = self.resolve_impl()
+        Kloc, Mloc, nbloc = coeffs if coeffs is not None else self.local_coeffs()
+        if impl == "pallas":
+            from ..ops.kron_pallas import (
+                _use_interpret,
+                x_stage_pallas,
+                y_stage_pallas,
+                z_stage_pallas,
+            )
+
+            interp = _use_interpret()
+
+        # Unsharded axes need no halo/edge pass at all: halos would be zeros
+        # and the zero-padded local apply is already globally exact there
+        # (the banded storage's zero boundary rows). Skipping also keeps
+        # 1-cell-deep unsharded axes legal (L = P + 1 < 2P).
+        sx, sy, sz = (d > 1 for d in self.dshape)
+
+        # --- Z stage (axis 2): halo in flight, local zero-padded main apply
+        # (no data dependency on the collective — XLA overlaps), then the
+        # 2P boundary planes are recomputed canonically from the halos.
+        if sz:
+            hl, hr = halo_slabs(x, 2, AXIS_NAMES[2], P)
+        if impl == "pallas":
+            aK, aM = z_stage_pallas(x, Kloc[2], Mloc[2], P, interp)
+        else:
+            aK = banded_apply(x, Kloc[2], 2)
+            aM = banded_apply(x, Mloc[2], 2)
+        if sz:
+            rl, rr = _edge_rows(x, hl, hr, Kloc[2], 2, P)
+            aK = _replace_edges(aK, rl, rr, 2, P)
+            rl, rr = _edge_rows(x, hl, hr, Mloc[2], 2, P)
+            aM = _replace_edges(aM, rl, rr, 2, P)
+
+        # --- Y stage (axis 1): both operands ride one ppermute payload.
+        if sy:
+            s = jnp.stack([aK, aM])  # y axis is 2 in the stacked view
+            hl, hr = halo_slabs(s, 2, AXIS_NAMES[1], P)
+            hlK, hlM, hrK, hrM = hl[0], hl[1], hr[0], hr[1]
+        if impl == "pallas":
+            t12, tyz = y_stage_pallas(aK, aM, Kloc[1], Mloc[1], P, interp)
+        else:
+            t12 = banded_apply(aK, Mloc[1], 1) + banded_apply(aM, Kloc[1], 1)
+            tyz = banded_apply(aM, Mloc[1], 1)
+        if sy:
+            al, ar = _edge_rows(aK, hlK, hrK, Mloc[1], 1, P)
+            bl, br = _edge_rows(aM, hlM, hrM, Kloc[1], 1, P)
+            t12 = _replace_edges(t12, al + bl, ar + br, 1, P)
+            rl, rr = _edge_rows(aM, hlM, hrM, Mloc[1], 1, P)
+            tyz = _replace_edges(tyz, rl, rr, 1, P)
+
+        # --- X stage (axis 0): kappa folds into the coefficients; the
+        # Dirichlet blend is re-applied on the recomputed boundary planes.
+        cMx = self.kappa * Mloc[0]
+        cKx = self.kappa * Kloc[0]
+        if sx:
+            s = jnp.stack([t12, tyz])  # x axis is 1 in the stacked view
+            hl, hr = halo_slabs(s, 1, AXIS_NAMES[0], P)
+            hlT, hlZ, hrT, hrZ = hl[0], hl[1], hr[0], hr[1]
+        nbx, nby, nbz = nbloc
+        if impl == "pallas":
+            nbc_yz = (nby[:, None] * nbz[None, :]).reshape(1, -1)
+            y = x_stage_pallas(
+                t12, tyz, x, cMx, cKx, nbx, nbc_yz, P, interp
+            )
+        else:
+            acc = banded_apply(t12, cMx, 0) + banded_apply(tyz, cKx, 0)
+            nb3 = nbx[:, None, None] * nby[None, :, None] * nbz[None, None, :]
+            y = nb3 * acc + (1.0 - nb3) * x
+        if not sx:
+            return y
+        tl, tr = _edge_rows(t12, hlT, hrT, cMx, 0, P)
+        zl, zr = _edge_rows(tyz, hlZ, hrZ, cKx, 0, P)
+        nb_yz = nby[None, :, None] * nbz[None, None, :]
+        Lx = x.shape[0]
+        nb_l = nbx[:P, None, None] * nb_yz
+        nb_r = nbx[Lx - P :, None, None] * nb_yz
+        rows_l = nb_l * (tl + zl) + (1.0 - nb_l) * lax.slice_in_dim(x, 0, P, axis=0)
+        rows_r = nb_r * (tr + zr) + (1.0 - nb_r) * lax.slice_in_dim(x, Lx - P, Lx, axis=0)
+        return _replace_edges(y, rows_l, rows_r, 0, P)
+
+
+def build_dist_kron(
+    n: tuple[int, int, int],
+    dgrid,
+    degree: int,
+    qmode: int,
+    rule: str = "gll",
+    kappa: float = 2.0,
+    dtype=jnp.float32,
+    tables: OperatorTables | None = None,
+    impl: str = "auto",
+) -> DistKronLaplacian:
+    """Build the sharded Kronecker operator for the uniform n-cell box over
+    the device grid. Host work is O(N^(1/3)) — three 1D assemblies."""
+    t = tables or build_operator_tables(degree, qmode, rule)
+    dshape = dgrid.dshape
+    ncl = shard_cells(n, dshape)
+    for c, d in zip(ncl, dshape):
+        if d > 1 and c < 2:
+            raise ValueError(
+                "distributed kron needs >= 2 cells per shard on sharded axes "
+                f"(got {ncl} cells/shard over device mesh {dshape})"
+            )
+    P = degree
+    Ks, Ms, masks = axis_matrices_1d(t, n)
+    Kd = tuple(jnp.asarray(banded_diags(K1, P), dtype=dtype) for K1 in Ks)
+    Md = tuple(jnp.asarray(banded_diags(M1, P), dtype=dtype) for M1 in Ms)
+    nb = tuple(jnp.asarray(m, dtype=dtype) for m in masks)
+    return DistKronLaplacian(
+        Kd=Kd,
+        Md=Md,
+        notbc1d=nb,
+        kappa=jnp.asarray(kappa, dtype=dtype),
+        n=tuple(n),
+        L=tuple(c * P + 1 for c in ncl),
+        dshape=tuple(dshape),
+        degree=degree,
+        impl=impl,
+    )
+
+
+def make_kron_sharded_fns(op: DistKronLaplacian, dgrid, nreps: int):
+    """Jittable sharded callables (apply, CG, norm) over (Dx,Dy,Dz,Lx,Ly,Lz)
+    grid blocks — same contract as dist.folded.make_folded_sharded_fns.
+    The operator rides along as a replicated pytree argument."""
+    from jax.sharding import PartitionSpec as P
+
+    from ..la.cg import cg_solve
+
+    spec = P(*AXIS_NAMES)
+    rep = P()
+    # pallas_call's out_shape carries no varying-mesh-axes annotation, which
+    # the default shard_map VMA check rejects; scope the opt-out to the
+    # impl that needs it.
+    vma = op.resolve_impl() != "pallas"
+
+    def _local(a):
+        return a[0, 0, 0]
+
+    def _dot(mask):
+        return lambda u, v: masked_dot(u, v, mask)
+
+    @partial(jax.shard_map, mesh=dgrid.mesh, in_specs=(spec, rep),
+             out_specs=spec, check_vma=vma)
+    def apply_fn(x, A):
+        return A.apply_local(_local(x))[None, None, None]
+
+    @partial(jax.shard_map, mesh=dgrid.mesh, in_specs=(spec, rep),
+             out_specs=spec, check_vma=vma)
+    def cg_fn(b, A):
+        bl = _local(b)
+        coeffs = A.local_coeffs()  # hoisted: sliced once, reused every iter
+        x = cg_solve(
+            lambda v: A.apply_local(v, coeffs),
+            bl,
+            jnp.zeros_like(bl),
+            nreps,
+            dot=_dot(owned_mask(bl.shape)),
+        )
+        return x[None, None, None]
+
+    @partial(jax.shard_map, mesh=dgrid.mesh, in_specs=spec, out_specs=rep)
+    def norm_fn(x):
+        xl = _local(x)
+        return jnp.sqrt(_dot(owned_mask(xl.shape))(xl, xl))
+
+    return apply_fn, cg_fn, norm_fn
+
+
+def make_kron_rhs_fn(op: DistKronLaplacian, dgrid, tables: OperatorTables):
+    """Jittable sharded RHS builder: b = M3d f_h per shard, from the global
+    separable 1D factors (ops.kron.rhs_factors_1d — O(N^(1/3)) host work,
+    replicated kilobytes on device, one outer product per shard). The
+    distributed analogue of ops.kron.device_rhs_uniform; no O(global-dofs)
+    array exists anywhere."""
+    from jax.sharding import PartitionSpec as P
+
+    from ..ops.kron import rhs_factors_1d
+
+    factors = rhs_factors_1d(tables, op.n)
+    dtype = op.kappa.dtype
+    fs = tuple(jnp.asarray(f, dtype=dtype) for f in factors)
+
+    @partial(jax.shard_map, mesh=dgrid.mesh, in_specs=(P(), P(), P()),
+             out_specs=P(*AXIS_NAMES))
+    def rhs_fn(fx, fy, fz):
+        loc = []
+        for ax, (name, f) in enumerate(zip(AXIS_NAMES, (fx, fy, fz))):
+            La = op.L[ax]
+            g0 = lax.axis_index(name) * (La - 1)
+            loc.append(lax.dynamic_slice(f, (g0,), (La,)))
+        b = loc[0][:, None, None] * loc[1][None, :, None] * loc[2][None, None, :]
+        return b[None, None, None]
+
+    return lambda: rhs_fn(*fs)

@@ -21,8 +21,12 @@ def compute_mesh_size(
     n0 = int(nx_approx + 0.5)
 
     def candidates(d: int) -> np.ndarray:
-        base = max(d, round(max(1, n0) / d) * d)
-        return np.array(sorted({max(d, base + k * d) for k in range(-5, 6)}), dtype=np.int64)
+        # Sharded axes need >= 2 cell layers per shard: the halo protocols
+        # (dist.kron P-plane exchange, dist.folded ghost columns) exchange
+        # owned-interior data that a 1-cell-deep shard does not have.
+        lo = 2 * d if d > 1 else d
+        base = max(lo, round(max(1, n0) / d) * d)
+        return np.array(sorted({max(lo, base + k * d) for k in range(-5, 6)}), dtype=np.int64)
 
     cx, cy, cz = (candidates(d) for d in dshape)
     ndx, ndy, ndz = (c * degree + 1 for c in (cx, cy, cz))

@@ -217,20 +217,21 @@ def dof_coords_1d(ncells: int, nodes1d: np.ndarray) -> np.ndarray:
     return x
 
 
-def device_rhs_uniform(
-    t: OperatorTables, n: tuple[int, int, int], dtype
-) -> jnp.ndarray:
-    """RHS b = M3d f_h with Dirichlet rows zeroed, built with O(N^(1/3))
-    host work: on the uniform mesh the mass matrix is separable
-    (M_x (x) M_y (x) M_z) *and* the benchmark source is separable
-    (1000 exp(-((x-.5)^2+(y-.5)^2)/0.02) = 1000 g(x) g(y) * 1), so
+def rhs_factors_1d(
+    t: OperatorTables, n: tuple[int, int, int]
+) -> list[np.ndarray]:
+    """The three 1D factors of the RHS b = M3d f_h with Dirichlet rows
+    zeroed, built with O(N^(1/3)) host work: on the uniform mesh the mass
+    matrix is separable (M_x (x) M_y (x) M_z) *and* the benchmark source is
+    separable (1000 exp(-((x-.5)^2+(y-.5)^2)/0.02) = 1000 g(x) g(y) * 1), so
 
         b = 1000 * (m_x o M_x g_x) (x) (m_y o M_y g_y) (x) (m_z o M_z 1)
 
-    — three tiny host-side 1D mass applies and one device outer product.
-    Replaces the O(N) host assembly path (fem.assemble.assemble_rhs,
-    mirroring /root/reference/src/laplacian_solver.cpp:100-105) for
-    uniform-mesh runs, where host memory would otherwise cap the problem
+    — three tiny host-side 1D mass applies; the caller outer-products them
+    on device (device_rhs_uniform single-chip, dist.kron.make_kron_rhs_fn
+    per shard). Replaces the O(N) host assembly path (fem.assemble.
+    assemble_rhs, mirroring /root/reference/src/laplacian_solver.cpp:100-105)
+    for uniform-mesh runs, where host memory would otherwise cap the problem
     size far below HBM capacity. Exactness vs the host path is tested."""
     from ..fem.source import default_source
 
@@ -268,8 +269,15 @@ def device_rhs_uniform(
             "benchmark source is not separable; device_rhs_uniform cannot "
             "be used (update ops.kron or use the host assembly path)"
         )
-    factors = [(M1 @ ga) * m for M1, ga, m in zip(Ms, g, masks)]
-    fx, fy, fz = (jnp.asarray(f, dtype=dtype) for f in factors)
+    return [(M1 @ ga) * m for M1, ga, m in zip(Ms, g, masks)]
+
+
+def device_rhs_uniform(
+    t: OperatorTables, n: tuple[int, int, int], dtype
+) -> jnp.ndarray:
+    """Single-chip device RHS: outer product of the separable 1D factors
+    (see rhs_factors_1d)."""
+    fx, fy, fz = (jnp.asarray(f, dtype=dtype) for f in rhs_factors_1d(t, n))
     return fx[:, None, None] * fy[None, :, None] * fz[None, None, :]
 
 

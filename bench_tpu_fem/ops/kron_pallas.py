@@ -105,6 +105,95 @@ def _make_x_kernel(P: int, NX: int):
     return kern
 
 
+def _vspec(bs, ix):
+    return pl.BlockSpec(bs, ix, memory_space=pltpu.VMEM)
+
+
+def z_stage_pallas(x, Kzd, Mzd, P, interpret, row_block=256):
+    """(NX, NY, NZ) -> (K_z x, M_z x), both (NX, NY, NZ). Coefficient arrays
+    are (2P+1, NZ) banded diagonals (any slice of a global banded matrix —
+    the distributed path passes per-shard slices)."""
+    NX, NY, NZ = x.shape
+    dtype = x.dtype
+    R = NX * NY
+    TR = min(row_block, R)
+    x2 = x.reshape(R, NZ)
+    aK, aM = pl.pallas_call(
+        _make_z_kernel(P, NZ),
+        grid=(_cdiv(R, TR),),
+        in_specs=[
+            _vspec((TR, NZ), lambda i: (i, 0)),
+            _vspec((2 * P + 1, NZ), lambda i: (0, 0)),
+            _vspec((2 * P + 1, NZ), lambda i: (0, 0)),
+        ],
+        out_specs=[
+            _vspec((TR, NZ), lambda i: (i, 0)),
+            _vspec((TR, NZ), lambda i: (i, 0)),
+        ],
+        out_shape=[jax.ShapeDtypeStruct((R, NZ), dtype)] * 2,
+        interpret=interpret,
+    )(x2, Kzd.astype(dtype), Mzd.astype(dtype))
+    return aK.reshape(NX, NY, NZ), aM.reshape(NX, NY, NZ)
+
+
+def y_stage_pallas(aK3, aM3, Kyd, Myd, P, interpret, lane_block=512):
+    """(aK, aM) -> (t12 = M_y aK + K_y aM, tyz = M_y aM)."""
+    NX, NY, NZ = aK3.shape
+    dtype = aK3.dtype
+    CZ = min(lane_block, NZ)
+    return pl.pallas_call(
+        _make_y_kernel(P, NY),
+        grid=(NX, _cdiv(NZ, CZ)),
+        in_specs=[
+            _vspec((1, NY, CZ), lambda i, j: (i, 0, j)),
+            _vspec((1, NY, CZ), lambda i, j: (i, 0, j)),
+            _vspec((2 * P + 1, NY), lambda i, j: (0, 0)),
+            _vspec((2 * P + 1, NY), lambda i, j: (0, 0)),
+        ],
+        out_specs=[
+            _vspec((1, NY, CZ), lambda i, j: (i, 0, j)),
+            _vspec((1, NY, CZ), lambda i, j: (i, 0, j)),
+        ],
+        out_shape=[jax.ShapeDtypeStruct((NX, NY, NZ), dtype)] * 2,
+        interpret=interpret,
+    )(aK3, aM3, Kyd.astype(dtype), Myd.astype(dtype))
+
+
+def x_stage_pallas(t12, tyz, x, cMx, cKx, mx, nbc_yz, P, interpret,
+                   lane_block=512):
+    """(t12, tyz, x) -> blended y = nb * (cMx t12 + cKx tyz) + (1 - nb) x.
+    kappa is pre-folded into cMx/cKx by the caller; nb = mx (outer) nbc_yz."""
+    NX, NY, NZ = x.shape
+    dtype = x.dtype
+    RZ = NY * NZ
+    CL = min(lane_block, RZ)
+    y2 = pl.pallas_call(
+        _make_x_kernel(P, NX),
+        grid=(_cdiv(RZ, CL),),
+        in_specs=[
+            _vspec((NX, CL), lambda i: (0, i)),
+            _vspec((NX, CL), lambda i: (0, i)),
+            _vspec((NX, CL), lambda i: (0, i)),
+            _vspec((2 * P + 1, NX), lambda i: (0, 0)),
+            _vspec((2 * P + 1, NX), lambda i: (0, 0)),
+            _vspec((NX, 1), lambda i: (0, 0)),
+            _vspec((1, CL), lambda i: (0, i)),
+        ],
+        out_specs=_vspec((NX, CL), lambda i: (0, i)),
+        out_shape=jax.ShapeDtypeStruct((NX, RZ), dtype),
+        interpret=interpret,
+    )(
+        t12.reshape(NX, RZ),
+        tyz.reshape(NX, RZ),
+        x.reshape(NX, RZ),
+        cMx.astype(dtype),
+        cKx.astype(dtype),
+        mx[:, None].astype(dtype),
+        nbc_yz.astype(dtype),
+    )
+    return y2.reshape(NX, NY, NZ)
+
+
 def kron_apply_pallas(
     x: jnp.ndarray,  # (NX, NY, NZ) dof grid
     Kd: tuple,  # 3x (2P+1, N_a) banded diagonals (bc-folded)
@@ -118,84 +207,17 @@ def kron_apply_pallas(
 ) -> jnp.ndarray:
     """Full uniform-mesh operator apply as three Pallas kernels."""
     P = degree
-    NX, NY, NZ = x.shape
-    dtype = x.dtype
     interp = _use_interpret() if interpret is None else interpret
 
-    Kzd, Myd, Kyd, Mzd = Kd[2], Md[1], Kd[1], Md[2]
     # kappa folds into the x-axis coefficients (the final stage).
-    cMx = (kappa * Md[0]).astype(dtype)
-    cKx = (kappa * Kd[0]).astype(dtype)
-
-    # --- Z stage: (R, NZ) rows, full z extent per tile
-    R = NX * NY
-    TR = min(row_block, R)
-    x2 = x.reshape(R, NZ)
-    vspec = lambda bs, ix: pl.BlockSpec(bs, ix, memory_space=pltpu.VMEM)  # noqa: E731
-    aK, aM = pl.pallas_call(
-        _make_z_kernel(P, NZ),
-        grid=(_cdiv(R, TR),),
-        in_specs=[
-            vspec((TR, NZ), lambda i: (i, 0)),
-            vspec((2 * P + 1, NZ), lambda i: (0, 0)),
-            vspec((2 * P + 1, NZ), lambda i: (0, 0)),
-        ],
-        out_specs=[
-            vspec((TR, NZ), lambda i: (i, 0)),
-            vspec((TR, NZ), lambda i: (i, 0)),
-        ],
-        out_shape=[jax.ShapeDtypeStruct((R, NZ), dtype)] * 2,
-        interpret=interp,
-    )(x2, Kzd.astype(dtype), Mzd.astype(dtype))
-
-    # --- Y stage: (1, NY, CZ) slabs, full y extent per tile
-    CZ = min(lane_block, NZ)
-    aK3 = aK.reshape(NX, NY, NZ)
-    aM3 = aM.reshape(NX, NY, NZ)
-    t12, tyz = pl.pallas_call(
-        _make_y_kernel(P, NY),
-        grid=(NX, _cdiv(NZ, CZ)),
-        in_specs=[
-            vspec((1, NY, CZ), lambda i, j: (i, 0, j)),
-            vspec((1, NY, CZ), lambda i, j: (i, 0, j)),
-            vspec((2 * P + 1, NY), lambda i, j: (0, 0)),
-            vspec((2 * P + 1, NY), lambda i, j: (0, 0)),
-        ],
-        out_specs=[
-            vspec((1, NY, CZ), lambda i, j: (i, 0, j)),
-            vspec((1, NY, CZ), lambda i, j: (i, 0, j)),
-        ],
-        out_shape=[jax.ShapeDtypeStruct((NX, NY, NZ), dtype)] * 2,
-        interpret=interp,
-    )(aK3, aM3, Kyd.astype(dtype), Myd.astype(dtype))
-
-    # --- X stage: (NX, CL) slabs, full x extent per tile, fused bc blend
-    RZ = NY * NZ
-    CL = min(lane_block, RZ)
+    cMx = kappa * Md[0]
+    cKx = kappa * Kd[0]
     mx, my, mz = notbc1d
-    nbc_yz = (my[:, None] * mz[None, :]).reshape(1, RZ).astype(dtype)
-    y2 = pl.pallas_call(
-        _make_x_kernel(P, NX),
-        grid=(_cdiv(RZ, CL),),
-        in_specs=[
-            vspec((NX, CL), lambda i: (0, i)),
-            vspec((NX, CL), lambda i: (0, i)),
-            vspec((NX, CL), lambda i: (0, i)),
-            vspec((2 * P + 1, NX), lambda i: (0, 0)),
-            vspec((2 * P + 1, NX), lambda i: (0, 0)),
-            vspec((NX, 1), lambda i: (0, 0)),
-            vspec((1, CL), lambda i: (0, i)),
-        ],
-        out_specs=vspec((NX, CL), lambda i: (0, i)),
-        out_shape=jax.ShapeDtypeStruct((NX, RZ), dtype),
-        interpret=interp,
-    )(
-        t12.reshape(NX, RZ),
-        tyz.reshape(NX, RZ),
-        x.reshape(NX, RZ),
-        cMx,
-        cKx,
-        mx[:, None].astype(dtype),
-        nbc_yz,
+    NY, NZ = x.shape[1], x.shape[2]
+    nbc_yz = (my[:, None] * mz[None, :]).reshape(1, NY * NZ)
+
+    aK, aM = z_stage_pallas(x, Kd[2], Md[2], P, interp, row_block)
+    t12, tyz = y_stage_pallas(aK, aM, Kd[1], Md[1], P, interp, lane_block)
+    return x_stage_pallas(
+        t12, tyz, x, cMx, cKx, mx, nbc_yz, P, interp, lane_block
     )
-    return y2.reshape(NX, NY, NZ)
