@@ -62,6 +62,9 @@ class BenchConfig:
     # operator kernel: "auto" | "kron" | "xla" | "pallas" (auto resolves to
     # kron on uniform single-chip meshes; see resolve_backend)
     backend: str = "auto"
+    # non-empty: wrap the timed region in jax.profiler.trace writing to this
+    # directory (device timelines; view with TensorBoard / xprof)
+    profile_dir: str = ""
 
 
 @dataclass
@@ -72,6 +75,8 @@ class BenchmarkResults:
     mat_free_time: float = 0.0
     unorm: float = 0.0
     ynorm: float = 0.0
+    unorm_linf: float = 0.0
+    ynorm_linf: float = 0.0
     znorm: float = 0.0
     enorm: float = 0.0
     ncells_global: int = 0
@@ -217,10 +222,35 @@ def run_benchmark(cfg: BenchConfig) -> BenchmarkResults:
         # operator is a pytree *argument*, not a closure capture: closed-over
         # arrays become HLO constants, and the geometry tensor G (hundreds of
         # MB at benchmark sizes) must stay an HBM-resident parameter.
+        # Folded operators have a fused benchmark engine (ops.folded_cg):
+        # delay-ring single-view apply, in-kernel p-update/dots/bc — the
+        # measured fast path. Valid because every CG/action vector here
+        # descends from the RHS, whose bc rows are zero (homogeneous
+        # Dirichlet). Falls back to apply_cg (multi-view fused kernel) when
+        # the input ring would not fit VMEM.
+        engine = False
+        if folded:
+            from ..ops.folded_cg import (
+                folded_apply_ring,
+                folded_cg_solve,
+                supports_cg_engine,
+            )
+
+            engine = supports_cg_engine(op)
+            res.extra["geom"] = "corner" if op.G is None else "g"
+            res.extra["cg_engine"] = engine
+        apply_fn = (lambda A: A.apply_cg) if folded else (lambda A: A.apply)
+        if engine:
+            apply_fn = lambda A: partial(folded_apply_ring, A)  # noqa: E731
         if cfg.use_cg:
-            fn = jax.jit(
-                lambda A, b, x0: cg_solve(A.apply, b, x0, cfg.nreps)
-            ).lower(op, u, jnp.zeros_like(u)).compile()
+            if engine:
+                fn = jax.jit(
+                    lambda A, b, x0: folded_cg_solve(A, b, cfg.nreps)
+                ).lower(op, u, jnp.zeros_like(u)).compile()
+            else:
+                fn = jax.jit(
+                    lambda A, b, x0: cg_solve(apply_fn(A), b, x0, cfg.nreps)
+                ).lower(op, u, jnp.zeros_like(u)).compile()
             warm = fn(op, u, jnp.zeros_like(u))
         else:
             # All nreps applies in one jitted fori_loop: same semantics as
@@ -234,7 +264,7 @@ def run_benchmark(cfg: BenchConfig) -> BenchmarkResults:
             # timed loop (a zero-cost compiler fence, no data movement).
             def _rep(i, y, A, x):
                 xx, _ = jax.lax.optimization_barrier((x, y))
-                return A.apply(xx)
+                return apply_fn(A)(xx)
 
             fn = jax.jit(
                 lambda A, x: jax.lax.fori_loop(
@@ -247,21 +277,32 @@ def run_benchmark(cfg: BenchConfig) -> BenchmarkResults:
         float(warm[(0,) * warm.ndim])
         del warm
 
-    t0 = time.perf_counter()
-    if cfg.use_cg:
-        y = fn(op, u, jnp.zeros_like(u))
-    else:
-        y = fn(op, u)
-    y.block_until_ready()
-    # Under the axon PJRT tunnel block_until_ready can return before the
-    # device work drains; fetching a scalar of the result is a hard fence
-    # (4-byte transfer, one slice kernel — negligible vs the timed work).
-    float(y[(0,) * y.ndim])
-    elapsed = time.perf_counter() - t0
+    from contextlib import nullcontext
+
+    prof = (
+        jax.profiler.trace(cfg.profile_dir) if cfg.profile_dir
+        else nullcontext()
+    )
+    with prof:
+        t0 = time.perf_counter()
+        if cfg.use_cg:
+            y = fn(op, u, jnp.zeros_like(u))
+        else:
+            y = fn(op, u)
+        y.block_until_ready()
+        # Under the axon PJRT tunnel block_until_ready can return before the
+        # device work drains; fetching a scalar of the result is a hard fence
+        # (4-byte transfer, one slice kernel — negligible vs the timed work).
+        float(y[(0,) * y.ndim])
+        elapsed = time.perf_counter() - t0
 
     res.mat_free_time = elapsed
-    res.unorm = float(jnp.linalg.norm(u))
-    res.ynorm = float(jnp.linalg.norm(y))
+    from ..la.vector import norm, norm_linf
+
+    res.unorm = float(norm(u))
+    res.ynorm = float(norm(y))
+    res.unorm_linf = float(norm_linf(u))
+    res.ynorm_linf = float(norm_linf(y))
     res.gdof_per_second = ndofs_global * cfg.nreps / (1e9 * elapsed)
 
     if cfg.mat_comp:

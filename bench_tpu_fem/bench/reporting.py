@@ -46,6 +46,8 @@ def results_json(cfg: BenchConfig, res: BenchmarkResults) -> str:
             "mat_free_time": res.mat_free_time,
             "u_norm": res.unorm,
             "y_norm": res.ynorm,
+            "u_norm_linf": res.unorm_linf,
+            "y_norm_linf": res.ynorm_linf,
             "z_norm": res.znorm,
             "gdof_per_second": res.gdof_per_second,
         },

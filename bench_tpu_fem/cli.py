@@ -50,6 +50,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="Operator kernel backend (auto: kron fast path on "
                         "uniform meshes, Pallas on TPU f32 otherwise)")
     p.add_argument("--log-level", default="info")
+    p.add_argument("--profile", default="",
+                   help="Write a jax.profiler trace of the timed region to "
+                        "this directory (view with TensorBoard / xprof)")
     return p
 
 
@@ -123,6 +126,7 @@ def main(argv: list[str] | None = None) -> int:
         platform=args.platform,
         ndevices=ndevices,
         backend=args.backend,
+        profile_dir=args.profile,
     )
 
     dev = devices[0]

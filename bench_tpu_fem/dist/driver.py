@@ -12,7 +12,7 @@ import numpy as np
 
 from ..la.cg import cg_solve
 from ..utils.timing import Timer
-from .halo import masked_dot, owned_mask
+from .halo import masked_dot, masked_linf, owned_mask
 from .mesh import AXIS_NAMES, compute_mesh_size_sharded, make_device_grid
 from .operator import (
     build_dist_laplacian,
@@ -69,9 +69,12 @@ def make_sharded_fns(op, dgrid, nreps: int):
         out_specs=rep,
     )
     def norm_fn(x):
+        """Global (L2, Linf) over owned dofs (psum / pmax)."""
         xl = _local(x)
         mask = owned_mask(xl.shape)
-        return jnp.sqrt(masked_dot(xl, xl, mask))
+        return jnp.stack(
+            [jnp.sqrt(masked_dot(xl, xl, mask)), masked_linf(xl, mask)]
+        )
 
     return apply_fn, cg_fn, norm_fn
 
@@ -206,15 +209,24 @@ def run_distributed(cfg, res, dtype):
         float(warm[(0,) * warm.ndim])
         del warm
 
-    t0 = time.perf_counter()
-    y = fn(u, *run_args)
-    y.block_until_ready()
-    float(y[(0,) * y.ndim])  # tunnel fence (see bench.driver)
-    elapsed = time.perf_counter() - t0
+    from contextlib import nullcontext
+
+    prof = (
+        jax.profiler.trace(cfg.profile_dir) if cfg.profile_dir
+        else nullcontext()
+    )
+    with prof:
+        t0 = time.perf_counter()
+        y = fn(u, *run_args)
+        y.block_until_ready()
+        float(y[(0,) * y.ndim])  # tunnel fence (see bench.driver)
+        elapsed = time.perf_counter() - t0
 
     res.mat_free_time = elapsed
-    res.unorm = float(norm_c(u, *norm_args))
-    res.ynorm = float(norm_c(y, *norm_args))
+    un = np.asarray(norm_c(u, *norm_args))
+    yn = np.asarray(norm_c(y, *norm_args))
+    res.unorm, res.unorm_linf = float(un[0]), float(un[1])
+    res.ynorm, res.ynorm_linf = float(yn[0]), float(yn[1])
     res.gdof_per_second = res.ndofs_global * cfg.nreps / (1e9 * elapsed)
 
     if cfg.mat_comp:

@@ -40,21 +40,27 @@ from ..ops.folded import (
     unfold_vector,
 )
 from ..ops.laplacian import freeze_table
-from .halo import _shift_from_left, _shift_from_right, psum_all
+from .halo import _shift_from_left, _shift_from_right, masked_linf, psum_all
 from .mesh import AXIS_NAMES, shard_cells
 
 
 def _cview(x: jnp.ndarray, layout: FoldedLayout) -> jnp.ndarray:
-    """Folded vector -> 6D cell view (P, P, P, npx, npy, npz) (drops the
-    block-padding tail, which stays untouched by halo traffic)."""
+    """Folded (nb, P^3, B) vector -> 6D cell view (P, P, P, npx, npy, npz)
+    (drops the block-padding tail, which stays untouched by halo traffic)."""
     P = layout.degree
-    return x[..., : layout.cg].reshape(P, P, P, *layout.np3)
+    flat = jnp.transpose(x, (1, 0, 2)).reshape(P * P * P, layout.lv)
+    return flat[:, : layout.cg].reshape(P, P, P, *layout.np3)
 
 
 def _from_cview(v: jnp.ndarray, x: jnp.ndarray, layout: FoldedLayout) -> jnp.ndarray:
     P = layout.degree
-    flat = v.reshape(P, P, P, layout.cg)
-    return jnp.concatenate([flat, x[..., layout.cg:]], axis=-1)
+    xflat = jnp.transpose(x, (1, 0, 2)).reshape(P * P * P, layout.lv)
+    flat = jnp.concatenate(
+        [v.reshape(P * P * P, layout.cg), xflat[:, layout.cg:]], axis=-1
+    )
+    return jnp.transpose(
+        flat.reshape(P * P * P, layout.nblocks, layout.block), (1, 0, 2)
+    )
 
 
 def folded_halo_refresh(x: jnp.ndarray, layout: FoldedLayout) -> jnp.ndarray:
@@ -131,8 +137,8 @@ class DistFoldedLaplacian:
     sharded over the device grid)."""
 
     G: jnp.ndarray  # (Dx,Dy,Dz, nblocks, 6, nq,nq,nq, 8, nl)
-    bc_mask: jnp.ndarray  # (Dx,Dy,Dz, P,P,P, Lv) bool
-    owned: jnp.ndarray  # (Dx,Dy,Dz, P,P,P, Lv) bool: dof counted here
+    bc_mask: jnp.ndarray  # (Dx,Dy,Dz, nb, P^3, B) bool
+    owned: jnp.ndarray  # (Dx,Dy,Dz, nb, P^3, B) bool: dof counted here
     kappa: jnp.ndarray
     n_local: tuple[int, int, int]
     degree: int
@@ -169,7 +175,7 @@ def shard_folded_vectors(
     layout: FoldedLayout,
 ) -> np.ndarray:
     """Global dof grid -> stacked per-shard folded vectors
-    (Dx, Dy, Dz, P, P, P, Lv). Each shard folds its inclusive local block
+    (Dx, Dy, Dz, nb, P^3, B). Each shard folds its inclusive local block
     (owned planes + the right-neighbour-owned closing plane, which lands in
     ghost slots: harmless placeholders, refreshed before use)."""
     P = degree
@@ -219,22 +225,24 @@ def owned_folded_mask(layout: FoldedLayout, shard_pos, dshape) -> np.ndarray:
     """Host-side: bool mask of slots counted by this shard in global
     reductions (every dof exactly once). Structural slots and interior
     shards' ghost columns are excluded."""
+    P3 = layout.degree ** 3
     marks = fold_vector(
         np.ones(tuple(c * layout.degree + 1 for c in layout.n)), layout
     ) > 0
-    v = marks[..., : layout.cg].reshape(
+    mflat = marks.transpose(1, 0, 2).reshape(P3, layout.lv)
+    v = mflat[:, : layout.cg].reshape(
         layout.degree, layout.degree, layout.degree, *layout.np3
-    )
+    ).copy()
     for ax in range(3):
         if shard_pos[ax] != dshape[ax] - 1:
             sl = [slice(None)] * 6
             sl[3 + ax] = layout.np3[ax] - 1
             v[tuple(sl)] = False
-    out = np.zeros(layout.vec_shape, dtype=bool)
-    out[..., : layout.cg] = v.reshape(
-        layout.degree, layout.degree, layout.degree, layout.cg
+    flat = np.zeros((P3, layout.lv), dtype=bool)
+    flat[:, : layout.cg] = v.reshape(P3, layout.cg)
+    return np.ascontiguousarray(
+        flat.reshape(P3, layout.nblocks, layout.block).transpose(1, 0, 2)
     )
-    return out
 
 
 def build_dist_folded(
@@ -334,8 +342,12 @@ def make_folded_sharded_fns(op: DistFoldedLaplacian, dgrid, nreps: int):
 
         return dot
 
-    # check_vma=False: pallas_call's out_shape carries no varying-mesh-axes
-    # annotation, which the default shard_map VMA check rejects.
+    # check_vma=False is *required* here, not a blanket waiver: every folded
+    # sharded computation runs the Pallas kernel (folded_cell_apply), whose
+    # pallas_call output carries no varying-mesh-axes annotation, and the
+    # default shard_map VMA check rejects exactly that. This mirrors
+    # dist/kron.py's scoped `check_vma = impl != "pallas"` — the folded path
+    # simply has no non-pallas impl to scope back to.
     @partial(jax.shard_map, mesh=dgrid.mesh,
              in_specs=(spec, spec, spec), out_specs=spec, check_vma=False)
     def apply_fn(x, G, bc):
@@ -356,7 +368,10 @@ def make_folded_sharded_fns(op: DistFoldedLaplacian, dgrid, nreps: int):
 
     @partial(jax.shard_map, mesh=dgrid.mesh, in_specs=(spec, spec), out_specs=rep)
     def norm_fn(x, owned):
-        xl = _local(x)
-        return jnp.sqrt(_dot(_local(owned))(xl, xl))
+        """Global (L2, Linf) over owned dofs (psum / pmax)."""
+        xl, ol = _local(x), _local(owned)
+        return jnp.stack(
+            [jnp.sqrt(_dot(ol)(xl, xl)), masked_linf(xl, ol)]
+        )
 
     return apply_fn, cg_fn, norm_fn

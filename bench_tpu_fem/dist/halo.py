@@ -101,6 +101,18 @@ def psum_all(x):
     return lax.psum(x, AXIS_NAMES)
 
 
+def pmax_all(x):
+    """Max over the whole device grid (the reference's MPI_MAX for Linf,
+    vector.hpp:211)."""
+    return lax.pmax(x, AXIS_NAMES)
+
+
 def masked_dot(a: jnp.ndarray, b: jnp.ndarray, mask: jnp.ndarray):
     local = jnp.sum(a * b * mask.astype(a.dtype))
     return psum_all(local)
+
+
+def masked_linf(a: jnp.ndarray, mask: jnp.ndarray):
+    """Global Linf over owned dofs (ghost planes excluded)."""
+    local = jnp.max(jnp.abs(a) * mask.astype(a.dtype))
+    return pmax_all(local)

@@ -48,7 +48,13 @@ from jax import lax
 
 from ..elements.tables import OperatorTables, build_operator_tables
 from ..ops.kron import axis_matrices_1d, banded_apply, banded_diags
-from .halo import _shift_from_left, _shift_from_right, masked_dot, owned_mask
+from .halo import (
+    _shift_from_left,
+    _shift_from_right,
+    masked_dot,
+    masked_linf,
+    owned_mask,
+)
 from .mesh import AXIS_NAMES, shard_cells
 
 
@@ -328,8 +334,13 @@ def make_kron_sharded_fns(op: DistKronLaplacian, dgrid, nreps: int):
 
     @partial(jax.shard_map, mesh=dgrid.mesh, in_specs=spec, out_specs=rep)
     def norm_fn(x):
+        """Global (L2, Linf) over owned dofs — psum / pmax reductions
+        (reference MPI_Allreduce SUM / MAX, vector.hpp:196-218)."""
         xl = _local(x)
-        return jnp.sqrt(_dot(owned_mask(xl.shape))(xl, xl))
+        m = owned_mask(xl.shape)
+        return jnp.stack(
+            [jnp.sqrt(masked_dot(xl, xl, m)), masked_linf(xl, m)]
+        )
 
     return apply_fn, cg_fn, norm_fn
 

@@ -28,7 +28,6 @@ from ..ops.laplacian import (
     fold_cells,
     freeze_table,
     gather_cells,
-    pallas_grid_apply,
 )
 from .halo import halo_refresh, masked_dot, owned_mask, reverse_scatter_add
 from .mesh import shard_cells
@@ -57,21 +56,21 @@ class DistLaplacian:
     dphi1_c: tuple | None = None
 
     def apply_local(self, x_local: jnp.ndarray, G_local, bc_local) -> jnp.ndarray:
-        """y = A x for one shard's block (call inside shard_map)."""
+        """y = A x for one shard's block (call inside shard_map).
+
+        Grid-layout shards support the XLA einsum kernel only: the Pallas
+        hot path is the folded layout (dist.folded / ops.folded_cg), which
+        replaced the earlier grid-layout pallas branch here — that branch
+        was unreachable from the driver and would not trace under the
+        default shard_map VMA check."""
         x = halo_refresh(x_local)
         xm = jnp.where(bc_local, 0, x)
-        if self.backend == "pallas":
-            y_grid = pallas_grid_apply(
-                xm, self.n_local, self.degree, G_local, self.kappa,
-                self.phi0_c, self.dphi1_c, self.is_identity,
-            )
-        else:
-            u = gather_cells(xm, self.n_local, self.degree)
-            y = cell_apply(
-                u, G_local, self.phi0, self.dphi1, self.kappa, self.is_identity,
-                backend=self.backend,
-            )
-            y_grid = fold_cells(y, self.n_local, self.degree)
+        u = gather_cells(xm, self.n_local, self.degree)
+        y = cell_apply(
+            u, G_local, self.phi0, self.dphi1, self.kappa, self.is_identity,
+            backend=self.backend,
+        )
+        y_grid = fold_cells(y, self.n_local, self.degree)
         y_grid = reverse_scatter_add(y_grid)
         return jnp.where(bc_local, x, y_grid)
 
@@ -157,12 +156,19 @@ def build_dist_laplacian(
     """Build stacked per-shard operator state. The geometry tensor is computed
     *on device, per shard* inside shard_map (each shard einsums only its own
     cells — the distributed analogue of `compute_geometry`,
-    laplacian.hpp:238-272)."""
+    laplacian.hpp:238-272). Grid-layout distribution is XLA-only; the Pallas
+    distributed path is dist.folded."""
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     from ..ops.geometry import geometry_factors_jax
     from .mesh import AXIS_NAMES
 
+    if backend not in ("xla",):
+        raise ValueError(
+            f"grid-layout distributed operator supports backend='xla' only "
+            f"(got {backend!r}); the Pallas distributed path is the folded "
+            f"layout (dist.folded)"
+        )
     t = tables
     dshape = dgrid.dshape
     corners_host = shard_cell_corners(mesh, dshape).astype(
@@ -180,12 +186,6 @@ def build_dist_laplacian(
     )
     def shard_geometry(c):
         G, _ = geometry_factors_jax(c[0, 0, 0], t.pts1d, t.wts1d)
-        if backend == "pallas":
-            from ..ops.pallas_laplacian import blocked_G, pick_lanes
-
-            G = blocked_G(
-                G, pick_lanes(degree + 1, t.nq, np.dtype(dtype).itemsize)
-            )
         return G[None, None, None]
 
     G = shard_geometry(corners)

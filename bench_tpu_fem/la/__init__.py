@@ -8,6 +8,23 @@ XLA computation with no host round-trips.
 """
 
 from .cg import cg_solve
-from .vector import inner_product, norm
+from .vector import (
+    axpy,
+    inner_product,
+    norm,
+    norm_linf,
+    pointwise_mult,
+    scale,
+    set_value,
+)
 
-__all__ = ["cg_solve", "inner_product", "norm"]
+__all__ = [
+    "axpy",
+    "cg_solve",
+    "inner_product",
+    "norm",
+    "norm_linf",
+    "pointwise_mult",
+    "scale",
+    "set_value",
+]

@@ -21,6 +21,8 @@ from typing import Callable
 import jax
 import jax.numpy as jnp
 
+from .vector import inner_product
+
 
 def cg_solve(
     apply_A: Callable[[jnp.ndarray], jnp.ndarray],
@@ -34,7 +36,7 @@ def cg_solve(
     ||r||/||r0|| < rtol. Early termination freezes the state rather than
     exiting the loop, keeping the iteration count static for XLA."""
     if dot is None:
-        dot = lambda u, v: jnp.vdot(u, v)
+        dot = inner_product
 
     y = apply_A(x0)
     r = b - y

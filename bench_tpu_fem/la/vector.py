@@ -1,6 +1,13 @@
-"""Vector reductions. Single-chip versions; the distributed layer wraps these
-with `lax.psum` over the device mesh (the ICI replacement for MPI_Allreduce,
-/root/reference/src/vector.hpp:173, cg.hpp:76)."""
+"""Vector math (device BLAS-1): the jnp counterparts of the reference's
+thrust + MPI_Allreduce vector layer (/root/reference/src/vector.hpp:159-292):
+inner product, L2/Linf norms, axpy, scale, copy-free pointwise ops, fill.
+
+Single-chip versions; the distributed layer wraps the reductions with
+`lax.psum` / `lax.pmax` over the device mesh (the ICI replacement for
+MPI_Allreduce SUM / MAX, vector.hpp:173,211). The CG loop (la.cg) and the
+benchmark drivers consume these — the dof layout (grid or folded) never
+matters because every operation is elementwise or a full reduction.
+"""
 
 from __future__ import annotations
 
@@ -8,10 +15,37 @@ import jax.numpy as jnp
 
 
 def inner_product(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """<a, b> (reference inner_product, vector.hpp:159-176)."""
     return jnp.vdot(a, b)
 
 
 def norm(a: jnp.ndarray) -> jnp.ndarray:
-    """L2 norm (the reference reports dolfinx::la::norm l2, e.g.
-    laplacian_solver.cpp:130-131)."""
+    """L2 norm (reference norm(..., l2), vector.hpp:196-209)."""
     return jnp.sqrt(jnp.vdot(a, a))
+
+
+def norm_linf(a: jnp.ndarray) -> jnp.ndarray:
+    """Linf norm (reference norm(..., linf) with MPI_MAX,
+    vector.hpp:210-218)."""
+    return jnp.max(jnp.abs(a))
+
+
+def axpy(y: jnp.ndarray, alpha, x: jnp.ndarray) -> jnp.ndarray:
+    """y + alpha * x (reference axpy, vector.hpp:228-240; functional — JAX
+    arrays are immutable, the caller rebinds)."""
+    return y + alpha * x
+
+
+def scale(a: jnp.ndarray, alpha) -> jnp.ndarray:
+    """alpha * a (reference scale, vector.hpp:242-252)."""
+    return alpha * a
+
+
+def pointwise_mult(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """Elementwise a * b (reference pointwise_mult, vector.hpp:254-277)."""
+    return a * b
+
+
+def set_value(a: jnp.ndarray, value) -> jnp.ndarray:
+    """Fill with a constant (reference set_value, vector.hpp:279-292)."""
+    return jnp.full_like(a, value)

@@ -49,6 +49,7 @@ from ..mesh.dofmap import boundary_dof_marker
 from .pallas_laplacian import (
     SUBLANES,
     _use_interpret,
+    corner_window_G,
     pick_lanes,
     sumfact_window_apply,
 )
@@ -87,7 +88,12 @@ class FoldedLayout:
 
     @property
     def nblocks(self) -> int:
-        return -(-self.cg // self.block)
+        """Rounded up to a multiple of 8 so streaming kernels (CG vector
+        update) can process 8 contiguous blocks per grid step without tail
+        masking — the pad blocks are structural zeros end to end (zero
+        geometry mask, zero vectors), so dots and updates are unaffected."""
+        nb = -(-self.cg // self.block)
+        return -(-nb // 8) * 8
 
     @property
     def lv(self) -> int:
@@ -95,7 +101,26 @@ class FoldedLayout:
         return self.nblocks * self.block
 
     @property
-    def vec_shape(self) -> tuple[int, int, int, int]:
+    def vec_shape(self) -> tuple[int, int, int]:
+        """Folded vectors are stored block-major 3D as (nblocks, P^3, B).
+
+        Two hardware constraints picked this layout (both measured):
+        - XLA tiles the trailing two dims (8, 128); with the tensor index P
+          on the second-minor axis an elementwise pass runs at P/8 sublane
+          utilisation — CG glue cost ~3x the kernel. (P^3, B) trailing
+          gives 27/32 utilisation at P=3.
+        - DMA wants the kernel's per-grid-step operand contiguous: a
+          (P^3, B) block gathered from a (P^3, Lv) array is P^3 scattered
+          4 kB rows and streams at ~140 GB/s; block-major it is one
+          contiguous ~108 kB chunk at full bandwidth.
+
+        The kernel reshapes blocks to (P, P, P, 8, nl) in-register
+        (leading-axis split, free)."""
+        P = self.degree
+        return (self.nblocks, P * P * P, self.block)
+
+    @property
+    def vec4_shape(self) -> tuple[int, int, int, int]:
         P = self.degree
         return (P, P, P, self.lv)
 
@@ -130,17 +155,21 @@ def _grid_to_cell_indices(layout: FoldedLayout):
 
 
 def fold_vector(grid: np.ndarray, layout: FoldedLayout) -> np.ndarray:
-    """(NX, NY, NZ) grid -> folded (P, P, P, Lv); structural slots zero."""
+    """(NX, NY, NZ) grid -> folded (nb, P^3, B); structural slots zero."""
     ii, jj, kk, c = _grid_to_cell_indices(layout)
-    out = np.zeros(layout.vec_shape, dtype=grid.dtype)
+    out = np.zeros(layout.vec4_shape, dtype=grid.dtype)
     out[ii, jj, kk, c] = grid
-    return out
+    P3 = layout.degree ** 3
+    return np.ascontiguousarray(
+        out.reshape(P3, layout.nblocks, layout.block).transpose(1, 0, 2)
+    )
 
 
 def unfold_vector(folded: np.ndarray, layout: FoldedLayout) -> np.ndarray:
-    """Folded (P, P, P, Lv) -> (NX, NY, NZ) grid (inverse of fold_vector)."""
+    """Folded (nb, P^3, B) -> (NX, NY, NZ) grid (inverse of fold_vector)."""
     ii, jj, kk, c = _grid_to_cell_indices(layout)
-    return np.asarray(folded)[ii, jj, kk, c]
+    flat = np.asarray(folded).transpose(1, 0, 2).reshape(layout.vec4_shape)
+    return flat[ii, jj, kk, c]
 
 
 def real_cell_flat_indices(layout: FoldedLayout) -> np.ndarray:
@@ -183,20 +212,15 @@ def _assemble_window(c000, cx, cy, cz, cxy, cxz, cyz, cxyz):
 
 
 def _make_folded_kernel(P: int, nl: int, is_identity: bool,
-                        phi0: np.ndarray, dphi1: np.ndarray):
-    def kernel(u000_ref, ux_ref, uy_ref, uz_ref, uxy_ref, uxz_ref, uyz_ref,
-               uxyz_ref, g_ref, kappa_ref,
-               y_ref, yx_ref, yy_ref, yz_ref, yxy_ref, yxz_ref, yyz_ref,
-               yxyz_ref):
-        r8 = lambda r: _r8(r[...], nl)  # noqa: E731
-        u = _assemble_window(
-            r8(u000_ref), r8(ux_ref), r8(uy_ref), r8(uz_ref),
-            r8(uxy_ref), r8(uxz_ref), r8(uyz_ref), r8(uxyz_ref),
-        )
-        y = sumfact_window_apply(
-            u, g_ref[0], kappa_ref[0, 0], phi0, dphi1, is_identity
-        )
+                        phi0: np.ndarray, dphi1: np.ndarray,
+                        geom_tables: tuple[np.ndarray, np.ndarray] | None = None):
+    """Kernel body. geom_tables=None: geometry streamed as a precomputed
+    blocked-G operand. geom_tables=(pts1d, wts1d): geometry computed
+    in-kernel from streamed cell corners (corner mode — ~24 floats/cell of
+    HBM traffic instead of 6*nq^3; see pallas_laplacian.corner_window_G)."""
 
+    def write_outs(y, y_ref, yx_ref, yy_ref, yz_ref, yxy_ref, yxz_ref,
+                   yyz_ref, yxyz_ref):
         y_ref[...] = _rb(y[:P, :P, :P])
         yx_ref[...] = _rb(y[P, :P, :P])
         yy_ref[...] = _rb(y[:P, P, :P])
@@ -206,20 +230,55 @@ def _make_folded_kernel(P: int, nl: int, is_identity: bool,
         yyz_ref[...] = _rb(y[:P, P, P])
         yxyz_ref[...] = _rb(y[P, P, P])
 
+    if geom_tables is None:
+        def kernel(u000_ref, ux_ref, uy_ref, uz_ref, uxy_ref, uxz_ref,
+                   uyz_ref, uxyz_ref, g_ref, kappa_ref, *out_refs):
+            r8 = lambda r: _r8(r[...], nl)  # noqa: E731
+            u = _assemble_window(
+                r8(u000_ref), r8(ux_ref), r8(uy_ref), r8(uz_ref),
+                r8(uxy_ref), r8(uxz_ref), r8(uyz_ref), r8(uxyz_ref),
+            )
+            y = sumfact_window_apply(
+                u, g_ref[0], kappa_ref[0, 0], phi0, dphi1, is_identity
+            )
+            write_outs(y, *out_refs)
+    else:
+        pts1d, wts1d = geom_tables
+
+        def kernel(u000_ref, ux_ref, uy_ref, uz_ref, uxy_ref, uxz_ref,
+                   uyz_ref, uxyz_ref, c_ref, m_ref, kappa_ref, *out_refs):
+            r8 = lambda r: _r8(r[...], nl)  # noqa: E731
+            u = _assemble_window(
+                r8(u000_ref), r8(ux_ref), r8(uy_ref), r8(uz_ref),
+                r8(uxy_ref), r8(uxz_ref), r8(uyz_ref), r8(uxyz_ref),
+            )
+            G = corner_window_G(c_ref[0], m_ref[0], pts1d, wts1d)
+            y = sumfact_window_apply(
+                u, G, kappa_ref[0, 0], phi0, dphi1, is_identity
+            )
+            write_outs(y, *out_refs)
+
     return kernel
 
 
 def folded_cell_apply(
-    xm: jnp.ndarray,  # (P, P, P, Lv) masked folded vector
-    G: jnp.ndarray,  # (nblocks, 6, nq, nq, nq, 8, nl) c-space blocked
+    xm: jnp.ndarray,  # (nb, P^3, B) masked folded vector
+    geom,  # blocked G (nblocks, 6, nq,nq,nq, 8, nl) | (corners_b, mask_b)
     kappa: jnp.ndarray,
     layout: FoldedLayout,
     phi0: np.ndarray,
     dphi1: np.ndarray,
     is_identity: bool,
     interpret: bool | None = None,
+    geom_tables: tuple[np.ndarray, np.ndarray] | None = None,
 ) -> jnp.ndarray:
-    """One operator contribution pass: returns the un-bc'd result vector."""
+    """One operator contribution pass: returns the un-bc'd result vector.
+
+    Geometry comes in one of two forms:
+    - precomputed: `geom` is the blocked G tensor (geom_tables None);
+    - corner mode: `geom` is `(corners_b, mask_b)` (see blocked_corners) and
+      `geom_tables=(pts1d, wts1d)` — G is computed in-kernel per cell.
+    """
     P = layout.degree
     nq = phi0.shape[0]
     nl, B, nb, Lv = layout.nl, layout.block, layout.nblocks, layout.lv
@@ -227,6 +286,9 @@ def folded_cell_apply(
     S7 = Sx + Sy + Sz
     dtype = xm.dtype
 
+    # block-major (nb, P^3, B) -> flat-c 4D (P, P, P, Lv) for the v1
+    # slab-slicing pipeline (a traced transpose; v1 is the reference path)
+    xm = jnp.transpose(xm, (1, 0, 2)).reshape(layout.vec4_shape)
     xp = jnp.pad(xm, [(0, 0)] * 3 + [(0, S7)])
     ux = jax.lax.slice(xp[0], (0, 0, Sx), (P, P, Sx + Lv))
     uy = jax.lax.slice(xp[:, 0], (0, 0, Sy), (P, P, Sy + Lv))
@@ -243,17 +305,36 @@ def folded_cell_apply(
     kernel = _make_folded_kernel(
         P, nl, is_identity,
         np.asarray(phi0, np.float64), np.asarray(dphi1, np.float64),
+        geom_tables=geom_tables,
     )
+    if geom_tables is None:
+        geom_ops = (geom,)
+        geom_specs = [
+            pl.BlockSpec(
+                (1, 6, nq, nq, nq, SUBLANES, nl),
+                lambda i: (i, 0, 0, 0, 0, 0, 0), memory_space=pltpu.VMEM,
+            ),
+        ]
+    else:
+        corners_b, mask_b = geom
+        geom_ops = (corners_b, mask_b)
+        geom_specs = [
+            pl.BlockSpec(
+                (1, 3, 2, 2, 2, SUBLANES, nl),
+                lambda i: (i, 0, 0, 0, 0, 0, 0), memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, SUBLANES, nl), lambda i: (i, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+        ]
     outs = pl.pallas_call(
         kernel,
         grid=(nb,),
         in_specs=[
             spec(P, P, P), spec(P, P), spec(P, P), spec(P, P),
             spec(P), spec(P), spec(P), spec(),
-            pl.BlockSpec(
-                (1, 6, nq, nq, nq, SUBLANES, nl),
-                lambda i: (i, 0, 0, 0, 0, 0, 0), memory_space=pltpu.VMEM,
-            ),
+            *geom_specs,
             pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.SMEM),
         ],
         out_specs=[
@@ -271,7 +352,7 @@ def folded_cell_apply(
             jax.ShapeDtypeStruct((Lv,), dtype),
         ],
         interpret=_use_interpret() if interpret is None else interpret,
-    )(xm, ux, uy, uz, uxy, uxz, uyz, uxyz, G,
+    )(xm, ux, uy, uz, uxy, uxz, uyz, uxyz, *geom_ops,
       kappa.reshape(1, 1).astype(dtype))
 
     Y, Yx, Yy, Yz, Yxy, Yxz, Yyz, Yxyz = outs
@@ -296,12 +377,289 @@ def folded_cell_apply(
     Yx = Yx + lift(shift(Yxy, Sy), 0) + lift(shift(Yxz, Sz), 1) \
         + lift(lift(shift(Yxyz, Sy + Sz), 0), 1)
     Yy = Yy + lift(shift(Yyz, Sz), 1)
-    return (
+    out = (
         Y
         + lift(shift(Yx, Sx), 0)
         + lift(shift(Yy, Sy), 1)
         + lift(shift(Yz, Sz), 2)
     )
+    return jnp.transpose(
+        out.reshape(P * P * P, nb, B), (1, 0, 2)
+    )
+
+
+# ---------------------------------------------------------------------------
+# Fused kernel: window gather + apply + seam overlap-add in ONE pallas_call
+# ---------------------------------------------------------------------------
+#
+# The v1 pipeline above (XLA pad/slice -> kernel -> XLA seam pass) measures
+# ~2x the kernel's own time: materialising the 7 shifted window slabs alone
+# costs as much as the whole contraction chain. The fused kernel eliminates
+# every XLA glue pass:
+#
+# - inputs: the SAME (P^3, Lv) folded vector is passed once per *distinct*
+#   block offset q = s // B needed by the 7 shift classes (typically 4-5
+#   views), each as a full (P^3, B) block at grid index i + q. In-kernel,
+#   each view reshapes (leading-axis split, free) to (P, P, P, 8, nl); the
+#   class's window plane is a vreg-indexed slice of that, and the sub-block
+#   shift (r = s mod B) is applied IN REGISTERS: a static sublane slice of
+#   the concatenated view pair plus a static lane rotate
+#   (_shift_window_pair). No shifted copy of x ever exists in HBM;
+# - outputs: ONE (P^3, B) block. Seam partials (the 7 cell-window faces/
+#   edges/corner that overlap the +x/+y/+z neighbour cells) are kept in VMEM
+#   ring buffers across the sequential TPU grid; block i folds in the
+#   partials emitted by blocks i - s//B - 1 and i - s//B, which are exactly
+#   the blocks whose +s windows overlap it. The reference's atomicAdd
+#   scatter (laplacian_gpu.hpp:425) thus becomes a register-shift + add in
+#   the consumer's grid step;
+# - the Dirichlet pass-through is an in-register select against a streamed
+#   0/1 mask block (see folded_cell_apply_fused docstring).
+
+
+def _shift_window_pair(v0, v1, r: int, nl: int):
+    """Extract the flat window [r, r + B) from the concatenation of two
+    consecutive (lead..., 8, nl) vreg blocks (flat index = sub*nl + lane).
+    r is compile-time static, 0 <= r <= B."""
+    if r == 0:
+        return v0
+    buf = jnp.concatenate([v0, v1], axis=-2)  # (lead..., 16, nl)
+    sr, lr = divmod(r, nl)
+    A = buf[..., sr:sr + SUBLANES, :]
+    if lr == 0:
+        return A
+    Bv = buf[..., sr + 1:sr + 1 + SUBLANES, :]
+    Ar = pltpu.roll(A, nl - lr, axis=A.ndim - 1)
+    Br = pltpu.roll(Bv, nl - lr, axis=Bv.ndim - 1)
+    lane = jax.lax.broadcasted_iota(jnp.int32, A.shape, A.ndim - 1)
+    # raw lax.select (not jnp.where): jnp wrappers trace to closed_call,
+    # which the Mosaic kernel-lowering path rejects
+    return jax.lax.select(lane < nl - lr, Ar, Br)
+
+
+# (class key, leading window axes of the slab) in fixed order
+_SHIFT_CLASSES = ("x", "y", "z", "xy", "xz", "yz", "xyz")
+
+
+def _class_shifts(layout: FoldedLayout) -> dict[str, int]:
+    Sx, Sy, Sz = layout.shifts
+    return {"x": Sx, "y": Sy, "z": Sz, "xy": Sx + Sy, "xz": Sx + Sz,
+            "yz": Sy + Sz, "xyz": Sx + Sy + Sz}
+
+
+def _seam_ring_shapes(P: int, K: int, nl: int) -> dict[str, tuple]:
+    """VMEM scratch shapes for the per-class seam partial rings."""
+    return {
+        "x": (K, P, P, SUBLANES, nl), "y": (K, P, P, SUBLANES, nl),
+        "z": (K, P, P, SUBLANES, nl), "xy": (K, P, SUBLANES, nl),
+        "xz": (K, P, SUBLANES, nl), "yz": (K, P, SUBLANES, nl),
+        "xyz": (K, SUBLANES, nl),
+    }
+
+
+def _seam_accumulate(rings, y, i, K: int, qr, B: int, nl: int, P: int):
+    """The in-kernel seam overlap-add, shared by every fused kernel (it is
+    the trickiest modular arithmetic in the module and must exist once):
+
+    1. publish block i's seam partials (the 7 cell-window faces/edges/corner
+       that overlap +x/+y/+z neighbour cells) into the VMEM rings;
+    2. fold in the partials emitted by blocks i - q - 1 and i - q per shift
+       class (exactly the blocks whose +s windows overlap [i*B, (i+1)*B)),
+       composing edges/corner into the +x/+y faces first and the faces into
+       the main block last — the same order as the v1 XLA seam pass.
+
+    Returns the finished (P, P, P, 8, nl) main block."""
+    part = {
+        "x": y[P, :P, :P], "y": y[:P, P, :P], "z": y[:P, :P, P],
+        "xy": y[P, P, :P], "xz": y[P, :P, P], "yz": y[:P, P, P],
+        "xyz": y[P, P, P],
+    }
+    islot = jax.lax.rem(i, np.int32(K))
+    for k in _SHIFT_CLASSES:
+        rings[k][islot] = part[k]
+
+    def ring_window(k):
+        q, r = qr[k]
+        # operands are non-negative, so lax.rem == mod (and, unlike the
+        # % operator, lowers without a closed_call)
+        j1 = jax.lax.rem(i + np.int32(K - q - 1), np.int32(K))
+        j0 = jax.lax.rem(i + np.int32(K - q), np.int32(K))
+        return _shift_window_pair(rings[k][j1], rings[k][j0], B - r, nl)
+
+    a_x, a_y, a_z = ring_window("x"), ring_window("y"), ring_window("z")
+    a_xy, a_xz = ring_window("xy"), ring_window("xz")
+    a_yz, a_xyz = ring_window("yz"), ring_window("xyz")
+    cat = jnp.concatenate
+    a_xy = cat([(a_xy[0] + a_xyz)[None], a_xy[1:]], axis=0)
+    a_x = cat([(a_x[0] + a_xy)[None], a_x[1:]], axis=0)
+    a_x = cat([(a_x[:, 0] + a_xz)[:, None], a_x[:, 1:]], axis=1)
+    a_y = cat([(a_y[:, 0] + a_yz)[:, None], a_y[:, 1:]], axis=1)
+    m = y[:P, :P, :P]
+    m = cat([(m[0] + a_x)[None], m[1:]], axis=0)
+    m = cat([(m[:, 0] + a_y)[:, None], m[:, 1:]], axis=1)
+    m = cat([(m[:, :, 0] + a_z)[:, :, None], m[:, :, 1:]], axis=2)
+    return m
+
+
+def _make_folded_fused_kernel(P: int, nl: int, B: int, K: int,
+                              is_identity: bool,
+                              phi0: np.ndarray, dphi1: np.ndarray,
+                              qr: dict[str, tuple[int, int]],
+                              offsets: tuple[int, ...],
+                              geom_tables=None):
+    corner_mode = geom_tables is not None
+    # Per shift class: which window-plane of the (P, P, P, 8, nl) view cube
+    # holds the slab (a vreg-indexed slice — free register naming).
+    plane = {
+        "x": lambda a: a[0], "y": lambda a: a[:, 0], "z": lambda a: a[:, :, 0],
+        "xy": lambda a: a[0, 0], "xz": lambda a: a[0, :, 0],
+        "yz": lambda a: a[:, 0, 0], "xyz": lambda a: a[0, 0, 0],
+    }
+
+    def kernel(*refs):
+        nv = len(offsets)
+        views = {off: refs[vi] for vi, off in enumerate(offsets)}
+        bc_ref = refs[nv]
+        ngeom = 2 if corner_mode else 1
+        geom_refs = refs[nv + 1:nv + 1 + ngeom]
+        kappa_ref = refs[nv + 1 + ngeom]
+        out_ref = refs[nv + 2 + ngeom]
+        rings = {k: refs[nv + 3 + ngeom + ci]
+                 for ci, k in enumerate(_SHIFT_CLASSES)}
+
+        i = pl.program_id(0)
+
+        @pl.when(i == 0)
+        def _zero_rings():
+            for k in _SHIFT_CLASSES:
+                rings[k][...] = jnp.zeros_like(rings[k])
+
+        # each view block (1, P^3, B) -> (P, P, P, 8, nl): leading-axis
+        # split plus the native (B,) -> (8, nl) lane relayout
+        v4 = {off: _r8(ref[0], nl).reshape(P, P, P, SUBLANES, nl)
+              for off, ref in views.items()}
+        u0 = v4[0]
+        win = {
+            k: _shift_window_pair(
+                plane[k](v4[qr[k][0]]), plane[k](v4[qr[k][0] + 1]),
+                qr[k][1], nl,
+            )
+            for k in _SHIFT_CLASSES
+        }
+        u = _assemble_window(
+            u0, win["x"], win["y"], win["z"],
+            win["xy"], win["xz"], win["yz"], win["xyz"],
+        )
+        if corner_mode:
+            G = corner_window_G(geom_refs[0][0], geom_refs[1][0],
+                                *geom_tables)
+        else:
+            G = geom_refs[0][0]
+        y = sumfact_window_apply(u, G, kappa_ref[0, 0], phi0, dphi1,
+                                 is_identity)
+        m = _seam_accumulate(rings, y, i, K, qr, B, nl, P)
+        # Dirichlet pass-through in-register (reference
+        # laplacian_gpu.hpp:163-169): bc is a streamed 0/1 mask in the
+        # vector dtype; select m -> own input on bc rows. Doing this here
+        # (instead of a jnp.where around the pallas_call) saves two full
+        # elementwise HBM passes per apply.
+        bcb = _r8(bc_ref[0], nl).reshape(P, P, P, SUBLANES, nl)
+        m = m + bcb * (u0 - m)
+        out_ref[0] = _rb(m).reshape(P * P * P, B)
+
+    return kernel
+
+
+def folded_cell_apply_fused(
+    xm: jnp.ndarray,  # (nb, P^3, B) folded vector
+    bcf: jnp.ndarray,  # (nb, P^3, B) 0/1 Dirichlet mask, vector dtype
+    geom,  # blocked G | (corners_b, mask_b)
+    kappa: jnp.ndarray,
+    layout: FoldedLayout,
+    phi0: np.ndarray,
+    dphi1: np.ndarray,
+    is_identity: bool,
+    interpret: bool | None = None,
+    geom_tables: tuple[np.ndarray, np.ndarray] | None = None,
+) -> jnp.ndarray:
+    """Fused single-pass operator apply (see module comment above).
+
+    Computes the cell-contribution sum of folded_cell_apply AND the
+    Dirichlet row pass-through in one kernel: output rows with bcf == 1
+    carry the *input* value of xm. Full operator semantics (y_bc = x_bc,
+    interior contributions exclude bc dofs) additionally require xm to be
+    zero on bc rows — which CG vectors satisfy by construction when the RHS
+    has homogeneous bc rows; general callers pre-mask (see
+    FoldedLaplacian.apply)."""
+    P = layout.degree
+    nq = phi0.shape[0]
+    nl, B, nb = layout.nl, layout.block, layout.nblocks
+    dtype = xm.dtype
+    shifts = _class_shifts(layout)
+    qr = {k: divmod(s, B) for k, s in shifts.items()}
+    K = max(q for q, _ in qr.values()) + 2
+    # distinct block offsets whose (P^3, B) views the kernel needs: each
+    # class reads from offsets q and q + 1 (0 is the main block itself)
+    offsets = tuple(sorted(
+        {0} | {q for q, _ in qr.values()} | {q + 1 for q, _ in qr.values()}
+    ))
+
+    def clampmap(q):
+        # np.int32 literals: under x64 a Python int would promote to int64,
+        # which lax.min rejects against the int32 grid index
+        return lambda i: (
+            jax.lax.min(i + np.int32(q), np.int32(nb - 1)), 0, 0
+        )
+
+    # One full-block view of xm per distinct offset (clamped; data read past
+    # the real array only ever feeds ghost/pad-cell windows whose geometry
+    # mask is zero).
+    in_specs = [
+        pl.BlockSpec((1, P * P * P, B), clampmap(q), memory_space=pltpu.VMEM)
+        for q in offsets
+    ]
+    operands = [xm for _ in offsets]
+    # streamed Dirichlet mask, own block only
+    in_specs.append(pl.BlockSpec((1, P * P * P, B), lambda i: (i, 0, 0),
+                                 memory_space=pltpu.VMEM))
+    operands.append(bcf)
+
+    if geom_tables is None:
+        operands.append(geom)
+        in_specs.append(pl.BlockSpec(
+            (1, 6, nq, nq, nq, SUBLANES, nl),
+            lambda i: (i, 0, 0, 0, 0, 0, 0), memory_space=pltpu.VMEM,
+        ))
+    else:
+        corners_b, mask_b = geom
+        operands += [corners_b, mask_b]
+        in_specs += [
+            pl.BlockSpec((1, 3, 2, 2, 2, SUBLANES, nl),
+                         lambda i: (i, 0, 0, 0, 0, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, SUBLANES, nl), lambda i: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ]
+    operands.append(kappa.reshape(1, 1).astype(dtype))
+    in_specs.append(pl.BlockSpec((1, 1), lambda i: (0, 0),
+                                 memory_space=pltpu.SMEM))
+
+    ring_shapes = _seam_ring_shapes(P, K, nl)
+    kernel = _make_folded_fused_kernel(
+        P, nl, B, K, is_identity,
+        np.asarray(phi0, np.float64), np.asarray(dphi1, np.float64),
+        qr, offsets, geom_tables=geom_tables,
+    )
+    return pl.pallas_call(
+        kernel,
+        grid=(nb,),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec((1, P * P * P, B), lambda i: (i, 0, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct(xm.shape, dtype),
+        scratch_shapes=[pltpu.VMEM(ring_shapes[k], dtype)
+                        for k in _SHIFT_CLASSES],
+        interpret=_use_interpret() if interpret is None else interpret,
+    )(*operands)
 
 
 # ---------------------------------------------------------------------------
@@ -310,15 +668,23 @@ def folded_cell_apply(
 
 @partial(
     jax.tree_util.register_dataclass,
-    data_fields=["G", "bc_mask", "kappa"],
-    meta_fields=["n", "degree", "nl", "is_identity", "phi0_c", "dphi1_c"],
+    data_fields=["G", "corners", "cmask", "bc_mask", "kappa"],
+    meta_fields=["n", "degree", "nl", "is_identity", "phi0_c", "dphi1_c",
+                 "pts_c", "wts_c"],
 )
 @dataclass(frozen=True)
 class FoldedLaplacian:
-    """Matrix-free Laplacian on folded vectors (the TPU fast path)."""
+    """Matrix-free Laplacian on folded vectors (the TPU fast path).
 
-    G: jnp.ndarray  # (nblocks, 6, nq, nq, nq, 8, nl)
-    bc_mask: jnp.ndarray  # (P, P, P, Lv) bool Dirichlet marker (folded)
+    Geometry is carried either precomputed (G set, corners/cmask None) or as
+    blocked cell corners (corner mode: G None) that the kernel turns into G
+    on the fly — the default, since the kernel is HBM-bound and corners are
+    ~30x less traffic than G at Q3."""
+
+    G: jnp.ndarray | None  # (nblocks, 6, nq, nq, nq, 8, nl) or None
+    corners: jnp.ndarray | None  # (nblocks, 3, 2, 2, 2, 8, nl) or None
+    cmask: jnp.ndarray | None  # (nblocks, 8, nl) or None
+    bc_mask: jnp.ndarray  # (nb, P^3, B) 0/1 Dirichlet marker, vector dtype
     kappa: jnp.ndarray
     n: tuple[int, int, int]
     degree: int
@@ -326,21 +692,51 @@ class FoldedLaplacian:
     is_identity: bool
     phi0_c: tuple = ()
     dphi1_c: tuple = ()
+    pts_c: tuple = ()
+    wts_c: tuple = ()
 
     @property
     def layout(self) -> FoldedLayout:
         return FoldedLayout(n=self.n, degree=self.degree, nl=self.nl)
 
-    def apply(self, x: jnp.ndarray) -> jnp.ndarray:
-        """y = A @ x on folded vectors, Dirichlet rows pass through."""
-        xm = jnp.where(self.bc_mask, 0, x)
-        y = folded_cell_apply(
-            xm, self.G, self.kappa, self.layout,
+    @property
+    def geom(self):
+        if self.G is not None:
+            return self.G
+        return (self.corners, self.cmask)
+
+    @property
+    def geom_tables(self) -> tuple[np.ndarray, np.ndarray] | None:
+        if self.G is not None:
+            return None
+        return (np.asarray(self.pts_c), np.asarray(self.wts_c))
+
+    def _fused(self, x: jnp.ndarray) -> jnp.ndarray:
+        return folded_cell_apply_fused(
+            x, self.bc_mask, self.geom, self.kappa, self.layout,
             np.asarray(self.phi0_c, np.float64),
             np.asarray(self.dphi1_c, np.float64),
             self.is_identity,
+            geom_tables=self.geom_tables,
         )
-        return jnp.where(self.bc_mask, x, y)
+
+    def apply(self, x: jnp.ndarray) -> jnp.ndarray:
+        """y = A @ x on folded vectors, Dirichlet rows pass through
+        (general x: bc rows of x are excluded from interior contributions
+        by pre-masking, then restored by the in-kernel pass-through +
+        final correction)."""
+        bc = self.bc_mask
+        xm = x * (1 - bc)
+        y = self._fused(xm)
+        # kernel pass-through carried xm's bc rows (zeros); restore x's
+        return y + bc * x
+
+    def apply_cg(self, x: jnp.ndarray) -> jnp.ndarray:
+        """Fast-path apply for CG iterations: assumes x is zero on Dirichlet
+        rows (true for every CG vector when the RHS has homogeneous bc rows
+        — r, p, x all inherit it). Skips both elementwise masking passes;
+        the in-kernel pass-through keeps bc rows at zero."""
+        return self._fused(x)
 
 
 _BUILD_CHUNK_BLOCKS = 64  # cells per geometry-build chunk = 64 * block
@@ -366,6 +762,23 @@ def ghost_corner_arrays(
     corners_cs[idx] = cell_corners.reshape(-1, 2, 2, 2, 3)
     mask_cs[idx] = 1.0
     return corners_cs, mask_cs
+
+
+def blocked_corners(
+    corners_cs: np.ndarray, mask_cs: np.ndarray, layout: FoldedLayout
+) -> tuple[np.ndarray, np.ndarray]:
+    """c-space corner/mask arrays (from ghost_corner_arrays) -> the blocked
+    kernel operands of corner mode:
+
+      (Lv, 2, 2, 2, 3), (Lv,) -> (nb, 3, 2, 2, 2, 8, nl), (nb, 8, nl)
+
+    using the same flat-c <-> (block, sublane, lane) mapping as blocked_G
+    (c = b*B + s*nl + l, see _r8)."""
+    nb, nl = layout.nblocks, layout.nl
+    c = corners_cs.reshape(nb, SUBLANES, nl, 2, 2, 2, 3)
+    c = c.transpose(0, 6, 3, 4, 5, 1, 2)
+    m = mask_cs.reshape(nb, SUBLANES, nl)
+    return np.ascontiguousarray(c), m
 
 
 def chunk_blocked_G(corners, mask, layout: FoldedLayout, t: OperatorTables,
@@ -441,22 +854,47 @@ def build_folded_laplacian(
     dtype=jnp.float32,
     tables: OperatorTables | None = None,
     nl: int | None = None,
+    geom: str = "auto",
 ) -> FoldedLaplacian:
-    """Build the folded-layout operator (geometry computed on device, in
-    chunks over c-space; ghost/pad cells get unit-cube corners so the
-    Jacobian stays invertible, then a zero mask)."""
+    """Build the folded-layout operator.
+
+    geom='g' precomputes the geometry tensor on device in chunks (fastest
+    apply while G fits HBM); geom='corner' ships the blocked cell corners
+    (24 floats/cell) and computes G in-kernel — ~30x less HBM capacity, so
+    perturbed-geometry problems scale to the same sizes as the uniform fast
+    path; geom='auto' (default) picks by G's footprint. Ghost/pad cells get
+    unit-cube corners so the Jacobian stays invertible, then a zero mask."""
     from .laplacian import freeze_table
 
+    if geom not in ("auto", "corner", "g"):
+        raise ValueError(f"unknown geom mode {geom!r}")
     t = tables or build_operator_tables(degree, qmode, rule)
     layout = make_layout(mesh.n, degree, t.nq, np.dtype(dtype).itemsize, nl=nl)
+    if geom == "auto":
+        # Precomputed G is the faster apply (the corner path trades ~2x
+        # FLOPs for ~30x less geometry traffic, and the kernel is compute-
+        # bound when G streams from HBM at full bandwidth) — but G costs
+        # 6*nq^3 values/cell of HBM. Use it when it fits comfortably,
+        # else fall back to corner mode, which scales to the same problem
+        # sizes as the uniform fast path.
+        g_bytes = layout.lv * 6 * t.nq ** 3 * np.dtype(dtype).itemsize
+        geom = "g" if g_bytes <= 6e9 else "corner"
     corners_cs, mask_cs = ghost_corner_arrays(layout, mesh.cell_corners)
-    G = _build_G_chunked(corners_cs, mask_cs, layout, t, dtype)
+    G = corners_b = cmask_b = None
+    if geom == "corner":
+        cb, mb = blocked_corners(corners_cs, mask_cs, layout)
+        corners_b = jnp.asarray(cb, dtype=dtype)
+        cmask_b = jnp.asarray(mb, dtype=dtype)
+    else:
+        G = _build_G_chunked(corners_cs, mask_cs, layout, t, dtype)
     bc = fold_vector(
-        np.asarray(boundary_dof_marker(mesh.n, degree)), layout
+        np.asarray(boundary_dof_marker(mesh.n, degree), np.float64), layout
     )
     return FoldedLaplacian(
         G=G,
-        bc_mask=jnp.asarray(bc),
+        corners=corners_b,
+        cmask=cmask_b,
+        bc_mask=jnp.asarray(bc, dtype=dtype),
         kappa=jnp.asarray(kappa, dtype=dtype),
         n=mesh.n,
         degree=degree,
@@ -464,4 +902,6 @@ def build_folded_laplacian(
         is_identity=t.is_identity,
         phi0_c=freeze_table(t.phi0),
         dphi1_c=freeze_table(t.dphi1),
+        pts_c=tuple(float(v) for v in t.pts1d),
+        wts_c=tuple(float(v) for v in t.wts1d),
     )

@@ -1,0 +1,393 @@
+"""Fully-fused CG engine on folded vectors: the TPU benchmark hot path.
+
+The reference CG iteration (/root/reference/src/cg.hpp:121-167) is one
+operator apply + two allreduce dots + three axpys. Run naively through XLA
+on folded vectors, the vector algebra and the operator's window gathers
+each re-stream the dof vector several times; measured, the glue costs more
+HBM time than the stiffness kernel itself. This module restates the whole
+iteration as ONE pallas kernel plus one fused XLA update pass:
+
+Kernel A (`_cg_apply_call`) — one pass over the mesh per iteration:
+  - DELAY-RING INPUT: the grid runs nb + D steps. At step t the kernel
+    DMAs input block t (ONE view of the vector — not one view per shift
+    offset) and stores it in a VMEM ring of KI = D + 1 blocks. The output
+    for block i = t - D is computed from ring slices: every shifted cell
+    window (+x/+y/+z neighbour slabs at flat shifts s) reads ring blocks
+    i + s//B and i + s//B + 1, which are guaranteed present because
+    D = max(s)//B + 1. Static sub-block shifts are register lane/sublane
+    rotates (ops.folded._shift_window_pair).
+  - p-UPDATE FUSED: on the input stage it forms p = beta*p_prev + r in
+    registers and writes it back out, so the CG direction update costs no
+    separate pass.
+  - SEAM RINGS: cell contributions that overlap +neighbour cells accumulate
+    across sequential grid steps in VMEM rings (see ops.folded fused
+    kernel) — the structured replacement for the reference's atomicAdd
+    scatter (laplacian_gpu.hpp:425).
+  - DOT FUSED: per-block partials of <p, y> are reduced in-register and
+    written as an (nb, 8, nl) array; XLA sums the ~MB-sized partials. One
+    scalar reduction's traffic instead of re-reading two 50 MB vectors.
+  - Dirichlet rows pass through p (zero) via a bc mask computed IN-KERNEL
+    from the structured-box closed form (no 4 B/dof mask stream;
+    laplacian_gpu.hpp:163-169 semantics; p is zero on bc rows by the CG
+    invariant since the RHS has homogeneous bc rows).
+
+The remaining vector algebra (x1 = x + alpha p; r1 = r - alpha y;
+<r1, r1>) runs as plain XLA ops: on the block-major (nb, P^3, B) layout XLA
+streams one fused elementwise+reduce pass at near-HBM bandwidth, measured
+faster than a hand-written pallas equivalent.
+
+The CG recurrence is reassociated so the p-update happens at the START of
+the next iteration (p_1 = r_1 + beta * p_0), which is algebraically the
+reference loop with the same operation order per element. rtol semantics:
+benchmark mode only (rtol = 0, exactly nreps iterations — cg.hpp:88-91).
+
+float32 only (Mosaic has no f64); the driver routes f64 to the XLA path.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .pallas_laplacian import (
+    SUBLANES,
+    _use_interpret,
+    corner_window_G,
+    sumfact_window_apply,
+)
+from .folded import (
+    _SHIFT_CLASSES,
+    FoldedLaplacian,
+    FoldedLayout,
+    _class_shifts,
+    _r8,
+    _rb,
+    _assemble_window,
+    _seam_accumulate,
+    _seam_ring_shapes,
+    _shift_window_pair,
+)
+
+# Input-ring depth above which the delay-ring VMEM footprint is not worth
+# it (KI * P^3 * 8 * nl * 4 bytes); callers fall back to the multi-view
+# apply path. KI grows with the +x flat shift: ~ (ny * nz) / (8 * nl).
+MAX_RING_BLOCKS = 24
+
+
+def ring_depth(layout: FoldedLayout) -> int:
+    """KI = D + 1 where D = max shift in blocks + 1."""
+    B = layout.block
+    qmax = max(s // B for s in _class_shifts(layout).values())
+    return qmax + 2
+
+
+def _make_cg_apply_kernel(P: int, nl: int, B: int, nb: int, KI: int, K: int,
+                          is_identity: bool,
+                          phi0: np.ndarray, dphi1: np.ndarray,
+                          qr: dict[str, tuple[int, int]],
+                          n_cells: tuple[int, int, int],
+                          update_p: bool, geom_tables=None):
+    corner_mode = geom_tables is not None
+    D = KI - 1
+    nx, ny, nz = n_cells
+    npy, npz = ny + 1, nz + 1
+    plane = {
+        "x": lambda a: a[0], "y": lambda a: a[:, 0], "z": lambda a: a[:, :, 0],
+        "xy": lambda a: a[0, 0], "xz": lambda a: a[0, :, 0],
+        "yz": lambda a: a[:, 0, 0], "xyz": lambda a: a[0, 0, 0],
+    }
+
+    def kernel(*refs):
+        if update_p:
+            r_ref, pprev_ref = refs[:2]
+            ni = 2
+        else:
+            (x_ref,) = refs[:1]
+            ni = 1
+        ngeom = 2 if corner_mode else 1
+        geom_refs = refs[ni:ni + ngeom]
+        scal_ref = refs[ni + ngeom]  # SMEM (1, 2): [beta, kappa]
+        base = ni + 1 + ngeom
+        if update_p:
+            p_out_ref, y_out_ref, dot_ref = refs[base:base + 3]
+            no = 3
+        else:
+            y_out_ref, dot_ref = refs[base:base + 2]
+            no = 2
+        inring = refs[base + no]
+        rings = {k: refs[base + no + 1 + ci]
+                 for ci, k in enumerate(_SHIFT_CLASSES)}
+
+        t = pl.program_id(0)
+
+        @pl.when(t == 0)
+        def _zero_rings():
+            for k in _SHIFT_CLASSES:
+                rings[k][...] = jnp.zeros_like(rings[k])
+
+        # ---- input stage: ingest block t (clamped at the tail) ----
+        @pl.when(t < np.int32(nb))
+        def _ingest():
+            if update_p:
+                pb = (scal_ref[0, 0] * _r8(pprev_ref[0], nl)
+                      + _r8(r_ref[0], nl))
+                p_out_ref[0] = _rb(pb)
+            else:
+                pb = _r8(x_ref[0], nl)
+            inring[jax.lax.rem(t, np.int32(KI))] = pb.reshape(
+                P, P, P, SUBLANES, nl
+            )
+
+        # ---- output stage: compute block i = t - D ----
+        @pl.when(t >= np.int32(D))
+        def _emit():
+            i = t - np.int32(D)
+
+            def rblk(d):
+                return inring[jax.lax.rem(i + np.int32(d), np.int32(KI))]
+
+            u0 = rblk(0)
+            win = {
+                k: _shift_window_pair(
+                    plane[k](rblk(qr[k][0])), plane[k](rblk(qr[k][0] + 1)),
+                    qr[k][1], nl,
+                )
+                for k in _SHIFT_CLASSES
+            }
+            u = _assemble_window(
+                u0, win["x"], win["y"], win["z"],
+                win["xy"], win["xz"], win["yz"], win["xyz"],
+            )
+            if corner_mode:
+                G = corner_window_G(geom_refs[0][0], geom_refs[1][0],
+                                    *geom_tables)
+            else:
+                G = geom_refs[0][0]
+            y = sumfact_window_apply(u, G, scal_ref[0, 1], phi0, dphi1,
+                                     is_identity)
+            m = _seam_accumulate(rings, y, i, K, qr, B, nl, P)
+            # Dirichlet pass-through with the bc mask computed IN-KERNEL
+            # from the structured-box closed form (no 4 B/dof HBM stream):
+            # grid coord X = cx*P + ilocal is on the boundary iff
+            # ilocal == 0 and cx in {0, nx} (the global X = nx*P plane lives
+            # in the ghost column's ilocal = 0 slots) — and likewise per
+            # axis. Sequential per-axis selects compose the union.
+            cat = jnp.concatenate
+            sub_i = jax.lax.broadcasted_iota(jnp.int32, (SUBLANES, nl), 0)
+            lane_i = jax.lax.broadcasted_iota(jnp.int32, (SUBLANES, nl), 1)
+            c = i * np.int32(B) + sub_i * np.int32(nl) + lane_i
+            cx = jax.lax.div(c, np.int32(npy * npz))
+            rem = c - cx * np.int32(npy * npz)
+            cy = jax.lax.div(rem, np.int32(npz))
+            cz = rem - cy * np.int32(npz)
+            mx = jnp.logical_or(cx == 0, cx == np.int32(nx))
+            my = jnp.logical_or(cy == 0, cy == np.int32(ny))
+            mz = jnp.logical_or(cz == 0, cz == np.int32(nz))
+
+            def bsel(mask, lead_shape):
+                return jax.lax.broadcast(mask, lead_shape)
+
+            m = cat([jax.lax.select(bsel(mx, (P, P)), u0[0], m[0])[None],
+                     m[1:]], axis=0)
+            m = cat([jax.lax.select(bsel(my, (P, P)), u0[:, 0],
+                                    m[:, 0])[:, None], m[:, 1:]], axis=1)
+            m = cat([jax.lax.select(bsel(mz, (P, P)), u0[:, :, 0],
+                                    m[:, :, 0])[:, :, None],
+                     m[:, :, 1:]], axis=2)
+            y_out_ref[0] = _rb(m).reshape(P * P * P, B)
+            # <p, y> partial for this block, reduced over the 27 window rows
+            dot_ref[...] = jnp.sum(
+                (u0 * m).reshape(P * P * P, SUBLANES, nl), axis=0
+            )[None]
+
+    return kernel
+
+
+def _cg_apply_call(
+    layout: FoldedLayout,
+    geom,
+    kappa,
+    phi0: np.ndarray,
+    dphi1: np.ndarray,
+    is_identity: bool,
+    geom_tables,
+    update_p: bool,
+    interpret: bool | None,
+    *vectors,
+):
+    """update_p: vectors = (r, p_prev, beta) -> (p, y, dot_partials).
+    else:       vectors = (x,)              -> (y, dot_partials) where the
+    dot partials are of <x, y> (used for <p, A p> style reductions).
+    kappa rides in SMEM next to beta — no scaled copy of G is ever made."""
+    P = layout.degree
+    nl, B, nb = layout.nl, layout.block, layout.nblocks
+    nq = phi0.shape[0]
+    qr = {k: divmod(s, B) for k, s in _class_shifts(layout).items()}
+    K = max(q for q, _ in qr.values()) + 2
+    KI = ring_depth(layout)
+    D = KI - 1
+    nsteps = nb + D
+    dtype = vectors[0].dtype
+    P3 = P * P * P
+
+    def clamp_in(i):
+        return (jax.lax.min(i, np.int32(nb - 1)), 0, 0)
+
+    def clamp_out(i):
+        return (jax.lax.max(i - np.int32(D), np.int32(0)), 0, 0)
+
+    in_specs = []
+    operands = []
+    if update_p:
+        r, p_prev, beta = vectors
+        in_specs += [
+            pl.BlockSpec((1, P3, B), clamp_in, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, P3, B), clamp_in, memory_space=pltpu.VMEM),
+        ]
+        operands += [r, p_prev]
+    else:
+        (x,) = vectors
+        beta = jnp.zeros((), dtype)
+        in_specs.append(pl.BlockSpec((1, P3, B), clamp_in,
+                                     memory_space=pltpu.VMEM))
+        operands.append(x)
+    if geom_tables is None:
+        in_specs.append(pl.BlockSpec(
+            (1, 6, nq, nq, nq, SUBLANES, nl),
+            lambda i: (jax.lax.max(i - np.int32(D), np.int32(0)),
+                       0, 0, 0, 0, 0, 0),
+            memory_space=pltpu.VMEM,
+        ))
+        operands.append(geom)
+    else:
+        corners_b, mask_b = geom
+        in_specs += [
+            pl.BlockSpec(
+                (1, 3, 2, 2, 2, SUBLANES, nl),
+                lambda i: (jax.lax.max(i - np.int32(D), np.int32(0)),
+                           0, 0, 0, 0, 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+            pl.BlockSpec(
+                (1, SUBLANES, nl),
+                lambda i: (jax.lax.max(i - np.int32(D), np.int32(0)), 0, 0),
+                memory_space=pltpu.VMEM,
+            ),
+        ]
+        operands += [corners_b, mask_b]
+    in_specs.append(pl.BlockSpec((1, 2), lambda i: (0, 0),
+                                 memory_space=pltpu.SMEM))
+    operands.append(
+        jnp.stack([beta.astype(dtype),
+                   jnp.asarray(kappa, dtype)]).reshape(1, 2)
+    )
+
+    out_specs = []
+    out_shapes = []
+    if update_p:
+        out_specs.append(pl.BlockSpec((1, P3, B), clamp_in,
+                                      memory_space=pltpu.VMEM))
+        out_shapes.append(jax.ShapeDtypeStruct((nb, P3, B), dtype))
+    out_specs.append(pl.BlockSpec((1, P3, B), clamp_out,
+                                  memory_space=pltpu.VMEM))
+    out_shapes.append(jax.ShapeDtypeStruct((nb, P3, B), dtype))
+    out_specs.append(pl.BlockSpec(
+        (1, SUBLANES, nl),
+        lambda i: (jax.lax.max(i - np.int32(D), np.int32(0)), 0, 0),
+        memory_space=pltpu.VMEM,
+    ))
+    out_shapes.append(jax.ShapeDtypeStruct((nb, SUBLANES, nl), dtype))
+
+    ring_shapes = _seam_ring_shapes(P, K, nl)
+    kernel = _make_cg_apply_kernel(
+        P, nl, B, nb, KI, K, is_identity,
+        np.asarray(phi0, np.float64), np.asarray(dphi1, np.float64),
+        qr, layout.n, update_p, geom_tables=geom_tables,
+    )
+    return pl.pallas_call(
+        kernel,
+        grid=(nsteps,),
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shapes,
+        scratch_shapes=(
+            [pltpu.VMEM((KI, P, P, P, SUBLANES, nl), dtype)]
+            + [pltpu.VMEM(ring_shapes[k], dtype) for k in _SHIFT_CLASSES]
+        ),
+        interpret=_use_interpret() if interpret is None else interpret,
+    )(*operands)
+
+
+def supports_cg_engine(op: FoldedLaplacian) -> bool:
+    """The delay-ring engine needs the input ring to fit VMEM."""
+    return ring_depth(op.layout) <= MAX_RING_BLOCKS
+
+
+def _op_geom_for_engine(op: FoldedLaplacian):
+    """Geometry operands for the engine kernel (kappa streams via SMEM)."""
+    if op.G is not None:
+        return op.G, None
+    return (op.corners, op.cmask), (
+        np.asarray(op.pts_c), np.asarray(op.wts_c)
+    )
+
+
+def folded_cg_solve(
+    op: FoldedLaplacian,
+    b: jnp.ndarray,
+    nreps: int,
+    interpret: bool | None = None,
+) -> jnp.ndarray:
+    """Benchmark CG (x0 = 0, rtol = 0, exactly nreps iterations) with the
+    fused two-kernel iteration. Matches la.cg.cg_solve(op.apply_cg, b, 0,
+    nreps) to f32 reassociation accuracy."""
+    layout = op.layout
+    geom, geom_tables = _op_geom_for_engine(op)
+    phi0 = np.asarray(op.phi0_c, np.float64)
+    dphi1 = np.asarray(op.dphi1_c, np.float64)
+
+    apply_cg = partial(
+        _cg_apply_call, layout, geom, op.kappa, phi0, dphi1,
+        op.is_identity, geom_tables,
+    )
+
+    def dot_from(partials):
+        return jnp.sum(partials)
+
+    # x0 = 0: r0 = b, p1 = r0 (beta=0), rnorm0 = <r0, r0>
+    x0 = jnp.zeros_like(b)
+    rnorm0 = jnp.vdot(b, b)
+
+    def body(_, state):
+        x, r, p_prev, beta, rnorm = state
+        p, y, pdot = apply_cg(True, interpret, r, p_prev, beta)
+        alpha = rnorm / dot_from(pdot)
+        x1 = x + alpha * p
+        r1 = r - alpha * y
+        rnorm1 = jnp.vdot(r1, r1)
+        beta1 = rnorm1 / rnorm
+        return (x1, r1, p, beta1, rnorm1)
+
+    state = (x0, b, jnp.zeros_like(b), jnp.zeros((), b.dtype), rnorm0)
+    x, *_ = jax.lax.fori_loop(0, nreps, body, state)
+    return x
+
+
+def folded_apply_ring(
+    op: FoldedLaplacian, x: jnp.ndarray, interpret: bool | None = None
+) -> jnp.ndarray:
+    """Single delay-ring apply (y = A x with Dirichlet pass-through,
+    x zero on bc rows — see FoldedLaplacian.apply_cg). Also returns only y,
+    discarding the fused <x, y> partials."""
+    geom, geom_tables = _op_geom_for_engine(op)
+    y, _ = _cg_apply_call(
+        op.layout, geom, op.kappa,
+        np.asarray(op.phi0_c, np.float64), np.asarray(op.dphi1_c, np.float64),
+        op.is_identity, geom_tables, False, interpret, x,
+    )
+    return y

@@ -96,6 +96,68 @@ def _stage(mat: np.ndarray, arr, axis: int):
     return jnp.stack(slabs, axis=axis)
 
 
+def corner_window_G(corners, mask, pts1d: np.ndarray, wts1d: np.ndarray):
+    """In-kernel geometry: trilinear Jacobian -> packed G, from the 8 cell
+    corners. The streamed-geometry replacement for a precomputed G tensor:
+    6*nq^3 values/cell of HBM traffic become 24 (plus ~30*nq^3 VPU FLOPs/cell,
+    which the folded kernel has headroom for — it is HBM-bound).
+
+    Same math as `geometry_computation_gpu` (/root/reference/src/
+    geometry_gpu.hpp:26-133) and ops.geometry.geometry_factors_jax, restated
+    as compile-time-table stages on the (8, NL) cell cross-section:
+
+      corners (3, 2, 2, 2, 8, NL)  [component, corner offsets a/b/c, cells]
+      mask    (8, NL)              1 for real cells, 0 for ghost/pad cells
+      -> G tuple of 6 arrays (nq, nq, nq, 8, NL): w*detJ^-1*(adj J)(adj J)^T
+         upper triangle, masked to zero on ghost cells.
+
+    pts1d/wts1d are numpy compile-time quadrature tables; N/D (trilinear
+    shape values/derivatives at the points) become FMA immediates via _stage.
+    Ghost cells must carry an invertible placeholder Jacobian (unit cube,
+    see ops.folded.ghost_corner_arrays) so the division stays finite.
+    """
+    pts = np.asarray(pts1d, np.float64)
+    nq = len(pts)
+    N = np.stack([1.0 - pts, pts], axis=1)  # (nq, 2)
+    D = np.broadcast_to(np.array([-1.0, 1.0]), (nq, 2))
+    cols = []  # cols[a][i] = d x_i / d xi_a at the nq^3 points
+    for a in range(3):
+        T = [N, N, N]
+        T[a] = D
+        col = []
+        for i in range(3):
+            c = corners[i]  # (2, 2, 2, 8, NL)
+            c = _stage(T[2], c, 2)
+            c = _stage(T[1], c, 1)
+            c = _stage(T[0], c, 0)
+            col.append(c)  # (nq, nq, nq, 8, NL)
+        cols.append(col)
+
+    def cross(u, v):
+        return (
+            u[1] * v[2] - u[2] * v[1],
+            u[2] * v[0] - u[0] * v[2],
+            u[0] * v[1] - u[1] * v[0],
+        )
+
+    # adjugate rows K[a] = cross of the other two Jacobian columns
+    K = (cross(cols[1], cols[2]), cross(cols[2], cols[0]),
+         cross(cols[0], cols[1]))
+    detJ = (cols[0][0] * K[0][0] + cols[0][1] * K[0][1]
+            + cols[0][2] * K[0][2])
+    # scale = mask * w3 / detJ; w3 = w⊗w⊗w applied as three diagonal stages
+    # (per-plane scalar immediates — Mosaic-friendly, no constant arrays).
+    scale = mask / detJ
+    wdiag = np.diag(np.asarray(wts1d, np.float64))
+    for ax in range(3):
+        scale = _stage(wdiag, scale, ax)
+    pairs = ((0, 0), (0, 1), (0, 2), (1, 1), (1, 2), (2, 2))
+    return tuple(
+        (K[a][0] * K[b][0] + K[a][1] * K[b][1] + K[a][2] * K[b][2]) * scale
+        for a, b in pairs
+    )
+
+
 def sumfact_window_apply(u, G, kappa, phi0: np.ndarray, dphi1: np.ndarray,
                          is_identity: bool):
     """The per-cell contraction chain on one VMEM-resident cell block:

@@ -3,8 +3,11 @@
 Replaces `dolfinx::common::Timer` + `list_timings` (MPI_MAX aggregated table,
 /root/reference/src/main.cpp:314, laplacian_solver.cpp:90,174-198). Timers
 accumulate by name in a process-local registry; `timer_report` renders the
-table (in a multi-host deployment the driver max-reduces across hosts before
-printing; single-controller JAX runs have one registry).
+table. Scope note: JAX here is single-controller — one Python process
+drives every device — so one registry IS the whole-job view and no
+cross-host reduction exists (the reference needs MPI_MAX only because each
+rank times independently). A future multi-controller deployment would
+max-reduce `timings()` across processes before printing.
 """
 
 from __future__ import annotations

@@ -1,0 +1,36 @@
+#!/usr/bin/env python
+"""Golden-JSON assertions for the end-to-end CLI run (CI).
+
+Behavioural analogue of the reference's output check (src/test_output.py):
+for the canonical config
+
+    --ndofs_global 1000 --degree 3 --qmode 0 --nreps 1 --mat_comp --float 64
+
+assert the echoed size, matrix-free vs assembled-CSR agreement, and the
+golden norm  y_norm = 9.912865833415553  (reference test_output.py:19 —
+the same operator on the same mesh must reproduce it to f64 tolerance).
+
+Usage: python scripts/check_output.py out.json
+"""
+
+import json
+import sys
+
+GOLDEN_Y_NORM = 9.912865833415553
+
+
+def main(path: str) -> int:
+    with open(path) as fh:
+        doc = json.load(fh)
+    out = doc["output"]
+    assert out["ndofs_global"] == 1000, out["ndofs_global"]
+    assert abs(out["y_norm"] - out["z_norm"]) < 1e-9, (
+        out["y_norm"], out["z_norm"],
+    )
+    assert abs(out["y_norm"] - GOLDEN_Y_NORM) < 1e-9, out["y_norm"]
+    print(f"OK: y_norm={out['y_norm']} matches golden {GOLDEN_Y_NORM}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1]))

@@ -71,7 +71,7 @@ def test_dist_apply_matches_single_device(dshape, degree, qmode):
 
     # Masked norm equals the global norm.
     np.testing.assert_allclose(
-        float(jax.jit(norm_fn)(yb)), np.linalg.norm(y_ref), rtol=1e-12
+        float(jax.jit(norm_fn)(yb)[0]), np.linalg.norm(y_ref), rtol=1e-12
     )
 
 

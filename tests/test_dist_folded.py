@@ -88,5 +88,5 @@ def test_dist_folded_cg_and_norm_match_global():
     scale = np.abs(x_ref).max()
     np.testing.assert_allclose(x, x_ref, atol=2e-4 * scale)
 
-    nrm = float(jax.jit(norm_fn)(bb, op.owned))
+    nrm = float(jax.jit(norm_fn)(bb, op.owned)[0])
     np.testing.assert_allclose(nrm, np.linalg.norm(b), rtol=1e-5)

@@ -117,7 +117,7 @@ def test_dist_kron_cg_and_norm_match_global(degree, qmode):
     scale = np.abs(x_ref).max()
     np.testing.assert_allclose(x, x_ref, atol=1e-12 * scale)
 
-    nrm = float(jax.jit(norm_fn)(bb))
+    nrm = float(jax.jit(norm_fn)(bb)[0])
     np.testing.assert_allclose(nrm, np.linalg.norm(b), rtol=1e-12)
 
 
