@@ -176,7 +176,11 @@ def run_benchmark(cfg: BenchConfig) -> BenchmarkResults:
     )
     res.extra["backend"] = backend
 
-    device_setup = backend == "kron" and not cfg.mat_comp
+    # Both fast paths build their RHS on device: the kron path from
+    # separable 1D factors, the folded path from cell corners
+    # (ops.folded_rhs) — no O(ndofs) host arrays in either. The host path
+    # remains for the mat_comp oracle and the XLA fallback backend.
+    device_setup = backend in ("kron", "pallas") and not cfg.mat_comp
     if not device_setup:
         # Host-side RHS/oracle setup (O(ndofs) host arrays; needed by the
         # mat_comp oracle and the general-geometry backends).
@@ -186,7 +190,7 @@ def run_benchmark(cfg: BenchConfig) -> BenchmarkResults:
 
     folded = backend == "pallas"
     with Timer("% Create matfree operator"):
-        if device_setup:
+        if backend == "kron" and device_setup:
             # Uniform-mesh fast path: RHS built on device from separable 1D
             # factors (ops.kron.device_rhs_uniform) — no O(ndofs) host
             # arrays anywhere, so problem size is capped by HBM, not host
@@ -205,13 +209,30 @@ def run_benchmark(cfg: BenchConfig) -> BenchmarkResults:
             # geometry (see ops.folded): no per-apply gather/fold
             # transposes, ~2x the grid-layout rate. The ndevices>1 branch
             # above routes pallas runs through dist.folded the same way.
-            from ..ops.folded import build_folded_laplacian, fold_vector
+            from ..ops.folded import (
+                build_folded_laplacian,
+                fold_vector,
+                ghost_corner_arrays,
+            )
 
             op = build_folded_laplacian(
                 mesh, cfg.degree, cfg.qmode, rule, kappa=2.0, dtype=dtype,
                 tables=t,
             )
-            u = jnp.asarray(fold_vector(b_host.astype(dtype), op.layout))
+            if device_setup:
+                # Device-side RHS from cell corners (ops.folded_rhs): the
+                # perturbed-mesh analogue of the kron path's separable RHS.
+                from ..ops.folded_rhs import device_rhs_folded
+
+                ccs, mcs = ghost_corner_arrays(op.layout, mesh.cell_corners)
+                u = jax.jit(
+                    lambda c, m, bc: device_rhs_folded(
+                        c, m, bc, op.layout, t, dtype
+                    )
+                )(jnp.asarray(ccs, dtype), jnp.asarray(mcs, dtype),
+                  op.bc_mask)
+            else:
+                u = jnp.asarray(fold_vector(b_host.astype(dtype), op.layout))
         else:
             op = build_laplacian(
                 mesh, cfg.degree, cfg.qmode, rule, kappa=2.0, dtype=dtype,

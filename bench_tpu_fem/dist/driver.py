@@ -110,18 +110,22 @@ def run_distributed(cfg, res, dtype):
     res.ncells_global = int(np.prod(n))
     res.ndofs_global = int(np.prod(dof_grid_shape(n, cfg.degree)))
 
-    # The kron flagship needs no O(global-dofs) host arrays at all: operator
-    # state is three 1D assemblies and the RHS is built per shard on device
-    # (the reference's per-rank setup, mesh.cpp:190-218 +
-    # laplacian_solver.cpp:100-114, with the 'per-rank' part made closed-form
-    # by the structured box). The host path remains for the general backends
-    # and for the mat_comp oracle.
-    if kron and not cfg.mat_comp:
+    # Neither fast path needs O(global-dofs) host arrays: the kron flagship's
+    # operator state is three 1D assemblies with a per-shard separable device
+    # RHS, and the folded path builds per-shard closed-form masks with a
+    # per-shard corner-based device RHS (the reference's per-rank setup,
+    # mesh.cpp:190-218 + laplacian_solver.cpp:100-114, with 'per-rank' made
+    # closed-form by the structured box). The host path remains for the XLA
+    # fallback backend and for the mat_comp oracle.
+    if (kron or folded) and not cfg.mat_comp:
         from ..elements.tables import build_operator_tables
+        from ..mesh.box import create_box_mesh
 
         rule = "gauss" if cfg.use_gauss else "gll"
         t = build_operator_tables(cfg.degree, cfg.qmode, rule)
         b_host = G_host = dm = bc_grid = None
+        mesh = (None if kron
+                else create_box_mesh(n, cfg.geom_perturb_fact))
     else:
         n, rule, t, mesh, grid_shape, bc_grid, dm, b_host, G_host = (
             _setup_problem(cfg, n)
@@ -153,25 +157,44 @@ def run_distributed(cfg, res, dtype):
             apply_args = (op,)
             norm_args = ()
         elif folded:
-            # Folded shards (ghost cell columns = halo; see dist.folded).
+            # Folded shards (ghost cell columns = halo; see dist.folded:
+            # overlap-by-construction apply, per-shard closed-form setup).
             from .folded import (
                 build_dist_folded,
+                make_folded_rhs_fn,
                 make_folded_sharded_fns,
+                shard_corner_cs,
                 shard_folded_vectors,
             )
 
             op = build_dist_folded(
                 mesh, dgrid, cfg.degree, t, kappa=2.0, dtype=dtype
             )
-            u_blocks = shard_folded_vectors(
-                b_host.astype(dtype), n, cfg.degree, dgrid.dshape, op.layout
+            apply_fn, cg_fn, norm_fn, sharded_state = (
+                make_folded_sharded_fns(op, dgrid, cfg.nreps)
             )
-            u = jax.device_put(jnp.asarray(u_blocks), sharding)
-            apply_fn, cg_fn, norm_fn = make_folded_sharded_fns(
-                op, dgrid, cfg.nreps
-            )
-            cg_args = (op.G, op.bc_mask, op.owned)
-            apply_args = (op.G, op.bc_mask)
+            state = sharded_state(op)
+            if b_host is not None:
+                # mat_comp: feed the oracle-precision host RHS to both paths.
+                u_blocks = shard_folded_vectors(
+                    b_host.astype(dtype), n, cfg.degree, dgrid.dshape,
+                    op.layout,
+                )
+                u = jax.device_put(jnp.asarray(u_blocks), sharding)
+            else:
+                # Per-shard device RHS (no O(global-dof) host arrays).
+                ccs, mcs = shard_corner_cs(mesh, dgrid.dshape, op.layout)
+                rhs_fn = make_folded_rhs_fn(op, dgrid, t, dtype)
+                # device_put numpy directly with the sharding: never stage
+                # the global corner array on a single device
+                np_dt = np.float32 if dtype == jnp.float32 else np.float64
+                u = jax.jit(rhs_fn)(
+                    jax.device_put(np.asarray(ccs, np_dt), sharding),
+                    jax.device_put(np.asarray(mcs, np_dt), sharding),
+                    op.bc_mask,
+                )
+            cg_args = (state, op.owned)
+            apply_args = (state,)
             norm_args = (op.owned,)
         else:
             op = build_dist_laplacian(

@@ -1,22 +1,45 @@
 """Distributed folded-layout operator: folded shards over the device grid.
 
 The folded layout (ops.folded) makes the halo structural: each shard's ghost
-cell columns are exactly the data it needs from its +x/+y/+z neighbours, so
+cell columns are exactly the data it needs from its +x/+y/+z neighbours.
+This module gives the general-geometry distributed path the same two
+properties the kron flagship path has (dist/kron.py):
 
-- forward halo  = one `lax.ppermute` per axis carrying the neighbour's
-  (c*=0, i=0) slab into the local ghost column (right -> left), and
-- reverse scatter = the same slab of accumulated seam partials sent left ->
-  right and added into the owner (the distributed tail of the overlap-add
-  that replaces the reference's atomicAdd + MPI ghost scatter,
-  /root/reference/src/vector.hpp:31-149, laplacian.hpp:286-347).
+COMM/COMPUTE OVERLAP BY CONSTRUCTION (the reference's lcell/bcell split,
+/root/reference/src/laplacian.hpp:286-347). The apply is decomposed by
+LINEARITY of the operator in its input:
 
-Exchanges run in axis order x, y, z; each payload spans the full local
-c-cross-section *including* previously refreshed ghost columns, which fills
-edge/corner ghosts transitively (all shards move in SPMD lockstep, so the
-x-refreshed data is present before the y exchange reads it). Ownership: the
-plane shared by two shards belongs to the *right* shard (it is that shard's
-(c*=0, i=0) slots); the global last plane per axis belongs to the last
-shard's ghost column.
+    y = A(x_interior) + A(g_x) + A(g_y) + A(g_z)
+
+where x_interior is the local vector with true-ghost slots zeroed and g_a
+is the (disjoint) class of ghost slots refreshed along axis a. The MAIN
+kernel — the full-volume fused Pallas apply — consumes only x_interior and
+therefore has NO data dependency on any collective: XLA is free to run the
+ppermute chain behind it. The ghost contributions are added by three THIN
+EPILOGUES, each a fused apply on a 2-cell-column sub-layout (the only cells
+whose windows touch that ghost class) — O(surface) compute that alone waits
+on the halo. The final reverse seam scatter (ghost partials -> owner,
+the distributed tail of the overlap-add replacing the reference's
+atomicAdd + MPI scatter, vector.hpp:31-149) runs after the adds.
+
+Ghost-class partition (exact, no double counting): g_x = all slots in the
++x ghost column; g_y = +y ghost column minus g_x's corner slots (only when
+x is actually sharded — otherwise those slots belong to g_y); g_z = +z
+ghost column minus both. Transitive corner filling follows from the
+exchange order x, y, z with payloads spanning the full refreshed
+cross-section (all shards move in SPMD lockstep).
+
+PER-SHARD CLOSED-FORM SETUP. No O(global-dof) host arrays anywhere:
+Dirichlet/ghost/owned masks are computed per shard from the shard position
+(the box structure makes them closed-form), geometry ships as per-shard
+cell corners (24 floats/cell; G is computed in-kernel — ops.folded corner
+mode — or precomputed per shard on device), and the RHS is assembled on
+device per shard (ops.folded_rhs) and seam-reduced. Host work is O(local)
+per shard plus one corner-array slice.
+
+Ownership: the plane shared by two shards belongs to the *right* shard (it
+is that shard's (c*=0, i=0) slots); the global last plane per axis belongs
+to the last shard's ghost column (an owned, real column there).
 """
 
 from __future__ import annotations
@@ -31,11 +54,12 @@ from jax import lax
 
 from ..elements.tables import OperatorTables
 from ..mesh.box import BoxMesh
-from ..mesh.dofmap import boundary_dof_marker
 from ..ops.folded import (
     FoldedLayout,
+    blocked_corners,
     fold_vector,
-    folded_cell_apply,
+    folded_cell_apply_fused,
+    ghost_corner_arrays,
     make_layout,
     unfold_vector,
 )
@@ -63,10 +87,24 @@ def _from_cview(v: jnp.ndarray, x: jnp.ndarray, layout: FoldedLayout) -> jnp.nda
     )
 
 
+def _cview_to_folded(v: jnp.ndarray, layout: FoldedLayout) -> jnp.ndarray:
+    """6D cell view -> folded (nb, P^3, B), block-padding tail zero."""
+    P = layout.degree
+    flat = v.reshape(P * P * P, layout.cg)
+    flat = jnp.pad(flat, ((0, 0), (0, layout.lv - layout.cg)))
+    return jnp.transpose(
+        flat.reshape(P * P * P, layout.nblocks, layout.block), (1, 0, 2)
+    )
+
+
 def folded_halo_refresh(x: jnp.ndarray, layout: FoldedLayout) -> jnp.ndarray:
     """Fill ghost-column (i=0) slots from the right neighbour along each
     axis (the forward scatter, owner -> ghost). The last shard keeps its own
-    ghost column: those slots are the owned global boundary plane."""
+    ghost column: those slots are the owned global boundary plane. Payloads
+    span the full refreshed cross-section, so later axes carry earlier
+    axes' ghost data into edge/corner slots transitively. Depends only on
+    the input — never on operator output — so the whole chain can run
+    behind the main kernel."""
     v = _cview(x, layout)
     for ax, name in zip(range(3), AXIS_NAMES):
         n = lax.axis_size(name)
@@ -126,46 +164,148 @@ def folded_reverse_scatter(y: jnp.ndarray, layout: FoldedLayout) -> jnp.ndarray:
     return _from_cview(v, y, layout)
 
 
+def _epi_layout(layout: FoldedLayout, axis: int) -> FoldedLayout:
+    """Sub-layout of the axis-a epilogue: the 2 cell columns adjacent to the
+    +a ghost plane (n_a -> 1, other axes unchanged). For axis 0 this is the
+    parent's trailing contiguous flat-c range; shifts along the other axes
+    are inherited exactly."""
+    n = list(layout.n)
+    n[axis] = 1
+    return FoldedLayout(n=tuple(n), degree=layout.degree, nl=layout.nl)
+
+
+def _extract_epi_input(xe6, layout: FoldedLayout, axis: int,
+                       excl: tuple[bool, bool, bool]):
+    """Build the axis-a epilogue sub-vector from the 6D view of the
+    ghost-only input xe (refreshed, bc-masked, true-ghost slots only):
+    columns [np_a - 2, np_a) with the adjacent real column zeroed (its data
+    is the main kernel's) and, per `excl`, the ghost slots already claimed
+    by an earlier sharded axis zeroed (the g_x > g_y > g_z partition)."""
+    np3 = layout.np3
+    cax = 3 + axis
+    ghost = lax.index_in_dim(xe6, np3[axis] - 1, axis=cax, keepdims=True)
+    for a2 in range(3):
+        if a2 == axis or not excl[a2]:
+            continue
+        # zero the a2 ghost plane inside this ghost column (claimed by g_a2)
+        c2 = 3 + a2
+        keep = lax.slice_in_dim(ghost, 0, np3[a2] - 1, axis=c2)
+        zero = jnp.zeros_like(
+            lax.index_in_dim(ghost, np3[a2] - 1, axis=c2, keepdims=True)
+        )
+        ghost = jnp.concatenate([keep, zero], axis=c2)
+    sub6 = jnp.concatenate([jnp.zeros_like(ghost), ghost], axis=cax)
+    return _cview_to_folded(sub6, _epi_layout(layout, axis))
+
+
+def _addback_epi(y6, ye, layout: FoldedLayout, axis: int):
+    """Add the axis-a epilogue output (sub-folded) into the parent 6D view
+    at columns [np_a - 2, np_a)."""
+    sl = _epi_layout(layout, axis)
+    P = layout.degree
+    ye6 = jnp.transpose(ye, (1, 0, 2)).reshape(P * P * P, sl.lv)[
+        :, : sl.cg
+    ].reshape(P, P, P, *sl.np3)
+    cax = 3 + axis
+    np_a = layout.np3[axis]
+    head = lax.slice_in_dim(y6, 0, np_a - 2, axis=cax)
+    tail = lax.slice_in_dim(y6, np_a - 2, np_a, axis=cax)
+    return jnp.concatenate([head, tail + ye6], axis=cax)
+
+
 @partial(
     jax.tree_util.register_dataclass,
-    data_fields=["G", "bc_mask", "owned", "kappa"],
-    meta_fields=["n_local", "degree", "nl", "is_identity", "phi0_c", "dphi1_c"],
+    data_fields=["G", "corners", "cmask", "bc_mask", "owned",
+                 "epi_geom", "kappa"],
+    meta_fields=["n_local", "degree", "nl", "is_identity", "dshape",
+                 "phi0_c", "dphi1_c", "pts_c", "wts_c"],
 )
 @dataclass(frozen=True)
 class DistFoldedLaplacian:
     """Stacked per-shard folded operator state (leading (Dx, Dy, Dz) axes
-    sharded over the device grid)."""
+    sharded over the device grid). Geometry is corner mode (G None) or
+    precomputed per shard (corners/cmask None), as in ops.folded."""
 
-    G: jnp.ndarray  # (Dx,Dy,Dz, nblocks, 6, nq,nq,nq, 8, nl)
-    bc_mask: jnp.ndarray  # (Dx,Dy,Dz, nb, P^3, B) bool
+    G: jnp.ndarray | None  # (Dx,Dy,Dz, nb, 6, nq,nq,nq, 8, nl) or None
+    corners: jnp.ndarray | None  # (Dx,Dy,Dz, nb, 3, 2,2,2, 8, nl) or None
+    cmask: jnp.ndarray | None  # (Dx,Dy,Dz, nb, 8, nl) or None
+    bc_mask: jnp.ndarray  # (Dx,Dy,Dz, nb, P^3, B) 0/1, vector dtype
     owned: jnp.ndarray  # (Dx,Dy,Dz, nb, P^3, B) bool: dof counted here
+    # NOTE: the "not a true ghost" mask the main kernel needs is exactly
+    # `owned` (every real non-ghost slot is owned under this partition);
+    # sharded_state derives it as owned.astype(dtype) instead of storing a
+    # byte-identical copy.
+    # per sharded axis: (geomlike..., bc_sub) for the 2-column epilogue
+    # sub-layout, stacked per shard; None for unsharded axes
+    epi_geom: tuple
     kappa: jnp.ndarray
     n_local: tuple[int, int, int]
     degree: int
     nl: int
     is_identity: bool
+    dshape: tuple[int, int, int] = (1, 1, 1)
     phi0_c: tuple = ()
     dphi1_c: tuple = ()
+    pts_c: tuple = ()
+    wts_c: tuple = ()
 
     @property
     def layout(self) -> FoldedLayout:
         return FoldedLayout(n=self.n_local, degree=self.degree, nl=self.nl)
 
-    def apply_local(self, x, G_local, bc_local):
-        """y = A x for one shard (inside shard_map): halo refresh -> local
-        folded apply -> reverse seam scatter -> Dirichlet pass-through."""
-        layout = self.layout
-        x = folded_halo_refresh(x, layout)
-        xm = jnp.where(bc_local, 0, x)
-        y = folded_cell_apply(
-            xm, G_local, self.kappa, layout,
-            np.asarray(self.phi0_c, np.float64),
-            np.asarray(self.dphi1_c, np.float64),
-            self.is_identity,
-        )
-        y = folded_reverse_scatter(y, layout)
-        return jnp.where(bc_local, x, y)
+    @property
+    def geom_tables(self):
+        if self.G is not None:
+            return None
+        return (np.asarray(self.pts_c), np.asarray(self.wts_c))
 
+    def _tables(self):
+        return (np.asarray(self.phi0_c, np.float64),
+                np.asarray(self.dphi1_c, np.float64))
+
+    def _fused(self, xb, bcf, geom, layout):
+        phi0, dphi1 = self._tables()
+        return folded_cell_apply_fused(
+            xb, bcf, geom, self.kappa, layout, phi0, dphi1,
+            self.is_identity, geom_tables=self.geom_tables,
+        )
+
+    def apply_local(self, x, state):
+        """y = A x for one shard (inside shard_map), with the main kernel
+        structurally independent of the halo collectives (see module
+        docstring). `state` holds this shard's slices (geom, bc, nghost,
+        epilogue state)."""
+        layout = self.layout
+        geom, bc, ngh, epi = state
+        # halo chain: depends only on x — overlaps the main kernel
+        xr = folded_halo_refresh(x, layout)
+        # main kernel: interior + locally-complete contributions only
+        xb = x * ngh * (1 - bc)
+        y = self._fused(xb, bc, geom, layout)
+        # thin epilogues: the ghost-slot contributions, per sharded axis
+        xe = xr * (1 - bc) * (1 - ngh)  # true-ghost slots only
+        xe6 = _cview(xe, layout)
+        y6 = _cview(y, layout)
+        excl = tuple(d > 1 for d in self.dshape)
+        for ax in range(3):
+            if self.dshape[ax] == 1:
+                continue
+            sub = _extract_epi_input(
+                xe6, layout, ax,
+                tuple(excl[a] and a < ax for a in range(3)),
+            )
+            geom_e, bc_e = epi[ax]
+            ye = self._fused(sub, bc_e, geom_e, _epi_layout(layout, ax))
+            y6 = _addback_epi(y6, ye, layout, ax)
+        y = _from_cview(y6, y, layout)
+        # distributed tail of the overlap-add, then Dirichlet pass-through
+        y = folded_reverse_scatter(y, layout)
+        return y + bc * (xr - y)
+
+
+# ---------------------------------------------------------------------------
+# Host-side shard helpers (test/oracle transport)
+# ---------------------------------------------------------------------------
 
 def shard_folded_vectors(
     grid: np.ndarray,
@@ -221,10 +361,30 @@ def unshard_folded_vectors(
     return out
 
 
+# ---------------------------------------------------------------------------
+# Per-shard closed-form setup
+# ---------------------------------------------------------------------------
+
+def _local_grid_marker(layout: FoldedLayout, shard_pos, dshape,
+                       n_global) -> np.ndarray:
+    """Local inclusive dof-grid bool: global Dirichlet boundary. Closed
+    form from the shard position — no global array is ever built
+    (C-equivalent of main.cpp:94-102 restricted to the shard)."""
+    P = layout.degree
+    marks = []
+    for ax in range(3):
+        L = layout.n[ax] * P + 1
+        g0 = shard_pos[ax] * layout.n[ax] * P
+        g = g0 + np.arange(L)
+        marks.append((g == 0) | (g == n_global[ax] * P))
+    return (marks[0][:, None, None] | marks[1][None, :, None]
+            | marks[2][None, None, :])
+
+
 def owned_folded_mask(layout: FoldedLayout, shard_pos, dshape) -> np.ndarray:
     """Host-side: bool mask of slots counted by this shard in global
     reductions (every dof exactly once). Structural slots and interior
-    shards' ghost columns are excluded."""
+    shards' ghost columns are excluded. O(local) closed form."""
     P3 = layout.degree ** 3
     marks = fold_vector(
         np.ones(tuple(c * layout.degree + 1 for c in layout.n)), layout
@@ -253,79 +413,190 @@ def build_dist_folded(
     kappa: float = 2.0,
     dtype=jnp.float32,
     nl: int | None = None,
+    geom: str = "corner",
 ) -> DistFoldedLaplacian:
-    """Build stacked folded shards; per-shard geometry computed on device
-    inside shard_map (ghost/pad cells: unit corners + zero mask, as in
-    ops.folded.build_folded_laplacian)."""
-    from jax.sharding import NamedSharding, PartitionSpec as P
-
-    from ..ops.folded import blocked_G_traced, ghost_corner_arrays
-
+    """Build stacked per-shard folded state. All masks are O(local) closed
+    form from the shard position; geometry ships as per-shard corner slices
+    (geom='corner', default — G computed in-kernel) or is precomputed per
+    shard on device (geom='g'). The only O(global) host touch is slicing
+    the mesh's corner array (O(ncells), same order as the reference's mesh
+    build, mesh.cpp:190-218)."""
     t = tables
     dshape = dgrid.dshape
     ncl = shard_cells(mesh.n, dshape)
     layout = make_layout(ncl, degree, t.nq, np.dtype(dtype).itemsize, nl=nl)
+    if geom not in ("corner", "g"):
+        raise ValueError(f"unknown geom mode {geom!r}")
 
-    # Host-side per-shard corner/mask/bc/owned prep (ghost-cell convention
-    # shared with the single-device builder via ghost_corner_arrays).
     corners_all = mesh.cell_corners  # (nx, ny, nz, 2,2,2,3)
-    bc_global = boundary_dof_marker(mesh.n, degree)
 
-    corners_cs = np.empty((*dshape, layout.lv, 2, 2, 2, 3), dtype=np.float64)
-    mask_cs = np.zeros((*dshape, layout.lv))
-    bc_blocks = np.zeros((*dshape, *layout.vec_shape), dtype=bool)
-    owned_blocks = np.zeros((*dshape, *layout.vec_shape), dtype=bool)
-    Pd = degree
-    for i in range(dshape[0]):
-        for j in range(dshape[1]):
-            for k in range(dshape[2]):
-                blk = corners_all[
-                    i * ncl[0]: (i + 1) * ncl[0],
-                    j * ncl[1]: (j + 1) * ncl[1],
-                    k * ncl[2]: (k + 1) * ncl[2],
-                ]
-                corners_cs[i, j, k], mask_cs[i, j, k] = ghost_corner_arrays(
-                    layout, blk
-                )
-                x0, y0, z0 = i * ncl[0] * Pd, j * ncl[1] * Pd, k * ncl[2] * Pd
-                bc_blk = bc_global[
-                    x0: x0 + ncl[0] * Pd + 1,
-                    y0: y0 + ncl[1] * Pd + 1,
-                    z0: z0 + ncl[2] * Pd + 1,
-                ]
-                bc_blocks[i, j, k] = fold_vector(bc_blk, layout)
-                owned_blocks[i, j, k] = owned_folded_mask(layout, (i, j, k), dshape)
+    def shard_corner_block(pos, sub_axis=None):
+        """This shard's cell-corner slice; for an epilogue sub-layout,
+        only the last real cell column along sub_axis (the ghost column
+        gets unit-cube placeholders from ghost_corner_arrays)."""
+        sl = []
+        for ax in range(3):
+            c0 = pos[ax] * ncl[ax]
+            c1 = c0 + ncl[ax]
+            if sub_axis == ax:
+                c0 = c1 - 1
+            sl.append(slice(c0, c1))
+        return corners_all[tuple(sl)]
 
-    spec = P(*AXIS_NAMES)
-    sharding = NamedSharding(dgrid.mesh, spec)
-    corners_d = jax.device_put(jnp.asarray(corners_cs, dtype=dtype), sharding)
-    mask_d = jax.device_put(jnp.asarray(mask_cs, dtype=dtype), sharding)
+    shp = dshape
+    # stacked per-shard state
+    stack = lambda builder, shape: np.stack([  # noqa: E731
+        np.stack([
+            np.stack([builder((i, j, k)) for k in range(shp[2])])
+            for j in range(shp[1])
+        ]) for i in range(shp[0])
+    ]).reshape(*shp, *shape)
 
-    @partial(jax.shard_map, mesh=dgrid.mesh, in_specs=(spec, spec), out_specs=spec)
-    def shard_geometry(c, m):
-        # Chunked (see ops.folded.blocked_G_traced): the per-shard G build
-        # must not peak at ~3x final-G — that was the capacity limit.
-        return blocked_G_traced(c[0, 0, 0], m[0, 0, 0], layout, t)[None, None, None]
+    np_dt = np.float32 if dtype == jnp.float32 else np.float64
+    from jax.sharding import NamedSharding, PartitionSpec as Pspec
 
-    G = shard_geometry(corners_d, mask_d)
+    sharding = NamedSharding(dgrid.mesh, Pspec(*AXIS_NAMES))
+
+    def put(a):
+        """Shard a stacked host array straight onto the device grid (never
+        staged whole on one device — the stacked state is Dx*Dy*Dz times
+        one chip's share)."""
+        return jax.device_put(a, sharding)
+
+    def corner_arrays(lay, corner_block):
+        """Blocked corner-mode geometry operands (host numpy, O(local))."""
+        ccs, mcs = ghost_corner_arrays(lay, corner_block)
+        cb, mb = blocked_corners(ccs, mcs, lay)
+        return cb.astype(np_dt), mb.astype(np_dt)
+
+    def build_G_sharded(lay, sub_axis=None):
+        """geom='g': per-shard G computed ON EACH SHARD'S OWN DEVICE inside
+        shard_map (chunked, ops.folded.blocked_G_traced) — neither the
+        host nor any single device ever holds the global G."""
+        from ..ops.folded import blocked_G_traced
+
+        ccs = np.empty((*shp, lay.lv, 2, 2, 2, 3))
+        mcs = np.empty((*shp, lay.lv))
+        for i in range(shp[0]):
+            for j in range(shp[1]):
+                for k in range(shp[2]):
+                    ccs[i, j, k], mcs[i, j, k] = ghost_corner_arrays(
+                        lay, shard_corner_block((i, j, k), sub_axis)
+                    )
+        ccs_d = put(np.asarray(ccs, np_dt))
+        mcs_d = put(np.asarray(mcs, np_dt))
+
+        @partial(jax.shard_map, mesh=dgrid.mesh,
+                 in_specs=(Pspec(*AXIS_NAMES), Pspec(*AXIS_NAMES)),
+                 out_specs=Pspec(*AXIS_NAMES))
+        def shard_geometry(c, m):
+            return blocked_G_traced(
+                c[0, 0, 0], m[0, 0, 0], lay, t
+            )[None, None, None]
+
+        return shard_geometry(ccs_d, mcs_d)
+
+    # main geometry
+    if geom == "corner":
+        parts = [corner_arrays(layout, shard_corner_block((i, j, k)))
+                 for i in range(shp[0]) for j in range(shp[1])
+                 for k in range(shp[2])]
+        corners_b = put(np.stack([p[0] for p in parts]).reshape(
+            *shp, *parts[0][0].shape))
+        cmask_b = put(np.stack([p[1] for p in parts]).reshape(
+            *shp, *parts[0][1].shape))
+        G_b = None
+    else:
+        G_b = build_G_sharded(layout)
+        corners_b = cmask_b = None
+
+    bcf = put(stack(
+        lambda pos: np.asarray(fold_vector(
+            _local_grid_marker(layout, pos, dshape, mesh.n).astype(
+                np.float64), layout)),
+        layout.vec_shape,
+    ).astype(np_dt))
+    owned = put(stack(
+        lambda pos: owned_folded_mask(layout, pos, dshape),
+        layout.vec_shape,
+    ))
+
+    # epilogue state per sharded axis: geometry + bc for the 2-column
+    # sub-layout (same for every shard along unsharded axes; stacked per
+    # shard so shard_map slices it uniformly)
+    epi = []
+    for ax in range(3):
+        if dshape[ax] == 1:
+            epi.append(None)
+            continue
+        slay = _epi_layout(layout, ax)
+
+        if geom == "corner":
+            parts = [corner_arrays(slay, shard_corner_block((i, j, k), ax))
+                     for i in range(shp[0]) for j in range(shp[1])
+                     for k in range(shp[2])]
+            ge = (put(np.stack([p[0] for p in parts]).reshape(
+                      *shp, *parts[0][0].shape)),
+                  put(np.stack([p[1] for p in parts]).reshape(
+                      *shp, *parts[0][1].shape)))
+        else:
+            ge = build_G_sharded(slay, sub_axis=ax)
+
+        def epi_bc(pos, ax=ax):
+            m = _local_grid_marker(layout, pos, dshape, mesh.n)
+            P = degree
+            lo = (layout.n[ax] - 1) * P
+            sl = [slice(None)] * 3
+            sl[ax] = slice(lo, lo + P + 1)
+            return np.asarray(fold_vector(m[tuple(sl)].astype(np.float64),
+                                          slay))
+
+        bce = put(stack(epi_bc, slay.vec_shape).astype(np_dt))
+        epi.append((ge, bce))
 
     return DistFoldedLaplacian(
-        G=G,
-        bc_mask=jax.device_put(jnp.asarray(bc_blocks), sharding),
-        owned=jax.device_put(jnp.asarray(owned_blocks), sharding),
+        G=G_b,
+        corners=corners_b,
+        cmask=cmask_b,
+        bc_mask=bcf,
+        owned=owned,
+        epi_geom=tuple(epi),
         kappa=jnp.asarray(kappa, dtype=dtype),
         n_local=tuple(ncl),
         degree=degree,
         nl=layout.nl,
         is_identity=t.is_identity,
+        dshape=tuple(dshape),
         phi0_c=freeze_table(t.phi0),
         dphi1_c=freeze_table(t.dphi1),
+        pts_c=tuple(float(v) for v in t.pts1d),
+        wts_c=tuple(float(v) for v in t.wts1d),
     )
+
+
+def shard_corner_cs(mesh: BoxMesh, dshape, layout: FoldedLayout):
+    """Stacked per-shard c-space corner/mask arrays for the device RHS:
+    ((Dx,Dy,Dz, Lv, 2,2,2,3), (Dx,Dy,Dz, Lv))."""
+    ncl = layout.n
+    ccs = np.empty((*dshape, layout.lv, 2, 2, 2, 3))
+    mcs = np.empty((*dshape, layout.lv))
+    for i in range(dshape[0]):
+        for j in range(dshape[1]):
+            for k in range(dshape[2]):
+                blk = mesh.cell_corners[
+                    i * ncl[0]:(i + 1) * ncl[0],
+                    j * ncl[1]:(j + 1) * ncl[1],
+                    k * ncl[2]:(k + 1) * ncl[2],
+                ]
+                ccs[i, j, k], mcs[i, j, k] = ghost_corner_arrays(layout, blk)
+    return ccs, mcs
 
 
 def make_folded_sharded_fns(op: DistFoldedLaplacian, dgrid, nreps: int):
     """Jittable sharded callables (apply, CG, norm) over folded shards —
-    mirrors dist.driver.make_sharded_fns."""
+    mirrors dist.driver.make_sharded_fns. The sharded per-shard arrays ride
+    as one pytree argument; the operator's replicated metadata rides via
+    closure."""
     from jax.sharding import PartitionSpec as P
 
     from ..la.cg import cg_solve
@@ -334,7 +605,7 @@ def make_folded_sharded_fns(op: DistFoldedLaplacian, dgrid, nreps: int):
     rep = P()
 
     def _local(a):
-        return a[0, 0, 0]
+        return jax.tree_util.tree_map(lambda x: x[0, 0, 0], a)
 
     def _dot(mask):
         def dot(u, v):
@@ -342,23 +613,31 @@ def make_folded_sharded_fns(op: DistFoldedLaplacian, dgrid, nreps: int):
 
         return dot
 
+    def sharded_state(A):
+        geom = A.G if A.G is not None else (A.corners, A.cmask)
+        # "not a true ghost" == owned under this ownership partition (pad
+        # slots are zero in every vector, so their mask value is moot)
+        nghost = A.owned.astype(A.bc_mask.dtype)
+        return (geom, A.bc_mask, nghost, A.epi_geom)
+
     # check_vma=False is *required* here, not a blanket waiver: every folded
-    # sharded computation runs the Pallas kernel (folded_cell_apply), whose
-    # pallas_call output carries no varying-mesh-axes annotation, and the
-    # default shard_map VMA check rejects exactly that. This mirrors
-    # dist/kron.py's scoped `check_vma = impl != "pallas"` — the folded path
-    # simply has no non-pallas impl to scope back to.
+    # sharded computation runs the Pallas kernel (folded_cell_apply_fused),
+    # whose pallas_call output carries no varying-mesh-axes annotation, and
+    # the default shard_map VMA check rejects exactly that. This mirrors
+    # dist/kron.py's scoped `check_vma = impl != "pallas"` — the folded
+    # path simply has no non-pallas impl to scope back to.
     @partial(jax.shard_map, mesh=dgrid.mesh,
-             in_specs=(spec, spec, spec), out_specs=spec, check_vma=False)
-    def apply_fn(x, G, bc):
-        return op.apply_local(_local(x), _local(G), _local(bc))[None, None, None]
+             in_specs=(spec, spec), out_specs=spec, check_vma=False)
+    def apply_fn(x, state):
+        return op.apply_local(_local(x), _local(state))[None, None, None]
 
     @partial(jax.shard_map, mesh=dgrid.mesh,
-             in_specs=(spec, spec, spec, spec), out_specs=spec, check_vma=False)
-    def cg_fn(b, G, bc, owned):
+             in_specs=(spec, spec, spec), out_specs=spec, check_vma=False)
+    def cg_fn(b, state, owned):
         bl = _local(b)
+        sl = _local(state)
         x = cg_solve(
-            lambda v: op.apply_local(v, _local(G), _local(bc)),
+            lambda v: op.apply_local(v, sl),
             bl,
             jnp.zeros_like(bl),
             nreps,
@@ -366,7 +645,8 @@ def make_folded_sharded_fns(op: DistFoldedLaplacian, dgrid, nreps: int):
         )
         return x[None, None, None]
 
-    @partial(jax.shard_map, mesh=dgrid.mesh, in_specs=(spec, spec), out_specs=rep)
+    @partial(jax.shard_map, mesh=dgrid.mesh, in_specs=(spec, spec),
+             out_specs=rep)
     def norm_fn(x, owned):
         """Global (L2, Linf) over owned dofs (psum / pmax)."""
         xl, ol = _local(x), _local(owned)
@@ -374,4 +654,31 @@ def make_folded_sharded_fns(op: DistFoldedLaplacian, dgrid, nreps: int):
             [jnp.sqrt(_dot(ol)(xl, xl)), masked_linf(xl, ol)]
         )
 
-    return apply_fn, cg_fn, norm_fn
+    return apply_fn, cg_fn, norm_fn, sharded_state
+
+
+def make_folded_rhs_fn(op: DistFoldedLaplacian, dgrid,
+                       t: OperatorTables, dtype):
+    """Jittable sharded RHS: per-shard device assembly (ops.folded_rhs)
+    from the shard's own corners, then the seam reverse-scatter so shared
+    planes hold the full sum and non-owned ghost slots are zero (the
+    distributed analogue of assemble + scatter_rev + bc.set,
+    laplacian_solver.cpp:100-105)."""
+    from jax.sharding import PartitionSpec as P
+
+    from ..ops.folded_rhs import device_rhs_folded
+
+    spec = P(*AXIS_NAMES)
+
+    @partial(jax.shard_map, mesh=dgrid.mesh,
+             in_specs=(spec, spec, spec), out_specs=spec)
+    def rhs_fn(ccs, mcs, bcf):
+        b = device_rhs_folded(
+            ccs[0, 0, 0], mcs[0, 0, 0], bcf[0, 0, 0], op.layout, t,
+            dtype=dtype,
+        )
+        b = folded_reverse_scatter(b, op.layout)
+        # bc rows again (seam sums may have re-populated shared bc rows)
+        return (b * (1 - bcf[0, 0, 0]))[None, None, None]
+
+    return rhs_fn

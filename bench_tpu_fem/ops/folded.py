@@ -355,12 +355,24 @@ def folded_cell_apply(
     )(xm, ux, uy, uz, uxy, uxz, uyz, uxyz, *geom_ops,
       kappa.reshape(1, 1).astype(dtype))
 
+    return xla_seam_fold(outs, layout)
+
+
+def xla_seam_fold(outs, layout: FoldedLayout) -> jnp.ndarray:
+    """XLA-side seam accumulation: the 8 per-cell contribution classes
+    (Y (P,P,P,Lv), faces Yx/Yy/Yz, edges Yxy/Yxz/Yyz, corner Yxyz — cells
+    last, flat c) overlap-added into one folded (nb, P^3, B) vector.
+
+    The i/j/k = P faces of each cell window coincide with the i/j/k = 0
+    slots of the +x/+y/+z neighbour (the structured replacement for
+    atomicAdd scatter). Everything is expressed as zero-pads + adds — XLA
+    fuses those into one elementwise pass, where the equivalent
+    .at[...].add chain costs a full-array copy per seam. Shared by the v1
+    reference apply and the device-side RHS assembly (ops.folded_rhs)."""
+    P = layout.degree
+    Lv, nb, B = layout.lv, layout.nblocks, layout.block
+    Sx, Sy, Sz = layout.shifts
     Y, Yx, Yy, Yz, Yxy, Yxz, Yyz, Yxyz = outs
-    # Seam accumulation: the i/j/k = P faces of each cell window coincide
-    # with the i/j/k = 0 slots of the +x/+y/+z neighbour (the structured
-    # replacement for atomicAdd scatter). Everything is expressed as
-    # zero-pads + adds — XLA fuses those into one elementwise pass, where
-    # the equivalent .at[...].add chain costs a full-array copy per seam.
 
     def shift(a, S):
         """a[..., c] -> contribution at c + S (front zero-pad)."""

@@ -14,9 +14,12 @@ import numpy as np
 
 
 def geometry_factors_jax(
-    corners: jnp.ndarray, pts1d: np.ndarray, wts1d: np.ndarray, dtype=None
-) -> tuple[jnp.ndarray, jnp.ndarray]:
+    corners: jnp.ndarray, pts1d: np.ndarray, wts1d: np.ndarray, dtype=None,
+    compute_G: bool = True,
+) -> tuple[jnp.ndarray | None, jnp.ndarray]:
     """corners: (ncells, 2, 2, 2, 3) -> (G (ncells,6,nq,nq,nq), wdetJ).
+    compute_G=False skips the stiffness tensor (returns (None, wdetJ)) —
+    the mass/RHS path needs only w*detJ.
 
     Computation is carried out in the dtype of `corners` (float64 host mesh
     data should be cast by the caller for f32 runs *after* this computes, or
@@ -41,16 +44,19 @@ def geometry_factors_jax(
         )
         for a in range(3)
     ]  # J columns: dx/dxi_a at (nq,nq,nq) points
-    K = [
-        jnp.cross(cols[1], cols[2]),
-        jnp.cross(cols[2], cols[0]),
-        jnp.cross(cols[0], cols[1]),
-    ]  # adjugate rows
-    detJ = jnp.einsum("...i,...i->...", cols[0], K[0])
+    K0 = jnp.cross(cols[1], cols[2])
+    detJ = jnp.einsum("...i,...i->...", cols[0], K0)
     w = np.asarray(wts1d)
     w3 = jnp.asarray(
         w[:, None, None] * w[None, :, None] * w[None, None, :], dtype=rdtype
     )
+    if not compute_G:
+        return None, w3[None] * detJ
+    K = [
+        K0,
+        jnp.cross(cols[2], cols[0]),
+        jnp.cross(cols[0], cols[1]),
+    ]  # adjugate rows
     scale = w3[None] / detJ
     pairs = [(0, 0), (0, 1), (0, 2), (1, 1), (1, 2), (2, 2)]
     G = jnp.stack(

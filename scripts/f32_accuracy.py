@@ -1,0 +1,86 @@
+#!/usr/bin/env python
+"""f32-vs-f64 CG accuracy evidence at benchmark scale (SURVEY §7 hard part
+1): the reference's headline configs are f64; TPUs only emulate f64, so the
+flagship benchmark numbers here are f32. This artifact quantifies what that
+costs in solution quality: run the SAME fixed-iteration CG (rtol = 0,
+cg.hpp:88-91 semantics) in f32 and in emulated f64 on the same problem and
+report final residual and solution-norm deltas.
+
+The problem size is chosen so the f64 run is tractable (~80x slower than
+f32); the iteration count matches the benchmark's 1000. Writes JSON:
+
+    python scripts/f32_accuracy.py [out.json] [ndofs] [nreps]
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def run(float_bits: int, ndofs: int, nreps: int):
+    import jax
+
+    if float_bits == 64:
+        jax.config.update("jax_enable_x64", True)
+    import jax.numpy as jnp
+    import numpy as np
+
+    from bench_tpu_fem.elements import build_operator_tables
+    from bench_tpu_fem.la.cg import cg_solve
+    from bench_tpu_fem.mesh.box import create_box_mesh
+    from bench_tpu_fem.mesh.sizing import compute_mesh_size
+    from bench_tpu_fem.ops.kron import build_kron_laplacian, device_rhs_uniform
+
+    dtype = jnp.float64 if float_bits == 64 else jnp.float32
+    degree, qmode = 3, 1
+    n = compute_mesh_size(ndofs, degree)
+    mesh = create_box_mesh(n)
+    t = build_operator_tables(degree, qmode)
+    op = build_kron_laplacian(mesh, degree, qmode, dtype=dtype, tables=t)
+    b = jax.jit(lambda: device_rhs_uniform(t, mesh.n, dtype))()
+
+    x = jax.jit(
+        lambda A, b: cg_solve(A.apply, b, jnp.zeros_like(b), nreps)
+    )(op, b)
+    x.block_until_ready()
+    r = b - jax.jit(op.apply)(x)
+    return {
+        "x": np.asarray(x, np.float64),
+        "xnorm": float(jnp.linalg.norm(x)),
+        "rnorm": float(jnp.linalg.norm(r)),
+        "bnorm": float(jnp.linalg.norm(b)),
+    }
+
+
+def main() -> int:
+    out_path = sys.argv[1] if len(sys.argv) > 1 else "F32_ACCURACY.json"
+    ndofs = int(sys.argv[2]) if len(sys.argv) > 2 else 2_000_000
+    nreps = int(sys.argv[3]) if len(sys.argv) > 3 else 1000
+
+    import numpy as np
+
+    r32 = run(32, ndofs, nreps)
+    r64 = run(64, ndofs, nreps)
+    dx = np.linalg.norm(r32["x"] - r64["x"]) / np.linalg.norm(r64["x"])
+    doc = {
+        "config": {"degree": 3, "qmode": 1, "cg_nreps": nreps,
+                   "ndofs": ndofs, "backend": "kron (uniform flagship)"},
+        "f32": {k: v for k, v in r32.items() if k != "x"},
+        "f64": {k: v for k, v in r64.items() if k != "x"},
+        "solution_rel_l2_diff_f32_vs_f64": float(dx),
+        "solution_norm_rel_diff": float(
+            abs(r32["xnorm"] - r64["xnorm"]) / r64["xnorm"]
+        ),
+        "final_rel_residual_f32": r32["rnorm"] / r32["bnorm"],
+        "final_rel_residual_f64": r64["rnorm"] / r64["bnorm"],
+    }
+    with open(out_path, "w") as fh:
+        json.dump(doc, fh, indent=1)
+    print(json.dumps(doc, indent=1))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,5 +1,8 @@
 """Distributed folded-layout operator vs the global single-device reference,
-on the 8-virtual-CPU-device mesh (conftest)."""
+on the 8-virtual-CPU-device mesh (conftest). Also asserts the structural
+comm/compute overlap property: the main fused kernel has no data dependency
+on the halo collectives (mirroring tests/test_dist_kron.py's checks), and
+the collectives lower to collective-permute, not all-gather."""
 
 import jax
 import jax.numpy as jnp
@@ -8,12 +11,13 @@ import pytest
 
 from bench_tpu_fem.dist.folded import (
     build_dist_folded,
+    make_folded_rhs_fn,
     make_folded_sharded_fns,
+    shard_corner_cs,
     shard_folded_vectors,
     unshard_folded_vectors,
 )
 from bench_tpu_fem.dist.mesh import make_device_grid
-from bench_tpu_fem.elements import build_operator_tables
 from bench_tpu_fem.la.cg import cg_solve
 from bench_tpu_fem.mesh import create_box_mesh, dof_grid_shape
 from bench_tpu_fem.ops import build_laplacian
@@ -32,29 +36,40 @@ def _global_reference(mesh, degree, qmode, x, nreps=None):
     )
 
 
-@pytest.mark.parametrize("dshape,degree", [((2, 2, 2), 3), ((2, 2, 1), 2)])
-def test_dist_folded_apply_matches_global(dshape, degree):
+def _sharded_vec(x, n, degree, dshape, dgrid, layout):
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from bench_tpu_fem.dist.mesh import AXIS_NAMES
+
+    sharding = NamedSharding(dgrid.mesh, P(*AXIS_NAMES))
+    return jax.device_put(
+        jnp.asarray(shard_folded_vectors(x, n, degree, dshape, layout)),
+        sharding,
+    )
+
+
+@pytest.mark.parametrize(
+    "dshape,degree,geom",
+    [((2, 2, 2), 3, "corner"), ((2, 2, 1), 2, "corner"), ((2, 2, 2), 3, "g"),
+     ((4, 1, 1), 2, "corner"), ((1, 2, 2), 3, "corner")],
+)
+def test_dist_folded_apply_matches_global(dshape, degree, geom):
     qmode = 1
     dgrid = make_device_grid(dshape=dshape)
     n = tuple(2 * d for d in dshape)
     mesh = create_box_mesh(n, geom_perturb_fact=0.15)
+    from bench_tpu_fem.elements import build_operator_tables
+
     t = build_operator_tables(degree, qmode)
-    op = build_dist_folded(mesh, dgrid, degree, t, dtype=jnp.float32, nl=16)
+    op = build_dist_folded(mesh, dgrid, degree, t, dtype=jnp.float32, nl=16,
+                           geom=geom)
 
     rng = np.random.RandomState(0)
     x = rng.randn(*dof_grid_shape(n, degree)).astype(np.float32)
     y_ref = _global_reference(mesh, degree, qmode, x)
 
-    from jax.sharding import NamedSharding, PartitionSpec as P
-    from bench_tpu_fem.dist.mesh import AXIS_NAMES
-
-    sharding = NamedSharding(dgrid.mesh, P(*AXIS_NAMES))
-    xb = jax.device_put(
-        jnp.asarray(shard_folded_vectors(x, n, degree, dshape, op.layout)),
-        sharding,
-    )
-    apply_fn, _, _ = make_folded_sharded_fns(op, dgrid, nreps=1)
-    yb = np.asarray(jax.jit(apply_fn)(xb, op.G, op.bc_mask))
+    xb = _sharded_vec(x, n, degree, dshape, dgrid, op.layout)
+    apply_fn, _, _, sharded_state = make_folded_sharded_fns(op, dgrid, 1)
+    yb = np.asarray(jax.jit(apply_fn)(xb, sharded_state(op)))
     y = unshard_folded_vectors(yb, n, degree, dshape, op.layout)
     scale = np.abs(y_ref).max()
     np.testing.assert_allclose(y, y_ref, atol=5e-5 * scale)
@@ -65,28 +80,142 @@ def test_dist_folded_cg_and_norm_match_global():
     dgrid = make_device_grid(dshape=dshape)
     n = (4, 4, 4)
     mesh = create_box_mesh(n, geom_perturb_fact=0.1)
+    from bench_tpu_fem.elements import build_operator_tables
+
     t = build_operator_tables(degree, qmode)
     op = build_dist_folded(mesh, dgrid, degree, t, dtype=jnp.float32, nl=16)
 
     rng = np.random.RandomState(5)
     b = rng.randn(*dof_grid_shape(n, degree)).astype(np.float32)
-    op_ref = build_laplacian(mesh, degree, qmode, dtype=jnp.float32, backend="xla")
+    op_ref = build_laplacian(mesh, degree, qmode, dtype=jnp.float32,
+                             backend="xla")
     b[np.asarray(op_ref.bc_mask)] = 0.0
     x_ref = _global_reference(mesh, degree, qmode, b, nreps=5)
+
+    bb = _sharded_vec(b, n, degree, dshape, dgrid, op.layout)
+    _, cg_fn, norm_fn, sharded_state = make_folded_sharded_fns(op, dgrid, 5)
+    xb = np.asarray(jax.jit(cg_fn)(bb, sharded_state(op), op.owned))
+    x = unshard_folded_vectors(xb, n, degree, dshape, op.layout)
+    scale = np.abs(x_ref).max()
+    np.testing.assert_allclose(x, x_ref, atol=2e-4 * scale)
+
+    nrms = np.asarray(jax.jit(norm_fn)(bb, op.owned))
+    np.testing.assert_allclose(float(nrms[0]), np.linalg.norm(b), rtol=1e-5)
+    np.testing.assert_allclose(float(nrms[1]), np.abs(b).max(), rtol=1e-6)
+
+
+def test_dist_folded_device_rhs_matches_host():
+    """Per-shard device RHS + seam reverse-scatter == host-assembled RHS
+    sharded (the O(global-dof)-free setup path)."""
+    dshape, degree, qmode = (2, 2, 2), 3, 1
+    dgrid = make_device_grid(dshape=dshape)
+    n = (4, 4, 4)
+    mesh = create_box_mesh(n, geom_perturb_fact=0.2)
+    from bench_tpu_fem.elements import build_operator_tables
+    from bench_tpu_fem.fem.assemble import assemble_rhs
+    from bench_tpu_fem.fem.geometry import geometry_factors
+    from bench_tpu_fem.fem.source import default_source
+    from bench_tpu_fem.mesh.dofmap import (
+        boundary_dof_marker,
+        cell_dofmap,
+        dof_coordinates,
+    )
+
+    t = build_operator_tables(degree, qmode)
+    op = build_dist_folded(mesh, dgrid, degree, t, dtype=jnp.float32, nl=16)
+
+    coords = dof_coordinates(mesh.vertices, degree, t.nodes1d)
+    f = default_source(coords).ravel()
+    _, wdetJ = geometry_factors(
+        mesh.cell_corners.reshape(-1, 2, 2, 2, 3), t.pts1d, t.wts1d,
+        compute_G=False,
+    )
+    bc = boundary_dof_marker(n, degree)
+    b_host = assemble_rhs(t, wdetJ, cell_dofmap(n, degree), f,
+                          bc.ravel()).reshape(dof_grid_shape(n, degree))
 
     from jax.sharding import NamedSharding, PartitionSpec as P
     from bench_tpu_fem.dist.mesh import AXIS_NAMES
 
     sharding = NamedSharding(dgrid.mesh, P(*AXIS_NAMES))
-    bb = jax.device_put(
-        jnp.asarray(shard_folded_vectors(b, n, degree, dshape, op.layout)),
-        sharding,
-    )
-    _, cg_fn, norm_fn = make_folded_sharded_fns(op, dgrid, nreps=5)
-    xb = np.asarray(jax.jit(cg_fn)(bb, op.G, op.bc_mask, op.owned))
-    x = unshard_folded_vectors(xb, n, degree, dshape, op.layout)
-    scale = np.abs(x_ref).max()
-    np.testing.assert_allclose(x, x_ref, atol=2e-4 * scale)
+    ccs, mcs = shard_corner_cs(mesh, dshape, op.layout)
+    rhs_fn = make_folded_rhs_fn(op, dgrid, t, jnp.float32)
+    bb = np.asarray(jax.jit(rhs_fn)(
+        jax.device_put(jnp.asarray(ccs, jnp.float32), sharding),
+        jax.device_put(jnp.asarray(mcs, jnp.float32), sharding),
+        op.bc_mask,
+    ))
+    b = unshard_folded_vectors(bb, n, degree, dshape, op.layout)
+    scale = np.abs(b_host).max()
+    np.testing.assert_allclose(b, b_host, atol=2e-6 * scale)
 
-    nrm = float(jax.jit(norm_fn)(bb, op.owned)[0])
-    np.testing.assert_allclose(nrm, np.linalg.norm(b), rtol=1e-5)
+
+def test_dist_folded_main_kernel_independent_of_collectives():
+    """The overlap property as DATAFLOW (mirrors test_dist_kron.py): in the
+    jaxpr of one distributed apply, the main fused pallas_call must not
+    (transitively) depend on any ppermute — only the epilogues and the
+    reverse scatter may. Also: the lowered HLO communicates via
+    collective-permute, never all-gather."""
+    dshape, degree, qmode = (2, 2, 2), 3, 1
+    dgrid = make_device_grid(dshape=dshape)
+    n = (4, 4, 4)
+    mesh = create_box_mesh(n, geom_perturb_fact=0.1)
+    from bench_tpu_fem.elements import build_operator_tables
+
+    t = build_operator_tables(degree, qmode)
+    op = build_dist_folded(mesh, dgrid, degree, t, dtype=jnp.float32, nl=16)
+    apply_fn, _, _, sharded_state = make_folded_sharded_fns(op, dgrid, 1)
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(*dof_grid_shape(n, degree)).astype(np.float32)
+    xb = _sharded_vec(x, n, degree, dshape, dgrid, op.layout)
+    state = sharded_state(op)
+
+    jaxpr = jax.make_jaxpr(apply_fn)(xb, state)
+
+    # walk the shard_map body: find pallas_call eqns and ppermute eqns,
+    # then check transitive dependencies of the LARGEST pallas_call (the
+    # main full-volume kernel) against every ppermute output.
+    def body_of(jx):
+        for eqn in jx.eqns:
+            if "shard_map" in str(eqn.primitive):
+                return eqn.params["jaxpr"]
+        return None
+
+    body = body_of(jaxpr.jaxpr)
+    assert body is not None
+    producers = {}
+    for eqn in body.eqns:
+        for out in eqn.outvars:
+            producers[out] = eqn
+
+    def depends_on_ppermute(eqn, seen=None):
+        seen = seen if seen is not None else set()
+        if id(eqn) in seen:
+            return False
+        seen.add(id(eqn))
+        if eqn.primitive.name == "ppermute":
+            return True
+        for v in eqn.invars:
+            try:
+                p = producers.get(v)
+            except TypeError:  # Literal operands are unhashable
+                continue
+            if p is not None and depends_on_ppermute(p, seen):
+                return True
+        return False
+
+    pallas_eqns = [e for e in body.eqns if e.primitive.name == "pallas_call"]
+    assert pallas_eqns, "no pallas_call in the distributed apply"
+    # main kernel = the pallas_call with the largest output
+    main = max(pallas_eqns,
+               key=lambda e: int(np.prod(e.outvars[0].aval.shape)))
+    assert not depends_on_ppermute(main), (
+        "main fused kernel depends on a halo collective — overlap broken"
+    )
+    # and at least one ppermute must exist (the halo itself)
+    assert any(e.primitive.name == "ppermute" for e in body.eqns)
+
+    hlo = jax.jit(apply_fn).lower(xb, state).compile().as_text()
+    assert "all-gather" not in hlo
+    assert "collective-permute" in hlo

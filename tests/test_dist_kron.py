@@ -141,13 +141,21 @@ def test_dist_kron_rhs_matches_host_assembly():
     np.testing.assert_allclose(b, blocks_ref, atol=1e-12 * np.abs(b_host).max())
 
 
-def test_dist_kron_pallas_interpret_matches_xla():
+@pytest.mark.parametrize(
+    "dshape,degree",
+    [
+        ((2, 2, 1), 3),
+        ((2, 2, 2), 3),  # all three axes sharded through the Pallas stages
+        ((2, 2, 1), 5),  # high degree: wide bands, larger edge epilogues
+        ((2, 1, 1), 7),  # max degree: the full 2P+1 = 15-wide stencil
+    ],
+)
+def test_dist_kron_pallas_interpret_matches_xla(dshape, degree):
     """The sharded Pallas impl (interpret mode on CPU) agrees with the
     sharded XLA impl — covers the halo + edge-correction composition with
-    the real flagship kernels."""
-    dshape, degree = (2, 2, 1), 3
+    the real flagship kernels, through the highest supported degree."""
     dgrid = make_device_grid(dshape=dshape)
-    n = (4, 4, 2)
+    n = tuple(2 * d for d in dshape)
     op_x = build_dist_kron(n, dgrid, degree, 1, dtype=jnp.float32, impl="xla")
     op_p = build_dist_kron(n, dgrid, degree, 1, dtype=jnp.float32, impl="pallas")
     rng = np.random.RandomState(11)
@@ -157,7 +165,31 @@ def test_dist_kron_pallas_interpret_matches_xla():
     ap, _, _ = make_kron_sharded_fns(op_p, dgrid, nreps=1)
     yx = np.asarray(jax.jit(ax)(xb, op_x))
     yp = np.asarray(jax.jit(ap)(xb, op_p))
-    np.testing.assert_allclose(yp, yx, atol=2e-5 * np.abs(yx).max())
+    np.testing.assert_allclose(yp, yx, atol=4e-5 * np.abs(yx).max())
+
+
+def test_dist_kron_edge_rows_compile_size_sane_at_degree7():
+    """_edge_rows Python-unrolls O(P*(2P+1)) sliced terms per side per axis;
+    at P = 7 that is ~105 terms per stage. Guard that the traced program
+    stays bounded: the optimized sharded-apply HLO must stay under a sane
+    size and trace+lower must complete quickly (catches accidental
+    quadratic blowups in the unrolling)."""
+    import time
+
+    dshape, degree = (2, 1, 1), 7
+    dgrid = make_device_grid(dshape=dshape)
+    n = (4, 2, 2)
+    op = build_dist_kron(n, dgrid, degree, 1, dtype=jnp.float64)
+    rng = np.random.RandomState(0)
+    x = rng.randn(*dof_grid_shape(n, degree))
+    xb = _sharded_blocks(x, n, degree, dgrid)
+    apply_fn, _, _ = make_kron_sharded_fns(op, dgrid, nreps=1)
+    t0 = time.perf_counter()
+    lowered = jax.jit(apply_fn).lower(xb, op)
+    trace_s = time.perf_counter() - t0
+    assert trace_s < 60.0, f"degree-7 trace+lower took {trace_s:.1f}s"
+    n_eqns = len(lowered.as_text().splitlines())
+    assert n_eqns < 60_000, f"degree-7 sharded apply lowers to {n_eqns} lines"
 
 
 def test_dist_kron_single_cell_unsharded_axis():
@@ -216,20 +248,22 @@ def test_dist_kron_e2e_driver_mat_comp():
 
 def test_dist_kron_e2e_driver_cg_matches_single_device():
     """Distributed CG through the driver (device-side per-shard RHS, no
-    host O(global) arrays) reproduces the single-device kron CG result."""
+    host O(global) arrays) reproduces the single-device kron CG result.
+    The requested size is a (4, 4, 4)-cell cube's exact dof count, which
+    both the serial and the sharded mesh sizing provably select (the
+    sharded (2,2,2) grid's >=2-cells-per-shard constraint is met by the
+    exact match), so the norm comparison always runs — asserted, not
+    hedged."""
     from bench_tpu_fem.bench.driver import BenchConfig, run_benchmark
 
-    common = dict(ndofs_global=8000, degree=3, qmode=1, nreps=3, use_cg=True,
-                  float_bits=64)
+    common = dict(ndofs_global=13 ** 3, degree=3, qmode=1, nreps=3,
+                  use_cg=True, float_bits=64)
     res_d = run_benchmark(BenchConfig(ndevices=8, **common))
     assert res_d.extra["backend"] == "kron"
     res_1 = run_benchmark(BenchConfig(ndevices=1, **common))
-    # Different device counts pick different mesh sizes only if the sharded
-    # sizing constraint binds; with 8000 dofs and an (2,2,2) grid it doesn't
-    # have to match exactly — compare norms only when meshes agree.
-    if res_d.ndofs_global == res_1.ndofs_global:
-        np.testing.assert_allclose(res_d.ynorm, res_1.ynorm, rtol=1e-10)
-        np.testing.assert_allclose(res_d.unorm, res_1.unorm, rtol=1e-10)
+    assert res_d.ndofs_global == res_1.ndofs_global == 13 ** 3
+    np.testing.assert_allclose(res_d.ynorm, res_1.ynorm, rtol=1e-10)
+    np.testing.assert_allclose(res_d.unorm, res_1.unorm, rtol=1e-10)
     assert np.isfinite(res_d.ynorm) and res_d.ynorm > 0
 
 
