@@ -131,13 +131,17 @@ def _setup_problem(cfg: BenchConfig, n: tuple[int, int, int] | None = None):
     return n, rule, t, mesh, grid_shape, bc_grid, dm, b, G_host
 
 
-def resolve_backend(backend: str, float_bits: int, uniform: bool = False) -> str:
+def resolve_backend(backend: str, float_bits: int, uniform: bool = False,
+                    degree: int = 3) -> str:
     """'auto' backend resolution:
 
     - uniform (unperturbed) mesh -> 'kron': the exact Kronecker-sum fast
       path (ops.kron), any dtype — no geometry tensor, ~2x the folded
       kernel's CG rate;
-    - perturbed mesh, f32 on TPU -> 'pallas' (the folded general kernel);
+    - perturbed mesh, f32 on TPU, degree <= 4 -> 'pallas' (the folded
+      general kernel). Degrees >= 5 exceed the Mosaic VMEM budget at the
+      kernel's fixed 128-lane block width (nq^3 intermediates scale as
+      degree^3) and fall back to 'xla';
     - otherwise 'xla' (einsum path; Mosaic has no f64, CPU runs use einsum,
       interpret-mode Pallas is for tests).
     """
@@ -147,7 +151,8 @@ def resolve_backend(backend: str, float_bits: int, uniform: bool = False) -> str
         return backend
     if uniform:
         return "kron"
-    if float_bits == 32 and jax.default_backend() == "tpu":
+    if (float_bits == 32 and jax.default_backend() == "tpu"
+            and degree <= 4):
         return "pallas"
     return "xla"
 
@@ -169,7 +174,8 @@ def run_benchmark(cfg: BenchConfig) -> BenchmarkResults:
         return run_distributed(cfg, res, dtype)
 
     n, rule, t, mesh = _mesh_setup(cfg)
-    backend = resolve_backend(cfg.backend, cfg.float_bits, uniform=mesh.is_uniform)
+    backend = resolve_backend(cfg.backend, cfg.float_bits,
+                              uniform=mesh.is_uniform, degree=cfg.degree)
     ndofs_global = int(np.prod(dof_grid_shape(n, cfg.degree)))
     res = BenchmarkResults(
         ncells_global=mesh.ncells, ndofs_global=ndofs_global, nreps=cfg.nreps

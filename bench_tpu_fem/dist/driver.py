@@ -94,7 +94,8 @@ def run_distributed(cfg, res, dtype):
     from ..mesh.dofmap import dof_grid_shape
 
     backend = resolve_backend(
-        cfg.backend, cfg.float_bits, uniform=cfg.geom_perturb_fact == 0.0
+        cfg.backend, cfg.float_bits,
+        uniform=cfg.geom_perturb_fact == 0.0, degree=cfg.degree,
     )
     res.extra["backend"] = backend
     kron = backend == "kron"

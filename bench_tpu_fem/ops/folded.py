@@ -880,6 +880,20 @@ def build_folded_laplacian(
 
     if geom not in ("auto", "corner", "g"):
         raise ValueError(f"unknown geom mode {geom!r}")
+    import jax
+
+    if degree > 4 and jax.default_backend() == "tpu":
+        # Ops-layer guard (the kron/perturbed guard's sibling): the fused
+        # kernels' nq^3 VMEM intermediates at the fixed 128-lane block
+        # width exceed the Mosaic budget beyond degree 4 — Mosaic would
+        # die later with an opaque VMEM stack error. resolve_backend's
+        # auto mode already routes these to 'xla'; this catches explicit
+        # --backend pallas requests. (CPU interpret-mode tests run all
+        # degrees.)
+        raise ValueError(
+            "the folded Pallas path supports degree <= 4 on TPU (VMEM "
+            "budget); use the xla backend for higher degrees"
+        )
     t = tables or build_operator_tables(degree, qmode, rule)
     layout = make_layout(mesh.n, degree, t.nq, np.dtype(dtype).itemsize, nl=nl)
     if geom == "auto":

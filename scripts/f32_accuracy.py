@@ -20,6 +20,11 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def run(float_bits: int, ndofs: int, nreps: int):
+    # Hermetic CPU runs must undo the axon tunnel hook (see utils.hermetic)
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        from bench_tpu_fem.utils.hermetic import force_host_cpu_devices
+
+        force_host_cpu_devices(1)
     import jax
 
     if float_bits == 64:
