@@ -98,11 +98,14 @@ def _mesh_setup(cfg: BenchConfig, n: tuple[int, int, int] | None = None):
     return n, rule, t, mesh
 
 
-def _setup_problem(cfg: BenchConfig, n: tuple[int, int, int] | None = None):
+def _setup_problem(cfg: BenchConfig, n: tuple[int, int, int] | None = None,
+                   prebuilt=None):
     """Shared host-side setup: mesh, tables, RHS (the oracle-precision f64
     path, as the reference assembles its RHS on the CPU). The host geometry
-    tensor G is only materialised when the mat_comp oracle needs it."""
-    n, rule, t, mesh = _mesh_setup(cfg, n)
+    tensor G is only materialised when the mat_comp oracle needs it.
+    `prebuilt` forwards an existing (n, rule, t, mesh) so callers that
+    already ran _mesh_setup don't rebuild the mesh and tables."""
+    n, rule, t, mesh = prebuilt if prebuilt is not None else _mesh_setup(cfg, n)
     grid_shape = dof_grid_shape(n, cfg.degree)
     bc_grid = boundary_dof_marker(n, cfg.degree)
 
@@ -132,16 +135,15 @@ def _setup_problem(cfg: BenchConfig, n: tuple[int, int, int] | None = None):
 
 
 def resolve_backend(backend: str, float_bits: int, uniform: bool = False,
-                    degree: int = 3) -> str:
+                    degree: int = 3, qmode: int = 1) -> str:
     """'auto' backend resolution:
 
     - uniform (unperturbed) mesh -> 'kron': the exact Kronecker-sum fast
       path (ops.kron), any dtype — no geometry tensor, ~2x the folded
       kernel's CG rate;
-    - perturbed mesh, f32 on TPU, degree <= 4 -> 'pallas' (the folded
-      general kernel). Degrees >= 5 exceed the Mosaic VMEM budget at the
-      kernel's fixed 128-lane block width (nq^3 intermediates scale as
-      degree^3) and fall back to 'xla';
+    - perturbed mesh, f32 on TPU, if the folded kernels fit full 128-lane
+      blocks (pick_lanes == 128; the nq^3 VMEM intermediates scale as
+      degree^3) -> 'pallas' (the folded general kernel);
     - otherwise 'xla' (einsum path; Mosaic has no f64, CPU runs use einsum,
       interpret-mode Pallas is for tests).
     """
@@ -151,9 +153,12 @@ def resolve_backend(backend: str, float_bits: int, uniform: bool = False,
         return backend
     if uniform:
         return "kron"
-    if (float_bits == 32 and jax.default_backend() == "tpu"
-            and degree <= 4):
-        return "pallas"
+    if float_bits == 32 and jax.default_backend() == "tpu":
+        from ..ops.pallas_laplacian import pick_lanes
+
+        nq = degree + qmode + 1
+        if pick_lanes(degree + 1, nq, 4) == 128:
+            return "pallas"
     return "xla"
 
 
@@ -175,7 +180,8 @@ def run_benchmark(cfg: BenchConfig) -> BenchmarkResults:
 
     n, rule, t, mesh = _mesh_setup(cfg)
     backend = resolve_backend(cfg.backend, cfg.float_bits,
-                              uniform=mesh.is_uniform, degree=cfg.degree)
+                              uniform=mesh.is_uniform, degree=cfg.degree,
+                              qmode=cfg.qmode)
     ndofs_global = int(np.prod(dof_grid_shape(n, cfg.degree)))
     res = BenchmarkResults(
         ncells_global=mesh.ncells, ndofs_global=ndofs_global, nreps=cfg.nreps
@@ -189,9 +195,10 @@ def run_benchmark(cfg: BenchConfig) -> BenchmarkResults:
     device_setup = backend in ("kron", "pallas") and not cfg.mat_comp
     if not device_setup:
         # Host-side RHS/oracle setup (O(ndofs) host arrays; needed by the
-        # mat_comp oracle and the general-geometry backends).
+        # mat_comp oracle and the general-geometry backends). Forward the
+        # mesh/tables already built above — no duplicate setup.
         _, _, _, _, grid_shape, bc_grid, dm, b_host, G_host = _setup_problem(
-            cfg, n
+            cfg, n, prebuilt=(n, rule, t, mesh)
         )
 
     folded = backend == "pallas"
@@ -301,6 +308,10 @@ def run_benchmark(cfg: BenchConfig) -> BenchmarkResults:
             warm = fn(op, u)
         # One warm-up execution (fenced): first execution pays one-time
         # transfer/initialisation costs that are not operator throughput.
+        # It runs the full nreps computation because a cheaper 1-rep
+        # warm-up would need a second full compile (tens of seconds) to
+        # save a few seconds of device time — net slower at every
+        # benchmark size we run.
         float(warm[(0,) * warm.ndim])
         del warm
 

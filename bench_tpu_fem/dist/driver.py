@@ -96,6 +96,7 @@ def run_distributed(cfg, res, dtype):
     backend = resolve_backend(
         cfg.backend, cfg.float_bits,
         uniform=cfg.geom_perturb_fact == 0.0, degree=cfg.degree,
+        qmode=cfg.qmode,
     )
     res.extra["backend"] = backend
     kron = backend == "kron"
@@ -229,6 +230,11 @@ def run_distributed(cfg, res, dtype):
             ).lower(u, *apply_args).compile()
             run_args = apply_args
         norm_c = jax.jit(norm_fn).lower(u, *norm_args).compile()
+        # Warm-up executes the full compiled computation once: the first
+        # execution pays program-load/buffer-init costs that are not
+        # operator throughput. A cheaper 1-rep warm-up would need a SECOND
+        # full compile of the CG loop (tens of seconds) to save a few
+        # seconds of device time — net slower at every size we run.
         warm = fn(u, *run_args)
         float(warm[(0,) * warm.ndim])
         del warm

@@ -56,7 +56,9 @@ from ..elements.tables import OperatorTables
 from ..mesh.box import BoxMesh
 from ..ops.folded import (
     FoldedLayout,
+    auto_geom,
     blocked_corners,
+    check_tpu_lane_support,
     fold_vector,
     folded_cell_apply_fused,
     ghost_corner_arrays,
@@ -413,20 +415,26 @@ def build_dist_folded(
     kappa: float = 2.0,
     dtype=jnp.float32,
     nl: int | None = None,
-    geom: str = "corner",
+    geom: str = "auto",
 ) -> DistFoldedLaplacian:
     """Build stacked per-shard folded state. All masks are O(local) closed
-    form from the shard position; geometry ships as per-shard corner slices
-    (geom='corner', default — G computed in-kernel) or is precomputed per
-    shard on device (geom='g'). The only O(global) host touch is slicing
+    form from the shard position; geometry is precomputed per shard on
+    device (geom='g' — the faster apply, chosen by 'auto' (default) while
+    the per-shard tensor fits HBM) or ships as per-shard corner slices
+    with G computed in-kernel (geom='corner' — the capacity mode). The only O(global) host touch is slicing
     the mesh's corner array (O(ncells), same order as the reference's mesh
     build, mesh.cpp:190-218)."""
     t = tables
     dshape = dgrid.dshape
     ncl = shard_cells(mesh.n, dshape)
     layout = make_layout(ncl, degree, t.nq, np.dtype(dtype).itemsize, nl=nl)
-    if geom not in ("corner", "g"):
+    if geom not in ("auto", "corner", "g"):
         raise ValueError(f"unknown geom mode {geom!r}")
+    if geom == "auto":
+        # Shared policy with the single-chip builder, applied to the
+        # PER-SHARD layout: G while it fits, corner mode for capacity.
+        geom = auto_geom(layout, t.nq, dtype)
+    check_tpu_lane_support(layout, degree, t.qmode)
 
     corners_all = mesh.cell_corners  # (nx, ny, nz, 2,2,2,3)
 

@@ -751,6 +751,39 @@ class FoldedLaplacian:
         return self._fused(x)
 
 
+def auto_geom(layout: FoldedLayout, nq: int, dtype) -> str:
+    """geom='auto' policy, shared by the single-chip and distributed
+    builders: precomputed G is the faster apply (the corner path trades
+    ~2x FLOPs for ~30x less geometry traffic, and the kernel is compute-
+    bound when G streams from HBM at full bandwidth) — but G costs 6*nq^3
+    values/cell of HBM. Use it while it fits comfortably (<= 6 GB for the
+    local layout), else corner mode, which scales to the same problem
+    sizes as the uniform fast path."""
+    g_bytes = layout.lv * 6 * nq ** 3 * np.dtype(dtype).itemsize
+    return "g" if g_bytes <= 6e9 else "corner"
+
+
+def check_tpu_lane_support(layout: FoldedLayout, degree: int,
+                           qmode: int) -> None:
+    """Ops-layer guard (the kron/perturbed guard's sibling), shared by the
+    single-chip and distributed builders: when the per-cell VMEM working
+    set forces pick_lanes below a full 128-lane block (degree 4 qmode 1
+    and up), the kernels' narrow (..., 8, nl<128) relayout is unsupported
+    by Mosaic and the compile dies with an opaque shape-cast error.
+    resolve_backend's auto mode routes these to 'xla'; this catches
+    explicit --backend pallas requests, including explicitly-passed small
+    nl. (CPU interpret-mode tests run all degrees — the backend check
+    excludes them.)"""
+    import jax
+
+    if layout.nl < 128 and jax.default_backend() == "tpu":
+        raise ValueError(
+            f"the folded Pallas path needs full 128-lane blocks on TPU; "
+            f"degree {degree} qmode {qmode} would need nl={layout.nl} — "
+            f"use the xla backend for this configuration"
+        )
+
+
 _BUILD_CHUNK_BLOCKS = 64  # cells per geometry-build chunk = 64 * block
 
 
@@ -882,29 +915,11 @@ def build_folded_laplacian(
         raise ValueError(f"unknown geom mode {geom!r}")
     import jax
 
-    if degree > 4 and jax.default_backend() == "tpu":
-        # Ops-layer guard (the kron/perturbed guard's sibling): the fused
-        # kernels' nq^3 VMEM intermediates at the fixed 128-lane block
-        # width exceed the Mosaic budget beyond degree 4 — Mosaic would
-        # die later with an opaque VMEM stack error. resolve_backend's
-        # auto mode already routes these to 'xla'; this catches explicit
-        # --backend pallas requests. (CPU interpret-mode tests run all
-        # degrees.)
-        raise ValueError(
-            "the folded Pallas path supports degree <= 4 on TPU (VMEM "
-            "budget); use the xla backend for higher degrees"
-        )
     t = tables or build_operator_tables(degree, qmode, rule)
     layout = make_layout(mesh.n, degree, t.nq, np.dtype(dtype).itemsize, nl=nl)
+    check_tpu_lane_support(layout, degree, qmode)
     if geom == "auto":
-        # Precomputed G is the faster apply (the corner path trades ~2x
-        # FLOPs for ~30x less geometry traffic, and the kernel is compute-
-        # bound when G streams from HBM at full bandwidth) — but G costs
-        # 6*nq^3 values/cell of HBM. Use it when it fits comfortably,
-        # else fall back to corner mode, which scales to the same problem
-        # sizes as the uniform fast path.
-        g_bytes = layout.lv * 6 * t.nq ** 3 * np.dtype(dtype).itemsize
-        geom = "g" if g_bytes <= 6e9 else "corner"
+        geom = auto_geom(layout, t.nq, dtype)
     corners_cs, mask_cs = ghost_corner_arrays(layout, mesh.cell_corners)
     G = corners_b = cmask_b = None
     if geom == "corner":
