@@ -85,6 +85,13 @@ def main(argv: list[str] | None = None) -> int:
 
         force_host_cpu_devices(max(args.ndevices, 1))
 
+    # Multi-host (DCN) launches: every host runs this same program; join
+    # the cluster before any backend use so jax.devices() spans the pod
+    # (no-op on single-process runs — see utils.multihost).
+    from .utils.multihost import maybe_initialize
+
+    maybe_initialize()
+
     # x64 must be configured before device arrays exist.
     import jax
 

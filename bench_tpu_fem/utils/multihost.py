@@ -1,0 +1,47 @@
+"""Multi-host (DCN) initialisation glue.
+
+The reference scales across hosts with one MPI rank per GPU (README.md:94-96,
+`mpirun`/SLURM launch, examples/submit.sh). The JAX-native equivalent is
+multi-controller SPMD: every host runs the same program, calls
+`jax.distributed.initialize()`, and then `jax.devices()` spans the whole
+slice/pod — after which the framework's `shard_map` code (dist/) is
+UNCHANGED: the device grid simply contains remote devices, XLA routes
+`ppermute` neighbours over ICI within a slice and DCN across slices, and
+`psum`/`pmax` reductions span everything (the MPI_Allreduce analogue,
+vector.hpp:173).
+
+On Cloud TPU pods the coordinator/process-id/process-count arguments are
+discovered from the TPU environment automatically; on other clusters they
+come from the standard JAX env vars (JAX_COORDINATOR_ADDRESS,
+JAX_PROCESS_ID / JAX_NUM_PROCESSES) that launchers such as SLURM scripts
+export. Single-process runs (including this repo's CI and the 1-chip
+benchmark rig) need no initialisation — `maybe_initialize` is a no-op
+unless a multi-process launch is detectable.
+"""
+
+from __future__ import annotations
+
+import os
+
+_MULTIHOST_ENV = (
+    "JAX_COORDINATOR_ADDRESS",
+    "COORDINATOR_ADDRESS",
+    "MEGASCALE_COORDINATOR_ADDRESS",
+)
+
+
+def launched_multihost() -> bool:
+    """True when the environment indicates a multi-process launch."""
+    return any(os.environ.get(k) for k in _MULTIHOST_ENV)
+
+
+def maybe_initialize() -> bool:
+    """Call jax.distributed.initialize() iff launched multi-host; returns
+    whether initialisation ran. Must be called before any backend use
+    (the CLI does, right after platform selection)."""
+    if not launched_multihost():
+        return False
+    import jax
+
+    jax.distributed.initialize()
+    return True

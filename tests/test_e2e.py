@@ -60,3 +60,16 @@ def test_nreps_zero_action_returns_zero_vector():
     cfg = BenchConfig(ndofs_global=1000, degree=2, qmode=1, nreps=0, ndevices=1)
     res = run_benchmark(cfg)
     assert res.ynorm == 0.0
+
+
+def test_multihost_glue_is_noop_single_process(monkeypatch):
+    """maybe_initialize must not touch jax.distributed outside a detectable
+    multi-process launch (single-process CI/benchmark runs)."""
+    from bench_tpu_fem.utils import multihost
+
+    for k in multihost._MULTIHOST_ENV:
+        monkeypatch.delenv(k, raising=False)
+    assert not multihost.launched_multihost()
+    assert multihost.maybe_initialize() is False
+    monkeypatch.setenv("JAX_COORDINATOR_ADDRESS", "10.0.0.1:1234")
+    assert multihost.launched_multihost()
