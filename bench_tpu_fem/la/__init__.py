@@ -11,6 +11,7 @@ from .cg import cg_solve
 from .vector import (
     axpy,
     inner_product,
+    inner_product_compensated,
     norm,
     norm_linf,
     pointwise_mult,
@@ -22,6 +23,7 @@ __all__ = [
     "axpy",
     "cg_solve",
     "inner_product",
+    "inner_product_compensated",
     "norm",
     "norm_linf",
     "pointwise_mult",

@@ -49,3 +49,32 @@ def pointwise_mult(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
 def set_value(a: jnp.ndarray, value) -> jnp.ndarray:
     """Fill with a constant (reference set_value, vector.hpp:279-292)."""
     return jnp.full_like(a, value)
+
+
+def inner_product_compensated(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """<a, b> with Neumaier (compensated) accumulation in the working
+    precision: a running sum + error term per lane over a lax.scan, then a
+    tree reduction across lanes. For f32 this recovers most of the
+    accuracy a wider accumulator would give without any f64 emulation —
+    the 'compensated dot' option the precision policy evaluates (the
+    reference accumulates per-rank dots in its scalar type T and
+    MPI_Allreduces, vector.hpp:159-176; an f32 reference build rounds the
+    same way our plain inner_product does).
+
+    Cost: a scan of length N / lane-count — an accuracy tool, not the
+    benchmark hot path (CG keeps inner_product)."""
+    import jax
+
+    p = a * b
+    flat = p.reshape(-1, p.shape[-1]) if p.ndim > 1 else p.reshape(-1, 1)
+
+    def body(carry, x):
+        s, c = carry
+        t = s + x
+        c = c + jnp.where(jnp.abs(s) >= jnp.abs(x),
+                          (s - t) + x, (x - t) + s)
+        return (t, c), None
+
+    zero = jnp.zeros(flat.shape[-1], dtype=flat.dtype)
+    (s, c), _ = jax.lax.scan(body, (zero, zero), flat)
+    return jnp.sum(s + c)

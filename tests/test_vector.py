@@ -66,3 +66,23 @@ def test_distributed_linf_matches_global():
     l2, linf = np.asarray(jax.jit(norm_fn)(xb))
     np.testing.assert_allclose(l2, np.linalg.norm(x), rtol=1e-12)
     np.testing.assert_allclose(linf, np.abs(x).max(), rtol=0)
+
+
+def test_compensated_dot_beats_naive_f32():
+    """Adversarial f32 dot (large cancellation + many small terms): the
+    Neumaier-compensated dot must land within a few ulp of the f64 truth
+    where the naive f32 reduction drifts measurably."""
+    from bench_tpu_fem.la import inner_product_compensated
+
+    rng = np.random.RandomState(0)
+    n = 200_064  # multiple of 128 lanes
+    a = (rng.randn(n) * (10.0 ** rng.uniform(-4, 4, n))).astype(np.float32)
+    b = np.ones(n, dtype=np.float32)
+    truth = float(np.sum(a.astype(np.float64)))
+    ja = jnp.asarray(a).reshape(-1, 128)
+    jb = jnp.asarray(b).reshape(-1, 128)
+    naive = float(inner_product(ja, jb))
+    comp = float(inner_product_compensated(ja, jb))
+    scale = np.abs(a.astype(np.float64)).sum()
+    assert abs(comp - truth) / scale <= abs(naive - truth) / scale
+    assert abs(comp - truth) / scale < 1e-7
