@@ -164,12 +164,27 @@ def resolve_backend(backend: str, float_bits: int, uniform: bool = False,
 
 def run_benchmark(cfg: BenchConfig) -> BenchmarkResults:
     import jax
+
+    if cfg.float_bits not in (32, 64):
+        raise ValueError("Invalid float size. Must be 32 or 64.")
+    # Set in BOTH directions: a prior f64 run in the same process (e.g.
+    # bench.py's f64 side metric) must not leak x64 into an f32 run — under
+    # x64, Python-int kernel parameters trace as int64 and Mosaic rejects
+    # them (tpu.dynamic_rotate wants i32 shifts). Restored on exit so an f32
+    # benchmark doesn't silently downgrade the caller's later f64 numerics
+    # (all results leave this function as Python floats).
+    prev_x64 = jax.config.jax_enable_x64
+    jax.config.update("jax_enable_x64", cfg.float_bits == 64)
+    try:
+        return _run_benchmark(cfg)
+    finally:
+        jax.config.update("jax_enable_x64", prev_x64)
+
+
+def _run_benchmark(cfg: BenchConfig) -> BenchmarkResults:
+    import jax
     import jax.numpy as jnp
 
-    if cfg.float_bits == 64:
-        jax.config.update("jax_enable_x64", True)
-    elif cfg.float_bits != 32:
-        raise ValueError("Invalid float size. Must be 32 or 64.")
     dtype = jnp.float64 if cfg.float_bits == 64 else jnp.float32
 
     if cfg.ndevices > 1:

@@ -95,8 +95,7 @@ def main(argv: list[str] | None = None) -> int:
     # x64 must be configured before device arrays exist.
     import jax
 
-    if args.float_bits == 64:
-        jax.config.update("jax_enable_x64", True)
+    jax.config.update("jax_enable_x64", args.float_bits == 64)
     if args.platform in ("cpu", "tpu"):
         try:
             jax.config.update("jax_platforms", args.platform)

@@ -66,7 +66,14 @@ def inner_product_compensated(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     import jax
 
     p = a * b
-    flat = p.reshape(-1, p.shape[-1]) if p.ndim > 1 else p.reshape(-1, 1)
+    if p.ndim > 1:
+        flat = p.reshape(-1, p.shape[-1])
+    else:
+        # Pad 1-D inputs up to a multiple of 128 lanes so the scan length is
+        # N/128, not N (zeros are exact no-ops for the accumulation).
+        lanes = min(p.size, 128) or 1
+        pad = (-p.size) % lanes
+        flat = jnp.pad(p, (0, pad)).reshape(-1, lanes)
 
     def body(carry, x):
         s, c = carry

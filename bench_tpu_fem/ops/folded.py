@@ -440,8 +440,10 @@ def _shift_window_pair(v0, v1, r: int, nl: int):
     if lr == 0:
         return A
     Bv = buf[..., sr + 1:sr + 1 + SUBLANES, :]
-    Ar = pltpu.roll(A, nl - lr, axis=A.ndim - 1)
-    Br = pltpu.roll(Bv, nl - lr, axis=Bv.ndim - 1)
+    # np.int32: under jax_enable_x64 a Python int traces as int64 and the
+    # Mosaic verifier rejects the rotate ('tpu.dynamic_rotate' wants i32).
+    Ar = pltpu.roll(A, np.int32(nl - lr), axis=A.ndim - 1)
+    Br = pltpu.roll(Bv, np.int32(nl - lr), axis=Bv.ndim - 1)
     lane = jax.lax.broadcasted_iota(jnp.int32, A.shape, A.ndim - 1)
     # raw lax.select (not jnp.where): jnp wrappers trace to closed_call,
     # which the Mosaic kernel-lowering path rejects

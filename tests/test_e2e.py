@@ -73,3 +73,27 @@ def test_multihost_glue_is_noop_single_process(monkeypatch):
     assert multihost.maybe_initialize() is False
     monkeypatch.setenv("JAX_COORDINATOR_ADDRESS", "10.0.0.1:1234")
     assert multihost.launched_multihost()
+
+
+def test_run_benchmark_sets_and_restores_x64():
+    """An f32 run in a process where x64 is on (e.g. after bench.py's f64
+    side metric) must trace in 32-bit — leaked x64 turns Python-int Pallas
+    parameters into int64, which Mosaic rejects on real TPUs
+    ('tpu.dynamic_rotate' wants i32 shifts) — and must restore the caller's
+    flag on exit so it doesn't downgrade later f64 numerics in-process."""
+    import jax
+
+    assert jax.config.jax_enable_x64  # conftest default
+    res = run_benchmark(BenchConfig(ndofs_global=1000, degree=2, qmode=1,
+                                    float_bits=32, nreps=1, ndevices=1))
+    assert np.isfinite(res.ynorm)
+    assert jax.config.jax_enable_x64  # restored, not left off
+
+    jax.config.update("jax_enable_x64", False)
+    try:
+        res = run_benchmark(BenchConfig(ndofs_global=1000, degree=2, qmode=1,
+                                        float_bits=64, nreps=1, ndevices=1))
+        assert np.isfinite(res.ynorm)
+        assert not jax.config.jax_enable_x64  # restored, not left on
+    finally:
+        jax.config.update("jax_enable_x64", True)
