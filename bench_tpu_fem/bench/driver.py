@@ -278,6 +278,8 @@ def _run_benchmark(cfg: BenchConfig) -> BenchmarkResults:
         # Dirichlet). Falls back to apply_cg (multi-view fused kernel) when
         # the input ring would not fit VMEM.
         engine = False
+        engine_cg = None  # fused (A, b) -> x solve, nreps baked in
+        engine_apply = None  # fused (A, x) -> y single apply
         if folded:
             from ..ops.folded_cg import (
                 folded_apply_ring,
@@ -288,13 +290,35 @@ def _run_benchmark(cfg: BenchConfig) -> BenchmarkResults:
             engine = supports_cg_engine(op)
             res.extra["geom"] = "corner" if op.G is None else "g"
             res.extra["cg_engine"] = engine
+            if engine:
+                engine_cg = lambda A, b: folded_cg_solve(A, b, cfg.nreps)  # noqa: E731
+                engine_apply = folded_apply_ring
+        elif backend == "kron":
+            # The kron path has its own fused engine (ops.kron_cg): one
+            # delay-ring kernel per iteration instead of three stage kernels
+            # plus unfused vector algebra. Pallas => TPU f32 only (same
+            # auto rule as KronLaplacian.apply); VMEM gates the ring.
+            from ..ops.kron_cg import (
+                kron_apply_ring,
+                kron_cg_solve,
+                supports_kron_cg_engine,
+            )
+
+            engine = (
+                jax.default_backend() == "tpu"
+                and supports_kron_cg_engine(u.shape, cfg.degree, u.dtype)
+            )
+            res.extra["cg_engine"] = engine
+            if engine:
+                engine_cg = lambda A, b: kron_cg_solve(A, b, cfg.nreps)  # noqa: E731
+                engine_apply = kron_apply_ring
         apply_fn = (lambda A: A.apply_cg) if folded else (lambda A: A.apply)
         if engine:
-            apply_fn = lambda A: partial(folded_apply_ring, A)  # noqa: E731
+            apply_fn = lambda A: partial(engine_apply, A)  # noqa: E731
         if cfg.use_cg:
             if engine:
                 fn = jax.jit(
-                    lambda A, b, x0: folded_cg_solve(A, b, cfg.nreps)
+                    lambda A, b, x0: engine_cg(A, b)
                 ).lower(op, u, jnp.zeros_like(u)).compile()
             else:
                 fn = jax.jit(

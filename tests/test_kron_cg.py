@@ -1,0 +1,150 @@
+"""Fused kron CG engine (ops.kron_cg) vs the XLA kron path.
+
+Mirrors tests/test_folded_cg.py's strategy for the general-geometry engine:
+interpret-mode pallas on CPU, parity against the independently-tested XLA
+apply (ops.kron.KronLaplacian, itself exact vs the assembled oracle in
+tests/test_kron.py) and against la.cg.cg_solve. f32 tolerances: the engine
+reassociates sums, so ~1e-6 relative, not bitwise."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bench_tpu_fem.elements.tables import build_operator_tables
+from bench_tpu_fem.la.cg import cg_solve
+from bench_tpu_fem.mesh.box import create_box_mesh
+from bench_tpu_fem.ops.kron import build_kron_laplacian, device_rhs_uniform
+from bench_tpu_fem.ops.kron_cg import (
+    _kron_cg_call,
+    engine_vmem_bytes,
+    kron_apply_ring,
+    kron_cg_solve,
+    supports_kron_cg_engine,
+)
+
+
+def _setup(degree, n, qmode=1):
+    t = build_operator_tables(degree, qmode, "gll")
+    mesh = create_box_mesh(n)
+    op = build_kron_laplacian(mesh, degree, qmode, dtype=jnp.float32,
+                              tables=t)
+    opx = dataclasses.replace(op, impl="xla")
+    b = device_rhs_uniform(t, mesh.n, jnp.float32)
+    return op, opx, b
+
+
+@pytest.mark.parametrize(
+    "degree,n",
+    [(1, (4, 5, 6)), (2, (3, 4, 5)), (3, (3, 4, 5)), (5, (2, 3, 2)),
+     (7, (2, 3, 2))],
+)
+def test_ring_apply_matches_xla(degree, n):
+    op, opx, b = _setup(degree, n)
+    y_ref = opx.apply(b)
+    y = kron_apply_ring(op, b, interpret=True)
+    rel = float(jnp.linalg.norm(y - y_ref) / jnp.linalg.norm(y_ref))
+    assert rel < 5e-6
+
+
+def test_ring_apply_fused_dot_matches():
+    op, opx, b = _setup(3, (3, 4, 5))
+    y_ref = opx.apply(b)
+    _, dot = _kron_cg_call(op, False, True, b)
+    dot_ref = float(jnp.vdot(b, y_ref))
+    assert abs(float(dot) - dot_ref) / abs(dot_ref) < 5e-6
+
+
+@pytest.mark.parametrize("degree,n", [(1, (4, 5, 6)), (3, (3, 4, 5)),
+                                      (6, (2, 3, 2))])
+def test_engine_cg_matches_reference_loop(degree, n):
+    # few enough iterations that f32 CG on these tiny meshes hasn't hit
+    # rnorm == 0 yet (fixed-iteration rtol=0 semantics divide by rnorm)
+    op, opx, b = _setup(degree, n)
+    x_ref = cg_solve(opx.apply, b, jnp.zeros_like(b), 12)
+    x = kron_cg_solve(op, b, 12, interpret=True)
+    rel = float(jnp.linalg.norm(x - x_ref) / jnp.linalg.norm(x_ref))
+    assert rel < 5e-5
+
+
+def test_engine_cg_dirichlet_rows_pass_through():
+    """bc rows of x stay zero through the engine (RHS bc rows are zero and
+    the blend passes p through on the boundary planes)."""
+    op, _, b = _setup(2, (3, 3, 3))
+    x = kron_cg_solve(op, b, 10, interpret=True)
+    xb = np.asarray(x)
+    assert np.all(xb[0] == 0) and np.all(xb[-1] == 0)
+    assert np.all(xb[:, 0] == 0) and np.all(xb[:, -1] == 0)
+    assert np.all(xb[:, :, 0] == 0) and np.all(xb[:, :, -1] == 0)
+
+
+def test_vmem_gate():
+    # dtype gates the engine; size only picks the internal form: the
+    # flagship 12.5M grid fits the one-kernel ring (~16 MB/core measured
+    # on v5e), the 100M grid must go through the y-chunked form
+    assert supports_kron_cg_engine((232, 232, 232), 3, jnp.float32)
+    assert supports_kron_cg_engine((463, 463, 466), 3, jnp.float32)
+    assert not supports_kron_cg_engine((232, 232, 232), 3, jnp.float64)
+    from bench_tpu_fem.ops.kron_cg import VMEM_BUDGET
+
+    assert engine_vmem_bytes((232, 232, 232), 3) <= VMEM_BUDGET
+    assert engine_vmem_bytes((463, 463, 466), 3) > VMEM_BUDGET
+    # the estimate is monotone in degree (ring depth 2P+2)
+    assert engine_vmem_bytes((232, 232, 232), 6) > engine_vmem_bytes(
+        (232, 232, 232), 3
+    )
+
+
+@pytest.mark.parametrize(
+    "degree,n",
+    # NY crosses chunk boundaries non-divisibly (CY = 64 or rounded-up-8)
+    [(1, (10, 70, 12)), (3, (4, 23, 5)), (5, (2, 12, 3))],
+)
+def test_chunked_form_matches_xla(degree, n):
+    from bench_tpu_fem.ops.kron_cg import _kron_cg_call_chunked
+
+    op, opx, b = _setup(degree, n)
+    y_ref = opx.apply(b)
+    y, dot = _kron_cg_call_chunked(op, False, True, b)
+    rel = float(jnp.linalg.norm(y - y_ref) / jnp.linalg.norm(y_ref))
+    assert rel < 5e-6
+    dot_ref = float(jnp.vdot(b, y_ref))
+    assert abs(float(dot) - dot_ref) / abs(dot_ref) < 2e-5
+
+
+def test_chunked_form_cg_matches_reference_loop():
+    from bench_tpu_fem.ops.kron_cg import _kron_cg_call_chunked
+
+    op, opx, b = _setup(3, (4, 23, 5))
+
+    def body(i, st):
+        x, r, p_prev, beta, rnorm = st
+        p, y, pd = _kron_cg_call_chunked(op, True, True, r, p_prev, beta)
+        alpha = rnorm / pd
+        x1 = x + alpha * p
+        r1 = r - alpha * y
+        rn1 = jnp.vdot(r1, r1)
+        return (x1, r1, p, rn1 / rnorm, rn1)
+
+    st = (jnp.zeros_like(b), b, jnp.zeros_like(b),
+          jnp.zeros((), b.dtype), jnp.vdot(b, b))
+    x = jax.lax.fori_loop(0, 10, body, st)[0]
+    x_ref = cg_solve(opx.apply, b, jnp.zeros_like(b), 10)
+    rel = float(jnp.linalg.norm(x - x_ref) / jnp.linalg.norm(x_ref))
+    assert rel < 5e-5
+
+
+def test_driver_uses_engine_only_on_tpu():
+    """On CPU the driver must keep the XLA kron path (the engine is a
+    Mosaic kernel; interpret mode is for tests, not benchmark runs)."""
+    from bench_tpu_fem.bench.driver import BenchConfig, run_benchmark
+
+    cfg = BenchConfig(ndofs_global=2000, degree=3, qmode=1, float_bits=32,
+                      nreps=3, use_cg=True, ndevices=1)
+    res = run_benchmark(cfg)
+    assert res.extra["backend"] == "kron"
+    assert res.extra.get("cg_engine") in (False, None) or \
+        jax.default_backend() == "tpu"
+    assert np.isfinite(res.ynorm)
