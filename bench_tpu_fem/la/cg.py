@@ -15,7 +15,6 @@ product while reusing this loop unchanged inside `shard_map`.
 
 from __future__ import annotations
 
-from functools import partial
 from typing import Callable
 
 import jax
@@ -64,4 +63,38 @@ def cg_solve(
 
     state = (x0, r, p, rnorm0, jnp.asarray(False))
     x, *_ = jax.lax.fori_loop(0, max_iter, body, state)
+    return x
+
+
+def fused_cg_solve(
+    engine: Callable,
+    b: jnp.ndarray,
+    nreps: int,
+) -> jnp.ndarray:
+    """Shared driver loop for the fused-engine CG paths (ops.folded_cg and
+    ops.kron_cg): `engine(r, p_prev, beta) -> (p, y, <p, A p>)` performs
+    the p-update, operator apply and alpha-dot in one fused pass; this
+    loop supplies the remaining algebra as one XLA elementwise+reduce
+    pass per iteration.
+
+    Benchmark semantics only (x0 = 0, rtol = 0, exactly `nreps`
+    iterations — reference cg.hpp:88-91); the recurrence is the reference
+    loop with the p-update reassociated to the start of the next
+    iteration (p1 = r1 + beta*p0), identical per-element operation
+    order."""
+    x0 = jnp.zeros_like(b)
+    rnorm0 = inner_product(b, b)
+
+    def body(_, state):
+        x, r, p_prev, beta, rnorm = state
+        p, y, pdot = engine(r, p_prev, beta)
+        alpha = rnorm / pdot
+        x1 = x + alpha * p
+        r1 = r - alpha * y
+        rnorm1 = inner_product(r1, r1)
+        beta1 = rnorm1 / rnorm
+        return (x1, r1, p, beta1, rnorm1)
+
+    state = (x0, b, jnp.zeros_like(b), jnp.zeros((), b.dtype), rnorm0)
+    x, *_ = jax.lax.fori_loop(0, nreps, body, state)
     return x

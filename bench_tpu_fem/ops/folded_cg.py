@@ -54,6 +54,7 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ..la.cg import fused_cg_solve
 from .pallas_laplacian import (
     SUBLANES,
     _use_interpret,
@@ -356,26 +357,12 @@ def folded_cg_solve(
         op.is_identity, geom_tables,
     )
 
-    def dot_from(partials):
-        return jnp.sum(partials)
-
-    # x0 = 0: r0 = b, p1 = r0 (beta=0), rnorm0 = <r0, r0>
-    x0 = jnp.zeros_like(b)
-    rnorm0 = jnp.vdot(b, b)
-
-    def body(_, state):
-        x, r, p_prev, beta, rnorm = state
+    def engine(r, p_prev, beta):
         p, y, pdot = apply_cg(True, interpret, r, p_prev, beta)
-        alpha = rnorm / dot_from(pdot)
-        x1 = x + alpha * p
-        r1 = r - alpha * y
-        rnorm1 = jnp.vdot(r1, r1)
-        beta1 = rnorm1 / rnorm
-        return (x1, r1, p, beta1, rnorm1)
+        # the kernel emits per-block partials; XLA sums the ~MB array
+        return p, y, jnp.sum(pdot)
 
-    state = (x0, b, jnp.zeros_like(b), jnp.zeros((), b.dtype), rnorm0)
-    x, *_ = jax.lax.fori_loop(0, nreps, body, state)
-    return x
+    return fused_cg_solve(engine, b, nreps)
 
 
 def folded_apply_ring(

@@ -68,6 +68,7 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ..la.cg import fused_cg_solve
 from .pallas_laplacian import _use_interpret
 
 # VMEM budget (bytes) for the ring + pipeline buffers; the hardware limit
@@ -587,25 +588,14 @@ def _kron_cg_call(op, update_p: bool, interpret, *vectors):
 
 def kron_cg_solve(op, b: jnp.ndarray, nreps: int,
                   interpret: bool | None = None) -> jnp.ndarray:
-    """Benchmark CG (x0 = 0, rtol = 0, exactly nreps iterations) with the
-    fused one-kernel iteration. Matches la.cg.cg_solve(op.apply, b, 0,
+    """Benchmark CG with the fused one-kernel iteration (shared driver
+    loop: la.cg.fused_cg_solve). Matches la.cg.cg_solve(op.apply, b, 0,
     nreps) to f32 reassociation accuracy."""
-    x0 = jnp.zeros_like(b)
-    rnorm0 = jnp.vdot(b, b)
 
-    def body(_, state):
-        x, r, p_prev, beta, rnorm = state
-        p, y, pdot = _kron_cg_call(op, True, interpret, r, p_prev, beta)
-        alpha = rnorm / pdot
-        x1 = x + alpha * p
-        r1 = r - alpha * y
-        rnorm1 = jnp.vdot(r1, r1)
-        beta1 = rnorm1 / rnorm
-        return (x1, r1, p, beta1, rnorm1)
+    def engine(r, p_prev, beta):
+        return _kron_cg_call(op, True, interpret, r, p_prev, beta)
 
-    state = (x0, b, jnp.zeros_like(b), jnp.zeros((), b.dtype), rnorm0)
-    x, *_ = jax.lax.fori_loop(0, nreps, body, state)
-    return x
+    return fused_cg_solve(engine, b, nreps)
 
 
 def kron_apply_ring(op, x: jnp.ndarray,

@@ -1,16 +1,22 @@
 #!/usr/bin/env python
-"""Golden-JSON assertions for the end-to-end CLI run (CI).
+"""Golden-JSON assertions for the end-to-end CLI runs (CI).
 
-Behavioural analogue of the reference's output check (src/test_output.py):
-for the canonical config
+Behavioural analogue of the reference's output check (src/test_output.py).
 
+One file — serial golden config
     --ndofs_global 1000 --degree 3 --qmode 0 --nreps 1 --mat_comp --float 64
-
-assert the echoed size, matrix-free vs assembled-CSR agreement, and the
+asserts the echoed size, matrix-free vs assembled-CSR agreement, and the
 golden norm  y_norm = 9.912865833415553  (reference test_output.py:19 —
 the same operator on the same mesh must reproduce it to f64 tolerance).
 
+Two files — serial vs sharded equality (the `mpirun -n 2` analogue of the
+reference CI): both runs must use a config where the serial and sharded
+mesh sizings provably coincide (2197 dofs -> a 4x4x4-cell box, 2 cells per
+shard along x); asserts each run's matfree-vs-CSR agreement and that the
+two y_norms match to f64 reduction tolerance.
+
 Usage: python scripts/check_output.py out.json
+       python scripts/check_output.py out-serial.json out-n2.json
 """
 
 import json
@@ -19,18 +25,42 @@ import sys
 GOLDEN_Y_NORM = 9.912865833415553
 
 
-def main(path: str) -> int:
+def _load(path: str) -> dict:
     with open(path) as fh:
-        doc = json.load(fh)
-    out = doc["output"]
-    assert out["ndofs_global"] == 1000, out["ndofs_global"]
-    assert abs(out["y_norm"] - out["z_norm"]) < 1e-9, (
+        out = json.load(fh)["output"]
+    # matfree vs assembled-CSR oracle (requires --mat_comp)
+    assert abs(out["y_norm"] - out["z_norm"]) < 1e-9 * abs(out["z_norm"]), (
         out["y_norm"], out["z_norm"],
     )
-    assert abs(out["y_norm"] - GOLDEN_Y_NORM) < 1e-9, out["y_norm"]
-    print(f"OK: y_norm={out['y_norm']} matches golden {GOLDEN_Y_NORM}")
+    return out
+
+
+def main(argv: list[str]) -> int:
+    if len(argv) not in (1, 2):
+        print("usage: check_output.py OUT.json [SHARDED_OUT.json]",
+              file=sys.stderr)
+        return 2
+    if len(argv) == 1:
+        out = _load(argv[0])
+        assert out["ndofs_global"] == 1000, out["ndofs_global"]
+        assert abs(out["y_norm"] - GOLDEN_Y_NORM) < 1e-9, out["y_norm"]
+        print(f"OK: y_norm={out['y_norm']} matches golden {GOLDEN_Y_NORM}")
+        return 0
+    a, b = (_load(p) for p in argv)
+    assert a["ndofs_global"] == b["ndofs_global"], (
+        a["ndofs_global"], b["ndofs_global"],
+    )
+    assert a["ncells_global"] == b["ncells_global"], (
+        "serial and sharded sizings disagree — pick a config where they "
+        "coincide (e.g. 2197 dofs at degree 3)",
+        a["ncells_global"], b["ncells_global"],
+    )
+    rel = abs(a["y_norm"] - b["y_norm"]) / abs(a["y_norm"])
+    assert rel < 1e-12, (a["y_norm"], b["y_norm"], rel)
+    print(f"OK: serial and sharded y_norm agree: {a['y_norm']} "
+          f"(rel diff {rel:.2e})")
     return 0
 
 
 if __name__ == "__main__":
-    sys.exit(main(sys.argv[1]))
+    sys.exit(main(sys.argv[1:]))
