@@ -142,8 +142,10 @@ def resolve_backend(backend: str, float_bits: int, uniform: bool = False,
       path (ops.kron), any dtype — no geometry tensor, ~2x the folded
       kernel's CG rate;
     - perturbed mesh, f32 on TPU, if the folded kernels fit full 128-lane
-      blocks (pick_lanes == 128; the nq^3 VMEM intermediates scale as
-      degree^3) -> 'pallas' (the folded general kernel);
+      blocks (G streaming through degree 3 qmode 1; corner mode's smaller
+      VMEM footprint extends that to degree 4 qmode 1 —
+      ops.folded.pallas_geom_constraint) -> 'pallas' (the folded general
+      kernel);
     - otherwise 'xla' (einsum path; Mosaic has no f64, CPU runs use einsum,
       interpret-mode Pallas is for tests).
     """
@@ -154,10 +156,10 @@ def resolve_backend(backend: str, float_bits: int, uniform: bool = False,
     if uniform:
         return "kron"
     if float_bits == 32 and jax.default_backend() == "tpu":
-        from ..ops.pallas_laplacian import pick_lanes
+        from ..ops.folded import pallas_geom_constraint
 
         nq = degree + qmode + 1
-        if pick_lanes(degree + 1, nq, 4) == 128:
+        if pallas_geom_constraint(degree, nq, 4)[0]:
             return "pallas"
     return "xla"
 

@@ -427,9 +427,13 @@ def build_dist_folded(
     t = tables
     dshape = dgrid.dshape
     ncl = shard_cells(mesh.n, dshape)
-    layout = make_layout(ncl, degree, t.nq, np.dtype(dtype).itemsize, nl=nl)
+    itemsize = np.dtype(dtype).itemsize
     if geom not in ("auto", "corner", "g"):
         raise ValueError(f"unknown geom mode {geom!r}")
+    from ..ops.folded import resolve_pallas_geom
+
+    geom, nl = resolve_pallas_geom(degree, t.nq, itemsize, geom, nl)
+    layout = make_layout(ncl, degree, t.nq, itemsize, nl=nl)
     if geom == "auto":
         # Shared policy with the single-chip builder, applied to the
         # PER-SHARD layout: G while it fits, corner mode for capacity.

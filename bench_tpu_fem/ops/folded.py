@@ -769,13 +769,13 @@ def check_tpu_lane_support(layout: FoldedLayout, degree: int,
                            qmode: int) -> None:
     """Ops-layer guard (the kron/perturbed guard's sibling), shared by the
     single-chip and distributed builders: when the per-cell VMEM working
-    set forces pick_lanes below a full 128-lane block (degree 4 qmode 1
-    and up), the kernels' narrow (..., 8, nl<128) relayout is unsupported
-    by Mosaic and the compile dies with an opaque shape-cast error.
-    resolve_backend's auto mode routes these to 'xla'; this catches
-    explicit --backend pallas requests, including explicitly-passed small
-    nl. (CPU interpret-mode tests run all degrees — the backend check
-    excludes them.)"""
+    set forces pick_lanes below a full 128-lane block (degree 5 qmode 1
+    and up; degree 4 qmode 1 in G-streaming mode), the kernels' narrow
+    (..., 8, nl<128) relayout is unsupported by Mosaic and the compile
+    dies with an opaque shape-cast error. resolve_backend's auto mode
+    routes these to 'xla'; this catches explicit --backend pallas
+    requests, including explicitly-passed small nl. (CPU interpret-mode
+    tests run all degrees — the backend check excludes them.)"""
     import jax
 
     if layout.nl < 128 and jax.default_backend() == "tpu":
@@ -784,6 +784,37 @@ def check_tpu_lane_support(layout: FoldedLayout, degree: int,
             f"degree {degree} qmode {qmode} would need nl={layout.nl} — "
             f"use the xla backend for this configuration"
         )
+
+
+def pallas_geom_constraint(degree: int, nq: int, itemsize: int = 4):
+    """(supported, forced_geom) for the TPU folded Pallas path: full
+    128-lane blocks with G streaming when it fits; corner mode's smaller
+    VMEM footprint rescues degree 4 qmode 1 (forced_geom='corner');
+    otherwise unsupported (the driver routes to 'xla'). Single policy
+    shared by resolve_backend and the builders (via resolve_pallas_geom)."""
+    from .pallas_laplacian import corner_lanes_ok, pick_lanes
+
+    if pick_lanes(degree + 1, nq, itemsize) == 128:
+        return True, None
+    if corner_lanes_ok(degree + 1, nq, itemsize):
+        return True, "corner"
+    return False, None
+
+
+def resolve_pallas_geom(degree: int, nq: int, itemsize: int,
+                        geom: str, nl: int | None):
+    """Apply the forced-corner lane policy to a builder's (geom, nl)
+    request — the one place the override lives, shared by the single-chip
+    and distributed builders. Deliberately platform-agnostic: CPU
+    interpret-mode builds take the same geom/nl the TPU compile would, so
+    the test suite exercises exactly the kernels TPU runs (an explicit
+    geom='g' request keeps the G-mode lane pick and hits the TPU lane
+    guard instead)."""
+    if nl is None and geom != "g":
+        _, forced = pallas_geom_constraint(degree, nq, itemsize)
+        if forced is not None:
+            return forced, 128
+    return geom, nl
 
 
 _BUILD_CHUNK_BLOCKS = 64  # cells per geometry-build chunk = 64 * block
@@ -918,7 +949,9 @@ def build_folded_laplacian(
     import jax
 
     t = tables or build_operator_tables(degree, qmode, rule)
-    layout = make_layout(mesh.n, degree, t.nq, np.dtype(dtype).itemsize, nl=nl)
+    itemsize = np.dtype(dtype).itemsize
+    geom, nl = resolve_pallas_geom(degree, t.nq, itemsize, geom, nl)
+    layout = make_layout(mesh.n, degree, t.nq, itemsize, nl=nl)
     check_tpu_lane_support(layout, degree, qmode)
     if geom == "auto":
         geom = auto_geom(layout, t.nq, dtype)

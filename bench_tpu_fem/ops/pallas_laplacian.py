@@ -48,6 +48,23 @@ def pick_lanes(nd: int, nq: int, itemsize: int = 4) -> int:
     return 8
 
 
+# Corner mode swaps the 12*nq^3 double-buffered G stream for 2*25
+# corner/mask values plus the in-kernel G as a ~6*nq^3 live value — a
+# smaller VMEM footprint, so some configurations (degree 4, qmode 1) keep
+# full 128-lane blocks that G streaming cannot. Its budget is separate and
+# deliberately tighter than the hardware ~16.5 MB: the corner kernels'
+# live-value estimate carries more model risk than the streaming one.
+_VMEM_BUDGET_CORNER_BYTES = 14 * 1024 * 1024
+
+
+def corner_lanes_ok(nd: int, nq: int, itemsize: int = 4) -> bool:
+    """True when the corner-mode kernel fits full 128-lane blocks:
+    double-buffered u/y (4*nd^3), live G + contraction intermediates
+    (~13*nq^3), double-buffered corners+mask (~50)."""
+    per_cell = (4 * nd**3 + 13 * nq**3 + 50) * itemsize
+    return per_cell * SUBLANES * 128 <= _VMEM_BUDGET_CORNER_BYTES
+
+
 def block_count(C: int, nl: int) -> int:
     return -(-C // (SUBLANES * nl))
 

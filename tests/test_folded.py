@@ -106,3 +106,41 @@ def test_folded_cg_matches_grid_cg():
     np.testing.assert_allclose(
         unfold_vector(x_f, op_f.layout), x_g, atol=1e-4 * scale
     )
+
+
+def test_pallas_geom_constraint_policy():
+    """TPU lane policy: G streaming fits 128 lanes through degree 3
+    qmode 1; corner mode rescues degree 4 qmode 1; degree 5+ qmode 1 is
+    unsupported (XLA fallback). nq = degree + qmode + 1."""
+    from bench_tpu_fem.ops.folded import pallas_geom_constraint
+
+    assert pallas_geom_constraint(3, 5) == (True, None)
+    assert pallas_geom_constraint(4, 6) == (True, "corner")
+    assert pallas_geom_constraint(5, 7) == (False, None)
+    assert pallas_geom_constraint(1, 2) == (True, None)
+
+
+def test_degree4_qmode1_builds_corner_at_full_lanes():
+    """The degree-4 qmode-1 folded operator must come out in corner mode
+    with full 128-lane blocks (the G-streaming lane pick would be 64,
+    which Mosaic cannot lower) — and still match the XLA operator."""
+    n, degree, qmode = (3, 2, 2), 4, 1
+    mesh = create_box_mesh(n, geom_perturb_fact=0.2)
+    op_f = build_folded_laplacian(mesh, degree, qmode, dtype=jnp.float32)
+    assert op_f.layout.nl == 128
+    assert op_f.G is None and op_f.corners is not None  # corner mode
+    op_g = build_laplacian(mesh, degree, qmode, dtype=jnp.float32,
+                           backend="xla")
+    rng = np.random.RandomState(3)
+    x = rng.randn(*dof_grid_shape(n, degree)).astype(np.float32)
+    y_ref = np.asarray(jax.jit(op_g.apply)(jnp.asarray(x)))
+    xf = jnp.asarray(fold_vector(x, op_f.layout))
+    y_f = np.asarray(jax.jit(op_f.apply)(xf))
+    scale = np.abs(y_ref).max()
+    np.testing.assert_allclose(
+        unfold_vector(y_f, op_f.layout), y_ref, atol=5e-5 * scale
+    )
+    # explicit geom='g' keeps the (narrow) G-mode lane pick instead
+    op_gg = build_folded_laplacian(mesh, degree, qmode, dtype=jnp.float32,
+                                   geom="g")
+    assert op_gg.G is not None and op_gg.layout.nl < 128
