@@ -47,6 +47,20 @@ def run_f64_side_metric(ndev: int) -> float:
     return res.gdof_per_second / ndev
 
 
+def run_df32_side_metric() -> float:
+    """f64-class-via-f32-pairs CG GDoF/s per chip (ops.kron_df): the
+    TPU-native alternative to XLA's software f64 — ~1e-12 residual floors
+    at a ~20x flop multiplier instead of ~100x emulation (README
+    'Precision policy'). Same size/reps as the emulated side metric."""
+    from bench_tpu_fem.bench.driver import BenchConfig, run_benchmark
+
+    cfg = BenchConfig(
+        ndofs_global=2_000_000, degree=DEGREE, qmode=QMODE, float_bits=64,
+        nreps=50, use_cg=True, ndevices=1, f64_impl="df32",
+    )
+    return run_benchmark(cfg).gdof_per_second
+
+
 def run_perturbed_metric(ndofs: int, ndev: int) -> dict:
     """Permanent second metric: the same Q3 CG config with a perturbed
     (general-geometry) mesh, forcing the folded Pallas path — the algorithm
@@ -115,6 +129,11 @@ def run(ndofs: int) -> dict:
     }
     if f64_err is not None:
         out["f64_error"] = f64_err
+    try:
+        out["f64_df32_gdof_per_s_per_chip"] = round(
+            run_df32_side_metric(), 4)
+    except Exception as e:  # record, never sink the flagship
+        out["f64_df32_error"] = f"{type(e).__name__}: {e}"[:200]
     try:
         out.update(run_perturbed_metric(ndofs, ndev))
     except Exception as e:  # ditto: record, never sink the flagship

@@ -62,6 +62,11 @@ class BenchConfig:
     # operator kernel: "auto" | "kron" | "xla" | "pallas" (auto resolves to
     # kron on uniform single-chip meshes; see resolve_backend)
     backend: str = "auto"
+    # float_bits=64 strategy: "emulated" (XLA software f64 — exact f64
+    # semantics, ~100x slower than f32 on TPUs, which have no f64 units)
+    # or "df32" (double-float f32 pairs, ~1e-12 residual floors at a ~20x
+    # flop multiplier — ops.kron_df; uniform single-chip meshes only)
+    f64_impl: str = "emulated"
     # non-empty: wrap the timed region in jax.profiler.trace writing to this
     # directory (device timelines; view with TensorBoard / xprof)
     profile_dir: str = ""
@@ -175,12 +180,124 @@ def run_benchmark(cfg: BenchConfig) -> BenchmarkResults:
     # them (tpu.dynamic_rotate wants i32 shifts). Restored on exit so an f32
     # benchmark doesn't silently downgrade the caller's later f64 numerics
     # (all results leave this function as Python floats).
+    if cfg.f64_impl not in ("emulated", "df32"):
+        raise ValueError("f64_impl must be 'emulated' or 'df32'")
+    # df32 traces in pure f32 pairs — x64 stays off for it.
+    want_x64 = cfg.float_bits == 64 and cfg.f64_impl == "emulated"
     prev_x64 = jax.config.jax_enable_x64
-    jax.config.update("jax_enable_x64", cfg.float_bits == 64)
+    jax.config.update("jax_enable_x64", want_x64)
     try:
+        if cfg.float_bits == 64 and cfg.f64_impl == "df32":
+            return _run_benchmark_df64(cfg)
         return _run_benchmark(cfg)
     finally:
         jax.config.update("jax_enable_x64", prev_x64)
+
+
+def _run_benchmark_df64(cfg: BenchConfig) -> BenchmarkResults:
+    """float_bits=64 via double-float f32 pairs (ops.kron_df): f64-class
+    CG residual floors without XLA's ~100x software-f64 emulation cost.
+    Uniform single-chip meshes (the kron path) only — the same protocol
+    and reporting as _run_benchmark."""
+    import jax
+    import numpy as np
+
+    from ..ops.kron_df import (
+        action_df,
+        build_kron_laplacian_df,
+        cg_solve_df,
+        device_rhs_uniform_df,
+    )
+    from ..la.df64 import df_to_f64
+
+    if cfg.ndevices > 1:
+        raise ValueError("f64_impl='df32' is single-chip (use 'emulated' "
+                         "for distributed f64 runs)")
+    if cfg.backend not in ("auto", "kron"):
+        raise ValueError("f64_impl='df32' runs the kron path; "
+                         f"--backend {cfg.backend} is not supported with it")
+    n, rule, t, mesh = _mesh_setup(cfg)
+    if not mesh.is_uniform:
+        raise ValueError("f64_impl='df32' requires a uniform (unperturbed) "
+                         "mesh — the kron fast path")
+    ndofs_global = int(np.prod(dof_grid_shape(n, cfg.degree)))
+    res = BenchmarkResults(
+        ncells_global=mesh.ncells, ndofs_global=ndofs_global, nreps=cfg.nreps
+    )
+    res.extra["backend"] = "kron"
+    res.extra["f64_impl"] = "df32"
+
+    b_host = bc_grid = dm = G_host = None
+    if cfg.mat_comp:
+        # oracle runs must solve the oracle's own RHS (the f32 path does
+        # the same): u is the host-assembled b, not the separable device
+        # RHS, so enorm measures solver error only
+        _, _, _, _, _, bc_grid, dm, b_host, G_host = _setup_problem(
+            cfg, n, prebuilt=(n, rule, t, mesh)
+        )
+
+    from ..la.df64 import df_from_f64
+
+    with Timer("% Create matfree operator"):
+        op = build_kron_laplacian_df(
+            mesh, cfg.degree, cfg.qmode, rule, kappa=2.0, tables=t
+        )
+        u = (df_from_f64(np.asarray(b_host, np.float64))
+             if cfg.mat_comp else device_rhs_uniform_df(t, mesh.n))
+        if cfg.use_cg:
+            fn = jax.jit(
+                lambda A, b: cg_solve_df(A, b, cfg.nreps)
+            ).lower(op, u).compile()
+        else:
+            fn = jax.jit(
+                lambda A, b: action_df(A, b, cfg.nreps)
+            ).lower(op, u).compile()
+        warm = fn(op, u)
+        float(warm.hi[(0,) * warm.hi.ndim])
+        del warm
+
+    from contextlib import nullcontext
+
+    prof = (
+        jax.profiler.trace(cfg.profile_dir) if cfg.profile_dir
+        else nullcontext()
+    )
+    with prof:
+        t0 = time.perf_counter()
+        y = fn(op, u)
+        jax.block_until_ready(y)
+        float(y.hi[(0,) * y.hi.ndim])  # hard fence (see _run_benchmark)
+        res.mat_free_time = time.perf_counter() - t0
+
+    # Norms on device: L2 via the compensated df dot (f64-class); Linf on
+    # the f32-rounded hi+lo (|.|max to ~f32 relative accuracy — casting to
+    # f64 on device would need x64, which this mode keeps off). No O(N)
+    # host transfer at any problem size.
+    from ..la.df64 import df_dot
+
+    import jax.numpy as jnp
+
+    dot_fn = jax.jit(df_dot)  # compiled once, reused for u and y
+    linf_fn = jax.jit(lambda a: jnp.max(jnp.abs(a.hi + a.lo)))
+
+    def norms(v):
+        l2 = float(np.sqrt(max(float(df_to_f64(dot_fn(v, v))), 0.0)))
+        return l2, float(linf_fn(v))
+
+    res.unorm, res.unorm_linf = norms(u)
+    res.ynorm, res.ynorm_linf = norms(y)
+    res.gdof_per_second = ndofs_global * cfg.nreps / (
+        1e9 * res.mat_free_time
+    )
+
+    if cfg.mat_comp:
+        # assembled-CSR oracle in true f64 (host path; oracle runs are
+        # small, so the one O(N) host transfer of y here is fine)
+        z = _mat_comp_oracle(cfg, t, dm, bc_grid, b_host, G_host)
+        e = df_to_f64(y) - z
+        res.znorm = float(np.linalg.norm(z))
+        res.enorm = float(np.linalg.norm(e))
+    return res
 
 
 def _run_benchmark(cfg: BenchConfig) -> BenchmarkResults:

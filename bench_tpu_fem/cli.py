@@ -49,6 +49,13 @@ def build_parser() -> argparse.ArgumentParser:
                    choices=["auto", "xla", "pallas", "kron"],
                    help="Operator kernel backend (auto: kron fast path on "
                         "uniform meshes, Pallas on TPU f32 otherwise)")
+    p.add_argument("--f64_impl", default="emulated",
+                   choices=["emulated", "df32"],
+                   help="--float 64 strategy on TPUs (no f64 units): "
+                        "'emulated' = XLA software f64 (exact, ~100x "
+                        "slower); 'df32' = double-float f32 pairs "
+                        "(~1e-12 CG residual floors at ~20x flops; "
+                        "uniform single-chip meshes)")
     p.add_argument("--log-level", default="info")
     p.add_argument("--profile", default="",
                    help="Write a jax.profiler trace of the timed region to "
@@ -95,7 +102,10 @@ def main(argv: list[str] | None = None) -> int:
     # x64 must be configured before device arrays exist.
     import jax
 
-    jax.config.update("jax_enable_x64", args.float_bits == 64)
+    jax.config.update(
+        "jax_enable_x64",
+        args.float_bits == 64 and args.f64_impl == "emulated",
+    )
     if args.platform in ("cpu", "tpu"):
         try:
             jax.config.update("jax_platforms", args.platform)
@@ -109,6 +119,11 @@ def main(argv: list[str] | None = None) -> int:
 
     devices = jax.devices()
     ndevices = args.ndevices or len(devices)
+    if (args.float_bits == 64 and args.f64_impl == "df32"
+            and args.ndevices == 0 and ndevices > 1):
+        # df32 is single-chip; with no explicit --ndevices, run on one chip
+        # rather than erroring out on multi-chip hosts.
+        ndevices = 1
 
     if args.ndofs_global is not None:
         ndofs_global = args.ndofs_global
@@ -132,6 +147,7 @@ def main(argv: list[str] | None = None) -> int:
         platform=args.platform,
         ndevices=ndevices,
         backend=args.backend,
+        f64_impl=args.f64_impl,
         profile_dir=args.profile,
     )
 

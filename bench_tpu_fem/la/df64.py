@@ -1,0 +1,186 @@
+"""Double-float ("df64") arithmetic: f64-class precision from f32 pairs.
+
+TPUs have no f64 hardware; XLA emulates f64 op-by-op and the measured CG
+throughput is ~100x below f32 (BENCH artifacts). This module provides the
+classical error-free-transformation alternative: a value is an unevaluated
+sum hi + lo of two f32 (|lo| <= ulp(hi)/2), giving ~48 significant bits —
+enough to track the reference's f64 CG residual behaviour to ~1e-12
+(their floor: laplacian_solver.cpp:130-148 norms) at a few tens of f32
+flops per op instead of XLA's per-op software emulation.
+
+Algorithms: Knuth two_sum (6 flops, no branches), Dekker split/two_prod
+(no FMA assumed — TPU VPU exposes none through XLA), and the standard
+double-float add/mul with one renormalisation. All functions are
+elementwise on (hi, lo) pairs of equal-shape f32 arrays and jit/vmap
+compatible (pure jnp).
+
+References (public domain algorithms): T.J. Dekker, "A floating-point
+technique for extending the available precision" (1971); D.E. Knuth,
+TAOCP vol. 2. The pair layout mirrors standard double-double libraries
+(e.g. QD); no code is derived from them.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+# Dekker splitter for f32: 2^12 + 1 (24-bit mantissa -> 12 + 12 bits).
+_SPLIT = np.float32(4097.0)
+
+
+def _launder(x):
+    """Round-trip through an int32 bitcast: value-identical, but opaque to
+    floating-point pattern rewrites. Required for correctness: when the
+    error-free transformations below fuse with their producers, the
+    compiler rewrites patterns like `a - (a + b)` as real arithmetic,
+    which zeroes the computed rounding error and silently degrades every
+    df64 result to ~f32 accuracy (measured on XLA:CPU whole-graph
+    compilation; per-op execution is unaffected, and no public XLA flag
+    disables it — tests/test_df64.py pins the jitted behaviour). Bitcasts
+    cost nothing on hardware."""
+    return jax.lax.bitcast_convert_type(
+        jax.lax.bitcast_convert_type(x, jnp.int32), jnp.float32
+    )
+
+
+class DF(NamedTuple):
+    """Unevaluated sum hi + lo; both f32 arrays of equal shape."""
+
+    hi: jnp.ndarray
+    lo: jnp.ndarray
+
+
+def two_sum(a, b):
+    """Error-free a + b: returns (s, err) with s + err == a + b exactly.
+    The laundered copy of s keeps the compiler from cancelling the error
+    terms (see _launder)."""
+    s = a + b
+    so = _launder(s)
+    bb = so - a
+    err = (a - (so - bb)) + (b - bb)
+    return s, err
+
+
+def _split(a):
+    c = _launder(_SPLIT * a)
+    hi = c - (c - a)
+    return hi, a - hi
+
+
+def two_prod(a, b):
+    """Error-free a * b (Dekker, no FMA): (p, err), p + err == a*b."""
+    p = a * b
+    ah, al = _split(a)
+    bh, bl = _split(b)
+    err = ((ah * bh - p) + ah * bl + al * bh) + al * bl
+    return p, err
+
+
+def _renorm(hi, lo):
+    # Full two_sum, not the classic quick-renorm s = hi + lo;
+    # lo' = (hi - s) + lo: whole-graph compilation rewrites the quick form
+    # to real arithmetic (lo' -> 0) even with a laundered s, silently
+    # degrading every df64 product to f32 accuracy. The laundered two_sum
+    # is the only renormalisation measured to survive fusion (see
+    # _launder; pinned by tests/test_df64.py).
+    return DF(*two_sum(hi, lo))
+
+
+def df_from_f64(a: np.ndarray) -> DF:
+    """Host-side split of an f64 array into an (hi, lo) f32 pair."""
+    hi = np.asarray(a, np.float32)
+    lo = np.asarray(a - np.asarray(hi, np.float64), np.float32)
+    return DF(jnp.asarray(hi), jnp.asarray(lo))
+
+
+def df_to_f64(a: DF) -> np.ndarray:
+    return np.asarray(a.hi, np.float64) + np.asarray(a.lo, np.float64)
+
+
+def df_zeros_like(a: DF) -> DF:
+    return DF(jnp.zeros_like(a.hi), jnp.zeros_like(a.lo))
+
+
+def df_add(a: DF, b: DF) -> DF:
+    s, e = two_sum(a.hi, b.hi)
+    t, f = two_sum(a.lo, b.lo)
+    e = e + t
+    s, e = _renorm(s, e)
+    e = e + f
+    return _renorm(s, e)
+
+
+def df_neg(a: DF) -> DF:
+    return DF(-a.hi, -a.lo)
+
+
+def df_sub(a: DF, b: DF) -> DF:
+    return df_add(a, df_neg(b))
+
+
+def _prod_terms(a: DF, b: DF):
+    """Raw product pair (p, e) with p + e ~= a*b to df accuracy: error-free
+    hi product plus the first-order cross terms folded into the error
+    channel. The one shared implementation of the mixed df product (df_mul,
+    df_dot, and the operator kernels build on it) so the fusion-hazard
+    defenses (see _launder) live in exactly one place."""
+    p, e = two_prod(a.hi, b.hi)
+    return p, e + (a.hi * b.lo + a.lo * b.hi)
+
+
+def df_mul(a: DF, b: DF) -> DF:
+    return _renorm(*_prod_terms(a, b))
+
+
+def df_div(a: DF, b: DF) -> DF:
+    """One Newton refinement of the f32 quotient — ~full df precision."""
+    q1 = a.hi / b.hi
+    r = df_sub(a, df_mul(DF(q1, jnp.zeros_like(q1)), b))
+    q2 = (r.hi + r.lo) / b.hi
+    s, e = two_sum(q1, q2)
+    return DF(s, e)
+
+
+def df_sum(a: DF):
+    """Full reduction to a scalar DF: a binary tree of full df_add steps
+    (log2 N levels of elementwise halving).
+
+    Deliberately NOT the cheaper raw two_sum + lo-carry fold: that
+    pattern is destroyed by XLA:CPU's fusion-time simplifications when
+    the intermediates are dead (measured: the compensation vanishes and
+    the dot degrades to ~f32-pairwise accuracy; the effect disappears if
+    the intermediates are returned as outputs). The fully renormalising
+    df_add chain survives whole-graph optimisation on every backend
+    tested and costs only ~3x the flops of the fragile fold — noise next
+    to the apply."""
+    x = DF(a.hi.ravel(), a.lo.ravel())
+    while x.hi.shape[0] > 1:
+        n = x.hi.shape[0]
+        m = n // 2
+        s = df_add(DF(x.hi[:m], x.lo[:m]), DF(x.hi[m : 2 * m],
+                                              x.lo[m : 2 * m]))
+        if n % 2:
+            s = DF(jnp.concatenate([s.hi, x.hi[-1:]]),
+                   jnp.concatenate([s.lo, x.lo[-1:]]))
+        x = s
+    return DF(x.hi[0], x.lo[0])
+
+
+def df_dot(a: DF, b: DF):
+    """<a, b> as a scalar DF (error-free products, compensated sum)."""
+    return df_sum(DF(*_prod_terms(a, b)))
+
+
+def df_scale(a: DF, s: DF) -> DF:
+    """a * scalar-DF s (broadcasts)."""
+    return df_mul(a, DF(jnp.broadcast_to(s.hi, a.hi.shape),
+                        jnp.broadcast_to(s.lo, a.hi.shape)))
+
+
+def df_axpy(y: DF, alpha: DF, x: DF) -> DF:
+    """y + alpha * x."""
+    return df_add(y, df_scale(x, alpha))

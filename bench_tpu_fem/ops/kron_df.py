@@ -1,0 +1,217 @@
+"""Double-float (df64) Kronecker-path operator and CG: the TPU-native
+answer to `--float 64` on the uniform mesh.
+
+The reference benchmarks f64 natively (GH200 has f64 units); a TPU does
+not, and XLA's op-by-op f64 emulation measures ~100x below f32 here
+(BENCH artifacts, 'Precision policy' in the README). This module runs the
+same banded Kronecker apply and CG recurrence in double-float arithmetic
+(la.df64: f32 pairs, ~48-bit mantissa): a few tens of f32 VPU flops per
+term instead of per-op software emulation, with CG residual behaviour in
+the reference's f64 class (~1e-12 floors vs f32's ~1e-3 —
+F32_ACCURACY artifacts; ref norms laplacian_solver.cpp:130-148).
+
+Semantics mirror ops.kron exactly: bc-folded banded 1D factors, separable
+Dirichlet blend, fixed-iteration rtol=0 CG (cg.hpp:88-91). Everything is
+pure jnp on (hi, lo) pairs — XLA fuses the error-free transformations
+into the same elementwise passes as the f32 path, so the expected cost is
+the ~20x flop multiplier, not the ~100x emulation penalty.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..elements.tables import OperatorTables, build_operator_tables
+from ..la.df64 import (
+    DF,
+    _prod_terms,
+    _renorm,
+    df_add,
+    df_axpy,
+    df_div,
+    df_dot,
+    df_from_f64,
+    df_scale,
+    df_sub,
+    df_zeros_like,
+)
+from ..mesh.box import BoxMesh
+from .kron import axis_matrices_1d, banded_diags, cell_matrices_1d  # noqa: F401
+
+
+def banded_apply_df(u: DF, diags: DF, axis: int) -> DF:
+    """df64 twin of ops.kron.banded_apply: one pad + 2P+1 shifted slices
+    with per-row DF coefficients, accumulated in df arithmetic."""
+    nb = diags.hi.shape[0]
+    P = (nb - 1) // 2
+    N = u.hi.shape[axis]
+    pads = [(0, 0)] * u.hi.ndim
+    pads[axis] = (P, P)
+    uhp = jnp.pad(u.hi, pads)
+    ulp = jnp.pad(u.lo, pads)
+    bshape = [1] * u.hi.ndim
+    bshape[axis] = N
+    acc = None
+    for di in range(nb):
+        start = [0] * u.hi.ndim
+        start[axis] = di
+        lim = list(uhp.shape)
+        lim[axis] = di + N
+        sh = jax.lax.slice(uhp, start, lim)
+        sl = jax.lax.slice(ulp, start, lim)
+        c = DF(diags.hi[di].reshape(bshape), diags.lo[di].reshape(bshape))
+        term = _renorm(*_prod_terms(c, DF(sh, sl)))
+        acc = term if acc is None else df_add(acc, term)
+    return acc
+
+
+@partial(
+    jax.tree_util.register_dataclass,
+    data_fields=["Kd", "Md", "notbc"],
+    meta_fields=["n", "degree"],
+)
+@dataclass(frozen=True)
+class KronLaplacianDF:
+    """df64 uniform-mesh Laplacian (pytree operator; kappa folded into the
+    1D factors host-side in f64, unlike the f32 twin, so no scalar df mul
+    is needed per apply)."""
+
+    Kd: tuple  # 3x DF of (2P+1, N_a) banded diagonals (bc-folded, kappa'd)
+    Md: tuple  # 3x DF (the x/y factors carry kappa once: see builder)
+    notbc: DF  # (NX, NY, NZ) 0/1 interior mask (exact in f32: hi only)
+    n: tuple[int, int, int]
+    degree: int
+
+    def apply(self, x: DF) -> DF:
+        aK = banded_apply_df(x, self.Kd[2], 2)
+        aM = banded_apply_df(x, self.Md[2], 2)
+        t12 = df_add(
+            banded_apply_df(aK, self.Md[1], 1),
+            banded_apply_df(aM, self.Kd[1], 1),
+        )
+        tyz = banded_apply_df(aM, self.Md[1], 1)
+        y = df_add(
+            banded_apply_df(t12, self.Md[0], 0),
+            banded_apply_df(tyz, self.Kd[0], 0),
+        )
+        nb = self.notbc
+        y_in = DF(nb.hi * y.hi, nb.hi * y.lo)  # mask is exactly 0/1
+        x_bc = DF((1.0 - nb.hi) * x.hi, (1.0 - nb.hi) * x.lo)
+        return df_add(y_in, x_bc)
+
+
+def build_kron_laplacian_df(
+    mesh: BoxMesh,
+    degree: int,
+    qmode: int,
+    rule: str = "gll",
+    kappa: float = 2.0,
+    tables: OperatorTables | None = None,
+) -> KronLaplacianDF:
+    """All 1D factors assembled host-side in f64, kappa folded into the
+    x-axis factors (any single axis works: A = kappa * sum of Kronecker
+    terms, and every term has exactly one x factor), then split hi/lo."""
+    if not mesh.is_uniform:
+        raise ValueError("df64 kron requires an unperturbed box mesh")
+    t = tables or build_operator_tables(degree, qmode, rule)
+    Ks, Ms, masks = axis_matrices_1d(t, mesh.n)
+    P = degree
+    Kd, Md = [], []
+    for a, (K1, M1) in enumerate(zip(Ks, Ms)):
+        scale = kappa if a == 0 else 1.0
+        Kd.append(df_from_f64(banded_diags(K1 * scale, P)))
+        Md.append(df_from_f64(banded_diags(M1 * scale, P)))
+    nb = (
+        masks[0][:, None, None] * masks[1][None, :, None]
+        * masks[2][None, None, :]
+    )
+    return KronLaplacianDF(
+        Kd=tuple(Kd),
+        Md=tuple(Md),
+        notbc=df_from_f64(nb),
+        n=mesh.n,
+        degree=degree,
+    )
+
+
+def cg_solve_df(op: KronLaplacianDF, b: DF, max_iter: int) -> DF:
+    """Fixed-iteration CG in df arithmetic (x0 = 0, rtol = 0 — reference
+    cg.hpp:89-169 semantics), scalars (alpha, beta, rnorm) carried as DF.
+
+    Freeze guard: on small problems a fixed iteration budget can push the
+    recurrence past the df64 residual floor (rel ~1e-12), where the
+    direction updates turn into noise amplification (beta > 1 sustained)
+    — unlike native f64, whose deeper floor self-stabilises within any
+    realistic budget. Once the recurrence residual drops below the floor
+    (rnorm <= 1e-24 * rnorm0, i.e. rel residual ~1e-12), the state
+    freezes, mirroring la.cg.cg_solve's rtol freeze. Benchmark-size runs
+    never converge that far and are unaffected."""
+    floor = jnp.float32(1e-24)
+
+    def body(_, state):
+        x, r, p, rnorm, done = state
+        y = op.apply(p)
+        alpha = df_div(rnorm, df_dot(p, y))
+        x1 = df_axpy(x, alpha, p)
+        r1 = df_sub(r, df_scale(y, alpha))
+        rnorm1 = df_dot(r1, r1)
+        beta = df_div(rnorm1, rnorm)
+        p1 = df_add(df_scale(p, beta), r1)
+        done1 = jnp.logical_or(done, rnorm1.hi <= floor * rnorm0_hi)
+
+        def keep(new, old):
+            return jax.tree_util.tree_map(
+                lambda n, o: jnp.where(done, o, n), new, old
+            )
+
+        return (keep(x1, x), keep(r1, r), keep(p1, p),
+                keep(rnorm1, rnorm), done1)
+
+    x0 = df_zeros_like(b)
+    rnorm0 = df_dot(b, b)
+    rnorm0_hi = rnorm0.hi
+    state = (x0, b, b, rnorm0, jnp.asarray(False))
+    x, *_ = jax.lax.fori_loop(0, max_iter, body, state)
+    return x
+
+
+def action_df(op: KronLaplacianDF, u: DF, nreps: int) -> DF:
+    """nreps operator applications of the same input (benchmark action
+    semantics, laplacian_solver.cpp:119-127), loop-fenced like the f32
+    driver."""
+
+    def rep(_, y):
+        uu, _ = jax.lax.optimization_barrier((u, y))
+        return op.apply(uu)
+
+    return jax.lax.fori_loop(0, nreps, rep, df_zeros_like(u))
+
+
+def device_rhs_uniform_df(t: OperatorTables, n) -> DF:
+    """Separable device RHS: the three O(N^(1/3)) 1D factors are split
+    hi/lo on the host and outer-multiplied ON DEVICE in df arithmetic —
+    no O(N) host array, preserving the kron path's RHS scaling rationale
+    (ops.kron.rhs_factors_1d docstring)."""
+    from .kron import rhs_factors_1d
+
+    fx, fy, fz = (df_from_f64(f) for f in rhs_factors_1d(t, n))
+
+    def outer():
+        fxg = DF(fx.hi[:, None, None], fx.lo[:, None, None])
+        fyg = DF(fy.hi[None, :, None], fy.lo[None, :, None])
+        fzg = DF(fz.hi[None, None, :], fz.lo[None, None, :])
+        nx, ny, nz = fx.hi.shape[0], fy.hi.shape[0], fz.hi.shape[0]
+
+        def bc(a):
+            return DF(jnp.broadcast_to(a.hi, (nx, ny, nz)),
+                      jnp.broadcast_to(a.lo, (nx, ny, nz)))
+
+        xy = _renorm(*_prod_terms(bc(fxg), bc(fyg)))
+        return _renorm(*_prod_terms(xy, bc(fzg)))
+
+    return jax.jit(outer)()

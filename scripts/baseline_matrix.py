@@ -36,7 +36,7 @@ def run_cfg(**kw):
     out = {
         "config": {k: getattr(cfg, k) for k in (
             "ndofs_global", "degree", "qmode", "float_bits", "nreps",
-            "use_cg", "geom_perturb_fact", "backend",
+            "use_cg", "geom_perturb_fact", "backend", "f64_impl",
         )},
         "ndofs_global": res.ndofs_global,
         "gdof_per_second": round(res.gdof_per_second, 4),
@@ -44,6 +44,8 @@ def run_cfg(**kw):
         "unorm": res.unorm,
         "ynorm": res.ynorm,
         "backend": res.extra.get("backend"),
+        "cg_engine": res.extra.get("cg_engine"),
+        "geom": res.extra.get("geom"),
         "wall_s": round(time.time() - t0, 1),
     }
     base = BASE.get(cfg.degree)
@@ -68,14 +70,20 @@ def main() -> int:
     # Q3 flagship size (same as bench.py)
     try_cfg(results, "q3_cg_12.5M", ndofs_global=12_500_000, degree=3,
             qmode=1, float_bits=32, nreps=1000, use_cg=True)
-    # Q3 max demonstrated size. HBM would fit ~500M dofs of CG state on the
-    # kron path, but XLA's TPU backend fails compilation above ~130M dofs
-    # with a VMEM stack-allocation error on whole-vector fusions
-    # ("allocating on stack for ... f32[667,670,670]") — a compiler
-    # limitation of very large single-array programs, recorded here
-    # honestly rather than worked around.
+    # Q3 at large sizes, up to the reference's Q3-300M per-device count.
+    # Round 3 hit an XLA VMEM stack-allocation compile failure above ~130M
+    # ("allocating on stack for ... f32[667,670,670]"); the fused kron CG
+    # engine replaces those whole-vector fusions with pallas kernels plus
+    # one elementwise+reduce pass — each size below records success or the
+    # verbatim failure.
+    try_cfg(results, "q3_cg_100M", ndofs_global=100_000_000, degree=3,
+            qmode=1, float_bits=32, nreps=100, use_cg=True)
     try_cfg(results, "q3_cg_128M", ndofs_global=128_000_000, degree=3,
             qmode=1, float_bits=32, nreps=100, use_cg=True)
+    try_cfg(results, "q3_cg_200M", ndofs_global=200_000_000, degree=3,
+            qmode=1, float_bits=32, nreps=50, use_cg=True)
+    try_cfg(results, "q3_cg_300M", ndofs_global=300_000_000, degree=3,
+            qmode=1, float_bits=32, nreps=50, use_cg=True)
     # Q6 at a large size (reference Q6-500M is 500M/GPU on 120 GB GH200;
     # scale to this chip's HBM and the compile-size ceiling)
     try_cfg(results, "q6_cg_64M", ndofs_global=64_000_000, degree=6,
@@ -87,10 +95,22 @@ def main() -> int:
         try_cfg(results, f"action_q{p}_12.5M", ndofs_global=12_500_000,
                 degree=p, qmode=(1 if p >= 2 else 0), float_bits=32,
                 nreps=400, use_cg=False)
-    # Perturbed-geometry Q3 CG (general-geometry kernel class)
+    # Perturbed-geometry CG (general-geometry kernel class); degree 4 runs
+    # the forced-corner folded path (full 128-lane blocks fit only with
+    # in-kernel geometry — ops.folded.resolve_pallas_geom)
     try_cfg(results, "q3_cg_perturbed_12.5M", ndofs_global=12_500_000,
             degree=3, qmode=1, float_bits=32, nreps=1000, use_cg=True,
             geom_perturb_fact=0.2)
+    try_cfg(results, "q4_cg_perturbed_12.5M", ndofs_global=12_500_000,
+            degree=4, qmode=1, float_bits=32, nreps=500, use_cg=True,
+            geom_perturb_fact=0.2)
+    # f64-class strategies side by side (TPUs have no f64 units):
+    # XLA software emulation vs double-float f32 pairs (ops.kron_df)
+    try_cfg(results, "q3_cg_f64_emulated_2M", ndofs_global=2_000_000,
+            degree=3, qmode=1, float_bits=64, nreps=50, use_cg=True)
+    try_cfg(results, "q3_cg_f64_df32_2M", ndofs_global=2_000_000,
+            degree=3, qmode=1, float_bits=64, nreps=50, use_cg=True,
+            f64_impl="df32")
 
     import jax
 

@@ -1,0 +1,131 @@
+"""Double-float (df64) arithmetic and the df32 f64-mode (la.df64 +
+ops.kron_df).
+
+The jit-parity tests are regression pins for a measured whole-graph
+compiler hazard: when the error-free transformations fuse with their
+producers, patterns like `a - (a + b)` get rewritten as real arithmetic,
+zeroing the computed rounding errors and silently degrading df64 to ~f32
+accuracy. la.df64 defends with bitcast laundering and a full-two_sum
+renormalisation; these tests fail if a refactor reintroduces the fragile
+forms (everything here runs UNDER jit for exactly that reason)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bench_tpu_fem.la import df64 as D
+
+jax.config.update("jax_enable_x64", True)  # for f64 references
+
+
+@pytest.fixture(scope="module")
+def rand_pairs():
+    rng = np.random.RandomState(0)
+    n = 50_000
+    a64, b64 = rng.randn(n), rng.randn(n)
+    return a64, b64, D.df_from_f64(a64), D.df_from_f64(b64)
+
+
+def test_split_roundtrip(rand_pairs):
+    a64, _, A, _ = rand_pairs
+    np.testing.assert_allclose(D.df_to_f64(A), a64, rtol=1e-14)
+
+
+def test_elementwise_ops_under_jit(rand_pairs):
+    a64, b64, A, B = rand_pairs
+    # error denominators: |a|+|b| for add (plain relative error is
+    # unbounded under cancellation for ANY fixed precision); |result| for
+    # mul/div (no cancellation, error ~ ulp of the result)
+    for fn, ref, denom in (
+        (D.df_add, a64 + b64, np.abs(a64) + np.abs(b64)),
+        (D.df_mul, a64 * b64, np.abs(a64 * b64) + 1e-300),
+        (D.df_div, a64 / b64, np.abs(a64 / b64) + 1e-300),
+    ):
+        got = D.df_to_f64(jax.jit(fn)(A, B))
+        assert np.max(np.abs(got - ref) / denom) < 1e-13, fn.__name__
+
+
+def test_dot_and_sum_under_jit(rand_pairs):
+    a64, b64, A, B = rand_pairs
+    ref = float(np.dot(a64, b64))
+    got = float(D.df_to_f64(jax.jit(D.df_dot)(A, B)))
+    assert abs(got - ref) / abs(ref) < 1e-12
+    refs = float(np.sum(a64))
+    gots = float(D.df_to_f64(jax.jit(D.df_sum)(A)))
+    assert abs(gots - refs) / abs(refs) < 1e-12
+
+
+def test_scalar_scale_under_jit(rand_pairs):
+    """The historical worst case: df_mul by a broadcast scalar inside a
+    fused graph (the compiler rewrite zeroed the compensation here)."""
+    a64, _, A, _ = rand_pairs
+    al = 0.123456789123456789
+    AL = D.DF(jnp.float32(np.float32(al)),
+              jnp.float32(np.float64(al) - np.float32(al)))
+    got = D.df_to_f64(jax.jit(D.df_scale)(A, AL))
+    assert np.max(np.abs(got - al * a64)) < 1e-13
+
+
+def _setup(n=(6, 6, 6), degree=3, qmode=1):
+    import dataclasses
+
+    from bench_tpu_fem.elements.tables import build_operator_tables
+    from bench_tpu_fem.mesh.box import create_box_mesh
+    from bench_tpu_fem.ops.kron import build_kron_laplacian, \
+        device_rhs_uniform
+    from bench_tpu_fem.ops.kron_df import build_kron_laplacian_df, \
+        device_rhs_uniform_df
+
+    t = build_operator_tables(degree, qmode, "gll")
+    mesh = create_box_mesh(n)
+    op64 = dataclasses.replace(
+        build_kron_laplacian(mesh, degree, qmode, dtype=jnp.float64,
+                             tables=t), impl="xla")
+    b64 = device_rhs_uniform(t, mesh.n, jnp.float64)
+    opdf = build_kron_laplacian_df(mesh, degree, qmode, tables=t)
+    bdf = device_rhs_uniform_df(t, mesh.n)
+    return op64, b64, opdf, bdf
+
+
+@pytest.mark.parametrize("degree,qmode", [(1, 0), (3, 1), (6, 1)])
+def test_df64_apply_matches_f64(degree, qmode):
+    op64, b64, opdf, bdf = _setup((4, 3, 3), degree, qmode)
+    y64 = np.asarray(op64.apply(b64), np.float64)
+    ydf = D.df_to_f64(jax.jit(opdf.apply)(bdf))
+    assert np.linalg.norm(ydf - y64) / np.linalg.norm(y64) < 1e-13
+
+
+def test_df64_cg_f64_class_floor():
+    """Jitted df64 CG must reach an f64-class residual floor (~1e-12; the
+    f32 path floors at ~1e-3 relative at scale) and stay there under a
+    fixed iteration budget far past convergence (the freeze guard)."""
+    from bench_tpu_fem.ops.kron_df import cg_solve_df
+
+    op64, b64, opdf, bdf = _setup((8, 8, 8))
+    bn = float(jnp.linalg.norm(b64))
+    for iters in (200, 1000):
+        x = jax.jit(lambda b: cg_solve_df(opdf, b, iters))(bdf)
+        xs = jnp.asarray(D.df_to_f64(x))
+        rel = float(jnp.linalg.norm(b64 - op64.apply(xs))) / bn
+        assert rel < 5e-12, (iters, rel)
+
+
+def test_driver_df32_mode():
+    """run_benchmark(f64_impl='df32'): kron path, f64-class oracle
+    agreement, x64 untouched."""
+    from bench_tpu_fem.bench.driver import BenchConfig, run_benchmark
+
+    cfg = BenchConfig(ndofs_global=2000, degree=3, qmode=1, float_bits=64,
+                      nreps=8, use_cg=True, mat_comp=True, ndevices=1,
+                      f64_impl="df32")
+    res = run_benchmark(cfg)
+    assert res.extra["f64_impl"] == "df32"
+    assert res.extra["backend"] == "kron"
+    assert res.enorm / res.znorm < 1e-9
+    assert jax.config.jax_enable_x64  # restored (conftest default)
+
+    with pytest.raises(ValueError, match="uniform"):
+        run_benchmark(BenchConfig(
+            ndofs_global=2000, degree=3, qmode=1, float_bits=64, nreps=2,
+            geom_perturb_fact=0.2, ndevices=1, f64_impl="df32"))
