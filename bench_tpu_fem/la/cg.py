@@ -70,12 +70,15 @@ def fused_cg_solve(
     engine: Callable,
     b: jnp.ndarray,
     nreps: int,
+    update: Callable | None = None,
 ) -> jnp.ndarray:
     """Shared driver loop for the fused-engine CG paths (ops.folded_cg and
     ops.kron_cg): `engine(r, p_prev, beta) -> (p, y, <p, A p>)` performs
-    the p-update, operator apply and alpha-dot in one fused pass; this
-    loop supplies the remaining algebra as one XLA elementwise+reduce
-    pass per iteration.
+    the p-update, operator apply and alpha-dot in one fused pass; the
+    remaining algebra runs as one XLA elementwise+reduce pass per
+    iteration, or through `update(x, p, r, y, alpha) -> (x1, r1,
+    <r1, r1>)` when given (ops.kron_cg routes very large problems through
+    a chunked pallas update pass this way).
 
     Benchmark semantics only (x0 = 0, rtol = 0, exactly `nreps`
     iterations — reference cg.hpp:88-91); the recurrence is the reference
@@ -89,9 +92,12 @@ def fused_cg_solve(
         x, r, p_prev, beta, rnorm = state
         p, y, pdot = engine(r, p_prev, beta)
         alpha = rnorm / pdot
-        x1 = x + alpha * p
-        r1 = r - alpha * y
-        rnorm1 = inner_product(r1, r1)
+        if update is None:
+            x1 = x + alpha * p
+            r1 = r - alpha * y
+            rnorm1 = inner_product(r1, r1)
+        else:
+            x1, r1, rnorm1 = update(x, p, r, y, alpha)
         beta1 = rnorm1 / rnorm
         return (x1, r1, p, beta1, rnorm1)
 

@@ -586,16 +586,91 @@ def _kron_cg_call(op, update_p: bool, interpret, *vectors):
     return y, dot[0, 0]
 
 
+def _make_update_kernel(NX: int, NY: int, NZ: int, CY: int):
+    """x/r update + <r, r> partials as one pallas pass: the same 6 streams
+    as the fused XLA pass, but immune to the XLA TPU backend's compile
+    failure on very large whole-vector fusions (VMEM stack allocation at
+    ~130M+ dofs), since every buffer here is one (CY, NZ) chunk."""
+
+    def kernel(x_ref, p_ref, r_ref, y_ref, al_ref, x1_ref, r1_ref,
+               rr_ref, racc):
+        xi = pl.program_id(0)
+        yj = pl.program_id(1)
+
+        @pl.when(jnp.logical_and(xi == 0, yj == 0))
+        def _init():
+            racc[...] = jnp.zeros_like(racc)
+
+        a = al_ref[0, 0]
+        x1_ref[0] = x_ref[0] + a * p_ref[0]
+        r1 = r_ref[0] - a * y_ref[0]
+        r1_ref[0] = r1
+        # mask virtual-pad rows of the last y-chunk out of the reduction
+        gy = (yj * np.int32(CY)
+              + jax.lax.broadcasted_iota(jnp.int32, (CY, NZ), 0))
+        r1m = jax.lax.select(gy < np.int32(NY), r1, jnp.zeros_like(r1))
+        racc[0, 0] += jnp.sum(r1m * r1m)
+
+        @pl.when(jnp.logical_and(xi == np.int32(NX - 1),
+                                 yj == np.int32(-(-NY // CY) - 1)))
+        def _finish():
+            rr_ref[0, 0] = racc[0, 0]
+
+    return kernel
+
+
+def cg_update_pallas(x, p, r, y, alpha, interpret: bool | None = None):
+    """(x + alpha p, r - alpha y, <r1, r1>) via the chunked pallas pass."""
+    NX, NY, NZ = x.shape
+    dtype = x.dtype
+    CY = _pick_cy(NY, 1)
+    NYB = -(-NY // CY)
+    spec = pl.BlockSpec((1, CY, NZ), lambda xi, yj: (xi, yj, 0),
+                        memory_space=pltpu.VMEM)
+    x1, r1, rr = pl.pallas_call(
+        _make_update_kernel(NX, NY, NZ, CY),
+        grid=(NX, NYB),
+        in_specs=[spec, spec, spec, spec,
+                  pl.BlockSpec((1, 1), lambda xi, yj: (0, 0),
+                               memory_space=pltpu.SMEM)],
+        out_specs=[spec, spec,
+                   pl.BlockSpec((1, 1), lambda xi, yj: (0, 0),
+                                memory_space=pltpu.VMEM)],
+        out_shape=[jax.ShapeDtypeStruct((NX, NY, NZ), dtype)] * 2
+        + [jax.ShapeDtypeStruct((1, 1), dtype)],
+        scratch_shapes=[pltpu.VMEM((1, 1), dtype)],
+        interpret=_use_interpret() if interpret is None else interpret,
+    )(x, p, r, y, alpha.astype(dtype).reshape(1, 1))
+    return x1, r1, rr[0, 0]
+
+
+# Above this many dofs the fused XLA update pass is replaced by the
+# chunked pallas one: XLA's TPU backend fails compilation of whole-vector
+# fusions around ~130M dofs ("allocating on stack for f32[667,670,670]").
+PALLAS_UPDATE_MIN_DOFS = 100_000_000
+
+
 def kron_cg_solve(op, b: jnp.ndarray, nreps: int,
-                  interpret: bool | None = None) -> jnp.ndarray:
+                  interpret: bool | None = None,
+                  pallas_update: bool | None = None) -> jnp.ndarray:
     """Benchmark CG with the fused one-kernel iteration (shared driver
     loop: la.cg.fused_cg_solve). Matches la.cg.cg_solve(op.apply, b, 0,
-    nreps) to f32 reassociation accuracy."""
+    nreps) to f32 reassociation accuracy. `pallas_update` (default: by
+    size) routes the x/r update through cg_update_pallas."""
 
     def engine(r, p_prev, beta):
         return _kron_cg_call(op, True, interpret, r, p_prev, beta)
 
-    return fused_cg_solve(engine, b, nreps)
+    use_pallas_upd = (
+        b.size >= PALLAS_UPDATE_MIN_DOFS if pallas_update is None
+        else pallas_update
+    )
+    update = (
+        (lambda x, p, r, y, alpha:
+         cg_update_pallas(x, p, r, y, alpha, interpret))
+        if use_pallas_upd else None
+    )
+    return fused_cg_solve(engine, b, nreps, update=update)
 
 
 def kron_apply_ring(op, x: jnp.ndarray,

@@ -148,3 +148,29 @@ def test_driver_uses_engine_only_on_tpu():
     assert res.extra.get("cg_engine") in (False, None) or \
         jax.default_backend() == "tpu"
     assert np.isfinite(res.ynorm)
+
+
+def test_pallas_update_pass_matches_xla_update():
+    from bench_tpu_fem.ops.kron_cg import cg_update_pallas
+
+    rng = np.random.RandomState(5)
+    shape = (7, 70, 13)  # non-divisible y-chunks
+    x, p, r, y = (jnp.asarray(rng.randn(*shape).astype(np.float32))
+                  for _ in range(4))
+    alpha = jnp.float32(0.37)
+    x1, r1, rr = cg_update_pallas(x, p, r, y, alpha, interpret=True)
+    # atol: entries of x + alpha*p near zero make pure rtol unbounded
+    np.testing.assert_allclose(np.asarray(x1), np.asarray(x + alpha * p),
+                               rtol=1e-6, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(r1), np.asarray(r - alpha * y),
+                               rtol=1e-6, atol=1e-6)
+    ref = float(jnp.vdot(r - alpha * y, r - alpha * y))
+    assert abs(float(rr) - ref) / ref < 1e-5
+
+
+def test_engine_cg_with_pallas_update_matches():
+    op, opx, b = _setup(3, (4, 23, 5))
+    x_ref = cg_solve(opx.apply, b, jnp.zeros_like(b), 12)
+    x = kron_cg_solve(op, b, 12, interpret=True, pallas_update=True)
+    rel = float(jnp.linalg.norm(x - x_ref) / jnp.linalg.norm(x_ref))
+    assert rel < 5e-5
