@@ -1,10 +1,11 @@
 #!/usr/bin/env python
-"""f32-vs-f64 CG accuracy evidence at benchmark scale (SURVEY §7 hard part
-1): the reference's headline configs are f64; TPUs only emulate f64, so the
-flagship benchmark numbers here are f32. This artifact quantifies what that
-costs in solution quality: run the SAME fixed-iteration CG (rtol = 0,
-cg.hpp:88-91 semantics) in f32 and in emulated f64 on the same problem and
-report final residual and solution-norm deltas.
+"""Precision accuracy evidence at benchmark scale (SURVEY §7 hard part 1):
+the reference's headline configs are f64; TPUs only emulate f64, so the
+flagship benchmark numbers here are f32 (with --f64_impl df32 as the
+double-float middle ground). This artifact runs the SAME fixed-iteration
+CG (rtol = 0, cg.hpp:88-91 semantics) in f32, emulated f64 and df32 on the
+same problem and reports, for each, the residual evaluated through the
+TRUE f64 operator plus solution deltas vs the f64 run.
 
 The problem size is chosen so the f64 run is tractable (~80x slower than
 f32); the iteration count matches the benchmark's 1000. Writes JSON:
@@ -18,45 +19,90 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+DEGREE, QMODE = 3, 1
 
-def run(float_bits: int, ndofs: int, nreps: int):
+
+def _hermetic():
     # Hermetic CPU runs must undo the axon tunnel hook (see utils.hermetic)
     if os.environ.get("JAX_PLATFORMS", "") == "cpu":
         from bench_tpu_fem.utils.hermetic import force_host_cpu_devices
 
         force_host_cpu_devices(1)
-    import jax
 
-    if float_bits == 64:
-        jax.config.update("jax_enable_x64", True)
-    import jax.numpy as jnp
-    import numpy as np
 
+def _setup(ndofs: int):
     from bench_tpu_fem.elements import build_operator_tables
-    from bench_tpu_fem.la.cg import cg_solve
     from bench_tpu_fem.mesh.box import create_box_mesh
     from bench_tpu_fem.mesh.sizing import compute_mesh_size
-    from bench_tpu_fem.ops.kron import build_kron_laplacian, device_rhs_uniform
 
-    dtype = jnp.float64 if float_bits == 64 else jnp.float32
-    degree, qmode = 3, 1
-    n = compute_mesh_size(ndofs, degree)
-    mesh = create_box_mesh(n)
-    t = build_operator_tables(degree, qmode)
-    op = build_kron_laplacian(mesh, degree, qmode, dtype=dtype, tables=t)
-    b = jax.jit(lambda: device_rhs_uniform(t, mesh.n, dtype))()
+    n = compute_mesh_size(ndofs, DEGREE)
+    return create_box_mesh(n), build_operator_tables(DEGREE, QMODE)
 
-    x = jax.jit(
-        lambda A, b: cg_solve(A.apply, b, jnp.zeros_like(b), nreps)
-    )(op, b)
-    x.block_until_ready()
-    r = b - jax.jit(op.apply)(x)
-    return {
-        "x": np.asarray(x, np.float64),
-        "xnorm": float(jnp.linalg.norm(x)),
-        "rnorm": float(jnp.linalg.norm(r)),
-        "bnorm": float(jnp.linalg.norm(b)),
-    }
+
+def _with_x64(value: bool):
+    """Set jax_enable_x64, returning the previous value (callers restore:
+    leaking the flag between runs is exactly the bug class the driver's
+    save/restore fixed — each precision must trace in its own regime)."""
+    import jax
+
+    prev = jax.config.jax_enable_x64
+    jax.config.update("jax_enable_x64", value)
+    return prev
+
+
+def run(float_bits: int, ndofs: int, nreps: int):
+    _hermetic()
+    import jax
+
+    prev = _with_x64(float_bits == 64)
+    try:
+        import jax.numpy as jnp
+        import numpy as np
+
+        from bench_tpu_fem.la.cg import cg_solve
+        from bench_tpu_fem.ops.kron import (
+            build_kron_laplacian,
+            device_rhs_uniform,
+        )
+
+        dtype = jnp.float64 if float_bits == 64 else jnp.float32
+        mesh, t = _setup(ndofs)
+        op = build_kron_laplacian(mesh, DEGREE, QMODE, dtype=dtype, tables=t)
+        b = jax.jit(lambda: device_rhs_uniform(t, mesh.n, dtype))()
+        x = jax.jit(
+            lambda A, bb: cg_solve(A.apply, bb, jnp.zeros_like(bb), nreps)
+        )(op, b)
+        x.block_until_ready()
+        return np.asarray(x, np.float64)
+    finally:
+        _with_x64(prev)
+
+
+def run_df32(ndofs: int, nreps: int):
+    """--f64_impl df32 on the same problem, traced with x64 OFF exactly as
+    the shipped configuration runs (driver forces it off for df32)."""
+    _hermetic()
+    import jax
+
+    prev = _with_x64(False)
+    try:
+        import numpy as np
+
+        from bench_tpu_fem.la.df64 import df_to_f64
+        from bench_tpu_fem.ops.kron_df import (
+            build_kron_laplacian_df,
+            cg_solve_df,
+            device_rhs_uniform_df,
+        )
+
+        mesh, t = _setup(ndofs)
+        op = build_kron_laplacian_df(mesh, DEGREE, QMODE, tables=t)
+        b = device_rhs_uniform_df(t, mesh.n)
+        x = jax.jit(lambda A, bb: cg_solve_df(A, bb, nreps))(op, b)
+        jax.block_until_ready(x)
+        return np.asarray(df_to_f64(x), np.float64)
+    finally:
+        _with_x64(prev)
 
 
 def main() -> int:
@@ -66,20 +112,54 @@ def main() -> int:
 
     import numpy as np
 
-    r32 = run(32, ndofs, nreps)
-    r64 = run(64, ndofs, nreps)
-    dx = np.linalg.norm(r32["x"] - r64["x"]) / np.linalg.norm(r64["x"])
+    x32 = run(32, ndofs, nreps)
+    x64 = run(64, ndofs, nreps)
+    xdf = run_df32(ndofs, nreps)
+
+    # Evaluate every solution's residual through the TRUE f64 operator —
+    # a self-residual through each run's own operator could not expose
+    # operator-level precision error.
+    _hermetic()
+    prev = _with_x64(True)
+    try:
+        import jax
+        import jax.numpy as jnp
+
+        from bench_tpu_fem.ops.kron import (
+            build_kron_laplacian,
+            device_rhs_uniform,
+        )
+
+        mesh, t = _setup(ndofs)
+        op64 = build_kron_laplacian(mesh, DEGREE, QMODE, dtype=jnp.float64,
+                                    tables=t)
+        b64 = jax.jit(
+            lambda: device_rhs_uniform(t, mesh.n, jnp.float64))()
+        bnorm = float(jnp.linalg.norm(b64))
+        apply64 = jax.jit(op64.apply)
+
+        def true_rel_res(x):
+            return float(jnp.linalg.norm(b64 - apply64(jnp.asarray(x)))
+                         ) / bnorm
+
+        res = {k: true_rel_res(v) for k, v in
+               (("f32", x32), ("f64", x64), ("df32", xdf))}
+    finally:
+        _with_x64(prev)
+
+    x64n = np.linalg.norm(x64)
     doc = {
-        "config": {"degree": 3, "qmode": 1, "cg_nreps": nreps,
+        "config": {"degree": DEGREE, "qmode": QMODE, "cg_nreps": nreps,
                    "ndofs": ndofs, "backend": "kron (uniform flagship)"},
-        "f32": {k: v for k, v in r32.items() if k != "x"},
-        "f64": {k: v for k, v in r64.items() if k != "x"},
-        "solution_rel_l2_diff_f32_vs_f64": float(dx),
-        "solution_norm_rel_diff": float(
-            abs(r32["xnorm"] - r64["xnorm"]) / r64["xnorm"]
-        ),
-        "final_rel_residual_f32": r32["rnorm"] / r32["bnorm"],
-        "final_rel_residual_f64": r64["rnorm"] / r64["bnorm"],
+        "xnorm": {"f32": float(np.linalg.norm(x32)), "f64": float(x64n),
+                  "df32": float(np.linalg.norm(xdf))},
+        "solution_rel_l2_diff_f32_vs_f64": float(
+            np.linalg.norm(x32 - x64) / x64n),
+        "solution_rel_l2_diff_df32_vs_f64": float(
+            np.linalg.norm(xdf - x64) / x64n),
+        "true_rel_residual_f32": res["f32"],
+        "true_rel_residual_f64": res["f64"],
+        "true_rel_residual_df32": res["df32"],
     }
     with open(out_path, "w") as fh:
         json.dump(doc, fh, indent=1)
