@@ -17,7 +17,6 @@ Problem size adapts downward if the chip's HBM cannot hold the default.
 
 import json
 import sys
-import time
 
 
 BASELINE_GDOF_PER_GPU = 4.02  # GH200 per-GPU, Q3-300M, reference examples/

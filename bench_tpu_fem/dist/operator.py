@@ -29,7 +29,7 @@ from ..ops.laplacian import (
     freeze_table,
     gather_cells,
 )
-from .halo import halo_refresh, masked_dot, owned_mask, reverse_scatter_add
+from .halo import halo_refresh, reverse_scatter_add
 from .mesh import shard_cells
 
 

@@ -24,7 +24,6 @@ from functools import partial
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 from ..elements.tables import OperatorTables, build_operator_tables
 from ..la.df64 import (

@@ -1,7 +1,6 @@
 import jax
 import jax.numpy as jnp
 import numpy as np
-import scipy.sparse.linalg as spla
 
 from bench_tpu_fem.elements import build_operator_tables
 from bench_tpu_fem.fem import (

@@ -2,9 +2,8 @@
 and the reference's CI golden value (/root/reference/src/test_output.py:19)."""
 
 import numpy as np
-import pytest
 
-from bench_tpu_fem.elements import build_operator_tables, gll_nodes
+from bench_tpu_fem.elements import build_operator_tables
 from bench_tpu_fem.fem import (
     assemble_csr,
     assemble_rhs,

@@ -7,7 +7,7 @@ import numpy as np
 import pytest
 
 from bench_tpu_fem.elements import build_operator_tables
-from bench_tpu_fem.mesh import boundary_dof_marker, create_box_mesh, dof_grid_shape
+from bench_tpu_fem.mesh import create_box_mesh, dof_grid_shape
 from bench_tpu_fem.ops import build_laplacian
 from bench_tpu_fem.ops.laplacian import _sumfact_cell_apply, gather_cells
 from bench_tpu_fem.ops.pallas_laplacian import pallas_cell_apply
