@@ -174,3 +174,18 @@ def test_engine_cg_with_pallas_update_matches():
     x = kron_cg_solve(op, b, 12, interpret=True, pallas_update=True)
     rel = float(jnp.linalg.norm(x - x_ref) / jnp.linalg.norm(x_ref))
     assert rel < 5e-5
+
+
+@pytest.mark.parametrize("degree", [1, 3])
+def test_engine_qmode0_matches_xla(degree):
+    """qmode 0 (collocation quadrature) changes the 1D factors; the engine
+    must track the XLA path there too."""
+    op, opx, b = _setup(degree, (3, 4, 5), qmode=0)
+    y_ref = opx.apply(b)
+    y = kron_apply_ring(op, b, interpret=True)
+    rel = float(jnp.linalg.norm(y - y_ref) / jnp.linalg.norm(y_ref))
+    assert rel < 5e-6
+    x_ref = cg_solve(opx.apply, b, jnp.zeros_like(b), 10)
+    x = kron_cg_solve(op, b, 10, interpret=True)
+    rel = float(jnp.linalg.norm(x - x_ref) / jnp.linalg.norm(x_ref))
+    assert rel < 5e-5
