@@ -1,0 +1,165 @@
+"""Run the full TPU measurement agenda for round 4, logging each step as
+it lands (a mid-run tunnel wedge preserves completed steps).
+
+Usage: python scripts/measure_all.py [stage...]
+Stages (default all): health ab12 q6 large deg4 df32 matrix bench
+"""
+import json
+import subprocess
+import sys
+import time
+
+LOG = "MEASURE_r04.log"
+
+
+def log(msg):
+    line = f"[{time.strftime('%H:%M:%S')}] {msg}"
+    print(line, flush=True)
+    with open(LOG, "a") as fh:
+        fh.write(line + "\n")
+
+
+def run_py(code, timeout=900):
+    r = subprocess.run(
+        [sys.executable, "-u", "-c", code], capture_output=True, text=True,
+        timeout=timeout, cwd="/root/repo",
+        env={**__import__("os").environ,
+             "PYTHONPATH": "/root/repo:/root/.axon_site"},
+    )
+    out = (r.stdout + r.stderr).strip().splitlines()
+    keep = [ln for ln in out if not ln.lower().startswith("warning")
+            and "Platform 'axon'" not in ln]
+    return r.returncode, "\n".join(keep[-25:])
+
+
+PRE = """
+import time, numpy as np, jax, jax.numpy as jnp
+from bench_tpu_fem.bench.driver import BenchConfig, run_benchmark
+def timed_res(cfg):
+    t0 = time.time(); res = run_benchmark(cfg); w = time.time()-t0
+    return res, w
+"""
+
+
+def stage_health():
+    rc, out = run_py(
+        "import jax, jax.numpy as jnp\n"
+        "x = jax.device_put(jnp.ones((1024,1024)))\n"
+        "(x@x).block_until_ready(); print('TPU OK', jax.devices())",
+        timeout=180,
+    )
+    log(f"health rc={rc}: {out}")
+    return rc == 0
+
+
+def stage_ab12():
+    # engine vs non-engine at the flagship config
+    code = PRE + """
+import bench_tpu_fem.ops.kron_cg as KC
+cfg = BenchConfig(ndofs_global=12_500_000, degree=3, qmode=1,
+                  float_bits=32, nreps=1000, use_cg=True)
+res, w = timed_res(cfg)
+print("ENGINE:", res.gdof_per_second, res.extra, "ynorm", res.ynorm)
+"""
+    rc, out = run_py(code, timeout=1200)
+    log(f"ab12 engine rc={rc}: {out}")
+    code2 = PRE + """
+# force the non-engine path by monkeypatching the support gate
+import bench_tpu_fem.ops.kron_cg as KC
+KC.supports_kron_cg_engine = lambda *a, **k: False
+cfg = BenchConfig(ndofs_global=12_500_000, degree=3, qmode=1,
+                  float_bits=32, nreps=1000, use_cg=True)
+res, w = timed_res(cfg)
+print("BASELINE3STAGE:", res.gdof_per_second, res.extra, "ynorm", res.ynorm)
+"""
+    rc, out = run_py(code2, timeout=1200)
+    log(f"ab12 baseline rc={rc}: {out}")
+
+
+def stage_q6():
+    code = PRE + """
+cfg = BenchConfig(ndofs_global=12_500_000, degree=6, qmode=1,
+                  float_bits=32, nreps=1000, use_cg=True)
+res, w = timed_res(cfg)
+print("Q6:", res.gdof_per_second, res.extra, "vs4.40:",
+      res.gdof_per_second/4.40)
+"""
+    rc, out = run_py(code, timeout=1800)
+    log(f"q6 rc={rc}: {out}")
+
+
+def stage_large():
+    for nd, reps in ((100_000_000, 100), (128_000_000, 100),
+                     (200_000_000, 50), (300_000_000, 50)):
+        code = PRE + f"""
+cfg = BenchConfig(ndofs_global={nd}, degree=3, qmode=1,
+                  float_bits=32, nreps={reps}, use_cg=True)
+res, w = timed_res(cfg)
+print("LARGE {nd}:", res.gdof_per_second, res.extra, "ynorm", res.ynorm)
+"""
+        try:
+            rc, out = run_py(code, timeout=2400)
+        except subprocess.TimeoutExpired:
+            rc, out = -1, "TIMEOUT"
+        log(f"large {nd} rc={rc}: {out}")
+
+
+def stage_deg4():
+    code = PRE + """
+cfg = BenchConfig(ndofs_global=12_500_000, degree=4, qmode=1,
+                  float_bits=32, nreps=500, use_cg=True,
+                  geom_perturb_fact=0.2)
+res, w = timed_res(cfg)
+print("DEG4PERT:", res.gdof_per_second, res.extra)
+"""
+    rc, out = run_py(code, timeout=1800)
+    log(f"deg4 rc={rc}: {out}")
+
+
+def stage_df32():
+    code = PRE + """
+cfg = BenchConfig(ndofs_global=2_000_000, degree=3, qmode=1,
+                  float_bits=64, nreps=50, use_cg=True, f64_impl="df32")
+res, w = timed_res(cfg)
+print("DF32:", res.gdof_per_second, res.extra, "ynorm", res.ynorm)
+cfg = BenchConfig(ndofs_global=2_000_000, degree=3, qmode=1,
+                  float_bits=64, nreps=50, use_cg=True)
+res, w = timed_res(cfg)
+print("EMULATED:", res.gdof_per_second, res.extra, "ynorm", res.ynorm)
+"""
+    rc, out = run_py(code, timeout=1800)
+    log(f"df32 rc={rc}: {out}")
+
+
+def stage_matrix():
+    rc = subprocess.call(
+        [sys.executable, "scripts/baseline_matrix.py",
+         "BASELINE_MATRIX_r04.json"], cwd="/root/repo")
+    log(f"baseline_matrix rc={rc}")
+
+
+def stage_bench():
+    r = subprocess.run([sys.executable, "bench.py"], capture_output=True,
+                       text=True, cwd="/root/repo", timeout=3600)
+    log(f"bench.py rc={r.returncode}: {r.stdout.strip().splitlines()[-1:]}")
+
+
+STAGES = {
+    "health": stage_health, "ab12": stage_ab12, "q6": stage_q6,
+    "large": stage_large, "deg4": stage_deg4, "df32": stage_df32,
+    "matrix": stage_matrix, "bench": stage_bench,
+}
+
+if __name__ == "__main__":
+    wanted = sys.argv[1:] or list(STAGES)
+    if "health" in wanted and not stage_health():
+        log("tunnel down; aborting")
+        sys.exit(1)
+    for s in wanted:
+        if s == "health":
+            continue
+        log(f"=== stage {s}")
+        try:
+            STAGES[s]()
+        except Exception as e:
+            log(f"stage {s} EXC: {e}")
