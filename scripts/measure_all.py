@@ -4,12 +4,14 @@ it lands (a mid-run tunnel wedge preserves completed steps).
 Usage: python scripts/measure_all.py [stage...]
 Stages (default all): health ab12 q6 large deg4 df32 matrix bench
 """
-import json
+import os
 import subprocess
 import sys
 import time
 
-LOG = "MEASURE_r04.log"
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LOG = os.path.join(ROOT, "MEASURE_r04.log")
+ENV = {**os.environ, "PYTHONPATH": f"{ROOT}:/root/.axon_site"}
 
 
 def log(msg):
@@ -19,17 +21,27 @@ def log(msg):
         fh.write(line + "\n")
 
 
-def run_py(code, timeout=900):
-    r = subprocess.run(
-        [sys.executable, "-u", "-c", code], capture_output=True, text=True,
-        timeout=timeout, cwd="/root/repo",
-        env={**__import__("os").environ,
-             "PYTHONPATH": "/root/repo:/root/.axon_site"},
-    )
+def _run(cmd, timeout, tail=25):
+    """Shared runner: same env/cwd/timeout handling for every stage. A
+    hang (wedged tunnel) is reported as rc=-9 with a TIMEOUT tail instead
+    of propagating — the agenda must keep logging whatever it can."""
+    try:
+        r = subprocess.run(cmd, capture_output=True, text=True,
+                           timeout=timeout, cwd=ROOT, env=ENV)
+    except subprocess.TimeoutExpired:
+        return -9, f"TIMEOUT after {timeout}s"
     out = (r.stdout + r.stderr).strip().splitlines()
     keep = [ln for ln in out if not ln.lower().startswith("warning")
             and "Platform 'axon'" not in ln]
-    return r.returncode, "\n".join(keep[-25:])
+    return r.returncode, "\n".join(keep[-tail:])
+
+
+def run_py(code, timeout=900):
+    return _run([sys.executable, "-u", "-c", code], timeout)
+
+
+def run_script(args, timeout):
+    return _run([sys.executable] + args, timeout, tail=15)
 
 
 PRE = """
@@ -55,7 +67,6 @@ def stage_health():
 def stage_ab12():
     # engine vs non-engine at the flagship config
     code = PRE + """
-import bench_tpu_fem.ops.kron_cg as KC
 cfg = BenchConfig(ndofs_global=12_500_000, degree=3, qmode=1,
                   float_bits=32, nreps=1000, use_cg=True)
 res, w = timed_res(cfg)
@@ -97,10 +108,7 @@ cfg = BenchConfig(ndofs_global={nd}, degree=3, qmode=1,
 res, w = timed_res(cfg)
 print("LARGE {nd}:", res.gdof_per_second, res.extra, "ynorm", res.ynorm)
 """
-        try:
-            rc, out = run_py(code, timeout=2400)
-        except subprocess.TimeoutExpired:
-            rc, out = -1, "TIMEOUT"
+        rc, out = run_py(code, timeout=2400)
         log(f"large {nd} rc={rc}: {out}")
 
 
@@ -132,16 +140,16 @@ print("EMULATED:", res.gdof_per_second, res.extra, "ynorm", res.ynorm)
 
 
 def stage_matrix():
-    rc = subprocess.call(
-        [sys.executable, "scripts/baseline_matrix.py",
-         "BASELINE_MATRIX_r04.json"], cwd="/root/repo")
-    log(f"baseline_matrix rc={rc}")
+    rc, out = run_script(
+        ["scripts/baseline_matrix.py", "BASELINE_MATRIX_r04.json"],
+        timeout=7200,
+    )
+    log(f"baseline_matrix rc={rc}: {out}")
 
 
 def stage_bench():
-    r = subprocess.run([sys.executable, "bench.py"], capture_output=True,
-                       text=True, cwd="/root/repo", timeout=3600)
-    log(f"bench.py rc={r.returncode}: {r.stdout.strip().splitlines()[-1:]}")
+    rc, out = run_script(["bench.py"], timeout=3600)
+    log(f"bench.py rc={rc}: {out}")
 
 
 STAGES = {
@@ -152,6 +160,11 @@ STAGES = {
 
 if __name__ == "__main__":
     wanted = sys.argv[1:] or list(STAGES)
+    unknown = [s for s in wanted if s not in STAGES]
+    if unknown:
+        print(f"unknown stage(s) {unknown}; valid: {list(STAGES)}",
+              file=sys.stderr)
+        sys.exit(2)
     if "health" in wanted and not stage_health():
         log("tunnel down; aborting")
         sys.exit(1)
