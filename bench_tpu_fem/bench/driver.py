@@ -431,15 +431,35 @@ def _run_benchmark(cfg: BenchConfig) -> BenchmarkResults:
             if engine:
                 engine_cg = lambda A, b: kron_cg_solve(A, b, cfg.nreps)  # noqa: E731
                 engine_apply = kron_apply_ring
-        apply_fn = (lambda A: A.apply_cg) if folded else (lambda A: A.apply)
+        unfused_apply = (
+            (lambda A: A.apply_cg) if folded else (lambda A: A.apply)
+        )
+
+        def _record_engine_failure(exc):
+            res.extra["cg_engine"] = False
+            res.extra["cg_engine_error"] = (
+                f"{type(exc).__name__}: {exc}"[:300]
+            )
+
+        apply_fn = unfused_apply
         if engine:
             apply_fn = lambda A: partial(engine_apply, A)  # noqa: E731
         if cfg.use_cg:
             if engine:
-                fn = jax.jit(
-                    lambda A, b, x0: engine_cg(A, b)
-                ).lower(op, u, jnp.zeros_like(u)).compile()
-            else:
+                # A Mosaic rejection of the fused engine (e.g. a VMEM or
+                # lowering limit this config's estimates missed) must not
+                # sink the benchmark: fall back to the unfused path and
+                # record why. Compile errors only — execution errors
+                # propagate (a fallback there could mask wrong results).
+                try:
+                    fn = jax.jit(
+                        lambda A, b, x0: engine_cg(A, b)
+                    ).lower(op, u, jnp.zeros_like(u)).compile()
+                except Exception as exc:
+                    engine = False
+                    _record_engine_failure(exc)
+                    apply_fn = unfused_apply
+            if not engine:
                 fn = jax.jit(
                     lambda A, b, x0: cg_solve(apply_fn(A), b, x0, cfg.nreps)
                 ).lower(op, u, jnp.zeros_like(u)).compile()
@@ -454,15 +474,28 @@ def _run_benchmark(cfg: BenchConfig) -> BenchmarkResults:
             # ties the apply's input to the loop carry so no present or
             # future XLA pass can hoist the loop-invariant apply out of the
             # timed loop (a zero-cost compiler fence, no data movement).
-            def _rep(i, y, A, x):
+            def _rep(i, y, A, x, af):
                 xx, _ = jax.lax.optimization_barrier((x, y))
-                return apply_fn(A)(xx)
+                return af(A)(xx)
 
-            fn = jax.jit(
-                lambda A, x: jax.lax.fori_loop(
-                    0, cfg.nreps, partial(_rep, A=A, x=x), jnp.zeros_like(x)
-                )
-            ).lower(op, u).compile()
+            def _compile_action(af):
+                return jax.jit(
+                    lambda A, x: jax.lax.fori_loop(
+                        0, cfg.nreps, partial(_rep, A=A, x=x, af=af),
+                        jnp.zeros_like(x),
+                    )
+                ).lower(op, u).compile()
+
+            try:
+                fn = _compile_action(apply_fn)
+            except Exception as exc:
+                if not engine:  # nothing to fall back to
+                    raise
+                # engine apply failed to compile: unfused fallback (same
+                # rationale as the CG branch above)
+                engine = False
+                _record_engine_failure(exc)
+                fn = _compile_action(unfused_apply)
             warm = fn(op, u)
         # One warm-up execution (fenced): first execution pays one-time
         # transfer/initialisation costs that are not operator throughput.

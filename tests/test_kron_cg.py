@@ -189,3 +189,28 @@ def test_engine_qmode0_matches_xla(degree):
     x = kron_cg_solve(op, b, 10, interpret=True)
     rel = float(jnp.linalg.norm(x - x_ref) / jnp.linalg.norm(x_ref))
     assert rel < 5e-5
+
+
+def test_driver_falls_back_when_engine_compile_fails(monkeypatch):
+    """A Mosaic rejection of the fused engine must not sink a benchmark
+    run: the driver records the error and completes on the unfused path."""
+    import bench_tpu_fem.ops.kron_cg as KC
+    import bench_tpu_fem.ops.kron_pallas as KP
+    from bench_tpu_fem.bench.driver import BenchConfig, run_benchmark
+
+    def boom(*a, **k):
+        raise RuntimeError("Mosaic says no")
+
+    monkeypatch.setattr(KC, "kron_cg_solve", boom)
+    monkeypatch.setattr(KC, "supports_kron_cg_engine", lambda *a: True)
+    # pretend we are on TPU so the engine branch engages; the fallback
+    # apply then auto-resolves to pallas, which must interpret on CPU
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    monkeypatch.setattr(KP, "_use_interpret", lambda: True)
+
+    cfg = BenchConfig(ndofs_global=2000, degree=3, qmode=1, float_bits=32,
+                      nreps=3, use_cg=True, ndevices=1)
+    res = run_benchmark(cfg)
+    assert res.extra["cg_engine"] is False
+    assert "Mosaic says no" in res.extra["cg_engine_error"]
+    assert np.isfinite(res.ynorm) and res.ynorm > 0
