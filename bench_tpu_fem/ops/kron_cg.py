@@ -165,8 +165,8 @@ def _x_emit_blend(ring_t12, ring_tyz, cx_ref, i, p_i, gy, gz, P: int,
         # source plane i + d - P; + 2*KI keeps lax.rem's argument
         # non-negative for the first planes
         slot = jax.lax.rem(i + np.int32(d - P + 2 * KI), np.int32(KI))
-        term = (cx_ref[0, d] * ring_t12[slot]
-                + cx_ref[0, 2 * P + 1 + d] * ring_tyz[slot])
+        term = (cx_ref[0, 0, d] * ring_t12[slot]
+                + cx_ref[0, 0, 2 * P + 1 + d] * ring_tyz[slot])
         acc = term if acc is None else acc + term
     # Closed-form Dirichlet mask: boundary dofs are exactly the extreme
     # planes of the structured dof grid, per axis.
@@ -243,11 +243,12 @@ def _make_kron_cg_kernel(P: int, NX: int, NY: int, NZ: int, KI: int,
             y2 = _x_emit_blend(ring_t12, ring_tyz, cx_ref, i, p_i, gy, gz,
                                P, KI, NX, NY, NZ)
             y_out_ref[0] = y2
-            dacc[0, 0] += jnp.sum(p_i * y2)
+            # rank-2 (1,1) stores: Mosaic rejects scalar stores to VMEM
+            dacc[...] = dacc[...] + jnp.sum(p_i * y2)
 
         @pl.when(t == np.int32(NX + D - 1))
         def _finish():
-            dot_ref[0, 0] = dacc[0, 0]
+            dot_ref[...] = dacc[...]
 
     return kernel
 
@@ -317,8 +318,8 @@ def _make_zy_chunk_kernel(P: int, NX: int, NY: int, NZ: int, CY: int,
             )
             # rows [(j-1)CY, (j+2)CY): the chunk's rows start at offset
             # CY - P relative to its -P halo
-            t12, tyz = _y_contract(bufK, bufM, cky_ref, cmy_ref, CY,
-                                   offset=CY - P)
+            t12, tyz = _y_contract(bufK, bufM, cky_ref[0], cmy_ref[0],
+                                   CY, offset=CY - P)
             t12_ref[0] = t12
             tyz_ref[0] = tyz
 
@@ -360,11 +361,12 @@ def _make_x_chunk_kernel(P: int, NX: int, NY: int, NZ: int, CY: int,
             y2 = _x_emit_blend(ring_t12, ring_tyz, cx_ref, i, p_i, gy, gz,
                                P, KI, NX, NY, NZ)
             y_out_ref[0] = y2
-            dacc[0, 0] += jnp.sum(p_i * y2)
+            # rank-2 (1,1) stores: Mosaic rejects scalar stores to VMEM
+            dacc[...] = dacc[...] + jnp.sum(p_i * y2)
 
         @pl.when(xi == np.int32(NX + D - 1))
         def _finish():
-            dot_ref[0, 0] = dacc[0, 0]
+            dot_ref[...] = dacc[...].reshape(1, 1, 1)
 
     return kernel
 
@@ -384,12 +386,18 @@ def _kron_cg_call_chunked(op, update_p: bool, interpret, *vectors):
 
     cx_rows = jnp.concatenate(
         [(op.kappa * op.Md[0]).T, (op.kappa * op.Kd[0]).T], axis=1
-    ).astype(dtype)  # (NX, 2(2P+1))
+    ).astype(dtype)[:, None, :]  # (NX, 1, 2(2P+1)) — see _kron_cg_call
     # y coefficients, zero-padded to the chunk grid (the zero columns keep
-    # garbage source rows out of valid outputs, as in banded_diags)
+    # garbage source rows out of valid outputs, as in banded_diags), laid
+    # out chunk-major (NYB, nb, CY) so each grid step's block covers the
+    # full trailing (nb, CY) axes — Mosaic rejects partial trailing-dim
+    # blocks that aren't (8,128)-divisible (a (nb, CY) block over
+    # (nb, NYB*CY) is such a block).
     pad_y = NYB * CY - NY
     cky = jnp.pad(op.Kd[1].astype(dtype), ((0, 0), (0, pad_y)))
     cmy = jnp.pad(op.Md[1].astype(dtype), ((0, 0), (0, pad_y)))
+    cky = cky.reshape(nb, NYB, CY).transpose(1, 0, 2)
+    cmy = cmy.reshape(nb, NYB, CY).transpose(1, 0, 2)
 
     def in_map(xi, yj):
         return (xi, jax.lax.min(yj, np.int32(NYB - 1)), 0)
@@ -419,8 +427,8 @@ def _kron_cg_call_chunked(op, update_p: bool, interpret, *vectors):
         operands.append(coeff.astype(dtype))
     for coeff in (cky, cmy):
         in_specs.append(pl.BlockSpec(
-            (nb, CY),
-            lambda xi, yj: (0, jax.lax.max(yj - 1, np.int32(0))),
+            (1, nb, CY),
+            lambda xi, yj: (jax.lax.max(yj - 1, np.int32(0)), 0, 0),
             memory_space=pltpu.VMEM,
         ))
         operands.append(coeff)
@@ -467,7 +475,7 @@ def _kron_cg_call_chunked(op, update_p: bool, interpret, *vectors):
 
     def cx_map(yj, xi):
         return (jax.lax.clamp(np.int32(0), xi - np.int32(D),
-                              np.int32(NX - 1)), 0)
+                              np.int32(NX - 1)), 0, 0)
 
     y, dot = pl.pallas_call(
         _make_x_chunk_kernel(P, NX, NY, NZ, CY, KI),
@@ -476,16 +484,16 @@ def _kron_cg_call_chunked(op, update_p: bool, interpret, *vectors):
             pl.BlockSpec((1, CY, NZ), x_in_map, memory_space=pltpu.VMEM),
             pl.BlockSpec((1, CY, NZ), x_in_map, memory_space=pltpu.VMEM),
             pl.BlockSpec((1, CY, NZ), x_lag_map, memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 2 * nb), cx_map, memory_space=pltpu.SMEM),
+            pl.BlockSpec((1, 1, 2 * nb), cx_map, memory_space=pltpu.SMEM),
         ],
         out_specs=[
             pl.BlockSpec((1, CY, NZ), x_lag_map, memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, 1), lambda yj, xi: (yj, 0),
+            pl.BlockSpec((1, 1, 1), lambda yj, xi: (yj, 0, 0),
                          memory_space=pltpu.VMEM),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((NX, NY, NZ), dtype),
-            jax.ShapeDtypeStruct((NYB, 1), dtype),
+            jax.ShapeDtypeStruct((NYB, 1, 1), dtype),
         ],
         scratch_shapes=[
             pltpu.VMEM((KI, CY, NZ), dtype),
@@ -513,11 +521,15 @@ def _kron_cg_call(op, update_p: bool, interpret, *vectors):
     dtype = vectors[0].dtype
 
     # kappa folds into the x coefficients; both banded tables ride one
-    # (NX, 2(2P+1)) array whose row i is streamed into SMEM at emit step.
+    # (NX, 1, 2(2P+1)) array whose row i is streamed into SMEM at emit
+    # step. The singleton middle axis makes the block's last-two dims
+    # equal the array's — Mosaic requires (8,128)-divisible or full-dim
+    # blocks in the trailing two axes, and a (1, 2nb) block over an
+    # (NX, 2nb) array violates that (sublane dim 1 vs NX).
     # jnp throughout: op is a traced pytree argument inside jit.
     cx_rows = jnp.concatenate(
         [(op.kappa * op.Md[0]).T, (op.kappa * op.Kd[0]).T], axis=1
-    ).astype(dtype)  # (NX, 2(2P+1))
+    ).astype(dtype)[:, None, :]  # (NX, 1, 2(2P+1))
 
     def clamp_in(t):
         return (jax.lax.min(t, np.int32(NX - 1)), 0, 0)
@@ -527,7 +539,7 @@ def _kron_cg_call(op, update_p: bool, interpret, *vectors):
 
     def cx_map(t):
         return (jax.lax.clamp(np.int32(0), t - np.int32(D),
-                              np.int32(NX - 1)), 0)
+                              np.int32(NX - 1)), 0, 0)
 
     nb = 2 * P + 1
     in_specs = []
@@ -551,7 +563,7 @@ def _kron_cg_call(op, update_p: bool, interpret, *vectors):
         in_specs.append(pl.BlockSpec((nb, n_ax), lambda t: (0, 0),
                                      memory_space=pltpu.VMEM))
         operands.append(coeff.astype(dtype))
-    in_specs.append(pl.BlockSpec((1, 2 * nb), cx_map,
+    in_specs.append(pl.BlockSpec((1, 1, 2 * nb), cx_map,
                                  memory_space=pltpu.SMEM))
     operands.append(cx_rows)
     in_specs.append(pl.BlockSpec((1, 1), lambda t: (0, 0),
@@ -616,12 +628,13 @@ def _make_update_kernel(NX: int, NY: int, NZ: int, CY: int):
         gy = (yj * np.int32(CY)
               + jax.lax.broadcasted_iota(jnp.int32, (CY, NZ), 0))
         r1m = jax.lax.select(gy < np.int32(NY), r1, jnp.zeros_like(r1))
-        racc[0, 0] += jnp.sum(r1m * r1m)
+        # rank-2 (1,1) stores: Mosaic rejects scalar stores to VMEM
+        racc[...] = racc[...] + jnp.sum(r1m * r1m)
 
         @pl.when(jnp.logical_and(xi == np.int32(NX - 1),
                                  yj == np.int32(-(-NY // CY) - 1)))
         def _finish():
-            rr_ref[0, 0] = racc[0, 0]
+            rr_ref[...] = racc[...]
 
     return kernel
 
