@@ -371,6 +371,19 @@ def _make_x_chunk_kernel(P: int, NX: int, NY: int, NZ: int, CY: int,
     return kernel
 
 
+def _cx_rows(op, dtype):
+    """Per-output-plane x coefficients [M-row | K-row], kappa folded in,
+    as an (NX, 1, 2(2P+1)) array streamed one row per emit step via SMEM.
+    The singleton middle axis makes each block's last-two dims equal the
+    array's — Mosaic requires (8,128)-divisible or full-dim blocks in the
+    trailing two axes, and a (1, 2nb) block over an (NX, 2nb) array
+    violates that (sublane dim 1 vs NX). jnp throughout: op is a traced
+    pytree argument inside jit. Shared by both engine forms."""
+    return jnp.concatenate(
+        [(op.kappa * op.Md[0]).T, (op.kappa * op.Kd[0]).T], axis=1
+    ).astype(dtype)[:, None, :]
+
+
 def _kron_cg_call_chunked(op, update_p: bool, interpret, *vectors):
     """Two-kernel (y-chunked) form of _kron_cg_call — same contract, no
     VMEM size ceiling."""
@@ -384,9 +397,7 @@ def _kron_cg_call_chunked(op, update_p: bool, interpret, *vectors):
     nb = 2 * P + 1
     interp = _use_interpret() if interpret is None else interpret
 
-    cx_rows = jnp.concatenate(
-        [(op.kappa * op.Md[0]).T, (op.kappa * op.Kd[0]).T], axis=1
-    ).astype(dtype)[:, None, :]  # (NX, 1, 2(2P+1)) — see _kron_cg_call
+    cx_rows = _cx_rows(op, dtype)
     # y coefficients, zero-padded to the chunk grid (the zero columns keep
     # garbage source rows out of valid outputs, as in banded_diags), laid
     # out chunk-major (NYB, nb, CY) so each grid step's block covers the
@@ -520,16 +531,7 @@ def _kron_cg_call(op, update_p: bool, interpret, *vectors):
     nsteps = NX + D
     dtype = vectors[0].dtype
 
-    # kappa folds into the x coefficients; both banded tables ride one
-    # (NX, 1, 2(2P+1)) array whose row i is streamed into SMEM at emit
-    # step. The singleton middle axis makes the block's last-two dims
-    # equal the array's — Mosaic requires (8,128)-divisible or full-dim
-    # blocks in the trailing two axes, and a (1, 2nb) block over an
-    # (NX, 2nb) array violates that (sublane dim 1 vs NX).
-    # jnp throughout: op is a traced pytree argument inside jit.
-    cx_rows = jnp.concatenate(
-        [(op.kappa * op.Md[0]).T, (op.kappa * op.Kd[0]).T], axis=1
-    ).astype(dtype)[:, None, :]  # (NX, 1, 2(2P+1))
+    cx_rows = _cx_rows(op, dtype)
 
     def clamp_in(t):
         return (jax.lax.min(t, np.int32(NX - 1)), 0, 0)

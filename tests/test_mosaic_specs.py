@@ -1,0 +1,172 @@
+"""Mosaic block-spec lint: interpret mode does not enforce TPU layout
+rules, so a kernel suite can be fully parity-tested on CPU and still fail
+to compile on hardware. Round 4 hit exactly that: the fused kron CG
+engine's coefficient streams used (1, 2nb)-over-(NX, 2nb) and
+(nb, CY)-over-(nb, NYB*CY) blocks, which Mosaic rejects ("the last two
+dimensions of your block shape are divisible by 8 and 128 respectively,
+or be equal to the respective dimensions of the overall array"), and the
+hardware benchmark silently fell back to the unfused path.
+
+This test wraps pl.pallas_call with a recorder, drives every Pallas code
+path we ship (both kron engine forms, the pallas update pass, the 3-stage
+kron apply, the folded fused apply and CG engine in both geometry modes)
+in interpret mode, and statically checks every captured BlockSpec against
+the Mosaic rule — catching the whole bug class on CPU.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.experimental import pallas as pl
+
+from bench_tpu_fem.mesh.box import create_box_mesh
+from bench_tpu_fem.mesh.sizing import compute_mesh_size
+
+
+class SpecRecorder:
+    """Monkeypatch harness: captures (block_shape, array_shape) pairs for
+    every operand/output of every pallas_call issued while active."""
+
+    def __init__(self):
+        self.records = []  # (kernel_name, io, idx, block_shape, arr_shape)
+
+    def patch(self, monkeypatch):
+        orig = pl.pallas_call
+
+        def wrapper(kernel, **kw):
+            fn = orig(kernel, **kw)
+            in_specs = kw.get("in_specs")
+            out_specs = kw.get("out_specs")
+            out_shape = kw.get("out_shape")
+
+            def traced(*operands):
+                name = getattr(kernel, "__name__", str(kernel))
+                if in_specs is not None:
+                    for i, (s, a) in enumerate(zip(in_specs, operands)):
+                        self.records.append(
+                            (name, "in", i, s.block_shape, a.shape)
+                        )
+                outs = (out_shape if isinstance(out_shape, (list, tuple))
+                        else [out_shape])
+                specs = (out_specs if isinstance(out_specs, (list, tuple))
+                         else [out_specs])
+                if out_specs is not None:
+                    for i, (s, a) in enumerate(zip(specs, outs)):
+                        self.records.append(
+                            (name, "out", i, s.block_shape, a.shape)
+                        )
+                return fn(*operands)
+
+            return traced
+
+        monkeypatch.setattr(pl, "pallas_call", wrapper)
+        # modules hold `pl` by reference, so patching the module attribute
+        # reaches every call site; nothing else needed.
+        return self
+
+    def check(self):
+        assert self.records, "no pallas_call captured — wiring broken?"
+        bad = []
+        for name, io, idx, bs, ash in self.records:
+            if bs is None:
+                continue
+            # Mosaic rule: last two block dims must each be divisible by
+            # (8, 128) respectively or equal to the full array dim. For
+            # rank-1 only the lane dim applies.
+            dims = [(-1, 128)] if len(bs) == 1 else [(-2, 8), (-1, 128)]
+            for d, q in dims:
+                if len(ash) < -d:
+                    continue
+                if bs[d] != ash[d] and bs[d] % q != 0:
+                    bad.append((name, io, idx, tuple(bs), tuple(ash), d))
+        assert not bad, (
+            "Mosaic-incompatible block specs (block dim neither full nor "
+            f"(8,128)-divisible):\n" + "\n".join(map(str, bad))
+        )
+
+
+@pytest.fixture
+def recorder(monkeypatch):
+    return SpecRecorder().patch(monkeypatch)
+
+
+def _mesh_op(ndofs, degree, perturb, geom):
+    import bench_tpu_fem.ops.folded as FO
+
+    nc = compute_mesh_size(ndofs, degree)
+    mesh = create_box_mesh(nc, geom_perturb_fact=perturb)
+    return FO.build_folded_laplacian(
+        mesh, degree, qmode=1, dtype=jnp.float32, geom=geom
+    )
+
+
+def _rand(shape):
+    return jnp.asarray(np.random.RandomState(0).rand(*shape), jnp.float32)
+
+
+@pytest.mark.parametrize("degree", [3, 4])
+@pytest.mark.parametrize("chunked", [False, True])
+def test_kron_engine_specs(recorder, degree, chunked, monkeypatch):
+    import bench_tpu_fem.ops.kron_cg as KC
+    from bench_tpu_fem.ops.kron import build_kron_laplacian
+
+    if chunked:
+        monkeypatch.setattr(KC, "VMEM_BUDGET", 0)  # force two-kernel form
+    nc = compute_mesh_size(40_000, degree)
+    mesh = create_box_mesh(nc)
+    op = build_kron_laplacian(mesh, degree, qmode=1, dtype=jnp.float32)
+    shape = tuple(int(a.shape[0]) for a in op.notbc1d)
+    r, p = _rand(shape), _rand(shape)
+    KC._kron_cg_call(op, True, True, r, p, jnp.float32(0.5))
+    KC._kron_cg_call(op, False, True, r)
+    recorder.check()
+
+
+def test_kron_update_pass_specs(recorder):
+    import bench_tpu_fem.ops.kron_cg as KC
+
+    x, p, r, y = (_rand((17, 29, 23)) for _ in range(4))
+    KC.cg_update_pallas(x, p, r, y, jnp.float32(0.3), interpret=True)
+    recorder.check()
+
+
+@pytest.mark.parametrize("degree", [3])
+def test_kron_3stage_specs(recorder, degree):
+    from bench_tpu_fem.ops.kron import build_kron_laplacian
+
+    nc = compute_mesh_size(40_000, degree)
+    mesh = create_box_mesh(nc)
+    op = build_kron_laplacian(mesh, degree, qmode=1, dtype=jnp.float32)
+    shape = tuple(int(a.shape[0]) for a in op.notbc1d)
+    from bench_tpu_fem.ops.kron_pallas import kron_apply_pallas
+
+    kron_apply_pallas(_rand(shape), op.Kd, op.Md, op.notbc1d, op.kappa,
+                      degree, interpret=True)
+    recorder.check()
+
+
+@pytest.mark.parametrize("geom", ["g", "corner"])
+@pytest.mark.parametrize("degree", [3, 4])
+def test_folded_engine_specs(recorder, geom, degree):
+    import bench_tpu_fem.ops.folded_cg as FCG
+
+    op = _mesh_op(40_000, degree, 0.1, geom)
+    lay = op.layout
+    shp = (lay.nblocks, degree ** 3, lay.block)
+    r, p = _rand(shp), _rand(shp)
+    FCG._cg_apply_call(
+        lay, op.geom, op.kappa,
+        np.asarray(op.phi0_c, np.float64), np.asarray(op.dphi1_c, np.float64),
+        op.is_identity, op.geom_tables, True, True, r, p, jnp.float32(0.5),
+    )
+    recorder.check()
+
+
+@pytest.mark.parametrize("geom", ["g", "corner"])
+def test_folded_fused_apply_specs(recorder, geom):
+    op = _mesh_op(40_000, 3, 0.1, geom)
+    lay = op.layout
+    x = _rand((lay.nblocks, 27, lay.block))
+    jax.jit(op.apply_cg)(x)
+    recorder.check()
