@@ -146,9 +146,12 @@ def run_distributed(cfg, res, dtype):
                 n, dgrid, cfg.degree, cfg.qmode, rule, kappa=2.0,
                 dtype=dtype, tables=t,
             )
+            from .kron import resolve_kron_engine
+
             apply_fn, cg_fn, norm_fn = make_kron_sharded_fns(
                 op, dgrid, cfg.nreps
             )
+            res.extra["cg_engine"] = resolve_kron_engine(op)
             if b_host is not None:
                 # mat_comp: feed the oracle-precision host RHS to both paths.
                 u_blocks = shard_grid_blocks(b_host, n, cfg.degree, dgrid.dshape)
@@ -211,7 +214,25 @@ def run_distributed(cfg, res, dtype):
             norm_args = ()
 
         if cfg.use_cg:
-            fn = jax.jit(cg_fn).lower(u, *cg_args).compile()
+            try:
+                fn = jax.jit(cg_fn).lower(u, *cg_args).compile()
+            except Exception as exc:
+                # Same hardening as the single-chip driver: a Mosaic/XLA
+                # rejection of the fused dist engine must not sink the
+                # benchmark — fall back to the unfused sharded CG (whose
+                # main kernel is also collective-independent) and record
+                # why. Only a failure of the *engine* path warrants the
+                # fallback recompile; anything else re-raises unchanged.
+                if not (kron and res.extra.get("cg_engine")):
+                    raise
+                res.extra["cg_engine"] = False
+                res.extra["cg_engine_error"] = (
+                    f"{type(exc).__name__}: {exc}"[:300]
+                )
+                _, cg_fn, _ = make_kron_sharded_fns(
+                    op, dgrid, cfg.nreps, engine=False
+                )
+                fn = jax.jit(cg_fn).lower(u, *cg_args).compile()
             run_args = cg_args
         else:
             # One jitted fori_loop over all reps (same rationale as the

@@ -1,0 +1,172 @@
+"""Distributed fused CG engine for the Kronecker (uniform-mesh) fast path.
+
+The single-chip fused engine (ops.kron_cg) is worth ~1.4x over the unfused
+3-stage composition on a v5e chip (9.14 vs 6.35 GDoF/s at the 12.5M-dof
+flagship config) because the CG iteration is HBM-stream-bound. This module
+carries that engine to x-axis-sharded device meshes (`dshape = (D, 1, 1)`,
+the natural decomposition for the plane-sequential delay ring):
+
+- HALO-EXTENDED INPUT, NO EDGE EPILOGUE: each shard owns dof planes
+  [x0, x0 + Lx) (seam planes shared with neighbours, dist.kron layout).
+  Before the kernel, ONE stacked `lax.ppermute` pair exchanges P planes of
+  (r, p_prev) per side along x (the ICI analogue of the reference's ghost
+  scatter, /root/reference/src/vector.hpp:31-149). The kernel — the
+  *same* `ops.kron_cg` kernel, in its `halo = P` form — then runs the
+  delay-ring recurrence over the extended slab [halo_l | local | halo_r]
+  and emits exactly the local planes: every output row is globally exact
+  by construction, so the 2P-plane boundary recomputation of dist.kron's
+  unfused path disappears entirely.
+- SEAM BIT-CONSISTENCY BY REPLAY: a seam plane is computed by both owners
+  from bitwise-identical inputs through the identical kernel instruction
+  sequence (same plane-local z/y contractions, same ascending-diagonal x
+  sum), and the CG updates use globally psum-reduced scalars — so the
+  duplicated planes stay bit-identical through CG with no refresh, the
+  same invariant tests/test_dist_kron.py pins for the unfused path
+  (tests/test_dist_kron_cg.py asserts the distributed apply is BITWISE
+  equal to the single-chip engine apply).
+- OWNERSHIP IN-KERNEL: the per-plane [interior-in-x, dot-weight] pair
+  streams through SMEM next to the x-coefficient rows; duplicated seam
+  planes get dot-weight 0 so <p, A p> partials count every dof once
+  globally before the psum.
+
+Trade-off vs the unfused distributed path (documented deliberately): the
+kernel input depends on the halo exchange, so the collective is on the
+critical path — the unfused path's main-kernel/collective independence
+(overlap by construction) is given up for ~2x fewer HBM streams per
+iteration. The exchange moves O(P * cross-section) bytes against
+O(volume) compute; on ICI this is microseconds against milliseconds, so
+the stream saving wins at any realistic size (the unfused path remains
+available via `make_kron_sharded_fns(..., engine=False)`, and the dist
+driver falls back to it if this engine fails to compile).
+
+VMEM: the ring holds KI = 2P + 2 full (NY, NZ) cross-section planes; with
+x-only sharding the cross-section does not shrink with the device count,
+so `supports_dist_kron_engine` gates on the same budget as the single-chip
+form and callers fall back to the unfused dist path above it (a y-chunked
+dist form is the natural extension if that ceiling ever matters). Very
+large per-shard blocks route the x/r update through the chunked pallas
+pass exactly like the single-chip solve (PALLAS_UPDATE_MIN_DOFS — the
+XLA TPU backend fails whole-vector fusions around ~130M dofs).
+
+float32 only (Mosaic has no f64); benchmark semantics (rtol = 0, exactly
+nreps iterations, reference cg.hpp:88-91).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from ..la.cg import fused_cg_solve
+from ..ops.kron_cg import (
+    PALLAS_UPDATE_MIN_DOFS,
+    VMEM_BUDGET,
+    _cx_rows,
+    _kron_cg_call,
+    cg_update_pallas,
+    engine_vmem_bytes,
+)
+from .halo import psum_all
+from .kron import DistKronLaplacian, halo_slabs
+from .mesh import AXIS_NAMES
+
+
+def supports_dist_kron_engine(op: DistKronLaplacian) -> bool:
+    """x-only device meshes, f32, one-kernel VMEM budget (see module
+    docstring)."""
+    Lx, NY, NZ = op.L[0], op.notbc1d[1].shape[0], op.notbc1d[2].shape[0]
+    return (
+        op.dshape[1] == 1
+        and op.dshape[2] == 1
+        and op.kappa.dtype == jnp.float32
+        and engine_vmem_bytes((Lx, NY, NZ), op.degree) <= VMEM_BUDGET
+    )
+
+
+def _shard_tables(op: DistKronLaplacian, dtype):
+    """Per-shard SMEM row streams, cut once per jitted computation (inside
+    shard_map, hoisted out of the CG loop): the local x-coefficient rows
+    and the [interior-in-x, dot-weight] aux rows."""
+    P = op.degree
+    Lx = op.L[0]
+    NXg = op.notbc1d[0].shape[0]
+    x0 = lax.axis_index(AXIS_NAMES[0]) * (Lx - 1)
+    cx_global = _cx_rows(op, dtype)  # (NXg, 1, 2(2P+1))
+    z0 = jnp.zeros((), dtype=x0.dtype)
+    cx_local = lax.dynamic_slice(
+        cx_global, (x0, z0, z0), (Lx, 1, 2 * (2 * P + 1))
+    )
+    gx = x0 + jnp.arange(Lx)
+    mi = jnp.logical_and(gx > 0, gx < NXg - 1).astype(dtype)
+    w = jnp.where(jnp.logical_and(jnp.arange(Lx) == 0, x0 > 0),
+                  jnp.zeros((), dtype), jnp.ones((), dtype))
+    aux_local = jnp.stack([mi, w], axis=-1)[:, None, :]  # (Lx, 1, 2)
+    return cx_local, aux_local
+
+
+def _extend_rp(r, p_prev, P: int):
+    """One stacked ppermute pair exchanges the P halo planes of r and
+    p_prev together; returns the halo-extended slabs."""
+    s = jnp.stack([r, p_prev])  # x axis is 1 in the stacked view
+    hl, hr = halo_slabs(s, 1, AXIS_NAMES[0], P)
+    r_ext = jnp.concatenate([hl[0], r, hr[0]], axis=0)
+    p_ext = jnp.concatenate([hl[1], p_prev, hr[1]], axis=0)
+    return r_ext, p_ext
+
+
+def _dist_kron_cg_call(op, cx_local, aux_local, update_p: bool, interpret,
+                       *vectors):
+    """Per-shard engine call: the shared ops.kron_cg kernel in halo form."""
+    return _kron_cg_call(op, update_p, interpret, *vectors,
+                         cx=cx_local, aux=aux_local)
+
+
+def dist_kron_cg_solve_local(op: DistKronLaplacian, b, nreps: int,
+                             interpret: bool | None = None):
+    """Per-shard fused-engine CG (call inside shard_map over an x-only
+    device mesh): returns the local solution block. Matches the unfused
+    dist path (dist.kron.make_kron_sharded_fns cg_fn) to f32 reassociation
+    accuracy, at ~half the HBM streams per iteration."""
+    dtype = b.dtype
+    cx_local, aux_local = _shard_tables(op, dtype)
+    P = op.degree
+    # owned-dof weight per plane for the masked psum inner products (the
+    # same ownership the kernel's aux column 1 applies to <p, A p>)
+    wplane = aux_local[:, 0, 1][:, None, None]
+
+    def inner(u, v):
+        return psum_all(jnp.sum(u * v * wplane))
+
+    def engine(r, p_prev, beta):
+        r_ext, p_ext = _extend_rp(r, p_prev, P)
+        p, y, pdot = _dist_kron_cg_call(
+            op, cx_local, aux_local, True, interpret, r_ext, p_ext, beta
+        )
+        return p, y, psum_all(pdot)
+
+    update = None
+    if b.size >= PALLAS_UPDATE_MIN_DOFS:
+        # Chunked pallas x/r update (single-chip rationale at
+        # ops.kron_cg.PALLAS_UPDATE_MIN_DOFS: XLA TPU fails whole-vector
+        # fusions ~130M dofs). Its <r1,r1> counts every local plane; the
+        # duplicated seam plane is subtracted before the psum.
+        def update(x, pv, r, y, alpha):
+            x1, r1, rr = cg_update_pallas(x, pv, r, y, alpha, interpret)
+            seam0 = jnp.sum(r1[0] * r1[0]) * (1.0 - wplane[0, 0, 0])
+            return x1, r1, psum_all(rr - seam0)
+
+    return fused_cg_solve(engine, b, nreps, update=update, inner=inner)
+
+
+def dist_kron_apply_ring_local(op: DistKronLaplacian, x,
+                               interpret: bool | None = None):
+    """Per-shard single delay-ring apply y = A x (inside shard_map),
+    discarding the fused dot partial — the distributed action-benchmark
+    analogue of ops.kron_cg.kron_apply_ring."""
+    cx_local, aux_local = _shard_tables(op, x.dtype)
+    hl, hr = halo_slabs(x, 0, AXIS_NAMES[0], op.degree)
+    x_ext = jnp.concatenate([hl, x, hr], axis=0)
+    y, _ = _dist_kron_cg_call(
+        op, cx_local, aux_local, False, interpret, x_ext
+    )
+    return y

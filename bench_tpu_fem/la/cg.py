@@ -71,6 +71,7 @@ def fused_cg_solve(
     b: jnp.ndarray,
     nreps: int,
     update: Callable | None = None,
+    inner: Callable | None = None,
 ) -> jnp.ndarray:
     """Shared driver loop for the fused-engine CG paths (ops.folded_cg and
     ops.kron_cg): `engine(r, p_prev, beta) -> (p, y, <p, A p>)` performs
@@ -84,9 +85,11 @@ def fused_cg_solve(
     iterations — reference cg.hpp:88-91); the recurrence is the reference
     loop with the p-update reassociated to the start of the next
     iteration (p1 = r1 + beta*p0), identical per-element operation
-    order."""
+    order. `inner` overrides the inner product (the distributed engine
+    passes an owned-dof-masked psum dot)."""
+    dot = inner_product if inner is None else inner
     x0 = jnp.zeros_like(b)
-    rnorm0 = inner_product(b, b)
+    rnorm0 = dot(b, b)
 
     def body(_, state):
         x, r, p_prev, beta, rnorm = state
@@ -95,7 +98,7 @@ def fused_cg_solve(
         if update is None:
             x1 = x + alpha * p
             r1 = r - alpha * y
-            rnorm1 = inner_product(r1, r1)
+            rnorm1 = dot(r1, r1)
         else:
             x1, r1, rnorm1 = update(x, p, r, y, alpha)
         beta1 = rnorm1 / rnorm

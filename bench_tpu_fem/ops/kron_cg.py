@@ -154,12 +154,14 @@ def _zy_contract(p2, ckz, cmz, cky, cmy, P: int, NY: int, NZ: int):
 
 
 def _x_emit_blend(ring_t12, ring_tyz, cx_ref, i, p_i, gy, gz, P: int,
-                  KI: int, NX: int, NY: int, NZ: int):
+                  KI: int, NX: int, NY: int, NZ: int, mi=None):
     """Banded x contraction from the delay ring + closed-form Dirichlet
-    blend: shared by both engine forms (gy/gz carry the caller's global
-    row/lane indices; virtual-pad rows arrive with p_i = 0 and inter =
-    False, so they emit 0). cx_ref row: [M-coeffs | K-coeffs], kappa
-    folded in."""
+    blend: shared by both engine forms and the distributed engine (gy/gz
+    carry the caller's global row/lane indices; virtual-pad rows arrive
+    with p_i = 0 and inter = False, so they emit 0). cx_ref row:
+    [M-coeffs | K-coeffs], kappa folded in. `mi` overrides the
+    interior-in-x indicator when the caller's plane index `i` is not the
+    global plane index (the distributed engine streams it per plane)."""
     acc = None
     for d in range(2 * P + 1):
         # source plane i + d - P; + 2*KI keeps lax.rem's argument
@@ -170,7 +172,8 @@ def _x_emit_blend(ring_t12, ring_tyz, cx_ref, i, p_i, gy, gz, P: int,
         acc = term if acc is None else acc + term
     # Closed-form Dirichlet mask: boundary dofs are exactly the extreme
     # planes of the structured dof grid, per axis.
-    mi = jnp.logical_and(i > 0, i < np.int32(NX - 1))
+    if mi is None:
+        mi = jnp.logical_and(i > 0, i < np.int32(NX - 1))
     inter = jnp.logical_and(
         mi,
         jnp.logical_and(
@@ -184,8 +187,22 @@ def _x_emit_blend(ring_t12, ring_tyz, cx_ref, i, p_i, gy, gz, P: int,
 
 
 def _make_kron_cg_kernel(P: int, NX: int, NY: int, NZ: int, KI: int,
-                         update_p: bool):
+                         update_p: bool, halo: int = 0):
+    """One-kernel delay-ring CG iteration. `halo = 0` is the single-chip
+    form over the full NX-plane grid. `halo = P` is the distributed form
+    (dist.kron_cg): NX is the shard's local plane count, the input slab is
+    extended by P exchanged halo planes per side, ingest sweeps the
+    NX + 2P extended planes and emit covers exactly the NX local planes —
+    every output row globally exact, no boundary epilogue. In that form
+    the per-plane [interior-in-x, dot-ownership] pair streams via SMEM
+    (aux_ref) since the local plane index is not the global one, and the
+    emit lag is fully absorbed by the trailing halo planes (extra steps
+    would clamp-revisit the final output block and overwrite it with
+    halo-plane garbage), so the grid is exactly NX + 2*halo steps when
+    halo > 0 and NX + P when halo == 0."""
     D = P  # output delay in grid steps
+    n_in = NX + 2 * halo  # ingest sweep length
+    nsteps = n_in if halo else NX + D
 
     def kernel(*refs):
         if update_p:
@@ -194,9 +211,14 @@ def _make_kron_cg_kernel(P: int, NX: int, NY: int, NZ: int, KI: int,
         else:
             (x_ref,) = refs[:1]
             ni = 1
-        ckz_ref, cmz_ref, cky_ref, cmy_ref, cx_ref, scal_ref = \
-            refs[ni:ni + 6]
-        base = ni + 6
+        ckz_ref, cmz_ref, cky_ref, cmy_ref, cx_ref = refs[ni:ni + 5]
+        ni += 5
+        aux_ref = None
+        if halo:
+            aux_ref = refs[ni]
+            ni += 1
+        scal_ref = refs[ni]
+        base = ni + 1
         if update_p:
             p_out_ref, y_out_ref, dot_ref = refs[base:base + 3]
             no = 3
@@ -218,11 +240,20 @@ def _make_kron_cg_kernel(P: int, NX: int, NY: int, NZ: int, KI: int,
             dacc[...] = jnp.zeros_like(dacc)
 
         # ---- ingest plane t: p-update, z+y contractions, ring publish ----
-        @pl.when(t < np.int32(NX))
+        @pl.when(t < np.int32(n_in))
         def _ingest():
             if update_p:
                 p2 = scal_ref[0, 0] * pprev_ref[0] + r_ref[0]
-                p_out_ref[0] = p2
+                if halo:
+                    # p is owned for the NX local planes only; the halo
+                    # planes feed the rings but are the neighbours' to
+                    # store.
+                    @pl.when(jnp.logical_and(t >= np.int32(halo),
+                                             t < np.int32(NX + halo)))
+                    def _store_p():
+                        p_out_ref[0] = p2
+                else:
+                    p_out_ref[0] = p2
             else:
                 p2 = x_ref[0]
             slot = jax.lax.rem(t, np.int32(KI))
@@ -234,19 +265,24 @@ def _make_kron_cg_kernel(P: int, NX: int, NY: int, NZ: int, KI: int,
             ring_tyz[slot] = tyz
 
         # ---- emit plane i = t - P: x contraction + blend + dot ----
-        @pl.when(t >= np.int32(D))
+        @pl.when(t >= np.int32(D + halo))
         def _emit():
             i = t - np.int32(D)
             p_i = ring_p[jax.lax.rem(i, np.int32(KI))]
             gy = jax.lax.broadcasted_iota(jnp.int32, (NY, NZ), 0)
             gz = jax.lax.broadcasted_iota(jnp.int32, (NY, NZ), 1)
+            mi = aux_ref[0, 0, 0] > 0.5 if halo else None
             y2 = _x_emit_blend(ring_t12, ring_tyz, cx_ref, i, p_i, gy, gz,
-                               P, KI, NX, NY, NZ)
+                               P, KI, NX, NY, NZ, mi=mi)
             y_out_ref[0] = y2
+            # aux col 1 (dist form): dot-ownership weight, 0 on duplicated
+            # seam planes so <p, A p> counts every dof once globally.
+            w = aux_ref[0, 0, 1] if halo else None
+            term = jnp.sum(p_i * y2)
             # rank-2 (1,1) stores: Mosaic rejects scalar stores to VMEM
-            dacc[...] = dacc[...] + jnp.sum(p_i * y2)
+            dacc[...] = dacc[...] + (w * term if halo else term)
 
-        @pl.when(t == np.int32(NX + D - 1))
+        @pl.when(t == np.int32(nsteps - 1))
         def _finish():
             dot_ref[...] = dacc[...]
 
@@ -519,28 +555,43 @@ def _kron_cg_call_chunked(op, update_p: bool, interpret, *vectors):
     return y, dot_total
 
 
-def _kron_cg_call(op, update_p: bool, interpret, *vectors):
+def _kron_cg_call(op, update_p: bool, interpret, *vectors,
+                  cx=None, aux=None):
     """update_p: vectors = (r, p_prev, beta) -> (p, y, <p, A p>).
-    else:       vectors = (x,)              -> (y, <x, A x>)."""
+    else:       vectors = (x,)              -> (y, <x, A x>).
+
+    With `cx`/`aux` given (the distributed form, dist.kron_cg), vectors
+    are halo-extended (NX + 2P, NY, NZ) local slabs, `cx` carries the
+    per-shard x-coefficient rows, `aux` the per-plane
+    [interior-in-x, dot-ownership] pairs; outputs stay (NX, NY, NZ)."""
     P = op.degree
-    NX, NY, NZ = (int(a.shape[0]) for a in op.notbc1d)
-    if engine_vmem_bytes((NX, NY, NZ), P) > VMEM_BUDGET:
-        return _kron_cg_call_chunked(op, update_p, interpret, *vectors)
+    halo = 0 if cx is None else P
+    if halo == 0:
+        NX, NY, NZ = (int(a.shape[0]) for a in op.notbc1d)
+        if engine_vmem_bytes((NX, NY, NZ), P) > VMEM_BUDGET:
+            return _kron_cg_call_chunked(op, update_p, interpret, *vectors)
+    else:
+        # distributed form (dist.kron_cg): vectors are halo-extended local
+        # slabs; the caller gates VMEM and provides per-shard cx/aux rows.
+        NXe, NY, NZ = (int(d) for d in vectors[0].shape)
+        NX = NXe - 2 * P
     KI = 2 * P + 2
     D = P
-    nsteps = NX + D
+    n_in = NX + 2 * halo
+    nsteps = n_in if halo else NX + D
     dtype = vectors[0].dtype
 
-    cx_rows = _cx_rows(op, dtype)
+    cx_rows = _cx_rows(op, dtype) if cx is None else cx
 
     def clamp_in(t):
-        return (jax.lax.min(t, np.int32(NX - 1)), 0, 0)
+        return (jax.lax.min(t, np.int32(n_in - 1)), 0, 0)
 
     def clamp_out(t):
-        return (jax.lax.max(t - np.int32(D), np.int32(0)), 0, 0)
+        return (jax.lax.clamp(np.int32(0), t - np.int32(D + halo),
+                              np.int32(NX - 1)), 0, 0)
 
-    def cx_map(t):
-        return (jax.lax.clamp(np.int32(0), t - np.int32(D),
+    def clamp_p_out(t):
+        return (jax.lax.clamp(np.int32(0), t - np.int32(halo),
                               np.int32(NX - 1)), 0, 0)
 
     nb = 2 * P + 1
@@ -565,9 +616,13 @@ def _kron_cg_call(op, update_p: bool, interpret, *vectors):
         in_specs.append(pl.BlockSpec((nb, n_ax), lambda t: (0, 0),
                                      memory_space=pltpu.VMEM))
         operands.append(coeff.astype(dtype))
-    in_specs.append(pl.BlockSpec((1, 1, 2 * nb), cx_map,
+    in_specs.append(pl.BlockSpec((1, 1, 2 * nb), clamp_out,
                                  memory_space=pltpu.SMEM))
     operands.append(cx_rows)
+    if halo:
+        in_specs.append(pl.BlockSpec((1, 1, 2), clamp_out,
+                                     memory_space=pltpu.SMEM))
+        operands.append(aux)
     in_specs.append(pl.BlockSpec((1, 1), lambda t: (0, 0),
                                  memory_space=pltpu.SMEM))
     operands.append(beta.astype(dtype).reshape(1, 1))
@@ -575,7 +630,7 @@ def _kron_cg_call(op, update_p: bool, interpret, *vectors):
     out_specs = []
     out_shapes = []
     if update_p:
-        out_specs.append(pl.BlockSpec((1, NY, NZ), clamp_in,
+        out_specs.append(pl.BlockSpec((1, NY, NZ), clamp_p_out,
                                       memory_space=pltpu.VMEM))
         out_shapes.append(jax.ShapeDtypeStruct((NX, NY, NZ), dtype))
     out_specs.append(pl.BlockSpec((1, NY, NZ), clamp_out,
@@ -585,7 +640,7 @@ def _kron_cg_call(op, update_p: bool, interpret, *vectors):
                                   memory_space=pltpu.VMEM))
     out_shapes.append(jax.ShapeDtypeStruct((1, 1), dtype))
 
-    kernel = _make_kron_cg_kernel(P, NX, NY, NZ, KI, update_p)
+    kernel = _make_kron_cg_kernel(P, NX, NY, NZ, KI, update_p, halo=halo)
     out = pl.pallas_call(
         kernel,
         grid=(nsteps,),

@@ -1,0 +1,218 @@
+"""Distributed fused CG engine (dist.kron_cg) on the 8-virtual-CPU mesh.
+
+The strongest check here is BITWISE: the halo-extended delay-ring kernel
+executes the identical instruction sequence as the single-chip engine for
+every plane (same plane-local z/y contractions, same ascending-diagonal x
+sum, same coefficient rows), so the distributed apply must equal the
+single-chip delay-ring apply bit for bit — seam planes included. CG
+solutions then match to f32 reassociation accuracy (the dots psum in a
+different association)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from bench_tpu_fem.dist.kron import build_dist_kron, make_kron_sharded_fns
+from bench_tpu_fem.dist.kron_cg import (
+    dist_kron_apply_ring_local,
+    dist_kron_cg_solve_local,
+    supports_dist_kron_engine,
+)
+from bench_tpu_fem.dist.mesh import AXIS_NAMES, make_device_grid
+from bench_tpu_fem.dist.operator import shard_grid_blocks, unshard_grid_blocks
+from bench_tpu_fem.mesh import create_box_mesh, dof_grid_shape
+from bench_tpu_fem.ops import build_laplacian
+
+
+def _sharded_blocks(x, n, degree, dgrid):
+    sharding = NamedSharding(dgrid.mesh, P(*AXIS_NAMES))
+    return jax.device_put(
+        jnp.asarray(shard_grid_blocks(x, n, degree, dgrid.dshape)), sharding
+    )
+
+
+def _setup(dshape, degree, ncells_x=None):
+    dgrid = make_device_grid(dshape=dshape)
+    n = (ncells_x or 2 * dshape[0], 2, 2)
+    mesh = create_box_mesh(n)
+    op_ref = build_laplacian(mesh, degree, 1, dtype=jnp.float32,
+                             backend="kron")
+    op = build_dist_kron(n, dgrid, degree, 1, dtype=jnp.float32)
+    return dgrid, n, mesh, op_ref, op
+
+
+@pytest.mark.parametrize("dshape,degree", [((4, 1, 1), 3), ((8, 1, 1), 2),
+                                           ((4, 1, 1), 5)])
+def test_dist_engine_apply_bitwise_vs_single_chip(dshape, degree):
+    from bench_tpu_fem.ops.kron_cg import kron_apply_ring
+
+    dgrid, n, mesh, op_ref, op = _setup(dshape, degree)
+    rng = np.random.RandomState(0)
+    x = rng.randn(*dof_grid_shape(n, degree)).astype(np.float32)
+    y_ref = np.asarray(
+        jax.jit(lambda v: kron_apply_ring(op_ref, v, interpret=True))(
+            jnp.asarray(x)
+        )
+    )
+
+    from functools import partial
+
+    @partial(jax.shard_map, mesh=dgrid.mesh,
+             in_specs=(P(*AXIS_NAMES), P()), out_specs=P(*AXIS_NAMES),
+             check_vma=False)
+    def apply_fn(xb, A):
+        return dist_kron_apply_ring_local(A, xb[0, 0, 0],
+                                          interpret=True)[None, None, None]
+
+    xb = _sharded_blocks(x, n, degree, dgrid)
+    yb = np.asarray(jax.jit(apply_fn)(xb, op))
+    blocks_ref = shard_grid_blocks(y_ref, n, degree, dgrid.dshape)
+    assert np.array_equal(yb, blocks_ref), (
+        "distributed delay-ring apply is not bitwise-identical to the "
+        "single-chip engine apply"
+    )
+
+
+@pytest.mark.parametrize("dshape,degree", [((4, 1, 1), 3), ((8, 1, 1), 2)])
+def test_dist_engine_cg_matches_single_chip_engine(dshape, degree):
+    from bench_tpu_fem.ops.kron_cg import kron_cg_solve
+
+    dgrid, n, mesh, op_ref, op = _setup(dshape, degree)
+    rng = np.random.RandomState(5)
+    b = rng.randn(*dof_grid_shape(n, degree)).astype(np.float32)
+    bc = np.asarray(build_laplacian(mesh, degree, 1, dtype=jnp.float32,
+                                    backend="xla").bc_mask)
+    b[bc] = 0.0
+    nreps = 5
+    x_ref = np.asarray(
+        jax.jit(lambda v: kron_cg_solve(op_ref, v, nreps, interpret=True))(
+            jnp.asarray(b)
+        )
+    )
+
+    bb = _sharded_blocks(b, n, degree, dgrid)
+    _, cg_fn, _ = make_kron_sharded_fns(op, dgrid, nreps=nreps, engine=True)
+    xb = np.asarray(jax.jit(cg_fn)(bb, op))
+    x = unshard_grid_blocks(xb, n, degree, dgrid.dshape)
+    scale = np.abs(x_ref).max()
+    np.testing.assert_allclose(x, x_ref, atol=2e-5 * scale)
+
+
+def test_dist_engine_cg_matches_unfused_dist():
+    dshape, degree = (4, 1, 1), 3
+    dgrid, n, mesh, op_ref, op = _setup(dshape, degree)
+    rng = np.random.RandomState(7)
+    b = rng.randn(*dof_grid_shape(n, degree)).astype(np.float32)
+    bc = np.asarray(build_laplacian(mesh, degree, 1, dtype=jnp.float32,
+                                    backend="xla").bc_mask)
+    b[bc] = 0.0
+    nreps = 4
+    bb = _sharded_blocks(b, n, degree, dgrid)
+    _, cg_eng, _ = make_kron_sharded_fns(op, dgrid, nreps=nreps, engine=True)
+    _, cg_unf, _ = make_kron_sharded_fns(op, dgrid, nreps=nreps,
+                                         engine=False)
+    xe = np.asarray(jax.jit(cg_eng)(bb, op))
+    xu = np.asarray(jax.jit(cg_unf)(bb, op))
+    scale = np.abs(xu).max()
+    np.testing.assert_allclose(xe, xu, atol=2e-5 * scale)
+
+
+def test_dist_engine_seam_planes_stay_bitwise():
+    """Both owners of a duplicated seam plane must hold bit-identical
+    values after a full engine CG — the no-ghost-refresh invariant."""
+    dshape, degree = (4, 1, 1), 3
+    dgrid, n, mesh, op_ref, op = _setup(dshape, degree)
+    rng = np.random.RandomState(9)
+    b = rng.randn(*dof_grid_shape(n, degree)).astype(np.float32)
+    bc = np.asarray(build_laplacian(mesh, degree, 1, dtype=jnp.float32,
+                                    backend="xla").bc_mask)
+    b[bc] = 0.0
+    bb = _sharded_blocks(b, n, degree, dgrid)
+    _, cg_fn, _ = make_kron_sharded_fns(op, dgrid, nreps=6, engine=True)
+    xb = np.asarray(jax.jit(cg_fn)(bb, op))
+    Lx = op.L[0]
+    for k in range(dshape[0] - 1):
+        left = xb[k, 0, 0, Lx - 1]
+        right = xb[k + 1, 0, 0, 0]
+        assert np.array_equal(left, right)
+
+
+def test_dist_engine_pdot_counts_owned_once():
+    """<p, A p> from the engine (in-kernel weighted partials + psum) must
+    equal the global dot computed on the unsharded vectors."""
+    from functools import partial
+
+    from bench_tpu_fem.dist.kron_cg import (
+        _dist_kron_cg_call,
+        _extend_rp,
+        _shard_tables,
+    )
+    from bench_tpu_fem.dist.halo import psum_all
+    from bench_tpu_fem.ops.kron_cg import kron_apply_ring
+
+    dshape, degree = (4, 1, 1), 3
+    dgrid, n, mesh, op_ref, op = _setup(dshape, degree)
+    rng = np.random.RandomState(11)
+    shape = dof_grid_shape(n, degree)
+    r = rng.randn(*shape).astype(np.float32)
+    pv = rng.randn(*shape).astype(np.float32)
+    beta = np.float32(0.5)
+
+    @partial(jax.shard_map, mesh=dgrid.mesh,
+             in_specs=(P(*AXIS_NAMES), P(*AXIS_NAMES), P()),
+             out_specs=P(), check_vma=False)
+    def pdot_fn(rb, pb, A):
+        cx, aux = _shard_tables(A, jnp.float32)
+        r_ext, p_ext = _extend_rp(rb[0, 0, 0], pb[0, 0, 0], A.degree)
+        _, _, pdot = _dist_kron_cg_call(A, cx, aux, True, True,
+                                        r_ext, p_ext, jnp.float32(beta))
+        return psum_all(pdot)
+
+    rb = _sharded_blocks(r, n, degree, dgrid)
+    pb = _sharded_blocks(pv, n, degree, dgrid)
+    got = float(jax.jit(pdot_fn)(rb, pb, op))
+
+    p_global = beta * pv + r
+    y_global = np.asarray(
+        jax.jit(lambda v: kron_apply_ring(op_ref, v, interpret=True))(
+            jnp.asarray(p_global)
+        )
+    )
+    want = float(np.sum(p_global.astype(np.float64)
+                        * y_global.astype(np.float64)))
+    np.testing.assert_allclose(got, want, rtol=2e-5)
+
+
+def test_dist_engine_support_gate():
+    """x-only meshes with a VMEM-fitting ring only."""
+    dgrid, n, mesh, op_ref, op = _setup((4, 1, 1), 3)
+    assert supports_dist_kron_engine(op)
+    dgrid2 = make_device_grid(dshape=(2, 2, 2))
+    op2 = build_dist_kron((4, 4, 4), dgrid2, 3, 1, dtype=jnp.float32)
+    assert not supports_dist_kron_engine(op2)
+    op3 = build_dist_kron((8, 2, 2), dgrid, 3, 1, dtype=jnp.float64)
+    assert not supports_dist_kron_engine(op3)
+
+
+def test_dist_engine_solve_local_runs_under_jit():
+    """The full per-shard solve (halos + engine + psum dots) compiles and
+    runs end to end via the public entry point."""
+    dshape, degree = (4, 1, 1), 3
+    dgrid, n, mesh, op_ref, op = _setup(dshape, degree)
+    rng = np.random.RandomState(13)
+    b = rng.randn(*dof_grid_shape(n, degree)).astype(np.float32)
+    bb = _sharded_blocks(b, n, degree, dgrid)
+
+    from functools import partial
+
+    @partial(jax.shard_map, mesh=dgrid.mesh,
+             in_specs=(P(*AXIS_NAMES), P()), out_specs=P(*AXIS_NAMES),
+             check_vma=False)
+    def solve(bb, A):
+        return dist_kron_cg_solve_local(A, bb[0, 0, 0], 3,
+                                        interpret=True)[None, None, None]
+
+    xb = jax.jit(solve)(bb, op)
+    assert np.isfinite(np.asarray(xb)).all()

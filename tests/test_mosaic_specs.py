@@ -170,3 +170,38 @@ def test_folded_fused_apply_specs(recorder, geom):
     x = _rand((lay.nblocks, 27, lay.block))
     jax.jit(op.apply_cg)(x)
     recorder.check()
+
+
+@pytest.mark.parametrize("degree", [3, 5])
+def test_dist_kron_engine_specs(recorder, degree):
+    from functools import partial
+
+    from jax.sharding import PartitionSpec as P
+
+    from bench_tpu_fem.dist.kron import build_dist_kron
+    from bench_tpu_fem.dist.kron_cg import (
+        _dist_kron_cg_call,
+        _extend_rp,
+        _shard_tables,
+    )
+    from bench_tpu_fem.dist.mesh import AXIS_NAMES, make_device_grid
+
+    dgrid = make_device_grid(dshape=(4, 1, 1))
+    n = (8, 2, 2)
+    op = build_dist_kron(n, dgrid, degree, 1, dtype=jnp.float32)
+    Lx, NY, NZ = op.L[0], op.notbc1d[1].shape[0], op.notbc1d[2].shape[0]
+
+    @partial(jax.shard_map, mesh=dgrid.mesh,
+             in_specs=(P(AXIS_NAMES[0]), P(AXIS_NAMES[0]), P()),
+             out_specs=P(AXIS_NAMES[0]), check_vma=False)
+    def run(r, p, A):
+        cx, aux = _shard_tables(A, jnp.float32)
+        r_ext, p_ext = _extend_rp(r, p, A.degree)
+        pp, y, _ = _dist_kron_cg_call(A, cx, aux, True, True,
+                                      r_ext, p_ext, jnp.float32(0.5))
+        return y
+
+    r = _rand((4 * Lx, NY, NZ))  # shard_map blocks the x axis into 4 locals
+    p = _rand((4 * Lx, NY, NZ))
+    jax.jit(run)(r, p, op)
+    recorder.check()
