@@ -49,7 +49,7 @@ from ..mesh.dofmap import boundary_dof_marker
 from .pallas_laplacian import (
     SUBLANES,
     _use_interpret,
-    corner_window_G,
+    corner_apply,
     pick_lanes,
     sumfact_window_apply,
 )
@@ -252,9 +252,9 @@ def _make_folded_kernel(P: int, nl: int, is_identity: bool,
                 r8(u000_ref), r8(ux_ref), r8(uy_ref), r8(uz_ref),
                 r8(uxy_ref), r8(uxz_ref), r8(uyz_ref), r8(uxyz_ref),
             )
-            G = corner_window_G(c_ref[0], m_ref[0], pts1d, wts1d)
-            y = sumfact_window_apply(
-                u, G, kappa_ref[0, 0], phi0, dphi1, is_identity
+            y = corner_apply(
+                u, c_ref[0], m_ref[0], kappa_ref[0, 0], phi0, dphi1,
+                pts1d, wts1d, is_identity
             )
             write_outs(y, *out_refs)
 
@@ -564,12 +564,12 @@ def _make_folded_fused_kernel(P: int, nl: int, B: int, K: int,
             win["xy"], win["xz"], win["yz"], win["xyz"],
         )
         if corner_mode:
-            G = corner_window_G(geom_refs[0][0], geom_refs[1][0],
-                                *geom_tables)
+            y = corner_apply(u, geom_refs[0][0], geom_refs[1][0],
+                             kappa_ref[0, 0], phi0, dphi1, *geom_tables,
+                             is_identity)
         else:
-            G = geom_refs[0][0]
-        y = sumfact_window_apply(u, G, kappa_ref[0, 0], phi0, dphi1,
-                                 is_identity)
+            y = sumfact_window_apply(u, geom_refs[0][0], kappa_ref[0, 0],
+                                     phi0, dphi1, is_identity)
         m = _seam_accumulate(rings, y, i, K, qr, B, nl, P)
         # Dirichlet pass-through in-register (reference
         # laplacian_gpu.hpp:163-169): bc is a streamed 0/1 mask in the
@@ -789,14 +789,24 @@ def check_tpu_lane_support(layout: FoldedLayout, degree: int,
 def pallas_geom_constraint(degree: int, nq: int, itemsize: int = 4):
     """(supported, forced_geom) for the TPU folded Pallas path: full
     128-lane blocks with G streaming when it fits; corner mode's smaller
-    VMEM footprint rescues degree 4 qmode 1 (forced_geom='corner');
-    otherwise unsupported (the driver routes to 'xla'). Single policy
-    shared by resolve_backend and the builders (via resolve_pallas_geom)."""
-    from .pallas_laplacian import corner_lanes_ok, pick_lanes
+    VMEM footprint rescues degree 4 qmode 1, and its plane-streamed form
+    (pallas_laplacian.sumfact_window_apply_corner_streamed — O(nq^2) live
+    geometry) extends that to degree 5 qmode 1 (forced_geom='corner';
+    corner_apply picks cube vs streamed statically from the same
+    estimates); otherwise unsupported (the driver routes to 'xla').
+    Single policy shared by resolve_backend and the builders (via
+    resolve_pallas_geom)."""
+    from .pallas_laplacian import (
+        corner_lanes_ok,
+        corner_streamed_lanes_ok,
+        pick_lanes,
+    )
 
     if pick_lanes(degree + 1, nq, itemsize) == 128:
         return True, None
     if corner_lanes_ok(degree + 1, nq, itemsize):
+        return True, "corner"
+    if corner_streamed_lanes_ok(degree + 1, nq, itemsize):
         return True, "corner"
     return False, None
 

@@ -58,7 +58,7 @@ from ..la.cg import fused_cg_solve
 from .pallas_laplacian import (
     SUBLANES,
     _use_interpret,
-    corner_window_G,
+    corner_apply,
     sumfact_window_apply,
 )
 from .folded import (
@@ -165,12 +165,13 @@ def _make_cg_apply_kernel(P: int, nl: int, B: int, nb: int, KI: int, K: int,
                 win["xy"], win["xz"], win["yz"], win["xyz"],
             )
             if corner_mode:
-                G = corner_window_G(geom_refs[0][0], geom_refs[1][0],
-                                    *geom_tables)
+                y = corner_apply(u, geom_refs[0][0], geom_refs[1][0],
+                                 scal_ref[0, 1], phi0, dphi1,
+                                 *geom_tables, is_identity)
             else:
-                G = geom_refs[0][0]
-            y = sumfact_window_apply(u, G, scal_ref[0, 1], phi0, dphi1,
-                                     is_identity)
+                y = sumfact_window_apply(u, geom_refs[0][0],
+                                         scal_ref[0, 1], phi0, dphi1,
+                                         is_identity)
             m = _seam_accumulate(rings, y, i, K, qr, B, nl, P)
             # Dirichlet pass-through with the bc mask computed IN-KERNEL
             # from the structured-box closed form (no 4 B/dof HBM stream):

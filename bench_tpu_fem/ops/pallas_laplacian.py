@@ -113,6 +113,43 @@ def _stage(mat: np.ndarray, arr, axis: int):
     return jnp.stack(slabs, axis=axis)
 
 
+def _packed_G_from_cols(cols, mask, wts1d: np.ndarray, pre_w: float,
+                        n_wdiag_axes: int):
+    """Shared numerically-sensitive tail of the corner geometry: Jacobian
+    columns -> adjugate rows (cross products) -> detJ -> scale =
+    pre_w * mask / detJ with a diagonal quadrature-weight _stage per
+    remaining tensor axis -> the 6 packed upper-triangle components of
+    w * detJ^-1 * (adj J)(adj J)^T. Exists exactly once so the cube
+    (corner_window_G) and plane-streamed (_corner_plane_G) forms can
+    never diverge — the packing order here is what sumfact_window_apply
+    consumes. Ghost cells must carry an invertible placeholder Jacobian
+    (unit cube, ops.folded.ghost_corner_arrays) so the division stays
+    finite; their mask zeroes the result."""
+
+    def cross(u, v):
+        return (
+            u[1] * v[2] - u[2] * v[1],
+            u[2] * v[0] - u[0] * v[2],
+            u[0] * v[1] - u[1] * v[0],
+        )
+
+    # adjugate rows K[a] = cross of the other two Jacobian columns
+    K = (cross(cols[1], cols[2]), cross(cols[2], cols[0]),
+         cross(cols[0], cols[1]))
+    detJ = (cols[0][0] * K[0][0] + cols[0][1] * K[0][1]
+            + cols[0][2] * K[0][2])
+    # per-axis diagonal weight stages: scalar immediates, Mosaic-friendly
+    scale = (pre_w * mask) / detJ if pre_w != 1.0 else mask / detJ
+    wdiag = np.diag(np.asarray(wts1d, np.float64))
+    for ax in range(n_wdiag_axes):
+        scale = _stage(wdiag, scale, ax)
+    pairs = ((0, 0), (0, 1), (0, 2), (1, 1), (1, 2), (2, 2))
+    return tuple(
+        (K[a][0] * K[b][0] + K[a][1] * K[b][1] + K[a][2] * K[b][2]) * scale
+        for a, b in pairs
+    )
+
+
 def corner_window_G(corners, mask, pts1d: np.ndarray, wts1d: np.ndarray):
     """In-kernel geometry: trilinear Jacobian -> packed G, from the 8 cell
     corners. The streamed-geometry replacement for a precomputed G tensor:
@@ -150,28 +187,146 @@ def corner_window_G(corners, mask, pts1d: np.ndarray, wts1d: np.ndarray):
             col.append(c)  # (nq, nq, nq, 8, NL)
         cols.append(col)
 
-    def cross(u, v):
-        return (
-            u[1] * v[2] - u[2] * v[1],
-            u[2] * v[0] - u[0] * v[2],
-            u[0] * v[1] - u[1] * v[0],
-        )
+    return _packed_G_from_cols(cols, mask, wts1d, 1.0, 3)
 
-    # adjugate rows K[a] = cross of the other two Jacobian columns
-    K = (cross(cols[1], cols[2]), cross(cols[2], cols[0]),
-         cross(cols[0], cols[1]))
-    detJ = (cols[0][0] * K[0][0] + cols[0][1] * K[0][1]
-            + cols[0][2] * K[0][2])
-    # scale = mask * w3 / detJ; w3 = w⊗w⊗w applied as three diagonal stages
-    # (per-plane scalar immediates — Mosaic-friendly, no constant arrays).
-    scale = mask / detJ
-    wdiag = np.diag(np.asarray(wts1d, np.float64))
-    for ax in range(3):
-        scale = _stage(wdiag, scale, ax)
-    pairs = ((0, 0), (0, 1), (0, 2), (1, 1), (1, 2), (2, 2))
-    return tuple(
-        (K[a][0] * K[b][0] + K[a][1] * K[b][1] + K[a][2] * K[b][2]) * scale
-        for a, b in pairs
+
+def _corner_plane_G(corners, mask, pts1d: np.ndarray, wts1d: np.ndarray,
+                    a: int):
+    """One qx-plane of `corner_window_G`: the 6 packed-G components at the
+    nq^2 quadrature points of x-plane `a`, as (nq, nq, 8, NL) arrays. The
+    x-direction shape/derivative tables collapse to their row `a` (scalar
+    immediates), so the per-plane Jacobian costs the same total FLOPs as
+    the full-cube form when summed over planes — but only O(nq^2) values
+    are ever live, which is what lets degree 5 qmode 1 keep full
+    128-lane blocks (see sumfact_window_apply_corner_streamed; degree 6
+    qmode 1 misses the budget by ~10%% even streamed —
+    corner_streamed_lanes_ok)."""
+    pts = np.asarray(pts1d, np.float64)
+    N = np.stack([1.0 - pts, pts], axis=1)  # (nq, 2)
+    D = np.broadcast_to(np.array([-1.0, 1.0]), (len(pts), 2))
+    cols = []
+    for d3 in range(3):
+        T = [N, N, N]
+        T[d3] = D
+        col = []
+        for i in range(3):
+            c = corners[i]  # (2, 2, 2, 8, NL)
+            ca = float(T[0][a, 0]) * c[0] + float(T[0][a, 1]) * c[1]
+            ca = _stage(T[1], ca, 0)
+            ca = _stage(T[2], ca, 1)
+            col.append(ca)  # (nq, nq, 8, NL)
+        cols.append(col)
+
+    return _packed_G_from_cols(cols, mask, wts1d, float(wts1d[a]), 2)
+
+
+def sumfact_window_apply_corner_streamed(u, corners, mask, kappa,
+                                         phi0: np.ndarray,
+                                         dphi1: np.ndarray,
+                                         pts1d: np.ndarray,
+                                         wts1d: np.ndarray,
+                                         is_identity: bool):
+    """Corner-mode contraction chain restructured as a sweep over the nq
+    qx-planes, algebraically identical to
+    `sumfact_window_apply(u, corner_window_G(...), ...)` but with O(nq^2)
+    live geometry instead of the 6*nq^3 G cube:
+
+      u_yz  = phi0_y phi0_z u                      (nd, nq, nq) live
+      per plane a: G_a from the corners; collocation values
+      u_a = phi0_x[a] u_yz and derivatives (du0 via the fused
+      dphi1@phi0 x-table, du1/du2 in-plane); flux planes f0/f1/f2;
+      z_a = dphi1_y^T f1 + dphi1_z^T f2;
+      y_acc[id] += (dphi1@phi0)[a, id] f0 + phi0[a, id] z_a
+      finally y = phi0_y^T phi0_z^T y_acc          (nd, nq, nq) live
+
+    The per-cell live set drops from ~13*nq^3 (cube corner mode) to
+    ~2*nd*nq^2 + nd^3 + O(nq^2), which keeps full 128-lane folded blocks
+    at degree 5 qmode 1 where the cube form (and G streaming) cannot
+    (pick_lanes/corner_lanes_ok; degree 6+ still exceeds the corner VMEM
+    budget and falls back to the XLA path). Same FLOP count to leading order; the
+    folded kernel is HBM-bound so the sweep's extra x-table FMAs are
+    hidden. Numerically: the quadrature-point sums are reassociated
+    (plane-major instead of stage-major), so results match the cube form
+    to f32 rounding, not bitwise — the oracle tests bound the difference."""
+    nq = len(pts1d)
+    if is_identity:
+        u_yz = u
+        dphi_x = np.asarray(dphi1, np.float64)
+        phi_x = np.eye(nq)
+    else:
+        u_yz = _stage(phi0, _stage(phi0, u, 2), 1)  # (nd, nq, nq, 8, NL)
+        dphi_x = np.asarray(dphi1, np.float64) @ np.asarray(phi0, np.float64)
+        phi_x = np.asarray(phi0, np.float64)
+    nd = u_yz.shape[0]
+
+    y_acc = None
+    for a in range(nq):
+        G = _corner_plane_G(corners, mask, pts1d, wts1d, a)
+        # collocation values and x-derivative at plane a (reads all nd
+        # u_yz planes — FMA chains against compile-time rows)
+        ua = None
+        du0 = None
+        for i in range(nd):
+            cv, cd = float(phi_x[a, i]), float(dphi_x[a, i])
+            if cv != 0.0:
+                ua = cv * u_yz[i] if ua is None else ua + cv * u_yz[i]
+            if cd != 0.0:
+                du0 = cd * u_yz[i] if du0 is None else du0 + cd * u_yz[i]
+        du1 = _stage(dphi1, ua, 0)
+        du2 = _stage(dphi1, ua, 1)
+        f0 = kappa * (G[0] * du0 + G[1] * du1 + G[2] * du2)
+        f1 = kappa * (G[1] * du0 + G[3] * du1 + G[4] * du2)
+        f2 = kappa * (G[2] * du0 + G[4] * du1 + G[5] * du2)
+        z_a = _stage(dphi1.T, f1, 0) + _stage(dphi1.T, f2, 1)
+        # scatter plane a into the (nd, nq, nq) x-reduced accumulator
+        contribs = []
+        for i in range(nd):
+            cv, cd = float(phi_x[a, i]), float(dphi_x[a, i])
+            term = None
+            if cd != 0.0:
+                term = cd * f0
+            if cv != 0.0:
+                term = cv * z_a if term is None else term + cv * z_a
+            if term is None:
+                term = jnp.zeros_like(f0)
+            contribs.append(term)
+        plane_acc = jnp.stack(contribs, axis=0)
+        y_acc = plane_acc if y_acc is None else y_acc + plane_acc
+
+    if is_identity:
+        return y_acc
+    return _stage(phi0.T, _stage(phi0.T, y_acc, 2), 1)
+
+
+def corner_streamed_lanes_ok(nd: int, nq: int, itemsize: int = 4) -> bool:
+    """True when the plane-streamed corner kernel fits full 128-lane
+    folded blocks: double-buffered u/y pipeline modelled as 4*nd^3 (the
+    same model corner_lanes_ok uses for the identical streams — the two
+    predicates must not disagree about shared terms), window (nd^3), the
+    two x-reduced accumulators (2*nd*nq^2, plus one transient stack), and
+    ~16 nq^2 live plane temporaries at the Jacobian/flux peaks."""
+    per_cell = (
+        5 * nd**3 + 3 * nd * nq**2 + 16 * nq**2 + 50
+    ) * itemsize
+    return per_cell * SUBLANES * 128 <= _VMEM_BUDGET_CORNER_BYTES
+
+
+def corner_apply(u, corners, mask, kappa, phi0: np.ndarray,
+                 dphi1: np.ndarray, pts1d: np.ndarray, wts1d: np.ndarray,
+                 is_identity: bool):
+    """Corner-mode cell apply with the cube/streamed choice made ONCE,
+    statically, from (nd, nq): the full-G-cube form while it fits VMEM
+    (fewer reassociations, marginally fewer FMAs), else the plane-streamed
+    form that keeps full 128-lane blocks at degree 5 qmode 1. All corner
+    call sites (plain folded kernel, folded CG engine) must route through
+    here so the policy cannot diverge between paths."""
+    nd, nq = u.shape[0], len(pts1d)
+    itemsize = jnp.dtype(u.dtype).itemsize
+    if corner_lanes_ok(nd, nq, itemsize):
+        G = corner_window_G(corners, mask, pts1d, wts1d)
+        return sumfact_window_apply(u, G, kappa, phi0, dphi1, is_identity)
+    return sumfact_window_apply_corner_streamed(
+        u, corners, mask, kappa, phi0, dphi1, pts1d, wts1d, is_identity
     )
 
 

@@ -110,13 +110,19 @@ def test_folded_cg_matches_grid_cg():
 
 def test_pallas_geom_constraint_policy():
     """TPU lane policy: G streaming fits 128 lanes through degree 3
-    qmode 1; corner mode rescues degree 4 qmode 1; degree 5+ qmode 1 is
-    unsupported (XLA fallback). nq = degree + qmode + 1."""
+    qmode 1; cube corner mode rescues degree 4 qmode 1; the
+    plane-streamed corner form extends to degree 5 qmode 1; degree 6+
+    qmode 1 remains unsupported (XLA fallback). nq = degree + qmode + 1."""
     from bench_tpu_fem.ops.folded import pallas_geom_constraint
+    from bench_tpu_fem.ops.pallas_laplacian import corner_lanes_ok
 
     assert pallas_geom_constraint(3, 5) == (True, None)
     assert pallas_geom_constraint(4, 6) == (True, "corner")
-    assert pallas_geom_constraint(5, 7) == (False, None)
+    assert pallas_geom_constraint(5, 7) == (True, "corner")
+    # degree 5 takes the streamed form (the cube estimate rejects it)
+    assert not corner_lanes_ok(6, 7)
+    assert pallas_geom_constraint(6, 8) == (False, None)
+    assert pallas_geom_constraint(7, 9) == (False, None)
     assert pallas_geom_constraint(1, 2) == (True, None)
 
 
@@ -144,3 +150,60 @@ def test_degree4_qmode1_builds_corner_at_full_lanes():
     op_gg = build_folded_laplacian(mesh, degree, qmode, dtype=jnp.float32,
                                    geom="g")
     assert op_gg.G is not None and op_gg.layout.nl < 128
+
+
+def test_corner_streamed_matches_cube_form():
+    """The plane-streamed corner contraction must match the cube form
+    (same math, reassociated plane-major) on the same random block."""
+    from bench_tpu_fem.elements import build_operator_tables
+    from bench_tpu_fem.ops.pallas_laplacian import (
+        corner_window_G,
+        sumfact_window_apply,
+        sumfact_window_apply_corner_streamed,
+    )
+
+    for degree, qmode in ((3, 1), (2, 0), (5, 1)):
+        t = build_operator_tables(degree, qmode)
+        nd = degree + 1
+        rng = np.random.RandomState(degree)
+        u = jnp.asarray(rng.randn(nd, nd, nd, 8, 8), jnp.float64)
+        base = np.stack(
+            np.meshgrid([0.0, 1.0], [0.0, 1.0], [0.0, 1.0], indexing="ij"),
+            axis=0,
+        )  # (3, 2, 2, 2)
+        corners = base[..., None, None] + 0.1 * rng.rand(3, 2, 2, 2, 8, 8)
+        corners = jnp.asarray(corners, jnp.float64)
+        mask = jnp.asarray((rng.rand(8, 8) > 0.2), jnp.float64)
+        kappa = jnp.float64(2.0)
+        G = corner_window_G(corners, mask, t.pts1d, t.wts1d)
+        y_cube = sumfact_window_apply(u, G, kappa, t.phi0, t.dphi1,
+                                      t.is_identity)
+        y_str = sumfact_window_apply_corner_streamed(
+            u, corners, mask, kappa, t.phi0, t.dphi1, t.pts1d, t.wts1d,
+            t.is_identity,
+        )
+        scale = float(jnp.abs(y_cube).max())
+        np.testing.assert_allclose(np.asarray(y_str), np.asarray(y_cube),
+                                   atol=1e-12 * scale)
+
+
+def test_degree5_qmode1_builds_corner_streamed_at_full_lanes():
+    """Degree 5 qmode 1 must now resolve to corner mode with full
+    128-lane blocks (via the plane-streamed contraction) and match the
+    XLA operator through the real folded apply."""
+    n, degree, qmode = (2, 2, 2), 5, 1
+    mesh = create_box_mesh(n, geom_perturb_fact=0.2)
+    op_f = build_folded_laplacian(mesh, degree, qmode, dtype=jnp.float32)
+    assert op_f.layout.nl == 128
+    assert op_f.G is None and op_f.corners is not None  # corner mode
+    op_g = build_laplacian(mesh, degree, qmode, dtype=jnp.float32,
+                           backend="xla")
+    rng = np.random.RandomState(11)
+    x = rng.randn(*dof_grid_shape(n, degree)).astype(np.float32)
+    y_ref = np.asarray(jax.jit(op_g.apply)(jnp.asarray(x)))
+    xf = jnp.asarray(fold_vector(x, op_f.layout))
+    y_f = np.asarray(jax.jit(op_f.apply)(xf))
+    scale = np.abs(y_ref).max()
+    np.testing.assert_allclose(
+        unfold_vector(y_f, op_f.layout), y_ref, atol=5e-5 * scale
+    )

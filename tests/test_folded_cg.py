@@ -44,6 +44,7 @@ def _setup(n, degree, qmode, geom, nl=8, perturb=0.3):
         ((8, 3, 7), 2, 1, "corner"),
         ((10, 9, 3), 1, 0, "corner"),
         ((4, 5, 3), 4, 1, "g"),
+        ((3, 3, 2), 5, 1, "corner"),
     ],
 )
 def test_ring_apply_matches_fused_apply(n, degree, qmode, geom):
@@ -64,6 +65,7 @@ def test_ring_apply_matches_fused_apply(n, degree, qmode, geom):
         ((6, 5, 4), 3, 1, "corner"),
         ((6, 5, 4), 3, 1, "g"),
         ((8, 3, 7), 2, 1, "corner"),
+        ((3, 3, 2), 5, 1, "corner"),
     ],
 )
 def test_engine_cg_matches_reference_cg(n, degree, qmode, geom):
