@@ -1,8 +1,22 @@
-"""Run the full TPU measurement agenda for round 4, logging each step as
-it lands (a mid-run tunnel wedge preserves completed steps).
+"""Run the outstanding TPU measurement agenda for round 4, logging each
+step as it lands (a mid-run tunnel wedge preserves completed steps).
+
+Most of the original agenda was collected on 2026-07-30 between the
+second and third tunnel wedges (BASELINE_MATRIX_r04.json,
+BENCH_r04_measured.json): engine A/B 9.05/6.35, Q6 4.97, 100-300M runs,
+deg4 3.14, df32 0.50. Remaining stages target what landed after:
+
+  health  - tunnel probe (aborts the rest when down)
+  deg5    - degree-5 qmode-1 perturbed on the NEW plane-streamed corner
+            Pallas path (Mosaic compile + perf; was XLA-fallback)
+  dist1   - distributed fused CG engine on a 1-device mesh (Mosaic
+            compile check of the halo-form kernel; ndevices=1 is x-only)
+  q6one   - degree-6 one-kernel engine form compile probe: VMEM estimate
+            12.4 MB vs 11 MiB budget - if Mosaic accepts it, the budget
+            can be raised and Q6 gains the ~4 fewer streams/iter form
+  bench   - the official bench.py line
 
 Usage: python scripts/measure_all.py [stage...]
-Stages (default all): health ab12 q6 large deg4 df32 matrix bench
 """
 import os
 import subprocess
@@ -88,15 +102,10 @@ print("BASELINE3STAGE:", res.gdof_per_second, res.extra, "ynorm", res.ynorm)
 
 
 def stage_q6():
-    code = PRE + """
-cfg = BenchConfig(ndofs_global=12_500_000, degree=6, qmode=1,
-                  float_bits=32, nreps=1000, use_cg=True)
-res, w = timed_res(cfg)
-print("Q6:", res.gdof_per_second, res.extra, "vs4.40:",
-      res.gdof_per_second/4.40)
-"""
-    rc, out = run_py(code, timeout=1800)
-    log(f"q6 rc={rc}: {out}")
+    _bench_stage("q6", "Q6:", dict(
+        ndofs_global=12_500_000, degree=6, qmode=1, float_bits=32,
+        nreps=1000, use_cg=True),
+        tail_expr=', "vs4.40:", res.gdof_per_second/4.40')
 
 
 def stage_large():
@@ -112,16 +121,26 @@ print("LARGE {nd}:", res.gdof_per_second, res.extra, "ynorm", res.ynorm)
         log(f"large {nd} rc={rc}: {out}")
 
 
-def stage_deg4():
-    code = PRE + """
-cfg = BenchConfig(ndofs_global=12_500_000, degree=4, qmode=1,
-                  float_bits=32, nreps=500, use_cg=True,
-                  geom_perturb_fact=0.2)
+def _bench_stage(name, label, cfg_kwargs, setup="", timeout=1800,
+                 tail_expr=""):
+    """Shared single-config benchmark stage: one BenchConfig, one
+    run_benchmark, one labelled print (the four degree/engine stages
+    differ only in these parameters)."""
+    kw = ", ".join(f"{k}={v!r}" for k, v in cfg_kwargs.items())
+    code = PRE + f"""
+{setup}
+cfg = BenchConfig({kw})
 res, w = timed_res(cfg)
-print("DEG4PERT:", res.gdof_per_second, res.extra)
+print({label!r}, res.gdof_per_second, res.extra{tail_expr})
 """
-    rc, out = run_py(code, timeout=1800)
-    log(f"deg4 rc={rc}: {out}")
+    rc, out = run_py(code, timeout=timeout)
+    log(f"{name} rc={rc}: {out}")
+
+
+def stage_deg4():
+    _bench_stage("deg4", "DEG4PERT:", dict(
+        ndofs_global=12_500_000, degree=4, qmode=1, float_bits=32,
+        nreps=500, use_cg=True, geom_perturb_fact=0.2))
 
 
 def stage_df32():
@@ -152,14 +171,45 @@ def stage_bench():
     log(f"bench.py rc={rc}: {out}")
 
 
+def stage_deg5():
+    _bench_stage("deg5", "DEG5PERT:", dict(
+        ndofs_global=12_500_000, degree=5, qmode=1, float_bits=32,
+        nreps=500, use_cg=True, geom_perturb_fact=0.2))
+
+
+def stage_dist1():
+    code = """
+import jax, jax.numpy as jnp
+from bench_tpu_fem.bench.driver import BenchConfig
+from bench_tpu_fem.dist.driver import run_distributed
+from bench_tpu_fem.bench.driver import BenchmarkResults
+cfg = BenchConfig(ndofs_global=2_000_000, degree=3, qmode=1,
+                  float_bits=32, nreps=100, use_cg=True, ndevices=1)
+res = BenchmarkResults()
+run_distributed(cfg, res, jnp.float32)
+print("DIST1:", res.gdof_per_second, res.extra)
+"""
+    rc, out = run_py(code, timeout=1200)
+    log(f"dist1 rc={rc}: {out}")
+
+
+def stage_q6one():
+    _bench_stage("q6one", "Q6ONEKERNEL:", dict(
+        ndofs_global=12_500_000, degree=6, qmode=1, float_bits=32,
+        nreps=1000, use_cg=True),
+        setup="import bench_tpu_fem.ops.kron_cg as KC\n"
+              "KC.VMEM_BUDGET = 14 * 2**20  # probe the one-kernel form")
+
+
 STAGES = {
     "health": stage_health, "ab12": stage_ab12, "q6": stage_q6,
     "large": stage_large, "deg4": stage_deg4, "df32": stage_df32,
     "matrix": stage_matrix, "bench": stage_bench,
+    "deg5": stage_deg5, "dist1": stage_dist1, "q6one": stage_q6one,
 }
 
 if __name__ == "__main__":
-    wanted = sys.argv[1:] or list(STAGES)
+    wanted = sys.argv[1:] or ["health", "deg5", "dist1", "q6one", "bench"]
     unknown = [s for s in wanted if s not in STAGES]
     if unknown:
         print(f"unknown stage(s) {unknown}; valid: {list(STAGES)}",
