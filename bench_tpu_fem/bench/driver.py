@@ -147,8 +147,8 @@ def resolve_backend(backend: str, float_bits: int, uniform: bool = False,
       path (ops.kron), any dtype — no geometry tensor, ~2x the folded
       kernel's CG rate;
     - perturbed mesh, f32 on TPU, if the folded kernels fit full 128-lane
-      blocks (G streaming through degree 3 qmode 1; corner mode's smaller
-      VMEM footprint extends that to degree 4 qmode 1 —
+      blocks (G streaming through degree 3 qmode 1; corner mode extends
+      that to degree 4, and its plane-streamed form to degree 5 qmode 1 —
       ops.folded.pallas_geom_constraint) -> 'pallas' (the folded general
       kernel);
     - otherwise 'xla' (einsum path; Mosaic has no f64, CPU runs use einsum,
