@@ -65,7 +65,8 @@ class BenchConfig:
     # float_bits=64 strategy: "emulated" (XLA software f64 — exact f64
     # semantics, ~100x slower than f32 on TPUs, which have no f64 units)
     # or "df32" (double-float f32 pairs, ~1e-12 residual floors at a ~20x
-    # flop multiplier — ops.kron_df; uniform single-chip meshes only)
+    # flop multiplier — ops.kron_df single-chip, dist.kron_df sharded;
+    # uniform meshes only)
     f64_impl: str = "emulated"
     # non-empty: wrap the timed region in jax.profiler.trace writing to this
     # directory (device timelines; view with TensorBoard / xprof)
@@ -197,8 +198,9 @@ def run_benchmark(cfg: BenchConfig) -> BenchmarkResults:
 def _run_benchmark_df64(cfg: BenchConfig) -> BenchmarkResults:
     """float_bits=64 via double-float f32 pairs (ops.kron_df): f64-class
     CG residual floors without XLA's ~100x software-f64 emulation cost.
-    Uniform single-chip meshes (the kron path) only — the same protocol
-    and reporting as _run_benchmark."""
+    Uniform meshes (the kron path) only; ndevices > 1 dispatches to the
+    sharded dist.kron_df path — the same protocol and reporting as
+    _run_benchmark."""
     import jax
     import numpy as np
 
@@ -211,8 +213,10 @@ def _run_benchmark_df64(cfg: BenchConfig) -> BenchmarkResults:
     from ..la.df64 import df_to_f64
 
     if cfg.ndevices > 1:
-        raise ValueError("f64_impl='df32' is single-chip (use 'emulated' "
-                         "for distributed f64 runs)")
+        from ..dist.driver import run_distributed_df64
+
+        res = BenchmarkResults(nreps=cfg.nreps)
+        return run_distributed_df64(cfg, res)
     if cfg.backend not in ("auto", "kron"):
         raise ValueError("f64_impl='df32' runs the kron path; "
                          f"--backend {cfg.backend} is not supported with it")

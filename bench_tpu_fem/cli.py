@@ -119,11 +119,6 @@ def main(argv: list[str] | None = None) -> int:
 
     devices = jax.devices()
     ndevices = args.ndevices or len(devices)
-    if (args.float_bits == 64 and args.f64_impl == "df32"
-            and args.ndevices == 0 and ndevices > 1):
-        # df32 is single-chip; with no explicit --ndevices, run on one chip
-        # rather than erroring out on multi-chip hosts.
-        ndevices = 1
 
     if args.ndofs_global is not None:
         ndofs_global = args.ndofs_global

@@ -299,3 +299,122 @@ def run_distributed(cfg, res, dtype):
         res.znorm = float(np.linalg.norm(z))
         res.enorm = float(np.linalg.norm(e))
     return res
+
+
+def run_distributed_df64(cfg, res):
+    """Multi-device df64 (double-float) benchmark: the dist.kron_df path.
+    Uniform meshes only (the kron decomposition); same protocol as
+    run_distributed — AOT compile, full warm-up, fenced timing — with DF
+    state and the compensated distributed reductions."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..bench.driver import _setup_problem
+    from ..elements.tables import build_operator_tables
+    from ..mesh.dofmap import dof_grid_shape
+    from .kron_df import (
+        DF,
+        build_dist_kron_df,
+        make_kron_df_rhs_fn,
+        make_kron_df_sharded_fns,
+    )
+
+    if cfg.backend not in ("auto", "kron"):
+        raise ValueError("f64_impl='df32' runs the kron path; "
+                         f"--backend {cfg.backend} is not supported with it")
+    if cfg.geom_perturb_fact != 0.0:
+        raise ValueError("f64_impl='df32' requires a uniform (unperturbed) "
+                         "mesh — the kron fast path")
+    dgrid = make_device_grid(cfg.ndevices)
+    n = compute_mesh_size_sharded(cfg.ndofs_global, cfg.degree, dgrid.dshape)
+    rule = "gauss" if cfg.use_gauss else "gll"
+    t = build_operator_tables(cfg.degree, cfg.qmode, rule)
+    res.ncells_global = int(np.prod(n))
+    res.ndofs_global = int(np.prod(dof_grid_shape(n, cfg.degree)))
+    res.extra["backend"] = "kron"
+    res.extra["f64_impl"] = "df32"
+
+    b_host = bc_grid = dm = G_host = None
+    if cfg.mat_comp:
+        # oracle runs solve the oracle's own host-assembled RHS (see
+        # _run_benchmark_df64): enorm then measures solver error only
+        from ..mesh.box import create_box_mesh
+
+        _, _, _, _, _, bc_grid, dm, b_host, G_host = _setup_problem(
+            cfg, n, prebuilt=(n, rule, t, create_box_mesh(n))
+        )
+
+    with Timer("% Create matfree operator"):
+        from ..la.df64 import df_from_f64
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        op = build_dist_kron_df(n, dgrid, cfg.degree, cfg.qmode, rule,
+                                kappa=2.0, tables=t)
+        if cfg.mat_comp:
+            bdf = df_from_f64(np.asarray(b_host, np.float64))
+            sharding = NamedSharding(dgrid.mesh, P(*AXIS_NAMES))
+            u = DF(*(
+                jax.device_put(
+                    jnp.asarray(shard_grid_blocks(
+                        np.asarray(c), n, cfg.degree, dgrid.dshape)),
+                    sharding)
+                for c in (bdf.hi, bdf.lo)
+            ))
+        else:
+            u = jax.jit(make_kron_df_rhs_fn(op, dgrid, t))()
+        apply_fn, cg_fn, norm_fn, norms_from = make_kron_df_sharded_fns(
+            op, dgrid, cfg.nreps
+        )
+        if cfg.use_cg:
+            fn = jax.jit(cg_fn).lower(u, op).compile()
+        else:
+            def _rep(i, y, x, A):
+                xx, _ = jax.lax.optimization_barrier((x, y))
+                return apply_fn(xx, A)
+
+            from ..la.df64 import df_zeros_like
+
+            fn = jax.jit(
+                lambda x, A: jax.lax.fori_loop(
+                    0, cfg.nreps, partial(_rep, x=x, A=A),
+                    df_zeros_like(x),
+                )
+            ).lower(u, op).compile()
+        warm = fn(u, op)
+        float(warm.hi[(0,) * warm.hi.ndim])
+        del warm
+
+    from contextlib import nullcontext
+
+    prof = (
+        jax.profiler.trace(cfg.profile_dir) if cfg.profile_dir
+        else nullcontext()
+    )
+    with prof:
+        t0 = time.perf_counter()
+        y = fn(u, op)
+        jax.block_until_ready(y)
+        float(y.hi[(0,) * y.hi.ndim])  # tunnel fence (see bench.driver)
+        res.mat_free_time = time.perf_counter() - t0
+
+    norm_c = jax.jit(norm_fn).lower(u, op).compile()
+    res.unorm, res.unorm_linf = norms_from(norm_c(u, op))
+    res.ynorm, res.ynorm_linf = norms_from(norm_c(y, op))
+    res.gdof_per_second = (
+        res.ndofs_global * cfg.nreps / (1e9 * res.mat_free_time)
+    )
+
+    if cfg.mat_comp:
+        from ..bench.driver import _mat_comp_oracle
+
+        z = _mat_comp_oracle(cfg, t, dm, bc_grid, b_host, G_host)
+        y64 = (
+            unshard_grid_blocks(np.asarray(y.hi, np.float64), n,
+                                cfg.degree, dgrid.dshape)
+            + unshard_grid_blocks(np.asarray(y.lo, np.float64), n,
+                                  cfg.degree, dgrid.dshape)
+        )
+        e = y64 - z
+        res.znorm = float(np.linalg.norm(z))
+        res.enorm = float(np.linalg.norm(e))
+    return res

@@ -1,0 +1,491 @@
+"""Distributed double-float (df64) Kronecker path: f64-class CG on
+sharded uniform meshes without XLA's ~100x software-f64 emulation.
+
+Composition of two existing designs, changing neither:
+
+- the banded-stencil distribution protocol of dist.kron — zero-padded
+  local apply per stage (no data dependency on the collective) + P-plane
+  ppermute halos + canonical ascending-diagonal edge-row recomputation;
+- the df64 arithmetic of la.df64/ops.kron_df — error-free f32-pair
+  transforms (~48-bit mantissas, CG residual floors ~1e-12 rel).
+
+A DF value's (hi, lo) components ride ONE stacked ppermute payload per
+side per axis, exactly like dist.kron stacks aK/aM.
+
+One deliberate deviation from the f32 protocol: the f32 path keeps
+duplicated seam planes bit-identical with NO ghost refresh (bitwise
+replay of identical instruction sequences). df compilation breaks that
+guarantee — XLA's fused df chains can round the lo component differently
+at different lane positions (see _df_seam_refresh) — so the df apply
+ends with an explicit owner -> ghost seam-plane refresh per sharded axis:
+O(face) traffic, consistency by construction instead of by replay.
+
+Cross-shard reductions: a plain `psum` of df partials would re-round in
+f32 at every tree-combine and silently discard the compensation. Instead
+`df_psum_all` all-gathers the per-shard DF partials (ndevices tiny
+scalars) and folds them in a fixed order with df_add on every shard —
+deterministic, identical on all shards (the SPMD invariant CG needs), and
+compensated end to end. The reference's MPI_Allreduce on f64 scalars
+(vector.hpp:173) has the same role; this is its precision-preserving
+TPU analogue.
+
+Single-chip df32 (`ops.kron_df`) remains the ndevices=1 path; the driver
+dispatches here for f64_impl='df32' with ndevices > 1.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..elements.tables import OperatorTables, build_operator_tables
+from ..la.df64 import (
+    DF,
+    _prod_terms,
+    _renorm,
+    df_add,
+    df_axpy,
+    df_div,
+    df_from_f64,
+    df_scale,
+    df_sub,
+    df_sum,
+    df_zeros_like,
+)
+from ..ops.kron import axis_matrices_1d, banded_diags
+from ..ops.kron_df import banded_apply_df
+from .halo import owned_mask
+from .kron import halo_slabs
+from .mesh import AXIS_NAMES, shard_cells
+
+
+def _df_stack(*dfs):
+    """Pack DF operands into one array for a single ppermute payload."""
+    parts = []
+    for d in dfs:
+        parts += [d.hi, d.lo]
+    return jnp.stack(parts)
+
+
+def _df_unstack(arr, n):
+    return tuple(DF(arr[2 * i], arr[2 * i + 1]) for i in range(n))
+
+
+def _df_halo(dfs, axis: int, name: str, P: int):
+    """Halo slabs for DF operands: one stacked exchange, returning
+    (halo_l, halo_r) tuples of DF."""
+    s = _df_stack(*dfs)
+    hl, hr = halo_slabs(s, axis + 1, name, P)
+    return _df_unstack(hl, len(dfs)), _df_unstack(hr, len(dfs))
+
+
+def _plane(a, j, axis):
+    return lax.index_in_dim(a, j, axis=axis, keepdims=True)
+
+
+def _df_seam_refresh(y: DF, dshape) -> DF:
+    """Owner -> ghost seam-plane refresh (dist.halo.halo_refresh on the
+    stacked hi/lo pair): one tiny ppermute per sharded axis.
+
+    The f32 dist path keeps duplicated seam planes consistent with NO
+    refresh, by bitwise replay: both owners execute the identical
+    instruction sequence on identical inputs. That guarantee does not
+    survive df compilation: XLA fuses the df chains and the backend may
+    contract mul+add pairs (FMA) differently across vectorization paths,
+    so the same df math at different lane positions can round its lo
+    component differently (observed on XLA:CPU as ~1e-16 lo drift on a
+    seam plane whose inputs were verified bitwise identical). Rather than
+    pin compiler codegen, the df path makes consistency structural: after
+    each apply the owner's seam plane overwrites the neighbour's ghost
+    copy — the reference's forward scatter (vector.hpp:95-149), O(face)
+    traffic against the O(volume) apply."""
+    from .halo import _shift_from_left
+
+    if all(d == 1 for d in dshape):
+        return y
+    s = jnp.stack([y.hi, y.lo])  # grid axes shift by one in the stack
+    for ax, name in zip((0, 1, 2), AXIS_NAMES):
+        if dshape[ax] == 1:
+            continue
+        sax = ax + 1
+        last = lax.index_in_dim(s, s.shape[sax] - 1, axis=sax,
+                                keepdims=True)
+        recv = _shift_from_left(last, name)
+        idx = lax.axis_index(name)
+        first = lax.index_in_dim(s, 0, axis=sax, keepdims=True)
+        new_first = jnp.where(idx == 0, first, recv)
+        rest = lax.slice_in_dim(s, 1, s.shape[sax], axis=sax)
+        s = jnp.concatenate([new_first, rest], axis=sax)
+    return DF(s[0], s[1])
+
+
+def _edge_rows_df(x: DF, halo_l: DF, halo_r: DF, dloc: DF, axis: int,
+                  P: int):
+    """df twin of dist.kron._edge_rows: recompute the P boundary output
+    planes per side as full banded rows over the halo-extended window,
+    summing strictly in ascending diagonal order (in df arithmetic) so
+    both owners of a duplicated seam plane replay the identical term
+    sequence — hi AND lo stay bit-identical."""
+    L = dloc.hi.shape[1]
+
+    def ext(a_l, a_x, a_r, lo_slice, hi_slice):
+        el = jnp.concatenate(
+            [a_l, lax.slice_in_dim(a_x, *lo_slice, axis=axis)], axis=axis
+        )
+        er = jnp.concatenate(
+            [lax.slice_in_dim(a_x, *hi_slice, axis=axis), a_r], axis=axis
+        )
+        return el, er
+
+    ehl, ehr = ext(halo_l.hi, x.hi, halo_r.hi, (0, 2 * P), (L - 2 * P, L))
+    ell, elr = ext(halo_l.lo, x.lo, halo_r.lo, (0, 2 * P), (L - 2 * P, L))
+    ext_l, ext_r = DF(ehl, ell), DF(ehr, elr)
+
+    def rows(ext_df, row_of, off_of):
+        out = []
+        for j in range(P):
+            i = row_of(j)
+            acc = None
+            for di in range(2 * P + 1):
+                c = DF(dloc.hi[di, i], dloc.lo[di, i])
+                pl_ = DF(_plane(ext_df.hi, off_of(j) + di, axis),
+                         _plane(ext_df.lo, off_of(j) + di, axis))
+                term = _renorm(*_prod_terms(c, pl_))
+                acc = term if acc is None else df_add(acc, term)
+            out.append(acc)
+        return DF(
+            jnp.concatenate([o.hi for o in out], axis=axis),
+            jnp.concatenate([o.lo for o in out], axis=axis),
+        )
+
+    left = rows(ext_l, lambda j: j, lambda j: j)
+    right = rows(ext_r, lambda j: L - P + j, lambda j: j)
+    return left, right
+
+
+def _replace_edges_df(y: DF, rl: DF, rr: DF, axis: int, P: int):
+    L = y.hi.shape[axis]
+
+    def rep(a, l_, r_):
+        mid = lax.slice_in_dim(a, P, L - P, axis=axis)
+        return jnp.concatenate([l_, mid, r_], axis=axis)
+
+    return DF(rep(y.hi, rl.hi, rr.hi), rep(y.lo, rl.lo, rr.lo))
+
+
+@partial(
+    jax.tree_util.register_dataclass,
+    data_fields=["Kd", "Md", "notbc1d"],
+    meta_fields=["n", "L", "dshape", "degree"],
+)
+@dataclass(frozen=True)
+class DistKronLaplacianDF:
+    """Sharded df64 uniform-mesh Kronecker operator: global DF banded 1D
+    coefficient sets (replicated kilobytes; kappa folded into the x
+    factors host-side in f64, as in ops.kron_df), per-shard slices cut
+    inside shard_map by device position."""
+
+    Kd: tuple  # 3x DF (2P+1, N_a)
+    Md: tuple  # 3x DF
+    notbc1d: tuple  # 3x f32 (N_a,) — exact 0/1, hi-only
+    n: tuple[int, int, int]
+    L: tuple[int, int, int]
+    dshape: tuple[int, int, int]
+    degree: int
+
+    def local_coeffs(self):
+        P = self.degree
+        Kloc, Mloc, nbloc = [], [], []
+        for ax, name in enumerate(AXIS_NAMES):
+            La = self.L[ax]
+            g0 = lax.axis_index(name) * (La - 1)
+            z0 = jnp.zeros((), dtype=g0.dtype)
+
+            def cut(df):
+                return DF(
+                    lax.dynamic_slice(df.hi, (z0, g0), (2 * P + 1, La)),
+                    lax.dynamic_slice(df.lo, (z0, g0), (2 * P + 1, La)),
+                )
+
+            Kloc.append(cut(self.Kd[ax]))
+            Mloc.append(cut(self.Md[ax]))
+            nbloc.append(lax.dynamic_slice(self.notbc1d[ax], (g0,), (La,)))
+        return Kloc, Mloc, nbloc
+
+    def apply_local(self, x: DF, coeffs=None) -> DF:
+        """y = A x for one shard's DF dof block (inside shard_map) —
+        dist.kron.apply_local's stage/halo/edge structure in df
+        arithmetic. The zero-padded local banded apply per stage has no
+        data dependency on the collective; only the 2P edge planes
+        consume the halos."""
+        P = self.degree
+        Kloc, Mloc, nbloc = coeffs if coeffs is not None else self.local_coeffs()
+        sx, sy, sz = (d > 1 for d in self.dshape)
+
+        if sz:
+            hl, hr = _df_halo((x,), 2, AXIS_NAMES[2], P)
+        aK = banded_apply_df(x, Kloc[2], 2)
+        aM = banded_apply_df(x, Mloc[2], 2)
+        if sz:
+            rl, rr = _edge_rows_df(x, hl[0], hr[0], Kloc[2], 2, P)
+            aK = _replace_edges_df(aK, rl, rr, 2, P)
+            rl, rr = _edge_rows_df(x, hl[0], hr[0], Mloc[2], 2, P)
+            aM = _replace_edges_df(aM, rl, rr, 2, P)
+
+        if sy:
+            hl, hr = _df_halo((aK, aM), 1, AXIS_NAMES[1], P)
+        t12 = df_add(
+            banded_apply_df(aK, Mloc[1], 1), banded_apply_df(aM, Kloc[1], 1)
+        )
+        tyz = banded_apply_df(aM, Mloc[1], 1)
+        if sy:
+            al, ar = _edge_rows_df(aK, hl[0], hr[0], Mloc[1], 1, P)
+            bl, br = _edge_rows_df(aM, hl[1], hr[1], Kloc[1], 1, P)
+            t12 = _replace_edges_df(
+                t12, df_add(al, bl), df_add(ar, br), 1, P
+            )
+            rl, rr = _edge_rows_df(aM, hl[1], hr[1], Mloc[1], 1, P)
+            tyz = _replace_edges_df(tyz, rl, rr, 1, P)
+
+        if sx:
+            hl, hr = _df_halo((t12, tyz), 0, AXIS_NAMES[0], P)
+        acc = df_add(
+            banded_apply_df(t12, Mloc[0], 0), banded_apply_df(tyz, Kloc[0], 0)
+        )
+        nbx, nby, nbz = nbloc
+        nb3 = (nbx[:, None, None] * nby[None, :, None]
+               * nbz[None, None, :])
+        y = df_add(
+            DF(nb3 * acc.hi, nb3 * acc.lo),
+            DF((1.0 - nb3) * x.hi, (1.0 - nb3) * x.lo),
+        )
+        if not sx:
+            return _df_seam_refresh(y, self.dshape)
+        tl, tr = _edge_rows_df(t12, hl[0], hr[0], Mloc[0], 0, P)
+        zl, zr = _edge_rows_df(tyz, hl[1], hr[1], Kloc[0], 0, P)
+        Lx = x.hi.shape[0]
+        nb_yz = nby[None, :, None] * nbz[None, None, :]
+        nb_l = nbx[:P, None, None] * nb_yz
+        nb_r = nbx[Lx - P:, None, None] * nb_yz
+
+        def blend(rows, nb_m, xs):
+            s = df_add(*rows)
+            return DF(nb_m * s.hi + (1.0 - nb_m) * xs.hi,
+                      nb_m * s.lo + (1.0 - nb_m) * xs.lo)
+
+        x_l = DF(lax.slice_in_dim(x.hi, 0, P, axis=0),
+                 lax.slice_in_dim(x.lo, 0, P, axis=0))
+        x_r = DF(lax.slice_in_dim(x.hi, Lx - P, Lx, axis=0),
+                 lax.slice_in_dim(x.lo, Lx - P, Lx, axis=0))
+        rows_l = blend((tl, zl), nb_l, x_l)
+        rows_r = blend((tr, zr), nb_r, x_r)
+        return _df_seam_refresh(
+            _replace_edges_df(y, rows_l, rows_r, 0, P), self.dshape
+        )
+
+
+def build_dist_kron_df(
+    n: tuple[int, int, int],
+    dgrid,
+    degree: int,
+    qmode: int,
+    rule: str = "gll",
+    kappa: float = 2.0,
+    tables: OperatorTables | None = None,
+) -> DistKronLaplacianDF:
+    t = tables or build_operator_tables(degree, qmode, rule)
+    dshape = dgrid.dshape
+    ncl = shard_cells(n, dshape)
+    for c, d in zip(ncl, dshape):
+        if d > 1 and c < 2:
+            raise ValueError(
+                "distributed kron needs >= 2 cells per shard on sharded "
+                f"axes (got {ncl} cells/shard over device mesh {dshape})"
+            )
+    P = degree
+    Ks, Ms, masks = axis_matrices_1d(t, n)
+    Kd, Md = [], []
+    for a, (K1, M1) in enumerate(zip(Ks, Ms)):
+        scale = kappa if a == 0 else 1.0
+        Kd.append(df_from_f64(banded_diags(K1 * scale, P)))
+        Md.append(df_from_f64(banded_diags(M1 * scale, P)))
+    return DistKronLaplacianDF(
+        Kd=tuple(Kd),
+        Md=tuple(Md),
+        notbc1d=tuple(jnp.asarray(m, jnp.float32) for m in masks),
+        n=tuple(n),
+        L=tuple(c * P + 1 for c in ncl),
+        dshape=tuple(dshape),
+        degree=degree,
+    )
+
+
+def df_psum_all(s: DF, dshape) -> DF:
+    """Compensated cross-shard sum of a scalar DF: all-gather the
+    per-shard partials over every mesh axis, then fold them in a fixed
+    order with df_add on each shard. A raw psum would re-round in f32 at
+    every combine; this keeps the ~48-bit accumulation and is bitwise
+    identical on all shards."""
+    flat = DF(s.hi.reshape(1), s.lo.reshape(1))
+    for name, d in zip(AXIS_NAMES, dshape):
+        if d == 1:
+            continue
+        flat = DF(
+            lax.all_gather(flat.hi, name, axis=0, tiled=True),
+            lax.all_gather(flat.lo, name, axis=0, tiled=True),
+        )
+    n = flat.hi.shape[0]
+    acc = DF(flat.hi[0], flat.lo[0])
+    for i in range(1, n):
+        acc = df_add(acc, DF(flat.hi[i], flat.lo[i]))
+    return acc
+
+
+def df_dot_dist(a: DF, b: DF, mask, dshape) -> DF:
+    """Owned-dof-masked df inner product with the compensated cross-shard
+    reduction (the df analogue of dist.halo.masked_dot)."""
+    m = mask.astype(a.hi.dtype)
+    local = df_sum(DF(*_prod_terms(DF(a.hi * m, a.lo * m), b)))
+    return df_psum_all(local, dshape)
+
+
+def dist_cg_solve_df_local(op: DistKronLaplacianDF, b: DF,
+                           nreps: int) -> DF:
+    """Per-shard fixed-iteration df CG (inside shard_map): the
+    ops.kron_df.cg_solve_df recurrence with distributed compensated dots
+    and the same past-the-floor freeze guard."""
+    mask = owned_mask(b.hi.shape)
+    coeffs = op.local_coeffs()  # hoisted out of the loop
+    floor = jnp.float32(1e-24)
+
+    def dot(u, v):
+        return df_dot_dist(u, v, mask, op.dshape)
+
+    rnorm0 = dot(b, b)
+    rnorm0_hi = rnorm0.hi
+
+    def body(_, state):
+        x, r, p, rnorm, done = state
+        y = op.apply_local(p, coeffs)
+        alpha = df_div(rnorm, dot(p, y))
+        x1 = df_axpy(x, alpha, p)
+        r1 = df_sub(r, df_scale(y, alpha))
+        rnorm1 = dot(r1, r1)
+        beta = df_div(rnorm1, rnorm)
+        p1 = df_add(df_scale(p, beta), r1)
+        done1 = jnp.logical_or(done, rnorm1.hi <= floor * rnorm0_hi)
+
+        def keep(new, old):
+            return jax.tree_util.tree_map(
+                lambda nw, o: jnp.where(done, o, nw), new, old
+            )
+
+        return (keep(x1, x), keep(r1, r), keep(p1, p),
+                keep(rnorm1, rnorm), done1)
+
+    # `done` is derived from the gathered dot, which shard_map's VMA
+    # system marks device-varying (the values are in fact identical on
+    # every shard — the reduction is deterministic); the initial carry
+    # must carry the same varying annotation for the loop types to match.
+    done0 = jax.lax.pcast(jnp.asarray(False), AXIS_NAMES, to="varying")
+    state = (df_zeros_like(b), b, b, rnorm0, done0)
+    x, *_ = jax.lax.fori_loop(0, nreps, body, state)
+    return x
+
+
+def make_kron_df_sharded_fns(op: DistKronLaplacianDF, dgrid, nreps: int):
+    """Jittable sharded callables over DF grid blocks (hi/lo each
+    (Dx,Dy,Dz,Lx,Ly,Lz)): (apply, CG, l2norm) — the df twin of
+    dist.kron.make_kron_sharded_fns."""
+    from jax.sharding import PartitionSpec as P
+
+    spec = P(*AXIS_NAMES)
+    rep = P()
+
+    def _local(a):
+        return DF(a.hi[0, 0, 0], a.lo[0, 0, 0])
+
+    def _wrap(a):
+        return DF(a.hi[None, None, None], a.lo[None, None, None])
+
+    @partial(jax.shard_map, mesh=dgrid.mesh, in_specs=(spec, rep),
+             out_specs=spec)
+    def apply_fn(x, A):
+        return _wrap(A.apply_local(_local(x)))
+
+    @partial(jax.shard_map, mesh=dgrid.mesh, in_specs=(spec, rep),
+             out_specs=spec)
+    def cg_fn(b, A):
+        return _wrap(dist_cg_solve_df_local(A, _local(b), nreps))
+
+    # check_vma off: the gathered compensated fold is genuinely replicated
+    # (same order on every shard) but the VMA system cannot infer that.
+    @partial(jax.shard_map, mesh=dgrid.mesh, in_specs=(spec, rep),
+             out_specs=rep, check_vma=False)
+    def norm_fn(x, A):
+        """[<x,x>.hi, <x,x>.lo, Linf] over owned dofs. The df32 mode runs
+        with x64 disabled, so the hi+lo recombination and sqrt happen in
+        the CALLER's Python f64 (an on-device astype(float64) would
+        silently stay f32) — see norms_from. Linf is on the f32-rounded
+        hi+lo, as in the single-chip df path."""
+        xl = _local(x)
+        m = owned_mask(xl.hi.shape)
+        d = df_dot_dist(xl, xl, m, A.dshape)
+        linf = lax.pmax(
+            jnp.max(jnp.abs(xl.hi + xl.lo) * m.astype(jnp.float32)),
+            AXIS_NAMES,
+        )
+        return jnp.stack([d.hi, d.lo, linf])
+
+    def norms_from(triple) -> tuple[float, float]:
+        """(L2, Linf) in full precision from norm_fn's output."""
+        hi, lo, linf = (float(v) for v in np.asarray(triple))
+        return float(np.sqrt(hi + lo)), linf
+
+    return apply_fn, cg_fn, norm_fn, norms_from
+
+
+def make_kron_df_rhs_fn(op: DistKronLaplacianDF, dgrid,
+                        tables: OperatorTables):
+    """Per-shard separable df RHS (the df twin of
+    dist.kron.make_kron_rhs_fn): 1D DF factor slices by shard position,
+    outer-multiplied on device in df arithmetic — no O(global) array."""
+    from jax.sharding import PartitionSpec as P
+
+    from ..ops.kron import rhs_factors_1d
+
+    fs = tuple(df_from_f64(f) for f in rhs_factors_1d(tables, op.n))
+    rep = P()
+
+    @partial(jax.shard_map, mesh=dgrid.mesh, in_specs=(rep,) * 6,
+             out_specs=P(*AXIS_NAMES))
+    def rhs_fn(fxh, fxl, fyh, fyl, fzh, fzl):
+        loc = []
+        for ax, (name, fh, fl) in enumerate(
+            zip(AXIS_NAMES, (fxh, fyh, fzh), (fxl, fyl, fzl))
+        ):
+            La = op.L[ax]
+            g0 = lax.axis_index(name) * (La - 1)
+            loc.append(DF(lax.dynamic_slice(fh, (g0,), (La,)),
+                          lax.dynamic_slice(fl, (g0,), (La,))))
+        Lx, Ly, Lz = op.L
+
+        def bc3(a, shape_pos):
+            sh = [1, 1, 1]
+            sh[shape_pos] = -1
+            return DF(
+                jnp.broadcast_to(a.hi.reshape(sh), (Lx, Ly, Lz)),
+                jnp.broadcast_to(a.lo.reshape(sh), (Lx, Ly, Lz)),
+            )
+
+        xy = _renorm(*_prod_terms(bc3(loc[0], 0), bc3(loc[1], 1)))
+        b = _renorm(*_prod_terms(xy, bc3(loc[2], 2)))
+        return DF(b.hi[None, None, None], b.lo[None, None, None])
+
+    return lambda: rhs_fn(fs[0].hi, fs[0].lo, fs[1].hi, fs[1].lo,
+                          fs[2].hi, fs[2].lo)

@@ -1,0 +1,187 @@
+"""Distributed df64 kron path (dist.kron_df) on the 8-virtual-CPU mesh:
+the sharded df apply/CG must match the single-chip df path (itself pinned
+against true f64 in test_df64.py), seams must stay bit-identical in BOTH
+components, and the compensated cross-shard dot must beat a plain-psum
+reduction's f32 re-rounding."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from bench_tpu_fem.dist.kron_df import (
+    DF,
+    build_dist_kron_df,
+    df_dot_dist,
+    make_kron_df_rhs_fn,
+    make_kron_df_sharded_fns,
+)
+from bench_tpu_fem.dist.mesh import AXIS_NAMES, make_device_grid
+from bench_tpu_fem.dist.operator import shard_grid_blocks, unshard_grid_blocks
+from bench_tpu_fem.elements import build_operator_tables
+from bench_tpu_fem.la.df64 import df_from_f64, df_to_f64
+from bench_tpu_fem.mesh import create_box_mesh, dof_grid_shape
+from bench_tpu_fem.ops.kron_df import (
+    build_kron_laplacian_df,
+    cg_solve_df,
+    device_rhs_uniform_df,
+)
+
+jax.config.update("jax_enable_x64", True)
+
+
+def _shard_df(x64, n, degree, dgrid):
+    df = df_from_f64(x64)
+    sharding = NamedSharding(dgrid.mesh, P(*AXIS_NAMES))
+    return DF(
+        jax.device_put(
+            jnp.asarray(shard_grid_blocks(np.asarray(df.hi), n, degree,
+                                          dgrid.dshape)), sharding),
+        jax.device_put(
+            jnp.asarray(shard_grid_blocks(np.asarray(df.lo), n, degree,
+                                          dgrid.dshape)), sharding),
+    )
+
+
+def _unshard_df(df_blocks, n, degree, dshape):
+    hi = unshard_grid_blocks(np.asarray(df_blocks.hi), n, degree, dshape)
+    lo = unshard_grid_blocks(np.asarray(df_blocks.lo), n, degree, dshape)
+    return hi.astype(np.float64) + lo.astype(np.float64)
+
+
+@pytest.mark.parametrize("dshape,degree", [((2, 2, 2), 3), ((4, 1, 2), 2)])
+def test_dist_df_apply_matches_single_chip(dshape, degree):
+    dgrid = make_device_grid(dshape=dshape)
+    n = tuple(2 * d for d in dshape)
+    mesh = create_box_mesh(n)
+    op1 = build_kron_laplacian_df(mesh, degree, 1)
+    opd = build_dist_kron_df(n, dgrid, degree, 1)
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(*dof_grid_shape(n, degree))
+    y_ref = df_to_f64(jax.jit(op1.apply)(df_from_f64(x)))
+
+    xb = _shard_df(x, n, degree, dgrid)
+    apply_fn, _, _, _ = make_kron_df_sharded_fns(opd, dgrid, nreps=1)
+    yb = jax.jit(apply_fn)(xb, opd)
+    y = _unshard_df(yb, n, degree, dgrid.dshape)
+    scale = np.abs(y_ref).max()
+    np.testing.assert_allclose(y, y_ref, atol=1e-13 * scale)
+
+
+def test_dist_df_cg_matches_single_chip():
+    dshape, degree, nreps = (2, 2, 2), 3, 6
+    dgrid = make_device_grid(dshape=dshape)
+    n = (4, 4, 4)
+    mesh = create_box_mesh(n)
+    t = build_operator_tables(degree, 1)
+    op1 = build_kron_laplacian_df(mesh, degree, 1, tables=t)
+    opd = build_dist_kron_df(n, dgrid, degree, 1, tables=t)
+
+    b1 = device_rhs_uniform_df(t, n)
+    x_ref = df_to_f64(
+        jax.jit(lambda A, b: cg_solve_df(A, b, nreps))(op1, b1)
+    )
+
+    bd = jax.jit(make_kron_df_rhs_fn(opd, dgrid, t))()
+    _, cg_fn, _, _ = make_kron_df_sharded_fns(opd, dgrid, nreps=nreps)
+    xb = jax.jit(cg_fn)(bd, opd)
+    x = _unshard_df(xb, n, degree, dgrid.dshape)
+    scale = np.abs(x_ref).max()
+    # df-class agreement: both runs share the recurrence but reduce dots
+    # in different (compensated) orders
+    np.testing.assert_allclose(x, x_ref, atol=1e-11 * scale)
+
+
+def test_dist_df_seams_stay_bitwise_in_both_components():
+    dshape, degree = (2, 2, 2), 3
+    dgrid = make_device_grid(dshape=dshape)
+    n = (4, 4, 4)
+    t = build_operator_tables(degree, 1)
+    opd = build_dist_kron_df(n, dgrid, degree, 1, tables=t)
+    bd = jax.jit(make_kron_df_rhs_fn(opd, dgrid, t))()
+    _, cg_fn, _, _ = make_kron_df_sharded_fns(opd, dgrid, nreps=5)
+    xb = jax.jit(cg_fn)(bd, opd)
+    Ld = opd.L
+    for comp in (np.asarray(xb.hi), np.asarray(xb.lo)):
+        for ax in range(3):
+            left = np.take(np.take(comp, 0, axis=ax), Ld[ax] - 1,
+                           axis=2 + ax)
+            right = np.take(np.take(comp, 1, axis=ax), 0, axis=2 + ax)
+            assert np.array_equal(left, right)
+
+
+def test_dist_df_dot_is_compensated_across_shards():
+    """The all-gather + ordered df_add reduction must recover the f64 dot
+    to df accuracy; a plain psum of hi/lo (f32 tree-sum) measurably
+    cannot on adversarial data."""
+    from functools import partial
+
+    from bench_tpu_fem.la.df64 import _prod_terms, df_sum
+
+    dshape, degree = (2, 2, 2), 1
+    dgrid = make_device_grid(dshape=dshape)
+    n = (4, 4, 4)
+    opd = build_dist_kron_df(n, dgrid, degree, 1)
+    shape = dof_grid_shape(n, degree)
+    rng = np.random.RandomState(4)
+    # adversarial magnitudes spanning ~12 decades
+    a = rng.randn(*shape) * 10.0 ** rng.uniform(-6, 6, size=shape)
+    want = float(np.sum(a.astype(np.float64) ** 2))
+
+    ab = _shard_df(a, n, degree, dgrid)
+
+    @partial(jax.shard_map, mesh=dgrid.mesh,
+             in_specs=(P(*AXIS_NAMES), P()), out_specs=P(),
+             check_vma=False)  # the gathered fold IS replicated; the VMA
+    def dot_fn(xb, A):         # system cannot infer that
+        xl = DF(xb.hi[0, 0, 0], xb.lo[0, 0, 0])
+        from bench_tpu_fem.dist.halo import owned_mask
+
+        d = df_dot_dist(xl, xl, owned_mask(xl.hi.shape), A.dshape)
+        return d.hi.astype(jnp.float64) + d.lo.astype(jnp.float64)
+
+    got = float(jax.jit(dot_fn)(ab, opd))
+    np.testing.assert_allclose(got, want, rtol=1e-10)
+
+    @partial(jax.shard_map, mesh=dgrid.mesh,
+             in_specs=(P(*AXIS_NAMES), P()), out_specs=P(),
+             check_vma=False)
+    def dot_psum(xb, A):
+        from jax import lax
+
+        from bench_tpu_fem.dist.halo import owned_mask
+
+        xl = DF(xb.hi[0, 0, 0], xb.lo[0, 0, 0])
+        m = owned_mask(xl.hi.shape).astype(jnp.float32)
+        local = df_sum(DF(*_prod_terms(DF(xl.hi * m, xl.lo * m), xl)))
+        hi = lax.psum(local.hi, AXIS_NAMES)
+        lo = lax.psum(local.lo, AXIS_NAMES)
+        return hi.astype(jnp.float64) + lo.astype(jnp.float64)
+
+    naive = float(jax.jit(dot_psum)(ab, opd))
+    got_err = abs(got - want) / abs(want)
+    naive_err = abs(naive - want) / abs(want)
+    assert got_err <= max(naive_err, 1e-10)
+
+
+def test_dist_df32_through_run_benchmark():
+    """Driver-level e2e: f64_impl='df32' with ndevices > 1 dispatches to
+    the distributed df path and must match the single-chip df solve on a
+    config where sharded and serial mesh sizing provably coincide."""
+    from bench_tpu_fem.bench.driver import BenchConfig, run_benchmark
+
+    # 4x4x4 cells at degree 3 -> 13^3 = 2197 dofs under BOTH sizings
+    cfg = dict(ndofs_global=2197, degree=3, qmode=1, float_bits=64,
+               nreps=5, use_cg=True, f64_impl="df32")
+    res_d = run_benchmark(BenchConfig(ndevices=8, **cfg))
+    res_1 = run_benchmark(BenchConfig(ndevices=1, **cfg))
+    assert res_d.ndofs_global == res_1.ndofs_global == 2197
+    assert res_d.extra["f64_impl"] == "df32"
+    # dispatch + plumbing check: the two paths build their RHS and reduce
+    # their dots in different (both compensated) association orders, so
+    # the CG trajectories drift slightly apart over the 5 iterations;
+    # strict operator/CG parity on identical inputs is pinned by
+    # test_dist_df_cg_matches_single_chip at 1e-11.
+    np.testing.assert_allclose(res_d.ynorm, res_1.ynorm, rtol=1e-7)
