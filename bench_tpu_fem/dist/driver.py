@@ -240,15 +240,33 @@ def run_distributed(cfg, res, dtype):
             # dispatch in the timed region; the optimization_barrier ties
             # the input to the loop carry so the invariant apply can never
             # be hoisted out of the timed loop).
-            def _rep(i, y, x, a):
-                xx, _ = jax.lax.optimization_barrier((x, y))
-                return apply_fn(xx, *a)
+            def _compile_action(ap):
+                def _rep(i, y, x, a):
+                    xx, _ = jax.lax.optimization_barrier((x, y))
+                    return ap(xx, *a)
 
-            fn = jax.jit(
-                lambda x, *a: jax.lax.fori_loop(
-                    0, cfg.nreps, partial(_rep, x=x, a=a), jnp.zeros_like(x)
+                return jax.jit(
+                    lambda x, *a: jax.lax.fori_loop(
+                        0, cfg.nreps, partial(_rep, x=x, a=a),
+                        jnp.zeros_like(x),
+                    )
+                ).lower(u, *apply_args).compile()
+
+            try:
+                fn = _compile_action(apply_fn)
+            except Exception as exc:
+                # Engine-apply compile failure: unfused fallback, same
+                # rationale as the CG branch above.
+                if not (kron and res.extra.get("cg_engine")):
+                    raise
+                res.extra["cg_engine"] = False
+                res.extra["cg_engine_error"] = (
+                    f"{type(exc).__name__}: {exc}"[:300]
                 )
-            ).lower(u, *apply_args).compile()
+                apply_fn, _, _ = make_kron_sharded_fns(
+                    op, dgrid, cfg.nreps, engine=False
+                )
+                fn = _compile_action(apply_fn)
             run_args = apply_args
         norm_c = jax.jit(norm_fn).lower(u, *norm_args).compile()
         # Warm-up executes the full compiled computation once: the first

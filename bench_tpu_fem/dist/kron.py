@@ -315,7 +315,7 @@ def make_kron_sharded_fns(op: DistKronLaplacian, dgrid, nreps: int,
     from jax.sharding import PartitionSpec as P
 
     from ..la.cg import cg_solve
-    from .kron_cg import dist_kron_cg_solve_local
+    from .kron_cg import dist_kron_apply_ring_local, dist_kron_cg_solve_local
 
     spec = P(*AXIS_NAMES)
     rep = P()
@@ -325,6 +325,13 @@ def make_kron_sharded_fns(op: DistKronLaplacian, dgrid, nreps: int,
     vma = op.resolve_impl() != "pallas"
     if engine is None:
         engine = resolve_kron_engine(op)
+    elif engine and not (op.dshape[1] == 1 and op.dshape[2] == 1):
+        # the delay-ring engine's halo extension is x-only; an explicit
+        # override on another mesh would silently drop y/z seam data
+        raise ValueError(
+            f"the fused dist engine needs an x-only device mesh, "
+            f"got dshape {op.dshape}"
+        )
 
     def _local(a):
         return a[0, 0, 0]
@@ -333,8 +340,10 @@ def make_kron_sharded_fns(op: DistKronLaplacian, dgrid, nreps: int,
         return lambda u, v: masked_dot(u, v, mask)
 
     @partial(jax.shard_map, mesh=dgrid.mesh, in_specs=(spec, rep),
-             out_specs=spec, check_vma=vma)
+             out_specs=spec, check_vma=False if engine else vma)
     def apply_fn(x, A):
+        if engine:
+            return dist_kron_apply_ring_local(A, _local(x))[None, None, None]
         return A.apply_local(_local(x))[None, None, None]
 
     @partial(jax.shard_map, mesh=dgrid.mesh, in_specs=(spec, rep),
