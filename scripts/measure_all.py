@@ -193,6 +193,24 @@ print("DIST1:", res.gdof_per_second, res.extra)
     log(f"dist1 rc={rc}: {out}")
 
 
+def stage_dfdist1():
+    # distributed df32 path compile+run on a 1-device mesh (the sharded
+    # dist.kron_df graph end to end; multi-chip perf needs real hardware)
+    code = """
+import jax, jax.numpy as jnp
+from bench_tpu_fem.bench.driver import BenchConfig, BenchmarkResults
+from bench_tpu_fem.dist.driver import run_distributed_df64
+cfg = BenchConfig(ndofs_global=2_000_000, degree=3, qmode=1,
+                  float_bits=64, nreps=50, use_cg=True,
+                  f64_impl="df32", ndevices=1)
+res = BenchmarkResults()
+run_distributed_df64(cfg, res)
+print("DFDIST1:", res.gdof_per_second, res.extra, "ynorm", res.ynorm)
+"""
+    rc, out = run_py(code, timeout=1200)
+    log(f"dfdist1 rc={rc}: {out}")
+
+
 def stage_q6one():
     _bench_stage("q6one", "Q6ONEKERNEL:", dict(
         ndofs_global=12_500_000, degree=6, qmode=1, float_bits=32,
@@ -206,10 +224,12 @@ STAGES = {
     "large": stage_large, "deg4": stage_deg4, "df32": stage_df32,
     "matrix": stage_matrix, "bench": stage_bench,
     "deg5": stage_deg5, "dist1": stage_dist1, "q6one": stage_q6one,
+    "dfdist1": stage_dfdist1,
 }
 
 if __name__ == "__main__":
-    wanted = sys.argv[1:] or ["health", "deg5", "dist1", "q6one", "bench"]
+    wanted = sys.argv[1:] or ["health", "deg5", "dist1", "dfdist1",
+                              "q6one", "bench"]
     unknown = [s for s in wanted if s not in STAGES]
     if unknown:
         print(f"unknown stage(s) {unknown}; valid: {list(STAGES)}",
