@@ -44,7 +44,7 @@ def _setup(dshape, degree, ncells_x=None):
 
 
 @pytest.mark.parametrize("dshape,degree", [((4, 1, 1), 3), ((8, 1, 1), 2),
-                                           ((4, 1, 1), 5)])
+                                           ((4, 1, 1), 5), ((4, 1, 1), 7)])
 def test_dist_engine_apply_bitwise_vs_single_chip(dshape, degree):
     from bench_tpu_fem.ops.kron_cg import kron_apply_ring
 
