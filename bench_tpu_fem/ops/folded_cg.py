@@ -55,6 +55,7 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from ..la.cg import fused_cg_solve
+from .kron_cg import pallas_update_for
 from .pallas_laplacian import (
     SUBLANES,
     _use_interpret,
@@ -344,10 +345,22 @@ def folded_cg_solve(
     b: jnp.ndarray,
     nreps: int,
     interpret: bool | None = None,
+    pallas_update: bool | None = None,
 ) -> jnp.ndarray:
     """Benchmark CG (x0 = 0, rtol = 0, exactly nreps iterations) with the
     fused two-kernel iteration. Matches la.cg.cg_solve(op.apply_cg, b, 0,
-    nreps) to f32 reassociation accuracy."""
+    nreps) to f32 reassociation accuracy.
+
+    `pallas_update` (default: by size) routes the x/r update through the
+    chunked pallas pass shared with the kron engine
+    (ops.kron_cg.cg_update_pallas): the XLA TPU backend fails compilation
+    of whole-vector fusions around ~130M dofs, and corner-mode geometry
+    scales perturbed problems well past that. The (nb, P^3, B) folded
+    layout rides the pass as a 3D grid directly — full B-lane trailing
+    blocks, sublane-aligned row chunks; the folded structural zero slots
+    contribute zeros to <r1, r1> exactly as in the fused XLA pass."""
+    from .kron_cg import PALLAS_UPDATE_MIN_DOFS, cg_update_pallas
+
     layout = op.layout
     geom, geom_tables = _op_geom_for_engine(op)
     phi0 = np.asarray(op.phi0_c, np.float64)
@@ -363,7 +376,8 @@ def folded_cg_solve(
         # the kernel emits per-block partials; XLA sums the ~MB array
         return p, y, jnp.sum(pdot)
 
-    return fused_cg_solve(engine, b, nreps)
+    update = pallas_update_for(b, pallas_update, interpret)
+    return fused_cg_solve(engine, b, nreps, update=update)
 
 
 def folded_apply_ring(

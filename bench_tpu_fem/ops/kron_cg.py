@@ -727,6 +727,20 @@ def cg_update_pallas(x, p, r, y, alpha, interpret: bool | None = None):
 PALLAS_UPDATE_MIN_DOFS = 100_000_000
 
 
+def pallas_update_for(b, pallas_update, interpret):
+    """Shared x/r-update routing for the fused CG solvers (kron, folded):
+    the chunked pallas pass above PALLAS_UPDATE_MIN_DOFS (XLA's TPU
+    backend fails whole-vector fusions ~130M dofs), else None (the fused
+    XLA pass). One helper so the gating policy cannot diverge between
+    engines."""
+    use = (b.size >= PALLAS_UPDATE_MIN_DOFS if pallas_update is None
+           else pallas_update)
+    if not use:
+        return None
+    return (lambda x, p, r, y, alpha:
+            cg_update_pallas(x, p, r, y, alpha, interpret))
+
+
 def kron_cg_solve(op, b: jnp.ndarray, nreps: int,
                   interpret: bool | None = None,
                   pallas_update: bool | None = None) -> jnp.ndarray:
@@ -738,15 +752,7 @@ def kron_cg_solve(op, b: jnp.ndarray, nreps: int,
     def engine(r, p_prev, beta):
         return _kron_cg_call(op, True, interpret, r, p_prev, beta)
 
-    use_pallas_upd = (
-        b.size >= PALLAS_UPDATE_MIN_DOFS if pallas_update is None
-        else pallas_update
-    )
-    update = (
-        (lambda x, p, r, y, alpha:
-         cg_update_pallas(x, p, r, y, alpha, interpret))
-        if use_pallas_upd else None
-    )
+    update = pallas_update_for(b, pallas_update, interpret)
     return fused_cg_solve(engine, b, nreps, update=update)
 
 

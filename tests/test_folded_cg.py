@@ -123,3 +123,14 @@ def test_engine_cg_against_csr_oracle():
     x = unfold_vector(np.asarray(folded_cg_solve(op, bf, 5)), op.layout)
     scale = np.abs(z).max()
     np.testing.assert_allclose(x.ravel(), z, atol=2e-4 * scale)
+
+
+def test_engine_cg_pallas_update_matches_default():
+    """The chunked pallas x/r update (shared with the kron engine, for
+    >=130M-dof capacity) must reproduce the fused-XLA update on folded
+    vectors, structural zero slots included."""
+    op, bf = _setup((6, 5, 4), 3, 1, "corner")
+    x_ref = np.asarray(folded_cg_solve(op, bf, 5))
+    x_pal = np.asarray(folded_cg_solve(op, bf, 5, pallas_update=True))
+    scale = np.abs(x_ref).max()
+    np.testing.assert_allclose(x_pal, x_ref, atol=1e-5 * scale)
