@@ -16,9 +16,10 @@ Kernel (`_kron_cg_call`) — grid over the NX dof planes, sequential:
   - Z+Y IN REGISTERS: the banded z (lane-shift) and y (sublane-shift)
     contractions for the ingested plane run back-to-back in-kernel; the
     t12/tyz intermediates never touch HBM.
-  - X VIA DELAY RING: t12/tyz/p planes land in VMEM rings of
-    KI = 2P + 2 slots; the x contraction for output plane i = t - P reads
-    ring rows i - P .. i + P with per-output-row banded coefficients
+  - X VIA DELAY RING: t12/tyz planes land in VMEM rings of KI = 2P + 2
+    slots (the x contraction for output plane i = t - P reads ring rows
+    i - P .. i + P); the p plane is read back exactly once at lag P, so
+    its ring needs only P + 1 slots. Per-output-row banded coefficients
     streamed as (1, 2P+1) SMEM blocks. Out-of-range rows are killed by the
     zero boundary columns of the banded-diagonal storage
     (ops.kron.banded_diags), as in every kron kernel.
@@ -40,8 +41,10 @@ loop (/root/reference/src/cg.hpp:121-167) with identical per-element
 operation order. float32 only (Mosaic has no f64); rtol = 0 benchmark
 semantics (exactly nreps iterations, cg.hpp:88-91).
 
-VMEM: the one-kernel form holds 3 rings x KI full (NY, NZ_padded) planes —
-fine through ~35M dofs. Above that a two-kernel form takes over, chunking
+VMEM: the one-kernel form holds 2 rings x KI + one ring x (P+1) full
+(NY, NZ_padded) planes — fine through ~45M dofs at degree 3, and through
+the 12.5M degree-6 flagship config. Above that a two-kernel form takes
+over, chunking
 the y axis so every VMEM object is a (CY, NZ) chunk:
 
   Kernel ZY (`_zy_chunk_call`): grid (NX, NYB+1). Step (xi, yj) ingests
@@ -88,13 +91,14 @@ def _lane_pad(n: int) -> int:
 
 
 def engine_vmem_bytes(grid_shape: tuple[int, int, int], degree: int) -> int:
-    """Estimated kernel VMEM footprint: 3 rings of KI (NY, NZpad) f32
-    planes + 4 pipeline-buffered in/out planes (x2 for double buffering)
-    + 2 in-register intermediates."""
+    """Estimated kernel VMEM footprint: 2 rings of KI = 2P+2 (NY, NZpad)
+    f32 planes (the t12/tyz x-windows) + the P+1-slot p ring (read once
+    at lag P) + 4 pipeline-buffered in/out planes (x2 for double
+    buffering) + 2 in-register intermediates."""
     _, NY, NZ = grid_shape
     plane = NY * _lane_pad(NZ) * 4
     KI = 2 * degree + 2
-    return (3 * KI + 4 * 2 + 2) * plane
+    return (2 * KI + degree + 1 + 4 * 2 + 2) * plane
 
 
 def supports_kron_cg_engine(grid_shape, degree: int, dtype) -> bool:
@@ -239,6 +243,8 @@ def _make_kron_cg_kernel(P: int, NX: int, NY: int, NZ: int, KI: int,
             ring_p[...] = jnp.zeros_like(ring_p)
             dacc[...] = jnp.zeros_like(dacc)
 
+        KP = np.int32(P + 1)  # p ring: single-plane read at lag D = P
+
         # ---- ingest plane t: p-update, z+y contractions, ring publish ----
         @pl.when(t < np.int32(n_in))
         def _ingest():
@@ -260,7 +266,10 @@ def _make_kron_cg_kernel(P: int, NX: int, NY: int, NZ: int, KI: int,
             t12, tyz = _zy_contract(
                 p2, ckz_ref, cmz_ref, cky_ref, cmy_ref, P, NY, NZ
             )
-            ring_p[slot] = p2
+            # p is read back exactly once, at emit lag D = P, so its ring
+            # needs only P + 1 slots (the t12/tyz rings need the full
+            # 2P + 1 x-window, hence KI = 2P + 2 with the write slot)
+            ring_p[jax.lax.rem(t, KP)] = p2
             ring_t12[slot] = t12
             ring_tyz[slot] = tyz
 
@@ -268,7 +277,7 @@ def _make_kron_cg_kernel(P: int, NX: int, NY: int, NZ: int, KI: int,
         @pl.when(t >= np.int32(D + halo))
         def _emit():
             i = t - np.int32(D)
-            p_i = ring_p[jax.lax.rem(i, np.int32(KI))]
+            p_i = ring_p[jax.lax.rem(i, KP)]
             gy = jax.lax.broadcasted_iota(jnp.int32, (NY, NZ), 0)
             gz = jax.lax.broadcasted_iota(jnp.int32, (NY, NZ), 1)
             mi = aux_ref[0, 0, 0] > 0.5 if halo else None
@@ -650,7 +659,7 @@ def _kron_cg_call(op, update_p: bool, interpret, *vectors,
         scratch_shapes=[
             pltpu.VMEM((KI, NY, NZ), dtype),
             pltpu.VMEM((KI, NY, NZ), dtype),
-            pltpu.VMEM((KI, NY, NZ), dtype),
+            pltpu.VMEM((P + 1, NY, NZ), dtype),  # p: single-plane lag read
             pltpu.VMEM((1, 1), dtype),
         ],
         interpret=_use_interpret() if interpret is None else interpret,
