@@ -211,6 +211,29 @@ print("DFDIST1:", res.gdof_per_second, res.extra, "ynorm", res.ynorm)
     log(f"dfdist1 rc={rc}: {out}")
 
 
+def stage_deg6stream():
+    # Degree-6 qmode-1 perturbed on the plane-streamed corner path:
+    # the VMEM estimate says ~15 MB vs the 14 MiB corner budget vs the
+    # ~16.5 MB hardware limit — genuinely borderline, so probe Mosaic
+    # directly (policy override; flip pallas_geom_constraint only with
+    # a successful compile + sane perf here).
+    code = PRE + """
+import bench_tpu_fem.ops.folded as FO
+import bench_tpu_fem.ops.pallas_laplacian as PL
+orig = FO.pallas_geom_constraint
+FO.pallas_geom_constraint = lambda d, nq, itemsize=4: (
+    (True, "corner") if d == 6 else orig(d, nq, itemsize))
+PL.corner_streamed_lanes_ok = lambda nd, nq, itemsize=4: True
+cfg = BenchConfig(ndofs_global=12_500_000, degree=6, qmode=1,
+                  float_bits=32, nreps=200, use_cg=True,
+                  geom_perturb_fact=0.2, backend="pallas")
+res, w = timed_res(cfg)
+print("DEG6STREAM:", res.gdof_per_second, res.extra)
+"""
+    rc, out = run_py(code, timeout=1800)
+    log(f"deg6stream rc={rc}: {out}")
+
+
 def stage_q6one():
     _bench_stage("q6one", "Q6ONEKERNEL:", dict(
         ndofs_global=12_500_000, degree=6, qmode=1, float_bits=32,
@@ -224,12 +247,12 @@ STAGES = {
     "large": stage_large, "deg4": stage_deg4, "df32": stage_df32,
     "matrix": stage_matrix, "bench": stage_bench,
     "deg5": stage_deg5, "dist1": stage_dist1, "q6one": stage_q6one,
-    "dfdist1": stage_dfdist1,
+    "dfdist1": stage_dfdist1, "deg6stream": stage_deg6stream,
 }
 
 if __name__ == "__main__":
     wanted = sys.argv[1:] or ["health", "deg5", "dist1", "dfdist1",
-                              "q6one", "bench"]
+                              "q6one", "deg6stream", "bench"]
     unknown = [s for s in wanted if s not in STAGES]
     if unknown:
         print(f"unknown stage(s) {unknown}; valid: {list(STAGES)}",
