@@ -403,6 +403,8 @@ def _run_benchmark(cfg: BenchConfig) -> BenchmarkResults:
         engine = False
         engine_cg = None  # fused (A, b) -> x solve, nreps baked in
         engine_apply = None  # fused (A, x) -> y single apply
+        engine_cg_retry = None  # chunked-form retry after a Mosaic reject
+        engine_apply_retry = None
         if folded:
             from ..ops.folded_cg import (
                 folded_apply_ring,
@@ -422,6 +424,7 @@ def _run_benchmark(cfg: BenchConfig) -> BenchmarkResults:
             # plus unfused vector algebra. Pallas => TPU f32 only (same
             # auto rule as KronLaplacian.apply); VMEM gates the ring.
             from ..ops.kron_cg import (
+                engine_form,
                 kron_apply_ring,
                 kron_cg_solve,
                 supports_kron_cg_engine,
@@ -435,6 +438,15 @@ def _run_benchmark(cfg: BenchConfig) -> BenchmarkResults:
             if engine:
                 engine_cg = lambda A, b: kron_cg_solve(A, b, cfg.nreps)  # noqa: E731
                 engine_apply = kron_apply_ring
+                if engine_form(u.shape, cfg.degree) == "one":
+                    # Near the VMEM budget line the estimate can admit a
+                    # one-kernel form Mosaic then rejects; the chunked
+                    # form (O(chunk) VMEM) is the right retry before
+                    # giving up the engine entirely.
+                    engine_cg_retry = lambda A, b: kron_cg_solve(  # noqa: E731
+                        A, b, cfg.nreps, force_chunked=True)
+                    engine_apply_retry = partial(
+                        kron_apply_ring, force_chunked=True)
         unfused_apply = (
             (lambda A: A.apply_cg) if folded else (lambda A: A.apply)
         )
@@ -452,17 +464,34 @@ def _run_benchmark(cfg: BenchConfig) -> BenchmarkResults:
             if engine:
                 # A Mosaic rejection of the fused engine (e.g. a VMEM or
                 # lowering limit this config's estimates missed) must not
-                # sink the benchmark: fall back to the unfused path and
-                # record why. Compile errors only — execution errors
-                # propagate (a fallback there could mask wrong results).
-                try:
-                    fn = jax.jit(
-                        lambda A, b, x0: engine_cg(A, b)
+                # sink the benchmark: retry the chunked form when the
+                # first pick was the one-kernel form, then fall back to
+                # the unfused path, recording why. Compile errors only —
+                # execution errors propagate (a fallback there could mask
+                # wrong results).
+                def _compile_cg(cg):
+                    return jax.jit(
+                        lambda A, b, x0: cg(A, b)
                     ).lower(op, u, jnp.zeros_like(u)).compile()
+
+                try:
+                    fn = _compile_cg(engine_cg)
                 except Exception as exc:
-                    engine = False
-                    _record_engine_failure(exc)
-                    apply_fn = unfused_apply
+                    if engine_cg_retry is not None:
+                        try:
+                            fn = _compile_cg(engine_cg_retry)
+                            res.extra["cg_engine_form"] = "chunked-retry"
+                        except Exception as exc2:
+                            engine = False
+                            _record_engine_failure(exc)
+                            res.extra["cg_engine_retry_error"] = (
+                                f"{type(exc2).__name__}: {exc2}"[:300]
+                            )
+                    else:
+                        engine = False
+                        _record_engine_failure(exc)
+                    if not engine:
+                        apply_fn = unfused_apply
             if not engine:
                 fn = jax.jit(
                     lambda A, b, x0: cg_solve(apply_fn(A), b, x0, cfg.nreps)
@@ -495,11 +524,22 @@ def _run_benchmark(cfg: BenchConfig) -> BenchmarkResults:
             except Exception as exc:
                 if not engine:  # nothing to fall back to
                     raise
-                # engine apply failed to compile: unfused fallback (same
-                # rationale as the CG branch above)
-                engine = False
-                _record_engine_failure(exc)
-                fn = _compile_action(unfused_apply)
+                # engine apply failed to compile: chunked retry, then
+                # unfused fallback (same rationale as the CG branch above)
+                fn = None
+                if engine_apply_retry is not None:
+                    try:
+                        fn = _compile_action(
+                            lambda A: partial(engine_apply_retry, A))
+                        res.extra["cg_engine_form"] = "chunked-retry"
+                    except Exception as exc2:
+                        res.extra["cg_engine_retry_error"] = (
+                            f"{type(exc2).__name__}: {exc2}"[:300]
+                        )
+                if fn is None:
+                    engine = False
+                    _record_engine_failure(exc)
+                    fn = _compile_action(unfused_apply)
             warm = fn(op, u)
         # One warm-up execution (fenced): first execution pays one-time
         # transfer/initialisation costs that are not operator throughput.

@@ -75,15 +75,17 @@ from ..la.cg import fused_cg_solve
 from .pallas_laplacian import _use_interpret
 
 # VMEM budget (bytes) for the ring + pipeline buffers; the hardware limit
-# measured on v5e is ~16.5 MB. Deliberately conservative: the estimate
-# does not model Mosaic's own allocations, and a Mosaic VMEM rejection at
-# benchmark time costs a recorded run — configs near the line (degree 6
-# at 12.5M dofs estimates 12.4 MB) take the chunked form, which is a few
-# streams slower but has O(chunk) VMEM at any size. Raise only with a
-# hardware compile check of the borderline configs. (11 MiB =
-# 11,534,336 B: below the degree-6 estimate of 12,353,536 B, above the
-# degree-3 flagship's 8,077,312 B.)
-VMEM_BUDGET = 11 * 2**20
+# measured on v5e is ~16.5 MB (Mosaic's scoped stack limit is 16.0 MB).
+# The estimate does not model Mosaic's own allocations, so the budget
+# stays below the hardware line — but the borderline config the previous
+# 11 MiB budget excluded (degree 6 at 12.5M dofs, estimate 12,353,536 B)
+# was hardware-compile-checked on v5e (MEASURE_r04.log q6one: compiles
+# and runs at 6.23 GDoF/s vs 4.97 for the chunked form), so 13 MiB
+# admits it while keeping ~3 MB of headroom for Mosaic. Configs above
+# the line take the chunked form: a few streams slower, O(chunk) VMEM
+# at any size. Raise further only with a hardware compile check of the
+# next borderline config.
+VMEM_BUDGET = 13 * 2**20
 
 
 def _lane_pad(n: int) -> int:
@@ -564,8 +566,18 @@ def _kron_cg_call_chunked(op, update_p: bool, interpret, *vectors):
     return y, dot_total
 
 
+def engine_form(grid_shape: tuple[int, int, int], degree: int) -> str:
+    """Which engine form the auto dispatch picks for a single-chip grid:
+    'one' (delay-ring one-kernel) under the VMEM budget, else 'chunked'.
+    Exposed so the driver's compile-failure fallback can retry the
+    chunked form exactly when the first attempt was the one-kernel form
+    (the estimate under-predicts Mosaic's stack near the budget line)."""
+    return ("one" if engine_vmem_bytes(grid_shape, degree) <= VMEM_BUDGET
+            else "chunked")
+
+
 def _kron_cg_call(op, update_p: bool, interpret, *vectors,
-                  cx=None, aux=None):
+                  cx=None, aux=None, force_chunked: bool = False):
     """update_p: vectors = (r, p_prev, beta) -> (p, y, <p, A p>).
     else:       vectors = (x,)              -> (y, <x, A x>).
 
@@ -577,7 +589,7 @@ def _kron_cg_call(op, update_p: bool, interpret, *vectors,
     halo = 0 if cx is None else P
     if halo == 0:
         NX, NY, NZ = (int(a.shape[0]) for a in op.notbc1d)
-        if engine_vmem_bytes((NX, NY, NZ), P) > VMEM_BUDGET:
+        if force_chunked or engine_form((NX, NY, NZ), P) == "chunked":
             return _kron_cg_call_chunked(op, update_p, interpret, *vectors)
     else:
         # distributed form (dist.kron_cg): vectors are halo-extended local
@@ -752,23 +764,28 @@ def pallas_update_for(b, pallas_update, interpret):
 
 def kron_cg_solve(op, b: jnp.ndarray, nreps: int,
                   interpret: bool | None = None,
-                  pallas_update: bool | None = None) -> jnp.ndarray:
+                  pallas_update: bool | None = None,
+                  force_chunked: bool = False) -> jnp.ndarray:
     """Benchmark CG with the fused one-kernel iteration (shared driver
     loop: la.cg.fused_cg_solve). Matches la.cg.cg_solve(op.apply, b, 0,
     nreps) to f32 reassociation accuracy. `pallas_update` (default: by
-    size) routes the x/r update through cg_update_pallas."""
+    size) routes the x/r update through cg_update_pallas. `force_chunked`
+    overrides the auto form pick (the driver's Mosaic-rejection retry)."""
 
     def engine(r, p_prev, beta):
-        return _kron_cg_call(op, True, interpret, r, p_prev, beta)
+        return _kron_cg_call(op, True, interpret, r, p_prev, beta,
+                             force_chunked=force_chunked)
 
     update = pallas_update_for(b, pallas_update, interpret)
     return fused_cg_solve(engine, b, nreps, update=update)
 
 
 def kron_apply_ring(op, x: jnp.ndarray,
-                    interpret: bool | None = None) -> jnp.ndarray:
+                    interpret: bool | None = None,
+                    force_chunked: bool = False) -> jnp.ndarray:
     """Single delay-ring apply y = A x (with Dirichlet pass-through),
     discarding the fused <x, A x> partial. Used by the action benchmark
     when the engine is available."""
-    y, _ = _kron_cg_call(op, False, interpret, x)
+    y, _ = _kron_cg_call(op, False, interpret, x,
+                         force_chunked=force_chunked)
     return y

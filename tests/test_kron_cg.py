@@ -191,6 +191,54 @@ def test_engine_qmode0_matches_xla(degree):
     assert rel < 5e-5
 
 
+def test_force_chunked_matches_auto_form():
+    """force_chunked (the driver's Mosaic-rejection retry) must produce
+    the same solve as the auto-picked form on a grid where auto picks the
+    one-kernel form."""
+    from bench_tpu_fem.ops.kron_cg import engine_form
+
+    op, opx, b = _setup(3, (4, 5, 6))
+    assert engine_form(b.shape, 3) == "one"
+    x_auto = kron_cg_solve(op, b, 10, interpret=True)
+    x_chunk = kron_cg_solve(op, b, 10, interpret=True, force_chunked=True)
+    rel = float(jnp.linalg.norm(x_auto - x_chunk)
+                / jnp.linalg.norm(x_auto))
+    assert rel < 5e-5
+    y_auto = kron_apply_ring(op, b, interpret=True)
+    y_chunk = kron_apply_ring(op, b, interpret=True, force_chunked=True)
+    rel = float(jnp.linalg.norm(y_auto - y_chunk)
+                / jnp.linalg.norm(y_auto))
+    assert rel < 5e-6
+
+
+def test_driver_retries_chunked_when_one_kernel_fails(monkeypatch):
+    """When the one-kernel form is the auto pick and Mosaic rejects it,
+    the driver must retry the chunked engine form (not drop straight to
+    the unfused path) and record the form switch."""
+    import bench_tpu_fem.ops.kron_cg as KC
+    from bench_tpu_fem.bench.driver import BenchConfig, run_benchmark
+
+    real = KC.kron_cg_solve
+
+    def picky(op, b, nreps, force_chunked=False, **kw):
+        if not force_chunked:
+            raise RuntimeError("Mosaic rejects the one-kernel form")
+        return real(op, b, nreps, interpret=True,
+                    force_chunked=True, **kw)
+
+    monkeypatch.setattr(KC, "kron_cg_solve", picky)
+    monkeypatch.setattr(KC, "supports_kron_cg_engine", lambda *a: True)
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+
+    cfg = BenchConfig(ndofs_global=2000, degree=3, qmode=1, float_bits=32,
+                      nreps=3, use_cg=True, ndevices=1)
+    res = run_benchmark(cfg)
+    assert res.extra["cg_engine"] is True
+    assert res.extra.get("cg_engine_form") == "chunked-retry"
+    assert "cg_engine_error" not in res.extra
+    assert np.isfinite(res.ynorm) and res.ynorm > 0
+
+
 def test_driver_falls_back_when_engine_compile_fails(monkeypatch):
     """A Mosaic rejection of the fused engine must not sink a benchmark
     run: the driver records the error and completes on the unfused path."""
