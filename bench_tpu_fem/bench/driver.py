@@ -41,6 +41,11 @@ from ..mesh.dofmap import (
     dof_grid_shape,
 )
 from ..ops.laplacian import build_laplacian
+from ..utils.compilation import (  # noqa: F401  (TPU_COMPILER_OPTIONS re-exported for probes/tests, which must mutate it IN PLACE — rebinding the name here would not reach compile_lowered)
+    TPU_COMPILER_OPTIONS,
+    compile_lowered,
+    scoped_vmem_options,
+)
 from ..utils.timing import Timer
 
 
@@ -249,13 +254,13 @@ def _run_benchmark_df64(cfg: BenchConfig) -> BenchmarkResults:
         u = (df_from_f64(np.asarray(b_host, np.float64))
              if cfg.mat_comp else device_rhs_uniform_df(t, mesh.n))
         if cfg.use_cg:
-            fn = jax.jit(
+            fn = compile_lowered(jax.jit(
                 lambda A, b: cg_solve_df(A, b, cfg.nreps)
-            ).lower(op, u).compile()
+            ).lower(op, u))
         else:
-            fn = jax.jit(
+            fn = compile_lowered(jax.jit(
                 lambda A, b: action_df(A, b, cfg.nreps)
-            ).lower(op, u).compile()
+            ).lower(op, u))
         warm = fn(op, u)
         float(warm.hi[(0,) * warm.hi.ndim])
         del warm
@@ -405,13 +410,23 @@ def _run_benchmark(cfg: BenchConfig) -> BenchmarkResults:
         engine_apply = None  # fused (A, x) -> y single apply
         engine_cg_retry = None  # chunked-form retry after a Mosaic reject
         engine_apply_retry = None
+        # Per-compile TPU options (utils.compilation): the folded
+        # streamed-corner kernels (degrees 5-6) and the kron one-kernel
+        # engine at large grids need a raised scoped-VMEM limit; every
+        # other path compiles with none (a blanket raise measured a ~12%
+        # flagship regression, MEASURE_r04.log A probe).
+        compile_opts = None
         if folded:
+            from ..ops.folded import pallas_plan
             from ..ops.folded_cg import (
                 folded_apply_ring,
                 folded_cg_solve,
                 supports_cg_engine,
             )
 
+            compile_opts = scoped_vmem_options(
+                pallas_plan(cfg.degree, t.nq, np.dtype(dtype).itemsize)[2]
+            )
             engine = supports_cg_engine(op)
             res.extra["geom"] = "corner" if op.G is None else "g"
             res.extra["cg_engine"] = engine
@@ -424,7 +439,7 @@ def _run_benchmark(cfg: BenchConfig) -> BenchmarkResults:
             # plus unfused vector algebra. Pallas => TPU f32 only (same
             # auto rule as KronLaplacian.apply); VMEM gates the ring.
             from ..ops.kron_cg import (
-                engine_form,
+                engine_plan,
                 kron_apply_ring,
                 kron_cg_solve,
                 supports_kron_cg_engine,
@@ -436,9 +451,11 @@ def _run_benchmark(cfg: BenchConfig) -> BenchmarkResults:
             )
             res.extra["cg_engine"] = engine
             if engine:
+                form, kib = engine_plan(u.shape, cfg.degree)
+                compile_opts = scoped_vmem_options(kib)
                 engine_cg = lambda A, b: kron_cg_solve(A, b, cfg.nreps)  # noqa: E731
                 engine_apply = kron_apply_ring
-                if engine_form(u.shape, cfg.degree) == "one":
+                if form == "one":
                     # Near the VMEM budget line the estimate can admit a
                     # one-kernel form Mosaic then rejects; the chunked
                     # form (O(chunk) VMEM) is the right retry before
@@ -450,6 +467,11 @@ def _run_benchmark(cfg: BenchConfig) -> BenchmarkResults:
         unfused_apply = (
             (lambda A: A.apply_cg) if folded else (lambda A: A.apply)
         )
+        # kron fallbacks (chunked retry / unfused) fit the default scoped
+        # limit — compiling them with the raise would hand them the same
+        # ~12% pipeline-headroom handicap the A probe measured; folded
+        # fallbacks still run the streamed kernels and keep the request.
+        fallback_opts = compile_opts if folded else None
 
         def _record_engine_failure(exc):
             res.extra["cg_engine"] = False
@@ -469,17 +491,17 @@ def _run_benchmark(cfg: BenchConfig) -> BenchmarkResults:
                 # the unfused path, recording why. Compile errors only —
                 # execution errors propagate (a fallback there could mask
                 # wrong results).
-                def _compile_cg(cg):
-                    return jax.jit(
+                def _compile_cg(cg, opts):
+                    return compile_lowered(jax.jit(
                         lambda A, b, x0: cg(A, b)
-                    ).lower(op, u, jnp.zeros_like(u)).compile()
+                    ).lower(op, u, jnp.zeros_like(u)), opts)
 
                 try:
-                    fn = _compile_cg(engine_cg)
+                    fn = _compile_cg(engine_cg, compile_opts)
                 except Exception as exc:
                     if engine_cg_retry is not None:
                         try:
-                            fn = _compile_cg(engine_cg_retry)
+                            fn = _compile_cg(engine_cg_retry, fallback_opts)
                             res.extra["cg_engine_form"] = "chunked-retry"
                         except Exception as exc2:
                             engine = False
@@ -493,9 +515,9 @@ def _run_benchmark(cfg: BenchConfig) -> BenchmarkResults:
                     if not engine:
                         apply_fn = unfused_apply
             if not engine:
-                fn = jax.jit(
+                fn = compile_lowered(jax.jit(
                     lambda A, b, x0: cg_solve(apply_fn(A), b, x0, cfg.nreps)
-                ).lower(op, u, jnp.zeros_like(u)).compile()
+                ).lower(op, u, jnp.zeros_like(u)), fallback_opts)
             warm = fn(op, u, jnp.zeros_like(u))
         else:
             # All nreps applies in one jitted fori_loop: same semantics as
@@ -511,16 +533,16 @@ def _run_benchmark(cfg: BenchConfig) -> BenchmarkResults:
                 xx, _ = jax.lax.optimization_barrier((x, y))
                 return af(A)(xx)
 
-            def _compile_action(af):
-                return jax.jit(
+            def _compile_action(af, opts):
+                return compile_lowered(jax.jit(
                     lambda A, x: jax.lax.fori_loop(
                         0, cfg.nreps, partial(_rep, A=A, x=x, af=af),
                         jnp.zeros_like(x),
                     )
-                ).lower(op, u).compile()
+                ).lower(op, u), opts)
 
             try:
-                fn = _compile_action(apply_fn)
+                fn = _compile_action(apply_fn, compile_opts)
             except Exception as exc:
                 if not engine:  # nothing to fall back to
                     raise
@@ -530,7 +552,8 @@ def _run_benchmark(cfg: BenchConfig) -> BenchmarkResults:
                 if engine_apply_retry is not None:
                     try:
                         fn = _compile_action(
-                            lambda A: partial(engine_apply_retry, A))
+                            lambda A: partial(engine_apply_retry, A),
+                            fallback_opts)
                         res.extra["cg_engine_form"] = "chunked-retry"
                     except Exception as exc2:
                         res.extra["cg_engine_retry_error"] = (
@@ -539,7 +562,7 @@ def _run_benchmark(cfg: BenchConfig) -> BenchmarkResults:
                 if fn is None:
                     engine = False
                     _record_engine_failure(exc)
-                    fn = _compile_action(unfused_apply)
+                    fn = _compile_action(unfused_apply, fallback_opts)
             warm = fn(op, u)
         # One warm-up execution (fenced): first execution pays one-time
         # transfer/initialisation costs that are not operator throughput.

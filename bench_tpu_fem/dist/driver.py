@@ -11,6 +11,7 @@ from functools import partial
 import numpy as np
 
 from ..la.cg import cg_solve
+from ..utils.compilation import compile_lowered, scoped_vmem_options
 from ..utils.timing import Timer
 from .halo import masked_dot, masked_linf, owned_mask
 from .mesh import AXIS_NAMES, compute_mesh_size_sharded, make_device_grid
@@ -109,6 +110,9 @@ def run_distributed(cfg, res, dtype):
             "use the xla/pallas backends for perturbed geometry"
         )
     folded = backend == "pallas"
+    # per-path raised scoped-VMEM request (utils.compilation), set by the
+    # kron-engine / folded-plan branches below
+    compile_opts = None
     res.ncells_global = int(np.prod(n))
     res.ndofs_global = int(np.prod(dof_grid_shape(n, cfg.degree)))
 
@@ -147,11 +151,17 @@ def run_distributed(cfg, res, dtype):
                 dtype=dtype, tables=t,
             )
             from .kron import resolve_kron_engine
+            from .kron_cg import dist_kron_engine_plan
 
             apply_fn, cg_fn, norm_fn = make_kron_sharded_fns(
                 op, dgrid, cfg.nreps
             )
             res.extra["cg_engine"] = resolve_kron_engine(op)
+            if res.extra["cg_engine"]:
+                # raised-tier one-kernel rings need the per-compile
+                # scoped-VMEM request, same plan as the single-chip driver
+                compile_opts = scoped_vmem_options(
+                    dist_kron_engine_plan(op)[1])
             if b_host is not None:
                 # mat_comp: feed the oracle-precision host RHS to both paths.
                 u_blocks = shard_grid_blocks(b_host, n, cfg.degree, dgrid.dshape)
@@ -164,6 +174,7 @@ def run_distributed(cfg, res, dtype):
         elif folded:
             # Folded shards (ghost cell columns = halo; see dist.folded:
             # overlap-by-construction apply, per-shard closed-form setup).
+            from ..ops.folded import pallas_plan
             from .folded import (
                 build_dist_folded,
                 make_folded_rhs_fn,
@@ -172,6 +183,11 @@ def run_distributed(cfg, res, dtype):
                 shard_folded_vectors,
             )
 
+            # the streamed-corner kernels (degrees 5-6) compile only with
+            # the raised scoped-VMEM limit, exactly like the single-chip
+            # folded path
+            compile_opts = scoped_vmem_options(
+                pallas_plan(cfg.degree, t.nq, np.dtype(dtype).itemsize)[2])
             op = build_dist_folded(
                 mesh, dgrid, cfg.degree, t, kappa=2.0, dtype=dtype
             )
@@ -215,7 +231,8 @@ def run_distributed(cfg, res, dtype):
 
         if cfg.use_cg:
             try:
-                fn = jax.jit(cg_fn).lower(u, *cg_args).compile()
+                fn = compile_lowered(jax.jit(cg_fn).lower(u, *cg_args),
+                                     compile_opts)
             except Exception as exc:
                 # Same hardening as the single-chip driver: a Mosaic/XLA
                 # rejection of the fused dist engine must not sink the
@@ -232,7 +249,8 @@ def run_distributed(cfg, res, dtype):
                 _, cg_fn, _ = make_kron_sharded_fns(
                     op, dgrid, cfg.nreps, engine=False
                 )
-                fn = jax.jit(cg_fn).lower(u, *cg_args).compile()
+                # unfused kron fallback fits the default scoped limit
+                fn = compile_lowered(jax.jit(cg_fn).lower(u, *cg_args))
             run_args = cg_args
         else:
             # One jitted fori_loop over all reps (same rationale as the
@@ -240,20 +258,20 @@ def run_distributed(cfg, res, dtype):
             # dispatch in the timed region; the optimization_barrier ties
             # the input to the loop carry so the invariant apply can never
             # be hoisted out of the timed loop).
-            def _compile_action(ap):
+            def _compile_action(ap, opts):
                 def _rep(i, y, x, a):
                     xx, _ = jax.lax.optimization_barrier((x, y))
                     return ap(xx, *a)
 
-                return jax.jit(
+                return compile_lowered(jax.jit(
                     lambda x, *a: jax.lax.fori_loop(
                         0, cfg.nreps, partial(_rep, x=x, a=a),
                         jnp.zeros_like(x),
                     )
-                ).lower(u, *apply_args).compile()
+                ).lower(u, *apply_args), opts)
 
             try:
-                fn = _compile_action(apply_fn)
+                fn = _compile_action(apply_fn, compile_opts)
             except Exception as exc:
                 # Engine-apply compile failure: unfused fallback, same
                 # rationale as the CG branch above.
@@ -266,9 +284,9 @@ def run_distributed(cfg, res, dtype):
                 apply_fn, _, _ = make_kron_sharded_fns(
                     op, dgrid, cfg.nreps, engine=False
                 )
-                fn = _compile_action(apply_fn)
+                fn = _compile_action(apply_fn, None)
             run_args = apply_args
-        norm_c = jax.jit(norm_fn).lower(u, *norm_args).compile()
+        norm_c = compile_lowered(jax.jit(norm_fn).lower(u, *norm_args))
         # Warm-up executes the full compiled computation once: the first
         # execution pays program-load/buffer-init costs that are not
         # operator throughput. A cheaper 1-rep warm-up would need a SECOND
@@ -384,7 +402,7 @@ def run_distributed_df64(cfg, res):
             op, dgrid, cfg.nreps
         )
         if cfg.use_cg:
-            fn = jax.jit(cg_fn).lower(u, op).compile()
+            fn = compile_lowered(jax.jit(cg_fn).lower(u, op))
         else:
             def _rep(i, y, x, A):
                 xx, _ = jax.lax.optimization_barrier((x, y))
@@ -392,12 +410,12 @@ def run_distributed_df64(cfg, res):
 
             from ..la.df64 import df_zeros_like
 
-            fn = jax.jit(
+            fn = compile_lowered(jax.jit(
                 lambda x, A: jax.lax.fori_loop(
                     0, cfg.nreps, partial(_rep, x=x, A=A),
                     df_zeros_like(x),
                 )
-            ).lower(u, op).compile()
+            ).lower(u, op))
         warm = fn(u, op)
         float(warm.hi[(0,) * warm.hi.ndim])
         del warm
@@ -415,7 +433,7 @@ def run_distributed_df64(cfg, res):
         float(y.hi[(0,) * y.hi.ndim])  # tunnel fence (see bench.driver)
         res.mat_free_time = time.perf_counter() - t0
 
-    norm_c = jax.jit(norm_fn).lower(u, op).compile()
+    norm_c = compile_lowered(jax.jit(norm_fn).lower(u, op))
     res.unorm, res.unorm_linf = norms_from(norm_c(u, op))
     res.ynorm, res.ynorm_linf = norms_from(norm_c(y, op))
     res.gdof_per_second = (

@@ -60,27 +60,36 @@ from jax import lax
 from ..la.cg import fused_cg_solve
 from ..ops.kron_cg import (
     PALLAS_UPDATE_MIN_DOFS,
-    VMEM_BUDGET,
     _cx_rows,
     _kron_cg_call,
     cg_update_pallas,
-    engine_vmem_bytes,
+    engine_plan,
 )
 from .halo import psum_all
 from .kron import DistKronLaplacian, halo_slabs
 from .mesh import AXIS_NAMES
 
 
-def supports_dist_kron_engine(op: DistKronLaplacian) -> bool:
-    """x-only device meshes, f32, one-kernel VMEM budget (see module
-    docstring)."""
+def dist_kron_engine_plan(
+    op: DistKronLaplacian,
+) -> tuple[bool, int | None]:
+    """(supported, scoped_vmem_kib): x-only device meshes, f32, and the
+    one-kernel ring within either tier of the single-chip engine_plan —
+    the ring's VMEM is set by the unsharded (NY, NZ) cross-section, so
+    the same plan applies per shard; the kib request forwards through
+    the dist driver's compile exactly like the single-chip one."""
     Lx, NY, NZ = op.L[0], op.notbc1d[1].shape[0], op.notbc1d[2].shape[0]
-    return (
-        op.dshape[1] == 1
-        and op.dshape[2] == 1
-        and op.kappa.dtype == jnp.float32
-        and engine_vmem_bytes((Lx, NY, NZ), op.degree) <= VMEM_BUDGET
-    )
+    if not (op.dshape[1] == 1 and op.dshape[2] == 1
+            and op.kappa.dtype == jnp.float32):
+        return False, None
+    form, kib = engine_plan((Lx, NY, NZ), op.degree)
+    return form == "one", kib
+
+
+def supports_dist_kron_engine(op: DistKronLaplacian) -> bool:
+    """Supported component of dist_kron_engine_plan (see module
+    docstring)."""
+    return dist_kron_engine_plan(op)[0]
 
 
 def _shard_tables(op: DistKronLaplacian, dtype):

@@ -786,29 +786,39 @@ def check_tpu_lane_support(layout: FoldedLayout, degree: int,
         )
 
 
-def pallas_geom_constraint(degree: int, nq: int, itemsize: int = 4):
-    """(supported, forced_geom) for the TPU folded Pallas path: full
-    128-lane blocks with G streaming when it fits; corner mode's smaller
-    VMEM footprint rescues degree 4 qmode 1, and its plane-streamed form
-    (pallas_laplacian.sumfact_window_apply_corner_streamed — O(nq^2) live
-    geometry) extends that to degree 5 qmode 1 (forced_geom='corner';
-    corner_apply picks cube vs streamed statically from the same
-    estimates); otherwise unsupported (the driver routes to 'xla').
-    Single policy shared by resolve_backend and the builders (via
+def pallas_plan(degree: int, nq: int, itemsize: int = 4):
+    """(supported, forced_geom, scoped_vmem_kib) for the TPU folded
+    Pallas path: full 128-lane blocks with G streaming when it fits;
+    corner mode's smaller VMEM footprint rescues degree 4 qmode 1; its
+    plane-streamed form (pallas_laplacian.
+    sumfact_window_apply_corner_streamed — O(nq^2) live geometry)
+    extends that to degrees 5-6 qmode 1 under a raised per-compile
+    scoped-VMEM limit (scoped_vmem_kib, passed to compile_lowered — the
+    streamed kernels measure 19-23 MB against the 16 MB default);
+    otherwise unsupported (the driver routes to 'xla'). Single policy
+    shared by resolve_backend and the builders (via
     resolve_pallas_geom)."""
     from .pallas_laplacian import (
+        STREAMED_SCOPED_KIB,
         corner_lanes_ok,
         corner_streamed_lanes_ok,
         pick_lanes,
     )
 
     if pick_lanes(degree + 1, nq, itemsize) == 128:
-        return True, None
+        return True, None, None
     if corner_lanes_ok(degree + 1, nq, itemsize):
-        return True, "corner"
+        return True, "corner", None
     if corner_streamed_lanes_ok(degree + 1, nq, itemsize):
-        return True, "corner"
-    return False, None
+        return True, "corner", STREAMED_SCOPED_KIB
+    return False, None, None
+
+
+def pallas_geom_constraint(degree: int, nq: int, itemsize: int = 4):
+    """(supported, forced_geom) — pallas_plan minus the compile option
+    (kept for callers that only route/build)."""
+    supported, forced, _ = pallas_plan(degree, nq, itemsize)
+    return supported, forced
 
 
 def resolve_pallas_geom(degree: int, nq: int, itemsize: int,

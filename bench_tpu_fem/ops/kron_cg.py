@@ -74,18 +74,14 @@ from jax.experimental.pallas import tpu as pltpu
 from ..la.cg import fused_cg_solve
 from .pallas_laplacian import _use_interpret
 
-# VMEM budget (bytes) for the ring + pipeline buffers; the hardware limit
-# measured on v5e is ~16.5 MB (Mosaic's scoped stack limit is 16.0 MB).
-# The estimate does not model Mosaic's own allocations, so the budget
-# stays below the hardware line — but the borderline config the previous
-# 11 MiB budget excluded (degree 6 at 12.5M dofs, estimate 12,353,536 B)
-# was hardware-compile-checked on v5e (MEASURE_r04.log q6one: compiles
-# and runs at 6.23 GDoF/s vs 4.97 for the chunked form), so 13 MiB
-# admits it while keeping ~3 MB of headroom for Mosaic. Configs above
-# the line take the chunked form: a few streams slower, O(chunk) VMEM
-# at any size. Raise further only with a hardware compile check of the
-# next borderline config.
-VMEM_BUDGET = 13 * 2**20
+# VMEM budget (bytes) under which the one-kernel ring compiles at the
+# DEFAULT scoped-VMEM limit (Mosaic's stack limit is 16.0 MB on v5e and
+# its allocator lands up to ~1.35x this estimate: the degree-3 12.8 MiB
+# estimate is rejected while the degree-6 12.35 MiB one compiles — so
+# 11 MiB is the hardware-validated safe line). Estimates between
+# VMEM_BUDGET and ONE_KERNEL_SCOPED_MAX still take the one-kernel form,
+# but with a raised per-compile scoped limit (engine_plan below).
+VMEM_BUDGET = 11 * 2**20
 
 
 def _lane_pad(n: int) -> int:
@@ -566,14 +562,41 @@ def _kron_cg_call_chunked(op, update_p: bool, interpret, *vectors):
     return y, dot_total
 
 
+# The one-kernel form above the default-scoped-limit budget: PJRT
+# forwards a raised xla_tpu_scoped_vmem_limit_kib per compile (see
+# utils.compilation), and the one-kernel form measured consistently
+# faster than the chunked form on v5e once admitted — Q3@25M 6.92 vs
+# ~5.3, Q3@100M 7.66 vs 6.32 GDoF/s (MEASURE_r04.log B/C probes,
+# estimate 30.5 MiB at 100M). Above ONE_KERNEL_SCOPED_MAX the ring no
+# longer fits even the raised limit (Mosaic's stack runs ~1.3-1.4x the
+# estimate) and the chunked form takes over. The raised limit is
+# requested ONLY for this range: a blanket raise costs the flagship
+# ~12% (9.26 -> 8.13, A probe) by stealing pipeline-buffer headroom.
+ONE_KERNEL_SCOPED_MAX = 31 * 2**20
+ONE_KERNEL_SCOPED_KIB = 65536
+
+
+def engine_plan(
+    grid_shape: tuple[int, int, int], degree: int
+) -> tuple[str, int | None]:
+    """(form, scoped_vmem_kib) the auto dispatch picks for a single-chip
+    grid: 'one' (delay-ring one-kernel) under the default-scoped-limit
+    budget; 'one' with a raised per-compile scoped-VMEM request up to
+    ONE_KERNEL_SCOPED_MAX; else 'chunked'. The driver passes the kib to
+    compile_lowered; _kron_cg_call derives the form from the same plan,
+    so the two cannot disagree."""
+    v = engine_vmem_bytes(grid_shape, degree)
+    if v <= VMEM_BUDGET:
+        return "one", None
+    if v <= ONE_KERNEL_SCOPED_MAX:
+        return "one", ONE_KERNEL_SCOPED_KIB
+    return "chunked", None
+
+
 def engine_form(grid_shape: tuple[int, int, int], degree: int) -> str:
-    """Which engine form the auto dispatch picks for a single-chip grid:
-    'one' (delay-ring one-kernel) under the VMEM budget, else 'chunked'.
-    Exposed so the driver's compile-failure fallback can retry the
-    chunked form exactly when the first attempt was the one-kernel form
-    (the estimate under-predicts Mosaic's stack near the budget line)."""
-    return ("one" if engine_vmem_bytes(grid_shape, degree) <= VMEM_BUDGET
-            else "chunked")
+    """Form component of engine_plan (the driver's compile-failure
+    fallback retries the chunked form exactly when this says 'one')."""
+    return engine_plan(grid_shape, degree)[0]
 
 
 def _kron_cg_call(op, update_p: bool, interpret, *vectors,

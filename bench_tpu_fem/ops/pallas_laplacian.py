@@ -298,17 +298,35 @@ def sumfact_window_apply_corner_streamed(u, corners, mask, kappa,
     return _stage(phi0.T, _stage(phi0.T, y_acc, 2), 1)
 
 
+# The plane-streamed kernels run above the DEFAULT ~16 MB scoped-VMEM
+# limit (Mosaic's stack allocator lands ~1.4-1.7x the live-value model:
+# degree 5 measured 19.3 MB, degree 6 23.2 MB on v5e) — they compile
+# only with a raised per-compile xla_tpu_scoped_vmem_limit_kib (see
+# utils.compilation; hardware-checked at degree 5: 3.82 GDoF/s at 12.5M
+# dofs, MEASURE_r04.log E probe). The request is per-path because a
+# blanket raise costs unaffected kernels pipeline headroom.
+STREAMED_SCOPED_KIB = 32768
+# Admit streamed configs whose modelled footprint x1.7 (the worst
+# measured model->Mosaic ratio) still leaves headroom inside the raised
+# 32 MB limit: degree 5 (model 11.5 MB) and degree 6 (16.9 MB) pass,
+# degree 7 (24 MB -> ~41 MB actual) does not.
+_STREAMED_SCOPED_BUDGET_BYTES = int(30 * 1024 * 1024 / 1.7)
+
+
 def corner_streamed_lanes_ok(nd: int, nq: int, itemsize: int = 4) -> bool:
     """True when the plane-streamed corner kernel fits full 128-lane
-    folded blocks: double-buffered u/y pipeline modelled as 4*nd^3 (the
-    same model corner_lanes_ok uses for the identical streams — the two
-    predicates must not disagree about shared terms), window (nd^3), the
-    two x-reduced accumulators (2*nd*nq^2, plus one transient stack), and
+    folded blocks under the RAISED scoped-VMEM limit (STREAMED_SCOPED_KIB
+    — every streamed config needs it; the degree-5 kernel already
+    measures 19.3 MB against the 16 MB default limit). Live-value model:
+    double-buffered u/y pipeline as 4*nd^3 (the same model
+    corner_lanes_ok uses for the identical streams — the two predicates
+    must not disagree about shared terms), window (nd^3), the two
+    x-reduced accumulators (2*nd*nq^2, plus one transient stack), and
     ~16 nq^2 live plane temporaries at the Jacobian/flux peaks."""
     per_cell = (
         5 * nd**3 + 3 * nd * nq**2 + 16 * nq**2 + 50
     ) * itemsize
-    return per_cell * SUBLANES * 128 <= _VMEM_BUDGET_CORNER_BYTES
+    return per_cell * SUBLANES * 128 <= _STREAMED_SCOPED_BUDGET_BYTES
 
 
 def corner_apply(u, corners, mask, kappa, phi0: np.ndarray,

@@ -1,0 +1,46 @@
+"""Per-compile TPU compiler options.
+
+PJRT forwards `compiler_options` inside each compile request, so they
+reach the TPU compiler even where client-side env XLA_FLAGS cannot (the
+axon remote-compile terminal snapshots its own env and rejects unknown
+flags in the local CPU jaxlib's parser — verified 2026-07-31).
+
+The one option used so far: `xla_tpu_scoped_vmem_limit_kib` raises
+Mosaic's scoped-VMEM stack limit (default ~16 MB on v5e, whose physical
+VMEM is 128 MiB/core). That limit is what gates the largest fused
+kernels: the degree-5/6 plane-streamed corner-geometry folded kernels
+(19.3/23.2 MB measured) and the kron one-kernel CG engine at large
+grids (~30 MiB estimated at 100M dofs). Raising it trades pipeline-
+buffer headroom for stack space, so callers request it per-path (see
+scoped_vmem_options) rather than globally.
+"""
+
+from __future__ import annotations
+
+# Mutable hook: the drivers merge this into every TPU .compile() call,
+# and it wins over per-path options (probes use it to pin a limit).
+# Mutate IN PLACE (.update()/.clear()): rebinding the name in an
+# importing module leaves compile_lowered reading this original dict.
+TPU_COMPILER_OPTIONS: dict[str, str] = {}
+
+
+def scoped_vmem_options(kib: int | None) -> dict[str, str] | None:
+    """The per-path compiler-options dict for a raised scoped-VMEM
+    limit (None when the path fits the default limit) — the single
+    spelling of the option key."""
+    if kib is None:
+        return None
+    return {"xla_tpu_scoped_vmem_limit_kib": str(kib)}
+
+
+def compile_lowered(lowered, extra: dict[str, str] | None = None):
+    """`.compile()` with the TPU compiler options (the global hook wins
+    over `extra`). On CPU (tests, interpret mode) options are dropped:
+    the CPU backend rejects TPU flags."""
+    import jax
+
+    opts = {**extra, **TPU_COMPILER_OPTIONS} if extra else dict(
+        TPU_COMPILER_OPTIONS)
+    if opts and jax.default_backend() == "tpu":
+        return lowered.compile(compiler_options=opts)
+    return lowered.compile()

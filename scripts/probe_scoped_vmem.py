@@ -1,0 +1,93 @@
+"""Hardware verification of the per-path scoped-VMEM policy
+(utils.compilation + ops.kron_cg.engine_plan + ops.folded.pallas_plan).
+
+History (MEASURE_r04.log): the raw probes that set the policy ran with a
+global TPU_COMPILER_OPTIONS hook — A_FLAG64 8.13 (blanket raise costs
+the flagship ~12%), B_25M_ONE 6.92, C_100M_ONE 7.66, D_DEG6PERT 0.199
+(old routing: xla), E_DEG5PERT 3.82. These stages verify the shipped
+per-path plan reproduces the wins with no global hook and no flagship
+regression.
+
+Usage: python scripts/probe_scoped_vmem.py [stage...]
+"""
+import os
+import subprocess
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LOG = os.path.join(ROOT, "MEASURE_r04.log")
+ENV = {**os.environ, "PYTHONPATH": f"{ROOT}:/root/.axon_site"}
+
+
+def log(msg):
+    line = f"[{time.strftime('%H:%M:%S')}] {msg}"
+    print(line, flush=True)
+    with open(LOG, "a") as fh:
+        fh.write(line + "\n")
+
+
+def run_py(code, timeout=900):
+    try:
+        r = subprocess.run([sys.executable, "-u", "-c", code],
+                           capture_output=True, text=True,
+                           timeout=timeout, cwd=ROOT, env=ENV)
+    except subprocess.TimeoutExpired:
+        return -9, f"TIMEOUT after {timeout}s"
+    out = (r.stdout + r.stderr).strip().splitlines()
+    keep = [ln for ln in out if not ln.lower().startswith("warning")
+            and "Platform 'axon'" not in ln]
+    return r.returncode, "\n".join(keep[-8:])
+
+
+BENCH = """
+from bench_tpu_fem.bench.driver import BenchConfig, run_benchmark
+cfg = BenchConfig({cfg})
+r = run_benchmark(cfg)
+print('{tag}:', r.gdof_per_second, r.extra.get('backend'),
+      r.extra.get('geom'), r.extra.get('cg_engine'),
+      r.extra.get('cg_engine_form'),
+      str(r.extra.get('cg_engine_error'))[:120])
+"""
+
+
+def probe(tag, cfg, timeout=900):
+    rc, out = run_py(BENCH.format(tag=tag, cfg=cfg), timeout)
+    tail = [ln for ln in out.splitlines() if ln.startswith(tag)]
+    log(f"{tag} rc={rc}: " + (tail[-1] if tail else out))
+
+
+STAGES = {
+    # flagship must stay ~9.1+ (no raised limit on its path)
+    "flag": lambda: probe(
+        "P_FLAG", "ndofs_global=12_500_000, degree=3, qmode=1, "
+        "float_bits=32, nreps=1000, use_cg=True"),
+    # one-kernel via plan at the sizes the chunked form used to take
+    "q3_25m": lambda: probe(
+        "P_25M", "ndofs_global=25_000_000, degree=3, qmode=1, "
+        "float_bits=32, nreps=500, use_cg=True"),
+    "q3_100m": lambda: probe(
+        "P_100M", "ndofs_global=100_000_000, degree=3, qmode=1, "
+        "float_bits=32, nreps=100, use_cg=True", 1200),
+    "q3_128m": lambda: probe(
+        "P_128M", "ndofs_global=128_000_000, degree=3, qmode=1, "
+        "float_bits=32, nreps=100, use_cg=True", 1200),
+    # streamed-corner perturbed paths at matrix configs
+    "deg5pert": lambda: probe(
+        "P_DEG5PERT", "ndofs_global=12_500_000, degree=5, qmode=1, "
+        "float_bits=32, nreps=500, use_cg=True, geom_perturb_fact=0.2",
+        1200),
+    "deg6pert": lambda: probe(
+        "P_DEG6PERT", "ndofs_global=12_500_000, degree=6, qmode=1, "
+        "float_bits=32, nreps=300, use_cg=True, geom_perturb_fact=0.2",
+        1200),
+    "q6": lambda: probe(
+        "P_Q6", "ndofs_global=12_500_000, degree=6, qmode=1, "
+        "float_bits=32, nreps=1000, use_cg=True", 1200),
+}
+
+if __name__ == "__main__":
+    wanted = sys.argv[1:] or list(STAGES)
+    for name in wanted:
+        log(f"=== stage {name}")
+        STAGES[name]()

@@ -111,19 +111,29 @@ def test_folded_cg_matches_grid_cg():
 def test_pallas_geom_constraint_policy():
     """TPU lane policy: G streaming fits 128 lanes through degree 3
     qmode 1; cube corner mode rescues degree 4 qmode 1; the
-    plane-streamed corner form extends to degree 5 qmode 1; degree 6+
-    qmode 1 remains unsupported (XLA fallback). nq = degree + qmode + 1."""
-    from bench_tpu_fem.ops.folded import pallas_geom_constraint
-    from bench_tpu_fem.ops.pallas_laplacian import corner_lanes_ok
+    plane-streamed corner form extends to degrees 5-6 qmode 1 under a
+    raised per-compile scoped-VMEM limit (the streamed kernels measure
+    19-23 MB against Mosaic's 16 MB default — pallas_plan carries the
+    kib request); degree 7+ qmode 1 remains unsupported (XLA fallback).
+    nq = degree + qmode + 1."""
+    from bench_tpu_fem.ops.folded import pallas_geom_constraint, pallas_plan
+    from bench_tpu_fem.ops.pallas_laplacian import (
+        STREAMED_SCOPED_KIB,
+        corner_lanes_ok,
+    )
 
-    assert pallas_geom_constraint(3, 5) == (True, None)
-    assert pallas_geom_constraint(4, 6) == (True, "corner")
-    assert pallas_geom_constraint(5, 7) == (True, "corner")
-    # degree 5 takes the streamed form (the cube estimate rejects it)
+    assert pallas_plan(3, 5) == (True, None, None)
+    assert pallas_plan(4, 6) == (True, "corner", None)
+    # degrees 5-6 take the streamed form (the cube estimate rejects
+    # them) and need the raised scoped-VMEM request
     assert not corner_lanes_ok(6, 7)
-    assert pallas_geom_constraint(6, 8) == (False, None)
+    assert pallas_plan(5, 7) == (True, "corner", STREAMED_SCOPED_KIB)
+    assert pallas_plan(6, 8) == (True, "corner", STREAMED_SCOPED_KIB)
+    assert pallas_plan(7, 9) == (False, None, None)
+    assert pallas_plan(1, 2) == (True, None, None)
+    # the 2-tuple view stays in sync with the plan
+    assert pallas_geom_constraint(6, 8) == (True, "corner")
     assert pallas_geom_constraint(7, 9) == (False, None)
-    assert pallas_geom_constraint(1, 2) == (True, None)
 
 
 def test_degree4_qmode1_builds_corner_at_full_lanes():

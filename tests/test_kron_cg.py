@@ -82,8 +82,10 @@ def test_engine_cg_dirichlet_rows_pass_through():
 
 def test_vmem_gate():
     # dtype gates the engine; size only picks the internal form: the
-    # flagship 12.5M grid fits the one-kernel ring (~16 MB/core measured
-    # on v5e), the 100M grid must go through the y-chunked form
+    # flagship 12.5M grid fits the one-kernel ring at the default scoped
+    # limit; the 100M grid exceeds VMEM_BUDGET, which now means the
+    # raised-limit one-kernel tier, not the chunked form (see
+    # test_engine_plan_tiers for the full tier map)
     assert supports_kron_cg_engine((232, 232, 232), 3, jnp.float32)
     assert supports_kron_cg_engine((463, 463, 466), 3, jnp.float32)
     assert not supports_kron_cg_engine((232, 232, 232), 3, jnp.float64)
@@ -95,6 +97,28 @@ def test_vmem_gate():
     assert engine_vmem_bytes((232, 232, 232), 6) > engine_vmem_bytes(
         (232, 232, 232), 3
     )
+
+
+def test_engine_plan_tiers():
+    """Three hardware-validated tiers (MEASURE_r04.log): one-kernel at
+    the default scoped limit (flagship), one-kernel with a raised
+    per-compile limit (Q3 at 25M-128M, Q6), chunked beyond it (Q3 at
+    200M+)."""
+    from bench_tpu_fem.ops.kron_cg import (
+        ONE_KERNEL_SCOPED_KIB,
+        engine_form,
+        engine_plan,
+    )
+
+    assert engine_plan((232, 232, 232), 3) == ("one", None)  # flagship
+    # 25M at degree 3: estimate in (11, 31] MiB
+    assert engine_plan((293, 292, 292), 3) == (
+        "one", ONE_KERNEL_SCOPED_KIB)
+    # 300M: beyond the raised-limit range
+    assert engine_plan((667, 670, 670), 3) == ("chunked", None)
+    # engine_form stays the [0] view (the driver's retry gate)
+    assert engine_form((232, 232, 232), 3) == "one"
+    assert engine_form((667, 670, 670), 3) == "chunked"
 
 
 @pytest.mark.parametrize(
