@@ -41,9 +41,11 @@ driver falls back to it if this engine fails to compile).
 
 VMEM: the ring holds KI = 2P + 2 full (NY, NZ) cross-section planes; with
 x-only sharding the cross-section does not shrink with the device count,
-so `supports_dist_kron_engine` gates on the same budget as the single-chip
-form and callers fall back to the unfused dist path above it (a y-chunked
-dist form is the natural extension if that ceiling ever matters). Very
+so `dist_kron_engine_plan` follows the single-chip engine_plan tiers
+(including its raised scoped-VMEM requests, threaded through the dist
+driver's compile) and callers fall back to the unfused dist path beyond
+them (a y-chunked dist form is the natural extension if that ceiling
+ever matters). Very
 large per-shard blocks route the x/r update through the chunked pallas
 pass exactly like the single-chip solve (PALLAS_UPDATE_MIN_DOFS — the
 XLA TPU backend fails whole-vector fusions around ~130M dofs).
@@ -74,7 +76,8 @@ def dist_kron_engine_plan(
     op: DistKronLaplacian,
 ) -> tuple[bool, int | None]:
     """(supported, scoped_vmem_kib): x-only device meshes, f32, and the
-    one-kernel ring within either tier of the single-chip engine_plan —
+    one-kernel ring within any one-kernel tier of the single-chip
+    engine_plan (including the raised-limit tiers) —
     the ring's VMEM is set by the unsharded (NY, NZ) cross-section, so
     the same plan applies per shard; the kib request forwards through
     the dist driver's compile exactly like the single-chip one."""

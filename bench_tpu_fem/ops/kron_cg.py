@@ -42,9 +42,11 @@ operation order. float32 only (Mosaic has no f64); rtol = 0 benchmark
 semantics (exactly nreps iterations, cg.hpp:88-91).
 
 VMEM: the one-kernel form holds 2 rings x KI + one ring x (P+1) full
-(NY, NZ_padded) planes — fine through ~45M dofs at degree 3, and through
-the 12.5M degree-6 flagship config. Above that a two-kernel form takes
-over, chunking
+(NY, NZ_padded) planes. engine_plan escalates through hardware-checked
+scoped-VMEM tiers (default limit, then raised 64/96 MiB per-compile
+requests — see the tier constants below), carrying the one-kernel form
+through 300M dofs at degree 3; beyond ~62 MiB of estimated ring a
+two-kernel form takes over, chunking
 the y axis so every VMEM object is a (CY, NZ) chunk:
 
   Kernel ZY (`_zy_chunk_call`): grid (NX, NYB+1). Step (xi, yj) ingests
@@ -565,15 +567,21 @@ def _kron_cg_call_chunked(op, update_p: bool, interpret, *vectors):
 # The one-kernel form above the default-scoped-limit budget: PJRT
 # forwards a raised xla_tpu_scoped_vmem_limit_kib per compile (see
 # utils.compilation), and the one-kernel form measured consistently
-# faster than the chunked form on v5e once admitted — Q3@25M 6.92 vs
-# ~5.3, Q3@100M 7.66 vs 6.32 GDoF/s (MEASURE_r04.log B/C probes,
-# estimate 30.5 MiB at 100M). Above ONE_KERNEL_SCOPED_MAX the ring no
-# longer fits even the raised limit (Mosaic's stack runs ~1.3-1.4x the
-# estimate) and the chunked form takes over. The raised limit is
-# requested ONLY for this range: a blanket raise costs the flagship
-# ~12% (9.26 -> 8.13, A probe) by stealing pipeline-buffer headroom.
+# faster than the chunked form on v5e once admitted (MEASURE_r04.log):
+# tier 2 (64 MiB limit) Q3@25M 6.90 vs ~5.3, Q3@100M 7.74 vs 6.32;
+# tier 3 (96 MiB limit, estimates to ~59 MiB) Q3@200M 6.63 vs 5.68,
+# Q3@300M 6.71 vs 5.74, Q6@64M 5.36 vs 5.00 GDoF/s (interactive
+# probes; the scripted matrix re-measures read 6.53/6.48/5.32 —
+# BASELINE_MATRIX_r04.json). Above
+# ONE_KERNEL_SCOPED_MAX2 the ring no longer fits even 96 MiB of the
+# 128 MiB physical VMEM (Mosaic's stack runs ~1.3-1.4x the estimate)
+# and the chunked form takes over. The raised limit is requested ONLY
+# where needed: a blanket raise costs the flagship ~12% (9.26 -> 8.13,
+# A probe) by stealing pipeline-buffer headroom.
 ONE_KERNEL_SCOPED_MAX = 31 * 2**20
 ONE_KERNEL_SCOPED_KIB = 65536
+ONE_KERNEL_SCOPED_MAX2 = 62 * 2**20
+ONE_KERNEL_SCOPED_KIB2 = 98304
 
 
 def engine_plan(
@@ -581,15 +589,17 @@ def engine_plan(
 ) -> tuple[str, int | None]:
     """(form, scoped_vmem_kib) the auto dispatch picks for a single-chip
     grid: 'one' (delay-ring one-kernel) under the default-scoped-limit
-    budget; 'one' with a raised per-compile scoped-VMEM request up to
-    ONE_KERNEL_SCOPED_MAX; else 'chunked'. The driver passes the kib to
-    compile_lowered; _kron_cg_call derives the form from the same plan,
-    so the two cannot disagree."""
+    budget; 'one' with a raised per-compile scoped-VMEM request through
+    the two hardware-checked tiers; else 'chunked'. The driver passes
+    the kib to compile_lowered; _kron_cg_call derives the form from the
+    same plan, so the two cannot disagree."""
     v = engine_vmem_bytes(grid_shape, degree)
     if v <= VMEM_BUDGET:
         return "one", None
     if v <= ONE_KERNEL_SCOPED_MAX:
         return "one", ONE_KERNEL_SCOPED_KIB
+    if v <= ONE_KERNEL_SCOPED_MAX2:
+        return "one", ONE_KERNEL_SCOPED_KIB2
     return "chunked", None
 
 

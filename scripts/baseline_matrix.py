@@ -104,15 +104,15 @@ def main() -> int:
     try_cfg(results, "q4_cg_perturbed_12.5M", ndofs_global=12_500_000,
             degree=4, qmode=1, float_bits=32, nreps=500, use_cg=True,
             geom_perturb_fact=0.2)
-    # degree 5 joins the Pallas path via the plane-streamed corner form;
-    # degree 6 perturbed is the XLA-backend capability number (honest
-    # coverage of the general-geometry path at the reference's second
-    # headline degree — expected well below the uniform Q6)
+    # degrees 5-6 join the Pallas path via the plane-streamed corner
+    # form under the raised per-compile scoped-VMEM limit
+    # (ops.folded.pallas_plan) — coverage of the general-geometry path
+    # at the reference's second headline degree
     try_cfg(results, "q5_cg_perturbed_12.5M", ndofs_global=12_500_000,
             degree=5, qmode=1, float_bits=32, nreps=500, use_cg=True,
             geom_perturb_fact=0.2)
-    try_cfg(results, "q6_cg_perturbed_2M", ndofs_global=2_000_000,
-            degree=6, qmode=1, float_bits=32, nreps=100, use_cg=True,
+    try_cfg(results, "q6_cg_perturbed_12.5M", ndofs_global=12_500_000,
+            degree=6, qmode=1, float_bits=32, nreps=300, use_cg=True,
             geom_perturb_fact=0.2)
     # f64-class strategies side by side (TPUs have no f64 units):
     # XLA software emulation vs double-float f32 pairs (ops.kron_df)

@@ -72,6 +72,12 @@ STAGES = {
     "q3_128m": lambda: probe(
         "P_128M", "ndofs_global=128_000_000, degree=3, qmode=1, "
         "float_bits=32, nreps=100, use_cg=True", 1200),
+    # tier 3 (96 MiB request): a regression here (e.g. a Mosaic stack-
+    # allocator change) silently degrades 200-300M and Q6@64M to the
+    # chunked retry — this stage makes that visible
+    "q3_300m": lambda: probe(
+        "P_300M", "ndofs_global=300_000_000, degree=3, qmode=1, "
+        "float_bits=32, nreps=50, use_cg=True", 1200),
     # streamed-corner perturbed paths at matrix configs
     "deg5pert": lambda: probe(
         "P_DEG5PERT", "ndofs_global=12_500_000, degree=5, qmode=1, "

@@ -100,12 +100,13 @@ def test_vmem_gate():
 
 
 def test_engine_plan_tiers():
-    """Three hardware-validated tiers (MEASURE_r04.log): one-kernel at
-    the default scoped limit (flagship), one-kernel with a raised
-    per-compile limit (Q3 at 25M-128M, Q6), chunked beyond it (Q3 at
-    200M+)."""
+    """Four hardware-validated tiers (MEASURE_r04.log): one-kernel at
+    the default scoped limit (flagship), one-kernel at the 64 MiB limit
+    (Q3 at 25M-128M), one-kernel at the 96 MiB limit (Q3 at 200-300M,
+    Q6 at 64M), chunked beyond ~62 MiB estimates."""
     from bench_tpu_fem.ops.kron_cg import (
         ONE_KERNEL_SCOPED_KIB,
+        ONE_KERNEL_SCOPED_KIB2,
         engine_form,
         engine_plan,
     )
@@ -114,11 +115,14 @@ def test_engine_plan_tiers():
     # 25M at degree 3: estimate in (11, 31] MiB
     assert engine_plan((293, 292, 292), 3) == (
         "one", ONE_KERNEL_SCOPED_KIB)
-    # 300M: beyond the raised-limit range
-    assert engine_plan((667, 670, 670), 3) == ("chunked", None)
+    # 300M at degree 3: estimate in (31, 62] MiB
+    assert engine_plan((667, 670, 670), 3) == (
+        "one", ONE_KERNEL_SCOPED_KIB2)
+    # beyond every raised tier: chunked
+    assert engine_plan((740, 740, 740), 3) == ("chunked", None)
     # engine_form stays the [0] view (the driver's retry gate)
     assert engine_form((232, 232, 232), 3) == "one"
-    assert engine_form((667, 670, 670), 3) == "chunked"
+    assert engine_form((740, 740, 740), 3) == "chunked"
 
 
 @pytest.mark.parametrize(
