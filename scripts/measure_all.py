@@ -242,17 +242,28 @@ def stage_q6one():
               "KC.VMEM_BUDGET = 14 * 2**20  # probe the one-kernel form")
 
 
+def stage_p300():
+    # tier-3 (96 MiB scoped limit) regression probe: delegated to the
+    # per-path-policy probe script so the two agendas cannot diverge
+    rc, out = run_script(["scripts/probe_scoped_vmem.py", "q3_300m"], 1800)
+    log(f"p300 rc={rc}: {out.splitlines()[-1] if out else ''}")
+
+
 STAGES = {
     "health": stage_health, "ab12": stage_ab12, "q6": stage_q6,
     "large": stage_large, "deg4": stage_deg4, "df32": stage_df32,
     "matrix": stage_matrix, "bench": stage_bench,
     "deg5": stage_deg5, "dist1": stage_dist1, "q6one": stage_q6one,
     "dfdist1": stage_dfdist1, "deg6stream": stage_deg6stream,
+    "p300": stage_p300,
 }
 
 if __name__ == "__main__":
-    wanted = sys.argv[1:] or ["health", "deg5", "dist1", "dfdist1",
-                              "q6one", "deg6stream", "bench"]
+    # Default agenda (2026-07-31, after the scoped-VMEM tier work): the
+    # 2026-07-30 agenda was fully collected; what remains is the tier-3
+    # probe interrupted by the fourth tunnel wedge plus a fresh official
+    # line.
+    wanted = sys.argv[1:] or ["health", "p300", "bench"]
     unknown = [s for s in wanted if s not in STAGES]
     if unknown:
         print(f"unknown stage(s) {unknown}; valid: {list(STAGES)}",

@@ -11,34 +11,13 @@ regression.
 Usage: python scripts/probe_scoped_vmem.py [stage...]
 """
 import os
-import subprocess
 import sys
-import time
 
-ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-LOG = os.path.join(ROOT, "MEASURE_r04.log")
-ENV = {**os.environ, "PYTHONPATH": f"{ROOT}:/root/.axon_site"}
-
-
-def log(msg):
-    line = f"[{time.strftime('%H:%M:%S')}] {msg}"
-    print(line, flush=True)
-    with open(LOG, "a") as fh:
-        fh.write(line + "\n")
-
-
-def run_py(code, timeout=900):
-    try:
-        r = subprocess.run([sys.executable, "-u", "-c", code],
-                           capture_output=True, text=True,
-                           timeout=timeout, cwd=ROOT, env=ENV)
-    except subprocess.TimeoutExpired:
-        return -9, f"TIMEOUT after {timeout}s"
-    out = (r.stdout + r.stderr).strip().splitlines()
-    keep = [ln for ln in out if not ln.lower().startswith("warning")
-            and "Platform 'axon'" not in ln]
-    return r.returncode, "\n".join(keep[-8:])
-
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+# shared logging/runner: one copy of the output filter + the
+# MEASURE_r04.log line format (measure_all delegates its p300 stage
+# back here, so the two agendas share one log convention)
+from measure_all import log, run_py  # noqa: E402
 
 BENCH = """
 from bench_tpu_fem.bench.driver import BenchConfig, run_benchmark
@@ -94,6 +73,11 @@ STAGES = {
 
 if __name__ == "__main__":
     wanted = sys.argv[1:] or list(STAGES)
+    unknown = [s for s in wanted if s not in STAGES]
+    if unknown:
+        print(f"unknown stage(s) {unknown}; valid: {list(STAGES)}",
+              file=sys.stderr)
+        sys.exit(2)
     for name in wanted:
         log(f"=== stage {name}")
         STAGES[name]()

@@ -50,7 +50,11 @@ def _sharded_vec(x, n, degree, dshape, dgrid, layout):
 @pytest.mark.parametrize(
     "dshape,degree,geom",
     [((2, 2, 2), 3, "corner"), ((2, 2, 1), 2, "corner"), ((2, 2, 2), 3, "g"),
-     ((4, 1, 1), 2, "corner"), ((1, 2, 2), 3, "corner")],
+     ((4, 1, 1), 2, "corner"), ((1, 2, 2), 3, "corner"),
+     # degrees 5-6 qmode 1: the plane-streamed corner contraction
+     # (corner_apply picks it statically — the composition the raised
+     # scoped-VMEM routing runs on TPU for dist perturbed meshes)
+     ((2, 1, 1), 5, "corner"), ((1, 2, 1), 6, "corner")],
 )
 def test_dist_folded_apply_matches_global(dshape, degree, geom):
     qmode = 1
