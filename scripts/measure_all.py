@@ -242,11 +242,27 @@ def stage_q6one():
               "KC.VMEM_BUDGET = 14 * 2**20  # probe the one-kernel form")
 
 
+def _probe_stage(name, timeout):
+    # delegate to the per-path-policy probe script so the two agendas
+    # cannot diverge (it logs its own result lines to the shared log)
+    rc, out = run_script(["scripts/probe_scoped_vmem.py", name], timeout)
+    log(f"{name} rc={rc}: {out.splitlines()[-1] if out else ''}")
+
+
 def stage_p300():
-    # tier-3 (96 MiB scoped limit) regression probe: delegated to the
-    # per-path-policy probe script so the two agendas cannot diverge
-    rc, out = run_script(["scripts/probe_scoped_vmem.py", "q3_300m"], 1800)
-    log(f"p300 rc={rc}: {out.splitlines()[-1] if out else ''}")
+    # tier-3 (96 MiB scoped limit) regression probe
+    _probe_stage("q3_300m", 1800)
+
+
+def stage_pert100():
+    # perturbed capacity at 100M (corner mode; matrix covers 12.5M only)
+    _probe_stage("pert100", 2100)
+
+
+def stage_deg7probe():
+    # raw deg-7 streamed-corner compile probe at 48 MiB (plan-widening
+    # evidence; see probe_scoped_vmem._deg7_probe)
+    _probe_stage("deg7probe", 1800)
 
 
 STAGES = {
@@ -255,7 +271,8 @@ STAGES = {
     "matrix": stage_matrix, "bench": stage_bench,
     "deg5": stage_deg5, "dist1": stage_dist1, "q6one": stage_q6one,
     "dfdist1": stage_dfdist1, "deg6stream": stage_deg6stream,
-    "p300": stage_p300,
+    "p300": stage_p300, "pert100": stage_pert100,
+    "deg7probe": stage_deg7probe,
 }
 
 if __name__ == "__main__":
@@ -263,7 +280,8 @@ if __name__ == "__main__":
     # 2026-07-30 agenda was fully collected; what remains is the tier-3
     # probe interrupted by the fourth tunnel wedge plus a fresh official
     # line.
-    wanted = sys.argv[1:] or ["health", "p300", "bench"]
+    wanted = sys.argv[1:] or ["health", "p300", "pert100",
+                              "deg7probe", "bench"]
     unknown = [s for s in wanted if s not in STAGES]
     if unknown:
         print(f"unknown stage(s) {unknown}; valid: {list(STAGES)}",

@@ -69,7 +69,51 @@ STAGES = {
     "q6": lambda: probe(
         "P_Q6", "ndofs_global=12_500_000, degree=6, qmode=1, "
         "float_bits=32, nreps=1000, use_cg=True", 1200),
+    # perturbed capacity: corner mode at the reference-scale sizes (the
+    # matrix measures perturbed only at 12.5M; auto-geom switches to
+    # corner above ~6 GB of G). The folded engine auto-falls-back with
+    # a recorded reason if its ring misses VMEM at this cross-section.
+    "pert100": lambda: probe(
+        "P_PERT100", "ndofs_global=100_000_000, degree=3, qmode=1, "
+        "float_bits=32, nreps=100, use_cg=True, geom_perturb_fact=0.2",
+        1800),
 }
+
+
+def _deg7_probe():
+    """Raw compile probe: degree-7 qmode-1 plane-streamed corner kernel
+    under a 48 MiB scoped limit (model ~24 MB x ~1.4 Mosaic ratio ~34 MB
+    — plausibly fits, but pallas_plan keeps degree 7 on the XLA fallback
+    until this compiles on hardware; a pass here is the evidence needed
+    to widen the plan next round)."""
+    code = """
+import jax, jax.numpy as jnp, numpy as np
+import bench_tpu_fem.ops.pallas_laplacian as PL
+PL._STREAMED_SCOPED_BUDGET_BYTES = 64 * 2**20  # admit degree 7 for the probe
+from bench_tpu_fem.mesh.box import create_box_mesh
+from bench_tpu_fem.mesh.sizing import compute_mesh_size
+from bench_tpu_fem.ops.folded import build_folded_laplacian, fold_vector
+from bench_tpu_fem.utils.compilation import compile_lowered
+n = compute_mesh_size(2_000_000, 7)
+mesh = create_box_mesh(n, geom_perturb_fact=0.2)
+op = build_folded_laplacian(mesh, 7, 1, dtype=jnp.float32, geom='corner')
+g = np.random.RandomState(0).rand(*[d*7+1 for d in n]).astype(np.float32)
+b = jnp.asarray(fold_vector(g, op.layout))
+# the raised limit must ride the compile request: plain jax.jit never
+# consults TPU_COMPILER_OPTIONS (only compile_lowered merges it)
+fn = compile_lowered(jax.jit(op.apply_cg).lower(b),
+                     {'xla_tpu_scoped_vmem_limit_kib': '49152'})
+y = fn(b)
+jax.block_until_ready(y)
+print('DEG7PROBE:', float(jnp.linalg.norm(y)))
+"""
+    rc, out = run_py(code, 1500)
+    tail = [ln for ln in out.splitlines() if ln.startswith("DEG7PROBE")]
+    # on failure keep the full tail: the Mosaic diagnostic IS the result
+    log(f"P_DEG7 rc={rc}: " + (tail[-1] if tail else out))
+
+
+STAGES["deg7probe"] = _deg7_probe
 
 if __name__ == "__main__":
     wanted = sys.argv[1:] or list(STAGES)
