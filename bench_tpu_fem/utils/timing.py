@@ -3,17 +3,20 @@
 Replaces `dolfinx::common::Timer` + `list_timings` (MPI_MAX aggregated table,
 /root/reference/src/main.cpp:314, laplacian_solver.cpp:90,174-198). Timers
 accumulate by name in a process-local registry; `timer_report` renders the
-table. Scope note: JAX here is single-controller — one Python process
-drives every device — so one registry IS the whole-job view and no
-cross-host reduction exists (the reference needs MPI_MAX only because each
-rank times independently). A future multi-controller deployment would
-max-reduce `timings()` across processes before printing.
+table, max-reducing across controller processes first when running
+multi-controller (utils.multihost) — the reference needs MPI_MAX because
+each rank times independently, and a multi-controller JAX job is in the
+same position. Single-controller (the common case: one Python process
+drives every device) the local registry IS the whole-job view and no
+communication happens.
 """
 
 from __future__ import annotations
 
 import time
 from collections import defaultdict
+
+import numpy as np
 
 _registry: dict[str, list[float]] = defaultdict(list)
 
@@ -46,9 +49,57 @@ def timings() -> dict[str, dict[str, float]]:
     }
 
 
+def _reduce_gathered(names: list[str],
+                     gathered: np.ndarray) -> dict[str, dict[str, float]]:
+    """MPI_MAX-equivalent fold of per-process timer rows: `gathered` is
+    (nproc, len(names), 3) of [count, total, max] rows in `names` order.
+    Split out from aggregated_timings so the reduction is unit-testable
+    without a multi-process run."""
+    return {
+        name: {
+            "count": int(gathered[:, i, 0].max()),
+            "total": float(gathered[:, i, 1].max()),
+            "max": float(gathered[:, i, 2].max()),
+        }
+        for i, name in enumerate(names)
+    }
+
+
+def aggregated_timings() -> dict[str, dict[str, float]]:
+    """`timings()`, max-reduced across controller processes when the job
+    is multi-controller (`jax.process_count() > 1`) — the reference's
+    `list_timings` MPI_MAX table (main.cpp:314). Requires every process
+    to have timed the same phases (the SPMD drivers do; the reference's
+    list_timings carries the same symmetry assumption). Single-process
+    returns the local registry untouched, without any device traffic."""
+    local = timings()
+    if not local:
+        # empty registry: nothing to reduce (and a 0-row gather would
+        # fail to reshape) — every process sees the same empty table,
+        # and the path stays jax-free (no backend init for no table)
+        return local
+    import jax
+
+    if jax.process_count() == 1:
+        return local
+    from jax.experimental import multihost_utils
+
+    names = sorted(local)
+    rows = np.array(
+        [[local[n]["count"], local[n]["total"], local[n]["max"]]
+         for n in names],
+        dtype=np.float64,
+    )
+    # keep the f64 rows through the gather: without x64 the collective
+    # silently demotes to f32 (the drivers deliberately leave x64 off)
+    with jax.experimental.enable_x64():
+        gathered = np.asarray(multihost_utils.process_allgather(rows))
+    return _reduce_gathered(names, gathered.reshape(-1, len(names), 3))
+
+
 def timer_report() -> str:
     rows = [f"{'Timer':<40s} {'count':>6s} {'total (s)':>12s} {'max (s)':>12s}"]
-    for name, t in sorted(timings().items()):
+    for name, t in sorted(aggregated_timings().items()):
         rows.append(f"{name:<40s} {t['count']:>6d} {t['total']:>12.4f} {t['max']:>12.4f}")
     return "\n".join(rows)
 

@@ -1,20 +1,18 @@
 """Run the outstanding TPU measurement agenda for round 4, logging each
 step as it lands (a mid-run tunnel wedge preserves completed steps).
 
-Most of the original agenda was collected on 2026-07-30 between the
-second and third tunnel wedges (BASELINE_MATRIX_r04.json,
-BENCH_r04_measured.json): engine A/B 9.05/6.35, Q6 4.97, 100-300M runs,
-deg4 3.14, df32 0.50. Remaining stages target what landed after:
+The 2026-07-30 agenda was fully collected (BASELINE_MATRIX_r04.json,
+BENCH_r04_measured.json); those stages remain callable by name. The
+default agenda now targets what the fourth tunnel wedge (2026-07-31
+~06:15 UTC) interrupted:
 
-  health  - tunnel probe (aborts the rest when down)
-  deg5    - degree-5 qmode-1 perturbed on the NEW plane-streamed corner
-            Pallas path (Mosaic compile + perf; was XLA-fallback)
-  dist1   - distributed fused CG engine on a 1-device mesh (Mosaic
-            compile check of the halo-form kernel; ndevices=1 is x-only)
-  q6one   - degree-6 one-kernel engine form compile probe: VMEM estimate
-            12.4 MB vs 11 MiB budget - if Mosaic accepts it, the budget
-            can be raised and Q6 gains the ~4 fewer streams/iter form
-  bench   - the official bench.py line
+  health    - tunnel probe (aborts the rest when down)
+  p300      - tier-3 (96 MiB scoped limit) one-kernel regression probe
+              at Q3-300M (probe_scoped_vmem q3_300m)
+  pert100   - perturbed capacity at 100M dofs, corner mode
+  deg7probe - degree-7 streamed-corner compile probe at 48 MiB (plan-
+              widening evidence)
+  bench     - the official bench.py line
 
 Usage: python scripts/measure_all.py [stage...]
 """

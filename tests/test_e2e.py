@@ -97,3 +97,33 @@ def test_run_benchmark_sets_and_restores_x64():
         assert not jax.config.jax_enable_x64  # restored, not left on
     finally:
         jax.config.update("jax_enable_x64", True)
+
+
+def test_timer_aggregation_max_reduce():
+    """Cross-process timer aggregation (the reference's list_timings
+    MPI_MAX table, main.cpp:314): the reduction folds per-process rows
+    by max, and the single-process path returns the local registry."""
+    import numpy as np
+
+    from bench_tpu_fem.utils.timing import (
+        Timer,
+        _reduce_gathered,
+        aggregated_timings,
+        reset_timers,
+        timings,
+    )
+
+    gathered = np.array([
+        [[2, 1.0, 0.8], [1, 0.2, 0.2]],   # process 0
+        [[2, 3.0, 2.5], [1, 0.1, 0.1]],   # process 1 (slowest on phase a)
+    ])
+    out = _reduce_gathered(["a", "b"], gathered)
+    assert out["a"] == {"count": 2, "total": 3.0, "max": 2.5}
+    assert out["b"] == {"count": 1, "total": 0.2, "max": 0.2}
+
+    reset_timers()
+    with Timer("% phase"):
+        pass
+    # single-process: identity with the local registry, no device traffic
+    assert aggregated_timings() == timings()
+    reset_timers()
