@@ -1,0 +1,74 @@
+"""utils.compilation: per-compile TPU option plumbing.
+
+The policy behind the values lives with the plans (ops.kron_cg.
+engine_plan, ops.folded.pallas_plan — tested there); this file pins the
+mechanism: option-dict construction, the global-hook-wins merge, and
+the CPU drop (the CPU backend rejects TPU flags)."""
+
+import jax
+import jax.numpy as jnp
+
+from bench_tpu_fem.utils.compilation import (
+    TPU_COMPILER_OPTIONS,
+    compile_lowered,
+    scoped_vmem_options,
+)
+
+
+class _FakeLowered:
+    """Captures what compile_lowered actually passes to .compile()."""
+
+    def __init__(self):
+        self.calls = []
+
+    def compile(self, compiler_options=None):
+        self.calls.append(compiler_options)
+        return "compiled"
+
+
+def test_scoped_vmem_options_spelling():
+    assert scoped_vmem_options(None) is None
+    assert scoped_vmem_options(32768) == {
+        "xla_tpu_scoped_vmem_limit_kib": "32768"
+    }
+
+
+def test_compile_lowered_drops_options_on_cpu():
+    """On the CPU backend (tests, interpret mode) options must never
+    reach .compile() — the backend rejects TPU flags."""
+    fake = _FakeLowered()
+    assert jax.default_backend() != "tpu"
+    compile_lowered(fake, {"xla_tpu_scoped_vmem_limit_kib": "65536"})
+    assert fake.calls == [None]
+
+
+def test_compile_lowered_merge_global_wins(monkeypatch):
+    """The global hook (probes pin a limit through it) must override a
+    per-path extra for the same key, and merge beside different keys."""
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    monkeypatch.setitem(TPU_COMPILER_OPTIONS,
+                        "xla_tpu_scoped_vmem_limit_kib", "98304")
+    fake = _FakeLowered()
+    compile_lowered(fake, {"xla_tpu_scoped_vmem_limit_kib": "32768",
+                           "other_flag": "1"})
+    assert fake.calls == [{
+        "xla_tpu_scoped_vmem_limit_kib": "98304",  # global wins
+        "other_flag": "1",
+    }]
+
+
+def test_compile_lowered_no_options_plain_compile(monkeypatch):
+    """No extra and an empty hook: plain .compile() even on TPU."""
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+    fake = _FakeLowered()
+    compile_lowered(fake)
+    assert fake.calls == [None]
+
+
+def test_compile_lowered_real_jit_on_cpu():
+    """End-to-end with a real lowered computation on the CPU backend."""
+    fn = compile_lowered(
+        jax.jit(lambda x: x * 2).lower(jnp.ones((4,))),
+        {"xla_tpu_scoped_vmem_limit_kib": "32768"},
+    )
+    assert float(fn(jnp.ones((4,))).sum()) == 8.0
