@@ -7,12 +7,24 @@ the CPU drop (the CPU backend rejects TPU flags)."""
 
 import jax
 import jax.numpy as jnp
+import pytest
 
 from bench_tpu_fem.utils.compilation import (
     TPU_COMPILER_OPTIONS,
     compile_lowered,
     scoped_vmem_options,
 )
+
+
+@pytest.fixture(autouse=True)
+def _empty_hook(monkeypatch):
+    """The hook is a process-global that probes .update() in place —
+    pin it empty so these exact-dict assertions stay order-independent."""
+    saved = dict(TPU_COMPILER_OPTIONS)
+    TPU_COMPILER_OPTIONS.clear()
+    yield
+    TPU_COMPILER_OPTIONS.clear()
+    TPU_COMPILER_OPTIONS.update(saved)
 
 
 class _FakeLowered:
