@@ -237,7 +237,9 @@ def test_sharded_apply_fn_engine_matches_unfused():
 
 
 def test_dist_engine_cg_chunked_update_matches_default(monkeypatch):
-    """The >=130M-dofs/shard chunked pallas x/r update carries a seam
+    """The large-shard chunked pallas x/r update (gate:
+    PALLAS_UPDATE_MIN_DOFS = 100M dofs/shard, guarding XLA's ~130M
+    whole-vector-fusion failure) carries a seam
     correction the default fused-XLA update doesn't need (the duplicated
     seam plane's <r1,r1> contribution is subtracted before the psum) —
     force it on via the size gate and require the same CG solution."""
