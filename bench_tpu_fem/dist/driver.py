@@ -11,7 +11,11 @@ from functools import partial
 import numpy as np
 
 from ..la.cg import cg_solve
-from ..utils.compilation import compile_lowered, scoped_vmem_options
+from ..utils.compilation import (
+    CPU_DF_DIST_OPTIONS,
+    compile_lowered,
+    scoped_vmem_options,
+)
 from ..utils.timing import Timer
 from .halo import masked_dot, masked_linf, owned_mask
 from .mesh import AXIS_NAMES, compute_mesh_size_sharded, make_device_grid
@@ -402,7 +406,8 @@ def run_distributed_df64(cfg, res):
             op, dgrid, cfg.nreps
         )
         if cfg.use_cg:
-            fn = compile_lowered(jax.jit(cg_fn).lower(u, op))
+            fn = compile_lowered(jax.jit(cg_fn).lower(u, op),
+                                 cpu_extra=CPU_DF_DIST_OPTIONS)
         else:
             def _rep(i, y, x, A):
                 xx, _ = jax.lax.optimization_barrier((x, y))
@@ -415,7 +420,7 @@ def run_distributed_df64(cfg, res):
                     0, cfg.nreps, partial(_rep, x=x, A=A),
                     df_zeros_like(x),
                 )
-            ).lower(u, op))
+            ).lower(u, op), cpu_extra=CPU_DF_DIST_OPTIONS)
         warm = fn(u, op)
         float(warm.hi[(0,) * warm.hi.ndim])
         del warm
@@ -433,7 +438,8 @@ def run_distributed_df64(cfg, res):
         float(y.hi[(0,) * y.hi.ndim])  # tunnel fence (see bench.driver)
         res.mat_free_time = time.perf_counter() - t0
 
-    norm_c = compile_lowered(jax.jit(norm_fn).lower(u, op))
+    norm_c = compile_lowered(jax.jit(norm_fn).lower(u, op),
+                             cpu_extra=CPU_DF_DIST_OPTIONS)
     res.unorm, res.unorm_linf = norms_from(norm_c(u, op))
     res.ynorm, res.ynorm_linf = norms_from(norm_c(y, op))
     res.gdof_per_second = (

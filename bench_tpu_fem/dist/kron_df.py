@@ -130,31 +130,37 @@ def _edge_rows_df(x: DF, halo_l: DF, halo_r: DF, dloc: DF, axis: int,
     planes per side as full banded rows over the halo-extended window,
     summing strictly in ascending diagonal order (in df arithmetic) so
     both owners of a duplicated seam plane replay the identical term
-    sequence — hi AND lo stay bit-identical."""
+    sequence — hi AND lo stay bit-identical.
+
+    Plane selection is PYTHON-STATIC (j, di are unrolled ints): each term
+    indexes the halo or the interior directly instead of slicing a
+    concatenated [halo | interior] window. Value-identical to the
+    windowed form (only input selection changes, never the arithmetic
+    sequence), but the concat-of-slices graph the windowed form built
+    sent XLA:CPU's fusion emitter into an LLVM-opt blowup — >28 min,
+    effectively unbounded, whenever no earlier collective had split the
+    fusion region (meshes sharded in x only: the dryrun(4) hang,
+    MEASURE_r04.log 2026-07-31; --xla_cpu_use_fusion_emitters=false
+    confirmed the diagnosis at 17.8 s)."""
     L = dloc.hi.shape[1]
 
-    def ext(a_l, a_x, a_r, lo_slice, hi_slice):
-        el = jnp.concatenate(
-            [a_l, lax.slice_in_dim(a_x, *lo_slice, axis=axis)], axis=axis
-        )
-        er = jnp.concatenate(
-            [lax.slice_in_dim(a_x, *hi_slice, axis=axis), a_r], axis=axis
-        )
-        return el, er
+    def ext_plane(side, idx):
+        """Plane `idx` of the virtual [halo | interior-slice] window."""
+        if side == "l":  # [halo_l (P) | x[0:2P]]
+            src, k = (halo_l, idx) if idx < P else (x, idx - P)
+        else:  # [x[L-2P:L] (2P) | halo_r]
+            src, k = (x, L - 2 * P + idx) if idx < 2 * P else (
+                halo_r, idx - 2 * P)
+        return DF(_plane(src.hi, k, axis), _plane(src.lo, k, axis))
 
-    ehl, ehr = ext(halo_l.hi, x.hi, halo_r.hi, (0, 2 * P), (L - 2 * P, L))
-    ell, elr = ext(halo_l.lo, x.lo, halo_r.lo, (0, 2 * P), (L - 2 * P, L))
-    ext_l, ext_r = DF(ehl, ell), DF(ehr, elr)
-
-    def rows(ext_df, row_of, off_of):
+    def rows(side, row_of):
         out = []
         for j in range(P):
             i = row_of(j)
             acc = None
             for di in range(2 * P + 1):
                 c = DF(dloc.hi[di, i], dloc.lo[di, i])
-                pl_ = DF(_plane(ext_df.hi, off_of(j) + di, axis),
-                         _plane(ext_df.lo, off_of(j) + di, axis))
+                pl_ = ext_plane(side, j + di)
                 term = _renorm(*_prod_terms(c, pl_))
                 acc = term if acc is None else df_add(acc, term)
             out.append(acc)
@@ -163,8 +169,8 @@ def _edge_rows_df(x: DF, halo_l: DF, halo_r: DF, dloc: DF, axis: int,
             jnp.concatenate([o.lo for o in out], axis=axis),
         )
 
-    left = rows(ext_l, lambda j: j, lambda j: j)
-    right = rows(ext_r, lambda j: L - P + j, lambda j: j)
+    left = rows("l", lambda j: j)
+    right = rows("r", lambda j: L - P + j)
     return left, right
 
 

@@ -33,14 +33,28 @@ def scoped_vmem_options(kib: int | None) -> dict[str, str] | None:
     return {"xla_tpu_scoped_vmem_limit_kib": str(kib)}
 
 
-def compile_lowered(lowered, extra: dict[str, str] | None = None):
-    """`.compile()` with the TPU compiler options (the global hook wins
-    over `extra`). On CPU (tests, interpret mode) options are dropped:
-    the CPU backend rejects TPU flags."""
+# XLA:CPU's fusion emitters blow up LLVM compile time (>28 min,
+# effectively unbounded) on the df64 distributed apply when the mesh is
+# sharded in x only — see utils.hermetic (which sets the equivalent env
+# flag for every entry that pins the CPU platform: tests, dryrun, and
+# CLI runs with platform=cpu) for the root cause. This per-compile form
+# covers the one driver path hermetic never sees: platform='auto' with
+# no JAX_PLATFORMS set, on a host whose default backend resolves to CPU.
+CPU_DF_DIST_OPTIONS: dict[str, bool] = {"xla_cpu_use_fusion_emitters": False}
+
+
+def compile_lowered(lowered, extra: dict[str, str] | None = None,
+                    cpu_extra: dict | None = None):
+    """`.compile()` with per-platform compiler options: on TPU, `extra`
+    merged under the global hook (the hook wins); on CPU, `cpu_extra`
+    (TPU flags are dropped there — the CPU backend rejects them)."""
     import jax
 
-    opts = {**extra, **TPU_COMPILER_OPTIONS} if extra else dict(
-        TPU_COMPILER_OPTIONS)
-    if opts and jax.default_backend() == "tpu":
-        return lowered.compile(compiler_options=opts)
+    if jax.default_backend() == "tpu":
+        opts = {**extra, **TPU_COMPILER_OPTIONS} if extra else dict(
+            TPU_COMPILER_OPTIONS)
+        if opts:
+            return lowered.compile(compiler_options=opts)
+    elif cpu_extra:
+        return lowered.compile(compiler_options=dict(cpu_extra))
     return lowered.compile()

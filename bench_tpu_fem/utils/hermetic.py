@@ -41,6 +41,17 @@ def force_host_cpu_devices(n_devices: int) -> None:
             + f"--xla_force_host_platform_device_count={n_devices}"
             + flags[m.end():]
         )
+    # XLA:CPU's fusion emitters send LLVM into an effectively unbounded
+    # (>28 min) opt blowup on the df64 distributed apply whenever the
+    # mesh is sharded in x only — the unrolled edge-row df chains fuse
+    # into one giant concatenate/slice kernel with no collective to
+    # split the region (root-caused 2026-07-31, MEASURE_r04.log; the
+    # same graph compiles in ~18 s with the emitters off, and in ~37 s
+    # untouched when y/z halos break the fusion). Disabling them here
+    # only changes the CPU compile strategy, never numerics; TPU
+    # compiles are unaffected (this entry point pins the CPU platform).
+    if "--xla_cpu_use_fusion_emitters" not in flags:
+        flags = (flags + " --xla_cpu_use_fusion_emitters=false").strip()
     os.environ["XLA_FLAGS"] = flags
 
     import jax

@@ -77,6 +77,16 @@ def test_compile_lowered_no_options_plain_compile(monkeypatch):
     assert fake.calls == [None]
 
 
+def test_compile_lowered_cpu_extra_reaches_cpu_compile():
+    """cpu_extra is the CPU-side channel (the df-dist fusion-emitter
+    workaround rides it); TPU extras must still be dropped beside it."""
+    fake = _FakeLowered()
+    assert jax.default_backend() != "tpu"
+    compile_lowered(fake, {"xla_tpu_scoped_vmem_limit_kib": "65536"},
+                    cpu_extra={"xla_cpu_use_fusion_emitters": False})
+    assert fake.calls == [{"xla_cpu_use_fusion_emitters": False}]
+
+
 def test_compile_lowered_real_jit_on_cpu():
     """End-to-end with a real lowered computation on the CPU backend."""
     fn = compile_lowered(

@@ -50,7 +50,18 @@ def _unshard_df(df_blocks, n, degree, dshape):
     return hi.astype(np.float64) + lo.astype(np.float64)
 
 
-@pytest.mark.parametrize("dshape,degree", [((2, 2, 2), 3), ((4, 1, 2), 2)])
+@pytest.mark.parametrize(
+    "dshape,degree",
+    [((2, 2, 2), 3), ((4, 1, 2), 2),
+     # x-only: numeric coverage of the composition that exposed the
+     # XLA:CPU fusion-emitter compile blowup (no y/z collective splits
+     # the fusion region; it hung dryrun_multichip(4)). NOTE: conftest's
+     # hermetic flag disables the emitters process-wide, so this case
+     # cannot itself detect a reintroduced blowup — the guard is the
+     # flag in utils.hermetic plus the static plane selection in
+     # _edge_rows_df.
+     ((2, 1, 1), 3)],
+)
 def test_dist_df_apply_matches_single_chip(dshape, degree):
     dgrid = make_device_grid(dshape=dshape)
     n = tuple(2 * d for d in dshape)
