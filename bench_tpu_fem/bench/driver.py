@@ -476,7 +476,7 @@ def _run_benchmark(cfg: BenchConfig) -> BenchmarkResults:
         def _record_engine_failure(exc):
             res.extra["cg_engine"] = False
             res.extra["cg_engine_error"] = (
-                f"{type(exc).__name__}: {exc}"[:300]
+                exc_str(exc)
             )
 
         apply_fn = unfused_apply
@@ -503,11 +503,18 @@ def _run_benchmark(cfg: BenchConfig) -> BenchmarkResults:
                         try:
                             fn = _compile_cg(engine_cg_retry, fallback_opts)
                             res.extra["cg_engine_form"] = "chunked-retry"
+                            # keep the one-kernel rejection too: the scoped
+                            # VMEM tiers are hardware-calibrated estimates,
+                            # and a drifted tier boundary is only
+                            # diagnosable from the first failure's text
+                            res.extra["cg_engine_one_kernel_error"] = (
+                                exc_str(exc)
+                            )
                         except Exception as exc2:
                             engine = False
                             _record_engine_failure(exc)
                             res.extra["cg_engine_retry_error"] = (
-                                f"{type(exc2).__name__}: {exc2}"[:300]
+                                exc_str(exc2)
                             )
                     else:
                         engine = False
@@ -555,9 +562,12 @@ def _run_benchmark(cfg: BenchConfig) -> BenchmarkResults:
                             lambda A: partial(engine_apply_retry, A),
                             fallback_opts)
                         res.extra["cg_engine_form"] = "chunked-retry"
+                        res.extra["cg_engine_one_kernel_error"] = (
+                            exc_str(exc)
+                        )
                     except Exception as exc2:
                         res.extra["cg_engine_retry_error"] = (
-                            f"{type(exc2).__name__}: {exc2}"[:300]
+                            exc_str(exc2)
                         )
                 if fn is None:
                     engine = False

@@ -248,7 +248,7 @@ def run_distributed(cfg, res, dtype):
                     raise
                 res.extra["cg_engine"] = False
                 res.extra["cg_engine_error"] = (
-                    f"{type(exc).__name__}: {exc}"[:300]
+                    exc_str(exc)
                 )
                 _, cg_fn, _ = make_kron_sharded_fns(
                     op, dgrid, cfg.nreps, engine=False
@@ -283,7 +283,7 @@ def run_distributed(cfg, res, dtype):
                     raise
                 res.extra["cg_engine"] = False
                 res.extra["cg_engine_error"] = (
-                    f"{type(exc).__name__}: {exc}"[:300]
+                    exc_str(exc)
                 )
                 apply_fn, _, _ = make_kron_sharded_fns(
                     op, dgrid, cfg.nreps, engine=False

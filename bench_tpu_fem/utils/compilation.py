@@ -43,6 +43,12 @@ def scoped_vmem_options(kib: int | None) -> dict[str, str] | None:
 CPU_DF_DIST_OPTIONS: dict[str, bool] = {"xla_cpu_use_fusion_emitters": False}
 
 
+def exc_str(exc: BaseException) -> str:
+    """Truncated `Type: message` form the drivers record in result
+    extras when a compile fails and a fallback path takes over."""
+    return f"{type(exc).__name__}: {exc}"[:300]
+
+
 def compile_lowered(lowered, extra: dict[str, str] | None = None,
                     cpu_extra: dict | None = None):
     """`.compile()` with per-platform compiler options: on TPU, `extra`
@@ -50,11 +56,15 @@ def compile_lowered(lowered, extra: dict[str, str] | None = None,
     (TPU flags are dropped there — the CPU backend rejects them)."""
     import jax
 
-    if jax.default_backend() == "tpu":
+    backend = jax.default_backend()
+    if backend == "tpu":
         opts = {**extra, **TPU_COMPILER_OPTIONS} if extra else dict(
             TPU_COMPILER_OPTIONS)
         if opts:
             return lowered.compile(compiler_options=opts)
-    elif cpu_extra:
+    elif backend == "cpu" and cpu_extra:
+        # cpu_extra is CPU-only (xla_cpu_*); any other backend (e.g. a
+        # GPU host under platform='auto') must fall through to a plain
+        # compile rather than receive a flag its compiler rejects
         return lowered.compile(compiler_options=dict(cpu_extra))
     return lowered.compile()

@@ -65,6 +65,35 @@ def _reduce_gathered(names: list[str],
     }
 
 
+# fixed width so the allgather sees identical shapes on every process;
+# names rarely approach 4 KiB, and a truncated-equal collision would have
+# to pair with divergent counts that still reshape cleanly to slip through
+_NAMES_CAP = 4096
+
+
+def _names_blob(names: list[str]) -> np.ndarray:
+    """Fixed-width uint8 encoding of the phase-name list for allgather
+    (uint8 is exempt from the x64-off f64→f32 demotion, so the check can
+    run outside the x64 save/restore)."""
+    return np.frombuffer(
+        ("\x1f".join(names)).encode()[:_NAMES_CAP].ljust(_NAMES_CAP, b"\0"),
+        dtype=np.uint8,
+    ).copy()
+
+
+def _check_gathered_names(gathered_names: np.ndarray, names: list[str]) -> None:
+    """Raise if any process gathered a different phase-name list: equal
+    phase COUNTS with divergent NAMES (an engine fallback firing on one
+    host only) would otherwise reshape fine and silently max-reduce
+    unrelated phases against each other."""
+    rows = np.asarray(gathered_names).reshape(-1, _NAMES_CAP)
+    if not (rows == rows[0]).all():
+        raise RuntimeError(
+            "timer phase names diverge across processes; cannot "
+            f"max-reduce the timing table (local names: {names})"
+        )
+
+
 def aggregated_timings() -> dict[str, dict[str, float]]:
     """`timings()`, max-reduced across controller processes when the job
     is multi-controller (`jax.process_count() > 1`) — the reference's
@@ -91,9 +120,17 @@ def aggregated_timings() -> dict[str, dict[str, float]]:
         dtype=np.float64,
     )
     # keep the f64 rows through the gather: without x64 the collective
-    # silently demotes to f32 (the drivers deliberately leave x64 off)
-    with jax.experimental.enable_x64():
+    # silently demotes to f32 (the drivers deliberately leave x64 off).
+    # Explicit save/restore of the config flag — jax.experimental has no
+    # enable_x64 context manager in the installed jax.
+    _check_gathered_names(
+        multihost_utils.process_allgather(_names_blob(names)), names)
+    prev = jax.config.jax_enable_x64
+    jax.config.update("jax_enable_x64", True)
+    try:
         gathered = np.asarray(multihost_utils.process_allgather(rows))
+    finally:
+        jax.config.update("jax_enable_x64", prev)
     return _reduce_gathered(names, gathered.reshape(-1, len(names), 3))
 
 

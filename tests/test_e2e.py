@@ -127,3 +127,20 @@ def test_timer_aggregation_max_reduce():
     # single-process: identity with the local registry, no device traffic
     assert aggregated_timings() == timings()
     reset_timers()
+
+
+def test_timer_name_divergence_detected():
+    """Equal phase counts with divergent names across processes must be
+    an error, not a silently misaligned max-reduce (the reference's
+    list_timings carries the same symmetry assumption implicitly)."""
+    import numpy as np
+    import pytest
+
+    from bench_tpu_fem.utils.timing import _check_gathered_names, _names_blob
+
+    same = np.stack([_names_blob(["a", "b"]), _names_blob(["a", "b"])])
+    _check_gathered_names(same, ["a", "b"])  # no raise
+
+    diverged = np.stack([_names_blob(["a", "b"]), _names_blob(["a", "c"])])
+    with pytest.raises(RuntimeError, match="diverge"):
+        _check_gathered_names(diverged, ["a", "b"])
