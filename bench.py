@@ -46,18 +46,26 @@ def run_f64_side_metric(ndev: int) -> float:
     return res.gdof_per_second / ndev
 
 
-def run_df32_side_metric() -> float:
-    """f64-class-via-f32-pairs CG GDoF/s per chip (ops.kron_df): the
-    TPU-native alternative to XLA's software f64 — ~1e-12 residual floors
-    at a ~20x flop multiplier instead of ~100x emulation (README
-    'Precision policy'). Same size/reps as the emulated side metric."""
+def run_df32_side_metric(ndofs: int) -> dict:
+    """f64-class-via-f32-pairs CG GDoF/s per chip: the TPU-native answer
+    to the reference's f64 benchmarks (~1e-12 residual floors from f32
+    pairs; README 'Precision policy'). Measured at the FLAGSHIP problem
+    size through the fused delay-ring df engine (ops.kron_cg_df) so the
+    number is comparable against the reference's per-GPU f64 baseline —
+    vs_baseline is against the same 4.02 GDoF/s as the headline."""
     from bench_tpu_fem.bench.driver import BenchConfig, run_benchmark
 
     cfg = BenchConfig(
-        ndofs_global=2_000_000, degree=DEGREE, qmode=QMODE, float_bits=64,
-        nreps=50, use_cg=True, ndevices=1, f64_impl="df32",
+        ndofs_global=ndofs, degree=DEGREE, qmode=QMODE, float_bits=64,
+        nreps=100, use_cg=True, ndevices=1, f64_impl="df32",
     )
-    return run_benchmark(cfg).gdof_per_second
+    res = run_benchmark(cfg)
+    return {
+        "f64_df32_gdof_per_s_per_chip": round(res.gdof_per_second, 4),
+        "f64_df32_vs_baseline": round(
+            res.gdof_per_second / BASELINE_GDOF_PER_GPU, 4),
+        "f64_df32_engine": res.extra.get("cg_engine"),
+    }
 
 
 def run_perturbed_metric(ndofs: int, ndev: int) -> dict:
@@ -129,8 +137,7 @@ def run(ndofs: int) -> dict:
     if f64_err is not None:
         out["f64_error"] = f64_err
     try:
-        out["f64_df32_gdof_per_s_per_chip"] = round(
-            run_df32_side_metric(), 4)
+        out.update(run_df32_side_metric(ndofs))
     except Exception as e:  # record, never sink the flagship
         out["f64_df32_error"] = f"{type(e).__name__}: {e}"[:200]
     try:
@@ -178,10 +185,22 @@ def _probe_devices(timeout_s: int = 180):
     return devs
 
 
-def main() -> int:
-    # Adaptive sizing: halve on OOM. 12.5M dofs/chip fits the v5e-class
-    # 16 GB HBM with the precomputed geometry tensor plus CG state.
-    ndofs = int(sys.argv[1]) if len(sys.argv) > 1 else 12_500_000
+def single_attempt(ndofs: int) -> int:
+    """One end-to-end benchmark attempt in THIS process (the round-1..4
+    bench.py behaviour): probe the devices under a hard watchdog, run,
+    print one JSON line. A wedged PJRT client holds the GIL, so a failed
+    attempt cannot recover in-process — retries happen at the process
+    level in main()."""
+    import os
+
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        # CPU-pinned runs (CI / local testing) must unhook the axon
+        # plugin: its sitecustomize hook consults the tunnel even under
+        # JAX_PLATFORMS=cpu and hangs every plain process when the
+        # tunnel is wedged (see utils.hermetic)
+        from bench_tpu_fem.utils.hermetic import force_host_cpu_devices
+
+        force_host_cpu_devices(1)
     _probe_devices()  # hard-exits with a JSON error line on a wedged tunnel
     requested = ndofs
     last_err = None
@@ -209,6 +228,93 @@ def main() -> int:
         gc.collect()
         jax.clear_caches()
     print(json.dumps(_error_line(f"could not fit problem: {last_err}")))
+    return 1
+
+
+def _last_json_line(text: str) -> dict | None:
+    for line in reversed(text.strip().splitlines()):
+        try:
+            obj = json.loads(line)
+        except (json.JSONDecodeError, ValueError):
+            continue
+        if isinstance(obj, dict) and "metric" in obj:
+            return obj
+    return None
+
+
+def main() -> int:
+    """Bounded retry-with-backoff around single attempts (round 4's
+    lesson: the TPU tunnel wedges for hours at a time, and a single
+    180 s fail-fast at end-of-round capture time turned a 2.31x round
+    into an official 0.0 artifact). Each attempt is a CHILD process —
+    a wedged PJRT init blocks the GIL and is unrecoverable in-process —
+    killed on overrun; the parent re-prints the child's JSON line
+    verbatim on success and otherwise retries every BENCH_RETRY_S until
+    the BENCH_WINDOW_S window closes."""
+    import os
+    import signal
+    import subprocess
+    import time as _time
+
+    ndofs_arg = [a for a in sys.argv[1:] if a != "--single-attempt"]
+    ndofs = int(ndofs_arg[0]) if ndofs_arg else 12_500_000
+    if "--single-attempt" in sys.argv:
+        return single_attempt(ndofs)
+
+    window_s = int(os.environ.get("BENCH_WINDOW_S", 7200))
+    retry_s = int(os.environ.get("BENCH_RETRY_S", 300))
+    attempt_timeout_s = int(os.environ.get("BENCH_ATTEMPT_TIMEOUT_S", 2700))
+    deadline = _time.monotonic() + window_s
+    last: dict | None = None
+    attempt = 0
+    while True:
+        attempt += 1
+        t0 = _time.monotonic()
+        try:
+            proc = subprocess.Popen(
+                [sys.executable, os.path.abspath(__file__),
+                 "--single-attempt", str(ndofs)],
+                stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+                text=True, start_new_session=True,
+            )
+            try:
+                out, _ = proc.communicate(timeout=attempt_timeout_s)
+                rc = proc.returncode
+            except subprocess.TimeoutExpired:
+                # kill the whole session: PJRT spawns helper threads that
+                # outlive a plain terminate when the tunnel is wedged.
+                # The child may exit between the deadline and the kill —
+                # that's a finished attempt, not a failure: fall through
+                # to parsing whatever it wrote.
+                try:
+                    os.killpg(proc.pid, signal.SIGKILL)
+                except ProcessLookupError:
+                    pass
+                out, _ = proc.communicate()
+                rc = None
+                last = _error_line(
+                    f"attempt {attempt} exceeded {attempt_timeout_s}s "
+                    "(TPU tunnel wedged mid-run)")
+        except OSError as exc:
+            out, rc = "", None
+            last = _error_line(f"attempt spawn failed: {exc}")
+        parsed = _last_json_line(out) if out else None
+        if parsed is not None:
+            last = parsed
+            # rc None = killed at the deadline; a complete JSON line with
+            # a non-zero value still means the benchmark finished
+            if rc in (0, None) and parsed.get("value", 0.0) > 0.0:
+                print(json.dumps(parsed), flush=True)
+                return 0
+        elapsed = _time.monotonic() - t0
+        if _time.monotonic() + retry_s >= deadline:
+            break
+        print(f"# attempt {attempt} failed after {elapsed:.0f}s "
+              f"({(last or {}).get('error', 'no JSON line')}); retrying in "
+              f"{retry_s}s", file=sys.stderr, flush=True)
+        _time.sleep(retry_s)
+    print(json.dumps(last if last is not None else _error_line(
+        f"no successful attempt within {window_s}s window")), flush=True)
     return 1
 
 

@@ -44,6 +44,7 @@ from ..ops.laplacian import build_laplacian
 from ..utils.compilation import (  # noqa: F401  (TPU_COMPILER_OPTIONS re-exported for probes/tests, which must mutate it IN PLACE — rebinding the name here would not reach compile_lowered)
     TPU_COMPILER_OPTIONS,
     compile_lowered,
+    exc_str,
     scoped_vmem_options,
 )
 from ..utils.timing import Timer
@@ -253,14 +254,49 @@ def _run_benchmark_df64(cfg: BenchConfig) -> BenchmarkResults:
         )
         u = (df_from_f64(np.asarray(b_host, np.float64))
              if cfg.mat_comp else device_rhs_uniform_df(t, mesh.n))
-        if cfg.use_cg:
-            fn = compile_lowered(jax.jit(
-                lambda A, b: cg_solve_df(A, b, cfg.nreps)
-            ).lower(op, u))
-        else:
-            fn = compile_lowered(jax.jit(
-                lambda A, b: action_df(A, b, cfg.nreps)
-            ).lower(op, u))
+
+        # Fused df delay-ring engine (ops.kron_cg_df) on TPU where the
+        # one-kernel form fits a scoped-VMEM tier; Mosaic compile
+        # rejections fall back to the unfused path with the reason
+        # recorded (same hardening as the f32 engine above).
+        from ..ops.kron_cg_df import (
+            action_ring_df,
+            engine_plan_df,
+            kron_cg_df_solve,
+        )
+
+        form, kib = engine_plan_df(dof_grid_shape(n, cfg.degree),
+                                   cfg.degree)
+        engine = jax.default_backend() == "tpu" and form == "one"
+        compile_opts = scoped_vmem_options(kib) if engine else None
+        res.extra["cg_engine"] = engine
+
+        def _lower(f):
+            return jax.jit(f).lower(op, u)
+
+        try:
+            if cfg.use_cg:
+                fn = compile_lowered(_lower(
+                    (lambda A, b: kron_cg_df_solve(A, b, cfg.nreps))
+                    if engine else
+                    (lambda A, b: cg_solve_df(A, b, cfg.nreps))
+                ), compile_opts)
+            else:
+                fn = compile_lowered(_lower(
+                    (lambda A, b: action_ring_df(A, b, cfg.nreps))
+                    if engine else
+                    (lambda A, b: action_df(A, b, cfg.nreps))
+                ), compile_opts)
+        except Exception as exc:
+            if not engine:
+                raise
+            engine = False
+            res.extra["cg_engine"] = False
+            res.extra["cg_engine_error"] = exc_str(exc)
+            fn = compile_lowered(_lower(
+                (lambda A, b: cg_solve_df(A, b, cfg.nreps)) if cfg.use_cg
+                else (lambda A, b: action_df(A, b, cfg.nreps))
+            ))
         warm = fn(op, u)
         float(warm.hi[(0,) * warm.hi.ndim])
         del warm

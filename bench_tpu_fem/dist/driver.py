@@ -14,6 +14,7 @@ from ..la.cg import cg_solve
 from ..utils.compilation import (
     CPU_DF_DIST_OPTIONS,
     compile_lowered,
+    exc_str,
     scoped_vmem_options,
 )
 from ..utils.timing import Timer

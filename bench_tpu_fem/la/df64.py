@@ -33,18 +33,31 @@ _SPLIT = np.float32(4097.0)
 
 
 def _launder(x):
-    """Round-trip through an int32 bitcast: value-identical, but opaque to
-    floating-point pattern rewrites. Required for correctness: when the
-    error-free transformations below fuse with their producers, the
-    compiler rewrites patterns like `a - (a + b)` as real arithmetic,
-    which zeroes the computed rounding error and silently degrades every
-    df64 result to ~f32 accuracy (measured on XLA:CPU whole-graph
-    compilation; per-op execution is unaffected, and no public XLA flag
-    disables it — tests/test_df64.py pins the jitted behaviour). Bitcasts
-    cost nothing on hardware."""
-    return jax.lax.bitcast_convert_type(
-        jax.lax.bitcast_convert_type(x, jnp.int32), jnp.float32
-    )
+    """Value-identical but opaque to floating-point pattern rewrites.
+    Required for correctness: when the error-free transformations below
+    fuse with their producers, the compiler rewrites patterns like
+    `a - (a + b)` as real arithmetic, which zeroes the computed rounding
+    error and silently degrades every df64 result to ~f32 accuracy
+    (measured on XLA:CPU whole-graph compilation; per-op execution is
+    unaffected, and no public XLA flag disables it — tests/test_df64.py
+    pins the jitted behaviour).
+
+    IMPORTANT: the launder is defense-in-depth, NOT a guarantee. On
+    XLA:CPU both known spellings are stripped before late
+    simplification (verified in HLO dumps of `after_optimizations`:
+    f32->i32->f32 bitcast pairs are folded to the identity, and
+    opt-barriers are expanded away), after which fused graphs can still
+    rewrite compensation patterns — the banded df contractions of
+    ops.kron_cg_df measured a ~1e-8 relative loss from exactly this.
+    The guaranteed defense is STRUCTURAL: every term is renormalised
+    (two_sum) before it enters an accumulation two_sum — the one form
+    measured to survive whole-graph optimisation (see
+    ops.kron_cg_df._acc2 and df_sum's docstring). The barrier spelling
+    is kept because it is free at run time and may still block earlier
+    pipeline phases (and other backends' pipelines) from fusing across
+    it."""
+    (out,) = jax.lax.optimization_barrier((x,))
+    return out
 
 
 class DF(NamedTuple):
@@ -56,11 +69,18 @@ class DF(NamedTuple):
 
 def two_sum(a, b):
     """Error-free a + b: returns (s, err) with s + err == a + b exactly.
-    The laundered copy of s keeps the compiler from cancelling the error
-    terms (see _launder)."""
+    The laundered copies are best-effort rewrite protection (see
+    _launder: XLA:CPU strips them, so they are NOT sufficient on their
+    own). The load-bearing rule is the CALLER's: renormalise each term
+    (a two_sum of the product pair itself is fine) BEFORE accumulating
+    it into a running sum — accumulating raw product values measurably
+    loses the carries inside larger fused graphs (~1e-8 relative in the
+    banded df contractions of ops.kron_cg_df) regardless of laundering,
+    while the renorm-first form holds ~1e-15 (see
+    ops.kron_cg_df._acc2)."""
     s = a + b
     so = _launder(s)
-    bb = so - a
+    bb = _launder(so - a)
     err = (a - (so - bb)) + (b - bb)
     return s, err
 

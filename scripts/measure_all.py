@@ -1,18 +1,22 @@
-"""Run the outstanding TPU measurement agenda for round 4, logging each
+"""Run the outstanding TPU measurement agenda (round 5), logging each
 step as it lands (a mid-run tunnel wedge preserves completed steps).
 
-The 2026-07-30 agenda was fully collected (BASELINE_MATRIX_r04.json,
-BENCH_r04_measured.json); those stages remain callable by name. The
-default agenda now targets what the fourth tunnel wedge (2026-07-31
-~06:15 UTC) interrupted:
+Round-4 stages remain callable by name. The round-5 default agenda
+targets the fused df32 engine (the round's headline: VERDICT item 1)
+plus the items the round-4 wedges left uncollected:
 
   health    - tunnel probe (aborts the rest when down)
-  p300      - tier-3 (96 MiB scoped limit) one-kernel regression probe
-              at Q3-300M (probe_scoped_vmem q3_300m)
+  dfacc     - df32 engine ACCURACY on hardware (mat_comp oracle): the
+              Mosaic compile path may behave differently from the
+              CPU-validated interpret path (FP rewrites, op support) —
+              this gate must pass before any df perf number is believed
+  dfeng     - fused df32 engine A/B vs unfused at 12.5M dofs
+  dflarge   - df32 engine at 100M (tier-3 scoped limit), plus the
+              recorded one-kernel ceiling behaviour toward 300M
   pert100   - perturbed capacity at 100M dofs, corner mode
-  deg7probe - degree-7 streamed-corner compile probe at 48 MiB (plan-
-              widening evidence)
-  bench     - the official bench.py line
+  deg7probe - degree-7 streamed-corner compile probe at 48 MiB
+  bench     - the official bench.py line (now includes the df32
+              headline side metric at flagship size)
 
 Usage: python scripts/measure_all.py [stage...]
 """
@@ -22,7 +26,7 @@ import sys
 import time
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-LOG = os.path.join(ROOT, "MEASURE_r04.log")
+LOG = os.path.join(ROOT, "MEASURE_r05.log")
 ENV = {**os.environ, "PYTHONPATH": f"{ROOT}:/root/.axon_site"}
 
 
@@ -36,16 +40,29 @@ def log(msg):
 def _run(cmd, timeout, tail=25):
     """Shared runner: same env/cwd/timeout handling for every stage. A
     hang (wedged tunnel) is reported as rc=-9 with a TIMEOUT tail instead
-    of propagating — the agenda must keep logging whatever it can."""
+    of propagating — the agenda must keep logging whatever it can. The
+    stage runs in its own session and the WHOLE GROUP is killed on
+    timeout: bench.py spawns detached single-attempt children, and a
+    parent-only kill would orphan one holding the wedged TPU client."""
+    import signal
+
+    proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT, text=True,
+                            cwd=ROOT, env=ENV, start_new_session=True)
     try:
-        r = subprocess.run(cmd, capture_output=True, text=True,
-                           timeout=timeout, cwd=ROOT, env=ENV)
+        out, _ = proc.communicate(timeout=timeout)
+        rc = proc.returncode
     except subprocess.TimeoutExpired:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except ProcessLookupError:
+            pass
+        out, _ = proc.communicate()
         return -9, f"TIMEOUT after {timeout}s"
-    out = (r.stdout + r.stderr).strip().splitlines()
-    keep = [ln for ln in out if not ln.lower().startswith("warning")
+    keep = [ln for ln in (out or "").strip().splitlines()
+            if not ln.lower().startswith("warning")
             and "Platform 'axon'" not in ln]
-    return r.returncode, "\n".join(keep[-tail:])
+    return rc, "\n".join(keep[-tail:])
 
 
 def run_py(code, timeout=900):
@@ -165,7 +182,17 @@ def stage_matrix():
 
 
 def stage_bench():
-    rc, out = run_script(["bench.py"], timeout=3600)
+    # The agenda only reaches this stage when health passed, so bench.py
+    # gets a SHORT retry window (its 2h default is for the driver's
+    # end-of-round capture against a possibly-wedged tunnel) and the
+    # stage timeout comfortably covers window + one attempt overrun.
+    ENV["BENCH_WINDOW_S"] = "1800"
+    ENV["BENCH_ATTEMPT_TIMEOUT_S"] = "1500"
+    try:
+        rc, out = run_script(["bench.py"], timeout=2400)
+    finally:
+        ENV.pop("BENCH_WINDOW_S", None)
+        ENV.pop("BENCH_ATTEMPT_TIMEOUT_S", None)
     log(f"bench.py rc={rc}: {out}")
 
 
@@ -263,6 +290,46 @@ def stage_deg7probe():
     _probe_stage("deg7probe", 1800)
 
 
+def stage_dfacc():
+    # df32 engine accuracy ON HARDWARE: the CPU suite validates the
+    # interpret path; Mosaic's compiled arithmetic (scheduling, any FP
+    # rewrites, scratch semantics) is only provable here. The oracle
+    # (assembled CSR, true f64) must agree to ~1e-9 like the unfused
+    # path; a failure here invalidates every df perf number after it.
+    code = PRE + """
+cfg = BenchConfig(ndofs_global=50_000, degree=3, qmode=1, float_bits=64,
+                  nreps=30, use_cg=True, mat_comp=True, f64_impl="df32")
+res, w = timed_res(cfg)
+print("DFACC:", "enorm/znorm", res.enorm / res.znorm, res.extra)
+assert res.extra.get("cg_engine") is True, "engine did not engage"
+assert res.enorm / res.znorm < 1e-9, "df engine lost f64-class accuracy"
+print("DFACC OK")
+"""
+    rc, out = run_py(code, timeout=1200)
+    log(f"dfacc rc={rc}: {out}")
+    return rc == 0
+
+
+def stage_dfeng():
+    # fused engine vs unfused df at flagship size
+    _bench_stage("dfeng", "DFENG12.5M:", dict(
+        ndofs_global=12_500_000, degree=3, qmode=1, float_bits=64,
+        nreps=200, use_cg=True, f64_impl="df32"),
+        tail_expr=', "vs4.02:", res.gdof_per_second/4.02')
+    _bench_stage("dfunf", "DFUNFUSED12.5M:", dict(
+        ndofs_global=12_500_000, degree=3, qmode=1, float_bits=64,
+        nreps=50, use_cg=True, f64_impl="df32"),
+        setup="import bench_tpu_fem.ops.kron_cg_df as KCD\n"
+              "KCD.engine_plan_df = lambda *a: ('unfused', None)")
+
+
+def stage_dflarge():
+    for nd, reps in ((100_000_000, 50), (150_000_000, 30)):
+        _bench_stage(f"dflarge{nd}", f"DFLARGE {nd}:", dict(
+            ndofs_global=nd, degree=3, qmode=1, float_bits=64,
+            nreps=reps, use_cg=True, f64_impl="df32"), timeout=2400)
+
+
 STAGES = {
     "health": stage_health, "ab12": stage_ab12, "q6": stage_q6,
     "large": stage_large, "deg4": stage_deg4, "df32": stage_df32,
@@ -270,16 +337,15 @@ STAGES = {
     "deg5": stage_deg5, "dist1": stage_dist1, "q6one": stage_q6one,
     "dfdist1": stage_dfdist1, "deg6stream": stage_deg6stream,
     "p300": stage_p300, "pert100": stage_pert100,
-    "deg7probe": stage_deg7probe,
+    "deg7probe": stage_deg7probe, "dfacc": stage_dfacc,
+    "dfeng": stage_dfeng, "dflarge": stage_dflarge,
 }
 
 if __name__ == "__main__":
-    # Default agenda (2026-07-31, after the scoped-VMEM tier work): the
-    # 2026-07-30 agenda was fully collected; what remains is the tier-3
-    # probe interrupted by the fourth tunnel wedge plus a fresh official
-    # line.
-    wanted = sys.argv[1:] or ["health", "p300", "pert100",
-                              "deg7probe", "bench"]
+    # Round-5 default agenda: df engine accuracy gate first, then its
+    # perf numbers, then the round-4 leftovers and the official line.
+    wanted = sys.argv[1:] or ["health", "dfacc", "dfeng", "dflarge",
+                              "pert100", "deg7probe", "bench"]
     unknown = [s for s in wanted if s not in STAGES]
     if unknown:
         print(f"unknown stage(s) {unknown}; valid: {list(STAGES)}",

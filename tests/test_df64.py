@@ -5,9 +5,12 @@ The jit-parity tests are regression pins for a measured whole-graph
 compiler hazard: when the error-free transformations fuse with their
 producers, patterns like `a - (a + b)` get rewritten as real arithmetic,
 zeroing the computed rounding errors and silently degrading df64 to ~f32
-accuracy. la.df64 defends with bitcast laundering and a full-two_sum
-renormalisation; these tests fail if a refactor reintroduces the fragile
-forms (everything here runs UNDER jit for exactly that reason)."""
+accuracy. The guaranteed defense is structural — renormalise every term
+before it enters an accumulation two_sum (la.df64._launder's laundering
+is best-effort only: XLA:CPU strips both its spellings before late
+simplification, see its docstring); these tests fail if a refactor
+reintroduces the fragile forms (everything here runs UNDER jit for
+exactly that reason)."""
 
 import jax
 import jax.numpy as jnp

@@ -1,0 +1,173 @@
+"""Fused df32 CG engine (ops.kron_cg_df) vs the unfused df path.
+
+Mirrors tests/test_kron_cg.py's strategy: interpret-mode pallas on CPU,
+parity against the independently-tested unfused df operator
+(ops.kron_df, itself matched against true f64 in tests/test_df64.py).
+df tolerances: both paths carry ~48-bit mantissas, so cross-path
+agreement is ~1e-12 relative, not the f32 suite's ~1e-6.
+"""
+
+import numpy as np
+import pytest
+
+from bench_tpu_fem.elements.tables import build_operator_tables
+from bench_tpu_fem.la.df64 import df_dot, df_sub, df_to_f64
+from bench_tpu_fem.mesh.box import create_box_mesh
+from bench_tpu_fem.ops.kron_cg_df import (
+    _engine_coeffs,
+    _kron_cg_df_call,
+    action_ring_df,
+    engine_plan_df,
+    engine_vmem_bytes_df,
+    kron_apply_ring_df,
+    kron_cg_df_solve,
+)
+from bench_tpu_fem.ops.kron_df import (
+    build_kron_laplacian_df,
+    cg_solve_df,
+    device_rhs_uniform_df,
+)
+
+
+def _setup(degree, n, qmode=1):
+    t = build_operator_tables(degree, qmode, "gll")
+    mesh = create_box_mesh(n)
+    op = build_kron_laplacian_df(mesh, degree, qmode, "gll", tables=t)
+    b = device_rhs_uniform_df(t, mesh.n)
+    return op, b
+
+
+@pytest.mark.parametrize(
+    "degree,n",
+    [(1, (4, 5, 6)), (2, (3, 4, 5)), (3, (3, 4, 5)), (5, (2, 3, 2)),
+     (7, (2, 3, 2))],
+)
+def test_ring_apply_matches_unfused_df(degree, n):
+    op, b = _setup(degree, n)
+    y_ref = df_to_f64(op.apply(b))
+    y = df_to_f64(kron_apply_ring_df(op, b, interpret=True))
+    rel = np.linalg.norm(y - y_ref) / np.linalg.norm(y_ref)
+    assert rel < 5e-13
+
+
+def test_ring_apply_fused_dot_matches():
+    op, b = _setup(3, (3, 4, 5))
+    y_ref = df_to_f64(op.apply(b))
+    coeffs = _engine_coeffs(op)
+    _, dot = _kron_cg_df_call(op, coeffs, False, True, b)
+    dot_ref = float(np.dot(df_to_f64(b).ravel(), y_ref.ravel()))
+    got = float(np.float64(dot.hi) + np.float64(dot.lo))
+    assert abs(got - dot_ref) / abs(dot_ref) < 1e-12
+
+
+@pytest.mark.parametrize("degree,n", [(1, (4, 5, 6)), (3, (3, 4, 5)),
+                                      (5, (2, 3, 2))])
+def test_engine_cg_matches_unfused_df(degree, n):
+    op, b = _setup(degree, n)
+    x_ref = df_to_f64(cg_solve_df(op, b, 12))
+    x = df_to_f64(kron_cg_df_solve(op, b, 12, interpret=True))
+    rel = np.linalg.norm(x - x_ref) / np.linalg.norm(x_ref)
+    assert rel < 1e-11
+
+
+def test_engine_cg_holds_df_floor():
+    """Long fixed-iteration run must freeze at the df64 residual floor
+    (~1e-12 relative), the same guarantee as the unfused cg_solve_df —
+    not drift or blow up (reference f64 behaviour,
+    laplacian_solver.cpp:130-148)."""
+    op, b = _setup(3, (4, 4, 4))
+    x = kron_cg_df_solve(op, b, 200, interpret=True)
+    r = df_sub(b, op.apply(x))
+    rn = float(np.sqrt(abs(float(df_to_f64(df_dot(r, r))))))
+    bn = float(np.sqrt(abs(float(df_to_f64(df_dot(b, b))))))
+    assert rn / bn < 1e-11
+
+
+def test_engine_cg_dirichlet_rows_pass_through():
+    """Boundary dofs of the CG solution equal the unfused path's exactly
+    (both blend u[bc] through untouched — laplacian_gpu.hpp:163-169
+    semantics in the reference)."""
+    op, b = _setup(3, (3, 3, 3))
+    x_ref = df_to_f64(cg_solve_df(op, b, 8))
+    x = df_to_f64(kron_cg_df_solve(op, b, 8, interpret=True))
+    nb = np.asarray(op.notbc.hi, np.float64)
+    bc = nb == 0.0
+    ref_bc = x_ref[bc]
+    assert np.allclose(x[bc], ref_bc, rtol=1e-12, atol=1e-300)
+
+
+def test_action_ring_matches_unfused():
+    from bench_tpu_fem.ops.kron_df import action_df
+
+    op, b = _setup(3, (3, 4, 5))
+    y_ref = df_to_f64(action_df(op, b, 3))
+    y = df_to_f64(action_ring_df(op, b, 3, interpret=True))
+    rel = np.linalg.norm(y - y_ref) / np.linalg.norm(y_ref)
+    assert rel < 5e-13
+
+
+def test_engine_plan_df_tiers():
+    """The df plan reuses the f32 tier ladder on the doubled-channel
+    estimate: small grids take the default-limit one-kernel form, the
+    flagship 12.5M sits in a raised tier, and past tier 3 the plan
+    reports 'unfused' (no df chunked form exists yet)."""
+    from bench_tpu_fem.ops.kron_cg import ONE_KERNEL_SCOPED_KIB2
+
+    form, kib = engine_plan_df((232, 232, 232), 3)  # ~12.5M dofs
+    assert form == "one" and kib is None  # 10.4 MiB: default limit
+    form, kib = engine_plan_df((465, 465, 465), 3)  # ~100M dofs
+    assert form == "one" and kib == ONE_KERNEL_SCOPED_KIB2
+    form, kib = engine_plan_df((670, 670, 670), 3)  # ~300M dofs
+    assert form == "unfused" and kib is None
+    # the estimate is monotone in plane size
+    assert (engine_vmem_bytes_df((10, 100, 100), 3)
+            < engine_vmem_bytes_df((10, 200, 200), 3))
+
+
+def test_driver_df32_engine_only_on_tpu():
+    """On CPU the df32 driver must keep the unfused path (the engine is
+    a Mosaic kernel; interpret mode is for tests, not benchmark runs)
+    and still agree with the f64 oracle."""
+    import jax
+
+    from bench_tpu_fem.bench.driver import BenchConfig, run_benchmark
+
+    cfg = BenchConfig(ndofs_global=2000, degree=3, qmode=1, float_bits=64,
+                      nreps=8, use_cg=True, mat_comp=True, ndevices=1,
+                      f64_impl="df32")
+    res = run_benchmark(cfg)
+    assert res.extra["f64_impl"] == "df32"
+    assert res.extra["cg_engine"] is False or \
+        jax.default_backend() == "tpu"
+    assert res.enorm / res.znorm < 1e-9
+
+
+def test_driver_df32_engine_fallback_on_compile_failure(monkeypatch):
+    """A Mosaic rejection of the fused df engine must not sink the
+    benchmark: the driver records the error and completes unfused."""
+    import jax
+    import numpy as np
+
+    import bench_tpu_fem.ops.kron_cg_df as KCD
+    from bench_tpu_fem.bench.driver import BenchConfig, run_benchmark
+
+    def boom(*a, **kw):
+        raise RuntimeError("Mosaic rejects the df one-kernel form")
+
+    monkeypatch.setattr(KCD, "kron_cg_df_solve", boom)
+    monkeypatch.setattr(jax, "default_backend", lambda: "tpu")
+
+    cfg = BenchConfig(ndofs_global=2000, degree=3, qmode=1, float_bits=64,
+                      nreps=3, use_cg=True, ndevices=1, f64_impl="df32")
+    res = run_benchmark(cfg)
+    assert res.extra["cg_engine"] is False
+    assert "Mosaic rejects" in res.extra["cg_engine_error"]
+    assert np.isfinite(res.ynorm) and res.ynorm > 0
+
+
+def test_qmode0_matches_unfused():
+    op, b = _setup(3, (3, 4, 5), qmode=0)
+    y_ref = df_to_f64(op.apply(b))
+    y = df_to_f64(kron_apply_ring_df(op, b, interpret=True))
+    rel = np.linalg.norm(y - y_ref) / np.linalg.norm(y_ref)
+    assert rel < 5e-13

@@ -175,6 +175,32 @@ def test_folded_fused_apply_specs(recorder, geom):
     recorder.check()
 
 
+@pytest.mark.parametrize("degree", [3, 4])
+def test_kron_df_engine_specs(recorder, degree):
+    """The fused df32 engine (ops.kron_cg_df): both the CG (update_p)
+    and action forms."""
+    from bench_tpu_fem.ops.kron_cg_df import _engine_coeffs, _kron_cg_df_call
+    from bench_tpu_fem.ops.kron_df import (
+        build_kron_laplacian_df,
+        device_rhs_uniform_df,
+    )
+    from bench_tpu_fem.elements.tables import build_operator_tables
+
+    nc = compute_mesh_size(40_000, degree)
+    t = build_operator_tables(degree, 1, "gll")
+    mesh = create_box_mesh(nc)
+    op = build_kron_laplacian_df(mesh, degree, 1, "gll", tables=t)
+    b = device_rhs_uniform_df(t, mesh.n)
+    coeffs = _engine_coeffs(op)
+    from bench_tpu_fem.ops.kron_cg_df import _beta4
+    from bench_tpu_fem.la.df64 import DF
+
+    beta = _beta4(DF(jnp.float32(0.5), jnp.float32(0.0)))
+    _kron_cg_df_call(op, coeffs, True, True, b, b, beta)
+    _kron_cg_df_call(op, coeffs, False, True, b)
+    recorder.check()
+
+
 @pytest.mark.parametrize("degree", [3, 5])
 def test_dist_kron_engine_specs(recorder, degree):
     from functools import partial
