@@ -204,7 +204,10 @@ def single_attempt(ndofs: int) -> int:
     _probe_devices()  # hard-exits with a JSON error line on a wedged tunnel
     requested = ndofs
     last_err = None
-    while ndofs >= 500_000:
+    # halving floor: never below the explicitly requested size (a small
+    # CLI/test size must still run once), capped at 500k for the default
+    floor = min(500_000, requested)
+    while ndofs >= floor:
         try:
             out = run(ndofs)
             if ndofs != requested:

@@ -1,0 +1,164 @@
+"""df32 roofline: measure the chip's VPU f32 throughput and HBM
+bandwidth, derive the df engine's compute/bandwidth ceilings, and
+compare the measured df32 CG rate against them.
+
+VERDICT r4 item 1's done-criterion allows "a committed roofline analysis
+proving the df32 ceiling and the best achievable number" where >=1.0x
+vs the reference's 4.02 GDoF/s/GPU f64 is not reachable: double-float
+arithmetic multiplies VPU work ~15-20x while the f32 engine already ran
+near the chip's HBM/VPU balance point, so the df ceiling is set by
+whichever of (VPU_flops / df_flops_per_dof, HBM_bytes / df_bytes_per_dof)
+is smaller. This script measures both machine numbers ON the chip (no
+datasheet guesses), prints the ceilings, runs the df engine, and reports
+achieved/ceiling.
+
+Run on hardware: python scripts/roofline_df.py [ndofs]
+Writes ROOFLINE_DF_r05.json at the repo root.
+"""
+
+import json
+import os
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+from jax.experimental import pallas as pl  # noqa: E402
+from jax.experimental.pallas import tpu as pltpu  # noqa: E402
+
+
+def measure_hbm_gbps(nbytes: int = 2 << 30) -> float:
+    """Streaming read+write bandwidth: y = x * c on an HBM-resident f32
+    array (2 streams)."""
+    n = nbytes // 8  # f32 in + f32 out per element
+    x = jnp.ones((n,), jnp.float32)
+    f = jax.jit(lambda a: a * jnp.float32(1.0000001))
+    f(x).block_until_ready()  # compile + warm
+    reps = 10
+    t0 = time.perf_counter()
+    y = x
+    for _ in range(reps):
+        y = f(y)
+    y.block_until_ready()
+    dt = time.perf_counter() - t0
+    return reps * n * 8 / dt / 1e9
+
+
+def _vpu_kernel(R: int, NY: int, NZ: int):
+    def kernel(x_ref, o_ref):
+        a = x_ref[...]
+        c = jnp.float32(1.0000001)
+        d = jnp.float32(1e-9)
+        # 4 independent chains for ILP; R iterations x 4 chains x 2 flops
+        b1 = a
+        b2 = a * jnp.float32(1.0001)
+        b3 = a * jnp.float32(0.9999)
+        b4 = a * jnp.float32(1.0002)
+        for _ in range(R):
+            b1 = b1 * c + d
+            b2 = b2 * c + d
+            b3 = b3 * c + d
+            b4 = b4 * c + d
+        o_ref[...] = (b1 + b2) + (b3 + b4)
+
+    return kernel
+
+
+def measure_vpu_gflops(NY: int = 256, NZ: int = 512) -> float:
+    """Sustained f32 VPU rate from a VMEM-resident multiply-add kernel:
+    two R values difference out the fixed overhead (launch, load/store)."""
+    x = jnp.ones((NY, NZ), jnp.float32)
+
+    def run(R):
+        f = pl.pallas_call(
+            _vpu_kernel(R, NY, NZ),
+            out_shape=jax.ShapeDtypeStruct((NY, NZ), jnp.float32),
+            in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+            out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        )
+        g = jax.jit(f)
+        g(x).block_until_ready()
+        reps = 50
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            y = g(x)
+        y.block_until_ready()
+        return (time.perf_counter() - t0) / reps
+
+    r_lo, r_hi = 64, 512
+    t_lo, t_hi = run(r_lo), run(r_hi)
+    flops = (r_hi - r_lo) * 4 * 2 * NY * NZ
+    return flops / (t_hi - t_lo) / 1e9
+
+
+def df_flops_per_dof(P: int) -> int:
+    """Analytic VPU flop count per dof of one fused df CG iteration
+    (ops.kron_cg_df kernel + the XLA update pass), from the kernel
+    structure: per banded term ~28 flops (_eft_term 13 + renorm 6 +
+    accumulation 9); z stage 2 contractions, y stage 3, x stage 2, each
+    (2P+1) terms; + per-stage splits/renorms, p-update, Dirichlet/dot,
+    and the XLA-side x/r update + <r,r> (df axpy ~30 + dot ~35)."""
+    nb = 2 * P + 1
+    per_term = 28
+    contractions = (2 + 3 + 2) * nb * per_term
+    stage_overhead = 3 * 10 + 2 * 12  # splits + renorms per stage
+    p_update = 40
+    emit = 6 + 4 + 30  # renorm + blend + compensated dot
+    xla_update = 30 + 30 + 35  # x-axpy, r-axpy, <r,r> df_dot tree
+    return contractions + stage_overhead + p_update + emit + xla_update
+
+
+DF_BYTES_PER_DOF = (
+    # kernel: r,p_prev in + p,y out, hi+lo each = 8 streams
+    8 * 4
+    # XLA update: read x,p,r,y + write x,r (hi+lo) = 12 streams; <r,r>
+    # tree re-reads ~2 more effective
+    + 14 * 4
+)
+
+
+def main() -> int:
+    ndofs = int(sys.argv[1]) if len(sys.argv) > 1 else 12_500_000
+    out = {"ndofs": ndofs, "degree": 3}
+    out["hbm_gbps"] = round(measure_hbm_gbps(), 1)
+    out["vpu_f32_gflops"] = round(measure_vpu_gflops(), 1)
+    fpd = df_flops_per_dof(3)
+    out["df_flops_per_dof"] = fpd
+    out["df_bytes_per_dof"] = DF_BYTES_PER_DOF
+    out["ceiling_compute_gdofs"] = round(out["vpu_f32_gflops"] / fpd, 3)
+    out["ceiling_bandwidth_gdofs"] = round(
+        out["hbm_gbps"] / DF_BYTES_PER_DOF, 3)
+    out["ceiling_gdofs"] = min(out["ceiling_compute_gdofs"],
+                               out["ceiling_bandwidth_gdofs"])
+
+    from bench_tpu_fem.bench.driver import BenchConfig, run_benchmark
+
+    res = run_benchmark(BenchConfig(
+        ndofs_global=ndofs, degree=3, qmode=1, float_bits=64,
+        nreps=100, use_cg=True, f64_impl="df32",
+    ))
+    out["measured_df32_gdofs"] = round(res.gdof_per_second, 3)
+    out["engine"] = res.extra.get("cg_engine")
+    out["fraction_of_ceiling"] = round(
+        res.gdof_per_second / out["ceiling_gdofs"], 3)
+    out["vs_f64_baseline_4.02"] = round(res.gdof_per_second / 4.02, 3)
+    # f32 engine comparison point (same size) for the balance argument
+    res32 = run_benchmark(BenchConfig(
+        ndofs_global=ndofs, degree=3, qmode=1, float_bits=32,
+        nreps=200, use_cg=True,
+    ))
+    out["f32_engine_gdofs"] = round(res32.gdof_per_second, 3)
+
+    path = os.path.join(ROOT, "ROOFLINE_DF_r05.json")
+    with open(path, "w") as fh:
+        json.dump(out, fh, indent=1)
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
