@@ -105,8 +105,10 @@ def _mesh_setup(cfg: BenchConfig, n: tuple[int, int, int] | None = None):
     if n is None:
         n = compute_mesh_size(cfg.ndofs_global, cfg.degree)
     rule = "gauss" if cfg.use_gauss else "gll"
-    t = build_operator_tables(cfg.degree, cfg.qmode, rule)
-    mesh = create_box_mesh(n, geom_perturb_fact=cfg.geom_perturb_fact)
+    with Timer("% Element tables (quadrature+basis)"):
+        t = build_operator_tables(cfg.degree, cfg.qmode, rule)
+    with Timer("% Build box mesh"):
+        mesh = create_box_mesh(n, geom_perturb_fact=cfg.geom_perturb_fact)
     return n, rule, t, mesh
 
 
@@ -267,36 +269,54 @@ def _run_benchmark_df64(cfg: BenchConfig) -> BenchmarkResults:
 
         form, kib = engine_plan_df(dof_grid_shape(n, cfg.degree),
                                    cfg.degree)
-        engine = jax.default_backend() == "tpu" and form == "one"
+        engine = jax.default_backend() == "tpu"
         compile_opts = scoped_vmem_options(kib) if engine else None
         res.extra["cg_engine"] = engine
+        if engine:
+            res.extra["cg_engine_form"] = form
 
         def _lower(f):
             return jax.jit(f).lower(op, u)
 
-        try:
+        def _fused(force_chunked=False):
             if cfg.use_cg:
-                fn = compile_lowered(_lower(
-                    (lambda A, b: kron_cg_df_solve(A, b, cfg.nreps))
-                    if engine else
-                    (lambda A, b: cg_solve_df(A, b, cfg.nreps))
-                ), compile_opts)
-            else:
-                fn = compile_lowered(_lower(
-                    (lambda A, b: action_ring_df(A, b, cfg.nreps))
-                    if engine else
-                    (lambda A, b: action_df(A, b, cfg.nreps))
-                ), compile_opts)
+                return lambda A, b: kron_cg_df_solve(
+                    A, b, cfg.nreps, force_chunked=force_chunked)
+            return lambda A, b: action_ring_df(
+                A, b, cfg.nreps, force_chunked=force_chunked)
+
+        def _unfused():
+            if cfg.use_cg:
+                return lambda A, b: cg_solve_df(A, b, cfg.nreps)
+            return lambda A, b: action_df(A, b, cfg.nreps)
+
+        try:
+            fn = compile_lowered(
+                _lower(_fused() if engine else _unfused()), compile_opts)
         except Exception as exc:
             if not engine:
                 raise
-            engine = False
-            res.extra["cg_engine"] = False
-            res.extra["cg_engine_error"] = exc_str(exc)
-            fn = compile_lowered(_lower(
-                (lambda A, b: cg_solve_df(A, b, cfg.nreps)) if cfg.use_cg
-                else (lambda A, b: action_df(A, b, cfg.nreps))
-            ))
+            # Mosaic rejection of the fused df engine: retry the chunked
+            # form when the first pick was one-kernel (same policy as the
+            # f32 engine), then fall back to the unfused path, recording
+            # why. Compile errors only — execution errors propagate.
+            fn = None
+            if form == "one":
+                try:
+                    fn = compile_lowered(
+                        _lower(_fused(force_chunked=True)))
+                    res.extra["cg_engine_form"] = "chunked-retry"
+                    res.extra["cg_engine_one_kernel_error"] = exc_str(exc)
+                except Exception as exc2:
+                    res.extra["cg_engine_retry_error"] = exc_str(exc2)
+            if fn is None:
+                engine = False
+                res.extra["cg_engine"] = False
+                res.extra["cg_engine_error"] = exc_str(exc)
+                # the recorded form never ran — don't attribute unfused
+                # timings to it
+                res.extra.pop("cg_engine_form", None)
+                fn = compile_lowered(_lower(_unfused()))
         warm = fn(op, u)
         float(warm.hi[(0,) * warm.hi.ndim])
         del warm
@@ -329,8 +349,9 @@ def _run_benchmark_df64(cfg: BenchConfig) -> BenchmarkResults:
         l2 = float(np.sqrt(max(float(df_to_f64(dot_fn(v, v))), 0.0)))
         return l2, float(linf_fn(v))
 
-    res.unorm, res.unorm_linf = norms(u)
-    res.ynorm, res.ynorm_linf = norms(y)
+    with Timer("% Norms (device reduce)"):
+        res.unorm, res.unorm_linf = norms(u)
+        res.ynorm, res.ynorm_linf = norms(y)
     res.gdof_per_second = ndofs_global * cfg.nreps / (
         1e9 * res.mat_free_time
     )

@@ -308,10 +308,12 @@ def make_kron_sharded_fns(op: DistKronLaplacian, dgrid, nreps: int,
     The operator rides along as a replicated pytree argument.
 
     `engine=None` (auto) routes CG through the distributed fused delay-ring
-    engine (dist.kron_cg) when the Pallas impl is active, the device mesh
-    is x-only and the ring fits VMEM — the ~2x-fewer-streams iteration
-    measured on the single-chip engine; the unfused 3-stage path (with its
-    collective-independent main kernel) serves everything else."""
+    engine (dist.kron_cg) when the Pallas impl is active and the ring fits
+    VMEM — the ~2x-fewer-streams iteration measured on the single-chip
+    engine. x-only meshes use the plane-halo kernel form; 3D meshes the
+    ext2d form (cross-sections halo-extended too). The unfused 3-stage
+    path (with its collective-independent main kernel) serves everything
+    else."""
     from jax.sharding import PartitionSpec as P
 
     from ..la.cg import cg_solve
@@ -325,13 +327,6 @@ def make_kron_sharded_fns(op: DistKronLaplacian, dgrid, nreps: int,
     vma = op.resolve_impl() != "pallas"
     if engine is None:
         engine = resolve_kron_engine(op)
-    elif engine and not (op.dshape[1] == 1 and op.dshape[2] == 1):
-        # the delay-ring engine's halo extension is x-only; an explicit
-        # override on another mesh would silently drop y/z seam data
-        raise ValueError(
-            f"the fused dist engine needs an x-only device mesh, "
-            f"got dshape {op.dshape}"
-        )
 
     def _local(a):
         return a[0, 0, 0]

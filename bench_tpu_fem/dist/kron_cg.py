@@ -75,17 +75,23 @@ from .mesh import AXIS_NAMES
 def dist_kron_engine_plan(
     op: DistKronLaplacian,
 ) -> tuple[bool, int | None]:
-    """(supported, scoped_vmem_kib): x-only device meshes, f32, and the
-    one-kernel ring within any one-kernel tier of the single-chip
-    engine_plan (including the raised-limit tiers) —
-    the ring's VMEM is set by the unsharded (NY, NZ) cross-section, so
-    the same plan applies per shard; the kib request forwards through
-    the dist driver's compile exactly like the single-chip one."""
-    Lx, NY, NZ = op.L[0], op.notbc1d[1].shape[0], op.notbc1d[2].shape[0]
-    if not (op.dshape[1] == 1 and op.dshape[2] == 1
-            and op.kappa.dtype == jnp.float32):
+    """(supported, scoped_vmem_kib): f32, and the one-kernel ring within
+    any one-kernel tier of the single-chip engine_plan (including the
+    raised-limit tiers). On x-only meshes the ring's VMEM is set by the
+    unsharded (NY, NZ) cross-section; on 3D meshes by the halo-extended
+    local cross-section (the ext2d kernel's ephemeral contraction
+    operands are (Ly+2P, Lz+2P)) — the same tier plan applies per shard,
+    and the kib request forwards through the dist driver's compile
+    exactly like the single-chip one."""
+    if op.kappa.dtype != jnp.float32:
         return False, None
-    form, kib = engine_plan((Lx, NY, NZ), op.degree)
+    P = op.degree
+    Lx = op.L[0]
+    if op.dshape[1] == 1 and op.dshape[2] == 1:
+        cross = (op.notbc1d[1].shape[0], op.notbc1d[2].shape[0])
+    else:
+        cross = (op.L[1] + 2 * P, op.L[2] + 2 * P)
+    form, kib = engine_plan((Lx, *cross), P)
     return form == "one", kib
 
 
@@ -126,6 +132,67 @@ def _extend_rp(r, p_prev, P: int):
     return r_ext, p_ext
 
 
+def _extend_all_axes(arrs, P: int, dshape):
+    """Halo-extend the stacked arrays by P planes per side along every
+    sharded axis, sequentially z -> y -> x so later exchanges carry the
+    earlier extensions (corner/edge halo data arrives already extended —
+    the standard sequential-corner construction). Unsharded axes are
+    zero-extended locally (no collective): the zero fringe meets the
+    zero-padded coefficient slices exactly like a global domain edge."""
+    s = jnp.stack(arrs)  # grid axes shift by 1 in the stacked view
+    for ax in (2, 1, 0):
+        sax = ax + 1
+        if dshape[ax] > 1:
+            hl, hr = halo_slabs(s, sax, AXIS_NAMES[ax], P)
+        else:
+            shp = list(s.shape)
+            shp[sax] = P
+            hl = hr = jnp.zeros(shp, s.dtype)
+        s = jnp.concatenate([hl, s, hr], axis=sax)
+    return tuple(s[i] for i in range(len(arrs)))
+
+
+def _shard_tables_3d(op: DistKronLaplacian, dtype):
+    """Per-shard tables for the ext2d kernel form (3D-sharded meshes):
+    the x-coefficient/aux rows of _shard_tables, plus the halo-extended
+    y/z banded coefficient slices (global-indexed, zero outside the
+    domain), the cross-section Dirichlet-interior mask, and the
+    cross-section dot-ownership weights (0 on duplicated seam rows/cols
+    so reductions count every dof once globally)."""
+    P = op.degree
+    nb = 2 * P + 1
+    cx_local, aux_local = _shard_tables(op, dtype)
+
+    def ext_coeff(global_diags, axis_i):
+        La = op.L[axis_i]
+        a0 = lax.axis_index(AXIS_NAMES[axis_i]) * (La - 1)
+        padded = jnp.pad(global_diags.astype(dtype), ((0, 0), (P, P)))
+        z0 = jnp.zeros((), dtype=a0.dtype)
+        # padded index a0 == global index a0 - P: the extended slice
+        # starts P rows/cols before the local block
+        return lax.dynamic_slice(padded, (z0, a0), (nb, La + 2 * P))
+
+    ckz = ext_coeff(op.Kd[2], 2)
+    cmz = ext_coeff(op.Md[2], 2)
+    cky = ext_coeff(op.Kd[1], 1)
+    cmy = ext_coeff(op.Md[1], 1)
+
+    def local_1d(vec, axis_i):
+        La = op.L[axis_i]
+        a0 = lax.axis_index(AXIS_NAMES[axis_i]) * (La - 1)
+        return lax.dynamic_slice(vec.astype(dtype), (a0,), (La,)), a0
+
+    nby, y0 = local_1d(op.notbc1d[1], 1)
+    nbz, z0 = local_1d(op.notbc1d[2], 2)
+    mask2d = nby[:, None] * nbz[None, :]
+    wy = jnp.where(jnp.logical_and(jnp.arange(op.L[1]) == 0, y0 > 0),
+                   jnp.zeros((), dtype), jnp.ones((), dtype))
+    wz = jnp.where(jnp.logical_and(jnp.arange(op.L[2]) == 0, z0 > 0),
+                   jnp.zeros((), dtype), jnp.ones((), dtype))
+    w2d = wy[:, None] * wz[None, :]
+    return cx_local, aux_local, (ckz, cmz, cky, cmy), mask2d, w2d
+
+
 def _dist_kron_cg_call(op, cx_local, aux_local, update_p: bool, interpret,
                        *vectors):
     """Per-shard engine call: the shared ops.kron_cg kernel in halo form."""
@@ -133,39 +200,70 @@ def _dist_kron_cg_call(op, cx_local, aux_local, update_p: bool, interpret,
                          cx=cx_local, aux=aux_local)
 
 
+def _is_x_only(op: DistKronLaplacian) -> bool:
+    return op.dshape[1] == 1 and op.dshape[2] == 1
+
+
 def dist_kron_cg_solve_local(op: DistKronLaplacian, b, nreps: int,
                              interpret: bool | None = None):
-    """Per-shard fused-engine CG (call inside shard_map over an x-only
-    device mesh): returns the local solution block. Matches the unfused
-    dist path (dist.kron.make_kron_sharded_fns cg_fn) to f32 reassociation
-    accuracy, at ~half the HBM streams per iteration."""
+    """Per-shard fused-engine CG (call inside shard_map): returns the
+    local solution block. Matches the unfused dist path
+    (dist.kron.make_kron_sharded_fns cg_fn) to f32 reassociation
+    accuracy, at ~half the HBM streams per iteration. x-only meshes use
+    the plane-halo kernel form; 3D meshes the ext2d form (cross-sections
+    halo-extended too, seam dedup via in-kernel weight planes)."""
     dtype = b.dtype
-    cx_local, aux_local = _shard_tables(op, dtype)
     P = op.degree
-    # owned-dof weight per plane for the masked psum inner products (the
-    # same ownership the kernel's aux column 1 applies to <p, A p>)
-    wplane = aux_local[:, 0, 1][:, None, None]
+    if _is_x_only(op):
+        cx_local, aux_local = _shard_tables(op, dtype)
+        coeffs = mask2d = w2d = None
+        w3 = aux_local[:, 0, 1][:, None, None]
 
+        def engine(r, p_prev, beta):
+            r_ext, p_ext = _extend_rp(r, p_prev, P)
+            p, y, pdot = _dist_kron_cg_call(
+                op, cx_local, aux_local, True, interpret, r_ext, p_ext,
+                beta
+            )
+            return p, y, psum_all(pdot)
+    else:
+        cx_local, aux_local, coeffs, mask2d, w2d = _shard_tables_3d(
+            op, dtype)
+        w3 = aux_local[:, 0, 1][:, None, None] * w2d[None]
+
+        def engine(r, p_prev, beta):
+            r_ext, p_ext = _extend_all_axes((r, p_prev), P, op.dshape)
+            p, y, pdot = _kron_cg_call(
+                op, True, interpret, r_ext, p_ext, beta,
+                cx=cx_local, aux=aux_local, coeffs=coeffs,
+                mask2d=mask2d, w2d=w2d,
+            )
+            return p, y, psum_all(pdot)
+
+    # owned-dof weight for the masked psum inner products (the same
+    # ownership the kernel's dot weighting applies to <p, A p>)
     def inner(u, v):
-        return psum_all(jnp.sum(u * v * wplane))
-
-    def engine(r, p_prev, beta):
-        r_ext, p_ext = _extend_rp(r, p_prev, P)
-        p, y, pdot = _dist_kron_cg_call(
-            op, cx_local, aux_local, True, interpret, r_ext, p_ext, beta
-        )
-        return p, y, psum_all(pdot)
+        return psum_all(jnp.sum(u * v * w3))
 
     update = None
     if b.size >= PALLAS_UPDATE_MIN_DOFS:
         # Chunked pallas x/r update (single-chip rationale at
         # ops.kron_cg.PALLAS_UPDATE_MIN_DOFS: XLA TPU fails whole-vector
-        # fusions ~130M dofs). Its <r1,r1> counts every local plane; the
-        # duplicated seam plane is subtracted before the psum.
+        # fusions ~130M dofs). Its <r1,r1> counts every local dof; the
+        # duplicated seam contribution is subtracted before the psum —
+        # one O(cross-section) plane read on x-only meshes (a full-array
+        # re-read would add a whole HBM stream per iteration on exactly
+        # the path built to minimise streams); ext2d seams need the
+        # full weighted correction.
+        x_only = _is_x_only(op)
+
         def update(x, pv, r, y, alpha):
             x1, r1, rr = cg_update_pallas(x, pv, r, y, alpha, interpret)
-            seam0 = jnp.sum(r1[0] * r1[0]) * (1.0 - wplane[0, 0, 0])
-            return x1, r1, psum_all(rr - seam0)
+            if x_only:
+                seam = jnp.sum(r1[0] * r1[0]) * (1.0 - w3[0, 0, 0])
+            else:
+                seam = jnp.sum(r1 * r1 * (1.0 - w3))
+            return x1, r1, psum_all(rr - seam)
 
     return fused_cg_solve(engine, b, nreps, update=update, inner=inner)
 
@@ -175,10 +273,19 @@ def dist_kron_apply_ring_local(op: DistKronLaplacian, x,
     """Per-shard single delay-ring apply y = A x (inside shard_map),
     discarding the fused dot partial — the distributed action-benchmark
     analogue of ops.kron_cg.kron_apply_ring."""
-    cx_local, aux_local = _shard_tables(op, x.dtype)
-    hl, hr = halo_slabs(x, 0, AXIS_NAMES[0], op.degree)
-    x_ext = jnp.concatenate([hl, x, hr], axis=0)
-    y, _ = _dist_kron_cg_call(
-        op, cx_local, aux_local, False, interpret, x_ext
+    P = op.degree
+    if _is_x_only(op):
+        cx_local, aux_local = _shard_tables(op, x.dtype)
+        hl, hr = halo_slabs(x, 0, AXIS_NAMES[0], P)
+        x_ext = jnp.concatenate([hl, x, hr], axis=0)
+        y, _ = _dist_kron_cg_call(
+            op, cx_local, aux_local, False, interpret, x_ext
+        )
+        return y
+    cx_local, aux_local, coeffs, mask2d, w2d = _shard_tables_3d(op, x.dtype)
+    (x_ext,) = _extend_all_axes((x,), P, op.dshape)
+    y, _ = _kron_cg_call(
+        op, False, interpret, x_ext,
+        cx=cx_local, aux=aux_local, coeffs=coeffs, mask2d=mask2d, w2d=w2d,
     )
     return y

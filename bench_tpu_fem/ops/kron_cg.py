@@ -158,14 +158,18 @@ def _zy_contract(p2, ckz, cmz, cky, cmy, P: int, NY: int, NZ: int):
 
 
 def _x_emit_blend(ring_t12, ring_tyz, cx_ref, i, p_i, gy, gz, P: int,
-                  KI: int, NX: int, NY: int, NZ: int, mi=None):
+                  KI: int, NX: int, NY: int, NZ: int, mi=None,
+                  inter2d=None):
     """Banded x contraction from the delay ring + closed-form Dirichlet
     blend: shared by both engine forms and the distributed engine (gy/gz
     carry the caller's global row/lane indices; virtual-pad rows arrive
     with p_i = 0 and inter = False, so they emit 0). cx_ref row:
     [M-coeffs | K-coeffs], kappa folded in. `mi` overrides the
     interior-in-x indicator when the caller's plane index `i` is not the
-    global plane index (the distributed engine streams it per plane)."""
+    global plane index (the distributed engine streams it per plane);
+    `inter2d` overrides the closed-form y/z interior test when local
+    row/col indices are not global (the 3D-sharded engine streams the
+    cross-section interior mask as a plane)."""
     acc = None
     for d in range(2 * P + 1):
         # source plane i + d - P; + 2*KI keeps lax.rem's argument
@@ -178,20 +182,20 @@ def _x_emit_blend(ring_t12, ring_tyz, cx_ref, i, p_i, gy, gz, P: int,
     # planes of the structured dof grid, per axis.
     if mi is None:
         mi = jnp.logical_and(i > 0, i < np.int32(NX - 1))
-    inter = jnp.logical_and(
-        mi,
-        jnp.logical_and(
+    if inter2d is None:
+        inter2d = jnp.logical_and(
             jnp.logical_and(gy > 0, gy < np.int32(NY - 1)),
             jnp.logical_and(gz > 0, gz < np.int32(NZ - 1)),
-        ),
-    )
+        )
+    inter = jnp.logical_and(mi, inter2d)
     # raw lax.select (not jnp.where): jnp wrappers trace to closed_call,
     # which the Mosaic kernel-lowering path rejects
     return jax.lax.select(inter, acc, p_i)
 
 
 def _make_kron_cg_kernel(P: int, NX: int, NY: int, NZ: int, KI: int,
-                         update_p: bool, halo: int = 0):
+                         update_p: bool, halo: int = 0,
+                         ext2d: bool = False):
     """One-kernel delay-ring CG iteration. `halo = 0` is the single-chip
     form over the full NX-plane grid. `halo = P` is the distributed form
     (dist.kron_cg): NX is the shard's local plane count, the input slab is
@@ -203,10 +207,22 @@ def _make_kron_cg_kernel(P: int, NX: int, NY: int, NZ: int, KI: int,
     emit lag is fully absorbed by the trailing halo planes (extra steps
     would clamp-revisit the final output block and overwrite it with
     halo-plane garbage), so the grid is exactly NX + 2*halo steps when
-    halo > 0 and NX + P when halo == 0."""
+    halo > 0 and NX + P when halo == 0.
+
+    `ext2d` (3D-sharded meshes, with halo = P): the input planes are
+    halo-extended in y/z as well ((NY+2P, NZ+2P), where NY/NZ are the
+    LOCAL cross-section); the z/y contractions run on the extended
+    cross-section with per-shard global-indexed coefficient slices —
+    exact on the local window, garbage in the (unconsumed) halo fringe —
+    and the local (NY, NZ) window is sliced before the rings. The
+    Dirichlet interior test and the dot ownership weights come from two
+    streamed (NY, NZ) mask planes (mask2d, w2d): the closed-form iota
+    test and the per-plane scalar weight only know global axes."""
     D = P  # output delay in grid steps
     n_in = NX + 2 * halo  # ingest sweep length
     nsteps = n_in if halo else NX + D
+    E = 2 * P if ext2d else 0
+    NYe, NZe = NY + E, NZ + E
 
     def kernel(*refs):
         if update_p:
@@ -217,10 +233,13 @@ def _make_kron_cg_kernel(P: int, NX: int, NY: int, NZ: int, KI: int,
             ni = 1
         ckz_ref, cmz_ref, cky_ref, cmy_ref, cx_ref = refs[ni:ni + 5]
         ni += 5
-        aux_ref = None
+        aux_ref = mask2d_ref = w2d_ref = None
         if halo:
             aux_ref = refs[ni]
             ni += 1
+            if ext2d:
+                mask2d_ref, w2d_ref = refs[ni:ni + 2]
+                ni += 2
         scal_ref = refs[ni]
         base = ni + 1
         if update_p:
@@ -249,7 +268,8 @@ def _make_kron_cg_kernel(P: int, NX: int, NY: int, NZ: int, KI: int,
         @pl.when(t < np.int32(n_in))
         def _ingest():
             if update_p:
-                p2 = scal_ref[0, 0] * pprev_ref[0] + r_ref[0]
+                p2f = scal_ref[0, 0] * pprev_ref[0] + r_ref[0]
+                p2 = p2f[P:P + NY, P:P + NZ] if ext2d else p2f
                 if halo:
                     # p is owned for the NX local planes only; the halo
                     # planes feed the rings but are the neighbours' to
@@ -261,11 +281,18 @@ def _make_kron_cg_kernel(P: int, NX: int, NY: int, NZ: int, KI: int,
                 else:
                     p_out_ref[0] = p2
             else:
-                p2 = x_ref[0]
+                p2f = x_ref[0]
+                p2 = p2f[P:P + NY, P:P + NZ] if ext2d else p2f
             slot = jax.lax.rem(t, np.int32(KI))
             t12, tyz = _zy_contract(
-                p2, ckz_ref, cmz_ref, cky_ref, cmy_ref, P, NY, NZ
+                p2f, ckz_ref, cmz_ref, cky_ref, cmy_ref, P, NYe, NZe
             )
+            if ext2d:
+                # exact on the local window (the per-shard coefficient
+                # slices are global-indexed there); the halo fringe rows/
+                # cols are garbage and sliced away before the rings
+                t12 = t12[P:P + NY, P:P + NZ]
+                tyz = tyz[P:P + NY, P:P + NZ]
             # p is read back exactly once, at emit lag D = P, so its ring
             # needs only P + 1 slots (the t12/tyz rings need the full
             # 2P + 1 x-window, hence KI = 2P + 2 with the write slot)
@@ -281,13 +308,19 @@ def _make_kron_cg_kernel(P: int, NX: int, NY: int, NZ: int, KI: int,
             gy = jax.lax.broadcasted_iota(jnp.int32, (NY, NZ), 0)
             gz = jax.lax.broadcasted_iota(jnp.int32, (NY, NZ), 1)
             mi = aux_ref[0, 0, 0] > 0.5 if halo else None
+            inter2d = mask2d_ref[...] > 0.5 if ext2d else None
             y2 = _x_emit_blend(ring_t12, ring_tyz, cx_ref, i, p_i, gy, gz,
-                               P, KI, NX, NY, NZ, mi=mi)
+                               P, KI, NX, NY, NZ, mi=mi, inter2d=inter2d)
             y_out_ref[0] = y2
             # aux col 1 (dist form): dot-ownership weight, 0 on duplicated
-            # seam planes so <p, A p> counts every dof once globally.
+            # seam planes so <p, A p> counts every dof once globally. In
+            # the ext2d form the cross-section seams are deduplicated by
+            # the w2d weight plane as well.
             w = aux_ref[0, 0, 1] if halo else None
-            term = jnp.sum(p_i * y2)
+            prod = p_i * y2
+            if ext2d:
+                prod = prod * w2d_ref[...]
+            term = jnp.sum(prod)
             # rank-2 (1,1) stores: Mosaic rejects scalar stores to VMEM
             dacc[...] = dacc[...] + (w * term if halo else term)
 
@@ -610,16 +643,25 @@ def engine_form(grid_shape: tuple[int, int, int], degree: int) -> str:
 
 
 def _kron_cg_call(op, update_p: bool, interpret, *vectors,
-                  cx=None, aux=None, force_chunked: bool = False):
+                  cx=None, aux=None, force_chunked: bool = False,
+                  coeffs=None, mask2d=None, w2d=None):
     """update_p: vectors = (r, p_prev, beta) -> (p, y, <p, A p>).
     else:       vectors = (x,)              -> (y, <x, A x>).
 
     With `cx`/`aux` given (the distributed form, dist.kron_cg), vectors
     are halo-extended (NX + 2P, NY, NZ) local slabs, `cx` carries the
     per-shard x-coefficient rows, `aux` the per-plane
-    [interior-in-x, dot-ownership] pairs; outputs stay (NX, NY, NZ)."""
+    [interior-in-x, dot-ownership] pairs; outputs stay (NX, NY, NZ).
+
+    With `mask2d`/`w2d`/`coeffs` also given (the 3D-sharded form),
+    vectors are halo-extended in every axis ((NX+2P, NY+2P, NZ+2P)
+    local slabs), `coeffs` carries the per-shard extended (ckz, cmz,
+    cky, cmy) banded slices, `mask2d` the (NY, NZ) cross-section
+    Dirichlet-interior mask and `w2d` the cross-section dot-ownership
+    weights; outputs stay (NX, NY, NZ)."""
     P = op.degree
     halo = 0 if cx is None else P
+    ext2d = mask2d is not None
     if halo == 0:
         NX, NY, NZ = (int(a.shape[0]) for a in op.notbc1d)
         if force_chunked or engine_form((NX, NY, NZ), P) == "chunked":
@@ -627,8 +669,12 @@ def _kron_cg_call(op, update_p: bool, interpret, *vectors,
     else:
         # distributed form (dist.kron_cg): vectors are halo-extended local
         # slabs; the caller gates VMEM and provides per-shard cx/aux rows.
-        NXe, NY, NZ = (int(d) for d in vectors[0].shape)
+        NXe, NYe_in, NZe_in = (int(d) for d in vectors[0].shape)
         NX = NXe - 2 * P
+        E = 2 * P if ext2d else 0
+        NY, NZ = NYe_in - E, NZe_in - E
+    E = 2 * P if ext2d else 0
+    NYe, NZe = NY + E, NZ + E
     KI = 2 * P + 2
     D = P
     n_in = NX + 2 * halo
@@ -654,19 +700,20 @@ def _kron_cg_call(op, update_p: bool, interpret, *vectors,
     if update_p:
         r, p_prev, beta = vectors
         in_specs += [
-            pl.BlockSpec((1, NY, NZ), clamp_in, memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, NY, NZ), clamp_in, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, NYe, NZe), clamp_in, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, NYe, NZe), clamp_in, memory_space=pltpu.VMEM),
         ]
         operands += [r, p_prev]
     else:
         (x,) = vectors
         beta = jnp.zeros((), dtype)
         in_specs.append(
-            pl.BlockSpec((1, NY, NZ), clamp_in, memory_space=pltpu.VMEM)
+            pl.BlockSpec((1, NYe, NZe), clamp_in, memory_space=pltpu.VMEM)
         )
         operands.append(x)
-    for coeff, n_ax in ((op.Kd[2], NZ), (op.Md[2], NZ),
-                        (op.Kd[1], NY), (op.Md[1], NY)):
+    coeff_ops = (coeffs if ext2d else
+                 (op.Kd[2], op.Md[2], op.Kd[1], op.Md[1]))
+    for coeff, n_ax in zip(coeff_ops, (NZe, NZe, NYe, NYe)):
         in_specs.append(pl.BlockSpec((nb, n_ax), lambda t: (0, 0),
                                      memory_space=pltpu.VMEM))
         operands.append(coeff.astype(dtype))
@@ -677,6 +724,12 @@ def _kron_cg_call(op, update_p: bool, interpret, *vectors,
         in_specs.append(pl.BlockSpec((1, 1, 2), clamp_out,
                                      memory_space=pltpu.SMEM))
         operands.append(aux)
+        if ext2d:
+            for plane in (mask2d, w2d):
+                in_specs.append(pl.BlockSpec(
+                    (NY, NZ), lambda t: (0, 0),
+                    memory_space=pltpu.VMEM))
+                operands.append(plane.astype(dtype))
     in_specs.append(pl.BlockSpec((1, 1), lambda t: (0, 0),
                                  memory_space=pltpu.SMEM))
     operands.append(beta.astype(dtype).reshape(1, 1))
@@ -694,7 +747,8 @@ def _kron_cg_call(op, update_p: bool, interpret, *vectors,
                                   memory_space=pltpu.VMEM))
     out_shapes.append(jax.ShapeDtypeStruct((1, 1), dtype))
 
-    kernel = _make_kron_cg_kernel(P, NX, NY, NZ, KI, update_p, halo=halo)
+    kernel = _make_kron_cg_kernel(P, NX, NY, NZ, KI, update_p, halo=halo,
+                                  ext2d=ext2d)
     out = pl.pallas_call(
         kernel,
         grid=(nsteps,),

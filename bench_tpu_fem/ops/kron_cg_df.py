@@ -92,9 +92,8 @@ def engine_plan_df(grid_shape: tuple[int, int, int],
                    degree: int) -> tuple[str, int | None]:
     """(form, scoped_vmem_kib) for the df engine, reusing the f32
     engine's hardware-checked scoped-VMEM tier ladder (ops.kron_cg):
-    'one' within the one-kernel tiers, else 'unfused' — the df chunked
-    form does not exist yet, so past the tier-3 ceiling the driver keeps
-    the unfused ops.kron_df path and records why."""
+    'one' within the one-kernel tiers, else 'chunked' (the y-chunked
+    two-kernel form — every VMEM object O(CY * NZ), no size ceiling)."""
     from .kron_cg import (
         ONE_KERNEL_SCOPED_KIB,
         ONE_KERNEL_SCOPED_KIB2,
@@ -110,7 +109,7 @@ def engine_plan_df(grid_shape: tuple[int, int, int],
         return "one", ONE_KERNEL_SCOPED_KIB
     if v <= ONE_KERNEL_SCOPED_MAX2:
         return "one", ONE_KERNEL_SCOPED_KIB2
-    return "unfused", None
+    return "chunked", None
 
 
 # ---------------------------------------------------------------------------
@@ -179,26 +178,20 @@ def _z_contract_df(hi, lo, cK, cM, P: int, NZ: int):
     return _renorm2(*accK), _renorm2(*accM)
 
 
-def _y_contract_df(aK, aM, cKy, cMy, P: int, NY: int):
-    """Banded y (sublane-shift) contractions: t12 = M_y aK + K_y aM
-    accumulated in ONE compensated pair, tyz = M_y aM. Inputs are
-    renormalised (hi, lo) pairs; their splits are computed once here."""
-    aKh, aKl = aK
-    aMh, aMl = aM
-    aKhh, aKhl = _split(aKh)
-    aMhh, aMhl = _split(aMh)
-
-    def pad(a):
-        return jnp.pad(a, ((P, P), (0, 0)))
-
-    ops_k = [pad(a) for a in (aKh, aKl, aKhh, aKhl)]
-    ops_m = [pad(a) for a in (aMh, aMl, aMhh, aMhl)]
+def _y_window_contract_df(ops_k, ops_m, cK_rows, cM_rows, nb: int,
+                          rows: int, offset: int = 0):
+    """Windowed banded y (sublane-shift) contraction core on 4-channel
+    pre-extended operands (rows [offset - P, offset + rows + P) relative
+    to the output): t12 = M_y aK + K_y aM in ONE compensated pair,
+    tyz = M_y aM. `cK_rows`/`cM_rows` are per-output-row coefficient
+    channels as callables ch, d -> (rows,) column vectors. Shared by the
+    one-kernel (full plane) and chunked forms."""
     acc12 = accyz = None
-    for d in range(2 * P + 1):
-        sK = [a[d:d + NY, :] for a in ops_k]
-        sM = [a[d:d + NY, :] for a in ops_m]
-        cm = [cMy[ch, d][:, None] for ch in range(4)]
-        ck = [cKy[ch, d][:, None] for ch in range(4)]
+    for d in range(nb):
+        sK = [a[offset + d:offset + d + rows, :] for a in ops_k]
+        sM = [a[offset + d:offset + d + rows, :] for a in ops_m]
+        cm = [cM_rows(ch, d)[:, None] for ch in range(4)]
+        ck = [cK_rows(ch, d)[:, None] for ch in range(4)]
         # t12 += M_y[d] * aK[shift]
         t, e = _eft_term(*cm, *sK)
         acc12 = _acc2(acc12, t, e)
@@ -209,6 +202,30 @@ def _y_contract_df(aK, aM, cKy, cMy, P: int, NY: int):
         t, e = _eft_term(*cm, *sM)
         accyz = _acc2(accyz, t, e)
     return _renorm2(*acc12), _renorm2(*accyz)
+
+
+def _split4(pair):
+    """(hi, lo) -> 4-channel [hi, lo, split_high(hi), split_low(hi)]."""
+    h, lo = pair
+    hh, hl = _split(h)
+    return [h, lo, hh, hl]
+
+
+def _y_contract_df(aK, aM, cKy, cMy, P: int, NY: int):
+    """Full-plane banded y contractions (one-kernel form): inputs are
+    renormalised (hi, lo) pairs; splits computed once, zero-padded by P
+    rows each side (boundary exactness via the banded zero columns)."""
+
+    def pad(a):
+        return jnp.pad(a, ((P, P), (0, 0)))
+
+    ops_k = [pad(a) for a in _split4(aK)]
+    ops_m = [pad(a) for a in _split4(aM)]
+    return _y_window_contract_df(
+        ops_k, ops_m,
+        lambda ch, d: cKy[ch, d], lambda ch, d: cMy[ch, d],
+        2 * P + 1, NY,
+    )
 
 
 def _plane_dot_df(ph, plo, yh, ylo, NY: int, NZ: int):
@@ -526,6 +543,458 @@ def _kron_cg_df_call(op: KronLaplacianDF, coeffs, update_p: bool,
     return DF(yh, yl), DF(dot[0, 0], dot[0, 1])
 
 
+# ---------------------------------------------------------------------------
+# Two-kernel (y-chunked) form: no VMEM size ceiling. Mirrors the f32
+# chunked form (ops.kron_cg): kernel ZY streams df (t12, tyz) chunk pairs
+# to HBM; kernel X runs the scatter-at-ingest x-band accumulation per
+# y-chunk row. Every VMEM object is O(CY * NZ), so 300M-dof df problems
+# compile where the one-kernel ring cannot fit a scoped-VMEM tier.
+# ---------------------------------------------------------------------------
+
+
+def _pick_cy_df(NY: int, P: int) -> int:
+    from .kron_cg import _pick_cy
+
+    return _pick_cy(NY, P)
+
+
+def _make_zy_chunk_df_kernel(P: int, NX: int, NY: int, NZ: int, CY: int,
+                             NYB: int, update_p: bool):
+    """Chunked form, kernel ZY: grid (NX, NYB+1). Ingest chunk yj of
+    plane xi (df p-update fused, virtual-pad rows masked), z-contract in
+    df, push (value, error) pairs into 3-slot rings; emit chunk yj-1's
+    y-contraction from the ring-concatenated window."""
+    nb = 2 * P + 1
+
+    def kernel(*refs):
+        if update_p:
+            rh_ref, rl_ref, pph_ref, ppl_ref = refs[:4]
+            ni = 4
+        else:
+            xh_ref, xl_ref = refs[:2]
+            ni = 2
+        ckz_ref, cmz_ref, cky_ref, cmy_ref, beta_ref = refs[ni:ni + 5]
+        base = ni + 5
+        if update_p:
+            (ph_out, pl_out, t12h_ref, t12l_ref, tyzh_ref, tyzl_ref) = \
+                refs[base:base + 6]
+            no = 6
+        else:
+            t12h_ref, t12l_ref, tyzh_ref, tyzl_ref = refs[base:base + 4]
+            no = 4
+        (rKp, rKe, rMp, rMe) = refs[base + no:base + no + 4]
+
+        xi = pl.program_id(0)
+        yj = pl.program_id(1)
+
+        @pl.when(jnp.logical_and(xi == 0, yj == 0))
+        def _init():
+            rKp[...] = jnp.zeros_like(rKp)
+            rKe[...] = jnp.zeros_like(rKe)
+            rMp[...] = jnp.zeros_like(rMp)
+            rMe[...] = jnp.zeros_like(rMe)
+
+        @pl.when(yj < np.int32(NYB))
+        def _ingest():
+            if update_p:
+                bh = beta_ref[0, 0]
+                bl = beta_ref[0, 1]
+                bhh = beta_ref[0, 2]
+                bhl = beta_ref[0, 3]
+                pph = pph_ref[0]
+                ppl = ppl_ref[0]
+                ph_h, ph_l = _split(pph)
+                tb = bh * pph
+                eb = (((bhh * ph_h - tb) + (bhh * ph_l + bhl * ph_h))
+                      + bhl * ph_l) + (bh * ppl + bl * pph)
+                tbh, tbl = two_sum(tb, eb)  # renorm-first (_acc2)
+                s, c = two_sum(tbh, rh_ref[0])
+                p2h, p2l = _renorm2(s, (tbl + c) + rl_ref[0])
+            else:
+                p2h = xh_ref[0]
+                p2l = xl_ref[0]
+            # Mask virtual-pad rows of the last chunk: their garbage
+            # would ride the ring into valid output rows as 0 * NaN.
+            gy = (yj * np.int32(CY)
+                  + jax.lax.broadcasted_iota(jnp.int32, (CY, NZ), 0))
+            valid = gy < np.int32(NY)
+            p2h = jax.lax.select(valid, p2h, jnp.zeros_like(p2h))
+            p2l = jax.lax.select(valid, p2l, jnp.zeros_like(p2l))
+            if update_p:
+                ph_out[0] = p2h
+                pl_out[0] = p2l
+            aK, aM = _z_contract_df(p2h, p2l, ckz_ref, cmz_ref, P, NZ)
+            slot = jax.lax.rem(yj, np.int32(3))
+            rKp[slot], rKe[slot] = aK
+            rMp[slot], rMe[slot] = aM
+
+        @pl.when(yj >= 1)
+        def _emit():
+            j = yj - 1
+
+            def rd(ring, d):
+                return ring[jax.lax.rem(j + np.int32(d + 3), np.int32(3))]
+
+            def buf(rp, re):
+                h = jnp.concatenate([rd(rp, -1), rd(rp, 0), rd(rp, 1)],
+                                    axis=0)
+                lo = jnp.concatenate([rd(re, -1), rd(re, 0), rd(re, 1)],
+                                     axis=0)
+                return _split4((h, lo))
+
+            ops_k = buf(rKp, rKe)
+            ops_m = buf(rMp, rMe)
+            # rows [(j-1)CY, (j+2)CY): the chunk's rows start at offset
+            # CY - P relative to its -P halo
+            t12, tyz = _y_window_contract_df(
+                ops_k, ops_m,
+                lambda ch, d: cky_ref[0, ch, d],
+                lambda ch, d: cmy_ref[0, ch, d],
+                nb, CY, offset=CY - P,
+            )
+            t12h_ref[0], t12l_ref[0] = t12
+            tyzh_ref[0], tyzl_ref[0] = tyz
+
+    return kernel
+
+
+def _make_x_chunk_df_kernel(P: int, NX: int, NY: int, NZ: int, CY: int):
+    """Chunked form, kernel X: grid (NYB, NX+P), xi fastest — the
+    scatter-at-ingest x-band accumulation and compensated dot of the
+    one-kernel form, per y-chunk row."""
+    nb = 2 * P + 1
+    KI = nb
+    KP = P + 1
+
+    def kernel(*refs):
+        (t12h_ref, t12l_ref, tyzh_ref, tyzl_ref, ph_ref, pl_ref) = refs[:6]
+        cx_refs = refs[6:6 + nb]
+        yh_out, yl_out, dot_ref = refs[6 + nb:6 + nb + 3]
+        (acc_p, acc_e, ring_ph, ring_pl, dacc_p, dacc_e) = \
+            refs[6 + nb + 3:6 + nb + 9]
+
+        yj = pl.program_id(0)
+        xi = pl.program_id(1)
+
+        @pl.when(xi == 0)
+        def _init():
+            acc_p[...] = jnp.zeros_like(acc_p)
+            acc_e[...] = jnp.zeros_like(acc_e)
+            ring_ph[...] = jnp.zeros_like(ring_ph)
+            ring_pl[...] = jnp.zeros_like(ring_pl)
+            dacc_p[...] = jnp.zeros_like(dacc_p)
+            dacc_e[...] = jnp.zeros_like(dacc_e)
+
+        @pl.when(xi < np.int32(NX))
+        def _ingest():
+            t12h = t12h_ref[0]
+            t12l = t12l_ref[0]
+            tyzh = tyzh_ref[0]
+            tyzl = tyzl_ref[0]
+            t12hh, t12hl = _split(t12h)
+            tyzhh, tyzhl = _split(tyzh)
+            ring_ph[jax.lax.rem(xi, np.int32(KP))] = ph_ref[0]
+            ring_pl[jax.lax.rem(xi, np.int32(KP))] = pl_ref[0]
+            for d in range(-P, P + 1):
+                i_out = xi + np.int32(d)
+
+                @pl.when(jnp.logical_and(i_out >= 0,
+                                         i_out < np.int32(NX)))
+                def _scatter(i_out=i_out, d=d):
+                    cx_ref = cx_refs[d + P]
+                    db = P - d
+                    cm = [cx_ref[0, 0, g * 2 * nb + db] for g in range(4)]
+                    ck = [cx_ref[0, 0, g * 2 * nb + nb + db]
+                          for g in range(4)]
+                    tM, eM = _eft_term(*cm, t12h, t12l, t12hh, t12hl)
+                    tK, eK = _eft_term(*ck, tyzh, tyzl, tyzhh, tyzhl)
+                    tMh, tMl = two_sum(tM, eM)
+                    tKh, tKl = two_sum(tK, eK)
+                    slot = jax.lax.rem(i_out, np.int32(KI))
+                    s1, c1 = two_sum(acc_p[slot], tMh)
+                    s2, c2 = two_sum(s1, tKh)
+                    acc_p[slot] = s2
+                    acc_e[slot] = (acc_e[slot]
+                                   + ((tMl + c1) + (tKl + c2)))
+
+        @pl.when(xi >= np.int32(P))
+        def _emit():
+            i = xi - np.int32(P)
+            slot = jax.lax.rem(i, np.int32(KI))
+            yh, yl = _renorm2(acc_p[slot], acc_e[slot])
+            pslot = jax.lax.rem(i, np.int32(KP))
+            gy = (yj * np.int32(CY)
+                  + jax.lax.broadcasted_iota(jnp.int32, (CY, NZ), 0))
+            gz = jax.lax.broadcasted_iota(jnp.int32, (CY, NZ), 1)
+            # Mask virtual-pad rows of the last chunk out of p: the p
+            # stream's partial edge block reads garbage there (the
+            # action form streams the raw input; the CG form reads back
+            # rows the ZY writeback dropped), and 0 * garbage is NaN.
+            valid = gy < np.int32(NY)
+            p_ih = jax.lax.select(valid, ring_ph[pslot],
+                                  jnp.zeros_like(ring_ph[pslot]))
+            p_il = jax.lax.select(valid, ring_pl[pslot],
+                                  jnp.zeros_like(ring_pl[pslot]))
+            inter = jnp.logical_and(
+                jnp.logical_and(i > 0, i < np.int32(NX - 1)),
+                jnp.logical_and(
+                    jnp.logical_and(gy > 0, gy < np.int32(NY - 1)),
+                    jnp.logical_and(gz > 0, gz < np.int32(NZ - 1)),
+                ),
+            )
+            yh = jax.lax.select(inter, yh, p_ih)
+            yl = jax.lax.select(inter, yl, p_il)
+            yh_out[0] = yh
+            yl_out[0] = yl
+            acc_p[slot] = jnp.zeros_like(yh)
+            acc_e[slot] = jnp.zeros_like(yh)
+            # pad rows also masked out of y for the dot (the acc garbage
+            # rides them; the writeback drops them from the output)
+            ydh = jax.lax.select(valid, yh, jnp.zeros_like(yh))
+            ydl = jax.lax.select(valid, yl, jnp.zeros_like(yl))
+            dp, de = _plane_dot_df(p_ih, p_il, ydh, ydl, CY, NZ)
+            s, c = two_sum(dacc_p[...], dp)
+            dacc_p[...] = s
+            dacc_e[...] = dacc_e[...] + (de + c)
+
+        @pl.when(xi == np.int32(NX + P - 1))
+        def _finish():
+            dh, dl = _renorm2(dacc_p[...], dacc_e[...])
+            dot_ref[...] = jnp.concatenate([dh, dl], axis=1)[None]
+
+    return kernel
+
+
+def _kron_cg_df_call_chunked(op: KronLaplacianDF, coeffs, update_p: bool,
+                             interpret, *vectors):
+    """Two-kernel (y-chunked) form of _kron_cg_df_call — same contract,
+    no VMEM size ceiling (every buffer is one (CY, NZ) chunk pair)."""
+    P = op.degree
+    NX, NY, NZ = _grid_shape(op)
+    nb = 2 * P + 1
+    CY = _pick_cy_df(NY, P)
+    NYB = -(-NY // CY)
+    dtype = jnp.float32
+    interp = _use_interpret() if interpret is None else interpret
+    ckz, cmz, cky, cmy, cx_rows = coeffs
+
+    # chunk-major y coefficients (NYB, 4, nb, CY), zero-padded rows (the
+    # zero columns keep garbage source rows out of valid outputs)
+    pad_y = NYB * CY - NY
+
+    def chunk_major(c4):
+        c = jnp.pad(c4, ((0, 0), (0, 0), (0, pad_y)))
+        return c.reshape(4, nb, NYB, CY).transpose(2, 0, 1, 3)
+
+    cky_c = chunk_major(cky)
+    cmy_c = chunk_major(cmy)
+
+    def in_map(xi, yj):
+        return (xi, jax.lax.min(yj, np.int32(NYB - 1)), 0)
+
+    def out_map_emit(xi, yj):
+        return (xi, jax.lax.max(yj - 1, np.int32(0)), 0)
+
+    in_specs = []
+    operands = []
+    if update_p:
+        r, p_prev, beta4 = vectors
+        in_specs += [pl.BlockSpec((1, CY, NZ), in_map,
+                                  memory_space=pltpu.VMEM)] * 4
+        operands += [r.hi, r.lo, p_prev.hi, p_prev.lo]
+    else:
+        (x,) = vectors
+        beta4 = jnp.zeros((1, 4), dtype)
+        in_specs += [pl.BlockSpec((1, CY, NZ), in_map,
+                                  memory_space=pltpu.VMEM)] * 2
+        operands += [x.hi, x.lo]
+    for c in (ckz, cmz):
+        in_specs.append(pl.BlockSpec((4, nb, NZ), lambda xi, yj: (0, 0, 0),
+                                     memory_space=pltpu.VMEM))
+        operands.append(c)
+    for c in (cky_c, cmy_c):
+        in_specs.append(pl.BlockSpec(
+            (1, 4, nb, CY),
+            lambda xi, yj: (jax.lax.max(yj - 1, np.int32(0)), 0, 0, 0),
+            memory_space=pltpu.VMEM,
+        ))
+        operands.append(c)
+    in_specs.append(pl.BlockSpec((1, 4), lambda xi, yj: (0, 0),
+                                 memory_space=pltpu.SMEM))
+    operands.append(beta4)
+
+    out_specs = []
+    out_shapes = []
+    if update_p:
+        out_specs += [pl.BlockSpec((1, CY, NZ), in_map,
+                                   memory_space=pltpu.VMEM)] * 2
+        out_shapes += [jax.ShapeDtypeStruct((NX, NY, NZ), dtype)] * 2
+    out_specs += [pl.BlockSpec((1, CY, NZ), out_map_emit,
+                               memory_space=pltpu.VMEM)] * 4
+    out_shapes += [jax.ShapeDtypeStruct((NX, NY, NZ), dtype)] * 4
+
+    zy = pl.pallas_call(
+        _make_zy_chunk_df_kernel(P, NX, NY, NZ, CY, NYB, update_p),
+        grid=(NX, NYB + 1),
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shapes,
+        scratch_shapes=[pltpu.VMEM((3, CY, NZ), dtype)] * 4,
+        interpret=interp,
+    )(*operands)
+    if update_p:
+        ph, plo, t12h, t12l, tyzh, tyzl = zy
+        p = DF(ph, plo)
+    else:
+        t12h, t12l, tyzh, tyzl = zy
+        p = vectors[0]
+
+    def x_in_map(yj, xi):
+        return (jax.lax.min(xi, np.int32(NX - 1)), yj, 0)
+
+    def x_lag_map(yj, xi):
+        return (jax.lax.clamp(np.int32(0), xi - np.int32(P),
+                              np.int32(NX - 1)), yj, 0)
+
+    x_in_specs = [pl.BlockSpec((1, CY, NZ), x_in_map,
+                               memory_space=pltpu.VMEM)] * 4
+    x_in_specs += [pl.BlockSpec((1, CY, NZ), x_in_map,
+                                memory_space=pltpu.VMEM)] * 2
+    x_operands = [t12h, t12l, tyzh, tyzl, p.hi, p.lo]
+    for j in range(nb):
+        def cx_map(yj, xi, j=j):
+            return (jax.lax.clamp(np.int32(0), xi + np.int32(j - P),
+                                  np.int32(NX - 1)), 0, 0)
+
+        x_in_specs.append(pl.BlockSpec((1, 1, 8 * nb), cx_map,
+                                       memory_space=pltpu.SMEM))
+        x_operands.append(cx_rows)
+
+    yh, yl, dot = pl.pallas_call(
+        _make_x_chunk_df_kernel(P, NX, NY, NZ, CY),
+        grid=(NYB, NX + P),
+        in_specs=x_in_specs,
+        out_specs=[
+            pl.BlockSpec((1, CY, NZ), x_lag_map, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, CY, NZ), x_lag_map, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, 1, 2), lambda yj, xi: (yj, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((NX, NY, NZ), dtype),
+            jax.ShapeDtypeStruct((NX, NY, NZ), dtype),
+            jax.ShapeDtypeStruct((NYB, 1, 2), dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((nb, CY, NZ), dtype),
+            pltpu.VMEM((nb, CY, NZ), dtype),
+            pltpu.VMEM((P + 1, CY, NZ), dtype),
+            pltpu.VMEM((P + 1, CY, NZ), dtype),
+            pltpu.VMEM((1, 1), dtype),
+            pltpu.VMEM((1, 1), dtype),
+        ],
+        interpret=interp,
+    )(*x_operands)
+    # per-chunk dot partials: fold the (value, error) rows with the
+    # renorm-first discipline (plain summing the hi channel would cost
+    # the compensation; NYB is tiny so this is scalar work)
+    from ..la.df64 import df_add
+
+    acc = DF(dot[0, 0, 0], dot[0, 0, 1])
+    for j in range(1, int(dot.shape[0])):
+        acc = df_add(acc, DF(dot[j, 0, 0], dot[j, 0, 1]))
+    y = DF(yh, yl)
+    if update_p:
+        return p, y, acc
+    return y, acc
+
+
+def _make_update_df_kernel(NX: int, NY: int, NZ: int, CY: int):
+    """df x/r update + <r, r> partials as one chunked pallas pass (the
+    XLA whole-vector df fusion hits the TPU backend's compile wall even
+    earlier than f32's ~130M dofs; every buffer here is one (CY, NZ)
+    chunk pair)."""
+
+    def kernel(xh_ref, xl_ref, ph_ref, pl_ref, rh_ref, rl_ref,
+               yh_ref, yl_ref, al_ref, x1h_ref, x1l_ref, r1h_ref,
+               r1l_ref, rr_ref, racc_p, racc_e):
+        xi = pl.program_id(0)
+        yj = pl.program_id(1)
+
+        @pl.when(jnp.logical_and(xi == 0, yj == 0))
+        def _init():
+            racc_p[...] = jnp.zeros_like(racc_p)
+            racc_e[...] = jnp.zeros_like(racc_e)
+
+        ah = al_ref[0, 0]
+        alo = al_ref[0, 1]
+        ahh = al_ref[0, 2]
+        ahl = al_ref[0, 3]
+
+        def axpy(vh, vl, wh, wl, sign):
+            # v + sign * alpha * w in df (alpha splits in SMEM)
+            wh_h, wh_l = _split(wh)
+            t = ah * wh
+            e = (((ahh * wh_h - t) + (ahh * wh_l + ahl * wh_h))
+                 + ahl * wh_l) + (ah * wl + alo * wh)
+            th, tl = two_sum(t, e)  # renorm-first (_acc2 docstring)
+            if sign < 0:
+                th, tl = -th, -tl
+            s, c = two_sum(vh, th)
+            return _renorm2(s, (tl + c) + vl)
+
+        x1h, x1l = axpy(xh_ref[0], xl_ref[0], ph_ref[0], pl_ref[0], +1)
+        x1h_ref[0] = x1h
+        x1l_ref[0] = x1l
+        r1h, r1l = axpy(rh_ref[0], rl_ref[0], yh_ref[0], yl_ref[0], -1)
+        # mask virtual-pad rows of the last y-chunk out of the reduction
+        gy = (yj * np.int32(CY)
+              + jax.lax.broadcasted_iota(jnp.int32, (CY, NZ), 0))
+        valid = gy < np.int32(NY)
+        r1h = jax.lax.select(valid, r1h, jnp.zeros_like(r1h))
+        r1l = jax.lax.select(valid, r1l, jnp.zeros_like(r1l))
+        r1h_ref[0] = r1h
+        r1l_ref[0] = r1l
+        dp, de = _plane_dot_df(r1h, r1l, r1h, r1l, CY, NZ)
+        s, c = two_sum(racc_p[...], dp)
+        racc_p[...] = s
+        racc_e[...] = racc_e[...] + (de + c)
+
+        @pl.when(jnp.logical_and(xi == np.int32(NX - 1),
+                                 yj == np.int32(-(-NY // CY) - 1)))
+        def _finish():
+            dh, dl = _renorm2(racc_p[...], racc_e[...])
+            rr_ref[...] = jnp.concatenate([dh, dl], axis=1)
+
+    return kernel
+
+
+def cg_update_df_pallas(x: DF, p: DF, r: DF, y: DF, alpha: DF,
+                        interpret: bool | None = None):
+    """(x + alpha p, r - alpha y, <r1, r1>) in df via the chunked pallas
+    pass; alpha rides as a 4-channel SMEM row."""
+    NX, NY, NZ = x.hi.shape
+    dtype = jnp.float32
+    CY = _pick_cy_df(NY, 1)
+    NYB = -(-NY // CY)
+    spec = pl.BlockSpec((1, CY, NZ), lambda xi, yj: (xi, yj, 0),
+                        memory_space=pltpu.VMEM)
+    a4 = _beta4(alpha)
+    x1h, x1l, r1h, r1l, rr = pl.pallas_call(
+        _make_update_df_kernel(NX, NY, NZ, CY),
+        grid=(NX, NYB),
+        in_specs=[spec] * 8 + [pl.BlockSpec((1, 4), lambda xi, yj: (0, 0),
+                                            memory_space=pltpu.SMEM)],
+        out_specs=[spec] * 4 + [pl.BlockSpec(
+            (1, 2), lambda xi, yj: (0, 0), memory_space=pltpu.VMEM)],
+        out_shape=[jax.ShapeDtypeStruct((NX, NY, NZ), dtype)] * 4
+        + [jax.ShapeDtypeStruct((1, 2), dtype)],
+        scratch_shapes=[pltpu.VMEM((1, 1), dtype)] * 2,
+        interpret=_use_interpret() if interpret is None else interpret,
+    )(x.hi, x.lo, p.hi, p.lo, r.hi, r.lo, y.hi, y.lo, a4)
+    return DF(x1h, x1l), DF(r1h, r1l), DF(rr[0, 0], rr[0, 1])
+
+
 def _engine_coeffs(op: KronLaplacianDF):
     """The kernel's coefficient operands, built once per jitted call
     (outside the CG loop): z/y 4-channel stacks + the x SMEM rows."""
@@ -548,12 +1017,14 @@ def _beta4(beta: DF) -> jnp.ndarray:
     ).reshape(1, 4)
 
 
-def fused_cg_solve_df(engine, b: DF, nreps: int) -> DF:
+def fused_cg_solve_df(engine, b: DF, nreps: int, update=None) -> DF:
     """Shared df driver loop, mirroring la.cg.fused_cg_solve: the engine
     performs p-update/apply/alpha-dot in one kernel; x/r updates and
-    <r, r> run as XLA df passes. Includes ops.kron_df.cg_solve_df's
-    df-floor freeze so small fixed-budget problems don't amplify noise
-    past the df64 residual floor."""
+    <r, r> run as XLA df passes, or through `update(x, p, r, y, alpha)
+    -> (x1, r1, <r1, r1>)` (the chunked pallas df pass for very large
+    problems). Includes ops.kron_df.cg_solve_df's df-floor freeze so
+    small fixed-budget problems don't amplify noise past the df64
+    residual floor."""
     floor = jnp.float32(1e-24)
     x0 = df_zeros_like(b)
     rnorm0 = df_dot(b, b)
@@ -563,9 +1034,12 @@ def fused_cg_solve_df(engine, b: DF, nreps: int) -> DF:
         x, r, p_prev, beta, rnorm, done = state
         p, y, pdot = engine(r, p_prev, _beta4(beta))
         alpha = df_div(rnorm, pdot)
-        x1 = df_axpy(x, alpha, p)
-        r1 = df_sub(r, df_scale(y, alpha))
-        rnorm1 = df_dot(r1, r1)
+        if update is None:
+            x1 = df_axpy(x, alpha, p)
+            r1 = df_sub(r, df_scale(y, alpha))
+            rnorm1 = df_dot(r1, r1)
+        else:
+            x1, r1, rnorm1 = update(x, p, r, y, alpha)
         beta1 = df_div(rnorm1, rnorm)
         done1 = jnp.logical_or(done, rnorm1.hi <= floor * rnorm0_hi)
 
@@ -583,39 +1057,65 @@ def fused_cg_solve_df(engine, b: DF, nreps: int) -> DF:
     return x
 
 
+def _df_call_for(op, force_chunked: bool):
+    """The engine call matching engine_plan_df's form pick (or the
+    driver's chunked retry)."""
+    form = engine_plan_df(_grid_shape(op), op.degree)[0]
+    if force_chunked or form == "chunked":
+        return _kron_cg_df_call_chunked
+    return _kron_cg_df_call
+
+
 def kron_cg_df_solve(op: KronLaplacianDF, b: DF, nreps: int,
-                     interpret: bool | None = None) -> DF:
+                     interpret: bool | None = None,
+                     pallas_update: bool | None = None,
+                     force_chunked: bool = False) -> DF:
     """Benchmark CG with the fused df iteration. Matches
     ops.kron_df.cg_solve_df to df reassociation accuracy (~1e-12
-    relative)."""
+    relative). `pallas_update` (default: by size, same policy constant
+    as the f32 engine) routes the x/r update through the chunked pallas
+    df pass; `force_chunked` overrides the auto form pick (the driver's
+    Mosaic-rejection retry)."""
+    from .kron_cg import PALLAS_UPDATE_MIN_DOFS
+
     coeffs = _engine_coeffs(op)
+    call = _df_call_for(op, force_chunked)
 
     def engine(r, p_prev, beta4):
-        return _kron_cg_df_call(op, coeffs, True, interpret,
-                                r, p_prev, beta4)
+        return call(op, coeffs, True, interpret, r, p_prev, beta4)
 
-    return fused_cg_solve_df(engine, b, nreps)
+    use_pallas_update = (b.hi.size >= PALLAS_UPDATE_MIN_DOFS
+                         if pallas_update is None else pallas_update)
+    update = None
+    if use_pallas_update:
+        def update(x, p, r, y, alpha):
+            return cg_update_df_pallas(x, p, r, y, alpha, interpret)
+
+    return fused_cg_solve_df(engine, b, nreps, update=update)
 
 
 def kron_apply_ring_df(op: KronLaplacianDF, x: DF,
-                       interpret: bool | None = None) -> DF:
+                       interpret: bool | None = None,
+                       force_chunked: bool = False) -> DF:
     """Single fused apply y = A x (Dirichlet pass-through), discarding
     the fused dot. Used by the df action benchmark."""
     coeffs = _engine_coeffs(op)
-    y, _ = _kron_cg_df_call(op, coeffs, False, interpret, x)
+    y, _ = _df_call_for(op, force_chunked)(op, coeffs, False, interpret, x)
     return y
 
 
 def action_ring_df(op: KronLaplacianDF, u: DF, nreps: int,
-                   interpret: bool | None = None) -> DF:
+                   interpret: bool | None = None,
+                   force_chunked: bool = False) -> DF:
     """nreps fused applies of the same input (benchmark action
     semantics, laplacian_solver.cpp:119-127), loop-fenced like the
     unfused twin (ops.kron_df.action_df)."""
     coeffs = _engine_coeffs(op)
+    call = _df_call_for(op, force_chunked)
 
     def rep(_, y):
         uu, _ = jax.lax.optimization_barrier((u, y))
-        out, _ = _kron_cg_df_call(op, coeffs, False, interpret, uu)
+        out, _ = call(op, coeffs, False, interpret, uu)
         return out
 
     return jax.lax.fori_loop(0, nreps, rep, df_zeros_like(u))

@@ -84,10 +84,23 @@ def main() -> int:
             qmode=1, float_bits=32, nreps=50, use_cg=True)
     try_cfg(results, "q3_cg_300M", ndofs_global=300_000_000, degree=3,
             qmode=1, float_bits=32, nreps=50, use_cg=True)
-    # Q6 at a large size (reference Q6-500M is 500M/GPU on 120 GB GH200;
-    # scale to this chip's HBM and the compile-size ceiling)
+    # Q6 at reference scale (Q6-500M is 500M/GPU on 120 GB GH200): push
+    # degree 6 to the largest size this chip's HBM fits — the kron path
+    # needs no geometry tensor, ~6 vectors + setup, so ~128-500M is the
+    # candidate range on 16 GB; each size records success or the
+    # verbatim HBM/compile ceiling (VERDICT r4 item 2's done-criterion)
     try_cfg(results, "q6_cg_64M", ndofs_global=64_000_000, degree=6,
             qmode=1, float_bits=32, nreps=200, use_cg=True)
+    try_cfg(results, "q6_cg_128M", ndofs_global=128_000_000, degree=6,
+            qmode=1, float_bits=32, nreps=100, use_cg=True)
+    try_cfg(results, "q6_cg_200M", ndofs_global=200_000_000, degree=6,
+            qmode=1, float_bits=32, nreps=50, use_cg=True)
+    try_cfg(results, "q6_cg_300M", ndofs_global=300_000_000, degree=6,
+            qmode=1, float_bits=32, nreps=30, use_cg=True)
+    try_cfg(results, "q6_cg_400M", ndofs_global=400_000_000, degree=6,
+            qmode=1, float_bits=32, nreps=30, use_cg=True)
+    try_cfg(results, "q6_cg_500M", ndofs_global=500_000_000, degree=6,
+            qmode=1, float_bits=32, nreps=20, use_cg=True)
     try_cfg(results, "q6_cg_12.5M", ndofs_global=12_500_000, degree=6,
             qmode=1, float_bits=32, nreps=1000, use_cg=True)
     # Operator action sweep Q1..Q7 (uniform mesh, qmode 1 except degree 1)
@@ -115,11 +128,27 @@ def main() -> int:
             degree=6, qmode=1, float_bits=32, nreps=300, use_cg=True,
             geom_perturb_fact=0.2)
     # f64-class strategies side by side (TPUs have no f64 units):
-    # XLA software emulation vs double-float f32 pairs (ops.kron_df)
+    # XLA software emulation vs double-float f32 pairs, now through the
+    # fused df delay-ring engine (ops.kron_cg_df) at benchmark sizes —
+    # the r5 headline axis (vs_baseline_per_gpu is against the SAME
+    # published f64 numbers, so these rows are the apples-to-apples
+    # comparison)
     try_cfg(results, "q3_cg_f64_emulated_2M", ndofs_global=2_000_000,
             degree=3, qmode=1, float_bits=64, nreps=50, use_cg=True)
     try_cfg(results, "q3_cg_f64_df32_2M", ndofs_global=2_000_000,
             degree=3, qmode=1, float_bits=64, nreps=50, use_cg=True,
+            f64_impl="df32")
+    try_cfg(results, "q3_cg_f64_df32_12.5M", ndofs_global=12_500_000,
+            degree=3, qmode=1, float_bits=64, nreps=200, use_cg=True,
+            f64_impl="df32")
+    try_cfg(results, "q3_cg_f64_df32_100M", ndofs_global=100_000_000,
+            degree=3, qmode=1, float_bits=64, nreps=50, use_cg=True,
+            f64_impl="df32")
+    try_cfg(results, "q3_cg_f64_df32_300M", ndofs_global=300_000_000,
+            degree=3, qmode=1, float_bits=64, nreps=30, use_cg=True,
+            f64_impl="df32")
+    try_cfg(results, "q6_cg_f64_df32_12.5M", ndofs_global=12_500_000,
+            degree=6, qmode=1, float_bits=64, nreps=100, use_cg=True,
             f64_impl="df32")
 
     import jax

@@ -175,8 +175,8 @@ print("EMULATED:", res.gdof_per_second, res.extra, "ynorm", res.ynorm)
 
 def stage_matrix():
     rc, out = run_script(
-        ["scripts/baseline_matrix.py", "BASELINE_MATRIX_r04.json"],
-        timeout=7200,
+        ["scripts/baseline_matrix.py", "BASELINE_MATRIX_r05.json"],
+        timeout=10800,
     )
     log(f"baseline_matrix rc={rc}: {out}")
 
@@ -291,21 +291,27 @@ def stage_deg7probe():
 
 
 def stage_dfacc():
-    # df32 engine accuracy ON HARDWARE: the CPU suite validates the
-    # interpret path; Mosaic's compiled arithmetic (scheduling, any FP
-    # rewrites, scratch semantics) is only provable here. The oracle
-    # (assembled CSR, true f64) must agree to ~1e-9 like the unfused
-    # path; a failure here invalidates every df perf number after it.
+    # df32 engine accuracy ON HARDWARE (both forms): the CPU suite
+    # validates the interpret path; Mosaic's compiled arithmetic
+    # (scheduling, any FP rewrites, scratch semantics) is only provable
+    # here. The oracle (assembled CSR, true f64) must agree to ~1e-9
+    # like the unfused path; a failure here invalidates every df perf
+    # number after it.
     code = PRE + """
 cfg = BenchConfig(ndofs_global=50_000, degree=3, qmode=1, float_bits=64,
                   nreps=30, use_cg=True, mat_comp=True, f64_impl="df32")
 res, w = timed_res(cfg)
-print("DFACC:", "enorm/znorm", res.enorm / res.znorm, res.extra)
+print("DFACC one:", "enorm/znorm", res.enorm / res.znorm, res.extra)
 assert res.extra.get("cg_engine") is True, "engine did not engage"
-assert res.enorm / res.znorm < 1e-9, "df engine lost f64-class accuracy"
+assert res.enorm / res.znorm < 1e-9, "df one-kernel lost f64 accuracy"
+import bench_tpu_fem.ops.kron_cg_df as KCD
+KCD.engine_plan_df = lambda *a: ("chunked", None)
+res, w = timed_res(cfg)
+print("DFACC chunked:", "enorm/znorm", res.enorm / res.znorm, res.extra)
+assert res.enorm / res.znorm < 1e-9, "df chunked lost f64 accuracy"
 print("DFACC OK")
 """
-    rc, out = run_py(code, timeout=1200)
+    rc, out = run_py(code, timeout=1800)
     log(f"dfacc rc={rc}: {out}")
     return rc == 0
 
@@ -342,10 +348,13 @@ STAGES = {
 }
 
 if __name__ == "__main__":
-    # Round-5 default agenda: df engine accuracy gate first, then its
-    # perf numbers, then the round-4 leftovers and the official line.
-    wanted = sys.argv[1:] or ["health", "dfacc", "dfeng", "dflarge",
-                              "pert100", "deg7probe", "bench"]
+    # Round-5 default agenda, ordered by value-per-minute under wedge
+    # risk: the df accuracy gate first (nothing df counts without it),
+    # then the official bench line, then df perf, the round-4
+    # leftovers, and the full matrix (longest) last.
+    wanted = sys.argv[1:] or ["health", "dfacc", "dfeng", "bench",
+                              "dflarge", "pert100", "deg7probe",
+                              "matrix"]
     unknown = [s for s in wanted if s not in STAGES]
     if unknown:
         print(f"unknown stage(s) {unknown}; valid: {list(STAGES)}",

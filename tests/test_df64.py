@@ -99,6 +99,7 @@ def test_df64_apply_matches_f64(degree, qmode):
     assert np.linalg.norm(ydf - y64) / np.linalg.norm(y64) < 1e-13
 
 
+@pytest.mark.slow
 def test_df64_cg_f64_class_floor():
     """Jitted df64 CG must reach an f64-class residual floor (~1e-12; the
     f32 path floors at ~1e-3 relative at scale) and stay there under a

@@ -8,6 +8,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
+
+# interpret-mode-heavy distributed suites dominate the full run
+# (up to ~150 s per case on one CPU core); the CI fast lane skips them
+pytestmark = pytest.mark.slow
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from bench_tpu_fem.dist.kron_df import (

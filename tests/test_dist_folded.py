@@ -9,6 +9,10 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+# interpret-mode-heavy distributed suites dominate the full run
+# (up to ~150 s per case on one CPU core); the CI fast lane skips them
+pytestmark = pytest.mark.slow
+
 from bench_tpu_fem.dist.folded import (
     build_dist_folded,
     make_folded_rhs_fn,

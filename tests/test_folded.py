@@ -38,7 +38,10 @@ def test_fold_unfold_roundtrip(n, degree):
 
 
 @pytest.mark.parametrize(
-    "degree,qmode", [(1, 0), (2, 0), (3, 1), (4, 1), (5, 1), (7, 1)]
+    "degree,qmode",
+    [(1, 0), (2, 0), (3, 1), (4, 1),
+     pytest.param(5, 1, marks=pytest.mark.slow),
+     pytest.param(7, 1, marks=pytest.mark.slow)]
 )
 def test_folded_apply_matches_grid_operator(degree, qmode):
     """Degrees 5 and 7 cover the largest VMEM working sets (nq = 9 at
@@ -197,6 +200,7 @@ def test_corner_streamed_matches_cube_form():
                                    atol=1e-12 * scale)
 
 
+@pytest.mark.slow
 def test_degree5_qmode1_builds_corner_streamed_at_full_lanes():
     """Degree 5 qmode 1 must now resolve to corner mode with full
     128-lane blocks (via the plane-streamed contraction) and match the

@@ -39,12 +39,14 @@ def _setup(n, degree, qmode, geom, nl=8, perturb=0.3):
 @pytest.mark.parametrize(
     "n,degree,qmode,geom",
     [
-        ((6, 5, 4), 3, 1, "corner"),
+        pytest.param((6, 5, 4), 3, 1, "corner",
+                     marks=pytest.mark.slow),
         ((6, 5, 4), 3, 1, "g"),
         ((8, 3, 7), 2, 1, "corner"),
         ((10, 9, 3), 1, 0, "corner"),
         ((4, 5, 3), 4, 1, "g"),
-        ((3, 3, 2), 5, 1, "corner"),
+        pytest.param((3, 3, 2), 5, 1, "corner",
+                     marks=pytest.mark.slow),
     ],
 )
 def test_ring_apply_matches_fused_apply(n, degree, qmode, geom):
@@ -62,10 +64,12 @@ def test_ring_apply_matches_fused_apply(n, degree, qmode, geom):
 @pytest.mark.parametrize(
     "n,degree,qmode,geom",
     [
-        ((6, 5, 4), 3, 1, "corner"),
+        pytest.param((6, 5, 4), 3, 1, "corner",
+                     marks=pytest.mark.slow),
         ((6, 5, 4), 3, 1, "g"),
         ((8, 3, 7), 2, 1, "corner"),
-        ((3, 3, 2), 5, 1, "corner"),
+        pytest.param((3, 3, 2), 5, 1, "corner",
+                     marks=pytest.mark.slow),
     ],
 )
 def test_engine_cg_matches_reference_cg(n, degree, qmode, geom):

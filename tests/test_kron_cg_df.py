@@ -7,6 +7,7 @@ df tolerances: both paths carry ~48-bit mantissas, so cross-path
 agreement is ~1e-12 relative, not the f32 suite's ~1e-6.
 """
 
+import jax.numpy as jnp
 import numpy as np
 import pytest
 
@@ -60,8 +61,11 @@ def test_ring_apply_fused_dot_matches():
     assert abs(got - dot_ref) / abs(dot_ref) < 1e-12
 
 
-@pytest.mark.parametrize("degree,n", [(1, (4, 5, 6)), (3, (3, 4, 5)),
-                                      (5, (2, 3, 2))])
+@pytest.mark.parametrize(
+    "degree,n",
+    [(1, (4, 5, 6)), (3, (3, 4, 5)),
+     pytest.param(5, (2, 3, 2), marks=pytest.mark.slow)],
+)
 def test_engine_cg_matches_unfused_df(degree, n):
     op, b = _setup(degree, n)
     x_ref = df_to_f64(cg_solve_df(op, b, 12))
@@ -108,9 +112,10 @@ def test_action_ring_matches_unfused():
 
 def test_engine_plan_df_tiers():
     """The df plan reuses the f32 tier ladder on the doubled-channel
-    estimate: small grids take the default-limit one-kernel form, the
-    flagship 12.5M sits in a raised tier, and past tier 3 the plan
-    reports 'unfused' (no df chunked form exists yet)."""
+    estimate: the flagship 12.5M fits the default-limit one-kernel
+    form, 100M needs the tier-3 raised scoped limit, and past the
+    ladder the plan picks the y-chunked two-kernel form (no size
+    ceiling)."""
     from bench_tpu_fem.ops.kron_cg import ONE_KERNEL_SCOPED_KIB2
 
     form, kib = engine_plan_df((232, 232, 232), 3)  # ~12.5M dofs
@@ -118,7 +123,7 @@ def test_engine_plan_df_tiers():
     form, kib = engine_plan_df((465, 465, 465), 3)  # ~100M dofs
     assert form == "one" and kib == ONE_KERNEL_SCOPED_KIB2
     form, kib = engine_plan_df((670, 670, 670), 3)  # ~300M dofs
-    assert form == "unfused" and kib is None
+    assert form == "chunked" and kib is None
     # the estimate is monotone in plane size
     assert (engine_vmem_bytes_df((10, 100, 100), 3)
             < engine_vmem_bytes_df((10, 200, 200), 3))
@@ -163,6 +168,80 @@ def test_driver_df32_engine_fallback_on_compile_failure(monkeypatch):
     assert res.extra["cg_engine"] is False
     assert "Mosaic rejects" in res.extra["cg_engine_error"]
     assert np.isfinite(res.ynorm) and res.ynorm > 0
+
+
+@pytest.mark.parametrize("degree,n", [(1, (4, 5, 6)), (3, (3, 4, 5)),
+                                      (5, (2, 3, 2))])
+def test_chunked_apply_matches_unfused(degree, n):
+    """The y-chunked two-kernel df form (the no-size-ceiling path for
+    300M-dof problems): apply parity vs the unfused df operator."""
+    op, b = _setup(degree, n)
+    y_ref = df_to_f64(op.apply(b))
+    y = df_to_f64(kron_apply_ring_df(op, b, interpret=True,
+                                     force_chunked=True))
+    rel = np.linalg.norm(y - y_ref) / np.linalg.norm(y_ref)
+    assert rel < 5e-13
+
+
+def test_chunked_cg_matches_unfused():
+    op, b = _setup(3, (4, 4, 4))
+    x_ref = df_to_f64(cg_solve_df(op, b, 10))
+    x = df_to_f64(kron_cg_df_solve(op, b, 10, interpret=True,
+                                   force_chunked=True))
+    rel = np.linalg.norm(x - x_ref) / np.linalg.norm(x_ref)
+    assert rel < 1e-11
+
+
+def test_chunked_fused_dot_matches():
+    from bench_tpu_fem.ops.kron_cg_df import _kron_cg_df_call_chunked
+
+    op, b = _setup(3, (3, 4, 5))
+    y_ref = df_to_f64(op.apply(b))
+    coeffs = _engine_coeffs(op)
+    _, dot = _kron_cg_df_call_chunked(op, coeffs, False, True, b)
+    dot_ref = float(np.dot(df_to_f64(b).ravel(), y_ref.ravel()))
+    got = float(np.float64(dot.hi) + np.float64(dot.lo))
+    assert abs(got - dot_ref) / abs(dot_ref) < 1e-12
+
+
+def test_update_df_pallas_matches_xla():
+    """The chunked pallas df x/r update pass vs the XLA df ops it
+    replaces (needed above ~100M dofs where XLA's whole-vector df
+    fusions hit the TPU compile wall)."""
+    from bench_tpu_fem.la.df64 import DF, df_axpy, df_scale, df_sub, df_dot
+    from bench_tpu_fem.ops.kron_cg_df import cg_update_df_pallas
+
+    rng = np.random.RandomState(7)
+    shape = (7, 70, 13)  # non-divisible y-chunks
+
+    def mk():
+        a = rng.randn(*shape)
+        hi = np.float32(a)
+        return DF(jnp.asarray(hi), jnp.asarray(np.float32(a - np.float64(hi))))
+
+    x, p, r, y = mk(), mk(), mk(), mk()
+    a64 = 0.37123456789
+    ahi = np.float32(a64)
+    alpha = DF(jnp.float32(ahi), jnp.float32(a64 - np.float64(ahi)))
+    x1, r1, rr = cg_update_df_pallas(x, p, r, y, alpha, interpret=True)
+    x1_ref = df_to_f64(df_axpy(x, alpha, p))
+    r1_ref = df_to_f64(df_sub(r, df_scale(y, alpha)))
+    np.testing.assert_allclose(df_to_f64(x1), x1_ref, rtol=1e-12,
+                               atol=1e-12)
+    np.testing.assert_allclose(df_to_f64(r1), r1_ref, rtol=1e-12,
+                               atol=1e-12)
+    rr_ref = float(df_to_f64(df_dot(DF(r1.hi, r1.lo), DF(r1.hi, r1.lo))))
+    got = float(np.float64(rr.hi) + np.float64(rr.lo))
+    assert abs(got - rr_ref) / abs(rr_ref) < 1e-12
+
+
+def test_engine_cg_with_pallas_update_matches():
+    op, b = _setup(3, (4, 4, 4))
+    x_ref = df_to_f64(kron_cg_df_solve(op, b, 8, interpret=True))
+    x = df_to_f64(kron_cg_df_solve(op, b, 8, interpret=True,
+                                   pallas_update=True))
+    rel = np.linalg.norm(x - x_ref) / np.linalg.norm(x_ref)
+    assert rel < 1e-11
 
 
 def test_qmode0_matches_unfused():

@@ -176,10 +176,15 @@ def test_folded_fused_apply_specs(recorder, geom):
 
 
 @pytest.mark.parametrize("degree", [3, 4])
-def test_kron_df_engine_specs(recorder, degree):
-    """The fused df32 engine (ops.kron_cg_df): both the CG (update_p)
-    and action forms."""
-    from bench_tpu_fem.ops.kron_cg_df import _engine_coeffs, _kron_cg_df_call
+@pytest.mark.parametrize("chunked", [False, True])
+def test_kron_df_engine_specs(recorder, degree, chunked):
+    """The fused df32 engine (ops.kron_cg_df): CG (update_p) and action
+    forms, one-kernel and y-chunked."""
+    from bench_tpu_fem.ops.kron_cg_df import (
+        _engine_coeffs,
+        _kron_cg_df_call,
+        _kron_cg_df_call_chunked,
+    )
     from bench_tpu_fem.ops.kron_df import (
         build_kron_laplacian_df,
         device_rhs_uniform_df,
@@ -195,9 +200,47 @@ def test_kron_df_engine_specs(recorder, degree):
     from bench_tpu_fem.ops.kron_cg_df import _beta4
     from bench_tpu_fem.la.df64 import DF
 
+    call = _kron_cg_df_call_chunked if chunked else _kron_cg_df_call
     beta = _beta4(DF(jnp.float32(0.5), jnp.float32(0.0)))
-    _kron_cg_df_call(op, coeffs, True, True, b, b, beta)
-    _kron_cg_df_call(op, coeffs, False, True, b)
+    call(op, coeffs, True, True, b, b, beta)
+    call(op, coeffs, False, True, b)
+    recorder.check()
+
+
+def test_kron_df_update_pass_specs(recorder):
+    from bench_tpu_fem.la.df64 import DF
+    from bench_tpu_fem.ops.kron_cg_df import cg_update_df_pallas
+
+    shape = (7, 70, 13)
+    x, p, r, y = (DF(_rand(shape), _rand(shape) * 1e-8) for _ in range(4))
+    alpha = DF(jnp.float32(0.3), jnp.float32(0.0))
+    cg_update_df_pallas(x, p, r, y, alpha, interpret=True)
+    recorder.check()
+
+
+def test_dist_kron_engine_3d_specs(recorder):
+    """The ext2d (3D-sharded) engine form: halo-extended cross-section
+    inputs, extended coefficient slices, mask/weight planes."""
+    from functools import partial
+
+    from jax.sharding import PartitionSpec as P
+
+    from bench_tpu_fem.dist.kron import build_dist_kron
+    from bench_tpu_fem.dist.kron_cg import dist_kron_apply_ring_local
+    from bench_tpu_fem.dist.mesh import AXIS_NAMES, make_device_grid
+
+    dgrid = make_device_grid(dshape=(2, 2, 2))
+    op = build_dist_kron((4, 4, 4), dgrid, 3, 1, dtype=jnp.float32)
+
+    @partial(jax.shard_map, mesh=dgrid.mesh,
+             in_specs=(P(*AXIS_NAMES), P()), out_specs=P(*AXIS_NAMES),
+             check_vma=False)
+    def run(x, A):
+        return dist_kron_apply_ring_local(A, x[0, 0, 0],
+                                          interpret=True)[None, None, None]
+
+    x = _rand((2, 2, 2, op.L[0], op.L[1], op.L[2]))
+    jax.jit(run)(x, op)
     recorder.check()
 
 
