@@ -87,6 +87,27 @@ def assemble_csr(
     return A
 
 
+def csr_spmv_T(A: sp.csr_matrix, x: np.ndarray) -> np.ndarray:
+    """Transpose SpMV y = A^T x — parity with the reference's
+    `spmvT_impl`/`apply_transpose` (/root/reference/src/csr.hpp:61-77),
+    which its own CG never calls either; provided for operator-API
+    completeness (the assembled Laplacian is symmetric, so this equals
+    the forward SpMV up to assembly rounding — a property the oracle
+    tests assert rather than assume)."""
+    return A.T @ x
+
+
+def csr_diag_inv(A: sp.csr_matrix) -> np.ndarray:
+    """Inverse diagonal 1/diag(A) — the Jacobi preconditioner vector the
+    reference's MatrixOperator computes at construction
+    (/root/reference/src/csr.hpp:79-107,135) and never consumes in its
+    unpreconditioned CG. Constrained (Dirichlet) rows carry a unit
+    diagonal (assemble_csr), so the result is finite everywhere for any
+    assembled Laplacian."""
+    d = np.asarray(A.diagonal())
+    return 1.0 / d
+
+
 def csr_cg_reference(A: sp.csr_matrix, b: np.ndarray, niter: int) -> np.ndarray:
     """Fixed-iteration unpreconditioned CG through the assembled matrix — the
     oracle counterpart of the device CG, same recurrence as the reference

@@ -89,3 +89,27 @@ def test_golden_ci_value():
     y = A @ u
     ynorm = np.linalg.norm(y)
     np.testing.assert_allclose(ynorm, 9.912865833415553, rtol=1e-12)
+
+
+def test_csr_transpose_spmv_and_diag_inv():
+    """CSR operator extras, reference-API parity: transpose SpMV
+    (csr.hpp:61-77) and the Jacobi inverse diagonal computed at operator
+    construction (csr.hpp:79-107,135) — both unused by the reference's
+    own unpreconditioned CG, provided for completeness. The assembled
+    Laplacian is symmetric, so A^T x must equal A x to assembly
+    rounding; diag_inv must be finite (Dirichlet rows carry a unit
+    diagonal) and invert the diagonal exactly."""
+    from bench_tpu_fem.fem.assemble import csr_diag_inv, csr_spmv_T
+
+    n, degree = (2, 2, 2), 3
+    A, b, bc, t = build_oracle(n, degree, 1, perturb=0.1)
+    rng = np.random.RandomState(3)
+    x = rng.randn(A.shape[0])
+    yT = csr_spmv_T(A, x)
+    np.testing.assert_allclose(yT, np.asarray(A.todense()).T @ x,
+                               rtol=1e-12, atol=1e-12)
+    np.testing.assert_allclose(yT, A @ x, rtol=1e-9, atol=1e-9)  # symmetry
+    dinv = csr_diag_inv(A)
+    assert np.all(np.isfinite(dinv))
+    np.testing.assert_allclose(dinv * A.diagonal(), 1.0, rtol=1e-14)
+    np.testing.assert_allclose(dinv[bc], 1.0, rtol=1e-14)  # unit bc rows
