@@ -1,0 +1,198 @@
+"""Distributed fused df32 CG engine: the f64-class delay-ring iteration
+on x-sharded device meshes.
+
+Composes the two existing designs without changing either:
+
+- the distributed halo protocol of dist.kron_cg — halo-extended input
+  slabs, one stacked ppermute pair per iteration, the SAME kernel in its
+  halo form emitting exactly the local planes, in-kernel dot ownership;
+- the df arithmetic of ops.kron_cg_df — (hi, lo) plane pairs with
+  error-free products and compensated accumulation.
+
+The DF halo payload stacks all four channels (r.hi, r.lo, p.hi, p.lo)
+into ONE ppermute pair per side, and the left-neighbour payload carries
+ONE EXTRA plane — the owner's copy of the shared seam plane — which
+overwrites this shard's ghost plane 0 before the kernel. That folded
+seam refresh is the df-specific requirement dist.kron_df derived: f32
+seams stay bit-identical by replay of identical instruction sequences,
+but compiled df chains may round the lo channel position-dependently
+(XLA fusion; interpret mode runs the kernel through XLA too), so df
+ghost copies are made consistent by construction — owner wins — at zero
+extra collectives (the refresh plane rides the halo exchange).
+
+Cross-shard reductions reuse dist.kron_df's compensated fold
+(df_psum_all: all-gather the per-shard DF partials, fixed-order df_add
+— a raw psum would re-round away the compensation); the kernel's
+<p, A p> partial already excludes duplicated seam planes via the aux
+dot weights. x/r updates + <r, r> run through the chunked pallas df
+pass (ops.kron_cg_df.cg_update_df_pallas) above the same size policy as
+f32, with the duplicated seam plane's <r1, r1> contribution subtracted
+before the fold.
+
+x-only device meshes (dshape = (D, 1, 1)); the unfused dist df path
+(dist.kron_df) serves other meshes and remains the compile-failure
+fallback. Reference parity: ghost scatter vector.hpp:31-149, CG
+recurrence cg.hpp:89-169, f64 dispatch main.cpp:277-288.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+from ..la.df64 import DF, df_sub, df_sum, _prod_terms
+from ..ops.kron_cg import PALLAS_UPDATE_MIN_DOFS
+from ..ops.kron_cg_df import (
+    _coeff_stack4,
+    _cx_rows_df,
+    _kron_cg_df_call,
+    cg_update_df_pallas,
+    engine_plan_df,
+    fused_cg_solve_df,
+)
+from .kron_df import DistKronLaplacianDF, df_psum_all
+from .mesh import AXIS_NAMES
+
+
+def dist_df_engine_plan(op: DistKronLaplacianDF) -> tuple[bool, int | None]:
+    """(supported, scoped_vmem_kib): x-only device meshes with the df
+    one-kernel ring inside a scoped-VMEM tier (the chunked df form has
+    no halo variant yet — past the tiers the unfused dist df path
+    serves)."""
+    if not (op.dshape[1] == 1 and op.dshape[2] == 1):
+        return False, None
+    Lx, NY, NZ = op.L[0], op.notbc1d[1].shape[0], op.notbc1d[2].shape[0]
+    form, kib = engine_plan_df((Lx, NY, NZ), op.degree)
+    return form == "one", kib
+
+
+def supports_dist_df_engine(op: DistKronLaplacianDF) -> bool:
+    return dist_df_engine_plan(op)[0]
+
+
+def _shard_tables_df(op: DistKronLaplacianDF, dtype=jnp.float32):
+    """Per-shard tables (inside shard_map, hoisted out of the CG loop):
+    the local 8nb-channel x-coefficient rows, the [interior-in-x,
+    dot-weight] aux rows, and the (global, replicated) z/y coefficient
+    stacks."""
+    P = op.degree
+    Lx = op.L[0]
+    NXg = op.notbc1d[0].shape[0]
+    x0 = lax.axis_index(AXIS_NAMES[0]) * (Lx - 1)
+    cx_global = _cx_rows_df(op, NXg)  # (NXg, 1, 8nb)
+    z0 = jnp.zeros((), dtype=x0.dtype)
+    cx_local = lax.dynamic_slice(
+        cx_global, (x0, z0, z0), (Lx, 1, 8 * (2 * P + 1))
+    )
+    gx = x0 + jnp.arange(Lx)
+    mi = jnp.logical_and(gx > 0, gx < NXg - 1).astype(dtype)
+    w = jnp.where(jnp.logical_and(jnp.arange(Lx) == 0, x0 > 0),
+                  jnp.zeros((), dtype), jnp.ones((), dtype))
+    aux_local = jnp.stack([mi, w], axis=-1)[:, None, :]  # (Lx, 1, 2)
+    coeffs = (
+        _coeff_stack4(op.Kd[2]),
+        _coeff_stack4(op.Md[2]),
+        _coeff_stack4(op.Kd[1]),
+        _coeff_stack4(op.Md[1]),
+        cx_global,  # placeholder slot; the call takes cx=cx_local
+    )
+    return cx_local, aux_local, coeffs
+
+
+def _extend_df(dfs, P: int):
+    """One stacked ppermute pair exchanges the P halo planes of every
+    channel of the given DF operands, with the seam-refresh plane folded
+    into the left-neighbour payload: planes [L-1-P, L) (P halos + the
+    owner's seam copy). Returns the halo-extended slabs with ghost plane
+    0 overwritten by the owner's value (except on shard 0, which owns
+    it)."""
+    from .halo import _shift_from_left, _shift_from_right
+
+    name = AXIS_NAMES[0]
+    chans = []
+    for d in dfs:
+        chans += [d.hi, d.lo]
+    s = jnp.stack(chans)  # x axis -> 1
+    L = s.shape[1]
+    to_left = lax.slice_in_dim(s, 1, P + 1, axis=1)
+    halo_r = _shift_from_right(to_left, name)
+    # P halo planes + the seam owner's plane L-1 (= this shard's ghost
+    # plane 0) in one payload
+    to_right = lax.slice_in_dim(s, L - 1 - P, L, axis=1)
+    recv_l = _shift_from_left(to_right, name)
+    halo_l = lax.slice_in_dim(recv_l, 0, P, axis=1)
+    seam = lax.slice_in_dim(recv_l, P, P + 1, axis=1)
+    idx = lax.axis_index(name)
+    first = lax.slice_in_dim(s, 0, 1, axis=1)
+    new_first = jnp.where(idx == 0, first, seam)
+    body = jnp.concatenate(
+        [new_first, lax.slice_in_dim(s, 1, L, axis=1)], axis=1
+    )
+    ext = jnp.concatenate([halo_l, body, halo_r], axis=1)
+    return tuple(DF(ext[2 * i], ext[2 * i + 1])
+                 for i in range(len(dfs)))
+
+
+def dist_kron_df_cg_solve_local(op: DistKronLaplacianDF, b: DF,
+                                nreps: int,
+                                interpret: bool | None = None) -> DF:
+    """Per-shard fused-engine df CG (inside shard_map over an x-only
+    device mesh): returns the local DF solution block. Matches the
+    unfused dist df path (dist.kron_df.dist_cg_solve_df_local) to df
+    reassociation accuracy."""
+    P = op.degree
+    cx_local, aux_local, coeffs = _shard_tables_df(op)
+    wplane = aux_local[:, 0, 1][:, None, None]
+
+    def inner(u: DF, v: DF) -> DF:
+        uw = DF(u.hi * wplane, u.lo * wplane)
+        local = df_sum(DF(*_prod_terms(uw, v)))
+        return df_psum_all(local, op.dshape)
+
+    def engine(r, p_prev, beta4):
+        r_ext, p_ext = _extend_df((r, p_prev), P)
+        p, y, pdot = _kron_cg_df_call(
+            op, coeffs, True, interpret, r_ext, p_ext, beta4,
+            cx=cx_local, aux=aux_local,
+        )
+        return p, y, df_psum_all(pdot, op.dshape)
+
+    update = None
+    if b.hi.size >= PALLAS_UPDATE_MIN_DOFS:
+        # chunked pallas df update (the XLA whole-vector df fusion
+        # compile wall, ops.kron_cg_df); the duplicated seam plane's
+        # <r1, r1> is subtracted before the compensated fold
+        def update(x, pv, r, y, alpha):
+            x1, r1, rr = cg_update_df_pallas(x, pv, r, y, alpha,
+                                             interpret)
+            w0 = 1.0 - wplane[0, 0, 0]
+            seam = df_sum(DF(*_prod_terms(
+                DF(r1.hi[0] * w0, r1.lo[0] * w0), DF(r1.hi[0], r1.lo[0])
+            )))
+            rr_own = df_sub(rr, seam)
+            return x1, r1, df_psum_all(rr_own, op.dshape)
+
+    # `done` derives from the gathered dots, which shard_map's VMA
+    # system marks device-varying (the fold is deterministic and
+    # identical on all shards); the initial carry must match — the same
+    # pcast the unfused dist df loop uses (dist.kron_df).
+    import jax
+
+    done0 = jax.lax.pcast(jnp.asarray(False), AXIS_NAMES, to="varying")
+    return fused_cg_solve_df(engine, b, nreps, update=update,
+                             inner=inner, done0=done0)
+
+
+def dist_kron_df_apply_ring_local(op: DistKronLaplacianDF, x: DF,
+                                  interpret: bool | None = None) -> DF:
+    """Per-shard single fused df apply y = A x (inside shard_map),
+    discarding the fused dot — the df action-benchmark analogue of
+    dist.kron_cg.dist_kron_apply_ring_local."""
+    P = op.degree
+    cx_local, aux_local, coeffs = _shard_tables_df(op)
+    (x_ext,) = _extend_df((x,), P)  # 2 channels only: no p payload
+    y, _ = _kron_cg_df_call(
+        op, coeffs, False, interpret, x_ext,
+        cx=cx_local, aux=aux_local,
+    )
+    return y

@@ -404,14 +404,47 @@ def dist_cg_solve_df_local(op: DistKronLaplacianDF, b: DF,
     return x
 
 
-def make_kron_df_sharded_fns(op: DistKronLaplacianDF, dgrid, nreps: int):
+def resolve_df_engine(op: DistKronLaplacianDF) -> bool:
+    """The fused dist df engine auto rule (mirrors
+    dist.kron.resolve_kron_engine): Mosaic kernels on TPU only, x-only
+    meshes, ring within a scoped-VMEM tier."""
+    import jax as _jax
+
+    from .kron_cg_df import supports_dist_df_engine
+
+    return (_jax.default_backend() == "tpu"
+            and supports_dist_df_engine(op))
+
+
+def make_kron_df_sharded_fns(op: DistKronLaplacianDF, dgrid, nreps: int,
+                             engine: bool | None = None):
     """Jittable sharded callables over DF grid blocks (hi/lo each
     (Dx,Dy,Dz,Lx,Ly,Lz)): (apply, CG, l2norm) — the df twin of
-    dist.kron.make_kron_sharded_fns."""
+    dist.kron.make_kron_sharded_fns.
+
+    `engine=None` (auto) routes CG and the apply through the fused
+    distributed df delay-ring engine (dist.kron_cg_df) on TPU x-only
+    meshes where the ring fits a scoped-VMEM tier; the unfused df
+    stage/halo path serves everything else and remains the
+    compile-failure fallback."""
     from jax.sharding import PartitionSpec as P
 
     spec = P(*AXIS_NAMES)
     rep = P()
+    if engine is None:
+        engine = resolve_df_engine(op)
+    elif engine:
+        from .kron_cg_df import supports_dist_df_engine
+
+        if not supports_dist_df_engine(op):
+            # unlike the f32 engine (which has a 3D ext2d form), the
+            # fused df engine exchanges x halos only — an explicit
+            # override on another mesh would silently double-count y/z
+            # seam dofs
+            raise ValueError(
+                "the fused dist df engine needs an x-only device mesh "
+                f"with a VMEM-fitting ring (dshape {op.dshape})"
+            )
 
     def _local(a):
         return DF(a.hi[0, 0, 0], a.lo[0, 0, 0])
@@ -420,13 +453,21 @@ def make_kron_df_sharded_fns(op: DistKronLaplacianDF, dgrid, nreps: int):
         return DF(a.hi[None, None, None], a.lo[None, None, None])
 
     @partial(jax.shard_map, mesh=dgrid.mesh, in_specs=(spec, rep),
-             out_specs=spec)
+             out_specs=spec, check_vma=not engine)
     def apply_fn(x, A):
+        if engine:
+            from .kron_cg_df import dist_kron_df_apply_ring_local
+
+            return _wrap(dist_kron_df_apply_ring_local(A, _local(x)))
         return _wrap(A.apply_local(_local(x)))
 
     @partial(jax.shard_map, mesh=dgrid.mesh, in_specs=(spec, rep),
-             out_specs=spec)
+             out_specs=spec, check_vma=not engine)
     def cg_fn(b, A):
+        if engine:
+            from .kron_cg_df import dist_kron_df_cg_solve_local
+
+            return _wrap(dist_kron_df_cg_solve_local(A, _local(b), nreps))
         return _wrap(dist_cg_solve_df_local(A, _local(b), nreps))
 
     # check_vma off: the gathered compensated fold is genuinely replicated

@@ -270,16 +270,29 @@ def _plane_dot_df(ph, plo, yh, ylo, NY: int, NZ: int):
 
 
 def _make_kron_cg_df_kernel(P: int, NX: int, NY: int, NZ: int,
-                            update_p: bool):
+                            update_p: bool, halo: int = 0):
     """One-kernel delay-ring df CG iteration: grid of NX + P steps. Step
     t < NX ingests plane t (df p-update fused), contracts z and y in
     registers, and scatter-accumulates the x-band contribution into the
     2P+1 pending output accumulator slots; step t >= P emits output
     plane i = t - P (renormalise, Dirichlet blend, compensated dot) and
-    recycles its slot."""
+    recycles its slot.
+
+    `halo = P` is the distributed form (dist.kron_cg_df): NX is the
+    shard's local plane count, the input slab carries P exchanged halo
+    planes per side, ingest sweeps all NX + 2P extended planes, the
+    scatter targets local outputs i = (t - halo) + d, and emit runs at
+    lag P + halo (output i's last contribution arrives at extended step
+    i + halo + P) — every output row globally exact, no boundary
+    epilogue, grid exactly NX + 2*halo steps. The per-plane
+    [interior-in-x, dot-ownership] pair streams via SMEM (aux_ref), as
+    in the f32 halo form (ops.kron_cg)."""
     KI = 2 * P + 1  # accumulator ring: exactly the live x-band window
     KP = P + 1  # p ring: read back once at lag P
     nb = 2 * P + 1
+    lag = P + halo
+    n_in = NX + 2 * halo
+    nsteps = n_in if halo else NX + P
 
     def kernel(*refs):
         if update_p:
@@ -291,12 +304,16 @@ def _make_kron_cg_df_kernel(P: int, NX: int, NY: int, NZ: int,
         ckz_ref, cmz_ref, cky_ref, cmy_ref = refs[ni:ni + 4]
         ni += 4
         # nb single-row SMEM views of the x coefficient rows: view j holds
-        # the row of output plane i = t - P + j (a stride-1 sliding window
-        # is not expressible as one blocked spec, so the window is nb
-        # static-offset views of the same array — the folded kernels'
-        # multi-view pattern)
+        # the row of output plane i = (t - halo) + (j - P) (a stride-1
+        # sliding window is not expressible as one blocked spec, so the
+        # window is nb static-offset views of the same array — the folded
+        # kernels' multi-view pattern)
         cx_refs = refs[ni:ni + nb]
         ni += nb
+        aux_ref = None
+        if halo:
+            aux_ref = refs[ni]
+            ni += 1
         beta_ref = refs[ni]
         base = ni + 1
         if update_p:
@@ -323,7 +340,7 @@ def _make_kron_cg_df_kernel(P: int, NX: int, NY: int, NZ: int,
             dacc_e[...] = jnp.zeros_like(dacc_e)
 
         # ---- ingest plane t ----
-        @pl.when(t < np.int32(NX))
+        @pl.when(t < np.int32(n_in))
         def _ingest():
             if update_p:
                 # p = beta * p_prev + r in df (beta splits ride in SMEM)
@@ -340,11 +357,25 @@ def _make_kron_cg_df_kernel(P: int, NX: int, NY: int, NZ: int,
                 tbh, tbl = two_sum(tb, eb)  # renorm-first (_acc2 docstring)
                 s, c = two_sum(tbh, rh_ref[0])
                 p2h, p2l = _renorm2(s, (tbl + c) + rl_ref[0])
-                ph_out[0] = p2h
-                pl_out[0] = p2l
+                if halo:
+                    # p is owned for the NX local planes only; halo
+                    # planes feed the rings but are the neighbours' to
+                    # store
+                    @pl.when(jnp.logical_and(t >= np.int32(halo),
+                                             t < np.int32(NX + halo)))
+                    def _store_p():
+                        ph_out[0] = p2h
+                        pl_out[0] = p2l
+                else:
+                    ph_out[0] = p2h
+                    pl_out[0] = p2l
             else:
                 p2h = xh_ref[0]
                 p2l = xl_ref[0]
+            # ungated extended-index ring store (the f32 halo kernel's
+            # scheme): emit for local output i reads the plane ingested
+            # at extended step i + halo — P intervening stores fill the
+            # other KP-1 slots, so no collision in either form
             ring_ph[jax.lax.rem(t, np.int32(KP))] = p2h
             ring_pl[jax.lax.rem(t, np.int32(KP))] = p2l
 
@@ -356,15 +387,15 @@ def _make_kron_cg_df_kernel(P: int, NX: int, NY: int, NZ: int,
             tyzhh, tyzhl = _split(tyzh)
 
             # x-band scatter: contribution of source plane t to output
-            # i = t + d uses band entry P - d of output i's coefficient
-            # row (y[i] = sum_db c[db, i] * t12[i + db - P]).
+            # i = (t - halo) + d uses band entry P - d of output i's
+            # coefficient row (y[i] = sum_db c[db, i] * t12[i + db - P]).
             for d in range(-P, P + 1):
-                i_out = t + np.int32(d)
+                i_out = t - np.int32(halo) + np.int32(d)
 
                 @pl.when(jnp.logical_and(i_out >= 0,
                                          i_out < np.int32(NX)))
                 def _scatter(i_out=i_out, d=d):
-                    cx_ref = cx_refs[d + P]  # view pinned to row t + d
+                    cx_ref = cx_refs[d + P]  # view pinned to this i_out
                     db = P - d
                     # cx channel groups of 2nb: [hi | lo | hih | hil],
                     # M at +db, K at +nb+db within each group
@@ -385,19 +416,24 @@ def _make_kron_cg_df_kernel(P: int, NX: int, NY: int, NZ: int,
                     acc_e[slot] = (acc_e[slot]
                                    + ((tMl + c1) + (tKl + c2)))
 
-        # ---- emit plane i = t - P ----
-        @pl.when(t >= np.int32(P))
+        # ---- emit plane i = t - (P + halo) ----
+        @pl.when(t >= np.int32(lag))
         def _emit():
-            i = t - np.int32(P)
+            i = t - np.int32(lag)
             slot = jax.lax.rem(i, np.int32(KI))
             yh, yl = _renorm2(acc_p[slot], acc_e[slot])
-            pslot = jax.lax.rem(i, np.int32(KP))
+            # local output i was ingested at extended step i + halo
+            pslot = jax.lax.rem(i + np.int32(halo), np.int32(KP))
             p_ih = ring_ph[pslot]
             p_il = ring_pl[pslot]
             gy = jax.lax.broadcasted_iota(jnp.int32, (NY, NZ), 0)
             gz = jax.lax.broadcasted_iota(jnp.int32, (NY, NZ), 1)
+            # interior-in-x from the streamed aux row in the halo form
+            # (the local plane index is not the global one)
+            mi = (aux_ref[0, 0, 0] > 0.5 if halo
+                  else jnp.logical_and(i > 0, i < np.int32(NX - 1)))
             inter = jnp.logical_and(
-                jnp.logical_and(i > 0, i < np.int32(NX - 1)),
+                mi,
                 jnp.logical_and(
                     jnp.logical_and(gy > 0, gy < np.int32(NY - 1)),
                     jnp.logical_and(gz > 0, gz < np.int32(NZ - 1)),
@@ -408,15 +444,21 @@ def _make_kron_cg_df_kernel(P: int, NX: int, NY: int, NZ: int,
             yh_out[0] = yh
             yl_out[0] = yl
             # recycle the slot for output i + KI (first touched at step
-            # i + KI - P > t, strictly after this zeroing)
+            # i + KI - P (+halo) > t, strictly after this zeroing)
             acc_p[slot] = jnp.zeros_like(yh)
             acc_e[slot] = jnp.zeros_like(yh)
             dp, de = _plane_dot_df(p_ih, p_il, yh, yl, NY, NZ)
+            if halo:
+                # dot-ownership weight: 0 on duplicated seam planes so
+                # <p, A p> counts every dof once globally
+                w = aux_ref[0, 0, 1]
+                dp = dp * w
+                de = de * w
             s, c = two_sum(dacc_p[...], dp)
             dacc_p[...] = s
             dacc_e[...] = dacc_e[...] + (de + c)
 
-        @pl.when(t == np.int32(NX + P - 1))
+        @pl.when(t == np.int32(nsteps - 1))
         def _finish():
             dh, dl = _renorm2(dacc_p[...], dacc_e[...])
             dot_ref[...] = jnp.concatenate([dh, dl], axis=1)
@@ -451,22 +493,36 @@ def _cx_rows_df(op: KronLaplacianDF, NX: int) -> jnp.ndarray:
 
 
 def _kron_cg_df_call(op: KronLaplacianDF, coeffs, update_p: bool,
-                     interpret, *vectors):
+                     interpret, *vectors, cx=None, aux=None):
     """update_p: vectors = (r: DF, p_prev: DF, beta4: (1,4)) ->
     (p: DF, y: DF, <p, A p>: scalar DF).
-    else: vectors = (x: DF) -> (y: DF, <x, A x>: scalar DF)."""
+    else: vectors = (x: DF) -> (y: DF, <x, A x>: scalar DF).
+
+    With `cx`/`aux` given (the distributed form, dist.kron_cg_df),
+    vectors are halo-extended (NX + 2P, NY, NZ) DF slabs, `cx` carries
+    the per-shard 8nb-channel x-coefficient rows, `aux` the per-plane
+    [interior-in-x, dot-ownership] pairs; outputs stay (NX, NY, NZ)."""
     P = op.degree
-    NX, NY, NZ = _grid_shape(op)
+    halo = 0 if cx is None else P
+    if halo == 0:
+        NX, NY, NZ = _grid_shape(op)
+    else:
+        NXe, NY, NZ = (int(d) for d in vectors[0].hi.shape)
+        NX = NXe - 2 * P
     nb = 2 * P + 1
     ckz, cmz, cky, cmy, cx_rows = coeffs
+    if cx is not None:
+        cx_rows = cx
     dtype = jnp.float32
-    nsteps = NX + P
+    lag = P + halo
+    n_in = NX + 2 * halo
+    nsteps = n_in if halo else NX + P
 
     def clamp_in(t):
-        return (jax.lax.min(t, np.int32(NX - 1)), 0, 0)
+        return (jax.lax.min(t, np.int32(n_in - 1)), 0, 0)
 
     def clamp_out(t):
-        return (jax.lax.clamp(np.int32(0), t - np.int32(P),
+        return (jax.lax.clamp(np.int32(0), t - np.int32(lag),
                               np.int32(NX - 1)), 0, 0)
 
     plane_spec_in = pl.BlockSpec((1, NY, NZ), clamp_in,
@@ -491,15 +547,19 @@ def _kron_cg_df_call(op: KronLaplacianDF, coeffs, update_p: bool,
         operands.append(c)
     for j in range(nb):
         def cx_map(t, j=j):
-            # view j: the row of output i = t + (j - P), clamped; writes
-            # to out-of-range i are gated in-kernel
+            # view j: the row of output i = (t - halo) + (j - P),
+            # clamped; writes to out-of-range i are gated in-kernel
             return (jax.lax.clamp(np.int32(0),
-                                  t + np.int32(j - P),
+                                  t + np.int32(j - P - halo),
                                   np.int32(NX - 1)), 0, 0)
 
         in_specs.append(pl.BlockSpec((1, 1, 8 * nb), cx_map,
                                      memory_space=pltpu.SMEM))
         operands.append(cx_rows)
+    if halo:
+        in_specs.append(pl.BlockSpec((1, 1, 2), clamp_out,
+                                     memory_space=pltpu.SMEM))
+        operands.append(aux)
     in_specs.append(pl.BlockSpec((1, 4), lambda t: (0, 0),
                                  memory_space=pltpu.SMEM))
     operands.append(beta4)
@@ -508,7 +568,8 @@ def _kron_cg_df_call(op: KronLaplacianDF, coeffs, update_p: bool,
     out_shapes = []
     if update_p:
         def clamp_p_out(t):
-            return (jax.lax.min(t, np.int32(NX - 1)), 0, 0)
+            return (jax.lax.clamp(np.int32(0), t - np.int32(halo),
+                                  np.int32(NX - 1)), 0, 0)
 
         out_specs += [pl.BlockSpec((1, NY, NZ), clamp_p_out,
                                    memory_space=pltpu.VMEM)] * 2
@@ -519,7 +580,7 @@ def _kron_cg_df_call(op: KronLaplacianDF, coeffs, update_p: bool,
                                   memory_space=pltpu.VMEM))
     out_shapes.append(jax.ShapeDtypeStruct((1, 2), dtype))
 
-    kernel = _make_kron_cg_df_kernel(P, NX, NY, NZ, update_p)
+    kernel = _make_kron_cg_df_kernel(P, NX, NY, NZ, update_p, halo=halo)
     out = pl.pallas_call(
         kernel,
         grid=(nsteps,),
@@ -1017,17 +1078,20 @@ def _beta4(beta: DF) -> jnp.ndarray:
     ).reshape(1, 4)
 
 
-def fused_cg_solve_df(engine, b: DF, nreps: int, update=None) -> DF:
+def fused_cg_solve_df(engine, b: DF, nreps: int, update=None,
+                      inner=None, done0=None) -> DF:
     """Shared df driver loop, mirroring la.cg.fused_cg_solve: the engine
     performs p-update/apply/alpha-dot in one kernel; x/r updates and
     <r, r> run as XLA df passes, or through `update(x, p, r, y, alpha)
     -> (x1, r1, <r1, r1>)` (the chunked pallas df pass for very large
-    problems). Includes ops.kron_df.cg_solve_df's df-floor freeze so
-    small fixed-budget problems don't amplify noise past the df64
-    residual floor."""
+    problems). `inner` overrides the inner product (the distributed
+    engine passes an owned-dof-masked compensated psum dot). Includes
+    ops.kron_df.cg_solve_df's df-floor freeze so small fixed-budget
+    problems don't amplify noise past the df64 residual floor."""
+    dot = df_dot if inner is None else inner
     floor = jnp.float32(1e-24)
     x0 = df_zeros_like(b)
-    rnorm0 = df_dot(b, b)
+    rnorm0 = dot(b, b)
     rnorm0_hi = rnorm0.hi
 
     def body(_, state):
@@ -1037,7 +1101,7 @@ def fused_cg_solve_df(engine, b: DF, nreps: int, update=None) -> DF:
         if update is None:
             x1 = df_axpy(x, alpha, p)
             r1 = df_sub(r, df_scale(y, alpha))
-            rnorm1 = df_dot(r1, r1)
+            rnorm1 = dot(r1, r1)
         else:
             x1, r1, rnorm1 = update(x, p, r, y, alpha)
         beta1 = df_div(rnorm1, rnorm)
@@ -1052,7 +1116,8 @@ def fused_cg_solve_df(engine, b: DF, nreps: int, update=None) -> DF:
                 keep(beta1, beta), keep(rnorm1, rnorm), done1)
 
     zero = DF(jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32))
-    state = (x0, b, df_zeros_like(b), zero, rnorm0, jnp.asarray(False))
+    state = (x0, b, df_zeros_like(b), zero, rnorm0,
+             jnp.asarray(False) if done0 is None else done0)
     x, *_ = jax.lax.fori_loop(0, nreps, body, state)
     return x
 

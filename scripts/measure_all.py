@@ -220,7 +220,10 @@ print("DIST1:", res.gdof_per_second, res.extra)
 
 def stage_dfdist1():
     # distributed df32 path compile+run on a 1-device mesh (the sharded
-    # dist.kron_df graph end to end; multi-chip perf needs real hardware)
+    # graph end to end; multi-chip perf needs real hardware). With the
+    # fused dist df engine landed, run_distributed_df64 auto-routes
+    # through it on TPU — the Mosaic compile check the CPU suite cannot
+    # give; extras record cg_engine / any recorded fallback reason.
     code = """
 import jax, jax.numpy as jnp
 from bench_tpu_fem.bench.driver import BenchConfig, BenchmarkResults

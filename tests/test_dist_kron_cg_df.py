@@ -1,0 +1,113 @@
+"""Distributed fused df32 CG engine (dist.kron_cg_df) on the 8-virtual-CPU
+mesh: the halo-form df delay-ring kernel vs the unfused dist df path
+(dist.kron_df, itself matched against the single-chip df operator in
+tests/test_dist_df64.py). df tolerances (~1e-12 relative)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bench_tpu_fem.dist.kron_cg_df import supports_dist_df_engine
+from bench_tpu_fem.dist.kron_df import (
+    build_dist_kron_df,
+    make_kron_df_rhs_fn,
+    make_kron_df_sharded_fns,
+)
+from bench_tpu_fem.dist.mesh import make_device_grid
+from bench_tpu_fem.elements.tables import build_operator_tables
+from bench_tpu_fem.la.df64 import df_to_f64
+
+pytestmark = pytest.mark.slow  # interpret-mode df kernels on 8 devices
+
+
+def _setup(dshape, degree, n):
+    dgrid = make_device_grid(dshape=dshape)
+    t = build_operator_tables(degree, 1, "gll")
+    op = build_dist_kron_df(n, dgrid, degree, 1, tables=t)
+    b = jax.jit(make_kron_df_rhs_fn(op, dgrid, t))()
+    return dgrid, op, b
+
+
+@pytest.mark.parametrize("dshape,degree,n",
+                         [((4, 1, 1), 3, (8, 2, 2)),
+                          ((8, 1, 1), 2, (16, 2, 2))])
+def test_dist_df_engine_apply_matches_unfused(dshape, degree, n):
+    dgrid, op, b = _setup(dshape, degree, n)
+    a_e, _, _, _ = make_kron_df_sharded_fns(op, dgrid, nreps=1,
+                                            engine=True)
+    a_u, _, _, _ = make_kron_df_sharded_fns(op, dgrid, nreps=1,
+                                            engine=False)
+    ye = df_to_f64(jax.jit(a_e)(b, op))
+    yu = df_to_f64(jax.jit(a_u)(b, op))
+    rel = np.linalg.norm(ye - yu) / np.linalg.norm(yu)
+    assert rel < 5e-13
+
+
+def test_dist_df_engine_cg_matches_unfused():
+    dgrid, op, b = _setup((4, 1, 1), 3, (8, 2, 2))
+    _, cg_e, _, _ = make_kron_df_sharded_fns(op, dgrid, nreps=8,
+                                             engine=True)
+    _, cg_u, _, _ = make_kron_df_sharded_fns(op, dgrid, nreps=8,
+                                             engine=False)
+    xe = df_to_f64(jax.jit(cg_e)(b, op))
+    xu = df_to_f64(jax.jit(cg_u)(b, op))
+    rel = np.linalg.norm(xe - xu) / np.linalg.norm(xu)
+    assert rel < 1e-11
+
+
+def test_dist_df_engine_cg_matches_single_chip_engine():
+    """Sharded fused df CG vs the single-chip fused df CG on the same
+    global problem (sizing pinned so serial and sharded grids
+    coincide)."""
+    from bench_tpu_fem.dist.operator import unshard_grid_blocks
+    from bench_tpu_fem.mesh.box import create_box_mesh
+    from bench_tpu_fem.ops.kron_cg_df import kron_cg_df_solve
+    from bench_tpu_fem.ops.kron_df import (
+        build_kron_laplacian_df,
+        device_rhs_uniform_df,
+    )
+
+    degree, n = 3, (8, 2, 2)
+    dgrid, op, b = _setup((4, 1, 1), degree, n)
+    _, cg_e, _, _ = make_kron_df_sharded_fns(op, dgrid, nreps=8,
+                                             engine=True)
+    xe = df_to_f64(jax.jit(cg_e)(b, op))  # (Dx,Dy,Dz,Lx,Ly,Lz) combined
+    xe_g = unshard_grid_blocks(np.asarray(xe), n, degree, dgrid.dshape)
+
+    t = build_operator_tables(degree, 1, "gll")
+    mesh = create_box_mesh(n)
+    op1 = build_kron_laplacian_df(mesh, degree, 1, "gll", tables=t)
+    b1 = device_rhs_uniform_df(t, mesh.n)
+    x1 = df_to_f64(kron_cg_df_solve(op1, b1, 8, interpret=True))
+    rel = np.linalg.norm(xe_g - x1) / np.linalg.norm(x1)
+    assert rel < 1e-11
+
+
+def test_dist_df_engine_seams_stay_consistent():
+    """Duplicated seam planes of the CG iterates must agree across
+    owners (the folded seam refresh makes this structural: the owner's
+    copy overwrites the ghost each iteration)."""
+    degree, n = 3, (8, 2, 2)
+    dgrid, op, b = _setup((4, 1, 1), degree, n)
+    _, cg_e, _, _ = make_kron_df_sharded_fns(op, dgrid, nreps=6,
+                                             engine=True)
+    xe = jax.jit(cg_e)(b, op)
+    hi = np.asarray(xe.hi)
+    lo = np.asarray(xe.lo)
+    D = dgrid.dshape[0]
+    for d in range(1, D):
+        # shard d's ghost plane 0 duplicates shard d-1's last plane
+        np.testing.assert_allclose(hi[d, 0, 0, 0], hi[d - 1, 0, 0, -1],
+                                   rtol=0, atol=0)
+        np.testing.assert_allclose(lo[d, 0, 0, 0], lo[d - 1, 0, 0, -1],
+                                   rtol=0, atol=1e-12)
+
+
+def test_dist_df_engine_support_gate():
+    dgrid, op, b = _setup((4, 1, 1), 3, (8, 2, 2))
+    assert supports_dist_df_engine(op)
+    dgrid2 = make_device_grid(dshape=(2, 2, 2))
+    t = build_operator_tables(3, 1, "gll")
+    op2 = build_dist_kron_df((4, 4, 4), dgrid2, 3, 1, tables=t)
+    assert not supports_dist_df_engine(op2)  # x-only meshes only

@@ -207,6 +207,38 @@ def test_kron_df_engine_specs(recorder, degree, chunked):
     recorder.check()
 
 
+def test_dist_kron_df_engine_specs(recorder):
+    """The distributed fused df engine (dist.kron_cg_df): the halo-form
+    df kernel's specs, via the per-shard apply on a 4-device x mesh."""
+    from functools import partial
+
+    from jax.sharding import PartitionSpec as P
+
+    from bench_tpu_fem.dist.kron_cg_df import dist_kron_df_apply_ring_local
+    from bench_tpu_fem.dist.kron_df import build_dist_kron_df
+    from bench_tpu_fem.dist.mesh import AXIS_NAMES, make_device_grid
+    from bench_tpu_fem.elements.tables import build_operator_tables
+    from bench_tpu_fem.la.df64 import DF
+
+    dgrid = make_device_grid(dshape=(4, 1, 1))
+    t = build_operator_tables(3, 1, "gll")
+    op = build_dist_kron_df((8, 2, 2), dgrid, 3, 1, tables=t)
+
+    @partial(jax.shard_map, mesh=dgrid.mesh,
+             in_specs=(P(*AXIS_NAMES), P(*AXIS_NAMES), P()),
+             out_specs=P(*AXIS_NAMES), check_vma=False)
+    def run(xh, xl, A):
+        y = dist_kron_df_apply_ring_local(
+            A, DF(xh[0, 0, 0], xl[0, 0, 0]))
+        return y.hi[None, None, None]
+
+    Lx, LY, LZ = op.L
+    xh = _rand((4, 1, 1, Lx, LY, LZ))
+    xl = _rand((4, 1, 1, Lx, LY, LZ))
+    jax.jit(run)(xh, xl, op)
+    recorder.check()
+
+
 def test_kron_df_update_pass_specs(recorder):
     from bench_tpu_fem.la.df64 import DF
     from bench_tpu_fem.ops.kron_cg_df import cg_update_df_pallas
