@@ -20,3 +20,10 @@ Layer map (mirrors SURVEY.md section 1):
 """
 
 __version__ = "0.1.0"
+
+# Older-jax API shims (jax.shard_map / lax.pcast names; no-op on current
+# jax) — must run before any dist module touches the attributes.
+from .utils.jax_compat import apply_compat_shims as _apply_compat_shims
+
+_apply_compat_shims()
+del _apply_compat_shims

@@ -99,21 +99,18 @@ def _cview_to_folded(v: jnp.ndarray, layout: FoldedLayout) -> jnp.ndarray:
     )
 
 
-def folded_halo_refresh(x: jnp.ndarray, layout: FoldedLayout) -> jnp.ndarray:
-    """Fill ghost-column (i=0) slots from the right neighbour along each
-    axis (the forward scatter, owner -> ghost). The last shard keeps its own
-    ghost column: those slots are the owned global boundary plane. Payloads
-    span the full refreshed cross-section, so later axes carry earlier
-    axes' ghost data into edge/corner slots transitively. Depends only on
-    the input — never on operator output — so the whole chain can run
-    behind the main kernel."""
-    v = _cview(x, layout)
+def _halo_refresh_view(v: jnp.ndarray, lead: int) -> jnp.ndarray:
+    """Owner -> ghost refresh on a 6D cell view with `lead` extra leading
+    (channel) axes — one ppermute per sharded axis carrying ALL leading
+    channels in a single stacked payload (the dist.kron_cg_df
+    stacked-channel pattern). Shared by the f32 (lead=0) and df (lead=1,
+    stacked hi/lo) forms."""
     for ax, name in zip(range(3), AXIS_NAMES):
         n = lax.axis_size(name)
         if n == 1:
             continue
-        cax = 3 + ax  # cell axis in the 6D view
-        iax = ax  # local dof index axis
+        cax = lead + 3 + ax  # cell axis in the view
+        iax = lead + ax  # local dof index axis
         # payload: the (c_ax = 0, i_ax = 0) slab, all other dims full
         payload = lax.index_in_dim(
             lax.index_in_dim(v, 0, axis=iax, keepdims=True), 0, axis=cax,
@@ -134,20 +131,31 @@ def folded_halo_refresh(x: jnp.ndarray, layout: FoldedLayout) -> jnp.ndarray:
         )
         rest = lax.slice_in_dim(v, 1, v.shape[iax], axis=iax)
         v = jnp.concatenate([islab, rest], axis=iax)
-    return _from_cview(v, x, layout)
+    return v
 
 
-def folded_reverse_scatter(y: jnp.ndarray, layout: FoldedLayout) -> jnp.ndarray:
-    """Send ghost-column seam partials to the owning right neighbour and
-    accumulate (ghost -> owner). Non-last shards' ghost columns are zeroed;
-    the last shard's ghost column holds owned boundary dofs and is kept."""
-    v = _cview(y, layout)
+def folded_halo_refresh(x: jnp.ndarray, layout: FoldedLayout) -> jnp.ndarray:
+    """Fill ghost-column (i=0) slots from the right neighbour along each
+    axis (the forward scatter, owner -> ghost). The last shard keeps its own
+    ghost column: those slots are the owned global boundary plane. Payloads
+    span the full refreshed cross-section, so later axes carry earlier
+    axes' ghost data into edge/corner slots transitively. Depends only on
+    the input — never on operator output — so the whole chain can run
+    behind the main kernel."""
+    return _from_cview(_halo_refresh_view(_cview(x, layout), 0), x, layout)
+
+
+def _reverse_scatter_view(v: jnp.ndarray, lead: int, add) -> jnp.ndarray:
+    """Ghost -> owner seam accumulation on a 6D cell view with `lead`
+    leading channel axes; `add` combines the owner's first-column slab
+    with the received partials (plain + for f32, a channel-split df_add
+    for the stacked df form — channel-wise adds would drop carries)."""
     for ax, name in zip(range(3), AXIS_NAMES):
         n = lax.axis_size(name)
         if n == 1:
             continue
-        cax = 3 + ax
-        iax = ax
+        cax = lead + 3 + ax
+        iax = lead + ax
         idx = lax.axis_index(name)
         last = v.shape[cax] - 1
         islab = lax.index_in_dim(v, 0, axis=iax, keepdims=True)
@@ -155,7 +163,7 @@ def folded_reverse_scatter(y: jnp.ndarray, layout: FoldedLayout) -> jnp.ndarray:
         contrib = jnp.where(idx == n - 1, jnp.zeros_like(ghost), ghost)
         recv = _shift_from_left(contrib, name)  # zeros on shard 0
         first = lax.index_in_dim(islab, 0, axis=cax, keepdims=True)
-        new_first = first + recv
+        new_first = add(first, recv)
         new_ghost = jnp.where(idx == n - 1, ghost, jnp.zeros_like(ghost))
         islab = jnp.concatenate(
             [new_first, lax.slice_in_dim(islab, 1, last, axis=cax), new_ghost],
@@ -163,6 +171,14 @@ def folded_reverse_scatter(y: jnp.ndarray, layout: FoldedLayout) -> jnp.ndarray:
         )
         rest = lax.slice_in_dim(v, 1, v.shape[iax], axis=iax)
         v = jnp.concatenate([islab, rest], axis=iax)
+    return v
+
+
+def folded_reverse_scatter(y: jnp.ndarray, layout: FoldedLayout) -> jnp.ndarray:
+    """Send ghost-column seam partials to the owning right neighbour and
+    accumulate (ghost -> owner). Non-last shards' ghost columns are zeroed;
+    the last shard's ghost column holds owned boundary dofs and is kept."""
+    v = _reverse_scatter_view(_cview(y, layout), 0, lambda a, b: a + b)
     return _from_cview(v, y, layout)
 
 
@@ -667,6 +683,346 @@ def make_folded_sharded_fns(op: DistFoldedLaplacian, dgrid, nreps: int):
         )
 
     return apply_fn, cg_fn, norm_fn, sharded_state
+
+
+# ---------------------------------------------------------------------------
+# Double-float (df64) sharded variant: f64-class CG on perturbed sharded
+# meshes (the distributed tail of ops.folded_df). Deliberately UNFUSED and
+# halo-first: the df pass refreshes ghosts, runs ONE full-volume unfused df
+# kernel pass on the refreshed vector (by linearity identical to the f32
+# path's interior + ghost-epilogue split, without df epilogue state), then
+# reverse-scatters seam partials with compensated adds. The f32 path's
+# comm/compute overlap decomposition is traded away: this is the
+# accuracy/capacity path, and the halo is O(surface) against an
+# arithmetic-heavy O(volume) df apply. Channels ride the halo as ONE
+# stacked ppermute payload per axis (the dist.kron_cg_df 4-channel
+# pattern, here 2 channels per vector), which also makes ghost copies
+# owner-consistent by construction — the df-specific requirement
+# dist.kron_df derived (compiled df chains round lo bits
+# position-dependently, so df seams cannot rely on bitwise replay).
+# ---------------------------------------------------------------------------
+
+
+def folded_halo_refresh_df(x, layout: FoldedLayout):
+    """df halo refresh: both channels in one stacked ppermute payload per
+    sharded axis; ghost slots become owner copies by construction."""
+    from ..la.df64 import DF
+
+    vs = jnp.stack([_cview(x.hi, layout), _cview(x.lo, layout)])
+    vs = _halo_refresh_view(vs, 1)
+    return DF(_from_cview(vs[0], x.hi, layout),
+              _from_cview(vs[1], x.lo, layout))
+
+
+def folded_reverse_scatter_df(y, layout: FoldedLayout):
+    """df seam reverse scatter: ghost partials accumulate into the owner
+    with a df_add (channel-wise adds would drop the two_sum carries)."""
+    from ..la.df64 import DF, df_add
+
+    def dfadd(a, b):
+        s = df_add(DF(a[0], a[1]), DF(b[0], b[1]))
+        return jnp.stack([s.hi, s.lo])
+
+    vs = jnp.stack([_cview(y.hi, layout), _cview(y.lo, layout)])
+    vs = _reverse_scatter_view(vs, 1, dfadd)
+    return DF(_from_cview(vs[0], y.hi, layout),
+              _from_cview(vs[1], y.lo, layout))
+
+
+@partial(
+    jax.tree_util.register_dataclass,
+    data_fields=["Gh", "Gl", "ch", "cl", "cmask", "bc_mask", "owned"],
+    meta_fields=["n_local", "degree", "nl", "is_identity", "kappa",
+                 "dshape", "phi0_c", "dphi1_c", "pts_c", "wts_c"],
+)
+@dataclass(frozen=True)
+class DistFoldedLaplacianDF:
+    """Stacked per-shard folded df operator state (leading (Dx, Dy, Dz)
+    axes sharded). Geometry is a per-shard blocked df G pair or df corner
+    pairs + mask, as in ops.folded_df; masks are the f32 builder's
+    closed-form per-shard arrays."""
+
+    Gh: jnp.ndarray | None
+    Gl: jnp.ndarray | None
+    ch: jnp.ndarray | None
+    cl: jnp.ndarray | None
+    cmask: jnp.ndarray | None
+    bc_mask: jnp.ndarray  # (Dx,Dy,Dz, nb, P^3, B) 0/1, f32
+    owned: jnp.ndarray  # (Dx,Dy,Dz, nb, P^3, B) bool
+    n_local: tuple[int, int, int]
+    degree: int
+    nl: int
+    is_identity: bool
+    kappa: float
+    dshape: tuple[int, int, int] = (1, 1, 1)
+    phi0_c: tuple = ()
+    dphi1_c: tuple = ()
+    pts_c: tuple = ()
+    wts_c: tuple = ()
+
+    @property
+    def layout(self) -> FoldedLayout:
+        return FoldedLayout(n=self.n_local, degree=self.degree, nl=self.nl)
+
+    @property
+    def geom_tables(self):
+        if self.Gh is not None:
+            return None
+        return (np.asarray(self.pts_c), np.asarray(self.wts_c))
+
+    def apply_local(self, x, state):
+        """y = A x for one shard's df pair (inside shard_map): halo-first
+        (see section comment), one full-volume unfused df pass, seam
+        reverse scatter, Dirichlet blend via exact 0/1-mask selects."""
+        from ..la.df64 import DF
+        from ..ops.folded_df import folded_cell_apply_df
+
+        geom, bc = state
+        layout = self.layout
+        xr = folded_halo_refresh_df(x, layout)
+        nbm = 1.0 - bc
+        xm = DF(xr.hi * nbm, xr.lo * nbm)
+        y = folded_cell_apply_df(
+            xm, geom, layout,
+            np.asarray(self.phi0_c, np.float64),
+            np.asarray(self.dphi1_c, np.float64),
+            self.is_identity, self.kappa,
+            geom_tables=self.geom_tables,
+        )
+        y = folded_reverse_scatter_df(y, layout)
+        return DF(y.hi * nbm + bc * xr.hi, y.lo * nbm + bc * xr.lo)
+
+
+def build_dist_folded_df(
+    mesh: BoxMesh,
+    dgrid,
+    degree: int,
+    tables: OperatorTables,
+    kappa: float = 2.0,
+    nl: int | None = None,
+    geom: str = "auto",
+) -> DistFoldedLaplacianDF:
+    """Build stacked per-shard folded df state: per-shard f64 host
+    geometry split into (hi, lo) channels (ops.folded_df helpers), the
+    f32 builder's closed-form per-shard bc/owned masks. O(local) host
+    work per shard plus the corner-array slices."""
+    from ..ops.folded_df import (
+        auto_geom_df,
+        folded_df_plan,
+        host_blocked_G_df,
+        split_corner_arrays_df,
+    )
+
+    t = tables
+    dshape = dgrid.dshape
+    ncl = shard_cells(mesh.n, dshape)
+    if geom not in ("auto", "corner", "g"):
+        raise ValueError(f"unknown geom mode {geom!r}")
+    if nl is None and geom != "g":
+        forced = folded_df_plan(degree, t.nq)[1]
+        if forced is not None:
+            geom = forced
+    layout = make_layout(ncl, degree, t.nq, 4, nl=nl)
+    check_tpu_lane_support(layout, degree, t.qmode)
+    if geom == "auto":
+        geom = auto_geom_df(layout, t.nq)
+
+    corners_all = mesh.cell_corners
+    shp = dshape
+
+    def shard_corner_block(pos):
+        return corners_all[tuple(
+            slice(pos[ax] * ncl[ax], (pos[ax] + 1) * ncl[ax])
+            for ax in range(3)
+        )]
+
+    stack = lambda builder, shape: np.stack([  # noqa: E731
+        np.stack([
+            np.stack([builder((i, j, k)) for k in range(shp[2])])
+            for j in range(shp[1])
+        ]) for i in range(shp[0])
+    ]).reshape(*shp, *shape)
+
+    from jax.sharding import NamedSharding, PartitionSpec as Pspec
+
+    sharding = NamedSharding(dgrid.mesh, Pspec(*AXIS_NAMES))
+
+    def put(a):
+        return jax.device_put(a, sharding)
+
+    Gh = Gl = ch = cl = cm = None
+    parts = []
+    for i in range(shp[0]):
+        for j in range(shp[1]):
+            for k in range(shp[2]):
+                ccs, mcs = ghost_corner_arrays(
+                    layout, shard_corner_block((i, j, k))
+                )
+                if geom == "corner":
+                    parts.append(split_corner_arrays_df(ccs, mcs, layout))
+                else:
+                    parts.append(host_blocked_G_df(ccs, mcs, layout, t,
+                                                   kappa))
+    if geom == "corner":
+        ch = put(np.stack([p[0] for p in parts]).reshape(
+            *shp, *parts[0][0].shape))
+        cl = put(np.stack([p[1] for p in parts]).reshape(
+            *shp, *parts[0][1].shape))
+        cm = put(np.stack([p[2] for p in parts]).reshape(
+            *shp, *parts[0][2].shape))
+    else:
+        Gh = put(np.stack([p[0] for p in parts]).reshape(
+            *shp, *parts[0][0].shape))
+        Gl = put(np.stack([p[1] for p in parts]).reshape(
+            *shp, *parts[0][1].shape))
+
+    bcf = put(stack(
+        lambda pos: np.asarray(fold_vector(
+            _local_grid_marker(layout, pos, dshape, mesh.n).astype(
+                np.float64), layout)),
+        layout.vec_shape,
+    ).astype(np.float32))
+    owned = put(stack(
+        lambda pos: owned_folded_mask(layout, pos, dshape),
+        layout.vec_shape,
+    ))
+    return DistFoldedLaplacianDF(
+        Gh=Gh, Gl=Gl, ch=ch, cl=cl, cmask=cm,
+        bc_mask=bcf,
+        owned=owned,
+        n_local=tuple(ncl),
+        degree=degree,
+        nl=layout.nl,
+        is_identity=t.is_identity,
+        kappa=float(kappa),
+        dshape=tuple(dshape),
+        phi0_c=freeze_table(t.phi0),
+        dphi1_c=freeze_table(t.dphi1),
+        pts_c=tuple(float(v) for v in t.pts1d),
+        wts_c=tuple(float(v) for v in t.wts1d),
+    )
+
+
+def shard_folded_vectors_df(grid: np.ndarray, n, degree: int, dshape,
+                            layout: FoldedLayout):
+    """f64 global dof grid -> stacked per-shard folded DF pairs (host
+    split, then the f32 shard transport per channel)."""
+    from ..la.df64 import DF
+
+    hi = np.asarray(grid, np.float32)
+    lo = np.asarray(grid - np.asarray(hi, np.float64), np.float32)
+    return DF(
+        jnp.asarray(shard_folded_vectors(hi, n, degree, dshape, layout)),
+        jnp.asarray(shard_folded_vectors(lo, n, degree, dshape, layout)),
+    )
+
+
+def make_folded_df_sharded_fns(op: DistFoldedLaplacianDF, dgrid,
+                               nreps: int):
+    """Jittable sharded df callables (apply, CG, norm, norms_from,
+    sharded_state) over folded df shards — the df twin of
+    make_folded_sharded_fns, with owned-masked compensated dots folded
+    cross-shard via dist.kron_df.df_psum_all."""
+    from jax.sharding import PartitionSpec as P
+
+    from ..la.df64 import (
+        DF,
+        _prod_terms,
+        df_add,
+        df_axpy,
+        df_div,
+        df_scale,
+        df_sub,
+        df_sum,
+        df_zeros_like,
+    )
+    from .kron_df import df_psum_all
+
+    spec = P(*AXIS_NAMES)
+    rep = P()
+
+    def _local(a):
+        return jax.tree_util.tree_map(lambda x: x[0, 0, 0], a)
+
+    def sharded_state(A):
+        geom = ((A.Gh, A.Gl) if A.Gh is not None
+                else (A.ch, A.cl, A.cmask))
+        return (geom, A.bc_mask)
+
+    def _dot(owned):
+        m = owned.astype(jnp.float32)
+
+        def dot(u, v):
+            uw = DF(u.hi * m, u.lo * m)
+            return df_psum_all(df_sum(DF(*_prod_terms(uw, v))), op.dshape)
+
+        return dot
+
+    # check_vma=False for the same reason as the f32 folded fns: every
+    # computation runs the Pallas kernel, whose outputs carry no
+    # varying-mesh-axes annotation.
+    @partial(jax.shard_map, mesh=dgrid.mesh,
+             in_specs=(spec, spec), out_specs=spec, check_vma=False)
+    def apply_fn(x, state):
+        y = op.apply_local(_local(x), _local(state))
+        return DF(y.hi[None, None, None], y.lo[None, None, None])
+
+    @partial(jax.shard_map, mesh=dgrid.mesh,
+             in_specs=(spec, spec, spec), out_specs=spec, check_vma=False)
+    def cg_fn(b, state, owned):
+        bl = _local(b)
+        sl = _local(state)
+        dot = _dot(_local(owned))
+        floor = jnp.float32(1e-24)
+        rnorm0 = dot(bl, bl)
+        rnorm0_hi = rnorm0.hi
+
+        def body(_, st):
+            x, r, p, rnorm, done = st
+            y = op.apply_local(p, sl)
+            alpha = df_div(rnorm, dot(p, y))
+            x1 = df_axpy(x, alpha, p)
+            r1 = df_sub(r, df_scale(y, alpha))
+            rnorm1 = dot(r1, r1)
+            beta = df_div(rnorm1, rnorm)
+            p1 = df_add(df_scale(p, beta), r1)
+            done1 = jnp.logical_or(done, rnorm1.hi <= floor * rnorm0_hi)
+
+            def keep(new, old):
+                return jax.tree_util.tree_map(
+                    lambda nw, o: jnp.where(done, o, nw), new, old
+                )
+
+            return (keep(x1, x), keep(r1, r), keep(p1, p),
+                    keep(rnorm1, rnorm), done1)
+
+        # `done` derives from the gathered dots (device-varying under the
+        # VMA system); the initial carry must match — the dist.kron_df
+        # pcast idiom.
+        done0 = lax.pcast(jnp.asarray(False), AXIS_NAMES, to="varying")
+        st = (df_zeros_like(bl), bl, bl, rnorm0, done0)
+        x, *_ = jax.lax.fori_loop(0, nreps, body, st)
+        return DF(x.hi[None, None, None], x.lo[None, None, None])
+
+    @partial(jax.shard_map, mesh=dgrid.mesh, in_specs=(spec, spec),
+             out_specs=rep, check_vma=False)
+    def norm_fn(x, owned):
+        """[<x,x>.hi, <x,x>.lo, Linf] over owned dofs; the hi+lo
+        recombination and sqrt happen in the caller's Python f64
+        (norms_from) because df mode keeps x64 off."""
+        xl, ol = _local(x), _local(owned)
+        d = _dot(ol)(xl, xl)
+        linf = lax.pmax(
+            jnp.max(jnp.abs(xl.hi + xl.lo) * ol.astype(jnp.float32)),
+            AXIS_NAMES,
+        )
+        return jnp.stack([d.hi, d.lo, linf])
+
+    def norms_from(triple):
+        hi, lo, linf = (float(v) for v in np.asarray(triple))
+        return float(np.sqrt(max(hi + lo, 0.0))), linf
+
+    return apply_fn, cg_fn, norm_fn, norms_from, sharded_state
 
 
 def make_folded_rhs_fn(op: DistFoldedLaplacian, dgrid,

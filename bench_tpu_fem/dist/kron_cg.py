@@ -2,7 +2,10 @@
 
 The single-chip fused engine (ops.kron_cg) is worth ~1.4x over the unfused
 3-stage composition on a v5e chip (9.14 vs 6.35 GDoF/s at the 12.5M-dof
-flagship config) because the CG iteration is HBM-stream-bound. This module
+flagship config — ROUND-4 measurement of the f32 engine,
+BASELINE_MATRIX_r04.json; the distributed form below and every df
+variant are design-stage and unmeasured on hardware) because the CG
+iteration is HBM-stream-bound. This module
 carries that engine to x-axis-sharded device meshes (`dshape = (D, 1, 1)`,
 the natural decomposition for the plane-sequential delay ring):
 

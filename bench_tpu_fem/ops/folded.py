@@ -261,6 +261,42 @@ def _make_folded_kernel(P: int, nl: int, is_identity: bool,
     return kernel
 
 
+def window_slabs(xm: jnp.ndarray, layout: FoldedLayout) -> tuple:
+    """(nb, P^3, B) folded vector -> the v1 window-slab set: the flat-c 4D
+    main view (P, P, P, Lv) plus the 7 shifted slab classes (pad + static
+    slices; a traced transpose). Shared by the f32 v1 pipeline and the df
+    pipeline (ops.folded_df), which runs it once per (hi, lo) channel."""
+    P = layout.degree
+    Lv = layout.lv
+    Sx, Sy, Sz = layout.shifts
+    S7 = Sx + Sy + Sz
+    xm = jnp.transpose(xm, (1, 0, 2)).reshape(layout.vec4_shape)
+    xp = jnp.pad(xm, [(0, 0)] * 3 + [(0, S7)])
+    ux = jax.lax.slice(xp[0], (0, 0, Sx), (P, P, Sx + Lv))
+    uy = jax.lax.slice(xp[:, 0], (0, 0, Sy), (P, P, Sy + Lv))
+    uz = jax.lax.slice(xp[:, :, 0], (0, 0, Sz), (P, P, Sz + Lv))
+    uxy = jax.lax.slice(xp[0, 0], (0, Sx + Sy), (P, Sx + Sy + Lv))
+    uxz = jax.lax.slice(xp[0, :, 0], (0, Sx + Sz), (P, Sx + Sz + Lv))
+    uyz = jax.lax.slice(xp[:, 0, 0], (0, Sy + Sz), (P, Sy + Sz + Lv))
+    uxyz = jax.lax.slice(xp[0, 0, 0], (S7,), (S7 + Lv,))
+    return (xm, ux, uy, uz, uxy, uxz, uyz, uxyz)
+
+
+def window_slab_specs(layout: FoldedLayout) -> list:
+    """BlockSpecs matching window_slabs' operand order (one (... , B) block
+    per grid step), shared with the df pipeline."""
+    P = layout.degree
+    B = layout.block
+    spec = lambda *lead: pl.BlockSpec(  # noqa: E731
+        (*lead, B), lambda i, _n=len(lead): (0,) * _n + (i,),
+        memory_space=pltpu.VMEM,
+    )
+    return [
+        spec(P, P, P), spec(P, P), spec(P, P), spec(P, P),
+        spec(P), spec(P), spec(P), spec(),
+    ]
+
+
 def folded_cell_apply(
     xm: jnp.ndarray,  # (nb, P^3, B) masked folded vector
     geom,  # blocked G (nblocks, 6, nq,nq,nq, 8, nl) | (corners_b, mask_b)
@@ -282,26 +318,11 @@ def folded_cell_apply(
     P = layout.degree
     nq = phi0.shape[0]
     nl, B, nb, Lv = layout.nl, layout.block, layout.nblocks, layout.lv
-    Sx, Sy, Sz = layout.shifts
-    S7 = Sx + Sy + Sz
     dtype = xm.dtype
 
-    # block-major (nb, P^3, B) -> flat-c 4D (P, P, P, Lv) for the v1
-    # slab-slicing pipeline (a traced transpose; v1 is the reference path)
-    xm = jnp.transpose(xm, (1, 0, 2)).reshape(layout.vec4_shape)
-    xp = jnp.pad(xm, [(0, 0)] * 3 + [(0, S7)])
-    ux = jax.lax.slice(xp[0], (0, 0, Sx), (P, P, Sx + Lv))
-    uy = jax.lax.slice(xp[:, 0], (0, 0, Sy), (P, P, Sy + Lv))
-    uz = jax.lax.slice(xp[:, :, 0], (0, 0, Sz), (P, P, Sz + Lv))
-    uxy = jax.lax.slice(xp[0, 0], (0, Sx + Sy), (P, Sx + Sy + Lv))
-    uxz = jax.lax.slice(xp[0, :, 0], (0, Sx + Sz), (P, Sx + Sz + Lv))
-    uyz = jax.lax.slice(xp[:, 0, 0], (0, Sy + Sz), (P, Sy + Sz + Lv))
-    uxyz = jax.lax.slice(xp[0, 0, 0], (S7,), (S7 + Lv,))
+    xm, ux, uy, uz, uxy, uxz, uyz, uxyz = window_slabs(xm, layout)
 
-    spec = lambda *lead: pl.BlockSpec(  # noqa: E731
-        (*lead, B), lambda i, _n=len(lead): (0,) * _n + (i,),
-        memory_space=pltpu.VMEM,
-    )
+    wspecs = window_slab_specs(layout)
     kernel = _make_folded_kernel(
         P, nl, is_identity,
         np.asarray(phi0, np.float64), np.asarray(dphi1, np.float64),
@@ -332,15 +353,11 @@ def folded_cell_apply(
         kernel,
         grid=(nb,),
         in_specs=[
-            spec(P, P, P), spec(P, P), spec(P, P), spec(P, P),
-            spec(P), spec(P), spec(P), spec(),
+            *wspecs,
             *geom_specs,
             pl.BlockSpec((1, 1), lambda i: (0, 0), memory_space=pltpu.SMEM),
         ],
-        out_specs=[
-            spec(P, P, P), spec(P, P), spec(P, P), spec(P, P),
-            spec(P), spec(P), spec(P), spec(),
-        ],
+        out_specs=list(wspecs),
         out_shape=[
             jax.ShapeDtypeStruct((P, P, P, Lv), dtype),
             jax.ShapeDtypeStruct((P, P, Lv), dtype),

@@ -1,0 +1,686 @@
+"""Double-float folded pipeline: f64-class CG on general (perturbed)
+geometry.
+
+The reference runs its f64 matrix-free operator on *arbitrary* geometry
+(laplacian_gpu.hpp:91-426 is templated on T=double with no uniformity
+assumption); this repo's df32 fast path was kron-uniform-only, so
+perturbed `--float 64` fell back to XLA's op-by-op f64 emulation
+(~0.014x of the reference baseline, BASELINE_MATRIX_r04.json). This
+module closes that cell: the UNFUSED folded/corner pipeline of
+ops.folded restated on (hi, lo) double-float channels —
+
+- the window gather/seam structure is ops.folded's v1 pipeline run once
+  per channel (pad/slice slabs -> one pallas kernel -> XLA seam fold);
+  the data movement (transpose, pad, slice, concat) is exact per channel,
+  so only the arithmetic needed df treatment;
+- the per-cell sum-factorised contraction chain runs with error-free
+  products against 4-channel compile-time basis-table immediates and the
+  renorm-first compensated accumulation pinned by ops.kron_cg_df._acc2
+  (every term renormalised by a two_sum before it enters the running
+  sum — the one form measured to survive whole-graph optimisation);
+- geometry is df end to end: precomputed mode streams the host-f64 G
+  split into (hi, lo) blocked pairs; corner mode ships df corner pairs
+  (2 x 24 floats/cell) and runs the full Jacobian -> adjugate -> detJ ->
+  division chain in df arithmetic in-kernel (la.df64 primitives — a
+  f32-rounded geometry would cap the whole pipeline at ~1e-7 relative,
+  defeating the ~1e-12 target);
+- the seam overlap-add and CG vector algebra run as XLA df passes
+  (df_add/df_dot: channel-wise adds would drop the two_sum carries).
+
+Deliberately UNFUSED (the v1 composition, not a delay-ring engine): the
+df working set roughly doubles every VMEM-resident value and the corner
+geometry chain adds deep df temporaries, so the fused forms' VMEM
+budgets do not carry over; the unfused pipeline is the capacity- and
+accuracy-correct first form (README 'Precision policy' named exactly
+this design), with the fused df folded engine as follow-up work once
+`folded_df_plan`'s DESIGN-ESTIMATE VMEM model is hardware-calibrated
+(scripts/measure_all.py pertdf stage).
+
+Reference parity: f64 dispatch main.cpp:277-288, per-cell math
+laplacian_gpu.hpp:91-426, CG recurrence cg.hpp:89-169 (rtol = 0,
+fixed iteration count), residual floors laplacian_solver.cpp:130-148.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..elements.tables import OperatorTables, build_operator_tables
+from ..la.df64 import (
+    DF,
+    _prod_terms,
+    _split,
+    df_add,
+    df_axpy,
+    df_div,
+    df_dot,
+    df_scale,
+    df_sub,
+    df_zeros_like,
+)
+from ..mesh.box import BoxMesh
+from ..mesh.dofmap import boundary_dof_marker
+from .folded import (
+    FoldedLayout,
+    _assemble_window,
+    _r8,
+    _rb,
+    blocked_corners,
+    check_tpu_lane_support,
+    fold_vector,
+    ghost_corner_arrays,
+    make_layout,
+    window_slab_specs,
+    window_slabs,
+)
+from .kron_cg_df import _acc2, _eft_term, _renorm2
+from .laplacian import freeze_table
+from .pallas_laplacian import SUBLANES, _use_interpret
+
+
+# ---------------------------------------------------------------------------
+# df building blocks
+# ---------------------------------------------------------------------------
+
+
+def _table4(mat: np.ndarray) -> tuple:
+    """Host 4-channel split of a compile-time f64 table: per entry
+    [hi, lo, split_high(hi), split_low(hi)], the df twin of the float
+    immediates ops.pallas_laplacian._stage bakes into kernels. The Dekker
+    split is computed in numpy f32 (error-free, so it reproduces
+    la.df64._split bit-for-bit); values are returned as f64 arrays so
+    float() emits them exactly."""
+    m64 = np.asarray(mat, np.float64)
+    mhi = np.asarray(m64, np.float32)
+    mlo = np.asarray(m64 - np.asarray(mhi, np.float64), np.float32)
+    c = np.float32(4097.0) * mhi
+    mhh = c - (c - mhi)
+    mhl = mhi - mhh
+    return tuple(np.asarray(a, np.float64) for a in (mhi, mlo, mhh, mhl))
+
+
+def _stage_df(tab4: tuple, u: DF, axis: int) -> DF:
+    """Contract a compile-time table (4-channel split, see _table4)
+    against tensor axis `axis` of the df pair `u`: error-free products of
+    scalar immediates against the data channels (_eft_term) with the
+    renorm-first compensated accumulation of ops.kron_cg_df (_acc2). The
+    Dekker split of u's hi channel is computed once and sliced per term;
+    zero coefficients are skipped, preserving structural zeros exactly
+    (the df twin of ops.pallas_laplacian._stage)."""
+    mhi, mlo, mhh, mhl = tab4
+    m, n = mhi.shape
+    hh, hl = _split(u.hi)
+
+    def take(a, i):
+        idx = [slice(None)] * a.ndim
+        idx[axis] = i
+        return a[tuple(idx)]
+
+    out_h, out_l = [], []
+    for q in range(m):
+        acc = None
+        for i in range(n):
+            if mhi[q, i] == 0.0 and mlo[q, i] == 0.0:
+                continue
+            t, e = _eft_term(
+                float(mhi[q, i]), float(mlo[q, i]),
+                float(mhh[q, i]), float(mhl[q, i]),
+                take(u.hi, i), take(u.lo, i), take(hh, i), take(hl, i),
+            )
+            acc = _acc2(acc, t, e)
+        if acc is None:
+            z = jnp.zeros_like(take(u.hi, 0))
+            out_h.append(z)
+            out_l.append(z)
+        else:
+            rh, rl = _renorm2(*acc)
+            out_h.append(rh)
+            out_l.append(rl)
+    return DF(jnp.stack(out_h, axis=axis), jnp.stack(out_l, axis=axis))
+
+
+def _mul_df(a: DF, b: DF) -> DF:
+    """Renormalised df product of two runtime pairs (splits in place)."""
+    return DF(*_renorm2(*_prod_terms(a, b)))
+
+
+def _sum_df(*terms: DF) -> DF:
+    """Compensated sum of renormalised df terms (renorm-first, _acc2)."""
+    acc = None
+    for t in terms:
+        acc = _acc2(acc, t.hi, t.lo)
+    return DF(*_renorm2(*acc))
+
+
+def _dot3_df(u, v) -> DF:
+    """Compensated 3-term df dot (Jacobian-column x adjugate-row)."""
+    acc = _acc2(None, *_prod_terms(u[0], v[0]))
+    acc = _acc2(acc, *_prod_terms(u[1], v[1]))
+    acc = _acc2(acc, *_prod_terms(u[2], v[2]))
+    return DF(*_renorm2(*acc))
+
+
+def sumfact_window_apply_df(u: DF, G, phi0_t4, dphi1_t4, phi0T_t4,
+                            dphi1T_t4, is_identity: bool) -> DF:
+    """df twin of ops.pallas_laplacian.sumfact_window_apply: window cube
+    (nd, nd, nd, 8, NL) df pair x 6-component df geometry tuple ->
+    contribution cube df pair. kappa is folded into the geometry by the
+    builders (the df analogue of ops.kron_df folding kappa into the 1D
+    factors host-side: no runtime df scalar product per apply). Tables
+    arrive pre-split (_table4) so the kernel maker pays the host split
+    once."""
+    if not is_identity:
+        u = _stage_df(phi0_t4, u, 0)
+        u = _stage_df(phi0_t4, u, 1)
+        u = _stage_df(phi0_t4, u, 2)
+    du0 = _stage_df(dphi1_t4, u, 0)
+    du1 = _stage_df(dphi1_t4, u, 1)
+    du2 = _stage_df(dphi1_t4, u, 2)
+
+    def flux(a, b, c):
+        acc = _acc2(None, *_prod_terms(G[a], du0))
+        acc = _acc2(acc, *_prod_terms(G[b], du1))
+        acc = _acc2(acc, *_prod_terms(G[c], du2))
+        return DF(*_renorm2(*acc))
+
+    f0 = flux(0, 1, 2)
+    f1 = flux(1, 3, 4)
+    f2 = flux(2, 4, 5)
+    y = _sum_df(
+        _stage_df(dphi1T_t4, f0, 0),
+        _stage_df(dphi1T_t4, f1, 1),
+        _stage_df(dphi1T_t4, f2, 2),
+    )
+    if not is_identity:
+        y = _stage_df(phi0T_t4, y, 0)
+        y = _stage_df(phi0T_t4, y, 1)
+        y = _stage_df(phi0T_t4, y, 2)
+    return y
+
+
+def corner_window_G_df(corners: DF, mask, pts1d: np.ndarray,
+                       wts1d: np.ndarray, kappa: float):
+    """df twin of ops.pallas_laplacian.corner_window_G: trilinear df
+    Jacobian (compile-time shape tables, df corner pairs) -> adjugate
+    rows (df cross products) -> detJ -> scale = kappa * mask / detJ (df
+    Newton division, la.df64.df_div) with diagonal quadrature-weight
+    stages -> the 6 packed G components as df pairs. kappa is a
+    compile-time constant folded into the scale numerator (exact: mask
+    is 0/1). Ghost cells carry the unit-cube placeholder Jacobian
+    (detJ = 1 exactly, also in df) and a zero mask that zeroes their G
+    rows exactly — the same self-masking convention as the f32 kernels
+    (ops.folded.ghost_corner_arrays)."""
+    pts = np.asarray(pts1d, np.float64)
+    nq = len(pts)
+    N4 = _table4(np.stack([1.0 - pts, pts], axis=1))
+    D4 = _table4(np.broadcast_to(np.array([-1.0, 1.0]), (nq, 2)))
+    cols = []
+    for a in range(3):
+        T = [N4, N4, N4]
+        T[a] = D4
+        col = []
+        for i in range(3):
+            c = DF(corners.hi[i], corners.lo[i])  # (2, 2, 2, 8, NL)
+            c = _stage_df(T[2], c, 2)
+            c = _stage_df(T[1], c, 1)
+            c = _stage_df(T[0], c, 0)
+            col.append(c)  # (nq, nq, nq, 8, NL)
+        cols.append(col)
+
+    def cross(u, v):
+        return (
+            df_sub(_mul_df(u[1], v[2]), _mul_df(u[2], v[1])),
+            df_sub(_mul_df(u[2], v[0]), _mul_df(u[0], v[2])),
+            df_sub(_mul_df(u[0], v[1]), _mul_df(u[1], v[0])),
+        )
+
+    K = (cross(cols[1], cols[2]), cross(cols[2], cols[0]),
+         cross(cols[0], cols[1]))
+    detJ = _dot3_df(cols[0], K[0])
+    khi = float(np.float32(kappa))
+    klo = float(np.float64(kappa) - np.float64(np.float32(kappa)))
+    # kappa * mask is exact per channel (mask is 0/1)
+    scale = df_div(DF(khi * mask, klo * mask), detJ)
+    w4 = _table4(np.diag(np.asarray(wts1d, np.float64)))
+    for ax in range(3):
+        scale = _stage_df(w4, scale, ax)
+    pairs = ((0, 0), (0, 1), (0, 2), (1, 1), (1, 2), (2, 2))
+    return tuple(_mul_df(_dot3_df(K[a], K[b]), scale) for a, b in pairs)
+
+
+# ---------------------------------------------------------------------------
+# Kernel + v1 pipeline
+# ---------------------------------------------------------------------------
+
+
+def _make_folded_df_kernel(P: int, nl: int, is_identity: bool,
+                           phi0: np.ndarray, dphi1: np.ndarray,
+                           geom_tables, kappa: float):
+    """Kernel body: 16 window slab refs (8 classes x hi then lo), df
+    geometry refs, 16 contribution outputs. Mirrors
+    ops.folded._make_folded_kernel with the arithmetic in df."""
+    t_phi0 = _table4(phi0)
+    t_dphi1 = _table4(dphi1)
+    t_phi0T = _table4(np.asarray(phi0, np.float64).T)
+    t_dphi1T = _table4(np.asarray(dphi1, np.float64).T)
+    corner_mode = geom_tables is not None
+
+    def write_outs(y, refs):
+        y_ref, yx_ref, yy_ref, yz_ref, yxy_ref, yxz_ref, yyz_ref, \
+            yxyz_ref = refs
+        y_ref[...] = _rb(y[:P, :P, :P])
+        yx_ref[...] = _rb(y[P, :P, :P])
+        yy_ref[...] = _rb(y[:P, P, :P])
+        yz_ref[...] = _rb(y[:P, :P, P])
+        yxy_ref[...] = _rb(y[P, P, :P])
+        yxz_ref[...] = _rb(y[P, :P, P])
+        yyz_ref[...] = _rb(y[:P, P, P])
+        yxyz_ref[...] = _rb(y[P, P, P])
+
+    def kernel(*refs):
+        r8 = lambda r: _r8(r[...], nl)  # noqa: E731
+        uh = _assemble_window(*(r8(refs[i]) for i in range(8)))
+        ul = _assemble_window(*(r8(refs[8 + i]) for i in range(8)))
+        if corner_mode:
+            ch_ref, cl_ref, m_ref = refs[16:19]
+            G = corner_window_G_df(
+                DF(ch_ref[0], cl_ref[0]), m_ref[0], *geom_tables, kappa
+            )
+            base = 19
+        else:
+            gh_ref, gl_ref = refs[16:18]
+            G = tuple(DF(gh_ref[0, c], gl_ref[0, c]) for c in range(6))
+            base = 18
+        y = sumfact_window_apply_df(
+            DF(uh, ul), G, t_phi0, t_dphi1, t_phi0T, t_dphi1T, is_identity
+        )
+        write_outs(y.hi, refs[base:base + 8])
+        write_outs(y.lo, refs[base + 8:base + 16])
+
+    return kernel
+
+
+def xla_seam_fold_df(outs_h, outs_l, layout: FoldedLayout) -> DF:
+    """df twin of ops.folded.xla_seam_fold: identical shift/lift zero-pad
+    structure (exact per channel), with every overlap addition a df_add —
+    channel-wise adds would drop the two_sum carries (an O(2^-24)
+    relative loss, exactly what df exists to avoid)."""
+    P = layout.degree
+    Lv, nb, B = layout.lv, layout.nblocks, layout.block
+    Sx, Sy, Sz = layout.shifts
+
+    def shift(a, S):
+        return jnp.pad(a[..., : Lv - S], [(0, 0)] * (a.ndim - 1) + [(S, 0)])
+
+    def lift(a, axis):
+        pads = [(0, 0)] * (a.ndim + 1)
+        pads[axis] = (0, P - 1)
+        return jnp.pad(jnp.expand_dims(a, axis), pads)
+
+    def sl(d: DF, S: int, *axes) -> DF:
+        h, lo = shift(d.hi, S), shift(d.lo, S)
+        for ax in axes:
+            h, lo = lift(h, ax), lift(lo, ax)
+        return DF(h, lo)
+
+    Y, Yx, Yy, Yz, Yxy, Yxz, Yyz, Yxyz = (
+        DF(h, lo) for h, lo in zip(outs_h, outs_l)
+    )
+    Yx = df_add(
+        df_add(Yx, sl(Yxy, Sy, 0)),
+        df_add(sl(Yxz, Sz, 1), sl(Yxyz, Sy + Sz, 0, 1)),
+    )
+    Yy = df_add(Yy, sl(Yyz, Sz, 1))
+    out = df_add(
+        df_add(Y, sl(Yx, Sx, 0)),
+        df_add(sl(Yy, Sy, 1), sl(Yz, Sz, 2)),
+    )
+
+    def fold_back(a):
+        return jnp.transpose(a.reshape(P * P * P, nb, B), (1, 0, 2))
+
+    return DF(fold_back(out.hi), fold_back(out.lo))
+
+
+def folded_cell_apply_df(
+    x: DF,  # (nb, P^3, B) masked folded df pair
+    geom,  # (Gh, Gl) blocked df G | (corners_h, corners_l, mask_b)
+    layout: FoldedLayout,
+    phi0: np.ndarray,
+    dphi1: np.ndarray,
+    is_identity: bool,
+    kappa: float,
+    interpret: bool | None = None,
+    geom_tables: tuple[np.ndarray, np.ndarray] | None = None,
+) -> DF:
+    """One unfused df operator contribution pass (the v1 pipeline of
+    ops.folded.folded_cell_apply on df channels): XLA slab prep per
+    channel -> ONE pallas kernel over 16 window operands + df geometry ->
+    XLA df seam fold. Returns the un-bc'd folded DF result."""
+    P = layout.degree
+    nq = np.shape(phi0)[0]
+    nl, nb, Lv = layout.nl, layout.nblocks, layout.lv
+    dtype = x.hi.dtype
+
+    wspecs = window_slab_specs(layout)
+    in_specs = wspecs + list(wspecs)
+    operands = [*window_slabs(x.hi, layout), *window_slabs(x.lo, layout)]
+    if geom_tables is None:
+        Gh, Gl = geom
+        gspec = pl.BlockSpec(
+            (1, 6, nq, nq, nq, SUBLANES, nl),
+            lambda i: (i, 0, 0, 0, 0, 0, 0), memory_space=pltpu.VMEM,
+        )
+        in_specs += [gspec, gspec]
+        operands += [Gh, Gl]
+    else:
+        ch, cl, mask_b = geom
+        cspec = pl.BlockSpec(
+            (1, 3, 2, 2, 2, SUBLANES, nl),
+            lambda i: (i, 0, 0, 0, 0, 0, 0), memory_space=pltpu.VMEM,
+        )
+        mspec = pl.BlockSpec((1, SUBLANES, nl), lambda i: (i, 0, 0),
+                             memory_space=pltpu.VMEM)
+        in_specs += [cspec, cspec, mspec]
+        operands += [ch, cl, mask_b]
+
+    out_shapes = [
+        jax.ShapeDtypeStruct((P, P, P, Lv), dtype),
+        jax.ShapeDtypeStruct((P, P, Lv), dtype),
+        jax.ShapeDtypeStruct((P, P, Lv), dtype),
+        jax.ShapeDtypeStruct((P, P, Lv), dtype),
+        jax.ShapeDtypeStruct((P, Lv), dtype),
+        jax.ShapeDtypeStruct((P, Lv), dtype),
+        jax.ShapeDtypeStruct((P, Lv), dtype),
+        jax.ShapeDtypeStruct((Lv,), dtype),
+    ]
+    kernel = _make_folded_df_kernel(
+        P, nl, is_identity,
+        np.asarray(phi0, np.float64), np.asarray(dphi1, np.float64),
+        geom_tables, kappa,
+    )
+    outs = pl.pallas_call(
+        kernel,
+        grid=(nb,),
+        in_specs=in_specs,
+        out_specs=list(wspecs) + list(wspecs),
+        out_shape=out_shapes + list(out_shapes),
+        interpret=_use_interpret() if interpret is None else interpret,
+    )(*operands)
+    return xla_seam_fold_df(outs[:8], outs[8:], layout)
+
+
+# ---------------------------------------------------------------------------
+# VMEM plan (DESIGN ESTIMATES — no folded-df kernel has been Mosaic-
+# compiled yet; the pertdf stage of scripts/measure_all.py is armed to
+# calibrate these the moment the tunnel lives)
+# ---------------------------------------------------------------------------
+
+# Per-compile scoped-VMEM request for every folded-df compile on TPU: the
+# df working set roughly doubles the f32 kernels', which already sit near
+# the 16 MiB default limit at full 128-lane blocks.
+FOLDED_DF_SCOPED_KIB = 65536
+# Live-value model budget under the raised 64 MiB limit, derated by the
+# WORST measured model->Mosaic allocator ratio in this repo (1.7x, the
+# plane-streamed corner kernels — ops.pallas_laplacian). The folded
+# kernels require full 128-lane blocks on TPU (narrower relayouts are
+# Mosaic-unsupported), so a config either fits at nl=128 or routes to the
+# recorded XLA-emulation fallback.
+_FOLDED_DF_BUDGET_BYTES = int(60 * 1024 * 1024 / 1.7)
+
+
+def _df_cell_bytes(nd: int, nq: int, geom: str) -> int:
+    """Modelled per-cell VMEM of the df window kernel: double-buffered
+    u/y at 2 channels (8*nd^3), live geometry + contraction intermediates
+    with their Dekker splits (~44*nq^3 G-streaming / ~34*nq^3 + corner
+    pairs in corner mode, where G is a live value but the df Jacobian
+    chain holds deep temporaries)."""
+    if geom == "g":
+        return (8 * nd**3 + 44 * nq**3) * 4
+    return (8 * nd**3 + 34 * nq**3 + 120) * 4
+
+
+def folded_df_plan(degree: int, nq: int):
+    """(supported, forced_geom, scoped_vmem_kib) for the TPU folded df
+    path: G-streaming while its modelled footprint fits the derated
+    raised-limit budget, corner mode (smaller streams, bigger compute)
+    as the rescue, else unsupported — the drivers route unsupported
+    configs to XLA f64 emulation WITH THE REASON RECORDED (never
+    silently). Single policy shared by the single-chip and distributed
+    builders and the bench drivers."""
+    nd = degree + 1
+    lanes = SUBLANES * 128
+    if _df_cell_bytes(nd, nq, "g") * lanes <= _FOLDED_DF_BUDGET_BYTES:
+        return True, None, FOLDED_DF_SCOPED_KIB
+    if _df_cell_bytes(nd, nq, "corner") * lanes <= _FOLDED_DF_BUDGET_BYTES:
+        return True, "corner", FOLDED_DF_SCOPED_KIB
+    return False, None, None
+
+
+def auto_geom_df(layout: FoldedLayout, nq: int) -> str:
+    """geom='auto' policy for the df operator: precomputed df G is the
+    faster apply but streams TWO blocked G channels — use it while both
+    fit the same comfort budget as the f32 policy (ops.folded.auto_geom),
+    else corner mode (2 x 24 floats/cell)."""
+    g_bytes = 2 * layout.lv * 6 * nq ** 3 * 4
+    return "g" if g_bytes <= 6e9 else "corner"
+
+
+# ---------------------------------------------------------------------------
+# Operator
+# ---------------------------------------------------------------------------
+
+
+def host_blocked_G_df(corners_cs: np.ndarray, mask_cs: np.ndarray,
+                      layout: FoldedLayout, t: OperatorTables,
+                      kappa: float) -> tuple[np.ndarray, np.ndarray]:
+    """Host-side f64 geometry for the df folded path: the oracle-precision
+    G (fem.geometry.geometry_factors) masked and kappa-folded in f64,
+    split into (hi, lo) f32 channels and re-laid block-major per channel
+    (the host twin of ops.folded.chunk_blocked_G's transform). O(Lv *
+    6 * nq^3) f64 host memory — corner mode is the capacity mode."""
+    from ..fem.geometry import geometry_factors
+
+    nq = t.nq
+    G, _ = geometry_factors(
+        corners_cs.reshape(-1, 2, 2, 2, 3), t.pts1d, t.wts1d
+    )
+    G = G * (kappa * mask_cs)[:, None, None, None, None]
+    Gh = np.asarray(G, np.float32)
+    Gl = np.asarray(G - np.asarray(Gh, np.float64), np.float32)
+
+    def block(a):
+        a = a.reshape(layout.nblocks, SUBLANES, layout.nl, 6, nq, nq, nq)
+        return np.ascontiguousarray(a.transpose(0, 3, 4, 5, 6, 1, 2))
+
+    return block(Gh), block(Gl)
+
+
+def split_corner_arrays_df(corners_cs: np.ndarray, mask_cs: np.ndarray,
+                           layout: FoldedLayout):
+    """f64 c-space corner/mask arrays (ghost_corner_arrays) -> blocked df
+    corner-mode operands: ((nb, 3, 2,2,2, 8, nl) hi, same lo, (nb, 8, nl)
+    mask), all f32. Shared by the single-chip and distributed builders."""
+    ch = np.asarray(corners_cs, np.float32)
+    cl = np.asarray(corners_cs - np.asarray(ch, np.float64), np.float32)
+    cb_h, mb = blocked_corners(ch, mask_cs, layout)
+    cb_l, _ = blocked_corners(cl, mask_cs, layout)
+    return (np.asarray(cb_h, np.float32), np.asarray(cb_l, np.float32),
+            np.asarray(mb, np.float32))
+
+
+@partial(
+    jax.tree_util.register_dataclass,
+    data_fields=["Gh", "Gl", "ch", "cl", "cmask", "bc_mask"],
+    meta_fields=["n", "degree", "nl", "is_identity", "kappa",
+                 "phi0_c", "dphi1_c", "pts_c", "wts_c"],
+)
+@dataclass(frozen=True)
+class FoldedLaplacianDF:
+    """Matrix-free df64 Laplacian on folded df vectors (general
+    geometry). Geometry is carried as a blocked (hi, lo) G pair (Gh/Gl
+    set) or as blocked df corner pairs with the mask (corner mode —
+    the capacity default at scale). kappa is compile-time metadata:
+    folded into G host-side (g mode) or into the in-kernel geometry
+    scale (corner mode)."""
+
+    Gh: jnp.ndarray | None
+    Gl: jnp.ndarray | None
+    ch: jnp.ndarray | None
+    cl: jnp.ndarray | None
+    cmask: jnp.ndarray | None
+    bc_mask: jnp.ndarray  # (nb, P^3, B) 0/1 Dirichlet marker, f32
+    n: tuple[int, int, int]
+    degree: int
+    nl: int
+    is_identity: bool
+    kappa: float
+    phi0_c: tuple = ()
+    dphi1_c: tuple = ()
+    pts_c: tuple = ()
+    wts_c: tuple = ()
+
+    @property
+    def layout(self) -> FoldedLayout:
+        return FoldedLayout(n=self.n, degree=self.degree, nl=self.nl)
+
+    @property
+    def geom(self):
+        if self.Gh is not None:
+            return (self.Gh, self.Gl)
+        return (self.ch, self.cl, self.cmask)
+
+    @property
+    def geom_tables(self):
+        if self.Gh is not None:
+            return None
+        return (np.asarray(self.pts_c), np.asarray(self.wts_c))
+
+    def contrib(self, xm: DF, interpret: bool | None = None) -> DF:
+        """Un-bc'd contribution pass on a pre-masked df vector."""
+        return folded_cell_apply_df(
+            xm, self.geom, self.layout,
+            np.asarray(self.phi0_c, np.float64),
+            np.asarray(self.dphi1_c, np.float64),
+            self.is_identity, self.kappa, interpret=interpret,
+            geom_tables=self.geom_tables,
+        )
+
+    def apply(self, x: DF) -> DF:
+        """y = A @ x with Dirichlet pass-through rows. All masking is
+        multiplication by exact 0/1 channels with disjoint-support sums
+        (never y + bc*(x - y), whose subtraction rounds)."""
+        bc = self.bc_mask
+        nbm = 1.0 - bc
+        y = self.contrib(DF(x.hi * nbm, x.lo * nbm))
+        return DF(y.hi * nbm + bc * x.hi, y.lo * nbm + bc * x.lo)
+
+
+def build_folded_laplacian_df(
+    mesh: BoxMesh,
+    degree: int,
+    qmode: int,
+    rule: str = "gll",
+    kappa: float = 2.0,
+    tables: OperatorTables | None = None,
+    nl: int | None = None,
+    geom: str = "auto",
+) -> FoldedLaplacianDF:
+    """Build the folded df operator: geometry in f64 on the host, split
+    into (hi, lo) channels (precomputed G or corner pairs), Dirichlet
+    marker folded once. Ghost/pad cells keep the unit-cube placeholder
+    corners (invertible Jacobian, zero mask) of the f32 path."""
+    if geom not in ("auto", "corner", "g"):
+        raise ValueError(f"unknown geom mode {geom!r}")
+    t = tables or build_operator_tables(degree, qmode, rule)
+    if nl is None and geom != "g":
+        forced = folded_df_plan(degree, t.nq)[1]
+        if forced is not None:
+            geom = forced
+    layout = make_layout(mesh.n, degree, t.nq, 4, nl=nl)
+    check_tpu_lane_support(layout, degree, qmode)
+    if geom == "auto":
+        geom = auto_geom_df(layout, t.nq)
+    corners_cs, mask_cs = ghost_corner_arrays(layout, mesh.cell_corners)
+    Gh = Gl = ch = cl = cm = None
+    if geom == "corner":
+        cb_h, cb_l, mb = split_corner_arrays_df(corners_cs, mask_cs, layout)
+        ch, cl = jnp.asarray(cb_h), jnp.asarray(cb_l)
+        cm = jnp.asarray(mb)
+    else:
+        gh, gl = host_blocked_G_df(corners_cs, mask_cs, layout, t, kappa)
+        Gh, Gl = jnp.asarray(gh), jnp.asarray(gl)
+    bc = fold_vector(
+        np.asarray(boundary_dof_marker(mesh.n, degree), np.float64), layout
+    )
+    return FoldedLaplacianDF(
+        Gh=Gh, Gl=Gl, ch=ch, cl=cl, cmask=cm,
+        bc_mask=jnp.asarray(bc, jnp.float32),
+        n=mesh.n,
+        degree=degree,
+        nl=layout.nl,
+        is_identity=t.is_identity,
+        kappa=float(kappa),
+        phi0_c=freeze_table(t.phi0),
+        dphi1_c=freeze_table(t.dphi1),
+        pts_c=tuple(float(v) for v in t.pts1d),
+        wts_c=tuple(float(v) for v in t.wts1d),
+    )
+
+
+# ---------------------------------------------------------------------------
+# CG / action (benchmark semantics)
+# ---------------------------------------------------------------------------
+
+
+def folded_cg_solve_df(op: FoldedLaplacianDF, b: DF, nreps: int) -> DF:
+    """Fixed-iteration df CG on folded df vectors (x0 = 0, rtol = 0 —
+    reference cg.hpp:89-169 semantics), the ops.kron_df.cg_solve_df
+    recurrence (including the past-the-df-floor freeze guard) on the
+    folded operator. Structural/pad slots are zero in every vector, so
+    the compensated dots count real dofs only."""
+    floor = jnp.float32(1e-24)
+    rnorm0 = df_dot(b, b)
+    rnorm0_hi = rnorm0.hi
+
+    def body(_, state):
+        x, r, p, rnorm, done = state
+        y = op.apply(p)
+        alpha = df_div(rnorm, df_dot(p, y))
+        x1 = df_axpy(x, alpha, p)
+        r1 = df_sub(r, df_scale(y, alpha))
+        rnorm1 = df_dot(r1, r1)
+        beta = df_div(rnorm1, rnorm)
+        p1 = df_add(df_scale(p, beta), r1)
+        done1 = jnp.logical_or(done, rnorm1.hi <= floor * rnorm0_hi)
+
+        def keep(new, old):
+            return jax.tree_util.tree_map(
+                lambda nw, o: jnp.where(done, o, nw), new, old
+            )
+
+        return (keep(x1, x), keep(r1, r), keep(p1, p),
+                keep(rnorm1, rnorm), done1)
+
+    state = (df_zeros_like(b), b, b, rnorm0, jnp.asarray(False))
+    x, *_ = jax.lax.fori_loop(0, nreps, body, state)
+    return x
+
+
+def folded_action_df(op: FoldedLaplacianDF, u: DF, nreps: int) -> DF:
+    """nreps df operator applications of the same input (benchmark action
+    semantics, laplacian_solver.cpp:119-127), loop-fenced like every
+    other action driver so the invariant apply cannot be hoisted."""
+
+    def rep(_, y):
+        uu, _ = jax.lax.optimization_barrier((u, y))
+        return op.apply(uu)
+
+    return jax.lax.fori_loop(0, nreps, rep, df_zeros_like(u))

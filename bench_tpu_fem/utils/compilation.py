@@ -65,6 +65,13 @@ def compile_lowered(lowered, extra: dict[str, str] | None = None,
     elif backend == "cpu" and cpu_extra:
         # cpu_extra is CPU-only (xla_cpu_*); any other backend (e.g. a
         # GPU host under platform='auto') must fall through to a plain
-        # compile rather than receive a flag its compiler rejects
-        return lowered.compile(compiler_options=dict(cpu_extra))
+        # compile rather than receive a flag its compiler rejects.
+        # Older jaxlibs don't know the option NAMES either (e.g.
+        # xla_cpu_use_fusion_emitters predates jaxlib 0.5) and raise on
+        # them — the options only steer compile strategy, never
+        # numerics, so fall back to a plain compile there.
+        try:
+            return lowered.compile(compiler_options=dict(cpu_extra))
+        except Exception:
+            return lowered.compile()
     return lowered.compile()

@@ -19,6 +19,18 @@ from __future__ import annotations
 import os
 
 
+def _jaxlib_at_least(major: int, minor: int) -> bool:
+    """True when the installed jaxlib is at least `major.minor` (flag
+    availability gate; unparseable versions count as too old)."""
+    try:
+        import jaxlib
+
+        ver = tuple(int(p) for p in jaxlib.__version__.split(".")[:2])
+    except Exception:
+        return False
+    return ver >= (major, minor)
+
+
 def force_host_cpu_devices(n_devices: int) -> None:
     """Pin this process to the CPU platform with ``n_devices`` XLA devices.
 
@@ -50,7 +62,12 @@ def force_host_cpu_devices(n_devices: int) -> None:
     # untouched when y/z halos break the fusion). Disabling them here
     # only changes the CPU compile strategy, never numerics; TPU
     # compiles are unaffected (this entry point pins the CPU platform).
-    if "--xla_cpu_use_fusion_emitters" not in flags:
+    # VERSION-GATED: the flag only exists from jaxlib 0.5; older
+    # bundled-XLA flag parsers ABORT the whole process on any unknown
+    # XLA_FLAGS entry (parse_flags_from_env.cc), which would turn every
+    # hermetic CPU run — the entire test suite — into a hard crash.
+    if ("--xla_cpu_use_fusion_emitters" not in flags
+            and _jaxlib_at_least(0, 5)):
         flags = (flags + " --xla_cpu_use_fusion_emitters=false").strip()
     os.environ["XLA_FLAGS"] = flags
 

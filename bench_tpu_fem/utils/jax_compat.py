@@ -1,0 +1,60 @@
+"""Compatibility shims for older JAX versions.
+
+The framework targets current JAX, where `jax.shard_map` is a top-level
+API with a `check_vma` flag and `jax.lax.pcast` adjusts varying-mesh-axes
+types. Some deployment containers pin jax 0.4.x, where the same
+functionality lives at `jax.experimental.shard_map.shard_map` with the
+older `check_rep` flag and no VMA system at all. These shims install the
+new names on old installations so the dist layer runs unmodified:
+
+- `jax.shard_map`: forwards to the experimental entry point, translating
+  `check_vma=` to `check_rep=` (semantically the corresponding check in
+  the pre-VMA representation system);
+- `jax.lax.pcast`: identity. pcast exists purely to satisfy the VMA type
+  system (marking a replicated value as device-varying so loop-carry
+  types match); without that system the value itself is already correct.
+
+On current JAX every `hasattr` gate passes and this module does nothing.
+Applied once from the package `__init__` (idempotent).
+"""
+
+from __future__ import annotations
+
+
+def apply_compat_shims() -> None:
+    import jax
+
+    if not hasattr(jax, "shard_map"):
+        from jax.experimental.shard_map import shard_map as _shard_map
+
+        def shard_map(f, **kw):
+            # check_rep (the pre-VMA replication lint) cannot type the
+            # drivers' frozen-state CG carries — on old jax its own error
+            # message prescribes check_rep=False as the workaround, and
+            # pcast (the new-API fix) does not exist to express the
+            # annotation. The check is a lint, never semantics.
+            kw.pop("check_vma", None)
+            kw["check_rep"] = False
+            return _shard_map(f, **kw)
+
+        jax.shard_map = shard_map
+
+    if not hasattr(jax.lax, "axis_size"):
+        def axis_size(axis_name):
+            from jax._src import core as _core
+
+            env = _core.get_axis_env()
+            if isinstance(axis_name, (tuple, list)):
+                size = 1
+                for n in axis_name:
+                    size *= env.axis_size(n)
+                return size
+            return env.axis_size(axis_name)
+
+        jax.lax.axis_size = axis_size
+
+    if not hasattr(jax.lax, "pcast"):
+        def pcast(x, axis_name, to=None, **kw):  # noqa: ARG001
+            return x
+
+        jax.lax.pcast = pcast

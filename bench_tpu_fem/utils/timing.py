@@ -65,28 +65,38 @@ def _reduce_gathered(names: list[str],
     }
 
 
-# fixed width so the allgather sees identical shapes on every process;
-# names rarely approach 4 KiB, and a truncated-equal collision would have
-# to pair with divergent counts that still reshape cleanly to slip through
+# fixed width so the allgather sees identical shapes on every process.
+# The readable head is truncated at 4 KiB; divergence PAST the cap is
+# caught by the appended metadata row: len(names) plus a sha256-derived
+# 8-byte digest of the FULL joined list, so name lists that agree in the
+# first 4 KiB but diverge beyond it can no longer silently max-reduce
+# unrelated phases against each other.
 _NAMES_CAP = 4096
+_NAMES_META = 16  # 8-byte big-endian length + 8-byte sha256 prefix
+_NAMES_ROW = _NAMES_CAP + _NAMES_META
 
 
 def _names_blob(names: list[str]) -> np.ndarray:
     """Fixed-width uint8 encoding of the phase-name list for allgather
     (uint8 is exempt from the x64-off f64→f32 demotion, so the check can
-    run outside the x64 save/restore)."""
-    return np.frombuffer(
-        ("\x1f".join(names)).encode()[:_NAMES_CAP].ljust(_NAMES_CAP, b"\0"),
-        dtype=np.uint8,
-    ).copy()
+    run outside the x64 save/restore): the truncated readable head plus
+    the full-list length/digest metadata."""
+    import hashlib
+
+    joined = ("\x1f".join(names)).encode()
+    head = joined[:_NAMES_CAP].ljust(_NAMES_CAP, b"\0")
+    meta = (len(names).to_bytes(8, "big")
+            + hashlib.sha256(joined).digest()[:8])
+    return np.frombuffer(head + meta, dtype=np.uint8).copy()
 
 
 def _check_gathered_names(gathered_names: np.ndarray, names: list[str]) -> None:
     """Raise if any process gathered a different phase-name list: equal
     phase COUNTS with divergent NAMES (an engine fallback firing on one
     host only) would otherwise reshape fine and silently max-reduce
-    unrelated phases against each other."""
-    rows = np.asarray(gathered_names).reshape(-1, _NAMES_CAP)
+    unrelated phases against each other. The digest row extends the
+    check past the 4 KiB readable cap."""
+    rows = np.asarray(gathered_names).reshape(-1, _NAMES_ROW)
     if not (rows == rows[0]).all():
         raise RuntimeError(
             "timer phase names diverge across processes; cannot "

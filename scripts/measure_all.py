@@ -1,15 +1,19 @@
-"""Run the outstanding TPU measurement agenda (round 5), logging each
+"""Run the outstanding TPU measurement agenda (round 6), logging each
 step as it lands (a mid-run tunnel wedge preserves completed steps).
 
-Round-4 stages remain callable by name. The round-5 default agenda
-targets the fused df32 engine (the round's headline: VERDICT item 1)
-plus the items the round-4 wedges left uncollected:
+Earlier rounds' stages remain callable by name. The round-6 default
+agenda adds the perturbed-geometry df32 gate for the folded df pipeline
+(ops.folded_df) to the still-uncollected round-5 items:
 
   health    - tunnel probe (aborts the rest when down)
   dfacc     - df32 engine ACCURACY on hardware (mat_comp oracle): the
               Mosaic compile path may behave differently from the
               CPU-validated interpret path (FP rewrites, op support) —
               this gate must pass before any df perf number is believed
+  pertdf    - perturbed df32 ACCURACY + throughput: the folded df
+              pipeline's first-ever Mosaic compile (its VMEM plan is a
+              design estimate until this runs), mat_comp gate first,
+              then the 12.5M perf point vs the 4.02 f64 baseline
   dfeng     - fused df32 engine A/B vs unfused at 12.5M dofs
   dflarge   - df32 engine at 100M (tier-3 scoped limit), plus the
               recorded one-kernel ceiling behaviour toward 300M
@@ -26,7 +30,7 @@ import sys
 import time
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-LOG = os.path.join(ROOT, "MEASURE_r05.log")
+LOG = os.path.join(ROOT, "MEASURE_r06.log")
 ENV = {**os.environ, "PYTHONPATH": f"{ROOT}:/root/.axon_site"}
 
 
@@ -319,6 +323,45 @@ print("DFACC OK")
     return rc == 0
 
 
+def stage_pertdf():
+    # Perturbed f64-class gate for the folded df pipeline (ops.folded_df):
+    # accuracy FIRST (the mat_comp oracle must agree to ~1e-9 like every
+    # other df path, and the run must NOT have taken the recorded
+    # emulation fallback), then the flagship-size perf point. Both
+    # geometry modes: auto (G-pair streaming at this size) and forced
+    # corner (the capacity mode whose in-kernel df Jacobian chain is the
+    # Mosaic-riskiest new code).
+    code = PRE + """
+cfg = BenchConfig(ndofs_global=50_000, degree=3, qmode=1, float_bits=64,
+                  nreps=30, use_cg=True, mat_comp=True, f64_impl="df32",
+                  geom_perturb_fact=0.2)
+res, w = timed_res(cfg)
+print("PERTDF acc:", "enorm/znorm", res.enorm / res.znorm, res.extra)
+assert res.extra.get("f64_impl") == "df32", res.extra
+assert res.enorm / res.znorm < 1e-9, "folded-df lost f64 accuracy"
+import bench_tpu_fem.ops.folded_df as FD
+import bench_tpu_fem.bench.driver as BD
+orig = FD.build_folded_laplacian_df
+FD.build_folded_laplacian_df = lambda *a, **k: orig(
+    *a, **{**k, "geom": "corner"})
+res, w = timed_res(cfg)
+print("PERTDF acc corner:", "enorm/znorm", res.enorm / res.znorm,
+      res.extra)
+assert res.extra.get("f64_impl") == "df32", res.extra
+assert res.extra.get("geom") == "corner", res.extra
+assert res.enorm / res.znorm < 1e-9, "folded-df corner lost f64 accuracy"
+FD.build_folded_laplacian_df = orig
+cfg = BenchConfig(ndofs_global=12_500_000, degree=3, qmode=1,
+                  float_bits=64, nreps=100, use_cg=True, f64_impl="df32",
+                  geom_perturb_fact=0.2)
+res, w = timed_res(cfg)
+print("PERTDF12.5M:", res.gdof_per_second, res.extra,
+      "vs4.02:", res.gdof_per_second / 4.02)
+"""
+    rc, out = run_py(code, timeout=2400)
+    log(f"pertdf rc={rc}: {out}")
+
+
 def stage_dfeng():
     # fused engine vs unfused df at flagship size
     _bench_stage("dfeng", "DFENG12.5M:", dict(
@@ -348,16 +391,18 @@ STAGES = {
     "p300": stage_p300, "pert100": stage_pert100,
     "deg7probe": stage_deg7probe, "dfacc": stage_dfacc,
     "dfeng": stage_dfeng, "dflarge": stage_dflarge,
+    "pertdf": stage_pertdf,
 }
 
 if __name__ == "__main__":
-    # Round-5 default agenda, ordered by value-per-minute under wedge
-    # risk: the df accuracy gate first (nothing df counts without it),
-    # then the official bench line, then df perf, the round-4
-    # leftovers, and the full matrix (longest) last.
-    wanted = sys.argv[1:] or ["health", "dfacc", "dfeng", "bench",
-                              "dflarge", "pert100", "deg7probe",
-                              "matrix"]
+    # Round-6 default agenda, ordered by value-per-minute under wedge
+    # risk: the df accuracy gates first (nothing df counts without
+    # them — pertdf is the folded df pipeline's first Mosaic compile),
+    # then the official bench line, then df perf, the leftovers, and
+    # the full matrix (longest) last.
+    wanted = sys.argv[1:] or ["health", "dfacc", "pertdf", "dfeng",
+                              "bench", "dflarge", "pert100",
+                              "deg7probe", "matrix"]
     unknown = [s for s in wanted if s not in STAGES]
     if unknown:
         print(f"unknown stage(s) {unknown}; valid: {list(STAGES)}",

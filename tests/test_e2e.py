@@ -144,3 +144,35 @@ def test_timer_name_divergence_detected():
     diverged = np.stack([_names_blob(["a", "b"]), _names_blob(["a", "c"])])
     with pytest.raises(RuntimeError, match="diverge"):
         _check_gathered_names(diverged, ["a", "b"])
+
+
+def test_timer_name_divergence_past_cap_detected():
+    """Name lists that agree in the first 4 KiB but diverge beyond the
+    truncation cap (or differ only in count past it) must still be
+    caught — the appended length + sha256-digest row covers the tail the
+    readable blob cannot."""
+    import numpy as np
+    import pytest
+
+    from bench_tpu_fem.utils.timing import (
+        _NAMES_CAP,
+        _check_gathered_names,
+        _names_blob,
+    )
+
+    # shared 4 KiB prefix, divergence only past the cap
+    prefix = ["p" * 256] * ((_NAMES_CAP // 257) + 1)
+    a = prefix + ["tail-one"]
+    b = prefix + ["tail-two"]
+    assert np.array_equal(_names_blob(a)[:_NAMES_CAP],
+                          _names_blob(b)[:_NAMES_CAP])
+    with pytest.raises(RuntimeError, match="diverge"):
+        _check_gathered_names(np.stack([_names_blob(a), _names_blob(b)]), a)
+
+    # equal names still pass with the metadata row appended
+    _check_gathered_names(np.stack([_names_blob(a), _names_blob(a)]), a)
+
+    # count-only divergence past the cap (same bytes, one extra name)
+    c = prefix + ["tail-one", "extra"]
+    with pytest.raises(RuntimeError, match="diverge"):
+        _check_gathered_names(np.stack([_names_blob(a), _names_blob(c)]), a)

@@ -1,0 +1,305 @@
+"""Folded double-float pipeline (ops.folded_df): f64-class operator and
+CG on perturbed (general) geometry.
+
+Strategy mirrors the other df suites: the folded df apply is matched
+against the true-f64 XLA operator (x64 is on in tests), the CG residual
+floor is checked in genuine f64, the driver's routing/fallback recording
+is pinned, and the sharded variant is parity-tested on virtual devices.
+df tolerances: ~48-bit mantissas end to end, so apply parity is ~1e-12
+relative (not the f32 suite's ~1e-5)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bench_tpu_fem.elements import build_operator_tables
+from bench_tpu_fem.la.df64 import DF
+from bench_tpu_fem.mesh import create_box_mesh, dof_grid_shape
+from bench_tpu_fem.mesh.dofmap import boundary_dof_marker
+from bench_tpu_fem.ops import build_laplacian
+from bench_tpu_fem.ops.folded import fold_vector, unfold_vector
+from bench_tpu_fem.ops.folded_df import (
+    build_folded_laplacian_df,
+    folded_action_df,
+    folded_cg_solve_df,
+    folded_df_plan,
+)
+
+jax.config.update("jax_enable_x64", True)
+
+
+def _df_fold(grid64, layout):
+    hi = np.asarray(grid64, np.float32)
+    lo = np.asarray(grid64 - np.asarray(hi, np.float64), np.float32)
+    return DF(jnp.asarray(fold_vector(hi, layout)),
+              jnp.asarray(fold_vector(lo, layout)))
+
+
+def _df_unfold(v, layout):
+    return (unfold_vector(np.asarray(v.hi, np.float64), layout)
+            + unfold_vector(np.asarray(v.lo, np.float64), layout))
+
+
+def _setup(n=(3, 2, 2), degree=3, qmode=1, geom="corner", nl=8,
+           perturb=0.2):
+    mesh = create_box_mesh(n, geom_perturb_fact=perturb)
+    t = build_operator_tables(degree, qmode)
+    op = build_folded_laplacian_df(
+        mesh, degree, qmode, kappa=2.0, tables=t, geom=geom, nl=nl
+    )
+    return mesh, t, op
+
+
+@pytest.mark.parametrize(
+    "geom", ["corner", pytest.param("g", marks=pytest.mark.slow)])
+@pytest.mark.parametrize(
+    "degree,qmode",
+    [(3, 1), pytest.param(2, 0, marks=pytest.mark.slow),
+     pytest.param(4, 1, marks=pytest.mark.slow)],
+)
+def test_apply_matches_true_f64(geom, degree, qmode):
+    """Folded df apply == the f64 XLA operator to df accuracy, both
+    geometry modes (precomputed df-G pair, in-kernel df corner chain)."""
+    n = (3, 2, 2) if degree <= 3 else (2, 2, 2)
+    mesh, t, op = _setup(n=n, degree=degree, qmode=qmode, geom=geom)
+    op_ref = build_laplacian(mesh, degree, qmode, kappa=2.0,
+                             dtype=jnp.float64, tables=t, backend="xla")
+    rng = np.random.RandomState(1)
+    x = rng.randn(*dof_grid_shape(n, degree))
+    y_ref = np.asarray(jax.jit(op_ref.apply)(jnp.asarray(x)))
+    y = jax.jit(op.apply)(_df_fold(x, op.layout))
+    # structural slots must stay zero in both channels
+    marks = fold_vector(np.ones(dof_grid_shape(n, degree)), op.layout) > 0
+    assert np.all(np.asarray(y.hi)[~marks] == 0.0)
+    assert np.all(np.asarray(y.lo)[~marks] == 0.0)
+    rel = (np.linalg.norm(_df_unfold(y, op.layout) - y_ref)
+           / np.linalg.norm(y_ref))
+    assert rel < 2e-12
+
+
+@pytest.mark.slow
+def test_apply_multiblock_matches_true_f64():
+    """nblocks > 1 exercises block-spanning shifted slabs and the padded
+    tail in the df kernel (same rationale as the f32 multiblock test)."""
+    n, degree, qmode = (7, 4, 4), 2, 1
+    mesh, t, op = _setup(n=n, degree=degree, qmode=qmode, geom="corner",
+                         nl=16, perturb=0.15)
+    assert op.layout.nblocks > 1
+    op_ref = build_laplacian(mesh, degree, qmode, kappa=2.0,
+                             dtype=jnp.float64, tables=t, backend="xla")
+    rng = np.random.RandomState(7)
+    x = rng.randn(*dof_grid_shape(n, degree))
+    y_ref = np.asarray(jax.jit(op_ref.apply)(jnp.asarray(x)))
+    y = jax.jit(op.apply)(_df_fold(x, op.layout))
+    rel = (np.linalg.norm(_df_unfold(y, op.layout) - y_ref)
+           / np.linalg.norm(y_ref))
+    assert rel < 2e-12
+
+
+@pytest.mark.slow
+def test_csr_oracle_parity_perturbed():
+    """mat_comp-grade check: the folded df apply against the assembled
+    CSR oracle (independent scipy assembly in true f64) on a perturbed
+    mesh — the same bar the driver's --mat_comp applies."""
+    from bench_tpu_fem.fem.assemble import (
+        assemble_csr,
+        element_stiffness_matrices,
+    )
+    from bench_tpu_fem.fem.geometry import geometry_factors
+    from bench_tpu_fem.mesh.dofmap import cell_dofmap
+
+    n, degree, qmode = (2, 2, 3), 3, 1
+    mesh, t, op = _setup(n=n, degree=degree, qmode=qmode, geom="corner")
+    G_host, _ = geometry_factors(
+        mesh.cell_corners.reshape(-1, 2, 2, 2, 3), t.pts1d, t.wts1d
+    )
+    bc = boundary_dof_marker(n, degree)
+    A = assemble_csr(
+        element_stiffness_matrices(t, G_host, 2.0),
+        cell_dofmap(n, degree), bc.ravel(),
+    )
+    rng = np.random.RandomState(3)
+    x = rng.randn(*dof_grid_shape(n, degree))
+    z = (A @ x.ravel()).reshape(x.shape)
+    y = jax.jit(op.apply)(_df_fold(x, op.layout))
+    rel = (np.linalg.norm(_df_unfold(y, op.layout) - z)
+           / np.linalg.norm(z))
+    assert rel < 2e-12
+
+
+@pytest.mark.slow
+def test_cg_residual_floor():
+    """A long fixed-iteration folded-df CG must reach and hold an
+    f64-class residual floor (~1e-12 relative, reference
+    laplacian_solver.cpp:130-148 behaviour), with the residual evaluated
+    through the true-f64 operator."""
+    n, degree, qmode = (3, 2, 2), 3, 1
+    mesh, t, op = _setup(n=n, degree=degree, qmode=qmode, geom="corner")
+    bc = boundary_dof_marker(n, degree)
+    b = np.where(bc, 0.0, 1.0)
+    bf = _df_fold(b, op.layout)
+    x = jax.jit(lambda A, v: folded_cg_solve_df(A, v, 400))(op, bf)
+    op_ref = build_laplacian(mesh, degree, qmode, kappa=2.0,
+                             dtype=jnp.float64, tables=t, backend="xla")
+    r = b - np.asarray(
+        jax.jit(op_ref.apply)(jnp.asarray(_df_unfold(x, op.layout)))
+    )
+    assert np.linalg.norm(r) / np.linalg.norm(b) < 1e-10
+
+
+@pytest.mark.slow
+def test_action_df_matches_apply():
+    n, degree = (3, 2, 2), 3
+    mesh, t, op = _setup(n=n, degree=degree, geom="corner")
+    rng = np.random.RandomState(5)
+    x = rng.randn(*dof_grid_shape(n, degree))
+    xf = _df_fold(x, op.layout)
+    y1 = jax.jit(op.apply)(xf)
+    y3 = jax.jit(lambda A, v: folded_action_df(A, v, 3))(op, xf)
+    np.testing.assert_allclose(
+        _df_unfold(y3, op.layout), _df_unfold(y1, op.layout),
+        rtol=0, atol=1e-12 * np.abs(_df_unfold(y1, op.layout)).max(),
+    )
+
+
+def test_folded_df_plan_ladder():
+    """The df VMEM plan's design-estimate ladder: degree 3 qmode 1
+    supports G streaming, degree 4 is forced to corner mode, degree 5+
+    is unsupported (drivers take the recorded emulation fallback). Every
+    supported config requests the raised scoped-VMEM limit."""
+    sup, forced, kib = folded_df_plan(3, 5)
+    assert sup and forced is None and kib is not None
+    sup, forced, kib = folded_df_plan(4, 6)
+    assert sup and forced == "corner" and kib is not None
+    sup, forced, kib = folded_df_plan(5, 7)
+    assert not sup
+
+
+def test_driver_routes_perturbed_df32_and_records_path():
+    """Perturbed --float 64 --f64_impl df32 runs end-to-end through the
+    folded-df pipeline with mat_comp oracle agreement, recording the
+    path it took."""
+    from bench_tpu_fem.bench.driver import BenchConfig, run_benchmark
+
+    cfg = BenchConfig(ndofs_global=1000, degree=3, qmode=1, float_bits=64,
+                      nreps=5, use_cg=True, mat_comp=True,
+                      f64_impl="df32", geom_perturb_fact=0.2)
+    res = run_benchmark(cfg)
+    assert res.extra["f64_impl"] == "df32"
+    assert res.extra["f64_df32_path"] == "folded"
+    assert res.extra["backend"] == "pallas"
+    assert res.enorm / res.znorm < 1e-11
+
+
+def test_driver_fallback_recorded_for_unsupported_degree():
+    """A config outside the df VMEM plan (degree 5 perturbed) must fall
+    back to XLA emulation WITH the reason recorded — never silently."""
+    from bench_tpu_fem.bench.driver import BenchConfig, run_benchmark
+
+    cfg = BenchConfig(ndofs_global=800, degree=5, qmode=1, float_bits=64,
+                      nreps=2, use_cg=True, f64_impl="df32",
+                      geom_perturb_fact=0.2)
+    res = run_benchmark(cfg)
+    assert res.extra["f64_impl"] == "emulated-fallback"
+    assert "folded-df plan" in res.extra["f64_df32_fallback_reason"]
+    assert np.isfinite(res.ynorm) and res.ynorm > 0
+
+
+def test_driver_fallback_recorded_on_compile_failure(monkeypatch):
+    """A compile rejection of the folded df kernels must complete on the
+    recorded emulation fallback, not sink the benchmark."""
+    import bench_tpu_fem.bench.driver as BD
+    from bench_tpu_fem.bench.driver import BenchConfig, run_benchmark
+
+    def boom(lowered, extra=None, cpu_extra=None):
+        raise RuntimeError("Mosaic rejects the folded df kernel")
+
+    calls = {"n": 0}
+    orig = BD.compile_lowered
+
+    def first_boom(lowered, extra=None, cpu_extra=None):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            return boom(lowered, extra, cpu_extra)
+        return orig(lowered, extra, cpu_extra=cpu_extra)
+
+    monkeypatch.setattr(BD, "compile_lowered", first_boom)
+    cfg = BenchConfig(ndofs_global=800, degree=3, qmode=1, float_bits=64,
+                      nreps=2, use_cg=True, f64_impl="df32",
+                      geom_perturb_fact=0.2)
+    res = run_benchmark(cfg)
+    assert res.extra["f64_impl"] == "emulated-fallback"
+    assert "Mosaic rejects" in res.extra["f64_df32_fallback_reason"]
+    assert np.isfinite(res.ynorm) and res.ynorm > 0
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("dshape", [(2, 1, 1), (2, 2, 1)])
+def test_dist_folded_df_matches_single_device(dshape):
+    """Sharded folded df (stacked-channel halos, compensated dots) vs
+    the single-chip folded df operator: apply and a short CG."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from bench_tpu_fem.dist.folded import (
+        build_dist_folded_df,
+        make_folded_df_sharded_fns,
+        shard_folded_vectors_df,
+        unshard_folded_vectors,
+    )
+    from bench_tpu_fem.dist.mesh import AXIS_NAMES, make_device_grid
+
+    degree, qmode = 3, 1
+    dgrid = make_device_grid(dshape=dshape)
+    n = tuple(2 * d for d in dshape)
+    mesh = create_box_mesh(n, geom_perturb_fact=0.2)
+    t = build_operator_tables(degree, qmode)
+    op = build_dist_folded_df(mesh, dgrid, degree, t, kappa=2.0, nl=8,
+                              geom="corner")
+    bc = boundary_dof_marker(n, degree)
+    b = np.where(bc, 0.0, 1.0)
+    sharding = NamedSharding(dgrid.mesh, P(*AXIS_NAMES))
+    bdf = shard_folded_vectors_df(b, n, degree, dgrid.dshape, op.layout)
+    bdf = DF(jax.device_put(bdf.hi, sharding),
+             jax.device_put(bdf.lo, sharding))
+    apply_fn, cg_fn, norm_fn, norms_from, sharded_state = (
+        make_folded_df_sharded_fns(op, dgrid, nreps=4)
+    )
+    state = sharded_state(op)
+
+    op1 = build_folded_laplacian_df(mesh, degree, qmode, kappa=2.0,
+                                    tables=t, geom="corner", nl=8)
+    bf1 = _df_fold(b, op1.layout)
+
+    def unshard(v):
+        return (unshard_folded_vectors(np.asarray(v.hi, np.float64), n,
+                                       degree, dgrid.dshape, op.layout)
+                + unshard_folded_vectors(np.asarray(v.lo, np.float64), n,
+                                         degree, dgrid.dshape, op.layout))
+
+    y = jax.jit(apply_fn)(bdf, state)
+    y1 = _df_unfold(jax.jit(op1.apply)(bf1), op1.layout)
+    assert np.linalg.norm(unshard(y) - y1) / np.linalg.norm(y1) < 2e-12
+
+    x = jax.jit(cg_fn)(bdf, state, op.owned)
+    x1 = _df_unfold(
+        jax.jit(lambda A, v: folded_cg_solve_df(A, v, 4))(op1, bf1),
+        op1.layout,
+    )
+    assert np.linalg.norm(unshard(x) - x1) / np.linalg.norm(x1) < 1e-11
+    l2, linf = norms_from(jax.jit(norm_fn)(x, op.owned))
+    assert np.isfinite(l2) and l2 > 0 and np.isfinite(linf)
+
+
+@pytest.mark.slow
+def test_dist_driver_perturbed_df32_mat_comp():
+    """The sharded driver path end to end on 2 virtual devices with the
+    CSR oracle."""
+    from bench_tpu_fem.bench.driver import BenchConfig, run_benchmark
+
+    cfg = BenchConfig(ndofs_global=2197, degree=3, qmode=1, float_bits=64,
+                      nreps=4, use_cg=True, mat_comp=True,
+                      f64_impl="df32", geom_perturb_fact=0.2, ndevices=2)
+    res = run_benchmark(cfg)
+    assert res.extra["f64_df32_path"] == "folded"
+    assert res.enorm / res.znorm < 1e-11

@@ -175,8 +175,10 @@ def test_folded_fused_apply_specs(recorder, geom):
     recorder.check()
 
 
-@pytest.mark.parametrize("degree", [3, 4])
-@pytest.mark.parametrize("chunked", [False, True])
+@pytest.mark.parametrize(
+    "degree", [3, pytest.param(4, marks=pytest.mark.slow)])
+@pytest.mark.parametrize(
+    "chunked", [False, pytest.param(True, marks=pytest.mark.slow)])
 def test_kron_df_engine_specs(recorder, degree, chunked):
     """The fused df32 engine (ops.kron_cg_df): CG (update_p) and action
     forms, one-kernel and y-chunked."""
@@ -236,6 +238,30 @@ def test_dist_kron_df_engine_specs(recorder):
     xh = _rand((4, 1, 1, Lx, LY, LZ))
     xl = _rand((4, 1, 1, Lx, LY, LZ))
     jax.jit(run)(xh, xl, op)
+    recorder.check()
+
+
+@pytest.mark.parametrize("geom", ["g", "corner"])
+def test_folded_df_apply_specs(recorder, geom):
+    """The folded df window kernel (ops.folded_df): 16 window operands +
+    df geometry channels, both geometry modes."""
+    from bench_tpu_fem.la.df64 import DF
+    from bench_tpu_fem.ops.folded import fold_vector
+    from bench_tpu_fem.ops.folded_df import build_folded_laplacian_df
+
+    nc = compute_mesh_size(40_000, 3)
+    mesh = create_box_mesh(nc, geom_perturb_fact=0.1)
+    op = build_folded_laplacian_df(mesh, 3, 1, geom=geom)
+    lay = op.layout
+    rng = np.random.RandomState(0)
+    from bench_tpu_fem.mesh.dofmap import dof_grid_shape
+
+    x = rng.rand(*dof_grid_shape(nc, 3))
+    xh = np.asarray(x, np.float32)
+    xl = np.asarray(x - np.asarray(xh, np.float64), np.float32)
+    xf = DF(jnp.asarray(fold_vector(xh, lay)),
+            jnp.asarray(fold_vector(xl, lay)))
+    jax.jit(op.apply)(xf)
     recorder.check()
 
 
