@@ -98,6 +98,27 @@ class BenchmarkResults:
     extra: dict = field(default_factory=dict)
 
 
+def record_engine(extra: dict, engine: bool, form: str | None = None,
+                  error=None) -> None:
+    """Unified engine-routing record, stamped by EVERY branch (kron /
+    folded / df, single-chip / dist): `cg_engine_form` is one of
+    "one_kernel" (single-chip delay ring) | "halo" (distributed plane/
+    block-halo ring) | "ext2d" (3D-sharded halo-extended cross-section
+    ring) | "chunked" (y-chunked two-kernel) | "unfused", and any
+    fallback carries the reason in `cg_engine_error` — so fallback
+    audits are ONE grep across BENCH/MULTICHIP artifacts."""
+    extra["cg_engine"] = engine
+    extra["cg_engine_form"] = (form or "unfused") if engine else "unfused"
+    if error is not None:
+        extra["cg_engine_error"] = (
+            error if isinstance(error, str) else exc_str(error)
+        )
+
+
+# engine_plan/engine_plan_df form names -> the unified vocabulary
+ENGINE_FORM_NAMES = {"one": "one_kernel", "chunked": "chunked"}
+
+
 def _mesh_setup(cfg: BenchConfig, n: tuple[int, int, int] | None = None):
     """Sizing, tables and mesh — O(ncells) host work, no dof-sized arrays."""
     from ..mesh.sizing import compute_mesh_size
@@ -264,6 +285,9 @@ def _run_benchmark_folded_df(cfg: BenchConfig) -> BenchmarkResults:
     res.extra["backend"] = "pallas"
     res.extra["f64_impl"] = "df32"
     res.extra["f64_df32_path"] = "folded"
+    # the folded df pipeline is the deliberately-unfused composition
+    # (ops.folded_df v1) — no fused engine form exists for it yet
+    record_engine(res.extra, False)
 
     # Host-assembled f64 RHS (the reference assembles its RHS on the CPU
     # too), split into df channels and folded per channel. The oracle
@@ -407,9 +431,7 @@ def _run_benchmark_df64(cfg: BenchConfig) -> BenchmarkResults:
                                    cfg.degree)
         engine = jax.default_backend() == "tpu"
         compile_opts = scoped_vmem_options(kib) if engine else None
-        res.extra["cg_engine"] = engine
-        if engine:
-            res.extra["cg_engine_form"] = form
+        record_engine(res.extra, engine, ENGINE_FORM_NAMES.get(form, form))
 
         def _lower(f):
             return jax.jit(f).lower(op, u)
@@ -441,17 +463,17 @@ def _run_benchmark_df64(cfg: BenchConfig) -> BenchmarkResults:
                 try:
                     fn = compile_lowered(
                         _lower(_fused(force_chunked=True)))
-                    res.extra["cg_engine_form"] = "chunked-retry"
+                    # the one-kernel rejection is kept alongside: a
+                    # drifted tier boundary is only diagnosable from it
+                    res.extra["cg_engine_form"] = "chunked"
                     res.extra["cg_engine_one_kernel_error"] = exc_str(exc)
                 except Exception as exc2:
                     res.extra["cg_engine_retry_error"] = exc_str(exc2)
             if fn is None:
                 engine = False
-                res.extra["cg_engine"] = False
-                res.extra["cg_engine_error"] = exc_str(exc)
-                # the recorded form never ran — don't attribute unfused
-                # timings to it
-                res.extra.pop("cg_engine_form", None)
+                # the recorded form never ran — the unfused stamp must
+                # not attribute unfused timings to an engine form
+                record_engine(res.extra, False, error=exc)
                 fn = compile_lowered(_lower(_unfused()))
         warm = fn(op, u)
         float(warm.hi[(0,) * warm.hi.ndim])
@@ -523,6 +545,9 @@ def _run_benchmark(cfg: BenchConfig) -> BenchmarkResults:
         ncells_global=mesh.ncells, ndofs_global=ndofs_global, nreps=cfg.nreps
     )
     res.extra["backend"] = backend
+    # default engine record (the kron/folded branches below overwrite):
+    # the xla backend has no fused engine form
+    record_engine(res.extra, False)
 
     # Both fast paths build their RHS on device: the kron path from
     # separable 1D factors, the folded path from cell corners
@@ -622,7 +647,7 @@ def _run_benchmark(cfg: BenchConfig) -> BenchmarkResults:
             )
             engine = supports_cg_engine(op)
             res.extra["geom"] = "corner" if op.G is None else "g"
-            res.extra["cg_engine"] = engine
+            record_engine(res.extra, engine, "one_kernel")
             if engine:
                 engine_cg = lambda A, b: folded_cg_solve(A, b, cfg.nreps)  # noqa: E731
                 engine_apply = folded_apply_ring
@@ -642,9 +667,10 @@ def _run_benchmark(cfg: BenchConfig) -> BenchmarkResults:
                 jax.default_backend() == "tpu"
                 and supports_kron_cg_engine(u.shape, cfg.degree, u.dtype)
             )
-            res.extra["cg_engine"] = engine
+            form, kib = engine_plan(u.shape, cfg.degree)
+            record_engine(res.extra, engine,
+                          ENGINE_FORM_NAMES.get(form, form))
             if engine:
-                form, kib = engine_plan(u.shape, cfg.degree)
                 compile_opts = scoped_vmem_options(kib)
                 engine_cg = lambda A, b: kron_cg_solve(A, b, cfg.nreps)  # noqa: E731
                 engine_apply = kron_apply_ring
@@ -667,10 +693,7 @@ def _run_benchmark(cfg: BenchConfig) -> BenchmarkResults:
         fallback_opts = compile_opts if folded else None
 
         def _record_engine_failure(exc):
-            res.extra["cg_engine"] = False
-            res.extra["cg_engine_error"] = (
-                exc_str(exc)
-            )
+            record_engine(res.extra, False, error=exc)
 
         apply_fn = unfused_apply
         if engine:
@@ -695,7 +718,7 @@ def _run_benchmark(cfg: BenchConfig) -> BenchmarkResults:
                     if engine_cg_retry is not None:
                         try:
                             fn = _compile_cg(engine_cg_retry, fallback_opts)
-                            res.extra["cg_engine_form"] = "chunked-retry"
+                            res.extra["cg_engine_form"] = "chunked"
                             # keep the one-kernel rejection too: the scoped
                             # VMEM tiers are hardware-calibrated estimates,
                             # and a drifted tier boundary is only
@@ -754,7 +777,7 @@ def _run_benchmark(cfg: BenchConfig) -> BenchmarkResults:
                         fn = _compile_action(
                             lambda A: partial(engine_apply_retry, A),
                             fallback_opts)
-                        res.extra["cg_engine_form"] = "chunked-retry"
+                        res.extra["cg_engine_form"] = "chunked"
                         res.extra["cg_engine_one_kernel_error"] = (
                             exc_str(exc)
                         )

@@ -10,6 +10,7 @@ from functools import partial
 
 import numpy as np
 
+from ..bench.driver import record_engine
 from ..la.cg import cg_solve
 from ..utils.compilation import (
     CPU_DF_DIST_OPTIONS,
@@ -156,12 +157,15 @@ def run_distributed(cfg, res, dtype):
                 dtype=dtype, tables=t,
             )
             from .kron import resolve_kron_engine
-            from .kron_cg import dist_kron_engine_plan
+            from .kron_cg import _is_x_only, dist_kron_engine_plan
 
             apply_fn, cg_fn, norm_fn = make_kron_sharded_fns(
                 op, dgrid, cfg.nreps
             )
-            res.extra["cg_engine"] = resolve_kron_engine(op)
+            # same predicate the kernel routing uses, so the recorded
+            # form cannot diverge from the form that runs
+            record_engine(res.extra, resolve_kron_engine(op),
+                          "halo" if _is_x_only(op) else "ext2d")
             if res.extra["cg_engine"]:
                 # raised-tier one-kernel rings need the per-compile
                 # scoped-VMEM request, same plan as the single-chip driver
@@ -184,18 +188,24 @@ def run_distributed(cfg, res, dtype):
                 build_dist_folded,
                 make_folded_rhs_fn,
                 make_folded_sharded_fns,
+                resolve_folded_engine,
                 shard_corner_cs,
                 shard_folded_vectors,
             )
 
             # the streamed-corner kernels (degrees 5-6) compile only with
             # the raised scoped-VMEM limit, exactly like the single-chip
-            # folded path
+            # folded path (dist_folded_engine_plan forwards the same kib)
             compile_opts = scoped_vmem_options(
                 pallas_plan(cfg.degree, t.nq, np.dtype(dtype).itemsize)[2])
             op = build_dist_folded(
                 mesh, dgrid, cfg.degree, t, kappa=2.0, dtype=dtype
             )
+            # fused dist folded engine (dist.folded_cg) when the
+            # per-shard ring fits — the auto rule inside
+            # make_folded_sharded_fns is the same resolver, so the
+            # recorded flag cannot diverge from what runs
+            record_engine(res.extra, resolve_folded_engine(op), "halo")
             apply_fn, cg_fn, norm_fn, sharded_state = (
                 make_folded_sharded_fns(op, dgrid, cfg.nreps)
             )
@@ -223,6 +233,7 @@ def run_distributed(cfg, res, dtype):
             apply_args = (state,)
             norm_args = (op.owned,)
         else:
+            record_engine(res.extra, False)  # xla path: no engine form
             op = build_dist_laplacian(
                 mesh, dgrid, cfg.degree, t, kappa=2.0, dtype=dtype,
                 backend=backend,
@@ -245,17 +256,23 @@ def run_distributed(cfg, res, dtype):
                 # main kernel is also collective-independent) and record
                 # why. Only a failure of the *engine* path warrants the
                 # fallback recompile; anything else re-raises unchanged.
-                if not (kron and res.extra.get("cg_engine")):
+                if not ((kron or folded) and res.extra.get("cg_engine")):
                     raise
-                res.extra["cg_engine"] = False
-                res.extra["cg_engine_error"] = (
-                    exc_str(exc)
-                )
-                _, cg_fn, _ = make_kron_sharded_fns(
-                    op, dgrid, cfg.nreps, engine=False
-                )
-                # unfused kron fallback fits the default scoped limit
-                fn = compile_lowered(jax.jit(cg_fn).lower(u, *cg_args))
+                record_engine(res.extra, False, error=exc)
+                if kron:
+                    _, cg_fn, _ = make_kron_sharded_fns(
+                        op, dgrid, cfg.nreps, engine=False
+                    )
+                    # unfused kron fallback fits the default scoped limit
+                    fn = compile_lowered(jax.jit(cg_fn).lower(u, *cg_args))
+                else:
+                    _, cg_fn, _, _ = make_folded_sharded_fns(
+                        op, dgrid, cfg.nreps, engine=False
+                    )
+                    # unfused folded fallback still runs the streamed
+                    # corner kernels — keep the raised scoped request
+                    fn = compile_lowered(jax.jit(cg_fn).lower(u, *cg_args),
+                                         compile_opts)
             run_args = cg_args
         else:
             # One jitted fori_loop over all reps (same rationale as the
@@ -280,16 +297,19 @@ def run_distributed(cfg, res, dtype):
             except Exception as exc:
                 # Engine-apply compile failure: unfused fallback, same
                 # rationale as the CG branch above.
-                if not (kron and res.extra.get("cg_engine")):
+                if not ((kron or folded) and res.extra.get("cg_engine")):
                     raise
-                res.extra["cg_engine"] = False
-                res.extra["cg_engine_error"] = (
-                    exc_str(exc)
-                )
-                apply_fn, _, _ = make_kron_sharded_fns(
-                    op, dgrid, cfg.nreps, engine=False
-                )
-                fn = _compile_action(apply_fn, None)
+                record_engine(res.extra, False, error=exc)
+                if kron:
+                    apply_fn, _, _ = make_kron_sharded_fns(
+                        op, dgrid, cfg.nreps, engine=False
+                    )
+                    fn = _compile_action(apply_fn, None)
+                else:
+                    apply_fn, _, _, _ = make_folded_sharded_fns(
+                        op, dgrid, cfg.nreps, engine=False
+                    )
+                    fn = _compile_action(apply_fn, compile_opts)
             run_args = apply_args
         norm_c = compile_lowered(jax.jit(norm_fn).lower(u, *norm_args))
         # Warm-up executes the full compiled computation once: the first
@@ -405,6 +425,9 @@ def _run_distributed_folded_df(cfg, res):
     res.extra["backend"] = "pallas"
     res.extra["f64_impl"] = "df32"
     res.extra["f64_df32_path"] = "folded"
+    # the sharded folded df pipeline is deliberately unfused (dist.folded
+    # df section) — no fused engine form exists for it yet
+    record_engine(res.extra, False)
 
     # Host-assembled f64 RHS split into df channels and sharded per
     # channel. O(global-dof) host arrays — accepted on this path (the
@@ -553,11 +576,12 @@ def run_distributed_df64(cfg, res):
             ))
         else:
             u = jax.jit(make_kron_df_rhs_fn(op, dgrid, t))()
-        from .kron_cg_df import dist_df_engine_plan
+        from .kron_cg_df import _is_x_only, dist_df_engine_plan
         from .kron_df import resolve_df_engine
 
         engine = resolve_df_engine(op)
-        res.extra["cg_engine"] = engine
+        record_engine(res.extra, engine,
+                      "halo" if _is_x_only(op) else "ext2d")
         opts = (scoped_vmem_options(dist_df_engine_plan(op)[1])
                 if engine else None)
         from ..la.df64 import df_zeros_like
@@ -591,8 +615,7 @@ def run_distributed_df64(cfg, res):
             if not engine:
                 raise
             engine = False
-            res.extra["cg_engine"] = False
-            res.extra["cg_engine_error"] = exc_str(exc)
+            record_engine(res.extra, False, error=exc)
             norm_fn, norms_from, fn = _build(False)
         warm = fn(u, op)
         float(warm.hi[(0,) * warm.hi.ndim])

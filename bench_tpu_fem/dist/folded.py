@@ -620,50 +620,90 @@ def shard_corner_cs(mesh: BoxMesh, dshape, layout: FoldedLayout):
     return ccs, mcs
 
 
-def make_folded_sharded_fns(op: DistFoldedLaplacian, dgrid, nreps: int):
+def resolve_folded_engine(op: DistFoldedLaplacian) -> bool:
+    """The dist folded engine auto rule, shared by make_folded_sharded_fns
+    and the dist driver's metadata/fallback logic so the recorded
+    cg_engine flag can never diverge from what actually runs. No backend
+    gate: like the single-chip folded engine, CPU runs take the same
+    kernels through interpret mode (the folded path is pallas-only)."""
+    from .folded_cg import supports_dist_folded_engine
+
+    return supports_dist_folded_engine(op)
+
+
+def make_folded_sharded_fns(op: DistFoldedLaplacian, dgrid, nreps: int,
+                            engine: bool | None = None):
     """Jittable sharded callables (apply, CG, norm) over folded shards —
     mirrors dist.driver.make_sharded_fns. The sharded per-shard arrays ride
     as one pytree argument; the operator's replicated metadata rides via
-    closure."""
+    closure.
+
+    `engine=None` (auto) routes CG and the apply through the distributed
+    fused delay-ring engine (dist.folded_cg) when the per-shard input
+    ring fits VMEM — one kernel pass per iteration instead of main
+    kernel + epilogues + unfused CG glue. The unfused composition (with
+    its collective-independent main kernel) serves everything else and
+    remains the driver's recorded compile-failure fallback. Both paths
+    consume the same `sharded_state` tuple; per-iteration-invariant state
+    (the geometry tuple, the owned-dof dot weight) is hoisted out of the
+    CG loop in both."""
     from jax.sharding import PartitionSpec as P
 
     from ..la.cg import cg_solve
+    from .folded_cg import (
+        dist_folded_apply_ring_local,
+        dist_folded_cg_solve_local,
+    )
 
     spec = P(*AXIS_NAMES)
     rep = P()
+    if engine is None:
+        engine = resolve_folded_engine(op)
 
     def _local(a):
         return jax.tree_util.tree_map(lambda x: x[0, 0, 0], a)
 
     def _dot(mask):
+        m = mask.astype(op.bc_mask.dtype)  # hoisted: cast once, not per dot
+
         def dot(u, v):
-            return psum_all(jnp.sum(u * v * mask.astype(u.dtype)))
+            return psum_all(jnp.sum(u * v * m))
 
         return dot
 
     def sharded_state(A):
         geom = A.G if A.G is not None else (A.corners, A.cmask)
         # "not a true ghost" == owned under this ownership partition (pad
-        # slots are zero in every vector, so their mask value is moot)
+        # slots are zero in every vector, so their mask value is moot);
+        # the engine path reuses the same array as its dot-ownership
+        # weight and streamed kernel mask.
         nghost = A.owned.astype(A.bc_mask.dtype)
         return (geom, A.bc_mask, nghost, A.epi_geom)
 
-    # check_vma=False is *required* here, not a blanket waiver: every folded
-    # sharded computation runs the Pallas kernel (folded_cell_apply_fused),
-    # whose pallas_call output carries no varying-mesh-axes annotation, and
-    # the default shard_map VMA check rejects exactly that. This mirrors
+    # check_vma=False is *required* on these two shard_maps, not a blanket
+    # waiver, and for a pallas-only reason: every folded sharded
+    # computation (unfused AND engine form) runs a Pallas kernel
+    # (folded_cell_apply_fused / the halo-form delay ring), whose
+    # pallas_call outputs carry no varying-mesh-axes annotation, and the
+    # default shard_map VMA check rejects exactly that. This mirrors
     # dist/kron.py's scoped `check_vma = impl != "pallas"` — the folded
     # path simply has no non-pallas impl to scope back to.
     @partial(jax.shard_map, mesh=dgrid.mesh,
              in_specs=(spec, spec), out_specs=spec, check_vma=False)
     def apply_fn(x, state):
+        if engine:
+            y = dist_folded_apply_ring_local(op, _local(x), _local(state))
+            return y[None, None, None]
         return op.apply_local(_local(x), _local(state))[None, None, None]
 
     @partial(jax.shard_map, mesh=dgrid.mesh,
              in_specs=(spec, spec, spec), out_specs=spec, check_vma=False)
     def cg_fn(b, state, owned):
         bl = _local(b)
-        sl = _local(state)
+        sl = _local(state)  # hoisted: sliced once, reused every iteration
+        if engine:
+            x = dist_folded_cg_solve_local(op, bl, sl, nreps)
+            return x[None, None, None]
         x = cg_solve(
             lambda v: op.apply_local(v, sl),
             bl,

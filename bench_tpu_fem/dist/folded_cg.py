@@ -1,0 +1,236 @@
+"""Distributed fused folded CG engine: the one-kernel-per-iteration
+delay-ring design of ops.folded_cg on sharded perturbed meshes — the
+general-geometry twin of dist.kron_cg, closing the last sharded
+configuration that still ran the unfused `cg_solve(apply_local)`
+composition (and re-paid the ~3x glue HBM cost every iteration on every
+shard, README's single-chip engine measurement).
+
+The folded layout makes this carry-over structurally simpler than the
+kron one: each shard's folded vector ALREADY contains its halo (the ghost
+cell columns, dist.folded), so there is no slab extension — the engine is
+
+- STACKED HALO REFRESH: one `ppermute` payload per sharded axis per
+  iteration carries BOTH (r, p_prev) ghost cross-sections (the
+  dist.folded `_halo_refresh_view` machinery with a leading channel
+  axis, exactly the dist.kron_cg_df stacked-channel pattern). The
+  in-kernel p-update then computes p = beta*p_prev + r at ghost slots
+  from the owner's refreshed copies with the same elementwise
+  instruction the owner executes — ghost p stays owner-consistent by
+  replay (the f32 invariant dist.kron pins).
+- THE SAME DELAY-RING KERNEL, HALO FORM: `ops.folded_cg._cg_apply_call`
+  with `masks=(bc, w)` — the per-shard Dirichlet mask streams as a block
+  operand (the single-chip closed form assumes global coordinates), and
+  the in-kernel <p, A p> partials are weighted by the streamed
+  owned-dof mask (dist.folded.owned_folded_mask as dtype) so ghost
+  columns and duplicated seam slots count ZERO before the psum — every
+  dof exactly once globally. Ghost cells keep their zero geometry rows,
+  so they self-mask exactly as on one chip.
+- SEAM OVERLAP-ADD IN TWO TIERS: intra-shard seams resolve inside the
+  kernel's VMEM seam rings (ops.folded._seam_accumulate, unchanged);
+  inter-shard seams are the partials the kernel leaves in the ghost
+  columns, resolved by the reverse-scatter tail (ghost -> owner ppermute
+  + add, dist.folded.folded_reverse_scatter). The <p, A p> partial the
+  kernel emits therefore misses exactly the incoming inter-shard seam
+  contributions; `folded_reverse_scatter_dot` accumulates that O(surface)
+  correction — sum of p * received-partials over owned destination slots
+  — alongside the scatter, so the psum'd dot is exact without re-reading
+  the two O(volume) vectors (the stream the engine exists to save).
+
+Trade-off vs the unfused dist path (same as dist.kron_cg, documented
+deliberately): the kernel input depends on the halo refresh, so the
+collective is on the critical path — the unfused path's main-kernel/
+collective independence is given up for one fused pass instead of
+main kernel + three epilogues + CG glue. The exchange moves O(surface)
+bytes against O(volume) compute; the unfused path remains available via
+`make_folded_sharded_fns(..., engine=False)` and is the driver's
+recorded compile-failure fallback.
+
+VMEM: identical rings to the single-chip engine on the PER-SHARD layout
+(the input ring shrinks with the shard cross-section), plus two streamed
+mask blocks that ride the existing pipeline — `dist_folded_engine_plan`
+reuses the single-chip `MAX_RING_BLOCKS` gate and the folded
+`pallas_plan` scoped-VMEM request. Both are DESIGN ESTIMATES for this
+form until the `foldeng` stage measures it on hardware.
+
+float32 only (Mosaic has no f64; the sharded df path is dist.folded's
+unfused df section). Benchmark semantics (rtol = 0, exactly nreps
+iterations).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..la.cg import fused_cg_solve
+from ..ops.folded import pallas_plan
+from ..ops.folded_cg import MAX_RING_BLOCKS, _cg_apply_call, ring_depth
+from ..ops.kron_cg import PALLAS_UPDATE_MIN_DOFS, cg_update_pallas
+from .folded import (
+    DistFoldedLaplacian,
+    _cview,
+    _from_cview,
+    _halo_refresh_view,
+    folded_halo_refresh,
+    folded_reverse_scatter,
+)
+from .halo import _shift_from_left, psum_all
+from .mesh import AXIS_NAMES
+
+
+def dist_folded_engine_plan(
+    op: DistFoldedLaplacian,
+) -> tuple[bool, int | None]:
+    """(supported, scoped_vmem_kib): f32 only (Mosaic has no f64) and the
+    per-shard input ring within the single-chip engine's MAX_RING_BLOCKS
+    VMEM gate (the ring depth is a per-shard layout property — sharding
+    the y/z axes shrinks it). The kib request forwards the folded
+    pallas_plan's raised scoped limit (the degree 5-6 streamed-corner
+    kernels), exactly what the unfused dist folded compile already
+    requests — the halo form adds only pipeline-buffered mask streams, no
+    new scratch, so the same ladder applies (DESIGN ESTIMATE until the
+    foldeng stage measures it)."""
+    if op.bc_mask.dtype != jnp.float32:
+        return False, None
+    if ring_depth(op.layout) > MAX_RING_BLOCKS:
+        return False, None
+    nq = int(np.asarray(op.phi0_c).shape[0])
+    return True, pallas_plan(op.degree, nq, 4)[2]
+
+
+def supports_dist_folded_engine(op: DistFoldedLaplacian) -> bool:
+    """Supported component of dist_folded_engine_plan."""
+    return dist_folded_engine_plan(op)[0]
+
+
+def _refresh_rp(r, p_prev, layout):
+    """Stacked halo refresh of (r, p_prev): ONE ppermute payload per
+    sharded axis carries both channels' ghost cross-sections (the
+    dist.folded view machinery with a leading channel axis)."""
+    vs = jnp.stack([_cview(r, layout), _cview(p_prev, layout)])
+    vs = _halo_refresh_view(vs, 1)
+    return (_from_cview(vs[0], r, layout),
+            _from_cview(vs[1], p_prev, layout))
+
+
+def folded_reverse_scatter_dot(y, p, w, layout):
+    """Inter-shard seam tail WITH the dot correction: the reverse scatter
+    of dist.folded (ghost partials -> owner, sequentially x, y, z so
+    edge/corner partials forward transitively), accumulating
+    dcorr = sum over owned destination slots of p * received-partial.
+
+    The kernel's <p, A p> partials already count p * (own contributions)
+    at every owned slot; the incoming seam partials are exactly what they
+    miss. Weighting each stage's receive by the owned mask counts a
+    forwarded partial only at its final owned destination (intermediate
+    shards see it on slots their mask zeroes), and p is owner-consistent
+    at duplicated slots, so the psum of (kernel partials + dcorr) is the
+    exact global dot — no O(volume) re-read. Returns (y_scattered,
+    dcorr)."""
+    v = _cview(y, layout)
+    pv = _cview(p, layout)
+    wv = _cview(w, layout)
+    dcorr = jnp.zeros((), y.dtype)
+    for ax, name in zip(range(3), AXIS_NAMES):
+        n = lax.axis_size(name)
+        if n == 1:
+            continue
+        cax = 3 + ax
+        idx = lax.axis_index(name)
+        last = v.shape[cax] - 1
+
+        def islab_of(a, ax=ax):
+            return lax.index_in_dim(a, 0, axis=ax, keepdims=True)
+
+        islab = islab_of(v)
+        ghost = lax.index_in_dim(islab, last, axis=cax, keepdims=True)
+        contrib = jnp.where(idx == n - 1, jnp.zeros_like(ghost), ghost)
+        recv = _shift_from_left(contrib, name)  # zeros on shard 0
+        first = lax.index_in_dim(islab, 0, axis=cax, keepdims=True)
+        p_first = lax.index_in_dim(islab_of(pv), 0, axis=cax,
+                                   keepdims=True)
+        w_first = lax.index_in_dim(islab_of(wv), 0, axis=cax,
+                                   keepdims=True)
+        dcorr = dcorr + jnp.sum(recv * p_first * w_first)
+        new_first = first + recv
+        new_ghost = jnp.where(idx == n - 1, ghost, jnp.zeros_like(ghost))
+        islab = jnp.concatenate(
+            [new_first, lax.slice_in_dim(islab, 1, last, axis=cax),
+             new_ghost], axis=cax,
+        )
+        rest = lax.slice_in_dim(v, 1, v.shape[ax], axis=ax)
+        v = jnp.concatenate([islab, rest], axis=ax)
+    return _from_cview(v, y, layout), dcorr
+
+
+def dist_folded_cg_solve_local(op: DistFoldedLaplacian, b, state, nreps,
+                               interpret: bool | None = None):
+    """Per-shard fused-engine CG (inside shard_map): returns the local
+    folded solution block. Matches the unfused dist path
+    (dist.folded.make_folded_sharded_fns cg_fn) to f32 reassociation
+    accuracy at one kernel pass per iteration. Shares the exact
+    `sharded_state` tuple of the unfused path: geom rides to the kernel,
+    bc streams as the in-kernel Dirichlet mask, and the owned/"not a true
+    ghost" mask doubles as the dot-ownership weight (they are the same
+    array under dist.folded's ownership partition)."""
+    layout = op.layout
+    geom, bc, w, _epi = state
+    phi0 = np.asarray(op.phi0_c, np.float64)
+    dphi1 = np.asarray(op.dphi1_c, np.float64)
+    apply_cg = partial(
+        _cg_apply_call, layout, geom, op.kappa, phi0, dphi1,
+        op.is_identity, op.geom_tables,
+    )
+
+    def engine(r, p_prev, beta):
+        r_h, p_h = _refresh_rp(r, p_prev, layout)
+        p, y, pdot = apply_cg(True, interpret, r_h, p_h, beta,
+                              masks=(bc, w))
+        y, dcorr = folded_reverse_scatter_dot(y, p, w, layout)
+        return p, y, psum_all(jnp.sum(pdot) + dcorr)
+
+    def inner(u, v):
+        # owned-dof psum dot; w is hoisted state (no per-iteration cast)
+        return psum_all(jnp.sum(u * v * w))
+
+    update = None
+    if b.size >= PALLAS_UPDATE_MIN_DOFS:
+        # Chunked pallas x/r update above the shared size policy
+        # (ops.kron_cg.PALLAS_UPDATE_MIN_DOFS: XLA TPU fails whole-vector
+        # fusions ~130M dofs; the folded (nb, P^3, B) layout rides the
+        # pass as a 3D grid). Its <r1, r1> counts every local slot; the
+        # non-owned contribution (ghost columns — structural pads are
+        # zero in every vector) is subtracted before the psum.
+        def update(x, pv, r, y, alpha):
+            x1, r1, rr = cg_update_pallas(x, pv, r, y, alpha, interpret)
+            seam = jnp.sum(r1 * r1 * (1.0 - w))
+            return x1, r1, psum_all(rr - seam)
+
+    return fused_cg_solve(engine, b, nreps, update=update, inner=inner)
+
+
+def dist_folded_apply_ring_local(op: DistFoldedLaplacian, x, state,
+                                 interpret: bool | None = None):
+    """Per-shard single delay-ring apply y = A x (inside shard_map) with
+    FULL general-x operator semantics (unlike the CG engine's invariant
+    form): halo refresh, pre-mask bc rows out of the interior windows,
+    one halo-form kernel pass, reverse-scatter tail, Dirichlet rows
+    restored from the refreshed input — the action-benchmark analogue of
+    dist.kron_cg.dist_kron_apply_ring_local, value-matching
+    DistFoldedLaplacian.apply_local."""
+    layout = op.layout
+    geom, bc, w, _epi = state
+    apply_cg = partial(
+        _cg_apply_call, layout, geom, op.kappa,
+        np.asarray(op.phi0_c, np.float64),
+        np.asarray(op.dphi1_c, np.float64),
+        op.is_identity, op.geom_tables,
+    )
+    xr = folded_halo_refresh(x, layout)
+    xm = xr * (1 - bc)
+    y, _ = apply_cg(False, interpret, xm, masks=(bc, w))
+    y = folded_reverse_scatter(y, layout)
+    return y + bc * (xr - y)

@@ -29,10 +29,17 @@ pass (ops.kron_cg_df.cg_update_df_pallas) above the same size policy as
 f32, with the duplicated seam plane's <r1, r1> contribution subtracted
 before the fold.
 
-x-only device meshes (dshape = (D, 1, 1)); the unfused dist df path
-(dist.kron_df) serves other meshes and remains the compile-failure
-fallback. Reference parity: ghost scatter vector.hpp:31-149, CG
-recurrence cg.hpp:89-169, f64 dispatch main.cpp:277-288.
+Device-mesh coverage: x-only meshes (dshape = (D, 1, 1)) use the plane-
+halo kernel form above; any other dshape uses the `ext2d` kernel form
+(ops.kron_cg_df, the df twin of the f32 ext2d form in ops.kron_cg) —
+cross-sections halo-extended by P per sharded side with the owner's seam
+plane folded into every left payload (the same owner-wins refresh as the
+x exchange, per axis), per-shard global-indexed 4-channel coefficient
+slices, and streamed (NY, NZ) interior/dot-ownership mask planes. The
+unfused dist df path (dist.kron_df) serves rings past the VMEM tiers and
+remains the compile-failure fallback. Reference parity: ghost scatter
+vector.hpp:31-149, CG recurrence cg.hpp:89-169, f64 dispatch
+main.cpp:277-288.
 """
 
 from __future__ import annotations
@@ -54,15 +61,25 @@ from .kron_df import DistKronLaplacianDF, df_psum_all
 from .mesh import AXIS_NAMES
 
 
+def _is_x_only(op: DistKronLaplacianDF) -> bool:
+    return op.dshape[1] == 1 and op.dshape[2] == 1
+
+
 def dist_df_engine_plan(op: DistKronLaplacianDF) -> tuple[bool, int | None]:
-    """(supported, scoped_vmem_kib): x-only device meshes with the df
-    one-kernel ring inside a scoped-VMEM tier (the chunked df form has
-    no halo variant yet — past the tiers the unfused dist df path
-    serves)."""
-    if not (op.dshape[1] == 1 and op.dshape[2] == 1):
-        return False, None
-    Lx, NY, NZ = op.L[0], op.notbc1d[1].shape[0], op.notbc1d[2].shape[0]
-    form, kib = engine_plan_df((Lx, NY, NZ), op.degree)
+    """(supported, scoped_vmem_kib): any device mesh with the df
+    one-kernel ring inside a scoped-VMEM tier — x-only meshes by the
+    global cross-section, 3D meshes by the halo-extended LOCAL
+    cross-section consumed by the ext2d form's ephemeral contraction
+    operands (the same accounting as the f32 dist_kron_engine_plan).
+    The chunked df form has no halo variant — past the tiers the
+    unfused dist df path serves."""
+    P = op.degree
+    Lx = op.L[0]
+    if _is_x_only(op):
+        cross = (op.notbc1d[1].shape[0], op.notbc1d[2].shape[0])
+    else:
+        cross = (op.L[1] + 2 * P, op.L[2] + 2 * P)
+    form, kib = engine_plan_df((Lx, *cross), P)
     return form == "one", kib
 
 
@@ -97,6 +114,102 @@ def _shard_tables_df(op: DistKronLaplacianDF, dtype=jnp.float32):
         cx_global,  # placeholder slot; the call takes cx=cx_local
     )
     return cx_local, aux_local, coeffs
+
+
+def _ext_coeff4(df_banded, axis_i: int, L: int, P: int, nb: int):
+    """Halo-extended per-shard 4-channel coefficient slice: the global
+    (4, nb, N_a) stack zero-padded by P columns each side (all four
+    channels — splits of 0 are 0, so the banded zero-boundary behaviour
+    is preserved exactly), then dynamic-sliced to (4, nb, L + 2P) at the
+    shard position. Padded index a0 == global index a0 - P: the extended
+    slice starts P rows/cols before the local block (the f32 ext_coeff
+    of dist.kron_cg, per channel)."""
+    stack = _coeff_stack4(df_banded)
+    a0 = lax.axis_index(AXIS_NAMES[axis_i]) * (L - 1)
+    padded = jnp.pad(stack, ((0, 0), (0, 0), (P, P)))
+    z0 = jnp.zeros((), dtype=a0.dtype)
+    return lax.dynamic_slice(padded, (z0, z0, a0), (4, nb, L + 2 * P))
+
+
+def _shard_tables_df_3d(op: DistKronLaplacianDF, dtype=jnp.float32):
+    """Per-shard tables for the ext2d df kernel form (3D-sharded
+    meshes): the x-coefficient/aux rows of _shard_tables_df, plus the
+    halo-extended y/z 4-channel banded slices (global-indexed, zero
+    outside the domain), the cross-section Dirichlet-interior mask, and
+    the cross-section dot-ownership weights (0 on duplicated seam
+    rows/cols so reductions count every dof once globally) — the df
+    twin of dist.kron_cg._shard_tables_3d."""
+    P = op.degree
+    nb = 2 * P + 1
+    cx_local, aux_local, _ = _shard_tables_df(op, dtype)
+    coeffs = (
+        _ext_coeff4(op.Kd[2], 2, op.L[2], P, nb),
+        _ext_coeff4(op.Md[2], 2, op.L[2], P, nb),
+        _ext_coeff4(op.Kd[1], 1, op.L[1], P, nb),
+        _ext_coeff4(op.Md[1], 1, op.L[1], P, nb),
+        cx_local,  # placeholder slot; the call takes cx=cx_local
+    )
+
+    def local_1d(vec, axis_i):
+        La = op.L[axis_i]
+        a0 = lax.axis_index(AXIS_NAMES[axis_i]) * (La - 1)
+        return lax.dynamic_slice(vec.astype(dtype), (a0,), (La,)), a0
+
+    nby, y0 = local_1d(op.notbc1d[1], 1)
+    nbz, z0 = local_1d(op.notbc1d[2], 2)
+    mask2d = nby[:, None] * nbz[None, :]
+    wy = jnp.where(jnp.logical_and(jnp.arange(op.L[1]) == 0, y0 > 0),
+                   jnp.zeros((), dtype), jnp.ones((), dtype))
+    wz = jnp.where(jnp.logical_and(jnp.arange(op.L[2]) == 0, z0 > 0),
+                   jnp.zeros((), dtype), jnp.ones((), dtype))
+    w2d = wy[:, None] * wz[None, :]
+    return cx_local, aux_local, coeffs, mask2d, w2d
+
+
+def _extend_all_axes_df(dfs, P: int, dshape):
+    """Halo-extend every channel of the DF operands by P planes per side
+    along each sharded axis, sequentially z -> y -> x so later exchanges
+    carry the earlier extensions (corner/edge halo data arrives already
+    extended — the f32 _extend_all_axes construction), with the
+    owner's seam plane folded into each left payload overwriting ghost
+    plane 0 (the per-axis generalisation of _extend_df's df
+    owner-consistency refresh — compiled df chains can round lo bits
+    position-dependently, so every duplicated seam plane is made
+    owner-consistent by construction). Unsharded axes zero-extend
+    locally: the zero fringe meets the zero-padded coefficient slices
+    exactly like a global domain edge."""
+    from .halo import _shift_from_left, _shift_from_right
+
+    chans = []
+    for d in dfs:
+        chans += [d.hi, d.lo]
+    s = jnp.stack(chans)  # grid axes shift by 1 in the stacked view
+    for ax in (2, 1, 0):
+        sax = ax + 1
+        L = s.shape[sax]
+        if dshape[ax] > 1:
+            name = AXIS_NAMES[ax]
+            to_left = lax.slice_in_dim(s, 1, P + 1, axis=sax)
+            halo_r = _shift_from_right(to_left, name)
+            # P halo planes + the seam owner's plane L-1 (= this shard's
+            # ghost plane 0) in one payload
+            to_right = lax.slice_in_dim(s, L - 1 - P, L, axis=sax)
+            recv_l = _shift_from_left(to_right, name)
+            halo_l = lax.slice_in_dim(recv_l, 0, P, axis=sax)
+            seam = lax.slice_in_dim(recv_l, P, P + 1, axis=sax)
+            idx = lax.axis_index(name)
+            first = lax.slice_in_dim(s, 0, 1, axis=sax)
+            new_first = jnp.where(idx == 0, first, seam)
+            s = jnp.concatenate(
+                [halo_l, new_first, lax.slice_in_dim(s, 1, L, axis=sax),
+                 halo_r], axis=sax,
+            )
+        else:
+            shp = list(s.shape)
+            shp[sax] = P
+            zero = jnp.zeros(shp, s.dtype)
+            s = jnp.concatenate([zero, s, zero], axis=sax)
+    return tuple(DF(s[2 * i], s[2 * i + 1]) for i in range(len(dfs)))
 
 
 def _extend_df(dfs, P: int):
@@ -136,39 +249,63 @@ def _extend_df(dfs, P: int):
 def dist_kron_df_cg_solve_local(op: DistKronLaplacianDF, b: DF,
                                 nreps: int,
                                 interpret: bool | None = None) -> DF:
-    """Per-shard fused-engine df CG (inside shard_map over an x-only
-    device mesh): returns the local DF solution block. Matches the
-    unfused dist df path (dist.kron_df.dist_cg_solve_df_local) to df
-    reassociation accuracy."""
+    """Per-shard fused-engine df CG (inside shard_map): returns the
+    local DF solution block. Matches the unfused dist df path
+    (dist.kron_df.dist_cg_solve_df_local) to df reassociation accuracy.
+    x-only device meshes use the plane-halo kernel form; any other
+    dshape the ext2d form (cross-sections halo-extended too, seam dedup
+    via the streamed w2d weight plane)."""
     P = op.degree
-    cx_local, aux_local, coeffs = _shard_tables_df(op)
-    wplane = aux_local[:, 0, 1][:, None, None]
+    x_only = _is_x_only(op)
+    if x_only:
+        cx_local, aux_local, coeffs = _shard_tables_df(op)
+        wplane = aux_local[:, 0, 1][:, None, None]
+
+        def engine(r, p_prev, beta4):
+            r_ext, p_ext = _extend_df((r, p_prev), P)
+            p, y, pdot = _kron_cg_df_call(
+                op, coeffs, True, interpret, r_ext, p_ext, beta4,
+                cx=cx_local, aux=aux_local,
+            )
+            return p, y, df_psum_all(pdot, op.dshape)
+    else:
+        cx_local, aux_local, coeffs, mask2d, w2d = _shard_tables_df_3d(op)
+        wplane = aux_local[:, 0, 1][:, None, None] * w2d[None]
+
+        def engine(r, p_prev, beta4):
+            r_ext, p_ext = _extend_all_axes_df((r, p_prev), P, op.dshape)
+            p, y, pdot = _kron_cg_df_call(
+                op, coeffs, True, interpret, r_ext, p_ext, beta4,
+                cx=cx_local, aux=aux_local, mask2d=mask2d, w2d=w2d,
+            )
+            return p, y, df_psum_all(pdot, op.dshape)
 
     def inner(u: DF, v: DF) -> DF:
         uw = DF(u.hi * wplane, u.lo * wplane)
         local = df_sum(DF(*_prod_terms(uw, v)))
         return df_psum_all(local, op.dshape)
 
-    def engine(r, p_prev, beta4):
-        r_ext, p_ext = _extend_df((r, p_prev), P)
-        p, y, pdot = _kron_cg_df_call(
-            op, coeffs, True, interpret, r_ext, p_ext, beta4,
-            cx=cx_local, aux=aux_local,
-        )
-        return p, y, df_psum_all(pdot, op.dshape)
-
     update = None
     if b.hi.size >= PALLAS_UPDATE_MIN_DOFS:
         # chunked pallas df update (the XLA whole-vector df fusion
-        # compile wall, ops.kron_cg_df); the duplicated seam plane's
-        # <r1, r1> is subtracted before the compensated fold
+        # compile wall, ops.kron_cg_df); the duplicated seam planes'
+        # <r1, r1> is subtracted before the compensated fold — one
+        # O(cross-section) plane on x-only meshes, the full weighted
+        # correction on ext2d meshes (seam rows/cols along every axis)
         def update(x, pv, r, y, alpha):
             x1, r1, rr = cg_update_df_pallas(x, pv, r, y, alpha,
                                              interpret)
-            w0 = 1.0 - wplane[0, 0, 0]
-            seam = df_sum(DF(*_prod_terms(
-                DF(r1.hi[0] * w0, r1.lo[0] * w0), DF(r1.hi[0], r1.lo[0])
-            )))
+            if x_only:
+                w0 = 1.0 - wplane[0, 0, 0]
+                r1s = DF(r1.hi[0], r1.lo[0])
+                seam = df_sum(DF(*_prod_terms(
+                    DF(r1s.hi * w0, r1s.lo * w0), r1s
+                )))
+            else:
+                w0 = 1.0 - wplane
+                seam = df_sum(DF(*_prod_terms(
+                    DF(r1.hi * w0, r1.lo * w0), r1
+                )))
             rr_own = df_sub(rr, seam)
             return x1, r1, df_psum_all(rr_own, op.dshape)
 
@@ -187,12 +324,21 @@ def dist_kron_df_apply_ring_local(op: DistKronLaplacianDF, x: DF,
                                   interpret: bool | None = None) -> DF:
     """Per-shard single fused df apply y = A x (inside shard_map),
     discarding the fused dot — the df action-benchmark analogue of
-    dist.kron_cg.dist_kron_apply_ring_local."""
+    dist.kron_cg.dist_kron_apply_ring_local. x-only meshes use the
+    plane-halo form; any other dshape the ext2d form."""
     P = op.degree
-    cx_local, aux_local, coeffs = _shard_tables_df(op)
-    (x_ext,) = _extend_df((x,), P)  # 2 channels only: no p payload
+    if _is_x_only(op):
+        cx_local, aux_local, coeffs = _shard_tables_df(op)
+        (x_ext,) = _extend_df((x,), P)  # 2 channels only: no p payload
+        y, _ = _kron_cg_df_call(
+            op, coeffs, False, interpret, x_ext,
+            cx=cx_local, aux=aux_local,
+        )
+        return y
+    cx_local, aux_local, coeffs, mask2d, w2d = _shard_tables_df_3d(op)
+    (x_ext,) = _extend_all_axes_df((x,), P, op.dshape)
     y, _ = _kron_cg_df_call(
         op, coeffs, False, interpret, x_ext,
-        cx=cx_local, aux=aux_local,
+        cx=cx_local, aux=aux_local, mask2d=mask2d, w2d=w2d,
     )
     return y

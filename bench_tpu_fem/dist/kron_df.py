@@ -406,8 +406,9 @@ def dist_cg_solve_df_local(op: DistKronLaplacianDF, b: DF,
 
 def resolve_df_engine(op: DistKronLaplacianDF) -> bool:
     """The fused dist df engine auto rule (mirrors
-    dist.kron.resolve_kron_engine): Mosaic kernels on TPU only, x-only
-    meshes, ring within a scoped-VMEM tier."""
+    dist.kron.resolve_kron_engine): Mosaic kernels on TPU only, any
+    device mesh (x-only meshes take the plane-halo form, 3D meshes the
+    ext2d form), ring within a scoped-VMEM tier."""
     import jax as _jax
 
     from .kron_cg_df import supports_dist_df_engine
@@ -423,8 +424,9 @@ def make_kron_df_sharded_fns(op: DistKronLaplacianDF, dgrid, nreps: int,
     dist.kron.make_kron_sharded_fns.
 
     `engine=None` (auto) routes CG and the apply through the fused
-    distributed df delay-ring engine (dist.kron_cg_df) on TPU x-only
-    meshes where the ring fits a scoped-VMEM tier; the unfused df
+    distributed df delay-ring engine (dist.kron_cg_df) on TPU where the
+    ring fits a scoped-VMEM tier — any dshape (x-only meshes take the
+    plane-halo kernel form, 3D meshes the ext2d form); the unfused df
     stage/halo path serves everything else and remains the
     compile-failure fallback."""
     from jax.sharding import PartitionSpec as P
@@ -437,13 +439,13 @@ def make_kron_df_sharded_fns(op: DistKronLaplacianDF, dgrid, nreps: int,
         from .kron_cg_df import supports_dist_df_engine
 
         if not supports_dist_df_engine(op):
-            # unlike the f32 engine (which has a 3D ext2d form), the
-            # fused df engine exchanges x halos only — an explicit
-            # override on another mesh would silently double-count y/z
-            # seam dofs
+            # the one remaining unsupported region: rings past every
+            # scoped-VMEM tier (the chunked df form has no halo
+            # variant) — an explicit override there would Mosaic-fail
+            # anyway, so refuse with the reason
             raise ValueError(
-                "the fused dist df engine needs an x-only device mesh "
-                f"with a VMEM-fitting ring (dshape {op.dshape})"
+                "the fused dist df engine needs a VMEM-tier-fitting "
+                f"ring (dshape {op.dshape}, local {op.L})"
             )
 
     def _local(a):

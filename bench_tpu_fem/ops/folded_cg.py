@@ -93,7 +93,16 @@ def _make_cg_apply_kernel(P: int, nl: int, B: int, nb: int, KI: int, K: int,
                           phi0: np.ndarray, dphi1: np.ndarray,
                           qr: dict[str, tuple[int, int]],
                           n_cells: tuple[int, int, int],
-                          update_p: bool, geom_tables=None):
+                          update_p: bool, geom_tables=None,
+                          stream_masks: bool = False):
+    """`stream_masks` is the HALO (distributed) form of the kernel
+    (dist.folded_cg): the closed-form Dirichlet mask assumes local block
+    coordinates are global, which is false on a shard, so the per-shard bc
+    mask streams as a (1, P^3, B) block instead; and a second streamed 0/1
+    weight block (the owned-dof mask) multiplies the <p, y> partials so
+    duplicated seam slots and ghost columns count zero BEFORE the psum —
+    every dof exactly once globally. The delay ring, seam rings, p-update
+    and emit schedule are identical to the single-chip form."""
     corner_mode = geom_tables is not None
     D = KI - 1
     nx, ny, nz = n_cells
@@ -115,6 +124,10 @@ def _make_cg_apply_kernel(P: int, nl: int, B: int, nb: int, KI: int, K: int,
         geom_refs = refs[ni:ni + ngeom]
         scal_ref = refs[ni + ngeom]  # SMEM (1, 2): [beta, kappa]
         base = ni + 1 + ngeom
+        bc_ref = w_ref = None
+        if stream_masks:
+            bc_ref, w_ref = refs[base:base + 2]
+            base += 2
         if update_p:
             p_out_ref, y_out_ref, dot_ref = refs[base:base + 3]
             no = 3
@@ -174,38 +187,54 @@ def _make_cg_apply_kernel(P: int, nl: int, B: int, nb: int, KI: int, K: int,
                                          scal_ref[0, 1], phi0, dphi1,
                                          is_identity)
             m = _seam_accumulate(rings, y, i, K, qr, B, nl, P)
-            # Dirichlet pass-through with the bc mask computed IN-KERNEL
-            # from the structured-box closed form (no 4 B/dof HBM stream):
-            # grid coord X = cx*P + ilocal is on the boundary iff
-            # ilocal == 0 and cx in {0, nx} (the global X = nx*P plane lives
-            # in the ghost column's ilocal = 0 slots) — and likewise per
-            # axis. Sequential per-axis selects compose the union.
-            cat = jnp.concatenate
-            sub_i = jax.lax.broadcasted_iota(jnp.int32, (SUBLANES, nl), 0)
-            lane_i = jax.lax.broadcasted_iota(jnp.int32, (SUBLANES, nl), 1)
-            c = i * np.int32(B) + sub_i * np.int32(nl) + lane_i
-            cx = jax.lax.div(c, np.int32(npy * npz))
-            rem = c - cx * np.int32(npy * npz)
-            cy = jax.lax.div(rem, np.int32(npz))
-            cz = rem - cy * np.int32(npz)
-            mx = jnp.logical_or(cx == 0, cx == np.int32(nx))
-            my = jnp.logical_or(cy == 0, cy == np.int32(ny))
-            mz = jnp.logical_or(cz == 0, cz == np.int32(nz))
+            if stream_masks:
+                # HALO form: per-shard bc mask streamed (the closed form
+                # below needs global coordinates), applied as the same
+                # multiplicative blend as folded_cell_apply_fused; the
+                # dot partials are weighted by the streamed owned mask so
+                # ghost/duplicated-seam slots count zero before the psum.
+                bcb = _r8(bc_ref[0], nl).reshape(P, P, P, SUBLANES, nl)
+                m = m + bcb * (u0 - m)
+                wb = _r8(w_ref[0], nl).reshape(P, P, P, SUBLANES, nl)
+                prod = u0 * m * wb
+            else:
+                # Dirichlet pass-through with the bc mask computed
+                # IN-KERNEL from the structured-box closed form (no
+                # 4 B/dof mask stream): grid coord X = cx*P + ilocal is
+                # on the boundary iff ilocal == 0 and cx in {0, nx} (the
+                # global X = nx*P plane lives in the ghost column's
+                # ilocal = 0 slots) — and likewise per axis. Sequential
+                # per-axis selects compose the union.
+                cat = jnp.concatenate
+                sub_i = jax.lax.broadcasted_iota(
+                    jnp.int32, (SUBLANES, nl), 0)
+                lane_i = jax.lax.broadcasted_iota(
+                    jnp.int32, (SUBLANES, nl), 1)
+                c = i * np.int32(B) + sub_i * np.int32(nl) + lane_i
+                cx = jax.lax.div(c, np.int32(npy * npz))
+                rem = c - cx * np.int32(npy * npz)
+                cy = jax.lax.div(rem, np.int32(npz))
+                cz = rem - cy * np.int32(npz)
+                mx = jnp.logical_or(cx == 0, cx == np.int32(nx))
+                my = jnp.logical_or(cy == 0, cy == np.int32(ny))
+                mz = jnp.logical_or(cz == 0, cz == np.int32(nz))
 
-            def bsel(mask, lead_shape):
-                return jax.lax.broadcast(mask, lead_shape)
+                def bsel(mask, lead_shape):
+                    return jax.lax.broadcast(mask, lead_shape)
 
-            m = cat([jax.lax.select(bsel(mx, (P, P)), u0[0], m[0])[None],
-                     m[1:]], axis=0)
-            m = cat([jax.lax.select(bsel(my, (P, P)), u0[:, 0],
-                                    m[:, 0])[:, None], m[:, 1:]], axis=1)
-            m = cat([jax.lax.select(bsel(mz, (P, P)), u0[:, :, 0],
-                                    m[:, :, 0])[:, :, None],
-                     m[:, :, 1:]], axis=2)
+                m = cat([jax.lax.select(bsel(mx, (P, P)), u0[0],
+                                        m[0])[None], m[1:]], axis=0)
+                m = cat([jax.lax.select(bsel(my, (P, P)), u0[:, 0],
+                                        m[:, 0])[:, None], m[:, 1:]],
+                        axis=1)
+                m = cat([jax.lax.select(bsel(mz, (P, P)), u0[:, :, 0],
+                                        m[:, :, 0])[:, :, None],
+                         m[:, :, 1:]], axis=2)
+                prod = u0 * m
             y_out_ref[0] = _rb(m).reshape(P * P * P, B)
             # <p, y> partial for this block, reduced over the 27 window rows
             dot_ref[...] = jnp.sum(
-                (u0 * m).reshape(P * P * P, SUBLANES, nl), axis=0
+                prod.reshape(P * P * P, SUBLANES, nl), axis=0
             )[None]
 
     return kernel
@@ -222,11 +251,17 @@ def _cg_apply_call(
     update_p: bool,
     interpret: bool | None,
     *vectors,
+    masks=None,
 ):
     """update_p: vectors = (r, p_prev, beta) -> (p, y, dot_partials).
     else:       vectors = (x,)              -> (y, dot_partials) where the
     dot partials are of <x, y> (used for <p, A p> style reductions).
-    kappa rides in SMEM next to beta — no scaled copy of G is ever made."""
+    kappa rides in SMEM next to beta — no scaled copy of G is ever made.
+
+    `masks = (bc, w)` selects the HALO form (dist.folded_cg): two extra
+    streamed (nb, P^3, B) blocks — the per-shard Dirichlet mask replacing
+    the closed-form in-kernel one, and the owned-dof dot weight (see
+    _make_cg_apply_kernel)."""
     P = layout.degree
     nl, B, nb = layout.nl, layout.block, layout.nblocks
     nq = phi0.shape[0]
@@ -289,6 +324,13 @@ def _cg_apply_call(
         jnp.stack([beta.astype(dtype),
                    jnp.asarray(kappa, dtype)]).reshape(1, 2)
     )
+    if masks is not None:
+        # halo form: bc + owned-weight blocks, consumed at the emit stage
+        # for output block i = t - D
+        for mk in masks:
+            in_specs.append(pl.BlockSpec((1, P3, B), clamp_out,
+                                         memory_space=pltpu.VMEM))
+            operands.append(mk.astype(dtype))
 
     out_specs = []
     out_shapes = []
@@ -311,6 +353,7 @@ def _cg_apply_call(
         P, nl, B, nb, KI, K, is_identity,
         np.asarray(phi0, np.float64), np.asarray(dphi1, np.float64),
         qr, layout.n, update_p, geom_tables=geom_tables,
+        stream_masks=masks is not None,
     )
     return pl.pallas_call(
         kernel,

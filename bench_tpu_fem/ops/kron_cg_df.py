@@ -289,7 +289,8 @@ def _plane_dot_df(ph, plo, yh, ylo, NY: int, NZ: int):
 
 
 def _make_kron_cg_df_kernel(P: int, NX: int, NY: int, NZ: int,
-                            update_p: bool, halo: int = 0):
+                            update_p: bool, halo: int = 0,
+                            ext2d: bool = False):
     """One-kernel delay-ring df CG iteration: grid of NX + P steps. Step
     t < NX ingests plane t (df p-update fused), contracts z and y in
     registers, and scatter-accumulates the x-band contribution into the
@@ -305,13 +306,29 @@ def _make_kron_cg_df_kernel(P: int, NX: int, NY: int, NZ: int,
     i + halo + P) — every output row globally exact, no boundary
     epilogue, grid exactly NX + 2*halo steps. The per-plane
     [interior-in-x, dot-ownership] pair streams via SMEM (aux_ref), as
-    in the f32 halo form (ops.kron_cg)."""
+    in the f32 halo form (ops.kron_cg).
+
+    `ext2d` (3D-sharded meshes, with halo = P — the df twin of the f32
+    ext2d form, ops.kron_cg): the input planes are halo-extended in y/z
+    as well ((NY+2P, NZ+2P), NY/NZ the LOCAL cross-section); the df z/y
+    contractions run on the extended cross-section with per-shard
+    global-indexed 4-channel coefficient slices — exact on the local
+    window, garbage in the (unconsumed) halo fringe — and the local
+    (NY, NZ) window of (p, t12, tyz) is sliced before the ring stores
+    and the accumulator scatter. The Dirichlet interior test and the
+    cross-section dot-ownership weights come from two streamed (NY, NZ)
+    mask planes (mask2d, w2d): the closed-form iota test and the
+    per-plane scalar weight only know global axes. The 0/1 w2d weight
+    multiplies the p channels BEFORE the compensated plane dot —
+    exact, so the compensation survives the dedup."""
     KI = 2 * P + 1  # accumulator ring: exactly the live x-band window
     KP = P + 1  # p ring: read back once at lag P
     nb = 2 * P + 1
     lag = P + halo
     n_in = NX + 2 * halo
     nsteps = n_in if halo else NX + P
+    E = 2 * P if ext2d else 0
+    NYe, NZe = NY + E, NZ + E
 
     def kernel(*refs):
         if update_p:
@@ -329,10 +346,13 @@ def _make_kron_cg_df_kernel(P: int, NX: int, NY: int, NZ: int,
         # kernels' multi-view pattern)
         cx_refs = refs[ni:ni + nb]
         ni += nb
-        aux_ref = None
+        aux_ref = mask2d_ref = w2d_ref = None
         if halo:
             aux_ref = refs[ni]
             ni += 1
+            if ext2d:
+                mask2d_ref, w2d_ref = refs[ni:ni + 2]
+                ni += 2
         beta_ref = refs[ni]
         base = ni + 1
         if update_p:
@@ -376,6 +396,18 @@ def _make_kron_cg_df_kernel(P: int, NX: int, NY: int, NZ: int,
                 tbh, tbl = two_sum(tb, eb)  # renorm-first (_acc2 docstring)
                 s, c = two_sum(tbh, rh_ref[0])
                 p2h, p2l = _renorm2(s, (tbl + c) + rl_ref[0])
+            else:
+                p2h = xh_ref[0]
+                p2l = xl_ref[0]
+            if ext2d:
+                # p-update runs on the FULL extended plane (the halo
+                # fringe feeds the contractions); ring/p_out carry the
+                # local window only
+                p2h_loc = p2h[P:P + NY, P:P + NZ]
+                p2l_loc = p2l[P:P + NY, P:P + NZ]
+            else:
+                p2h_loc, p2l_loc = p2h, p2l
+            if update_p:
                 if halo:
                     # p is owned for the NX local planes only; halo
                     # planes feed the rings but are the neighbours' to
@@ -383,25 +415,31 @@ def _make_kron_cg_df_kernel(P: int, NX: int, NY: int, NZ: int,
                     @pl.when(jnp.logical_and(t >= np.int32(halo),
                                              t < np.int32(NX + halo)))
                     def _store_p():
-                        ph_out[0] = p2h
-                        pl_out[0] = p2l
+                        ph_out[0] = p2h_loc
+                        pl_out[0] = p2l_loc
                 else:
-                    ph_out[0] = p2h
-                    pl_out[0] = p2l
-            else:
-                p2h = xh_ref[0]
-                p2l = xl_ref[0]
+                    ph_out[0] = p2h_loc
+                    pl_out[0] = p2l_loc
             # ungated extended-index ring store (the f32 halo kernel's
             # scheme): emit for local output i reads the plane ingested
             # at extended step i + halo — P intervening stores fill the
             # other KP-1 slots, so no collision in either form
-            ring_ph[jax.lax.rem(t, np.int32(KP))] = p2h
-            ring_pl[jax.lax.rem(t, np.int32(KP))] = p2l
+            ring_ph[jax.lax.rem(t, np.int32(KP))] = p2h_loc
+            ring_pl[jax.lax.rem(t, np.int32(KP))] = p2l_loc
 
-            aK, aM = _z_contract_df(p2h, p2l, ckz_ref, cmz_ref, P, NZ)
-            t12, tyz = _y_contract_df(aK, aM, cky_ref, cmy_ref, P, NY)
+            aK, aM = _z_contract_df(p2h, p2l, ckz_ref, cmz_ref, P, NZe)
+            t12, tyz = _y_contract_df(aK, aM, cky_ref, cmy_ref, P, NYe)
             t12h, t12l = t12
             tyzh, tyzl = tyz
+            if ext2d:
+                # exact on the local window (the per-shard coefficient
+                # slices are global-indexed there); the halo fringe
+                # rows/cols are garbage and sliced away before the
+                # accumulator scatter
+                t12h = t12h[P:P + NY, P:P + NZ]
+                t12l = t12l[P:P + NY, P:P + NZ]
+                tyzh = tyzh[P:P + NY, P:P + NZ]
+                tyzl = tyzl[P:P + NY, P:P + NZ]
             t12hh, t12hl = _split(t12h)
             tyzhh, tyzhl = _split(tyzh)
 
@@ -445,19 +483,22 @@ def _make_kron_cg_df_kernel(P: int, NX: int, NY: int, NZ: int,
             pslot = jax.lax.rem(i + np.int32(halo), np.int32(KP))
             p_ih = ring_ph[pslot]
             p_il = ring_pl[pslot]
-            gy = jax.lax.broadcasted_iota(jnp.int32, (NY, NZ), 0)
-            gz = jax.lax.broadcasted_iota(jnp.int32, (NY, NZ), 1)
             # interior-in-x from the streamed aux row in the halo form
             # (the local plane index is not the global one)
             mi = (aux_ref[0, 0, 0] > 0.5 if halo
                   else jnp.logical_and(i > 0, i < np.int32(NX - 1)))
-            inter = jnp.logical_and(
-                mi,
-                jnp.logical_and(
+            if ext2d:
+                # streamed cross-section interior mask: local row/col
+                # indices are not global ones on a 3D-sharded mesh
+                inter2d = mask2d_ref[...] > 0.5
+            else:
+                gy = jax.lax.broadcasted_iota(jnp.int32, (NY, NZ), 0)
+                gz = jax.lax.broadcasted_iota(jnp.int32, (NY, NZ), 1)
+                inter2d = jnp.logical_and(
                     jnp.logical_and(gy > 0, gy < np.int32(NY - 1)),
                     jnp.logical_and(gz > 0, gz < np.int32(NZ - 1)),
-                ),
-            )
+                )
+            inter = jnp.logical_and(mi, inter2d)
             yh = jax.lax.select(inter, yh, p_ih)
             yl = jax.lax.select(inter, yl, p_il)
             yh_out[0] = yh
@@ -466,7 +507,14 @@ def _make_kron_cg_df_kernel(P: int, NX: int, NY: int, NZ: int,
             # i + KI - P (+halo) > t, strictly after this zeroing)
             acc_p[slot] = jnp.zeros_like(yh)
             acc_e[slot] = jnp.zeros_like(yh)
-            dp, de = _plane_dot_df(p_ih, p_il, yh, yl, NY, NZ)
+            if ext2d:
+                # cross-section seam dedup: the exact 0/1 w2d weight
+                # multiplies the p channels before the compensated dot
+                pdh = p_ih * w2d_ref[...]
+                pdl = p_il * w2d_ref[...]
+            else:
+                pdh, pdl = p_ih, p_il
+            dp, de = _plane_dot_df(pdh, pdl, yh, yl, NY, NZ)
             if halo:
                 # dot-ownership weight: 0 on duplicated seam planes so
                 # <p, A p> counts every dof once globally
@@ -512,7 +560,8 @@ def _cx_rows_df(op: KronLaplacianDF, NX: int) -> jnp.ndarray:
 
 
 def _kron_cg_df_call(op: KronLaplacianDF, coeffs, update_p: bool,
-                     interpret, *vectors, cx=None, aux=None):
+                     interpret, *vectors, cx=None, aux=None,
+                     mask2d=None, w2d=None):
     """update_p: vectors = (r: DF, p_prev: DF, beta4: (1,4)) ->
     (p: DF, y: DF, <p, A p>: scalar DF).
     else: vectors = (x: DF) -> (y: DF, <x, A x>: scalar DF).
@@ -520,14 +569,25 @@ def _kron_cg_df_call(op: KronLaplacianDF, coeffs, update_p: bool,
     With `cx`/`aux` given (the distributed form, dist.kron_cg_df),
     vectors are halo-extended (NX + 2P, NY, NZ) DF slabs, `cx` carries
     the per-shard 8nb-channel x-coefficient rows, `aux` the per-plane
-    [interior-in-x, dot-ownership] pairs; outputs stay (NX, NY, NZ)."""
+    [interior-in-x, dot-ownership] pairs; outputs stay (NX, NY, NZ).
+
+    With `mask2d`/`w2d` also given (the ext2d 3D-sharded form), vectors
+    are halo-extended in every axis ((NX+2P, NY+2P, NZ+2P) DF slabs),
+    `coeffs` carries the per-shard extended 4-channel (ckz, cmz, cky,
+    cmy) banded slices, `mask2d` the (NY, NZ) cross-section
+    Dirichlet-interior mask and `w2d` the cross-section dot-ownership
+    weights; outputs stay (NX, NY, NZ)."""
     P = op.degree
     halo = 0 if cx is None else P
+    ext2d = mask2d is not None
+    E = 2 * P if ext2d else 0
     if halo == 0:
         NX, NY, NZ = _grid_shape(op)
     else:
-        NXe, NY, NZ = (int(d) for d in vectors[0].hi.shape)
+        NXe, NYe_in, NZe_in = (int(d) for d in vectors[0].hi.shape)
         NX = NXe - 2 * P
+        NY, NZ = NYe_in - E, NZe_in - E
+    NYe, NZe = NY + E, NZ + E
     nb = 2 * P + 1
     ckz, cmz, cky, cmy, cx_rows = coeffs
     if cx is not None:
@@ -544,7 +604,7 @@ def _kron_cg_df_call(op: KronLaplacianDF, coeffs, update_p: bool,
         return (jax.lax.clamp(np.int32(0), t - np.int32(lag),
                               np.int32(NX - 1)), 0, 0)
 
-    plane_spec_in = pl.BlockSpec((1, NY, NZ), clamp_in,
+    plane_spec_in = pl.BlockSpec((1, NYe, NZe), clamp_in,
                                  memory_space=pltpu.VMEM)
     plane_spec_out = pl.BlockSpec((1, NY, NZ), clamp_out,
                                   memory_space=pltpu.VMEM)
@@ -560,7 +620,7 @@ def _kron_cg_df_call(op: KronLaplacianDF, coeffs, update_p: bool,
         beta4 = jnp.zeros((1, 4), dtype)
         in_specs += [plane_spec_in] * 2
         operands += [x.hi, x.lo]
-    for c, n_ax in ((ckz, NZ), (cmz, NZ), (cky, NY), (cmy, NY)):
+    for c, n_ax in ((ckz, NZe), (cmz, NZe), (cky, NYe), (cmy, NYe)):
         in_specs.append(pl.BlockSpec((4, nb, n_ax), lambda t: (0, 0, 0),
                                      memory_space=pltpu.VMEM))
         operands.append(c)
@@ -579,6 +639,11 @@ def _kron_cg_df_call(op: KronLaplacianDF, coeffs, update_p: bool,
         in_specs.append(pl.BlockSpec((1, 1, 2), clamp_out,
                                      memory_space=pltpu.SMEM))
         operands.append(aux)
+        if ext2d:
+            for plane in (mask2d, w2d):
+                in_specs.append(pl.BlockSpec((NY, NZ), lambda t: (0, 0),
+                                             memory_space=pltpu.VMEM))
+                operands.append(plane.astype(dtype))
     in_specs.append(pl.BlockSpec((1, 4), lambda t: (0, 0),
                                  memory_space=pltpu.SMEM))
     operands.append(beta4)
@@ -599,7 +664,8 @@ def _kron_cg_df_call(op: KronLaplacianDF, coeffs, update_p: bool,
                                   memory_space=pltpu.VMEM))
     out_shapes.append(jax.ShapeDtypeStruct((1, 2), dtype))
 
-    kernel = _make_kron_cg_df_kernel(P, NX, NY, NZ, update_p, halo=halo)
+    kernel = _make_kron_cg_df_kernel(P, NX, NY, NZ, update_p, halo=halo,
+                                     ext2d=ext2d)
     out = pl.pallas_call(
         kernel,
         grid=(nsteps,),

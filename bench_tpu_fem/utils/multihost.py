@@ -38,10 +38,41 @@ def launched_multihost() -> bool:
 def maybe_initialize() -> bool:
     """Call jax.distributed.initialize() iff launched multi-host; returns
     whether initialisation ran. Must be called before any backend use
-    (the CLI does, right after platform selection)."""
+    (the CLI does, right after platform selection).
+
+    On launchers that export the coordinator/process env vars explicitly
+    (JAX_COORDINATOR_ADDRESS + JAX_PROCESS_ID/JAX_NUM_PROCESSES — the
+    repo's own 2-process CI lane, scripts/multihost_smoke.py, and any
+    plain-ssh launch) the values are passed to initialize() directly:
+    the installed jax 0.4.x only auto-detects SLURM/OpenMPI/TPU cluster
+    environments, not these generic vars. Cluster launchers without the
+    explicit pair keep the autodetect path."""
     if not launched_multihost():
         return False
     import jax
 
-    jax.distributed.initialize()
+    # Only JAX_COORDINATOR_ADDRESS names the jax.distributed service
+    # itself; the other launch-detection vars (MEGASCALE_*) point at
+    # different services and must stay on the autodetect path. All three
+    # explicit vars must be non-empty together — empty-string exports
+    # (unset launcher substitutions) fall through to autodetect rather
+    # than crashing on int("").
+    addr = os.environ.get("JAX_COORDINATOR_ADDRESS")
+    nproc = os.environ.get("JAX_NUM_PROCESSES")
+    pid = os.environ.get("JAX_PROCESS_ID")
+    if addr and nproc and pid:
+        try:
+            nproc_i, pid_i = int(nproc), int(pid)
+        except ValueError as exc:
+            raise ValueError(
+                "malformed multihost env: JAX_NUM_PROCESSES="
+                f"{nproc!r} JAX_PROCESS_ID={pid!r} must be integers"
+            ) from exc
+        jax.distributed.initialize(
+            coordinator_address=addr,
+            num_processes=nproc_i,
+            process_id=pid_i,
+        )
+    else:
+        jax.distributed.initialize()
     return True

@@ -382,6 +382,74 @@ def stage_dflarge():
             nreps=reps, use_cg=True, f64_impl="df32"), timeout=2400)
 
 
+def stage_foldeng():
+    # Dist folded fused engine vs unfused A/B at the flagship perturbed
+    # config (the sharded graph end to end on a 1-device mesh: halo
+    # refresh, halo-form delay-ring Mosaic compile, reverse-scatter dot
+    # tail — the collectives degenerate to identity there; multi-chip
+    # scaling needs real multi-chip hardware). Engine routing and any
+    # recorded fallback ride res.extra (cg_engine_form: halo/unfused).
+    code = """
+import jax, jax.numpy as jnp
+from bench_tpu_fem.bench.driver import BenchConfig, BenchmarkResults
+from bench_tpu_fem.dist.driver import run_distributed
+cfg = BenchConfig(ndofs_global=12_500_000, degree=3, qmode=1,
+                  float_bits=32, nreps=500, use_cg=True, ndevices=1,
+                  backend="pallas", geom_perturb_fact=0.2)
+res = BenchmarkResults(nreps=cfg.nreps)
+run_distributed(cfg, res, jnp.float32)
+print("FOLDENG:", res.gdof_per_second, res.extra, "ynorm", res.ynorm)
+# loud on routing drift: an unfused fallback here would otherwise make
+# the A/B below compare unfused vs unfused (the reason is in the extras)
+assert res.extra.get("cg_engine_form") == "halo", res.extra
+import bench_tpu_fem.dist.folded_cg as DFC
+DFC.dist_folded_engine_plan = lambda op: (False, None)
+res2 = BenchmarkResults(nreps=cfg.nreps)
+run_distributed(cfg, res2, jnp.float32)
+print("FOLDENG-UNFUSED:", res2.gdof_per_second, res2.extra,
+      "ynorm", res2.ynorm, "speedup:",
+      res.gdof_per_second / max(res2.gdof_per_second, 1e-12))
+"""
+    rc, out = run_py(code, timeout=2400)
+    log(f"foldeng rc={rc}: {out}")
+
+
+def stage_dfext2d():
+    # ext2d df engine form ((2,2,2)-dshape coverage). On an 8-device rig
+    # this is the real (2,2,2) run; on the 1-chip rig the ext2d branch
+    # is forced onto the 1-device mesh — the kernel form's FIRST Mosaic
+    # compile is the gate that matters (round-4 lesson: interpret mode
+    # accepts kernels Mosaic rejects), and with degenerate collectives
+    # the halo fringes are zero so the numbers stay exact. Gated behind
+    # dfacc in the default agenda like every df number. (The force
+    # patches the private _is_x_only predicate, which the solve path
+    # reads at call time — the cg_engine_form assert below turns any
+    # routing drift into a loud rc!=0, never a silent wrong-form
+    # measurement.)
+    code = """
+import jax, jax.numpy as jnp
+from bench_tpu_fem.bench.driver import BenchConfig, BenchmarkResults
+from bench_tpu_fem.dist.driver import run_distributed_df64
+nd = len(jax.devices())
+if nd >= 8:
+    ndev, tag = 8, "(2,2,2)"
+else:
+    import bench_tpu_fem.dist.kron_cg_df as KCD
+    KCD._is_x_only = lambda op: False
+    ndev, tag = 1, "forced-ext2d-1dev"
+cfg = BenchConfig(ndofs_global=2_000_000, degree=3, qmode=1,
+                  float_bits=64, nreps=50, use_cg=True,
+                  f64_impl="df32", ndevices=ndev)
+res = BenchmarkResults(nreps=cfg.nreps)
+run_distributed_df64(cfg, res)
+print("DFEXT2D", tag, ":", res.gdof_per_second, res.extra,
+      "ynorm", res.ynorm)
+assert res.extra.get("cg_engine_form") == "ext2d", res.extra
+"""
+    rc, out = run_py(code, timeout=2400)
+    log(f"dfext2d rc={rc}: {out}")
+
+
 STAGES = {
     "health": stage_health, "ab12": stage_ab12, "q6": stage_q6,
     "large": stage_large, "deg4": stage_deg4, "df32": stage_df32,
@@ -391,18 +459,26 @@ STAGES = {
     "p300": stage_p300, "pert100": stage_pert100,
     "deg7probe": stage_deg7probe, "dfacc": stage_dfacc,
     "dfeng": stage_dfeng, "dflarge": stage_dflarge,
-    "pertdf": stage_pertdf,
+    "pertdf": stage_pertdf, "foldeng": stage_foldeng,
+    "dfext2d": stage_dfext2d,
 }
+
+# df stages whose numbers only count after the on-hardware df accuracy
+# gate (dfacc) passes — when dfacc runs in the same agenda and FAILS,
+# these are skipped with a log line instead of producing numbers that
+# round-5's evidence-hygiene rule would have to discard.
+DF_GATED = {"pertdf", "dfeng", "dflarge", "dfext2d"}
 
 if __name__ == "__main__":
     # Round-6 default agenda, ordered by value-per-minute under wedge
     # risk: the df accuracy gates first (nothing df counts without
     # them — pertdf is the folded df pipeline's first Mosaic compile),
-    # then the official bench line, then df perf, the leftovers, and
-    # the full matrix (longest) last.
-    wanted = sys.argv[1:] or ["health", "dfacc", "pertdf", "dfeng",
-                              "bench", "dflarge", "pert100",
-                              "deg7probe", "matrix"]
+    # then the new fused-coverage forms (foldeng is f32 — ungated;
+    # dfext2d is df — gated), the official bench line, df perf, the
+    # leftovers, and the full matrix (longest) last.
+    wanted = sys.argv[1:] or ["health", "dfacc", "pertdf", "foldeng",
+                              "dfext2d", "dfeng", "bench", "dflarge",
+                              "pert100", "deg7probe", "matrix"]
     unknown = [s for s in wanted if s not in STAGES]
     if unknown:
         print(f"unknown stage(s) {unknown}; valid: {list(STAGES)}",
@@ -411,11 +487,19 @@ if __name__ == "__main__":
     if "health" in wanted and not stage_health():
         log("tunnel down; aborting")
         sys.exit(1)
+    dfacc_ok = None  # unknown until (and unless) the gate stage runs
     for s in wanted:
         if s == "health":
             continue
+        if s in DF_GATED and dfacc_ok is False:
+            log(f"=== stage {s} SKIPPED: dfacc gate failed — df numbers "
+                "don't count without the on-hardware accuracy check")
+            continue
         log(f"=== stage {s}")
         try:
-            STAGES[s]()
+            result = STAGES[s]()
         except Exception as e:
             log(f"stage {s} EXC: {e}")
+            result = None
+        if s == "dfacc":
+            dfacc_ok = bool(result)

@@ -1,0 +1,83 @@
+#!/usr/bin/env python
+"""One process of the 2-process multi-controller CI lane — the true
+analogue of the reference CI's `mpirun -n 2` job (.github/workflows/
+ci.yml), which virtual-device tests cannot exercise: every virtual-device
+suite runs ONE controller, so jax.distributed.initialize, the gloo CPU
+collectives, cross-PROCESS ppermute/psum, and the cross-host timer
+allgather (utils.timing.aggregated_timings) never execute there.
+
+Launched once per process by tests/test_multihost.py (or by hand, see
+below) with the coordinator env vars set; each process contributes ONE
+CPU device, joins via utils.multihost.maybe_initialize, runs the golden
+sharded config (2197 dofs at degree 3 — the config where serial and
+sharded mesh sizings provably coincide, scripts/check_output.py) through
+the distributed kron CG driver over the 2-device grid, max-reduces the
+timer table across the processes, and prints one RESULT line. The parent
+asserts both processes print the SAME y_norm and that it matches a
+serial single-process reference to f64 reduction tolerance.
+
+Manual launch (two shells or one with &):
+
+    JAX_PLATFORMS=cpu JAX_COORDINATOR_ADDRESS=127.0.0.1:29511 \
+    JAX_NUM_PROCESSES=2 JAX_PROCESS_ID=0 python scripts/multihost_smoke.py &
+    JAX_PLATFORMS=cpu JAX_COORDINATOR_ADDRESS=127.0.0.1:29511 \
+    JAX_NUM_PROCESSES=2 JAX_PROCESS_ID=1 python scripts/multihost_smoke.py
+"""
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+# Pin the CPU platform WITHOUT the virtual-device multiplication the
+# test conftest exports: each controller must contribute exactly one
+# device, or the 2-device grid would land entirely on process 0.
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+from bench_tpu_fem.utils.hermetic import force_host_cpu_devices  # noqa: E402
+
+force_host_cpu_devices(1)
+
+import jax  # noqa: E402
+
+# gloo is the jaxlib-bundled cross-process CPU collectives backend (the
+# MPI analogue); must be selected before the backend initialises
+jax.config.update("jax_cpu_collectives_implementation", "gloo")
+
+from bench_tpu_fem.utils.multihost import maybe_initialize  # noqa: E402
+
+
+def main() -> int:
+    assert maybe_initialize(), (
+        "multihost env vars not set — launch via tests/test_multihost.py "
+        "or the manual command in the module docstring"
+    )
+    assert jax.process_count() == 2, jax.process_count()
+    assert len(jax.devices()) == 2, jax.devices()
+
+    jax.config.update("jax_enable_x64", True)
+    import jax.numpy as jnp
+
+    from bench_tpu_fem.bench.driver import BenchConfig, BenchmarkResults
+    from bench_tpu_fem.dist.driver import run_distributed
+    from bench_tpu_fem.utils.timing import aggregated_timings
+
+    cfg = BenchConfig(ndofs_global=2197, degree=3, qmode=0, float_bits=64,
+                      nreps=10, use_cg=True, ndevices=2)
+    res = BenchmarkResults(nreps=cfg.nreps)
+    run_distributed(cfg, res, jnp.float64)
+
+    # the cross-host timer allgather: max-reduces the per-process timer
+    # registries (the reference's MPI_MAX list_timings table) — raises if
+    # the phase-name digests diverge across the two processes
+    agg = aggregated_timings()
+    assert agg, "timer registry empty — the driver stopped timing phases"
+
+    print(f"RESULT pid={jax.process_index()} ynorm={res.ynorm!r} "
+          f"unorm={res.unorm!r} ncells={res.ncells_global} "
+          f"ntimers={len(agg)} extra={res.extra}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -172,7 +172,12 @@ def test_dist_folded_main_kernel_independent_of_collectives():
 
     t = build_operator_tables(degree, qmode)
     op = build_dist_folded(mesh, dgrid, degree, t, dtype=jnp.float32, nl=16)
-    apply_fn, _, _, sharded_state = make_folded_sharded_fns(op, dgrid, 1)
+    # engine=False pins the UNFUSED path: its overlap-by-construction
+    # property is exactly what this test asserts. The fused engine form
+    # (dist.folded_cg) deliberately trades that overlap for one kernel
+    # pass per iteration — its halo is on the critical path by design.
+    apply_fn, _, _, sharded_state = make_folded_sharded_fns(op, dgrid, 1,
+                                                           engine=False)
 
     rng = np.random.RandomState(0)
     x = rng.randn(*dof_grid_shape(n, degree)).astype(np.float32)

@@ -1,0 +1,204 @@
+"""Distributed fused folded CG engine (dist.folded_cg) on the
+8-virtual-CPU-device mesh: the halo-form delay-ring kernel + stacked
+(r, p_prev) refresh + reverse-scatter dot tail vs (a) the unfused dist
+folded path and (b) the single-chip fused folded engine on the same
+global perturbed problem. The support-gate test is fast; the kernel
+parity cases run interpret-mode Pallas on 8 devices and live in the slow
+lane (the CI fast lane's budget is measured, tests/conftest rationale)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from bench_tpu_fem.dist.folded import (
+    build_dist_folded,
+    make_folded_sharded_fns,
+    resolve_folded_engine,
+    shard_folded_vectors,
+    unshard_folded_vectors,
+)
+from bench_tpu_fem.dist.folded_cg import supports_dist_folded_engine
+from bench_tpu_fem.dist.mesh import AXIS_NAMES, make_device_grid
+from bench_tpu_fem.elements import build_operator_tables
+from bench_tpu_fem.mesh import create_box_mesh, dof_grid_shape
+from bench_tpu_fem.mesh.dofmap import boundary_dof_marker
+
+jax.config.update("jax_enable_x64", True)
+
+
+def _setup(dshape, degree, geom="corner", perturb=0.15, seed=0):
+    dgrid = make_device_grid(dshape=dshape)
+    n = tuple(2 * d for d in dshape)
+    mesh = create_box_mesh(n, geom_perturb_fact=perturb)
+    t = build_operator_tables(degree, 1)
+    op = build_dist_folded(mesh, dgrid, degree, t, dtype=jnp.float32,
+                           nl=16, geom=geom)
+    rng = np.random.RandomState(seed)
+    b = rng.randn(*dof_grid_shape(n, degree)).astype(np.float32)
+    b[np.asarray(boundary_dof_marker(n, degree))] = 0.0
+    sharding = NamedSharding(dgrid.mesh, P(*AXIS_NAMES))
+    bb = jax.device_put(
+        jnp.asarray(shard_folded_vectors(b, n, degree, dshape, op.layout)),
+        sharding,
+    )
+    return dgrid, n, mesh, op, b, bb
+
+
+def test_dist_folded_engine_support_gate():
+    """f32 with a per-shard ring inside MAX_RING_BLOCKS supports the
+    engine on any dshape; f64 never (Mosaic has no f64)."""
+    dgrid, n, mesh, op, _, _ = _setup((2, 2, 2), 3)
+    assert supports_dist_folded_engine(op)
+    assert resolve_folded_engine(op)
+    t = build_operator_tables(3, 1)
+    op64 = build_dist_folded(mesh, dgrid, 3, t, dtype=jnp.float64, nl=16,
+                             geom="corner")
+    assert not supports_dist_folded_engine(op64)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("dshape,degree,geom",
+                         [((2, 1, 1), 3, "corner"), ((2, 2, 2), 3, "corner"),
+                          ((2, 2, 2), 2, "g")])
+def test_dist_folded_engine_cg_matches_unfused(dshape, degree, geom):
+    dgrid, n, mesh, op, b, bb = _setup(dshape, degree, geom)
+    nreps = 5
+    _, cg_e, _, ss = make_folded_sharded_fns(op, dgrid, nreps, engine=True)
+    _, cg_u, _, _ = make_folded_sharded_fns(op, dgrid, nreps, engine=False)
+    st = ss(op)
+    xe = np.asarray(jax.jit(cg_e)(bb, st, op.owned))
+    xu = np.asarray(jax.jit(cg_u)(bb, st, op.owned))
+    xg_e = unshard_folded_vectors(xe, n, degree, dshape, op.layout)
+    xg_u = unshard_folded_vectors(xu, n, degree, dshape, op.layout)
+    scale = np.abs(xg_u).max()
+    np.testing.assert_allclose(xg_e, xg_u, atol=2e-4 * scale)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("dshape,degree", [((2, 1, 1), 3), ((2, 2, 2), 3)])
+def test_dist_folded_engine_cg_matches_single_chip_engine(dshape, degree):
+    """Sharded fused CG vs the single-chip fused folded CG engine on the
+    same global perturbed problem — the acceptance oracle (enorm within
+    f32 reassociation tolerance of the single-chip engine result)."""
+    from bench_tpu_fem.ops.folded import build_folded_laplacian, fold_vector
+    from bench_tpu_fem.ops.folded_cg import folded_cg_solve
+
+    dgrid, n, mesh, op, b, bb = _setup(dshape, degree, seed=5)
+    nreps = 5
+    _, cg_e, _, ss = make_folded_sharded_fns(op, dgrid, nreps, engine=True)
+    xe = np.asarray(jax.jit(cg_e)(bb, ss(op), op.owned))
+    x = unshard_folded_vectors(xe, n, degree, dshape, op.layout)
+
+    op1 = build_folded_laplacian(mesh, degree, 1, dtype=jnp.float32,
+                                 nl=16, geom="corner")
+    b1 = jnp.asarray(fold_vector(b, op1.layout))
+    from bench_tpu_fem.ops.folded import unfold_vector
+
+    x1 = unfold_vector(np.asarray(folded_cg_solve(op1, b1, nreps)),
+                       op1.layout)
+    scale = np.abs(x1).max()
+    np.testing.assert_allclose(x, x1, atol=3e-4 * scale)
+
+
+@pytest.mark.slow
+def test_dist_folded_engine_apply_matches_unfused():
+    """Engine apply_fn (general-x semantics: refresh + pre-mask + ring
+    kernel + scatter + bc blend) vs the unfused apply_local on a random
+    vector with NONZERO bc rows."""
+    dshape, degree = (2, 2, 2), 3
+    dgrid, n, mesh, op, _, _ = _setup(dshape, degree)
+    rng = np.random.RandomState(7)
+    x = rng.randn(*dof_grid_shape(n, degree)).astype(np.float32)
+    sharding = NamedSharding(dgrid.mesh, P(*AXIS_NAMES))
+    xb = jax.device_put(
+        jnp.asarray(shard_folded_vectors(x, n, degree, dshape, op.layout)),
+        sharding,
+    )
+    ap_e, _, _, ss = make_folded_sharded_fns(op, dgrid, 1, engine=True)
+    ap_u, _, _, _ = make_folded_sharded_fns(op, dgrid, 1, engine=False)
+    st = ss(op)
+    ye = np.asarray(jax.jit(ap_e)(xb, st))
+    yu = np.asarray(jax.jit(ap_u)(xb, st))
+    scale = np.abs(yu).max()
+    np.testing.assert_allclose(ye, yu, atol=2e-6 * scale)
+
+
+@pytest.mark.slow
+def test_dist_folded_engine_pdot_counts_owned_once():
+    """The engine's <p, A p> (in-kernel owned-weighted partials + the
+    reverse-scatter dot correction + psum) must equal the global dot on
+    the unsharded vectors — the seam/ghost dedup contract."""
+    from functools import partial
+
+    from bench_tpu_fem.dist.folded_cg import (
+        _refresh_rp,
+        folded_reverse_scatter_dot,
+    )
+    from bench_tpu_fem.dist.halo import psum_all
+    from bench_tpu_fem.ops import build_laplacian
+    from bench_tpu_fem.ops.folded_cg import _cg_apply_call
+
+    dshape, degree = (2, 2, 2), 3
+    dgrid, n, mesh, op, _, _ = _setup(dshape, degree)
+    rng = np.random.RandomState(3)
+    shape = dof_grid_shape(n, degree)
+    bc = np.asarray(boundary_dof_marker(n, degree))
+    r = rng.randn(*shape).astype(np.float32)
+    r[bc] = 0.0
+    pv = rng.randn(*shape).astype(np.float32)
+    pv[bc] = 0.0
+    beta = np.float32(0.5)
+    sharding = NamedSharding(dgrid.mesh, P(*AXIS_NAMES))
+
+    def sv(a):
+        return jax.device_put(
+            jnp.asarray(shard_folded_vectors(a, n, degree, dshape,
+                                             op.layout)), sharding)
+
+    _, _, _, ss = make_folded_sharded_fns(op, dgrid, 1)
+    state = ss(op)
+
+    @partial(jax.shard_map, mesh=dgrid.mesh,
+             in_specs=(P(*AXIS_NAMES),) * 3, out_specs=P(),
+             check_vma=False)
+    def pdot_fn(rb, pb, st):
+        def loc(a):
+            return jax.tree_util.tree_map(lambda v: v[0, 0, 0], a)
+
+        geom, bcm, w, _ = loc(st)
+        layout = op.layout
+        rh, ph = _refresh_rp(loc(rb), loc(pb), layout)
+        p, y, pdot = _cg_apply_call(
+            layout, geom, op.kappa,
+            np.asarray(op.phi0_c, np.float64),
+            np.asarray(op.dphi1_c, np.float64),
+            op.is_identity, op.geom_tables, True, None,
+            rh, ph, jnp.float32(beta), masks=(bcm, w),
+        )
+        _, dcorr = folded_reverse_scatter_dot(y, p, w, layout)
+        return psum_all(jnp.sum(pdot) + dcorr)
+
+    got = float(jax.jit(pdot_fn)(sv(r), sv(pv), state))
+    p_global = beta * pv + r
+    op_ref = build_laplacian(mesh, degree, 1, dtype=jnp.float32,
+                             backend="xla")
+    y_global = np.asarray(jax.jit(op_ref.apply)(jnp.asarray(p_global)))
+    want = float(np.sum(p_global.astype(np.float64)
+                        * y_global.astype(np.float64)))
+    np.testing.assert_allclose(got, want, rtol=2e-5)
+
+
+@pytest.mark.slow
+def test_dist_folded_engine_cg_keeps_bc_rows_zero():
+    """With a homogeneous-bc RHS, every engine CG iterate keeps bc rows
+    at exactly zero (streamed-mask pass-through + scatter of zeroed
+    ghost bc partials)."""
+    dshape, degree = (2, 2, 2), 3
+    dgrid, n, mesh, op, b, bb = _setup(dshape, degree, seed=11)
+    _, cg_e, _, ss = make_folded_sharded_fns(op, dgrid, 4, engine=True)
+    xe = np.asarray(jax.jit(cg_e)(bb, ss(op), op.owned))
+    x = unshard_folded_vectors(xe, n, degree, dshape, op.layout)
+    bc = np.asarray(boundary_dof_marker(n, degree))
+    assert np.all(x[bc] == 0.0)

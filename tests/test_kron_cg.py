@@ -262,7 +262,10 @@ def test_driver_retries_chunked_when_one_kernel_fails(monkeypatch):
                       nreps=3, use_cg=True, ndevices=1)
     res = run_benchmark(cfg)
     assert res.extra["cg_engine"] is True
-    assert res.extra.get("cg_engine_form") == "chunked-retry"
+    # unified form vocabulary: the retry lands on "chunked"; the retry
+    # provenance is the recorded one-kernel rejection
+    assert res.extra.get("cg_engine_form") == "chunked"
+    assert "cg_engine_one_kernel_error" in res.extra
     assert "cg_engine_error" not in res.extra
     assert np.isfinite(res.ynorm) and res.ynorm > 0
 

@@ -335,3 +335,66 @@ def test_dist_kron_engine_specs(recorder, degree):
     p = _rand((4 * Lx, NY, NZ))
     jax.jit(run)(r, p, op)
     recorder.check()
+
+
+@pytest.mark.slow
+def test_dist_folded_engine_specs(recorder):
+    """The dist folded halo-form delay-ring kernel (dist.folded_cg): the
+    streamed bc/owned mask blocks must ride full-trailing-dim
+    (1, P^3, B) specs like every other folded operand."""
+    from functools import partial
+
+    from jax.sharding import PartitionSpec as P
+
+    from bench_tpu_fem.dist.folded import (
+        build_dist_folded,
+        make_folded_sharded_fns,
+    )
+    from bench_tpu_fem.dist.mesh import AXIS_NAMES, make_device_grid
+    from bench_tpu_fem.elements.tables import build_operator_tables
+
+    dgrid = make_device_grid(dshape=(2, 1, 1))
+    mesh = create_box_mesh((4, 2, 2), geom_perturb_fact=0.1)
+    t = build_operator_tables(3, 1)
+    op = build_dist_folded(mesh, dgrid, 3, t, dtype=jnp.float32, nl=16)
+    apply_fn, _, _, sharded_state = make_folded_sharded_fns(
+        op, dgrid, 1, engine=True
+    )
+    lay = op.layout
+    x = _rand((2, 1, 1, lay.nblocks, 27, lay.block))
+    jax.jit(apply_fn)(x, sharded_state(op))
+    recorder.check()
+
+
+@pytest.mark.slow
+def test_dist_kron_df_engine_ext2d_specs(recorder):
+    """The ext2d df engine form (dist.kron_cg_df on a 3D mesh):
+    halo-extended DF plane inputs, extended 4-channel coefficient
+    slices, streamed mask/weight planes."""
+    from functools import partial
+
+    from jax.sharding import PartitionSpec as P
+
+    from bench_tpu_fem.dist.kron_cg_df import dist_kron_df_apply_ring_local
+    from bench_tpu_fem.dist.kron_df import build_dist_kron_df
+    from bench_tpu_fem.dist.mesh import AXIS_NAMES, make_device_grid
+    from bench_tpu_fem.elements.tables import build_operator_tables
+    from bench_tpu_fem.la.df64 import DF
+
+    dgrid = make_device_grid(dshape=(2, 2, 2))
+    t = build_operator_tables(3, 1, "gll")
+    op = build_dist_kron_df((4, 4, 4), dgrid, 3, 1, tables=t)
+
+    @partial(jax.shard_map, mesh=dgrid.mesh,
+             in_specs=(P(*AXIS_NAMES), P(*AXIS_NAMES), P()),
+             out_specs=P(*AXIS_NAMES), check_vma=False)
+    def run(xh, xl, A):
+        y = dist_kron_df_apply_ring_local(
+            A, DF(xh[0, 0, 0], xl[0, 0, 0]))
+        return y.hi[None, None, None]
+
+    Lx, LY, LZ = op.L
+    xh = _rand((2, 2, 2, Lx, LY, LZ))
+    xl = _rand((2, 2, 2, Lx, LY, LZ))
+    jax.jit(run)(xh, xl, op)
+    recorder.check()
