@@ -54,17 +54,19 @@ def run_df32_side_metric(ndofs: int) -> dict:
     number is comparable against the reference's per-GPU f64 baseline —
     vs_baseline is against the same 4.02 GDoF/s as the headline.
 
-    Runs inside its OWN OOM-halving loop (floor 2M dofs): df32 roughly
-    doubles per-dof memory vs f32, so a flagship-size attempt can OOM
-    where a halved size still yields the round's df headline number —
-    previously that dropped the metric entirely (recorded only as
-    f64_df32_error). The size actually measured is recorded."""
+    Runs inside its OWN OOM degradation ladder (harness.policy.OomLadder,
+    floor 2M dofs): df32 roughly doubles per-dof memory vs f32, so a
+    flagship-size attempt can OOM where a halved size still yields the
+    round's df headline number — previously that dropped the metric
+    entirely (recorded only as f64_df32_error). The size actually
+    measured is recorded."""
     from bench_tpu_fem.bench.driver import BenchConfig, run_benchmark
+    from bench_tpu_fem.harness.classify import classify_exception
+    from bench_tpu_fem.harness.policy import OomLadder
 
     requested = ndofs
-    floor = min(2_000_000, requested)
     last_err = None
-    while ndofs >= floor:
+    for ndofs in OomLadder(floor=min(2_000_000, requested)).sizes(requested):
         cfg = BenchConfig(
             ndofs_global=ndofs, degree=DEGREE, qmode=QMODE, float_bits=64,
             nreps=100, use_cg=True, ndevices=1, f64_impl="df32",
@@ -72,12 +74,9 @@ def run_df32_side_metric(ndofs: int) -> dict:
         try:
             res = run_benchmark(cfg)
         except (RuntimeError, MemoryError) as exc:
-            msg = str(exc)
-            if not ("RESOURCE_EXHAUSTED" in msg or "Out of memory" in msg
-                    or "OOM" in msg.lower()):
+            if classify_exception(exc) != "oom":
                 raise
-            last_err = msg
-            ndofs //= 2
+            last_err = str(exc)
             import gc
 
             import jax
@@ -177,11 +176,15 @@ def run(ndofs: int) -> dict:
     return out
 
 
-def _error_line(msg: str) -> dict:
-    """The bench JSON contract's failure line (single definition; both the
-    watchdog and the could-not-fit path emit it)."""
-    return {"metric": "cg_gdof_per_s_per_chip_q3_f32", "value": 0.0,
-            "unit": "GDoF/s", "vs_baseline": 0.0, "error": msg}
+def _error_line(msg: str, failure_class: str | None = None) -> dict:
+    """The bench JSON contract's failure line: the harness's unified
+    error-record schema (journal.error_record), so every bench.py failure
+    artifact carries a machine-readable ``failure_class`` from the shared
+    taxonomy — auditable with one grep, like ``cg_engine_form``."""
+    from bench_tpu_fem.harness.classify import classify_text
+    from bench_tpu_fem.harness.journal import error_record
+
+    return error_record(msg, failure_class or classify_text(msg))
 
 
 def _probe_devices(timeout_s: int = 180):
@@ -193,13 +196,17 @@ def _probe_devices(timeout_s: int = 180):
     import os
     import threading
 
+    # Build the error line BEFORE touching any device API: the watchdog
+    # thread must never need an import while the main thread hangs in
+    # PJRT holding locks.
+    wedge_line = json.dumps(_error_line(
+        f"device init/probe exceeded {timeout_s}s "
+        "(TPU tunnel unavailable/wedged)", "tunnel_wedge"))
     done = threading.Event()
 
     def watchdog():
         if not done.wait(timeout_s):
-            print(json.dumps(_error_line(
-                f"device init/probe exceeded {timeout_s}s "
-                "(TPU tunnel unavailable/wedged)")), flush=True)
+            print(wedge_line, flush=True)
             os._exit(1)
 
     threading.Thread(target=watchdog, daemon=True).start()
@@ -231,13 +238,15 @@ def single_attempt(ndofs: int) -> int:
         from bench_tpu_fem.utils.hermetic import force_host_cpu_devices
 
         force_host_cpu_devices(1)
+    from bench_tpu_fem.harness.classify import classify_exception
+    from bench_tpu_fem.harness.policy import OomLadder
+
     _probe_devices()  # hard-exits with a JSON error line on a wedged tunnel
     requested = ndofs
     last_err = None
-    # halving floor: never below the explicitly requested size (a small
+    # ladder floor: never below the explicitly requested size (a small
     # CLI/test size must still run once), capped at 500k for the default
-    floor = min(500_000, requested)
-    while ndofs >= floor:
+    for ndofs in OomLadder(floor=min(500_000, requested)).sizes(requested):
         try:
             out = run(ndofs)
             if ndofs != requested:
@@ -246,12 +255,9 @@ def single_attempt(ndofs: int) -> int:
             print(json.dumps(out))
             return 0
         except (RuntimeError, MemoryError) as exc:  # XLA OOM surfaces as RuntimeError
-            msg = str(exc)
-            if not ("RESOURCE_EXHAUSTED" in msg or "Out of memory" in msg
-                    or "OOM" in msg.lower()):
+            if classify_exception(exc) != "oom":
                 raise
-            last_err = msg
-            ndofs //= 2
+            last_err = str(exc)
         # Out of the except block (so exc/traceback no longer pin the failed
         # attempt's device arrays): free them before the halved retry.
         import gc
@@ -260,19 +266,15 @@ def single_attempt(ndofs: int) -> int:
 
         gc.collect()
         jax.clear_caches()
-    print(json.dumps(_error_line(f"could not fit problem: {last_err}")))
+    print(json.dumps(_error_line(f"could not fit problem: {last_err}",
+                                 "oom")))
     return 1
 
 
 def _last_json_line(text: str) -> dict | None:
-    for line in reversed(text.strip().splitlines()):
-        try:
-            obj = json.loads(line)
-        except (json.JSONDecodeError, ValueError):
-            continue
-        if isinstance(obj, dict) and "metric" in obj:
-            return obj
-    return None
+    from bench_tpu_fem.harness.runner import last_json_line
+
+    return last_json_line(text)
 
 
 def main() -> int:
@@ -281,13 +283,20 @@ def main() -> int:
     180 s fail-fast at end-of-round capture time turned a 2.31x round
     into an official 0.0 artifact). Each attempt is a CHILD process —
     a wedged PJRT init blocks the GIL and is unrecoverable in-process —
-    killed on overrun; the parent re-prints the child's JSON line
-    verbatim on success and otherwise retries every BENCH_RETRY_S until
-    the BENCH_WINDOW_S window closes."""
+    killed (whole session: PJRT helper threads outlive a plain
+    terminate) on overrun via the harness's shared subprocess runner;
+    the parent re-prints the child's JSON line verbatim on success and
+    otherwise retries every BENCH_RETRY_S until the BENCH_WINDOW_S
+    window closes. Every attempt is journaled (classified) when
+    BENCH_JOURNAL names a journal file — the harness agenda points it at
+    the round's MEASURE_rNN.jsonl so the driver's end-of-round capture
+    and the agenda share one evidence trail."""
     import os
-    import signal
-    import subprocess
     import time as _time
+
+    from bench_tpu_fem.harness.classify import classify
+    from bench_tpu_fem.harness.journal import Journal
+    from bench_tpu_fem.harness.runner import run_subprocess
 
     ndofs_arg = [a for a in sys.argv[1:] if a != "--single-attempt"]
     ndofs = int(ndofs_arg[0]) if ndofs_arg else 12_500_000
@@ -297,57 +306,58 @@ def main() -> int:
     window_s = int(os.environ.get("BENCH_WINDOW_S", 7200))
     retry_s = int(os.environ.get("BENCH_RETRY_S", 300))
     attempt_timeout_s = int(os.environ.get("BENCH_ATTEMPT_TIMEOUT_S", 2700))
+    journal = (Journal(os.environ["BENCH_JOURNAL"])
+               if os.environ.get("BENCH_JOURNAL") else None)
+    round_tag = os.environ.get("BENCH_ROUND", "")
     deadline = _time.monotonic() + window_s
     last: dict | None = None
     attempt = 0
     while True:
         attempt += 1
-        t0 = _time.monotonic()
-        try:
-            proc = subprocess.Popen(
-                [sys.executable, os.path.abspath(__file__),
-                 "--single-attempt", str(ndofs)],
-                stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
-                text=True, start_new_session=True,
-            )
-            try:
-                out, _ = proc.communicate(timeout=attempt_timeout_s)
-                rc = proc.returncode
-            except subprocess.TimeoutExpired:
-                # kill the whole session: PJRT spawns helper threads that
-                # outlive a plain terminate when the tunnel is wedged.
-                # The child may exit between the deadline and the kill —
-                # that's a finished attempt, not a failure: fall through
-                # to parsing whatever it wrote.
-                try:
-                    os.killpg(proc.pid, signal.SIGKILL)
-                except ProcessLookupError:
-                    pass
-                out, _ = proc.communicate()
-                rc = None
-                last = _error_line(
-                    f"attempt {attempt} exceeded {attempt_timeout_s}s "
-                    "(TPU tunnel wedged mid-run)")
-        except OSError as exc:
-            out, rc = "", None
-            last = _error_line(f"attempt spawn failed: {exc}")
-        parsed = _last_json_line(out) if out else None
+        res = run_subprocess(
+            [sys.executable, os.path.abspath(__file__),
+             "--single-attempt", str(ndofs)],
+            attempt_timeout_s)
+        # rc None = killed at the deadline (or spawn failure). The child
+        # may exit between the deadline and the kill — that's a finished
+        # attempt, not a failure: parse whatever it wrote either way.
+        parsed = _last_json_line(res.out) if res.out else None
+        failure_class = classify(res.rc, res.out, timed_out=res.timed_out)
+        if res.timed_out:
+            # class from the classifier, not hardcoded: the journal record
+            # and the printed artifact line must give ONE answer (a child
+            # that printed an OOM then hung in teardown is an oom)
+            last = _error_line(
+                f"attempt {attempt} exceeded {attempt_timeout_s}s "
+                "(TPU tunnel wedged mid-run)", failure_class)
+        elif res.rc is None:
+            last = _error_line(f"attempt spawn failed: {res.out}",
+                               failure_class or "transient")
+        if journal is not None:
+            journal.append({
+                "event": "bench_attempt", "stage": "bench",
+                "round": round_tag, "attempt": attempt, "rc": res.rc,
+                "timed_out": res.timed_out,
+                "wall_s": round(res.wall_s, 3),
+                "failure_class": failure_class,
+                "result": parsed})
         if parsed is not None:
             last = parsed
-            # rc None = killed at the deadline; a complete JSON line with
-            # a non-zero value still means the benchmark finished
-            if rc in (0, None) and parsed.get("value", 0.0) > 0.0:
+            # a complete JSON line with a non-zero value means the
+            # benchmark finished, even if the kill raced its exit
+            if res.rc in (0, None) and parsed.get("value", 0.0) > 0.0:
                 print(json.dumps(parsed), flush=True)
                 return 0
-        elapsed = _time.monotonic() - t0
         if _time.monotonic() + retry_s >= deadline:
             break
-        print(f"# attempt {attempt} failed after {elapsed:.0f}s "
+        print(f"# attempt {attempt} failed after {res.wall_s:.0f}s "
+              f"[{failure_class}] "
               f"({(last or {}).get('error', 'no JSON line')}); retrying in "
               f"{retry_s}s", file=sys.stderr, flush=True)
         _time.sleep(retry_s)
     print(json.dumps(last if last is not None else _error_line(
-        f"no successful attempt within {window_s}s window")), flush=True)
+        f"no successful attempt within {window_s}s window",
+        failure_class or "transient")), flush=True)
     return 1
 
 

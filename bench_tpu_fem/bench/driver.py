@@ -105,14 +105,21 @@ def record_engine(extra: dict, engine: bool, form: str | None = None,
     "one_kernel" (single-chip delay ring) | "halo" (distributed plane/
     block-halo ring) | "ext2d" (3D-sharded halo-extended cross-section
     ring) | "chunked" (y-chunked two-kernel) | "unfused", and any
-    fallback carries the reason in `cg_engine_error` — so fallback
-    audits are ONE grep across BENCH/MULTICHIP artifacts."""
+    fallback carries the reason in `cg_engine_error` plus its harness
+    taxonomy class in `failure_class` (tunnel_wedge/oom/mosaic_reject/
+    accuracy_fail/timeout/unsupported/transient) — so fallback audits
+    are ONE grep across BENCH/MULTICHIP/MEASURE artifacts."""
+    from ..harness.classify import classify_exception, classify_text
+
     extra["cg_engine"] = engine
     extra["cg_engine_form"] = (form or "unfused") if engine else "unfused"
     if error is not None:
-        extra["cg_engine_error"] = (
-            error if isinstance(error, str) else exc_str(error)
-        )
+        if isinstance(error, str):
+            extra["cg_engine_error"] = error
+            extra["failure_class"] = classify_text(error)
+        else:
+            extra["cg_engine_error"] = exc_str(error)
+            extra["failure_class"] = classify_exception(error)
 
 
 # engine_plan/engine_plan_df form names -> the unified vocabulary
@@ -245,6 +252,9 @@ def _df64_emulated_fallback(cfg: BenchConfig, reason: str) -> BenchmarkResults:
         jax.config.update("jax_enable_x64", prev)
     res.extra["f64_impl"] = "emulated-fallback"
     res.extra["f64_df32_fallback_reason"] = reason
+    from ..harness.classify import classify_text
+
+    res.extra["failure_class"] = classify_text(reason)
     return res
 
 

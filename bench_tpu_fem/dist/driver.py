@@ -408,6 +408,9 @@ def _run_distributed_folded_df(cfg, res):
             jax.config.update("jax_enable_x64", prev)
         out.extra["f64_impl"] = "emulated-fallback"
         out.extra["f64_df32_fallback_reason"] = reason
+        from ..harness.classify import classify_text
+
+        out.extra["failure_class"] = classify_text(reason)
         return out
 
     dgrid = make_device_grid(cfg.ndevices)

@@ -1,0 +1,46 @@
+"""Resilient evidence-collection harness (journaled, resumable, fault-
+classified).
+
+Rounds 4 and 5 both lost their hardware evidence to a wedged TPU tunnel:
+the retry/timeout/backoff machinery existed as three ad-hoc fragments
+(bench.py's retry parent, measure_all.py's linear stage loop with an
+in-process ``dfacc_ok`` flag, scripts/watch_tunnel.sh). This package
+unifies them:
+
+- ``journal``   crash-safe append-only JSONL journal (``MEASURE_rNN.jsonl``)
+                — every stage attempt recorded before/after execution, so a
+                SIGKILL'd agenda loses at most one record — plus the ONE
+                error-line schema (``error_record``) shared by bench.py, the
+                watchdog and the harness stages;
+- ``classify``  the failure taxonomy (``tunnel_wedge`` / ``oom`` /
+                ``mosaic_reject`` / ``accuracy_fail`` / ``timeout`` /
+                ``unsupported`` / ``transient``) derived from rc + output
+                patterns;
+- ``policy``    per-stage retry/timeout/backoff policy + the generalized
+                OOM size-halving degradation ladder (lifted from
+                bench.py:run_df32_side_metric — any stage can opt in);
+- ``runner``    the resumable stage state machine: journal-completed stages
+                skip on ``--resume``, persisted gate outcomes (dfacc) keep
+                gating across resumes, a tunnel wedge triggers health
+                re-probe + bounded backoff instead of burning the remaining
+                stages' timeouts;
+- ``agenda``    the measurement agendas (round6 = measure_all's stages) +
+                the ``python -m bench_tpu_fem.harness run|watch`` CLI
+                (watch replaces scripts/watch_tunnel.sh);
+- ``faults``    fault injection (hang / crash / OOM / wedge-then-recover /
+                gate failure scripts) so the whole state machine is
+                CPU-testable in CI with no hardware.
+
+Every module here is stdlib-only: the harness parent process never runs a
+JAX computation or initialises a backend (a wedged PJRT client is
+unrecoverable in-process — all device work happens in killable child
+processes, the round-4/5 lesson). The parent *package* import does pull in
+the jax module for its compat shims; that is safe under a wedged tunnel —
+backend initialisation, not module import, is what hangs (see
+utils/hermetic.py).
+"""
+
+from . import classify, journal, policy  # noqa: F401  (stdlib-only, cheap)
+from .classify import TAXONOMY, classify_exception, classify_text  # noqa: F401
+from .journal import Journal, error_record  # noqa: F401
+from .policy import OomLadder, RetryPolicy, StagePolicy  # noqa: F401

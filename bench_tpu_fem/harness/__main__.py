@@ -1,0 +1,8 @@
+"""``python -m bench_tpu_fem.harness`` — run/watch measurement agendas."""
+
+import sys
+
+from .agenda import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,533 @@
+"""The measurement agendas + the ``python -m bench_tpu_fem.harness`` CLI.
+
+The round-6 agenda is scripts/measure_all.py's stage set, restated as
+declarative :class:`~.runner.Stage` entries over the journal/classify/
+policy machinery (measure_all itself is now a thin back-compat shim over
+this module). Composite measure_all names (``ab12``, ``large``,
+``dfeng``, ``dflarge``) expand via ``ALIASES`` so old invocations keep
+working, stage-per-subprocess.
+
+``run``   executes an agenda: ``python -m bench_tpu_fem.harness run
+          --agenda round6 --resume`` skips journal-completed stages,
+          re-runs failed ones per policy, and honors persisted gate
+          outcomes (dfacc).
+``watch`` replaces scripts/watch_tunnel.sh: probe the tunnel on an
+          interval, run the agenda (resumed) the moment it lives, re-arm
+          when the agenda aborts on a fresh wedge — all journaled.
+
+Every stage runs in its own killable child process; stage payloads are
+the same code strings measure_all ran (the df accuracy gates, A/B
+configs, probe delegations are measurement DESIGN, unchanged here — only
+the fault handling around them moved into the harness).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+from .journal import Journal, default_journal_path
+from .policy import OomLadder, RetryPolicy, StagePolicy
+from .runner import Runner, Stage, clean_tail, last_json_line, run_subprocess
+
+ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+# The round tag rides the MEASURE_ROUND env var into child stages, so a
+# script a stage shells out to (probe_scoped_vmem) logs into the SAME
+# round's files as the journal that launched it (evidence hygiene).
+DEFAULT_ROUND = os.environ.get("MEASURE_ROUND", "r06")
+
+# Ladder-size placeholder in templated stage payloads (str.format would
+# choke on the payloads' own braces).
+_NDOFS = "__NDOFS__"
+
+# The probe requires the TPU backend unless the caller explicitly pinned
+# CPU (tests/dev): a fast-failing TPU client makes jax fall back to CPU
+# with a warning, and a successful CPU matmul must read as "tunnel DOWN",
+# not up — an agenda run on the fallback would journal bogus "hardware"
+# numbers (watch_tunnel.sh's old backend guard, kept).
+PROBE_CODE = """
+import os, sys
+import jax, jax.numpy as jnp
+x = jax.device_put(jnp.ones((1024, 1024)))
+(x @ x).block_until_ready()
+backend = jax.default_backend()
+pinned_cpu = os.environ.get('JAX_PLATFORMS', '') == 'cpu'
+print(('TPU OK' if backend == 'tpu' else f'{backend} (pinned)' if
+       pinned_cpu else f'NOT TPU: fell back to {backend}'), jax.devices())
+sys.exit(0 if backend == 'tpu' or pinned_cpu else 1)
+"""
+
+PRE = """
+import time, numpy as np, jax, jax.numpy as jnp
+from bench_tpu_fem.bench.driver import BenchConfig, run_benchmark
+def timed_res(cfg):
+    t0 = time.time(); res = run_benchmark(cfg); w = time.time()-t0
+    return res, w
+"""
+
+
+def base_env(round_tag: str = DEFAULT_ROUND) -> dict:
+    return {**os.environ, "PYTHONPATH": f"{ROOT}:/root/.axon_site",
+            "MEASURE_ROUND": round_tag}
+
+
+def probe_tunnel(timeout_s: float = 180.0):
+    """The tunnel health probe, in a killable child (a wedged PJRT client
+    hangs holding the GIL — the parent must never touch it in-process).
+    Returns (ok, detail)."""
+    res = run_subprocess([sys.executable, "-u", "-c", PROBE_CODE],
+                         timeout_s, env=base_env(), cwd=ROOT)
+    ok = res.rc == 0 and not res.timed_out
+    tail = (res.out or "").strip().splitlines()
+    detail = tail[-1] if tail else ("TIMEOUT" if res.timed_out else "no output")
+    return ok, f"rc={res.rc} {detail}"
+
+
+def run_py(code, timeout=900, tail=25):
+    """Legacy stage helper for scripts/ (probe_scoped_vmem delegates its
+    probes here): one ``python -c`` child under the harness subprocess
+    runner, (rc, output-tail) out — measure_all._run's contract, with rc
+    -9 standing in for a timeout kill. Unlike the old _run, the captured
+    PARTIAL output rides along after the TIMEOUT marker: where a stage
+    hung is evidence."""
+    res = run_subprocess([sys.executable, "-u", "-c", code], timeout,
+                         env=base_env(), cwd=ROOT)
+    text = clean_tail(res.out, tail)
+    if res.timed_out:
+        return -9, (f"TIMEOUT after {timeout}s; partial output tail:\n"
+                    f"{text}" if text else f"TIMEOUT after {timeout}s "
+                    "(no output before the kill)")
+    return res.rc, text
+
+
+def _bench_code(label, cfg_kwargs, setup="", tail_expr=""):
+    """The shared single-config benchmark payload (measure_all's
+    _bench_stage): one BenchConfig, one run_benchmark, one labelled
+    print."""
+    kw = ", ".join(f"{k}={v}" if v == _NDOFS else f"{k}={v!r}"
+                   for k, v in cfg_kwargs.items())
+    return PRE + f"""
+{setup}
+cfg = BenchConfig({kw})
+res, w = timed_res(cfg)
+print({label!r}, res.gdof_per_second, res.extra{tail_expr})
+"""
+
+
+def _py(name, code, timeout, *, tries=1, gate=None, provides=None,
+        size=None, floor=None, env=None, critical=False, parse=None,
+        tail=25):
+    """A python -c stage. ``size``/``floor`` opt the stage into the OOM
+    degradation ladder: its payload carries the __NDOFS__ placeholder and
+    re-runs halved on a classified OOM down to ``floor``."""
+    policy = StagePolicy(
+        timeout_s=timeout,
+        retry=RetryPolicy(max_attempts=max(tries, 1)),
+        oom_ladder=OomLadder(floor=floor) if floor is not None else None,
+    )
+
+    def command(ctx):
+        payload = code
+        if ctx.size is not None:
+            payload = payload.replace(_NDOFS, str(ctx.size))
+        return [sys.executable, "-u", "-c", payload]
+
+    return Stage(name=name, command=command, policy=policy,
+                 requires_gate=gate, provides_gate=provides, size=size,
+                 env=env, critical=critical, parse=parse, tail=tail)
+
+
+def _script(name, args, timeout, *, tail=15):
+    return Stage(name=name,
+                 command=lambda ctx: [sys.executable] + list(args),
+                 policy=StagePolicy(timeout_s=timeout), tail=tail)
+
+
+# --------------------------------------------------------------------------
+# Stage payloads (measure_all's measurement design, verbatim).
+
+AB12_ENGINE = PRE + """
+cfg = BenchConfig(ndofs_global=12_500_000, degree=3, qmode=1,
+                  float_bits=32, nreps=1000, use_cg=True)
+res, w = timed_res(cfg)
+print("ENGINE:", res.gdof_per_second, res.extra, "ynorm", res.ynorm)
+"""
+
+AB12_BASELINE = PRE + """
+# force the non-engine path by monkeypatching the support gate
+import bench_tpu_fem.ops.kron_cg as KC
+KC.supports_kron_cg_engine = lambda *a, **k: False
+cfg = BenchConfig(ndofs_global=12_500_000, degree=3, qmode=1,
+                  float_bits=32, nreps=1000, use_cg=True)
+res, w = timed_res(cfg)
+print("BASELINE3STAGE:", res.gdof_per_second, res.extra, "ynorm", res.ynorm)
+"""
+
+DF32 = PRE + """
+cfg = BenchConfig(ndofs_global=2_000_000, degree=3, qmode=1,
+                  float_bits=64, nreps=50, use_cg=True, f64_impl="df32")
+res, w = timed_res(cfg)
+print("DF32:", res.gdof_per_second, res.extra, "ynorm", res.ynorm)
+cfg = BenchConfig(ndofs_global=2_000_000, degree=3, qmode=1,
+                  float_bits=64, nreps=50, use_cg=True)
+res, w = timed_res(cfg)
+print("EMULATED:", res.gdof_per_second, res.extra, "ynorm", res.ynorm)
+"""
+
+DIST1 = """
+import jax, jax.numpy as jnp
+from bench_tpu_fem.bench.driver import BenchConfig
+from bench_tpu_fem.dist.driver import run_distributed
+from bench_tpu_fem.bench.driver import BenchmarkResults
+cfg = BenchConfig(ndofs_global=2_000_000, degree=3, qmode=1,
+                  float_bits=32, nreps=100, use_cg=True, ndevices=1)
+res = BenchmarkResults()
+run_distributed(cfg, res, jnp.float32)
+print("DIST1:", res.gdof_per_second, res.extra)
+"""
+
+DFDIST1 = """
+import jax, jax.numpy as jnp
+from bench_tpu_fem.bench.driver import BenchConfig, BenchmarkResults
+from bench_tpu_fem.dist.driver import run_distributed_df64
+cfg = BenchConfig(ndofs_global=2_000_000, degree=3, qmode=1,
+                  float_bits=64, nreps=50, use_cg=True,
+                  f64_impl="df32", ndevices=1)
+res = BenchmarkResults()
+run_distributed_df64(cfg, res)
+print("DFDIST1:", res.gdof_per_second, res.extra, "ynorm", res.ynorm)
+"""
+
+DEG6STREAM = PRE + """
+import bench_tpu_fem.ops.folded as FO
+import bench_tpu_fem.ops.pallas_laplacian as PL
+orig = FO.pallas_geom_constraint
+FO.pallas_geom_constraint = lambda d, nq, itemsize=4: (
+    (True, "corner") if d == 6 else orig(d, nq, itemsize))
+PL.corner_streamed_lanes_ok = lambda nd, nq, itemsize=4: True
+cfg = BenchConfig(ndofs_global=12_500_000, degree=6, qmode=1,
+                  float_bits=32, nreps=200, use_cg=True,
+                  geom_perturb_fact=0.2, backend="pallas")
+res, w = timed_res(cfg)
+print("DEG6STREAM:", res.gdof_per_second, res.extra)
+"""
+
+DFACC = PRE + """
+cfg = BenchConfig(ndofs_global=50_000, degree=3, qmode=1, float_bits=64,
+                  nreps=30, use_cg=True, mat_comp=True, f64_impl="df32")
+res, w = timed_res(cfg)
+print("DFACC one:", "enorm/znorm", res.enorm / res.znorm, res.extra)
+assert res.extra.get("cg_engine") is True, "engine did not engage"
+assert res.enorm / res.znorm < 1e-9, "df one-kernel lost f64 accuracy"
+import bench_tpu_fem.ops.kron_cg_df as KCD
+KCD.engine_plan_df = lambda *a: ("chunked", None)
+res, w = timed_res(cfg)
+print("DFACC chunked:", "enorm/znorm", res.enorm / res.znorm, res.extra)
+assert res.enorm / res.znorm < 1e-9, "df chunked lost f64 accuracy"
+print("DFACC OK")
+"""
+
+PERTDF = PRE + """
+cfg = BenchConfig(ndofs_global=50_000, degree=3, qmode=1, float_bits=64,
+                  nreps=30, use_cg=True, mat_comp=True, f64_impl="df32",
+                  geom_perturb_fact=0.2)
+res, w = timed_res(cfg)
+print("PERTDF acc:", "enorm/znorm", res.enorm / res.znorm, res.extra)
+assert res.extra.get("f64_impl") == "df32", res.extra
+assert res.enorm / res.znorm < 1e-9, "folded-df lost f64 accuracy"
+import bench_tpu_fem.ops.folded_df as FD
+import bench_tpu_fem.bench.driver as BD
+orig = FD.build_folded_laplacian_df
+FD.build_folded_laplacian_df = lambda *a, **k: orig(
+    *a, **{**k, "geom": "corner"})
+res, w = timed_res(cfg)
+print("PERTDF acc corner:", "enorm/znorm", res.enorm / res.znorm,
+      res.extra)
+assert res.extra.get("f64_impl") == "df32", res.extra
+assert res.extra.get("geom") == "corner", res.extra
+assert res.enorm / res.znorm < 1e-9, "folded-df corner lost f64 accuracy"
+FD.build_folded_laplacian_df = orig
+cfg = BenchConfig(ndofs_global=12_500_000, degree=3, qmode=1,
+                  float_bits=64, nreps=100, use_cg=True, f64_impl="df32",
+                  geom_perturb_fact=0.2)
+res, w = timed_res(cfg)
+print("PERTDF12.5M:", res.gdof_per_second, res.extra,
+      "vs4.02:", res.gdof_per_second / 4.02)
+"""
+
+FOLDENG = """
+import jax, jax.numpy as jnp
+from bench_tpu_fem.bench.driver import BenchConfig, BenchmarkResults
+from bench_tpu_fem.dist.driver import run_distributed
+cfg = BenchConfig(ndofs_global=12_500_000, degree=3, qmode=1,
+                  float_bits=32, nreps=500, use_cg=True, ndevices=1,
+                  backend="pallas", geom_perturb_fact=0.2)
+res = BenchmarkResults(nreps=cfg.nreps)
+run_distributed(cfg, res, jnp.float32)
+print("FOLDENG:", res.gdof_per_second, res.extra, "ynorm", res.ynorm)
+# loud on routing drift: an unfused fallback here would otherwise make
+# the A/B below compare unfused vs unfused (the reason is in the extras)
+assert res.extra.get("cg_engine_form") == "halo", res.extra
+import bench_tpu_fem.dist.folded_cg as DFC
+DFC.dist_folded_engine_plan = lambda op: (False, None)
+res2 = BenchmarkResults(nreps=cfg.nreps)
+run_distributed(cfg, res2, jnp.float32)
+print("FOLDENG-UNFUSED:", res2.gdof_per_second, res2.extra,
+      "ynorm", res2.ynorm, "speedup:",
+      res.gdof_per_second / max(res2.gdof_per_second, 1e-12))
+"""
+
+DFEXT2D = """
+import jax, jax.numpy as jnp
+from bench_tpu_fem.bench.driver import BenchConfig, BenchmarkResults
+from bench_tpu_fem.dist.driver import run_distributed_df64
+nd = len(jax.devices())
+if nd >= 8:
+    ndev, tag = 8, "(2,2,2)"
+else:
+    import bench_tpu_fem.dist.kron_cg_df as KCD
+    KCD._is_x_only = lambda op: False
+    ndev, tag = 1, "forced-ext2d-1dev"
+cfg = BenchConfig(ndofs_global=2_000_000, degree=3, qmode=1,
+                  float_bits=64, nreps=50, use_cg=True,
+                  f64_impl="df32", ndevices=ndev)
+res = BenchmarkResults(nreps=cfg.nreps)
+run_distributed_df64(cfg, res)
+print("DFEXT2D", tag, ":", res.gdof_per_second, res.extra,
+      "ynorm", res.ynorm)
+assert res.extra.get("cg_engine_form") == "ext2d", res.extra
+"""
+
+
+def make_stages(round_tag: str = DEFAULT_ROUND) -> dict[str, Stage]:
+    """All known stages by name. Gate topology: ``dfacc`` (the
+    on-hardware df accuracy oracle) gates every df perf stage; the gate
+    outcome persists in the journal across resumes."""
+    journal_path = default_journal_path(ROOT, round_tag)
+    stages = [
+        _py("health", PROBE_CODE, 180, critical=True),
+        _py("ab12", AB12_ENGINE, 1200),
+        _py("ab12base", AB12_BASELINE, 1200),
+        _py("q6", _bench_code("Q6:", dict(
+            ndofs_global=12_500_000, degree=6, qmode=1, float_bits=32,
+            nreps=1000, use_cg=True),
+            tail_expr=', "vs4.40:", res.gdof_per_second/4.40'), 1200),
+        _py("deg4", _bench_code("DEG4PERT:", dict(
+            ndofs_global=12_500_000, degree=4, qmode=1, float_bits=32,
+            nreps=500, use_cg=True, geom_perturb_fact=0.2)), 1800),
+        _py("deg5", _bench_code("DEG5PERT:", dict(
+            ndofs_global=12_500_000, degree=5, qmode=1, float_bits=32,
+            nreps=500, use_cg=True, geom_perturb_fact=0.2)), 1800),
+        _py("df32", DF32, 1800),
+        _py("dist1", DIST1, 1200),
+        _py("dfdist1", DFDIST1, 1200),
+        _py("deg6stream", DEG6STREAM, 1800),
+        _py("q6one", _bench_code("Q6ONEKERNEL:", dict(
+            ndofs_global=12_500_000, degree=6, qmode=1, float_bits=32,
+            nreps=1000, use_cg=True),
+            setup="import bench_tpu_fem.ops.kron_cg as KC\n"
+                  "KC.VMEM_BUDGET = 14 * 2**20  # probe the one-kernel "
+                  "form"), 1800),
+        _py("dfacc", DFACC, 1800, provides="dfacc"),
+        _py("pertdf", PERTDF, 2400, gate="dfacc"),
+        _py("foldeng", FOLDENG, 2400),
+        _py("dfext2d", DFEXT2D, 2400, gate="dfacc"),
+        _py("dfeng", _bench_code("DFENG12.5M:", dict(
+            ndofs_global=12_500_000, degree=3, qmode=1, float_bits=64,
+            nreps=200, use_cg=True, f64_impl="df32"),
+            tail_expr=', "vs4.02:", res.gdof_per_second/4.02'),
+            1800, gate="dfacc"),
+        _py("dfunf", _bench_code("DFUNFUSED12.5M:", dict(
+            ndofs_global=12_500_000, degree=3, qmode=1, float_bits=64,
+            nreps=50, use_cg=True, f64_impl="df32"),
+            setup="import bench_tpu_fem.ops.kron_cg_df as KCD\n"
+                  "KCD.engine_plan_df = lambda *a: ('unfused', None)"),
+            1800, gate="dfacc"),
+        # The df capacity points opt into the OOM degradation ladder: df32
+        # roughly doubles per-dof memory vs f32, and a downsized number
+        # (journaled with the size measured) beats no number — the
+        # generalized form of bench.py:run_df32_side_metric's loop.
+        _py("dflarge100", _bench_code("DFLARGE100M:", dict(
+            ndofs_global=_NDOFS, degree=3, qmode=1, float_bits=64,
+            nreps=50, use_cg=True, f64_impl="df32")),
+            2400, gate="dfacc", size=100_000_000, floor=25_000_000),
+        _py("dflarge150", _bench_code("DFLARGE150M:", dict(
+            ndofs_global=_NDOFS, degree=3, qmode=1, float_bits=64,
+            nreps=30, use_cg=True, f64_impl="df32")),
+            2400, gate="dfacc", size=150_000_000, floor=25_000_000),
+        # f32 capacity points (fixed sizes; the f32 ceiling climb is the
+        # measurement itself, so no ladder — an OOM IS the data point).
+        _py("large100", _bench_code("LARGE 100000000:", dict(
+            ndofs_global=100_000_000, degree=3, qmode=1, float_bits=32,
+            nreps=100, use_cg=True)), 2400),
+        _py("large128", _bench_code("LARGE 128000000:", dict(
+            ndofs_global=128_000_000, degree=3, qmode=1, float_bits=32,
+            nreps=100, use_cg=True)), 2400),
+        _py("large200", _bench_code("LARGE 200000000:", dict(
+            ndofs_global=200_000_000, degree=3, qmode=1, float_bits=32,
+            nreps=50, use_cg=True)), 2400),
+        _py("large300", _bench_code("LARGE 300000000:", dict(
+            ndofs_global=300_000_000, degree=3, qmode=1, float_bits=32,
+            nreps=50, use_cg=True)), 2400),
+        # bench.py runs under a SHORT retry window here (the agenda only
+        # reaches it when health passed; its 2h default is the driver's
+        # end-of-round capture) and journals its parent attempts into the
+        # same round journal.
+        Stage(name="bench",
+              command=lambda ctx: [sys.executable, "bench.py"],
+              policy=StagePolicy(timeout_s=2400),
+              env={"BENCH_WINDOW_S": "1800",
+                   "BENCH_ATTEMPT_TIMEOUT_S": "1500",
+                   "BENCH_JOURNAL": journal_path,
+                   "BENCH_ROUND": round_tag},
+              parse=last_json_line, tail=15),
+        _script("matrix", ["scripts/baseline_matrix.py",
+                           f"BASELINE_MATRIX_{round_tag}.json"], 10800),
+        _script("p300", ["scripts/probe_scoped_vmem.py", "q3_300m"], 1800),
+        _script("pert100", ["scripts/probe_scoped_vmem.py", "pert100"],
+                2100),
+        _script("deg7probe", ["scripts/probe_scoped_vmem.py", "deg7probe"],
+                1800),
+    ]
+    return {s.name: s for s in stages}
+
+
+# Composite measure_all stage names -> granular harness stages.
+ALIASES = {
+    "ab12": ["ab12", "ab12base"],
+    "large": ["large100", "large128", "large200", "large300"],
+    "dfeng": ["dfeng", "dfunf"],
+    "dflarge": ["dflarge100", "dflarge150"],
+}
+
+# Round-6 default agenda, ordered by value-per-minute under wedge risk
+# (measure_all's ordering, expanded through ALIASES).
+AGENDAS = {
+    "round6": ["health", "dfacc", "pertdf", "foldeng", "dfext2d",
+               "dfeng", "bench", "dflarge", "pert100", "deg7probe",
+               "matrix"],
+}
+
+
+def resolve_stage_names(wanted, stages) -> list[str]:
+    """Expand composite aliases; error on unknown names (measure_all's
+    CLI contract)."""
+    out: list[str] = []
+    unknown: list[str] = []
+    for name in wanted:
+        if name in ALIASES:
+            out.extend(ALIASES[name])
+        elif name in stages:
+            out.append(name)
+        else:
+            unknown.append(name)
+    if unknown:
+        valid = sorted(set(stages) | set(ALIASES))
+        raise SystemExit(f"unknown stage(s) {unknown}; valid: {valid}")
+    # order-preserving dedupe: "dfeng" is both a composite alias and a
+    # granular stage name, so naming both must not run dfunf twice
+    return list(dict.fromkeys(out))
+
+
+def make_log(round_tag: str):
+    """measure_all's tee logger: [HH:MM:SS] lines to stdout + the round
+    log (human narrative; the machine record is the .jsonl journal)."""
+    path = os.path.join(ROOT, f"MEASURE_{round_tag}.log")
+
+    def log(msg):
+        line = f"[{time.strftime('%H:%M:%S')}] {msg}"
+        print(line, flush=True)
+        with open(path, "a") as fh:
+            fh.write(line + "\n")
+
+    return log
+
+
+# The current round's shared logger (probe_scoped_vmem and the two
+# agendas write one log, one line convention).
+log = make_log(DEFAULT_ROUND)
+
+
+def build_runner(stage_names=None, round_tag: str = DEFAULT_ROUND,
+                 agenda: str = "round6") -> Runner:
+    stages = make_stages(round_tag)
+    names = resolve_stage_names(stage_names or AGENDAS[agenda], stages)
+    journal = Journal(default_journal_path(ROOT, round_tag))
+    return Runner([stages[n] for n in names], journal,
+                  probe=probe_tunnel, log=make_log(round_tag),
+                  base_env=base_env(round_tag), cwd=ROOT,
+                  round_tag=round_tag)
+
+
+def watch(stage_names=None, round_tag: str = DEFAULT_ROUND,
+          agenda: str = "round6", interval_s: float = 180.0,
+          max_cycles: int = 0, sleep=time.sleep) -> int:
+    """The watch daemon (replaces scripts/watch_tunnel.sh): probe the
+    tunnel every ``interval_s``; on recovery run the agenda RESUMED (the
+    round-4 lesson: wedges last hours and recovery windows are precious —
+    fire the moment the tunnel returns, skip what the journal already
+    holds); if the agenda aborts on a fresh wedge, re-arm instead of
+    exiting. ``max_cycles`` bounds probe attempts (0 = unbounded)."""
+    log = make_log(round_tag)
+    journal = Journal(default_journal_path(ROOT, round_tag))
+    cycles = 0
+    ran_once = False
+    while True:
+        cycles += 1
+        ok, detail = probe_tunnel()
+        journal.append({"event": "probe", "ok": ok, "detail": detail[:300],
+                        "source": "watch"})
+        if ok:
+            log(f"[watch] tunnel up ({detail}); running agenda")
+            runner = build_runner(stage_names, round_tag, agenda)
+            # Explicitly NAMED stages measure fresh on the first pass
+            # (the measure_all contract: re-collecting by name must not
+            # replay the journal); re-arms after a wedge always resume —
+            # they continue THIS watch session's partial agenda.
+            rc = runner.run(resume=ran_once or not stage_names)
+            ran_once = True
+            if runner.aborted == "tunnel_wedge":
+                log("[watch] agenda aborted on a fresh wedge; re-arming")
+            else:
+                return rc
+        else:
+            log(f"[watch] tunnel down ({detail}); "
+                f"sleeping {interval_s:.0f}s")
+        if max_cycles and cycles >= max_cycles:
+            log(f"[watch] giving up after {cycles} cycles")
+            return 1
+        sleep(interval_s)
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m bench_tpu_fem.harness",
+        description="Resilient measurement harness (journaled, resumable,"
+                    " fault-classified)")
+    sub = p.add_subparsers(dest="cmd", required=True)
+    pr = sub.add_parser("run", help="run a measurement agenda")
+    pw = sub.add_parser("watch", help="probe-and-run daemon "
+                                      "(watch_tunnel replacement)")
+    for sp in (pr, pw):
+        sp.add_argument("stages", nargs="*",
+                        help="stage names (default: the agenda's list)")
+        sp.add_argument("--agenda", default="round6",
+                        choices=sorted(AGENDAS))
+        sp.add_argument("--round", default=DEFAULT_ROUND,
+                        help="round tag stamped on journal/log artifacts")
+    pr.add_argument("--resume", action="store_true",
+                    help="skip journal-completed stages; honor persisted "
+                         "gate outcomes")
+    pw.add_argument("--interval", type=float, default=180.0,
+                    help="probe interval seconds")
+    pw.add_argument("--max-cycles", type=int, default=0,
+                    help="probe attempts before giving up (0 = unbounded)")
+    args = p.parse_args(argv)
+    if args.cmd == "run":
+        runner = build_runner(args.stages or None, args.round, args.agenda)
+        return runner.run(resume=args.resume)
+    return watch(args.stages or None, args.round, args.agenda,
+                 interval_s=args.interval, max_cycles=args.max_cycles)

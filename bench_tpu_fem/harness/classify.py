@@ -1,0 +1,116 @@
+"""Failure taxonomy: one classifier for every failure artifact line.
+
+Classes (the shared vocabulary — ``error_record``/``record_engine`` refuse
+anything else):
+
+  tunnel_wedge   the TPU tunnel / PJRT client is hung or unreachable
+                 (device init exceeds its watchdog, UNAVAILABLE /
+                 DEADLINE_EXCEEDED transport errors). Policy: health
+                 re-probe + bounded backoff — retrying the stage into a
+                 wedged tunnel just burns its timeout.
+  oom            device memory exhaustion (XLA RESOURCE_EXHAUSTED /
+                 "Out of memory"). Policy: size-halving degradation
+                 ladder where the stage opts in, else give up.
+  mosaic_reject  the Mosaic/Pallas TPU compiler rejected a kernel
+                 (deterministic — retrying cannot help; the drivers fall
+                 back to chunked/unfused forms and record why).
+  accuracy_fail  a correctness gate failed (mat_comp oracle disagreement,
+                 "lost f64 accuracy" assertions). Deterministic; when the
+                 stage provides a gate (dfacc) the FAIL is persisted so
+                 dependent stages stay gated across resumes.
+  timeout        the stage overran its budget with no wedge signature —
+                 re-probe decides whether it was really a wedge.
+  unsupported    a capability/plan gate declined the configuration
+                 (folded_df_plan, engine_plan tiers) — not a fault, but a
+                 recorded fallback still carries a class.
+  transient      everything else (spawn failures, flaky infrastructure);
+                 worth a plain bounded retry.
+
+Derivation is rc + output patterns (the only evidence a killed child
+leaves), mirroring what the drivers' except-clauses match in-process
+(bench.py's RESOURCE_EXHAUSTED test, the Mosaic fallback chains).
+"""
+
+from __future__ import annotations
+
+import re
+
+TAXONOMY = (
+    "tunnel_wedge",
+    "oom",
+    "mosaic_reject",
+    "accuracy_fail",
+    "timeout",
+    "unsupported",
+    "transient",
+)
+
+# Pattern tables, first hit wins within a class. All matched case-
+# sensitively except where the compiled regex says otherwise: the strings
+# are exact artifacts of XLA/Mosaic/bench.py, not prose.
+_OOM_PAT = re.compile(
+    r"RESOURCE_EXHAUSTED|Out of memory|MemoryError|\bOOM\b|\boom\b"
+)
+_MOSAIC_PAT = re.compile(
+    r"Mosaic|mosaic|Pallas TPU lowering|pallas_call|scoped vmem|Scoped Vmem"
+)
+_ACCURACY_PAT = re.compile(
+    r"lost f64 accuracy|accuracy_fail|enorm/znorm exceeded|mat_comp mismatch"
+    r"|engine did not engage"
+)
+_WEDGE_PAT = re.compile(
+    r"tunnel (?:unavailable|wedged|down)|TPU tunnel|DEADLINE_EXCEEDED"
+    r"|UNAVAILABLE|device init/probe exceeded|[Ww]edged"
+)
+_UNSUPPORTED_PAT = re.compile(
+    r"exceeds the df VMEM model|is not supported|unsupported|requires a "
+    r"uniform"
+)
+
+
+def classify_text(text: str, timed_out: bool = False) -> str:
+    """Classify a failure's textual evidence (child output tail, exception
+    string, recorded fallback reason). ``timed_out`` marks that the parent
+    killed the child at its deadline — a wedge signature in the partial
+    output upgrades that to tunnel_wedge (the round-5 BENCH_r05.json
+    failure mode), otherwise it stays a plain timeout for the re-probe
+    step to adjudicate."""
+    text = text or ""
+    # Deterministic, content-specific classes outrank the kill reason: a
+    # child that printed an OOM then hung in teardown is an OOM.
+    if _ACCURACY_PAT.search(text):
+        return "accuracy_fail"
+    if _OOM_PAT.search(text):
+        return "oom"
+    if _MOSAIC_PAT.search(text):
+        return "mosaic_reject"
+    if _WEDGE_PAT.search(text):
+        return "tunnel_wedge"
+    if _UNSUPPORTED_PAT.search(text):
+        return "unsupported"
+    if timed_out:
+        return "timeout"
+    return "transient"
+
+
+def classify(rc: int | None, output: str, timed_out: bool = False) -> str | None:
+    """Classify a finished child process: None means success. Only an
+    actual deadline kill counts as ``timed_out``; rc None WITHOUT a
+    timeout is a spawn failure (the child never ran — transient
+    infrastructure, not a deadline, so it gets the plain bounded retry
+    rather than a tunnel re-probe). Negative rc is a signal death
+    (transient unless the output says otherwise)."""
+    if rc == 0 and not timed_out:
+        return None
+    return classify_text(output, timed_out=timed_out)
+
+
+def classify_exception(exc: BaseException) -> str:
+    """In-process twin of ``classify_text`` for the drivers' fallback
+    chains and bench.py's single-attempt loop: same taxonomy from an
+    exception's type + message."""
+    if isinstance(exc, MemoryError):
+        return "oom"
+    if isinstance(exc, TimeoutError):
+        return "timeout"
+    return classify_text(f"{type(exc).__name__}: {exc}")

@@ -1,0 +1,128 @@
+"""Fault injection: scripted stage outcomes + probes so the runner state
+machine (wedge→backoff→recover→resume, crash-mid-stage replay, OOM
+ladder, gate-fail propagation) is CPU-testable in CI with no hardware.
+
+An injected outcome is just a SubprocessResult the executor returns in
+place of spawning a child; the canned output texts are real artifacts of
+the failure modes they simulate (XLA's RESOURCE_EXHAUSTED phrasing, the
+Mosaic lowering rejection, bench.py's watchdog line), so the classifier
+is exercised on the same evidence hardware produces.
+"""
+
+from __future__ import annotations
+
+from .runner import SubprocessResult
+
+# Canned child-output texts, verbatim from the failure modes they model.
+OOM_TEXT = (
+    "jaxlib.xla_extension.XlaRuntimeError: RESOURCE_EXHAUSTED: "
+    "Out of memory while trying to allocate 12884901888 bytes."
+)
+MOSAIC_TEXT = (
+    "ValueError: The Pallas TPU lowering currently requires that the last "
+    "two dimensions of your block shape are divisible by 8 and 128 "
+    "respectively. Mosaic lowering failed."
+)
+ACCURACY_TEXT = (
+    "AssertionError: df one-kernel lost f64 accuracy\n"
+    "DFACC one: enorm/znorm 3.1e-05"
+)
+WEDGE_TEXT = (
+    '{"metric": "cg_gdof_per_s_per_chip_q3_f32", "value": 0.0, '
+    '"unit": "GDoF/s", "vs_baseline": 0.0, "error": "device init/probe '
+    'exceeded 180s (TPU tunnel unavailable/wedged)", '
+    '"failure_class": "tunnel_wedge"}'
+)
+HANG_PARTIAL = (
+    "% Element tables (quadrature+basis): 0.41s\n"
+    "% Build box mesh: 1.73s\n"
+    "% Create matfree operator:"  # ...and then nothing, ever
+)
+
+
+def ok(out: str = "STAGE OK", wall_s: float = 1.0) -> SubprocessResult:
+    return SubprocessResult(0, out, False, wall_s)
+
+
+def crash(rc: int = 1, out: str = "Traceback: something transient",
+          wall_s: float = 1.0) -> SubprocessResult:
+    return SubprocessResult(rc, out, False, wall_s)
+
+
+def oom(out: str = OOM_TEXT) -> SubprocessResult:
+    return SubprocessResult(1, out, False, 5.0)
+
+
+def mosaic_reject(out: str = MOSAIC_TEXT) -> SubprocessResult:
+    return SubprocessResult(1, out, False, 5.0)
+
+
+def accuracy_fail(out: str = ACCURACY_TEXT) -> SubprocessResult:
+    return SubprocessResult(1, out, False, 5.0)
+
+
+def hang(partial: str = HANG_PARTIAL, wall_s: float = 900.0) -> SubprocessResult:
+    """Timed out + killed: rc None, PARTIAL output preserved (the
+    evidence of where it hung)."""
+    return SubprocessResult(None, partial, True, wall_s)
+
+
+class Killed(BaseException):
+    """Raised by a scripted outcome to simulate the harness process itself
+    dying mid-stage (SIGKILL): the attempt_start record is in the journal,
+    the attempt_end never lands."""
+
+
+def kill_harness():
+    def _raise() -> SubprocessResult:
+        raise Killed()
+
+    return _raise
+
+
+class FaultyExecutor:
+    """Scripted stage executor: ``script`` maps stage name -> list of
+    outcomes (SubprocessResult, or a callable returning one — callables
+    let a script raise Killed). Each execution pops the next outcome; a
+    stage past its script (or unscripted) succeeds. Every call is
+    recorded as (stage_name, attempt, size) for assertions."""
+
+    def __init__(self, script: dict[str, list]):
+        self.script = {k: list(v) for k, v in script.items()}
+        self.calls: list[tuple[str, int, int | None]] = []
+
+    def __call__(self, stage, ctx) -> SubprocessResult:
+        self.calls.append((stage.name, ctx.attempt, ctx.size))
+        seq = self.script.get(stage.name)
+        outcome = seq.pop(0) if seq else ok()
+        if callable(outcome):
+            outcome = outcome()
+        return outcome
+
+
+class FlakyProbe:
+    """Scripted health probe: yields the scripted booleans, then stays at
+    the final value (a recovered tunnel stays up; a dead one stays down)."""
+
+    def __init__(self, results: list[bool]):
+        self.results = list(results)
+        self.calls = 0
+
+    def __call__(self) -> tuple[bool, str]:
+        self.calls += 1
+        if self.results:
+            up = self.results.pop(0) if len(self.results) > 1 else self.results[0]
+        else:
+            up = True
+        return up, f"scripted probe #{self.calls}: {'up' if up else 'down'}"
+
+
+class FakeSleep:
+    """Records requested sleeps instead of blocking (the backoff
+    assertions read ``waits``)."""
+
+    def __init__(self):
+        self.waits: list[float] = []
+
+    def __call__(self, seconds: float) -> None:
+        self.waits.append(seconds)

@@ -1,0 +1,181 @@
+"""Crash-safe append-only JSONL journal + the unified error-line schema.
+
+One record per line, written (flush + fsync) BEFORE and AFTER every stage
+attempt, so a SIGKILL'd agenda loses at most the record being written.
+``replay`` folds a journal back into the state the runner needs to resume:
+which stages completed, every persisted gate outcome (a crash between a
+``dfacc`` FAIL and the next df stage must NOT silently un-gate the df
+agenda on re-run), and the last degradation-ladder size per stage.
+
+A truncated final line (the crash case) is tolerated on read; anything
+else unparseable is surfaced in ``JournalState.corrupt`` rather than
+silently dropped — a measurement journal is evidence, and evidence loss
+must be visible.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from dataclasses import dataclass, field
+
+SCHEMA_VERSION = 1
+
+# The bench JSON contract's metric name (bench.py prints exactly one line
+# with this metric; the driver greps for it).
+BENCH_METRIC = "cg_gdof_per_s_per_chip_q3_f32"
+
+
+def error_record(msg: str, failure_class: str, **extra) -> dict:
+    """The ONE failure-line schema. bench.py's ``_error_line``, its
+    ``_probe_devices`` watchdog and every harness stage emit this shape,
+    so failure audits across BENCH/MEASURE artifacts are a single grep on
+    ``failure_class`` (same contract as ``cg_engine_form``)."""
+    from .classify import TAXONOMY
+
+    if failure_class not in TAXONOMY:
+        raise ValueError(f"failure_class {failure_class!r} not in {TAXONOMY}")
+    rec = {
+        "metric": BENCH_METRIC,
+        "value": 0.0,
+        "unit": "GDoF/s",
+        "vs_baseline": 0.0,
+        "error": msg,
+        "failure_class": failure_class,
+    }
+    rec.update(extra)
+    return rec
+
+
+class Journal:
+    """Append-only JSONL file. Every ``append`` stamps a monotonic ``seq``,
+    a wall-clock ``ts`` and the schema version, then flushes AND fsyncs:
+    the journal must survive the process being SIGKILL'd the next
+    instant (the whole point of journaling before each attempt)."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._seq = _tail_seq(path) + 1
+
+    def _next_seq(self) -> int:
+        """Best-effort monotonic seq across the writers sharing one round
+        file (the runner, bench.py's parent journaling its attempts, the
+        watch daemon): re-read the tail seq so interleaved appends keep
+        ascending instead of replaying a stale cached counter."""
+        self._seq = max(self._seq, _tail_seq(self.path) + 1)
+        return self._seq
+
+    def append(self, record: dict) -> dict:
+        rec = {"v": SCHEMA_VERSION, "seq": self._next_seq(),
+               "ts": time.time()}
+        rec.update(record)
+        self._seq += 1
+        line = json.dumps(rec, sort_keys=True)
+        # O_APPEND open per record: atomic single-write append even when
+        # bench.py (journaling its parent attempts) and the harness runner
+        # share one journal file.
+        with open(self.path, "a") as fh:
+            fh.write(line + "\n")
+            fh.flush()
+            os.fsync(fh.fileno())
+        return rec
+
+    def records(self) -> list[dict]:
+        recs, _ = read_records(self.path)
+        return recs
+
+
+def _tail_seq(path: str) -> int:
+    """Highest seq among the last few records of the file (-1 when none):
+    a bounded tail read, so per-append cost stays O(1) as journals grow."""
+    try:
+        size = os.path.getsize(path)
+    except OSError:
+        return -1
+    with open(path, "rb") as fh:
+        fh.seek(max(0, size - 65536))
+        chunk = fh.read().decode("utf-8", errors="replace")
+    for line in reversed(chunk.splitlines()):
+        try:
+            obj = json.loads(line)
+        except (json.JSONDecodeError, ValueError):
+            continue
+        if isinstance(obj, dict) and isinstance(obj.get("seq"), int):
+            return obj["seq"]
+    return -1
+
+
+def read_records(path: str) -> tuple[list[dict], list[str]]:
+    """Parse a journal file; returns (records, corrupt_lines). A torn
+    FINAL line (crash mid-write) is expected and not counted corrupt."""
+    if not os.path.exists(path):
+        return [], []
+    recs: list[dict] = []
+    corrupt: list[str] = []
+    with open(path) as fh:
+        lines = fh.read().splitlines()
+    for i, line in enumerate(lines):
+        if not line.strip():
+            continue
+        try:
+            obj = json.loads(line)
+        except (json.JSONDecodeError, ValueError):
+            if i == len(lines) - 1:
+                continue  # torn tail record: the crash case, by design
+            corrupt.append(line)
+            continue
+        if isinstance(obj, dict):
+            recs.append(obj)
+        else:
+            corrupt.append(line)
+    return recs, corrupt
+
+
+@dataclass
+class JournalState:
+    """The fold of a journal the resumable runner consumes."""
+
+    completed: dict[str, dict] = field(default_factory=dict)
+    failed: dict[str, dict] = field(default_factory=dict)
+    gates: dict[str, bool] = field(default_factory=dict)
+    attempts: dict[str, int] = field(default_factory=dict)
+    last_size: dict[str, int] = field(default_factory=dict)
+    corrupt: list[str] = field(default_factory=list)
+
+    def done(self, stage: str) -> bool:
+        return stage in self.completed
+
+
+def replay(path_or_records) -> JournalState:
+    """Fold journal records into resumable state. Later records win (a
+    re-run stage's fresh outcome replaces its old one; a re-run gate stage
+    refreshes the persisted gate)."""
+    if isinstance(path_or_records, str):
+        records, corrupt = read_records(path_or_records)
+    else:
+        records, corrupt = list(path_or_records), []
+    st = JournalState(corrupt=corrupt)
+    for rec in records:
+        ev = rec.get("event")
+        stage = rec.get("stage")
+        if ev == "attempt_start" and stage:
+            st.attempts[stage] = st.attempts.get(stage, 0) + 1
+            if rec.get("size") is not None:
+                st.last_size[stage] = rec["size"]
+        elif ev == "attempt_end" and stage:
+            if rec.get("outcome") == "ok":
+                st.completed[stage] = rec
+                st.failed.pop(stage, None)
+            else:
+                st.failed[stage] = rec
+                st.completed.pop(stage, None)
+        elif ev == "gate" and rec.get("gate"):
+            st.gates[rec["gate"]] = bool(rec.get("ok"))
+    return st
+
+
+def default_journal_path(root: str, round_tag: str) -> str:
+    """MEASURE_rNN.jsonl next to MEASURE_rNN.log — the round's evidence
+    journal (round-stamped per the evidence-hygiene rule)."""
+    return os.path.join(root, f"MEASURE_{round_tag}.jsonl")

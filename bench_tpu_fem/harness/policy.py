@@ -1,0 +1,120 @@
+"""Per-stage retry/timeout/backoff policy + the OOM degradation ladder.
+
+The policy layer is a pure decision table: given a failure class and the
+attempt history, return the next action. All clocks/sleeps live in the
+runner (injectable for the fault-injection tests); nothing here blocks.
+
+The OOM ladder generalises bench.py:run_df32_side_metric's one-off
+halving loop: any stage that opts in (``StagePolicy.oom_ladder``) walks
+requested → requested/2 → ... → floor before giving up, and the size
+actually measured is journaled (evidence-hygiene: a downsized number must
+say so).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+# Action kinds the runner executes.
+RETRY = "retry"                    # same stage, same size, after wait_s
+DEGRADE = "degrade"                # same stage at next_size (OOM ladder)
+REPROBE = "reprobe"                # health-probe loop w/ backoff, then retry
+GIVE_UP = "give_up"                # stage failed terminally
+
+
+@dataclass(frozen=True)
+class Action:
+    kind: str
+    wait_s: float = 0.0
+    next_size: int | None = None
+    reason: str = ""
+
+
+@dataclass(frozen=True)
+class OomLadder:
+    """Size-halving degradation ladder. ``floor`` is the smallest size
+    still worth measuring (bench.py's df32 side metric uses 2M dofs: a
+    halved size still yields the round's df headline where the flagship
+    size OOMs)."""
+
+    floor: int
+    factor: float = 0.5
+
+    def next_size(self, size: int) -> int | None:
+        nxt = int(size * self.factor)
+        return nxt if nxt >= self.floor else None
+
+    def sizes(self, start: int):
+        """All ladder rungs from ``start`` down to the floor (the
+        in-process consumers — bench.py — iterate this)."""
+        size = start
+        while size >= min(self.floor, start):
+            yield size
+            nxt = int(size * self.factor)
+            if nxt == size:
+                break
+            size = nxt
+            if size < self.floor:
+                break
+
+
+@dataclass(frozen=True)
+class RetryPolicy:
+    """Bounded retry with exponential backoff (the round-4 lesson: one
+    180 s fail-fast at capture time turned a 2.31x round into an official
+    0.0 artifact — but unbounded retries burn the recovery window)."""
+
+    max_attempts: int = 2
+    backoff_s: float = 60.0
+    backoff_factor: float = 2.0
+    max_backoff_s: float = 900.0
+
+    def backoff(self, attempt: int) -> float:
+        """Backoff before retry number ``attempt`` (1-based)."""
+        return min(
+            self.backoff_s * self.backoff_factor ** max(attempt - 1, 0),
+            self.max_backoff_s,
+        )
+
+
+@dataclass(frozen=True)
+class StagePolicy:
+    timeout_s: float = 900.0
+    retry: RetryPolicy = field(default_factory=RetryPolicy)
+    # Classes worth a plain same-size retry. Deterministic failures
+    # (mosaic_reject / accuracy_fail / unsupported) never are.
+    retry_on: tuple[str, ...] = ("transient", "timeout")
+    # Bounded wedge recovery: how many probe×backoff rounds one stage may
+    # spend waiting for the tunnel before the agenda aborts (wedges last
+    # hours; the watch daemon re-arms at that horizon instead).
+    wedge_max_probes: int = 5
+    oom_ladder: OomLadder | None = None
+
+
+def next_action(
+    failure_class: str,
+    attempt: int,
+    policy: StagePolicy,
+    size: int | None = None,
+) -> Action:
+    """The decision table. ``attempt`` is the 1-based attempt that just
+    failed; ladder rungs do not consume plain-retry budget (a stage that
+    OOMs four times down the ladder has learned something each time)."""
+    if failure_class == "oom" and policy.oom_ladder and size is not None:
+        nxt = policy.oom_ladder.next_size(size)
+        if nxt is not None:
+            return Action(DEGRADE, next_size=nxt,
+                          reason=f"oom ladder {size} -> {nxt}")
+        return Action(GIVE_UP,
+                      reason=f"oom ladder exhausted at floor (size {size})")
+    if failure_class == "tunnel_wedge":
+        if policy.wedge_max_probes > 0:
+            return Action(REPROBE, wait_s=policy.retry.backoff(attempt),
+                          reason="tunnel wedge: re-probe + bounded backoff")
+        return Action(GIVE_UP, reason="tunnel wedge (probing disabled)")
+    if failure_class in policy.retry_on and attempt < policy.retry.max_attempts:
+        return Action(RETRY, wait_s=policy.retry.backoff(attempt),
+                      reason=f"{failure_class}: retry "
+                             f"{attempt + 1}/{policy.retry.max_attempts}")
+    return Action(GIVE_UP, reason=f"{failure_class}: no retry "
+                                  f"(attempt {attempt})")

@@ -1,0 +1,351 @@
+"""The resumable stage runner: journal + classify + policy, one state
+machine.
+
+Executes an agenda of stages, each in its own killable process session
+(bench.py spawns detached single-attempt children, and a parent-only kill
+would orphan one holding the wedged TPU client — the whole group dies on
+timeout). Every attempt is journaled before and after; ``--resume`` skips
+journal-completed stages, re-runs failed ones per policy, and honors
+persisted gate outcomes (a crash between a dfacc FAIL and the next df
+stage must not un-gate the df agenda).
+
+Wedge handling is the round-5 fix: a classified tunnel_wedge (or a
+timeout whose re-probe fails) does NOT burn the remaining stages'
+timeouts — the runner enters a bounded probe×backoff loop and either
+resumes the agenda on recovery or aborts it, journaled either way, for
+the watch daemon to re-arm.
+
+Clock, sleep, probe and stage execution are all injectable so the entire
+state machine runs under fault injection on CPU in CI (harness/faults.py).
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import subprocess  # noqa: TID251  (the one sanctioned process-control site)
+import sys
+import time
+from dataclasses import dataclass, field
+
+from .classify import classify, classify_text
+from .journal import Journal, replay
+from .policy import DEGRADE, REPROBE, RETRY, StagePolicy, next_action
+
+# Output lines dropped from journaled tails (measure_all's filter): pure
+# noise at best, and at worst they push the informative tail lines out.
+_NOISE_PREFIXES = ("warning",)          # matched on the lowercased line
+_NOISE_SUBSTRINGS = ("Platform 'axon'",)
+
+
+def clean_tail(out: str, tail: int = 25) -> str:
+    keep = [
+        ln for ln in (out or "").strip().splitlines()
+        if not ln.lower().startswith(_NOISE_PREFIXES)
+        and not any(s in ln for s in _NOISE_SUBSTRINGS)
+    ]
+    return "\n".join(keep[-tail:])
+
+
+@dataclass
+class SubprocessResult:
+    rc: int | None          # None = killed at the deadline
+    out: str                # captured output — PARTIAL output on timeout
+    timed_out: bool
+    wall_s: float
+
+
+def run_subprocess(cmd, timeout_s, env=None, cwd=None) -> SubprocessResult:
+    """Shared child runner (lifted from measure_all._run / bench.py main):
+    own session, stdout+stderr merged, the WHOLE GROUP SIGKILLed on
+    timeout. The captured partial output survives the kill — *where* a
+    stage hung is evidence (a wedge at device init reads differently from
+    a hang mid-CG), and the old TIMEOUT path that discarded it lost
+    exactly the lines that diagnose the wedge."""
+    t0 = time.monotonic()
+    try:
+        proc = subprocess.Popen(
+            cmd, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True, cwd=cwd, env=env, start_new_session=True,
+        )
+    except OSError as exc:
+        return SubprocessResult(None, f"spawn failed: {exc}", False,
+                                time.monotonic() - t0)
+    try:
+        out, _ = proc.communicate(timeout=timeout_s)
+        return SubprocessResult(proc.returncode, out or "", False,
+                                time.monotonic() - t0)
+    except subprocess.TimeoutExpired as exc:
+        try:
+            os.killpg(proc.pid, signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
+        out, _ = proc.communicate()
+        # communicate(timeout=) buffers what the child wrote before the
+        # kill either in exc.output (pre-kill reads) or in the post-kill
+        # drain — keep whichever carries the evidence.
+        partial = out or (exc.output if isinstance(exc.output, str) else "")
+        return SubprocessResult(None, partial or "", True,
+                                time.monotonic() - t0)
+
+
+@dataclass
+class StageContext:
+    """What a stage's command builder sees: the current ladder size (None
+    when the stage didn't opt into the OOM ladder) and the attempt
+    number."""
+
+    size: int | None = None
+    attempt: int = 1
+    round_tag: str = ""
+
+
+@dataclass
+class Stage:
+    """One agenda entry. ``command`` builds the child argv from the
+    context (ladder stages interpolate ``ctx.size``); tests bypass it via
+    the runner's injectable executor."""
+
+    name: str
+    command: object = None          # callable(StageContext) -> list[str]
+    policy: StagePolicy = field(default_factory=StagePolicy)
+    requires_gate: str | None = None
+    provides_gate: str | None = None
+    size: int | None = None         # initial OOM-ladder size
+    env: dict | None = None         # stage-specific env overrides
+    critical: bool = False          # terminal failure aborts the agenda
+    check: object = None            # callable(rc, out) -> bool (success)
+    parse: object = None            # callable(out) -> dict | None (result)
+    tail: int = 25
+
+
+class Runner:
+    """Drives an agenda through the journal/classify/policy machinery.
+
+    ``probe`` is the tunnel health check: callable() -> (ok, detail). The
+    default runs a tiny device computation in a killable child (see
+    agenda.probe_tunnel). ``exec_stage`` (callable(stage, ctx) ->
+    SubprocessResult) defaults to the subprocess runner; fault-injection
+    tests swap it for a scripted executor."""
+
+    def __init__(self, stages, journal: Journal, probe=None,
+                 sleep=time.sleep, log=None, exec_stage=None,
+                 base_env=None, cwd=None, round_tag=""):
+        self.stages = list(stages)
+        self.journal = journal
+        self.probe = probe
+        self.sleep = sleep
+        self.log = log or (lambda msg: print(msg, flush=True))
+        self.exec_stage = exec_stage or self._exec_subprocess
+        self.base_env = base_env
+        self.cwd = cwd
+        self.round_tag = round_tag
+        self.gates: dict[str, bool] = {}
+        self.aborted: str | None = None  # set by run(); the watch daemon
+        # re-arms on "tunnel_wedge" instead of giving up
+
+    # -- execution ---------------------------------------------------------
+
+    def _exec_subprocess(self, stage: Stage, ctx: StageContext):
+        env = dict(self.base_env if self.base_env is not None else os.environ)
+        if stage.env:
+            env.update(stage.env)
+        cmd = stage.command(ctx)
+        return run_subprocess(cmd, stage.policy.timeout_s, env=env,
+                              cwd=self.cwd)
+
+    def _probe(self) -> bool:
+        """One journaled health probe."""
+        if self.probe is None:
+            return True
+        ok, detail = self.probe()
+        self.journal.append({"event": "probe", "ok": bool(ok),
+                             "detail": str(detail)[:300]})
+        self.log(f"probe {'OK' if ok else 'DOWN'}: {detail}")
+        return bool(ok)
+
+    def _wedge_recovery(self, stage: Stage, attempt: int) -> bool:
+        """Bounded probe×backoff loop after a classified wedge. True =
+        tunnel recovered (re-run the stage); False = still wedged (abort
+        the agenda — the watch daemon owns longer horizons)."""
+        pol = stage.policy
+        for round_i in range(1, pol.wedge_max_probes + 1):
+            wait = pol.retry.backoff(round_i)
+            self.log(f"[{stage.name}] wedge backoff {wait:.0f}s "
+                     f"(probe {round_i}/{pol.wedge_max_probes})")
+            self.sleep(wait)
+            if self._probe():
+                return True
+        return False
+
+    # -- the state machine -------------------------------------------------
+
+    def run(self, resume: bool = False) -> int:
+        state = replay(self.journal.records()) if resume else None
+        if state is not None:
+            # Persisted gate outcomes survive the crash/kill (satellite:
+            # dfacc FAIL keeps gating df stages on re-run until the gate
+            # stage itself re-runs and passes).
+            self.gates.update(state.gates)
+            if state.corrupt:
+                self.log(f"journal: {len(state.corrupt)} corrupt line(s) "
+                         "retained for audit")
+        self.journal.append({
+            "event": "agenda_start", "resume": resume,
+            "round": self.round_tag,
+            "stages": [s.name for s in self.stages],
+        })
+        aborted: str | None = None
+        failed: list[str] = []
+        for stage in self.stages:
+            if aborted:
+                self.journal.append({"event": "stage_skip",
+                                     "stage": stage.name,
+                                     "reason": f"agenda aborted: {aborted}"})
+                continue
+            if resume and state is not None and state.done(stage.name):
+                self.log(f"=== {stage.name} SKIPPED (journal: completed)")
+                self.journal.append({"event": "stage_skip",
+                                     "stage": stage.name,
+                                     "reason": "already-completed"})
+                continue
+            gate = stage.requires_gate
+            if gate is not None and self.gates.get(gate) is False:
+                self.log(f"=== {stage.name} SKIPPED: {gate} gate failed — "
+                         "df numbers don't count without the on-hardware "
+                         "accuracy check")
+                self.journal.append({"event": "stage_skip",
+                                     "stage": stage.name,
+                                     "reason": "gate-failed", "gate": gate})
+                continue
+            outcome, why, abort = self._run_stage(stage, state)
+            if outcome != "ok":
+                failed.append(stage.name)
+                if abort or (stage.critical and outcome == "failed"):
+                    aborted = why or "critical stage failed"
+        self.journal.append({"event": "agenda_end", "aborted": aborted,
+                             "failed": failed, "round": self.round_tag})
+        self.aborted = aborted
+        if aborted:
+            self.log(f"agenda ABORTED: {aborted}")
+        return 0 if not failed and not aborted else 1
+
+    def _run_stage(self, stage: Stage, state):
+        """Run one stage to a terminal outcome. Returns (outcome,
+        terminal_failure_class, abort_agenda) — abort only for a
+        probe-confirmed tunnel wedge, never for a stage that merely
+        *classifies* like one while the tunnel answers."""
+        size = stage.size
+        if (state is not None and stage.name in state.last_size
+                and stage.policy.oom_ladder is not None):
+            # resume the ladder where the killed run left it — the rungs
+            # above are journal-proven OOM
+            size = state.last_size[stage.name]
+        attempt = 0
+        degrades = 0  # ladder rungs don't consume plain-retry budget
+        wedge_rounds = 0
+        while True:
+            attempt += 1
+            ctx = StageContext(size=size, attempt=attempt,
+                               round_tag=self.round_tag)
+            self.log(f"=== stage {stage.name} (attempt {attempt}"
+                     + (f", size {size}" if size is not None else "") + ")")
+            self.journal.append({"event": "attempt_start",
+                                 "stage": stage.name, "attempt": attempt,
+                                 "size": size})
+            res = self.exec_stage(stage, ctx)
+            tail = clean_tail(res.out, stage.tail)
+            ok = (res.rc == 0 and not res.timed_out)
+            if stage.check is not None:
+                ok = bool(stage.check(res.rc, res.out)) and not res.timed_out
+            cls = None
+            if not ok:
+                # a check-rejected rc==0 run still needs a class (every
+                # journaled failure carries one): fall through to the
+                # text patterns, "transient" at worst
+                cls = (classify(res.rc, res.out, timed_out=res.timed_out)
+                       or classify_text(res.out))
+            if cls == "timeout" and self.probe is not None:
+                # a timeout is only a timeout if the tunnel still answers;
+                # a failed re-probe reclassifies it as the wedge it is
+                if not self._probe():
+                    cls = "tunnel_wedge"
+            result = None
+            if ok and stage.parse is not None:
+                result = stage.parse(res.out)
+            end = {"event": "attempt_end", "stage": stage.name,
+                   "attempt": attempt, "rc": res.rc,
+                   "timed_out": res.timed_out,
+                   "wall_s": round(res.wall_s, 3), "size": size,
+                   "outcome": "ok" if ok else "failed",
+                   "failure_class": cls, "output_tail": tail}
+            if result is not None:
+                end["result"] = result
+            self.journal.append(end)
+            self.log(f"{stage.name} rc={res.rc}"
+                     + (" TIMEOUT" if res.timed_out else "")
+                     + (f" [{cls}]" if cls else "") + f": {tail}")
+            if ok:
+                self._set_gate(stage, True)
+                return "ok", None, False
+            act = next_action(cls, attempt - degrades, stage.policy,
+                              size=size)
+            self.journal.append({"event": "action", "stage": stage.name,
+                                 "kind": act.kind, "reason": act.reason,
+                                 "wait_s": act.wait_s,
+                                 "next_size": act.next_size})
+            if act.kind == RETRY:
+                self.log(f"[{stage.name}] {act.reason}; backoff "
+                         f"{act.wait_s:.0f}s")
+                self.sleep(act.wait_s)
+                continue
+            if act.kind == DEGRADE:
+                self.log(f"[{stage.name}] {act.reason}")
+                size = act.next_size
+                degrades += 1
+                continue
+            if act.kind == REPROBE:
+                wedge_rounds += 1
+                if wedge_rounds > stage.policy.wedge_max_probes:
+                    # the tunnel answered every probe, yet the stage keeps
+                    # failing with a wedge signature: a deterministic
+                    # failure whose text merely matches the wedge patterns
+                    # (e.g. an embedded gRPC UNAVAILABLE). Terminal for
+                    # the STAGE — aborting the agenda here would send the
+                    # watch daemon into an endless re-arm loop while the
+                    # remaining stages never run.
+                    self._set_gate(stage, False)
+                    self.log(f"[{stage.name}] FAILED terminally: wedge-"
+                             "classified but the tunnel answers probes")
+                    return "failed", cls, False
+                if self._wedge_recovery(stage, attempt):
+                    self.log(f"[{stage.name}] tunnel recovered; re-running")
+                    continue
+                self._set_gate(stage, False)
+                return "failed", "tunnel_wedge", True
+            # GIVE_UP
+            self._set_gate(stage, False)
+            self.log(f"[{stage.name}] FAILED terminally: {act.reason}")
+            return "failed", cls, False
+
+    def _set_gate(self, stage: Stage, ok: bool) -> None:
+        if stage.provides_gate is None:
+            return
+        self.gates[stage.provides_gate] = ok
+        self.journal.append({"event": "gate", "gate": stage.provides_gate,
+                             "ok": ok, "stage": stage.name})
+
+
+def last_json_line(text: str) -> dict | None:
+    """The bench JSON contract parser (shared with bench.py's parent):
+    last parseable {"metric": ...} line wins."""
+    import json
+
+    for line in reversed((text or "").strip().splitlines()):
+        try:
+            obj = json.loads(line)
+        except (json.JSONDecodeError, ValueError):
+            continue
+        if isinstance(obj, dict) and "metric" in obj:
+            return obj
+    return None

@@ -1,505 +1,37 @@
-"""Run the outstanding TPU measurement agenda (round 6), logging each
-step as it lands (a mid-run tunnel wedge preserves completed steps).
+"""Back-compat shim over the resilient measurement harness.
 
-Earlier rounds' stages remain callable by name. The round-6 default
-agenda adds the perturbed-geometry df32 gate for the folded df pipeline
-(ops.folded_df) to the still-uncollected round-5 items:
+The TPU measurement agenda now lives in ``bench_tpu_fem.harness`` —
+journaled (MEASURE_rNN.jsonl), resumable, fault-classified. This script
+keeps the historical entry point working:
 
-  health    - tunnel probe (aborts the rest when down)
-  dfacc     - df32 engine ACCURACY on hardware (mat_comp oracle): the
-              Mosaic compile path may behave differently from the
-              CPU-validated interpret path (FP rewrites, op support) —
-              this gate must pass before any df perf number is believed
-  pertdf    - perturbed df32 ACCURACY + throughput: the folded df
-              pipeline's first-ever Mosaic compile (its VMEM plan is a
-              design estimate until this runs), mat_comp gate first,
-              then the 12.5M perf point vs the 4.02 f64 baseline
-  dfeng     - fused df32 engine A/B vs unfused at 12.5M dofs
-  dflarge   - df32 engine at 100M (tier-3 scoped limit), plus the
-              recorded one-kernel ceiling behaviour toward 300M
-  pert100   - perturbed capacity at 100M dofs, corner mode
-  deg7probe - degree-7 streamed-corner compile probe at 48 MiB
-  bench     - the official bench.py line (now includes the df32
-              headline side metric at flagship size)
+    python scripts/measure_all.py [stage...]
 
-Usage: python scripts/measure_all.py [stage...]
+is exactly
+
+    python -m bench_tpu_fem.harness run --resume [stage...]
+
+Stage names are unchanged (health, dfacc, pertdf, foldeng, dfext2d,
+dfeng, bench, dflarge, pert100, deg7probe, matrix, and the earlier
+rounds' ab12/q6/large/...); composite names expand to their granular
+harness stages (see harness.agenda.ALIASES). The legacy contract is kept
+exactly: explicitly NAMED stages always run (no --resume — re-collecting
+a number by name must measure, not replay the journal), while the
+no-argument default agenda runs ``--resume`` because that is strictly
+better under wedge risk: completed stages are skipped via the journal,
+failed ones re-run per policy, and a previously-FAILED dfacc gate keeps
+gating df stages instead of resetting to unknown (the old in-process
+``dfacc_ok`` flag died with the process).
+
+The failure taxonomy, retry/backoff policy, OOM degradation ladder and
+journal format are documented in README "Measurement harness".
 """
 import os
-import subprocess
 import sys
-import time
 
-ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-LOG = os.path.join(ROOT, "MEASURE_r06.log")
-ENV = {**os.environ, "PYTHONPATH": f"{ROOT}:/root/.axon_site"}
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-
-def log(msg):
-    line = f"[{time.strftime('%H:%M:%S')}] {msg}"
-    print(line, flush=True)
-    with open(LOG, "a") as fh:
-        fh.write(line + "\n")
-
-
-def _run(cmd, timeout, tail=25):
-    """Shared runner: same env/cwd/timeout handling for every stage. A
-    hang (wedged tunnel) is reported as rc=-9 with a TIMEOUT tail instead
-    of propagating — the agenda must keep logging whatever it can. The
-    stage runs in its own session and the WHOLE GROUP is killed on
-    timeout: bench.py spawns detached single-attempt children, and a
-    parent-only kill would orphan one holding the wedged TPU client."""
-    import signal
-
-    proc = subprocess.Popen(cmd, stdout=subprocess.PIPE,
-                            stderr=subprocess.STDOUT, text=True,
-                            cwd=ROOT, env=ENV, start_new_session=True)
-    try:
-        out, _ = proc.communicate(timeout=timeout)
-        rc = proc.returncode
-    except subprocess.TimeoutExpired:
-        try:
-            os.killpg(proc.pid, signal.SIGKILL)
-        except ProcessLookupError:
-            pass
-        out, _ = proc.communicate()
-        return -9, f"TIMEOUT after {timeout}s"
-    keep = [ln for ln in (out or "").strip().splitlines()
-            if not ln.lower().startswith("warning")
-            and "Platform 'axon'" not in ln]
-    return rc, "\n".join(keep[-tail:])
-
-
-def run_py(code, timeout=900):
-    return _run([sys.executable, "-u", "-c", code], timeout)
-
-
-def run_script(args, timeout):
-    return _run([sys.executable] + args, timeout, tail=15)
-
-
-PRE = """
-import time, numpy as np, jax, jax.numpy as jnp
-from bench_tpu_fem.bench.driver import BenchConfig, run_benchmark
-def timed_res(cfg):
-    t0 = time.time(); res = run_benchmark(cfg); w = time.time()-t0
-    return res, w
-"""
-
-
-def stage_health():
-    rc, out = run_py(
-        "import jax, jax.numpy as jnp\n"
-        "x = jax.device_put(jnp.ones((1024,1024)))\n"
-        "(x@x).block_until_ready(); print('TPU OK', jax.devices())",
-        timeout=180,
-    )
-    log(f"health rc={rc}: {out}")
-    return rc == 0
-
-
-def stage_ab12():
-    # engine vs non-engine at the flagship config
-    code = PRE + """
-cfg = BenchConfig(ndofs_global=12_500_000, degree=3, qmode=1,
-                  float_bits=32, nreps=1000, use_cg=True)
-res, w = timed_res(cfg)
-print("ENGINE:", res.gdof_per_second, res.extra, "ynorm", res.ynorm)
-"""
-    rc, out = run_py(code, timeout=1200)
-    log(f"ab12 engine rc={rc}: {out}")
-    code2 = PRE + """
-# force the non-engine path by monkeypatching the support gate
-import bench_tpu_fem.ops.kron_cg as KC
-KC.supports_kron_cg_engine = lambda *a, **k: False
-cfg = BenchConfig(ndofs_global=12_500_000, degree=3, qmode=1,
-                  float_bits=32, nreps=1000, use_cg=True)
-res, w = timed_res(cfg)
-print("BASELINE3STAGE:", res.gdof_per_second, res.extra, "ynorm", res.ynorm)
-"""
-    rc, out = run_py(code2, timeout=1200)
-    log(f"ab12 baseline rc={rc}: {out}")
-
-
-def stage_q6():
-    _bench_stage("q6", "Q6:", dict(
-        ndofs_global=12_500_000, degree=6, qmode=1, float_bits=32,
-        nreps=1000, use_cg=True),
-        tail_expr=', "vs4.40:", res.gdof_per_second/4.40')
-
-
-def stage_large():
-    for nd, reps in ((100_000_000, 100), (128_000_000, 100),
-                     (200_000_000, 50), (300_000_000, 50)):
-        code = PRE + f"""
-cfg = BenchConfig(ndofs_global={nd}, degree=3, qmode=1,
-                  float_bits=32, nreps={reps}, use_cg=True)
-res, w = timed_res(cfg)
-print("LARGE {nd}:", res.gdof_per_second, res.extra, "ynorm", res.ynorm)
-"""
-        rc, out = run_py(code, timeout=2400)
-        log(f"large {nd} rc={rc}: {out}")
-
-
-def _bench_stage(name, label, cfg_kwargs, setup="", timeout=1800,
-                 tail_expr=""):
-    """Shared single-config benchmark stage: one BenchConfig, one
-    run_benchmark, one labelled print (the four degree/engine stages
-    differ only in these parameters)."""
-    kw = ", ".join(f"{k}={v!r}" for k, v in cfg_kwargs.items())
-    code = PRE + f"""
-{setup}
-cfg = BenchConfig({kw})
-res, w = timed_res(cfg)
-print({label!r}, res.gdof_per_second, res.extra{tail_expr})
-"""
-    rc, out = run_py(code, timeout=timeout)
-    log(f"{name} rc={rc}: {out}")
-
-
-def stage_deg4():
-    _bench_stage("deg4", "DEG4PERT:", dict(
-        ndofs_global=12_500_000, degree=4, qmode=1, float_bits=32,
-        nreps=500, use_cg=True, geom_perturb_fact=0.2))
-
-
-def stage_df32():
-    code = PRE + """
-cfg = BenchConfig(ndofs_global=2_000_000, degree=3, qmode=1,
-                  float_bits=64, nreps=50, use_cg=True, f64_impl="df32")
-res, w = timed_res(cfg)
-print("DF32:", res.gdof_per_second, res.extra, "ynorm", res.ynorm)
-cfg = BenchConfig(ndofs_global=2_000_000, degree=3, qmode=1,
-                  float_bits=64, nreps=50, use_cg=True)
-res, w = timed_res(cfg)
-print("EMULATED:", res.gdof_per_second, res.extra, "ynorm", res.ynorm)
-"""
-    rc, out = run_py(code, timeout=1800)
-    log(f"df32 rc={rc}: {out}")
-
-
-def stage_matrix():
-    rc, out = run_script(
-        ["scripts/baseline_matrix.py", "BASELINE_MATRIX_r05.json"],
-        timeout=10800,
-    )
-    log(f"baseline_matrix rc={rc}: {out}")
-
-
-def stage_bench():
-    # The agenda only reaches this stage when health passed, so bench.py
-    # gets a SHORT retry window (its 2h default is for the driver's
-    # end-of-round capture against a possibly-wedged tunnel) and the
-    # stage timeout comfortably covers window + one attempt overrun.
-    ENV["BENCH_WINDOW_S"] = "1800"
-    ENV["BENCH_ATTEMPT_TIMEOUT_S"] = "1500"
-    try:
-        rc, out = run_script(["bench.py"], timeout=2400)
-    finally:
-        ENV.pop("BENCH_WINDOW_S", None)
-        ENV.pop("BENCH_ATTEMPT_TIMEOUT_S", None)
-    log(f"bench.py rc={rc}: {out}")
-
-
-def stage_deg5():
-    _bench_stage("deg5", "DEG5PERT:", dict(
-        ndofs_global=12_500_000, degree=5, qmode=1, float_bits=32,
-        nreps=500, use_cg=True, geom_perturb_fact=0.2))
-
-
-def stage_dist1():
-    code = """
-import jax, jax.numpy as jnp
-from bench_tpu_fem.bench.driver import BenchConfig
-from bench_tpu_fem.dist.driver import run_distributed
-from bench_tpu_fem.bench.driver import BenchmarkResults
-cfg = BenchConfig(ndofs_global=2_000_000, degree=3, qmode=1,
-                  float_bits=32, nreps=100, use_cg=True, ndevices=1)
-res = BenchmarkResults()
-run_distributed(cfg, res, jnp.float32)
-print("DIST1:", res.gdof_per_second, res.extra)
-"""
-    rc, out = run_py(code, timeout=1200)
-    log(f"dist1 rc={rc}: {out}")
-
-
-def stage_dfdist1():
-    # distributed df32 path compile+run on a 1-device mesh (the sharded
-    # graph end to end; multi-chip perf needs real hardware). With the
-    # fused dist df engine landed, run_distributed_df64 auto-routes
-    # through it on TPU — the Mosaic compile check the CPU suite cannot
-    # give; extras record cg_engine / any recorded fallback reason.
-    code = """
-import jax, jax.numpy as jnp
-from bench_tpu_fem.bench.driver import BenchConfig, BenchmarkResults
-from bench_tpu_fem.dist.driver import run_distributed_df64
-cfg = BenchConfig(ndofs_global=2_000_000, degree=3, qmode=1,
-                  float_bits=64, nreps=50, use_cg=True,
-                  f64_impl="df32", ndevices=1)
-res = BenchmarkResults()
-run_distributed_df64(cfg, res)
-print("DFDIST1:", res.gdof_per_second, res.extra, "ynorm", res.ynorm)
-"""
-    rc, out = run_py(code, timeout=1200)
-    log(f"dfdist1 rc={rc}: {out}")
-
-
-def stage_deg6stream():
-    # Degree-6 qmode-1 perturbed on the plane-streamed corner path:
-    # the VMEM estimate says ~15 MB vs the 14 MiB corner budget vs the
-    # ~16.5 MB hardware limit — genuinely borderline, so probe Mosaic
-    # directly (policy override; flip pallas_geom_constraint only with
-    # a successful compile + sane perf here).
-    code = PRE + """
-import bench_tpu_fem.ops.folded as FO
-import bench_tpu_fem.ops.pallas_laplacian as PL
-orig = FO.pallas_geom_constraint
-FO.pallas_geom_constraint = lambda d, nq, itemsize=4: (
-    (True, "corner") if d == 6 else orig(d, nq, itemsize))
-PL.corner_streamed_lanes_ok = lambda nd, nq, itemsize=4: True
-cfg = BenchConfig(ndofs_global=12_500_000, degree=6, qmode=1,
-                  float_bits=32, nreps=200, use_cg=True,
-                  geom_perturb_fact=0.2, backend="pallas")
-res, w = timed_res(cfg)
-print("DEG6STREAM:", res.gdof_per_second, res.extra)
-"""
-    rc, out = run_py(code, timeout=1800)
-    log(f"deg6stream rc={rc}: {out}")
-
-
-def stage_q6one():
-    _bench_stage("q6one", "Q6ONEKERNEL:", dict(
-        ndofs_global=12_500_000, degree=6, qmode=1, float_bits=32,
-        nreps=1000, use_cg=True),
-        setup="import bench_tpu_fem.ops.kron_cg as KC\n"
-              "KC.VMEM_BUDGET = 14 * 2**20  # probe the one-kernel form")
-
-
-def _probe_stage(name, timeout):
-    # delegate to the per-path-policy probe script so the two agendas
-    # cannot diverge (it logs its own result lines to the shared log)
-    rc, out = run_script(["scripts/probe_scoped_vmem.py", name], timeout)
-    log(f"{name} rc={rc}: {out.splitlines()[-1] if out else ''}")
-
-
-def stage_p300():
-    # tier-3 (96 MiB scoped limit) regression probe
-    _probe_stage("q3_300m", 1800)
-
-
-def stage_pert100():
-    # perturbed capacity at 100M (corner mode; matrix covers 12.5M only)
-    _probe_stage("pert100", 2100)
-
-
-def stage_deg7probe():
-    # raw deg-7 streamed-corner compile probe at 48 MiB (plan-widening
-    # evidence; see probe_scoped_vmem._deg7_probe)
-    _probe_stage("deg7probe", 1800)
-
-
-def stage_dfacc():
-    # df32 engine accuracy ON HARDWARE (both forms): the CPU suite
-    # validates the interpret path; Mosaic's compiled arithmetic
-    # (scheduling, any FP rewrites, scratch semantics) is only provable
-    # here. The oracle (assembled CSR, true f64) must agree to ~1e-9
-    # like the unfused path; a failure here invalidates every df perf
-    # number after it.
-    code = PRE + """
-cfg = BenchConfig(ndofs_global=50_000, degree=3, qmode=1, float_bits=64,
-                  nreps=30, use_cg=True, mat_comp=True, f64_impl="df32")
-res, w = timed_res(cfg)
-print("DFACC one:", "enorm/znorm", res.enorm / res.znorm, res.extra)
-assert res.extra.get("cg_engine") is True, "engine did not engage"
-assert res.enorm / res.znorm < 1e-9, "df one-kernel lost f64 accuracy"
-import bench_tpu_fem.ops.kron_cg_df as KCD
-KCD.engine_plan_df = lambda *a: ("chunked", None)
-res, w = timed_res(cfg)
-print("DFACC chunked:", "enorm/znorm", res.enorm / res.znorm, res.extra)
-assert res.enorm / res.znorm < 1e-9, "df chunked lost f64 accuracy"
-print("DFACC OK")
-"""
-    rc, out = run_py(code, timeout=1800)
-    log(f"dfacc rc={rc}: {out}")
-    return rc == 0
-
-
-def stage_pertdf():
-    # Perturbed f64-class gate for the folded df pipeline (ops.folded_df):
-    # accuracy FIRST (the mat_comp oracle must agree to ~1e-9 like every
-    # other df path, and the run must NOT have taken the recorded
-    # emulation fallback), then the flagship-size perf point. Both
-    # geometry modes: auto (G-pair streaming at this size) and forced
-    # corner (the capacity mode whose in-kernel df Jacobian chain is the
-    # Mosaic-riskiest new code).
-    code = PRE + """
-cfg = BenchConfig(ndofs_global=50_000, degree=3, qmode=1, float_bits=64,
-                  nreps=30, use_cg=True, mat_comp=True, f64_impl="df32",
-                  geom_perturb_fact=0.2)
-res, w = timed_res(cfg)
-print("PERTDF acc:", "enorm/znorm", res.enorm / res.znorm, res.extra)
-assert res.extra.get("f64_impl") == "df32", res.extra
-assert res.enorm / res.znorm < 1e-9, "folded-df lost f64 accuracy"
-import bench_tpu_fem.ops.folded_df as FD
-import bench_tpu_fem.bench.driver as BD
-orig = FD.build_folded_laplacian_df
-FD.build_folded_laplacian_df = lambda *a, **k: orig(
-    *a, **{**k, "geom": "corner"})
-res, w = timed_res(cfg)
-print("PERTDF acc corner:", "enorm/znorm", res.enorm / res.znorm,
-      res.extra)
-assert res.extra.get("f64_impl") == "df32", res.extra
-assert res.extra.get("geom") == "corner", res.extra
-assert res.enorm / res.znorm < 1e-9, "folded-df corner lost f64 accuracy"
-FD.build_folded_laplacian_df = orig
-cfg = BenchConfig(ndofs_global=12_500_000, degree=3, qmode=1,
-                  float_bits=64, nreps=100, use_cg=True, f64_impl="df32",
-                  geom_perturb_fact=0.2)
-res, w = timed_res(cfg)
-print("PERTDF12.5M:", res.gdof_per_second, res.extra,
-      "vs4.02:", res.gdof_per_second / 4.02)
-"""
-    rc, out = run_py(code, timeout=2400)
-    log(f"pertdf rc={rc}: {out}")
-
-
-def stage_dfeng():
-    # fused engine vs unfused df at flagship size
-    _bench_stage("dfeng", "DFENG12.5M:", dict(
-        ndofs_global=12_500_000, degree=3, qmode=1, float_bits=64,
-        nreps=200, use_cg=True, f64_impl="df32"),
-        tail_expr=', "vs4.02:", res.gdof_per_second/4.02')
-    _bench_stage("dfunf", "DFUNFUSED12.5M:", dict(
-        ndofs_global=12_500_000, degree=3, qmode=1, float_bits=64,
-        nreps=50, use_cg=True, f64_impl="df32"),
-        setup="import bench_tpu_fem.ops.kron_cg_df as KCD\n"
-              "KCD.engine_plan_df = lambda *a: ('unfused', None)")
-
-
-def stage_dflarge():
-    for nd, reps in ((100_000_000, 50), (150_000_000, 30)):
-        _bench_stage(f"dflarge{nd}", f"DFLARGE {nd}:", dict(
-            ndofs_global=nd, degree=3, qmode=1, float_bits=64,
-            nreps=reps, use_cg=True, f64_impl="df32"), timeout=2400)
-
-
-def stage_foldeng():
-    # Dist folded fused engine vs unfused A/B at the flagship perturbed
-    # config (the sharded graph end to end on a 1-device mesh: halo
-    # refresh, halo-form delay-ring Mosaic compile, reverse-scatter dot
-    # tail — the collectives degenerate to identity there; multi-chip
-    # scaling needs real multi-chip hardware). Engine routing and any
-    # recorded fallback ride res.extra (cg_engine_form: halo/unfused).
-    code = """
-import jax, jax.numpy as jnp
-from bench_tpu_fem.bench.driver import BenchConfig, BenchmarkResults
-from bench_tpu_fem.dist.driver import run_distributed
-cfg = BenchConfig(ndofs_global=12_500_000, degree=3, qmode=1,
-                  float_bits=32, nreps=500, use_cg=True, ndevices=1,
-                  backend="pallas", geom_perturb_fact=0.2)
-res = BenchmarkResults(nreps=cfg.nreps)
-run_distributed(cfg, res, jnp.float32)
-print("FOLDENG:", res.gdof_per_second, res.extra, "ynorm", res.ynorm)
-# loud on routing drift: an unfused fallback here would otherwise make
-# the A/B below compare unfused vs unfused (the reason is in the extras)
-assert res.extra.get("cg_engine_form") == "halo", res.extra
-import bench_tpu_fem.dist.folded_cg as DFC
-DFC.dist_folded_engine_plan = lambda op: (False, None)
-res2 = BenchmarkResults(nreps=cfg.nreps)
-run_distributed(cfg, res2, jnp.float32)
-print("FOLDENG-UNFUSED:", res2.gdof_per_second, res2.extra,
-      "ynorm", res2.ynorm, "speedup:",
-      res.gdof_per_second / max(res2.gdof_per_second, 1e-12))
-"""
-    rc, out = run_py(code, timeout=2400)
-    log(f"foldeng rc={rc}: {out}")
-
-
-def stage_dfext2d():
-    # ext2d df engine form ((2,2,2)-dshape coverage). On an 8-device rig
-    # this is the real (2,2,2) run; on the 1-chip rig the ext2d branch
-    # is forced onto the 1-device mesh — the kernel form's FIRST Mosaic
-    # compile is the gate that matters (round-4 lesson: interpret mode
-    # accepts kernels Mosaic rejects), and with degenerate collectives
-    # the halo fringes are zero so the numbers stay exact. Gated behind
-    # dfacc in the default agenda like every df number. (The force
-    # patches the private _is_x_only predicate, which the solve path
-    # reads at call time — the cg_engine_form assert below turns any
-    # routing drift into a loud rc!=0, never a silent wrong-form
-    # measurement.)
-    code = """
-import jax, jax.numpy as jnp
-from bench_tpu_fem.bench.driver import BenchConfig, BenchmarkResults
-from bench_tpu_fem.dist.driver import run_distributed_df64
-nd = len(jax.devices())
-if nd >= 8:
-    ndev, tag = 8, "(2,2,2)"
-else:
-    import bench_tpu_fem.dist.kron_cg_df as KCD
-    KCD._is_x_only = lambda op: False
-    ndev, tag = 1, "forced-ext2d-1dev"
-cfg = BenchConfig(ndofs_global=2_000_000, degree=3, qmode=1,
-                  float_bits=64, nreps=50, use_cg=True,
-                  f64_impl="df32", ndevices=ndev)
-res = BenchmarkResults(nreps=cfg.nreps)
-run_distributed_df64(cfg, res)
-print("DFEXT2D", tag, ":", res.gdof_per_second, res.extra,
-      "ynorm", res.ynorm)
-assert res.extra.get("cg_engine_form") == "ext2d", res.extra
-"""
-    rc, out = run_py(code, timeout=2400)
-    log(f"dfext2d rc={rc}: {out}")
-
-
-STAGES = {
-    "health": stage_health, "ab12": stage_ab12, "q6": stage_q6,
-    "large": stage_large, "deg4": stage_deg4, "df32": stage_df32,
-    "matrix": stage_matrix, "bench": stage_bench,
-    "deg5": stage_deg5, "dist1": stage_dist1, "q6one": stage_q6one,
-    "dfdist1": stage_dfdist1, "deg6stream": stage_deg6stream,
-    "p300": stage_p300, "pert100": stage_pert100,
-    "deg7probe": stage_deg7probe, "dfacc": stage_dfacc,
-    "dfeng": stage_dfeng, "dflarge": stage_dflarge,
-    "pertdf": stage_pertdf, "foldeng": stage_foldeng,
-    "dfext2d": stage_dfext2d,
-}
-
-# df stages whose numbers only count after the on-hardware df accuracy
-# gate (dfacc) passes — when dfacc runs in the same agenda and FAILS,
-# these are skipped with a log line instead of producing numbers that
-# round-5's evidence-hygiene rule would have to discard.
-DF_GATED = {"pertdf", "dfeng", "dflarge", "dfext2d"}
+from bench_tpu_fem.harness.agenda import main  # noqa: E402
 
 if __name__ == "__main__":
-    # Round-6 default agenda, ordered by value-per-minute under wedge
-    # risk: the df accuracy gates first (nothing df counts without
-    # them — pertdf is the folded df pipeline's first Mosaic compile),
-    # then the new fused-coverage forms (foldeng is f32 — ungated;
-    # dfext2d is df — gated), the official bench line, df perf, the
-    # leftovers, and the full matrix (longest) last.
-    wanted = sys.argv[1:] or ["health", "dfacc", "pertdf", "foldeng",
-                              "dfext2d", "dfeng", "bench", "dflarge",
-                              "pert100", "deg7probe", "matrix"]
-    unknown = [s for s in wanted if s not in STAGES]
-    if unknown:
-        print(f"unknown stage(s) {unknown}; valid: {list(STAGES)}",
-              file=sys.stderr)
-        sys.exit(2)
-    if "health" in wanted and not stage_health():
-        log("tunnel down; aborting")
-        sys.exit(1)
-    dfacc_ok = None  # unknown until (and unless) the gate stage runs
-    for s in wanted:
-        if s == "health":
-            continue
-        if s in DF_GATED and dfacc_ok is False:
-            log(f"=== stage {s} SKIPPED: dfacc gate failed — df numbers "
-                "don't count without the on-hardware accuracy check")
-            continue
-        log(f"=== stage {s}")
-        try:
-            result = STAGES[s]()
-        except Exception as e:
-            log(f"stage {s} EXC: {e}")
-            result = None
-        if s == "dfacc":
-            dfacc_ok = bool(result)
+    args = sys.argv[1:]
+    sys.exit(main(["run", *([] if args else ["--resume"]), *args]))

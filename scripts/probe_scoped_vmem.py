@@ -13,11 +13,12 @@ Usage: python scripts/probe_scoped_vmem.py [stage...]
 import os
 import sys
 
-sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 # shared logging/runner: one copy of the output filter + the
-# MEASURE_r04.log line format (measure_all delegates its p300 stage
-# back here, so the two agendas share one log convention)
-from measure_all import log, run_py  # noqa: E402
+# MEASURE_rNN.log line format (the harness agenda delegates its p300
+# stage back here, so the two agendas share one log convention — and the
+# harness runner's timeout path keeps the partial output tail)
+from bench_tpu_fem.harness.agenda import log, run_py  # noqa: E402
 
 BENCH = """
 from bench_tpu_fem.bench.driver import BenchConfig, run_benchmark

@@ -1,20 +1,9 @@
 #!/bin/bash
-# Tunnel watcher: probe the TPU every 3 minutes; on recovery run the
-# measurement agenda (scripts/measure_all.py default stages) once and
-# exit. Round-4 lesson: wedges last hours and recovery windows are
-# precious — the agenda must fire the moment the tunnel returns, not
-# when a human notices.
+# Tunnel watcher — thin shim over the harness watch daemon: probe the TPU
+# every 3 minutes; on recovery run the measurement agenda RESUMED from its
+# journal; if the agenda aborts on a fresh wedge, re-arm instead of
+# exiting. Round-4 lesson: wedges last hours and recovery windows are
+# precious — the agenda must fire the moment the tunnel returns, not when
+# a human notices. All probes/attempts are journaled in MEASURE_rNN.jsonl.
 cd "$(dirname "$0")/.."
-while true; do
-  if timeout 180 python -c "
-import jax, jax.numpy as jnp, sys
-x = jax.device_put(jnp.ones((1024, 1024)))
-(x @ x).block_until_ready()
-sys.exit(0 if jax.default_backend() == 'tpu' else 1)
-" 2>/dev/null; then
-    echo "[watch_tunnel] tunnel up at $(date -u +%H:%M:%S); running agenda"
-    python scripts/measure_all.py "$@"
-    exit $?
-  fi
-  sleep 180
-done
+exec python -m bench_tpu_fem.harness watch --interval 180 "$@"

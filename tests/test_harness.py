@@ -1,0 +1,682 @@
+"""Fault-injection suite for the measurement harness (CPU-only, fast,
+tier-1): the runner state machine under injected hang / crash / OOM /
+wedge-then-recover / gate-failure sequences, plus journal round-trip
+property tests. No jax computation anywhere — the harness parent is
+stdlib-only by design (a wedged PJRT client is unrecoverable in-process).
+
+The acceptance scenarios from the harness issue are each a named test:
+  - a SIGKILL'd agenda resumes from the journal skipping completed stages
+  - an injected wedge sequence backs off, re-probes, and completes the
+    remaining stages on recovery
+  - an injected dfacc failure gates df stages ACROSS a resume
+  - an injected OOM walks the halving ladder to its floor
+"""
+
+import json
+import os
+import random
+import string
+import sys
+
+import pytest
+
+from bench_tpu_fem.harness import classify as C
+from bench_tpu_fem.harness import faults as F
+from bench_tpu_fem.harness import journal as J
+from bench_tpu_fem.harness import policy as P
+from bench_tpu_fem.harness.runner import (
+    Runner,
+    Stage,
+    clean_tail,
+    last_json_line,
+    run_subprocess,
+)
+
+pytestmark = pytest.mark.harness
+
+
+def make_runner(stages, journal, script=None, probe_results=None,
+                **kw):
+    ex = F.FaultyExecutor(script or {})
+    probe = F.FlakyProbe(probe_results if probe_results is not None
+                         else [True])
+    sleep = F.FakeSleep()
+    r = Runner(stages, journal, probe=probe, sleep=sleep,
+               log=lambda m: None, exec_stage=ex, **kw)
+    return r, ex, probe, sleep
+
+
+def events(journal, kind=None):
+    recs = journal.records()
+    return [r for r in recs if kind is None or r.get("event") == kind]
+
+
+# -------------------------------------------------------------------------
+# classifier
+
+
+@pytest.mark.parametrize("rc,out,timed_out,expect", [
+    (0, "all fine", False, None),
+    (1, F.OOM_TEXT, False, "oom"),
+    (1, "RESOURCE_EXHAUSTED: oom", False, "oom"),
+    (1, F.MOSAIC_TEXT, False, "mosaic_reject"),
+    (1, "Mosaic says no", False, "mosaic_reject"),
+    (1, F.ACCURACY_TEXT, False, "accuracy_fail"),
+    (1, "AssertionError: df chunked lost f64 accuracy", False,
+     "accuracy_fail"),
+    (None, "", True, "timeout"),
+    (None, F.HANG_PARTIAL, True, "timeout"),
+    (None, F.WEDGE_TEXT, True, "tunnel_wedge"),
+    (1, "UNAVAILABLE: socket closed", False, "tunnel_wedge"),
+    (1, "device init/probe exceeded 180s", False, "tunnel_wedge"),
+    (1, "folded-df plan: degree 7 exceeds the df VMEM model", False,
+     "unsupported"),
+    (1, "Traceback ... ValueError: whatever", False, "transient"),
+    (-9, "killed", False, "transient"),
+    # spawn failure: rc None WITHOUT a timeout — the child never ran, so
+    # it's transient infrastructure (plain retry), NOT a timeout/wedge
+    (None, "spawn failed: [Errno 12] Cannot allocate memory", False,
+     "transient"),
+])
+def test_classify_taxonomy(rc, out, timed_out, expect):
+    assert C.classify(rc, out, timed_out=timed_out) == expect
+    if expect is not None:
+        assert expect in C.TAXONOMY
+
+
+def test_classify_exception():
+    assert C.classify_exception(MemoryError("big")) == "oom"
+    assert C.classify_exception(TimeoutError("slow")) == "timeout"
+    assert C.classify_exception(
+        RuntimeError("RESOURCE_EXHAUSTED: 12GB")) == "oom"
+    assert C.classify_exception(
+        ValueError("Mosaic lowering failed")) == "mosaic_reject"
+    assert C.classify_exception(ValueError("nope")) == "transient"
+
+
+def test_error_record_schema():
+    rec = J.error_record("boom", "tunnel_wedge", attempt=3)
+    # the bench JSON contract shape + the machine-readable class
+    assert rec["metric"] == J.BENCH_METRIC
+    assert rec["value"] == 0.0 and rec["vs_baseline"] == 0.0
+    assert rec["unit"] == "GDoF/s"
+    assert rec["error"] == "boom" and rec["attempt"] == 3
+    assert rec["failure_class"] == "tunnel_wedge"
+    with pytest.raises(ValueError):
+        J.error_record("boom", "not_a_class")
+
+
+# -------------------------------------------------------------------------
+# journal
+
+
+def test_journal_round_trip_property(tmp_path):
+    """Property test: random records of assorted shapes survive the
+    append/read round trip verbatim, in order, with monotonic seq."""
+    rng = random.Random(42)
+    path = str(tmp_path / "j.jsonl")
+    j = J.Journal(path)
+
+    def rand_value(depth=0):
+        kind = rng.randrange(6 if depth < 2 else 4)
+        if kind == 0:
+            return rng.randint(-10**9, 10**9)
+        if kind == 1:
+            return rng.random() * 1e6
+        if kind == 2:
+            return "".join(rng.choices(string.printable, k=rng.randrange(40)))
+        if kind == 3:
+            return rng.choice([None, True, False, "µ∂√ unicode ✓"])
+        if kind == 4:
+            return [rand_value(depth + 1) for _ in range(rng.randrange(4))]
+        return {f"k{i}": rand_value(depth + 1)
+                for i in range(rng.randrange(4))}
+
+    sent = []
+    for _ in range(60):
+        rec = {"event": "prop", "payload": rand_value()}
+        sent.append(json.loads(json.dumps(rec)))  # canonical form
+        j.append(rec)
+    got = j.records()
+    assert len(got) == len(sent)
+    assert [g["seq"] for g in got] == sorted(g["seq"] for g in got)
+    for g, s in zip(got, sent):
+        assert g["payload"] == s["payload"]
+        assert g["v"] == J.SCHEMA_VERSION and "ts" in g
+
+
+def test_journal_tolerates_torn_tail_and_reports_corruption(tmp_path):
+    path = str(tmp_path / "j.jsonl")
+    j = J.Journal(path)
+    j.append({"event": "attempt_end", "stage": "a", "outcome": "ok"})
+    with open(path, "a") as fh:
+        fh.write('{"event": "attempt_start", "stage": "b", "att')  # torn
+    recs, corrupt = J.read_records(path)
+    assert len(recs) == 1 and not corrupt  # torn FINAL line: the crash case
+    # corruption mid-file is surfaced, not dropped silently
+    with open(path, "a") as fh:
+        fh.write("\n???not json???\n")
+        fh.write(json.dumps({"event": "attempt_end", "stage": "c",
+                             "outcome": "ok"}) + "\n")
+    st = J.replay(path)
+    assert st.done("c") and len(st.corrupt) >= 1
+    # a fresh Journal on the same file continues the seq chain
+    j2 = J.Journal(path)
+    rec = j2.append({"event": "x"})
+    assert rec["seq"] > 0
+
+
+def test_journal_seq_monotonic_across_shared_writers(tmp_path):
+    """The agenda runner and bench.py's parent share one round journal
+    (BENCH_JOURNAL): interleaved appends from separate Journal instances
+    must keep seq ascending, not replay stale cached counters."""
+    path = str(tmp_path / "j.jsonl")
+    a, b = J.Journal(path), J.Journal(path)
+    a.append({"event": "x"})
+    b.append({"event": "y"})
+    a.append({"event": "z"})
+    b.append({"event": "w"})
+    seqs = [r["seq"] for r in a.records()]
+    assert seqs == sorted(seqs) and len(set(seqs)) == len(seqs), seqs
+
+
+def test_replay_later_records_win(tmp_path):
+    st = J.replay([
+        {"event": "attempt_end", "stage": "a", "outcome": "failed",
+         "failure_class": "transient"},
+        {"event": "gate", "gate": "dfacc", "ok": False},
+        {"event": "attempt_end", "stage": "a", "outcome": "ok"},
+        {"event": "gate", "gate": "dfacc", "ok": True},
+    ])
+    assert st.done("a") and st.gates["dfacc"] is True
+
+
+# -------------------------------------------------------------------------
+# policy
+
+
+def test_oom_ladder_sizes_and_floor():
+    lad = P.OomLadder(floor=25)
+    assert lad.next_size(100) == 50
+    assert lad.next_size(50) == 25
+    assert lad.next_size(25) is None  # below floor: exhausted
+    assert list(lad.sizes(100)) == [100, 50, 25]
+
+
+def test_next_action_table():
+    pol = P.StagePolicy(retry=P.RetryPolicy(max_attempts=3, backoff_s=10),
+                        oom_ladder=P.OomLadder(floor=50))
+    assert P.next_action("oom", 1, pol, size=100).kind == P.DEGRADE
+    assert P.next_action("oom", 1, pol, size=50).kind == P.GIVE_UP
+    assert P.next_action("oom", 1, P.StagePolicy(), size=None).kind \
+        == P.GIVE_UP  # no ladder opt-in
+    assert P.next_action("tunnel_wedge", 1, pol).kind == P.REPROBE
+    assert P.next_action("mosaic_reject", 1, pol).kind == P.GIVE_UP
+    assert P.next_action("accuracy_fail", 1, pol).kind == P.GIVE_UP
+    assert P.next_action("unsupported", 1, pol).kind == P.GIVE_UP
+    a = P.next_action("transient", 1, pol)
+    assert a.kind == P.RETRY and a.wait_s == 10
+    assert P.next_action("transient", 2, pol).wait_s == 20  # exponential
+    assert P.next_action("transient", 3, pol).kind == P.GIVE_UP  # budget
+
+
+# -------------------------------------------------------------------------
+# runner state machine under fault injection
+
+
+def test_wedge_backoff_reprobe_recover_completes_agenda(tmp_path):
+    """A mid-agenda hang whose re-probe fails is a wedge: the runner backs
+    off with growing waits, re-probes until the tunnel returns, re-runs
+    the stage and completes the REST of the agenda (instead of burning
+    every remaining stage's timeout into the wedge)."""
+    j = J.Journal(str(tmp_path / "j.jsonl"))
+    r, ex, probe, sleep = make_runner(
+        [Stage("a"), Stage("b"), Stage("c")], j,
+        script={"b": [F.hang()]},
+        probe_results=[False, False, True])
+    rc = r.run()
+    assert rc == 0
+    assert [c[0] for c in ex.calls] == ["a", "b", "b", "c"]
+    assert sleep.waits == [60.0, 120.0]  # exponential wedge backoff
+    ends = {(e["stage"], e["outcome"]) for e in events(j, "attempt_end")}
+    assert ("b", "ok") in ends and ("c", "ok") in ends
+    wedge = [e for e in events(j, "attempt_end")
+             if e.get("failure_class") == "tunnel_wedge"]
+    assert wedge and wedge[0]["stage"] == "b"
+
+
+def test_wedge_unrecovered_aborts_agenda_not_burns_stages(tmp_path):
+    j = J.Journal(str(tmp_path / "j.jsonl"))
+    pol = P.StagePolicy(wedge_max_probes=2)
+    r, ex, probe, sleep = make_runner(
+        [Stage("a", policy=pol), Stage("b", policy=pol)], j,
+        script={"a": [F.hang()]}, probe_results=[False])
+    rc = r.run()
+    assert rc == 1 and r.aborted == "tunnel_wedge"
+    # b never executed — its timeout was NOT burned into the wedge
+    assert [c[0] for c in ex.calls] == ["a"]
+    skips = events(j, "stage_skip")
+    assert skips and skips[0]["stage"] == "b"
+    assert "aborted" in skips[0]["reason"]
+
+
+def test_wedge_classified_but_tunnel_healthy_fails_stage_not_agenda(tmp_path):
+    """A stage whose failure text merely matches the wedge patterns (an
+    embedded gRPC UNAVAILABLE, say) while every probe answers must fail
+    TERMINALLY as a stage — not abort the agenda, which would send the
+    watch daemon into an endless re-arm loop."""
+    j = J.Journal(str(tmp_path / "j.jsonl"))
+    pol = P.StagePolicy(wedge_max_probes=2)
+    r, ex, probe, _ = make_runner(
+        [Stage("a", policy=pol), Stage("b", policy=pol)], j,
+        script={"a": [F.crash(out="UNAVAILABLE: socket closed")] * 10},
+        probe_results=[True])
+    rc = r.run()
+    assert rc == 1
+    assert r.aborted is None  # stage failed; agenda continued
+    assert [c[0] for c in ex.calls] == ["a", "a", "a", "b"]  # b still ran
+    ends = events(j, "attempt_end")
+    assert [e["stage"] for e in ends][-1] == "b"
+
+
+def test_check_rejected_success_still_classified(tmp_path):
+    """A stage whose check callback rejects an rc==0 run must still get a
+    failure_class (every journaled failure carries one) and the normal
+    retry policy."""
+    j = J.Journal(str(tmp_path / "j.jsonl"))
+    st = Stage("s", check=lambda rc, out: "THE_MARKER" in out,
+               policy=P.StagePolicy(retry=P.RetryPolicy(max_attempts=2,
+                                                        backoff_s=1)))
+    r, ex, _, sleep = make_runner(
+        [st], j, script={"s": [F.ok(out="no marker here")] * 2})
+    assert r.run() == 1
+    ends = events(j, "attempt_end")
+    assert len(ends) == 2 and sleep.waits == [1]  # transient: retried
+    assert all(e["failure_class"] == "transient" for e in ends)
+
+
+def test_sigkilled_agenda_resumes_skipping_completed(tmp_path):
+    """Run 1 completes stage a, then the harness process dies mid-stage-b
+    (attempt_start journaled, no attempt_end). Run 2 --resume skips a,
+    re-runs b, runs c."""
+    path = str(tmp_path / "j.jsonl")
+    j = J.Journal(path)
+    stages = [Stage("a"), Stage("b"), Stage("c")]
+    r, ex, _, _ = make_runner(stages, j,
+                              script={"b": [F.kill_harness()]})
+    with pytest.raises(F.Killed):
+        r.run()
+    st = J.replay(path)
+    assert st.done("a") and not st.done("b")
+    assert st.attempts["b"] == 1  # the dangling attempt_start survived
+
+    j2 = J.Journal(path)
+    r2, ex2, _, _ = make_runner(stages, j2)
+    rc = r2.run(resume=True)
+    assert rc == 0
+    assert [c[0] for c in ex2.calls] == ["b", "c"]  # a skipped via journal
+    skip = [e for e in events(j2, "stage_skip")
+            if e["reason"] == "already-completed"]
+    assert [e["stage"] for e in skip] == ["a"]
+
+
+def test_dfacc_gate_failure_gates_df_stages_across_resume(tmp_path):
+    """An injected dfacc accuracy failure (1) skips gated stages in the
+    same run, (2) persists in the journal, so a RESUMED agenda that does
+    not re-run dfacc still honors the FAIL instead of resetting the gate
+    to unknown."""
+    path = str(tmp_path / "j.jsonl")
+    gate_stage = Stage("dfacc", provides_gate="dfacc")
+    df = Stage("pertdf", requires_gate="dfacc")
+    j = J.Journal(path)
+    r, ex, _, _ = make_runner([gate_stage, df], j,
+                              script={"dfacc": [F.accuracy_fail()]})
+    rc = r.run()
+    assert rc == 1
+    assert [c[0] for c in ex.calls] == ["dfacc"]  # pertdf never ran
+    gates = events(j, "gate")
+    assert gates[-1] == {**gates[-1], "gate": "dfacc", "ok": False}
+    end = events(j, "attempt_end")[-1]
+    assert end["failure_class"] == "accuracy_fail"
+
+    # resume WITHOUT re-running dfacc: the persisted FAIL must still gate
+    j2 = J.Journal(path)
+    r2, ex2, _, _ = make_runner([df], j2)
+    r2.run(resume=True)
+    assert ex2.calls == []  # still gated
+    skip = events(j2, "stage_skip")[-1]
+    assert skip["reason"] == "gate-failed" and skip["gate"] == "dfacc"
+
+    # a re-run dfacc that now PASSES refreshes the gate and unblocks
+    j3 = J.Journal(path)
+    r3, ex3, _, _ = make_runner([gate_stage, df], j3)
+    rc = r3.run(resume=True)
+    assert rc == 0 and [c[0] for c in ex3.calls] == ["dfacc", "pertdf"]
+
+
+def test_dfacc_unknown_does_not_gate(tmp_path):
+    """Gate semantics match measure_all's dfacc_ok=None: unknown (gate
+    stage absent from the agenda, no journal record) means RUN."""
+    j = J.Journal(str(tmp_path / "j.jsonl"))
+    r, ex, _, _ = make_runner([Stage("pertdf", requires_gate="dfacc")], j)
+    assert r.run() == 0
+    assert [c[0] for c in ex.calls] == ["pertdf"]
+
+
+def test_oom_walks_halving_ladder_to_floor(tmp_path):
+    """An always-OOM stage with the ladder opt-in degrades 100 -> 50 ->
+    25 (the floor) and only then fails terminally, classified oom, with
+    every rung journaled."""
+    j = J.Journal(str(tmp_path / "j.jsonl"))
+    st = Stage("dflarge", size=100,
+               policy=P.StagePolicy(oom_ladder=P.OomLadder(floor=25)))
+    r, ex, _, _ = make_runner([st], j,
+                              script={"dflarge": [F.oom()] * 5})
+    rc = r.run()
+    assert rc == 1
+    assert [c[2] for c in ex.calls] == [100, 50, 25]  # to the floor, stop
+    ends = events(j, "attempt_end")
+    assert [e["size"] for e in ends] == [100, 50, 25]
+    assert all(e["failure_class"] == "oom" for e in ends)
+
+
+def test_oom_ladder_success_records_measured_size(tmp_path):
+    j = J.Journal(str(tmp_path / "j.jsonl"))
+    st = Stage("dflarge", size=100,
+               policy=P.StagePolicy(oom_ladder=P.OomLadder(floor=25)))
+    r, ex, _, _ = make_runner([st], j, script={"dflarge": [F.oom()]})
+    assert r.run() == 0
+    ok = [e for e in events(j, "attempt_end") if e["outcome"] == "ok"]
+    assert ok[0]["size"] == 50  # the size actually measured is evidence
+
+
+def test_ladder_rungs_do_not_consume_retry_budget(tmp_path):
+    """policy.next_action's contract: degradation rungs are learning, not
+    retries — a transient failure after an OOM degrade still gets its
+    full plain-retry budget."""
+    j = J.Journal(str(tmp_path / "j.jsonl"))
+    pol = P.StagePolicy(oom_ladder=P.OomLadder(floor=25),
+                        retry=P.RetryPolicy(max_attempts=2, backoff_s=1))
+    r, ex, _, sleep = make_runner(
+        [Stage("s", size=100, policy=pol)], j,
+        script={"s": [F.oom(), F.crash(), F.ok()]})
+    assert r.run() == 0
+    # oom degraded 100 -> 50; the transient at 50 still had its retry
+    assert [c[2] for c in ex.calls] == [100, 50, 50]
+    assert sleep.waits == [1]
+
+
+def test_oom_ladder_resumes_at_journaled_rung(tmp_path):
+    """A killed ladder walk resumes at the last attempted size: the rungs
+    above are journal-proven OOM and must not be re-burned."""
+    path = str(tmp_path / "j.jsonl")
+    st = Stage("dflarge", size=100,
+               policy=P.StagePolicy(oom_ladder=P.OomLadder(floor=25)))
+    j = J.Journal(path)
+    r, ex, _, _ = make_runner([st], j,
+                              script={"dflarge": [F.oom(),
+                                                  F.kill_harness()]})
+    with pytest.raises(F.Killed):
+        r.run()
+    j2 = J.Journal(path)
+    r2, ex2, _, _ = make_runner([st], j2)
+    assert r2.run(resume=True) == 0
+    assert ex2.calls[0][2] == 50  # not back at 100
+
+
+def test_timeout_keeps_partial_output_tail(tmp_path):
+    """Satellite: the TIMEOUT path must preserve the captured partial
+    output (where the stage hung is the evidence), not discard it."""
+    j = J.Journal(str(tmp_path / "j.jsonl"))
+    r, ex, probe, _ = make_runner(
+        [Stage("s", policy=P.StagePolicy(
+            retry=P.RetryPolicy(max_attempts=1)))], j,
+        script={"s": [F.hang(partial=F.HANG_PARTIAL)]},
+        probe_results=[True])  # tunnel answers: a real timeout
+    r.run()
+    end = events(j, "attempt_end")[0]
+    assert end["failure_class"] == "timeout" and end["timed_out"]
+    assert "Create matfree operator" in end["output_tail"]
+
+
+def test_run_subprocess_timeout_returns_partial_tail():
+    """The real subprocess runner: group-killed on timeout WITH the
+    partial output retained (the old measure_all._run returned only the
+    string 'TIMEOUT after Ns')."""
+    res = run_subprocess(
+        [sys.executable, "-u", "-c",
+         "print('BEFORE_THE_HANG', flush=True)\n"
+         "import time; time.sleep(60)"],
+        timeout_s=3.0)
+    assert res.timed_out and res.rc is None
+    assert "BEFORE_THE_HANG" in res.out
+    assert res.wall_s < 30
+
+
+def test_run_subprocess_ok_and_spawn_failure():
+    res = run_subprocess([sys.executable, "-c", "print('hi')"], 30.0)
+    assert res.rc == 0 and "hi" in res.out and not res.timed_out
+    res = run_subprocess(["/nonexistent-binary-xyz"], 5.0)
+    assert res.rc is None and "spawn failed" in res.out
+
+
+def test_transient_retries_then_gives_up(tmp_path):
+    j = J.Journal(str(tmp_path / "j.jsonl"))
+    pol = P.StagePolicy(retry=P.RetryPolicy(max_attempts=2, backoff_s=5))
+    r, ex, _, sleep = make_runner(
+        [Stage("s", policy=pol)], j,
+        script={"s": [F.crash(), F.crash()]})
+    assert r.run() == 1
+    assert [c[1] for c in ex.calls] == [1, 2]
+    assert sleep.waits == [5]
+    assert events(j, "attempt_end")[-1]["failure_class"] == "transient"
+
+
+def test_mosaic_reject_never_retried(tmp_path):
+    j = J.Journal(str(tmp_path / "j.jsonl"))
+    r, ex, _, sleep = make_runner(
+        [Stage("s")], j, script={"s": [F.mosaic_reject()]})
+    assert r.run() == 1
+    assert len(ex.calls) == 1 and sleep.waits == []
+
+
+def test_critical_stage_failure_aborts(tmp_path):
+    """health is critical: its terminal failure (here transient, probes
+    up) skips the rest of the agenda."""
+    j = J.Journal(str(tmp_path / "j.jsonl"))
+    pol = P.StagePolicy(retry=P.RetryPolicy(max_attempts=1))
+    r, ex, _, _ = make_runner(
+        [Stage("health", critical=True, policy=pol), Stage("b")], j,
+        script={"health": [F.crash()]})
+    assert r.run() == 1
+    assert [c[0] for c in ex.calls] == ["health"]
+
+
+# -------------------------------------------------------------------------
+# agenda construction (no subprocess runs — shape checks only)
+
+
+def test_round6_agenda_shape():
+    from bench_tpu_fem.harness import agenda as A
+
+    stages = A.make_stages("r99")
+    names = A.resolve_stage_names(A.AGENDAS["round6"], stages)
+    assert names[0] == "health" and stages["health"].critical
+    assert stages["dfacc"].provides_gate == "dfacc"
+    for df in ("pertdf", "dfeng", "dfunf", "dflarge100", "dflarge150",
+               "dfext2d"):
+        assert stages[df].requires_gate == "dfacc", df
+    # the ladder opt-in carries the measured-size floor
+    assert stages["dflarge100"].policy.oom_ladder.floor == 25_000_000
+    # measure_all composite names expand
+    assert A.resolve_stage_names(["dflarge"], stages) == [
+        "dflarge100", "dflarge150"]
+    with pytest.raises(SystemExit):
+        A.resolve_stage_names(["nonsense"], stages)
+    # ladder payloads interpolate the rung size
+    from bench_tpu_fem.harness.runner import StageContext
+
+    argv = stages["dflarge100"].command(StageContext(size=50_000_000))
+    assert "50000000" in argv[-1] and A._NDOFS not in argv[-1]
+    # round tag lands on the bench stage's journal env (evidence hygiene)
+    assert "r99" in stages["bench"].env["BENCH_JOURNAL"]
+    # ...and rides MEASURE_ROUND into child stages, so scripts a stage
+    # shells out to (probe_scoped_vmem) log into the same round's files
+    assert A.base_env("r99")["MEASURE_ROUND"] == "r99"
+
+
+def test_probe_requires_tpu_backend_unless_cpu_pinned(tmp_path):
+    """The tunnel probe must read a CPU FALLBACK as tunnel-down (a fast-
+    failing TPU client falls back to CPU; measuring there would journal
+    bogus hardware numbers) while an explicit JAX_PLATFORMS=cpu pin
+    (tests/dev) still probes ok. A stub jax (backend scripted via
+    STUB_JAX_BACKEND) keeps this subprocess test fast and hermetic —
+    real unpinned jax init may itself hang on a wedged tunnel."""
+    from bench_tpu_fem.harness import agenda as A
+
+    (tmp_path / "jax").mkdir()
+    (tmp_path / "jax" / "__init__.py").write_text(
+        "import os\n"
+        "from . import numpy\n"
+        "class _Arr:\n"
+        "    def __matmul__(self, other): return self\n"
+        "    def block_until_ready(self): return self\n"
+        "def device_put(x): return _Arr()\n"
+        "def default_backend():\n"
+        "    return os.environ.get('STUB_JAX_BACKEND', 'cpu')\n"
+        "def devices(): return [default_backend() + ':0']\n")
+    (tmp_path / "jax" / "numpy.py").write_text(
+        "def ones(shape): return None\n")
+    env = {k: v for k, v in os.environ.items() if k != "JAX_PLATFORMS"}
+    env["PYTHONPATH"] = str(tmp_path)
+
+    def probe(**overrides):
+        return run_subprocess([sys.executable, "-u", "-c", A.PROBE_CODE],
+                              60.0, env={**env, **overrides})
+
+    res = probe(STUB_JAX_BACKEND="cpu")
+    assert res.rc == 1 and "NOT TPU" in res.out  # fallback = down
+    res = probe(STUB_JAX_BACKEND="tpu")
+    assert res.rc == 0 and "TPU OK" in res.out
+    res = probe(STUB_JAX_BACKEND="cpu", JAX_PLATFORMS="cpu")
+    assert res.rc == 0  # pinned cpu = explicitly sanctioned
+
+
+def test_watch_named_stages_fresh_then_resume(tmp_path, monkeypatch):
+    """Named stages through the watch daemon measure FRESH on the first
+    pass (the measure_all by-name contract) and resume on wedge re-arms
+    (continuing this watch session's partial agenda)."""
+    from bench_tpu_fem.harness import agenda as A
+
+    monkeypatch.setattr(A, "probe_tunnel",
+                        lambda timeout_s=180.0: (True, "up"))
+    monkeypatch.setattr(A, "default_journal_path",
+                        lambda root, tag: str(tmp_path / f"{tag}.jsonl"))
+    monkeypatch.setattr(A, "make_log", lambda tag: lambda msg: None)
+    resumes = []
+    outcomes = iter(["tunnel_wedge", None])
+
+    class FakeRunner:
+        def run(self, resume=False):
+            resumes.append(resume)
+            self.aborted = next(outcomes)
+            return 1 if self.aborted else 0
+
+    monkeypatch.setattr(A, "build_runner", lambda *a, **k: FakeRunner())
+    rc = A.watch(stage_names=["pertdf"], round_tag="rtest2",
+                 interval_s=1.0, sleep=F.FakeSleep())
+    assert rc == 0 and resumes == [False, True]
+
+
+def test_watch_rearms_on_wedge(tmp_path, monkeypatch):
+    """The watch daemon: probe down -> sleep; probe up -> run agenda; a
+    wedge-aborted agenda re-arms instead of exiting."""
+    from bench_tpu_fem.harness import agenda as A
+
+    probes = iter([(False, "down"), (True, "up"), (True, "up")])
+    monkeypatch.setattr(A, "probe_tunnel", lambda timeout_s=180.0:
+                        next(probes))
+    monkeypatch.setattr(A, "default_journal_path",
+                        lambda root, tag: str(tmp_path / f"{tag}.jsonl"))
+    monkeypatch.setattr(A, "make_log", lambda tag: lambda msg: None)
+
+    outcomes = iter(["tunnel_wedge", None])
+    rcs = iter([1, 0])
+
+    class FakeRunner:
+        def __init__(self):
+            self.aborted = None
+
+        def run(self, resume=False):
+            assert resume  # watch must resume, never restart from scratch
+            self.aborted = next(outcomes)
+            return next(rcs)
+
+    monkeypatch.setattr(A, "build_runner",
+                        lambda *a, **k: FakeRunner())
+    sleep = F.FakeSleep()
+    rc = A.watch(round_tag="rtest", interval_s=7.0, sleep=sleep)
+    assert rc == 0
+    assert sleep.waits == [7.0, 7.0]  # down-sleep + wedge re-arm sleep
+
+
+def test_clean_tail_and_last_json_line():
+    out = ("WARNING: something\nPlatform 'axon' detected\nuseful 1\n"
+           '{"metric": "m", "value": 1.5}\n')
+    tail = clean_tail(out, 10)
+    assert "WARNING" not in tail and "axon" not in tail
+    assert "useful 1" in tail
+    assert last_json_line(out) == {"metric": "m", "value": 1.5}
+    assert last_json_line("no json here") is None
+
+
+# -------------------------------------------------------------------------
+# driver integration: every fallback record carries the taxonomy class
+
+
+def test_record_engine_stamps_failure_class():
+    from bench_tpu_fem.bench.driver import record_engine
+
+    extra = {}
+    record_engine(extra, False, error=ValueError(
+        "Mosaic lowering failed: block shape"))
+    assert extra["failure_class"] == "mosaic_reject"
+    assert "Mosaic" in extra["cg_engine_error"]
+    extra = {}
+    record_engine(extra, False, error="RESOURCE_EXHAUSTED: 12GiB on device")
+    assert extra["failure_class"] == "oom"
+    extra = {}
+    record_engine(extra, True, "one_kernel")  # success: no class stamped
+    assert "failure_class" not in extra and extra["cg_engine_form"] == \
+        "one_kernel"
+
+
+def test_df64_fallback_reason_carries_failure_class():
+    from bench_tpu_fem.harness.classify import classify_text
+
+    # the recorded-fallback reasons the drivers stamp (bench/driver
+    # _df64_emulated_fallback, dist/driver fallback) classify as the plan
+    # gate they are, not as faults
+    reason = ("folded-df plan: degree 7 qmode 0 exceeds the df VMEM model "
+              "(no 128-lane folded df kernel)")
+    assert classify_text(reason) == "unsupported"
+    assert classify_text("folded-df compile failed: ValueError: Mosaic "
+                         "never") == "mosaic_reject"
+
+
+# -------------------------------------------------------------------------
+# bench.py integration: the unified error-line schema
+
+
+def test_bench_error_line_carries_failure_class():
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    import bench
+
+    line = bench._error_line("could not fit problem: OOM", "oom")
+    assert line["failure_class"] == "oom"
+    assert line["metric"] == J.BENCH_METRIC and line["value"] == 0.0
+    # default classification derives the class from the message
+    line = bench._error_line(
+        "device init/probe exceeded 180s (TPU tunnel unavailable/wedged)")
+    assert line["failure_class"] == "tunnel_wedge"
