@@ -162,6 +162,11 @@ def run(ndofs: int) -> dict:
         "nreps": NREPS,
         "cg_wall_s": round(res.mat_free_time, 3),
         "f64_gdof_per_s_per_chip": f64,
+        # The static analyzer's per-rule verdict (analysis.verdict reads
+        # the report CI produced; {"available": false} when none exists)
+        # — every benchmark artifact answers "did static analysis
+        # predict this?" without a second lookup.
+        "static_analysis": _static_analysis_verdict(),
     }
     if f64_err is not None:
         out["f64_error"] = f64_err
@@ -176,15 +181,27 @@ def run(ndofs: int) -> dict:
     return out
 
 
+def _static_analysis_verdict() -> dict:
+    from bench_tpu_fem.analysis.verdict import static_analysis_verdict
+
+    return static_analysis_verdict()
+
+
 def _error_line(msg: str, failure_class: str | None = None) -> dict:
     """The bench JSON contract's failure line: the harness's unified
     error-record schema (journal.error_record), so every bench.py failure
     artifact carries a machine-readable ``failure_class`` from the shared
-    taxonomy — auditable with one grep, like ``cg_engine_form``."""
+    taxonomy — auditable with one grep, like ``cg_engine_form``. Mosaic
+    rejections and OOMs — the classes static analysis models — also
+    carry the analyzer's verdict (did it predict this?)."""
     from bench_tpu_fem.harness.classify import classify_text
     from bench_tpu_fem.harness.journal import error_record
 
-    return error_record(msg, failure_class or classify_text(msg))
+    fc = failure_class or classify_text(msg)
+    rec = error_record(msg, fc)
+    if fc in ("mosaic_reject", "oom"):
+        rec["static_analysis"] = _static_analysis_verdict()
+    return rec
 
 
 def _probe_devices(timeout_s: int = 180):

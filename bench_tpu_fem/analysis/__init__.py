@@ -1,0 +1,57 @@
+"""Static-analysis subsystem: CPU-provable hardware-compile safety.
+
+The framework's recurring fatal failure class is "interpret mode accepted
+it, Mosaic rejected it on the chip": round 4 shipped kernels with
+BlockSpec tiling violations that every CPU parity test passed, and round 5
+produced zero hardware numbers because the TPU tunnel was wedged all
+session — for long stretches, static analysis on CPU is the only line of
+defense between a green tier-1 suite and a silent unfused fallback on
+hardware.
+
+This package intercepts every ``pl.pallas_call`` issued by every shipped
+kernel configuration (``capture``), and runs a pluggable rule engine
+(``rules``) over the captures:
+
+  R1  Mosaic tiling divisibility per dtype (8x128 f32 / 16x128 bf16 /
+      32x128 int8, or equal-to-array) on every BlockSpec.
+  R2  Per-kernel VMEM accounting: sum operand/out blocks + scratch from
+      the captured specs and cross-check against the plan estimators.
+  R3  f64-leak detection: no float64 operand, out_shape or jaxpr
+      intermediate may reach a pallas_call.
+  R4  Jaxpr walk flagging primitives with no Mosaic lowering.
+  R5  shard_map consistency: collective axis names must exist in the
+      mesh and match the halo layout's declared axes.
+
+``configs`` drives the full shipped-config matrix (every engine form x
+geometry mode x df/f32 x single-chip/sharded) at TRACE time only — no
+kernel executes, so the whole matrix runs on CPU in seconds.
+``fixtures`` is the known-bad regression corpus (including the exact
+round-4 tiling bug); the analyzer must flag every fixture and pass every
+shipped kernel. ``python -m bench_tpu_fem.analysis`` emits a
+machine-readable JSON report with one record per kernel instance per
+rule; ``verdict`` folds that report into bench artifacts.
+"""
+
+ANALYZER_VERSION = "1.0"
+
+_LAZY = {
+    "capture": ".capture",
+    "budgets": ".budgets",
+    "rules": ".rules",
+    "configs": ".configs",
+    "fixtures": ".fixtures",
+    "verdict": ".verdict",
+}
+
+
+def __getattr__(name):
+    # Submodules that import ops/dist are loaded lazily so that
+    # `from bench_tpu_fem.analysis.budgets import ...` inside ops modules
+    # cannot create an import cycle.
+    if name in _LAZY:
+        import importlib
+
+        mod = importlib.import_module(_LAZY[name], __name__)
+        globals()[name] = mod
+        return mod
+    raise AttributeError(name)

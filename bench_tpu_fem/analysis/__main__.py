@@ -1,0 +1,170 @@
+"""CLI: drive the full shipped-config matrix through the rule engine and
+emit a machine-readable JSON report (one record per kernel instance per
+rule).
+
+    python -m bench_tpu_fem.analysis                 # full matrix
+    python -m bench_tpu_fem.analysis --configs kron  # name filter
+    python -m bench_tpu_fem.analysis --corpus        # + known-bad corpus
+    python -m bench_tpu_fem.analysis --json ANALYSIS.json
+    python -m bench_tpu_fem.analysis --list
+
+Exit code 0 = zero violations on shipped kernels AND (with --corpus)
+100% of the known-bad fixtures flagged; 1 otherwise. Runs on CPU in
+seconds: every drive is trace-only (jax.eval_shape / make_jaxpr), no
+kernel executes. bench.py picks the report up via BENCH_ANALYSIS_REPORT
+(default ./ANALYSIS.json) and stamps the per-rule verdict into its JSON
+artifact (analysis.verdict).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def main(argv=None) -> int:
+    # Must precede any jax backend init: the dist configs need 8 virtual
+    # CPU devices, and the axon tunnel hook must be unhooked (hermetic).
+    from bench_tpu_fem.utils.hermetic import force_host_cpu_devices
+
+    force_host_cpu_devices(8)
+    import jax
+
+    # x64 on, deliberately: R3 (f64-leak) must see any f64 the host code
+    # would feed a kernel at full precision, not a silently downcast f32.
+    jax.config.update("jax_enable_x64", True)
+
+    ap = argparse.ArgumentParser(prog="python -m bench_tpu_fem.analysis")
+    ap.add_argument("--configs", default="", metavar="SUBSTR",
+                    help="only drive configs whose name contains SUBSTR")
+    ap.add_argument("--rules", default="", metavar="R1,R2,...",
+                    help="comma-separated rule subset (default: all)")
+    ap.add_argument("--corpus", action="store_true",
+                    help="also run the known-bad corpus and fail unless "
+                         "every fixture is flagged")
+    ap.add_argument("--json", default="", metavar="PATH",
+                    help="write the JSON report here (default: stdout "
+                         "summary only)")
+    ap.add_argument("--list", action="store_true",
+                    help="list config names and exit")
+    args = ap.parse_args(argv)
+
+    from bench_tpu_fem.analysis import ANALYZER_VERSION
+    from bench_tpu_fem.analysis.configs import SHIPPED_CONFIGS
+    from bench_tpu_fem.analysis.rules import RULE_IDS, run_rules, summarize
+
+    if args.list:
+        for c in SHIPPED_CONFIGS:
+            print(c.name)
+        return 0
+
+    rules = tuple(r for r in args.rules.split(",") if r) or RULE_IDS
+    unknown_rules = [r for r in rules if r not in RULE_IDS]
+    if unknown_rules:
+        # A typo'd rule name must not silently disable the lane and
+        # report green — fail loudly instead.
+        ap.error(f"unknown rules {unknown_rules}; valid: {list(RULE_IDS)}")
+    t0 = time.monotonic()
+    all_records = []
+    config_reports = []
+    ndev = len(jax.devices())
+    for spec in SHIPPED_CONFIGS:
+        if args.configs and args.configs not in spec.name:
+            continue
+        if spec.min_devices > ndev:
+            config_reports.append({"name": spec.name, "skipped":
+                                   f"needs {spec.min_devices} devices"})
+            continue
+        tc = time.monotonic()
+        try:
+            result = spec.drive()
+            records = run_rules(result, rules)
+        except Exception as exc:  # a broken drive is itself a violation:
+            # the matrix exists to prove these kernels still trace
+            from bench_tpu_fem.analysis.rules import Record
+
+            records = [Record(spec.name, "drive", None, "fail",
+                              {"error": f"{type(exc).__name__}: {exc}"[:500]})]
+            result = None
+        all_records.extend(records)
+        config_reports.append({
+            "name": spec.name,
+            "tags": result.tags if result is not None else {},
+            "kernels": ([c.name for c in result.captures]
+                        if result is not None else []),
+            "plan_unsupported": (result.plan_unsupported
+                                 if result is not None else None),
+            "seconds": round(time.monotonic() - tc, 2),
+            "records": [_rec_json(r) for r in records],
+        })
+        bad = sum(1 for r in records if r.status == "fail")
+        print(f"# {spec.name}: {len(records)} records, {bad} violations",
+              flush=True)
+
+    corpus_report = None
+    if args.corpus:
+        from bench_tpu_fem.analysis.fixtures import run_corpus
+
+        corpus_records, missed = run_corpus()
+        corpus_report = {
+            "fixtures_flagged": not missed,
+            "missed": missed,
+            "records": [_rec_json(r) for r in corpus_records],
+        }
+        print(f"# corpus: {'all flagged' if not missed else missed}",
+              flush=True)
+
+    summary = summarize(all_records)
+    summary["wall_s"] = round(time.monotonic() - t0, 2)
+    report = {
+        "analyzer_version": ANALYZER_VERSION,
+        # What tree this verdict is ABOUT: a stale committed report must
+        # be detectable when bench artifacts stamp it (verdict.py
+        # forwards this block), or "static analysis did not predict
+        # this" becomes unanswerable.
+        "source": _source_identity(),
+        "rules": list(rules),
+        "summary": summary,
+        "configs": config_reports,
+        **({"corpus": corpus_report} if corpus_report is not None else {}),
+    }
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(report, f, indent=1)
+        print(f"# report -> {args.json}")
+    print(json.dumps({"analyzer_version": ANALYZER_VERSION, **summary}))
+    ok = summary["violations"] == 0 and (
+        corpus_report is None or corpus_report["fixtures_flagged"])
+    return 0 if ok else 1
+
+
+def _source_identity() -> dict:
+    import os
+    import subprocess
+
+    ident = {"generated_at":
+             time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())}
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    try:
+        rev = subprocess.run(["git", "rev-parse", "HEAD"], cwd=repo,
+                             capture_output=True, text=True, timeout=10)
+        dirty = subprocess.run(["git", "status", "--porcelain"], cwd=repo,
+                               capture_output=True, text=True, timeout=10)
+        if rev.returncode == 0:
+            ident["git_rev"] = rev.stdout.strip()
+            ident["git_dirty"] = bool(dirty.stdout.strip())
+    except Exception:
+        pass  # not a git checkout (pip install): timestamp still stamps
+    return ident
+
+
+def _rec_json(r) -> dict:
+    return {"config": r.config, "rule": r.rule, "kernel": r.kernel,
+            "status": r.status, "detail": r.detail}
+
+
+if __name__ == "__main__":
+    sys.exit(main())

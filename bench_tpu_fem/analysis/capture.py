@@ -1,0 +1,248 @@
+"""Library form of the Mosaic spec recorder (grown out of
+tests/test_mosaic_specs.py): intercept every ``pl.pallas_call`` issued
+while a session is active and capture, per kernel instance, the block
+specs, grid, scratch shapes, out shapes, operand avals and everything
+needed to re-derive the kernel's closed jaxpr — the raw material the
+rule engine (rules.R1-R4) runs over.
+
+Interception is by swapping the ``pallas_call`` attribute on the
+``jax.experimental.pallas`` module: every kernel module holds that module
+by reference (``from jax.experimental import pallas as pl``), so one
+patch reaches every shipped call site — which is also why the lint ban
+(pyproject TID251) keeps raw ``pl.pallas_call`` out of code outside
+``ops/``/``dist/``: a kernel issued elsewhere would dodge this recorder.
+
+Capture happens at TRACE time (the wrapper runs when the surrounding
+jit/shard_map traces), so driving a config through ``jax.eval_shape`` or
+``jax.make_jaxpr`` records every spec without executing a single kernel
+— the whole shipped matrix analyzes on CPU in seconds where the old
+interpret-mode drive took minutes.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Callable
+
+import jax
+import numpy as np
+from jax.experimental import pallas as pl
+
+# The unpatched pallas_call, for re-issuing a captured kernel during
+# jaxpr extraction (a session may or may not be active by then).
+_ORIG_PALLAS_CALL = pl.pallas_call
+
+
+@dataclass
+class SpecRecord:
+    """One operand/output of one pallas_call: its BlockSpec block shape
+    against the bound array's shape and dtype."""
+
+    io: str  # "in" | "out"
+    idx: int
+    block_shape: tuple | None  # None = no BlockSpec (whole array)
+    arr_shape: tuple
+    dtype: str
+
+
+@dataclass
+class KernelCapture:
+    """Everything recorded for one pallas_call instance."""
+
+    name: str
+    call_index: int
+    grid: tuple
+    specs: list[SpecRecord]
+    operand_avals: list[tuple[tuple, str]]  # (shape, dtype name)
+    out_avals: list[tuple[tuple, str]]
+    scratch: list[tuple[tuple, str]]  # (shape, dtype name) per VMEM scratch
+    kernel_fn: Callable | None = None
+    kw: dict | None = None
+    _jaxpr: Any = field(default=None, repr=False)
+    jaxpr_error: str | None = None  # re-derivation failure, surfaced by R4
+
+    def kernel_jaxpr(self):
+        """The kernel body's jaxpr, extracted by re-tracing the captured
+        pallas_call against the captured operand avals (abstract only —
+        nothing executes) and pulling the ``jaxpr`` param off the
+        pallas_call equation. Cached; None when the capture was built
+        by hand (fixture records) or re-tracing fails."""
+        if self._jaxpr is not None or self.kernel_fn is None:
+            return self._jaxpr
+        try:
+            args = [jax.ShapeDtypeStruct(s, np.dtype(d))
+                    for s, d in self.operand_avals]
+            closed = jax.make_jaxpr(
+                _ORIG_PALLAS_CALL(self.kernel_fn, **self.kw))(*args)
+            for eqn in closed.jaxpr.eqns:
+                if eqn.primitive.name == "pallas_call":
+                    self._jaxpr = eqn.params["jaxpr"]
+                    break
+        except Exception as exc:
+            self.jaxpr_error = f"{type(exc).__name__}: {exc}"[:300]
+            return None
+        return self._jaxpr
+
+
+@dataclass
+class CollectiveUse:
+    """One collective equation found in a sharded apply's jaxpr (rule
+    R5's input): which primitive, which axis names it binds, against
+    which mesh axes and which axes the halo layout declares."""
+
+    prim: str
+    axes: tuple[str, ...]
+    mesh_axes: tuple[str, ...]
+    declared_axes: tuple[str, ...]
+
+
+def _aval(x) -> tuple[tuple, str]:
+    shape = tuple(getattr(x, "shape", np.shape(x)))
+    dtype = getattr(x, "dtype", None)
+    if dtype is None:
+        dtype = np.asarray(x).dtype
+    return shape, np.dtype(dtype).name
+
+
+def _spec_block(spec) -> tuple | None:
+    if spec is None:
+        return None
+    bs = getattr(spec, "block_shape", None)
+    return None if bs is None else tuple(bs)
+
+
+def _as_list(x) -> list:
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+class CaptureSession:
+    """Context manager that records every pallas_call issued while
+    active. Nesting is not needed anywhere and not supported."""
+
+    def __init__(self):
+        self.kernels: list[KernelCapture] = []
+        self._orig = None
+
+    # -- patching -----------------------------------------------------------
+    def __enter__(self):
+        self._orig = pl.pallas_call
+        orig = self._orig
+
+        def recording_pallas_call(kernel, *args, **kw):
+            # Normalize the one positional-or-keyword parameter
+            # (out_shape) into kw, so the capture sees it and the
+            # jaxpr re-derivation can re-issue the call verbatim — a
+            # positionally-written call site must not silently
+            # under-capture.
+            if args:
+                kw = dict(kw)
+                kw.setdefault("out_shape", args[0])
+                if len(args) > 1:
+                    raise TypeError(
+                        "pallas_call with >1 positional argument is not "
+                        "capturable; pass keyword arguments")
+            fn = orig(kernel, **kw)
+
+            def traced(*operands):
+                self.kernels.append(self._capture(kernel, kw, operands))
+                return fn(*operands)
+
+            return traced
+
+        pl.pallas_call = recording_pallas_call
+        return self
+
+    def __exit__(self, *exc):
+        pl.pallas_call = self._orig
+        return False
+
+    # -- record building ----------------------------------------------------
+    def _capture(self, kernel, kw, operands) -> KernelCapture:
+        # Kernel bodies are factory closures, so the bare __name__ is
+        # always "kernel"; the qualname's enclosing factory is the
+        # readable identity (e.g. "_make_cg_apply_kernel.kernel").
+        name = getattr(kernel, "__qualname__",
+                       getattr(kernel, "__name__", str(kernel)))
+        name = name.replace(".<locals>", "")
+        specs: list[SpecRecord] = []
+        in_specs = kw.get("in_specs")
+        if in_specs is not None:
+            for i, (s, a) in enumerate(zip(_as_list(in_specs), operands)):
+                shape, dt = _aval(a)
+                specs.append(SpecRecord("in", i, _spec_block(s), shape, dt))
+        out_shape = _as_list(kw.get("out_shape"))
+        out_specs = kw.get("out_specs")
+        if out_specs is not None:
+            for i, (s, a) in enumerate(zip(_as_list(out_specs), out_shape)):
+                shape, dt = _aval(a)
+                specs.append(SpecRecord("out", i, _spec_block(s), shape, dt))
+        scratch = []
+        for s in _as_list(kw.get("scratch_shapes") or []):
+            shape = tuple(getattr(s, "shape", ()))
+            dt = np.dtype(getattr(s, "dtype", np.float32)).name
+            scratch.append((shape, dt))
+        grid = kw.get("grid", ())
+        grid = tuple(grid) if isinstance(grid, (list, tuple)) else (grid,)
+        return KernelCapture(
+            name=name,
+            call_index=len(self.kernels),
+            grid=grid,
+            specs=specs,
+            operand_avals=[_aval(a) for a in operands],
+            out_avals=[_aval(a) for a in out_shape if a is not None],
+            scratch=scratch,
+            kernel_fn=kernel,
+            kw=dict(kw),
+        )
+
+
+# ---------------------------------------------------------------------------
+# Collective capture (rule R5)
+# ---------------------------------------------------------------------------
+
+# Primitives whose params bind mesh axis names.
+_COLLECTIVE_PRIMS = {
+    "ppermute", "psum", "psum2", "all_gather", "all_to_all", "pmax",
+    "pmin", "axis_index", "reduce_scatter",
+}
+
+
+def _axis_names(params: dict) -> tuple[str, ...]:
+    names: list[str] = []
+    for key in ("axis_name", "axes", "axis_names"):
+        v = params.get(key)
+        if v is None:
+            continue
+        for a in v if isinstance(v, (tuple, list)) else (v,):
+            if isinstance(a, str):
+                names.append(a)
+    return tuple(names)
+
+
+def _walk_jaxpr(jaxpr, found: list):
+    import jax.core as jc
+
+    for eqn in jaxpr.eqns:
+        if eqn.primitive.name in _COLLECTIVE_PRIMS:
+            found.append((eqn.primitive.name, _axis_names(eqn.params)))
+        for v in eqn.params.values():
+            if isinstance(v, jc.ClosedJaxpr):
+                _walk_jaxpr(v.jaxpr, found)
+            elif isinstance(v, jc.Jaxpr):
+                _walk_jaxpr(v, found)
+            elif isinstance(v, (tuple, list)):
+                for w in v:
+                    if isinstance(w, (jc.ClosedJaxpr, jc.Jaxpr)):
+                        _walk_jaxpr(getattr(w, "jaxpr", w), found)
+
+
+def trace_collectives(fn, *args, mesh_axes: tuple[str, ...],
+                      declared_axes: tuple[str, ...]) -> list[CollectiveUse]:
+    """Trace ``fn(*args)`` (abstract, nothing executes) and collect every
+    collective equation with the axis names it binds, tagged with the
+    mesh's axes and the halo layout's declared axes for rules.R5."""
+    closed = jax.make_jaxpr(fn)(*args)
+    found: list[tuple[str, tuple[str, ...]]] = []
+    _walk_jaxpr(closed.jaxpr, found)
+    return [CollectiveUse(prim, axes, tuple(mesh_axes), tuple(declared_axes))
+            for prim, axes in found]

@@ -1,0 +1,181 @@
+"""Known-bad regression corpus: one synthetic violation per rule R1-R5
+plus the EXACT round-4 Mosaic rejection, reproduced from the kernels the
+round-4 fused kron CG engine shipped — the (1, 2nb)-over-(NX, 2nb)
+coefficient stream every CPU parity test passed and Mosaic rejected on
+the chip ("the last two dimensions of your block shape are divisible by
+8 and 128 respectively, or be equal to the respective dimensions of the
+overall array").
+
+The analyzer must flag 100% of this corpus while passing every shipped
+kernel; the corpus runs in CI (``python -m bench_tpu_fem.analysis
+--corpus``) and in tests/test_analysis.py, so a rule that silently stops
+firing fails the lane the same way a kernel regression does.
+
+Fixtures that a CPU trace can express (R1, R2, R4) really issue
+pallas_calls under a CaptureSession; the two a trace CANNOT express
+(R3's f64 operand without global x64 side effects, R5's unbound axis
+name — shard_map refuses to trace one) are hand-built capture records,
+which is legitimate: the rule engine's contract is the capture schema,
+not the tracer.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .capture import CaptureSession, CollectiveUse, KernelCapture, SpecRecord
+from .rules import (
+    ConfigResult,
+    PlanCheck,
+    Record,
+    run_rules,
+)
+
+
+def _trace_fixture_kernel(name, kernel, in_specs, out_specs, out_shape,
+                          grid, operands) -> ConfigResult:
+    import jax
+    from jax.experimental import pallas as pl
+
+    with CaptureSession() as s:
+        fn = pl.pallas_call(kernel, grid=grid, in_specs=in_specs,
+                            out_specs=out_specs, out_shape=out_shape,
+                            interpret=True)
+        jax.eval_shape(fn, *operands)
+    return ConfigResult(name, {"fixture": True}, s.kernels)
+
+
+def fixture_r1_round4() -> tuple[str, ConfigResult]:
+    """The round-4 bug, verbatim: the fused kron engine streamed its
+    banded coefficient tables as (1, 2nb)-over-(NX, 2nb) and
+    (nb, CY)-over-(nb, NYB*CY) blocks — block rows of 1 (neither 8-divisible
+    nor the full NX) and block lanes of CY=64 (neither 128-divisible nor
+    the full NYB*CY)."""
+    import jax
+    from jax.experimental import pallas as pl
+
+    nb, NX, NYB, CY = 7, 34, 3, 64
+
+    def kernel(c_ref, y_ref, o_ref):
+        import jax.numpy as jnp
+
+        o_ref[...] = c_ref[...] + jnp.sum(y_ref[...])
+
+    in_specs = [
+        pl.BlockSpec((1, 2 * nb), lambda i: (i, 0)),
+        pl.BlockSpec((nb, CY), lambda i: (0, i)),
+    ]
+    out_specs = pl.BlockSpec((1, 2 * nb), lambda i: (i, 0))
+    out_shape = jax.ShapeDtypeStruct((NX, 2 * nb), np.float32)
+    operands = (jax.ShapeDtypeStruct((NX, 2 * nb), np.dtype("float32")),
+                jax.ShapeDtypeStruct((nb, NYB * CY), np.dtype("float32")))
+    return "R1", _trace_fixture_kernel(
+        "fixture_r1_round4_coeff_stream", kernel, in_specs, out_specs,
+        out_shape, (NX,), operands)
+
+
+def fixture_r1_bf16() -> tuple[str, ConfigResult]:
+    """Dtype-awareness: an (8, 128) block is legal for f32 but NOT for
+    bf16, whose sublane quantum is 16 — the rule must flag it."""
+    import jax
+    from jax.experimental import pallas as pl
+
+    def kernel(x_ref, o_ref):
+        o_ref[...] = x_ref[...]
+
+    spec = pl.BlockSpec((8, 128), lambda i: (i, 0))
+    out_shape = jax.ShapeDtypeStruct((64, 128), np.dtype("bfloat16"))
+    operands = (jax.ShapeDtypeStruct((64, 128), np.dtype("bfloat16")),)
+    return "R1", _trace_fixture_kernel(
+        "fixture_r1_bf16_sublane", kernel, [spec], spec, out_shape,
+        (8,), operands)
+
+
+def fixture_r2_overbudget() -> tuple[str, ConfigResult]:
+    """A kernel whose spec-accounted footprint (two double-buffered
+    24 MiB blocks) exceeds the default 16 MiB scoped limit AND whose
+    claimed plan estimate (1 MiB) undershoots it — both R2 checks must
+    fire."""
+    import jax
+    from jax.experimental import pallas as pl
+
+    def kernel(x_ref, o_ref):
+        o_ref[...] = x_ref[...]
+
+    spec = pl.BlockSpec((2048, 3072), lambda i: (i, 0))
+    out_shape = jax.ShapeDtypeStruct((4096, 3072), np.float32)
+    operands = (jax.ShapeDtypeStruct((4096, 3072), np.dtype("float32")),)
+    res = _trace_fixture_kernel(
+        "fixture_r2_overbudget", kernel, [spec], spec, out_shape,
+        (2,), operands)
+    res.plan = PlanCheck("fixture.bogus_estimator", 1 * 2**20)
+    return "R2", res
+
+
+def fixture_r3_f64() -> tuple[str, ConfigResult]:
+    """An f64 operand reaching a pallas_call (hand-built capture: real
+    f64 arrays need global x64 state the analyzer must not toggle)."""
+    cap = KernelCapture(
+        name="fixture_r3_f64_operand", call_index=0, grid=(4,),
+        specs=[SpecRecord("in", 0, (1, 8, 128), (4, 8, 128), "float64")],
+        operand_avals=[((4, 8, 128), "float64")],
+        out_avals=[((4, 8, 128), "float32")], scratch=[])
+    return "R3", ConfigResult("fixture_r3_f64", {"fixture": True}, [cap])
+
+
+def fixture_r4_unlowerable() -> tuple[str, ConfigResult]:
+    """A kernel body containing a primitive Mosaic can never lower (an
+    FFT) — the jaxpr walk must flag it."""
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import pallas as pl
+
+    def kernel(x_ref, o_ref):
+        o_ref[...] = jnp.real(
+            jnp.fft.fft(x_ref[...].astype(jnp.complex64))
+        ).astype(jnp.float32)
+
+    spec = pl.BlockSpec((8, 128), lambda i: (i, 0))
+    out_shape = jax.ShapeDtypeStruct((8, 128), np.float32)
+    operands = (jax.ShapeDtypeStruct((8, 128), np.dtype("float32")),)
+    return "R4", _trace_fixture_kernel(
+        "fixture_r4_fft", kernel, [spec], spec, out_shape, (1,), operands)
+
+
+def fixture_r5_bogus_axis() -> tuple[str, ConfigResult]:
+    """A collective bound to an axis name that exists in neither the
+    device mesh nor the halo layout's declared axes (hand-built:
+    shard_map refuses to even trace an unbound axis name, which is
+    exactly why drift arrives via renames — a kernel binding 'x' after
+    the mesh was renamed to 'dx' traces fine against ITS mesh and
+    deadlocks against ours)."""
+    use = CollectiveUse(prim="ppermute", axes=("x",),
+                        mesh_axes=("dx", "dy", "dz"),
+                        declared_axes=("dx", "dy", "dz"))
+    return "R5", ConfigResult("fixture_r5_bogus_axis", {"fixture": True},
+                              [], collectives=[use])
+
+
+CORPUS = (
+    fixture_r1_round4,
+    fixture_r1_bf16,
+    fixture_r2_overbudget,
+    fixture_r3_f64,
+    fixture_r4_unlowerable,
+    fixture_r5_bogus_axis,
+)
+
+
+def run_corpus() -> tuple[list[Record], list[str]]:
+    """Run the rule engine over every known-bad fixture. Returns (all
+    records, names of fixtures the engine FAILED to flag on the targeted
+    rule — must be empty)."""
+    records: list[Record] = []
+    missed: list[str] = []
+    for fx in CORPUS:
+        rule, result = fx()
+        recs = run_rules(result)
+        records.extend(recs)
+        if not any(r.rule == rule and r.status == "fail" for r in recs):
+            missed.append(f"{result.name} (expected {rule} violation)")
+    return records, missed

@@ -1,0 +1,425 @@
+"""The pluggable rule engine over pallas_call captures.
+
+Five rules, one record per kernel instance per rule (plus config-level
+records for the plan cross-check and the collective-axis check):
+
+  R1 tiling      Mosaic BlockSpec divisibility, dtype-aware: the last two
+                 block dims must each be divisible by (sublane, 128) —
+                 sublane 8 for 4-byte, 16 for 2-byte, 32 for 1-byte
+                 dtypes — or equal the full array dim (rank-1: lane only).
+                 This is the rule the round-4 kernels violated.
+  R2 vmem        Per-kernel VMEM accounting from the CAPTURED specs
+                 (blocked operands/outputs double-buffered by the Mosaic
+                 pipeline, whole-array operands and scratch single), with
+                 two checks: no kernel's accounted footprint may exceed
+                 the scoped-VMEM limit its config compiles under, and the
+                 config's plan estimator may not undershoot the accounted
+                 footprint by more than 10% (estimates are upper-bound
+                 models — an undershoot means the plan admits kernels
+                 Mosaic will reject) unless a tracked waiver
+                 (budgets.R2_WAIVERS) documents why.
+  R3 f64         No float64 operand, out_shape, scratch or kernel-jaxpr
+                 intermediate may reach a pallas_call: Mosaic has no f64,
+                 and the df32 pipeline exists precisely so f64 never hits
+                 the TPU.
+  R4 lowering    Walk the kernel's closed jaxpr and flag primitives with
+                 no Mosaic lowering: a hard denylist (fft/sort/linalg/
+                 conv — never lowerable) plus, when this jax build
+                 exposes the Mosaic lowering registry, any primitive
+                 absent from it.
+  R5 collectives shard_map consistency: every ppermute/psum axis name a
+                 dist kernel binds must exist in the device mesh AND in
+                 the halo layout's declared axes.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass, field
+
+from . import ANALYZER_VERSION  # noqa: F401  (re-exported with the engine)
+from .budgets import R2_WAIVERS, scoped_limit_bytes
+from .capture import CollectiveUse, KernelCapture
+
+RULE_IDS = ("R1", "R2", "R3", "R4", "R5")
+
+# Estimate may exceed the spec-accounted footprint freely (models include
+# live values the specs cannot see); it may undershoot by at most this.
+R2_TOLERANCE = 0.10
+
+
+@dataclass
+class PlanCheck:
+    """What the config's plan function claimed: which estimator, its
+    estimate (None = the estimator does not model this form, e.g. the
+    chunked retry path), and the scoped-VMEM limit the config compiles
+    under (budgets.scoped_limit_bytes of the plan's kib request)."""
+
+    estimator: str
+    estimate_bytes: int | None
+    scoped_limit: int = scoped_limit_bytes(None)
+    notes: str = ""
+
+
+@dataclass
+class ConfigResult:
+    """One driven shipped-config instance: its captures plus the plan
+    claim and collective uses the rules cross-check."""
+
+    name: str
+    tags: dict = field(default_factory=dict)
+    captures: list[KernelCapture] = field(default_factory=list)
+    collectives: list[CollectiveUse] = field(default_factory=list)
+    plan: PlanCheck | None = None
+    plan_unsupported: str | None = None  # plan routes this config off
+    # Pallas entirely (records as a pass: the fallback is the defense)
+
+
+@dataclass
+class Record:
+    """One rule verdict. status: pass | fail | warn | skip."""
+
+    config: str
+    rule: str
+    kernel: str | None
+    status: str
+    detail: dict = field(default_factory=dict)
+
+
+def _records_fail(records: list[Record]) -> bool:
+    return any(r.status == "fail" for r in records)
+
+
+# ---------------------------------------------------------------------------
+# R1: Mosaic tiling divisibility, dtype-aware
+# ---------------------------------------------------------------------------
+
+def _sublane_quantum(dtype: str) -> int:
+    import numpy as np
+
+    itemsize = np.dtype(dtype).itemsize
+    return {4: 8, 2: 16, 1: 32}.get(itemsize, 8)
+
+
+def check_tiling(config: str, cap: KernelCapture) -> Record:
+    bad = []
+    for rec in cap.specs:
+        bs = rec.block_shape
+        if bs is None:
+            continue
+        # None entries are squeezed dims (block size 1 there).
+        bs = tuple(1 if d is None else d for d in bs)
+        ash = rec.arr_shape
+        q_sub = _sublane_quantum(rec.dtype)
+        dims = ([(-1, 128)] if len(bs) == 1
+                else [(-2, q_sub), (-1, 128)])
+        for d, q in dims:
+            if len(ash) < -d:
+                continue
+            if bs[d] != ash[d] and bs[d] % q != 0:
+                bad.append({
+                    "io": rec.io, "idx": rec.idx, "block": list(bs),
+                    "array": list(ash), "dim": d, "dtype": rec.dtype,
+                    "quantum": q,
+                })
+    return Record(config, "R1", cap.name,
+                  "fail" if bad else "pass",
+                  {"violations": bad} if bad else {})
+
+
+# ---------------------------------------------------------------------------
+# R2: VMEM accounting vs plan estimate and scoped limit
+# ---------------------------------------------------------------------------
+
+def _bytes_of(shape: tuple, dtype: str) -> int:
+    import numpy as np
+
+    return int(math.prod(int(d) for d in shape) or 1) * np.dtype(dtype).itemsize
+
+
+def measured_vmem_bytes(cap: KernelCapture) -> dict:
+    """Spec-accounted VMEM footprint of one kernel instance: blocked
+    operands/outputs count twice (the Mosaic pipeline double-buffers
+    every gridded block), whole-array bindings and scratch once. A lower
+    bound of the true footprint (live values inside the kernel body are
+    invisible to specs) — which is exactly the right direction for the
+    undershoot check: a plan estimate below even this bound is provably
+    wrong."""
+    gridded = math.prod(cap.grid) > 1 if cap.grid else False
+    total = 0
+    parts = {"in": 0, "out": 0, "scratch": 0}
+    spec_by = {("in", r.idx): r for r in cap.specs if r.io == "in"}
+    for i, (shape, dtype) in enumerate(cap.operand_avals):
+        rec = spec_by.get(("in", i))
+        if rec is not None and rec.block_shape is not None:
+            blk = tuple(1 if d is None else d for d in rec.block_shape)
+            b = _bytes_of(blk, dtype) * (2 if gridded else 1)
+        else:
+            b = _bytes_of(shape, dtype)
+        parts["in"] += b
+    for r in cap.specs:
+        if r.io != "out":
+            continue
+        if r.block_shape is not None:
+            blk = tuple(1 if d is None else d for d in r.block_shape)
+            b = _bytes_of(blk, r.dtype) * (2 if gridded else 1)
+        else:
+            b = _bytes_of(r.arr_shape, r.dtype)
+        parts["out"] += b
+    if not any(r.io == "out" for r in cap.specs):
+        for shape, dtype in cap.out_avals:
+            parts["out"] += _bytes_of(shape, dtype)
+    for shape, dtype in cap.scratch:
+        parts["scratch"] += _bytes_of(shape, dtype)
+    parts["total"] = sum(parts.values())
+    return parts
+
+
+def check_vmem(config: str, captures: list[KernelCapture],
+               plan: PlanCheck | None) -> list[Record]:
+    records: list[Record] = []
+    limit = plan.scoped_limit if plan else scoped_limit_bytes(None)
+    peak = 0
+    peak_kernel = None
+    for cap in captures:
+        parts = measured_vmem_bytes(cap)
+        status = "pass" if parts["total"] <= limit else "fail"
+        records.append(Record(config, "R2", cap.name, status, {
+            "accounted_bytes": parts["total"],
+            "breakdown": {k: v for k, v in parts.items() if k != "total"},
+            "scoped_limit_bytes": limit,
+        }))
+        if parts["total"] > peak:
+            peak, peak_kernel = parts["total"], cap.name
+    if plan is not None and plan.estimate_bytes is not None and captures:
+        # The estimator models the dominant (engine) kernel — cross-check
+        # against the peak accounted footprint in this drive.
+        est = plan.estimate_bytes
+        gap = (peak - est) / est if est else float("inf")
+        waiver = R2_WAIVERS.get((config, plan.estimator))
+        if peak > est * (1 + R2_TOLERANCE) and waiver is None:
+            status = "fail"
+        else:
+            status = "pass"
+        records.append(Record(config, "R2", None, status, {
+            "estimator": plan.estimator,
+            "estimate_bytes": est,
+            "accounted_peak_bytes": peak,
+            "accounted_peak_kernel": peak_kernel,
+            "estimate_vs_accounted_gap": round(gap, 4),
+            "scoped_limit_bytes": limit,
+            **({"waiver": waiver} if waiver else {}),
+            **({"notes": plan.notes} if plan.notes else {}),
+        }))
+    return records
+
+
+# ---------------------------------------------------------------------------
+# R3: f64 leak detection
+# ---------------------------------------------------------------------------
+
+def _jaxpr_f64(jaxpr) -> list[str]:
+    import jax.core as jc
+
+    leaks: list[str] = []
+
+    def aval_f64(v):
+        aval = getattr(v, "aval", None)
+        dt = getattr(aval, "dtype", None)
+        return dt is not None and str(dt) == "float64"
+
+    def walk(j):
+        for v in list(j.invars) + list(j.constvars):
+            if aval_f64(v):
+                leaks.append(f"var:{v.aval.str_short()}")
+        for eqn in j.eqns:
+            for v in eqn.outvars:
+                if aval_f64(v):
+                    leaks.append(
+                        f"{eqn.primitive.name}:{v.aval.str_short()}")
+            for p in eqn.params.values():
+                if isinstance(p, jc.ClosedJaxpr):
+                    walk(p.jaxpr)
+                elif isinstance(p, jc.Jaxpr):
+                    walk(p)
+
+    walk(jaxpr)
+    return leaks
+
+
+def check_f64(config: str, cap: KernelCapture) -> Record:
+    leaks = []
+    for i, (shape, dtype) in enumerate(cap.operand_avals):
+        if dtype == "float64":
+            leaks.append({"where": f"operand[{i}]", "shape": list(shape)})
+    for i, (shape, dtype) in enumerate(cap.out_avals):
+        if dtype == "float64":
+            leaks.append({"where": f"out_shape[{i}]", "shape": list(shape)})
+    for i, (shape, dtype) in enumerate(cap.scratch):
+        if dtype == "float64":
+            leaks.append({"where": f"scratch[{i}]", "shape": list(shape)})
+    jaxpr = cap.kernel_jaxpr()
+    if jaxpr is not None:
+        for leak in _jaxpr_f64(jaxpr):
+            leaks.append({"where": "jaxpr", "what": leak})
+    return Record(config, "R3", cap.name,
+                  "fail" if leaks else "pass",
+                  {"leaks": leaks} if leaks else {})
+
+
+# ---------------------------------------------------------------------------
+# R4: primitives with no Mosaic lowering
+# ---------------------------------------------------------------------------
+
+# Structural primitives Mosaic handles by recursing, not by a per-prim
+# lowering rule — descend, never flag.
+_STRUCTURAL = {
+    "pjit", "closed_call", "core_call", "custom_jvp_call",
+    "custom_vjp_call", "custom_vjp_call_jaxpr", "remat", "remat2",
+    "checkpoint", "cond", "while", "scan", "custom_vmap_call",
+}
+
+# Never lowerable on the Mosaic TPU backend regardless of jax version.
+_DENYLIST = {
+    "fft", "sort", "sort_key_val", "top_k", "eig", "eigh", "svd", "qr",
+    "lu", "cholesky", "triangular_solve", "conv_general_dilated",
+}
+
+# Absent from this jaxlib's Mosaic lowering-rule listing but PROVEN to
+# lower: the folded window kernels (gather — the in-kernel window
+# gather) and the df kernels (optimization_barrier — the renorm-first
+# accumulation pin) both compiled and measured on v5e hardware
+# (MEASURE_r04.log / BASELINE_MATRIX_r04.json). Registry listings move
+# between jax versions; hardware evidence wins.
+_KNOWN_LOWERED = {"gather", "optimization_barrier"}
+
+
+def _mosaic_registry() -> set[str] | None:
+    try:
+        from jax._src.pallas.mosaic import lowering as _ml
+
+        return {p.name for p in _ml.lowering_rules}
+    except Exception:
+        return None
+
+
+def _jaxpr_prims(jaxpr) -> set[str]:
+    import jax.core as jc
+
+    names: set[str] = set()
+
+    def walk(j):
+        for eqn in j.eqns:
+            names.add(eqn.primitive.name)
+            for p in eqn.params.values():
+                if isinstance(p, jc.ClosedJaxpr):
+                    walk(p.jaxpr)
+                elif isinstance(p, jc.Jaxpr):
+                    walk(p)
+
+    walk(jaxpr)
+    return names
+
+
+def check_lowering(config: str, cap: KernelCapture,
+                   registry: set[str] | None) -> Record:
+    jaxpr = cap.kernel_jaxpr()
+    if jaxpr is None:
+        if cap.jaxpr_error is not None:
+            # A real kernel whose jaxpr could not be re-derived is a
+            # coverage hole, not a pass — fail loudly.
+            return Record(config, "R4", cap.name, "fail",
+                          {"jaxpr_error": cap.jaxpr_error})
+        return Record(config, "R4", cap.name, "skip",
+                      {"reason": "no kernel jaxpr (hand-built capture)"})
+    prims = _jaxpr_prims(jaxpr)
+    denied = sorted(prims & _DENYLIST)
+    unknown: list[str] = []
+    if registry is not None:
+        unknown = sorted(prims - registry - _STRUCTURAL - _DENYLIST
+                         - _KNOWN_LOWERED)
+    if denied:
+        return Record(config, "R4", cap.name, "fail",
+                      {"denied": denied, "unknown": unknown})
+    if unknown:
+        # Absent from this jax build's Mosaic registry but not provably
+        # unlowerable (registries move between versions): surfaced as a
+        # warning, not a violation.
+        return Record(config, "R4", cap.name, "warn", {"unknown": unknown})
+    return Record(config, "R4", cap.name, "pass", {})
+
+
+# ---------------------------------------------------------------------------
+# R5: shard_map collective-axis consistency
+# ---------------------------------------------------------------------------
+
+def check_collectives(config: str,
+                      uses: list[CollectiveUse]) -> list[Record]:
+    records = []
+    for u in uses:
+        bad = [a for a in u.axes
+               if a not in u.mesh_axes or a not in u.declared_axes]
+        records.append(Record(config, "R5", None,
+                              "fail" if bad else "pass", {
+                                  "prim": u.prim, "axes": list(u.axes),
+                                  "mesh_axes": list(u.mesh_axes),
+                                  "declared_axes": list(u.declared_axes),
+                                  **({"bad_axes": bad} if bad else {}),
+                              }))
+    return records
+
+
+# ---------------------------------------------------------------------------
+# Engine
+# ---------------------------------------------------------------------------
+
+def run_rules(result: ConfigResult,
+              rules: tuple[str, ...] = RULE_IDS) -> list[Record]:
+    """All applicable rule records for one driven config."""
+    records: list[Record] = []
+    if result.plan_unsupported is not None and not result.captures:
+        # The plan routes this config off Pallas entirely — that routing
+        # is the defense the rule engine exists to verify, so it records
+        # as an explicit pass with the reason.
+        return [Record(result.name, "R2", None, "pass",
+                       {"plan_unsupported": result.plan_unsupported})]
+    registry = _mosaic_registry() if "R4" in rules else None
+    for cap in result.captures:
+        if "R1" in rules:
+            records.append(check_tiling(result.name, cap))
+        if "R3" in rules:
+            records.append(check_f64(result.name, cap))
+        if "R4" in rules:
+            records.append(check_lowering(result.name, cap, registry))
+    if "R2" in rules:
+        if result.plan_unsupported is not None:
+            # Captures from a variant the plan refuses to ship (e.g.
+            # explicit geom='g' where corner is forced): the tiling/
+            # dtype/lowering lint above still applies — it is CPU-test
+            # coverage of a kernel users can reach with explicit flags —
+            # but VMEM accounting does not: the plan already routes the
+            # config off this kernel on TPU.
+            records.append(Record(result.name, "R2", None, "pass",
+                                  {"plan_unsupported":
+                                   result.plan_unsupported}))
+        else:
+            records.extend(
+                check_vmem(result.name, result.captures, result.plan))
+    if "R5" in rules and result.collectives:
+        records.extend(check_collectives(result.name, result.collectives))
+    return records
+
+
+def summarize(records: list[Record]) -> dict:
+    by_rule: dict[str, dict] = {}
+    for r in records:
+        d = by_rule.setdefault(r.rule, {"pass": 0, "fail": 0, "warn": 0,
+                                        "skip": 0})
+        d[r.status] += 1
+    return {
+        "analyzer_version": ANALYZER_VERSION,
+        "records": len(records),
+        "violations": sum(1 for r in records if r.status == "fail"),
+        "warnings": sum(1 for r in records if r.status == "warn"),
+        "by_rule": by_rule,
+    }

@@ -1,0 +1,67 @@
+"""Fold the analyzer's JSON report into bench artifacts.
+
+"Did static analysis predict this?" must be one grep across artifacts:
+bench.py stamps ``static_analysis`` (per-rule pass/fail + analyzer
+version) into its JSON line, and the drivers stamp the same block into
+the ``error_record``-shaped extras whenever a hardware run falls back to
+unfused — consistent with PR 3's ``failure_class`` convention.
+
+The report is produced separately (``python -m bench_tpu_fem.analysis
+--json ANALYSIS.json`` — CI's analysis lane, or the measurement agenda's
+pre-flight) and read here, NEVER regenerated inside a bench process: the
+analyzer forces an 8-virtual-device CPU platform, which a TPU bench
+process must not touch. ``BENCH_ANALYSIS_REPORT`` overrides the default
+./ANALYSIS.json location.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+_DEFAULT_REPORT = "ANALYSIS.json"
+
+
+def load_report(path: str | None = None) -> dict | None:
+    """The analyzer report, or None when none has been produced (the
+    verdict then records unavailability rather than guessing)."""
+    path = path or os.environ.get("BENCH_ANALYSIS_REPORT", _DEFAULT_REPORT)
+    try:
+        with open(path) as f:
+            return json.load(f)
+    except (OSError, ValueError):
+        return None
+
+
+def static_analysis_verdict(report: dict | None = None,
+                            path: str | None = None) -> dict:
+    """The compact per-rule verdict block bench artifacts carry:
+    {"available", "analyzer_version", "rules": {R1: pass|fail, ...},
+    "violations"} — one record per rule, pass only when every config's
+    record under that rule passed."""
+    if report is None:
+        report = load_report(path)
+    if report is None:
+        return {"available": False}
+    by_rule = report.get("summary", {}).get("by_rule", {})
+    return {
+        "available": True,
+        "analyzer_version": report.get("analyzer_version"),
+        "violations": report.get("summary", {}).get("violations"),
+        "rules": {rule: ("fail" if counts.get("fail") else "pass")
+                  for rule, counts in sorted(by_rule.items())},
+        # identifies WHICH tree the report analyzed (git rev + dirty +
+        # timestamp) — an artifact stamped from a stale report is
+        # detectable instead of quietly authoritative
+        **({"source": report["source"]} if "source" in report else {}),
+    }
+
+
+def stamp_static_analysis(extra: dict) -> None:
+    """Attach the verdict to a result/error extras dict (drivers call
+    this on every unfused fallback; never raises — a missing report must
+    not sink a benchmark)."""
+    try:
+        extra["static_analysis"] = static_analysis_verdict()
+    except Exception:  # defensive: artifact stamping is best-effort
+        extra["static_analysis"] = {"available": False}

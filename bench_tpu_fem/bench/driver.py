@@ -120,6 +120,13 @@ def record_engine(extra: dict, engine: bool, form: str | None = None,
         else:
             extra["cg_engine_error"] = exc_str(error)
             extra["failure_class"] = classify_exception(error)
+        # A hardware run that fell back to unfused is exactly the event
+        # static analysis exists to predict: stamp the analyzer's
+        # per-rule verdict next to the failure_class so "did static
+        # analysis predict this?" is one grep across artifacts.
+        from ..analysis.verdict import stamp_static_analysis
+
+        stamp_static_analysis(extra)
 
 
 # engine_plan/engine_plan_df form names -> the unified vocabulary
@@ -252,9 +259,11 @@ def _df64_emulated_fallback(cfg: BenchConfig, reason: str) -> BenchmarkResults:
         jax.config.update("jax_enable_x64", prev)
     res.extra["f64_impl"] = "emulated-fallback"
     res.extra["f64_df32_fallback_reason"] = reason
+    from ..analysis.verdict import stamp_static_analysis
     from ..harness.classify import classify_text
 
     res.extra["failure_class"] = classify_text(reason)
+    stamp_static_analysis(res.extra)
     return res
 
 

@@ -52,6 +52,7 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ..analysis import budgets as _B
 from ..elements.tables import OperatorTables, build_operator_tables
 from ..la.df64 import (
     DF,
@@ -426,14 +427,15 @@ def folded_cell_apply_df(
 # Per-compile scoped-VMEM request for every folded-df compile on TPU: the
 # df working set roughly doubles the f32 kernels', which already sit near
 # the 16 MiB default limit at full 128-lane blocks.
-FOLDED_DF_SCOPED_KIB = 65536
+FOLDED_DF_SCOPED_KIB = _B.FOLDED_DF_SCOPED_KIB
 # Live-value model budget under the raised 64 MiB limit, derated by the
 # WORST measured model->Mosaic allocator ratio in this repo (1.7x, the
-# plane-streamed corner kernels — ops.pallas_laplacian). The folded
+# plane-streamed corner kernels — ops.pallas_laplacian; derivation with
+# every other budget in analysis.budgets). The folded
 # kernels require full 128-lane blocks on TPU (narrower relayouts are
 # Mosaic-unsupported), so a config either fits at nl=128 or routes to the
 # recorded XLA-emulation fallback.
-_FOLDED_DF_BUDGET_BYTES = int(60 * 1024 * 1024 / 1.7)
+_FOLDED_DF_BUDGET_BYTES = _B.FOLDED_DF_BUDGET_BYTES
 
 
 def _df_cell_bytes(nd: int, nq: int, geom: str) -> int:

@@ -73,6 +73,7 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ..analysis import budgets as _B
 from ..la.cg import fused_cg_solve
 from .pallas_laplacian import _use_interpret
 
@@ -82,8 +83,10 @@ from .pallas_laplacian import _use_interpret
 # estimate is rejected while the degree-6 12.35 MiB one compiles — so
 # 11 MiB is the hardware-validated safe line). Estimates between
 # VMEM_BUDGET and ONE_KERNEL_SCOPED_MAX still take the one-kernel form,
-# but with a raised per-compile scoped limit (engine_plan below).
-VMEM_BUDGET = 11 * 2**20
+# but with a raised per-compile scoped limit (engine_plan below). The
+# constant lives with every other VMEM budget in analysis.budgets; the
+# module-attribute alias is the patch point probes use.
+VMEM_BUDGET = _B.KRON_VMEM_BUDGET
 
 
 def _lane_pad(n: int) -> int:
@@ -611,10 +614,10 @@ def _kron_cg_call_chunked(op, update_p: bool, interpret, *vectors):
 # and the chunked form takes over. The raised limit is requested ONLY
 # where needed: a blanket raise costs the flagship ~12% (9.26 -> 8.13,
 # A probe) by stealing pipeline-buffer headroom.
-ONE_KERNEL_SCOPED_MAX = 31 * 2**20
-ONE_KERNEL_SCOPED_KIB = 65536
-ONE_KERNEL_SCOPED_MAX2 = 62 * 2**20
-ONE_KERNEL_SCOPED_KIB2 = 98304
+ONE_KERNEL_SCOPED_MAX = _B.KRON_ONE_KERNEL_SCOPED_MAX
+ONE_KERNEL_SCOPED_KIB = _B.KRON_ONE_KERNEL_SCOPED_KIB
+ONE_KERNEL_SCOPED_MAX2 = _B.KRON_ONE_KERNEL_SCOPED_MAX2
+ONE_KERNEL_SCOPED_KIB2 = _B.KRON_ONE_KERNEL_SCOPED_KIB2
 
 
 def engine_plan(

@@ -70,6 +70,7 @@ from ..la.df64 import (
     df_zeros_like,
     two_sum,
 )
+from ..analysis import budgets as _B
 from .kron_df import KronLaplacianDF
 from .pallas_laplacian import _use_interpret
 
@@ -107,9 +108,11 @@ def engine_vmem_bytes_df(grid_shape: tuple[int, int, int],
 # one costs a recorded Mosaic-reject retry — the driver survives both,
 # but the estimates must not masquerade as f32's measured ones
 # (round-5 verdict, weak #3).
-DF_VMEM_BUDGET = 9 * 2**20  # 16 MiB default scoped limit / 1.7
-DF_ONE_KERNEL_SCOPED_MAX = 30 * 2**20  # 64 MiB tier (f32 measured 31)
-DF_ONE_KERNEL_SCOPED_MAX2 = 56 * 2**20  # 96 MiB tier / 1.7
+# (constants consolidated in analysis.budgets with every other VMEM
+# budget; the module-attribute aliases remain the probes' patch points)
+DF_VMEM_BUDGET = _B.DF_VMEM_BUDGET  # 16 MiB default scoped limit / 1.7
+DF_ONE_KERNEL_SCOPED_MAX = _B.DF_ONE_KERNEL_SCOPED_MAX  # 64 MiB tier
+DF_ONE_KERNEL_SCOPED_MAX2 = _B.DF_ONE_KERNEL_SCOPED_MAX2  # 96 MiB / 1.7
 
 
 def engine_plan_df(grid_shape: tuple[int, int, int],

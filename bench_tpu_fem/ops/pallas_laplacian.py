@@ -31,17 +31,31 @@ import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from ..analysis.budgets import (
+    PALLAS_CORNER_BUDGET_BYTES as _VMEM_BUDGET_CORNER_BYTES,
+    PALLAS_STREAM_BUDGET_BYTES as _VMEM_BUDGET_BYTES,
+    PALLAS_STREAMED_BUDGET_BYTES as _STREAMED_SCOPED_BUDGET_BYTES,
+    PALLAS_STREAMED_SCOPED_KIB as STREAMED_SCOPED_KIB,
+)
+
 SUBLANES = 8  # cells fill the full sublane x lane vreg cross-section
-_VMEM_BUDGET_BYTES = 12 * 1024 * 1024  # leave headroom in the ~16 MB VMEM
+
+
+def stream_cell_bytes(nd: int, nq: int, itemsize: int = 4) -> int:
+    """Modelled per-cell VMEM of the G-streaming window kernel:
+    double-buffered u/y (2*nd^3 each), double-buffered G (12*nq^3) and
+    the live contraction intermediates (~7*nq^3). The ONE formula behind
+    pick_lanes — the analysis rule engine (analysis.rules.R2)
+    cross-checks it against captured spec footprints."""
+    return (4 * nd**3 + 19 * nq**3) * itemsize
 
 
 def pick_lanes(nd: int, nq: int, itemsize: int = 4) -> int:
-    """Lanes-per-block so one block's VMEM working set fits the budget:
-    double-buffered u/y (2*nd^3 each), double-buffered G (12*nq^3) and the
-    live contraction intermediates (~7*nq^3), all per cell, times the
-    8 x lanes cells per block. 128 lanes (1024 cells) through degree ~4,
-    shrinking for the big high-degree working sets."""
-    per_cell = (4 * nd**3 + 19 * nq**3) * itemsize
+    """Lanes-per-block so one block's VMEM working set (stream_cell_bytes
+    per cell, times the 8 x lanes cells per block) fits the budget.
+    128 lanes (1024 cells) through degree ~4, shrinking for the big
+    high-degree working sets."""
+    per_cell = stream_cell_bytes(nd, nq, itemsize)
     for nl in (128, 64, 32, 16):
         if per_cell * SUBLANES * nl <= _VMEM_BUDGET_BYTES:
             return nl
@@ -51,17 +65,22 @@ def pick_lanes(nd: int, nq: int, itemsize: int = 4) -> int:
 # Corner mode swaps the 12*nq^3 double-buffered G stream for 2*25
 # corner/mask values plus the in-kernel G as a ~6*nq^3 live value — a
 # smaller VMEM footprint, so some configurations (degree 4, qmode 1) keep
-# full 128-lane blocks that G streaming cannot. Its budget is separate and
+# full 128-lane blocks that G streaming cannot. Its budget
+# (analysis.budgets.PALLAS_CORNER_BUDGET_BYTES) is separate and
 # deliberately tighter than the hardware ~16.5 MB: the corner kernels'
 # live-value estimate carries more model risk than the streaming one.
-_VMEM_BUDGET_CORNER_BYTES = 14 * 1024 * 1024
+
+
+def corner_cell_bytes(nd: int, nq: int, itemsize: int = 4) -> int:
+    """Modelled per-cell VMEM of the corner-mode kernel: double-buffered
+    u/y (4*nd^3), live G + contraction intermediates (~13*nq^3),
+    double-buffered corners+mask (~50)."""
+    return (4 * nd**3 + 13 * nq**3 + 50) * itemsize
 
 
 def corner_lanes_ok(nd: int, nq: int, itemsize: int = 4) -> bool:
-    """True when the corner-mode kernel fits full 128-lane blocks:
-    double-buffered u/y (4*nd^3), live G + contraction intermediates
-    (~13*nq^3), double-buffered corners+mask (~50)."""
-    per_cell = (4 * nd**3 + 13 * nq**3 + 50) * itemsize
+    """True when the corner-mode kernel fits full 128-lane blocks."""
+    per_cell = corner_cell_bytes(nd, nq, itemsize)
     return per_cell * SUBLANES * 128 <= _VMEM_BUDGET_CORNER_BYTES
 
 
@@ -304,28 +323,29 @@ def sumfact_window_apply_corner_streamed(u, corners, mask, kappa,
 # only with a raised per-compile xla_tpu_scoped_vmem_limit_kib (see
 # utils.compilation; hardware-checked at degree 5: 3.82 GDoF/s at 12.5M
 # dofs, MEASURE_r04.log E probe). The request is per-path because a
-# blanket raise costs unaffected kernels pipeline headroom.
-STREAMED_SCOPED_KIB = 32768
-# Admit streamed configs whose modelled footprint x1.7 (the worst
-# measured model->Mosaic ratio) still leaves headroom inside the raised
-# 32 MB limit: degree 5 (model 11.5 MB) and degree 6 (16.9 MB) pass,
-# degree 7 (24 MB -> ~41 MB actual) does not.
-_STREAMED_SCOPED_BUDGET_BYTES = int(30 * 1024 * 1024 / 1.7)
+# blanket raise costs unaffected kernels pipeline headroom. The raised
+# request and the derated admission budget both live in
+# analysis.budgets (imported at the top of this module).
+
+
+def streamed_cell_bytes(nd: int, nq: int, itemsize: int = 4) -> int:
+    """Modelled per-cell VMEM of the plane-streamed corner kernel:
+    double-buffered u/y pipeline as 4*nd^3 (the same model
+    corner_cell_bytes uses for the identical streams — the two models
+    must not disagree about shared terms), window (nd^3), the two
+    x-reduced accumulators (2*nd*nq^2, plus one transient stack), and
+    ~16 nq^2 live plane temporaries at the Jacobian/flux peaks."""
+    return (5 * nd**3 + 3 * nd * nq**2 + 16 * nq**2 + 50) * itemsize
 
 
 def corner_streamed_lanes_ok(nd: int, nq: int, itemsize: int = 4) -> bool:
     """True when the plane-streamed corner kernel fits full 128-lane
     folded blocks under the RAISED scoped-VMEM limit (STREAMED_SCOPED_KIB
     — every streamed config needs it; the degree-5 kernel already
-    measures 19.3 MB against the 16 MB default limit). Live-value model:
-    double-buffered u/y pipeline as 4*nd^3 (the same model
-    corner_lanes_ok uses for the identical streams — the two predicates
-    must not disagree about shared terms), window (nd^3), the two
-    x-reduced accumulators (2*nd*nq^2, plus one transient stack), and
-    ~16 nq^2 live plane temporaries at the Jacobian/flux peaks."""
-    per_cell = (
-        5 * nd**3 + 3 * nd * nq**2 + 16 * nq**2 + 50
-    ) * itemsize
+    measures 19.3 MB against the 16 MB default limit): degree 5 (model
+    11.5 MB) and degree 6 (16.9 MB) pass, degree 7 (24 MB -> ~41 MB
+    actual) does not."""
+    per_cell = streamed_cell_bytes(nd, nq, itemsize)
     return per_cell * SUBLANES * 128 <= _STREAMED_SCOPED_BUDGET_BYTES
 
 

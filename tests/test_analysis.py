@@ -1,0 +1,268 @@
+"""The static-analysis subsystem itself: rule-engine unit behaviour on
+hand-built captures, the known-bad regression corpus (every fixture —
+including the exact round-4 Mosaic rejection — must be flagged), the
+budget consolidation (ops modules must alias analysis.budgets, so a
+budget edit cannot fork), the CLI, and the bench-artifact verdict stamp.
+"""
+
+import json
+
+import pytest
+
+from bench_tpu_fem.analysis.capture import (
+    CollectiveUse,
+    KernelCapture,
+    SpecRecord,
+)
+from bench_tpu_fem.analysis.rules import (
+    ConfigResult,
+    PlanCheck,
+    check_collectives,
+    check_tiling,
+    check_vmem,
+    measured_vmem_bytes,
+    run_rules,
+)
+
+
+def _cap(specs, grid=(4,), operands=None, outs=None, scratch=None,
+         name="k"):
+    return KernelCapture(
+        name=name, call_index=0, grid=grid, specs=specs,
+        operand_avals=operands or [], out_avals=outs or [],
+        scratch=scratch or [])
+
+
+# ---------------------------------------------------------------------------
+# R1: dtype-aware tiling
+# ---------------------------------------------------------------------------
+
+def test_r1_f32_8x128_ok_bf16_flagged():
+    spec32 = SpecRecord("in", 0, (8, 128), (64, 256), "float32")
+    spec16 = SpecRecord("in", 0, (8, 128), (64, 256), "bfloat16")
+    assert check_tiling("c", _cap([spec32])).status == "pass"
+    rec = check_tiling("c", _cap([spec16]))
+    assert rec.status == "fail"
+    assert rec.detail["violations"][0]["quantum"] == 16
+
+
+def test_r1_full_dim_always_legal():
+    # block equal to the full array dim is legal at ANY size (the rule's
+    # equal-to-array escape) — including non-multiples of 8/128.
+    spec = SpecRecord("in", 0, (3, 77), (3, 77), "float32")
+    assert check_tiling("c", _cap([spec])).status == "pass"
+
+
+def test_r1_round4_shape_flagged():
+    # the exact round-4 coefficient stream: (1, 2nb) over (NX, 2nb)
+    spec = SpecRecord("in", 0, (1, 14), (34, 14), "float32")
+    rec = check_tiling("c", _cap([spec]))
+    assert rec.status == "fail"
+    v = rec.detail["violations"][0]
+    assert v["dim"] == -2 and v["block"] == [1, 14]
+
+
+def test_r1_int8_quantum_32():
+    spec = SpecRecord("in", 0, (16, 128), (64, 256), "int8")
+    rec = check_tiling("c", _cap([spec]))
+    assert rec.status == "fail"
+    assert rec.detail["violations"][0]["quantum"] == 32
+
+
+# ---------------------------------------------------------------------------
+# R2: VMEM accounting
+# ---------------------------------------------------------------------------
+
+def test_r2_accounting_double_buffers_blocked_operands():
+    cap = _cap(
+        specs=[SpecRecord("in", 0, (8, 128), (64, 128), "float32"),
+               SpecRecord("out", 0, (8, 128), (64, 128), "float32")],
+        operands=[((64, 128), "float32")],
+        scratch=[((8, 128), "float32")])
+    parts = measured_vmem_bytes(cap)
+    blk = 8 * 128 * 4
+    assert parts["in"] == 2 * blk
+    assert parts["out"] == 2 * blk
+    assert parts["scratch"] == blk
+    assert parts["total"] == 5 * blk
+
+
+def test_r2_limit_and_undershoot():
+    big = SpecRecord("in", 0, (2048, 3072), (4096, 3072), "float32")
+    cap = _cap([big], operands=[((4096, 3072), "float32")], grid=(2,))
+    recs = check_vmem("c", [cap], PlanCheck("est", 1 * 2**20))
+    kernel_rec = [r for r in recs if r.kernel is not None][0]
+    plan_rec = [r for r in recs if r.kernel is None][0]
+    assert kernel_rec.status == "fail"  # 48 MiB > 16 MiB default limit
+    assert plan_rec.status == "fail"  # estimate 1 MiB << accounted
+
+
+def test_r2_estimate_overbound_passes():
+    small = SpecRecord("in", 0, (8, 128), (64, 128), "float32")
+    cap = _cap([small], operands=[((64, 128), "float32")])
+    recs = check_vmem("c", [cap], PlanCheck("est", 10 * 2**20))
+    assert all(r.status == "pass" for r in recs)
+
+
+# ---------------------------------------------------------------------------
+# R3 / R4: f64 and lowering via a real traced kernel
+# ---------------------------------------------------------------------------
+
+def test_r3_flags_f64_operand_and_jaxpr():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.experimental import pallas as pl
+
+    from bench_tpu_fem.analysis.capture import CaptureSession
+
+    def kernel(x_ref, o_ref):
+        # x64 is on in tests (conftest) — this really produces f64 eqns
+        o_ref[...] = (x_ref[...].astype(jnp.float64) * 2.0).astype(
+            jnp.float32)
+
+    spec = pl.BlockSpec((8, 128), lambda i: (i, 0))
+    with CaptureSession() as s:
+        fn = pl.pallas_call(
+            kernel, grid=(1,), in_specs=[spec], out_specs=spec,
+            out_shape=jax.ShapeDtypeStruct((8, 128), np.float32),
+            interpret=True)
+        jax.eval_shape(fn, jax.ShapeDtypeStruct((8, 128),
+                                                np.dtype("float32")))
+    recs = run_rules(ConfigResult("c", {}, s.kernels), rules=("R3",))
+    assert [r.status for r in recs] == ["fail"]
+    assert any(leak["where"] == "jaxpr" for leak in recs[0].detail["leaks"])
+
+
+def test_r4_denylist_flags_fft():
+    from bench_tpu_fem.analysis.fixtures import fixture_r4_unlowerable
+
+    rule, result = fixture_r4_unlowerable()
+    recs = run_rules(result, rules=("R4",))
+    assert any(r.status == "fail" and "fft" in r.detail.get("denied", [])
+               for r in recs)
+
+
+# ---------------------------------------------------------------------------
+# R5: collective axes
+# ---------------------------------------------------------------------------
+
+def test_r5_axis_membership():
+    ok = CollectiveUse("psum", ("dx", "dy"), ("dx", "dy", "dz"),
+                       ("dx", "dy", "dz"))
+    bad = CollectiveUse("ppermute", ("x",), ("dx", "dy", "dz"),
+                        ("dx", "dy", "dz"))
+    assert check_collectives("c", [ok])[0].status == "pass"
+    rec = check_collectives("c", [bad])[0]
+    assert rec.status == "fail" and rec.detail["bad_axes"] == ["x"]
+
+
+def test_r5_dist_configs_capture_collectives():
+    from bench_tpu_fem.analysis.configs import run_config
+
+    res = run_config("dist_folded_engine")
+    assert res.collectives, "dist drive captured no collectives"
+    prims = {u.prim for u in res.collectives}
+    assert "ppermute" in prims or "psum" in prims
+
+
+# ---------------------------------------------------------------------------
+# Known-bad corpus
+# ---------------------------------------------------------------------------
+
+def test_corpus_fully_flagged():
+    from bench_tpu_fem.analysis.fixtures import run_corpus
+
+    _, missed = run_corpus()
+    assert not missed, f"rules failed to flag fixtures: {missed}"
+
+
+# ---------------------------------------------------------------------------
+# Budget consolidation
+# ---------------------------------------------------------------------------
+
+def test_ops_budgets_alias_analysis_budgets():
+    from bench_tpu_fem.analysis import budgets as B
+    from bench_tpu_fem.ops import folded_df as FD
+    from bench_tpu_fem.ops import kron_cg as KC
+    from bench_tpu_fem.ops import kron_cg_df as KCD
+    from bench_tpu_fem.ops import pallas_laplacian as PL
+
+    assert KC.VMEM_BUDGET == B.KRON_VMEM_BUDGET
+    assert KC.ONE_KERNEL_SCOPED_MAX == B.KRON_ONE_KERNEL_SCOPED_MAX
+    assert KC.ONE_KERNEL_SCOPED_MAX2 == B.KRON_ONE_KERNEL_SCOPED_MAX2
+    assert KCD.DF_VMEM_BUDGET == B.DF_VMEM_BUDGET
+    assert KCD.DF_ONE_KERNEL_SCOPED_MAX == B.DF_ONE_KERNEL_SCOPED_MAX
+    assert PL._VMEM_BUDGET_BYTES == B.PALLAS_STREAM_BUDGET_BYTES
+    assert PL._VMEM_BUDGET_CORNER_BYTES == B.PALLAS_CORNER_BUDGET_BYTES
+    assert PL._STREAMED_SCOPED_BUDGET_BYTES == B.PALLAS_STREAMED_BUDGET_BYTES
+    assert PL.STREAMED_SCOPED_KIB == B.PALLAS_STREAMED_SCOPED_KIB
+    assert FD._FOLDED_DF_BUDGET_BYTES == B.FOLDED_DF_BUDGET_BYTES
+    assert FD.FOLDED_DF_SCOPED_KIB == B.FOLDED_DF_SCOPED_KIB
+
+
+def test_budget_patch_point_still_works(monkeypatch):
+    # harness.agenda probes patch KC.VMEM_BUDGET; engine_plan must see it
+    import bench_tpu_fem.ops.kron_cg as KC
+
+    monkeypatch.setattr(KC, "VMEM_BUDGET", 0)
+    form, kib = KC.engine_plan((64, 64, 64), 3)
+    assert form == "one" and kib is not None  # fell through to tier 1
+
+
+# ---------------------------------------------------------------------------
+# CLI + verdict stamp
+# ---------------------------------------------------------------------------
+
+def test_cli_filtered_run_writes_report(tmp_path):
+    from bench_tpu_fem.analysis.__main__ import main
+
+    out = tmp_path / "report.json"
+    rc = main(["--configs", "kron_update_pass", "--json", str(out)])
+    assert rc == 0
+    rep = json.loads(out.read_text())
+    assert rep["summary"]["violations"] == 0
+    names = [c["name"] for c in rep["configs"]]
+    assert "kron_update_pass" in names
+    recs = [r for c in rep["configs"] if c["name"] == "kron_update_pass"
+            for r in c["records"]]
+    assert {r["rule"] for r in recs} >= {"R1", "R2", "R3", "R4"}
+
+
+def test_verdict_reads_report(tmp_path, monkeypatch):
+    from bench_tpu_fem.analysis.verdict import static_analysis_verdict
+
+    rep = {"analyzer_version": "1.0",
+           "summary": {"violations": 1,
+                       "by_rule": {"R1": {"fail": 1, "pass": 3},
+                                   "R3": {"fail": 0, "pass": 4}}}}
+    p = tmp_path / "ANALYSIS.json"
+    p.write_text(json.dumps(rep))
+    monkeypatch.setenv("BENCH_ANALYSIS_REPORT", str(p))
+    v = static_analysis_verdict()
+    assert v == {"available": True, "analyzer_version": "1.0",
+                 "violations": 1,
+                 "rules": {"R1": "fail", "R3": "pass"}}
+    monkeypatch.setenv("BENCH_ANALYSIS_REPORT", str(tmp_path / "nope.json"))
+    assert static_analysis_verdict() == {"available": False}
+
+
+def test_record_engine_stamps_verdict_on_fallback(tmp_path, monkeypatch):
+    from bench_tpu_fem.analysis.verdict import static_analysis_verdict
+    from bench_tpu_fem.bench.driver import record_engine
+
+    del static_analysis_verdict
+    rep = {"analyzer_version": "1.0",
+           "summary": {"violations": 0, "by_rule": {"R1": {"fail": 0}}}}
+    p = tmp_path / "ANALYSIS.json"
+    p.write_text(json.dumps(rep))
+    monkeypatch.setenv("BENCH_ANALYSIS_REPORT", str(p))
+    extra = {}
+    record_engine(extra, False, error="Mosaic failed to compile: tiling")
+    assert extra["failure_class"] == "mosaic_reject"
+    assert extra["static_analysis"]["available"] is True
+    assert extra["static_analysis"]["rules"] == {"R1": "pass"}
+    # the success path stays unstamped (no fallback happened)
+    extra_ok = {}
+    record_engine(extra_ok, True, "one_kernel")
+    assert "static_analysis" not in extra_ok

@@ -7,394 +7,123 @@ dimensions of your block shape are divisible by 8 and 128 respectively,
 or be equal to the respective dimensions of the overall array"), and the
 hardware benchmark silently fell back to the unfused path.
 
-This test wraps pl.pallas_call with a recorder, drives every Pallas code
-path we ship (both kron engine forms, the pallas update pass, the 3-stage
-kron apply, the folded fused apply and CG engine in both geometry modes)
-in interpret mode, and statically checks every captured BlockSpec against
-the Mosaic rule — catching the whole bug class on CPU.
+Round 6 grew the original test-local recorder into the static-analysis
+subsystem (bench_tpu_fem.analysis): capture.CaptureSession generalizes
+SpecRecorder, configs.py owns the shipped-config drives, and rules.py
+runs the full R1-R5 rule engine (tiling, VMEM accounting, f64 leaks,
+Mosaic lowering, collective axes) where this file checked one rule. This
+file is now a thin pytest adapter: every pre-existing case maps to its
+named config in the analysis matrix and asserts the rule engine reports
+zero violations. The known-bad corpus (including the round-4 repro
+above) lives in analysis.fixtures and is asserted in test_analysis.py;
+`python -m bench_tpu_fem.analysis` drives the whole matrix standalone.
 """
 
-import jax
-import jax.numpy as jnp
-import numpy as np
 import pytest
-from jax.experimental import pallas as pl
-
-from bench_tpu_fem.mesh.box import create_box_mesh
-from bench_tpu_fem.mesh.sizing import compute_mesh_size
 
 
-class SpecRecorder:
-    """Monkeypatch harness: captures (block_shape, array_shape) pairs for
-    every operand/output of every pallas_call issued while active."""
+def _run(config_name: str):
+    from bench_tpu_fem.analysis.configs import run_config
+    from bench_tpu_fem.analysis.rules import run_rules
 
-    def __init__(self):
-        self.records = []  # (kernel_name, io, idx, block_shape, arr_shape)
-
-    def patch(self, monkeypatch):
-        orig = pl.pallas_call
-
-        def wrapper(kernel, **kw):
-            fn = orig(kernel, **kw)
-            in_specs = kw.get("in_specs")
-            out_specs = kw.get("out_specs")
-            out_shape = kw.get("out_shape")
-
-            def traced(*operands):
-                name = getattr(kernel, "__name__", str(kernel))
-                if in_specs is not None:
-                    for i, (s, a) in enumerate(zip(in_specs, operands)):
-                        self.records.append(
-                            (name, "in", i, s.block_shape, a.shape)
-                        )
-                outs = (out_shape if isinstance(out_shape, (list, tuple))
-                        else [out_shape])
-                specs = (out_specs if isinstance(out_specs, (list, tuple))
-                         else [out_specs])
-                if out_specs is not None:
-                    for i, (s, a) in enumerate(zip(specs, outs)):
-                        self.records.append(
-                            (name, "out", i, s.block_shape, a.shape)
-                        )
-                return fn(*operands)
-
-            return traced
-
-        monkeypatch.setattr(pl, "pallas_call", wrapper)
-        # modules hold `pl` by reference, so patching the module attribute
-        # reaches every call site; nothing else needed.
-        return self
-
-    def check(self):
-        assert self.records, "no pallas_call captured — wiring broken?"
-        bad = []
-        for name, io, idx, bs, ash in self.records:
-            if bs is None:
-                continue
-            # Mosaic rule: last two block dims must each be divisible by
-            # (8, 128) respectively or equal to the full array dim. For
-            # rank-1 only the lane dim applies.
-            dims = [(-1, 128)] if len(bs) == 1 else [(-2, 8), (-1, 128)]
-            for d, q in dims:
-                if len(ash) < -d:
-                    continue
-                if bs[d] != ash[d] and bs[d] % q != 0:
-                    bad.append((name, io, idx, tuple(bs), tuple(ash), d))
-        assert not bad, (
-            "Mosaic-incompatible block specs (block dim neither full nor "
-            f"(8,128)-divisible):\n" + "\n".join(map(str, bad))
-        )
-
-
-@pytest.fixture
-def recorder(monkeypatch):
-    return SpecRecorder().patch(monkeypatch)
-
-
-def _mesh_op(ndofs, degree, perturb, geom):
-    import bench_tpu_fem.ops.folded as FO
-
-    nc = compute_mesh_size(ndofs, degree)
-    mesh = create_box_mesh(nc, geom_perturb_fact=perturb)
-    return FO.build_folded_laplacian(
-        mesh, degree, qmode=1, dtype=jnp.float32, geom=geom
-    )
-
-
-def _rand(shape):
-    return jnp.asarray(np.random.RandomState(0).rand(*shape), jnp.float32)
+    res = run_config(config_name)
+    assert res.captures, "no pallas_call captured — wiring broken?"
+    bad = [r for r in run_rules(res) if r.status == "fail"]
+    assert not bad, "static-analysis violations:\n" + "\n".join(
+        f"{r.rule} {r.kernel}: {r.detail}" for r in bad)
 
 
 @pytest.mark.parametrize("degree", [3, 4])
 @pytest.mark.parametrize("chunked", [False, True])
-def test_kron_engine_specs(recorder, degree, chunked):
-    import bench_tpu_fem.ops.kron_cg as KC
-    from bench_tpu_fem.ops.kron import build_kron_laplacian
-
-    nc = compute_mesh_size(40_000, degree)
-    mesh = create_box_mesh(nc)
-    op = build_kron_laplacian(mesh, degree, qmode=1, dtype=jnp.float32)
-    shape = tuple(int(a.shape[0]) for a in op.notbc1d)
-    r, p = _rand(shape), _rand(shape)
-    # force_chunked is the form toggle itself (a VMEM_BUDGET=0 patch no
-    # longer forces the two-kernel form: engine_plan's raised-limit tier
-    # would still pick 'one') — the chunked form is the driver's
-    # Mosaic-reject retry path and needs its own spec lint.
-    KC._kron_cg_call(op, True, True, r, p, jnp.float32(0.5),
-                     force_chunked=chunked)
-    KC._kron_cg_call(op, False, True, r, force_chunked=chunked)
-    recorder.check()
+def test_kron_engine_specs(degree, chunked):
+    _run(f"kron_engine_d{degree}" + ("_chunked" if chunked else ""))
 
 
-def test_kron_update_pass_specs(recorder):
-    import bench_tpu_fem.ops.kron_cg as KC
-
-    x, p, r, y = (_rand((17, 29, 23)) for _ in range(4))
-    KC.cg_update_pallas(x, p, r, y, jnp.float32(0.3), interpret=True)
-    recorder.check()
+def test_kron_update_pass_specs():
+    _run("kron_update_pass")
 
 
 @pytest.mark.parametrize("degree", [3])
-def test_kron_3stage_specs(recorder, degree):
-    from bench_tpu_fem.ops.kron import build_kron_laplacian
-
-    nc = compute_mesh_size(40_000, degree)
-    mesh = create_box_mesh(nc)
-    op = build_kron_laplacian(mesh, degree, qmode=1, dtype=jnp.float32)
-    shape = tuple(int(a.shape[0]) for a in op.notbc1d)
-    from bench_tpu_fem.ops.kron_pallas import kron_apply_pallas
-
-    kron_apply_pallas(_rand(shape), op.Kd, op.Md, op.notbc1d, op.kappa,
-                      degree, interpret=True)
-    recorder.check()
+def test_kron_3stage_specs(degree):
+    _run(f"kron_3stage_d{degree}")
 
 
 @pytest.mark.parametrize("geom", ["g", "corner"])
 @pytest.mark.parametrize("degree", [3, 4])
-def test_folded_engine_specs(recorder, geom, degree):
-    import bench_tpu_fem.ops.folded_cg as FCG
-
-    op = _mesh_op(40_000, degree, 0.1, geom)
-    lay = op.layout
-    shp = (lay.nblocks, degree ** 3, lay.block)
-    r, p = _rand(shp), _rand(shp)
-    FCG._cg_apply_call(
-        lay, op.geom, op.kappa,
-        np.asarray(op.phi0_c, np.float64), np.asarray(op.dphi1_c, np.float64),
-        op.is_identity, op.geom_tables, True, True, r, p, jnp.float32(0.5),
-    )
-    recorder.check()
+def test_folded_engine_specs(geom, degree):
+    _run(f"folded_engine_{geom}_d{degree}")
 
 
 @pytest.mark.parametrize("geom", ["g", "corner"])
-def test_folded_fused_apply_specs(recorder, geom):
-    op = _mesh_op(40_000, 3, 0.1, geom)
-    lay = op.layout
-    x = _rand((lay.nblocks, 27, lay.block))
-    jax.jit(op.apply_cg)(x)
-    recorder.check()
+def test_folded_fused_apply_specs(geom):
+    _run(f"folded_apply_{geom}_d3")
 
 
 @pytest.mark.parametrize(
     "degree", [3, pytest.param(4, marks=pytest.mark.slow)])
 @pytest.mark.parametrize(
     "chunked", [False, pytest.param(True, marks=pytest.mark.slow)])
-def test_kron_df_engine_specs(recorder, degree, chunked):
+def test_kron_df_engine_specs(degree, chunked):
     """The fused df32 engine (ops.kron_cg_df): CG (update_p) and action
     forms, one-kernel and y-chunked."""
-    from bench_tpu_fem.ops.kron_cg_df import (
-        _engine_coeffs,
-        _kron_cg_df_call,
-        _kron_cg_df_call_chunked,
-    )
-    from bench_tpu_fem.ops.kron_df import (
-        build_kron_laplacian_df,
-        device_rhs_uniform_df,
-    )
-    from bench_tpu_fem.elements.tables import build_operator_tables
-
-    nc = compute_mesh_size(40_000, degree)
-    t = build_operator_tables(degree, 1, "gll")
-    mesh = create_box_mesh(nc)
-    op = build_kron_laplacian_df(mesh, degree, 1, "gll", tables=t)
-    b = device_rhs_uniform_df(t, mesh.n)
-    coeffs = _engine_coeffs(op)
-    from bench_tpu_fem.ops.kron_cg_df import _beta4
-    from bench_tpu_fem.la.df64 import DF
-
-    call = _kron_cg_df_call_chunked if chunked else _kron_cg_df_call
-    beta = _beta4(DF(jnp.float32(0.5), jnp.float32(0.0)))
-    call(op, coeffs, True, True, b, b, beta)
-    call(op, coeffs, False, True, b)
-    recorder.check()
+    _run(f"kron_df_engine_d{degree}" + ("_chunked" if chunked else ""))
 
 
-def test_dist_kron_df_engine_specs(recorder):
+def test_dist_kron_df_engine_specs():
     """The distributed fused df engine (dist.kron_cg_df): the halo-form
     df kernel's specs, via the per-shard apply on a 4-device x mesh."""
-    from functools import partial
-
-    from jax.sharding import PartitionSpec as P
-
-    from bench_tpu_fem.dist.kron_cg_df import dist_kron_df_apply_ring_local
-    from bench_tpu_fem.dist.kron_df import build_dist_kron_df
-    from bench_tpu_fem.dist.mesh import AXIS_NAMES, make_device_grid
-    from bench_tpu_fem.elements.tables import build_operator_tables
-    from bench_tpu_fem.la.df64 import DF
-
-    dgrid = make_device_grid(dshape=(4, 1, 1))
-    t = build_operator_tables(3, 1, "gll")
-    op = build_dist_kron_df((8, 2, 2), dgrid, 3, 1, tables=t)
-
-    @partial(jax.shard_map, mesh=dgrid.mesh,
-             in_specs=(P(*AXIS_NAMES), P(*AXIS_NAMES), P()),
-             out_specs=P(*AXIS_NAMES), check_vma=False)
-    def run(xh, xl, A):
-        y = dist_kron_df_apply_ring_local(
-            A, DF(xh[0, 0, 0], xl[0, 0, 0]))
-        return y.hi[None, None, None]
-
-    Lx, LY, LZ = op.L
-    xh = _rand((4, 1, 1, Lx, LY, LZ))
-    xl = _rand((4, 1, 1, Lx, LY, LZ))
-    jax.jit(run)(xh, xl, op)
-    recorder.check()
+    _run("dist_kron_df_halo")
 
 
 @pytest.mark.parametrize("geom", ["g", "corner"])
-def test_folded_df_apply_specs(recorder, geom):
+def test_folded_df_apply_specs(geom):
     """The folded df window kernel (ops.folded_df): 16 window operands +
     df geometry channels, both geometry modes."""
-    from bench_tpu_fem.la.df64 import DF
-    from bench_tpu_fem.ops.folded import fold_vector
-    from bench_tpu_fem.ops.folded_df import build_folded_laplacian_df
-
-    nc = compute_mesh_size(40_000, 3)
-    mesh = create_box_mesh(nc, geom_perturb_fact=0.1)
-    op = build_folded_laplacian_df(mesh, 3, 1, geom=geom)
-    lay = op.layout
-    rng = np.random.RandomState(0)
-    from bench_tpu_fem.mesh.dofmap import dof_grid_shape
-
-    x = rng.rand(*dof_grid_shape(nc, 3))
-    xh = np.asarray(x, np.float32)
-    xl = np.asarray(x - np.asarray(xh, np.float64), np.float32)
-    xf = DF(jnp.asarray(fold_vector(xh, lay)),
-            jnp.asarray(fold_vector(xl, lay)))
-    jax.jit(op.apply)(xf)
-    recorder.check()
+    _run(f"folded_df_apply_{geom}_d3")
 
 
-def test_kron_df_update_pass_specs(recorder):
-    from bench_tpu_fem.la.df64 import DF
-    from bench_tpu_fem.ops.kron_cg_df import cg_update_df_pallas
-
-    shape = (7, 70, 13)
-    x, p, r, y = (DF(_rand(shape), _rand(shape) * 1e-8) for _ in range(4))
-    alpha = DF(jnp.float32(0.3), jnp.float32(0.0))
-    cg_update_df_pallas(x, p, r, y, alpha, interpret=True)
-    recorder.check()
+def test_kron_df_update_pass_specs():
+    _run("kron_df_update_pass")
 
 
-def test_dist_kron_engine_3d_specs(recorder):
+def test_dist_kron_engine_3d_specs():
     """The ext2d (3D-sharded) engine form: halo-extended cross-section
     inputs, extended coefficient slices, mask/weight planes."""
-    from functools import partial
-
-    from jax.sharding import PartitionSpec as P
-
-    from bench_tpu_fem.dist.kron import build_dist_kron
-    from bench_tpu_fem.dist.kron_cg import dist_kron_apply_ring_local
-    from bench_tpu_fem.dist.mesh import AXIS_NAMES, make_device_grid
-
-    dgrid = make_device_grid(dshape=(2, 2, 2))
-    op = build_dist_kron((4, 4, 4), dgrid, 3, 1, dtype=jnp.float32)
-
-    @partial(jax.shard_map, mesh=dgrid.mesh,
-             in_specs=(P(*AXIS_NAMES), P()), out_specs=P(*AXIS_NAMES),
-             check_vma=False)
-    def run(x, A):
-        return dist_kron_apply_ring_local(A, x[0, 0, 0],
-                                          interpret=True)[None, None, None]
-
-    x = _rand((2, 2, 2, op.L[0], op.L[1], op.L[2]))
-    jax.jit(run)(x, op)
-    recorder.check()
+    _run("dist_kron_engine_ext2d")
 
 
 @pytest.mark.parametrize("degree", [3, 5])
-def test_dist_kron_engine_specs(recorder, degree):
-    from functools import partial
-
-    from jax.sharding import PartitionSpec as P
-
-    from bench_tpu_fem.dist.kron import build_dist_kron
-    from bench_tpu_fem.dist.kron_cg import (
-        _dist_kron_cg_call,
-        _extend_rp,
-        _shard_tables,
-    )
-    from bench_tpu_fem.dist.mesh import AXIS_NAMES, make_device_grid
-
-    dgrid = make_device_grid(dshape=(4, 1, 1))
-    n = (8, 2, 2)
-    op = build_dist_kron(n, dgrid, degree, 1, dtype=jnp.float32)
-    Lx, NY, NZ = op.L[0], op.notbc1d[1].shape[0], op.notbc1d[2].shape[0]
-
-    @partial(jax.shard_map, mesh=dgrid.mesh,
-             in_specs=(P(AXIS_NAMES[0]), P(AXIS_NAMES[0]), P()),
-             out_specs=P(AXIS_NAMES[0]), check_vma=False)
-    def run(r, p, A):
-        cx, aux = _shard_tables(A, jnp.float32)
-        r_ext, p_ext = _extend_rp(r, p, A.degree)
-        pp, y, _ = _dist_kron_cg_call(A, cx, aux, True, True,
-                                      r_ext, p_ext, jnp.float32(0.5))
-        return y
-
-    r = _rand((4 * Lx, NY, NZ))  # shard_map blocks the x axis into 4 locals
-    p = _rand((4 * Lx, NY, NZ))
-    jax.jit(run)(r, p, op)
-    recorder.check()
+def test_dist_kron_engine_specs(degree):
+    _run(f"dist_kron_engine_d{degree}")
 
 
 @pytest.mark.slow
-def test_dist_folded_engine_specs(recorder):
+def test_dist_folded_engine_specs():
     """The dist folded halo-form delay-ring kernel (dist.folded_cg): the
     streamed bc/owned mask blocks must ride full-trailing-dim
     (1, P^3, B) specs like every other folded operand."""
-    from functools import partial
-
-    from jax.sharding import PartitionSpec as P
-
-    from bench_tpu_fem.dist.folded import (
-        build_dist_folded,
-        make_folded_sharded_fns,
-    )
-    from bench_tpu_fem.dist.mesh import AXIS_NAMES, make_device_grid
-    from bench_tpu_fem.elements.tables import build_operator_tables
-
-    dgrid = make_device_grid(dshape=(2, 1, 1))
-    mesh = create_box_mesh((4, 2, 2), geom_perturb_fact=0.1)
-    t = build_operator_tables(3, 1)
-    op = build_dist_folded(mesh, dgrid, 3, t, dtype=jnp.float32, nl=16)
-    apply_fn, _, _, sharded_state = make_folded_sharded_fns(
-        op, dgrid, 1, engine=True
-    )
-    lay = op.layout
-    x = _rand((2, 1, 1, lay.nblocks, 27, lay.block))
-    jax.jit(apply_fn)(x, sharded_state(op))
-    recorder.check()
+    _run("dist_folded_engine")
 
 
 @pytest.mark.slow
-def test_dist_kron_df_engine_ext2d_specs(recorder):
+def test_dist_kron_df_engine_ext2d_specs():
     """The ext2d df engine form (dist.kron_cg_df on a 3D mesh):
     halo-extended DF plane inputs, extended 4-channel coefficient
     slices, streamed mask/weight planes."""
-    from functools import partial
+    _run("dist_kron_df_ext2d")
 
-    from jax.sharding import PartitionSpec as P
 
-    from bench_tpu_fem.dist.kron_cg_df import dist_kron_df_apply_ring_local
-    from bench_tpu_fem.dist.kron_df import build_dist_kron_df
-    from bench_tpu_fem.dist.mesh import AXIS_NAMES, make_device_grid
-    from bench_tpu_fem.elements.tables import build_operator_tables
-    from bench_tpu_fem.la.df64 import DF
+def test_degree_sweep_configs_present():
+    """The acceptance sweep — every VMEM estimator cross-checked at
+    degrees {1, 3, 6} in both geometry modes — must stay in the matrix
+    (the CLI drives it; this guards against the matrix shrinking)."""
+    from bench_tpu_fem.analysis.configs import config_names
 
-    dgrid = make_device_grid(dshape=(2, 2, 2))
-    t = build_operator_tables(3, 1, "gll")
-    op = build_dist_kron_df((4, 4, 4), dgrid, 3, 1, tables=t)
-
-    @partial(jax.shard_map, mesh=dgrid.mesh,
-             in_specs=(P(*AXIS_NAMES), P(*AXIS_NAMES), P()),
-             out_specs=P(*AXIS_NAMES), check_vma=False)
-    def run(xh, xl, A):
-        y = dist_kron_df_apply_ring_local(
-            A, DF(xh[0, 0, 0], xl[0, 0, 0]))
-        return y.hi[None, None, None]
-
-    Lx, LY, LZ = op.L
-    xh = _rand((2, 2, 2, Lx, LY, LZ))
-    xl = _rand((2, 2, 2, Lx, LY, LZ))
-    jax.jit(run)(xh, xl, op)
-    recorder.check()
+    names = set(config_names())
+    for d in (1, 3, 6):
+        assert f"kron_engine_d{d}" in names
+        assert f"kron_df_engine_d{d}" in names
+        for geom in ("g", "corner"):
+            assert f"folded_engine_{geom}_d{d}" in names
+            assert f"folded_apply_{geom}_d{d}" in names
+            assert f"folded_df_apply_{geom}_d{d}" in names
