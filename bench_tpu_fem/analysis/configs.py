@@ -368,6 +368,61 @@ def drive_folded_df_apply(geom: str, degree: int) -> ConfigResult:
         s.kernels, plan=_folded_df_plan_check(degree, t.nq, geom))
 
 
+def drive_serve_batched_apply(geom: str, degree: int,
+                              nrhs: int = 4) -> ConfigResult:
+    """The serving layer's batched apply: the SAME folded fused-apply
+    kernel as folded_apply_*, traced THROUGH `jax.vmap` — the
+    bench/serve batched path (cg_solve_batched's vmapped operator).
+    vmap batches the pallas grid, never the block shapes, so the
+    captured specs must lint identically to the unbatched drive; this
+    config keeps that claim continuously verified instead of assumed."""
+    import jax
+
+    from bench_tpu_fem.elements.tables import build_operator_tables
+
+    name = f"serve_batched_apply_{geom}_d{degree}"
+    t = build_operator_tables(degree, 1, "gll")
+    plan, unshipped = _folded_plan_or_unsupported(name, geom, degree, t.nq)
+    op = _mesh_op(DEFAULT_NDOFS, degree, 0.1, geom)
+    lay = op.layout
+    B = _f32((nrhs, lay.nblocks, degree ** 3, lay.block))
+    with CaptureSession() as s:
+        jax.eval_shape(jax.vmap(op.apply_cg), B)
+    return ConfigResult(
+        name, {"engine": "folded", "pass": "batched_apply", "geom": geom,
+               "degree": degree, "dtype": "f32", "nrhs": nrhs},
+        s.kernels, plan=plan, plan_unsupported=unshipped)
+
+
+def drive_serve_batched_kron_3stage(degree: int = 3,
+                                    nrhs: int = 4) -> ConfigResult:
+    """Batched (vmapped) kron 3-stage pallas apply — the uniform-mesh
+    serving twin of drive_serve_batched_apply."""
+    import jax
+    import jax.numpy as jnp
+
+    from bench_tpu_fem.mesh.box import create_box_mesh
+    from bench_tpu_fem.mesh.sizing import compute_mesh_size
+    from bench_tpu_fem.ops.kron import build_kron_laplacian
+    from bench_tpu_fem.ops.kron_pallas import kron_apply_pallas
+
+    nc = compute_mesh_size(DEFAULT_NDOFS, degree)
+    mesh = create_box_mesh(nc)
+    op = build_kron_laplacian(mesh, degree, qmode=1, dtype=jnp.float32)
+    shape = tuple(int(a.shape[0]) for a in op.notbc1d)
+    with CaptureSession() as s:
+        jax.eval_shape(
+            jax.vmap(lambda x: kron_apply_pallas(
+                x, op.Kd, op.Md, op.notbc1d, op.kappa, degree,
+                interpret=True)),
+            _f32((nrhs, *shape)))
+    return ConfigResult(
+        f"serve_batched_kron_3stage_d{degree}",
+        {"engine": "kron", "pass": "batched_apply", "dtype": "f32",
+         "nrhs": nrhs},
+        s.kernels)
+
+
 # ---------------------------------------------------------------------------
 # Distributed drives (collectives captured from the same trace)
 # ---------------------------------------------------------------------------
@@ -603,6 +658,15 @@ def _matrix() -> list[ConfigSpec]:
             specs.append(ConfigSpec(
                 f"folded_df_apply_{geom}_d{d}",
                 lambda g=geom, d=d: drive_folded_df_apply(g, d)))
+    # serve-layer batched (vmapped) applies, degrees {1, 3, 6} + the
+    # uniform kron twin (ISSUE 5: the batched configs run through the
+    # same R1-R5 engine as the one-shot forms).
+    for d in (1, 3, 6):
+        specs.append(ConfigSpec(
+            f"serve_batched_apply_corner_d{d}",
+            lambda d=d: drive_serve_batched_apply("corner", d)))
+    specs.append(ConfigSpec("serve_batched_kron_3stage_d3",
+                            drive_serve_batched_kron_3stage))
     # distributed forms (8 virtual CPU devices).
     for d in (3, 5):
         specs.append(ConfigSpec(

@@ -39,6 +39,7 @@ def results_json(cfg: BenchConfig, res: BenchmarkResults) -> str:
             "mat_comp": cfg.mat_comp,
             "qmode": cfg.qmode,
             "cg": cfg.use_cg,
+            "nrhs": cfg.nrhs,
         },
         "output": {
             "ncells_global": res.ncells_global,
@@ -52,4 +53,10 @@ def results_json(cfg: BenchConfig, res: BenchmarkResults) -> str:
             "gdof_per_second": res.gdof_per_second,
         },
     }
+    if cfg.nrhs > 1:
+        # batched artifact stamp: GDoF/s above accounts the whole batch
+        # (ndofs * nreps * nrhs / t); the bucket is the serve cache's
+        # padding class for this batch size
+        root["output"]["nrhs"] = res.extra.get("nrhs", cfg.nrhs)
+        root["output"]["nrhs_bucket"] = res.extra.get("nrhs_bucket")
     return json.dumps(root)

@@ -34,6 +34,12 @@ def build_parser() -> argparse.ArgumentParser:
                         "direction, qmode=1 has P+2 points in each direction.")
     p.add_argument("--cg", action="store_true",
                    help="Do CG iterations, rather than simple operator action")
+    p.add_argument("--nrhs", type=int, default=1,
+                   help="Batched multi-RHS: solve this many right-hand "
+                        "sides (distinct per-lane scales of the benchmark "
+                        "RHS) in ONE batched computation — the serving-"
+                        "layer shape (bench_tpu_fem.serve). GDoF/s "
+                        "accounts the batch: ndofs x nreps x nrhs / t.")
     p.add_argument("--nreps", type=int, default=1000, help="Number of repetitions")
     p.add_argument("--degree", type=int, default=3, help='Polynomial degree "P" (1-7)')
     p.add_argument("--mat_comp", action="store_true",
@@ -75,6 +81,8 @@ def main(argv: list[str] | None = None) -> int:
     # the reference (main.cpp:192-196) — even if a value equals its default.
     if args.ndofs is not None and args.ndofs_global is not None:
         raise SystemExit("Conflicting options 'ndofs' and 'ndofs_global'")
+    if args.nrhs < 1:
+        raise SystemExit("Invalid nrhs. Must be >= 1.")
 
     from .utils.logging import init_logging
 
@@ -144,6 +152,7 @@ def main(argv: list[str] | None = None) -> int:
         backend=args.backend,
         f64_impl=args.f64_impl,
         profile_dir=args.profile,
+        nrhs=args.nrhs,
     )
 
     dev = devices[0]

@@ -86,6 +86,60 @@ def make_sharded_fns(op, dgrid, nreps: int):
     return apply_fn, cg_fn, norm_fn
 
 
+def make_sharded_batched_cg(op, dgrid, nreps: int):
+    """Batched multi-RHS sharded CG for the general-geometry (xla)
+    operator: vmapped local apply + owned-dof-masked psum'd batched dot
+    (see dist.kron.make_kron_batched_cg_fn for the kron twin and the
+    design note)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from ..la.cg import cg_solve_batched
+    from .halo import psum_all
+
+    bspec = P(None, *AXIS_NAMES)
+    spec = P(*AXIS_NAMES)
+
+    @partial(jax.shard_map, mesh=dgrid.mesh,
+             in_specs=(bspec, spec, spec), out_specs=bspec,
+             check_vma=False)
+    def cg_fn(Bv, G, bc):
+        Bl, Gl, bcl = Bv[:, 0, 0, 0], G[0, 0, 0], bc[0, 0, 0]
+        mask = owned_mask(Bl.shape[1:]).astype(Bl.dtype)
+
+        def bdot(U, V):
+            return psum_all(jnp.sum(U * V * mask[None],
+                                    axis=tuple(range(1, U.ndim))))
+
+        X = cg_solve_batched(
+            lambda v: op.apply_local(v, Gl, bcl), Bl,
+            jnp.zeros_like(Bl), nreps, dot=bdot,
+        )
+        return X[:, None, None, None]
+
+    return cg_fn
+
+
+def batch_sharded_rhs(u, nrhs: int, dgrid):
+    """(nrhs, Dx, Dy, Dz, ...) batched RHS stack from the sharded u:
+    per-lane power-of-two scales (bench.driver.batch_scales — lane 0 is
+    the one-shot problem verbatim), resharded so the batch axis is
+    replicated and the grid axes keep their shards."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ..bench.driver import batch_scales
+
+    scales = jnp.asarray(batch_scales(nrhs), u.dtype)
+    sharding = NamedSharding(dgrid.mesh, P(None, *AXIS_NAMES))
+    return jax.jit(
+        lambda v: scales.reshape((-1,) + (1,) * v.ndim) * v[None],
+        out_shardings=sharding,
+    )(u)
+
+
 def run_distributed(cfg, res, dtype):
     """Multi-device benchmark. Fills and returns `res` (BenchmarkResults)."""
     import jax
@@ -245,7 +299,36 @@ def run_distributed(cfg, res, dtype):
             apply_args = (op.G, op.bc_mask)
             norm_args = ()
 
-        if cfg.use_cg:
+        run_input = u
+        if cfg.nrhs > 1:
+            # Batched multi-RHS sharded solve (the serving-layer shape):
+            # one executable, psum'd batched dots, unfused vmapped local
+            # apply — the fused engines have no batched form (recorded).
+            from ..bench.driver import BATCHED_UNFUSED_REASON, stamp_nrhs
+
+            if not cfg.use_cg:
+                raise ValueError(
+                    "batched multi-RHS (nrhs>1) sharded runs require "
+                    "--cg; batched sharded action is unsupported")
+            if folded:
+                raise ValueError(
+                    "batched multi-RHS sharded CG supports the kron and "
+                    "xla backends; the folded (pallas) sharded batch "
+                    "form is unsupported")
+            record_engine(res.extra, False, error=BATCHED_UNFUSED_REASON)
+            stamp_nrhs(res.extra, cfg.nrhs)
+            if kron:
+                from .kron import make_kron_batched_cg_fn
+
+                cg_fn = make_kron_batched_cg_fn(op, dgrid, cfg.nreps)
+            else:
+                cg_fn = make_sharded_batched_cg(op, dgrid, cfg.nreps)
+            B = batch_sharded_rhs(u, cfg.nrhs, dgrid)
+            run_input = B
+            # unfused path: the default scoped limit suffices (kron/xla)
+            fn = compile_lowered(jax.jit(cg_fn).lower(B, *cg_args))
+            run_args = cg_args
+        elif cfg.use_cg:
             try:
                 fn = compile_lowered(jax.jit(cg_fn).lower(u, *cg_args),
                                      compile_opts)
@@ -317,7 +400,7 @@ def run_distributed(cfg, res, dtype):
         # operator throughput. A cheaper 1-rep warm-up would need a SECOND
         # full compile of the CG loop (tens of seconds) to save a few
         # seconds of device time — net slower at every size we run.
-        warm = fn(u, *run_args)
+        warm = fn(run_input, *run_args)
         float(warm[(0,) * warm.ndim])
         del warm
 
@@ -329,17 +412,22 @@ def run_distributed(cfg, res, dtype):
     )
     with prof:
         t0 = time.perf_counter()
-        y = fn(u, *run_args)
+        y = fn(run_input, *run_args)
         y.block_until_ready()
         float(y[(0,) * y.ndim])  # tunnel fence (see bench.driver)
         elapsed = time.perf_counter() - t0
 
+    if cfg.nrhs > 1:
+        # lane 0 (scale 1.0) is the one-shot problem verbatim: norms and
+        # the mat_comp oracle below read it, GDoF/s accounts the batch
+        y = y[0]
     res.mat_free_time = elapsed
     un = np.asarray(norm_c(u, *norm_args))
     yn = np.asarray(norm_c(y, *norm_args))
     res.unorm, res.unorm_linf = float(un[0]), float(un[1])
     res.ynorm, res.ynorm_linf = float(yn[0]), float(yn[1])
-    res.gdof_per_second = res.ndofs_global * cfg.nreps / (1e9 * elapsed)
+    res.gdof_per_second = (
+        res.ndofs_global * cfg.nreps * cfg.nrhs / (1e9 * elapsed))
 
     if cfg.mat_comp:
         from ..bench.driver import _mat_comp_oracle
@@ -582,9 +670,43 @@ def run_distributed_df64(cfg, res):
         from .kron_cg_df import _is_x_only, dist_df_engine_plan
         from .kron_df import resolve_df_engine
 
-        engine = resolve_df_engine(op)
-        record_engine(res.extra, engine,
-                      "halo" if _is_x_only(op) else "ext2d")
+        u_run = u
+        if cfg.nrhs > 1:
+            # batched multi-RHS sharded df: vmapped unfused local df
+            # solve + compensated psum dots (dist.kron_df); the fused
+            # dist df engine has no batched form — recorded fallback
+            from ..bench.driver import (
+                BATCHED_UNFUSED_REASON,
+                batch_scales,
+                stamp_nrhs,
+            )
+            from .kron_df import make_kron_df_batched_cg_fn
+
+            if not cfg.use_cg:
+                raise ValueError(
+                    "batched multi-RHS (nrhs>1) sharded df runs require "
+                    "--cg; batched sharded df action is unsupported")
+            record_engine(res.extra, False, error=BATCHED_UNFUSED_REASON)
+            stamp_nrhs(res.extra, cfg.nrhs)
+            _, _, norm_fn, norms_from = make_kron_df_sharded_fns(
+                op, dgrid, cfg.nreps, engine=False)
+            sc = jnp.asarray(batch_scales(cfg.nrhs), jnp.float32)
+            bsh = NamedSharding(dgrid.mesh, P(None, *AXIS_NAMES))
+
+            def _mk(c):
+                return jax.device_put(
+                    sc.reshape((-1,) + (1,) * c.ndim) * c[None], bsh)
+
+            u_run = DF(_mk(u.hi), _mk(u.lo))
+            cg_bat = make_kron_df_batched_cg_fn(op, dgrid, cfg.nreps)
+            fn = compile_lowered(
+                jax.jit(cg_bat).lower(u_run, op),
+                cpu_extra=CPU_DF_DIST_OPTIONS)
+            engine = False
+        else:
+            engine = resolve_df_engine(op)
+            record_engine(res.extra, engine,
+                          "halo" if _is_x_only(op) else "ext2d")
         opts = (scoped_vmem_options(dist_df_engine_plan(op)[1])
                 if engine else None)
         from ..la.df64 import df_zeros_like
@@ -610,17 +732,19 @@ def run_distributed_df64(cfg, res):
                 low, extra=opts if eng else None,
                 cpu_extra=CPU_DF_DIST_OPTIONS)
 
-        try:
-            norm_fn, norms_from, fn = _build(engine)
-        except Exception as exc:
-            # a Mosaic rejection of the fused dist df engine must not
-            # sink the benchmark: record and complete on the unfused path
-            if not engine:
-                raise
-            engine = False
-            record_engine(res.extra, False, error=exc)
-            norm_fn, norms_from, fn = _build(False)
-        warm = fn(u, op)
+        if cfg.nrhs == 1:
+            try:
+                norm_fn, norms_from, fn = _build(engine)
+            except Exception as exc:
+                # a Mosaic rejection of the fused dist df engine must not
+                # sink the benchmark: record and complete on the unfused
+                # path
+                if not engine:
+                    raise
+                engine = False
+                record_engine(res.extra, False, error=exc)
+                norm_fn, norms_from, fn = _build(False)
+        warm = fn(u_run, op)
         float(warm.hi[(0,) * warm.hi.ndim])
         del warm
 
@@ -632,17 +756,22 @@ def run_distributed_df64(cfg, res):
     )
     with prof:
         t0 = time.perf_counter()
-        y = fn(u, op)
+        y = fn(u_run, op)
         jax.block_until_ready(y)
         float(y.hi[(0,) * y.hi.ndim])  # tunnel fence (see bench.driver)
         res.mat_free_time = time.perf_counter() - t0
 
+    if cfg.nrhs > 1:
+        # lane 0 (scale 1.0) is the one-shot problem verbatim; GDoF/s
+        # accounts the whole batch
+        y = DF(y.hi[0], y.lo[0])
     norm_c = compile_lowered(jax.jit(norm_fn).lower(u, op),
                              cpu_extra=CPU_DF_DIST_OPTIONS)
     res.unorm, res.unorm_linf = norms_from(norm_c(u, op))
     res.ynorm, res.ynorm_linf = norms_from(norm_c(y, op))
     res.gdof_per_second = (
-        res.ndofs_global * cfg.nreps / (1e9 * res.mat_free_time)
+        res.ndofs_global * cfg.nreps * cfg.nrhs
+        / (1e9 * res.mat_free_time)
     )
 
     if cfg.mat_comp:

@@ -370,6 +370,44 @@ def make_kron_sharded_fns(op: DistKronLaplacian, dgrid, nreps: int,
     return apply_fn, cg_fn, norm_fn
 
 
+def make_kron_batched_cg_fn(op: DistKronLaplacian, dgrid, nreps: int):
+    """Batched multi-RHS sharded CG (the serving-layer shape): a
+    (nrhs, Dx, Dy, Dz, Lx, Ly, Lz) stack solved in ONE shard_map
+    computation — vmapped UNFUSED local apply (the halo ppermutes batch
+    cleanly under vmap; the fused delay-ring engine has no batched form
+    and the caller records that), with the owned-dof-masked psum'd
+    BATCHED dot: each lane's partial dots reduce locally to a (nrhs,)
+    vector, then one psum over the device grid carries all lanes — per
+    lane exactly the reference's MPI_Allreduce dot, amortised across
+    the batch."""
+    from jax.sharding import PartitionSpec as P
+
+    from ..la.cg import cg_solve_batched
+    from .halo import psum_all
+
+    bspec = P(None, *AXIS_NAMES)
+    rep = P()
+
+    @partial(jax.shard_map, mesh=dgrid.mesh, in_specs=(bspec, rep),
+             out_specs=bspec, check_vma=False)
+    def cg_fn(Bv, A):
+        Bl = Bv[:, 0, 0, 0]
+        coeffs = A.local_coeffs()  # hoisted: sliced once, shared by lanes
+        mask = owned_mask(Bl.shape[1:]).astype(Bl.dtype)
+
+        def bdot(U, V):
+            return psum_all(jnp.sum(U * V * mask[None],
+                                    axis=tuple(range(1, U.ndim))))
+
+        X = cg_solve_batched(
+            lambda v: A.apply_local(v, coeffs), Bl,
+            jnp.zeros_like(Bl), nreps, dot=bdot,
+        )
+        return X[:, None, None, None]
+
+    return cg_fn
+
+
 def make_kron_rhs_fn(op: DistKronLaplacian, dgrid, tables: OperatorTables):
     """Jittable sharded RHS builder: b = M3d f_h per shard, from the global
     separable 1D factors (ops.kron.rhs_factors_1d — O(N^(1/3)) host work,

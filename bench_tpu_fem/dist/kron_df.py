@@ -499,6 +499,30 @@ def make_kron_df_sharded_fns(op: DistKronLaplacianDF, dgrid, nreps: int,
     return apply_fn, cg_fn, norm_fn, norms_from
 
 
+def make_kron_df_batched_cg_fn(op: DistKronLaplacianDF, dgrid, nreps: int):
+    """Batched multi-RHS sharded df CG: the whole per-lane UNFUSED local
+    df solve (`dist_cg_solve_df_local` — df halo exchange, compensated
+    psum dots, per-lane residual-floor freeze) vmapped over the batch
+    axis inside one shard_map. The df collectives and the
+    optimization_barrier laundering batch under vmap (utils.jax_compat
+    registers the barrier's pass-through batching rule on older jax);
+    the fused dist df engine has no batched form — the caller records
+    the unfused fallback."""
+    from jax.sharding import PartitionSpec as P
+
+    bspec = P(None, *AXIS_NAMES)
+    rep = P()
+
+    @partial(jax.shard_map, mesh=dgrid.mesh, in_specs=(bspec, rep),
+             out_specs=bspec, check_vma=False)
+    def cg_fn(b, A):
+        lb = DF(b.hi[:, 0, 0, 0], b.lo[:, 0, 0, 0])
+        X = jax.vmap(lambda v: dist_cg_solve_df_local(A, v, nreps))(lb)
+        return DF(X.hi[:, None, None, None], X.lo[:, None, None, None])
+
+    return cg_fn
+
+
 def make_kron_df_rhs_fn(op: DistKronLaplacianDF, dgrid,
                         tables: OperatorTables):
     """Per-shard separable df RHS (the df twin of

@@ -302,6 +302,45 @@ assert res.extra.get("cg_engine_form") == "ext2d", res.extra
 """
 
 
+SERVE_SMOKE = """
+import os
+if os.environ.get('JAX_PLATFORMS', '') == 'cpu':
+    from bench_tpu_fem.utils.hermetic import force_host_cpu_devices
+    force_host_cpu_devices(1)
+import json, threading, urllib.request
+from bench_tpu_fem.serve import (Broker, ExecutableCache, Metrics,
+                                 SolveSpec, make_server)
+cache = ExecutableCache(); metrics = Metrics()
+broker = Broker(cache, metrics, queue_max=256, nrhs_max=8, window_s=0.2)
+specs = [SolveSpec(degree=d, ndofs=4000, nreps=15) for d in (1, 2, 3)]
+broker.warmup(specs)
+compiles0 = cache.stats()['compiles']
+srv = make_server(broker); host, port = srv.server_address[:2]
+threading.Thread(target=srv.serve_forever, daemon=True).start()
+results = []
+def fire(i):
+    spec = specs[i % 3]
+    body = json.dumps({'degree': spec.degree, 'ndofs': spec.ndofs,
+                       'nreps': spec.nreps, 'scale': 1.0}).encode()
+    req = urllib.request.Request(f'http://{host}:{port}/solve',
+                                 data=body, method='POST')
+    with urllib.request.urlopen(req, timeout=120) as r:
+        results.append(json.loads(r.read()))
+threads = [threading.Thread(target=fire, args=(i,)) for i in range(64)]
+[t.start() for t in threads]; [t.join() for t in threads]
+snap = json.loads(urllib.request.urlopen(
+    f'http://{host}:{port}/metrics', timeout=30).read())
+srv.shutdown(); broker.shutdown()
+assert len(results) == 64 and all(r['ok'] for r in results), snap
+assert snap['mean_batch_occupancy'] >= 4.0, snap
+assert snap['cache_hit_rate_requests'] > 0.9, snap
+assert cache.stats()['compiles'] == compiles0, cache.stats()
+print('SERVE OK', {k: round(snap[k], 3) for k in (
+    'requests_total', 'batches', 'mean_batch_occupancy',
+    'cache_hit_rate_requests')})
+"""
+
+
 def make_stages(round_tag: str = DEFAULT_ROUND) -> dict[str, Stage]:
     """All known stages by name. Gate topology: ``dfacc`` (the
     on-hardware df accuracy oracle) gates every df perf stage; the gate
@@ -331,6 +370,12 @@ def make_stages(round_tag: str = DEFAULT_ROUND) -> dict[str, Stage]:
             setup="import bench_tpu_fem.ops.kron_cg as KC\n"
                   "KC.VMEM_BUDGET = 14 * 2**20  # probe the one-kernel "
                   "form"), 1800),
+        # Serving-layer smoke (CPU-pinned: a software-stack check, not a
+        # hardware measurement — and it must never hang on a wedged
+        # tunnel): 64 concurrent mixed-degree requests through the
+        # broker, asserting batch occupancy, warm-cache hit-rate and
+        # zero recompiles. See README "Serving".
+        _py("serve", SERVE_SMOKE, 300, env={"JAX_PLATFORMS": "cpu"}),
         _py("dfacc", DFACC, 1800, provides="dfacc"),
         _py("pertdf", PERTDF, 2400, gate="dfacc"),
         _py("foldeng", FOLDENG, 2400),
@@ -406,7 +451,7 @@ ALIASES = {
 # Round-6 default agenda, ordered by value-per-minute under wedge risk
 # (measure_all's ordering, expanded through ALIASES).
 AGENDAS = {
-    "round6": ["health", "dfacc", "pertdf", "foldeng", "dfext2d",
+    "round6": ["health", "serve", "dfacc", "pertdf", "foldeng", "dfext2d",
                "dfeng", "bench", "dflarge", "pert100", "deg7probe",
                "matrix"],
 }

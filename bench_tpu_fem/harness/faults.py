@@ -126,3 +126,40 @@ class FakeSleep:
 
     def __call__(self, seconds: float) -> None:
         self.waits.append(seconds)
+
+
+class FaultySolveHook:
+    """Scripted serve-layer solve faults: install as
+    ``serve.engine.FAULT_HOOK`` and every compiled-solver execution pops
+    the next outcome — "ok" passes through, "oom"/"mosaic"/"accuracy"
+    raise RuntimeErrors carrying the canned hardware texts (so the
+    broker's classifier sees the same evidence real failures produce),
+    "hang" sleeps past the broker's batch deadline (the
+    abandoned-thread path), "crash" raises a transient. Past the end of
+    the script everything succeeds — an incident that ENDS, so the test
+    can also assert recovery. Calls are recorded for assertions."""
+
+    def __init__(self, script: list[str], hang_s: float = 30.0,
+                 sleep=None):
+        import time as _time
+
+        self.script = list(script)
+        self.hang_s = hang_s
+        self.sleep = sleep or _time.sleep
+        self.calls: list[tuple[str, int]] = []
+
+    def __call__(self, spec, scales) -> None:
+        outcome = self.script.pop(0) if self.script else "ok"
+        self.calls.append((outcome, len(scales)))
+        if outcome == "ok":
+            return
+        if outcome == "oom":
+            raise RuntimeError(OOM_TEXT)
+        if outcome == "mosaic":
+            raise RuntimeError(MOSAIC_TEXT)
+        if outcome == "accuracy":
+            raise RuntimeError(ACCURACY_TEXT)
+        if outcome == "hang":
+            self.sleep(self.hang_s)
+            return
+        raise RuntimeError(f"Traceback: injected {outcome} fault")

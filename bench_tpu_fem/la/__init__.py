@@ -7,7 +7,7 @@ whole CG iteration (halo exchange, operator, two dots, three axpys) is one
 XLA computation with no host round-trips.
 """
 
-from .cg import cg_solve
+from .cg import cg_solve, cg_solve_batched
 from .vector import (
     axpy,
     inner_product,
@@ -22,6 +22,7 @@ from .vector import (
 __all__ = [
     "axpy",
     "cg_solve",
+    "cg_solve_batched",
     "inner_product",
     "inner_product_compensated",
     "norm",

@@ -1,0 +1,63 @@
+"""Solver-as-a-service: batched multi-RHS CG behind an
+admission-controlled request broker with an AOT-executable cache.
+
+The one-shot benchmark amortises compile/launch cost by problem size
+(>= 10M dofs/device, README.md:160-163 in the reference); this package
+amortises it ACROSS REQUESTS — the production-serving shape the ROADMAP
+north star names:
+
+  engine.py   SolveSpec -> compiled batched solver (la.cg.cg_solve_batched
+              over the existing unfused operators; vmapped cg_solve_df
+              for df32 pairs)
+  cache.py    AOT executables keyed by (degree, cell shape, precision,
+              geometry class, engine form, nrhs bucket, device mesh),
+              LRU + hit/miss/evict/compile counters + warmup
+  broker.py   bounded-queue admission control, dynamic batching window,
+              per-batch hard deadline, harness-taxonomy fault classes
+  server.py   localhost HTTP/JSON front end (POST /solve, GET /metrics,
+              GET /healthz) — `python -m bench_tpu_fem.serve`
+  metrics.py  counters + crash-safe JSONL journal (harness.journal),
+              with `replay_serve` folding a journal back into the
+              incident summary
+
+Everything is stdlib + the existing jax stack: no new dependencies.
+"""
+
+from .broker import Broker, QueueFull, RETRIABLE_CLASSES
+from .cache import (
+    NRHS_BUCKETS,
+    ExecutableCache,
+    ExecutableKey,
+    default_cache,
+    nrhs_bucket,
+)
+from .engine import (
+    BatchResult,
+    CompiledSolver,
+    SolveSpec,
+    UnsupportedSpec,
+    build_solver,
+    spec_cache_key,
+)
+from .metrics import Metrics, replay_serve
+from .server import make_server
+
+__all__ = [
+    "BatchResult",
+    "Broker",
+    "CompiledSolver",
+    "ExecutableCache",
+    "ExecutableKey",
+    "Metrics",
+    "NRHS_BUCKETS",
+    "QueueFull",
+    "RETRIABLE_CLASSES",
+    "SolveSpec",
+    "UnsupportedSpec",
+    "build_solver",
+    "default_cache",
+    "make_server",
+    "nrhs_bucket",
+    "replay_serve",
+    "spec_cache_key",
+]

@@ -1,0 +1,109 @@
+"""`python -m bench_tpu_fem.serve`: run the localhost solver service.
+
+Example (CPU):
+
+    JAX_PLATFORMS=cpu python -m bench_tpu_fem.serve --port 8378 \
+        --warmup 1,3 --ndofs 50000 --nreps 30 --journal SERVE_r06.jsonl
+
+then:
+
+    curl -s -X POST localhost:8378/solve -d \
+      '{"degree": 3, "ndofs": 50000, "nreps": 30, "scale": 2.0}'
+    curl -s localhost:8378/metrics
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m bench_tpu_fem.serve",
+        description="Solver-as-a-service: batched multi-RHS CG with an "
+                    "AOT-executable cache behind an admission-controlled "
+                    "broker")
+    p.add_argument("--host", default="127.0.0.1")
+    p.add_argument("--port", type=int, default=8378,
+                   help="0 = ephemeral (printed on startup)")
+    p.add_argument("--queue-max", type=int, default=128,
+                   help="admission-control bound: beyond this, requests "
+                        "shed with a retriable 503")
+    p.add_argument("--nrhs-max", type=int, default=8,
+                   help="batching-window lane cap (pads to the bucket)")
+    p.add_argument("--window-ms", type=float, default=25.0,
+                   help="batching window: wait this long for compatible "
+                        "requests before solving a partial batch")
+    p.add_argument("--solve-timeout", type=float, default=120.0,
+                   help="hard per-batch deadline; overruns answer "
+                        "classified-timeout and are abandoned")
+    p.add_argument("--journal", default="",
+                   help="metrics JSONL journal path (crash-safe, "
+                        "harness.journal format)")
+    p.add_argument("--warmup", default="",
+                   help="comma-separated degrees to prebuild at startup "
+                        "(with --ndofs/--nreps/--precision), e.g. '1,3,6'")
+    p.add_argument("--ndofs", type=int, default=50_000,
+                   help="warmup spec ndofs")
+    p.add_argument("--nreps", type=int, default=30,
+                   help="warmup spec CG iterations")
+    p.add_argument("--precision", default="f32",
+                   choices=["f32", "f64", "df32"],
+                   help="warmup spec precision")
+    args = p.parse_args(argv)
+
+    # Hermetic CPU pinning, same contract as the CLI: a serving process
+    # must never hang on a wedged TPU tunnel when the caller pinned CPU.
+    import os
+
+    if os.environ.get("JAX_PLATFORMS", "") == "cpu":
+        from ..utils.hermetic import force_host_cpu_devices
+
+        force_host_cpu_devices(1)
+    import jax
+
+    # Serving accepts mixed precision in one process: x64 on, so
+    # f64-emulated requests trace at full width (f32/df32 operators pin
+    # their dtypes explicitly and are unaffected).
+    jax.config.update("jax_enable_x64", True)
+
+    from .broker import Broker
+    from .cache import ExecutableCache
+    from .engine import SolveSpec
+    from .metrics import Metrics
+    from .server import make_server
+
+    metrics = Metrics(args.journal or None)
+    broker = Broker(
+        ExecutableCache(), metrics,
+        queue_max=args.queue_max, nrhs_max=args.nrhs_max,
+        window_s=args.window_ms / 1000.0,
+        solve_timeout_s=args.solve_timeout,
+    )
+    if args.warmup:
+        degrees = [int(d) for d in args.warmup.split(",") if d.strip()]
+        specs = [SolveSpec(degree=d, ndofs=args.ndofs, nreps=args.nreps,
+                           precision=args.precision) for d in degrees]
+        print(f"warmup: compiling {len(specs)} executables "
+              f"(degrees {degrees}, bucket {broker.nrhs_max})", flush=True)
+        broker.warmup(specs)
+        print(f"warmup done: {broker.cache.stats()}", flush=True)
+
+    srv = make_server(broker, args.host, args.port)
+    host, port = srv.server_address[:2]
+    print(f"serving on http://{host}:{port} "
+          f"(queue_max={args.queue_max}, nrhs_max={broker.nrhs_max}, "
+          f"window={args.window_ms}ms)", flush=True)
+    try:
+        srv.serve_forever()
+    except KeyboardInterrupt:
+        pass
+    finally:
+        srv.shutdown()
+        broker.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,289 @@
+"""Admission-controlled request broker with a dynamic batching window.
+
+The serving core: requests enter a BOUNDED queue (admission control —
+a full queue sheds the request immediately with a retriable signal
+rather than letting latency grow without bound), a single batching
+worker drains it, collecting requests with the SAME `SolveSpec` until
+either `nrhs_max` lanes are gathered or the batching window expires,
+pads the batch to the executable cache's nrhs bucket, and runs ONE
+compiled batched solve for the whole group.
+
+Fault semantics reuse the measurement harness's taxonomy
+(`harness.classify`): every failed response carries a `failure_class`,
+and the retriable set (transient / timeout / oom / tunnel_wedge) maps to
+"shed with retry-after" while the deterministic set (mosaic_reject /
+accuracy_fail / unsupported) maps to "don't retry" — retrying a
+deterministic failure just burns queue capacity, the same policy the
+stage runner applies.
+
+The queue can never deadlock on a wedged solve: each batch executes on
+its own disposable thread under a hard deadline; a batch that overruns
+is answered (classified `timeout`, retriable) and ABANDONED — the
+worker moves on to the next batch while the stuck thread, which Python
+cannot kill, is left to finish into the void. This is the in-process
+analogue of the harness runner's group-kill-and-continue.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+
+from ..harness.classify import classify_exception
+from .cache import NRHS_BUCKETS, ExecutableCache, nrhs_bucket
+from .engine import SolveSpec, build_solver, spec_cache_key
+from .metrics import Metrics
+
+# Classes worth a client retry (capacity/infrastructure); everything
+# else in the taxonomy is deterministic — same split the stage-retry
+# policy uses.
+RETRIABLE_CLASSES = frozenset(
+    {"transient", "timeout", "oom", "tunnel_wedge"})
+
+
+class QueueFull(Exception):
+    """Admission control shed the request (bounded queue at capacity).
+    Retriable by contract: the server maps it to 503 + Retry-After."""
+
+
+@dataclass
+class PendingRequest:
+    """One admitted request: the worker fulfils `result` and sets
+    `done`; the submitting thread waits on it."""
+
+    id: str
+    spec: SolveSpec
+    scale: float
+    enqueued: float
+    done: threading.Event = field(default_factory=threading.Event)
+    result: dict | None = None
+
+
+def _spec_dict(spec: SolveSpec) -> dict:
+    return {"degree": spec.degree, "ndofs": spec.ndofs,
+            "nreps": spec.nreps, "precision": spec.precision,
+            "geom_perturb_fact": spec.geom_perturb_fact}
+
+
+class Broker:
+    def __init__(self, cache: ExecutableCache | None = None,
+                 metrics: Metrics | None = None, *,
+                 queue_max: int = 128, nrhs_max: int = 8,
+                 window_s: float = 0.025, solve_timeout_s: float = 120.0,
+                 builder=build_solver):
+        self.cache = cache or ExecutableCache()
+        self.metrics = metrics or Metrics()
+        self.queue_max = queue_max
+        self.nrhs_max = min(nrhs_max, NRHS_BUCKETS[-1])
+        self.window_s = window_s
+        self.solve_timeout_s = solve_timeout_s
+        self._builder = builder
+        self._queue: deque[PendingRequest] = deque()
+        self._cv = threading.Condition()
+        self._stop = False
+        self._ids = itertools.count(1)
+        self._worker = threading.Thread(target=self._loop, daemon=True,
+                                        name="serve-broker")
+        self._worker.start()
+
+    # -- client side -------------------------------------------------------
+
+    def submit(self, spec: SolveSpec, scale: float = 1.0,
+               req_id: str | None = None) -> PendingRequest:
+        """Admit one request or shed it (QueueFull). Never blocks on the
+        solve — the caller waits on the returned PendingRequest."""
+        rid = req_id or f"r{next(self._ids)}"
+        with self._cv:
+            depth = len(self._queue)
+            if self._stop:
+                raise QueueFull("broker is shut down")
+            if depth >= self.queue_max:
+                self.metrics.shed(rid, depth)
+                raise QueueFull(
+                    f"queue at capacity ({depth}/{self.queue_max})")
+            pending = PendingRequest(rid, spec, float(scale), time.monotonic())
+            self._queue.append(pending)
+            self.metrics.request(rid, _spec_dict(spec), len(self._queue))
+            self._cv.notify_all()
+        return pending
+
+    def wait(self, pending: PendingRequest,
+             timeout_s: float | None = None) -> dict:
+        """Block until the request is answered (or the wait times out —
+        a retriable timeout response; the broker may still answer the
+        underlying batch later, into the void)."""
+        if pending.done.wait(timeout_s):
+            return pending.result  # type: ignore[return-value]
+        return {"ok": False, "id": pending.id,
+                "error": f"response wait exceeded {timeout_s}s",
+                "failure_class": "timeout", "retriable": True}
+
+    def warmup(self, specs, bucket: int | None = None) -> list:
+        """Prebuild executables for the given specs at `bucket`
+        (default: the broker's own nrhs_max bucket, the one its batches
+        pad to) — requests arriving after warmup never pay a compile."""
+        b = bucket or nrhs_bucket(self.nrhs_max)
+        return self.cache.warmup(
+            [(spec_cache_key(s, b), (lambda s=s: self._builder(s, b)))
+             for s in specs])
+
+    def shutdown(self, timeout_s: float = 5.0) -> None:
+        with self._cv:
+            self._stop = True
+            self._cv.notify_all()
+        self._worker.join(timeout_s)
+        # anything still queued is answered, not dropped
+        with self._cv:
+            leftovers = list(self._queue)
+            self._queue.clear()
+        for p in leftovers:
+            self._respond(p, {"ok": False, "id": p.id,
+                              "error": "broker shut down",
+                              "failure_class": "transient",
+                              "retriable": True})
+
+    # -- worker side -------------------------------------------------------
+
+    def _take_compatible(self, spec: SolveSpec, k: int) -> list:
+        """Pull up to k same-spec requests out of the queue (FIFO among
+        compatible; incompatible requests keep their positions)."""
+        taken, kept = [], deque()
+        while self._queue and len(taken) < k:
+            p = self._queue.popleft()
+            (taken if p.spec == spec else kept).append(p)
+        kept.extend(self._queue)
+        self._queue.clear()
+        self._queue.extend(kept)
+        return list(taken)
+
+    def _loop(self) -> None:
+        while True:
+            batch = self._gather()
+            if batch is None:
+                return
+            if not batch:
+                continue
+            try:
+                self._execute(batch)
+            except BaseException as exc:  # the queue must NEVER deadlock
+                self._fail_batch(batch, exc)
+
+    def _gather(self) -> list | None:
+        """Block for the first request, then hold the batching window
+        open: collect same-spec requests until nrhs_max or deadline.
+        Returns None only on shutdown with an empty queue."""
+        with self._cv:
+            while not self._queue:
+                if self._stop:
+                    return None
+                self._cv.wait(0.1)
+            first = self._queue.popleft()
+            batch = [first]
+            deadline = time.monotonic() + self.window_s
+            while len(batch) < self.nrhs_max:
+                batch.extend(self._take_compatible(
+                    first.spec, self.nrhs_max - len(batch)))
+                if len(batch) >= self.nrhs_max:
+                    break
+                remaining = deadline - time.monotonic()
+                if remaining <= 0 or self._stop:
+                    break
+                self._cv.wait(remaining)
+            self.metrics.set_queue_depth(len(self._queue))
+        return batch
+
+    def _pick_bucket(self, spec: SolveSpec, live: int) -> int:
+        """Prefer the smallest ALREADY-COMPILED bucket that fits the
+        batch (padding is cheap — dead lanes start frozen; a compile is
+        seconds), else the minimal bucket for the batch size."""
+        for b in NRHS_BUCKETS:
+            if b >= live and self.cache.lookup(
+                    spec_cache_key(spec, b)) is not None:
+                return b
+        return nrhs_bucket(live)
+
+    def _execute(self, batch: list) -> None:
+        spec = batch[0].spec
+        live = len(batch)
+        bucket = self._pick_bucket(spec, live)
+        key = spec_cache_key(spec, bucket)
+        cache_hit = self.cache.lookup(key) is not None
+        scales = [p.scale for p in batch]
+        box: dict = {}
+
+        def _run():
+            try:
+                entry = self.cache.get_or_build(
+                    key, lambda: self._builder(spec, bucket))
+                box["result"] = entry.executable.solve(scales)
+            except BaseException as exc:
+                box["error"] = exc
+
+        t = threading.Thread(target=_run, daemon=True,
+                             name="serve-solve")
+        t.start()
+        t.join(self.solve_timeout_s)
+        if t.is_alive():
+            # hard deadline: answer + abandon (the harness's
+            # kill-the-group, minus the kill Python threads lack)
+            msg = (f"solve exceeded {self.solve_timeout_s}s "
+                   f"(spec {_spec_dict(spec)}); batch abandoned")
+            for p in batch:
+                self._respond(p, {
+                    "ok": False, "id": p.id, "error": msg,
+                    "failure_class": "timeout", "retriable": True})
+            self.metrics.batch(_spec_dict(spec), live, bucket, cache_hit,
+                               self.solve_timeout_s, 0.0)
+            return
+        if "error" in box:
+            self._fail_batch(batch, box["error"], bucket=bucket,
+                             cache_hit=cache_hit)
+            return
+        res = box["result"]
+        self.metrics.batch(_spec_dict(spec), live, res.nrhs_bucket,
+                           cache_hit, res.wall_s, res.gdof_per_second)
+        now = time.monotonic()
+        for lane, p in enumerate(batch):
+            self._respond(p, {
+                "ok": True, "id": p.id,
+                "xnorm": res.xnorms[lane],
+                "scale": p.scale,
+                "spec": _spec_dict(spec),
+                "nrhs_live": res.nrhs_live,
+                "nrhs_bucket": res.nrhs_bucket,
+                "ndofs_global": res.ndofs_global,
+                "cg_engine_form": "unfused",
+                "cache": "hit" if cache_hit else "miss",
+                "batch_wall_s": res.wall_s,
+                "gdof_per_second": res.gdof_per_second,
+                "latency_s": now - p.enqueued,
+            })
+
+    def _fail_batch(self, batch: list, exc: BaseException, *,
+                    bucket: int | None = None,
+                    cache_hit: bool = False) -> None:
+        cls = classify_exception(exc)
+        retriable = cls in RETRIABLE_CLASSES
+        spec = batch[0].spec
+        self.metrics.batch(_spec_dict(spec), len(batch),
+                           bucket or nrhs_bucket(len(batch)), cache_hit,
+                           0.0, 0.0)
+        for p in batch:
+            self._respond(p, {
+                "ok": False, "id": p.id,
+                "error": f"{type(exc).__name__}: {exc}"[:500],
+                "failure_class": cls, "retriable": retriable})
+
+    def _respond(self, pending: PendingRequest, result: dict) -> None:
+        if pending.done.is_set():
+            return
+        pending.result = result
+        latency = time.monotonic() - pending.enqueued
+        self.metrics.response(
+            pending.id, bool(result.get("ok")), latency,
+            failure_class=result.get("failure_class"),
+            retriable=result.get("retriable"))
+        pending.done.set()

@@ -1,0 +1,193 @@
+"""AOT-executable cache: compiled solver reuse across requests and reps.
+
+The one-shot drivers lower and compile a fresh XLA executable per
+invocation (`bench/driver.py` — tens of seconds against millisecond
+solves at serving sizes). The reference amortises that launch cost by
+demanding >= 10M dofs per device (README.md:160-163); a serving layer
+amortises it the other way — across requests — by keying compiled
+executables on everything that shapes the lowered computation and
+reusing them for every compatible request.
+
+The key is deliberately NOT the request: two requests with different
+right-hand sides (or different nrhs up to the same bucket — batches are
+padded, see `nrhs_bucket`) hit the same executable, because the RHS is
+an *argument* of the compiled function, never a constant baked into it
+(the same pytree-argument discipline as the benchmark drivers).
+
+Counters (hits / misses / evictions / compiles) are the serving
+contract's evidence: the smoke test asserts zero recompiles on repeat
+configs straight off them, and `/metrics` republishes them.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import OrderedDict
+from dataclasses import dataclass, field
+from typing import Callable
+
+# Batch-size buckets the broker pads to: a handful of executables cover
+# every batch size, and the padding lanes (zero RHS) start frozen inside
+# cg_solve_batched, so a padded solve does the same per-lane work.
+NRHS_BUCKETS = (1, 2, 4, 8, 16)
+
+
+def nrhs_bucket(nrhs: int) -> int:
+    """Smallest bucket >= nrhs (the largest bucket for anything beyond:
+    the broker never builds batches past its own nrhs_max anyway)."""
+    for b in NRHS_BUCKETS:
+        if nrhs <= b:
+            return b
+    return NRHS_BUCKETS[-1]
+
+
+@dataclass(frozen=True)
+class ExecutableKey:
+    """Everything that shapes the lowered solver computation — the
+    ISSUE's cache-key contract: degree, the per-device (local) cell
+    shape, precision (f32 / f64-emulated / df32), geometry class,
+    engine form, the nrhs bucket the batch pads to, and the device
+    mesh it was compiled for. Two requests agreeing on this key can
+    share one executable; anything else must not."""
+
+    degree: int
+    cell_shape: tuple  # local (per-device) mesh cells, e.g. (8, 8, 8)
+    precision: str  # "f32" | "f64" | "df32"
+    geom: str  # "uniform" | "perturbed"
+    engine_form: str  # unified vocabulary (bench.driver.record_engine)
+    nrhs_bucket: int
+    device_mesh: tuple  # dshape, (1, 1, 1) for single-chip
+    nreps: int = 0  # CG iterations baked into the loop
+
+
+@dataclass
+class CacheEntry:
+    key: ExecutableKey
+    executable: object  # the compiled solver (serve.engine.CompiledSolver)
+    compile_s: float = 0.0
+    meta: dict = field(default_factory=dict)
+
+
+class ExecutableCache:
+    """Thread-safe LRU over `ExecutableKey` with hit/miss/evict/compile
+    counters and a warmup API. `get_or_build` is the only way anything
+    enters the cache, so `compiles` counts exactly the builder calls —
+    "zero recompiles on repeat configs" is `compiles` staying flat while
+    `hits` climbs."""
+
+    def __init__(self, capacity: int = 32):
+        self.capacity = capacity
+        self._entries: OrderedDict[ExecutableKey, CacheEntry] = OrderedDict()
+        self._lock = threading.Lock()
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+        self.compiles = 0
+
+    def lookup(self, key: ExecutableKey) -> CacheEntry | None:
+        """Counter-free peek (the broker uses it to prefer an
+        already-compiled bucket over the minimal one)."""
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is not None:
+                self._entries.move_to_end(key)
+            return entry
+
+    def get(self, key: ExecutableKey) -> CacheEntry | None:
+        """Counted lookup: a hit or a miss, no build (the driver's
+        exec-cache path pairs this with `insert`)."""
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is not None:
+                self.hits += 1
+                self._entries.move_to_end(key)
+            else:
+                self.misses += 1
+            return entry
+
+    def insert(self, key: ExecutableKey, executable,
+               compile_s: float = 0.0, meta: dict | None = None
+               ) -> CacheEntry:
+        """Insert an already-built executable (counted as one compile —
+        the build happened at the caller; the counters stay truthful)."""
+        entry = CacheEntry(key, executable, compile_s=compile_s,
+                           meta=meta or {})
+        with self._lock:
+            self.compiles += 1
+            self._entries[key] = entry
+            self._entries.move_to_end(key)
+            while len(self._entries) > self.capacity:
+                self._entries.popitem(last=False)
+                self.evictions += 1
+        return entry
+
+    def get_or_build(self, key: ExecutableKey,
+                     builder: Callable[[], object],
+                     compile_s: float | None = None) -> CacheEntry:
+        """Return the cached executable for `key`, or build, count and
+        insert one. The builder runs OUTSIDE the lock (compiles take
+        seconds; lookups must not queue behind them) — a racing
+        duplicate build is possible and harmless: last-in wins, both
+        builds are counted (the counters are evidence, not fiction)."""
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is not None:
+                self.hits += 1
+                self._entries.move_to_end(key)
+                return entry
+            self.misses += 1
+        import time
+
+        t0 = time.perf_counter()
+        executable = builder()
+        wall = time.perf_counter() - t0 if compile_s is None else compile_s
+        entry = CacheEntry(key, executable, compile_s=wall)
+        with self._lock:
+            self.compiles += 1
+            self._entries[key] = entry
+            self._entries.move_to_end(key)
+            while len(self._entries) > self.capacity:
+                self._entries.popitem(last=False)
+                self.evictions += 1
+        return entry
+
+    def warmup(self, keys_and_builders) -> list[CacheEntry]:
+        """Prebuild executables for [(key, builder), ...] — the serving
+        analogue of the benchmark's compile-outside-the-timed-region
+        rule: requests arriving after warmup never pay a compile."""
+        return [self.get_or_build(k, b) for k, b in keys_and_builders]
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "entries": len(self._entries),
+                "capacity": self.capacity,
+                "hits": self.hits,
+                "misses": self.misses,
+                "evictions": self.evictions,
+                "compiles": self.compiles,
+                "hit_rate": (
+                    self.hits / (self.hits + self.misses)
+                    if (self.hits + self.misses) else 0.0
+                ),
+            }
+
+    def keys(self) -> list[ExecutableKey]:
+        with self._lock:
+            return list(self._entries)
+
+
+# Process-wide default instance: bench.py routes its repeated
+# side-metric configs through it (BenchConfig.exec_cache) so a retry
+# ladder's unchanged configs stop recompiling; the serve broker builds
+# its own instance per server unless handed this one.
+_DEFAULT: ExecutableCache | None = None
+_DEFAULT_LOCK = threading.Lock()
+
+
+def default_cache() -> ExecutableCache:
+    global _DEFAULT
+    with _DEFAULT_LOCK:
+        if _DEFAULT is None:
+            _DEFAULT = ExecutableCache()
+        return _DEFAULT

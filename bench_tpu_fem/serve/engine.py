@@ -1,0 +1,290 @@
+"""Solver construction for the serving layer: spec -> compiled batched
+executable.
+
+A `SolveSpec` is the request-compatibility class (degree, problem size,
+iteration count, precision, geometry class): requests agreeing on it can
+share one batch and one executable. `build_solver` assembles the
+operator ONCE from the existing unfused operator builders (ops.kron /
+ops.laplacian / ops.kron_df — the fused delay-ring engines have no
+batched form yet, so the serving path is the recorded
+`cg_engine_form: "unfused"` composition, same vocabulary as
+bench.driver.record_engine) and AOT-compiles the batched multi-RHS CG
+(`la.cg.cg_solve_batched`, or a vmapped `cg_solve_df` for df32 pairs)
+for one nrhs bucket.
+
+The request's right-hand side enters as a per-lane SCALE of the spec's
+canonical benchmark RHS (the Gaussian-bump source every driver solves).
+CG with a fixed iteration count is exactly linear in b — alpha/beta are
+scale-invariant ratios, so x(c*b) = c*x(b) — which gives the serving
+acceptance check its teeth: every response must match the one-shot
+driver's solution norm times the request scale to the batched-parity
+tolerances (<= 1e-7 f32, <= 1e-13 df32), per lane, straight off the
+wire. Precision caveat: the scaling itself is exact for power-of-two
+scales in f32 (what the acceptance smoke and bench.driver.batch_scales
+use) and df-exact for ANY scale in df32 (the scale multiplies as a df
+pair, see solve()); an f32 request with a non-power-of-two scale adds
+one input rounding (~6e-8 relative) on top of the contract.
+
+Evidence label: serving throughput numbers from this module are
+CPU-measured unless a round artifact says otherwise; the TPU folded/
+pallas serving path is a design note in the README, not a shipped form.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from .cache import ExecutableKey, nrhs_bucket
+
+# Test/fault-injection seam: when set, called as FAULT_HOOK(spec, scales)
+# at the top of every compiled-solver execution — raising here simulates
+# a solve-path fault (OOM, hang, Mosaic reject) without touching the
+# solver code. harness.faults.FaultySolveHook scripts it.
+FAULT_HOOK = None
+
+_PRECISIONS = ("f32", "f64", "df32")
+
+# Admission cap on problem size: a single oversized request must be
+# REFUSED (classified `unsupported`, 422) rather than allowed to grind
+# the worker through a multi-GB host allocation — or worse, draw the
+# Linux OOM killer onto the serving process. Generous for CPU serving
+# (the benchmark's own flagship is 12.5M dofs); raise deliberately for
+# a TPU deployment, not by accident.
+MAX_NDOFS = 50_000_000
+
+
+@dataclass(frozen=True)
+class SolveSpec:
+    """The request-compatibility key, pre-bucket. `nreps` is the CG
+    iteration count (benchmark semantics: rtol=0, exactly nreps
+    iterations — responses are comparable across requests only because
+    the iteration count is part of the spec)."""
+
+    degree: int = 3
+    ndofs: int = 50_000
+    nreps: int = 30
+    precision: str = "f32"
+    geom_perturb_fact: float = 0.0
+
+    @property
+    def geom(self) -> str:
+        return "perturbed" if self.geom_perturb_fact != 0.0 else "uniform"
+
+    def validate(self) -> None:
+        if not 1 <= self.degree <= 7:
+            raise UnsupportedSpec(f"degree {self.degree} unsupported (1-7)")
+        if self.precision not in _PRECISIONS:
+            raise UnsupportedSpec(
+                f"precision {self.precision!r} unsupported {_PRECISIONS}")
+        if self.precision == "df32" and self.geom != "uniform":
+            raise UnsupportedSpec(
+                "df32 serving requires a uniform mesh (the kron df path); "
+                "perturbed f64-class serving is unsupported here")
+        if self.ndofs <= 0 or self.nreps <= 0:
+            raise UnsupportedSpec("ndofs and nreps must be positive")
+        if self.ndofs > MAX_NDOFS:
+            raise UnsupportedSpec(
+                f"ndofs {self.ndofs} exceeds the serving cap "
+                f"{MAX_NDOFS} (engine.MAX_NDOFS) — unsupported")
+
+
+class UnsupportedSpec(ValueError):
+    """A capability gate declined the spec — classified `unsupported`
+    by the harness taxonomy (deterministic: retrying cannot help)."""
+
+
+def spec_cache_key(spec: SolveSpec, bucket: int,
+                   device_mesh: tuple = (1, 1, 1)) -> ExecutableKey:
+    from ..mesh.sizing import compute_mesh_size
+
+    cells = compute_mesh_size(spec.ndofs, spec.degree)
+    return ExecutableKey(
+        degree=spec.degree,
+        cell_shape=tuple(int(c) for c in cells),
+        precision=spec.precision,
+        geom=spec.geom,
+        engine_form="unfused",
+        nrhs_bucket=bucket,
+        device_mesh=tuple(device_mesh),
+        nreps=spec.nreps,
+    )
+
+
+@dataclass
+class BatchResult:
+    """One executed batch: per-live-lane solution norms plus the
+    accounting the metrics layer journals."""
+
+    xnorms: list  # len(scales): L2 norm of each live lane's solution
+    wall_s: float
+    nrhs_live: int
+    nrhs_bucket: int
+    ndofs_global: int
+    nreps: int
+    gdof_per_second: float
+    extra: dict = field(default_factory=dict)
+
+
+class CompiledSolver:
+    """One AOT-compiled batched solver: operator state + base RHS held on
+    device, executable compiled for (bucket, *grid) inputs. `solve`
+    scales the base RHS per lane (zero-padding dead lanes — they start
+    frozen inside the batched CG), runs the executable, and returns the
+    per-lane norms with throughput accounting
+    (GDoF/s = ndofs * nreps * live_lanes / wall)."""
+
+    def __init__(self, spec: SolveSpec, bucket: int):
+        import jax
+        import jax.numpy as jnp
+
+        spec.validate()
+        self.spec = spec
+        self.bucket = int(bucket)
+        self.key = spec_cache_key(spec, self.bucket)
+
+        from ..elements.tables import build_operator_tables
+        from ..mesh.box import create_box_mesh
+        from ..mesh.dofmap import dof_grid_shape
+        from ..mesh.sizing import compute_mesh_size
+        from ..utils.compilation import compile_lowered
+
+        t0 = time.perf_counter()
+        n = compute_mesh_size(spec.ndofs, spec.degree)
+        t = build_operator_tables(spec.degree, 1, "gll")
+        mesh = create_box_mesh(n, geom_perturb_fact=spec.geom_perturb_fact)
+        self.ndofs_global = int(np.prod(dof_grid_shape(n, spec.degree)))
+
+        # Host-assembled f64 RHS (the canonical benchmark problem: the
+        # drivers assemble the same b), scaled per lane at solve time.
+        from ..bench.driver import BenchConfig, _setup_problem
+
+        cfg = BenchConfig(ndofs_global=spec.ndofs, degree=spec.degree,
+                          qmode=1, nreps=spec.nreps,
+                          geom_perturb_fact=spec.geom_perturb_fact)
+        _, _, _, _, _, _, _, b_host, _ = _setup_problem(
+            cfg, n, prebuilt=(n, "gll", t, mesh))
+        b64 = np.asarray(b_host, np.float64)
+
+        nreps = spec.nreps
+        if spec.precision == "df32":
+            from ..la.df64 import DF, df_from_f64
+            from ..ops.kron_df import build_kron_laplacian_df, cg_solve_df
+
+            self._op = build_kron_laplacian_df(
+                mesh, spec.degree, 1, "gll", kappa=2.0, tables=t)
+            bdf = df_from_f64(b64)
+            self._base = DF(jnp.asarray(bdf.hi), jnp.asarray(bdf.lo))
+
+            def run(A, Bhi, Blo):
+                return jax.vmap(
+                    lambda bh, bl: cg_solve_df(A, DF(bh, bl), nreps)
+                )(Bhi, Blo)
+
+            Bs = jax.ShapeDtypeStruct((self.bucket, *b64.shape),
+                                      np.dtype("float32"))
+            self._fn = compile_lowered(
+                jax.jit(run).lower(self._op, Bs, Bs), None)
+        else:
+            from ..la.cg import cg_solve_batched
+            from ..ops.laplacian import build_laplacian
+
+            dtype = jnp.float64 if spec.precision == "f64" else jnp.float32
+            if spec.precision == "f64" and not jax.config.jax_enable_x64:
+                raise UnsupportedSpec(
+                    "precision 'f64' needs jax_enable_x64 (the serve CLI "
+                    "enables it; in-process callers must)")
+            # Uniform meshes take the exact Kronecker fast path; general
+            # (perturbed) geometry the einsum operator. Both unfused
+            # applies vmap cleanly over the batch axis — the Pallas
+            # folded serving form is future work (design note, README).
+            backend = "kron" if spec.geom == "uniform" else "xla"
+            self._op = build_laplacian(
+                mesh, spec.degree, 1, "gll", kappa=2.0, dtype=dtype,
+                tables=t, backend=backend)
+            self._base = jnp.asarray(b64, dtype)
+
+            def run(A, B):
+                return cg_solve_batched(
+                    A.apply, B, jnp.zeros_like(B), nreps)
+
+            Bs = jax.ShapeDtypeStruct((self.bucket, *b64.shape),
+                                      np.dtype(dtype))
+            self._fn = compile_lowered(jax.jit(run).lower(self._op, Bs),
+                                       None)
+        self.compile_s = time.perf_counter() - t0
+
+    def solve(self, scales) -> BatchResult:
+        """Run one padded batch: `scales` (len <= bucket) are the live
+        lanes' RHS scales; dead lanes are zero-padded and return frozen
+        zeros. Norms come back per live lane."""
+        import jax
+        import jax.numpy as jnp
+
+        if FAULT_HOOK is not None:
+            FAULT_HOOK(self.spec, scales)
+        live = len(scales)
+        if live > self.bucket:
+            raise ValueError(f"{live} scales > bucket {self.bucket}")
+        pad = np.zeros(self.bucket, np.float64)
+        pad[:live] = np.asarray(scales, np.float64)
+
+        t0 = time.perf_counter()
+        if self.spec.precision == "df32":
+            # df-exact per-lane scaling: the f64 scale splits into its
+            # own hi/lo pair and multiplies in df arithmetic, so s*b
+            # keeps df precision for ANY scale (a naive f32 s*hi drops
+            # the product's rounding error and would degrade the 1e-13
+            # linearity contract to ~1e-8 for non-power-of-two scales)
+            from ..la.df64 import DF, df_from_f64, df_scale
+
+            sdf = df_from_f64(pad)
+            sb = DF(jnp.asarray(sdf.hi)[:, None, None, None],
+                    jnp.asarray(sdf.lo)[:, None, None, None])
+            shape = (self.bucket, *self._base.hi.shape)
+            base_b = DF(jnp.broadcast_to(self._base.hi[None], shape),
+                        jnp.broadcast_to(self._base.lo[None], shape))
+            Bdf = jax.jit(df_scale)(base_b, sb)
+            X = self._fn(self._op, Bdf.hi, Bdf.lo)
+            jax.block_until_ready(X)
+            from ..la.df64 import DF, df_dot, df_to_f64
+
+            xn = [
+                float(np.sqrt(max(float(df_to_f64(df_dot(
+                    DF(X.hi[i], X.lo[i]), DF(X.hi[i], X.lo[i])))), 0.0)))
+                for i in range(live)
+            ]
+        else:
+            s = jnp.asarray(pad, self._base.dtype)[:, None, None, None]
+            X = self._fn(self._op, s * self._base[None])
+            jax.block_until_ready(X)
+            # vmapped scalar dot (la.cg.batched_dot): per lane the SAME
+            # reduction as the one-shot driver's vdot — the parity
+            # check compares norms straight across
+            from ..la.cg import batched_dot
+
+            sq = jax.jit(batched_dot)(X, X)
+            xn = [float(v) for v in np.sqrt(np.asarray(sq)[:live])]
+        wall = time.perf_counter() - t0
+        return BatchResult(
+            xnorms=xn,
+            wall_s=wall,
+            nrhs_live=live,
+            nrhs_bucket=self.bucket,
+            ndofs_global=self.ndofs_global,
+            nreps=self.spec.nreps,
+            gdof_per_second=(
+                self.ndofs_global * self.spec.nreps * live / (1e9 * wall)
+                if wall > 0 else 0.0),
+            extra={"cg_engine_form": "unfused",
+                   "precision": self.spec.precision,
+                   "geom": self.spec.geom},
+        )
+
+
+def build_solver(spec: SolveSpec, bucket: int | None = None) -> CompiledSolver:
+    """Build + AOT-compile a batched solver for the spec at the given
+    (or minimal) nrhs bucket."""
+    return CompiledSolver(spec, bucket or nrhs_bucket(1))

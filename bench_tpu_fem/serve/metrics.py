@@ -1,0 +1,201 @@
+"""Serving metrics: counters + a crash-safe JSONL journal.
+
+Reuses the measurement harness's journal (`harness.journal.Journal` —
+fsynced append-only JSONL, torn-tail tolerant) so a served incident
+leaves the same class of evidence a measurement run does: every request
+admission, shed, batch execution and response is one journal record, and
+`replay_serve` folds a journal back into the incident summary
+("the metrics journal replays the full incident" — the backpressure
+acceptance criterion).
+
+Record schema (all lines also carry the journal's v/seq/ts):
+
+  {"event": "serve_request",  "id": ..., "spec": {...}, "queue_depth": N}
+  {"event": "serve_shed",     "id": ..., "failure_class": "transient",
+                              "queue_depth": N}
+  {"event": "serve_batch",    "spec": {...}, "nrhs_live": N,
+                              "nrhs_bucket": B, "cache": "hit"|"miss",
+                              "wall_s": ..., "gdof_per_second": ...}
+  {"event": "serve_response", "id": ..., "ok": bool, "latency_s": ...,
+                              "failure_class": ... (failures only),
+                              "retriable": bool (failures only)}
+
+Cache hit-rate is REQUEST-weighted (requests served from an
+already-compiled executable / requests batched): a warm cache serving
+64 requests in 10 batches is a 100% hit-rate story, not a 10-lookup
+one. The raw cache counters ride along unweighted in `snapshot()`.
+"""
+
+from __future__ import annotations
+
+import threading
+from collections import deque
+
+from ..harness.journal import Journal, read_records
+
+# Bounded latency window: serving metrics must not grow without bound.
+_LATENCY_WINDOW = 4096
+
+
+class Metrics:
+    """Thread-safe counters + optional journal. Every mutator journals
+    first (evidence before bookkeeping — a crash mid-increment still
+    leaves the record)."""
+
+    def __init__(self, journal_path: str | None = None):
+        self.journal = Journal(journal_path) if journal_path else None
+        self._lock = threading.Lock()
+        self.requests_total = 0
+        self.shed_total = 0
+        self.completed = 0
+        self.failed = 0
+        self.failed_by_class: dict[str, int] = {}
+        self.batches = 0
+        self.lanes_total = 0  # live lanes across batches (occupancy sum)
+        self.cache_hit_requests = 0
+        self.cache_miss_requests = 0
+        self.gdof_samples: deque = deque(maxlen=_LATENCY_WINDOW)
+        self.latencies: deque = deque(maxlen=_LATENCY_WINDOW)
+        self.queue_depth = 0
+
+    def _journal(self, rec: dict) -> None:
+        if self.journal is not None:
+            self.journal.append(rec)
+
+    # -- events ------------------------------------------------------------
+
+    def request(self, req_id: str, spec_dict: dict, queue_depth: int) -> None:
+        self._journal({"event": "serve_request", "id": req_id,
+                       "spec": spec_dict, "queue_depth": queue_depth})
+        with self._lock:
+            self.requests_total += 1
+            self.queue_depth = queue_depth
+
+    def shed(self, req_id: str, queue_depth: int,
+             failure_class: str = "transient") -> None:
+        self._journal({"event": "serve_shed", "id": req_id,
+                       "failure_class": failure_class,
+                       "queue_depth": queue_depth})
+        with self._lock:
+            self.shed_total += 1
+
+    def batch(self, spec_dict: dict, nrhs_live: int, nrhs_bucket: int,
+              cache_hit: bool, wall_s: float,
+              gdof_per_second: float) -> None:
+        self._journal({"event": "serve_batch", "spec": spec_dict,
+                       "nrhs_live": nrhs_live, "nrhs_bucket": nrhs_bucket,
+                       "cache": "hit" if cache_hit else "miss",
+                       "wall_s": round(wall_s, 6),
+                       "gdof_per_second": round(gdof_per_second, 6)})
+        with self._lock:
+            self.batches += 1
+            self.lanes_total += nrhs_live
+            if cache_hit:
+                self.cache_hit_requests += nrhs_live
+            else:
+                self.cache_miss_requests += nrhs_live
+            self.gdof_samples.append(gdof_per_second)
+
+    def response(self, req_id: str, ok: bool, latency_s: float,
+                 failure_class: str | None = None,
+                 retriable: bool | None = None) -> None:
+        rec = {"event": "serve_response", "id": req_id, "ok": ok,
+               "latency_s": round(latency_s, 6)}
+        if not ok:
+            rec["failure_class"] = failure_class or "transient"
+            rec["retriable"] = bool(retriable)
+        self._journal(rec)
+        with self._lock:
+            if ok:
+                self.completed += 1
+            else:
+                self.failed += 1
+                fc = failure_class or "transient"
+                self.failed_by_class[fc] = (
+                    self.failed_by_class.get(fc, 0) + 1)
+            self.latencies.append(latency_s)
+
+    def set_queue_depth(self, depth: int) -> None:
+        with self._lock:
+            self.queue_depth = depth
+
+    # -- snapshot ----------------------------------------------------------
+
+    def snapshot(self, cache_stats: dict | None = None) -> dict:
+        with self._lock:
+            lat = sorted(self.latencies)
+            batched = self.cache_hit_requests + self.cache_miss_requests
+            out = {
+                "requests_total": self.requests_total,
+                "shed_total": self.shed_total,
+                "completed": self.completed,
+                "failed": self.failed,
+                "failed_by_class": dict(self.failed_by_class),
+                "batches": self.batches,
+                "queue_depth": self.queue_depth,
+                "mean_batch_occupancy": (
+                    self.lanes_total / self.batches if self.batches else 0.0
+                ),
+                "cache_hit_rate_requests": (
+                    self.cache_hit_requests / batched if batched else 0.0
+                ),
+                "latency_p50_s": _pct(lat, 0.50),
+                "latency_p95_s": _pct(lat, 0.95),
+                "gdof_per_second_mean": (
+                    sum(self.gdof_samples) / len(self.gdof_samples)
+                    if self.gdof_samples else 0.0
+                ),
+            }
+        if cache_stats is not None:
+            out["cache"] = cache_stats
+        return out
+
+
+def _pct(sorted_vals, q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    i = min(len(sorted_vals) - 1, int(q * len(sorted_vals)))
+    return float(sorted_vals[i])
+
+
+def replay_serve(journal_path: str) -> dict:
+    """Fold a serve journal back into the incident summary: per-event
+    counts, per-class failure counts, occupancy and hit-rate — enough to
+    reconstruct "what happened" from the file alone (the journal IS the
+    incident record; this is its reader)."""
+    records, corrupt = read_records(journal_path)
+    out = {
+        "requests": 0, "shed": 0, "batches": 0, "responses_ok": 0,
+        "responses_failed": 0, "failed_by_class": {}, "lanes_total": 0,
+        "cache_hits": 0, "cache_misses": 0, "corrupt_lines": len(corrupt),
+    }
+    for rec in records:
+        ev = rec.get("event")
+        if ev == "serve_request":
+            out["requests"] += 1
+        elif ev == "serve_shed":
+            out["shed"] += 1
+            fc = rec.get("failure_class", "transient")
+            out["failed_by_class"][fc] = (
+                out["failed_by_class"].get(fc, 0) + 1)
+        elif ev == "serve_batch":
+            out["batches"] += 1
+            out["lanes_total"] += int(rec.get("nrhs_live", 0))
+            if rec.get("cache") == "hit":
+                out["cache_hits"] += int(rec.get("nrhs_live", 0))
+            else:
+                out["cache_misses"] += int(rec.get("nrhs_live", 0))
+        elif ev == "serve_response":
+            if rec.get("ok"):
+                out["responses_ok"] += 1
+            else:
+                out["responses_failed"] += 1
+                fc = rec.get("failure_class", "transient")
+                out["failed_by_class"][fc] = (
+                    out["failed_by_class"].get(fc, 0) + 1)
+    out["mean_batch_occupancy"] = (
+        out["lanes_total"] / out["batches"] if out["batches"] else 0.0)
+    batched = out["cache_hits"] + out["cache_misses"]
+    out["cache_hit_rate_requests"] = (
+        out["cache_hits"] / batched if batched else 0.0)
+    return out

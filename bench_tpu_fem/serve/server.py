@@ -1,0 +1,118 @@
+"""Localhost HTTP/JSON front end over the broker (stdlib http.server).
+
+Endpoints:
+
+  POST /solve    {"degree": 3, "ndofs": 50000, "nreps": 30,
+                  "precision": "f32", "geom_perturb_fact": 0.0,
+                  "scale": 1.0}
+                 -> 200 {"ok": true, "xnorm": ..., "nrhs_live": ...,
+                         "nrhs_bucket": ..., "cache": "hit", ...}
+                 -> 503 + Retry-After on shed / retriable failure
+                    (failure_class in transient/timeout/oom/tunnel_wedge)
+                 -> 422 on deterministic failure (mosaic_reject/
+                    accuracy_fail/unsupported) — retrying cannot help
+                 -> 400 on malformed requests
+  GET  /metrics  metrics snapshot + cache counters (JSON)
+  GET  /healthz  {"ok": true}
+
+ThreadingHTTPServer gives one handler thread per connection; every
+handler immediately parks on its broker future, so concurrency is
+bounded by the BROKER's queue, not by threads — admission control stays
+the single backpressure point.
+"""
+
+from __future__ import annotations
+
+import json
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from .broker import Broker, QueueFull
+from .engine import SolveSpec
+
+RETRY_AFTER_S = 1
+
+
+def make_handler(broker: Broker, request_timeout_s: float = 300.0,
+                 quiet: bool = True):
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt, *args):  # noqa: N802
+            if not quiet:
+                super().log_message(fmt, *args)
+
+        def _send(self, code: int, payload: dict,
+                  headers: dict | None = None) -> None:
+            body = (json.dumps(payload) + "\n").encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            for k, v in (headers or {}).items():
+                self.send_header(k, str(v))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def do_GET(self):  # noqa: N802
+            if self.path == "/healthz":
+                self._send(200, {"ok": True})
+            elif self.path == "/metrics":
+                self._send(200, broker.metrics.snapshot(
+                    cache_stats=broker.cache.stats()))
+            else:
+                self._send(404, {"ok": False, "error": "not found"})
+
+        def do_POST(self):  # noqa: N802
+            if self.path != "/solve":
+                self._send(404, {"ok": False, "error": "not found"})
+                return
+            try:
+                length = int(self.headers.get("Content-Length", "0"))
+                req = json.loads(self.rfile.read(length) or b"{}")
+                if not isinstance(req, dict):
+                    raise ValueError(
+                        f"request body must be a JSON object, got "
+                        f"{type(req).__name__}")
+                spec = SolveSpec(
+                    degree=int(req.get("degree", 3)),
+                    ndofs=int(req.get("ndofs", 50_000)),
+                    nreps=int(req.get("nreps", 30)),
+                    precision=str(req.get("precision", "f32")),
+                    geom_perturb_fact=float(
+                        req.get("geom_perturb_fact", 0.0)),
+                )
+                scale = float(req.get("scale", 1.0))
+            except (ValueError, TypeError, json.JSONDecodeError) as exc:
+                self._send(400, {"ok": False,
+                                 "error": f"malformed request: {exc}",
+                                 "failure_class": "unsupported",
+                                 "retriable": False})
+                return
+            try:
+                pending = broker.submit(spec, scale)
+            except QueueFull as exc:
+                self._send(503, {"ok": False, "error": str(exc),
+                                 "failure_class": "transient",
+                                 "retriable": True},
+                           {"Retry-After": RETRY_AFTER_S})
+                return
+            result = broker.wait(pending, request_timeout_s)
+            if result.get("ok"):
+                self._send(200, result)
+            elif result.get("retriable"):
+                self._send(503, result, {"Retry-After": RETRY_AFTER_S})
+            else:
+                self._send(422, result)
+
+    return Handler
+
+
+def make_server(broker: Broker, host: str = "127.0.0.1", port: int = 0,
+                request_timeout_s: float = 300.0,
+                quiet: bool = True) -> ThreadingHTTPServer:
+    """Bind (port 0 = ephemeral; the bound port is
+    `server.server_address[1]`). The caller owns serve_forever/shutdown
+    — tests run it on a thread, the CLI blocks on it."""
+    handler = make_handler(broker, request_timeout_s, quiet)
+    srv = ThreadingHTTPServer((host, port), handler)
+    srv.daemon_threads = True
+    return srv

@@ -58,3 +58,28 @@ def apply_compat_shims() -> None:
             return x
 
         jax.lax.pcast = pcast
+
+    _ensure_optimization_barrier_batching()
+
+
+def _ensure_optimization_barrier_batching() -> None:
+    """Older jax (0.4.x) ships no vmap batching rule for
+    `optimization_barrier`, which breaks `jax.vmap` over anything built
+    on la.df64 (every df product launders its operands through a barrier)
+    — exactly what the serve layer's batched df32 path does. The barrier
+    is semantically an identity with a compiler fence, so the batching
+    rule is a pass-through: bind the primitive on the batched operands,
+    keep each operand's batch dim. Current jax registers its own rule
+    and this is a no-op."""
+    try:
+        from jax._src.lax.lax import optimization_barrier_p
+        from jax.interpreters import batching
+    except ImportError:  # pragma: no cover - layout drift in future jax
+        return
+    if optimization_barrier_p in batching.primitive_batchers:
+        return
+
+    def _batcher(args, dims, **params):
+        return optimization_barrier_p.bind(*args, **params), dims
+
+    batching.primitive_batchers[optimization_barrier_p] = _batcher

@@ -1,0 +1,138 @@
+#!/usr/bin/env python
+"""Concurrent mixed-degree load generator for the serve subsystem.
+
+Drives `python -m bench_tpu_fem.serve` over localhost HTTP with N
+concurrent requests across a degree mix, retrying retriable 503 sheds
+once, then prints one JSON summary line (per-class failure counts, the
+server's /metrics snapshot, wall time). Exit code 1 if any request
+ends unrecovered.
+
+    # terminal 1
+    JAX_PLATFORMS=cpu python -m bench_tpu_fem.serve --port 8378 \
+        --warmup 1,2,3 --ndofs 4000 --nreps 15
+    # terminal 2
+    python scripts/serve_loadgen.py --url http://127.0.0.1:8378 \
+        --requests 64 --concurrency 16 --degrees 1,2,3 \
+        --ndofs 4000 --nreps 15
+
+stdlib only (urllib + threading): the loadgen must run anywhere the
+server does, including the CI serve lane.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+
+
+def _post(url: str, body: dict, timeout_s: float):
+    req = urllib.request.Request(url + "/solve",
+                                 data=json.dumps(body).encode(),
+                                 method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=timeout_s) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        try:
+            return e.code, json.loads(e.read())
+        except (ValueError, json.JSONDecodeError):
+            return e.code, {"ok": False, "error": str(e),
+                            "failure_class": "transient",
+                            "retriable": True}
+    except OSError as e:
+        # connection refused / reset / socket timeout: the server is
+        # unreachable — a COUNTED failure, never a silently-dead worker
+        # thread (a loadgen that loses requests reads as a green run)
+        return 0, {"ok": False, "error": f"{type(e).__name__}: {e}",
+                   "failure_class": "transient", "retriable": True}
+
+
+def run_load(url: str, requests: int = 64, concurrency: int = 16,
+             degrees=(1, 2, 3), ndofs: int = 4000, nreps: int = 15,
+             precision: str = "f32", timeout_s: float = 120.0) -> dict:
+    """Fire `requests` mixed-degree solves with a bounded worker pool;
+    retriable failures (shed 503s) get ONE retry after the server's
+    Retry-After hint. Returns the summary dict main() prints."""
+    degrees = list(degrees)
+    lock = threading.Lock()
+    out = {"completed": 0, "failed": 0, "shed_retried": 0,
+           "failed_by_class": {}, "latency_s": []}
+    sem = threading.Semaphore(concurrency)
+
+    def fire(i: int):
+        with sem:
+            body = {"degree": degrees[i % len(degrees)], "ndofs": ndofs,
+                    "nreps": nreps, "precision": precision,
+                    "scale": float(1 + (i % 4))}
+            t0 = time.monotonic()
+            code, resp = _post(url, body, timeout_s)
+            if code != 200 and resp.get("retriable"):
+                with lock:
+                    out["shed_retried"] += 1
+                time.sleep(1.0)
+                code, resp = _post(url, body, timeout_s)
+            with lock:
+                out["latency_s"].append(round(time.monotonic() - t0, 4))
+                if code == 200 and resp.get("ok"):
+                    out["completed"] += 1
+                else:
+                    out["failed"] += 1
+                    fc = resp.get("failure_class", "transient")
+                    out["failed_by_class"][fc] = (
+                        out["failed_by_class"].get(fc, 0) + 1)
+
+    t0 = time.monotonic()
+    threads = [threading.Thread(target=fire, args=(i,))
+               for i in range(requests)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    out["wall_s"] = round(time.monotonic() - t0, 3)
+    # accounting invariant: every request ends as completed or failed —
+    # a worker thread that died uncounted would break this, and a run
+    # that lost requests must not exit 0
+    lost = requests - out["completed"] - out["failed"]
+    if lost:
+        out["failed"] += lost
+        out["failed_by_class"]["lost"] = lost
+    lat = sorted(out.pop("latency_s"))
+    out["latency_p50_s"] = lat[len(lat) // 2] if lat else 0.0
+    out["latency_max_s"] = lat[-1] if lat else 0.0
+    try:
+        with urllib.request.urlopen(url + "/metrics", timeout=30) as r:
+            out["metrics"] = json.loads(r.read())
+    except OSError as exc:
+        out["metrics"] = {"error": str(exc)}
+    return out
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--url", default="http://127.0.0.1:8378")
+    p.add_argument("--requests", type=int, default=64)
+    p.add_argument("--concurrency", type=int, default=16)
+    p.add_argument("--degrees", default="1,2,3",
+                   help="comma-separated degree mix")
+    p.add_argument("--ndofs", type=int, default=4000)
+    p.add_argument("--nreps", type=int, default=15)
+    p.add_argument("--precision", default="f32",
+                   choices=["f32", "f64", "df32"])
+    p.add_argument("--timeout", type=float, default=120.0)
+    args = p.parse_args(argv)
+    summary = run_load(
+        args.url, requests=args.requests, concurrency=args.concurrency,
+        degrees=[int(d) for d in args.degrees.split(",") if d.strip()],
+        ndofs=args.ndofs, nreps=args.nreps, precision=args.precision,
+        timeout_s=args.timeout)
+    print(json.dumps(summary))
+    return 0 if summary["failed"] == 0 else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,430 @@
+"""Solver-as-a-service suite (bench_tpu_fem.serve): executable cache,
+batched engine parity, broker batching/admission/fault semantics, HTTP
+server, metrics journal replay.
+
+The two ISSUE-5 acceptance scenarios live here:
+
+- `test_server_smoke_64_concurrent_mixed_degree`: 64 concurrent
+  mixed-degree requests -> mean batch occupancy >= 4 RHS, request-level
+  cache hit-rate > 90% after warmup, ZERO recompiles on repeat configs
+  (cache counters), and every response matching the one-shot driver
+  result to the batched-parity tolerances.
+- `test_backpressure_under_fault_injection`: harness/faults hangs/OOMs
+  injected into the solve path -> the broker sheds with classified
+  retriable errors, never deadlocks the queue, and the metrics journal
+  replays the full incident.
+
+Everything is CPU (pytest runs under the hermetic 8-virtual-device CPU
+platform); serving-throughput numbers printed here are CPU-measured by
+construction.
+"""
+
+import json
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+import bench_tpu_fem.serve.engine as engine_mod
+from bench_tpu_fem.harness.faults import FaultySolveHook
+from bench_tpu_fem.serve import (
+    Broker,
+    ExecutableCache,
+    ExecutableKey,
+    Metrics,
+    QueueFull,
+    SolveSpec,
+    UnsupportedSpec,
+    build_solver,
+    make_server,
+    nrhs_bucket,
+    replay_serve,
+    spec_cache_key,
+)
+
+pytestmark = pytest.mark.serve
+
+# Small, fast serving specs shared across the suite (one compile each).
+SPECS = [SolveSpec(degree=d, ndofs=2500, nreps=12) for d in (1, 2, 3)]
+
+
+# ---------------------------------------------------------------------------
+# cache
+# ---------------------------------------------------------------------------
+
+def _key(i, bucket=4):
+    return ExecutableKey(3, (4, 4, i), "f32", "uniform", "unfused",
+                         bucket, (1, 1, 1), 10)
+
+
+def test_nrhs_bucket_rounding():
+    assert [nrhs_bucket(n) for n in (1, 2, 3, 4, 5, 8, 9, 16, 99)] == \
+        [1, 2, 4, 4, 8, 8, 16, 16, 16]
+
+
+def test_cache_counters_lru_eviction_and_warmup():
+    cache = ExecutableCache(capacity=2)
+    built = []
+
+    def builder(tag):
+        def _b():
+            built.append(tag)
+            return f"exe-{tag}"
+        return _b
+
+    e1 = cache.get_or_build(_key(1), builder(1))
+    assert e1.executable == "exe-1" and cache.stats()["compiles"] == 1
+    assert cache.get_or_build(_key(1), builder("dup")).executable == "exe-1"
+    assert cache.stats()["hits"] == 1 and built == [1]
+    cache.get_or_build(_key(2), builder(2))
+    cache.lookup(_key(1))  # LRU touch: key 2 is now the eviction victim
+    cache.get_or_build(_key(3), builder(3))
+    assert cache.stats()["evictions"] == 1
+    assert cache.lookup(_key(2)) is None and cache.lookup(_key(1))
+    # warmup prebuilds through the same counted path
+    cache.warmup([(_key(9), builder(9))])
+    assert built == [1, 2, 3, 9]
+    # counted get/insert (the driver exec-cache pairing)
+    assert cache.get(_key(9)) is not None
+    assert cache.get(_key(77)) is None
+    st = cache.stats()
+    assert st["hits"] == 2 and st["compiles"] == 4
+
+
+def test_spec_cache_key_fields():
+    k = spec_cache_key(SolveSpec(degree=3, ndofs=2500, nreps=12), 8)
+    assert k.degree == 3 and k.nrhs_bucket == 8
+    assert k.precision == "f32" and k.geom == "uniform"
+    assert k.engine_form == "unfused" and len(k.cell_shape) == 3
+
+
+def test_unsupported_specs_refused():
+    with pytest.raises(UnsupportedSpec):
+        SolveSpec(degree=9).validate()
+    with pytest.raises(UnsupportedSpec):
+        SolveSpec(precision="f16").validate()
+    with pytest.raises(UnsupportedSpec):
+        SolveSpec(precision="df32", geom_perturb_fact=0.1).validate()
+    # admission cap: an oversized request is refused before any
+    # problem-sized allocation happens (OOM-killer defense)
+    with pytest.raises(UnsupportedSpec):
+        SolveSpec(ndofs=10**12).validate()
+
+
+def test_driver_exec_cache_distinct_nrhs_no_collision():
+    """Driver exec-cache regression: nrhs=2 and nrhs=3 share a serve
+    bucket but compile different (unpadded) batch widths — they must
+    use distinct cache keys, not hand a 2-lane executable a 3-lane
+    input."""
+    from bench_tpu_fem.bench.driver import BenchConfig, run_benchmark
+
+    base = dict(ndofs_global=2000, degree=2, qmode=1, float_bits=32,
+                nreps=5, use_cg=True, exec_cache=True)
+    r2 = run_benchmark(BenchConfig(**base, nrhs=2))
+    r3 = run_benchmark(BenchConfig(**base, nrhs=3))  # same bucket (4)
+    assert r2.extra["exec_cache"] == "miss"
+    assert r3.extra["exec_cache"] == "miss"  # distinct key, no reuse
+    # and an exact repeat still hits
+    r3b = run_benchmark(BenchConfig(**base, nrhs=3))
+    assert r3b.extra["exec_cache"] == "hit"
+    assert r3b.ynorm == r3.ynorm
+
+
+# ---------------------------------------------------------------------------
+# engine parity
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def solver_f32():
+    return build_solver(SPECS[2], bucket=4)
+
+
+@pytest.fixture(scope="module")
+def solver_f32_d2():
+    return build_solver(SPECS[1], bucket=4)
+
+
+def test_engine_solve_scale_linearity_and_padding(solver_f32):
+    r = solver_f32.solve([1.0, 2.0, 0.5])
+    assert r.nrhs_live == 3 and r.nrhs_bucket == 4
+    np.testing.assert_allclose(r.xnorms[1], 2.0 * r.xnorms[0], rtol=1e-6)
+    np.testing.assert_allclose(r.xnorms[2], 0.5 * r.xnorms[0], rtol=1e-6)
+    assert r.gdof_per_second > 0
+
+
+def test_engine_matches_one_shot_driver_f32(solver_f32):
+    """Serving response == the one-shot scalar solver on the same
+    operator/RHS, to the batched-parity tolerance (<= 1e-7 f32)."""
+    import jax
+    import jax.numpy as jnp
+
+    from bench_tpu_fem.la import cg_solve
+
+    r = solver_f32.solve([1.0])
+    x_ref = jax.jit(
+        lambda A, b: cg_solve(A.apply, b, jnp.zeros_like(b),
+                              solver_f32.spec.nreps)
+    )(solver_f32._op, solver_f32._base)
+    ref_norm = float(np.sqrt(float(jnp.vdot(x_ref, x_ref))))
+    np.testing.assert_allclose(r.xnorms[0], ref_norm, rtol=1e-7)
+
+
+def test_engine_matches_one_shot_df32():
+    """df32 serving parity (<= 1e-13): the vmapped lane equals the
+    scalar cg_solve_df result."""
+    import jax
+
+    from bench_tpu_fem.la.df64 import df_dot, df_to_f64
+    from bench_tpu_fem.ops.kron_df import cg_solve_df
+
+    spec = SolveSpec(degree=2, ndofs=2000, nreps=12, precision="df32")
+    s = build_solver(spec, bucket=2)
+    r = s.solve([1.0, 2.0])
+    x_ref = jax.jit(lambda A, b: cg_solve_df(A, b, spec.nreps))(
+        s._op, s._base)
+    ref_norm = float(np.sqrt(max(
+        float(df_to_f64(jax.jit(df_dot)(x_ref, x_ref))), 0.0)))
+    np.testing.assert_allclose(r.xnorms[0], ref_norm, rtol=1e-13)
+    np.testing.assert_allclose(r.xnorms[1], 2.0 * ref_norm, rtol=1e-13)
+
+
+# ---------------------------------------------------------------------------
+# broker
+# ---------------------------------------------------------------------------
+
+def _mini_broker(metrics=None, **kw):
+    defaults = dict(queue_max=64, nrhs_max=4, window_s=0.1,
+                    solve_timeout_s=60.0)
+    defaults.update(kw)
+    return Broker(ExecutableCache(), metrics or Metrics(), **defaults)
+
+
+def test_broker_batches_compatible_requests(solver_f32):
+    """Same-spec requests batch into one executable run; the prebuilt
+    bucket is preferred over the minimal one (no extra compile)."""
+    broker = _mini_broker()
+    broker.cache.get_or_build(spec_cache_key(SPECS[2], 4),
+                              lambda: solver_f32)
+    compiles0 = broker.cache.stats()["compiles"]
+    pending = [broker.submit(SPECS[2], scale=1.0 + i) for i in range(3)]
+    outs = [broker.wait(p, 60) for p in pending]
+    broker.shutdown()
+    assert all(o["ok"] for o in outs)
+    assert {o["nrhs_live"] for o in outs} == {3}
+    assert all(o["nrhs_bucket"] == 4 for o in outs)  # prebuilt bucket
+    assert all(o["cache"] == "hit" for o in outs)
+    assert broker.cache.stats()["compiles"] == compiles0
+    assert broker.metrics.snapshot()["mean_batch_occupancy"] == 3.0
+
+
+def test_broker_sheds_on_full_queue(solver_f32):
+    """Admission control: a full queue sheds immediately (QueueFull ->
+    503 at the server), counted in metrics."""
+    broker = _mini_broker(queue_max=2)
+    # stall the worker so the queue actually fills
+    engine_mod.FAULT_HOOK = FaultySolveHook(["hang"], hang_s=2.0)
+    try:
+        broker.cache.get_or_build(spec_cache_key(SPECS[2], 4),
+                                  lambda: solver_f32)
+        first = broker.submit(SPECS[2])  # picked up by the worker
+        time.sleep(0.3)  # let the worker enter the hung solve
+        broker.submit(SPECS[2])
+        broker.submit(SPECS[2])
+        with pytest.raises(QueueFull):
+            broker.submit(SPECS[2])
+        assert broker.metrics.shed_total == 1
+        assert broker.wait(first, 30)["ok"]
+    finally:
+        engine_mod.FAULT_HOOK = None
+        broker.shutdown()
+
+
+def test_broker_deterministic_fault_not_retriable(solver_f32_d2):
+    broker = _mini_broker()
+    broker.cache.get_or_build(spec_cache_key(SPECS[1], 4),
+                              lambda: solver_f32_d2)
+    engine_mod.FAULT_HOOK = FaultySolveHook(["mosaic"])
+    try:
+        out = broker.wait(broker.submit(SPECS[1]), 60)
+        assert not out["ok"]
+        assert out["failure_class"] == "mosaic_reject"
+        assert out["retriable"] is False
+    finally:
+        engine_mod.FAULT_HOOK = None
+        broker.shutdown()
+
+
+def test_broker_unsupported_spec_classified():
+    broker = _mini_broker()
+    try:
+        out = broker.wait(
+            broker.submit(SolveSpec(degree=3, ndofs=2000, nreps=5,
+                                    precision="df32",
+                                    geom_perturb_fact=0.1)), 60)
+        assert not out["ok"]
+        assert out["failure_class"] == "unsupported"
+        assert out["retriable"] is False
+    finally:
+        broker.shutdown()
+
+
+def test_backpressure_under_fault_injection(tmp_path, solver_f32_d2):
+    """The acceptance scenario: hangs + OOMs injected into the solve
+    path. The broker answers every request with a classified retriable
+    error, keeps serving afterwards (no queue deadlock — the hung batch
+    thread is abandoned), and the crash-safe metrics journal replays
+    the whole incident."""
+    journal = str(tmp_path / "SERVE_incident.jsonl")
+    metrics = Metrics(journal)
+    broker = _mini_broker(metrics, solve_timeout_s=1.0, window_s=0.05)
+    spec = SPECS[1]
+    broker.cache.get_or_build(spec_cache_key(spec, 4),
+                              lambda: solver_f32_d2)
+    engine_mod.FAULT_HOOK = FaultySolveHook(["hang", "oom"], hang_s=3.0)
+    try:
+        # incident phase 1: the hang — answered at the 1 s deadline
+        out1 = broker.wait(broker.submit(spec), 30)
+        assert not out1["ok"] and out1["retriable"] is True
+        assert out1["failure_class"] == "timeout"
+        # incident phase 2: the OOM — classified, retriable
+        out2 = broker.wait(broker.submit(spec), 30)
+        assert not out2["ok"] and out2["retriable"] is True
+        assert out2["failure_class"] == "oom"
+        # recovery: the queue never deadlocked; the next request solves
+        out3 = broker.wait(broker.submit(spec), 30)
+        assert out3["ok"], out3
+    finally:
+        engine_mod.FAULT_HOOK = None
+        broker.shutdown()
+    replay = replay_serve(journal)
+    assert replay["requests"] == 3
+    assert replay["responses_ok"] == 1
+    assert replay["responses_failed"] == 2
+    assert replay["failed_by_class"] == {"timeout": 1, "oom": 1}
+    assert replay["corrupt_lines"] == 0
+
+
+# ---------------------------------------------------------------------------
+# HTTP server (the 64-request acceptance smoke)
+# ---------------------------------------------------------------------------
+
+def _post(url, body, timeout=120):
+    req = urllib.request.Request(url, data=json.dumps(body).encode(),
+                                 method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+@pytest.fixture(scope="module")
+def served_broker():
+    metrics = Metrics()
+    broker = Broker(ExecutableCache(), metrics, queue_max=256,
+                    nrhs_max=8, window_s=0.2, solve_timeout_s=60.0)
+    broker.warmup(SPECS)
+    srv = make_server(broker)
+    t = threading.Thread(target=srv.serve_forever, daemon=True)
+    t.start()
+    host, port = srv.server_address[:2]
+    yield broker, f"http://{host}:{port}"
+    srv.shutdown()
+    broker.shutdown()
+
+
+def test_server_healthz_metrics_and_errors(served_broker):
+    _, url = served_broker
+    with urllib.request.urlopen(url + "/healthz", timeout=10) as r:
+        assert json.loads(r.read())["ok"]
+    code, body = _post(url + "/solve", {"degree": "not-a-number"})
+    assert code == 400 and body["failure_class"] == "unsupported"
+    # a non-dict JSON body must come back as a contracted 400, not a
+    # dropped connection from an uncaught handler AttributeError
+    req = urllib.request.Request(url + "/solve", data=b"[1, 2]",
+                                 method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=10) as r:
+            code, body = r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        code, body = e.code, json.loads(e.read())
+    assert code == 400 and body["failure_class"] == "unsupported"
+    code, body = _post(url + "/solve", {"degree": 3, "precision": "df32",
+                                        "geom_perturb_fact": 0.5})
+    assert code == 422 and body["failure_class"] == "unsupported"
+
+
+def test_server_smoke_64_concurrent_mixed_degree(served_broker):
+    """64 concurrent mixed-degree requests: occupancy >= 4, hit-rate
+    > 90% after warmup, zero recompiles (cache counters), and every
+    response matching the one-shot driver result (xnorm == scale *
+    one-shot norm, <= 1e-7 relative — f32 parity tolerance)."""
+    import jax
+    import jax.numpy as jnp
+
+    from bench_tpu_fem.la import cg_solve
+
+    broker, url = served_broker
+    compiles0 = broker.cache.stats()["compiles"]
+
+    # one-shot oracle per degree, from the same compiled solvers' base
+    # problem (scale-linearity makes every scaled response checkable)
+    one_shot = {}
+    for spec in SPECS:
+        entry = broker.cache.lookup(spec_cache_key(spec, 8))
+        s = entry.executable
+        x = jax.jit(
+            lambda A, b, nreps=spec.nreps: cg_solve(
+                A.apply, b, jnp.zeros_like(b), nreps)
+        )(s._op, s._base)
+        one_shot[spec.degree] = float(np.sqrt(float(jnp.vdot(x, x))))
+
+    results = []
+    errors = []
+
+    def fire(i):
+        spec = SPECS[i % len(SPECS)]
+        # power-of-two scales: exact in f32, so scale-linearity against
+        # the one-shot oracle is exact too (see bench.driver.batch_scales)
+        scale = float(2 ** (i % 3))
+        code, body = _post(url + "/solve", {
+            "degree": spec.degree, "ndofs": spec.ndofs,
+            "nreps": spec.nreps, "scale": scale})
+        (results if code == 200 else errors).append((spec, scale, body))
+
+    threads = [threading.Thread(target=fire, args=(i,))
+               for i in range(64)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, errors[:3]
+    assert len(results) == 64
+
+    for spec, scale, body in results:
+        assert body["cg_engine_form"] == "unfused"
+        np.testing.assert_allclose(
+            body["xnorm"], scale * one_shot[spec.degree], rtol=1e-7,
+            err_msg=f"degree {spec.degree} scale {scale}: response "
+                    "diverged from the one-shot driver")
+
+    snap = broker.metrics.snapshot(cache_stats=broker.cache.stats())
+    assert snap["mean_batch_occupancy"] >= 4.0, snap
+    assert snap["cache_hit_rate_requests"] > 0.9, snap
+    # zero recompiles on repeat configs, asserted via cache counters
+    assert broker.cache.stats()["compiles"] == compiles0, snap
+
+
+def test_loadgen_against_in_process_server(served_broker):
+    """scripts/serve_loadgen drives the same acceptance flow from the
+    outside (the CI serve lane runs it against a real subprocess)."""
+    import scripts.serve_loadgen as lg
+
+    _, url = served_broker
+    summary = lg.run_load(url, requests=12, concurrency=6,
+                          degrees=[1, 2, 3], ndofs=2500, nreps=12,
+                          timeout_s=120)
+    assert summary["completed"] == 12 and summary["failed"] == 0
+    assert summary["metrics"]["requests_total"] >= 12
