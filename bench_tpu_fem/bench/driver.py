@@ -79,10 +79,12 @@ class BenchConfig:
     profile_dir: str = ""
     # batched multi-RHS: solve nrhs right-hand sides (distinct per-lane
     # scales of the benchmark RHS) in ONE batched CG/action — the
-    # serving-layer shape (la.cg.cg_solve_batched). GDoF/s accounts the
-    # whole batch: ndofs * nreps * nrhs / t. Runs the UNFUSED operators
-    # (vmapped); the fused engines have no batched form yet and the
-    # fallback is recorded (cg_engine_form: "unfused").
+    # serving-layer shape. Single-chip uniform kron f32 CG runs the
+    # fused nrhs-native delay ring (ops.kron_cg.kron_cg_solve_batched)
+    # where the per-bucket VMEM plan admits it; other paths run the
+    # UNFUSED vmapped operators with the fallback recorded
+    # (cg_engine_form: "unfused"). GDoF/s accounts the whole batch:
+    # ndofs * nreps * nrhs / t.
     nrhs: int = 1
     # route the final solver compile through the serve-layer executable
     # cache (serve.cache.default_cache) so repeated identical configs in
@@ -144,14 +146,19 @@ def record_engine(extra: dict, engine: bool, form: str | None = None,
 
 
 # engine_plan/engine_plan_df form names -> the unified vocabulary
-ENGINE_FORM_NAMES = {"one": "one_kernel", "chunked": "chunked"}
+ENGINE_FORM_NAMES = {"one": "one_kernel", "chunked": "chunked",
+                     "one_batched": "one_kernel_batched"}
 
-# The recorded reason every nrhs>1 branch stamps (classified
-# `unsupported` by the harness taxonomy): the fused delay-ring engines
-# have no batched form, so batching runs the unfused vmapped apply.
+# The recorded reason every nrhs>1 branch WITHOUT a fused batched form
+# stamps (classified `unsupported` by the harness taxonomy). Since the
+# nrhs-native kron engine (ops.kron_cg.kron_cg_solve_batched) landed,
+# single-chip uniform f32 CG batches run fused where the per-bucket VMEM
+# plan admits them; every other batched branch (action, folded, df,
+# sharded, over-budget buckets) still runs the unfused vmapped apply and
+# records this.
 BATCHED_UNFUSED_REASON = (
-    "batched multi-RHS (nrhs>1): fused-engine batching is unsupported; "
-    "running the unfused vmapped apply")
+    "batched multi-RHS (nrhs>1): fused batching is unsupported on this "
+    "path (no batched engine form); running the unfused vmapped apply")
 
 
 def batch_scales(nrhs: int) -> np.ndarray:
@@ -656,11 +663,16 @@ def _finish_batched(cfg: BenchConfig, res: BenchmarkResults, n, op, u,
                     folded: bool, compile_opts, oracle_args=None):
     """Batched multi-RHS completion of the single-chip f32/f64 benchmark:
     nrhs per-lane-scaled copies of the benchmark RHS through ONE batched
-    computation — `la.cg.cg_solve_batched` over the vmapped UNFUSED
-    apply (CG), or a vmapped apply inside the fenced rep loop (action).
-    Reported norms are lane 0's (scale 1.0 — the one-shot problem
-    verbatim, so unorm/ynorm stay comparable across nrhs); GDoF/s
-    accounts the whole batch (ndofs * nreps * nrhs / t)."""
+    computation. The single-chip uniform kron f32 CG path runs the FUSED
+    nrhs-native delay ring (ops.kron_cg.kron_cg_solve_batched,
+    `cg_engine_form: "one_kernel_batched"`) where the per-bucket VMEM
+    plan admits it; every other combination runs
+    `la.cg.cg_solve_batched` over the vmapped UNFUSED apply (CG) or a
+    vmapped apply inside the fenced rep loop (action), recording
+    BATCHED_UNFUSED_REASON. Reported norms are lane 0's (scale 1.0 —
+    the one-shot problem verbatim, so unorm/ynorm stay comparable
+    across nrhs); GDoF/s accounts the whole batch
+    (ndofs * nreps * nrhs / t)."""
     import jax
     import jax.numpy as jnp
 
@@ -668,10 +680,42 @@ def _finish_batched(cfg: BenchConfig, res: BenchmarkResults, n, op, u,
     from ..la.vector import norm, norm_linf
 
     stamp_nrhs(res.extra, cfg.nrhs)
-    record_engine(res.extra, False, error=BATCHED_UNFUSED_REASON)
     apply_one = (lambda A: A.apply_cg) if folded else (lambda A: A.apply)
     scales = jnp.asarray(batch_scales(cfg.nrhs), u.dtype)
     B = scales.reshape((-1,) + (1,) * u.ndim) * u[None]
+
+    # Fused batched engine (ops.kron_cg.kron_cg_solve_batched): the
+    # nrhs-native delay ring on the single-chip uniform kron CG path,
+    # where the per-bucket VMEM plan admits this lane count. Everything
+    # else (action, folded, non-f32, over-budget nrhs) stays on the
+    # unfused vmapped apply with the reason recorded.
+    engine = False
+    planned_form = "unfused"
+    engine_run = None
+    engine_opts = compile_opts
+    if (cfg.use_cg and not folded
+            and res.extra.get("backend") == "kron"
+            and jax.default_backend() == "tpu"):
+        from ..ops.kron_cg import (
+            engine_plan_batched,
+            kron_cg_solve_batched,
+            supports_kron_cg_engine_batched,
+        )
+
+        if supports_kron_cg_engine_batched(u.shape, cfg.degree, u.dtype,
+                                           cfg.nrhs):
+            form, kib = engine_plan_batched(u.shape, cfg.degree, cfg.nrhs)
+            engine = True
+            planned_form = form
+            engine_opts = scoped_vmem_options(kib)
+            record_engine(res.extra, True,
+                          ENGINE_FORM_NAMES.get(form, form))
+
+            def engine_run(A, Bv):
+                return kron_cg_solve_batched(A, Bv, cfg.nreps)
+
+    if not engine:
+        record_engine(res.extra, False, error=BATCHED_UNFUSED_REASON)
 
     if cfg.use_cg:
         def run(A, Bv):
@@ -686,11 +730,26 @@ def _finish_batched(cfg: BenchConfig, res: BenchmarkResults, n, op, u,
             return jax.lax.fori_loop(0, cfg.nreps, _rep,
                                      jnp.zeros_like(Bv))
 
-    key = _exec_cache_key(cfg, n, "unfused",
+    # Exec-cache key on the PLANNED form (deterministic per config; a
+    # Mosaic-reject fallback executable is stored under the planned key
+    # with its true routing stamps replayed from the entry meta).
+    key = _exec_cache_key(cfg, n, planned_form,
                           "cg" if cfg.use_cg else "action")
     fn = _exec_cache_get(cfg, key, res)
+    from_cache = fn is not None
+    if fn is None and engine:
+        # Same hardening as the single-RHS engine compiles: a Mosaic
+        # rejection of the batched ring (a drifted per-bucket tier
+        # boundary) must not sink the benchmark — fall back to the
+        # unfused vmapped path, recording why. Compile errors only.
+        try:
+            fn = compile_lowered(jax.jit(engine_run).lower(op, B),
+                                 engine_opts)
+        except Exception as exc:
+            record_engine(res.extra, False, error=exc)
     if fn is None:
         fn = compile_lowered(jax.jit(run).lower(op, B), compile_opts)
+    if not from_cache:
         _exec_cache_put(cfg, key, fn, res)
     warm = fn(op, B)
     float(warm[(0,) * warm.ndim])

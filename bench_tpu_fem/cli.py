@@ -83,6 +83,32 @@ def main(argv: list[str] | None = None) -> int:
         raise SystemExit("Conflicting options 'ndofs' and 'ndofs_global'")
     if args.nrhs < 1:
         raise SystemExit("Invalid nrhs. Must be >= 1.")
+    # Early serve-bucket audit (satellite, ISSUE 6): the benchmark
+    # compiles the EXACT nrhs width, but a serving deployment pads the
+    # batch to its executable-cache bucket — warn up front when those
+    # differ (dead padded lanes burn bucket capacity), instead of the
+    # user discovering it deep in the driver's artifact stamps. The
+    # padded width is stamped on the artifact either way (stamp_nrhs).
+    from .serve.cache import NRHS_BUCKETS, nrhs_bucket
+
+    padded_nrhs = nrhs_bucket(args.nrhs)
+    if args.nrhs > NRHS_BUCKETS[-1]:
+        import warnings
+
+        warnings.warn(
+            f"--nrhs {args.nrhs} exceeds the largest serve bucket "
+            f"({NRHS_BUCKETS[-1]}): a serving deployment would split "
+            f"this batch across buckets; the benchmark itself runs the "
+            f"exact width. Artifact stamps nrhs_bucket={padded_nrhs}.")
+    elif args.nrhs > 1 and padded_nrhs != args.nrhs:
+        import warnings
+
+        warnings.warn(
+            f"--nrhs {args.nrhs} is not a serve bucket "
+            f"{NRHS_BUCKETS}: a serving deployment pads this batch to "
+            f"{padded_nrhs} lanes ({padded_nrhs - args.nrhs} dead); the "
+            f"benchmark itself runs the exact width. Artifact stamps "
+            f"nrhs_bucket={padded_nrhs}.")
 
     from .utils.logging import init_logging
 

@@ -302,6 +302,27 @@ assert res.extra.get("cg_engine_form") == "ext2d", res.extra
 """
 
 
+FUSEDBATCH = PRE + """
+# The nrhs-native fused batched kron engine (ISSUE 6) on hardware:
+# batched GDoF/s at the serve buckets vs the unfused vmapped fallback,
+# with the engine-form stamp asserted — converts the per-bucket VMEM
+# tier admissions from design estimates to measurements.
+for nrhs in (2, 4, 8):
+    cfg = BenchConfig(ndofs_global=12_500_000, degree=3, qmode=1,
+                      float_bits=32, nreps=200, use_cg=True, nrhs=nrhs)
+    res, w = timed_res(cfg)
+    print(f"FUSEDBATCH nrhs{nrhs}:", res.gdof_per_second, res.extra)
+    assert res.extra.get("cg_engine_form") == "one_kernel_batched", \\
+        res.extra
+import bench_tpu_fem.ops.kron_cg as KC
+KC.engine_plan_batched = lambda *a: ("unfused", None)
+cfg = BenchConfig(ndofs_global=12_500_000, degree=3, qmode=1,
+                  float_bits=32, nreps=200, use_cg=True, nrhs=4)
+res2, w = timed_res(cfg)
+print("FUSEDBATCH unfused4:", res2.gdof_per_second, res2.extra)
+"""
+
+
 SERVE_SMOKE = """
 import os
 if os.environ.get('JAX_PLATFORMS', '') == 'cpu':
@@ -310,9 +331,10 @@ if os.environ.get('JAX_PLATFORMS', '') == 'cpu':
 import json, threading, urllib.request
 from bench_tpu_fem.serve import (Broker, ExecutableCache, Metrics,
                                  SolveSpec, make_server)
+import time
 cache = ExecutableCache(); metrics = Metrics()
-broker = Broker(cache, metrics, queue_max=256, nrhs_max=8, window_s=0.2)
-specs = [SolveSpec(degree=d, ndofs=4000, nreps=15) for d in (1, 2, 3)]
+broker = Broker(cache, metrics, queue_max=256, nrhs_max=8, window_s=0.05)
+specs = [SolveSpec(degree=d, ndofs=4000, nreps=40) for d in (1, 2, 3)]
 broker.warmup(specs)
 compiles0 = cache.stats()['compiles']
 srv = make_server(broker); host, port = srv.server_address[:2]
@@ -327,17 +349,25 @@ def fire(i):
     with urllib.request.urlopen(req, timeout=120) as r:
         results.append(json.loads(r.read()))
 threads = [threading.Thread(target=fire, args=(i,)) for i in range(64)]
-[t.start() for t in threads]; [t.join() for t in threads]
+# ramp arrivals: the queue must span solve boundaries so continuous
+# batching has mid-solve work to admit (ISSUE 6 acceptance)
+for t in threads:
+    t.start(); time.sleep(0.02)
+[t.join() for t in threads]
 snap = json.loads(urllib.request.urlopen(
     f'http://{host}:{port}/metrics', timeout=30).read())
 srv.shutdown(); broker.shutdown()
 assert len(results) == 64 and all(r['ok'] for r in results), snap
+assert all(r['cg_engine_form'] == 'one_kernel_batched'
+           for r in results), results[0]
 assert snap['mean_batch_occupancy'] >= 4.0, snap
 assert snap['cache_hit_rate_requests'] > 0.9, snap
+assert snap['midsolve_admissions'] >= 1, snap
 assert cache.stats()['compiles'] == compiles0, cache.stats()
 print('SERVE OK', {k: round(snap[k], 3) for k in (
     'requests_total', 'batches', 'mean_batch_occupancy',
-    'cache_hit_rate_requests')})
+    'cache_hit_rate_requests', 'midsolve_admissions',
+    'mean_live_lanes', 'padding_waste')})
 """
 
 
@@ -372,10 +402,16 @@ def make_stages(round_tag: str = DEFAULT_ROUND) -> dict[str, Stage]:
                   "form"), 1800),
         # Serving-layer smoke (CPU-pinned: a software-stack check, not a
         # hardware measurement — and it must never hang on a wedged
-        # tunnel): 64 concurrent mixed-degree requests through the
-        # broker, asserting batch occupancy, warm-cache hit-rate and
-        # zero recompiles. See README "Serving".
+        # tunnel): 64 ramped mixed-degree requests through the broker,
+        # asserting the fused batched engine form, batch occupancy,
+        # mid-solve admissions (continuous batching), warm-cache
+        # hit-rate and zero recompiles. See README "Serving".
         _py("serve", SERVE_SMOKE, 300, env={"JAX_PLATFORMS": "cpu"}),
+        # The fused batched engine on hardware (ISSUE 6): batched
+        # GDoF/s at serve buckets 2/4/8 + the unfused A/B — converts
+        # the per-bucket VMEM tiers from design estimates to
+        # measurements the moment the tunnel lives.
+        _py("fusedbatch", FUSEDBATCH, 2400),
         _py("dfacc", DFACC, 1800, provides="dfacc"),
         _py("pertdf", PERTDF, 2400, gate="dfacc"),
         _py("foldeng", FOLDENG, 2400),
@@ -451,9 +487,9 @@ ALIASES = {
 # Round-6 default agenda, ordered by value-per-minute under wedge risk
 # (measure_all's ordering, expanded through ALIASES).
 AGENDAS = {
-    "round6": ["health", "serve", "dfacc", "pertdf", "foldeng", "dfext2d",
-               "dfeng", "bench", "dflarge", "pert100", "deg7probe",
-               "matrix"],
+    "round6": ["health", "serve", "fusedbatch", "dfacc", "pertdf",
+               "foldeng", "dfext2d", "dfeng", "bench", "dflarge",
+               "pert100", "deg7probe", "matrix"],
 }
 
 

@@ -7,7 +7,18 @@ whole CG iteration (halo exchange, operator, two dots, three axpys) is one
 XLA computation with no host round-trips.
 """
 
-from .cg import cg_solve, cg_solve_batched
+from .cg import (
+    BatchedCGState,
+    batched_cg_admit,
+    batched_cg_init,
+    batched_cg_retire,
+    batched_cg_run,
+    cg_solve,
+    cg_solve_batched,
+    fused_cg_solve_batched,
+    make_batched_cg_step,
+    unfused_batch_engine,
+)
 from .vector import (
     axpy,
     inner_product,
@@ -20,9 +31,17 @@ from .vector import (
 )
 
 __all__ = [
+    "BatchedCGState",
     "axpy",
+    "batched_cg_admit",
+    "batched_cg_init",
+    "batched_cg_retire",
+    "batched_cg_run",
     "cg_solve",
     "cg_solve_batched",
+    "fused_cg_solve_batched",
+    "make_batched_cg_step",
+    "unfused_batch_engine",
     "inner_product",
     "inner_product_compensated",
     "norm",

@@ -15,7 +15,7 @@ product while reusing this loop unchanged inside `shard_map`.
 
 from __future__ import annotations
 
-from typing import Callable
+from typing import Callable, NamedTuple
 
 import jax
 import jax.numpy as jnp
@@ -147,6 +147,204 @@ def cg_solve_batched(
     state = (X0, R, P, rnorm0, done0)
     X, *_ = jax.lax.fori_loop(0, max_iter, body, state)
     return X
+
+
+# ---------------------------------------------------------------------------
+# Checkpointable batched CG: the continuous-batching primitive.
+#
+# `cg_solve_batched` above runs a whole batch to completion inside one
+# fori_loop — the fixed-window serving shape. Continuous batching needs
+# the SAME per-lane recurrence exposed at iteration boundaries, so the
+# serving broker can admit a new RHS into a free lane and retire a
+# finished lane while the other lanes keep iterating. The state below is
+# that boundary: one pytree per batch, every field lane-major, every
+# lane's algebra independent of every other lane's (the only shared
+# computation, the batched operator apply, is lane-diagonal), so an
+# admit/retire is a pure per-lane state edit and the frozen-lane `keep`
+# discipline of `cg_solve_batched` carries over unchanged.
+#
+# The recurrence is the p-update-reassociated form the fused engines use
+# (p = beta * p_prev + r at the START of the iteration — see
+# `fused_cg_solve`): with the unfused composition engine
+# (`unfused_batch_engine`) it is the same per-element operation order as
+# `cg_solve_batched`, measured bitwise-equal per lane on CPU —
+# `cg_solve_batched` stays the parity oracle. A fused engine (e.g.
+# ops.kron_cg.kron_batched_engine) slots into the same step function and
+# matches to f32 reassociation accuracy instead.
+# ---------------------------------------------------------------------------
+
+
+class BatchedCGState(NamedTuple):
+    """One batched CG solve at an iteration boundary. Lane-major
+    ((nrhs, ...) arrays / (nrhs,) scalars); `P` is the search direction
+    the LAST iteration used (p_{k-1} of the reassociated recurrence),
+    `beta` the coefficient the NEXT p-update will apply, `iters` the
+    per-lane iteration count since that lane's admission (each lane runs
+    exactly its own budget: benchmark rtol=0 semantics per request)."""
+
+    X: jnp.ndarray
+    R: jnp.ndarray
+    P: jnp.ndarray
+    beta: jnp.ndarray
+    rnorm: jnp.ndarray
+    rnorm0: jnp.ndarray
+    done: jnp.ndarray
+    iters: jnp.ndarray
+
+
+def batched_cg_init(B: jnp.ndarray,
+                    dot: Callable | None = None) -> BatchedCGState:
+    """Fresh state for a padded RHS stack, x0 = 0 (the serving and
+    benchmark semantics — `cg_solve_batched(apply, B, 0, ...)` computes
+    apply(0) = 0 exactly, so skipping the initial apply is bitwise
+    equivalent). All-zero lanes (padding) are born frozen, exactly as in
+    `cg_solve_batched`."""
+    if dot is None:
+        dot = batched_dot
+    nrhs = B.shape[0]
+    rnorm0 = dot(B, B)
+    return BatchedCGState(
+        X=jnp.zeros_like(B),
+        R=B,
+        P=jnp.zeros_like(B),
+        beta=jnp.zeros((nrhs,), B.dtype),
+        rnorm=rnorm0,
+        rnorm0=rnorm0,
+        done=rnorm0 == jnp.zeros((), rnorm0.dtype),
+        iters=jnp.zeros((nrhs,), jnp.int32),
+    )
+
+
+def unfused_batch_engine(batch_apply: Callable,
+                         dot: Callable | None = None) -> Callable:
+    """The unfused composition of the fused-engine contract
+    `engine(R, P_prev, beta) -> (P, Y, <P, A P>)`: p-update, vmapped
+    operator apply and alpha-dot as separate XLA passes. Driving
+    `make_batched_cg_step` with this engine reproduces
+    `cg_solve_batched` bitwise per lane (same ops, same order — the
+    p-update just moved across the loop boundary)."""
+    if dot is None:
+        dot = batched_dot
+
+    def engine(R, P_prev, beta):
+        P = _bcast(beta, P_prev) * P_prev + R
+        Y = batch_apply(P)
+        return P, Y, dot(P, Y)
+
+    return engine
+
+
+def make_batched_cg_step(engine: Callable, nreps: int,
+                         dot: Callable | None = None,
+                         rtol: float = 0.0) -> Callable:
+    """One iteration `state -> state` of the batched reassociated CG
+    recurrence. Frozen-lane discipline identical to `cg_solve_batched`:
+    a done lane's arithmetic is computed and discarded (`keep`), its
+    state bit-frozen; a lane freezes when its own `iters` reaches
+    `nreps` (each lane gets exactly its request's iteration budget,
+    regardless of when it was admitted) or, with rtol > 0, when its
+    residual converges. Dead/padding lanes (rnorm0 == 0) produce the
+    same 0/0 arithmetic `cg_solve_batched` documents — discarded every
+    iteration, never contaminating live lanes."""
+    if dot is None:
+        dot = batched_dot
+
+    def step(state: BatchedCGState) -> BatchedCGState:
+        X, R, P_prev, beta, rnorm, rnorm0, done, iters = state
+        P, Y, pdot = engine(R, P_prev, beta)
+        alpha = rnorm / pdot
+        X1 = X + _bcast(alpha, X) * P
+        R1 = R - _bcast(alpha, R) * Y
+        rnorm1 = dot(R1, R1)
+        beta1 = rnorm1 / rnorm
+        iters1 = iters + 1
+        new_done = jnp.logical_or(done, iters1 >= jnp.int32(nreps))
+        if rtol > 0.0:
+            new_done = jnp.logical_or(
+                new_done, rnorm1 / rnorm0 < jnp.asarray(rtol * rtol,
+                                                        rnorm1.dtype))
+
+        def keep(new, old):
+            return jnp.where(_bcast(done, old), old, new)
+
+        def keep1(new, old):
+            return jnp.where(done, old, new)
+
+        return BatchedCGState(
+            X=keep(X1, X),
+            R=keep(R1, R),
+            P=keep(P, P_prev),
+            beta=keep1(beta1, beta),
+            rnorm=keep1(rnorm1, rnorm),
+            rnorm0=rnorm0,
+            done=new_done,
+            iters=jnp.where(done, iters, iters1),
+        )
+
+    return step
+
+
+def batched_cg_run(state: BatchedCGState, step: Callable,
+                   k: int) -> BatchedCGState:
+    """Advance a batched solve by k iteration boundaries (one compiled
+    fori_loop; frozen lanes stay frozen, so overshooting a lane's budget
+    is harmless)."""
+    return jax.lax.fori_loop(0, k, lambda _, s: step(s), state)
+
+
+def batched_cg_admit(state: BatchedCGState, lane,
+                     b: jnp.ndarray) -> BatchedCGState:
+    """Admit a new RHS into one lane at an iteration boundary: the lane
+    restarts exactly as a fresh `batched_cg_init` lane would (x0 = 0,
+    its own rnorm0/iters), so its trajectory is indistinguishable from
+    the same RHS solved in a fresh batch — the admit-parity property the
+    serving tests assert. Every edit is lane-local; live lanes' state is
+    untouched bit-for-bit."""
+    rn = inner_product(b, b)
+    zero = jnp.zeros_like(b)
+    return BatchedCGState(
+        X=state.X.at[lane].set(zero),
+        R=state.R.at[lane].set(b),
+        P=state.P.at[lane].set(zero),
+        beta=state.beta.at[lane].set(jnp.zeros((), state.beta.dtype)),
+        rnorm=state.rnorm.at[lane].set(rn),
+        rnorm0=state.rnorm0.at[lane].set(rn),
+        done=state.done.at[lane].set(rn == jnp.zeros((), rn.dtype)),
+        iters=state.iters.at[lane].set(jnp.zeros((), jnp.int32)),
+    )
+
+
+def batched_cg_retire(state: BatchedCGState, lane) -> BatchedCGState:
+    """Retire one lane at an iteration boundary: zero its state and mark
+    it born-frozen (rnorm0 = 0, the padding-lane convention), freeing
+    the lane for a future admit. Lane-local, so live lanes are
+    unperturbed bit-for-bit."""
+    zero = jnp.zeros_like(state.X[0])
+    zs = jnp.zeros((), state.rnorm.dtype)
+    return BatchedCGState(
+        X=state.X.at[lane].set(zero),
+        R=state.R.at[lane].set(zero),
+        P=state.P.at[lane].set(zero),
+        beta=state.beta.at[lane].set(jnp.zeros((), state.beta.dtype)),
+        rnorm=state.rnorm.at[lane].set(zs),
+        rnorm0=state.rnorm0.at[lane].set(zs),
+        done=state.done.at[lane].set(True),
+        iters=state.iters.at[lane].set(jnp.zeros((), jnp.int32)),
+    )
+
+
+def fused_cg_solve_batched(engine: Callable, B: jnp.ndarray, nreps: int,
+                           dot: Callable | None = None) -> jnp.ndarray:
+    """Whole-batch driver over the checkpointable machinery: init + nreps
+    steps, returning X — the batched analogue of `fused_cg_solve`
+    (benchmark semantics: x0 = 0, rtol = 0, exactly nreps iterations per
+    live lane; padding lanes born frozen). With `unfused_batch_engine`
+    this equals `cg_solve_batched` bitwise per lane; with a fused engine
+    it matches to f32 reassociation accuracy (<= 1e-7, the serving
+    parity contract)."""
+    state = batched_cg_init(B, dot=dot)
+    step = make_batched_cg_step(engine, nreps, dot=dot)
+    return batched_cg_run(state, step, nreps).X
 
 
 def fused_cg_solve(

@@ -879,3 +879,242 @@ def kron_apply_ring(op, x: jnp.ndarray,
     y, _ = _kron_cg_call(op, False, interpret, x,
                          force_chunked=force_chunked)
     return y
+
+
+# ---------------------------------------------------------------------------
+# Batch-aware (nrhs-native) fused engine: the serving-layer kernel form.
+#
+# The vmapped fallback batches the GRID — each lane re-streams the banded
+# coefficient tables and runs its own delay ring as a separate kernel
+# sweep. This form makes nrhs a VMEM-resident minor axis of ONE sweep
+# instead: every lane's rings live in VMEM simultaneously (per-lane ring
+# buffers, so all indexing is exactly the proven single-RHS pattern), the
+# z/y/x banded coefficient blocks and the per-plane SMEM cx rows are
+# fetched ONCE per grid step and shared by all lanes, and the per-lane
+# <p, A p> partials accumulate in per-lane (1, 1) VMEM scalars emitted
+# together at the last step. Input/output blocks carry the whole lane
+# stack for one x-plane ((nrhs, 1, NY, NZ) over lane-major (nrhs, NX,
+# NY, NZ) arrays — trailing two dims full, so Mosaic tiling is the same
+# as the single-RHS form's).
+#
+# VMEM scales ~ nrhs x the single-RHS ring estimate, so the bucket is a
+# plan input: `engine_plan_batched` walks the same hardware-checked
+# scoped-VMEM tiers as `engine_plan` and falls back to "unfused"
+# (recorded by the caller) when the stacked rings outgrow the top tier.
+# Evidence label: the batched form's tier admissions are DESIGN ESTIMATES
+# derived from the single-RHS measured ceilings (same allocator ratio
+# assumed per lane); no hardware numbers yet (tunnel wedged since r04) —
+# the harness `fusedbatch` stage is armed to convert them.
+# ---------------------------------------------------------------------------
+
+
+def engine_vmem_bytes_batched(grid_shape: tuple[int, int, int],
+                              degree: int, nrhs: int) -> int:
+    """Estimated batched-kernel VMEM footprint: nrhs independent lane
+    rings (each the single-RHS model) — the coefficient blocks shared
+    across lanes are small and already over-bounded by the per-lane
+    model's slack."""
+    return int(nrhs) * engine_vmem_bytes(grid_shape, degree)
+
+
+def engine_plan_batched(
+    grid_shape: tuple[int, int, int], degree: int, nrhs: int
+) -> tuple[str, int | None]:
+    """(form, scoped_vmem_kib) for a batched single-chip solve at this
+    lane count: 'one_batched' (the nrhs-native delay ring) through the
+    same default/raised scoped-VMEM tiers as `engine_plan`, else
+    'unfused' (vmapped fallback; the caller records the reason). nrhs = 1
+    degenerates to the single-RHS ring footprint. There is no chunked
+    batched form yet — planned, gated here."""
+    if nrhs < 1:
+        raise ValueError(f"nrhs must be >= 1, got {nrhs}")
+    v = engine_vmem_bytes_batched(grid_shape, degree, nrhs)
+    if v <= VMEM_BUDGET:
+        return "one_batched", None
+    if v <= ONE_KERNEL_SCOPED_MAX:
+        return "one_batched", ONE_KERNEL_SCOPED_KIB
+    if v <= ONE_KERNEL_SCOPED_MAX2:
+        return "one_batched", ONE_KERNEL_SCOPED_KIB2
+    return "unfused", None
+
+
+def supports_kron_cg_engine_batched(grid_shape, degree: int, dtype,
+                                    nrhs: int) -> bool:
+    """f32 only (Mosaic has no f64) AND the stacked rings must fit a
+    scoped-VMEM tier — unlike the single-RHS engine there is no chunked
+    escape hatch yet, so the plan gates availability."""
+    return (dtype == jnp.float32
+            and engine_plan_batched(grid_shape, degree, nrhs)[0]
+            != "unfused")
+
+
+def _make_kron_cg_kernel_batched(P: int, NX: int, NY: int, NZ: int,
+                                 KI: int, nrhs: int):
+    """nrhs-native one-kernel delay-ring CG iteration (single-chip,
+    update_p form only — the serving/batched-benchmark path). Per-lane
+    ring scratch keeps every store/read the exact single-RHS pattern;
+    the static python loop over lanes unrolls at trace time (nrhs is a
+    bucket constant, <= 16)."""
+    D = P
+    nsteps = NX + D
+
+    def kernel(*refs):
+        (r_ref, pprev_ref, ckz_ref, cmz_ref, cky_ref, cmy_ref, cx_ref,
+         scal_ref, p_out_ref, y_out_ref) = refs[:10]
+        dot_refs = refs[10:10 + nrhs]
+        scr = refs[10 + nrhs:]
+        lanes = [scr[4 * l:4 * l + 4] for l in range(nrhs)]
+
+        t = pl.program_id(0)
+
+        @pl.when(t == 0)
+        def _init():
+            # Zero every lane's rings: 0 * garbage must stay finite (see
+            # the single-RHS kernel's _init).
+            for ring_t12, ring_tyz, ring_p, dacc in lanes:
+                ring_t12[...] = jnp.zeros_like(ring_t12)
+                ring_tyz[...] = jnp.zeros_like(ring_tyz)
+                ring_p[...] = jnp.zeros_like(ring_p)
+                dacc[...] = jnp.zeros_like(dacc)
+
+        KP = np.int32(P + 1)
+
+        @pl.when(t < np.int32(NX))
+        def _ingest():
+            slot = jax.lax.rem(t, np.int32(KI))
+            pslot = jax.lax.rem(t, KP)
+            for l in range(nrhs):
+                ring_t12, ring_tyz, ring_p, _ = lanes[l]
+                # per-lane beta rides in the shared SMEM row
+                p2 = scal_ref[0, l] * pprev_ref[l, 0] + r_ref[l, 0]
+                p_out_ref[l, 0] = p2
+                t12, tyz = _zy_contract(p2, ckz_ref, cmz_ref, cky_ref,
+                                        cmy_ref, P, NY, NZ)
+                ring_p[pslot] = p2
+                ring_t12[slot] = t12
+                ring_tyz[slot] = tyz
+
+        @pl.when(t >= np.int32(D))
+        def _emit():
+            i = t - np.int32(D)
+            gy = jax.lax.broadcasted_iota(jnp.int32, (NY, NZ), 0)
+            gz = jax.lax.broadcasted_iota(jnp.int32, (NY, NZ), 1)
+            for l in range(nrhs):
+                ring_t12, ring_tyz, ring_p, dacc = lanes[l]
+                p_i = ring_p[jax.lax.rem(i, KP)]
+                y2 = _x_emit_blend(ring_t12, ring_tyz, cx_ref, i, p_i,
+                                   gy, gz, P, KI, NX, NY, NZ)
+                y_out_ref[l, 0] = y2
+                # rank-2 (1,1) stores: Mosaic rejects scalar VMEM stores
+                dacc[...] = dacc[...] + jnp.sum(p_i * y2)
+
+        @pl.when(t == np.int32(nsteps - 1))
+        def _finish():
+            for l in range(nrhs):
+                dot_refs[l][...] = lanes[l][3][...]
+
+    return kernel
+
+
+def _kron_cg_call_batched(op, interpret, R, P_prev, beta):
+    """Batched fused iteration: lane-major (nrhs, NX, NY, NZ) slabs in,
+    (P, Y, pdots) out with pdots a (nrhs,) vector — the
+    `la.cg.make_batched_cg_step` engine contract. Single-chip uniform
+    geometry, f32, update_p form only (the plan gates everything
+    else)."""
+    P_ = op.degree
+    NX, NY, NZ = (int(a.shape[0]) for a in op.notbc1d)
+    nrhs = int(R.shape[0])
+    KI = 2 * P_ + 2
+    D = P_
+    nsteps = NX + D
+    dtype = R.dtype
+    nb = 2 * P_ + 1
+    cx_rows = _cx_rows(op, dtype)
+
+    def clamp_in(t):
+        return (0, jax.lax.min(t, np.int32(NX - 1)), 0, 0)
+
+    def clamp_p_out(t):
+        return (0, jax.lax.clamp(np.int32(0), t, np.int32(NX - 1)), 0, 0)
+
+    def clamp_out(t):
+        return (0, jax.lax.clamp(np.int32(0), t - np.int32(D),
+                                 np.int32(NX - 1)), 0, 0)
+
+    def cx_map(t):
+        return (jax.lax.clamp(np.int32(0), t - np.int32(D),
+                              np.int32(NX - 1)), 0, 0)
+
+    lane_block = (nrhs, 1, NY, NZ)
+    in_specs = [
+        pl.BlockSpec(lane_block, clamp_in, memory_space=pltpu.VMEM),
+        pl.BlockSpec(lane_block, clamp_in, memory_space=pltpu.VMEM),
+    ]
+    operands = [R, P_prev]
+    for coeff, n_ax in zip((op.Kd[2], op.Md[2], op.Kd[1], op.Md[1]),
+                           (NZ, NZ, NY, NY)):
+        in_specs.append(pl.BlockSpec((nb, n_ax), lambda t: (0, 0),
+                                     memory_space=pltpu.VMEM))
+        operands.append(coeff.astype(dtype))
+    in_specs.append(pl.BlockSpec((1, 1, 2 * nb), cx_map,
+                                 memory_space=pltpu.SMEM))
+    operands.append(cx_rows)
+    in_specs.append(pl.BlockSpec((1, nrhs), lambda t: (0, 0),
+                                 memory_space=pltpu.SMEM))
+    operands.append(beta.astype(dtype).reshape(1, nrhs))
+
+    out_specs = [
+        pl.BlockSpec(lane_block, clamp_p_out, memory_space=pltpu.VMEM),
+        pl.BlockSpec(lane_block, clamp_out, memory_space=pltpu.VMEM),
+    ]
+    out_shapes = [jax.ShapeDtypeStruct((nrhs, NX, NY, NZ), dtype)] * 2
+    for _ in range(nrhs):
+        out_specs.append(pl.BlockSpec((1, 1), lambda t: (0, 0),
+                                      memory_space=pltpu.VMEM))
+        out_shapes.append(jax.ShapeDtypeStruct((1, 1), dtype))
+
+    scratch = []
+    for _ in range(nrhs):
+        scratch += [
+            pltpu.VMEM((KI, NY, NZ), dtype),
+            pltpu.VMEM((KI, NY, NZ), dtype),
+            pltpu.VMEM((P_ + 1, NY, NZ), dtype),
+            pltpu.VMEM((1, 1), dtype),
+        ]
+
+    out = pl.pallas_call(
+        _make_kron_cg_kernel_batched(P_, NX, NY, NZ, KI, nrhs),
+        grid=(nsteps,),
+        in_specs=in_specs,
+        out_specs=out_specs,
+        out_shape=out_shapes,
+        scratch_shapes=scratch,
+        interpret=_use_interpret() if interpret is None else interpret,
+    )(*operands)
+    p, y = out[0], out[1]
+    pdots = jnp.concatenate([d.reshape(1) for d in out[2:]], axis=0)
+    return p, y, pdots
+
+
+def kron_batched_engine(op, interpret: bool | None = None):
+    """The fused batched iteration as a `la.cg.make_batched_cg_step`
+    engine: engine(R, P_prev, beta) -> (P, Y, <P, A P> per lane)."""
+
+    def engine(R, P_prev, beta):
+        return _kron_cg_call_batched(op, interpret, R, P_prev, beta)
+
+    return engine
+
+
+def kron_cg_solve_batched(op, B: jnp.ndarray, nreps: int,
+                          interpret: bool | None = None) -> jnp.ndarray:
+    """Batched benchmark CG with the nrhs-native fused iteration
+    (la.cg.fused_cg_solve_batched over kron_batched_engine). Matches
+    `la.cg.cg_solve_batched(op.apply, B, 0, nreps)` per lane to f32
+    reassociation accuracy (<= 1e-7 — the serving parity contract);
+    padding (all-zero) lanes return zeros, exactly as the oracle's."""
+    from ..la.cg import fused_cg_solve_batched
+
+    return fused_cg_solve_batched(kron_batched_engine(op, interpret),
+                                  B, nreps)

@@ -6,13 +6,17 @@ The one-shot benchmark amortises compile/launch cost by problem size
 amortises it ACROSS REQUESTS — the production-serving shape the ROADMAP
 north star names:
 
-  engine.py   SolveSpec -> compiled batched solver (la.cg.cg_solve_batched
-              over the existing unfused operators; vmapped cg_solve_df
-              for df32 pairs)
+  engine.py   SolveSpec -> compiled batched solver with an iteration-
+              boundary checkpoint API (la.cg.BatchedCGState machinery:
+              the fused nrhs-native kron ring on f32 uniform specs,
+              the unfused vmapped composition elsewhere; vmapped
+              cg_solve_df for df32 pairs, continuous-gated)
   cache.py    AOT executables keyed by (degree, cell shape, precision,
-              geometry class, engine form, nrhs bucket, device mesh),
-              LRU + hit/miss/evict/compile counters + warmup
-  broker.py   bounded-queue admission control, dynamic batching window,
+              geometry class, PLANNED engine form, nrhs bucket, device
+              mesh), LRU + hit/miss/evict/compile counters + warmup
+  broker.py   bounded-queue admission control, continuous batching
+              (mid-solve lane admissions + early retires at iteration
+              boundaries; fixed-window fallback for gated solvers),
               per-batch hard deadline, harness-taxonomy fault classes
   server.py   localhost HTTP/JSON front end (POST /solve, GET /metrics,
               GET /healthz) — `python -m bench_tpu_fem.serve`
@@ -37,6 +41,7 @@ from .engine import (
     SolveSpec,
     UnsupportedSpec,
     build_solver,
+    planned_engine_form,
     spec_cache_key,
 )
 from .metrics import Metrics, replay_serve
@@ -58,6 +63,7 @@ __all__ = [
     "default_cache",
     "make_server",
     "nrhs_bucket",
+    "planned_engine_form",
     "replay_serve",
     "spec_cache_key",
 ]

@@ -38,6 +38,10 @@ def main(argv=None) -> int:
     p.add_argument("--solve-timeout", type=float, default=120.0,
                    help="hard per-batch deadline; overruns answer "
                         "classified-timeout and are abandoned")
+    p.add_argument("--no-continuous", action="store_true",
+                   help="disable continuous batching (fixed-window "
+                        "one-shot batches only) — the occupancy A/B "
+                        "baseline")
     p.add_argument("--journal", default="",
                    help="metrics JSONL journal path (crash-safe, "
                         "harness.journal format)")
@@ -80,6 +84,7 @@ def main(argv=None) -> int:
         queue_max=args.queue_max, nrhs_max=args.nrhs_max,
         window_s=args.window_ms / 1000.0,
         solve_timeout_s=args.solve_timeout,
+        continuous=not args.no_continuous,
     )
     if args.warmup:
         degrees = [int(d) for d in args.warmup.split(",") if d.strip()]

@@ -1,12 +1,26 @@
-"""Admission-controlled request broker with a dynamic batching window.
+"""Admission-controlled request broker with continuous batching.
 
 The serving core: requests enter a BOUNDED queue (admission control —
 a full queue sheds the request immediately with a retriable signal
 rather than letting latency grow without bound), a single batching
 worker drains it, collecting requests with the SAME `SolveSpec` until
 either `nrhs_max` lanes are gathered or the batching window expires,
-pads the batch to the executable cache's nrhs bucket, and runs ONE
-compiled batched solve for the whole group.
+pads the batch to the executable cache's nrhs bucket, and starts ONE
+compiled batched solve for the group.
+
+For solvers exposing the iteration-boundary checkpoint API
+(f32/f64 — serve.engine.CompiledSolver.supports_continuous), the batch
+then runs CONTINUOUSLY, the shape LLM inference servers use: at every
+`iter_chunk` iteration boundary the worker retires lanes that finished
+their budget (answering those requests immediately — a finished request
+never waits for its batch-mates) and admits compatible queued requests
+into the freed lanes mid-solve (`serve_admit` journal records with
+midsolve=true; each admitted lane gets its full iteration budget). The
+solve ends when no lane is live and no compatible request is queued —
+so under sustained traffic one batch can serve many windows' worth of
+requests with lane occupancy pinned near the bucket instead of sawing
+down as lanes finish. Solvers without the checkpoint API (df32) keep
+the fixed-window one-shot batch, reason recorded.
 
 Fault semantics reuse the measurement harness's taxonomy
 (`harness.classify`): every failed response carries a `failure_class`,
@@ -51,8 +65,12 @@ class QueueFull(Exception):
 
 @dataclass
 class PendingRequest:
-    """One admitted request: the worker fulfils `result` and sets
-    `done`; the submitting thread waits on it."""
+    """One admitted request: a responder claims it (`answered`, under
+    the broker's response lock), fulfils `result` and sets `done`; the
+    submitting thread waits on `done`. With continuous batching two
+    threads can race to answer (the solve thread's retire loop vs the
+    worker's timeout path), so the claim must be atomic — `done` alone
+    is a check-then-act hole."""
 
     id: str
     spec: SolveSpec
@@ -60,6 +78,7 @@ class PendingRequest:
     enqueued: float
     done: threading.Event = field(default_factory=threading.Event)
     result: dict | None = None
+    answered: bool = False
 
 
 def _spec_dict(spec: SolveSpec) -> dict:
@@ -73,16 +92,24 @@ class Broker:
                  metrics: Metrics | None = None, *,
                  queue_max: int = 128, nrhs_max: int = 8,
                  window_s: float = 0.025, solve_timeout_s: float = 120.0,
-                 builder=build_solver):
+                 continuous: bool = True, builder=build_solver):
         self.cache = cache or ExecutableCache()
         self.metrics = metrics or Metrics()
         self.queue_max = queue_max
         self.nrhs_max = min(nrhs_max, NRHS_BUCKETS[-1])
         self.window_s = window_s
         self.solve_timeout_s = solve_timeout_s
+        # continuous=False pins every solver to fixed-window one-shot
+        # batches — the A/B baseline the occupancy acceptance compares
+        # against (serve CLI --no-continuous).
+        self.continuous = continuous
         self._builder = builder
         self._queue: deque[PendingRequest] = deque()
         self._cv = threading.Condition()
+        # atomic response claim (see PendingRequest.answered): the solve
+        # thread (continuous retires) and the worker thread (timeout/
+        # failure paths) may race to answer the same request
+        self._respond_lock = threading.Lock()
         self._stop = False
         self._ids = itertools.count(1)
         self._worker = threading.Thread(target=self._loop, daemon=True,
@@ -205,20 +232,45 @@ class Broker:
                 return b
         return nrhs_bucket(live)
 
+    def _poll_compatible(self, spec: SolveSpec, k: int) -> list:
+        """Queue poll from the solve thread (continuous admissions):
+        same-spec FIFO extraction under the lock."""
+        with self._cv:
+            taken = self._take_compatible(spec, k)
+            self.metrics.set_queue_depth(len(self._queue))
+        return taken
+
     def _execute(self, batch: list) -> None:
         spec = batch[0].spec
         live = len(batch)
         bucket = self._pick_bucket(spec, live)
         key = spec_cache_key(spec, bucket)
         cache_hit = self.cache.lookup(key) is not None
-        scales = [p.scale for p in batch]
+        # `members` grows with mid-solve admissions: the timeout/failure
+        # paths below must answer every request the solve ever owned
+        # (_respond skips the already-answered ones).
+        members = list(batch)
         box: dict = {}
+        # the admission horizon is anchored where the HARD deadline is
+        # (batch-execution start, before any compile): a zombie solve
+        # thread must stop admitting BEFORE the worker can abandon the
+        # batch, or admitted requests would sit outside any deadline
+        # cover
+        admit_deadline = time.monotonic() + self.solve_timeout_s / 2
 
         def _run():
             try:
                 entry = self.cache.get_or_build(
                     key, lambda: self._builder(spec, bucket))
-                box["result"] = entry.executable.solve(scales)
+                solver = entry.executable
+                if self.continuous and getattr(
+                        solver, "supports_continuous", False):
+                    box["summary"] = self._solve_continuous(
+                        solver, spec, members, bucket, cache_hit,
+                        admit_deadline)
+                else:
+                    box["result"] = solver.solve(
+                        [p.scale for p in members])
             except BaseException as exc:
                 box["error"] = exc
 
@@ -228,19 +280,33 @@ class Broker:
         t.join(self.solve_timeout_s)
         if t.is_alive():
             # hard deadline: answer + abandon (the harness's
-            # kill-the-group, minus the kill Python threads lack)
+            # kill-the-group, minus the kill Python threads lack).
+            # Continuous members already retired were answered as they
+            # finished; _respond skips them here.
             msg = (f"solve exceeded {self.solve_timeout_s}s "
                    f"(spec {_spec_dict(spec)}); batch abandoned")
-            for p in batch:
+            for p in members:
                 self._respond(p, {
                     "ok": False, "id": p.id, "error": msg,
                     "failure_class": "timeout", "retriable": True})
-            self.metrics.batch(_spec_dict(spec), live, bucket, cache_hit,
-                               self.solve_timeout_s, 0.0)
+            self.metrics.batch(_spec_dict(spec), len(members), bucket,
+                               cache_hit, self.solve_timeout_s, 0.0)
             return
         if "error" in box:
-            self._fail_batch(batch, box["error"], bucket=bucket,
+            self._fail_batch(members, box["error"], bucket=bucket,
                              cache_hit=cache_hit)
+            return
+        if "summary" in box:
+            # continuous: per-request responses went out at each retire;
+            # here only the batch-level accounting lands
+            s = box["summary"]
+            self.metrics.batch(
+                _spec_dict(spec), s["served"], bucket, cache_hit,
+                s["wall_s"], s["gdof_per_second"],
+                padded_lanes=s["padded_lanes"], midsolve=s["midsolve"],
+                boundaries=s["boundaries"],
+                live_lane_boundaries=s["live_lane_boundaries"],
+                continuous=True)
             return
         res = box["result"]
         self.metrics.batch(_spec_dict(spec), live, res.nrhs_bucket,
@@ -255,12 +321,102 @@ class Broker:
                 "nrhs_live": res.nrhs_live,
                 "nrhs_bucket": res.nrhs_bucket,
                 "ndofs_global": res.ndofs_global,
-                "cg_engine_form": "unfused",
+                "cg_engine_form": res.extra.get("cg_engine_form",
+                                                "unfused"),
+                "continuous": False,
                 "cache": "hit" if cache_hit else "miss",
                 "batch_wall_s": res.wall_s,
                 "gdof_per_second": res.gdof_per_second,
                 "latency_s": now - p.enqueued,
             })
+
+    def _solve_continuous(self, solver, spec: SolveSpec, members: list,
+                          bucket: int, cache_hit: bool,
+                          admit_deadline: float) -> dict:
+        """Run one continuous batch on the solve thread: step the
+        compiled solve `iter_chunk` iterations at a time; at every
+        boundary retire finished lanes (responding immediately) and
+        admit compatible queued requests into the freed lanes. Returns
+        the batch-level accounting for metrics.batch.
+
+        `admit_deadline` (half the solve timeout, anchored by the
+        caller at batch-execution start so a slow compile eats into it
+        rather than extending it) closes the admission horizon well
+        before the worker's hard deadline: a sustained request stream
+        cannot hold one batch past the abandon point, and an abandoned
+        zombie thread can never keep pulling fresh requests into a
+        batch nobody is watching — remaining lanes drain, the batch
+        ends, the worker forms a fresh batch for whatever is queued."""
+        t0 = time.monotonic()
+        state = solver.cont_init([p.scale for p in members])
+        lanes: list = [None] * bucket
+        served = midsolve = boundaries = live_lane_boundaries = 0
+        dead_lane_boundaries = 0
+        boundary_iter = 0
+        for lane, p in enumerate(members):
+            lanes[lane] = p
+            self.metrics.admit(p.id, lane, 0, False, lane + 1)
+
+        def spec_d():
+            return _spec_dict(spec)
+
+        while any(p is not None for p in lanes):
+            state = solver.cont_step(state)
+            boundary_iter += solver.iter_chunk
+            iters, done = solver.cont_poll(state)
+            live = sum(1 for p in lanes if p is not None)
+            boundaries += 1
+            live_lane_boundaries += live
+            dead_lane_boundaries += bucket - live
+            now = time.monotonic()
+            for lane, p in enumerate(lanes):
+                if p is None or not bool(done[lane]):
+                    continue
+                state, xnorm = solver.cont_retire(state, lane)
+                lanes[lane] = None
+                live -= 1
+                served += 1
+                self.metrics.retire(p.id, lane, boundary_iter,
+                                    int(iters[lane]), live)
+                self._respond(p, {
+                    "ok": True, "id": p.id,
+                    "xnorm": xnorm,
+                    "scale": p.scale,
+                    "spec": spec_d(),
+                    "nrhs_live": live,
+                    "nrhs_bucket": bucket,
+                    "ndofs_global": solver.ndofs_global,
+                    "cg_engine_form": solver.engine_form,
+                    "continuous": True,
+                    "iters_run": int(iters[lane]),
+                    "cache": "hit" if cache_hit else "miss",
+                    "latency_s": now - p.enqueued,
+                })
+            free = [i for i, p in enumerate(lanes) if p is None]
+            if free and now < admit_deadline:
+                for p in self._poll_compatible(spec, len(free)):
+                    lane = free.pop(0)
+                    state = solver.cont_admit(state, lane, p.scale)
+                    lanes[lane] = p
+                    members.append(p)
+                    midsolve += 1
+                    live += 1
+                    self.metrics.admit(p.id, lane, boundary_iter, True,
+                                       live)
+        wall = time.monotonic() - t0
+        # GDoF/s over the whole continuous batch: every served lane ran
+        # its full budget (retired lanes are answered, not truncated)
+        gdof = (solver.ndofs_global * spec.nreps * served
+                / (1e9 * wall) if wall > 0 else 0.0)
+        # padding waste in lane units: dead boundary-slots normalised by
+        # boundaries (comparable with the one-shot bucket - live)
+        padded = (round(dead_lane_boundaries / boundaries)
+                  if boundaries else bucket - served)
+        return {"served": served, "wall_s": wall,
+                "gdof_per_second": gdof, "midsolve": midsolve,
+                "boundaries": boundaries,
+                "live_lane_boundaries": live_lane_boundaries,
+                "padded_lanes": padded}
 
     def _fail_batch(self, batch: list, exc: BaseException, *,
                     bucket: int | None = None,
@@ -278,12 +434,17 @@ class Broker:
                 "failure_class": cls, "retriable": retriable})
 
     def _respond(self, pending: PendingRequest, result: dict) -> None:
-        if pending.done.is_set():
-            return
-        pending.result = result
+        # atomic claim: exactly ONE responder wins (metrics must count
+        # each request once; the loser's payload is dropped)
+        with self._respond_lock:
+            if pending.answered:
+                return
+            pending.answered = True
+            pending.result = result
         latency = time.monotonic() - pending.enqueued
         self.metrics.response(
             pending.id, bool(result.get("ok")), latency,
             failure_class=result.get("failure_class"),
-            retriable=result.get("retriable"))
+            retriable=result.get("retriable"),
+            cache=result.get("cache"))
         pending.done.set()

@@ -1,33 +1,53 @@
 """Solver construction for the serving layer: spec -> compiled batched
-executable.
+executable with an iteration-boundary checkpoint API.
 
 A `SolveSpec` is the request-compatibility class (degree, problem size,
 iteration count, precision, geometry class): requests agreeing on it can
 share one batch and one executable. `build_solver` assembles the
-operator ONCE from the existing unfused operator builders (ops.kron /
-ops.laplacian / ops.kron_df — the fused delay-ring engines have no
-batched form yet, so the serving path is the recorded
-`cg_engine_form: "unfused"` composition, same vocabulary as
-bench.driver.record_engine) and AOT-compiles the batched multi-RHS CG
-(`la.cg.cg_solve_batched`, or a vmapped `cg_solve_df` for df32 pairs)
-for one nrhs bucket.
+operator ONCE and AOT-compiles the checkpointable batched CG machinery
+(`la.cg.BatchedCGState` + step/admit/retire) for one nrhs bucket:
+
+  * f32 uniform specs whose bucket fits the per-bucket VMEM plan run
+    the FUSED nrhs-native kron delay ring
+    (ops.kron_cg.kron_batched_engine, `cg_engine_form:
+    "one_kernel_batched"` — interpret mode off-TPU, the real kernel on
+    chip);
+  * every other f32/f64 spec runs the unfused vmapped composition
+    (`la.cg.unfused_batch_engine`, bitwise the `cg_solve_batched`
+    parity oracle per lane), recorded `"unfused"`;
+  * df32 pairs keep the whole-solve vmapped `cg_solve_df` executable —
+    no checkpoint boundary exists inside the df recurrence yet, so
+    continuous batching for df32 is planned-but-gated
+    (`continuous_gate_reason` records why; the broker falls back to
+    fixed-window one-shot batches for it).
+
+The checkpoint API (`cont_init` / `cont_step` / `cont_admit` /
+`cont_retire` / `cont_poll`) is what the broker's continuous batching
+drives: `cont_step` advances all lanes by `iter_chunk` iterations in one
+compiled call, and between calls the broker may admit a queued request
+into a free lane or retire a finished one — per-lane algebra is
+lane-local (la.cg docstrings), so admits/retires never perturb in-flight
+lanes.
 
 The request's right-hand side enters as a per-lane SCALE of the spec's
 canonical benchmark RHS (the Gaussian-bump source every driver solves).
 CG with a fixed iteration count is exactly linear in b — alpha/beta are
 scale-invariant ratios, so x(c*b) = c*x(b) — which gives the serving
-acceptance check its teeth: every response must match the one-shot
-driver's solution norm times the request scale to the batched-parity
-tolerances (<= 1e-7 f32, <= 1e-13 df32), per lane, straight off the
-wire. Precision caveat: the scaling itself is exact for power-of-two
-scales in f32 (what the acceptance smoke and bench.driver.batch_scales
-use) and df-exact for ANY scale in df32 (the scale multiplies as a df
-pair, see solve()); an f32 request with a non-power-of-two scale adds
-one input rounding (~6e-8 relative) on top of the contract.
+acceptance check its teeth: every response must match the SAME compiled
+solver's scale-1.0 solution norm times the request scale (exact for
+power-of-two scales in f32 — lanes are fully independent inside the
+batched executable — and df-exact for ANY scale in df32; a non-power-
+of-two f32 scale adds one input rounding, ~6e-8 relative). Unfused
+responses additionally match the one-shot `cg_solve` driver bitwise;
+fused responses match it to the engine family's f32 reassociation
+accuracy (<= 5e-5 relative L2 — same convention as the kron engine
+suite), which is why the parity oracle is per-executable, not
+cross-path.
 
 Evidence label: serving throughput numbers from this module are
-CPU-measured unless a round artifact says otherwise; the TPU folded/
-pallas serving path is a design note in the README, not a shipped form.
+CPU-measured unless a round artifact says otherwise; the fused batched
+kernel's TPU VMEM tiers are design estimates until the harness
+`fusedbatch` stage runs on hardware.
 """
 
 from __future__ import annotations
@@ -54,6 +74,14 @@ _PRECISIONS = ("f32", "f64", "df32")
 # (the benchmark's own flagship is 12.5M dofs); raise deliberately for
 # a TPU deployment, not by accident.
 MAX_NDOFS = 50_000_000
+
+# Iterations per continuous-batching boundary: each `cont_step` call
+# advances every live lane by this many CG iterations in one compiled
+# executable, then the broker gets a chance to admit/retire lanes. Small
+# enough that a freed lane is refilled within a fraction of a serving
+# solve (nreps is typically 12-50), large enough that the per-boundary
+# host round-trip (a (bucket,) iters/done fetch) stays negligible.
+ITER_CHUNK = 4
 
 
 @dataclass(frozen=True)
@@ -96,6 +124,25 @@ class UnsupportedSpec(ValueError):
     by the harness taxonomy (deterministic: retrying cannot help)."""
 
 
+def planned_engine_form(spec: SolveSpec, bucket: int) -> str:
+    """The engine form the serving compile will pick for (spec, bucket)
+    — a deterministic function of the spec, so it can be part of the
+    cache key: the fused nrhs-native kron ring for f32 uniform specs
+    whose bucket fits the per-bucket VMEM plan
+    (ops.kron_cg.engine_plan_batched), else the unfused vmapped
+    composition. Unified vocabulary (bench.driver.record_engine)."""
+    if spec.precision == "f32" and spec.geom == "uniform":
+        from ..mesh.dofmap import dof_grid_shape
+        from ..mesh.sizing import compute_mesh_size
+        from ..ops.kron_cg import engine_plan_batched
+
+        n = compute_mesh_size(spec.ndofs, spec.degree)
+        grid = dof_grid_shape(n, spec.degree)
+        if engine_plan_batched(grid, spec.degree, bucket)[0] != "unfused":
+            return "one_kernel_batched"
+    return "unfused"
+
+
 def spec_cache_key(spec: SolveSpec, bucket: int,
                    device_mesh: tuple = (1, 1, 1)) -> ExecutableKey:
     from ..mesh.sizing import compute_mesh_size
@@ -106,7 +153,7 @@ def spec_cache_key(spec: SolveSpec, bucket: int,
         cell_shape=tuple(int(c) for c in cells),
         precision=spec.precision,
         geom=spec.geom,
-        engine_form="unfused",
+        engine_form=planned_engine_form(spec, bucket),
         nrhs_bucket=bucket,
         device_mesh=tuple(device_mesh),
         nreps=spec.nreps,
@@ -130,11 +177,21 @@ class BatchResult:
 
 class CompiledSolver:
     """One AOT-compiled batched solver: operator state + base RHS held on
-    device, executable compiled for (bucket, *grid) inputs. `solve`
+    device, executables compiled for (bucket, *grid) inputs. `solve`
     scales the base RHS per lane (zero-padding dead lanes — they start
-    frozen inside the batched CG), runs the executable, and returns the
+    frozen inside the batched CG), runs the solve, and returns the
     per-lane norms with throughput accounting
-    (GDoF/s = ndofs * nreps * live_lanes / wall)."""
+    (GDoF/s = ndofs * nreps * live_lanes / wall).
+
+    f32/f64 specs additionally expose the continuous-batching checkpoint
+    API (`supports_continuous`): `cont_init(scales) -> state`,
+    `cont_step(state) -> state` (+`iter_chunk` iterations, one compiled
+    call), `cont_poll(state) -> (iters, done)` (host numpy),
+    `cont_admit(state, lane, scale)` and
+    `cont_retire(state, lane) -> (state, xnorm)` — all lane-local, so
+    the broker edits the batch between steps without touching in-flight
+    lanes. df32 keeps the whole-solve vmapped executable
+    (`continuous_gate_reason` records why)."""
 
     def __init__(self, spec: SolveSpec, bucket: int):
         import jax
@@ -169,10 +226,23 @@ class CompiledSolver:
         b64 = np.asarray(b_host, np.float64)
 
         nreps = spec.nreps
+        self.iter_chunk = min(ITER_CHUNK, nreps)
+        self.supports_continuous = False
+        self.continuous_gate_reason = None
+        self.engine_form = "unfused"
+        self.engine_fallback_reason = None
         if spec.precision == "df32":
             from ..la.df64 import DF, df_from_f64
             from ..ops.kron_df import build_kron_laplacian_df, cg_solve_df
 
+            # Whole-solve vmapped df executable: no iteration-boundary
+            # checkpoint exists inside the df recurrence yet, so df32
+            # continuous batching is planned-but-gated with the reason
+            # recorded (the broker serves df32 in fixed-window batches).
+            self.continuous_gate_reason = (
+                "df32 continuous batching unsupported: the vmapped "
+                "cg_solve_df recurrence is one whole-solve executable "
+                "with no iteration-boundary checkpoint (planned)")
             self._op = build_kron_laplacian_df(
                 mesh, spec.degree, 1, "gll", kappa=2.0, tables=t)
             bdf = df_from_f64(b64)
@@ -188,7 +258,15 @@ class CompiledSolver:
             self._fn = compile_lowered(
                 jax.jit(run).lower(self._op, Bs, Bs), None)
         else:
-            from ..la.cg import cg_solve_batched
+            from ..la.cg import (
+                batched_cg_admit,
+                batched_cg_init,
+                batched_cg_retire,
+                batched_cg_run,
+                make_batched_cg_step,
+                unfused_batch_engine,
+            )
+            from ..la.vector import inner_product
             from ..ops.laplacian import build_laplacian
 
             dtype = jnp.float64 if spec.precision == "f64" else jnp.float32
@@ -197,23 +275,83 @@ class CompiledSolver:
                     "precision 'f64' needs jax_enable_x64 (the serve CLI "
                     "enables it; in-process callers must)")
             # Uniform meshes take the exact Kronecker fast path; general
-            # (perturbed) geometry the einsum operator. Both unfused
-            # applies vmap cleanly over the batch axis — the Pallas
-            # folded serving form is future work (design note, README).
+            # (perturbed) geometry the einsum operator.
             backend = "kron" if spec.geom == "uniform" else "xla"
             self._op = build_laplacian(
                 mesh, spec.degree, 1, "gll", kappa=2.0, dtype=dtype,
                 tables=t, backend=backend)
             self._base = jnp.asarray(b64, dtype)
+            self.engine_form = planned_engine_form(spec, self.bucket)
 
-            def run(A, B):
-                return cg_solve_batched(
-                    A.apply, B, jnp.zeros_like(B), nreps)
+            def _engine(A, fused):
+                if fused:
+                    from ..ops.kron_cg import kron_batched_engine
 
-            Bs = jax.ShapeDtypeStruct((self.bucket, *b64.shape),
-                                      np.dtype(dtype))
-            self._fn = compile_lowered(jax.jit(run).lower(self._op, Bs),
-                                       None)
+                    return kron_batched_engine(A)
+                return unfused_batch_engine(jax.vmap(A.apply))
+
+            def _init(base, scales):
+                B = scales.reshape((-1,) + (1,) * base.ndim) * base[None]
+                return batched_cg_init(B)
+
+            def _make_step(fused):
+                def _step(A, state):
+                    step = make_batched_cg_step(_engine(A, fused), nreps)
+                    return batched_cg_run(state, step, self.iter_chunk)
+
+                return _step
+
+            def _admit(base, state, lane, scale):
+                return batched_cg_admit(state, lane, scale * base)
+
+            def _retire(state, lane):
+                x = state.X[lane]
+                return (batched_cg_retire(state, lane),
+                        jnp.sqrt(inner_product(x, x)))
+
+            npdt = np.dtype(dtype)
+            base_s = jax.ShapeDtypeStruct(b64.shape, npdt)
+            scales_s = jax.ShapeDtypeStruct((self.bucket,), npdt)
+            state_s = jax.eval_shape(_init, base_s, scales_s)
+            lane_s = jax.ShapeDtypeStruct((), np.dtype(np.int32))
+            scale_s = jax.ShapeDtypeStruct((), npdt)
+
+            fused = self.engine_form == "one_kernel_batched"
+            step_opts = None
+            if fused and jax.default_backend() == "tpu":
+                from ..ops.kron_cg import engine_plan_batched
+                from ..utils.compilation import scoped_vmem_options
+
+                grid = dof_grid_shape(n, spec.degree)
+                step_opts = scoped_vmem_options(
+                    engine_plan_batched(grid, spec.degree,
+                                        self.bucket)[1])
+            try:
+                self._step_fn = compile_lowered(
+                    jax.jit(_make_step(fused)).lower(self._op, state_s),
+                    step_opts)
+            except Exception as exc:
+                if not fused:
+                    raise
+                # Mosaic rejection of the fused batched ring (a drifted
+                # per-bucket tier): fall back to the unfused composition
+                # with the reason recorded — never silently (the cache
+                # key stays the PLANNED form; responses stamp the form
+                # that actually ran, same discipline as the driver).
+                self.engine_form = "unfused"
+                self.engine_fallback_reason = (
+                    f"{type(exc).__name__}: {exc}"[:500])
+                self._step_fn = compile_lowered(
+                    jax.jit(_make_step(False)).lower(self._op, state_s),
+                    None)
+            self._init_fn = compile_lowered(
+                jax.jit(_init).lower(base_s, scales_s), None)
+            self._admit_fn = compile_lowered(
+                jax.jit(_admit).lower(base_s, state_s, lane_s, scale_s),
+                None)
+            self._retire_fn = compile_lowered(
+                jax.jit(_retire).lower(state_s, lane_s), None)
+            self.supports_continuous = True
         self.compile_s = time.perf_counter() - t0
 
     def solve(self, scales) -> BatchResult:
@@ -226,10 +364,7 @@ class CompiledSolver:
         if FAULT_HOOK is not None:
             FAULT_HOOK(self.spec, scales)
         live = len(scales)
-        if live > self.bucket:
-            raise ValueError(f"{live} scales > bucket {self.bucket}")
-        pad = np.zeros(self.bucket, np.float64)
-        pad[:live] = np.asarray(scales, np.float64)
+        pad = self._pad_scales(scales)
 
         t0 = time.perf_counter()
         if self.spec.precision == "df32":
@@ -257,17 +392,30 @@ class CompiledSolver:
                 for i in range(live)
             ]
         else:
-            s = jnp.asarray(pad, self._base.dtype)[:, None, None, None]
-            X = self._fn(self._op, s * self._base[None])
-            jax.block_until_ready(X)
+            # whole-batch solve through the SAME checkpoint executables
+            # continuous batching drives (init + ceil(nreps/chunk) chunk
+            # steps — bitwise the one-fori_loop solve: the extra frozen
+            # steps of the last chunk are per-lane no-ops)
+            state = self._init_fn(self._base,
+                                  jnp.asarray(pad, self._base.dtype))
+            for _ in range(-(-self.spec.nreps // self.iter_chunk)):
+                state = self._step_fn(self._op, state)
             # vmapped scalar dot (la.cg.batched_dot): per lane the SAME
             # reduction as the one-shot driver's vdot — the parity
             # check compares norms straight across
             from ..la.cg import batched_dot
 
-            sq = jax.jit(batched_dot)(X, X)
+            sq = jax.jit(batched_dot)(state.X, state.X)
+            jax.block_until_ready(sq)
             xn = [float(v) for v in np.sqrt(np.asarray(sq)[:live])]
         wall = time.perf_counter() - t0
+        extra = {"cg_engine_form": self.engine_form,
+                 "precision": self.spec.precision,
+                 "geom": self.spec.geom}
+        if self.continuous_gate_reason:
+            extra["continuous_gate_reason"] = self.continuous_gate_reason
+        if self.engine_fallback_reason:
+            extra["cg_engine_error"] = self.engine_fallback_reason
         return BatchResult(
             xnorms=xn,
             wall_s=wall,
@@ -278,10 +426,53 @@ class CompiledSolver:
             gdof_per_second=(
                 self.ndofs_global * self.spec.nreps * live / (1e9 * wall)
                 if wall > 0 else 0.0),
-            extra={"cg_engine_form": "unfused",
-                   "precision": self.spec.precision,
-                   "geom": self.spec.geom},
+            extra=extra,
         )
+
+    # -- continuous-batching checkpoint API (f32/f64) ----------------------
+
+    def _pad_scales(self, scales) -> np.ndarray:
+        live = len(scales)
+        if live > self.bucket:
+            raise ValueError(f"{live} scales > bucket {self.bucket}")
+        pad = np.zeros(self.bucket, np.float64)
+        pad[:live] = np.asarray(scales, np.float64)
+        return pad
+
+    def cont_init(self, scales):
+        """Fresh checkpoint state for the initial batch (padding lanes
+        born frozen). Runs the fault-injection hook — the continuous
+        path must be as testable as the one-shot one."""
+        import jax.numpy as jnp
+
+        if FAULT_HOOK is not None:
+            FAULT_HOOK(self.spec, scales)
+        return self._init_fn(
+            self._base,
+            jnp.asarray(self._pad_scales(scales), self._base.dtype))
+
+    def cont_step(self, state):
+        """Advance every live lane by `iter_chunk` iterations (one
+        compiled call; frozen lanes stay frozen)."""
+        return self._step_fn(self._op, state)
+
+    def cont_poll(self, state):
+        """(iters, done) per lane as host numpy — the broker's
+        retire/admit decision input (a (bucket,)-sized transfer)."""
+        return np.asarray(state.iters), np.asarray(state.done)
+
+    def cont_admit(self, state, lane: int, scale: float):
+        """Admit a request into a free lane at this boundary: the lane
+        restarts as scale * base RHS with its own iteration budget."""
+        return self._admit_fn(self._base, state, np.int32(lane),
+                              np.asarray(scale, self._base.dtype))
+
+    def cont_retire(self, state, lane: int):
+        """Retire a finished lane: returns (state with the lane freed,
+        that lane's solution L2 norm — same reduction as the one-shot
+        driver's vdot)."""
+        state, xn = self._retire_fn(state, np.int32(lane))
+        return state, float(xn)
 
 
 def build_solver(spec: SolveSpec, bucket: int | None = None) -> CompiledSolver:

@@ -13,17 +13,34 @@ Record schema (all lines also carry the journal's v/seq/ts):
   {"event": "serve_request",  "id": ..., "spec": {...}, "queue_depth": N}
   {"event": "serve_shed",     "id": ..., "failure_class": "transient",
                               "queue_depth": N}
+  {"event": "serve_admit",    "id": ..., "lane": L, "iter": K,
+                              "midsolve": bool, "live": N}
+  {"event": "serve_retire",   "id": ..., "lane": L, "iter": K,
+                              "iters_run": R, "live": N}
   {"event": "serve_batch",    "spec": {...}, "nrhs_live": N,
                               "nrhs_bucket": B, "cache": "hit"|"miss",
-                              "wall_s": ..., "gdof_per_second": ...}
+                              "wall_s": ..., "gdof_per_second": ...,
+                              "padded_lanes": P, "midsolve": M,
+                              "boundaries": Q, "mean_live_lanes": ...,
+                              "continuous": bool}
   {"event": "serve_response", "id": ..., "ok": bool, "latency_s": ...,
+                              "cache": "hit"|"miss" (when known),
                               "failure_class": ... (failures only),
                               "retriable": bool (failures only)}
+
+serve_admit/serve_retire are the continuous-batching boundary events:
+`iter` is the batch's iteration-boundary index at the event and `live`
+the live-lane count right after it — together they ARE the
+lane-occupancy-over-time record (occupancy only changes at these
+events), replayable from the journal alone.
 
 Cache hit-rate is REQUEST-weighted (requests served from an
 already-compiled executable / requests batched): a warm cache serving
 64 requests in 10 batches is a 100% hit-rate story, not a 10-lookup
 one. The raw cache counters ride along unweighted in `snapshot()`.
+Response latency percentiles split by cache warmth (the `cache` field
+on responses): `latency_warm_*` is the steady-state serving latency
+story, uncontaminated by compile stalls.
 """
 
 from __future__ import annotations
@@ -56,7 +73,14 @@ class Metrics:
         self.cache_miss_requests = 0
         self.gdof_samples: deque = deque(maxlen=_LATENCY_WINDOW)
         self.latencies: deque = deque(maxlen=_LATENCY_WINDOW)
+        self.latencies_warm: deque = deque(maxlen=_LATENCY_WINDOW)
         self.queue_depth = 0
+        # continuous-batching accounting
+        self.midsolve_admissions = 0
+        self.padded_lanes_total = 0  # dead/padded lane-slots across batches
+        self.lane_slots_total = 0  # bucket-sized slots across batches
+        self.live_lane_boundaries = 0  # sum of live counts per boundary
+        self.boundaries_total = 0
 
     def _journal(self, rec: dict) -> None:
         if self.journal is not None:
@@ -79,17 +103,57 @@ class Metrics:
         with self._lock:
             self.shed_total += 1
 
+    def admit(self, req_id: str, lane: int, boundary: int,
+              midsolve: bool, live: int) -> None:
+        """A request entered a batch lane at iteration boundary
+        `boundary` (0 = batch formation; midsolve=True = continuous
+        admission into an in-flight solve)."""
+        self._journal({"event": "serve_admit", "id": req_id,
+                       "lane": int(lane), "iter": int(boundary),
+                       "midsolve": bool(midsolve), "live": int(live)})
+        if midsolve:
+            with self._lock:
+                self.midsolve_admissions += 1
+
+    def retire(self, req_id: str, lane: int, boundary: int,
+               iters_run: int, live: int) -> None:
+        """A lane finished its iteration budget and was freed at
+        boundary `boundary` (`live` = live lanes remaining)."""
+        self._journal({"event": "serve_retire", "id": req_id,
+                       "lane": int(lane), "iter": int(boundary),
+                       "iters_run": int(iters_run), "live": int(live)})
+
     def batch(self, spec_dict: dict, nrhs_live: int, nrhs_bucket: int,
               cache_hit: bool, wall_s: float,
-              gdof_per_second: float) -> None:
+              gdof_per_second: float, *,
+              padded_lanes: int | None = None, midsolve: int = 0,
+              boundaries: int = 0, live_lane_boundaries: int = 0,
+              continuous: bool = False) -> None:
+        """One executed batch. `padded_lanes` defaults to the one-shot
+        padding (bucket - live); continuous batches pass their true
+        dead-slot integral (bucket * boundaries - live-lane boundaries,
+        in boundary units normalised to lanes)."""
+        if padded_lanes is None:
+            padded_lanes = max(nrhs_bucket - nrhs_live, 0)
+        mean_live = (live_lane_boundaries / boundaries
+                     if boundaries else float(nrhs_live))
         self._journal({"event": "serve_batch", "spec": spec_dict,
                        "nrhs_live": nrhs_live, "nrhs_bucket": nrhs_bucket,
                        "cache": "hit" if cache_hit else "miss",
                        "wall_s": round(wall_s, 6),
-                       "gdof_per_second": round(gdof_per_second, 6)})
+                       "gdof_per_second": round(gdof_per_second, 6),
+                       "padded_lanes": int(padded_lanes),
+                       "midsolve": int(midsolve),
+                       "boundaries": int(boundaries),
+                       "mean_live_lanes": round(mean_live, 4),
+                       "continuous": bool(continuous)})
         with self._lock:
             self.batches += 1
             self.lanes_total += nrhs_live
+            self.padded_lanes_total += int(padded_lanes)
+            self.lane_slots_total += int(nrhs_bucket)
+            self.live_lane_boundaries += int(live_lane_boundaries)
+            self.boundaries_total += int(boundaries)
             if cache_hit:
                 self.cache_hit_requests += nrhs_live
             else:
@@ -98,9 +162,12 @@ class Metrics:
 
     def response(self, req_id: str, ok: bool, latency_s: float,
                  failure_class: str | None = None,
-                 retriable: bool | None = None) -> None:
+                 retriable: bool | None = None,
+                 cache: str | None = None) -> None:
         rec = {"event": "serve_response", "id": req_id, "ok": ok,
                "latency_s": round(latency_s, 6)}
+        if cache is not None:
+            rec["cache"] = cache
         if not ok:
             rec["failure_class"] = failure_class or "transient"
             rec["retriable"] = bool(retriable)
@@ -114,6 +181,8 @@ class Metrics:
                 self.failed_by_class[fc] = (
                     self.failed_by_class.get(fc, 0) + 1)
             self.latencies.append(latency_s)
+            if cache == "hit":
+                self.latencies_warm.append(latency_s)
 
     def set_queue_depth(self, depth: int) -> None:
         with self._lock:
@@ -124,6 +193,7 @@ class Metrics:
     def snapshot(self, cache_stats: dict | None = None) -> dict:
         with self._lock:
             lat = sorted(self.latencies)
+            warm = sorted(self.latencies_warm)
             batched = self.cache_hit_requests + self.cache_miss_requests
             out = {
                 "requests_total": self.requests_total,
@@ -141,6 +211,26 @@ class Metrics:
                 ),
                 "latency_p50_s": _pct(lat, 0.50),
                 "latency_p95_s": _pct(lat, 0.95),
+                "latency_p99_s": _pct(lat, 0.99),
+                # cache-warm percentiles: the steady-state serving story
+                # (cold responses carry compile stalls)
+                "latency_warm_p50_s": _pct(warm, 0.50),
+                "latency_warm_p95_s": _pct(warm, 0.95),
+                "latency_warm_p99_s": _pct(warm, 0.99),
+                # padding waste: dead/padded lane-slots over all slots
+                # the executed buckets provided
+                "padded_lanes_total": self.padded_lanes_total,
+                "padding_waste": (
+                    self.padded_lanes_total / self.lane_slots_total
+                    if self.lane_slots_total else 0.0
+                ),
+                # continuous batching: admissions into in-flight solves
+                # and the boundary-weighted live-lane occupancy
+                "midsolve_admissions": self.midsolve_admissions,
+                "mean_live_lanes": (
+                    self.live_lane_boundaries / self.boundaries_total
+                    if self.boundaries_total else 0.0
+                ),
                 "gdof_per_second_mean": (
                     sum(self.gdof_samples) / len(self.gdof_samples)
                     if self.gdof_samples else 0.0
@@ -160,15 +250,21 @@ def _pct(sorted_vals, q: float) -> float:
 
 def replay_serve(journal_path: str) -> dict:
     """Fold a serve journal back into the incident summary: per-event
-    counts, per-class failure counts, occupancy and hit-rate — enough to
-    reconstruct "what happened" from the file alone (the journal IS the
-    incident record; this is its reader)."""
+    counts, per-class failure counts, occupancy, hit-rate, padding
+    waste, mid-solve admissions and cache-warm latency percentiles —
+    enough to reconstruct "what happened" from the file alone (the
+    journal IS the incident record; this is its reader)."""
     records, corrupt = read_records(journal_path)
     out = {
         "requests": 0, "shed": 0, "batches": 0, "responses_ok": 0,
         "responses_failed": 0, "failed_by_class": {}, "lanes_total": 0,
         "cache_hits": 0, "cache_misses": 0, "corrupt_lines": len(corrupt),
+        "admits": 0, "midsolve_admissions": 0, "retires": 0,
+        "padded_lanes_total": 0, "lane_slots_total": 0,
+        "live_lane_boundaries": 0, "boundaries_total": 0,
     }
+    warm_lat: list[float] = []
+    occupancy: list[dict] = []  # (seq, iter, live) — occupancy over time
     for rec in records:
         ev = rec.get("event")
         if ev == "serve_request":
@@ -178,9 +274,28 @@ def replay_serve(journal_path: str) -> dict:
             fc = rec.get("failure_class", "transient")
             out["failed_by_class"][fc] = (
                 out["failed_by_class"].get(fc, 0) + 1)
+        elif ev == "serve_admit":
+            out["admits"] += 1
+            if rec.get("midsolve"):
+                out["midsolve_admissions"] += 1
+            occupancy.append({"seq": rec.get("seq"),
+                              "iter": rec.get("iter"),
+                              "live": rec.get("live")})
+        elif ev == "serve_retire":
+            out["retires"] += 1
+            occupancy.append({"seq": rec.get("seq"),
+                              "iter": rec.get("iter"),
+                              "live": rec.get("live")})
         elif ev == "serve_batch":
             out["batches"] += 1
             out["lanes_total"] += int(rec.get("nrhs_live", 0))
+            out["padded_lanes_total"] += int(rec.get("padded_lanes", 0))
+            out["lane_slots_total"] += int(rec.get("nrhs_bucket", 0))
+            out["live_lane_boundaries"] += int(
+                rec.get("boundaries", 0)
+                and round(rec.get("mean_live_lanes", 0.0)
+                          * rec.get("boundaries", 0)))
+            out["boundaries_total"] += int(rec.get("boundaries", 0))
             if rec.get("cache") == "hit":
                 out["cache_hits"] += int(rec.get("nrhs_live", 0))
             else:
@@ -188,6 +303,8 @@ def replay_serve(journal_path: str) -> dict:
         elif ev == "serve_response":
             if rec.get("ok"):
                 out["responses_ok"] += 1
+                if rec.get("cache") == "hit":
+                    warm_lat.append(float(rec.get("latency_s", 0.0)))
             else:
                 out["responses_failed"] += 1
                 fc = rec.get("failure_class", "transient")
@@ -198,4 +315,15 @@ def replay_serve(journal_path: str) -> dict:
     batched = out["cache_hits"] + out["cache_misses"]
     out["cache_hit_rate_requests"] = (
         out["cache_hits"] / batched if batched else 0.0)
+    out["padding_waste"] = (
+        out["padded_lanes_total"] / out["lane_slots_total"]
+        if out["lane_slots_total"] else 0.0)
+    out["mean_live_lanes"] = (
+        out["live_lane_boundaries"] / out["boundaries_total"]
+        if out["boundaries_total"] else 0.0)
+    warm = sorted(warm_lat)
+    out["latency_warm_p50_s"] = _pct(warm, 0.50)
+    out["latency_warm_p95_s"] = _pct(warm, 0.95)
+    out["latency_warm_p99_s"] = _pct(warm, 0.99)
+    out["occupancy_timeline"] = occupancy
     return out

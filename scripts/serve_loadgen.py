@@ -3,20 +3,38 @@
 
 Drives `python -m bench_tpu_fem.serve` over localhost HTTP with N
 concurrent requests across a degree mix, retrying retriable 503 sheds
-once, then prints one JSON summary line (per-class failure counts, the
-server's /metrics snapshot, wall time). Exit code 1 if any request
-ends unrecovered.
+once, then prints one JSON summary line (per-class failure counts, an
+engine-form histogram, the server's /metrics snapshot, wall time).
+Exit code 1 if any request ends unrecovered or an --assert-* check
+fails.
+
+Profiles:
+  burst (default)  all requests fired at once behind the concurrency
+                   semaphore — the PR-5 acceptance shape.
+  ramp             staggered arrivals (--stagger-ms apart) so the queue
+                   stays non-empty ACROSS solve boundaries — the
+                   continuous-batching acceptance shape: an in-flight
+                   batch keeps finding compatible queued work to admit
+                   at its iteration boundaries.
+
+Journal assertions (CI serve lane): when the server journals to a file
+this loadgen can read (--journal), --assert-continuous parses it
+(plain JSONL, stdlib json) and fails the run unless it records
+mid-solve admissions (serve_admit with midsolve=true);
+--expect-fused fails the run unless every 200 response carried a fused
+(non-"unfused") cg_engine_form.
 
     # terminal 1
     JAX_PLATFORMS=cpu python -m bench_tpu_fem.serve --port 8378 \
-        --warmup 1,2,3 --ndofs 4000 --nreps 15
+        --warmup 1,2,3 --ndofs 4000 --nreps 15 --journal /tmp/s.jsonl
     # terminal 2
     python scripts/serve_loadgen.py --url http://127.0.0.1:8378 \
         --requests 64 --concurrency 16 --degrees 1,2,3 \
-        --ndofs 4000 --nreps 15
+        --ndofs 4000 --nreps 15 --profile ramp \
+        --journal /tmp/s.jsonl --assert-continuous --expect-fused
 
-stdlib only (urllib + threading): the loadgen must run anywhere the
-server does, including the CI serve lane.
+stdlib only (urllib + threading + json): the loadgen must run anywhere
+the server does, including the CI serve lane.
 """
 
 from __future__ import annotations
@@ -54,14 +72,18 @@ def _post(url: str, body: dict, timeout_s: float):
 
 def run_load(url: str, requests: int = 64, concurrency: int = 16,
              degrees=(1, 2, 3), ndofs: int = 4000, nreps: int = 15,
-             precision: str = "f32", timeout_s: float = 120.0) -> dict:
+             precision: str = "f32", timeout_s: float = 120.0,
+             profile: str = "burst", stagger_ms: float = 30.0) -> dict:
     """Fire `requests` mixed-degree solves with a bounded worker pool;
     retriable failures (shed 503s) get ONE retry after the server's
-    Retry-After hint. Returns the summary dict main() prints."""
+    Retry-After hint. `profile="ramp"` staggers thread starts by
+    `stagger_ms` so arrivals straddle solve boundaries (the queue stays
+    non-empty while batches are in flight — what continuous batching
+    feeds on). Returns the summary dict main() prints."""
     degrees = list(degrees)
     lock = threading.Lock()
     out = {"completed": 0, "failed": 0, "shed_retried": 0,
-           "failed_by_class": {}, "latency_s": []}
+           "failed_by_class": {}, "engine_forms": {}, "latency_s": []}
     sem = threading.Semaphore(concurrency)
 
     def fire(i: int):
@@ -80,6 +102,9 @@ def run_load(url: str, requests: int = 64, concurrency: int = 16,
                 out["latency_s"].append(round(time.monotonic() - t0, 4))
                 if code == 200 and resp.get("ok"):
                     out["completed"] += 1
+                    form = resp.get("cg_engine_form", "unknown")
+                    out["engine_forms"][form] = (
+                        out["engine_forms"].get(form, 0) + 1)
                 else:
                     out["failed"] += 1
                     fc = resp.get("failure_class", "transient")
@@ -91,6 +116,8 @@ def run_load(url: str, requests: int = 64, concurrency: int = 16,
                for i in range(requests)]
     for t in threads:
         t.start()
+        if profile == "ramp":
+            time.sleep(stagger_ms / 1000.0)
     for t in threads:
         t.join()
     out["wall_s"] = round(time.monotonic() - t0, 3)
@@ -112,6 +139,37 @@ def run_load(url: str, requests: int = 64, concurrency: int = 16,
     return out
 
 
+def check_journal_continuous(journal_path: str) -> dict:
+    """Parse the server's JSONL journal (stdlib json — no repo imports:
+    the loadgen stays standalone) and summarise the continuous-batching
+    evidence: mid-solve admissions, retires, batches. The CI assertion
+    reads this instead of trusting the in-process counters."""
+    midsolve = admits = retires = batches = 0
+    corrupt = 0
+    with open(journal_path) as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                corrupt += 1  # torn tail tolerated, counted
+                continue
+            ev = rec.get("event")
+            if ev == "serve_admit":
+                admits += 1
+                if rec.get("midsolve"):
+                    midsolve += 1
+            elif ev == "serve_retire":
+                retires += 1
+            elif ev == "serve_batch":
+                batches += 1
+    return {"admits": admits, "midsolve_admissions": midsolve,
+            "retires": retires, "batches": batches,
+            "corrupt_lines": corrupt}
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("--url", default="http://127.0.0.1:8378")
@@ -124,14 +182,52 @@ def main(argv=None) -> int:
     p.add_argument("--precision", default="f32",
                    choices=["f32", "f64", "df32"])
     p.add_argument("--timeout", type=float, default=120.0)
+    p.add_argument("--profile", default="burst",
+                   choices=["burst", "ramp"],
+                   help="burst: fire everything at once; ramp: stagger "
+                        "arrivals so the queue spans solve boundaries")
+    p.add_argument("--stagger-ms", type=float, default=30.0,
+                   help="ramp profile inter-arrival gap")
+    p.add_argument("--journal", default="",
+                   help="the SERVER's journal path (for --assert-*)")
+    p.add_argument("--assert-continuous", action="store_true",
+                   help="fail unless the journal records mid-solve "
+                        "admissions (requires --journal)")
+    p.add_argument("--expect-fused", action="store_true",
+                   help="fail unless every 200 response carried a "
+                        "fused (non-'unfused') cg_engine_form")
     args = p.parse_args(argv)
     summary = run_load(
         args.url, requests=args.requests, concurrency=args.concurrency,
         degrees=[int(d) for d in args.degrees.split(",") if d.strip()],
         ndofs=args.ndofs, nreps=args.nreps, precision=args.precision,
-        timeout_s=args.timeout)
+        timeout_s=args.timeout, profile=args.profile,
+        stagger_ms=args.stagger_ms)
+    rc = 0 if summary["failed"] == 0 else 1
+    if args.assert_continuous:
+        if not args.journal:
+            summary["assert_continuous"] = "FAIL: --journal required"
+            rc = 1
+        else:
+            cont = check_journal_continuous(args.journal)
+            summary["journal"] = cont
+            if cont["midsolve_admissions"] < 1:
+                summary["assert_continuous"] = (
+                    "FAIL: no mid-solve admissions journaled")
+                rc = 1
+            else:
+                summary["assert_continuous"] = "ok"
+    if args.expect_fused:
+        forms = summary["engine_forms"]
+        bad = {f: n for f, n in forms.items()
+               if f in ("unfused", "unknown")}
+        if bad or not forms:
+            summary["expect_fused"] = f"FAIL: {bad or 'no responses'}"
+            rc = 1
+        else:
+            summary["expect_fused"] = "ok"
     print(json.dumps(summary))
-    return 0 if summary["failed"] == 0 else 1
+    return rc
 
 
 if __name__ == "__main__":

@@ -127,6 +127,151 @@ def test_per_rhs_freeze_and_zero_padding():
 
 
 # ---------------------------------------------------------------------------
+# Checkpointable batched CG (la.cg.BatchedCGState machinery) + the fused
+# nrhs-native kron engine (ops.kron_cg) — ISSUE 6
+# ---------------------------------------------------------------------------
+
+def test_checkpoint_machinery_bitwise_matches_oracle():
+    """The reassociated checkpoint loop with the unfused composition
+    engine IS `cg_solve_batched` bit for bit (the parity-oracle
+    contract: the p-update just moved across the loop boundary)."""
+    from bench_tpu_fem.la import fused_cg_solve_batched, unfused_batch_engine
+
+    op, b = _kron_problem(3, ndofs=2000)
+    B = _stack_scaled(b, [1.0, 2.0, 0.0])
+    nreps = 18
+    X_ref = jax.jit(
+        lambda A, Bv: cg_solve_batched(A.apply, Bv,
+                                       jnp.zeros_like(Bv), nreps)
+    )(op, B)
+    X = jax.jit(
+        lambda A, Bv: fused_cg_solve_batched(
+            unfused_batch_engine(jax.vmap(A.apply)), Bv, nreps)
+    )(op, B)
+    assert bool(jnp.all(X == X_ref))
+
+
+@pytest.mark.parametrize("degree", [1, 3])
+def test_fused_batched_kron_engine_parity(degree):
+    """The nrhs-native fused kron CG vs the cg_solve_batched oracle,
+    per lane: the engine family's f32 reassociation accuracy (<= 5e-5
+    relative L2, the kron engine suite's convention) — plus the exact
+    per-executable contracts: power-of-two scale linearity bitwise
+    across lanes, padding lane exactly zero."""
+    from bench_tpu_fem.ops.kron_cg import kron_cg_solve_batched
+
+    op, b = _kron_problem(degree, ndofs=2500)
+    B = _stack_scaled(b, [1.0, 2.0, 0.5, 0.0])
+    nreps = 12
+    X_ref = jax.jit(
+        lambda A, Bv: cg_solve_batched(A.apply, Bv,
+                                       jnp.zeros_like(Bv), nreps)
+    )(op, B)
+    X = jax.jit(
+        lambda A, Bv: kron_cg_solve_batched(A, Bv, nreps,
+                                            interpret=True)
+    )(op, B)
+    for lane in range(3):
+        rel = float(jnp.linalg.norm(X[lane] - X_ref[lane])
+                    / jnp.linalg.norm(X_ref[lane]))
+        assert rel < 5e-5, f"lane {lane}: {rel}"
+    # lanes are independent inside one executable and power-of-two
+    # scaling is exact: the serving parity contract, bitwise
+    assert bool(jnp.all(X[1] == 2.0 * X[0]))
+    assert float(jnp.max(jnp.abs(X[3]))) == 0.0
+
+
+def test_engine_plan_batched_tiers():
+    """Per-bucket VMEM plan: nrhs scales the ring estimate through the
+    same hardware-checked tiers as the single-RHS plan; over the top
+    tier the plan says 'unfused' (no chunked batched form yet)."""
+    from bench_tpu_fem.ops.kron_cg import (
+        engine_plan_batched,
+        engine_vmem_bytes,
+        engine_vmem_bytes_batched,
+        supports_kron_cg_engine_batched,
+    )
+
+    grid = (118, 118, 118)  # ~1.6M dofs at degree 3
+    single = engine_vmem_bytes(grid, 3)
+    assert engine_vmem_bytes_batched(grid, 3, 4) == 4 * single
+    # nrhs=1 degenerates to the single-RHS plan's form admission
+    assert engine_plan_batched(grid, 3, 1)[0] == "one_batched"
+    # the flagship-scale grid: small buckets fused, huge buckets not
+    big = (232, 232, 232)
+    form_b, _ = engine_plan_batched(big, 3, 16)
+    assert form_b == "unfused"
+    assert not supports_kron_cg_engine_batched(big, 3, jnp.float32, 16)
+    assert supports_kron_cg_engine_batched(grid, 3, jnp.float32, 4)
+    assert not supports_kron_cg_engine_batched(grid, 3, jnp.float64, 4)
+    with pytest.raises(ValueError):
+        engine_plan_batched(grid, 3, 0)
+
+
+def test_property_frozen_lane_algebra_under_admit_retire():
+    """Satellite property test: lanes admitted at iteration boundaries
+    converge to the same answer as the same RHS solved in isolation
+    (<= 1e-7 f32; <= 1e-13 at f64 width — the df-class bound the gated
+    df32 continuous path will inherit), and retired lanes never perturb
+    live lanes (bitwise). Randomised admission/retire schedule over a
+    dense SPD operator."""
+    from bench_tpu_fem.la import (
+        batched_cg_admit,
+        batched_cg_init,
+        batched_cg_retire,
+        batched_cg_run,
+        cg_solve_batched,
+        make_batched_cg_step,
+        unfused_batch_engine,
+    )
+
+    for dtype, tol in ((jnp.float32, 1e-7), (jnp.float64, 1e-13)):
+        rng = np.random.RandomState(42)
+        M = rng.randn(48, 48)
+        A = jnp.asarray(M @ M.T + 48 * np.eye(48), dtype)
+        apply_one = lambda v: A @ v  # noqa: E731
+        nreps = 24
+        step = jax.jit(make_batched_cg_step(
+            unfused_batch_engine(jax.vmap(apply_one)), nreps))
+        run = jax.jit(lambda s, k: batched_cg_run(s, step, k),
+                      static_argnums=1)
+        rhs = [jnp.asarray(rng.randn(48), dtype) for _ in range(5)]
+
+        # randomised schedule: lanes 0/1 start; b2 admitted at boundary
+        # 8; lane 1 retired the moment it finishes; b3/b4 admitted into
+        # freed lanes at later boundaries
+        st = batched_cg_init(jnp.stack([rhs[0], rhs[1],
+                                        jnp.zeros(48, dtype)]))
+        st = run(st, 8)
+        st = batched_cg_admit(st, 2, rhs[2])
+        st = run(st, 16)  # lanes 0/1 hit nreps=24 here
+        x0, x1 = st.X[0], st.X[1]
+        st_retired = batched_cg_retire(st, 1)
+        st_retired = batched_cg_admit(st_retired, 0, rhs[3])
+        st_retired = run(st_retired, 8)  # b2 hits its 24
+        x2 = st_retired.X[2]
+        st_retired = batched_cg_admit(st_retired, 1, rhs[4])
+        st_retired = run(st_retired, 24)  # b3/b4 finish
+        x3, x4 = st_retired.X[0], st_retired.X[1]
+
+        # isolation oracle: every RHS solved alone
+        iso = cg_solve_batched(apply_one, jnp.stack(rhs),
+                               jnp.zeros((5, 48), dtype), nreps)
+        for lane, got in enumerate((x0, x1, x2, x3, x4)):
+            ref = np.asarray(iso[lane], np.float64)
+            err = np.abs(np.asarray(got, np.float64) - ref).max()
+            scale = np.abs(ref).max()
+            assert err <= tol * scale, (
+                f"dtype {np.dtype(dtype).name} RHS {lane}: admit/retire "
+                f"schedule diverged from isolation ({err / scale:.2e})")
+
+        # retired lanes never perturb live lanes: b2's trajectory with
+        # lane 1 retired is bitwise the trajectory without the retire
+        st_kept = run(st, 8)
+        assert bool(jnp.all(x2 == st_kept.X[2]))
+
+
+# ---------------------------------------------------------------------------
 # Sharded batched: psum'd batched dots vs a global oracle (8 devices)
 # ---------------------------------------------------------------------------
 

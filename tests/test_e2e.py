@@ -56,6 +56,38 @@ def test_cli_conflicting_dof_flags():
         main(["--ndofs", "1000", "--ndofs_global", "100000"])
 
 
+def test_cli_nrhs_validated_early():
+    """Satellite (ISSUE 6): --nrhs < 1 rejected at argument-validation
+    time; a non-bucket nrhs warns about serve-bucket padding up front
+    (and still runs, stamping the padded width) instead of failing or
+    surprising deep in the driver."""
+    from bench_tpu_fem.cli import main
+
+    import jax
+
+    with pytest.raises(SystemExit):
+        main(["--nrhs", "0"])
+    with pytest.raises(SystemExit):
+        main(["--nrhs", "-2"])
+    prev_x64 = jax.config.jax_enable_x64  # main() is a process entry
+    try:                                  # point: it sets x64 globally
+        with pytest.warns(UserWarning, match="pads this batch to 4"):
+            rc = main(["--ndofs_global", "1000", "--degree", "2",
+                       "--float", "32", "--nreps", "2", "--nrhs", "3",
+                       "--cg", "--platform", "cpu"])
+        assert rc == 0
+        # above the largest bucket: a deployment SPLITS, it cannot pad
+        # down — the message must say so, not claim negative dead lanes
+        with pytest.warns(UserWarning,
+                          match="exceeds the largest serve bucket"):
+            rc = main(["--ndofs_global", "1000", "--degree", "2",
+                       "--float", "32", "--nreps", "2", "--nrhs", "17",
+                       "--cg", "--platform", "cpu"])
+    finally:
+        jax.config.update("jax_enable_x64", prev_x64)
+    assert rc == 0
+
+
 def test_nreps_zero_action_returns_zero_vector():
     cfg = BenchConfig(ndofs_global=1000, degree=2, qmode=1, nreps=0, ndevices=1)
     res = run_benchmark(cfg)

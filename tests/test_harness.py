@@ -502,6 +502,9 @@ def test_round6_agenda_shape():
     stages = A.make_stages("r99")
     names = A.resolve_stage_names(A.AGENDAS["round6"], stages)
     assert names[0] == "health" and stages["health"].critical
+    # the fused-batched hardware smoke is armed right after the CPU
+    # serve smoke (ISSUE 6)
+    assert names[:3] == ["health", "serve", "fusedbatch"]
     assert stages["dfacc"].provides_gate == "dfacc"
     for df in ("pertdf", "dfeng", "dfunf", "dflarge100", "dflarge150",
                "dfext2d"):
