@@ -246,3 +246,48 @@ def trace_collectives(fn, *args, mesh_axes: tuple[str, ...],
     _walk_jaxpr(closed.jaxpr, found)
     return [CollectiveUse(prim, axes, tuple(mesh_axes), tuple(declared_axes))
             for prim, axes in found]
+
+
+# Loop primitives a fori_loop/while_loop/scan lowers to: the CG
+# iteration body lives inside one of these.
+_LOOP_PRIMS = {"while", "scan"}
+
+# The reduction collectives (the "psum count" of the overlap contract)
+# vs the permutation/gather collectives, counted separately.
+_REDUCTION_PRIMS = {"psum", "psum2", "pmax", "pmin", "reduce_scatter"}
+
+
+def loop_collective_counts(fn, *args) -> dict[str, int]:
+    """Per-iteration collective counts of ``fn``'s loop body: trace
+    (abstract — nothing executes), find every while/scan body, and count
+    the collective equations inside. This is the CPU-provable invariant
+    behind the overlap engine forms — e.g. an overlapped CG must show
+    exactly ONE `psum` per iteration where the synchronous form shows
+    two, and the weak-scaling journal records these counts next to every
+    A/B measurement. Returns a {prim_name: count} dict plus two
+    aggregates: ``reductions`` (psum-class) and ``movements``
+    (ppermute/all_gather-class)."""
+    import jax.core as jc
+
+    closed = jax.make_jaxpr(fn)(*args)
+    counts: dict[str, int] = {}
+
+    def walk(j, in_loop: bool):
+        for eqn in j.eqns:
+            name = eqn.primitive.name
+            if in_loop and name in _COLLECTIVE_PRIMS and name != "axis_index":
+                counts[name] = counts.get(name, 0) + 1
+            sub_in_loop = in_loop or name in _LOOP_PRIMS
+            for v in eqn.params.values():
+                vs = v if isinstance(v, (tuple, list)) else (v,)
+                for w in vs:
+                    if isinstance(w, (jc.ClosedJaxpr, jc.Jaxpr)):
+                        walk(getattr(w, "jaxpr", w), sub_in_loop)
+
+    walk(closed.jaxpr, False)
+    counts["reductions"] = sum(c for p, c in counts.items()
+                               if p in _REDUCTION_PRIMS)
+    counts["movements"] = sum(c for p, c in counts.items()
+                              if p in ("ppermute", "all_gather",
+                                       "all_to_all"))
+    return counts

@@ -654,6 +654,166 @@ def drive_dist_folded_engine() -> ConfigResult:
         s.kernels, collectives=coll, plan=plan)
 
 
+def drive_dist_kron_overlap(degree: int, ext2d: bool) -> ConfigResult:
+    """The communication-overlapped kron engine forms (halo_overlap /
+    ext2d_overlap): the FULL overlapped CG loop traced through shard_map
+    — same delay-ring kernel as the synchronous dist forms (R1-R4 must
+    lint identically) plus the overlap loop's collectives (R5: the
+    carried-halo exchange and the single stacked psum)."""
+    from functools import partial
+
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from bench_tpu_fem.dist.kron import build_dist_kron
+    from bench_tpu_fem.dist.kron_cg import (
+        dist_kron_cg_solve_local_overlap,
+        dist_kron_engine_plan,
+    )
+    from bench_tpu_fem.dist.mesh import AXIS_NAMES, make_device_grid
+
+    dshape = (2, 2, 2) if ext2d else (4, 1, 1)
+    n = (4, 4, 4) if ext2d else (8, 2, 2)
+    dgrid = make_device_grid(dshape=dshape)
+    op = build_dist_kron(n, dgrid, degree, 1, dtype=jnp.float32)
+
+    @partial(jax.shard_map, mesh=dgrid.mesh,
+             in_specs=(P(*AXIS_NAMES), P()), out_specs=P(*AXIS_NAMES),
+             check_vma=False)
+    def run(b, A):
+        x = dist_kron_cg_solve_local_overlap(A, b[0, 0, 0], 2,
+                                             interpret=True)
+        return x[None, None, None]
+
+    b = _f32((*dshape, op.L[0], op.L[1], op.L[2]))
+    with CaptureSession() as s:
+        coll = trace_collectives(run, b, op,
+                                 mesh_axes=dgrid.mesh.axis_names,
+                                 declared_axes=AXIS_NAMES)
+    supported, kib = dist_kron_engine_plan(op)
+    from ..ops.kron_cg import engine_vmem_bytes
+
+    P_ = op.degree
+    cross = ((op.notbc1d[1].shape[0], op.notbc1d[2].shape[0])
+             if not ext2d else (op.L[1] + 2 * P_, op.L[2] + 2 * P_))
+    plan = PlanCheck("dist.kron_cg.dist_kron_engine_plan",
+                     engine_vmem_bytes((op.L[0], *cross), degree)
+                     if supported else None,
+                     scoped_limit_bytes(kib),
+                     notes="overlap form: same ring as the synchronous "
+                           "engine (update_p=False call)")
+    name = ("dist_kron_overlap_ext2d" if ext2d
+            else f"dist_kron_overlap_d{degree}")
+    return ConfigResult(
+        name, {"engine": "kron",
+               "dist": "ext2d_overlap" if ext2d else "halo_overlap",
+               "degree": degree, "dtype": "f32"},
+        s.kernels, collectives=coll, plan=plan)
+
+
+def drive_dist_kron_df_overlap(dshape: tuple) -> ConfigResult:
+    """The overlapped df engine forms: full overlapped df CG loop traced
+    through shard_map (same df kernel; R5 additionally sees the single
+    stacked all-gather fold replacing the per-dot gather chains)."""
+    from functools import partial
+
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from bench_tpu_fem.dist.kron_cg_df import (
+        dist_df_engine_plan,
+        dist_kron_df_cg_solve_local_overlap,
+    )
+    from bench_tpu_fem.dist.kron_df import DF, build_dist_kron_df
+    from bench_tpu_fem.dist.mesh import AXIS_NAMES, make_device_grid
+    from bench_tpu_fem.elements.tables import build_operator_tables
+
+    ext2d = dshape != (4, 1, 1)
+    dgrid = make_device_grid(dshape=dshape)
+    t = build_operator_tables(3, 1, "gll")
+    n = (4, 4, 4) if ext2d else (8, 2, 2)
+    op = build_dist_kron_df(n, dgrid, 3, 1, tables=t)
+
+    @partial(jax.shard_map, mesh=dgrid.mesh,
+             in_specs=(P(*AXIS_NAMES), P(*AXIS_NAMES), P()),
+             out_specs=P(*AXIS_NAMES), check_vma=False)
+    def run(bh, bl, A):
+        x = dist_kron_df_cg_solve_local_overlap(
+            A, DF(bh[0, 0, 0], bl[0, 0, 0]), 2, interpret=True)
+        return x.hi[None, None, None]
+
+    Lx, LY, LZ = op.L
+    b = _f32((*dshape, Lx, LY, LZ))
+    with CaptureSession() as s:
+        coll = trace_collectives(run, b, b, op,
+                                 mesh_axes=dgrid.mesh.axis_names,
+                                 declared_axes=AXIS_NAMES)
+    supported, kib = dist_df_engine_plan(op)
+    from ..ops.kron_cg_df import engine_vmem_bytes_df
+
+    P_ = op.degree
+    cross = ((op.notbc1d[1].shape[0], op.notbc1d[2].shape[0])
+             if not ext2d else (LY + 2 * P_, LZ + 2 * P_))
+    plan = PlanCheck("dist.kron_cg_df.dist_df_engine_plan",
+                     engine_vmem_bytes_df((Lx, *cross), 3)
+                     if supported else None,
+                     scoped_limit_bytes(kib),
+                     notes="overlap form: same df ring as the "
+                           "synchronous engine (update_p=False call)")
+    name = ("dist_kron_df_overlap_ext2d" if ext2d
+            else "dist_kron_df_overlap_halo")
+    return ConfigResult(
+        name, {"engine": "kron_df",
+               "dist": "ext2d_overlap" if ext2d else "halo_overlap",
+               "degree": 3, "dtype": "df32"},
+        s.kernels, collectives=coll, plan=plan)
+
+
+def drive_dist_folded_overlap() -> ConfigResult:
+    """The overlapped folded engine form (halo_overlap): the full
+    overlapped folded CG loop — identical halo-form delay-ring kernel as
+    dist_folded_engine, with the forward refresh moved onto y and the
+    single stacked psum (R5 sees scatter + refresh + one psum)."""
+    import jax
+    import jax.numpy as jnp
+
+    from bench_tpu_fem.dist.folded import (
+        build_dist_folded,
+        make_folded_sharded_fns,
+    )
+    from bench_tpu_fem.dist.folded_cg import dist_folded_engine_plan
+    from bench_tpu_fem.dist.mesh import AXIS_NAMES, make_device_grid
+    from bench_tpu_fem.elements.tables import build_operator_tables
+    from bench_tpu_fem.mesh.box import create_box_mesh
+
+    dgrid = make_device_grid(dshape=(2, 1, 1))
+    mesh = create_box_mesh((4, 2, 2), geom_perturb_fact=0.1)
+    t = build_operator_tables(3, 1)
+    op = build_dist_folded(mesh, dgrid, 3, t, dtype=jnp.float32, nl=16)
+    _, cg_fn, _, sharded_state = make_folded_sharded_fns(
+        op, dgrid, 2, engine=True, overlap=True)
+    lay = op.layout
+    b = _f32((2, 1, 1, lay.nblocks, 27, lay.block))
+    state = sharded_state(op)
+    with CaptureSession() as s:
+        coll = trace_collectives(cg_fn, b, state, op.owned,
+                                 mesh_axes=dgrid.mesh.axis_names,
+                                 declared_axes=AXIS_NAMES)
+    supported, kib = dist_folded_engine_plan(op)
+    plan = PlanCheck("dist.folded_cg.dist_folded_engine_plan",
+                     _folded_window_plan(3, t.nq, "g").estimate_bytes
+                     if supported else None,
+                     scoped_limit_bytes(kib),
+                     notes="overlap form: same halo-form ring as "
+                           "dist_folded_engine")
+    return ConfigResult(
+        "dist_folded_overlap",
+        {"engine": "folded", "dist": "halo_overlap", "degree": 3,
+         "dtype": "f32"},
+        s.kernels, collectives=coll, plan=plan)
+
+
 # ---------------------------------------------------------------------------
 # Registry
 # ---------------------------------------------------------------------------
@@ -735,6 +895,23 @@ def _matrix() -> list[ConfigSpec]:
                             min_devices=8))
     specs.append(ConfigSpec("dist_folded_engine", drive_dist_folded_engine,
                             min_devices=2))
+    # communication-overlapped engine forms (ISSUE 7): the full
+    # overlapped CG loops traced end to end, so R5 covers the carried-
+    # halo exchanges and the single fused reduction per iteration.
+    specs.append(ConfigSpec(
+        "dist_kron_overlap_d3",
+        lambda: drive_dist_kron_overlap(3, False), min_devices=4))
+    specs.append(ConfigSpec(
+        "dist_kron_overlap_ext2d",
+        lambda: drive_dist_kron_overlap(3, True), min_devices=8))
+    specs.append(ConfigSpec("dist_kron_df_overlap_halo",
+                            lambda: drive_dist_kron_df_overlap((4, 1, 1)),
+                            min_devices=4))
+    specs.append(ConfigSpec("dist_kron_df_overlap_ext2d",
+                            lambda: drive_dist_kron_df_overlap((2, 2, 2)),
+                            min_devices=8))
+    specs.append(ConfigSpec("dist_folded_overlap",
+                            drive_dist_folded_overlap, min_devices=2))
     return specs
 
 

@@ -156,6 +156,29 @@ def fixture_r5_bogus_axis() -> tuple[str, ConfigResult]:
                               [], collectives=[use])
 
 
+def fixture_r5_misaxed_overlap() -> tuple[str, ConfigResult]:
+    """The overlap-loop failure mode (ISSUE 7): an overlapped CG whose
+    carried-halo y exchange and fused single psum came out of a refactor
+    binding a STALE axis name — the ppermute exchange correctly binds
+    'dx' but the stacked reduction psums over ('dx', 'dy', 'z') (a
+    rename survivor). Hand-built like fixture_r5_bogus_axis (shard_map
+    refuses to trace an unbound name — which is exactly how this class
+    of drift ships: the kernel traces fine against the mesh it was
+    developed on and deadlocks/misreduces against ours). R5 must flag
+    the psum while passing the exchange."""
+    uses = [
+        CollectiveUse(prim="ppermute", axes=("dx",),
+                      mesh_axes=("dx", "dy", "dz"),
+                      declared_axes=("dx", "dy", "dz")),
+        CollectiveUse(prim="psum", axes=("dx", "dy", "z"),
+                      mesh_axes=("dx", "dy", "dz"),
+                      declared_axes=("dx", "dy", "dz")),
+    ]
+    return "R5", ConfigResult("fixture_r5_misaxed_overlap",
+                              {"fixture": True, "dist": "halo_overlap"},
+                              [], collectives=uses)
+
+
 CORPUS = (
     fixture_r1_round4,
     fixture_r1_bf16,
@@ -163,6 +186,7 @@ CORPUS = (
     fixture_r3_f64,
     fixture_r4_unlowerable,
     fixture_r5_bogus_axis,
+    fixture_r5_misaxed_overlap,
 )
 
 

@@ -39,6 +39,7 @@ from ..mesh.dofmap import (
     cell_dofmap,
     dof_coordinates,
     dof_grid_shape,
+    global_ndofs,
 )
 from ..ops.laplacian import build_laplacian
 from ..utils.compilation import (  # noqa: F401  (TPU_COMPILER_OPTIONS re-exported for probes/tests, which must mutate it IN PLACE — rebinding the name here would not reach compile_lowered)
@@ -93,6 +94,14 @@ class BenchConfig:
     # default: tests that monkeypatch kernel internals rely on every
     # run_benchmark call compiling fresh.
     exec_cache: bool = False
+    # communication/compute overlap for the SHARDED fused CG engines
+    # (ISSUE 7): "auto" engages the double-buffered-halo single-psum
+    # forms (`halo_overlap` / `ext2d_overlap`) wherever the family's
+    # resolver supports them, "off" pins the synchronous engines, "on"
+    # insists (unsupported configs still fall back with the gate reason
+    # recorded in `overlap_gate_reason`). Single-chip paths have no
+    # collectives and ignore this.
+    overlap: str = "auto"
 
 
 @dataclass
@@ -120,11 +129,14 @@ def record_engine(extra: dict, engine: bool, form: str | None = None,
     folded / df, single-chip / dist): `cg_engine_form` is one of
     "one_kernel" (single-chip delay ring) | "halo" (distributed plane/
     block-halo ring) | "ext2d" (3D-sharded halo-extended cross-section
-    ring) | "chunked" (y-chunked two-kernel) | "unfused", and any
-    fallback carries the reason in `cg_engine_error` plus its harness
-    taxonomy class in `failure_class` (tunnel_wedge/oom/mosaic_reject/
-    accuracy_fail/timeout/unsupported/transient) — so fallback audits
-    are ONE grep across BENCH/MULTICHIP/MEASURE artifacts."""
+    ring) | "halo_overlap" / "ext2d_overlap" (the communication-
+    overlapped double-buffered-halo single-psum variants of the two
+    dist forms) | "chunked" (y-chunked two-kernel) | "one_kernel_batched"
+    (nrhs-native batched ring) | "unfused", and any fallback carries the
+    reason in `cg_engine_error` plus its harness taxonomy class in
+    `failure_class` (tunnel_wedge/oom/mosaic_reject/accuracy_fail/
+    timeout/unsupported/transient) — so fallback audits are ONE grep
+    across BENCH/MULTICHIP/MEASURE artifacts."""
     from ..harness.classify import classify_exception, classify_text
 
     extra["cg_engine"] = engine
@@ -406,7 +418,7 @@ def _run_benchmark_folded_df(cfg: BenchConfig) -> BenchmarkResults:
             cfg,
             f"folded-df plan: degree {cfg.degree} qmode {cfg.qmode} "
             "exceeds the df VMEM model (no 128-lane folded df kernel)")
-    ndofs_global = int(np.prod(dof_grid_shape(n, cfg.degree)))
+    ndofs_global = global_ndofs(n, cfg.degree)
     res = BenchmarkResults(
         ncells_global=mesh.ncells, ndofs_global=ndofs_global, nreps=cfg.nreps
     )
@@ -520,7 +532,7 @@ def _run_benchmark_df64(cfg: BenchConfig) -> BenchmarkResults:
     if not mesh.is_uniform:
         raise ValueError("f64_impl='df32' requires a uniform (unperturbed) "
                          "mesh — the kron fast path")
-    ndofs_global = int(np.prod(dof_grid_shape(n, cfg.degree)))
+    ndofs_global = global_ndofs(n, cfg.degree)
     res = BenchmarkResults(
         ncells_global=mesh.ncells, ndofs_global=ndofs_global, nreps=cfg.nreps
     )
@@ -876,7 +888,7 @@ def _run_benchmark(cfg: BenchConfig) -> BenchmarkResults:
     backend = resolve_backend(cfg.backend, cfg.float_bits,
                               uniform=mesh.is_uniform, degree=cfg.degree,
                               qmode=cfg.qmode)
-    ndofs_global = int(np.prod(dof_grid_shape(n, cfg.degree)))
+    ndofs_global = global_ndofs(n, cfg.degree)
     res = BenchmarkResults(
         ncells_global=mesh.ncells, ndofs_global=ndofs_global, nreps=cfg.nreps
     )

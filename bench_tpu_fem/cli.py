@@ -62,6 +62,15 @@ def build_parser() -> argparse.ArgumentParser:
                         "slower); 'df32' = double-float f32 pairs "
                         "(~1e-12 CG residual floors at ~20x flops; "
                         "uniform single-chip meshes)")
+    p.add_argument("--overlap", default="auto",
+                   choices=["auto", "on", "off"],
+                   help="Communication/compute overlap for the sharded "
+                        "fused CG engines (double-buffered halo exchange "
+                        "+ single-psum iterations, engine forms "
+                        "halo_overlap/ext2d_overlap). 'auto' engages "
+                        "where supported; unsupported configs record the "
+                        "gate reason and run synchronously. Single-chip "
+                        "runs ignore this.")
     p.add_argument("--log-level", default="info")
     p.add_argument("--profile", default="",
                    help="Write a jax.profiler trace of the timed region to "
@@ -179,6 +188,7 @@ def main(argv: list[str] | None = None) -> int:
         f64_impl=args.f64_impl,
         profile_dir=args.profile,
         nrhs=args.nrhs,
+        overlap=args.overlap,
     )
 
     dev = devices[0]

@@ -12,6 +12,7 @@ import numpy as np
 
 from ..bench.driver import record_engine
 from ..la.cg import cg_solve
+from ..mesh.dofmap import global_ncells, global_ndofs
 from ..utils.compilation import (
     CPU_DF_DIST_OPTIONS,
     compile_lowered,
@@ -19,13 +20,29 @@ from ..utils.compilation import (
     scoped_vmem_options,
 )
 from ..utils.timing import Timer
-from .halo import masked_dot, masked_linf, owned_mask
+from .halo import masked_dot, masked_linf, owned_dot, owned_mask
 from .mesh import AXIS_NAMES, compute_mesh_size_sharded, make_device_grid
 from .operator import (
     build_dist_laplacian,
     shard_grid_blocks,
     unshard_grid_blocks,
 )
+
+
+def _resolve_overlap_mode(cfg, extra: dict, supported: bool,
+                          gate_reason: str | None) -> bool:
+    """cfg.overlap ('auto' | 'on' | 'off') -> whether the communication-
+    overlapped CG form engages, recording a reasoned gate when 'auto'/
+    'on' stays synchronous (the ISSUE-7 contract: every overlap branch
+    stamps its form and records why it did not engage)."""
+    mode = getattr(cfg, "overlap", "auto")
+    if mode == "off":
+        return False
+    if supported:
+        return True
+    if gate_reason:
+        extra["overlap_gate_reason"] = gate_reason
+    return False
 
 
 def make_sharded_fns(op, dgrid, nreps: int):
@@ -59,13 +76,12 @@ def make_sharded_fns(op, dgrid, nreps: int):
     )
     def cg_fn(b, G, bc):
         bl, Gl, bcl = _local(b), _local(G), _local(bc)
-        mask = owned_mask(bl.shape)
         x = cg_solve(
             lambda v: op.apply_local(v, Gl, bcl),
             bl,
             jnp.zeros_like(bl),
             nreps,
-            dot=lambda u, v: masked_dot(u, v, mask),
+            dot=owned_dot(owned_mask(bl.shape).astype(bl.dtype)),
         )
         return x[None, None, None]
 
@@ -96,7 +112,7 @@ def make_sharded_batched_cg(op, dgrid, nreps: int):
     from jax.sharding import PartitionSpec as P
 
     from ..la.cg import cg_solve_batched
-    from .halo import psum_all
+    from .halo import owned_batched_dot
 
     bspec = P(None, *AXIS_NAMES)
     spec = P(*AXIS_NAMES)
@@ -108,13 +124,9 @@ def make_sharded_batched_cg(op, dgrid, nreps: int):
         Bl, Gl, bcl = Bv[:, 0, 0, 0], G[0, 0, 0], bc[0, 0, 0]
         mask = owned_mask(Bl.shape[1:]).astype(Bl.dtype)
 
-        def bdot(U, V):
-            return psum_all(jnp.sum(U * V * mask[None],
-                                    axis=tuple(range(1, U.ndim))))
-
         X = cg_solve_batched(
             lambda v: op.apply_local(v, Gl, bcl), Bl,
-            jnp.zeros_like(Bl), nreps, dot=bdot,
+            jnp.zeros_like(Bl), nreps, dot=owned_batched_dot(mask),
         )
         return X[:, None, None, None]
 
@@ -152,7 +164,6 @@ def run_distributed(cfg, res, dtype):
     n = compute_mesh_size_sharded(cfg.ndofs_global, cfg.degree, dgrid.dshape)
 
     from ..bench.driver import resolve_backend
-    from ..mesh.dofmap import dof_grid_shape
 
     backend = resolve_backend(
         cfg.backend, cfg.float_bits,
@@ -173,8 +184,12 @@ def run_distributed(cfg, res, dtype):
     # per-path raised scoped-VMEM request (utils.compilation), set by the
     # kron-engine / folded-plan branches below
     compile_opts = None
-    res.ncells_global = int(np.prod(n))
-    res.ndofs_global = int(np.prod(dof_grid_shape(n, cfg.degree)))
+    # communication-overlap routing state, set by the kron/folded
+    # branches (the xla path has no engine and therefore no overlap form)
+    overlap_on = False
+    base_form = None
+    res.ncells_global = global_ncells(n)
+    res.ndofs_global = global_ndofs(n, cfg.degree)
 
     # Neither fast path needs O(global-dofs) host arrays: the kron flagship's
     # operator state is three 1D assemblies with a per-shard separable device
@@ -210,16 +225,20 @@ def run_distributed(cfg, res, dtype):
                 n, dgrid, cfg.degree, cfg.qmode, rule, kappa=2.0,
                 dtype=dtype, tables=t,
             )
-            from .kron import resolve_kron_engine
+            from .kron import resolve_kron_engine, resolve_kron_overlap
             from .kron_cg import _is_x_only, dist_kron_engine_plan
 
+            base_form = "halo" if _is_x_only(op) else "ext2d"
+            ovl_ok, ovl_gate = resolve_kron_overlap(op)
+            overlap_on = cfg.use_cg and cfg.nrhs == 1 and (
+                _resolve_overlap_mode(cfg, res.extra, ovl_ok, ovl_gate))
             apply_fn, cg_fn, norm_fn = make_kron_sharded_fns(
-                op, dgrid, cfg.nreps
+                op, dgrid, cfg.nreps, overlap=overlap_on
             )
             # same predicate the kernel routing uses, so the recorded
             # form cannot diverge from the form that runs
             record_engine(res.extra, resolve_kron_engine(op),
-                          "halo" if _is_x_only(op) else "ext2d")
+                          base_form + ("_overlap" if overlap_on else ""))
             if res.extra["cg_engine"]:
                 # raised-tier one-kernel rings need the per-compile
                 # scoped-VMEM request, same plan as the single-chip driver
@@ -259,9 +278,17 @@ def run_distributed(cfg, res, dtype):
             # per-shard ring fits — the auto rule inside
             # make_folded_sharded_fns is the same resolver, so the
             # recorded flag cannot diverge from what runs
-            record_engine(res.extra, resolve_folded_engine(op), "halo")
+            from .folded import resolve_folded_overlap
+
+            base_form = "halo"
+            ovl_ok, ovl_gate = resolve_folded_overlap(op)
+            overlap_on = cfg.use_cg and cfg.nrhs == 1 and (
+                _resolve_overlap_mode(cfg, res.extra, ovl_ok, ovl_gate))
+            record_engine(res.extra, resolve_folded_engine(op),
+                          "halo_overlap" if overlap_on else "halo")
             apply_fn, cg_fn, norm_fn, sharded_state = (
-                make_folded_sharded_fns(op, dgrid, cfg.nreps)
+                make_folded_sharded_fns(op, dgrid, cfg.nreps,
+                                        overlap=overlap_on)
             )
             state = sharded_state(op)
             if b_host is not None:
@@ -329,6 +356,22 @@ def run_distributed(cfg, res, dtype):
             fn = compile_lowered(jax.jit(cg_fn).lower(B, *cg_args))
             run_args = cg_args
         elif cfg.use_cg:
+            def _rebuild_cg(eng, ovl):
+                if kron:
+                    _, c, _ = make_kron_sharded_fns(
+                        op, dgrid, cfg.nreps, engine=eng, overlap=ovl
+                    )
+                    # unfused kron fallback fits the default scoped limit
+                    opts = compile_opts if eng else None
+                else:
+                    _, c, _, _ = make_folded_sharded_fns(
+                        op, dgrid, cfg.nreps, engine=eng, overlap=ovl
+                    )
+                    # unfused folded fallback still runs the streamed
+                    # corner kernels — keep the raised scoped request
+                    opts = compile_opts
+                return compile_lowered(jax.jit(c).lower(u, *cg_args), opts)
+
             try:
                 fn = compile_lowered(jax.jit(cg_fn).lower(u, *cg_args),
                                      compile_opts)
@@ -341,21 +384,19 @@ def run_distributed(cfg, res, dtype):
                 # fallback recompile; anything else re-raises unchanged.
                 if not ((kron or folded) and res.extra.get("cg_engine")):
                     raise
-                record_engine(res.extra, False, error=exc)
-                if kron:
-                    _, cg_fn, _ = make_kron_sharded_fns(
-                        op, dgrid, cfg.nreps, engine=False
-                    )
-                    # unfused kron fallback fits the default scoped limit
-                    fn = compile_lowered(jax.jit(cg_fn).lower(u, *cg_args))
+                if overlap_on:
+                    # an overlap-form rejection first retries the
+                    # SYNCHRONOUS engine (the recorded fallback the
+                    # overlap contract requires), then the unfused path
+                    record_engine(res.extra, True, base_form, error=exc)
+                    try:
+                        fn = _rebuild_cg(True, False)
+                    except Exception as exc2:
+                        record_engine(res.extra, False, error=exc2)
+                        fn = _rebuild_cg(False, False)
                 else:
-                    _, cg_fn, _, _ = make_folded_sharded_fns(
-                        op, dgrid, cfg.nreps, engine=False
-                    )
-                    # unfused folded fallback still runs the streamed
-                    # corner kernels — keep the raised scoped request
-                    fn = compile_lowered(jax.jit(cg_fn).lower(u, *cg_args),
-                                         compile_opts)
+                    record_engine(res.extra, False, error=exc)
+                    fn = _rebuild_cg(False, False)
             run_args = cg_args
         else:
             # One jitted fori_loop over all reps (same rationale as the
@@ -463,7 +504,6 @@ def _run_distributed_folded_df(cfg, res):
     from ..elements.tables import build_operator_tables
     from ..la.df64 import DF
     from ..mesh.box import create_box_mesh
-    from ..mesh.dofmap import dof_grid_shape
     from ..ops.folded_df import folded_df_plan
     from .folded import (
         build_dist_folded_df,
@@ -511,8 +551,8 @@ def _run_distributed_folded_df(cfg, res):
             f"folded-df plan: degree {cfg.degree} qmode {cfg.qmode} "
             "exceeds the df VMEM model (no 128-lane folded df kernel)")
     mesh = create_box_mesh(n, cfg.geom_perturb_fact)
-    res.ncells_global = int(np.prod(n))
-    res.ndofs_global = int(np.prod(dof_grid_shape(n, cfg.degree)))
+    res.ncells_global = global_ncells(n)
+    res.ndofs_global = global_ndofs(n, cfg.degree)
     res.extra["backend"] = "pallas"
     res.extra["f64_impl"] = "df32"
     res.extra["f64_df32_path"] = "folded"
@@ -616,7 +656,6 @@ def run_distributed_df64(cfg, res):
 
     from ..bench.driver import _setup_problem
     from ..elements.tables import build_operator_tables
-    from ..mesh.dofmap import dof_grid_shape
     from .kron_df import (
         DF,
         build_dist_kron_df,
@@ -634,8 +673,8 @@ def run_distributed_df64(cfg, res):
     n = compute_mesh_size_sharded(cfg.ndofs_global, cfg.degree, dgrid.dshape)
     rule = "gauss" if cfg.use_gauss else "gll"
     t = build_operator_tables(cfg.degree, cfg.qmode, rule)
-    res.ncells_global = int(np.prod(n))
-    res.ndofs_global = int(np.prod(dof_grid_shape(n, cfg.degree)))
+    res.ncells_global = global_ncells(n)
+    res.ndofs_global = global_ndofs(n, cfg.degree)
     res.extra["backend"] = "kron"
     res.extra["f64_impl"] = "df32"
 
@@ -668,7 +707,7 @@ def run_distributed_df64(cfg, res):
         else:
             u = jax.jit(make_kron_df_rhs_fn(op, dgrid, t))()
         from .kron_cg_df import _is_x_only, dist_df_engine_plan
-        from .kron_df import resolve_df_engine
+        from .kron_df import resolve_df_engine, resolve_df_overlap
 
         u_run = u
         if cfg.nrhs > 1:
@@ -705,15 +744,19 @@ def run_distributed_df64(cfg, res):
             engine = False
         else:
             engine = resolve_df_engine(op)
+            base_form = "halo" if _is_x_only(op) else "ext2d"
+            ovl_ok, ovl_gate = resolve_df_overlap(op)
+            overlap_on = cfg.use_cg and (
+                _resolve_overlap_mode(cfg, res.extra, ovl_ok, ovl_gate))
             record_engine(res.extra, engine,
-                          "halo" if _is_x_only(op) else "ext2d")
+                          base_form + ("_overlap" if overlap_on else ""))
         opts = (scoped_vmem_options(dist_df_engine_plan(op)[1])
                 if engine else None)
         from ..la.df64 import df_zeros_like
 
-        def _build(eng):
+        def _build(eng, ovl=False):
             a_fn, c_fn, n_fn, n_from = make_kron_df_sharded_fns(
-                op, dgrid, cfg.nreps, engine=eng
+                op, dgrid, cfg.nreps, engine=eng, overlap=ovl
             )
             if cfg.use_cg:
                 low = jax.jit(c_fn).lower(u, op)
@@ -734,16 +777,27 @@ def run_distributed_df64(cfg, res):
 
         if cfg.nrhs == 1:
             try:
-                norm_fn, norms_from, fn = _build(engine)
+                norm_fn, norms_from, fn = _build(engine, overlap_on)
             except Exception as exc:
                 # a Mosaic rejection of the fused dist df engine must not
                 # sink the benchmark: record and complete on the unfused
-                # path
+                # path (an overlap-form rejection first retries the
+                # synchronous engine, the recorded fallback the overlap
+                # contract requires)
                 if not engine:
                     raise
-                engine = False
-                record_engine(res.extra, False, error=exc)
-                norm_fn, norms_from, fn = _build(False)
+                if overlap_on:
+                    record_engine(res.extra, True, base_form, error=exc)
+                    try:
+                        norm_fn, norms_from, fn = _build(True, False)
+                    except Exception as exc2:
+                        engine = False
+                        record_engine(res.extra, False, error=exc2)
+                        norm_fn, norms_from, fn = _build(False)
+                else:
+                    engine = False
+                    record_engine(res.extra, False, error=exc)
+                    norm_fn, norms_from, fn = _build(False)
         warm = fn(u_run, op)
         float(warm.hi[(0,) * warm.hi.ndim])
         del warm

@@ -66,7 +66,7 @@ from ..ops.folded import (
     unfold_vector,
 )
 from ..ops.laplacian import freeze_table
-from .halo import _shift_from_left, _shift_from_right, masked_linf, psum_all
+from .halo import _shift_from_left, _shift_from_right, masked_linf
 from .mesh import AXIS_NAMES, shard_cells
 
 
@@ -631,8 +631,24 @@ def resolve_folded_engine(op: DistFoldedLaplacian) -> bool:
     return supports_dist_folded_engine(op)
 
 
+def resolve_folded_overlap(op: DistFoldedLaplacian) -> tuple[bool, str | None]:
+    """(supported, gate_reason) for the communication-overlapped folded
+    engine form — shared with the driver so the recorded form cannot
+    diverge from the routing."""
+    from .folded_cg import supports_dist_folded_overlap
+
+    if not resolve_folded_engine(op):
+        return False, ("overlap form rides the fused folded engine; the "
+                       "engine is unavailable here (per-shard input ring "
+                       "past MAX_RING_BLOCKS or non-f32)")
+    if not supports_dist_folded_overlap(op):
+        return False, "folded overlap plan gate"
+    return True, None
+
+
 def make_folded_sharded_fns(op: DistFoldedLaplacian, dgrid, nreps: int,
-                            engine: bool | None = None):
+                            engine: bool | None = None,
+                            overlap: bool = False):
     """Jittable sharded callables (apply, CG, norm) over folded shards —
     mirrors dist.driver.make_sharded_fns. The sharded per-shard arrays ride
     as one pytree argument; the operator's replicated metadata rides via
@@ -646,30 +662,38 @@ def make_folded_sharded_fns(op: DistFoldedLaplacian, dgrid, nreps: int,
     remains the driver's recorded compile-failure fallback. Both paths
     consume the same `sharded_state` tuple; per-iteration-invariant state
     (the geometry tuple, the owned-dof dot weight) is hoisted out of the
-    CG loop in both."""
+    CG loop in both.
+
+    `overlap=True` routes CG through the communication-overlapped engine
+    form (dist.folded_cg.dist_folded_cg_solve_local_overlap: carried
+    refreshed state, the forward refresh moved onto y off the next
+    kernel's critical path, ONE stacked psum per iteration) — requires
+    the engine; callers gate via resolve_folded_overlap and record the
+    form as `halo_overlap`."""
     from jax.sharding import PartitionSpec as P
 
     from ..la.cg import cg_solve
     from .folded_cg import (
         dist_folded_apply_ring_local,
         dist_folded_cg_solve_local,
+        dist_folded_cg_solve_local_overlap,
     )
+    from .halo import owned_dot
 
     spec = P(*AXIS_NAMES)
     rep = P()
     if engine is None:
         engine = resolve_folded_engine(op)
+    if overlap and not engine:
+        raise ValueError("the overlapped folded CG form rides the fused "
+                         "engine; pass engine=True (or let it resolve)")
 
     def _local(a):
         return jax.tree_util.tree_map(lambda x: x[0, 0, 0], a)
 
     def _dot(mask):
-        m = mask.astype(op.bc_mask.dtype)  # hoisted: cast once, not per dot
-
-        def dot(u, v):
-            return psum_all(jnp.sum(u * v * m))
-
-        return dot
+        # hoisted: cast once, not per dot
+        return owned_dot(mask.astype(op.bc_mask.dtype))
 
     def sharded_state(A):
         geom = A.G if A.G is not None else (A.corners, A.cmask)
@@ -702,7 +726,9 @@ def make_folded_sharded_fns(op: DistFoldedLaplacian, dgrid, nreps: int,
         bl = _local(b)
         sl = _local(state)  # hoisted: sliced once, reused every iteration
         if engine:
-            x = dist_folded_cg_solve_local(op, bl, sl, nreps)
+            solve = (dist_folded_cg_solve_local_overlap if overlap
+                     else dist_folded_cg_solve_local)
+            x = solve(op, bl, sl, nreps)
             return x[None, None, None]
         x = cg_solve(
             lambda v: op.apply_local(v, sl),

@@ -65,7 +65,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax import lax
 
-from ..la.cg import fused_cg_solve
+from ..la.cg import fused_cg_solve, onered_scalars
 from ..ops.folded import pallas_plan
 from ..ops.folded_cg import MAX_RING_BLOCKS, _cg_apply_call, ring_depth
 from ..ops.kron_cg import PALLAS_UPDATE_MIN_DOFS, cg_update_pallas
@@ -77,7 +77,7 @@ from .folded import (
     folded_halo_refresh,
     folded_reverse_scatter,
 )
-from .halo import _shift_from_left, psum_all
+from .halo import _shift_from_left, owned_dot, psum_all, psum_stack
 from .mesh import AXIS_NAMES
 
 
@@ -192,9 +192,8 @@ def dist_folded_cg_solve_local(op: DistFoldedLaplacian, b, state, nreps,
         y, dcorr = folded_reverse_scatter_dot(y, p, w, layout)
         return p, y, psum_all(jnp.sum(pdot) + dcorr)
 
-    def inner(u, v):
-        # owned-dof psum dot; w is hoisted state (no per-iteration cast)
-        return psum_all(jnp.sum(u * v * w))
+    # owned-dof psum dot; w is hoisted state (no per-iteration cast)
+    inner = owned_dot(w)
 
     update = None
     if b.size >= PALLAS_UPDATE_MIN_DOFS:
@@ -210,6 +209,100 @@ def dist_folded_cg_solve_local(op: DistFoldedLaplacian, b, state, nreps,
             return x1, r1, psum_all(rr - seam)
 
     return fused_cg_solve(engine, b, nreps, update=update, inner=inner)
+
+
+# ---------------------------------------------------------------------------
+# Communication-overlapped folded engine form. The folded layout keeps
+# ghosts structural (slots inside the vector), so "double buffering"
+# here means carrying the REFRESHED (r, p_prev) vectors across
+# iterations instead of refreshing them on the kernel's critical path:
+#
+#  - the per-iteration forward refresh moves from the kernel INPUT
+#    (r, p_prev — 2 channels, blocking the kernel) to the kernel OUTPUT
+#    y (1 channel, issued right after the reverse scatter); its only
+#    consumers are the r-update's ghost slots at the very end of the
+#    body, so the refresh overlaps the dot partials, the fused psum and
+#    the x update, and the NEXT kernel starts with its halo already
+#    resident;
+#  - the two psum'd dots fuse into ONE stacked psum of (<p, A p> kernel
+#    partials + the reverse-scatter dot correction, <r, y>, <y, y>) —
+#    the la.cg.onered_scalars recurrence supplies <r1, r1>.
+#
+# Ghost slots of r and p stay owner-consistent by f32 elementwise replay
+# (the in-kernel p-update and the elementwise r update apply identical
+# instructions at ghost and owner slots — the invariant the synchronous
+# form already pins); the refreshed y supplies the owner's seam-complete
+# value where the local partial would be wrong. Gated as engine form
+# `halo_overlap`; parity vs the synchronous folded engine <= 1e-7 rel
+# f32 (the reassociated residual-norm recurrence).
+# ---------------------------------------------------------------------------
+
+
+def supports_dist_folded_overlap(op: DistFoldedLaplacian) -> bool:
+    """Same plan as the synchronous folded engine: the overlap form runs
+    the identical kernel (halo form, update_p) plus one extra O(volume)
+    elementwise read pass for the fused dot trio."""
+    return supports_dist_folded_engine(op)
+
+
+def dist_folded_cg_solve_local_overlap(op: DistFoldedLaplacian, b, state,
+                                       nreps,
+                                       interpret: bool | None = None):
+    """Per-shard communication-overlapped fused folded CG (inside
+    shard_map): matches the synchronous engine
+    (dist_folded_cg_solve_local) to the single-reduction reassociation
+    envelope (<= 1e-7 rel f32) at one kernel pass, one reverse scatter,
+    one forward refresh (of y, off the next kernel's critical path) and
+    ONE stacked psum per iteration."""
+    layout = op.layout
+    geom, bc, w, _epi = state
+    phi0 = np.asarray(op.phi0_c, np.float64)
+    dphi1 = np.asarray(op.dphi1_c, np.float64)
+    apply_cg = partial(
+        _cg_apply_call, layout, geom, op.kappa, phi0, dphi1,
+        op.is_identity, op.geom_tables,
+    )
+    inner = owned_dot(w)
+    rnorm0 = inner(b, b)  # one psum, outside the loop
+    # the rhs is already owner-complete at owned slots; refresh once so
+    # the carried r starts ghost-consistent (the synchronous engine
+    # refreshes on every iteration's critical path instead)
+    r0 = folded_halo_refresh(b, layout)
+    big = b.size >= PALLAS_UPDATE_MIN_DOFS
+
+    def body(_, st):
+        x, r_h, p_prev_h, beta, rnorm = st
+        # kernel consumes the CARRIED refreshed state: no collective on
+        # its critical path; in-kernel p-update covers ghost slots by
+        # elementwise replay
+        p, y, pdk = apply_cg(True, interpret, r_h, p_prev_h, beta,
+                             masks=(bc, w))
+        y, dcorr = folded_reverse_scatter_dot(y, p, w, layout)
+        # the forward refresh moved here, onto y: issued before the
+        # psum, consumed only by the r update's ghost slots
+        y_r = folded_halo_refresh(y, layout)
+        # fused dot trio: owned slots only (w zeroes ghosts), so the
+        # pre-refresh y is correct and the dots do NOT wait on the
+        # refresh collective
+        yw = y * w
+        g = psum_stack(jnp.sum(pdk) + dcorr, jnp.sum(r_h * yw),
+                       jnp.sum(y * yw))
+        alpha, rnorm1, beta1 = onered_scalars(rnorm, g[0], g[1], g[2])
+        if big:
+            # chunked pallas x/r update (the XLA whole-vector fusion
+            # wall); its fused <r1,r1> is discarded — the overlap
+            # recurrence never reads it
+            x1, r1_h, _ = cg_update_pallas(x, p, r_h, y_r, alpha,
+                                           interpret)
+        else:
+            x1 = x + alpha * p
+            r1_h = r_h - alpha * y_r  # ghost slots replay the owner
+        return (x1, r1_h, p, beta1, rnorm1)
+
+    state0 = (jnp.zeros_like(b), r0, jnp.zeros_like(b),
+              jnp.zeros((), b.dtype), rnorm0)
+    x, *_ = lax.fori_loop(0, nreps, body, state0)
+    return x
 
 
 def dist_folded_apply_ring_local(op: DistFoldedLaplacian, x, state,

@@ -112,6 +112,62 @@ def masked_dot(a: jnp.ndarray, b: jnp.ndarray, mask: jnp.ndarray):
     return psum_all(local)
 
 
+# ---------------------------------------------------------------------------
+# Shared owned-dof psum dot factories. Every sharded family (kron, df,
+# folded) and every batched path used to hand-copy these closures; they
+# live here once so the masked-reduction convention (weight as a
+# multiplicative 0/1 array, ONE psum over all mesh axes) cannot drift
+# between families.
+# ---------------------------------------------------------------------------
+
+
+def owned_dot(weight: jnp.ndarray):
+    """Scalar owned-dof psum inner product over local blocks: `weight` is
+    the 0/1 ownership array (ghost planes / duplicated seams zero), cast
+    and closed over ONCE so no per-iteration cast rides the CG loop."""
+    def dot(u, v):
+        return psum_all(jnp.sum(u * v * weight))
+
+    return dot
+
+
+def owned_batched_dot(weight: jnp.ndarray):
+    """Batched twin of owned_dot over (nrhs, ...) lane stacks: per-lane
+    local reductions, then ONE psum carries the whole (nrhs,) vector —
+    per lane exactly the reference's MPI_Allreduce dot, amortised across
+    the batch."""
+    def dot(U, V):
+        return psum_all(jnp.sum(U * V * weight[None],
+                                axis=tuple(range(1, U.ndim))))
+
+    return dot
+
+
+def owned_dot3(weight: jnp.ndarray):
+    """Fused single-reduction dot trio (la.cg.stacked_dot3's distributed
+    twin): [<p,y>, <r,y>, <y,y>] over owned dofs in ONE stacked psum.
+    The fused ENGINES build their trio from the kernel's in-kernel
+    <p,Ap> partial via psum_stack instead; this closure is the
+    `cg_solve(dot3=)` / `cg_solve_batched(dot3=)` hook for the unfused
+    and batched sharded paths — property-tested today, wired into
+    production routing when the batched overlap form lands (ROADMAP
+    item 5 remainder)."""
+    def dot3(p, y, r):
+        yw = y * weight
+        return psum_all(jnp.stack([
+            jnp.sum(p * yw), jnp.sum(r * yw), jnp.sum(y * yw)
+        ]))
+
+    return dot3
+
+
+def psum_stack(*partials):
+    """ONE psum carrying several already-reduced local scalar partials
+    (the overlap engines stack the kernel's in-kernel <p, A p> partial
+    next to the locally-computed <r, y> / <y, y> partials)."""
+    return psum_all(jnp.stack([jnp.asarray(p) for p in partials]))
+
+
 def masked_linf(a: jnp.ndarray, mask: jnp.ndarray):
     """Global Linf over owned dofs (ghost planes excluded)."""
     local = jnp.max(jnp.abs(a) * mask.astype(a.dtype))

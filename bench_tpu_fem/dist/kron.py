@@ -301,8 +301,27 @@ def resolve_kron_engine(op: DistKronLaplacian) -> bool:
     return op.resolve_impl() == "pallas" and supports_dist_kron_engine(op)
 
 
+def resolve_kron_overlap(op: DistKronLaplacian) -> tuple[bool, str | None]:
+    """(supported, gate_reason) for the communication-overlapped engine
+    form (dist.kron_cg.dist_kron_cg_solve_local_overlap) — shared by the
+    driver so the recorded `cg_engine_form` and any gate reason cannot
+    diverge from the routing."""
+    from .kron_cg import supports_dist_kron_overlap
+
+    if not resolve_kron_engine(op):
+        return False, ("overlap form rides the fused engine; the engine "
+                       "is unavailable here (non-pallas impl or ring "
+                       "past every scoped-VMEM tier)")
+    if not supports_dist_kron_overlap(op):
+        return False, ("ext2d overlap keeps the whole-slab r update as "
+                       "one XLA pass; this shard is past the whole-"
+                       "vector fusion wall (PALLAS_UPDATE_MIN_DOFS)")
+    return True, None
+
+
 def make_kron_sharded_fns(op: DistKronLaplacian, dgrid, nreps: int,
-                          engine: bool | None = None):
+                          engine: bool | None = None,
+                          overlap: bool = False):
     """Jittable sharded callables (apply, CG, norm) over (Dx,Dy,Dz,Lx,Ly,Lz)
     grid blocks — same contract as dist.folded.make_folded_sharded_fns.
     The operator rides along as a replicated pytree argument.
@@ -313,11 +332,23 @@ def make_kron_sharded_fns(op: DistKronLaplacian, dgrid, nreps: int,
     engine. x-only meshes use the plane-halo kernel form; 3D meshes the
     ext2d form (cross-sections halo-extended too). The unfused 3-stage
     path (with its collective-independent main kernel) serves everything
-    else."""
+    else.
+
+    `overlap=True` routes CG through the communication-overlapped engine
+    form (dist.kron_cg.dist_kron_cg_solve_local_overlap: carried halo
+    state, one y-boundary ppermute off the critical path, ONE stacked
+    psum per iteration) — requires the engine; callers gate via
+    resolve_kron_overlap and record the form as `halo_overlap` /
+    `ext2d_overlap`."""
     from jax.sharding import PartitionSpec as P
 
     from ..la.cg import cg_solve
-    from .kron_cg import dist_kron_apply_ring_local, dist_kron_cg_solve_local
+    from .halo import owned_dot
+    from .kron_cg import (
+        dist_kron_apply_ring_local,
+        dist_kron_cg_solve_local,
+        dist_kron_cg_solve_local_overlap,
+    )
 
     spec = P(*AXIS_NAMES)
     rep = P()
@@ -327,12 +358,12 @@ def make_kron_sharded_fns(op: DistKronLaplacian, dgrid, nreps: int,
     vma = op.resolve_impl() != "pallas"
     if engine is None:
         engine = resolve_kron_engine(op)
+    if overlap and not engine:
+        raise ValueError("the overlapped kron CG form rides the fused "
+                         "engine; pass engine=True (or let it resolve)")
 
     def _local(a):
         return a[0, 0, 0]
-
-    def _dot(mask):
-        return lambda u, v: masked_dot(u, v, mask)
 
     @partial(jax.shard_map, mesh=dgrid.mesh, in_specs=(spec, rep),
              out_specs=spec, check_vma=False if engine else vma)
@@ -346,14 +377,16 @@ def make_kron_sharded_fns(op: DistKronLaplacian, dgrid, nreps: int,
     def cg_fn(b, A):
         bl = _local(b)
         if engine:
-            return dist_kron_cg_solve_local(A, bl, nreps)[None, None, None]
+            solve = (dist_kron_cg_solve_local_overlap if overlap
+                     else dist_kron_cg_solve_local)
+            return solve(A, bl, nreps)[None, None, None]
         coeffs = A.local_coeffs()  # hoisted: sliced once, reused every iter
         x = cg_solve(
             lambda v: A.apply_local(v, coeffs),
             bl,
             jnp.zeros_like(bl),
             nreps,
-            dot=_dot(owned_mask(bl.shape)),
+            dot=owned_dot(owned_mask(bl.shape).astype(bl.dtype)),
         )
         return x[None, None, None]
 
@@ -383,7 +416,7 @@ def make_kron_batched_cg_fn(op: DistKronLaplacian, dgrid, nreps: int):
     from jax.sharding import PartitionSpec as P
 
     from ..la.cg import cg_solve_batched
-    from .halo import psum_all
+    from .halo import owned_batched_dot
 
     bspec = P(None, *AXIS_NAMES)
     rep = P()
@@ -395,13 +428,9 @@ def make_kron_batched_cg_fn(op: DistKronLaplacian, dgrid, nreps: int):
         coeffs = A.local_coeffs()  # hoisted: sliced once, shared by lanes
         mask = owned_mask(Bl.shape[1:]).astype(Bl.dtype)
 
-        def bdot(U, V):
-            return psum_all(jnp.sum(U * V * mask[None],
-                                    axis=tuple(range(1, U.ndim))))
-
         X = cg_solve_batched(
             lambda v: A.apply_local(v, coeffs), Bl,
-            jnp.zeros_like(Bl), nreps, dot=bdot,
+            jnp.zeros_like(Bl), nreps, dot=owned_batched_dot(mask),
         )
         return X[:, None, None, None]
 

@@ -62,7 +62,9 @@ from __future__ import annotations
 import jax.numpy as jnp
 from jax import lax
 
-from ..la.cg import fused_cg_solve
+import numpy as np
+
+from ..la.cg import fused_cg_solve, onered_scalars
 from ..ops.kron_cg import (
     PALLAS_UPDATE_MIN_DOFS,
     _cx_rows,
@@ -70,7 +72,7 @@ from ..ops.kron_cg import (
     cg_update_pallas,
     engine_plan,
 )
-from .halo import psum_all
+from .halo import owned_dot, psum_all, psum_stack
 from .kron import DistKronLaplacian, halo_slabs
 from .mesh import AXIS_NAMES
 
@@ -245,8 +247,7 @@ def dist_kron_cg_solve_local(op: DistKronLaplacian, b, nreps: int,
 
     # owned-dof weight for the masked psum inner products (the same
     # ownership the kernel's dot weighting applies to <p, A p>)
-    def inner(u, v):
-        return psum_all(jnp.sum(u * v * w3))
+    inner = owned_dot(w3)
 
     update = None
     if b.size >= PALLAS_UPDATE_MIN_DOFS:
@@ -269,6 +270,150 @@ def dist_kron_cg_solve_local(op: DistKronLaplacian, b, nreps: int,
             return x1, r1, psum_all(rr - seam)
 
     return fused_cg_solve(engine, b, nreps, update=update, inner=inner)
+
+
+# ---------------------------------------------------------------------------
+# Communication-overlapped (double-buffered halo) engine form.
+#
+# The synchronous engine above puts BOTH collectives on the iteration's
+# critical path: the (r, p_prev) halo exchange feeds the kernel, and two
+# psum'd dots serialize against the updates. The overlap form
+# restructures the loop around a carried halo-extended state:
+#
+#  - DOUBLE-BUFFERED HALO: the loop carries (r_ext, p_prev_ext) —
+#    already halo-extended slabs. The iteration's ONLY ppermute is the
+#    exchange of the fresh operator output y's boundary planes, issued
+#    immediately after the kernel; its sole consumer is the O(fringe)
+#    tail of the r update (r1_ext = r_ext - alpha * y_ext), so XLA can
+#    run the exchange behind the dot partials, the psum, and the whole
+#    x update — and the NEXT iteration's kernel input needs no exchange
+#    at all (the halo for apply k+1 is in flight while iteration k's
+#    interior compute runs).
+#  - SINGLE-PSUM ITERATIONS: the two reductions fuse into one stacked
+#    psum of (<p, A p>, <r, y>, <y, y>) — <r1, r1> follows from the
+#    la.cg.onered_scalars recurrence. The kernel's in-kernel owned-
+#    weighted <p, A p> partial rides the same stack.
+#
+# The p-update moves OUT of the kernel (p_ext = beta * p_prev_ext +
+# r_ext, one fused elementwise pass over the extended slab) so the ghost
+# fringe replays the owner's arithmetic elementwise — XLA applies the
+# identical instruction to every element of one fused op, so fringe and
+# seam values stay bitwise consistent across shards, exactly the replay
+# invariant the synchronous form pins. Cost accounting (the deliberate
+# trade): one extra O(volume) elementwise stream (the externalised
+# p-update) and one extra fused read pass for <r, y>/<y, y>, against one
+# fewer psum per iteration and every halo exchange moved off the
+# critical path. At pod scale and fixed local size the collective
+# latency dominates those streams; the weak-scaling harness
+# (scripts/weak_scaling.py) measures exactly this A/B and the CPU lane
+# proves parity + the collective-count invariant today. Gated as engine
+# forms `halo_overlap` / `ext2d_overlap`; parity vs the synchronous
+# oracle <= 1e-7 rel f32 (the reassociated residual-norm recurrence).
+# ---------------------------------------------------------------------------
+
+
+def supports_dist_kron_overlap(op: DistKronLaplacian) -> bool:
+    """The overlap form shares the synchronous engine's ring plan; the
+    ext2d variant additionally keeps its whole-slab r update as one XLA
+    elementwise pass (no chunked-update route on the 3D fringe yet), so
+    shards at the XLA whole-vector fusion wall fall back to the
+    synchronous engine with the reason recorded by the driver."""
+    if not supports_dist_kron_engine(op):
+        return False
+    if _is_x_only(op):
+        return True
+    return int(np.prod(op.L)) < PALLAS_UPDATE_MIN_DOFS
+
+
+def _extend_arrs(arrs, op: DistKronLaplacian):
+    """Halo-extend arrays for the kernel-input slab of the active form:
+    x-only meshes extend along x only (one stacked ppermute pair); 3D
+    meshes extend every axis (the sequential-corner construction)."""
+    P = op.degree
+    if _is_x_only(op):
+        s = jnp.stack(arrs)  # x axis is 1 in the stacked view
+        hl, hr = halo_slabs(s, 1, AXIS_NAMES[0], P)
+        s = jnp.concatenate([hl, s, hr], axis=1)
+        return tuple(s[i] for i in range(len(arrs)))
+    return _extend_all_axes(arrs, P, op.dshape)
+
+
+def _interior(v, op: DistKronLaplacian):
+    """Local (Lx, Ly, Lz) block of a halo-extended slab."""
+    P = op.degree
+    if _is_x_only(op):
+        return lax.slice_in_dim(v, P, P + op.L[0], axis=0)
+    for ax in range(3):
+        v = lax.slice_in_dim(v, P, P + op.L[ax], axis=ax)
+    return v
+
+
+def dist_kron_cg_solve_local_overlap(op: DistKronLaplacian, b, nreps: int,
+                                     interpret: bool | None = None):
+    """Per-shard communication-overlapped fused-engine CG (inside
+    shard_map): carried halo-extended (r, p_prev) state, one y-boundary
+    ppermute per iteration off the critical path, ONE stacked psum per
+    iteration. Matches the synchronous engine
+    (dist_kron_cg_solve_local) to the single-reduction reassociation
+    envelope (<= 1e-7 rel f32). x-only meshes use the plane-halo kernel
+    form; 3D meshes the ext2d form."""
+    dtype = b.dtype
+    P = op.degree
+    x_only = _is_x_only(op)
+    if x_only:
+        cx_local, aux_local = _shard_tables(op, dtype)
+        w3 = aux_local[:, 0, 1][:, None, None]
+        kw = dict(cx=cx_local, aux=aux_local)
+    else:
+        cx_local, aux_local, coeffs, mask2d, w2d = _shard_tables_3d(
+            op, dtype)
+        w3 = aux_local[:, 0, 1][:, None, None] * w2d[None]
+        kw = dict(cx=cx_local, aux=aux_local, coeffs=coeffs,
+                  mask2d=mask2d, w2d=w2d)
+
+    rnorm0 = owned_dot(w3)(b, b)  # one psum, outside the loop
+    (r_ext0,) = _extend_arrs((b,), op)
+    # chunked pallas x/r update above the shared size policy (x-only
+    # meshes: the fringe planes update elementwise and the local block
+    # rides the pallas pass, its fused <r1,r1> discarded — the overlap
+    # recurrence never reads it)
+    big = x_only and b.size >= PALLAS_UPDATE_MIN_DOFS
+
+    def body(_, state):
+        x, r_ext, p_prev_ext, beta, rnorm = state
+        # externalised p-update: one fused elementwise pass over the
+        # extended slab (fringe replays the owner's arithmetic)
+        p_ext = beta * p_prev_ext + r_ext
+        y, pd = _kron_cg_call(op, False, interpret, p_ext, **kw)
+        # the ONLY exchange of the iteration: y's boundary planes for
+        # the NEXT apply's halo — consumed solely by the r-update tail,
+        # so it overlaps the dots, the psum and the x update
+        (y_ext,) = _extend_arrs((y,), op)
+        r_loc = _interior(r_ext, op)
+        p_loc = _interior(p_ext, op)
+        yw = y * w3
+        g = psum_stack(pd, jnp.sum(r_loc * yw), jnp.sum(y * yw))
+        alpha, rnorm1, beta1 = onered_scalars(rnorm, g[0], g[1], g[2])
+        if big:
+            x1, r1_loc, _ = cg_update_pallas(x, p_loc, r_loc, y, alpha,
+                                             interpret)
+            Le = r_ext.shape[0]
+            r1_ext = jnp.concatenate([
+                lax.slice_in_dim(r_ext, 0, P, axis=0)
+                - alpha * lax.slice_in_dim(y_ext, 0, P, axis=0),
+                r1_loc,
+                lax.slice_in_dim(r_ext, Le - P, Le, axis=0)
+                - alpha * lax.slice_in_dim(y_ext, Le - P, Le, axis=0),
+            ], axis=0)
+        else:
+            x1 = x + alpha * p_loc
+            r1_ext = r_ext - alpha * y_ext
+        return (x1, r1_ext, p_ext, beta1, rnorm1)
+
+    state = (jnp.zeros_like(b), r_ext0, jnp.zeros_like(r_ext0),
+             jnp.zeros((), dtype), rnorm0)
+    x, *_ = lax.fori_loop(0, nreps, body, state)
+    return x
 
 
 def dist_kron_apply_ring_local(op: DistKronLaplacian, x,

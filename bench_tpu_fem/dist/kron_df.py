@@ -352,6 +352,32 @@ def df_psum_all(s: DF, dshape) -> DF:
     return acc
 
 
+def df_psum_all_stacked(parts, dshape):
+    """Compensated cross-shard fold of SEVERAL scalar DF partials in ONE
+    collective per sharded axis — the df analogue of the overlap form's
+    single stacked psum. All partials' (hi, lo) channels ride a single
+    stacked all-gather payload (a separate df_psum_all per dot would run
+    one gather chain each), then each partial folds in the same fixed
+    order as df_psum_all: deterministic, identical on all shards, and
+    compensated end to end. Returns a tuple of DF scalars."""
+    k = len(parts)
+    flat = jnp.stack(
+        [c.reshape(()) for p in parts for c in (p.hi, p.lo)]
+    ).reshape(1, 2 * k)
+    for name, d in zip(AXIS_NAMES, dshape):
+        if d == 1:
+            continue
+        flat = lax.all_gather(flat, name, axis=0, tiled=True)
+    n = flat.shape[0]
+    out = []
+    for i in range(k):
+        acc = DF(flat[0, 2 * i], flat[0, 2 * i + 1])
+        for j in range(1, n):
+            acc = df_add(acc, DF(flat[j, 2 * i], flat[j, 2 * i + 1]))
+        out.append(acc)
+    return tuple(out)
+
+
 def df_dot_dist(a: DF, b: DF, mask, dshape) -> DF:
     """Owned-dof-masked df inner product with the compensated cross-shard
     reduction (the df analogue of dist.halo.masked_dot)."""
@@ -417,8 +443,26 @@ def resolve_df_engine(op: DistKronLaplacianDF) -> bool:
             and supports_dist_df_engine(op))
 
 
+def resolve_df_overlap(op: DistKronLaplacianDF) -> tuple[bool, str | None]:
+    """(supported, gate_reason) for the overlapped df engine form —
+    shared with the driver so the recorded form cannot diverge from the
+    routing."""
+    from .kron_cg_df import supports_dist_df_overlap
+
+    if not resolve_df_engine(op):
+        return False, ("overlap form rides the fused df engine; the "
+                       "engine is unavailable here (non-TPU backend or "
+                       "ring past every scoped-VMEM tier)")
+    if not supports_dist_df_overlap(op):
+        return False, ("df overlap keeps the whole-slab df r update as "
+                       "one XLA pass; this shard is past the whole-"
+                       "vector fusion wall (PALLAS_UPDATE_MIN_DOFS)")
+    return True, None
+
+
 def make_kron_df_sharded_fns(op: DistKronLaplacianDF, dgrid, nreps: int,
-                             engine: bool | None = None):
+                             engine: bool | None = None,
+                             overlap: bool = False):
     """Jittable sharded callables over DF grid blocks (hi/lo each
     (Dx,Dy,Dz,Lx,Ly,Lz)): (apply, CG, l2norm) — the df twin of
     dist.kron.make_kron_sharded_fns.
@@ -428,7 +472,14 @@ def make_kron_df_sharded_fns(op: DistKronLaplacianDF, dgrid, nreps: int,
     ring fits a scoped-VMEM tier — any dshape (x-only meshes take the
     plane-halo kernel form, 3D meshes the ext2d form); the unfused df
     stage/halo path serves everything else and remains the
-    compile-failure fallback."""
+    compile-failure fallback.
+
+    `overlap=True` routes CG through the communication-overlapped df
+    engine form (dist.kron_cg_df.dist_kron_df_cg_solve_local_overlap:
+    carried halo state, one y exchange off the critical path, ONE
+    stacked compensated fold per iteration) — requires the engine;
+    callers gate via resolve_df_overlap and record the form as
+    `halo_overlap` / `ext2d_overlap`."""
     from jax.sharding import PartitionSpec as P
 
     spec = P(*AXIS_NAMES)
@@ -447,6 +498,9 @@ def make_kron_df_sharded_fns(op: DistKronLaplacianDF, dgrid, nreps: int,
                 "the fused dist df engine needs a VMEM-tier-fitting "
                 f"ring (dshape {op.dshape}, local {op.L})"
             )
+    if overlap and not engine:
+        raise ValueError("the overlapped df CG form rides the fused "
+                         "engine; pass engine=True (or let it resolve)")
 
     def _local(a):
         return DF(a.hi[0, 0, 0], a.lo[0, 0, 0])
@@ -467,9 +521,14 @@ def make_kron_df_sharded_fns(op: DistKronLaplacianDF, dgrid, nreps: int,
              out_specs=spec, check_vma=not engine)
     def cg_fn(b, A):
         if engine:
-            from .kron_cg_df import dist_kron_df_cg_solve_local
+            from .kron_cg_df import (
+                dist_kron_df_cg_solve_local,
+                dist_kron_df_cg_solve_local_overlap,
+            )
 
-            return _wrap(dist_kron_df_cg_solve_local(A, _local(b), nreps))
+            solve = (dist_kron_df_cg_solve_local_overlap if overlap
+                     else dist_kron_df_cg_solve_local)
+            return _wrap(solve(A, _local(b), nreps))
         return _wrap(dist_cg_solve_df_local(A, _local(b), nreps))
 
     # check_vma off: the gathered compensated fold is genuinely replicated

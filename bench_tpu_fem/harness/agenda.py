@@ -259,6 +259,7 @@ print("PERTDF12.5M:", res.gdof_per_second, res.extra,
 """
 
 FOLDENG = """
+import dataclasses
 import jax, jax.numpy as jnp
 from bench_tpu_fem.bench.driver import BenchConfig, BenchmarkResults
 from bench_tpu_fem.dist.driver import run_distributed
@@ -268,9 +269,17 @@ cfg = BenchConfig(ndofs_global=12_500_000, degree=3, qmode=1,
 res = BenchmarkResults(nreps=cfg.nreps)
 run_distributed(cfg, res, jnp.float32)
 print("FOLDENG:", res.gdof_per_second, res.extra, "ynorm", res.ynorm)
-# loud on routing drift: an unfused fallback here would otherwise make
+# loud on routing drift: the overlap form engages by default on the
+# folded engine (ISSUE 7); an unfused fallback here would otherwise make
 # the A/B below compare unfused vs unfused (the reason is in the extras)
-assert res.extra.get("cg_engine_form") == "halo", res.extra
+assert res.extra.get("cg_engine_form") == "halo_overlap", res.extra
+res_sync = BenchmarkResults(nreps=cfg.nreps)
+run_distributed(dataclasses.replace(cfg, overlap="off"), res_sync,
+                jnp.float32)
+print("FOLDENG-SYNC:", res_sync.gdof_per_second, res_sync.extra,
+      "ynorm", res_sync.ynorm, "overlap_speedup:",
+      res.gdof_per_second / max(res_sync.gdof_per_second, 1e-12))
+assert res_sync.extra.get("cg_engine_form") == "halo", res_sync.extra
 import bench_tpu_fem.dist.folded_cg as DFC
 DFC.dist_folded_engine_plan = lambda op: (False, None)
 res2 = BenchmarkResults(nreps=cfg.nreps)
@@ -298,7 +307,8 @@ res = BenchmarkResults(nreps=cfg.nreps)
 run_distributed_df64(cfg, res)
 print("DFEXT2D", tag, ":", res.gdof_per_second, res.extra,
       "ynorm", res.ynorm)
-assert res.extra.get("cg_engine_form") == "ext2d", res.extra
+# overlap engages by default on the df engine (ISSUE 7)
+assert res.extra.get("cg_engine_form") == "ext2d_overlap", res.extra
 """
 
 
@@ -416,6 +426,13 @@ def make_stages(round_tag: str = DEFAULT_ROUND) -> dict[str, Stage]:
         _py("pertdf", PERTDF, 2400, gate="dfacc"),
         _py("foldeng", FOLDENG, 2400),
         _py("dfext2d", DFEXT2D, 2400, gate="dfacc"),
+        # Weak scaling with overlap A/B (ISSUE 7): fixed 2M local dofs
+        # swept over the available device mesh, journaled GDoF/s +
+        # per-iteration collective counts per overlap arm. Armed for
+        # hardware; the CPU lane proves parity and the one-psum
+        # invariant via `--smoke` in CI (multihost gloo lane).
+        _script("scale", ["scripts/weak_scaling.py", "--local-dofs",
+                          "2000000", "--nreps", "200"], 2400),
         _py("dfeng", _bench_code("DFENG12.5M:", dict(
             ndofs_global=12_500_000, degree=3, qmode=1, float_bits=64,
             nreps=200, use_cg=True, f64_impl="df32"),
@@ -488,8 +505,8 @@ ALIASES = {
 # (measure_all's ordering, expanded through ALIASES).
 AGENDAS = {
     "round6": ["health", "serve", "fusedbatch", "dfacc", "pertdf",
-               "foldeng", "dfext2d", "dfeng", "bench", "dflarge",
-               "pert100", "deg7probe", "matrix"],
+               "foldeng", "dfext2d", "scale", "dfeng", "bench",
+               "dflarge", "pert100", "deg7probe", "matrix"],
 }
 
 

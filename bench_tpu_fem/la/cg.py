@@ -23,6 +23,67 @@ import jax.numpy as jnp
 from .vector import inner_product
 
 
+# ---------------------------------------------------------------------------
+# Single-reduction (fused-psum) recurrence: the communication-overlap CG
+# forms replace the iteration's TWO global reductions (<p, A p> for
+# alpha, then <r1, r1> for beta) with ONE fused reduction of the trio
+# (<p, y>, <r, y>, <y, y>) computed right after the operator apply —
+# <r1, r1> follows algebraically from r1 = r - alpha y:
+#
+#     <r1, r1> = <r, r> - 2 alpha <r, y> + alpha^2 <y, y>
+#
+# so all three partials are known BEFORE alpha and can ride one stacked
+# psum per iteration (the reference's two MPI_Allreduce calls per
+# iteration, cg.hpp:120-141, halved). The recurrence reassociates the
+# residual-norm computation, so it is gated as a distinct engine form
+# with measured parity bounds against the two-reduction oracle (<= 1e-7
+# rel f32, <= 1e-13 df-class over the benchmark iteration budgets).
+# ---------------------------------------------------------------------------
+
+
+def onered_scalars(rnorm, pdot, ry, yy):
+    """(alpha, rnorm1, beta1) of the single-reduction recurrence from the
+    fused dot trio. rnorm1 is clamped at zero: near the f32 residual
+    floor the reassociated form can cancel below zero, and a zero rnorm1
+    (beta1 = 0, i.e. a steepest-descent restart) is the graceful
+    degradation — the two-reduction oracle hits its own floor there."""
+    alpha = rnorm / pdot
+    rnorm1 = jnp.maximum(
+        rnorm - alpha * (2.0 * ry - alpha * yy),
+        jnp.zeros((), rnorm.dtype),
+    )
+    return alpha, rnorm1, rnorm1 / rnorm
+
+
+def onered_scalars_df(rnorm, pdot, ry, yy):
+    """df twin of onered_scalars: the same fused-reduction recurrence in
+    compensated (hi, lo) arithmetic. The clamp guards the hi channel
+    only (a negative hi at the df floor is the same cancellation mode)."""
+    from .df64 import DF, df_div, df_mul, df_sub
+
+    alpha = df_div(rnorm, pdot)
+    two_ry = DF(2.0 * ry.hi, 2.0 * ry.lo)  # exact: power-of-two scale
+    corr = df_mul(alpha, df_sub(two_ry, df_mul(alpha, yy)))
+    rnorm1 = df_sub(rnorm, corr)
+    pos = rnorm1.hi > 0
+    rnorm1 = DF(jnp.where(pos, rnorm1.hi, jnp.zeros((), rnorm1.hi.dtype)),
+                jnp.where(pos, rnorm1.lo, jnp.zeros((), rnorm1.lo.dtype)))
+    return alpha, rnorm1, df_div(rnorm1, rnorm)
+
+
+def stacked_dot3(p: jnp.ndarray, y: jnp.ndarray,
+                 r: jnp.ndarray) -> jnp.ndarray:
+    """Single-chip fused dot trio [<p,y>, <r,y>, <y,y>] as one stacked
+    (3,) reduction — the `dot3` contract of `cg_solve(..., dot3=)`. The
+    distributed layer's owned-dof-masked psum twin is
+    dist.halo.owned_dot3 (the fused engines instead stack the kernel's
+    in-kernel <p,Ap> partial via dist.halo.psum_stack; the dot3 hooks
+    serve the unfused/batched sharded paths, production-wired when the
+    batched overlap form lands)."""
+    return jnp.stack([inner_product(p, y), inner_product(r, y),
+                      inner_product(y, y)])
+
+
 def cg_solve(
     apply_A: Callable[[jnp.ndarray], jnp.ndarray],
     b: jnp.ndarray,
@@ -30,10 +91,17 @@ def cg_solve(
     max_iter: int,
     rtol: float = 0.0,
     dot: Callable[[jnp.ndarray, jnp.ndarray], jnp.ndarray] | None = None,
+    dot3: Callable | None = None,
 ) -> jnp.ndarray:
     """Solve A x = b; returns x after `max_iter` iterations (rtol=0) or until
     ||r||/||r0|| < rtol. Early termination freezes the state rather than
-    exiting the loop, keeping the iteration count static for XLA."""
+    exiting the loop, keeping the iteration count static for XLA.
+
+    With `dot3(p, y, r) -> (3,) [<p,y>, <r,y>, <y,y>]` given, the loop
+    runs the single-reduction recurrence (see onered_scalars): one fused
+    reduction per iteration instead of two — the distributed overlap
+    form's psum-count contract. Reassociated; parity vs the default
+    two-reduction loop is <= 1e-7 rel (f32) over benchmark budgets."""
     if dot is None:
         dot = inner_product
 
@@ -45,11 +113,17 @@ def cg_solve(
     def body(_, state):
         x, r, p, rnorm, done = state
         y = apply_A(p)
-        alpha = rnorm / dot(p, y)
-        x1 = x + alpha * p
-        r1 = r - alpha * y
-        rnorm_new = dot(r1, r1)
-        beta = rnorm_new / rnorm
+        if dot3 is None:
+            alpha = rnorm / dot(p, y)
+            x1 = x + alpha * p
+            r1 = r - alpha * y
+            rnorm_new = dot(r1, r1)
+            beta = rnorm_new / rnorm
+        else:
+            pdot, ry, yy = dot3(p, y, r)
+            alpha, rnorm_new, beta = onered_scalars(rnorm, pdot, ry, yy)
+            x1 = x + alpha * p
+            r1 = r - alpha * y
         p1 = beta * p + r1
         new_done = jnp.logical_or(done, rnorm_new / rnorm0 < rtol * rtol)
         keep = lambda new, old: jnp.where(done, old, new)
@@ -82,6 +156,16 @@ def _bcast(flag: jnp.ndarray, like: jnp.ndarray) -> jnp.ndarray:
     return flag.reshape((-1,) + (1,) * (like.ndim - 1))
 
 
+def batched_dot3(P: jnp.ndarray, Y: jnp.ndarray,
+                 R: jnp.ndarray) -> jnp.ndarray:
+    """Batched fused dot trio: (3, nrhs) stack of per-lane [<p,y>, <r,y>,
+    <y,y>] — the `dot3` contract of `cg_solve_batched(..., dot3=)`. One
+    reduction pass; the distributed twin psums the whole (3, nrhs) block
+    in one collective."""
+    return jnp.stack([batched_dot(P, Y), batched_dot(R, Y),
+                      batched_dot(Y, Y)])
+
+
 def cg_solve_batched(
     apply_A: Callable[[jnp.ndarray], jnp.ndarray],
     B: jnp.ndarray,
@@ -90,6 +174,7 @@ def cg_solve_batched(
     rtol: float = 0.0,
     dot: Callable[[jnp.ndarray, jnp.ndarray], jnp.ndarray] | None = None,
     batch_apply: Callable[[jnp.ndarray], jnp.ndarray] | None = None,
+    dot3: Callable | None = None,
 ) -> jnp.ndarray:
     """Multi-RHS CG over a (nrhs, ...) stack: solve A x_i = b_i for every
     RHS in ONE static loop — the serving-layer batch primitive (each
@@ -109,7 +194,13 @@ def cg_solve_batched(
     All-zero RHS lanes (the batching window's padding) start frozen:
     they return X0 untouched and their 0/0 alpha never contaminates the
     live lanes (`keep` discards the dead lanes' arithmetic every
-    iteration)."""
+    iteration).
+
+    With `dot3(P, Y, R) -> (3, nrhs)` given, the loop runs the
+    single-reduction recurrence (onered_scalars, vectorised per lane):
+    ONE fused reduction carries all lanes' three dots per iteration —
+    the batched analogue of the distributed overlap form's one-psum
+    contract (same reassociation, same parity envelope)."""
     if dot is None:
         dot = batched_dot
     if batch_apply is None:
@@ -125,11 +216,17 @@ def cg_solve_batched(
     def body(_, state):
         X, R, P, rnorm, done = state
         Y = batch_apply(P)
-        alpha = rnorm / dot(P, Y)
-        X1 = X + _bcast(alpha, X) * P
-        R1 = R - _bcast(alpha, R) * Y
-        rnorm_new = dot(R1, R1)
-        beta = rnorm_new / rnorm
+        if dot3 is None:
+            alpha = rnorm / dot(P, Y)
+            X1 = X + _bcast(alpha, X) * P
+            R1 = R - _bcast(alpha, R) * Y
+            rnorm_new = dot(R1, R1)
+            beta = rnorm_new / rnorm
+        else:
+            pdot, ry, yy = dot3(P, Y, R)
+            alpha, rnorm_new, beta = onered_scalars(rnorm, pdot, ry, yy)
+            X1 = X + _bcast(alpha, X) * P
+            R1 = R - _bcast(alpha, R) * Y
         P1 = _bcast(beta, P) * P + R1
         new_done = jnp.logical_or(done, rnorm_new / rnorm0 < rtol * rtol)
 

@@ -14,6 +14,8 @@ from .dofmap import (
     dof_grid_shape,
     boundary_dof_marker,
     dof_coordinates,
+    global_ncells,
+    global_ndofs,
 )
 
 __all__ = [
@@ -24,4 +26,6 @@ __all__ = [
     "dof_grid_shape",
     "boundary_dof_marker",
     "dof_coordinates",
+    "global_ncells",
+    "global_ndofs",
 ]

@@ -21,19 +21,44 @@ def dof_grid_shape(n: tuple[int, int, int], degree: int) -> tuple[int, int, int]
     return tuple(int(ni) * degree + 1 for ni in n)
 
 
+def global_ndofs(n: tuple[int, int, int], degree: int) -> int:
+    """Exact global dof count as a Python int. The weak-scaling sweep
+    crosses 2^31 global dofs (billions at pod scale), where a numpy
+    product can silently wrap on platforms whose default integer is
+    int32 — every driver/artifact dof count routes through this instead
+    of `np.prod(dof_grid_shape(...))`."""
+    out = 1
+    for s in dof_grid_shape(n, degree):
+        out *= int(s)
+    return out
+
+
+def global_ncells(n: tuple[int, int, int]) -> int:
+    """Exact global cell count as a Python int (same overflow rationale
+    as global_ndofs)."""
+    out = 1
+    for ni in n:
+        out *= int(ni)
+    return out
+
+
 def cell_dofmap(n: tuple[int, int, int], degree: int) -> np.ndarray:
     """(ncells, (P+1)^3) int32 dofmap; cells in (cx, cy, cz) row-major order,
     local dofs in (i, j, k) row-major order."""
     nx, ny, nz = n
     NX, NY, NZ = dof_grid_shape(n, degree)
     nd = degree + 1
-    gx = (np.arange(nx) * degree)[:, None] + np.arange(nd)[None, :]  # (nx, nd)
-    gy = (np.arange(ny) * degree)[:, None] + np.arange(nd)[None, :]
-    gz = (np.arange(nz) * degree)[:, None] + np.arange(nd)[None, :]
+    # int64 throughout: numpy's default integer is int32 on some
+    # platforms, and the per-term products (gy * NZ, gx * NY * NZ) can
+    # wrap before the final promotion at > 2^31 global dofs
+    ar = lambda k: np.arange(k, dtype=np.int64)  # noqa: E731
+    gx = (ar(nx) * degree)[:, None] + ar(nd)[None, :]  # (nx, nd)
+    gy = (ar(ny) * degree)[:, None] + ar(nd)[None, :]
+    gz = (ar(nz) * degree)[:, None] + ar(nd)[None, :]
     # dof id = gx*NY*NZ + gy*NZ + gz, broadcast to (nx,ny,nz,nd,nd,nd)
     ids = (
-        gx[:, None, None, :, None, None].astype(np.int64) * (NY * NZ)
-        + gy[None, :, None, None, :, None] * NZ
+        gx[:, None, None, :, None, None] * np.int64(NY * NZ)
+        + gy[None, :, None, None, :, None] * np.int64(NZ)
         + gz[None, None, :, None, None, :]
     )
     if ids.max() > np.iinfo(np.int32).max:

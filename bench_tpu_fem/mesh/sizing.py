@@ -4,6 +4,15 @@ Behavioural parity with `benchdolfinx::compute_mesh_size`
 (/root/reference/src/mesh.cpp:117-152): start from the cube-root estimate and
 brute-force search +/-5 cells in each direction for the best fit of
 (nx*p+1)(ny*p+1)(nz*p+1) to ndofs_global.
+
+int32-overflow audit (ISSUE 7 — the weak-scaling sweep crosses 2^31
+global dofs): every intermediate here is either a Python int (arbitrary
+precision) or an explicitly `int64` numpy array — the candidate arrays
+pin `dtype=np.int64` rather than trusting numpy's platform-default
+integer (int32 on some hosts), so the (ndx*ndy*ndz - ndofs_global)
+misfit stays exact at multi-billion-dof targets (regression-tested to
+19B dofs in tests/test_overlap_cg.py). Exact dof/cell COUNTS for
+drivers/artifacts live in mesh.dofmap.global_ndofs/global_ncells.
 """
 
 from __future__ import annotations

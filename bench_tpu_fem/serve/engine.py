@@ -204,7 +204,7 @@ class CompiledSolver:
 
         from ..elements.tables import build_operator_tables
         from ..mesh.box import create_box_mesh
-        from ..mesh.dofmap import dof_grid_shape
+        from ..mesh.dofmap import dof_grid_shape, global_ndofs
         from ..mesh.sizing import compute_mesh_size
         from ..utils.compilation import compile_lowered
 
@@ -212,7 +212,7 @@ class CompiledSolver:
         n = compute_mesh_size(spec.ndofs, spec.degree)
         t = build_operator_tables(spec.degree, 1, "gll")
         mesh = create_box_mesh(n, geom_perturb_fact=spec.geom_perturb_fact)
-        self.ndofs_global = int(np.prod(dof_grid_shape(n, spec.degree)))
+        self.ndofs_global = global_ndofs(n, spec.degree)
 
         # Host-assembled f64 RHS (the canonical benchmark problem: the
         # drivers assemble the same b), scaled per lane at solve time.

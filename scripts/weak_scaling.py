@@ -1,0 +1,243 @@
+#!/usr/bin/env python
+"""Weak-scaling harness: sweep the device mesh at FIXED local DoFs and
+measure the communication-overlapped sharded CG engines A/B against the
+synchronous forms (ISSUE 7, ROADMAP item 5 — the paper's own scaling
+axis: one rank per device, ghost exchange + allreduce per iteration,
+GDoF/s at billions of global DoFs).
+
+Per sweep point (device count d, dshape = factor_devices(d), global
+dofs = local_dofs * d) the script builds the sharded f32 kron operator,
+runs CG with `overlap` off and on (engine forms `halo`/`ext2d` vs
+`halo_overlap`/`ext2d_overlap`), and journals one `weak_scaling` record
+each:
+
+    {"event": "weak_scaling", "round": ..., "devices": d,
+     "dshape": [...], "ndofs_global": ..., "local_dofs": ...,
+     "degree": ..., "nreps": ..., "overlap": bool, "engine_form": ...,
+     "gdof_s": ..., "elapsed_s": ..., "ynorm": ...,
+     "collectives_per_iter": {"psum": ..., "ppermute": ..., ...},
+     "backend": "cpu"|"tpu", "measured": "cpu-interpret"|"hardware"}
+
+The per-iteration collective counts come from a TRACE-level walk of the
+CG loop body (analysis.capture.loop_collective_counts) — the overlapped
+form must show exactly ONE psum per iteration, the synchronous form two.
+That invariant plus overlap-vs-sync solution parity is what the CPU lane
+(--smoke, also launched 2-process over gloo by tests/test_multihost.py)
+proves today; the same script on a TPU pod is the armed `scale` agenda
+stage (GDoF/s columns become hardware evidence the moment the tunnel
+lives — until then every CPU number is labelled `cpu-interpret`, never a
+throughput claim).
+
+Multihost: launch one process per host with the standard coordinator env
+vars (JAX_COORDINATOR_ADDRESS / JAX_NUM_PROCESSES / JAX_PROCESS_ID) and
+the sweep runs over the global device set; every process prints the same
+ynorm (asserted by the gloo CI lane).
+"""
+import argparse
+import json
+import os
+import sys
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+MULTIHOST = bool(os.environ.get("JAX_COORDINATOR_ADDRESS"))
+if MULTIHOST:
+    # one device per controller (mirrors scripts/multihost_smoke.py)
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    from bench_tpu_fem.utils.hermetic import force_host_cpu_devices
+
+    force_host_cpu_devices(1)
+
+import jax  # noqa: E402
+
+if MULTIHOST:
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+
+from bench_tpu_fem.utils.multihost import maybe_initialize  # noqa: E402
+
+maybe_initialize()
+
+import jax.numpy as jnp  # noqa: E402
+import numpy as np  # noqa: E402
+
+from bench_tpu_fem.analysis.capture import loop_collective_counts  # noqa: E402
+from bench_tpu_fem.dist.kron import (  # noqa: E402
+    build_dist_kron,
+    make_kron_rhs_fn,
+    make_kron_sharded_fns,
+)
+from bench_tpu_fem.dist.kron_cg import (  # noqa: E402
+    _is_x_only,
+    supports_dist_kron_overlap,
+)
+from bench_tpu_fem.dist.mesh import (  # noqa: E402
+    compute_mesh_size_sharded,
+    factor_devices,
+    make_device_grid,
+)
+from bench_tpu_fem.elements.tables import build_operator_tables  # noqa: E402
+from bench_tpu_fem.harness.journal import (  # noqa: E402
+    Journal,
+    default_journal_path,
+)
+from bench_tpu_fem.mesh.dofmap import global_ndofs  # noqa: E402
+
+
+def device_sweep(max_devices: int | None) -> list[int]:
+    """Power-of-two device counts up to the available (or capped) mesh."""
+    avail = len(jax.devices())
+    cap = min(avail, max_devices) if max_devices else avail
+    out, d = [], 1
+    while d <= cap:
+        out.append(d)
+        d *= 2
+    if out[-1] != cap and cap not in out:
+        out.append(cap)
+    return out
+
+
+def run_point(degree: int, local_dofs: int, d: int, nreps: int,
+              overlap: bool, journal, round_tag: str, measured: str):
+    dshape = factor_devices(d)
+    dgrid = make_device_grid(d, dshape=dshape)
+    ndofs_req = local_dofs * d
+    n = compute_mesh_size_sharded(ndofs_req, degree, dshape)
+    op = build_dist_kron(n, dgrid, degree, 1, dtype=jnp.float32)
+    t = build_operator_tables(degree, 1, "gll")
+    b = jax.jit(make_kron_rhs_fn(op, dgrid, t))()
+    # A/B the FUSED engine forms (the interesting comparison); on CPU the
+    # kernels run interpret mode — parity/collective evidence, not speed.
+    if overlap and not supports_dist_kron_overlap(op):
+        # ISSUE-7 contract: a gated overlap arm records WHY (otherwise a
+        # hardware sweep's missing A/B points are undiagnosable). The
+        # plan-level predicate fails on exactly two grounds:
+        from bench_tpu_fem.dist.kron_cg import supports_dist_kron_engine
+
+        reason = ("engine ring past every scoped-VMEM tier (or non-f32)"
+                  if not supports_dist_kron_engine(op) else
+                  "ext2d shard past the whole-vector fusion wall "
+                  "(PALLAS_UPDATE_MIN_DOFS); sync engine serves")
+        gate = {"event": "weak_scaling_gate", "round": round_tag,
+                "devices": d, "dshape": list(dshape),
+                "overlap_gate_reason": reason}
+        if journal is not None and jax.process_index() == 0:
+            journal.append(gate)
+        print("WEAK-GATED", json.dumps(gate, sort_keys=True), flush=True)
+        return None
+    _, cg_fn, norm_fn = make_kron_sharded_fns(op, dgrid, nreps,
+                                              engine=True, overlap=overlap)
+    counts = loop_collective_counts(cg_fn, b, op)
+    if jax.default_backend() == "tpu":
+        # raised-tier one-kernel rings need the per-compile scoped-VMEM
+        # request, exactly like the dist driver's compile
+        from bench_tpu_fem.dist.kron_cg import dist_kron_engine_plan
+        from bench_tpu_fem.utils.compilation import (
+            compile_lowered,
+            scoped_vmem_options,
+        )
+
+        fn = compile_lowered(
+            jax.jit(cg_fn).lower(b, op),
+            scoped_vmem_options(dist_kron_engine_plan(op)[1]))
+    else:
+        fn = jax.jit(cg_fn)
+    x = fn(b, op)  # warm-up: compile + first run
+    jax.block_until_ready(x)
+    t0 = time.perf_counter()
+    x = fn(b, op)
+    jax.block_until_ready(x)
+    elapsed = time.perf_counter() - t0
+    ynorm = float(np.asarray(jax.jit(norm_fn)(x))[0])
+    ndofs = global_ndofs(n, degree)
+    form = ("halo" if _is_x_only(op) else "ext2d") + (
+        "_overlap" if overlap else "")
+    rec = {
+        "event": "weak_scaling", "round": round_tag, "devices": d,
+        "dshape": list(dshape), "ndofs_global": ndofs,
+        "local_dofs": ndofs // d, "degree": degree, "nreps": nreps,
+        "overlap": overlap, "engine_form": form,
+        "gdof_s": ndofs * nreps / (1e9 * elapsed),
+        "elapsed_s": elapsed, "ynorm": ynorm,
+        "collectives_per_iter": {k: int(v) for k, v in counts.items()},
+        "backend": jax.default_backend(),
+        "measured": measured,
+    }
+    if journal is not None and jax.process_index() == 0:
+        journal.append(rec)
+    print("WEAK", json.dumps(rec, sort_keys=True), flush=True)
+    return rec, x
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--local-dofs", type=int, default=2_000_000,
+                   help="dofs per device (held fixed across the sweep)")
+    p.add_argument("--degree", type=int, default=3)
+    p.add_argument("--nreps", type=int, default=100)
+    p.add_argument("--max-devices", type=int, default=0,
+                   help="cap the sweep (0 = all available devices)")
+    p.add_argument("--overlap", default="both",
+                   choices=["both", "on", "off"])
+    p.add_argument("--round", default=os.environ.get("MEASURE_ROUND",
+                                                     "r06"))
+    p.add_argument("--no-journal", action="store_true")
+    p.add_argument("--smoke", action="store_true",
+                   help="CPU proving lane: tiny config, overlap A/B "
+                        "parity + exactly-one-psum-per-iteration "
+                        "assertions (what CI runs; also 2-process gloo)")
+    args = p.parse_args()
+
+    if args.smoke:
+        args.local_dofs = min(args.local_dofs, 1500)
+        args.nreps = min(args.nreps, 4)
+
+    on_tpu = jax.default_backend() == "tpu"
+    measured = "hardware" if on_tpu else "cpu-interpret"
+    journal = None
+    if not args.no_journal and jax.process_index() == 0:
+        journal = Journal(default_journal_path(ROOT, args.round))
+
+    sweep = device_sweep(args.max_devices or None)
+    if args.smoke:
+        sweep = sweep[-1:]  # one point: the full available mesh
+    rc = 0
+    for d in sweep:
+        recs = {}
+        for overlap in (False, True):
+            if args.overlap == "on" and not overlap:
+                continue
+            if args.overlap == "off" and overlap:
+                continue
+            out = run_point(args.degree, args.local_dofs, d, args.nreps,
+                            overlap, journal, args.round, measured)
+            if out is not None:
+                recs[overlap] = out
+        if args.smoke and recs.get(False) and recs.get(True):
+            (sync_r, xs), (ovl_r, xo) = recs[False], recs[True]
+            ps = sync_r["collectives_per_iter"].get("psum", 0) + \
+                sync_r["collectives_per_iter"].get("psum2", 0)
+            po = ovl_r["collectives_per_iter"].get("psum", 0) + \
+                ovl_r["collectives_per_iter"].get("psum2", 0)
+            # full-solution parity (not just norms): the overlap
+            # recurrence's f32 envelope at smoke budgets
+            rel = float(jnp.linalg.norm((xo - xs).ravel())
+                        / jnp.linalg.norm(xs.ravel()))
+            ok = po == 1 and ps == 2 and rel < 5e-6
+            print(f"SMOKE devices={d} psum_sync={ps} psum_overlap={po} "
+                  f"solution_rel={rel:.3e} -> {'OK' if ok else 'FAIL'}",
+                  flush=True)
+            if not ok:
+                rc = 1
+        if MULTIHOST and recs:
+            # per-process RESULT line: the gloo lane asserts every
+            # controller computed identical global norms
+            any_rec = next(r for r, _ in recs.values() if r)
+            print(f"RESULT pid={jax.process_index()} "
+                  f"ynorm={any_rec['ynorm']!r} devices={d}", flush=True)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -20,9 +20,11 @@ import subprocess
 import sys
 
 import numpy as np
+import pytest
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 SMOKE = os.path.join(ROOT, "scripts", "multihost_smoke.py")
+SCALE = os.path.join(ROOT, "scripts", "weak_scaling.py")
 
 
 def _free_port() -> int:
@@ -47,10 +49,10 @@ def _child_env(port: int, pid: int) -> dict:
     return env
 
 
-def _launch_pair(port: int):
+def _launch_pair(port: int, argv=None):
     procs = [
         subprocess.Popen(
-            [sys.executable, "-u", SMOKE],
+            [sys.executable, "-u"] + (argv or [SMOKE]),
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
             cwd=ROOT, env=_child_env(port, pid),
         )
@@ -115,3 +117,36 @@ def test_two_process_golden_config_y_norm_matches():
     rel = abs(y0 - ref.ynorm) / abs(ref.ynorm)
     assert rel < 1e-12, (y0, ref.ynorm, rel)
     np.testing.assert_allclose(u0, ref.unorm, rtol=1e-12)
+
+
+@pytest.mark.slow  # two subprocess engine compiles; the tier-1 fast
+# lane is at its 870 s budget line — CI's slow lane runs this
+def test_two_process_weak_scaling_scale_smoke():
+    """The `scale` stage's CPU proving run, CROSS-PROCESS: two gloo
+    controllers run scripts/weak_scaling.py --smoke (small mesh, overlap
+    on/off A/B over the fused kron engine). The script itself asserts
+    the collective-count invariant (overlapped CG = exactly ONE psum per
+    iteration, synchronous = two) and overlap-vs-sync solution parity —
+    here additionally: both controllers print rc 0 and the IDENTICAL
+    global ynorm (cross-process ppermute + the stacked fused psum agree
+    over real gloo collectives, not virtual devices)."""
+    argv = [SCALE, "--smoke", "--no-journal"]
+    for attempt in range(2):
+        procs, outs = _launch_pair(_free_port(), argv)
+        if all(p.returncode == 0 for p in procs):
+            break
+        bindy = any("bind" in out.lower() or "address" in out.lower()
+                    for out in outs)
+        if attempt == 1 or not bindy:
+            break
+    for pid, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"process {pid} failed:\n{out}"
+    norms = {}
+    for pid, out in enumerate(outs):
+        assert "SMOKE" in out and "-> OK" in out, out
+        m = re.search(r"RESULT pid=(\d) ynorm=([\d.e+-]+) devices=(\d+)",
+                      out)
+        assert m, f"no RESULT line from process {pid}:\n{out}"
+        norms[pid] = (float(m.group(2)), int(m.group(3)))
+    assert norms[0] == norms[1], norms
+    assert norms[0][1] == 2  # the full 2-device gloo mesh was swept

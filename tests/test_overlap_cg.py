@@ -1,0 +1,386 @@
+"""Communication-overlapped sharded CG engines (ISSUE 7): the
+`halo_overlap` / `ext2d_overlap` forms across the kron, df and folded
+families on the 8-virtual-CPU mesh, plus the trace-level collective
+invariants behind them.
+
+Two classes of check:
+
+- PARITY vs the synchronous oracle. The overlap forms reassociate the
+  residual-norm recurrence (one fused psum of <p,Ap>/<r,y>/<y,y> instead
+  of two psum'd dots), so f32 parity floors at a few ulps per iteration
+  (~3e-7 at 2 iterations, growing with the budget exactly like the
+  repo's existing engine-vs-unfused envelope of 2e-5 * scale); the
+  df-class forms hold <= 1e-13 (measured ~1e-14).
+- COLLECTIVE COUNTS, trace-level: the overlapped loop body must contain
+  exactly ONE psum per iteration (the synchronous form two), and the df
+  overlap exactly one all-gather fold — the CPU-provable invariant the
+  weak-scaling harness journals next to every A/B point.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from bench_tpu_fem.analysis.capture import loop_collective_counts
+from bench_tpu_fem.dist.kron import (
+    build_dist_kron,
+    make_kron_rhs_fn,
+    make_kron_sharded_fns,
+    resolve_kron_overlap,
+)
+from bench_tpu_fem.dist.kron_cg import supports_dist_kron_overlap
+from bench_tpu_fem.dist.mesh import AXIS_NAMES, make_device_grid
+from bench_tpu_fem.dist.operator import shard_grid_blocks
+from bench_tpu_fem.elements.tables import build_operator_tables
+from bench_tpu_fem.mesh import create_box_mesh, dof_grid_shape
+
+
+def _kron_setup(dshape, n, degree=3):
+    dgrid = make_device_grid(dshape=dshape)
+    op = build_dist_kron(n, dgrid, degree, 1, dtype=jnp.float32)
+    t = build_operator_tables(degree, 1, "gll")
+    b = jax.jit(make_kron_rhs_fn(op, dgrid, t))()
+    return dgrid, op, b
+
+
+def _rel(a, b):
+    return np.linalg.norm(np.asarray(a) - np.asarray(b)) / np.linalg.norm(
+        np.asarray(b))
+
+
+# ---------------------------------------------------------------------------
+# kron f32
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow  # two engine compiles; the fast lane is at its budget
+def test_kron_overlap_parity_halo():
+    """x-only mesh, benchmark RHS: the overlap form tracks the
+    synchronous engine within the single-reduction f32 envelope (the
+    larger-budget 2e-5-envelope legs live in the slow ext2d case)."""
+    dgrid, op, b = _kron_setup((4, 1, 1), (8, 2, 2))
+    nreps = 2
+    _, cg_s, _ = make_kron_sharded_fns(op, dgrid, nreps, engine=True)
+    _, cg_o, _ = make_kron_sharded_fns(op, dgrid, nreps, engine=True,
+                                       overlap=True)
+    xs = jax.jit(cg_s)(b, op)
+    xo = jax.jit(cg_o)(b, op)
+    assert _rel(xo, xs) < 1e-6, _rel(xo, xs)
+
+
+@pytest.mark.slow
+def test_kron_overlap_parity_ext2d():
+    """3D-sharded mesh (ext2d_overlap) parity, including a random RHS
+    (Dirichlet rows zeroed) so seam rows/cols are exercised."""
+    from bench_tpu_fem.ops import build_laplacian
+
+    dshape, n, degree = (2, 2, 2), (4, 4, 4), 3
+    dgrid, op, b = _kron_setup(dshape, n, degree)
+    mesh = create_box_mesh(n)
+    rng = np.random.RandomState(7)
+    braw = rng.randn(*dof_grid_shape(n, degree)).astype(np.float32)
+    bc = np.asarray(build_laplacian(mesh, degree, 1, dtype=jnp.float32,
+                                    backend="xla").bc_mask)
+    braw[bc] = 0.0
+    sharding = NamedSharding(dgrid.mesh, P(*AXIS_NAMES))
+    brand = jax.device_put(
+        jnp.asarray(shard_grid_blocks(braw, n, degree, dgrid.dshape)),
+        sharding)
+    for rhs, nreps, tol in ((b, 2, 1e-6), (brand, 6, 2e-5)):
+        _, cg_s, _ = make_kron_sharded_fns(op, dgrid, nreps, engine=True)
+        _, cg_o, _ = make_kron_sharded_fns(op, dgrid, nreps, engine=True,
+                                           overlap=True)
+        xs = jax.jit(cg_s)(rhs, op)
+        xo = jax.jit(cg_o)(rhs, op)
+        assert _rel(xo, xs) < tol, (nreps, _rel(xo, xs))
+
+
+def test_kron_overlap_one_psum_per_iteration():
+    """TRACE-LEVEL invariant: the overlapped CG loop body carries exactly
+    one psum; the synchronous loop two. The halo traffic stays one
+    stacked ppermute pair per sharded axis in both."""
+    dgrid, op, b = _kron_setup((4, 1, 1), (8, 2, 2))
+    _, cg_s, _ = make_kron_sharded_fns(op, dgrid, 3, engine=True)
+    _, cg_o, _ = make_kron_sharded_fns(op, dgrid, 3, engine=True,
+                                       overlap=True)
+    cs = loop_collective_counts(cg_s, b, op)
+    co = loop_collective_counts(cg_o, b, op)
+    assert cs["reductions"] == 2, cs
+    assert co["reductions"] == 1, co
+    assert co.get("psum", 0) + co.get("psum2", 0) == 1, co
+    assert co["movements"] == cs["movements"] == 2, (cs, co)
+
+
+def test_kron_overlap_one_psum_ext2d():
+    dgrid, op, b = _kron_setup((2, 2, 2), (4, 4, 4))
+    _, cg_o, _ = make_kron_sharded_fns(op, dgrid, 2, engine=True,
+                                       overlap=True)
+    co = loop_collective_counts(cg_o, b, op)
+    assert co.get("psum", 0) + co.get("psum2", 0) == 1, co
+    # one stacked exchange pair per sharded axis (y halos)
+    assert co["ppermute"] == 6, co
+
+
+def test_kron_overlap_support_gate():
+    """Overlap rides the engine plan; f64 and pallas-update-walled ext2d
+    shards are refused with a reason from the shared resolver."""
+    dgrid = make_device_grid(dshape=(4, 1, 1))
+    op = build_dist_kron((8, 2, 2), dgrid, 3, 1, dtype=jnp.float32)
+    assert supports_dist_kron_overlap(op)
+    op64 = build_dist_kron((8, 2, 2), dgrid, 3, 1, dtype=jnp.float64)
+    assert not supports_dist_kron_overlap(op64)
+    ok, reason = resolve_kron_overlap(op64)
+    assert not ok and "engine" in reason
+    # overlap without the engine is a contract error at the fns layer
+    with pytest.raises(ValueError):
+        make_kron_sharded_fns(op64, dgrid, 2, engine=False, overlap=True)
+
+
+# ---------------------------------------------------------------------------
+# df (double-float)
+# ---------------------------------------------------------------------------
+
+def _df_setup(dshape, n):
+    from bench_tpu_fem.dist.kron_df import build_dist_kron_df, \
+        make_kron_df_rhs_fn
+
+    dgrid = make_device_grid(dshape=dshape)
+    t = build_operator_tables(3, 1, "gll")
+    op = build_dist_kron_df(n, dgrid, 3, 1, tables=t)
+    b = jax.jit(make_kron_df_rhs_fn(op, dgrid, t))()
+    return dgrid, op, b
+
+
+def _df_rel(xo, xs):
+    a = np.asarray(xo.hi, np.float64) + np.asarray(xo.lo, np.float64)
+    b = np.asarray(xs.hi, np.float64) + np.asarray(xs.lo, np.float64)
+    return np.linalg.norm(a - b) / np.linalg.norm(b)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("dshape,n", [((4, 1, 1), (8, 2, 2)),
+                                      ((2, 2, 2), (4, 4, 4))])
+def test_df_overlap_parity(dshape, n):
+    """df overlap vs the synchronous df engine: the df-class bound
+    (<= 1e-13; measured ~1e-14) over both kernel forms."""
+    from bench_tpu_fem.dist.kron_df import make_kron_df_sharded_fns
+
+    dgrid, op, b = _df_setup(dshape, n)
+    _, cg_s, _, _ = make_kron_df_sharded_fns(op, dgrid, 6, engine=True)
+    _, cg_o, _, _ = make_kron_df_sharded_fns(op, dgrid, 6, engine=True,
+                                             overlap=True)
+    xs = jax.jit(cg_s)(b, op)
+    xo = jax.jit(cg_o)(b, op)
+    assert _df_rel(xo, xs) < 1e-13
+
+
+def test_df_overlap_single_gather_fold():
+    """The df overlap loop folds ALL its cross-shard reductions through
+    ONE stacked all-gather per sharded axis; the synchronous df engine
+    runs one gather chain per dot (hi+lo channels each)."""
+    from bench_tpu_fem.dist.kron_df import make_kron_df_sharded_fns
+
+    dgrid, op, b = _df_setup((4, 1, 1), (8, 2, 2))
+    _, cg_s, _, _ = make_kron_df_sharded_fns(op, dgrid, 2, engine=True)
+    _, cg_o, _, _ = make_kron_df_sharded_fns(op, dgrid, 2, engine=True,
+                                             overlap=True)
+    cs = loop_collective_counts(cg_s, b, op)
+    co = loop_collective_counts(cg_o, b, op)
+    assert co["all_gather"] == 1, co
+    assert cs["all_gather"] > co["all_gather"], (cs, co)
+
+
+# ---------------------------------------------------------------------------
+# folded (perturbed geometry)
+# ---------------------------------------------------------------------------
+
+def _folded_setup(dshape=(2, 1, 1), n=(4, 2, 2)):
+    from bench_tpu_fem.dist.folded import (
+        build_dist_folded,
+        make_folded_rhs_fn,
+        shard_corner_cs,
+    )
+
+    dgrid = make_device_grid(dshape=dshape)
+    mesh = create_box_mesh(n, geom_perturb_fact=0.15)
+    t = build_operator_tables(3, 1)
+    op = build_dist_folded(mesh, dgrid, 3, t, dtype=jnp.float32, nl=16)
+    ccs, mcs = shard_corner_cs(mesh, dgrid.dshape, op.layout)
+    sharding = NamedSharding(dgrid.mesh, P(*AXIS_NAMES))
+    b = jax.jit(make_folded_rhs_fn(op, dgrid, t, jnp.float32))(
+        jax.device_put(np.asarray(ccs, np.float32), sharding),
+        jax.device_put(np.asarray(mcs, np.float32), sharding),
+        op.bc_mask)
+    return dgrid, op, b
+
+
+@pytest.mark.slow
+def test_folded_overlap_parity():
+    from bench_tpu_fem.dist.folded import make_folded_sharded_fns
+
+    dgrid, op, b = _folded_setup()
+    _, cg_s, _, ss = make_folded_sharded_fns(op, dgrid, 5, engine=True)
+    _, cg_o, _, _ = make_folded_sharded_fns(op, dgrid, 5, engine=True,
+                                            overlap=True)
+    state = ss(op)
+    xs = jax.jit(cg_s)(b, state, op.owned)
+    xo = jax.jit(cg_o)(b, state, op.owned)
+    assert _rel(xo, xs) < 2e-5
+
+
+@pytest.mark.slow
+def test_folded_overlap_one_psum_and_refresh_on_y():
+    """Folded overlap trace invariant: one psum per iteration; the
+    ppermute count stays at two chains per sharded axis (reverse scatter
+    + the forward refresh, now of y instead of the (r, p) pair)."""
+    from bench_tpu_fem.dist.folded import make_folded_sharded_fns
+
+    dgrid, op, b = _folded_setup()
+    _, cg_s, _, ss = make_folded_sharded_fns(op, dgrid, 2, engine=True)
+    _, cg_o, _, _ = make_folded_sharded_fns(op, dgrid, 2, engine=True,
+                                            overlap=True)
+    state = ss(op)
+    cs = loop_collective_counts(cg_s, b, state, op.owned)
+    co = loop_collective_counts(cg_o, b, state, op.owned)
+    assert cs["reductions"] == 2 and co["reductions"] == 1, (cs, co)
+    assert cs["ppermute"] == co["ppermute"] == 2, (cs, co)
+
+
+# ---------------------------------------------------------------------------
+# driver stamping
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_driver_stamps_overlap_form_and_off_switch():
+    """run_distributed on the folded path (the one family whose engine
+    resolves on CPU): overlap='auto' stamps halo_overlap, overlap='off'
+    the synchronous halo form — same GDoF/s accounting, parity within
+    the f32 envelope."""
+    import dataclasses
+
+    from bench_tpu_fem.bench.driver import BenchConfig, BenchmarkResults
+    from bench_tpu_fem.dist.driver import run_distributed
+
+    cfg = BenchConfig(ndofs_global=1500, degree=3, qmode=1,
+                      float_bits=32, nreps=2, use_cg=True, ndevices=2,
+                      backend="pallas", geom_perturb_fact=0.15)
+    res = BenchmarkResults(nreps=cfg.nreps)
+    run_distributed(cfg, res, jnp.float32)
+    assert res.extra["cg_engine_form"] == "halo_overlap", res.extra
+    res2 = BenchmarkResults(nreps=cfg.nreps)
+    run_distributed(dataclasses.replace(cfg, overlap="off"), res2,
+                    jnp.float32)
+    assert res2.extra["cg_engine_form"] == "halo", res2.extra
+    assert abs(res.ynorm - res2.ynorm) / abs(res2.ynorm) < 1e-5
+
+
+# ---------------------------------------------------------------------------
+# la.cg single-reduction machinery (no kernels: fast)
+# ---------------------------------------------------------------------------
+
+def test_cg_solve_dot3_matches_two_reduction():
+    from bench_tpu_fem.la.cg import cg_solve, stacked_dot3
+
+    rng = np.random.RandomState(0)
+    A = rng.randn(40, 40)
+    A = (A @ A.T + 40 * np.eye(40)).astype(np.float64)
+    b = rng.randn(40).astype(np.float64)
+    Aj = jnp.asarray(A)
+    apply_A = lambda v: Aj @ v  # noqa: E731
+    x0 = jnp.zeros(40, jnp.float64)
+    xs = cg_solve(apply_A, jnp.asarray(b), x0, 15)
+    xo = cg_solve(apply_A, jnp.asarray(b), x0, 15, dot3=stacked_dot3)
+    # f64: reassociation noise drops ~6 orders below the f32 envelope
+    assert _rel(xo, xs) < 1e-9
+
+
+def test_cg_solve_batched_dot3_matches():
+    from bench_tpu_fem.la.cg import batched_dot3, cg_solve_batched
+
+    rng = np.random.RandomState(1)
+    A = rng.randn(24, 24)
+    A = (A @ A.T + 24 * np.eye(24)).astype(np.float64)
+    B = rng.randn(3, 24).astype(np.float64)
+    B[2] = 0.0  # padding lane stays frozen under dot3 too
+    Aj = jnp.asarray(A)
+    apply_A = lambda v: Aj @ v  # noqa: E731
+    X0 = jnp.zeros_like(jnp.asarray(B))
+    Xs = cg_solve_batched(apply_A, jnp.asarray(B), X0, 12)
+    Xo = cg_solve_batched(apply_A, jnp.asarray(B), X0, 12,
+                          dot3=batched_dot3)
+    assert _rel(Xo, Xs) < 1e-9
+    assert np.all(np.asarray(Xo)[2] == 0.0)
+
+
+def test_onered_scalars_recurrence_and_clamp():
+    from bench_tpu_fem.la.cg import onered_scalars
+
+    rnorm = jnp.float64(2.0)
+    pdot, ry, yy = jnp.float64(4.0), jnp.float64(0.75), jnp.float64(1.0)
+    alpha, rnorm1, beta = onered_scalars(rnorm, pdot, ry, yy)
+    # <r1,r1> = rnorm - 2a*ry + a^2*yy with a = 0.5
+    assert float(alpha) == 0.5
+    assert abs(float(rnorm1) - (2.0 - 0.75 + 0.25)) < 1e-15
+    # cancellation below zero clamps to a graceful restart (beta = 0)
+    _, rz, bz = onered_scalars(jnp.float64(1.0), jnp.float64(1.0),
+                               jnp.float64(10.0), jnp.float64(1.0))
+    assert float(rz) == 0.0 and float(bz) == 0.0
+
+
+def test_owned_dot3_matches_separate_dots():
+    """The shared dist.halo owned-dot helpers agree with the hand-rolled
+    masked reductions they replaced (single shard_map, 8 devices)."""
+    from functools import partial
+
+    from bench_tpu_fem.dist.halo import owned_dot, owned_dot3, owned_mask
+
+    dgrid = make_device_grid(dshape=(2, 2, 2))
+    rng = np.random.RandomState(3)
+    shape = (2, 2, 2, 5, 5, 5)
+    p = rng.randn(*shape).astype(np.float32)
+    y = rng.randn(*shape).astype(np.float32)
+    r = rng.randn(*shape).astype(np.float32)
+    sharding = NamedSharding(dgrid.mesh, P(*AXIS_NAMES))
+    pv, yv, rv = (jax.device_put(jnp.asarray(a), sharding)
+                  for a in (p, y, r))
+
+    @partial(jax.shard_map, mesh=dgrid.mesh,
+             in_specs=(P(*AXIS_NAMES),) * 3, out_specs=P())
+    def run(pb, yb, rb):
+        pl, yl, rl = pb[0, 0, 0], yb[0, 0, 0], rb[0, 0, 0]
+        w = owned_mask(pl.shape).astype(pl.dtype)
+        trio = owned_dot3(w)(pl, yl, rl)
+        dot = owned_dot(w)
+        sep = jnp.stack([dot(pl, yl), dot(rl, yl), dot(yl, yl)])
+        return jnp.stack([trio, sep])
+
+    out = np.asarray(jax.jit(run)(pv, yv, rv))
+    np.testing.assert_allclose(out[0], out[1], rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# int64 sizing (the >2^31-global-dofs satellite)
+# ---------------------------------------------------------------------------
+
+def test_mesh_sizing_3b_dofs_int64():
+    """Synthetic 3B-dof sizing (the weak-scaling sweep crosses 2^31):
+    the search and the dof accounting must stay exact Python/int64
+    arithmetic end to end."""
+    from bench_tpu_fem.mesh.dofmap import global_ncells, global_ndofs
+    from bench_tpu_fem.mesh.sizing import compute_mesh_size
+
+    target = 3_000_000_000
+    for dshape in ((1, 1, 1), (2, 2, 2), (4, 2, 1)):
+        n = compute_mesh_size(target, 3, dshape)
+        nd = global_ndofs(n, 3)
+        assert isinstance(nd, int)
+        assert nd > 2**31  # really crossed the int32 wall
+        assert abs(nd - target) / target < 0.05, (n, nd)
+        assert global_ncells(n) == n[0] * n[1] * n[2]
+        for ni, di in zip(n, dshape):
+            assert ni % di == 0
+    # the reference's 19B-dof flagship scale stays exact too
+    n = compute_mesh_size(19_000_000_000, 6, (4, 4, 4))
+    nd = global_ndofs(n, 6)
+    assert nd > 2**34 and abs(nd - 19_000_000_000) / 19e9 < 0.05
