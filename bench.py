@@ -179,6 +179,14 @@ def run(ndofs: int) -> dict:
         "nrhs": nrhs,
         "nrhs_bucket": nrhs_bucket(nrhs),
         "cg_wall_s": round(res.mat_free_time, 3),
+        # Observability stamps (ISSUE 8): the GDoF/s claim carries its
+        # phase breakdown, roofline placement (intensity + fraction,
+        # evidence-labelled) and peak device memory.
+        "roofline": res.extra.get("roofline"),
+        "peak_memory_bytes": res.extra.get("peak_memory_bytes"),
+        "phase_s": res.extra.get("phase_s"),
+        "phase_share": res.extra.get("phase_share"),
+        "timing": res.extra.get("timing"),
         "f64_gdof_per_s_per_chip": f64,
         # The static analyzer's per-rule verdict (analysis.verdict reads
         # the report CI produced; {"available": false} when none exists)

@@ -48,6 +48,7 @@ from ..utils.compilation import (  # noqa: F401  (TPU_COMPILER_OPTIONS re-export
     exc_str,
     scoped_vmem_options,
 )
+from ..obs.trace import BenchObserver
 from ..utils.timing import Timer
 
 
@@ -94,6 +95,12 @@ class BenchConfig:
     # default: tests that monkeypatch kernel internals rely on every
     # run_benchmark call compiling fresh.
     exec_cache: bool = False
+    # execute the timed region this many times (each fully fenced) and
+    # report the per-rep wall distribution (min/median/max) in
+    # extra["timing"] — exposes warmup and jitter. mat_free_time (what
+    # GDoF/s divides by) is the MEDIAN; the default 1 reproduces the
+    # historical single measurement exactly.
+    timing_reps: int = 1
     # communication/compute overlap for the SHARDED fused CG engines
     # (ISSUE 7): "auto" engages the double-buffered-halo single-psum
     # forms (`halo_overlap` / `ext2d_overlap`) wherever the family's
@@ -155,6 +162,42 @@ def record_engine(extra: dict, engine: bool, form: str | None = None,
         from ..analysis.verdict import stamp_static_analysis
 
         stamp_static_analysis(extra)
+
+
+def config_precision(cfg: BenchConfig) -> str:
+    """The unified precision label every obs/serve/cache consumer uses:
+    f32 | df32 | f64 (emulated)."""
+    return ("f32" if cfg.float_bits == 32
+            else ("df32" if cfg.f64_impl == "df32" else "f64"))
+
+
+def stamp_observability(cfg: BenchConfig, res: BenchmarkResults,
+                        obs: BenchObserver,
+                        precision: str | None = None) -> None:
+    """The ISSUE-8 record contract, stamped by EVERY driver branch:
+    ``phase_s``/``phase_share`` (span-attributed compile/transfer/solve
+    shares), ``timing`` (per-rep wall distribution),
+    ``peak_memory_bytes`` + ``memory`` (device stats on hardware,
+    process-RSS proxy on CPU), and ``roofline`` (analytic intensity +
+    achieved-vs-ceiling fraction for the engine form that RAN).
+    ``precision`` is the precision that actually EXECUTED (a df32
+    config rerun through the emulated fallback stamps "f64")."""
+    import jax
+
+    from ..obs.roofline import roofline_stamp
+
+    obs.stamp(res.extra)
+    geom = res.extra.get("geom") or (
+        "perturbed" if cfg.geom_perturb_fact != 0.0 else "uniform")
+    try:
+        roofline_stamp(
+            res.extra, degree=cfg.degree, qmode=cfg.qmode,
+            precision=precision or config_precision(cfg),
+            backend=res.extra.get("backend", ""), geom=geom,
+            use_cg=cfg.use_cg, gdof_s=res.gdof_per_second,
+            platform=jax.default_backend())
+    except Exception as exc:  # telemetry must never sink a benchmark
+        res.extra["roofline_error"] = exc_str(exc)
 
 
 # engine_plan/engine_plan_df form names -> the unified vocabulary
@@ -436,6 +479,7 @@ def _run_benchmark_folded_df(cfg: BenchConfig) -> BenchmarkResults:
         cfg, n, prebuilt=(n, rule, t, mesh)
     )
 
+    obs = BenchObserver(cfg)
     with Timer("% Create matfree operator"):
         op = build_folded_laplacian_df(
             mesh, cfg.degree, cfg.qmode, rule, kappa=2.0, tables=t
@@ -453,28 +497,21 @@ def _run_benchmark_folded_df(cfg: BenchConfig) -> BenchmarkResults:
         else:
             fn_py = lambda A, b: folded_action_df(A, b, cfg.nreps)  # noqa: E731
         try:
-            fn = compile_lowered(jax.jit(fn_py).lower(op, u), compile_opts)
+            with obs.phase("compile"):
+                fn = compile_lowered(jax.jit(fn_py).lower(op, u),
+                                     compile_opts)
         except Exception as exc:
             # a Mosaic/XLA rejection of the folded df kernels must not
             # sink the benchmark: recorded emulation fallback
             return _df64_emulated_fallback(
                 cfg, "folded-df compile failed: " + exc_str(exc))
-        warm = fn(op, u)
-        float(warm.hi[(0,) * warm.hi.ndim])
-        del warm
+        with obs.phase("transfer"):
+            warm = fn(op, u)
+            float(warm.hi[(0,) * warm.hi.ndim])
+            del warm
 
-    from contextlib import nullcontext
-
-    prof = (
-        jax.profiler.trace(cfg.profile_dir) if cfg.profile_dir
-        else nullcontext()
-    )
-    with prof:
-        t0 = time.perf_counter()
-        y = fn(op, u)
-        jax.block_until_ready(y)
-        float(y.hi[(0,) * y.hi.ndim])  # hard fence (see _run_benchmark)
-        res.mat_free_time = time.perf_counter() - t0
+    y = obs.timed_reps(lambda: fn(op, u))
+    res.mat_free_time = obs.elapsed()
 
     dot_fn = jax.jit(df_dot)
     linf_fn = jax.jit(lambda a: jnp.max(jnp.abs(a.hi + a.lo)))
@@ -489,6 +526,7 @@ def _run_benchmark_folded_df(cfg: BenchConfig) -> BenchmarkResults:
     res.gdof_per_second = ndofs_global * cfg.nreps / (
         1e9 * res.mat_free_time
     )
+    stamp_observability(cfg, res, obs, "df32")
 
     if cfg.mat_comp:
         z = _mat_comp_oracle(cfg, t, dm, bc_grid, b_host, G_host)
@@ -550,6 +588,7 @@ def _run_benchmark_df64(cfg: BenchConfig) -> BenchmarkResults:
 
     from ..la.df64 import df_from_f64
 
+    obs = BenchObserver(cfg)
     with Timer("% Create matfree operator"):
         op = build_kron_laplacian_df(
             mesh, cfg.degree, cfg.qmode, rule, kappa=2.0, tables=t
@@ -596,8 +635,10 @@ def _run_benchmark_df64(cfg: BenchConfig) -> BenchmarkResults:
             return lambda A, b: action_df(A, b, cfg.nreps)
 
         try:
-            fn = compile_lowered(
-                _lower(_fused() if engine else _unfused()), compile_opts)
+            with obs.phase("compile"):
+                fn = compile_lowered(
+                    _lower(_fused() if engine else _unfused()),
+                    compile_opts)
         except Exception as exc:
             if not engine:
                 raise
@@ -606,38 +647,31 @@ def _run_benchmark_df64(cfg: BenchConfig) -> BenchmarkResults:
             # f32 engine), then fall back to the unfused path, recording
             # why. Compile errors only — execution errors propagate.
             fn = None
-            if form == "one":
-                try:
-                    fn = compile_lowered(
-                        _lower(_fused(force_chunked=True)))
-                    # the one-kernel rejection is kept alongside: a
-                    # drifted tier boundary is only diagnosable from it
-                    res.extra["cg_engine_form"] = "chunked"
-                    res.extra["cg_engine_one_kernel_error"] = exc_str(exc)
-                except Exception as exc2:
-                    res.extra["cg_engine_retry_error"] = exc_str(exc2)
-            if fn is None:
-                engine = False
-                # the recorded form never ran — the unfused stamp must
-                # not attribute unfused timings to an engine form
-                record_engine(res.extra, False, error=exc)
-                fn = compile_lowered(_lower(_unfused()))
-        warm = fn(op, u)
-        float(warm.hi[(0,) * warm.hi.ndim])
-        del warm
+            with obs.phase("compile"):
+                if form == "one":
+                    try:
+                        fn = compile_lowered(
+                            _lower(_fused(force_chunked=True)))
+                        # the one-kernel rejection is kept alongside: a
+                        # drifted tier boundary is only diagnosable from it
+                        res.extra["cg_engine_form"] = "chunked"
+                        res.extra["cg_engine_one_kernel_error"] = (
+                            exc_str(exc))
+                    except Exception as exc2:
+                        res.extra["cg_engine_retry_error"] = exc_str(exc2)
+                if fn is None:
+                    engine = False
+                    # the recorded form never ran — the unfused stamp must
+                    # not attribute unfused timings to an engine form
+                    record_engine(res.extra, False, error=exc)
+                    fn = compile_lowered(_lower(_unfused()))
+        with obs.phase("transfer"):
+            warm = fn(op, u)
+            float(warm.hi[(0,) * warm.hi.ndim])
+            del warm
 
-    from contextlib import nullcontext
-
-    prof = (
-        jax.profiler.trace(cfg.profile_dir) if cfg.profile_dir
-        else nullcontext()
-    )
-    with prof:
-        t0 = time.perf_counter()
-        y = fn(op, u)
-        jax.block_until_ready(y)
-        float(y.hi[(0,) * y.hi.ndim])  # hard fence (see _run_benchmark)
-        res.mat_free_time = time.perf_counter() - t0
+    y = obs.timed_reps(lambda: fn(op, u))
+    res.mat_free_time = obs.elapsed()
 
     # Norms on device: L2 via the compensated df dot (f64-class); Linf on
     # the f32-rounded hi+lo (|.|max to ~f32 relative accuracy — casting to
@@ -660,6 +694,7 @@ def _run_benchmark_df64(cfg: BenchConfig) -> BenchmarkResults:
     res.gdof_per_second = ndofs_global * cfg.nreps / (
         1e9 * res.mat_free_time
     )
+    stamp_observability(cfg, res, obs, "df32")
 
     if cfg.mat_comp:
         # assembled-CSR oracle in true f64 (host path; oracle runs are
@@ -745,38 +780,33 @@ def _finish_batched(cfg: BenchConfig, res: BenchmarkResults, n, op, u,
     # Exec-cache key on the PLANNED form (deterministic per config; a
     # Mosaic-reject fallback executable is stored under the planned key
     # with its true routing stamps replayed from the entry meta).
+    obs = BenchObserver(cfg)
     key = _exec_cache_key(cfg, n, planned_form,
                           "cg" if cfg.use_cg else "action")
     fn = _exec_cache_get(cfg, key, res)
     from_cache = fn is not None
-    if fn is None and engine:
-        # Same hardening as the single-RHS engine compiles: a Mosaic
-        # rejection of the batched ring (a drifted per-bucket tier
-        # boundary) must not sink the benchmark — fall back to the
-        # unfused vmapped path, recording why. Compile errors only.
-        try:
-            fn = compile_lowered(jax.jit(engine_run).lower(op, B),
-                                 engine_opts)
-        except Exception as exc:
-            record_engine(res.extra, False, error=exc)
-    if fn is None:
-        fn = compile_lowered(jax.jit(run).lower(op, B), compile_opts)
+    with obs.phase("compile"):
+        if fn is None and engine:
+            # Same hardening as the single-RHS engine compiles: a Mosaic
+            # rejection of the batched ring (a drifted per-bucket tier
+            # boundary) must not sink the benchmark — fall back to the
+            # unfused vmapped path, recording why. Compile errors only.
+            try:
+                fn = compile_lowered(jax.jit(engine_run).lower(op, B),
+                                     engine_opts)
+            except Exception as exc:
+                record_engine(res.extra, False, error=exc)
+        if fn is None:
+            fn = compile_lowered(jax.jit(run).lower(op, B), compile_opts)
     if not from_cache:
         _exec_cache_put(cfg, key, fn, res)
-    warm = fn(op, B)
-    float(warm[(0,) * warm.ndim])
-    del warm
+    with obs.phase("transfer"):
+        warm = fn(op, B)
+        float(warm[(0,) * warm.ndim])
+        del warm
 
-    from contextlib import nullcontext
-
-    prof = (jax.profiler.trace(cfg.profile_dir) if cfg.profile_dir
-            else nullcontext())
-    with prof:
-        t0 = time.perf_counter()
-        Y = fn(op, B)
-        Y.block_until_ready()
-        float(Y[(0,) * Y.ndim])  # hard fence (see _run_benchmark)
-        elapsed = time.perf_counter() - t0
+    Y = obs.timed_reps(lambda: fn(op, B))
+    elapsed = obs.elapsed()
 
     res.mat_free_time = elapsed
     y0 = Y[0]
@@ -786,6 +816,7 @@ def _finish_batched(cfg: BenchConfig, res: BenchmarkResults, n, op, u,
     res.ynorm_linf = float(norm_linf(y0))
     res.gdof_per_second = (
         res.ndofs_global * cfg.nreps * cfg.nrhs / (1e9 * elapsed))
+    stamp_observability(cfg, res, obs)
 
     if cfg.mat_comp and oracle_args is not None:
         t, dm, bc_grid, b_host, G_host = oracle_args
@@ -827,26 +858,21 @@ def _finish_batched_df(cfg: BenchConfig, res: BenchmarkResults, n, op, u,
         def run(A, Bh, Bl):
             return jax.vmap(lambda b: action_df(A, b, nreps))(DF(Bh, Bl))
 
+    obs = BenchObserver(cfg)
     key = _exec_cache_key(cfg, n, "unfused",
                           "cg" if cfg.use_cg else "action")
     fn = _exec_cache_get(cfg, key, res)
     if fn is None:
-        fn = compile_lowered(jax.jit(run).lower(op, B.hi, B.lo), None)
+        with obs.phase("compile"):
+            fn = compile_lowered(jax.jit(run).lower(op, B.hi, B.lo), None)
         _exec_cache_put(cfg, key, fn, res)
-    warm = fn(op, B.hi, B.lo)
-    float(warm.hi[(0,) * warm.hi.ndim])
-    del warm
+    with obs.phase("transfer"):
+        warm = fn(op, B.hi, B.lo)
+        float(warm.hi[(0,) * warm.hi.ndim])
+        del warm
 
-    from contextlib import nullcontext
-
-    prof = (jax.profiler.trace(cfg.profile_dir) if cfg.profile_dir
-            else nullcontext())
-    with prof:
-        t0 = time.perf_counter()
-        Y = fn(op, B.hi, B.lo)
-        jax.block_until_ready(Y)
-        float(Y.hi[(0,) * Y.hi.ndim])  # hard fence
-        res.mat_free_time = time.perf_counter() - t0
+    Y = obs.timed_reps(lambda: fn(op, B.hi, B.lo))
+    res.mat_free_time = obs.elapsed()
 
     dot_fn = jax.jit(df_dot)
     linf_fn = jax.jit(lambda a: jnp.max(jnp.abs(a.hi + a.lo)))
@@ -862,6 +888,7 @@ def _finish_batched_df(cfg: BenchConfig, res: BenchmarkResults, n, op, u,
     res.gdof_per_second = (
         res.ndofs_global * cfg.nreps * cfg.nrhs
         / (1e9 * res.mat_free_time))
+    stamp_observability(cfg, res, obs, "df32")
 
     if cfg.mat_comp and oracle_args is not None:
         t, dm, bc_grid, b_host, G_host = oracle_args
@@ -1071,6 +1098,7 @@ def _run_benchmark(cfg: BenchConfig) -> BenchmarkResults:
         exec_key = _exec_cache_key(
             cfg, n, res.extra.get("cg_engine_form", "unfused"),
             "cg" if cfg.use_cg else "action")
+        obs = BenchObserver(cfg)
         if cfg.use_cg:
             fn = _exec_cache_get(cfg, exec_key, res)
             from_cache = fn is not None
@@ -1083,9 +1111,10 @@ def _run_benchmark(cfg: BenchConfig) -> BenchmarkResults:
                 # execution errors propagate (a fallback there could mask
                 # wrong results).
                 def _compile_cg(cg, opts):
-                    return compile_lowered(jax.jit(
-                        lambda A, b, x0: cg(A, b)
-                    ).lower(op, u, jnp.zeros_like(u)), opts)
+                    with obs.phase("compile"):
+                        return compile_lowered(jax.jit(
+                            lambda A, b, x0: cg(A, b)
+                        ).lower(op, u, jnp.zeros_like(u)), opts)
 
                 try:
                     fn = _compile_cg(engine_cg, compile_opts)
@@ -1113,12 +1142,15 @@ def _run_benchmark(cfg: BenchConfig) -> BenchmarkResults:
                     if not engine:
                         apply_fn = unfused_apply
             if fn is None:
-                fn = compile_lowered(jax.jit(
-                    lambda A, b, x0: cg_solve(apply_fn(A), b, x0, cfg.nreps)
-                ).lower(op, u, jnp.zeros_like(u)), fallback_opts)
+                with obs.phase("compile"):
+                    fn = compile_lowered(jax.jit(
+                        lambda A, b, x0: cg_solve(apply_fn(A), b, x0,
+                                                  cfg.nreps)
+                    ).lower(op, u, jnp.zeros_like(u)), fallback_opts)
             if not from_cache:
                 _exec_cache_put(cfg, exec_key, fn, res)
-            warm = fn(op, u, jnp.zeros_like(u))
+            with obs.phase("transfer"):
+                warm = fn(op, u, jnp.zeros_like(u))
         else:
             # All nreps applies in one jitted fori_loop: same semantics as
             # the reference's per-rep launches (y = A u each rep, same input,
@@ -1134,12 +1166,13 @@ def _run_benchmark(cfg: BenchConfig) -> BenchmarkResults:
                 return af(A)(xx)
 
             def _compile_action(af, opts):
-                return compile_lowered(jax.jit(
-                    lambda A, x: jax.lax.fori_loop(
-                        0, cfg.nreps, partial(_rep, A=A, x=x, af=af),
-                        jnp.zeros_like(x),
-                    )
-                ).lower(op, u), opts)
+                with obs.phase("compile"):
+                    return compile_lowered(jax.jit(
+                        lambda A, x: jax.lax.fori_loop(
+                            0, cfg.nreps, partial(_rep, A=A, x=x, af=af),
+                            jnp.zeros_like(x),
+                        )
+                    ).lower(op, u), opts)
 
             fn = _exec_cache_get(cfg, exec_key, res)
             if fn is None:
@@ -1170,34 +1203,21 @@ def _run_benchmark(cfg: BenchConfig) -> BenchmarkResults:
                         _record_engine_failure(exc)
                         fn = _compile_action(unfused_apply, fallback_opts)
                 _exec_cache_put(cfg, exec_key, fn, res)
-            warm = fn(op, u)
-        # One warm-up execution (fenced): first execution pays one-time
-        # transfer/initialisation costs that are not operator throughput.
-        # It runs the full nreps computation because a cheaper 1-rep
+            with obs.phase("transfer"):
+                warm = fn(op, u)
+        # One warm-up execution (fenced, attributed to the "transfer"
+        # phase — it pays the one-time transfer/initialisation costs):
+        # it runs the full nreps computation because a cheaper 1-rep
         # warm-up would need a second full compile (tens of seconds) to
         # save a few seconds of device time — net slower at every
         # benchmark size we run.
-        float(warm[(0,) * warm.ndim])
-        del warm
+        with obs.phase("transfer"):
+            float(warm[(0,) * warm.ndim])
+            del warm
 
-    from contextlib import nullcontext
-
-    prof = (
-        jax.profiler.trace(cfg.profile_dir) if cfg.profile_dir
-        else nullcontext()
-    )
-    with prof:
-        t0 = time.perf_counter()
-        if cfg.use_cg:
-            y = fn(op, u, jnp.zeros_like(u))
-        else:
-            y = fn(op, u)
-        y.block_until_ready()
-        # Under the axon PJRT tunnel block_until_ready can return before the
-        # device work drains; fetching a scalar of the result is a hard fence
-        # (4-byte transfer, one slice kernel — negligible vs the timed work).
-        float(y[(0,) * y.ndim])
-        elapsed = time.perf_counter() - t0
+    y = obs.timed_reps(lambda: fn(op, u, jnp.zeros_like(u))
+                       if cfg.use_cg else fn(op, u))
+    elapsed = obs.elapsed()
 
     res.mat_free_time = elapsed
     from ..la.vector import norm, norm_linf
@@ -1207,6 +1227,8 @@ def _run_benchmark(cfg: BenchConfig) -> BenchmarkResults:
     res.unorm_linf = float(norm_linf(u))
     res.ynorm_linf = float(norm_linf(y))
     res.gdof_per_second = ndofs_global * cfg.nreps / (1e9 * elapsed)
+    stamp_observability(cfg, res, obs,
+                        "f32" if cfg.float_bits == 32 else "f64")
 
     if cfg.mat_comp:
         z = _mat_comp_oracle(cfg, t, dm, bc_grid, b_host, G_host)

@@ -59,4 +59,12 @@ def results_json(cfg: BenchConfig, res: BenchmarkResults) -> str:
         # padding class for this batch size
         root["output"]["nrhs"] = res.extra.get("nrhs", cfg.nrhs)
         root["output"]["nrhs_bucket"] = res.extra.get("nrhs_bucket")
+    # observability stamps (ISSUE 8): attribution rides on every record
+    # — roofline placement, peak device memory, span-attributed phase
+    # shares and the per-rep timing distribution (each carries its own
+    # evidence label; see obs/)
+    for key in ("roofline", "peak_memory_bytes", "memory", "phase_s",
+                "phase_share", "timing"):
+        if key in res.extra:
+            root["output"][key] = res.extra[key]
     return json.dumps(root)

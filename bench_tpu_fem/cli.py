@@ -75,6 +75,17 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--profile", default="",
                    help="Write a jax.profiler trace of the timed region to "
                         "this directory (view with TensorBoard / xprof)")
+    p.add_argument("--trace", default="",
+                   help="Enable the obs span tracer and write a Chrome "
+                        "trace-event JSON (Perfetto-loadable) to this "
+                        "path; span + bench records also land in a "
+                        "sibling .jsonl journal. Render both with "
+                        "python -m bench_tpu_fem.obs")
+    p.add_argument("--timing-reps", type=int, default=1,
+                   help="Execute the timed region this many times and "
+                        "report the per-rep wall distribution "
+                        "(min/median/max) — exposes warmup and jitter; "
+                        "the reported time is the median")
     return p
 
 
@@ -189,13 +200,49 @@ def main(argv: list[str] | None = None) -> int:
         profile_dir=args.profile,
         nrhs=args.nrhs,
         overlap=args.overlap,
+        timing_reps=max(args.timing_reps, 1),
     )
+
+    obs_journal = None
+    if args.trace:
+        # span tracer on for the whole run: spans stream into the
+        # sibling .jsonl journal as they close (crash-safe), the Chrome
+        # trace exports after the run
+        from .harness.journal import Journal
+        from .obs.trace import enable
+
+        base = (args.trace[:-5] if args.trace.endswith(".json")
+                else args.trace)
+        obs_journal = Journal(base + ".jsonl")
+        enable(journal=obs_journal, fresh=True)
 
     dev = devices[0]
     info = f"Device: {dev.platform}:{dev.device_kind} x{len(devices)}"
     print(banner(cfg, info))
 
     res = run_benchmark(cfg)
+
+    if args.trace:
+        from .obs.trace import export_chrome_trace
+
+        export_chrome_trace(args.trace)
+        # the journal also carries the obs-stamped bench record, so
+        # `python -m bench_tpu_fem.obs --journal` renders the roofline
+        # table next to the span tree from one file
+        obs_journal.append({
+            "event": "bench_record",
+            "gdof_per_second": res.gdof_per_second,
+            "ndofs_global": res.ndofs_global,
+            "roofline": res.extra.get("roofline"),
+            "peak_memory_bytes": res.extra.get("peak_memory_bytes"),
+            "memory": res.extra.get("memory"),
+            "phase_s": res.extra.get("phase_s"),
+            "phase_share": res.extra.get("phase_share"),
+            "timing": res.extra.get("timing"),
+            "cg_engine_form": res.extra.get("cg_engine_form"),
+        })
+        print(f"*** Writing Chrome trace to: {args.trace} "
+              f"(journal: {obs_journal.path})")
 
     comp_type = "CG" if cfg.use_cg else "Action"
     print(f"Computation time ({comp_type}): {res.mat_free_time}s")

@@ -12,6 +12,8 @@ import numpy as np
 
 from ..bench.driver import record_engine
 from ..la.cg import cg_solve
+from ..obs import trace as obs_trace
+from ..obs.trace import BenchObserver
 from ..mesh.dofmap import global_ncells, global_ndofs
 from ..utils.compilation import (
     CPU_DF_DIST_OPTIONS,
@@ -27,6 +29,27 @@ from .operator import (
     shard_grid_blocks,
     unshard_grid_blocks,
 )
+
+
+def _stamp_collectives(extra: dict, nreps: int, elapsed: float,
+                       cg_fn, *args) -> None:
+    """Per-iteration collective-vs-compute attribution for the sharded
+    drivers (the overlap A/B's evidence): ``per_iter_s`` always (cheap
+    arithmetic), plus the TRACE-level per-iteration collective counts
+    (analysis.capture.loop_collective_counts — nothing executes) when
+    the obs tracer is enabled and the original engine actually ran (a
+    fallback's fn differs from the traced one, so counts would lie)."""
+    extra["per_iter_s"] = round(elapsed / max(nreps, 1), 9)
+    if not obs_trace.enabled() or "cg_engine_error" in extra:
+        return
+    try:
+        from ..analysis.capture import loop_collective_counts
+
+        counts = loop_collective_counts(cg_fn, *args)
+        extra["collectives_per_iter"] = {
+            k: int(v) for k, v in counts.items()}
+    except Exception:
+        pass  # attribution must never sink the benchmark
 
 
 def _resolve_overlap_mode(cfg, extra: dict, supported: bool,
@@ -190,6 +213,7 @@ def run_distributed(cfg, res, dtype):
     base_form = None
     res.ncells_global = global_ncells(n)
     res.ndofs_global = global_ndofs(n, cfg.degree)
+    obs = BenchObserver(cfg, run="dist")
 
     # Neither fast path needs O(global-dofs) host arrays: the kron flagship's
     # operator state is three 1D assemblies with a per-shard separable device
@@ -353,7 +377,8 @@ def run_distributed(cfg, res, dtype):
             B = batch_sharded_rhs(u, cfg.nrhs, dgrid)
             run_input = B
             # unfused path: the default scoped limit suffices (kron/xla)
-            fn = compile_lowered(jax.jit(cg_fn).lower(B, *cg_args))
+            with obs.phase("compile"):
+                fn = compile_lowered(jax.jit(cg_fn).lower(B, *cg_args))
             run_args = cg_args
         elif cfg.use_cg:
             def _rebuild_cg(eng, ovl):
@@ -370,11 +395,14 @@ def run_distributed(cfg, res, dtype):
                     # unfused folded fallback still runs the streamed
                     # corner kernels — keep the raised scoped request
                     opts = compile_opts
-                return compile_lowered(jax.jit(c).lower(u, *cg_args), opts)
+                with obs.phase("compile"):
+                    return compile_lowered(jax.jit(c).lower(u, *cg_args),
+                                           opts)
 
             try:
-                fn = compile_lowered(jax.jit(cg_fn).lower(u, *cg_args),
-                                     compile_opts)
+                with obs.phase("compile"):
+                    fn = compile_lowered(
+                        jax.jit(cg_fn).lower(u, *cg_args), compile_opts)
             except Exception as exc:
                 # Same hardening as the single-chip driver: a Mosaic/XLA
                 # rejection of the fused dist engine must not sink the
@@ -409,12 +437,13 @@ def run_distributed(cfg, res, dtype):
                     xx, _ = jax.lax.optimization_barrier((x, y))
                     return ap(xx, *a)
 
-                return compile_lowered(jax.jit(
-                    lambda x, *a: jax.lax.fori_loop(
-                        0, cfg.nreps, partial(_rep, x=x, a=a),
-                        jnp.zeros_like(x),
-                    )
-                ).lower(u, *apply_args), opts)
+                with obs.phase("compile"):
+                    return compile_lowered(jax.jit(
+                        lambda x, *a: jax.lax.fori_loop(
+                            0, cfg.nreps, partial(_rep, x=x, a=a),
+                            jnp.zeros_like(x),
+                        )
+                    ).lower(u, *apply_args), opts)
 
             try:
                 fn = _compile_action(apply_fn, compile_opts)
@@ -435,28 +464,20 @@ def run_distributed(cfg, res, dtype):
                     )
                     fn = _compile_action(apply_fn, compile_opts)
             run_args = apply_args
-        norm_c = compile_lowered(jax.jit(norm_fn).lower(u, *norm_args))
+        with obs.phase("compile"):
+            norm_c = compile_lowered(jax.jit(norm_fn).lower(u, *norm_args))
         # Warm-up executes the full compiled computation once: the first
         # execution pays program-load/buffer-init costs that are not
         # operator throughput. A cheaper 1-rep warm-up would need a SECOND
         # full compile of the CG loop (tens of seconds) to save a few
         # seconds of device time — net slower at every size we run.
-        warm = fn(run_input, *run_args)
-        float(warm[(0,) * warm.ndim])
-        del warm
+        with obs.phase("transfer"):
+            warm = fn(run_input, *run_args)
+            float(warm[(0,) * warm.ndim])
+            del warm
 
-    from contextlib import nullcontext
-
-    prof = (
-        jax.profiler.trace(cfg.profile_dir) if cfg.profile_dir
-        else nullcontext()
-    )
-    with prof:
-        t0 = time.perf_counter()
-        y = fn(run_input, *run_args)
-        y.block_until_ready()
-        float(y[(0,) * y.ndim])  # tunnel fence (see bench.driver)
-        elapsed = time.perf_counter() - t0
+    y = obs.timed_reps(lambda: fn(run_input, *run_args))
+    elapsed = obs.elapsed()
 
     if cfg.nrhs > 1:
         # lane 0 (scale 1.0) is the one-shot problem verbatim: norms and
@@ -469,6 +490,13 @@ def run_distributed(cfg, res, dtype):
     res.ynorm, res.ynorm_linf = float(yn[0]), float(yn[1])
     res.gdof_per_second = (
         res.ndofs_global * cfg.nreps * cfg.nrhs / (1e9 * elapsed))
+    from ..bench.driver import stamp_observability
+
+    stamp_observability(cfg, res, obs,
+                        "f32" if cfg.float_bits == 32 else "f64")
+    if cfg.use_cg and cfg.nrhs == 1:
+        _stamp_collectives(res.extra, cfg.nreps, elapsed, cg_fn, u,
+                           *cg_args)
 
     if cfg.mat_comp:
         from ..bench.driver import _mat_comp_oracle
@@ -568,6 +596,7 @@ def _run_distributed_folded_df(cfg, res):
         cfg, n, prebuilt=(n, rule, t, mesh)
     )
 
+    obs = BenchObserver(cfg, run="dist")
     with Timer("% Create matfree operator"):
         from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -603,26 +632,18 @@ def _run_distributed_folded_df(cfg, res):
             ).lower(u, state)
             run_args = (state,)
         try:
-            fn = compile_lowered(low, compile_opts,
-                                 cpu_extra=CPU_DF_DIST_OPTIONS)
+            with obs.phase("compile"):
+                fn = compile_lowered(low, compile_opts,
+                                     cpu_extra=CPU_DF_DIST_OPTIONS)
         except Exception as exc:
             return fallback("folded-df compile failed: " + exc_str(exc))
-        warm = fn(u, *run_args)
-        float(warm.hi[(0,) * warm.hi.ndim])
-        del warm
+        with obs.phase("transfer"):
+            warm = fn(u, *run_args)
+            float(warm.hi[(0,) * warm.hi.ndim])
+            del warm
 
-    from contextlib import nullcontext
-
-    prof = (
-        jax.profiler.trace(cfg.profile_dir) if cfg.profile_dir
-        else nullcontext()
-    )
-    with prof:
-        t0 = time.perf_counter()
-        y = fn(u, *run_args)
-        jax.block_until_ready(y)
-        float(y.hi[(0,) * y.hi.ndim])  # tunnel fence (see bench.driver)
-        res.mat_free_time = time.perf_counter() - t0
+    y = obs.timed_reps(lambda: fn(u, *run_args))
+    res.mat_free_time = obs.elapsed()
 
     norm_c = compile_lowered(jax.jit(norm_fn).lower(u, op.owned),
                              cpu_extra=CPU_DF_DIST_OPTIONS)
@@ -631,6 +652,12 @@ def _run_distributed_folded_df(cfg, res):
     res.gdof_per_second = (
         res.ndofs_global * cfg.nreps / (1e9 * res.mat_free_time)
     )
+    from ..bench.driver import stamp_observability
+
+    stamp_observability(cfg, res, obs, "df32")
+    if cfg.use_cg:
+        _stamp_collectives(res.extra, cfg.nreps, res.mat_free_time,
+                           cg_fn, u, state, op.owned)
 
     if cfg.mat_comp:
         z = _mat_comp_oracle(cfg, t, dm, bc_grid, b_host, G_host)
@@ -688,6 +715,7 @@ def run_distributed_df64(cfg, res):
             cfg, n, prebuilt=(n, rule, t, create_box_mesh(n))
         )
 
+    obs = BenchObserver(cfg, run="dist")
     with Timer("% Create matfree operator"):
         from ..la.df64 import df_from_f64
         from jax.sharding import NamedSharding, PartitionSpec as P
@@ -738,9 +766,10 @@ def run_distributed_df64(cfg, res):
 
             u_run = DF(_mk(u.hi), _mk(u.lo))
             cg_bat = make_kron_df_batched_cg_fn(op, dgrid, cfg.nreps)
-            fn = compile_lowered(
-                jax.jit(cg_bat).lower(u_run, op),
-                cpu_extra=CPU_DF_DIST_OPTIONS)
+            with obs.phase("compile"):
+                fn = compile_lowered(
+                    jax.jit(cg_bat).lower(u_run, op),
+                    cpu_extra=CPU_DF_DIST_OPTIONS)
             engine = False
         else:
             engine = resolve_df_engine(op)
@@ -754,11 +783,14 @@ def run_distributed_df64(cfg, res):
                 if engine else None)
         from ..la.df64 import df_zeros_like
 
+        built = {}  # the python cg fn that ran (collective attribution)
+
         def _build(eng, ovl=False):
             a_fn, c_fn, n_fn, n_from = make_kron_df_sharded_fns(
                 op, dgrid, cfg.nreps, engine=eng, overlap=ovl
             )
             if cfg.use_cg:
+                built["cg_fn"] = c_fn
                 low = jax.jit(c_fn).lower(u, op)
             else:
                 def _rep(i, y, x, A):
@@ -771,9 +803,10 @@ def run_distributed_df64(cfg, res):
                         df_zeros_like(x),
                     )
                 ).lower(u, op)
-            return n_fn, n_from, compile_lowered(
-                low, extra=opts if eng else None,
-                cpu_extra=CPU_DF_DIST_OPTIONS)
+            with obs.phase("compile"):
+                return n_fn, n_from, compile_lowered(
+                    low, extra=opts if eng else None,
+                    cpu_extra=CPU_DF_DIST_OPTIONS)
 
         if cfg.nrhs == 1:
             try:
@@ -798,22 +831,13 @@ def run_distributed_df64(cfg, res):
                     engine = False
                     record_engine(res.extra, False, error=exc)
                     norm_fn, norms_from, fn = _build(False)
-        warm = fn(u_run, op)
-        float(warm.hi[(0,) * warm.hi.ndim])
-        del warm
+        with obs.phase("transfer"):
+            warm = fn(u_run, op)
+            float(warm.hi[(0,) * warm.hi.ndim])
+            del warm
 
-    from contextlib import nullcontext
-
-    prof = (
-        jax.profiler.trace(cfg.profile_dir) if cfg.profile_dir
-        else nullcontext()
-    )
-    with prof:
-        t0 = time.perf_counter()
-        y = fn(u_run, op)
-        jax.block_until_ready(y)
-        float(y.hi[(0,) * y.hi.ndim])  # tunnel fence (see bench.driver)
-        res.mat_free_time = time.perf_counter() - t0
+    y = obs.timed_reps(lambda: fn(u_run, op))
+    res.mat_free_time = obs.elapsed()
 
     if cfg.nrhs > 1:
         # lane 0 (scale 1.0) is the one-shot problem verbatim; GDoF/s
@@ -827,6 +851,12 @@ def run_distributed_df64(cfg, res):
         res.ndofs_global * cfg.nreps * cfg.nrhs
         / (1e9 * res.mat_free_time)
     )
+    from ..bench.driver import stamp_observability
+
+    stamp_observability(cfg, res, obs, "df32")
+    if cfg.use_cg and cfg.nrhs == 1 and built.get("cg_fn") is not None:
+        _stamp_collectives(res.extra, cfg.nreps, res.mat_free_time,
+                           built["cg_fn"], u, op)
 
     if cfg.mat_comp:
         from ..bench.driver import _mat_comp_oracle

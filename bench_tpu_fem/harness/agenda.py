@@ -64,7 +64,9 @@ PRE = """
 import time, numpy as np, jax, jax.numpy as jnp
 from bench_tpu_fem.bench.driver import BenchConfig, run_benchmark
 def timed_res(cfg):
-    t0 = time.time(); res = run_benchmark(cfg); w = time.time()-t0
+    # monotonic, not time.time(): an NTP step mid-stage must not corrupt
+    # the journaled stage wall
+    t0 = time.monotonic(); res = run_benchmark(cfg); w = time.monotonic()-t0
     return res, w
 """
 
@@ -616,6 +618,11 @@ def main(argv=None) -> int:
                         choices=sorted(AGENDAS))
         sp.add_argument("--round", default=DEFAULT_ROUND,
                         help="round tag stamped on journal/log artifacts")
+        sp.add_argument("--trace", action="store_true",
+                        help="enable the obs span tracer: stage spans "
+                             "fold into the round journal as 'span' "
+                             "records (render with python -m "
+                             "bench_tpu_fem.obs --journal ...)")
     pr.add_argument("--resume", action="store_true",
                     help="skip journal-completed stages; honor persisted "
                          "gate outcomes")
@@ -624,6 +631,10 @@ def main(argv=None) -> int:
     pw.add_argument("--max-cycles", type=int, default=0,
                     help="probe attempts before giving up (0 = unbounded)")
     args = p.parse_args(argv)
+    if args.trace:
+        from ..obs.trace import enable
+
+        enable(journal=Journal(default_journal_path(ROOT, args.round)))
     if args.cmd == "run":
         runner = build_runner(args.stages or None, args.round, args.agenda)
         return runner.run(resume=args.resume)

@@ -28,6 +28,7 @@ import sys
 import time
 from dataclasses import dataclass, field
 
+from ..obs.trace import span
 from .classify import classify, classify_text
 from .journal import Journal, replay
 from .policy import DEGRADE, REPROBE, RETRY, StagePolicy, next_action
@@ -253,7 +254,10 @@ class Runner:
             self.journal.append({"event": "attempt_start",
                                  "stage": stage.name, "attempt": attempt,
                                  "size": size})
-            res = self.exec_stage(stage, ctx)
+            # stage span (obs.trace): no-op unless the tracer is enabled
+            # (harness CLI --trace sinks these into the round journal)
+            with span(f"stage:{stage.name}", attempt=attempt, size=size):
+                res = self.exec_stage(stage, ctx)
             tail = clean_tail(res.out, stage.tail)
             ok = (res.rc == 0 and not res.timed_out)
             if stage.check is not None:

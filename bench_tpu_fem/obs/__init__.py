@@ -1,0 +1,46 @@
+"""Unified tracing & telemetry (ISSUE 8).
+
+Three small, stdlib-first pieces with one contract between them — every
+number a benchmark, serve or harness run emits can be *attributed*:
+
+``obs.trace``
+    Hierarchical span tracer: context manager + decorator, thread-safe,
+    provably near-no-op when disabled. Exports Chrome trace-event JSON
+    (Perfetto-loadable), folds span records into the harness JSONL
+    journal, and emits ``jax.profiler.TraceAnnotation`` around spans so
+    they line up with TPU profiler timelines the moment a hardware
+    profile is taken (hardware-armed; CPU runs exercise the same code).
+
+``obs.roofline``
+    Analytic FLOP / HBM-byte cost model per engine form (degree x cells
+    x precision), cross-checked against the ``analysis.budgets`` VMEM
+    models and ``scripts/roofline_df.py`` — stamps arithmetic intensity
+    and achieved-vs-roofline fraction into every bench record.
+
+``obs.memory``
+    Device-memory telemetry: ``device.memory_stats()`` peak /
+    bytes-in-use around timed regions on hardware, process-RSS fallback
+    on CPU — stamped into bench records and the serve ``/metrics``.
+
+``python -m bench_tpu_fem.obs`` renders a journal + exported trace into
+a report (span tree, timer table, roofline table) and validates the
+trace JSON (rc 1 on schema violations) — see ``obs.report``.
+
+Evidence discipline (ROADMAP item 8): every stamp carries its evidence
+label — a CPU-measured share or an analytic design estimate is never
+presented as a hardware measurement.
+"""
+
+from .trace import (  # noqa: F401
+    BenchObserver,
+    Lifecycle,
+    SpanTracer,
+    enable,
+    disable,
+    enabled,
+    export_chrome_trace,
+    span,
+    traced,
+    tracer,
+    validate_chrome_trace,
+)

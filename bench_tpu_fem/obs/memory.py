@@ -1,0 +1,133 @@
+"""Device-memory telemetry: peak / bytes-in-use around timed regions.
+
+On hardware backends every JAX device exposes ``memory_stats()``
+(PJRT allocator counters: ``bytes_in_use``, ``peak_bytes_in_use``,
+``bytes_limit``); the host-CPU backend returns ``None``, so CPU runs
+fall back to process RSS from ``/proc/self/status`` (``VmRSS`` current,
+``VmHWM`` peak) — a HOST proxy, labelled as such, never presented as
+HBM telemetry (the evidence-hygiene rule).
+
+Stamp contract (``MemoryWatch.stamp`` / bench records):
+
+    "peak_memory_bytes": N,          # the one headline number
+    "memory": {
+        "source":  "device" | "process_rss",
+        "measured": "hardware" | "cpu-host",
+        "bytes_in_use": N,           # at stop()
+        "peak_bytes": N,             # max over devices (device source)
+        "baseline_bytes": N,         # at start()
+        "devices": K,                # device source only
+        "bytes_limit": N,            # device source, when reported
+    }
+
+``memory_summary()`` is the serve ``/metrics`` form of the same sample
+(no start/stop pair — a point-in-time reading).
+"""
+
+from __future__ import annotations
+
+import sys
+
+__all__ = ["device_memory_stats", "process_rss", "sample",
+           "memory_summary", "MemoryWatch"]
+
+
+def device_memory_stats() -> dict | None:
+    """Aggregate ``memory_stats()`` over the visible devices (sum of
+    bytes_in_use, MAX of per-device peaks — the binding constraint is
+    the fullest chip, not the fleet total). None when jax is not
+    imported or no device reports stats (host CPU)."""
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return None
+    try:
+        devs = jax.devices()
+    except Exception:
+        return None
+    per = []
+    for d in devs:
+        try:
+            st = d.memory_stats()
+        except Exception:
+            st = None
+        if st:
+            per.append(st)
+    if not per:
+        return None
+    out = {
+        "bytes_in_use": sum(int(s.get("bytes_in_use", 0)) for s in per),
+        "peak_bytes": max(int(s.get("peak_bytes_in_use",
+                                    s.get("bytes_in_use", 0)))
+                          for s in per),
+        "devices": len(per),
+    }
+    limits = [int(s["bytes_limit"]) for s in per if "bytes_limit" in s]
+    if limits:
+        out["bytes_limit"] = min(limits)
+    return out
+
+
+def process_rss() -> tuple[int, int]:
+    """(current RSS, peak RSS) in bytes. /proc on Linux; the resource
+    module's ru_maxrss (KiB on Linux) as the portable peak fallback."""
+    rss = hwm = 0
+    try:
+        with open("/proc/self/status") as fh:
+            for line in fh:
+                if line.startswith("VmRSS:"):
+                    rss = int(line.split()[1]) * 1024
+                elif line.startswith("VmHWM:"):
+                    hwm = int(line.split()[1]) * 1024
+    except OSError:
+        pass
+    if hwm == 0:
+        try:
+            import resource
+
+            hwm = resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
+        except Exception:
+            pass
+    return rss, max(hwm, rss)
+
+
+def sample() -> dict:
+    """One labelled memory sample: device allocator stats when any
+    device reports them, else the process-RSS host proxy."""
+    dev = device_memory_stats()
+    if dev is not None:
+        return {"source": "device", "measured": "hardware", **dev}
+    rss, hwm = process_rss()
+    return {"source": "process_rss", "measured": "cpu-host",
+            "bytes_in_use": rss, "peak_bytes": hwm}
+
+
+def memory_summary() -> dict:
+    """The serve /metrics form: a point-in-time sample (same labels)."""
+    return sample()
+
+
+class MemoryWatch:
+    """Before/after sampling around a timed region. ``stamp`` folds the
+    pair into the bench-record contract (peak_memory_bytes + the
+    labelled detail dict)."""
+
+    def __init__(self):
+        self.baseline: dict | None = None
+        self.final: dict | None = None
+
+    def start(self) -> "MemoryWatch":
+        self.baseline = sample()
+        return self
+
+    def stop(self) -> dict:
+        self.final = sample()
+        return self.final
+
+    def stamp(self, extra: dict) -> None:
+        if self.final is None:
+            self.stop()
+        fin = dict(self.final)
+        if self.baseline is not None:
+            fin["baseline_bytes"] = self.baseline.get("bytes_in_use", 0)
+        extra["peak_memory_bytes"] = int(fin.get("peak_bytes", 0))
+        extra["memory"] = fin

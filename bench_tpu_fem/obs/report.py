@@ -1,0 +1,243 @@
+"""The obs CLI: render a journal + exported Chrome trace into a report.
+
+    python -m bench_tpu_fem.obs [--journal MEASURE_r06.jsonl]
+                                [--trace trace.json]
+                                [--json] [--validate-only]
+
+Sections (text mode):
+
+  * trace validation — schema check of the Chrome trace-event JSON
+    (``obs.trace.validate_chrome_trace``); ANY violation exits rc 1
+    (the CI obs lane's contract);
+  * span tree — the hierarchical spans from the journal's ``span``
+    records and/or the trace file (parent links ride in ``args``);
+  * timer table — spans aggregated by name (count / total / max), the
+    ``utils.timing.timer_report`` shape derived from spans (the obs
+    replacement the timing module's deprecation note points at);
+  * roofline table — every journal record carrying a ``roofline`` stamp
+    (``bench_record`` events, weak-scaling rows), one line per record
+    with intensity / fraction / bound / evidence.
+
+``--json`` emits the folded report as one JSON object instead.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from .trace import validate_chrome_trace
+
+_TREE_MAX = 400  # spans rendered in the tree before truncation
+
+
+def load_trace(path: str) -> tuple[dict | None, list[str]]:
+    """(trace object, violations). An unreadable/unparseable file is a
+    violation, not an exception — the CLI must exit 1, not crash."""
+    try:
+        with open(path) as fh:
+            obj = json.load(fh)
+    except OSError as exc:
+        return None, [f"cannot read trace file: {exc}"]
+    except json.JSONDecodeError as exc:
+        return None, [f"trace file is not valid JSON: {exc}"]
+    return obj, validate_chrome_trace(obj)
+
+
+def spans_from_trace(obj: dict) -> list[dict]:
+    """Span records recovered from an exported Chrome trace (our export
+    carries span_id/parent/depth in args; foreign traces fall back to
+    flat spans)."""
+    out = []
+    for ev in obj.get("traceEvents", []):
+        if not isinstance(ev, dict) or ev.get("ph") != "X":
+            continue
+        args = ev.get("args") or {}
+        out.append({
+            "event": "span",
+            "span_id": args.get("span_id"),
+            "parent": args.get("parent"),
+            "name": ev.get("name", "?"),
+            "thread": ev.get("tid", 0),
+            "depth": args.get("depth", 0),
+            "t_start_s": float(ev.get("ts", 0)) / 1e6,
+            "dur_s": float(ev.get("dur", 0)) / 1e6,
+            "attrs": {k: v for k, v in args.items()
+                      if k not in ("span_id", "parent", "depth")},
+        })
+    return out
+
+
+def spans_from_journal(records: list[dict]) -> list[dict]:
+    return [r for r in records if r.get("event") == "span"]
+
+
+def render_span_tree(spans: list[dict]) -> str:
+    """Indent spans by their parent links, per thread, children in
+    start order. Spans without a resolvable parent root their thread's
+    tree."""
+    if not spans:
+        return "(no spans)"
+    by_id = {s.get("span_id"): s for s in spans
+             if s.get("span_id") is not None}
+    children: dict = {}
+    roots: list[dict] = []
+    for s in sorted(spans, key=lambda r: r.get("t_start_s", 0.0)):
+        pid = s.get("parent")
+        if pid is not None and pid in by_id:
+            children.setdefault(pid, []).append(s)
+        else:
+            roots.append(s)
+    lines: list[str] = []
+
+    def emit(s, indent):
+        if len(lines) >= _TREE_MAX:
+            return
+        attrs = s.get("attrs") or {}
+        extra = (" " + json.dumps(attrs, sort_keys=True)) if attrs else ""
+        lines.append(f"{'  ' * indent}{s.get('name', '?'):<{max(44 - 2 * indent, 8)}s}"
+                     f" {s.get('dur_s', 0.0) * 1e3:10.3f} ms{extra}")
+        for c in children.get(s.get("span_id"), []):
+            emit(c, indent + 1)
+
+    threads = sorted({s.get("thread", 0) for s in roots})
+    for tid in threads:
+        lines.append(f"-- thread {tid}")
+        for s in roots:
+            if s.get("thread", 0) == tid:
+                emit(s, 1)
+    if len(lines) >= _TREE_MAX:
+        lines.append(f"... truncated at {_TREE_MAX} lines "
+                     f"({len(spans)} spans)")
+    return "\n".join(lines)
+
+
+def timer_table(spans: list[dict]) -> dict[str, dict]:
+    out: dict[str, dict] = {}
+    for s in spans:
+        t = out.setdefault(s.get("name", "?"),
+                           {"count": 0, "total": 0.0, "max": 0.0})
+        t["count"] += 1
+        t["total"] += float(s.get("dur_s", 0.0))
+        t["max"] = max(t["max"], float(s.get("dur_s", 0.0)))
+    return out
+
+
+def render_timer_table(spans: list[dict]) -> str:
+    rows = [f"{'Span':<44s} {'count':>6s} {'total (s)':>12s} {'max (s)':>12s}"]
+    for name, t in sorted(timer_table(spans).items()):
+        rows.append(f"{name:<44s} {t['count']:>6d} {t['total']:>12.4f} "
+                    f"{t['max']:>12.4f}")
+    return "\n".join(rows)
+
+
+def roofline_rows(records: list[dict]) -> list[dict]:
+    rows = []
+    for r in records:
+        rl = r.get("roofline") or (r.get("result") or {}).get("roofline")
+        if not isinstance(rl, dict):
+            continue
+        rows.append({
+            "event": r.get("event", "?"),
+            "form": rl.get("form"),
+            "precision": rl.get("precision"),
+            "degree": rl.get("degree"),
+            "gdof_s": rl.get("achieved_gdof_s"),
+            "intensity": rl.get("intensity_flop_per_byte"),
+            "fraction": rl.get("fraction_of_ceiling"),
+            "bound": rl.get("bound"),
+            "evidence": rl.get("evidence"),
+        })
+    return rows
+
+
+def render_roofline_table(rows: list[dict]) -> str:
+    if not rows:
+        return "(no roofline-stamped records)"
+    out = [f"{'event':<16s} {'form':<18s} {'prec':<5s} {'deg':>3s} "
+           f"{'GDoF/s':>10s} {'flop/B':>8s} {'frac':>6s} {'bound':<9s} evidence"]
+    for r in rows:
+        out.append(
+            f"{str(r['event']):<16s} {str(r['form']):<18s} "
+            f"{str(r['precision']):<5s} {str(r['degree']):>3s} "
+            f"{(r['gdof_s'] if r['gdof_s'] is not None else 0):>10.4f} "
+            f"{(r['intensity'] or 0):>8.2f} {(r['fraction'] or 0):>6.3f} "
+            f"{str(r['bound']):<9s} {str(r['evidence'])[:48]}")
+    return "\n".join(out)
+
+
+def build_report(journal_path: str | None, trace_path: str | None) -> dict:
+    """Fold journal + trace into one report dict (the --json payload):
+    violations, spans (deduped: journal wins over trace replicas of the
+    same span_id), timer table, roofline rows, serve/bench counts."""
+    violations: list[str] = []
+    spans: list[dict] = []
+    records: list[dict] = []
+    if trace_path:
+        obj, violations = load_trace(trace_path)
+        if obj is not None and not violations:
+            spans.extend(spans_from_trace(obj))
+    if journal_path:
+        from ..harness.journal import read_records
+
+        records, corrupt = read_records(journal_path)
+        jspans = spans_from_journal(records)
+        if jspans:
+            seen = {s.get("span_id") for s in jspans
+                    if s.get("span_id") is not None}
+            spans = [s for s in spans
+                     if s.get("span_id") not in seen] + jspans
+        if corrupt:
+            violations.append(
+                f"journal: {len(corrupt)} corrupt line(s) (torn tail "
+                "excluded) — retained for audit")
+    return {
+        "violations": violations,
+        "valid": not violations,
+        "n_spans": len(spans),
+        "spans": spans,
+        "timers": timer_table(spans),
+        "roofline": roofline_rows(records),
+    }
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m bench_tpu_fem.obs",
+        description="Render a journal + Chrome trace into a report "
+                    "(span tree, timer table, roofline table); "
+                    "validates the trace JSON (rc 1 on violations).")
+    p.add_argument("--journal", default="",
+                   help="harness JSONL journal (span/bench records)")
+    p.add_argument("--trace", default="",
+                   help="exported Chrome trace-event JSON")
+    p.add_argument("--json", action="store_true",
+                   help="emit the folded report as one JSON object")
+    p.add_argument("--validate-only", action="store_true",
+                   help="only run the trace schema check")
+    args = p.parse_args(argv)
+    if not args.journal and not args.trace:
+        p.error("need --journal and/or --trace")
+    rep = build_report(args.journal or None, args.trace or None)
+    if args.json:
+        print(json.dumps(rep))
+    else:
+        if args.trace:
+            status = ("OK" if rep["valid"]
+                      else f"INVALID ({len(rep['violations'])})")
+            print(f"== trace validation: {status}")
+            for v in rep["violations"][:20]:
+                print(f"   {v}")
+        if not args.validate_only:
+            print("== span tree")
+            print(render_span_tree(rep["spans"]))
+            print("== timer table (from spans)")
+            print(render_timer_table(rep["spans"]))
+            print("== roofline table")
+            print(render_roofline_table(rep["roofline"]))
+    return 0 if rep["valid"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

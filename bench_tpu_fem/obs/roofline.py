@@ -1,0 +1,289 @@
+"""Analytic FLOP / HBM-byte cost model per engine form — the roofline
+stamp every bench record carries.
+
+The models are DESIGN ESTIMATES derived from the kernel structure (the
+same discipline as the VMEM plans in ``analysis.budgets``): counted from
+the shipped kernels' dataflow, never fitted to a measurement. Two
+anchors keep them honest, cross-checked by tests/test_obs.py on degrees
+{1, 3, 6}:
+
+* the df32 kron model REPLICATES ``scripts/roofline_df.py`` exactly
+  (``df_flops_per_dof`` / ``DF_BYTES_PER_DOF`` — the committed round-5
+  roofline analysis); a drift between the two is a test failure, not a
+  silent fork;
+* the folded G-stream traffic model ties to
+  ``ops.pallas_laplacian.stream_cell_bytes``'s VMEM accounting: the
+  kernel double-buffers the stream, so its VMEM term must equal exactly
+  2x the per-cell HBM bytes modelled here.
+
+Machine peaks: measured on-chip numbers from the newest
+``ROOFLINE_DF_r*.json`` at the repo root when one exists (the armed
+``scripts/roofline_df.py`` writes it), else labelled design estimates —
+a roofline *fraction* stamped from estimated peaks says so in its
+``evidence`` field (ROADMAP item 8: numbers carry provenance).
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+
+__all__ = [
+    "df_flops_per_dof", "DF_BYTES_PER_DOF", "folded_cell_flops",
+    "folded_g_stream_bytes_per_cell", "cost_model", "machine_peaks",
+    "roofline_stamp",
+]
+
+_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+# --- machine peaks ---------------------------------------------------------
+
+# Design-estimate peaks (v5e class) used until scripts/roofline_df.py has
+# measured the chip: HBM stream bandwidth from the datasheet ballpark,
+# VPU f32 rate from the kernel family's arithmetic port (the CG engines
+# are VPU elementwise/banded work, not MXU matmuls).
+DESIGN_PEAKS = {"hbm_gbps": 819.0, "vpu_f32_gflops": 4000.0}
+
+
+def machine_peaks(root: str = _ROOT) -> dict:
+    """{"hbm_gbps", "vpu_f32_gflops", "evidence"} — measured numbers
+    from the newest ROOFLINE_DF_r*.json when present (evidence names the
+    file), else the design table (evidence: "design-estimate")."""
+    candidates = sorted(glob.glob(os.path.join(root, "ROOFLINE_DF_r*.json")))
+    for path in reversed(candidates):
+        try:
+            with open(path) as fh:
+                data = json.load(fh)
+            hbm = float(data["hbm_gbps"])
+            vpu = float(data["vpu_f32_gflops"])
+        except (OSError, KeyError, TypeError, ValueError,
+                json.JSONDecodeError):
+            continue
+        return {"hbm_gbps": hbm, "vpu_f32_gflops": vpu,
+                "evidence": f"measured:{os.path.basename(path)}"}
+    return {**DESIGN_PEAKS, "evidence": "design-estimate"}
+
+
+# --- df32 kron model (MUST equal scripts/roofline_df.py) -------------------
+
+
+def df_flops_per_dof(P: int) -> int:
+    """Analytic VPU flop count per dof of one fused df CG iteration
+    (ops.kron_cg_df kernel + the XLA update pass) — the committed
+    round-5 model, replicated verbatim from scripts/roofline_df.py
+    (tests cross-check the two stay equal): per banded term ~28 flops
+    (_eft_term 13 + renorm 6 + accumulation 9); z stage 2 contractions,
+    y stage 3, x stage 2, each (2P+1) terms; + per-stage splits/renorms,
+    p-update, Dirichlet/dot, and the XLA-side x/r update + <r,r>."""
+    nb = 2 * P + 1
+    per_term = 28
+    contractions = (2 + 3 + 2) * nb * per_term
+    stage_overhead = 3 * 10 + 2 * 12
+    p_update = 40
+    emit = 6 + 4 + 30
+    xla_update = 30 + 30 + 35
+    return contractions + stage_overhead + p_update + emit + xla_update
+
+
+# kernel: r,p_prev in + p,y out, hi+lo each = 8 streams; XLA update:
+# read x,p,r,y + write x,r (hi+lo) = 12 streams + ~2 effective re-reads
+# for the <r,r> tree (scripts/roofline_df.py's DF_BYTES_PER_DOF).
+DF_BYTES_PER_DOF = 8 * 4 + 14 * 4
+
+# --- f32 kron model --------------------------------------------------------
+
+# One fused f32 CG iteration: the same 7 banded contraction passes
+# (z 2 + y 3 + x 2) at 2 flops per (2P+1)-term, plus the in-kernel
+# p-update/emit (~6) and the XLA-side x/r axpys + <r,r> (~8).
+_KRON_F32_STAGE_PASSES = 7
+_KRON_F32_TAIL = 14
+
+
+def kron_f32_flops_per_dof(P: int, use_cg: bool = True) -> int:
+    nb = 2 * P + 1
+    apply_f = _KRON_F32_STAGE_PASSES * nb * 2
+    return apply_f + (_KRON_F32_TAIL if use_cg else 2)
+
+
+# f32 CG streams per dof: kernel r,p_prev in + p,y out (4) + XLA update
+# read x,p,r,y write x,r + ~1 dot re-read (7) = 11 f32 streams.
+KRON_F32_CG_STREAMS = 11
+# action: x in, y out through the ring (+ the barriered carry) = 3.
+KRON_F32_ACTION_STREAMS = 3
+# the unfused 3-stage composition materialises two stage temporaries
+# per apply (in+out each): +4 streams over the fused ring.
+UNFUSED_EXTRA_STREAMS = 4
+
+# --- folded (general-geometry) model ---------------------------------------
+
+# G·grad contraction at each quadrature point: 6 symmetric G entries
+# against 3 gradient components -> 9 multiplies + 6 adds.
+_G_DOT_GRAD_FLOPS = 15
+# corner mode recomputes the geometry chain (Jacobian, adjugate,
+# det/div) in-kernel per quadrature point instead of streaming G.
+GEOM_CHAIN_FLOPS_PER_QP = 85
+
+
+def folded_cell_flops(P: int, nq: int, geom: str = "g") -> int:
+    """Per-cell flops of one sum-factorized general-geometry apply:
+    three gradient components forward (dofs -> quad) and three transpose
+    components back, each a 3-stage 1D tensor contraction chain, plus
+    the per-quadrature-point G·grad (and, in corner mode, the in-kernel
+    geometry chain)."""
+    p1 = P + 1
+    chain = 2 * (nq * p1**3 + nq**2 * p1**2 + nq**3 * p1)
+    qp = _G_DOT_GRAD_FLOPS + (GEOM_CHAIN_FLOPS_PER_QP
+                              if geom == "corner" else 0)
+    return 6 * chain + qp * nq**3
+
+
+def folded_g_stream_bytes_per_cell(nq: int, itemsize: int = 4) -> int:
+    """Per-cell HBM traffic of the streamed geometry tensor: 6 symmetric
+    G entries per quadrature point, read once per apply. The kernel
+    double-buffers this stream, so ops.pallas_laplacian's VMEM model
+    carries exactly 2x this value (12*nq^3 of its 19*nq^3 term) — the
+    cross-check tests/test_obs.py pins."""
+    return 6 * nq**3 * itemsize
+
+
+# corner mode streams 24 corner coordinates + ~1 mask value per cell
+# instead of G.
+FOLDED_CORNER_VALUES_PER_CELL = 25
+
+
+# --- the per-form cost model -----------------------------------------------
+
+# double-float pairs double every stream; emulated f64 doubles width and
+# multiplies VPU work (software f64 on a chip without f64 units — the
+# measured ~70x throughput ratio proxied as a flop multiplier, a crude
+# but labelled estimate).
+_EMULATED_F64_FLOP_MULT = 70
+
+
+def cost_model(*, family: str, degree: int, qmode: int = 1,
+               precision: str = "f32", geom: str = "uniform",
+               form: str = "unfused", use_cg: bool = True) -> dict:
+    """FLOPs and HBM bytes per dof per CG iteration (or per apply when
+    ``use_cg`` is false) for one engine family:
+
+    ``kron``   uniform-mesh Kronecker/banded engines (ops.kron_cg[,_df])
+    ``folded`` general-geometry folded Pallas kernels (ops.folded*)
+    ``xla``    the einsum fallback (folded dataflow + gather/scatter
+               overhead — the crudest model here, labelled so)
+
+    Returns {"flops_per_dof", "hbm_bytes_per_dof",
+    "intensity_flop_per_byte", "model"}.
+    """
+    P = max(int(degree), 1)
+    nq = P + 1 + int(qmode)
+    fused = form not in ("unfused", "unknown")
+    note = "analytic-design-estimate"
+
+    if family == "kron":
+        if precision == "df32":
+            flops = df_flops_per_dof(P)
+            hbm = DF_BYTES_PER_DOF
+            if not use_cg:
+                flops = int(flops * 0.6)  # no XLA x/r update tail
+                hbm = 8 * 4
+        else:
+            itemsize = 8 if precision == "f64" else 4
+            flops = kron_f32_flops_per_dof(P, use_cg)
+            if precision == "f64":
+                flops *= _EMULATED_F64_FLOP_MULT
+                note = ("analytic-design-estimate (emulated-f64 flop "
+                        "multiplier is a measured-ratio proxy)")
+            streams = (KRON_F32_CG_STREAMS if use_cg
+                       else KRON_F32_ACTION_STREAMS)
+            if use_cg and not fused:
+                streams += UNFUSED_EXTRA_STREAMS
+            hbm = streams * itemsize
+    else:  # folded / xla: general geometry
+        itemsize = 8 if precision == "f64" else 4
+        dof_per_cell = P**3  # interior share: (nP+1)^3 / n^3 -> P^3
+        gmode = "corner" if geom == "corner" else "g"
+        cell_f = folded_cell_flops(P, nq, gmode)
+        if precision == "df32":
+            cell_f *= 13  # per-op EFT cost (la.df64 _eft_term)
+            itemsize = 8  # hi+lo pair per value
+        elif precision == "f64":
+            cell_f *= _EMULATED_F64_FLOP_MULT
+            note = ("analytic-design-estimate (emulated-f64 flop "
+                    "multiplier is a measured-ratio proxy)")
+        geom_stream = (FOLDED_CORNER_VALUES_PER_CELL * 4 if gmode == "corner"
+                       else folded_g_stream_bytes_per_cell(nq))
+        vec_streams = (KRON_F32_CG_STREAMS if use_cg
+                       else KRON_F32_ACTION_STREAMS)
+        if use_cg and not fused:
+            vec_streams += UNFUSED_EXTRA_STREAMS
+        flops = cell_f // dof_per_cell + (_KRON_F32_TAIL if use_cg else 0)
+        hbm = geom_stream // dof_per_cell + vec_streams * itemsize
+        if family == "xla":
+            # einsum path adds dofmap gather/scatter traffic per apply
+            hbm += 2 * 4 + 2 * itemsize
+            note = ("analytic-design-estimate (xla einsum path: folded "
+                    "dataflow + gather/scatter overhead, crudest model)")
+    flops = int(flops)
+    hbm = int(hbm)
+    return {
+        "flops_per_dof": flops,
+        "hbm_bytes_per_dof": hbm,
+        "intensity_flop_per_byte": round(flops / hbm, 4) if hbm else 0.0,
+        "model": note,
+    }
+
+
+_FAMILY_BY_BACKEND = {"kron": "kron", "pallas": "folded", "xla": "xla"}
+
+
+def roofline_stamp(extra: dict, *, degree: int, qmode: int,
+                   precision: str, backend: str, geom: str,
+                   use_cg: bool, gdof_s: float,
+                   platform: str | None = None,
+                   root: str = _ROOT) -> dict:
+    """Stamp ``extra["roofline"]`` from a finished benchmark: the cost
+    model for the form that RAN (``cg_engine_form``), achieved GB/s and
+    GFLOP/s at the measured GDoF/s, both roofline ceilings and the
+    achieved-vs-ceiling fraction, with the peaks' provenance. A CPU run
+    stamps its fraction against the TPU peaks with an explicit evidence
+    label (the fraction then reads "where this config would sit on the
+    chip's roofline at this rate" — a design aid, never a hardware
+    claim)."""
+    family = _FAMILY_BY_BACKEND.get(backend or "", "xla")
+    form = (extra.get("cg_engine_form")
+            or extra.get("engine_form", "unfused"))
+    model = cost_model(family=family, degree=degree, qmode=qmode,
+                       precision=precision, geom=geom, form=form,
+                       use_cg=use_cg)
+    peaks = machine_peaks(root)
+    hbm_pd = model["hbm_bytes_per_dof"]
+    flops_pd = model["flops_per_dof"]
+    ceil_bw = peaks["hbm_gbps"] / hbm_pd if hbm_pd else 0.0
+    ceil_fl = (peaks["vpu_f32_gflops"] / flops_pd) if flops_pd else 0.0
+    ceiling = min(ceil_bw, ceil_fl) if ceil_bw and ceil_fl else (
+        ceil_bw or ceil_fl)
+    on_tpu = (platform or "") == "tpu"
+    rl = {
+        "family": family,
+        "form": form,
+        "precision": precision,
+        "degree": int(degree),
+        **model,
+        "achieved_gdof_s": round(float(gdof_s), 4),
+        "achieved_gbps": round(float(gdof_s) * hbm_pd, 2),
+        "achieved_gflops": round(float(gdof_s) * flops_pd, 2),
+        "ceiling_bandwidth_gdof_s": round(ceil_bw, 3),
+        "ceiling_compute_gdof_s": round(ceil_fl, 3),
+        "ceiling_gdof_s": round(ceiling, 3),
+        "fraction_of_ceiling": (round(float(gdof_s) / ceiling, 4)
+                                if ceiling else 0.0),
+        "bound": "bandwidth" if ceil_bw <= ceil_fl else "compute",
+        "peaks": peaks,
+        "evidence": ("hardware" if on_tpu else
+                     "cpu-run vs chip peaks (placement on the roofline, "
+                     "not a throughput claim)"),
+    }
+    extra["roofline"] = rl
+    return rl

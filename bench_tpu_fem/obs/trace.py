@@ -1,0 +1,559 @@
+"""Hierarchical span tracer: context manager + decorator, thread-safe,
+near-no-op when disabled.
+
+Design constraints, in order:
+
+1. **Disabled is free.** The global ``span()`` helper is the form the
+   hot paths call; when tracing is off it returns a shared no-op context
+   manager after ONE attribute load — no allocation, no lock, no clock
+   read. A test bounds the overhead (tests/test_obs.py).
+2. **Stdlib only.** The harness (which must run when jax is wedged) and
+   the serve broker both import this module; jax is touched only when it
+   is ALREADY imported by the process (``sys.modules`` probe), in which
+   case every span also enters a ``jax.profiler.TraceAnnotation`` so
+   spans line up with TPU profiler timelines (hardware-armed: on CPU the
+   annotation is a cheap no-op; under an active on-device profiler
+   session it labels the device timeline).
+3. **Spans are evidence.** A tracer can sink every closed span into the
+   harness JSONL journal (``harness.journal.Journal`` — fsynced,
+   torn-tail tolerant) as ``{"event": "span", ...}`` records, and/or
+   export the whole run as Chrome trace-event JSON
+   (``export_chrome_trace`` — loads in Perfetto / chrome://tracing).
+   ``validate_chrome_trace`` is the schema checker the obs CLI and CI
+   lane run (rc 1 on violation).
+
+Span record schema (journal + ``SpanTracer.spans()``):
+
+    {"event": "span", "span_id": N, "parent": N|null, "name": ...,
+     "thread": tid, "depth": D, "t_start_s": ..., "dur_s": ...,
+     "attrs": {...}}
+
+``t_start_s`` is seconds since the tracer's epoch (``perf_counter``
+based — monotonic, immune to NTP steps).
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import os
+import sys
+import threading
+import time
+from functools import wraps
+
+__all__ = [
+    "SpanTracer", "span", "traced", "tracer", "enable", "disable",
+    "enabled", "export_chrome_trace", "validate_chrome_trace",
+    "Lifecycle", "BenchObserver",
+]
+
+
+def _jax_annotation(name: str):
+    """A ``jax.profiler.TraceAnnotation`` when jax is already imported
+    (never import jax from here — the harness must stay stdlib-only),
+    else None. Failures are swallowed: profiler plumbing must never
+    sink the traced computation."""
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return None
+    try:
+        return jax.profiler.TraceAnnotation(name)
+    except Exception:
+        return None
+
+
+class _SpanCtx:
+    """One open span: context manager handed out by SpanTracer.span()."""
+
+    __slots__ = ("_tracer", "name", "attrs", "span_id", "parent", "depth",
+                 "_t0", "_ann")
+
+    def __init__(self, tracer: "SpanTracer", name: str, attrs: dict):
+        self._tracer = tracer
+        self.name = name
+        self.attrs = attrs
+        self.span_id = None
+        self.parent = None
+        self.depth = 0
+        self._t0 = 0.0
+        self._ann = None
+
+    def __enter__(self):
+        self.span_id, self.parent, self.depth, self._t0 = (
+            self._tracer._open(self))
+        if self._tracer.annotate:
+            self._ann = _jax_annotation(self.name)
+            if self._ann is not None:
+                try:
+                    self._ann.__enter__()
+                except Exception:
+                    self._ann = None
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if self._ann is not None:
+            try:
+                self._ann.__exit__(exc_type, exc, tb)
+            except Exception:
+                pass
+        if exc_type is not None:
+            # a span that died carries the exception class: a trace with
+            # a hole in it should say why
+            self.attrs = dict(self.attrs)
+            self.attrs["error"] = exc_type.__name__
+        self._tracer._close(self)
+        return False
+
+
+class _Noop:
+    """The disabled-mode context manager: one shared instance, nothing
+    but two empty methods. ``as s`` still works (s is the singleton)."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NOOP = _Noop()
+
+
+class SpanTracer:
+    """Thread-safe hierarchical span recorder.
+
+    Per-thread nesting via ``threading.local`` stacks (a span's parent
+    is the innermost open span ON ITS OWN THREAD — the broker's
+    disposable solve threads each get an independent tree); closed spans
+    append to one locked list and optionally to a journal sink."""
+
+    def __init__(self, journal=None, annotate: bool = True,
+                 clock=time.perf_counter):
+        self.journal = journal
+        self.annotate = annotate
+        self._clock = clock
+        self._epoch = clock()
+        self._lock = threading.Lock()
+        self._spans: list[dict] = []
+        self._ids = itertools.count(1)
+        self._local = threading.local()
+
+    # -- recording ---------------------------------------------------------
+
+    def span(self, name: str, **attrs) -> _SpanCtx:
+        return _SpanCtx(self, name, attrs)
+
+    def _stack(self) -> list:
+        st = getattr(self._local, "stack", None)
+        if st is None:
+            st = self._local.stack = []
+        return st
+
+    def _open(self, ctx: _SpanCtx):
+        st = self._stack()
+        parent = st[-1].span_id if st else None
+        depth = len(st)
+        st.append(ctx)
+        return next(self._ids), parent, depth, self._clock()
+
+    def _close(self, ctx: _SpanCtx) -> None:
+        t1 = self._clock()
+        st = self._stack()
+        # tolerate out-of-order exits (a generator-held span closing
+        # late): pop ctx wherever it is, not blindly the top
+        if ctx in st:
+            st.remove(ctx)
+        rec = {
+            "event": "span",
+            "span_id": ctx.span_id,
+            "parent": ctx.parent,
+            "name": ctx.name,
+            "thread": threading.get_ident(),
+            "depth": ctx.depth,
+            "t_start_s": round(ctx._t0 - self._epoch, 9),
+            "dur_s": round(t1 - ctx._t0, 9),
+        }
+        if ctx.attrs:
+            rec["attrs"] = ctx.attrs
+        with self._lock:
+            self._spans.append(rec)
+        if self.journal is not None:
+            try:
+                self.journal.append(rec)
+            except Exception:
+                pass  # evidence sink failure must not sink the work
+
+    # -- reading / export --------------------------------------------------
+
+    def spans(self) -> list[dict]:
+        with self._lock:
+            return list(self._spans)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._spans.clear()
+        self._epoch = self._clock()
+
+    def chrome_trace(self) -> dict:
+        """Chrome trace-event JSON object (complete 'X' events,
+        microsecond timestamps). Loads in Perfetto / chrome://tracing;
+        span_id/parent ride along in args so the obs CLI can rebuild
+        the tree from the file alone."""
+        pid = os.getpid()
+        events = []
+        for s in self.spans():
+            args = {"span_id": s["span_id"], "parent": s["parent"],
+                    "depth": s["depth"]}
+            args.update(s.get("attrs", {}))
+            events.append({
+                "name": s["name"],
+                "cat": "bench_tpu_fem",
+                "ph": "X",
+                "ts": round(s["t_start_s"] * 1e6, 3),
+                "dur": round(s["dur_s"] * 1e6, 3),
+                "pid": pid,
+                "tid": s["thread"],
+                "args": args,
+            })
+        return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+    def export_chrome_trace(self, path: str) -> dict:
+        obj = self.chrome_trace()
+        with open(path, "w") as fh:
+            json.dump(obj, fh)
+        return obj
+
+
+# --------------------------------------------------------------------------
+# Global tracer + the near-no-op disabled fast path.
+
+_tracer = SpanTracer()
+_enabled = False
+
+
+def tracer() -> SpanTracer:
+    return _tracer
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def enable(journal=None, annotate: bool = True,
+           fresh: bool = False) -> SpanTracer:
+    """Turn the global tracer on (optionally sinking spans into a
+    harness Journal). ``fresh=True`` replaces the tracer (new epoch,
+    empty span list) — what the CLI does per run."""
+    global _tracer, _enabled
+    if fresh:
+        _tracer = SpanTracer(journal=journal, annotate=annotate)
+    else:
+        if journal is not None:
+            _tracer.journal = journal
+        _tracer.annotate = annotate
+    _enabled = True
+    return _tracer
+
+
+def disable() -> None:
+    global _enabled
+    _enabled = False
+
+
+def span(name: str, **attrs):
+    """The form hot paths call: a real span when tracing is enabled,
+    the shared no-op context manager otherwise (no allocation, no
+    clock read — the disabled-overhead test bounds this)."""
+    if not _enabled:
+        return _NOOP
+    return _tracer.span(name, **attrs)
+
+
+def traced(name: str | None = None, **attrs):
+    """Decorator twin of ``span``: ``@traced()`` uses the function's
+    qualname."""
+
+    def deco(fn):
+        label = name or fn.__qualname__
+
+        @wraps(fn)
+        def wrapper(*a, **kw):
+            if not _enabled:
+                return fn(*a, **kw)
+            with _tracer.span(label, **attrs):
+                return fn(*a, **kw)
+
+        return wrapper
+
+    return deco
+
+
+def export_chrome_trace(path: str) -> dict:
+    return _tracer.export_chrome_trace(path)
+
+
+# --------------------------------------------------------------------------
+# Chrome trace-event schema validation (the obs CLI / CI lane checker).
+
+_PHASES = frozenset("BEXibnsftPNODMVvRcCSp")
+
+
+def validate_chrome_trace(obj) -> list[str]:
+    """Schema check of a Chrome trace-event JSON object. Returns the
+    violation list (empty = valid). Checks the shape Perfetto's legacy
+    importer requires: a ``traceEvents`` array of event objects, each
+    with a string ``name``, a known single-char ``ph``, numeric
+    non-negative ``ts``, int ``pid``/``tid``, numeric non-negative
+    ``dur`` on complete ('X') events, and object ``args`` when
+    present."""
+    errs: list[str] = []
+    if not isinstance(obj, dict):
+        return [f"top level must be an object, got {type(obj).__name__}"]
+    events = obj.get("traceEvents")
+    if not isinstance(events, list):
+        return ["traceEvents must be an array"]
+    for i, ev in enumerate(events):
+        where = f"traceEvents[{i}]"
+        if not isinstance(ev, dict):
+            errs.append(f"{where}: event must be an object")
+            continue
+        if not isinstance(ev.get("name"), str) or not ev.get("name"):
+            errs.append(f"{where}: missing/empty string 'name'")
+        ph = ev.get("ph")
+        if not (isinstance(ph, str) and len(ph) == 1 and ph in _PHASES):
+            errs.append(f"{where}: 'ph' must be a known phase char, "
+                        f"got {ph!r}")
+        ts = ev.get("ts")
+        if not isinstance(ts, (int, float)) or isinstance(ts, bool) \
+                or ts < 0:
+            errs.append(f"{where}: 'ts' must be a non-negative number, "
+                        f"got {ts!r}")
+        for key in ("pid", "tid"):
+            v = ev.get(key)
+            if not isinstance(v, int) or isinstance(v, bool):
+                errs.append(f"{where}: '{key}' must be an int, got {v!r}")
+        if ph == "X":
+            dur = ev.get("dur")
+            if not isinstance(dur, (int, float)) or isinstance(dur, bool) \
+                    or dur < 0:
+                errs.append(f"{where}: complete event needs non-negative "
+                            f"numeric 'dur', got {dur!r}")
+        if "args" in ev and not isinstance(ev["args"], dict):
+            errs.append(f"{where}: 'args' must be an object")
+    return errs
+
+
+# --------------------------------------------------------------------------
+# Request lifecycle marks (the serve broker's enqueue→admit→solve→respond
+# arithmetic, replacing ad-hoc time.monotonic() subtraction).
+
+
+class Lifecycle:
+    """Monotonic lifecycle marks for one request. ``mark`` records the
+    FIRST occurrence of each named event (a retire/timeout race must not
+    rewrite history); ``breakdown`` folds the marks into the per-stage
+    deltas the response/journal carry."""
+
+    __slots__ = ("_clock", "marks")
+
+    #: canonical serve order; breakdown() reports deltas between the
+    #: present consecutive marks
+    ORDER = ("enqueue", "admit", "solve", "respond")
+
+    def __init__(self, clock=time.monotonic):
+        self._clock = clock
+        self.marks: dict[str, float] = {}
+
+    def mark(self, name: str) -> float:
+        t = self._clock()
+        self.marks.setdefault(name, t)
+        return t
+
+    def t(self, name: str) -> float | None:
+        return self.marks.get(name)
+
+    def since(self, name: str) -> float:
+        t0 = self.marks.get(name)
+        return 0.0 if t0 is None else self._clock() - t0
+
+    def breakdown(self) -> dict[str, float]:
+        """Per-stage deltas over the canonical order, e.g.
+        {"queue_wait_s": admit-enqueue, "solve_s": respond-solve,
+        "total_s": respond-enqueue}. Missing marks collapse their stage
+        to the next present one (a shed request has only
+        enqueue→respond = total)."""
+        present = [(n, self.marks[n]) for n in self.ORDER
+                   if n in self.marks]
+        out: dict[str, float] = {}
+        names = {("enqueue", "admit"): "queue_wait_s",
+                 ("admit", "solve"): "batch_form_s",
+                 ("solve", "respond"): "solve_s"}
+        for (a, ta), (b, tb) in zip(present, present[1:]):
+            out[names.get((a, b), f"{a}_to_{b}_s")] = round(tb - ta, 6)
+        if len(present) >= 2:
+            out["total_s"] = round(present[-1][1] - present[0][1], 6)
+        return out
+
+
+# --------------------------------------------------------------------------
+# The benchmark drivers' integration facade: phase spans + per-rep timing
+# distribution + device-memory watch, stamped into one results dict.
+
+
+class BenchObserver:
+    """One per benchmark run. Wraps the driver's three phases —
+    ``compile`` (AOT lowering+compilation), ``transfer`` (the warm-up
+    execution, which pays the one-time transfer/init costs), ``solve``
+    (the timed region) — in spans that always accumulate locally (phase
+    attribution is part of the record contract, tracer on or off) and
+    mirror into the global tracer when it is enabled.
+
+    ``solve_region`` additionally opens ``jax.profiler.trace`` when the
+    config carries a profile_dir — the five ad-hoc profiler sites the
+    drivers used to hand-roll — so device timelines and spans share one
+    entry point.
+
+    ``rep``/``elapsed`` implement the per-rep timing distribution: the
+    driver may execute the timed computation ``timing_reps`` times
+    (default 1 — byte-identical to the historical single measurement)
+    and the stamp carries min/median/max to expose warmup and jitter;
+    ``elapsed()`` (the number GDoF/s divides by) is the MEDIAN."""
+
+    def __init__(self, cfg=None, run: str = "bench"):
+        self.run = run
+        self.profile_dir = getattr(cfg, "profile_dir", "") if cfg else ""
+        self.timing_reps = max(int(getattr(cfg, "timing_reps", 1) or 1), 1)
+        self.phase_s: dict[str, float] = {}
+        self.walls: list[float] = []
+        self.warmup_s: float | None = None
+        from .memory import MemoryWatch
+
+        self._mem = MemoryWatch()
+        self._mem.start()
+
+    # -- phases ------------------------------------------------------------
+
+    class _Phase:
+        __slots__ = ("obs", "name", "inner", "extra_cms", "_t0")
+
+        def __init__(self, obs, name, extra_cms=()):
+            self.obs = obs
+            self.name = name
+            self.inner = None
+            self.extra_cms = list(extra_cms)
+            self._t0 = 0.0
+
+        def __enter__(self):
+            self.inner = span(f"{self.obs.run}:{self.name}")
+            self.inner.__enter__()
+            for cm in self.extra_cms:
+                cm.__enter__()
+            if not _enabled:
+                # the enabled tracer's span already annotates; with the
+                # tracer off the phase still labels the device timeline
+                ann = _jax_annotation(f"{self.obs.run}:{self.name}")
+                if ann is not None:
+                    try:
+                        ann.__enter__()
+                        self.extra_cms.append(ann)
+                    except Exception:
+                        pass
+            self._t0 = time.perf_counter()
+            return self
+
+        def __exit__(self, *exc):
+            dt = time.perf_counter() - self._t0
+            for cm in reversed(self.extra_cms):
+                try:
+                    cm.__exit__(*exc)
+                except Exception:
+                    pass
+            self.inner.__exit__(*exc)
+            self.obs.phase_s[self.name] = (
+                self.obs.phase_s.get(self.name, 0.0) + dt)
+            return False
+
+    def phase(self, name: str) -> "_Phase":
+        return self._Phase(self, name)
+
+    def solve_region(self):
+        """The timed region: span + (when cfg.profile_dir is set)
+        ``jax.profiler.trace`` writing device timelines there — the
+        drivers' historical profiler hook, now the same entry point as
+        the span."""
+        extra = []
+        if self.profile_dir:
+            jax = sys.modules.get("jax")
+            if jax is not None:
+                try:
+                    extra.append(jax.profiler.trace(self.profile_dir))
+                except Exception:
+                    pass
+        return self._Phase(self, "solve", extra_cms=extra)
+
+    # -- per-rep timing ----------------------------------------------------
+
+    def timed_reps(self, call):
+        """THE timed region, shared by every bench/dist driver path:
+        run ``call`` ``timing_reps`` times inside ``solve_region()``,
+        each rep walled around call -> ``jax.block_until_ready`` ->
+        a scalar fetch of the result (under the axon PJRT tunnel
+        block_until_ready can return before the device work drains;
+        fetching one scalar is a hard fence — 4-byte transfer, one
+        slice kernel, negligible vs the timed work). Double-float
+        results fence through their ``hi`` component. Returns the last
+        rep's result; ``elapsed()`` is the median wall."""
+        jax = sys.modules["jax"]  # the drivers imported it long ago
+        out = None
+        with self.solve_region():
+            for _ in range(self.timing_reps):
+                t0 = time.perf_counter()
+                out = call()
+                jax.block_until_ready(out)
+                arr = out.hi if hasattr(out, "hi") else out
+                float(arr[(0,) * arr.ndim])
+                self.rep(time.perf_counter() - t0)
+        return out
+
+    def rep(self, wall_s: float) -> None:
+        self.walls.append(float(wall_s))
+
+    def elapsed(self) -> float:
+        """Median of the recorded rep walls (== the single wall when
+        timing_reps is 1, the default)."""
+        if not self.walls:
+            return 0.0
+        s = sorted(self.walls)
+        return s[len(s) // 2]
+
+    # -- the stamp ---------------------------------------------------------
+
+    def stamp(self, extra: dict) -> None:
+        """Fold everything into the bench record: ``phase_s`` (absolute
+        seconds), ``phase_share`` (normalised over the attributed
+        phases), ``timing`` (per-rep distribution) and the memory
+        telemetry (``peak_memory_bytes`` + ``memory``)."""
+        total = sum(self.phase_s.values())
+        extra["phase_s"] = {k: round(v, 6) for k, v in self.phase_s.items()}
+        extra["phase_share"] = {
+            k: round(v / total, 4) if total > 0 else 0.0
+            for k, v in self.phase_s.items()
+        }
+        if self.warmup_s is None and "transfer" in self.phase_s:
+            # the transfer phase IS the warm-up execution (it pays the
+            # one-time transfer/init costs)
+            self.warmup_s = self.phase_s["transfer"]
+        timing = {
+            "reps": len(self.walls),
+            "min_s": round(min(self.walls), 6) if self.walls else 0.0,
+            "median_s": round(self.elapsed(), 6),
+            "max_s": round(max(self.walls), 6) if self.walls else 0.0,
+        }
+        if self.warmup_s is not None:
+            timing["warmup_s"] = round(self.warmup_s, 6)
+        extra["timing"] = timing
+        self._mem.stop()
+        self._mem.stamp(extra)

@@ -47,6 +47,7 @@ from collections import deque
 from dataclasses import dataclass, field
 
 from ..harness.classify import classify_exception
+from ..obs.trace import Lifecycle, span
 from .cache import NRHS_BUCKETS, ExecutableCache, nrhs_bucket
 from .engine import SolveSpec, build_solver, spec_cache_key
 from .metrics import Metrics
@@ -70,7 +71,14 @@ class PendingRequest:
     submitting thread waits on `done`. With continuous batching two
     threads can race to answer (the solve thread's retire loop vs the
     worker's timeout path), so the claim must be atomic — `done` alone
-    is a check-then-act hole."""
+    is a check-then-act hole.
+
+    ``lc`` carries the request's lifecycle marks
+    (enqueue -> admit -> solve -> respond, obs.trace.Lifecycle): every
+    latency the broker reports derives from these marks instead of
+    ad-hoc time.monotonic() arithmetic, and the per-stage breakdown
+    rides on the response/journal. ``enqueued`` is kept as an alias of
+    the enqueue mark (existing readers)."""
 
     id: str
     spec: SolveSpec
@@ -79,6 +87,10 @@ class PendingRequest:
     done: threading.Event = field(default_factory=threading.Event)
     result: dict | None = None
     answered: bool = False
+    lc: Lifecycle = field(default_factory=Lifecycle)
+
+    def __post_init__(self):
+        self.lc.marks.setdefault("enqueue", self.enqueued)
 
 
 def _spec_dict(spec: SolveSpec) -> dict:
@@ -246,6 +258,8 @@ class Broker:
         bucket = self._pick_bucket(spec, live)
         key = spec_cache_key(spec, bucket)
         cache_hit = self.cache.lookup(key) is not None
+        for p in batch:
+            p.lc.mark("admit")  # window-seeded members enter the batch
         # `members` grows with mid-solve admissions: the timeout/failure
         # paths below must answer every request the solve ever owned
         # (_respond skips the already-answered ones).
@@ -260,17 +274,21 @@ class Broker:
 
         def _run():
             try:
-                entry = self.cache.get_or_build(
-                    key, lambda: self._builder(spec, bucket))
-                solver = entry.executable
-                if self.continuous and getattr(
-                        solver, "supports_continuous", False):
-                    box["summary"] = self._solve_continuous(
-                        solver, spec, members, bucket, cache_hit,
-                        admit_deadline)
-                else:
-                    box["result"] = solver.solve(
-                        [p.scale for p in members])
+                with span("serve:solve", spec=_spec_dict(spec),
+                          bucket=bucket, live=len(members)):
+                    entry = self.cache.get_or_build(
+                        key, lambda: self._builder(spec, bucket))
+                    solver = entry.executable
+                    for p in members:
+                        p.lc.mark("solve")
+                    if self.continuous and getattr(
+                            solver, "supports_continuous", False):
+                        box["summary"] = self._solve_continuous(
+                            solver, spec, members, bucket, cache_hit,
+                            admit_deadline)
+                    else:
+                        box["result"] = solver.solve(
+                            [p.scale for p in members])
             except BaseException as exc:
                 box["error"] = exc
 
@@ -311,7 +329,6 @@ class Broker:
         res = box["result"]
         self.metrics.batch(_spec_dict(spec), live, res.nrhs_bucket,
                            cache_hit, res.wall_s, res.gdof_per_second)
-        now = time.monotonic()
         for lane, p in enumerate(batch):
             self._respond(p, {
                 "ok": True, "id": p.id,
@@ -327,7 +344,6 @@ class Broker:
                 "cache": "hit" if cache_hit else "miss",
                 "batch_wall_s": res.wall_s,
                 "gdof_per_second": res.gdof_per_second,
-                "latency_s": now - p.enqueued,
             })
 
     def _solve_continuous(self, solver, spec: SolveSpec, members: list,
@@ -390,12 +406,13 @@ class Broker:
                     "continuous": True,
                     "iters_run": int(iters[lane]),
                     "cache": "hit" if cache_hit else "miss",
-                    "latency_s": now - p.enqueued,
                 })
             free = [i for i, p in enumerate(lanes) if p is None]
             if free and now < admit_deadline:
                 for p in self._poll_compatible(spec, len(free)):
                     lane = free.pop(0)
+                    p.lc.mark("admit")
+                    p.lc.mark("solve")  # admitted into an in-flight solve
                     state = solver.cont_admit(state, lane, p.scale)
                     lanes[lane] = p
                     members.append(p)
@@ -440,11 +457,17 @@ class Broker:
             if pending.answered:
                 return
             pending.answered = True
+            # the lifecycle marks ARE the latency accounting: total and
+            # the per-stage breakdown ride on every response/journal line
+            pending.lc.mark("respond")
+            lifecycle = pending.lc.breakdown()
+            result["latency_s"] = latency = lifecycle.get("total_s", 0.0)
+            result["lifecycle_s"] = lifecycle
             pending.result = result
-        latency = time.monotonic() - pending.enqueued
         self.metrics.response(
             pending.id, bool(result.get("ok")), latency,
             failure_class=result.get("failure_class"),
             retriable=result.get("retriable"),
-            cache=result.get("cache"))
+            cache=result.get("cache"),
+            lifecycle=lifecycle)
         pending.done.set()

@@ -163,11 +163,17 @@ class Metrics:
     def response(self, req_id: str, ok: bool, latency_s: float,
                  failure_class: str | None = None,
                  retriable: bool | None = None,
-                 cache: str | None = None) -> None:
+                 cache: str | None = None,
+                 lifecycle: dict | None = None) -> None:
         rec = {"event": "serve_response", "id": req_id, "ok": ok,
                "latency_s": round(latency_s, 6)}
         if cache is not None:
             rec["cache"] = cache
+        if lifecycle:
+            # the request's lifecycle breakdown (enqueue->admit->solve->
+            # respond deltas, obs.trace.Lifecycle) — queue wait vs solve
+            # time attribution per response, replayable from the journal
+            rec["lifecycle_s"] = lifecycle
         if not ok:
             rec["failure_class"] = failure_class or "transient"
             rec["retriable"] = bool(retriable)
@@ -190,7 +196,8 @@ class Metrics:
 
     # -- snapshot ----------------------------------------------------------
 
-    def snapshot(self, cache_stats: dict | None = None) -> dict:
+    def snapshot(self, cache_stats: dict | None = None,
+                 memory: dict | None = None) -> dict:
         with self._lock:
             lat = sorted(self.latencies)
             warm = sorted(self.latencies_warm)
@@ -238,7 +245,88 @@ class Metrics:
             }
         if cache_stats is not None:
             out["cache"] = cache_stats
+        if memory is not None:
+            # device-memory telemetry (obs.memory): allocator stats on
+            # hardware, labelled process-RSS proxy on CPU
+            out["memory"] = memory
         return out
+
+
+# --------------------------------------------------------------------------
+# Prometheus text exposition (GET /metrics content negotiation).
+
+_PROM_PREFIX = "benchfem_serve_"
+# snapshot keys that are monotone counters (TYPE counter); everything
+# else numeric is a gauge
+_PROM_COUNTERS = frozenset({
+    "requests_total", "shed_total", "completed", "failed", "batches",
+    "padded_lanes_total", "midsolve_admissions",
+})
+
+
+def _prom_name(key: str) -> str:
+    out = "".join(c if c.isalnum() or c == "_" else "_" for c in key)
+    if out and out[0].isdigit():
+        out = "_" + out
+    return _PROM_PREFIX + out
+
+
+def _prom_escape(v: str) -> str:
+    return str(v).replace("\\", r"\\").replace('"', r'\"').replace(
+        "\n", r"\n")
+
+
+def prometheus_text(snapshot: dict) -> str:
+    """Render a metrics snapshot as Prometheus text exposition format
+    (version 0.0.4 — what a standard scrape expects): one
+    ``# HELP``/``# TYPE`` header per metric, ``benchfem_serve_``-prefixed
+    names, labelled series for the per-class failure counts, and the
+    cache/memory sub-dicts flattened. Non-numeric leaves (e.g. the
+    memory source label) become ``_info``-style labelled gauges."""
+    lines: list[str] = []
+
+    def emit(key: str, value) -> None:
+        name = _prom_name(key)
+        kind = "counter" if key in _PROM_COUNTERS else "gauge"
+        lines.append(f"# HELP {name} serve metrics snapshot field "
+                     f"{key!r}")
+        lines.append(f"# TYPE {name} {kind}")
+        lines.append(f"{name} {float(value):g}")
+
+    for key, value in snapshot.items():
+        if isinstance(value, bool):
+            emit(key, int(value))
+        elif isinstance(value, (int, float)):
+            emit(key, value)
+        elif key == "failed_by_class" and isinstance(value, dict):
+            name = _prom_name("failed_by_class")
+            lines.append(f"# HELP {name} failed responses by harness "
+                         "failure class")
+            lines.append(f"# TYPE {name} counter")
+            for fc, n in sorted(value.items()):
+                lines.append(
+                    f'{name}{{failure_class="{_prom_escape(fc)}"}} '
+                    f"{float(n):g}")
+        elif isinstance(value, dict):
+            # cache/memory sub-dicts: numeric leaves flatten to
+            # <prefix><key>_<leaf>; string leaves become one labelled
+            # info gauge
+            info = {}
+            for leaf, lv in value.items():
+                if isinstance(lv, bool):
+                    emit(f"{key}_{leaf}", int(lv))
+                elif isinstance(lv, (int, float)):
+                    emit(f"{key}_{leaf}", lv)
+                else:
+                    info[leaf] = lv
+            if info:
+                name = _prom_name(f"{key}_info")
+                lab = ",".join(f'{k}="{_prom_escape(v)}"'
+                               for k, v in sorted(info.items()))
+                lines.append(f"# HELP {name} non-numeric {key} fields")
+                lines.append(f"# TYPE {name} gauge")
+                lines.append(f"{name}{{{lab}}} 1")
+    return "\n".join(lines) + "\n"
 
 
 def _pct(sorted_vals, q: float) -> float:

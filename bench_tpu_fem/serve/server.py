@@ -12,7 +12,11 @@ Endpoints:
                  -> 422 on deterministic failure (mosaic_reject/
                     accuracy_fail/unsupported) — retrying cannot help
                  -> 400 on malformed requests
-  GET  /metrics  metrics snapshot + cache counters (JSON)
+  GET  /metrics  metrics snapshot + cache counters + device-memory
+                 telemetry. Content negotiation: JSON by default;
+                 Prometheus text exposition (0.0.4) when the Accept
+                 header asks for text/plain or openmetrics (what
+                 standard scrapers send), or with ?format=prometheus.
   GET  /healthz  {"ok": true}
 
 ThreadingHTTPServer gives one handler thread per connection; every
@@ -52,12 +56,46 @@ def make_handler(broker: Broker, request_timeout_s: float = 300.0,
             self.end_headers()
             self.wfile.write(body)
 
+        def _send_text(self, code: int, body: str,
+                       content_type: str) -> None:
+            data = body.encode()
+            self.send_response(code)
+            self.send_header("Content-Type", content_type)
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
         def do_GET(self):  # noqa: N802
-            if self.path == "/healthz":
+            from urllib.parse import parse_qs, urlparse
+
+            url = urlparse(self.path)
+            if url.path == "/healthz":
                 self._send(200, {"ok": True})
-            elif self.path == "/metrics":
-                self._send(200, broker.metrics.snapshot(
-                    cache_stats=broker.cache.stats()))
+            elif url.path == "/metrics":
+                from ..obs.memory import memory_summary
+                from .metrics import prometheus_text
+
+                snap = broker.metrics.snapshot(
+                    cache_stats=broker.cache.stats(),
+                    memory=memory_summary())
+                accept = (self.headers.get("Accept", "") or "").lower()
+                fmt = (parse_qs(url.query).get("format", [""])[0]
+                       or "").lower()
+                # standard scrapers ask for text/plain (0.0.4) or
+                # openmetrics and never for application/json; JSON wins
+                # whenever the client lists it (e.g. the common
+                # composite default "application/json, text/plain, */*"
+                # must keep getting JSON — existing consumers)
+                want_prom = (fmt == "prometheus"
+                             or (("openmetrics" in accept
+                                  or "text/plain" in accept)
+                                 and "application/json" not in accept))
+                if want_prom:
+                    self._send_text(
+                        200, prometheus_text(snap),
+                        "text/plain; version=0.0.4; charset=utf-8")
+                else:
+                    self._send(200, snap)
             else:
                 self._send(404, {"ok": False, "error": "not found"})
 

