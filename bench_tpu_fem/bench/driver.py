@@ -18,6 +18,7 @@ measure the toolchain, not the hardware.
 
 from __future__ import annotations
 
+import os
 import time
 from dataclasses import dataclass, field
 from functools import partial
@@ -109,6 +110,21 @@ class BenchConfig:
     # recorded in `overlap_gate_reason`). Single-chip paths have no
     # collectives and ignore this.
     overlap: str = "auto"
+    # Durable CG checkpoints (ISSUE 9): > 0 runs the CG solve at
+    # iteration boundaries (la.checkpoint — the body is cg_solve's
+    # verbatim, so the chunked loop is bitwise the one-loop solve) and,
+    # with checkpoint_dir set, snapshots the solve state crash-safely
+    # every `checkpoint_every` iterations (harness.checkpoint): a killed
+    # process restores from the last snapshot instead of iteration 0.
+    # The fused whole-solve engines expose no boundary and are gated off
+    # with `checkpoint_gate_reason` recorded. 0 (the default) leaves the
+    # hot path untouched — same executables, same routing, bit-for-bit.
+    # Env defaults (BENCH_CHECKPOINT_EVERY / BENCH_CHECKPOINT_DIR) let
+    # harness stages opt in without payload changes.
+    checkpoint_every: int = field(default_factory=lambda: int(
+        os.environ.get("BENCH_CHECKPOINT_EVERY", "0") or 0))
+    checkpoint_dir: str = field(default_factory=lambda: os.environ.get(
+        "BENCH_CHECKPOINT_DIR", ""))
 
 
 @dataclass
@@ -215,6 +231,182 @@ BATCHED_UNFUSED_REASON = (
     "batched multi-RHS (nrhs>1): fused batching is unsupported on this "
     "path (no batched engine form); running the unfused vmapped apply")
 
+# The recorded reason every fused-engine branch stamps when durable
+# checkpointing is requested (ISSUE 9): the whole-solve engines bake
+# nreps into ONE executable and expose no iteration boundary to snapshot
+# at, so the driver runs the unfused checkpointable loop instead.
+CHECKPOINT_GATE_REASON = (
+    "durable checkpointing (checkpoint_every > 0): the fused whole-solve "
+    "engine exposes no iteration boundary; running the unfused "
+    "checkpointable loop (la.checkpoint)")
+
+
+def checkpoint_fingerprint(cfg: BenchConfig, kind: str,
+                           ndofs_global: int,
+                           backend: str = "") -> str:
+    """The solve identity a snapshot is keyed on: every field that
+    changes the CG trajectory. An OOM-ladder rung (different
+    ndofs_global), a precision change or an operator-backend flip
+    (kron/xla/pallas produce distinct f32 trajectories) gets a fresh
+    fingerprint — its snapshots can never restore into the wrong
+    solve. ``backend`` is the RESOLVED backend (res.extra), not the
+    raw --backend flag, so auto-resolution can't alias two operators
+    under one key."""
+    from ..harness.checkpoint import solve_fingerprint
+
+    return solve_fingerprint(
+        kind=kind, ndofs_global=int(ndofs_global), degree=cfg.degree,
+        qmode=cfg.qmode, float_bits=cfg.float_bits, nreps=cfg.nreps,
+        geom_perturb_fact=cfg.geom_perturb_fact,
+        f64_impl=cfg.f64_impl, use_gauss=cfg.use_gauss,
+        backend=backend or cfg.backend,
+        every=int(cfg.checkpoint_every))
+
+
+def stamp_checkpoint(extra: dict, cfg: BenchConfig, store,
+                     restored_it: int, saves: int) -> None:
+    """The checkpoint evidence stamp every checkpointed run carries:
+    cadence, durable-or-not, snapshots written, the iteration restored
+    from, and the evidence label (snapshot/restore on real HBM is
+    hardware-armed; off-TPU numbers are CPU-measured — ROADMAP item 8)."""
+    import jax
+
+    extra["checkpoint"] = {
+        "every": int(cfg.checkpoint_every),
+        "durable": store is not None,
+        "saves": int(saves),
+        "restored_iteration": int(restored_it),
+        "evidence": ("hardware" if jax.default_backend() == "tpu"
+                     else "cpu-measured"),
+    }
+
+
+def stamp_breakdown(extra: dict, ynorm) -> None:
+    """Breakdown sentinel stamp (ISSUE 9), shared by every driver: a
+    NaN/Inf solution must carry a recorded failure class, never pose as
+    a clean benchmark number."""
+    if not np.isfinite(ynorm):
+        extra["failure_class"] = "breakdown"
+        extra["breakdown"] = ("non-finite solution norm "
+                              f"({ynorm!r}): CG breakdown")
+
+
+def open_checkpoint(cfg: BenchConfig, res: BenchmarkResults, state_s,
+                    kind: str, nreps: int):
+    """Open the solve's CheckpointStore and restore its newest usable
+    snapshot (host-side pytree; sharded callers re-place it on device).
+    Shared by the single-chip f32/df and dist checkpointed paths so the
+    restore rules live in ONE place:
+
+    * a snapshot at or past ``nreps`` is a COMPLETED solve — restoring
+      it would replay zero iterations and journal a zero-work
+      "measurement" (gdof_per_second 0.0) on any retry that reuses the
+      stage's round-stable snapshot dir; a re-run measures fresh
+      instead, with the reason recorded;
+    * a mismatched snapshot (shape/dtype/field drift) restores NOTHING
+      (reason recorded) — wrong state is worse than restart.
+
+    Returns ``(store, host_state_or_None, restored_iteration)``."""
+    from ..harness.checkpoint import CheckpointStore
+    from ..la.checkpoint import state_from_host
+
+    store = CheckpointStore(
+        cfg.checkpoint_dir,
+        checkpoint_fingerprint(cfg, kind, res.ndofs_global,
+                               backend=res.extra.get("backend", "")))
+    snap = store.latest()
+    if snap is None:
+        return store, None, 0
+    it, arrays, _meta = snap
+    if int(it) >= nreps:
+        res.extra["checkpoint_restore_skipped"] = (
+            f"snapshot at iteration {int(it)} covers the whole solve "
+            f"(nreps {nreps}): completed run, measuring fresh")
+        # clear the WHOLE store, not just skip: left in place, the
+        # completed snapshot sorts newest-by-iteration forever and
+        # would shadow the mid-solve snapshot a later preemption of
+        # THIS retry leaves behind — re-disabling resume for good
+        store.clear()
+        return store, None, 0
+    try:
+        return store, state_from_host(state_s, arrays), int(it)
+    except ValueError as exc:
+        res.extra["checkpoint_restore_error"] = exc_str(exc)
+        return store, None, 0
+
+
+def checkpointed_loop(state, run_chunk, *, store, restored_it: int,
+                      nreps: int, k: int, kind: str, saves: dict,
+                      save: bool):
+    """Advance a restored (or fresh) iteration-boundary CG state to
+    ``nreps``, snapshotting at every boundary when a store is given —
+    the one loop all three checkpointed paths run. ``state_to_host``
+    fetches the carry (the boundary host sync the enabled path pays and
+    the disabled path provably does not)."""
+    from ..la.checkpoint import state_to_host
+
+    it = restored_it
+    while it < nreps:
+        state = run_chunk(state)
+        it = min(it + k, nreps)
+        if save and store is not None:
+            store.save(it, state_to_host(state),
+                       meta={"kind": kind, "nreps": nreps})
+            saves["n"] += 1
+    return state
+
+
+def _make_checkpointed_cg(cfg: BenchConfig, res: BenchmarkResults, obs,
+                          op, apply_fn, u, opts):
+    """Compile the iteration-boundary CG loop (la.checkpoint — cg_solve's
+    body verbatim, so the chunked loop is bitwise the one-loop solve) and
+    return ``run(save=True) -> x`` plus the restore bookkeeping.
+
+    With cfg.checkpoint_dir set, ``run`` snapshots the host-fetched state
+    every ``checkpoint_every`` iterations through the crash-safe
+    CheckpointStore, and a fresh process restores from the newest valid
+    snapshot instead of iteration 0 (torn/mismatched snapshots are
+    skipped by the store — a restore can never load another solve's
+    state). Without a dir the chunked loop still runs (the
+    measured-overhead A/B arm) but nothing is written."""
+    import jax
+
+    from ..la.checkpoint import cg_ckpt_init, cg_ckpt_run, make_cg_ckpt_step
+
+    k = int(cfg.checkpoint_every)
+    nreps = cfg.nreps
+
+    def _init(A, b):
+        return cg_ckpt_init(apply_fn(A), b)
+
+    def _run_chunk(A, s):
+        return cg_ckpt_run(s, make_cg_ckpt_step(apply_fn(A), nreps), k)
+
+    with obs.phase("compile"):
+        state_s = jax.eval_shape(_init, op, u)
+        init_fn = compile_lowered(jax.jit(_init).lower(op, u), opts)
+        run_fn = compile_lowered(jax.jit(_run_chunk).lower(op, state_s),
+                                 opts)
+
+    store = None
+    start_state = None
+    restored_it = 0
+    if cfg.checkpoint_dir:
+        store, start_state, restored_it = open_checkpoint(
+            cfg, res, state_s, "bench_cg", nreps)
+    saves = {"n": 0}
+
+    def run(save: bool = True):
+        state = start_state if start_state is not None else init_fn(op, u)
+        state = checkpointed_loop(
+            state, lambda s: run_fn(op, s), store=store,
+            restored_it=restored_it, nreps=nreps, k=k, kind="bench_cg",
+            saves=saves, save=save)
+        jax.block_until_ready(state.x)
+        return state.x
+
+    return run, store, restored_it, saves
+
 
 def batch_scales(nrhs: int) -> np.ndarray:
     """Per-lane RHS scales for the batched benchmark/serving path:
@@ -224,13 +416,22 @@ def batch_scales(nrhs: int) -> np.ndarray:
     return 2.0 ** (np.arange(nrhs) % 3).astype(np.float64)
 
 
-def stamp_nrhs(extra: dict, nrhs: int) -> None:
+def stamp_nrhs(extra: dict, nrhs: int, checkpoint_every: int = 0) -> None:
     """nrhs + its serving bucket, stamped into every batched artifact
-    line (the serve cache pads batches to these buckets)."""
+    line (the serve cache pads batches to these buckets). A batched run
+    that ASKED for durable checkpoints records why it got none: the
+    bench batched paths run whole-batch executables with no iteration
+    boundary (the serve broker's BatchedCGState checkpointing is a
+    different machine) — without the reason a preempted batched ladder
+    retry would silently restart at iteration 0."""
     from ..serve.cache import nrhs_bucket
 
     extra["nrhs"] = int(nrhs)
     extra["nrhs_bucket"] = nrhs_bucket(int(nrhs))
+    if checkpoint_every > 0:
+        extra["checkpoint_gate_reason"] = (
+            "batched (nrhs>1) bench paths run whole-batch executables "
+            "with no iteration boundary; snapshots disabled for this run")
 
 
 def _exec_cache_key(cfg: BenchConfig, n, form: str, kind: str):
@@ -471,6 +672,13 @@ def _run_benchmark_folded_df(cfg: BenchConfig) -> BenchmarkResults:
     # the folded df pipeline is the deliberately-unfused composition
     # (ops.folded_df v1) — no fused engine form exists for it yet
     record_engine(res.extra, False)
+    if cfg.use_cg and cfg.checkpoint_every > 0:
+        # no checkpointable boundary exists inside the folded df CG
+        # composition yet (its seam-fold state rides the kernel chain):
+        # recorded, runs the standard whole-solve executable
+        res.extra["checkpoint_gate_reason"] = (
+            "folded-df pipeline has no checkpointable loop form; "
+            "snapshots disabled for this run")
 
     # Host-assembled f64 RHS (the reference assembles its RHS on the CPU
     # too), split into df channels and folded per channel. The oracle
@@ -536,6 +744,56 @@ def _run_benchmark_folded_df(cfg: BenchConfig) -> BenchmarkResults:
         res.znorm = float(np.linalg.norm(z))
         res.enorm = float(np.linalg.norm(e))
     return res
+
+
+def _make_checkpointed_cg_df(cfg: BenchConfig, res: BenchmarkResults,
+                             obs, op, u, opts=None):
+    """The df (double-float) twin of ``_make_checkpointed_cg``:
+    ops.kron_df.cg_solve_df's body at iteration boundaries
+    (la.checkpoint.make_df_cg_ckpt_step — including its residual-floor
+    freeze), so the chunked loop is bitwise the uninterrupted df solve
+    and a restore continues it bit-for-bit."""
+    import jax
+
+    from ..la.checkpoint import (
+        cg_ckpt_run,
+        df_cg_ckpt_init,
+        make_df_cg_ckpt_step,
+    )
+
+    k = int(cfg.checkpoint_every)
+    nreps = cfg.nreps
+
+    def _init(b):
+        return df_cg_ckpt_init(b)
+
+    def _run_chunk(A, s):
+        return cg_ckpt_run(s, make_df_cg_ckpt_step(A.apply, nreps), k)
+
+    with obs.phase("compile"):
+        state_s = jax.eval_shape(_init, u)
+        init_fn = compile_lowered(jax.jit(_init).lower(u), None)
+        run_fn = compile_lowered(jax.jit(_run_chunk).lower(op, state_s),
+                                 opts)
+
+    store = None
+    start_state = None
+    restored_it = 0
+    if cfg.checkpoint_dir:
+        store, start_state, restored_it = open_checkpoint(
+            cfg, res, state_s, "bench_cg_df", nreps)
+    saves = {"n": 0}
+
+    def run(save: bool = True):
+        state = start_state if start_state is not None else init_fn(u)
+        state = checkpointed_loop(
+            state, lambda s: run_fn(op, s), store=store,
+            restored_it=restored_it, nreps=nreps, k=k, kind="bench_cg_df",
+            saves=saves, save=save)
+        jax.block_until_ready(state.x.hi)
+        return state.x
+
+    return run, store, restored_it, saves
 
 
 def _run_benchmark_df64(cfg: BenchConfig) -> BenchmarkResults:
@@ -616,6 +874,12 @@ def _run_benchmark_df64(cfg: BenchConfig) -> BenchmarkResults:
         form, kib = engine_plan_df(dof_grid_shape(n, cfg.degree),
                                    cfg.degree)
         engine = jax.default_backend() == "tpu"
+        ckpt = cfg.use_cg and cfg.checkpoint_every > 0
+        if ckpt and engine:
+            # same gate as the f32 driver: the fused df ring is one
+            # whole-solve executable with no boundary to snapshot at
+            engine = False
+            res.extra["checkpoint_gate_reason"] = CHECKPOINT_GATE_REASON
         compile_opts = scoped_vmem_options(kib) if engine else None
         record_engine(res.extra, engine, ENGINE_FORM_NAMES.get(form, form))
 
@@ -634,43 +898,60 @@ def _run_benchmark_df64(cfg: BenchConfig) -> BenchmarkResults:
                 return lambda A, b: cg_solve_df(A, b, cfg.nreps)
             return lambda A, b: action_df(A, b, cfg.nreps)
 
-        try:
-            with obs.phase("compile"):
-                fn = compile_lowered(
-                    _lower(_fused() if engine else _unfused()),
-                    compile_opts)
-        except Exception as exc:
-            if not engine:
-                raise
-            # Mosaic rejection of the fused df engine: retry the chunked
-            # form when the first pick was one-kernel (same policy as the
-            # f32 engine), then fall back to the unfused path, recording
-            # why. Compile errors only — execution errors propagate.
+        run_ck = ck_store = None
+        ck_restored = 0
+        ck_saves = {"n": 0}
+        if ckpt:
+            run_ck, ck_store, ck_restored, ck_saves = (
+                _make_checkpointed_cg_df(cfg, res, obs, op, u))
+            with obs.phase("transfer"):
+                warm = run_ck(save=False)
+                float(warm.hi[(0,) * warm.hi.ndim])
+                del warm
             fn = None
-            with obs.phase("compile"):
-                if form == "one":
-                    try:
-                        fn = compile_lowered(
-                            _lower(_fused(force_chunked=True)))
-                        # the one-kernel rejection is kept alongside: a
-                        # drifted tier boundary is only diagnosable from it
-                        res.extra["cg_engine_form"] = "chunked"
-                        res.extra["cg_engine_one_kernel_error"] = (
-                            exc_str(exc))
-                    except Exception as exc2:
-                        res.extra["cg_engine_retry_error"] = exc_str(exc2)
-                if fn is None:
-                    engine = False
-                    # the recorded form never ran — the unfused stamp must
-                    # not attribute unfused timings to an engine form
-                    record_engine(res.extra, False, error=exc)
-                    fn = compile_lowered(_lower(_unfused()))
-        with obs.phase("transfer"):
-            warm = fn(op, u)
-            float(warm.hi[(0,) * warm.hi.ndim])
-            del warm
+        else:
+            try:
+                with obs.phase("compile"):
+                    fn = compile_lowered(
+                        _lower(_fused() if engine else _unfused()),
+                        compile_opts)
+            except Exception as exc:
+                if not engine:
+                    raise
+                # Mosaic rejection of the fused df engine: retry the
+                # chunked form when the first pick was one-kernel (same
+                # policy as the f32 engine), then fall back to the
+                # unfused path, recording why. Compile errors only —
+                # execution errors propagate.
+                fn = None
+                with obs.phase("compile"):
+                    if form == "one":
+                        try:
+                            fn = compile_lowered(
+                                _lower(_fused(force_chunked=True)))
+                            # the one-kernel rejection is kept alongside:
+                            # a drifted tier boundary is only diagnosable
+                            # from it
+                            res.extra["cg_engine_form"] = "chunked"
+                            res.extra["cg_engine_one_kernel_error"] = (
+                                exc_str(exc))
+                        except Exception as exc2:
+                            res.extra["cg_engine_retry_error"] = (
+                                exc_str(exc2))
+                    if fn is None:
+                        engine = False
+                        # the recorded form never ran — the unfused stamp
+                        # must not attribute unfused timings to an engine
+                        # form
+                        record_engine(res.extra, False, error=exc)
+                        fn = compile_lowered(_lower(_unfused()))
+            with obs.phase("transfer"):
+                warm = fn(op, u)
+                float(warm.hi[(0,) * warm.hi.ndim])
+                del warm
 
-    y = obs.timed_reps(lambda: fn(op, u))
+    y = obs.timed_reps(run_ck if run_ck is not None
+                       else (lambda: fn(op, u)))
     res.mat_free_time = obs.elapsed()
 
     # Norms on device: L2 via the compensated df dot (f64-class); Linf on
@@ -691,9 +972,14 @@ def _run_benchmark_df64(cfg: BenchConfig) -> BenchmarkResults:
     with Timer("% Norms (device reduce)"):
         res.unorm, res.unorm_linf = norms(u)
         res.ynorm, res.ynorm_linf = norms(y)
-    res.gdof_per_second = ndofs_global * cfg.nreps / (
+    iters_timed = cfg.nreps - (ck_restored if run_ck is not None else 0)
+    res.gdof_per_second = ndofs_global * iters_timed / (
         1e9 * res.mat_free_time
     )
+    if run_ck is not None:
+        stamp_checkpoint(res.extra, cfg, ck_store, ck_restored,
+                         ck_saves["n"])
+    stamp_breakdown(res.extra, res.ynorm)
     stamp_observability(cfg, res, obs, "df32")
 
     if cfg.mat_comp:
@@ -726,7 +1012,7 @@ def _finish_batched(cfg: BenchConfig, res: BenchmarkResults, n, op, u,
     from ..la.cg import cg_solve_batched
     from ..la.vector import norm, norm_linf
 
-    stamp_nrhs(res.extra, cfg.nrhs)
+    stamp_nrhs(res.extra, cfg.nrhs, cfg.checkpoint_every)
     apply_one = (lambda A: A.apply_cg) if folded else (lambda A: A.apply)
     scales = jnp.asarray(batch_scales(cfg.nrhs), u.dtype)
     B = scales.reshape((-1,) + (1,) * u.ndim) * u[None]
@@ -845,7 +1131,7 @@ def _finish_batched_df(cfg: BenchConfig, res: BenchmarkResults, n, op, u,
     from ..la.df64 import DF, df_dot, df_to_f64
     from ..ops.kron_df import action_df, cg_solve_df
 
-    stamp_nrhs(res.extra, cfg.nrhs)
+    stamp_nrhs(res.extra, cfg.nrhs, cfg.checkpoint_every)
     record_engine(res.extra, False, error=BATCHED_UNFUSED_REASON)
     scales = jnp.asarray(batch_scales(cfg.nrhs), jnp.float32)
     sb = scales.reshape((-1,) + (1,) * u.hi.ndim)
@@ -1089,6 +1375,14 @@ def _run_benchmark(cfg: BenchConfig) -> BenchmarkResults:
         apply_fn = unfused_apply
         if engine:
             apply_fn = lambda A: partial(engine_apply, A)  # noqa: E731
+        ckpt = cfg.use_cg and cfg.checkpoint_every > 0
+        if ckpt and engine:
+            # durable checkpointing needs iteration boundaries; the
+            # fused whole-solve engines have none (CHECKPOINT_GATE_REASON)
+            engine = False
+            apply_fn = unfused_apply
+            res.extra["checkpoint_gate_reason"] = CHECKPOINT_GATE_REASON
+            record_engine(res.extra, False)
         # Executable-cache key: the PLANNED engine form (what the plan
         # functions deterministically pick for this config), so a repeat
         # of the same config finds the executable its first compile
@@ -1099,7 +1393,18 @@ def _run_benchmark(cfg: BenchConfig) -> BenchmarkResults:
             cfg, n, res.extra.get("cg_engine_form", "unfused"),
             "cg" if cfg.use_cg else "action")
         obs = BenchObserver(cfg)
-        if cfg.use_cg:
+        run_ck = ck_store = ck_saves = None
+        ck_restored = 0
+        if ckpt:
+            # the iteration-boundary loop (bitwise cg_solve — the body
+            # is verbatim) with durable snapshots at each boundary; the
+            # warm-up pays compile/transfer without writing snapshots
+            run_ck, ck_store, ck_restored, ck_saves = (
+                _make_checkpointed_cg(cfg, res, obs, op, apply_fn, u,
+                                      fallback_opts))
+            with obs.phase("transfer"):
+                warm = run_ck(save=False)
+        elif cfg.use_cg:
             fn = _exec_cache_get(cfg, exec_key, res)
             from_cache = fn is not None
             if fn is None and engine:
@@ -1215,8 +1520,11 @@ def _run_benchmark(cfg: BenchConfig) -> BenchmarkResults:
             float(warm[(0,) * warm.ndim])
             del warm
 
-    y = obs.timed_reps(lambda: fn(op, u, jnp.zeros_like(u))
-                       if cfg.use_cg else fn(op, u))
+    if run_ck is not None:
+        y = obs.timed_reps(run_ck)
+    else:
+        y = obs.timed_reps(lambda: fn(op, u, jnp.zeros_like(u))
+                           if cfg.use_cg else fn(op, u))
     elapsed = obs.elapsed()
 
     res.mat_free_time = elapsed
@@ -1226,7 +1534,14 @@ def _run_benchmark(cfg: BenchConfig) -> BenchmarkResults:
     res.ynorm = float(norm(y))
     res.unorm_linf = float(norm_linf(u))
     res.ynorm_linf = float(norm_linf(y))
-    res.gdof_per_second = ndofs_global * cfg.nreps / (1e9 * elapsed)
+    # a restored run only executed the REMAINING iterations: its rate
+    # must not be credited with the snapshot's pre-crash work
+    iters_timed = cfg.nreps - (ck_restored if run_ck is not None else 0)
+    res.gdof_per_second = ndofs_global * iters_timed / (1e9 * elapsed)
+    stamp_breakdown(res.extra, res.ynorm)
+    if run_ck is not None:
+        stamp_checkpoint(res.extra, cfg, ck_store, ck_restored,
+                         ck_saves["n"])
     stamp_observability(cfg, res, obs,
                         "f32" if cfg.float_bits == 32 else "f64")
 

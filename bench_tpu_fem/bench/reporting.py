@@ -67,4 +67,11 @@ def results_json(cfg: BenchConfig, res: BenchmarkResults) -> str:
                 "phase_share", "timing"):
         if key in res.extra:
             root["output"][key] = res.extra[key]
+    # fault-tolerance stamp (ISSUE 9): checkpoint cadence/saves/restore
+    # provenance + evidence label, and why checkpointing was gated or a
+    # snapshot was not restored — the record must say what recovered
+    for key in ("checkpoint", "checkpoint_gate_reason",
+                "checkpoint_restore_skipped", "checkpoint_restore_error"):
+        if key in res.extra:
+            root["output"][key] = res.extra[key]
     return json.dumps(root)

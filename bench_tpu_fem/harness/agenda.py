@@ -121,10 +121,14 @@ print({label!r}, res.gdof_per_second, res.extra{tail_expr})
 
 def _py(name, code, timeout, *, tries=1, gate=None, provides=None,
         size=None, floor=None, env=None, critical=False, parse=None,
-        tail=25):
+        tail=25, ckpt_every=0):
     """A python -c stage. ``size``/``floor`` opt the stage into the OOM
     degradation ladder: its payload carries the __NDOFS__ placeholder and
-    re-runs halved on a classified OOM down to ``floor``."""
+    re-runs halved on a classified OOM down to ``floor``.
+    ``ckpt_every`` opts the stage's bench runs into durable CG snapshots
+    (BENCH_CHECKPOINT_EVERY/DIR env -> BenchConfig): a wedge/preemption
+    retry or a --resume after SIGKILL restarts from the last snapshot
+    instead of iteration 0."""
     policy = StagePolicy(
         timeout_s=timeout,
         retry=RetryPolicy(max_attempts=max(tries, 1)),
@@ -139,13 +143,15 @@ def _py(name, code, timeout, *, tries=1, gate=None, provides=None,
 
     return Stage(name=name, command=command, policy=policy,
                  requires_gate=gate, provides_gate=provides, size=size,
-                 env=env, critical=critical, parse=parse, tail=tail)
+                 env=env, critical=critical, parse=parse, tail=tail,
+                 ckpt_every=ckpt_every)
 
 
-def _script(name, args, timeout, *, tail=15):
+def _script(name, args, timeout, *, tail=15, env=None):
     return Stage(name=name,
                  command=lambda ctx: [sys.executable] + list(args),
-                 policy=StagePolicy(timeout_s=timeout), tail=tail)
+                 policy=StagePolicy(timeout_s=timeout), tail=tail,
+                 env=env)
 
 
 # --------------------------------------------------------------------------
@@ -419,6 +425,14 @@ def make_stages(round_tag: str = DEFAULT_ROUND) -> dict[str, Stage]:
         # mid-solve admissions (continuous batching), warm-cache
         # hit-rate and zero recompiles. See README "Serving".
         _py("serve", SERVE_SMOKE, 300, env={"JAX_PLATFORMS": "cpu"}),
+        # Chaos/soak (ISSUE 9): scripted SIGKILL/worker-crash/NaN/
+        # preemption schedules against the live serve stack, asserting
+        # exactly-once, boundary-checkpoint resume, breakdown sentinels
+        # and bitwise preemption recovery. CPU-pinned (a software-
+        # recovery proof, not a hardware measurement — and it must never
+        # hang on a wedged tunnel).
+        _script("chaos", ["scripts/chaos_soak.py", "--quick"], 600,
+                env={"JAX_PLATFORMS": "cpu"}),
         # The fused batched engine on hardware (ISSUE 6): batched
         # GDoF/s at serve buckets 2/4/8 + the unfused A/B — converts
         # the per-bucket VMEM tiers from design estimates to
@@ -450,14 +464,23 @@ def make_stages(round_tag: str = DEFAULT_ROUND) -> dict[str, Stage]:
         # roughly doubles per-dof memory vs f32, and a downsized number
         # (journaled with the size measured) beats no number — the
         # generalized form of bench.py:run_df32_side_metric's loop.
+        # The capacity ladders carry durable CG snapshots (ISSUE 9,
+        # ckpt_every): these are the stages a preemption/wedge most
+        # often kills mid-solve, and a retried/resumed attempt restores
+        # from the last boundary instead of re-running the whole solve
+        # (a downsized OOM rung changes the fingerprint and measures
+        # fresh). The fused engines gate off under checkpointing — the
+        # ladder stages run the unfused df path anyway.
         _py("dflarge100", _bench_code("DFLARGE100M:", dict(
             ndofs_global=_NDOFS, degree=3, qmode=1, float_bits=64,
             nreps=50, use_cg=True, f64_impl="df32")),
-            2400, gate="dfacc", size=100_000_000, floor=25_000_000),
+            2400, gate="dfacc", size=100_000_000, floor=25_000_000,
+            ckpt_every=10),
         _py("dflarge150", _bench_code("DFLARGE150M:", dict(
             ndofs_global=_NDOFS, degree=3, qmode=1, float_bits=64,
             nreps=30, use_cg=True, f64_impl="df32")),
-            2400, gate="dfacc", size=150_000_000, floor=25_000_000),
+            2400, gate="dfacc", size=150_000_000, floor=25_000_000,
+            ckpt_every=10),
         # f32 capacity points (fixed sizes; the f32 ceiling climb is the
         # measurement itself, so no ladder — an OOM IS the data point).
         _py("large100", _bench_code("LARGE 100000000:", dict(
@@ -506,8 +529,8 @@ ALIASES = {
 # Round-6 default agenda, ordered by value-per-minute under wedge risk
 # (measure_all's ordering, expanded through ALIASES).
 AGENDAS = {
-    "round6": ["health", "serve", "fusedbatch", "dfacc", "pertdf",
-               "foldeng", "dfext2d", "scale", "dfeng", "bench",
+    "round6": ["health", "serve", "chaos", "fusedbatch", "dfacc",
+               "pertdf", "foldeng", "dfext2d", "scale", "dfeng", "bench",
                "dflarge", "pert100", "deg7probe", "matrix"],
 }
 

@@ -1,0 +1,110 @@
+"""Chaos schedules for the live serve stack (ISSUE 9): faults.py's
+scripted-outcome idea, extended from stage subprocesses to the serving
+layer's own fault seams so the recovery machinery is CPU-provable.
+
+Each helper drives ONE seam the recovery work owns, and each maps to a
+scripted fault in ``scripts/chaos_soak.py``'s schedules:
+
+  worker-thread crash   ``BoundaryCrashHook`` installed as
+                        ``serve.engine.BOUNDARY_HOOK`` raises at scripted
+                        iteration boundaries inside the broker's
+                        disposable solve thread; the broker's bounded
+                        retry must RESUME the batch from its parked
+                        boundary checkpoint (``serve_retry`` with
+                        resumed=true), not restart it at iteration 0.
+  injected NaN          a request submitted with ``scale=nan`` poisons
+                        exactly one lane's RHS; the breakdown sentinel
+                        must answer that request ``failure_class:
+                        "breakdown"`` while its batch-mates retire
+                        normally (lane algebra is independent).
+  preemption mid-CG     ``CHAOS_CKPT_KILL_AFTER=N`` (read by
+                        harness.checkpoint.CheckpointStore) SIGKILLs the
+                        process right after the Nth durable snapshot —
+                        the resumed solve must match the uninterrupted
+                        one bitwise (the la.checkpoint restore proof).
+  SIGKILL mid-batch     the soak script's generation driver: the parent
+                        SIGKILLs a serving child mid-incident, then the
+                        next generation replays the shared journal
+                        through ``Broker.recover``.
+  torn journal tail     ``tear_journal_tail`` appends a deliberately
+                        truncated record (the bytes a crash mid-write
+                        strands); recovery must drop exactly that line
+                        (``read_records``' torn-tail rule) and replay
+                        the request it failed to answer.
+
+The soak invariant the schedules are judged against is
+``serve.recovery.verify_exactly_once`` over the WHOLE journal — all
+generations appended to one file: every submitted request answered
+exactly once, no losses, no duplicates.
+
+stdlib-only (the serve imports are lazy): the harness package stays
+importable with the accelerator stack wedged.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+from .journal import _torn_tail
+
+
+class BoundaryCrash(RuntimeError):
+    """The scripted worker-thread death. The message classifies
+    `transient` (harness taxonomy) so the broker's bounded retry — not
+    the client — absorbs it."""
+
+    def __init__(self, boundary: int):
+        super().__init__(
+            f"Traceback: injected worker-thread crash at iteration "
+            f"boundary {boundary} (chaos schedule)")
+        self.boundary = boundary
+
+
+class BoundaryCrashHook:
+    """Scripted ``serve.engine.BOUNDARY_HOOK``: raises BoundaryCrash at
+    each scripted boundary index (indices count BOUNDARY_HOOK calls
+    across the broker's solve attempts, so ``crash_at=[2, 5]`` kills the
+    worker thread twice; a resumed attempt continues the count). Calls
+    are recorded for assertions."""
+
+    def __init__(self, crash_at):
+        self.crash_at = set(int(b) for b in crash_at)
+        self.calls = 0
+        self.crashes: list[int] = []
+
+    def __call__(self, spec, boundary_iter) -> None:
+        i = self.calls
+        self.calls += 1
+        if i in self.crash_at:
+            self.crash_at.discard(i)
+            self.crashes.append(i)
+            raise BoundaryCrash(i)
+
+
+def tear_journal_tail(path: str,
+                      rid: str = "r999999",
+                      event: str = "serve_response") -> str:
+    """Append a deliberately TORN record (no trailing newline, truncated
+    mid-value): byte-for-byte what a crash between ``write`` and the end
+    of ``Journal.append``'s line leaves behind. Returns the bytes
+    written. ``read_records`` must drop exactly this line, so a torn
+    ``serve_response`` must NOT count as answered (the client was never
+    released — the fsync never returned) and the request replays."""
+    frag = json.dumps({"event": event, "id": rid, "ok": True})[:-8]
+    with open(path, "a") as fh:
+        fh.write("\n" if _torn_tail(path) else "")
+        fh.write(frag)
+        fh.flush()
+        os.fsync(fh.fileno())
+    return frag
+
+
+def install_boundary_hook(hook):
+    """Install/uninstall helper (pairs with a try/finally):
+    ``prev = install_boundary_hook(h)`` ... ``install_boundary_hook(prev)``."""
+    from ..serve import engine as _engine
+
+    prev = _engine.BOUNDARY_HOOK
+    _engine.BOUNDARY_HOOK = hook
+    return prev

@@ -1,0 +1,182 @@
+"""Crash-safe host-side solve snapshots (the durable half of ISSUE 9's
+checkpointable CG: `la.checkpoint` owns the state algebra, this module
+owns the bytes).
+
+Write protocol (the journal's fsync discipline, applied to snapshots):
+
+    ckpt-<iteration>.ck.tmp  <- MAGIC | payload_len | crc32 | npz payload
+    flush + fsync            (the bytes are durable)
+    os.replace -> ckpt-<iteration>.ck   (atomic: readers see old or new,
+                                         never a torn file)
+    fsync(directory)         (the rename itself is durable)
+
+so a SIGKILL at ANY instant leaves either the previous snapshot intact
+or the new one complete — `latest()` walks snapshots newest-first,
+validates magic + length + CRC + the embedded meta, and silently skips
+anything torn (a `.tmp` the crash stranded, a truncated payload). A
+snapshot whose meta fingerprint does not match the restoring solve is
+skipped too: resuming a DIFFERENT problem's state would be worse than
+restarting.
+
+Only the newest `keep` snapshots are retained (pruned AFTER the new one
+is durable, so there is always at least one valid snapshot on disk once
+the first save completes).
+
+Chaos seam: ``CHAOS_CKPT_KILL_AFTER=N`` in the environment SIGKILLs the
+process right after the Nth successful save — the scripted
+"preemption mid-CG" fault `scripts/chaos_soak.py` drives (the kill
+lands after the rename+fsync, so the snapshot it proves recovery from
+is exactly the one a real preemption would leave behind).
+
+stdlib + numpy only (no jax): snapshots must be writable/readable from
+harness tooling even when the accelerator stack is wedged.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import json
+import os
+import signal
+import struct
+import zlib
+
+import numpy as np
+
+MAGIC = b"BTFCKPT1"
+_HEADER = struct.Struct(">QI")  # payload length, crc32
+
+
+def solve_fingerprint(**fields) -> str:
+    """Deterministic identity of one solve configuration (degree, grid,
+    nreps, precision, ...): snapshots only restore into the exact solve
+    that wrote them."""
+    blob = json.dumps(fields, sort_keys=True, default=str).encode()
+    return hashlib.sha256(blob).hexdigest()[:16]
+
+
+class CheckpointStore:
+    """Directory of durable snapshots for ONE solve (keyed by its
+    fingerprint — a store root may hold many solves' subdirectories)."""
+
+    def __init__(self, root: str, fingerprint: str, keep: int = 2,
+                 kill_after: int | None = None):
+        self.dir = os.path.join(root, fingerprint)
+        self.fingerprint = fingerprint
+        self.keep = max(int(keep), 1)
+        os.makedirs(self.dir, exist_ok=True)
+        if kill_after is None:
+            kill_after = int(os.environ.get("CHAOS_CKPT_KILL_AFTER", "0"))
+        self.kill_after = kill_after
+        self.saves = 0
+
+    # -- write -------------------------------------------------------------
+
+    def save(self, iteration: int, arrays: dict[str, np.ndarray],
+             meta: dict | None = None) -> str:
+        """Durably write one snapshot at `iteration`; returns its path.
+        `meta` rides inside the payload (fingerprint + iteration are
+        always stamped) and is validated on restore."""
+        meta = dict(meta or {})
+        meta["fingerprint"] = self.fingerprint
+        meta["iteration"] = int(iteration)
+        buf = io.BytesIO()
+        np.savez(buf, __meta__=np.frombuffer(
+            json.dumps(meta, sort_keys=True).encode(), np.uint8), **arrays)
+        payload = buf.getvalue()
+        path = os.path.join(self.dir, f"ckpt-{int(iteration):09d}.ck")
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as fh:
+            fh.write(MAGIC)
+            fh.write(_HEADER.pack(len(payload), zlib.crc32(payload)))
+            fh.write(payload)
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, path)
+        self._fsync_dir()
+        self.saves += 1
+        self._prune()
+        if self.kill_after and self.saves >= self.kill_after:
+            # chaos seam: die AFTER the snapshot is durable (see module
+            # docstring) — the recovery test's scripted preemption
+            os.kill(os.getpid(), signal.SIGKILL)
+        return path
+
+    def _fsync_dir(self) -> None:
+        try:
+            fd = os.open(self.dir, os.O_RDONLY)
+            try:
+                os.fsync(fd)
+            finally:
+                os.close(fd)
+        except OSError:
+            pass  # some filesystems refuse directory fsync; best-effort
+
+    def _prune(self) -> None:
+        snaps = self._snapshots()
+        for _, path in snaps[self.keep:]:
+            try:
+                os.remove(path)
+            except OSError:
+                pass
+
+    # -- read --------------------------------------------------------------
+
+    def _snapshots(self) -> list[tuple[int, str]]:
+        """(iteration, path) newest-first."""
+        out = []
+        try:
+            names = os.listdir(self.dir)
+        except OSError:
+            return []
+        for name in names:
+            if not (name.startswith("ckpt-") and name.endswith(".ck")):
+                continue
+            try:
+                it = int(name[5:-3])
+            except ValueError:
+                continue
+            out.append((it, os.path.join(self.dir, name)))
+        out.sort(reverse=True)
+        return out
+
+    def _read(self, path: str):
+        """One validated snapshot or None (torn/corrupt/mismatched —
+        recovery skips, never crashes on bad bytes)."""
+        try:
+            with open(path, "rb") as fh:
+                if fh.read(len(MAGIC)) != MAGIC:
+                    return None
+                head = fh.read(_HEADER.size)
+                if len(head) != _HEADER.size:
+                    return None
+                length, crc = _HEADER.unpack(head)
+                payload = fh.read(length)
+            if len(payload) != length or zlib.crc32(payload) != crc:
+                return None
+            with np.load(io.BytesIO(payload)) as z:
+                arrays = {k: z[k] for k in z.files if k != "__meta__"}
+                meta = json.loads(bytes(z["__meta__"]).decode())
+        except (OSError, ValueError, KeyError, json.JSONDecodeError):
+            return None
+        if meta.get("fingerprint") != self.fingerprint:
+            return None
+        return meta.get("iteration", 0), arrays, meta
+
+    def latest(self):
+        """Newest valid snapshot as (iteration, arrays, meta), or None.
+        Torn/corrupt snapshots are skipped (the crash case, by design —
+        the previous durable snapshot then wins)."""
+        for _, path in self._snapshots():
+            snap = self._read(path)
+            if snap is not None:
+                return snap
+        return None
+
+    def clear(self) -> None:
+        for _, path in self._snapshots():
+            try:
+                os.remove(path)
+            except OSError:
+                pass

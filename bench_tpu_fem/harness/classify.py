@@ -20,6 +20,24 @@ anything else):
                  dependent stages stay gated across resumes.
   timeout        the stage overran its budget with no wedge signature —
                  re-probe decides whether it was really a wedge.
+  preempted      the TPU worker/VM was preempted out from under the run
+                 (the maintenance/eviction notices preemptible fleets
+                 emit). Retriable by definition — the work was fine, the
+                 machine went away; with durable CG checkpoints
+                 (la.checkpoint + harness.checkpoint) the retry RESUMES
+                 from the last snapshot instead of iteration 0.
+  breakdown      the CG recurrence broke down numerically (non-finite
+                 residual norm, <p, A p> <= 0) — the la.cg sentinel
+                 classes (ISSUE 9). Deterministic for a given input:
+                 retrying the same solve reproduces it. The CG loops
+                 freeze on an exact-zero residual in-loop (never
+                 synthesize NaN out of exact convergence); the serve
+                 broker answers a non-finite lane `breakdown`
+                 lane-locally at retire; the bench drivers stamp the
+                 class on any non-finite solve record. The full in-loop
+                 guard set (NaN freeze at the last finite iterate,
+                 steepest-descent restart, stagnation counters) is the
+                 opt-in `cg_solve(sentinel=True)` carry.
   unsupported    a capability/plan gate declined the configuration
                  (folded_df_plan, engine_plan tiers) — not a fault, but a
                  recorded fallback still carries a class.
@@ -41,9 +59,19 @@ TAXONOMY = (
     "mosaic_reject",
     "accuracy_fail",
     "timeout",
+    "preempted",
+    "breakdown",
     "unsupported",
     "transient",
 )
+
+# Classes worth retrying (capacity/infrastructure went away, the work was
+# fine); everything else in the taxonomy is deterministic. The serve
+# broker's internal retry and the chaos invariants read this set;
+# StagePolicy.retry_on is deliberately narrower (oom and tunnel_wedge
+# have their own ladder/probe handling there, not a plain retry).
+RETRIABLE_CLASSES = frozenset(
+    {"transient", "timeout", "oom", "tunnel_wedge", "preempted"})
 
 # Pattern tables, first hit wins within a class. All matched case-
 # sensitively except where the compiled regex says otherwise: the strings
@@ -57,6 +85,22 @@ _MOSAIC_PAT = re.compile(
 _ACCURACY_PAT = re.compile(
     r"lost f64 accuracy|accuracy_fail|enorm/znorm exceeded|mat_comp mismatch"
     r"|engine did not engage"
+)
+_BREAKDOWN_PAT = re.compile(
+    r"CG breakdown|breakdown_restarts|non-?finite residual"
+    r"|failure_class.{0,4}breakdown|\bCGBreakdown\b"
+)
+# Real preemptible-fleet eviction notices: the Cloud TPU maintenance-
+# event phrasing, the libtpu/gRPC worker-restart ABORTED text, the GCE
+# instance-preempted operation, and the k8s pod-eviction message. These
+# must outrank the wedge patterns — the gRPC notice embeds UNAVAILABLE,
+# and a preemption is NOT a wedge (the machine is gone, not hung; the
+# right policy is resume-from-snapshot, not probe-and-wait).
+_PREEMPT_PAT = re.compile(
+    r"[Pp]reempt(?:ed|ion)|maintenance event"
+    r"|[Tt]he TPU worker .{0,40}(?:restarted|terminated)"
+    r"|instance was (?:preempted|terminated)"
+    r"|[Ee]victed pod|TerminationByKubernetes"
 )
 _WEDGE_PAT = re.compile(
     r"tunnel (?:unavailable|wedged|down)|TPU tunnel|DEADLINE_EXCEEDED"
@@ -80,10 +124,14 @@ def classify_text(text: str, timed_out: bool = False) -> str:
     # child that printed an OOM then hung in teardown is an OOM.
     if _ACCURACY_PAT.search(text):
         return "accuracy_fail"
+    if _BREAKDOWN_PAT.search(text):
+        return "breakdown"
     if _OOM_PAT.search(text):
         return "oom"
     if _MOSAIC_PAT.search(text):
         return "mosaic_reject"
+    if _PREEMPT_PAT.search(text):
+        return "preempted"
     if _WEDGE_PAT.search(text):
         return "tunnel_wedge"
     if _UNSUPPORTED_PAT.search(text):

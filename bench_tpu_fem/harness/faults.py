@@ -38,6 +38,14 @@ HANG_PARTIAL = (
     "% Build box mesh: 1.73s\n"
     "% Create matfree operator:"  # ...and then nothing, ever
 )
+# The libtpu/gRPC worker-restart notice a preempted Cloud TPU fleet
+# emits (embeds UNAVAILABLE: the preemption patterns must outrank the
+# wedge patterns, harness.classify) and the GCE operation text.
+PREEMPT_TEXT = (
+    "jaxlib.xla_extension.XlaRuntimeError: UNAVAILABLE: "
+    "The TPU worker at address 10.0.0.7:8471 restarted unexpectedly "
+    "(maintenance event: the instance was preempted)."
+)
 
 
 def ok(out: str = "STAGE OK", wall_s: float = 1.0) -> SubprocessResult:
@@ -65,6 +73,12 @@ def hang(partial: str = HANG_PARTIAL, wall_s: float = 900.0) -> SubprocessResult
     """Timed out + killed: rc None, PARTIAL output preserved (the
     evidence of where it hung)."""
     return SubprocessResult(None, partial, True, wall_s)
+
+
+def preempted(out: str = PREEMPT_TEXT) -> SubprocessResult:
+    """The machine went away mid-stage (SIGKILL'd by the fleet: negative
+    rc, the eviction notice in the tail)."""
+    return SubprocessResult(-9, out, False, 30.0)
 
 
 class Killed(BaseException):
@@ -159,6 +173,8 @@ class FaultySolveHook:
             raise RuntimeError(MOSAIC_TEXT)
         if outcome == "accuracy":
             raise RuntimeError(ACCURACY_TEXT)
+        if outcome == "preempt":
+            raise RuntimeError(PREEMPT_TEXT)
         if outcome == "hang":
             self.sleep(self.hang_s)
             return

@@ -74,9 +74,15 @@ class Journal:
         line = json.dumps(rec, sort_keys=True)
         # O_APPEND open per record: atomic single-write append even when
         # bench.py (journaling its parent attempts) and the harness runner
-        # share one journal file.
+        # share one journal file. A crash mid-write leaves a newline-less
+        # torn tail; gluing the next record onto it would destroy BOTH
+        # (read_records drops the merged line), so heal it first. The
+        # prepended newline rides in the same single write; if two
+        # recovering writers race the heal, the worst case is one empty
+        # line, which read_records skips.
         with open(self.path, "a") as fh:
-            fh.write(line + "\n")
+            prefix = "\n" if _torn_tail(self.path) else ""
+            fh.write(prefix + line + "\n")
             fh.flush()
             os.fsync(fh.fileno())
         return rec
@@ -84,6 +90,17 @@ class Journal:
     def records(self) -> list[dict]:
         recs, _ = read_records(self.path)
         return recs
+
+
+def _torn_tail(path: str) -> bool:
+    """True when the file's last byte is not a newline — the signature a
+    SIGKILL between ``write`` and the end of ``append`` leaves behind."""
+    try:
+        with open(path, "rb") as fh:
+            fh.seek(-1, os.SEEK_END)
+            return fh.read(1) != b"\n"
+    except (OSError, ValueError):
+        return False
 
 
 def _tail_seq(path: str) -> int:

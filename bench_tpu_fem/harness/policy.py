@@ -82,8 +82,11 @@ class StagePolicy:
     timeout_s: float = 900.0
     retry: RetryPolicy = field(default_factory=RetryPolicy)
     # Classes worth a plain same-size retry. Deterministic failures
-    # (mosaic_reject / accuracy_fail / unsupported) never are.
-    retry_on: tuple[str, ...] = ("transient", "timeout")
+    # (mosaic_reject / accuracy_fail / unsupported / breakdown) never
+    # are. `preempted` retries by definition — the work was fine, the
+    # machine went away; with durable CG checkpoints the retry resumes
+    # from the last snapshot instead of iteration 0.
+    retry_on: tuple[str, ...] = ("transient", "timeout", "preempted")
     # Bounded wedge recovery: how many probe×backoff rounds one stage may
     # spend waiting for the tunnel before the agenda aborts (wedges last
     # hours; the watch daemon re-arms at that horizon instead).

@@ -114,6 +114,15 @@ class Stage:
     provides_gate: str | None = None
     size: int | None = None         # initial OOM-ladder size
     env: dict | None = None         # stage-specific env overrides
+    # > 0: the stage's bench runs write durable CG snapshots every N
+    # iterations into a round-stable per-stage directory
+    # (BENCH_CHECKPOINT_EVERY/DIR env -> BenchConfig defaults), so a
+    # retried or resumed attempt — wedge recovery, preemption retry, a
+    # --resume after SIGKILL — restarts the solve from the last snapshot
+    # instead of iteration 0. OOM-ladder rungs change the problem size
+    # and therefore the snapshot fingerprint: a downsized rung measures
+    # fresh by construction (harness.checkpoint skips mismatches).
+    ckpt_every: int = 0
     critical: bool = False          # terminal failure aborts the agenda
     check: object = None            # callable(rc, out) -> bool (success)
     parse: object = None            # callable(out) -> dict | None (result)
@@ -151,6 +160,14 @@ class Runner:
         env = dict(self.base_env if self.base_env is not None else os.environ)
         if stage.env:
             env.update(stage.env)
+        if stage.ckpt_every > 0:
+            # durable-checkpoint opt-in (ISSUE 9): a round-stable
+            # per-stage snapshot dir, so every retry/resume of THIS
+            # stage restores the solve from its last snapshot
+            env.setdefault("BENCH_CHECKPOINT_EVERY", str(stage.ckpt_every))
+            env.setdefault("BENCH_CHECKPOINT_DIR", os.path.join(
+                self.cwd or ".", ".ckpt",
+                self.round_tag or "r0", stage.name))
         cmd = stage.command(ctx)
         return run_subprocess(cmd, stage.policy.timeout_s, env=env,
                               cwd=self.cwd)

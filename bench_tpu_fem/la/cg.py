@@ -84,6 +84,15 @@ def stacked_dot3(p: jnp.ndarray, y: jnp.ndarray,
                       inner_product(y, y)])
 
 
+def _sentinel_zero() -> dict:
+    """Fresh device-scalar sentinel carry (see `cg_solve(sentinel=)`)."""
+    i32 = jnp.int32
+    return {"breakdown_restarts": jnp.zeros((), i32),
+            "nonfinite": jnp.asarray(False),
+            "stag_run": jnp.zeros((), i32),
+            "stag_max": jnp.zeros((), i32)}
+
+
 def cg_solve(
     apply_A: Callable[[jnp.ndarray], jnp.ndarray],
     b: jnp.ndarray,
@@ -92,7 +101,8 @@ def cg_solve(
     rtol: float = 0.0,
     dot: Callable[[jnp.ndarray, jnp.ndarray], jnp.ndarray] | None = None,
     dot3: Callable | None = None,
-) -> jnp.ndarray:
+    sentinel: bool = False,
+):
     """Solve A x = b; returns x after `max_iter` iterations (rtol=0) or until
     ||r||/||r0|| < rtol. Early termination freezes the state rather than
     exiting the loop, keeping the iteration count static for XLA.
@@ -101,7 +111,20 @@ def cg_solve(
     runs the single-reduction recurrence (see onered_scalars): one fused
     reduction per iteration instead of two — the distributed overlap
     form's psum-count contract. Reassociated; parity vs the default
-    two-reduction loop is <= 1e-7 rel (f32) over benchmark budgets."""
+    two-reduction loop is <= 1e-7 rel (f32) over benchmark budgets.
+
+    With `sentinel=True` the loop carries the numerical-breakdown
+    sentinels (ISSUE 9) and returns `(x, info)` where info holds device
+    scalars: `breakdown_restarts` (iterations where <p, A p> <= 0 or
+    non-finite — routed to the graceful steepest-descent restart: the
+    step is skipped and the next direction is the bare residual),
+    `nonfinite` (a non-finite residual norm appeared; the state FREEZES
+    at the last finite iterate instead of propagating NaN into the
+    answer), and `stag_max` (longest run of non-decreasing residual
+    norms — a stall signature). All sentinels are jit-safe select
+    arithmetic on the scalars the loop already computes: no host sync
+    anywhere on the hot path, and on a healthy solve every selected
+    value is bit-identical to the unguarded loop."""
     if dot is None:
         dot = inner_product
 
@@ -111,32 +134,85 @@ def cg_solve(
     rnorm0 = dot(p, r)
 
     def body(_, state):
-        x, r, p, rnorm, done = state
+        x, r, p, rnorm, done, info = state
         y = apply_A(p)
         if dot3 is None:
-            alpha = rnorm / dot(p, y)
+            pdot = dot(p, y)
+            alpha = rnorm / pdot
+            if sentinel:
+                ok_p = jnp.logical_and(pdot > 0, jnp.isfinite(pdot))
+                alpha = jnp.where(ok_p, alpha, jnp.zeros((), alpha.dtype))
             x1 = x + alpha * p
             r1 = r - alpha * y
             rnorm_new = dot(r1, r1)
             beta = rnorm_new / rnorm
+            if sentinel:
+                # steepest-descent restart: a skipped step's next
+                # direction is the bare residual (beta = 0)
+                beta = jnp.where(ok_p, beta, jnp.zeros((), beta.dtype))
         else:
             pdot, ry, yy = dot3(p, y, r)
+            if sentinel:
+                ok_p = jnp.logical_and(pdot > 0, jnp.isfinite(pdot))
             alpha, rnorm_new, beta = onered_scalars(rnorm, pdot, ry, yy)
+            if sentinel:
+                alpha = jnp.where(ok_p, alpha, jnp.zeros((), alpha.dtype))
+                beta = jnp.where(ok_p, beta, jnp.zeros((), beta.dtype))
+                # the recurrence's rnorm_new was computed from the
+                # UN-zeroed alpha: on a skipped step the residual did not
+                # move, so its norm did not either
+                rnorm_new = jnp.where(ok_p, rnorm_new, rnorm)
             x1 = x + alpha * p
             r1 = r - alpha * y
         p1 = beta * p + r1
         new_done = jnp.logical_or(done, rnorm_new / rnorm0 < rtol * rtol)
-        keep = lambda new, old: jnp.where(done, old, new)
+        # exact-zero residual = converged EXACTLY (small problems under
+        # long budgets underflow there): freeze — one more iteration
+        # would synthesize NaN out of beta = 0/0 (ISSUE 9: never
+        # silently emit NaN; same guard as cg_solve_batched, keeping
+        # the lane-0-bitwise parity in the degenerate regime too).
+        # Benchmark-size problems never reach exact zero, so the
+        # standing bitwise contracts are untouched.
+        new_done = jnp.logical_or(
+            new_done, rnorm_new == jnp.zeros((), rnorm_new.dtype))
+        if sentinel:
+            bad_r = jnp.logical_not(jnp.isfinite(rnorm_new))
+            live = jnp.logical_not(done)
+            info = dict(info)
+            info["breakdown_restarts"] = info["breakdown_restarts"] + (
+                jnp.logical_and(live, jnp.logical_not(ok_p))
+                .astype(jnp.int32))
+            info["nonfinite"] = jnp.logical_or(
+                info["nonfinite"], jnp.logical_and(live, bad_r))
+            no_prog = jnp.logical_and(rnorm_new >= rnorm,
+                                      jnp.logical_not(bad_r))
+            stag = jnp.where(jnp.logical_and(live, no_prog),
+                             info["stag_run"] + 1,
+                             jnp.zeros((), jnp.int32))
+            info["stag_run"] = stag
+            info["stag_max"] = jnp.maximum(info["stag_max"], stag)
+            # a poisoned iterate freezes the state at the last finite
+            # one: the loop keeps running (static trip count) but every
+            # subsequent update is discarded
+            new_done = jnp.logical_or(new_done, bad_r)
+            hold = jnp.logical_or(done, bad_r)
+        else:
+            hold = done
+        keep = lambda new, old: jnp.where(hold, old, new)
         return (
             keep(x1, x),
             keep(r1, r),
             keep(p1, p),
             keep(rnorm_new, rnorm),
             new_done,
+            info,
         )
 
-    state = (x0, r, p, rnorm0, jnp.asarray(False))
-    x, *_ = jax.lax.fori_loop(0, max_iter, body, state)
+    state = (x0, r, p, rnorm0, jnp.asarray(False),
+             _sentinel_zero() if sentinel else {})
+    x, _, _, _, _, info = jax.lax.fori_loop(0, max_iter, body, state)
+    if sentinel:
+        return x, {k: v for k, v in info.items() if k != "stag_run"}
     return x
 
 
@@ -175,7 +251,8 @@ def cg_solve_batched(
     dot: Callable[[jnp.ndarray, jnp.ndarray], jnp.ndarray] | None = None,
     batch_apply: Callable[[jnp.ndarray], jnp.ndarray] | None = None,
     dot3: Callable | None = None,
-) -> jnp.ndarray:
+    sentinel: bool = False,
+):
     """Multi-RHS CG over a (nrhs, ...) stack: solve A x_i = b_i for every
     RHS in ONE static loop — the serving-layer batch primitive (each
     request contributes one RHS; launch/loop overhead amortises across
@@ -200,7 +277,14 @@ def cg_solve_batched(
     single-reduction recurrence (onered_scalars, vectorised per lane):
     ONE fused reduction carries all lanes' three dots per iteration —
     the batched analogue of the distributed overlap form's one-psum
-    contract (same reassociation, same parity envelope)."""
+    contract (same reassociation, same parity envelope).
+
+    With `sentinel=True` the loop carries per-lane breakdown sentinels
+    (the `cg_solve(sentinel=)` contract, vectorised) and returns
+    `(X, info)` with (nrhs,) arrays: `breakdown_restarts`, `nonfinite`
+    (that lane froze at its last finite iterate), `stag_max`. Lane
+    sentinels are independent: one poisoned lane never perturbs — or
+    stalls — its batch-mates."""
     if dot is None:
         dot = batched_dot
     if batch_apply is None:
@@ -212,37 +296,89 @@ def cg_solve_batched(
     rnorm0 = dot(P, R)
     # padding lanes (rnorm0 == 0) are born converged
     done0 = rnorm0 == jnp.zeros((), rnorm0.dtype)
+    nrhs = rnorm0.shape[0]
 
     def body(_, state):
-        X, R, P, rnorm, done = state
+        X, R, P, rnorm, done, info = state
         Y = batch_apply(P)
         if dot3 is None:
-            alpha = rnorm / dot(P, Y)
-            X1 = X + _bcast(alpha, X) * P
-            R1 = R - _bcast(alpha, R) * Y
-            rnorm_new = dot(R1, R1)
-            beta = rnorm_new / rnorm
+            pdot = dot(P, Y)
+            alpha = rnorm / pdot
         else:
             pdot, ry, yy = dot3(P, Y, R)
             alpha, rnorm_new, beta = onered_scalars(rnorm, pdot, ry, yy)
-            X1 = X + _bcast(alpha, X) * P
-            R1 = R - _bcast(alpha, R) * Y
+        if sentinel:
+            ok_p = jnp.logical_and(pdot > 0, jnp.isfinite(pdot))
+            alpha = jnp.where(ok_p, alpha, jnp.zeros((), alpha.dtype))
+        X1 = X + _bcast(alpha, X) * P
+        R1 = R - _bcast(alpha, R) * Y
+        if dot3 is None:
+            rnorm_new = dot(R1, R1)
+            beta = rnorm_new / rnorm
+        if sentinel:
+            beta = jnp.where(ok_p, beta, jnp.zeros((), beta.dtype))
+            if dot3 is not None:
+                # the single-reduction rnorm_new used the UN-zeroed
+                # alpha: a skipped lane's residual norm did not move
+                rnorm_new = jnp.where(ok_p, rnorm_new, rnorm)
         P1 = _bcast(beta, P) * P + R1
         new_done = jnp.logical_or(done, rnorm_new / rnorm0 < rtol * rtol)
+        # exact-zero residual = converged EXACTLY (small problems under
+        # long budgets underflow there): freeze the lane — one more
+        # iteration would synthesize NaN out of beta = 0/0 (ISSUE 9:
+        # never silently emit NaN solutions). Benchmark-size problems
+        # never reach exact zero, so the standing bitwise contracts are
+        # untouched.
+        new_done = jnp.logical_or(
+            new_done, rnorm_new == jnp.zeros((), rnorm_new.dtype))
+        if sentinel:
+            bad_r = jnp.logical_not(jnp.isfinite(rnorm_new))
+            live = jnp.logical_not(done)
+            info = dict(info)
+            info["breakdown_restarts"] = info["breakdown_restarts"] + (
+                jnp.logical_and(live, jnp.logical_not(ok_p))
+                .astype(jnp.int32))
+            info["nonfinite"] = jnp.logical_or(
+                info["nonfinite"], jnp.logical_and(live, bad_r))
+            no_prog = jnp.logical_and(rnorm_new >= rnorm,
+                                      jnp.logical_not(bad_r))
+            stag = jnp.where(jnp.logical_and(live, no_prog),
+                             info["stag_run"] + 1,
+                             jnp.zeros((), jnp.int32))
+            info["stag_run"] = stag
+            info["stag_max"] = jnp.maximum(info["stag_max"], stag)
+            new_done = jnp.logical_or(new_done, bad_r)
+            hold = jnp.logical_or(done, bad_r)
+        else:
+            hold = done
 
         def keep(new, old):
-            return jnp.where(_bcast(done, old), old, new)
+            return jnp.where(_bcast(hold, old), old, new)
+
+        def keep1(new, old):
+            return jnp.where(hold, old, new)
 
         return (
             keep(X1, X),
             keep(R1, R),
             keep(P1, P),
-            keep(rnorm_new, rnorm),
+            keep1(rnorm_new, rnorm),
             new_done,
+            info,
         )
 
-    state = (X0, R, P, rnorm0, done0)
-    X, *_ = jax.lax.fori_loop(0, max_iter, body, state)
+    if sentinel:
+        i32 = jnp.int32
+        info0 = {"breakdown_restarts": jnp.zeros((nrhs,), i32),
+                 "nonfinite": jnp.zeros((nrhs,), bool),
+                 "stag_run": jnp.zeros((nrhs,), i32),
+                 "stag_max": jnp.zeros((nrhs,), i32)}
+    else:
+        info0 = {}
+    state = (X0, R, P, rnorm0, done0, info0)
+    X, _, _, _, _, info = jax.lax.fori_loop(0, max_iter, body, state)
+    if sentinel:
+        return X, {k: v for k, v in info.items() if k != "stag_run"}
     return X
 
 
@@ -360,6 +496,12 @@ def make_batched_cg_step(engine: Callable, nreps: int,
             new_done = jnp.logical_or(
                 new_done, rnorm1 / rnorm0 < jnp.asarray(rtol * rtol,
                                                         rnorm1.dtype))
+        # exact-zero residual = exact convergence: freeze the lane (one
+        # more iteration would synthesize NaN from beta = 0/0) — same
+        # guard as cg_solve_batched, so the bitwise parity contract
+        # between the two holds in the degenerate regime too
+        new_done = jnp.logical_or(
+            new_done, rnorm1 == jnp.zeros((), rnorm1.dtype))
 
         def keep(new, old):
             return jnp.where(_bcast(done, old), old, new)

@@ -1,0 +1,229 @@
+"""Checkpointable CG state: the iteration-boundary form of `la.cg`.
+
+`cg_solve` / `cg_solve_df` run a whole solve inside ONE `fori_loop`
+executable — the benchmark shape, but also the shape a preemption kills
+at iteration 0: nothing inside the loop is observable, so a killed
+process restarts from scratch. This module re-exposes the SAME loop
+bodies at iteration boundaries (the continuous-batching move of
+`BatchedCGState`, applied to the scalar and df solves) so a solve can be
+advanced `k` iterations at a time, its carry fetched to the host,
+snapshotted crash-safely (`harness.checkpoint.CheckpointStore`) and
+restored into a fresh process.
+
+Parity contract (the restore proof, pinned by tests/test_checkpoint.py):
+
+* the step body is `cg_solve`'s body **verbatim** (same ops, same order
+  — not the p-update-reassociated fused recurrence), so a sequence of
+  chunked `fori_loop`s over it is bitwise-identical to the single-loop
+  solve, and a save/restore round-trip through host numpy (exact: array
+  bits move, nothing is recomputed) keeps the continuation bitwise too;
+* the df twin mirrors `ops.kron_df.cg_solve_df` the same way (including
+  its residual-floor freeze), so checkpointed df solves are bitwise the
+  uninterrupted ones;
+* overshoot is free: a lane frozen at `max_iter` (or by rtol) keeps its
+  state bit-for-bit through any number of extra step calls, so chunk
+  sizes need not divide the iteration budget.
+
+Fused whole-solve engines (ops.kron_cg / ops.folded_cg) bake `nreps`
+into one executable and expose no boundary — the drivers gate them off
+with a recorded reason when checkpointing is requested
+(`checkpoint_gate_reason`); the fused *batched* serving path checkpoints
+through `BatchedCGState`, whose per-executable envelope is the standing
+serve parity contract.
+
+Serialization is generic pytree <-> host-numpy (`state_to_host` /
+`state_from_host`): it covers `CGCkptState`, `DFCGCkptState` and
+`la.cg.BatchedCGState` (and any future NamedTuple state) without
+per-type code; shapes and dtypes are validated on restore so a snapshot
+from a different problem can never be silently loaded.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .vector import inner_product
+
+
+class CGCkptState(NamedTuple):
+    """One f32/f64 CG solve at an iteration boundary: exactly
+    `cg_solve`'s loop carry plus the boundary bookkeeping (`rnorm0` for
+    the rtol test, `iters` so overshot chunks freeze instead of running
+    past the budget)."""
+
+    x: jnp.ndarray
+    r: jnp.ndarray
+    p: jnp.ndarray
+    rnorm: jnp.ndarray
+    rnorm0: jnp.ndarray
+    done: jnp.ndarray
+    iters: jnp.ndarray
+
+
+def cg_ckpt_init(apply_A: Callable, b: jnp.ndarray,
+                 x0: jnp.ndarray | None = None,
+                 dot: Callable | None = None) -> CGCkptState:
+    """`cg_solve`'s preamble, verbatim (y = A x0; r = b - y; p = r)."""
+    if dot is None:
+        dot = inner_product
+    if x0 is None:
+        x0 = jnp.zeros_like(b)
+    y = apply_A(x0)
+    r = b - y
+    p = r
+    rnorm0 = dot(p, r)
+    return CGCkptState(x=x0, r=r, p=p, rnorm=rnorm0, rnorm0=rnorm0,
+                       done=jnp.asarray(False),
+                       iters=jnp.zeros((), jnp.int32))
+
+
+def make_cg_ckpt_step(apply_A: Callable, max_iter: int,
+                      rtol: float = 0.0,
+                      dot: Callable | None = None) -> Callable:
+    """One iteration `state -> state`, `cg_solve`'s body verbatim. While
+    `iters < max_iter` the select predicate equals `cg_solve`'s `done`,
+    so every kept value is bit-identical; past the budget the state
+    freezes (overshoot-safe chunking)."""
+    if dot is None:
+        dot = inner_product
+
+    def step(state: CGCkptState) -> CGCkptState:
+        x, r, p, rnorm, rnorm0, done, iters = state
+        y = apply_A(p)
+        alpha = rnorm / dot(p, y)
+        x1 = x + alpha * p
+        r1 = r - alpha * y
+        rnorm_new = dot(r1, r1)
+        beta = rnorm_new / rnorm
+        p1 = beta * p + r1
+        new_done = jnp.logical_or(done, rnorm_new / rnorm0 < rtol * rtol)
+        # cg_solve's exact-zero-residual freeze, mirrored VERBATIM (the
+        # bitwise contract): exact convergence must not synthesize NaN
+        # out of beta = 0/0 on the next iteration
+        new_done = jnp.logical_or(
+            new_done, rnorm_new == jnp.zeros((), rnorm_new.dtype))
+        hold = jnp.logical_or(done, iters >= jnp.int32(max_iter))
+        keep = lambda new, old: jnp.where(hold, old, new)  # noqa: E731
+        return CGCkptState(
+            x=keep(x1, x),
+            r=keep(r1, r),
+            p=keep(p1, p),
+            rnorm=keep(rnorm_new, rnorm),
+            rnorm0=rnorm0,
+            done=jnp.where(hold, done, new_done),
+            iters=jnp.where(hold, iters, iters + 1),
+        )
+
+    return step
+
+
+def cg_ckpt_run(state, step: Callable, k: int):
+    """Advance a checkpointable solve by k iteration boundaries in one
+    compiled `fori_loop` (frozen state is held bit-for-bit, so k need
+    not divide the remaining budget)."""
+    return jax.lax.fori_loop(0, k, lambda _, s: step(s), state)
+
+
+# ---------------------------------------------------------------------------
+# df twin: ops.kron_df.cg_solve_df at iteration boundaries.
+# ---------------------------------------------------------------------------
+
+
+class DFCGCkptState(NamedTuple):
+    """df (double-float) CG solve at an iteration boundary — the carry
+    of `ops.kron_df.cg_solve_df` (DF vectors/scalars) plus `rnorm0_hi`
+    (its closed-over floor reference) and the boundary bookkeeping."""
+
+    x: object  # DF
+    r: object  # DF
+    p: object  # DF
+    rnorm: object  # DF
+    rnorm0_hi: jnp.ndarray
+    done: jnp.ndarray
+    iters: jnp.ndarray
+
+
+def df_cg_ckpt_init(b) -> DFCGCkptState:
+    """`cg_solve_df`'s preamble verbatim: x0 = 0, r = p = b."""
+    from .df64 import df_dot, df_zeros_like
+
+    rnorm0 = df_dot(b, b)
+    return DFCGCkptState(x=df_zeros_like(b), r=b, p=b, rnorm=rnorm0,
+                         rnorm0_hi=rnorm0.hi, done=jnp.asarray(False),
+                         iters=jnp.zeros((), jnp.int32))
+
+
+def make_df_cg_ckpt_step(apply_A: Callable, max_iter: int) -> Callable:
+    """One df iteration `state -> state`: `cg_solve_df`'s body verbatim
+    — including its residual-floor freeze (rnorm.hi <= 1e-24 * rnorm0.hi)
+    — with the overshoot freeze added on top."""
+    from .df64 import df_add, df_axpy, df_div, df_dot, df_scale, df_sub
+
+    floor = jnp.float32(1e-24)
+
+    def step(state: DFCGCkptState) -> DFCGCkptState:
+        x, r, p, rnorm, rnorm0_hi, done, iters = state
+        y = apply_A(p)
+        alpha = df_div(rnorm, df_dot(p, y))
+        x1 = df_axpy(x, alpha, p)
+        r1 = df_sub(r, df_scale(y, alpha))
+        rnorm1 = df_dot(r1, r1)
+        beta = df_div(rnorm1, rnorm)
+        p1 = df_add(df_scale(p, beta), r1)
+        done1 = jnp.logical_or(done, rnorm1.hi <= floor * rnorm0_hi)
+        hold = jnp.logical_or(done, iters >= jnp.int32(max_iter))
+
+        def keep(new, old):
+            return jax.tree_util.tree_map(
+                lambda n, o: jnp.where(hold, o, n), new, old)
+
+        return DFCGCkptState(
+            x=keep(x1, x), r=keep(r1, r), p=keep(p1, p),
+            rnorm=keep(rnorm1, rnorm), rnorm0_hi=rnorm0_hi,
+            done=jnp.where(hold, done, done1),
+            iters=jnp.where(hold, iters, iters + 1),
+        )
+
+    return step
+
+
+# ---------------------------------------------------------------------------
+# Host <-> device serialization (generic over pytree states).
+# ---------------------------------------------------------------------------
+
+
+def state_to_host(state) -> dict[str, np.ndarray]:
+    """Flatten a CG state pytree to host numpy arrays keyed by leaf
+    index (`leaf_000`, ...). The flatten order is the pytree's — stable
+    for a given state type, which `state_from_host` re-derives from its
+    template, so no names need to survive in the snapshot."""
+    leaves = jax.tree_util.tree_leaves(state)
+    return {f"leaf_{i:03d}": np.asarray(leaf)
+            for i, leaf in enumerate(leaves)}
+
+
+def state_from_host(template, arrays: dict[str, np.ndarray]):
+    """Rebuild a state of `template`'s type/treedef from `state_to_host`
+    output. `template` may hold concrete arrays or
+    `jax.ShapeDtypeStruct`s (e.g. from `jax.eval_shape` over the init
+    function). Shape/dtype mismatches raise — a snapshot from a
+    different problem or precision must never load silently."""
+    leaves, treedef = jax.tree_util.tree_flatten(template)
+    if len(arrays) != len(leaves):
+        raise ValueError(
+            f"snapshot has {len(arrays)} leaves, state needs {len(leaves)}")
+    out = []
+    for i, ref in enumerate(leaves):
+        a = arrays[f"leaf_{i:03d}"]
+        ref_shape = tuple(ref.shape)
+        ref_dtype = np.dtype(ref.dtype)
+        if tuple(a.shape) != ref_shape or np.dtype(a.dtype) != ref_dtype:
+            raise ValueError(
+                f"snapshot leaf {i} is {a.dtype}{a.shape}, state needs "
+                f"{ref_dtype}{ref_shape}")
+        out.append(jnp.asarray(a))
+    return jax.tree_util.tree_unflatten(treedef, out)

@@ -12,8 +12,8 @@ Sections (text mode):
   * span tree — the hierarchical spans from the journal's ``span``
     records and/or the trace file (parent links ride in ``args``);
   * timer table — spans aggregated by name (count / total / max), the
-    ``utils.timing.timer_report`` shape derived from spans (the obs
-    replacement the timing module's deprecation note points at);
+    count/total/max shape derived from spans (also the renderer for
+    the legacy `%`-phase Timer registry — utils.timing);
   * roofline table — every journal record carrying a ``roofline`` stamp
     (``bench_record`` events, weak-scaling rows), one line per record
     with intensity / fraction / bound / evidence.
@@ -124,12 +124,21 @@ def timer_table(spans: list[dict]) -> dict[str, dict]:
     return out
 
 
-def render_timer_table(spans: list[dict]) -> str:
-    rows = [f"{'Span':<44s} {'count':>6s} {'total (s)':>12s} {'max (s)':>12s}"]
-    for name, t in sorted(timer_table(spans).items()):
+def render_timer_rows(timers: dict[str, dict]) -> str:
+    """Render a {name: {count, total, max}} aggregate as the timer
+    table. ONE renderer for both sources: span-derived aggregates
+    (``timer_table``) and the legacy `%`-phase Timer registry
+    (``utils.timing.aggregated_timings`` — the CLI's reference-parity
+    banner, whose deprecated ``timer_report`` shim this replaced)."""
+    rows = [f"{'Timer':<44s} {'count':>6s} {'total (s)':>12s} {'max (s)':>12s}"]
+    for name, t in sorted(timers.items()):
         rows.append(f"{name:<44s} {t['count']:>6d} {t['total']:>12.4f} "
                     f"{t['max']:>12.4f}")
     return "\n".join(rows)
+
+
+def render_timer_table(spans: list[dict]) -> str:
+    return render_timer_rows(timer_table(spans))
 
 
 def roofline_rows(records: list[dict]) -> list[dict]:

@@ -44,7 +44,8 @@ from .engine import (
     planned_engine_form,
     spec_cache_key,
 )
-from .metrics import Metrics, replay_serve
+from .metrics import Metrics, prometheus_text, replay_serve
+from .recovery import RecoveryPlan, fold_outstanding, verify_exactly_once
 from .server import make_server
 
 __all__ = [
@@ -57,13 +58,17 @@ __all__ = [
     "NRHS_BUCKETS",
     "QueueFull",
     "RETRIABLE_CLASSES",
+    "RecoveryPlan",
     "SolveSpec",
     "UnsupportedSpec",
     "build_solver",
     "default_cache",
+    "fold_outstanding",
     "make_server",
     "nrhs_bucket",
     "planned_engine_form",
+    "prometheus_text",
     "replay_serve",
     "spec_cache_key",
+    "verify_exactly_once",
 ]

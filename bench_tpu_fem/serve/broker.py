@@ -40,23 +40,22 @@ analogue of the harness runner's group-kill-and-continue.
 
 from __future__ import annotations
 
-import itertools
+import math
+import random
 import threading
 import time
 from collections import deque
 from dataclasses import dataclass, field
 
-from ..harness.classify import classify_exception
+# RETRIABLE_CLASSES (re-exported): classes worth a client retry
+# (capacity/infrastructure, now including `preempted`); everything else
+# in the taxonomy is deterministic — the ONE split, shared with the
+# stage-retry policy and the chaos invariants (harness.classify owns it).
+from ..harness.classify import RETRIABLE_CLASSES, classify_exception
 from ..obs.trace import Lifecycle, span
 from .cache import NRHS_BUCKETS, ExecutableCache, nrhs_bucket
 from .engine import SolveSpec, build_solver, spec_cache_key
 from .metrics import Metrics
-
-# Classes worth a client retry (capacity/infrastructure); everything
-# else in the taxonomy is deterministic — same split the stage-retry
-# policy uses.
-RETRIABLE_CLASSES = frozenset(
-    {"transient", "timeout", "oom", "tunnel_wedge"})
 
 
 class QueueFull(Exception):
@@ -88,6 +87,11 @@ class PendingRequest:
     result: dict | None = None
     answered: bool = False
     lc: Lifecycle = field(default_factory=Lifecycle)
+    # claim lock: PER REQUEST, not broker-global — the exactly-once
+    # contract only needs responders to the SAME request serialized;
+    # a global lock would funnel every response in the broker through
+    # one journal fsync at a time
+    lock: threading.Lock = field(default_factory=threading.Lock)
 
     def __post_init__(self):
         self.lc.marks.setdefault("enqueue", self.enqueued)
@@ -104,7 +108,9 @@ class Broker:
                  metrics: Metrics | None = None, *,
                  queue_max: int = 128, nrhs_max: int = 8,
                  window_s: float = 0.025, solve_timeout_s: float = 120.0,
-                 continuous: bool = True, builder=build_solver):
+                 continuous: bool = True, builder=build_solver,
+                 retry_max: int = 1, retry_backoff_s: float = 0.05,
+                 retry_jitter: float = 0.5, sleep=time.sleep, rng=None):
         self.cache = cache or ExecutableCache()
         self.metrics = metrics or Metrics()
         self.queue_max = queue_max
@@ -116,14 +122,21 @@ class Broker:
         # against (serve CLI --no-continuous).
         self.continuous = continuous
         self._builder = builder
+        # Broker-internal bounded retry (ISSUE 9): a batch whose solve
+        # fails with a RETRIABLE class is re-run up to `retry_max` times
+        # with exponential backoff + jitter (jitter so a fleet of
+        # brokers recovering from one shared transient doesn't
+        # re-converge on the same instant) — transient faults stop being
+        # the client's problem. Deterministic classes never retry.
+        self.retry_max = max(int(retry_max), 0)
+        self.retry_backoff_s = retry_backoff_s
+        self.retry_jitter = retry_jitter
+        self._sleep = sleep
+        self._rng = rng or random.Random()
         self._queue: deque[PendingRequest] = deque()
         self._cv = threading.Condition()
-        # atomic response claim (see PendingRequest.answered): the solve
-        # thread (continuous retires) and the worker thread (timeout/
-        # failure paths) may race to answer the same request
-        self._respond_lock = threading.Lock()
         self._stop = False
-        self._ids = itertools.count(1)
+        self._next_id = 1  # guarded by _cv (see submit/recover)
         self._worker = threading.Thread(target=self._loop, daemon=True,
                                         name="serve-broker")
         self._worker.start()
@@ -134,8 +147,16 @@ class Broker:
                req_id: str | None = None) -> PendingRequest:
         """Admit one request or shed it (QueueFull). Never blocks on the
         solve — the caller waits on the returned PendingRequest."""
-        rid = req_id or f"r{next(self._ids)}"
         with self._cv:
+            if req_id is None:
+                # id minting under the queue lock: recover() bumps the
+                # counter under the same lock, so a submission racing a
+                # journal replay can never mint an id colliding with a
+                # replayed request's original id
+                rid = f"r{self._next_id}"
+                self._next_id += 1
+            else:
+                rid = req_id
             depth = len(self._queue)
             if self._stop:
                 raise QueueFull("broker is shut down")
@@ -145,7 +166,12 @@ class Broker:
                     f"queue at capacity ({depth}/{self.queue_max})")
             pending = PendingRequest(rid, spec, float(scale), time.monotonic())
             self._queue.append(pending)
-            self.metrics.request(rid, _spec_dict(spec), len(self._queue))
+            # the write-ahead admitted-request record (ISSUE 9): journaled
+            # (fsynced, Journal.append) BEFORE the client gets its future
+            # back, carrying spec + scale so a crashed generation's
+            # recovery can replay the request (serve.recovery)
+            self.metrics.request(rid, _spec_dict(spec), len(self._queue),
+                                 scale=float(scale))
             self._cv.notify_all()
         return pending
 
@@ -252,6 +278,17 @@ class Broker:
             self.metrics.set_queue_depth(len(self._queue))
         return taken
 
+    def _requeue_front(self, reqs: list) -> None:
+        """Put polled-but-not-admitted requests back at the queue front
+        (relative order kept): a crash between the queue pop and the
+        admission park must strand nobody. Bypasses the queue_max cap —
+        these requests were already admitted once."""
+        with self._cv:
+            for p in reversed(reqs):
+                self._queue.appendleft(p)
+            self.metrics.set_queue_depth(len(self._queue))
+            self._cv.notify_all()
+
     def _execute(self, batch: list) -> None:
         spec = batch[0].spec
         live = len(batch)
@@ -264,56 +301,102 @@ class Broker:
         # paths below must answer every request the solve ever owned
         # (_respond skips the already-answered ones).
         members = list(batch)
-        box: dict = {}
+        # resume box (ISSUE 9): _solve_continuous parks its latest
+        # iteration-boundary checkpoint here (state + lane map +
+        # accounting). A retriable worker-thread crash re-enters the
+        # solve FROM that boundary instead of abandoning the batch or
+        # restarting at iteration 0 (metrics.retry resumed=true).
+        resume: dict = {}
         # the admission horizon is anchored where the HARD deadline is
         # (batch-execution start, before any compile): a zombie solve
         # thread must stop admitting BEFORE the worker can abandon the
         # batch, or admitted requests would sit outside any deadline
         # cover
         admit_deadline = time.monotonic() + self.solve_timeout_s / 2
+        attempt = 0
+        while True:
+            box: dict = {}
 
-        def _run():
-            try:
-                with span("serve:solve", spec=_spec_dict(spec),
-                          bucket=bucket, live=len(members)):
-                    entry = self.cache.get_or_build(
-                        key, lambda: self._builder(spec, bucket))
-                    solver = entry.executable
-                    for p in members:
-                        p.lc.mark("solve")
-                    if self.continuous and getattr(
-                            solver, "supports_continuous", False):
-                        box["summary"] = self._solve_continuous(
-                            solver, spec, members, bucket, cache_hit,
-                            admit_deadline)
-                    else:
-                        box["result"] = solver.solve(
-                            [p.scale for p in members])
-            except BaseException as exc:
-                box["error"] = exc
+            def _run():
+                try:
+                    with span("serve:solve", spec=_spec_dict(spec),
+                              bucket=bucket, live=len(members)):
+                        entry = self.cache.get_or_build(
+                            key, lambda: self._builder(spec, bucket))
+                        solver = entry.executable
+                        for p in members:
+                            p.lc.mark("solve")
+                        if self.continuous and getattr(
+                                solver, "supports_continuous", False):
+                            box["summary"] = self._solve_continuous(
+                                solver, spec, members, bucket, cache_hit,
+                                admit_deadline, resume)
+                        else:
+                            box["result"] = solver.solve(
+                                [p.scale for p in members])
+                except BaseException as exc:
+                    box["error"] = exc
 
-        t = threading.Thread(target=_run, daemon=True,
-                             name="serve-solve")
-        t.start()
-        t.join(self.solve_timeout_s)
-        if t.is_alive():
-            # hard deadline: answer + abandon (the harness's
-            # kill-the-group, minus the kill Python threads lack).
-            # Continuous members already retired were answered as they
-            # finished; _respond skips them here.
-            msg = (f"solve exceeded {self.solve_timeout_s}s "
-                   f"(spec {_spec_dict(spec)}); batch abandoned")
-            for p in members:
-                self._respond(p, {
-                    "ok": False, "id": p.id, "error": msg,
-                    "failure_class": "timeout", "retriable": True})
-            self.metrics.batch(_spec_dict(spec), len(members), bucket,
-                               cache_hit, self.solve_timeout_s, 0.0)
-            return
-        if "error" in box:
-            self._fail_batch(members, box["error"], bucket=bucket,
-                             cache_hit=cache_hit)
-            return
+            t = threading.Thread(target=_run, daemon=True,
+                                 name="serve-solve")
+            t.start()
+            t.join(self.solve_timeout_s)
+            if t.is_alive():
+                # hard deadline: answer + abandon (the harness's
+                # kill-the-group, minus the kill Python threads lack).
+                # Continuous members already retired were answered as
+                # they finished; _respond skips them here. Never
+                # retried: the zombie thread still owns the members and
+                # the resume state — a resumed attempt would race it.
+                msg = (f"solve exceeded {self.solve_timeout_s}s "
+                       f"(spec {_spec_dict(spec)}); batch abandoned")
+                for p in members:
+                    self._respond(p, {
+                        "ok": False, "id": p.id, "error": msg,
+                        "failure_class": "timeout", "retriable": True})
+                self.metrics.batch(_spec_dict(spec), len(members), bucket,
+                                   cache_hit, self.solve_timeout_s, 0.0)
+                return
+            if "error" in box:
+                exc = box["error"]
+                cls = classify_exception(exc)
+                # broker-internal bounded retry (ISSUE 9): transient
+                # faults stop being the client's problem. Deterministic
+                # classes fail straight through — retrying them burns
+                # queue capacity for the same answer. The solve thread
+                # has EXITED here (unlike the timeout path), so a
+                # resumed attempt races nobody.
+                if cls in RETRIABLE_CLASSES and attempt < self.retry_max:
+                    attempt += 1
+                    wait = self.retry_backoff_s * (2 ** (attempt - 1))
+                    wait *= 1.0 + self.retry_jitter * self._rng.random()
+                    resumed = resume.get("state") is not None
+                    if resumed:
+                        # reconcile members against the parked lane map:
+                        # a member that is unanswered and in no parked
+                        # lane (the crash hit between its admission and
+                        # its park) is invisible to the resumed solve —
+                        # requeue it rather than lose it.
+                        parked = {id(q) for q in resume["lanes"]
+                                  if q is not None}
+                        orphans = [q for q in members
+                                   if not q.answered
+                                   and id(q) not in parked]
+                        if orphans:
+                            self._requeue_front(orphans)
+                            gone = {id(q) for q in orphans}
+                            members = [q for q in members
+                                       if id(q) not in gone]
+                    with span("serve:retry", failure_class=cls,
+                              attempt=attempt, resumed=resumed):
+                        self.metrics.retry(_spec_dict(spec), cls, attempt,
+                                           wait, resumed)
+                    self._sleep(wait)
+                    continue
+                self._fail_batch(members, exc, bucket=bucket,
+                                 cache_hit=cache_hit)
+                return
+            break
         if "summary" in box:
             # continuous: per-request responses went out at each retire;
             # here only the batch-level accounting lands
@@ -330,6 +413,16 @@ class Broker:
         self.metrics.batch(_spec_dict(spec), live, res.nrhs_bucket,
                            cache_hit, res.wall_s, res.gdof_per_second)
         for lane, p in enumerate(batch):
+            if not math.isfinite(res.xnorms[lane]):
+                # breakdown sentinel, one-shot path (incl. df32): same
+                # contract as the continuous retire check above
+                self._respond(p, {
+                    "ok": False, "id": p.id,
+                    "error": ("non-finite solution norm "
+                              f"({res.xnorms[lane]!r}): CG breakdown"),
+                    "failure_class": "breakdown", "retriable": False,
+                    "spec": _spec_dict(spec), "continuous": False})
+                continue
             self._respond(p, {
                 "ok": True, "id": p.id,
                 "xnorm": res.xnorms[lane],
@@ -348,7 +441,8 @@ class Broker:
 
     def _solve_continuous(self, solver, spec: SolveSpec, members: list,
                           bucket: int, cache_hit: bool,
-                          admit_deadline: float) -> dict:
+                          admit_deadline: float,
+                          resume: dict | None = None) -> dict:
         """Run one continuous batch on the solve thread: step the
         compiled solve `iter_chunk` iterations at a time; at every
         boundary retire finished lanes (responding immediately) and
@@ -362,21 +456,68 @@ class Broker:
         cannot hold one batch past the abandon point, and an abandoned
         zombie thread can never keep pulling fresh requests into a
         batch nobody is watching — remaining lanes drain, the batch
-        ends, the worker forms a fresh batch for whatever is queued."""
+        ends, the worker forms a fresh batch for whatever is queued.
+
+        `resume` (ISSUE 9) is the caller-owned boundary checkpoint box:
+        after every boundary's retire/admit processing the solve parks
+        its state (immutable pytree), lane map and accounting there; a
+        retrying `_execute` passes the same box back and the solve
+        continues FROM that boundary — already-retired lanes stay
+        retired (their requests were answered; `_respond` would skip a
+        re-answer anyway), in-flight lanes keep their iterates."""
+        resume = resume if resume is not None else {}
+        if resume.get("state") is not None:
+            # resumed attempt: continue the crashed attempt's batch at
+            # its last parked boundary (no cont_init — the fault hook
+            # already fired on the attempt that crashed)
+            state = resume["state"]
+            lanes = list(resume["lanes"])
+            (served, midsolve, boundaries, live_lane_boundaries,
+             dead_lane_boundaries, boundary_iter, wall_accum) = resume["acct"]
+        else:
+            state = solver.cont_init([p.scale for p in members])
+            lanes = [None] * bucket
+            served = midsolve = boundaries = live_lane_boundaries = 0
+            dead_lane_boundaries = 0
+            boundary_iter = 0
+            wall_accum = 0.0
+            for lane, p in enumerate(members):
+                lanes[lane] = p
+                self.metrics.admit(p.id, lane, 0, False, lane + 1)
+            # park boundary 0 immediately: a crash BEFORE the first
+            # in-loop park (a hook at boundary 0, the first chunk) must
+            # retry down the resumed path — re-running cont_init would
+            # journal every member's serve_admit record a second time
+            # and double-count those lanes in journal replay
+            resume["lanes"] = list(lanes)
+            resume["acct"] = (served, midsolve, boundaries,
+                              live_lane_boundaries, dead_lane_boundaries,
+                              boundary_iter, wall_accum)
+            resume["state"] = state
         t0 = time.monotonic()
-        state = solver.cont_init([p.scale for p in members])
-        lanes: list = [None] * bucket
-        served = midsolve = boundaries = live_lane_boundaries = 0
-        dead_lane_boundaries = 0
-        boundary_iter = 0
-        for lane, p in enumerate(members):
-            lanes[lane] = p
-            self.metrics.admit(p.id, lane, 0, False, lane + 1)
 
         def spec_d():
             return _spec_dict(spec)
 
+        def park():
+            # park the boundary checkpoint: everything a resumed attempt
+            # needs to continue from HERE instead of iteration 0. Called
+            # after every journaled lane mutation (retire sweep, each
+            # admission, end of boundary) so a retriable crash BETWEEN
+            # mutations can neither re-journal a retired lane nor drop
+            # an admitted one on resume.
+            resume["lanes"] = list(lanes)
+            resume["acct"] = (served, midsolve, boundaries,
+                              live_lane_boundaries, dead_lane_boundaries,
+                              boundary_iter,
+                              wall_accum + (time.monotonic() - t0))
+            resume["state"] = state
+
+        from . import engine as _engine
+
         while any(p is not None for p in lanes):
+            if _engine.BOUNDARY_HOOK is not None:
+                _engine.BOUNDARY_HOOK(spec, boundary_iter)
             state = solver.cont_step(state)
             boundary_iter += solver.iter_chunk
             iters, done = solver.cont_poll(state)
@@ -394,6 +535,29 @@ class Broker:
                 served += 1
                 self.metrics.retire(p.id, lane, boundary_iter,
                                     int(iters[lane]), live)
+                # per-retire park, between the journaled retire record
+                # and the response: a retriable crash later in this
+                # sweep must not re-retire this lane (duplicate
+                # serve_retire) on resume; if the crash lands inside
+                # _respond itself, the lane is parked retired-but-
+                # unanswered and the retry-path reconcile requeues it
+                park()
+                if not math.isfinite(xnorm):
+                    # breakdown sentinel (ISSUE 9): a poisoned lane
+                    # (injected NaN, numerical breakdown) must never
+                    # ship as ok:true — classified `breakdown`,
+                    # deterministic (re-solving the same input
+                    # reproduces it), lane-local (batch-mates retire
+                    # normally: lane algebra is independent)
+                    self._respond(p, {
+                        "ok": False, "id": p.id,
+                        "error": ("non-finite solution norm "
+                                  f"({xnorm!r}): CG breakdown"),
+                        "failure_class": "breakdown",
+                        "retriable": False,
+                        "spec": spec_d(), "continuous": True,
+                        "iters_run": int(iters[lane])})
+                    continue
                 self._respond(p, {
                     "ok": True, "id": p.id,
                     "xnorm": xnorm,
@@ -407,20 +571,37 @@ class Broker:
                     "iters_run": int(iters[lane]),
                     "cache": "hit" if cache_hit else "miss",
                 })
+            # park the boundary step + accounting even when nothing
+            # retired: a crash in the admission block must not replay
+            # this boundary's cont_step on resume
+            park()
             free = [i for i, p in enumerate(lanes) if p is None]
             if free and now < admit_deadline:
-                for p in self._poll_compatible(spec, len(free)):
+                polled = self._poll_compatible(spec, len(free))
+                for j, p in enumerate(polled):
                     lane = free.pop(0)
                     p.lc.mark("admit")
                     p.lc.mark("solve")  # admitted into an in-flight solve
-                    state = solver.cont_admit(state, lane, p.scale)
+                    try:
+                        state = solver.cont_admit(state, lane, p.scale)
+                    except BaseException:
+                        # p (and any requests polled after it) is out of
+                        # the queue but in neither `members` nor a parked
+                        # lane — invisible to every answer path. Back to
+                        # the queue front: the resumed attempt (or a
+                        # later batch) re-admits them.
+                        self._requeue_front(polled[j:])
+                        raise
                     lanes[lane] = p
                     members.append(p)
                     midsolve += 1
                     live += 1
                     self.metrics.admit(p.id, lane, boundary_iter, True,
                                        live)
-        wall = time.monotonic() - t0
+                    park()  # per-admission: a crash on the NEXT admit
+                    # must not lose (or re-journal) this one on resume
+            park()
+        wall = wall_accum + (time.monotonic() - t0)
         # GDoF/s over the whole continuous batch: every served lane ran
         # its full budget (retired lanes are answered, not truncated)
         gdof = (solver.ndofs_global * spec.nreps * served
@@ -450,12 +631,24 @@ class Broker:
                 "error": f"{type(exc).__name__}: {exc}"[:500],
                 "failure_class": cls, "retriable": retriable})
 
-    def _respond(self, pending: PendingRequest, result: dict) -> None:
-        # atomic claim: exactly ONE responder wins (metrics must count
-        # each request once; the loser's payload is dropped)
-        with self._respond_lock:
+    def _respond(self, pending: PendingRequest, result: dict) -> bool:
+        """Answer one request exactly once; True = this call won the
+        claim. The whole visibility sequence — claim, journal the
+        serve_response record (fsynced inside Journal.append),
+        done.set() — runs UNDER the request's claim lock: a racing late
+        responder (a zombie solve thread retiring a lane the worker
+        already failed via _fail_batch, or vice versa) can neither
+        double-release the client nor journal a second serve_response
+        for the SAME request. Different requests journal concurrently —
+        each Journal.append is an atomic O_APPEND write, so per-request
+        locking suffices and the broker isn't serialized through one
+        fsync. The fsync-before-done.set() ordering is what makes
+        recovery exactly-once (serve.recovery): a request whose client
+        was released always has a durable response record, so a replay
+        can never answer it a second time."""
+        with pending.lock:
             if pending.answered:
-                return
+                return False
             pending.answered = True
             # the lifecycle marks ARE the latency accounting: total and
             # the per-stage breakdown ride on every response/journal line
@@ -464,10 +657,79 @@ class Broker:
             result["latency_s"] = latency = lifecycle.get("total_s", 0.0)
             result["lifecycle_s"] = lifecycle
             pending.result = result
-        self.metrics.response(
-            pending.id, bool(result.get("ok")), latency,
-            failure_class=result.get("failure_class"),
-            retriable=result.get("retriable"),
-            cache=result.get("cache"),
-            lifecycle=lifecycle)
-        pending.done.set()
+            self.metrics.response(
+                pending.id, bool(result.get("ok")), latency,
+                failure_class=result.get("failure_class"),
+                retriable=result.get("retriable"),
+                cache=result.get("cache"),
+                lifecycle=lifecycle)
+            pending.done.set()
+        return True
+
+    # -- crash recovery (ISSUE 9) ------------------------------------------
+
+    def recover(self, journal) -> dict:
+        """Replay a crashed generation's journal into THIS broker:
+        re-admit every admitted-but-unresponded request
+        (serve.recovery.fold_outstanding — requests whose write-ahead
+        ``serve_request`` record has no complete ``serve_response``)
+        under its ORIGINAL id, so the journal reads as one continuous
+        incident across restarts and ``verify_exactly_once`` holds over
+        all generations appended to one file. No new serve_request
+        records are written (the WAL line already exists); the fresh-id
+        counter resumes past every journaled numeric id so new
+        admissions never collide with replayed ones.
+
+        ``journal`` is a journal path, an iterable of records, or a
+        prebuilt RecoveryPlan. Returns {"plan", "pending", "replayed",
+        "skipped"}; the caller waits on ``pending`` (the original
+        clients died with the crashed process — their responses land in
+        the journal, which is the exactly-once contract's ledger)."""
+        from .recovery import RecoveryPlan, fold_outstanding
+
+        plan = (journal if isinstance(journal, RecoveryPlan)
+                else fold_outstanding(journal))
+        replayed: list[PendingRequest] = []
+        skipped = 0
+        with span("serve:recover", outstanding=len(plan.outstanding),
+                  corrupt=plan.corrupt):
+            if plan.max_numeric_id:
+                # never move the counter backward, and take the queue
+                # lock: ids minted by submissions that beat (or race)
+                # the recovery stay unique vs replayed ids
+                with self._cv:
+                    self._next_id = max(self._next_id,
+                                        plan.max_numeric_id + 1)
+            for req in plan.outstanding:
+                try:
+                    spec = SolveSpec(**req["spec"])
+                    spec.validate()
+                except Exception:
+                    # a journal record too damaged to rebuild its spec:
+                    # counted, never crashes the recovery (the rest of
+                    # the outstanding set still replays). The id still
+                    # gets a TERMINAL failure response — leaving it
+                    # unanswered would hold the exactly-once ledger
+                    # (verify_exactly_once) open forever: the request
+                    # would read as LOST even though recovery behaved.
+                    # Deterministic (the spec can never rebuild), so
+                    # `unsupported`, never retriable.
+                    self.metrics.response(
+                        req["id"], False, 0.0,
+                        failure_class="unsupported", retriable=False)
+                    skipped += 1
+                    continue
+                pending = PendingRequest(req["id"], spec,
+                                         float(req.get("scale", 1.0)),
+                                         time.monotonic())
+                # replay bypasses admission control: these requests were
+                # ALREADY admitted (their WAL records prove it) — a full
+                # queue must not convert an admitted request into a loss
+                with self._cv:
+                    self._queue.append(pending)
+                    self._cv.notify_all()
+                replayed.append(pending)
+            self.metrics.recovery(len(plan.outstanding), len(replayed),
+                                  skipped, plan.corrupt)
+        return {"plan": plan, "pending": replayed,
+                "replayed": len(replayed), "skipped": skipped}

@@ -65,6 +65,15 @@ from .cache import ExecutableKey, nrhs_bucket
 # solver code. harness.faults.FaultySolveHook scripts it.
 FAULT_HOOK = None
 
+# Boundary fault seam (ISSUE 9): when set, called as
+# BOUNDARY_HOOK(spec, boundary_iter) at every continuous-batching
+# iteration boundary, INSIDE the broker's disposable solve thread —
+# raising here simulates the worker thread dying mid-batch (the
+# SIGKILL-adjacent crash the broker's boundary-checkpoint resume
+# recovers from). Separate from FAULT_HOOK so per-boundary scripting
+# never consumes a FaultySolveHook script out from under existing tests.
+BOUNDARY_HOOK = None
+
 _PRECISIONS = ("f32", "f64", "df32")
 
 # Admission cap on problem size: a single oversized request must be

@@ -10,7 +10,8 @@ acceptance criterion).
 
 Record schema (all lines also carry the journal's v/seq/ts):
 
-  {"event": "serve_request",  "id": ..., "spec": {...}, "queue_depth": N}
+  {"event": "serve_request",  "id": ..., "spec": {...}, "scale": ...,
+                              "queue_depth": N}
   {"event": "serve_shed",     "id": ..., "failure_class": "transient",
                               "queue_depth": N}
   {"event": "serve_admit",    "id": ..., "lane": L, "iter": K,
@@ -27,6 +28,20 @@ Record schema (all lines also carry the journal's v/seq/ts):
                               "cache": "hit"|"miss" (when known),
                               "failure_class": ... (failures only),
                               "retriable": bool (failures only)}
+  {"event": "serve_retry",    "spec": {...}, "failure_class": ...,
+                              "attempt": N, "wait_s": ..., "resumed": bool}
+  {"event": "serve_recover",  "outstanding": N, "replayed": N,
+                              "skipped": N, "corrupt_lines": N}
+
+serve_request is the broker's WRITE-AHEAD admitted-request record
+(fsynced before the client gets its future back; `scale` makes it
+replayable), serve_response its visibility fence (fsynced before
+``done.set()``): recovery (serve.recovery) folds the two into the
+admitted-but-unresponded set after a crash. serve_retry is a
+broker-internal bounded retry of a retriable-failed batch
+(resumed=true = the continuous solve resumed from its iter-chunk
+boundary checkpoint instead of restarting); serve_recover is one
+``Broker.recover`` replay.
 
 serve_admit/serve_retire are the continuous-batching boundary events:
 `iter` is the batch's iteration-boundary index at the event and `live`
@@ -81,6 +96,11 @@ class Metrics:
         self.lane_slots_total = 0  # bucket-sized slots across batches
         self.live_lane_boundaries = 0  # sum of live counts per boundary
         self.boundaries_total = 0
+        # fault-tolerance accounting (ISSUE 9)
+        self.broker_retries = 0  # bounded internal retries of failed batches
+        self.batch_resumes = 0  # retries that resumed a boundary checkpoint
+        self.recovery_runs = 0  # Broker.recover invocations
+        self.recovered_requests = 0  # admitted-unresponded requests replayed
 
     def _journal(self, rec: dict) -> None:
         if self.journal is not None:
@@ -88,9 +108,14 @@ class Metrics:
 
     # -- events ------------------------------------------------------------
 
-    def request(self, req_id: str, spec_dict: dict, queue_depth: int) -> None:
+    def request(self, req_id: str, spec_dict: dict, queue_depth: int,
+                scale: float = 1.0) -> None:
+        """The write-ahead admitted-request record: journaled (fsynced)
+        before the submitting client gets its future back, carrying
+        everything a recovery replay needs (spec + scale)."""
         self._journal({"event": "serve_request", "id": req_id,
-                       "spec": spec_dict, "queue_depth": queue_depth})
+                       "spec": spec_dict, "scale": float(scale),
+                       "queue_depth": queue_depth})
         with self._lock:
             self.requests_total += 1
             self.queue_depth = queue_depth
@@ -190,6 +215,33 @@ class Metrics:
             if cache == "hit":
                 self.latencies_warm.append(latency_s)
 
+    def retry(self, spec_dict: dict, failure_class: str, attempt: int,
+              wait_s: float, resumed: bool) -> None:
+        """One broker-internal retry of a retriable-failed batch
+        (resumed=True: the continuous solve resumed from its iter-chunk
+        boundary checkpoint instead of restarting at iteration 0)."""
+        self._journal({"event": "serve_retry", "spec": spec_dict,
+                       "failure_class": failure_class,
+                       "attempt": int(attempt),
+                       "wait_s": round(float(wait_s), 6),
+                       "resumed": bool(resumed)})
+        with self._lock:
+            self.broker_retries += 1
+            if resumed:
+                self.batch_resumes += 1
+
+    def recovery(self, outstanding: int, replayed: int, skipped: int,
+                 corrupt: int) -> None:
+        """One Broker.recover replay of a crashed generation's journal."""
+        self._journal({"event": "serve_recover",
+                       "outstanding": int(outstanding),
+                       "replayed": int(replayed),
+                       "skipped": int(skipped),
+                       "corrupt_lines": int(corrupt)})
+        with self._lock:
+            self.recovery_runs += 1
+            self.recovered_requests += int(replayed)
+
     def set_queue_depth(self, depth: int) -> None:
         with self._lock:
             self.queue_depth = depth
@@ -242,6 +294,12 @@ class Metrics:
                     sum(self.gdof_samples) / len(self.gdof_samples)
                     if self.gdof_samples else 0.0
                 ),
+                # fault tolerance: internal retries, boundary-checkpoint
+                # resumes and journal-replay recovery (ISSUE 9)
+                "broker_retries": self.broker_retries,
+                "batch_resumes": self.batch_resumes,
+                "recovery_runs": self.recovery_runs,
+                "recovered_requests": self.recovered_requests,
             }
         if cache_stats is not None:
             out["cache"] = cache_stats
@@ -261,6 +319,8 @@ _PROM_PREFIX = "benchfem_serve_"
 _PROM_COUNTERS = frozenset({
     "requests_total", "shed_total", "completed", "failed", "batches",
     "padded_lanes_total", "midsolve_admissions",
+    "broker_retries", "batch_resumes", "recovery_runs",
+    "recovered_requests",
 })
 
 
@@ -350,6 +410,8 @@ def replay_serve(journal_path: str) -> dict:
         "admits": 0, "midsolve_admissions": 0, "retires": 0,
         "padded_lanes_total": 0, "lane_slots_total": 0,
         "live_lane_boundaries": 0, "boundaries_total": 0,
+        "broker_retries": 0, "batch_resumes": 0, "recovery_runs": 0,
+        "recovered_requests": 0,
     }
     warm_lat: list[float] = []
     occupancy: list[dict] = []  # (seq, iter, live) — occupancy over time
@@ -388,6 +450,13 @@ def replay_serve(journal_path: str) -> dict:
                 out["cache_hits"] += int(rec.get("nrhs_live", 0))
             else:
                 out["cache_misses"] += int(rec.get("nrhs_live", 0))
+        elif ev == "serve_retry":
+            out["broker_retries"] += 1
+            if rec.get("resumed"):
+                out["batch_resumes"] += 1
+        elif ev == "serve_recover":
+            out["recovery_runs"] += 1
+            out["recovered_requests"] += int(rec.get("replayed", 0))
         elif ev == "serve_response":
             if rec.get("ok"):
                 out["responses_ok"] += 1

@@ -1,0 +1,122 @@
+"""Broker crash recovery: fold a (possibly torn) serve journal back into
+the set of admitted-but-unresponded requests, and verify the
+exactly-once contract over any number of broker generations.
+
+The write-ahead record is the existing ``serve_request`` journal line:
+``Broker.submit`` fsyncs it (id, spec, scale) BEFORE the client gets its
+``PendingRequest`` back, so every request a client may be waiting on is
+durable. The matching visibility rule lives in ``Broker._respond``: the
+``serve_response`` record is fsynced BEFORE ``done.set()`` releases the
+client. Together they make recovery exactly-once by construction:
+
+* a request with a ``serve_request`` record and no ``serve_response``
+  record was never answered — the crash ate it mid-flight; replaying it
+  answers it for the first time;
+* a request whose response record is the TORN final line was never
+  released to the client either (the fsync did not return, so
+  ``done.set()`` never ran) — ``read_records`` drops the torn line and
+  the request correctly replays;
+* a request with a COMPLETE response record may have been seen by the
+  client — it is never replayed.
+
+``fold_outstanding`` is the reader half of that contract;
+``Broker.recover`` is the writer half (re-admit each outstanding request
+under its ORIGINAL id, so the journal reads as one continuous incident
+across restarts). ``verify_exactly_once`` is the chaos-soak invariant:
+over the whole journal — all broker generations appended to one file —
+every requested id has exactly one response, no losses, no duplicates.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass, field
+
+from ..harness.journal import read_records
+
+_NUMERIC_ID = re.compile(r"^r(\d+)$")
+
+
+@dataclass
+class RecoveryPlan:
+    """The fold of a serve journal a recovering broker consumes."""
+
+    outstanding: list[dict] = field(default_factory=list)
+    requests: int = 0
+    responses: int = 0
+    shed: int = 0
+    corrupt: int = 0
+    #: highest numeric rN id seen — the recovering broker resumes its id
+    #: counter past it so fresh admissions never collide with replayed
+    #: ids (the journal must stay one id-space per incident)
+    max_numeric_id: int = 0
+
+
+def fold_outstanding(path_or_records) -> RecoveryPlan:
+    """Fold journal records into the recovery plan. Outstanding =
+    requested, never responded, never shed — in admission order (the
+    order the original clients were promised)."""
+    if isinstance(path_or_records, str):
+        records, corrupt = read_records(path_or_records)
+    else:
+        records, corrupt = list(path_or_records), []
+    plan = RecoveryPlan(corrupt=len(corrupt))
+    requested: dict[str, dict] = {}
+    answered: set[str] = set()
+    shed: set[str] = set()
+    for rec in records:
+        ev = rec.get("event")
+        rid = rec.get("id")
+        if ev == "serve_request" and rid:
+            plan.requests += 1
+            requested[rid] = {"id": rid, "spec": rec.get("spec") or {},
+                              "scale": rec.get("scale", 1.0)}
+            m = _NUMERIC_ID.match(str(rid))
+            if m:
+                plan.max_numeric_id = max(plan.max_numeric_id,
+                                          int(m.group(1)))
+        elif ev == "serve_response" and rid:
+            plan.responses += 1
+            answered.add(rid)
+        elif ev == "serve_shed" and rid:
+            plan.shed += 1
+            shed.add(rid)
+    plan.outstanding = [req for rid, req in requested.items()
+                       if rid not in answered and rid not in shed]
+    return plan
+
+
+def verify_exactly_once(path_or_records) -> dict:
+    """The chaos-soak invariant over a whole incident journal (any
+    number of broker generations appended to one file): every requested
+    id has EXACTLY one response. Returns a verdict dict with ``ok`` and
+    the offending id lists (bounded) — losses (requested, never
+    answered) and duplicates (answered more than once) are both
+    contract violations."""
+    if isinstance(path_or_records, str):
+        records, _ = read_records(path_or_records)
+    else:
+        records = list(path_or_records)
+    requested: list[str] = []
+    responses: dict[str, int] = {}
+    shed: set[str] = set()
+    for rec in records:
+        ev, rid = rec.get("event"), rec.get("id")
+        if not rid:
+            continue
+        if ev == "serve_request":
+            requested.append(rid)
+        elif ev == "serve_response":
+            responses[rid] = responses.get(rid, 0) + 1
+        elif ev == "serve_shed":
+            shed.add(rid)
+    lost = [r for r in requested if r not in responses and r not in shed]
+    dup = sorted(r for r, n in responses.items() if n > 1)
+    return {
+        "ok": not lost and not dup,
+        "requested": len(requested),
+        "responded": sum(responses.values()),
+        "shed": len(shed),
+        "lost": lost[:32],
+        "duplicates": dup[:32],
+    }

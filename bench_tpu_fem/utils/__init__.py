@@ -1,7 +1,7 @@
 """Logging and timing utilities (reference: spdlog + dolfinx::common::Timer,
 see SURVEY.md C17)."""
 
-from .timing import Timer, timer_report, timings
+from .timing import Timer, timings
 from .logging import init_logging
 
-__all__ = ["Timer", "timer_report", "timings", "init_logging"]
+__all__ = ["Timer", "timings", "init_logging"]

@@ -2,21 +2,21 @@
 
 Replaces `dolfinx::common::Timer` + `list_timings` (MPI_MAX aggregated table,
 /root/reference/src/main.cpp:314, laplacian_solver.cpp:90,174-198). Timers
-accumulate by name in a process-local registry; `timer_report` renders the
-table, max-reducing across controller processes first when running
+accumulate by name in a process-local registry; `aggregated_timings`
+folds the table, max-reducing across controller processes first when running
 multi-controller (utils.multihost) — the reference needs MPI_MAX because
 each rank times independently, and a multi-controller JAX job is in the
 same position. Single-controller (the common case: one Python process
 drives every device) the local registry IS the whole-job view and no
 communication happens.
 
-.. deprecated::
-    ``timer_report`` remains for the reference-parity banner, but new
-    attribution work should use the obs span tracer
-    (``bench_tpu_fem.obs.trace``) + ``python -m bench_tpu_fem.obs``,
-    which render the same count/total/max table FROM spans — plus the
-    span tree, Chrome trace export and roofline table this registry
-    cannot produce (README "Observability").
+Rendering lives in the obs layer: the CLI banner and the obs CLI both
+use ``obs.report.render_timer_rows`` over ``aggregated_timings()`` /
+span aggregates (the deprecated ``timer_report`` shim flagged in the
+observability PR has been removed). New attribution work should use the
+obs span tracer (``bench_tpu_fem.obs.trace``) + ``python -m
+bench_tpu_fem.obs`` — span tree, Chrome trace export and roofline table
+on top of the same count/total/max shape (README "Observability").
 """
 
 from __future__ import annotations
@@ -150,13 +150,6 @@ def aggregated_timings() -> dict[str, dict[str, float]]:
     finally:
         jax.config.update("jax_enable_x64", prev)
     return _reduce_gathered(names, gathered.reshape(-1, len(names), 3))
-
-
-def timer_report() -> str:
-    rows = [f"{'Timer':<40s} {'count':>6s} {'total (s)':>12s} {'max (s)':>12s}"]
-    for name, t in sorted(aggregated_timings().items()):
-        rows.append(f"{name:<40s} {t['count']:>6d} {t['total']:>12.4f} {t['max']:>12.4f}")
-    return "\n".join(rows)
 
 
 def reset_timers() -> None:

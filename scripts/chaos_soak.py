@@ -1,0 +1,407 @@
+#!/usr/bin/env python
+"""Chaos/soak harness for the fault-tolerant serving stack (ISSUE 9).
+
+Drives scripted fault schedules (harness.chaos) against the LIVE serve
+stack and asserts the recovery invariants:
+
+  1. generations — a serving child (gen 1) is SIGKILL'd mid-incident;
+     the parent then TEARS the journal tail (the crash-mid-write bytes)
+     and gen 2 recovers the shared journal (``Broker.recover``): every
+     admitted-but-unresponded request replays, fresh traffic still
+     serves, and ``serve.recovery.verify_exactly_once`` must hold over
+     the WHOLE journal — no losses, no duplicates, no deadlock. The
+     recovery is span-traced (``serve:recover`` in the journal) and
+     counted in /metrics (JSON snapshot + Prometheus exposition).
+  2. worker-thread crash — ``BoundaryCrashHook`` raises mid-batch inside
+     the broker's disposable solve thread; the bounded retry resumes the
+     batch from its parked boundary checkpoint (``serve_retry`` with
+     resumed=true) and every request is still answered ok, exactly once.
+  3. injected NaN — a poisoned lane (scale=nan) answers
+     ``failure_class: "breakdown"``; its batch-mates are unaffected.
+  4. preemption mid-CG — a durably-checkpointed bench solve is SIGKILL'd
+     right after a snapshot (``CHAOS_CKPT_KILL_AFTER``); the resumed run
+     must match the uninterrupted solve BITWISE (the la.checkpoint
+     restore proof, end-to-end through a real process death).
+
+All CPU (``JAX_PLATFORMS=cpu`` is pinned — this is a software-recovery
+proof, not a hardware measurement; snapshot/restore on real HBM stays
+hardware-armed per the evidence-hygiene rule). ``--quick`` bounds the
+whole run to roughly a minute — the CI ``chaos`` lane's contract.
+
+rc 0 = every invariant held; rc 1 names the first violation.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import math
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+CHILD_ENV = {**os.environ, "JAX_PLATFORMS": "cpu",
+             "PYTHONPATH": f"{ROOT}:{os.environ.get('PYTHONPATH', '')}"}
+
+# the generation workload: small enough to compile in seconds on CPU,
+# slow enough (nreps) that a SIGKILL reliably lands mid-incident
+SPEC_KW = dict(degree=2, ndofs=2500, nreps=400)
+
+
+def _pin_cpu():
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from bench_tpu_fem.utils.hermetic import force_host_cpu_devices
+
+    force_host_cpu_devices(1)
+
+
+def log(msg: str) -> None:
+    print(f"[chaos {time.strftime('%H:%M:%S')}] {msg}", flush=True)
+
+
+def fail(msg: str) -> int:
+    print(f"CHAOS FAIL: {msg}", flush=True)
+    return 1
+
+
+# ---------------------------------------------------------------------------
+# generation children (leg 1)
+# ---------------------------------------------------------------------------
+
+
+def serve_child(journal: str, generation: int, nreq: int) -> int:
+    """One broker generation against the shared journal. Gen 1 submits a
+    burst and prints INFLIGHT (the parent's kill cue) while batches are
+    mid-solve; gen >= 2 first replays the journal (Broker.recover), then
+    serves fresh traffic, and reports its metrics for the parent's
+    /metrics assertions."""
+    _pin_cpu()
+    import threading
+
+    from bench_tpu_fem.harness.journal import Journal
+    from bench_tpu_fem.obs.trace import enable
+    from bench_tpu_fem.serve import (
+        Broker,
+        ExecutableCache,
+        Metrics,
+        SolveSpec,
+        prometheus_text,
+    )
+
+    # recovery/retry spans stream into the SAME journal as the serve
+    # records — the span-traced-recovery acceptance rides this file
+    enable(journal=Journal(journal))
+    metrics = Metrics(journal)
+    broker = Broker(ExecutableCache(), metrics, queue_max=256, nrhs_max=4,
+                    window_s=0.02, solve_timeout_s=120.0)
+    spec = SolveSpec(**SPEC_KW)
+    broker.warmup([spec])
+    pending = []
+    if generation >= 2:
+        rec = broker.recover(journal)
+        log(f"gen{generation}: recovered {rec['replayed']} outstanding "
+            f"({rec['skipped']} skipped, {rec['plan'].corrupt} corrupt)")
+        pending.extend(rec["pending"])
+    log(f"gen{generation}: submitting {nreq} requests")
+    for i in range(nreq):
+        pending.append(broker.submit(spec, scale=2.0 ** (i % 3)))
+    print("INFLIGHT", len(pending), flush=True)
+    waits = []
+    for p in pending:
+        t = threading.Thread(target=lambda p=p: waits.append(
+            broker.wait(p, 120)), daemon=True)
+        t.start()
+        t.join(180)
+    broker.shutdown()
+    snap = metrics.snapshot()
+    print("SNAPSHOT", json.dumps(snap), flush=True)
+    prom = prometheus_text(snap)
+    ok_prom = "benchfem_serve_recovery_runs" in prom
+    print("PROM_OK" if ok_prom else "PROM_MISSING", flush=True)
+    bad = [w for w in waits if not w.get("ok")]
+    print("SERVED", len(waits) - len(bad), "FAILED", len(bad), flush=True)
+    return 0
+
+
+def run_generations(quick: bool) -> int:
+    """Leg 1: SIGKILL mid-incident + torn tail + journal-replay recovery
+    + whole-journal exactly-once."""
+    from bench_tpu_fem.harness.chaos import tear_journal_tail
+    from bench_tpu_fem.serve.recovery import (
+        fold_outstanding,
+        verify_exactly_once,
+    )
+    from bench_tpu_fem.harness.journal import read_records
+
+    tmp = tempfile.mkdtemp(prefix="chaos_soak_")
+    journal = os.path.join(tmp, "SERVE_chaos.jsonl")
+    nreq = 6 if quick else 16
+
+    # gen 1: killed mid-incident
+    child = subprocess.Popen(
+        [sys.executable, "-u", __file__, "--serve-child", "1",
+         "--journal", journal, "--nreq", str(nreq)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=CHILD_ENV, cwd=ROOT, start_new_session=True)
+    killed = False
+    hung = threading.Event()
+
+    def _watchdog():
+        # the stdout for-loop below blocks until a LINE arrives: a child
+        # wedged before its first print (jax import/compile hang — the
+        # failure class this repo designs around) would otherwise pin
+        # the soak until CI's outer timeout. Kill the group so the pipe
+        # closes and the loop exits with the script's own diagnosis.
+        hung.set()
+        try:
+            os.killpg(child.pid, signal.SIGKILL)
+        except (OSError, ProcessLookupError):
+            pass
+
+    wd = threading.Timer(300, _watchdog)
+    wd.start()
+    try:
+        for line in child.stdout:  # type: ignore[union-attr]
+            print("  gen1|", line.rstrip(), flush=True)
+            if line.startswith("INFLIGHT"):
+                time.sleep(0.2)  # let batches reach mid-solve
+                os.killpg(child.pid, signal.SIGKILL)
+                killed = True
+                break
+            if hung.is_set():
+                break
+    finally:
+        wd.cancel()
+    child.wait(30)
+    if hung.is_set() and not killed:
+        return fail("gen 1 hung without output for 300 s "
+                    "(watchdog killed it)")
+    if not killed:
+        return fail("gen 1 never reported INFLIGHT (kill cue missed)")
+    log(f"gen1 SIGKILL'd (rc {child.returncode})")
+
+    outstanding = fold_outstanding(journal).outstanding
+    log(f"journal holds {len(outstanding)} admitted-unresponded requests")
+    if not outstanding:
+        return fail("SIGKILL left no outstanding requests — the kill "
+                    "landed after the incident; nothing recovered")
+
+    # the crash-mid-write bytes: a torn response for one outstanding id
+    # must NOT count as answered (the client was never released)
+    tear_journal_tail(journal, rid=outstanding[0]["id"])
+    still = fold_outstanding(journal).outstanding
+    if outstanding[0]["id"] not in [r["id"] for r in still]:
+        return fail("torn serve_response tail counted as answered")
+
+    # gen 2: recover + serve fresh traffic
+    out = subprocess.run(
+        [sys.executable, "-u", __file__, "--serve-child", "2",
+         "--journal", journal, "--nreq", "2"],
+        capture_output=True, text=True, timeout=600, env=CHILD_ENV,
+        cwd=ROOT)
+    print("  gen2|", out.stdout.strip().replace("\n", "\n  gen2| "),
+          flush=True)
+    if out.returncode != 0:
+        return fail(f"gen 2 exited rc {out.returncode}")
+    snap = None
+    for line in out.stdout.splitlines():
+        if line.startswith("SNAPSHOT "):
+            snap = json.loads(line[len("SNAPSHOT "):])
+    if snap is None:
+        return fail("gen 2 reported no metrics snapshot")
+    if snap["recovery_runs"] < 1 or snap["recovered_requests"] < 1:
+        return fail(f"recovery not counted in /metrics: {snap}")
+    if "PROM_OK" not in out.stdout:
+        return fail("recovery counters missing from Prometheus exposition")
+
+    verdict = verify_exactly_once(journal)
+    log(f"exactly-once verdict: {verdict}")
+    if not verdict["ok"]:
+        return fail(f"exactly-once violated: lost={verdict['lost']} "
+                    f"duplicates={verdict['duplicates']}")
+    records, _ = read_records(journal)
+    spans = [r for r in records if r.get("event") == "span"]
+    if not any(r.get("name") == "serve:recover" for r in spans):
+        return fail("no serve:recover span in the journal trace")
+    log("leg 1 (generations + torn tail) OK")
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# in-process legs
+# ---------------------------------------------------------------------------
+
+
+def run_worker_crash(quick: bool) -> int:
+    """Leg 2: worker-thread crash mid-batch -> boundary-checkpoint
+    resume (serve_retry resumed=true), everyone answered exactly once."""
+    _pin_cpu()
+    from bench_tpu_fem.harness.chaos import (
+        BoundaryCrashHook,
+        install_boundary_hook,
+    )
+    from bench_tpu_fem.serve import (
+        Broker,
+        ExecutableCache,
+        Metrics,
+        SolveSpec,
+    )
+
+    tmp = tempfile.mkdtemp(prefix="chaos_crash_")
+    journal = os.path.join(tmp, "SERVE_crash.jsonl")
+    metrics = Metrics(journal)
+    broker = Broker(ExecutableCache(), metrics, queue_max=64, nrhs_max=4,
+                    window_s=0.02, solve_timeout_s=120.0, retry_max=2,
+                    retry_backoff_s=0.01)
+    spec = SolveSpec(**SPEC_KW)
+    broker.warmup([spec])
+    hook = BoundaryCrashHook(crash_at=[5])
+    prev = install_boundary_hook(hook)
+    try:
+        pending = [broker.submit(spec, scale=float(1 + i))
+                   for i in range(3)]
+        outs = [broker.wait(p, 180) for p in pending]
+    finally:
+        install_boundary_hook(prev)
+        broker.shutdown()
+    if not all(o.get("ok") for o in outs):
+        return fail(f"worker-crash leg: not all answered ok: {outs}")
+    if not hook.crashes:
+        return fail("worker-crash leg: the scripted crash never fired")
+    if metrics.batch_resumes < 1:
+        return fail("worker-crash leg: retry did not resume the boundary "
+                    f"checkpoint (batch_resumes={metrics.batch_resumes})")
+    log(f"leg 2 (worker-thread crash -> boundary resume) OK "
+        f"(crashed at boundary {hook.crashes[0]}, "
+        f"resumes={metrics.batch_resumes})")
+    return 0
+
+
+def run_nan_injection(quick: bool) -> int:
+    """Leg 3: injected NaN -> breakdown sentinel, batch-mates clean."""
+    _pin_cpu()
+    from bench_tpu_fem.serve import (
+        Broker,
+        ExecutableCache,
+        Metrics,
+        SolveSpec,
+    )
+
+    broker = Broker(ExecutableCache(), Metrics(), queue_max=64,
+                    nrhs_max=4, window_s=0.05, solve_timeout_s=120.0)
+    # pre-convergence budget: past the f32 residual floor, underflow
+    # breaks exact power-of-two lane scaling (post-floor noise — the
+    # standing serve-parity caveat), which would fog the lane-isolation
+    # check this leg exists for
+    spec = SolveSpec(**{**SPEC_KW, "nreps": 60})
+    broker.warmup([spec])
+    try:
+        pending = [broker.submit(spec, scale=s)
+                   for s in (1.0, float("nan"), 2.0)]
+        outs = [broker.wait(p, 180) for p in pending]
+    finally:
+        broker.shutdown()
+    poisoned = outs[1]
+    if poisoned.get("ok") or poisoned.get("failure_class") != "breakdown":
+        return fail(f"NaN lane not classified breakdown: {poisoned}")
+    mates = [outs[0], outs[2]]
+    if not all(o.get("ok") and math.isfinite(o["xnorm"]) for o in mates):
+        return fail(f"NaN lane perturbed its batch-mates: {mates}")
+    if abs(mates[1]["xnorm"] - 2.0 * mates[0]["xnorm"]) > 1e-5 * abs(
+            mates[1]["xnorm"]):
+        return fail(f"batch-mate linearity broken next to the NaN lane: "
+                    f"{mates}")
+    log("leg 3 (injected NaN -> breakdown, lane-local) OK")
+    return 0
+
+
+def run_preemption(quick: bool) -> int:
+    """Leg 4: preemption mid-CG — SIGKILL right after a durable
+    snapshot, resume, compare BITWISE with the uninterrupted solve."""
+    BENCH = """
+import os
+os.environ.setdefault('JAX_PLATFORMS', 'cpu')
+from bench_tpu_fem.bench.driver import BenchConfig, run_benchmark
+import sys
+res = run_benchmark(BenchConfig(
+    ndofs_global=4000, degree=2, qmode=1, float_bits=32, nreps=24,
+    use_cg=True, checkpoint_every={every}, checkpoint_dir={ckdir!r}))
+print('YNORM', repr(res.ynorm), res.extra.get('checkpoint'))
+"""
+    tmp = tempfile.mkdtemp(prefix="chaos_preempt_")
+    ckdir = os.path.join(tmp, "snaps")
+
+    def run_bench(extra_env=None, every=6, ckdir_=None):
+        env = dict(CHILD_ENV)
+        env.update(extra_env or {})
+        return subprocess.run(
+            [sys.executable, "-u", "-c",
+             BENCH.format(every=every, ckdir=ckdir_ or "")],
+            capture_output=True, text=True, timeout=600, env=env,
+            cwd=ROOT)
+
+    # uninterrupted reference (chunked loop, no snapshots)
+    ref = run_bench()
+    if ref.returncode != 0:
+        return fail(f"preemption leg reference run failed:\n{ref.stdout}"
+                    f"\n{ref.stderr}")
+    ref_norm = [ln for ln in ref.stdout.splitlines()
+                if ln.startswith("YNORM")][0].split()[1]
+
+    # preempted run: SIGKILL'd by the store right after snapshot #2
+    pre = run_bench(extra_env={"CHAOS_CKPT_KILL_AFTER": "2"},
+                    ckdir_=ckdir)
+    if pre.returncode == 0:
+        return fail("preemption leg: the scripted SIGKILL never fired")
+    log(f"preempted run died rc {pre.returncode} (scripted) — resuming")
+
+    # resumed run restores the snapshot and finishes
+    res = run_bench(ckdir_=ckdir)
+    if res.returncode != 0:
+        return fail(f"preemption leg resume failed:\n{res.stdout}"
+                    f"\n{res.stderr}")
+    line = [ln for ln in res.stdout.splitlines()
+            if ln.startswith("YNORM")][0]
+    res_norm = line.split()[1]
+    if "'restored_iteration': 0" in line:
+        return fail(f"resume did not restore a snapshot: {line}")
+    if res_norm != ref_norm:
+        return fail(f"recovery parity broken: resumed {res_norm} != "
+                    f"uninterrupted {ref_norm} (bitwise contract)")
+    log(f"leg 4 (preemption mid-CG -> bitwise resume) OK ({line})")
+    return 0
+
+
+# ---------------------------------------------------------------------------
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--quick", action="store_true",
+                   help="bound the soak to ~60 s (the CI chaos lane)")
+    p.add_argument("--serve-child", type=int, default=0,
+                   help=argparse.SUPPRESS)  # internal: generation driver
+    p.add_argument("--journal", default="", help=argparse.SUPPRESS)
+    p.add_argument("--nreq", type=int, default=8, help=argparse.SUPPRESS)
+    args = p.parse_args(argv)
+    if args.serve_child:
+        return serve_child(args.journal, args.serve_child, args.nreq)
+    t0 = time.monotonic()
+    for leg in (run_generations, run_worker_crash, run_nan_injection,
+                run_preemption):
+        rc = leg(args.quick)
+        if rc:
+            return rc
+    log(f"CHAOS SOAK OK ({time.monotonic() - t0:.1f}s)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

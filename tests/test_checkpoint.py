@@ -1,0 +1,454 @@
+"""Durable CG checkpoints (ISSUE 9): the la.checkpoint state algebra,
+the harness.checkpoint crash-safe store, the breakdown sentinels in
+la.cg, and the driver wiring behind BenchConfig.checkpoint_every.
+
+The restore proof this file pins:
+
+  * the chunked iteration-boundary loop is BITWISE the one-`fori_loop`
+    `cg_solve` (the step body is verbatim), for f32 and f64, including a
+    save/restore round-trip through host numpy mid-solve;
+  * the df twin is bitwise `ops.kron_df.cg_solve_df` the same way;
+  * overshooting a frozen state is a bit-exact no-op (chunk sizes need
+    not divide the budget);
+  * the store survives torn files, CRC corruption, stranded .tmp files
+    and fingerprint mismatches by SKIPPING them (the previous durable
+    snapshot wins — never a crash, never a wrong restore);
+  * the driver's checkpoint_every=0 path is structurally untouched (the
+    checkpoint machinery is provably not on the disabled hot path), and
+    the enabled path is bitwise the plain run + carries the evidence
+    stamp.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bench_tpu_fem.harness.checkpoint import CheckpointStore, solve_fingerprint
+from bench_tpu_fem.la.cg import cg_solve, cg_solve_batched
+from bench_tpu_fem.la.checkpoint import (
+    cg_ckpt_init,
+    cg_ckpt_run,
+    df_cg_ckpt_init,
+    make_cg_ckpt_step,
+    make_df_cg_ckpt_step,
+    state_from_host,
+    state_to_host,
+)
+
+
+def _spd(n, seed, dtype):
+    rng = np.random.RandomState(seed)
+    M = rng.randn(n, n)
+    A = jnp.asarray(M @ M.T + n * np.eye(n), dtype)
+    b = jnp.asarray(rng.randn(n), dtype)
+    return (lambda v: A @ v), b
+
+
+# ---------------------------------------------------------------------------
+# la.checkpoint: the bitwise chunked-loop contract
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.float64])
+@pytest.mark.parametrize("chunk", [1, 5, 7])
+def test_chunked_loop_bitwise_cg_solve(dtype, chunk):
+    """ceil(nreps/chunk) chunked fori_loops == ONE fori_loop, bit for
+    bit, with a host save/restore round-trip in the middle (arrays move
+    as bits; nothing is recomputed)."""
+    apply_A, b = _spd(48, 3, dtype)
+    nreps = 23
+    ref = cg_solve(apply_A, b, jnp.zeros_like(b), nreps)
+
+    step = make_cg_ckpt_step(apply_A, nreps)
+    state = cg_ckpt_init(apply_A, b)
+    it = 0
+    while it < nreps:
+        state = cg_ckpt_run(state, step, chunk)
+        it += chunk
+        # the save/restore round trip every boundary: host numpy and
+        # back must be the identity on the state bits
+        state = state_from_host(state, state_to_host(state))
+    np.testing.assert_array_equal(np.asarray(state.x), np.asarray(ref))
+    assert int(state.iters) == nreps
+
+
+def test_overshoot_freezes_bitwise():
+    """Past the budget the state is bit-frozen: extra chunks are no-ops
+    (chunk sizes need not divide the budget)."""
+    apply_A, b = _spd(32, 7, jnp.float32)
+    step = make_cg_ckpt_step(apply_A, 10)
+    state = cg_ckpt_run(cg_ckpt_init(apply_A, b), step, 10)
+    over = cg_ckpt_run(state, step, 13)
+    for got, want in zip(jax.tree_util.tree_leaves(over),
+                         jax.tree_util.tree_leaves(state)):
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_chunked_rtol_matches_cg_solve():
+    """The rtol freeze fires identically in the chunked loop (the select
+    predicate is cg_solve's `done` while iters < max_iter)."""
+    apply_A, b = _spd(40, 11, jnp.float64)
+    nreps, rtol = 120, 1e-10
+    ref = cg_solve(apply_A, b, jnp.zeros_like(b), nreps, rtol=rtol)
+    step = make_cg_ckpt_step(apply_A, nreps, rtol=rtol)
+    state = cg_ckpt_init(apply_A, b)
+    for _ in range(-(-nreps // 9)):
+        state = cg_ckpt_run(state, step, 9)
+    np.testing.assert_array_equal(np.asarray(state.x), np.asarray(ref))
+    assert bool(state.done)
+
+
+def test_df_chunked_loop_bitwise_cg_solve_df():
+    """The df twin: chunked make_df_cg_ckpt_step == ops.kron_df's
+    cg_solve_df, bitwise on both channels, through a host round-trip."""
+    from bench_tpu_fem.elements import build_operator_tables
+    from bench_tpu_fem.mesh import create_box_mesh
+    from bench_tpu_fem.ops.kron_df import (
+        build_kron_laplacian_df,
+        cg_solve_df,
+        device_rhs_uniform_df,
+    )
+
+    t = build_operator_tables(2, 1, "gll")
+    mesh = create_box_mesh((3, 3, 3))
+    op = build_kron_laplacian_df(mesh, 2, 1, "gll", kappa=2.0, tables=t)
+    b = device_rhs_uniform_df(t, mesh.n)
+    nreps, chunk = 11, 4
+    ref = cg_solve_df(op, b, nreps)
+
+    step = make_df_cg_ckpt_step(op.apply, nreps)
+    state = df_cg_ckpt_init(b)
+    it = 0
+    while it < nreps:
+        state = cg_ckpt_run(state, step, chunk)
+        it += chunk
+        state = state_from_host(state, state_to_host(state))
+    np.testing.assert_array_equal(np.asarray(state.x.hi),
+                                  np.asarray(ref.hi))
+    np.testing.assert_array_equal(np.asarray(state.x.lo),
+                                  np.asarray(ref.lo))
+
+
+def test_state_from_host_validates_shape_dtype_count():
+    apply_A, b = _spd(16, 1, jnp.float32)
+    state = cg_ckpt_init(apply_A, b)
+    arrays = state_to_host(state)
+    wrong = dict(arrays)
+    wrong["leaf_000"] = np.zeros(17, np.float32)
+    with pytest.raises(ValueError, match="leaf 0"):
+        state_from_host(state, wrong)
+    wrong = dict(arrays)
+    wrong["leaf_000"] = arrays["leaf_000"].astype(np.float64)
+    with pytest.raises(ValueError, match="leaf 0"):
+        state_from_host(state, wrong)
+    with pytest.raises(ValueError, match="leaves"):
+        state_from_host(state, {"leaf_000": arrays["leaf_000"]})
+
+
+# ---------------------------------------------------------------------------
+# la.cg breakdown sentinels
+# ---------------------------------------------------------------------------
+
+
+def test_sentinel_healthy_solve_bitwise_and_clean():
+    """On a healthy solve the sentinel arm selects the identical values:
+    x is bitwise the unguarded solve, and every sentinel reads zero."""
+    apply_A, b = _spd(40, 21, jnp.float32)
+    ref = cg_solve(apply_A, b, jnp.zeros_like(b), 15)
+    x, info = cg_solve(apply_A, b, jnp.zeros_like(b), 15, sentinel=True)
+    np.testing.assert_array_equal(np.asarray(x), np.asarray(ref))
+    assert int(info["breakdown_restarts"]) == 0
+    assert not bool(info["nonfinite"])
+
+
+def test_sentinel_indefinite_operator_counts_restarts():
+    """<p, A p> <= 0 (an indefinite operator) is a breakdown: the step
+    is skipped (steepest-descent restart: beta = 0), counted, and the
+    returned x stays finite instead of exploding through a negative
+    curvature direction."""
+    n = 24
+    A = jnp.asarray(-np.eye(n), jnp.float32)  # strictly negative curvature
+    b = jnp.asarray(np.random.RandomState(2).randn(n), jnp.float32)
+    x, info = cg_solve(lambda v: A @ v, b, jnp.zeros_like(b), 8,
+                      sentinel=True)
+    assert int(info["breakdown_restarts"]) >= 1
+    assert np.isfinite(np.asarray(x)).all()
+
+
+def test_sentinel_nan_freezes_last_finite_iterate():
+    """A NaN-emitting operator (the injected-NaN chaos fault) makes the
+    unguarded loop return NaN; the sentinel loop returns the last finite
+    iterate (here x0) and flags why instead."""
+    apply_A, b = _spd(24, 5, jnp.float32)
+    poisoned = lambda v: apply_A(v) * jnp.nan  # noqa: E731
+    bad = cg_solve(poisoned, b, jnp.zeros_like(b), 10)
+    assert not np.isfinite(np.asarray(bad)).all()  # unguarded: NaN out
+    x, info = cg_solve(poisoned, b, jnp.zeros_like(b), 10, sentinel=True)
+    assert bool(info["nonfinite"]) or int(info["breakdown_restarts"]) > 0
+    assert np.isfinite(np.asarray(x)).all()
+
+
+def test_sentinel_batched_lane_isolation():
+    """Per-lane sentinels: a poisoned lane freezes finite and flags
+    itself; its batch-mates are BITWISE the clean batch."""
+    apply_A, b = _spd(32, 9, jnp.float32)
+    B = jnp.stack([b, 2.0 * b, 4.0 * b])
+    Bbad = B.at[1].set(B[1] * jnp.nan)
+    batch_apply = jax.vmap(apply_A)
+    ref = cg_solve_batched(apply_A, B, jnp.zeros_like(B), 12,
+                           batch_apply=batch_apply)
+    X, info = cg_solve_batched(apply_A, Bbad, jnp.zeros_like(B), 12,
+                               batch_apply=batch_apply, sentinel=True)
+    # clean lanes bitwise
+    np.testing.assert_array_equal(np.asarray(X[0]), np.asarray(ref[0]))
+    np.testing.assert_array_equal(np.asarray(X[2]), np.asarray(ref[2]))
+    # the poisoned lane froze finite and is flagged
+    assert np.isfinite(np.asarray(X[1])).all()
+    flagged = bool(info["nonfinite"][1]) or int(
+        info["breakdown_restarts"][1]) > 0
+    assert flagged
+    assert not bool(info["nonfinite"][0])
+    assert not bool(info["nonfinite"][2])
+
+
+# ---------------------------------------------------------------------------
+# harness.checkpoint: the crash-safe store
+# ---------------------------------------------------------------------------
+
+
+def _arrays(seed=0):
+    rng = np.random.RandomState(seed)
+    return {"leaf_000": rng.randn(8, 3).astype(np.float32),
+            "leaf_001": np.asarray(seed, np.int32)}
+
+
+def test_store_roundtrip_and_meta(tmp_path):
+    store = CheckpointStore(str(tmp_path), "fp1")
+    store.save(10, _arrays(1), meta={"note": "a"})
+    store.save(20, _arrays(2))
+    it, arrays, meta = store.latest()
+    assert it == 20 and meta["fingerprint"] == "fp1"
+    np.testing.assert_array_equal(arrays["leaf_000"],
+                                  _arrays(2)["leaf_000"])
+
+
+def test_store_skips_torn_and_corrupt(tmp_path):
+    store = CheckpointStore(str(tmp_path), "fp1", keep=10)
+    store.save(10, _arrays(1))
+    p20 = store.save(20, _arrays(2))
+    # torn: truncate the newest snapshot mid-payload (the crash case)
+    with open(p20, "r+b") as fh:
+        fh.truncate(os.path.getsize(p20) // 2)
+    it, arrays, _ = store.latest()
+    assert it == 10  # previous durable snapshot wins
+    # corrupt: flip payload bytes so the CRC fails
+    p30 = store.save(30, _arrays(3))
+    data = bytearray(open(p30, "rb").read())
+    data[-5] ^= 0xFF
+    open(p30, "wb").write(bytes(data))
+    it, _, _ = store.latest()
+    assert it == 10
+    # a stranded .tmp never reads as a snapshot
+    open(os.path.join(store.dir, "ckpt-000000099.ck.tmp"), "wb").write(
+        b"garbage")
+    it, _, _ = store.latest()
+    assert it == 10
+
+
+def test_store_fingerprint_mismatch_never_restores(tmp_path):
+    CheckpointStore(str(tmp_path), "fpA").save(5, _arrays(1))
+    other = CheckpointStore(str(tmp_path), "fpB")
+    assert other.latest() is None
+    # ...even if the bytes are copied into the wrong solve's directory
+    src = CheckpointStore(str(tmp_path), "fpA")._snapshots()[0][1]
+    import shutil
+
+    shutil.copy(src, os.path.join(other.dir, "ckpt-000000005.ck"))
+    assert other.latest() is None
+
+
+def test_store_prunes_to_keep(tmp_path):
+    store = CheckpointStore(str(tmp_path), "fp1", keep=2)
+    for it in (10, 20, 30, 40):
+        store.save(it, _arrays(it))
+    its = [i for i, _ in store._snapshots()]
+    assert its == [40, 30]
+
+
+def test_fingerprint_is_deterministic_and_field_sensitive():
+    a = solve_fingerprint(kind="x", ndofs=100, degree=3)
+    assert a == solve_fingerprint(kind="x", ndofs=100, degree=3)
+    assert a != solve_fingerprint(kind="x", ndofs=50, degree=3)
+
+
+def test_store_kill_after_seam(tmp_path):
+    """CHAOS_CKPT_KILL_AFTER: the process dies by SIGKILL right AFTER
+    the Nth snapshot is durable — the scripted preemption the chaos soak
+    resumes from. Subprocess: the kill is real."""
+    from bench_tpu_fem.harness.runner import run_subprocess
+
+    code = f"""
+import numpy as np
+from bench_tpu_fem.harness.checkpoint import CheckpointStore
+store = CheckpointStore({str(tmp_path)!r}, "fpk", kill_after=2)
+for it in (5, 10, 15):
+    store.save(it, {{"leaf_000": np.ones(4, np.float32)}})
+    print("saved", it, flush=True)
+print("NEVER REACHED", flush=True)
+"""
+    import sys
+
+    res = run_subprocess([sys.executable, "-u", "-c", code], 60,
+                         env={**os.environ, "JAX_PLATFORMS": "cpu"})
+    assert res.rc == -9, (res.rc, res.out)
+    # the kill fires INSIDE the 2nd save (after the rename+fsync), so
+    # only the 1st save's print ever lands — but the 2nd snapshot is
+    # durable: that ordering is the whole point of the seam
+    assert "saved 5" in res.out and "NEVER REACHED" not in res.out
+    it, _, _ = CheckpointStore(str(tmp_path), "fpk").latest()
+    assert it == 10  # the snapshot the kill proved durable
+
+
+# ---------------------------------------------------------------------------
+# driver wiring
+# ---------------------------------------------------------------------------
+
+
+_BENCH_KW = dict(ndofs_global=4000, degree=2, qmode=1, float_bits=32,
+                 nreps=18, use_cg=True)
+
+
+def test_driver_disabled_path_never_touches_checkpoint_machinery(
+        monkeypatch):
+    """checkpoint_every=0 (the default): the hot path is structurally
+    untouched — the checkpoint modules are provably not consulted (the
+    no-per-iteration-host-sync acceptance, checked structurally rather
+    than by a flaky timing bound) and no stamp appears."""
+    import bench_tpu_fem.la.checkpoint as la_ckpt
+    from bench_tpu_fem.bench.driver import BenchConfig, run_benchmark
+
+    def _bomb(*a, **k):
+        raise AssertionError("checkpoint machinery touched on the "
+                             "disabled path")
+
+    monkeypatch.setattr(la_ckpt, "cg_ckpt_init", _bomb)
+    monkeypatch.setattr(la_ckpt, "make_cg_ckpt_step", _bomb)
+    res = run_benchmark(BenchConfig(**_BENCH_KW))
+    assert "checkpoint" not in res.extra
+    assert np.isfinite(res.ynorm)
+
+
+def test_driver_checkpointed_run_bitwise_and_stamped(tmp_path):
+    from bench_tpu_fem.bench.driver import BenchConfig, run_benchmark
+
+    plain = run_benchmark(BenchConfig(**_BENCH_KW))
+    ck = run_benchmark(BenchConfig(**_BENCH_KW, checkpoint_every=5,
+                                   checkpoint_dir=str(tmp_path)))
+    assert ck.ynorm == plain.ynorm  # bitwise (f32 repr round-trips)
+    stamp = ck.extra["checkpoint"]
+    assert stamp["every"] == 5 and stamp["durable"] is True
+    assert stamp["saves"] == 4  # ceil(18/5) boundaries
+    assert stamp["restored_iteration"] == 0
+    assert stamp["evidence"] == "cpu-measured"
+
+
+def test_driver_restore_resumes_not_restarts(tmp_path):
+    """A run against a MID-SOLVE snapshot resumes from it (not iteration
+    0) and still reproduces the solution bitwise — while a COMPLETED
+    run's final snapshot (iteration == nreps) never restores: a retry
+    reusing the stage's round-stable snapshot dir would otherwise replay
+    zero iterations and journal a zero-work "measurement"."""
+    from bench_tpu_fem.bench.driver import BenchConfig, run_benchmark
+
+    kw = dict(_BENCH_KW, checkpoint_every=5,
+              checkpoint_dir=str(tmp_path))
+    first = run_benchmark(BenchConfig(**kw))
+    # completed snapshot (it 18 == nreps): measure fresh, reason recorded
+    second = run_benchmark(BenchConfig(**kw))
+    assert second.extra["checkpoint"]["restored_iteration"] == 0
+    assert second.extra["checkpoint"]["saves"] == 4
+    assert ("covers the whole solve"
+            in second.extra["checkpoint_restore_skipped"])
+    assert second.ynorm == first.ynorm
+    # drop the completed snapshot: the newest remaining one (it 15, the
+    # state a preemption mid-solve leaves behind) must resume
+    sub = next(p for p in tmp_path.iterdir() if p.is_dir())
+    (sub / "ckpt-000000018.ck").unlink()
+    third = run_benchmark(BenchConfig(**kw))
+    assert third.extra["checkpoint"]["restored_iteration"] == 15
+    assert third.extra["checkpoint"]["saves"] == 1  # 15 -> 18 only
+    assert third.ynorm == first.ynorm
+
+
+def test_driver_undurable_checkpoint_writes_nothing(tmp_path):
+    """checkpoint_every without a dir: the chunked loop runs (the
+    measured-overhead A/B arm) but no snapshot file appears."""
+    from bench_tpu_fem.bench.driver import BenchConfig, run_benchmark
+
+    res = run_benchmark(BenchConfig(**_BENCH_KW, checkpoint_every=6))
+    assert res.extra["checkpoint"]["durable"] is False
+    assert res.extra["checkpoint"]["saves"] == 0
+
+
+def test_driver_env_defaults_opt_in(tmp_path, monkeypatch):
+    """BENCH_CHECKPOINT_EVERY/DIR env -> BenchConfig defaults: the
+    harness-stage opt-in path (runner.Stage.ckpt_every) needs no payload
+    changes."""
+    from bench_tpu_fem.bench.driver import BenchConfig
+
+    monkeypatch.setenv("BENCH_CHECKPOINT_EVERY", "7")
+    monkeypatch.setenv("BENCH_CHECKPOINT_DIR", str(tmp_path))
+    cfg = BenchConfig(**_BENCH_KW)
+    assert cfg.checkpoint_every == 7
+    assert cfg.checkpoint_dir == str(tmp_path)
+
+
+def test_driver_mismatched_snapshot_measures_fresh(tmp_path):
+    """A snapshot from a DIFFERENT problem size never restores: the
+    fingerprint differs, so the run measures fresh (restored 0)."""
+    from bench_tpu_fem.bench.driver import BenchConfig, run_benchmark
+
+    run_benchmark(BenchConfig(**_BENCH_KW, checkpoint_every=5,
+                              checkpoint_dir=str(tmp_path)))
+    other = run_benchmark(BenchConfig(
+        **{**_BENCH_KW, "ndofs_global": 6000}, checkpoint_every=5,
+        checkpoint_dir=str(tmp_path)))
+    assert other.extra["checkpoint"]["restored_iteration"] == 0
+
+
+@pytest.mark.slow
+def test_dist_driver_checkpointed_bitwise_and_restores(tmp_path):
+    """The sharded (xla backend) checkpointed loop is bitwise the
+    one-executable sharded solve, and a restart restores."""
+    import jax.numpy as jnp
+
+    from bench_tpu_fem.bench.driver import BenchConfig, BenchmarkResults
+    from bench_tpu_fem.dist.driver import run_distributed
+
+    kw = dict(ndofs_global=64000, degree=2, qmode=1, float_bits=32,
+              nreps=12, use_cg=True, ndevices=8, backend="xla")
+    plain = BenchmarkResults()
+    run_distributed(BenchConfig(**kw), plain, jnp.float32)
+    ck = BenchmarkResults()
+    run_distributed(BenchConfig(**kw, checkpoint_every=5,
+                                checkpoint_dir=str(tmp_path)),
+                    ck, jnp.float32)
+    assert ck.ynorm == plain.ynorm
+    assert ck.extra["checkpoint"]["saves"] == 3
+    # the completed run's final snapshot never restores (a retry would
+    # measure zero iterations); drop it so the newest remaining snapshot
+    # is mid-solve (it 10) — that one must resume and stay bitwise
+    sub = next(p for p in tmp_path.iterdir() if p.is_dir())
+    (sub / "ckpt-000000012.ck").unlink()
+    re = BenchmarkResults()
+    run_distributed(BenchConfig(**kw, checkpoint_every=5,
+                                checkpoint_dir=str(tmp_path)),
+                    re, jnp.float32)
+    assert re.extra["checkpoint"]["restored_iteration"] == 10
+    assert re.extra["checkpoint"]["saves"] == 1
+    assert re.ynorm == plain.ynorm

@@ -166,6 +166,31 @@ def test_journal_tolerates_torn_tail_and_reports_corruption(tmp_path):
     assert rec["seq"] > 0
 
 
+def test_journal_append_heals_torn_tail(tmp_path):
+    """ISSUE-9 review hardening: the recovering generation's first
+    append after a crash left a newline-less torn tail must NOT glue
+    onto the fragment — gluing destroys the appended (fsynced!) record
+    and breaks the exactly-once fold built on the journal. The heal
+    isolates the fragment on its own line (surfaced as corruption, by
+    design) and the new record parses."""
+    from bench_tpu_fem.harness.chaos import tear_journal_tail
+
+    path = str(tmp_path / "j.jsonl")
+    j = J.Journal(path)
+    j.append({"event": "serve_request", "id": "r1"})
+    tear_journal_tail(path, rid="r2")  # SIGKILL mid-write signature
+    j2 = J.Journal(path)  # the restarted generation
+    j2.append({"event": "serve_response", "id": "r1", "ok": True})
+    recs, corrupt = J.read_records(path)
+    # the durable response SURVIVES (pre-fix it merged into the torn
+    # fragment and both were dropped: r1 read as lost/unanswered)
+    assert [r["event"] for r in recs] == ["serve_request",
+                                          "serve_response"]
+    # the fragment is now mid-file: surfaced as corruption, not silently
+    # forgiven as a torn FINAL line
+    assert len(corrupt) == 1
+
+
 def test_journal_seq_monotonic_across_shared_writers(tmp_path):
     """The agenda runner and bench.py's parent share one round journal
     (BENCH_JOURNAL): interleaved appends from separate Journal instances
@@ -502,9 +527,13 @@ def test_round6_agenda_shape():
     stages = A.make_stages("r99")
     names = A.resolve_stage_names(A.AGENDAS["round6"], stages)
     assert names[0] == "health" and stages["health"].critical
-    # the fused-batched hardware smoke is armed right after the CPU
-    # serve smoke (ISSUE 6)
-    assert names[:3] == ["health", "serve", "fusedbatch"]
+    # the CPU-provable software stages (serve smoke, chaos soak) run
+    # before the hardware stages; the fused-batched hardware smoke is
+    # armed right after them (ISSUE 6/9)
+    assert names[:4] == ["health", "serve", "chaos", "fusedbatch"]
+    assert stages["chaos"].env["JAX_PLATFORMS"] == "cpu"
+    # the capacity ladders opt into durable checkpoints (ISSUE 9)
+    assert stages["dflarge100"].ckpt_every > 0
     assert stages["dfacc"].provides_gate == "dfacc"
     for df in ("pertdf", "dfeng", "dfunf", "dflarge100", "dflarge150",
                "dfext2d"):
@@ -683,3 +712,191 @@ def test_bench_error_line_carries_failure_class():
     line = bench._error_line(
         "device init/probe exceeded 180s (TPU tunnel unavailable/wedged)")
     assert line["failure_class"] == "tunnel_wedge"
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 9: the `preempted`/`breakdown` classes + journal multi-writer
+# safety
+# ---------------------------------------------------------------------------
+
+
+def test_classify_preempted_real_fleet_texts():
+    """Real preemptible-fleet eviction notices classify `preempted` —
+    including the libtpu worker-restart text, which embeds UNAVAILABLE
+    and would otherwise misclassify as a wedge (the wrong policy: a
+    preempted machine is GONE, probe-and-wait cannot bring it back)."""
+    assert C.classify_text(F.PREEMPT_TEXT) == "preempted"
+    for text in (
+        "Instance was preempted by Compute Engine.",
+        "upcoming maintenance event on this TPU worker",
+        "The TPU worker with task id 3 was restarted",
+        "The instance was terminated by the managed instance group",
+        "Evicted pod serving-worker-2 (node shutdown)",
+        "pod deleted: TerminationByKubernetes",
+    ):
+        assert C.classify_text(text) == "preempted", text
+    # a plain wedge stays a wedge
+    assert C.classify_text("TPU tunnel unavailable/wedged") == \
+        "tunnel_wedge"
+    # rc/negative-signal deaths with the notice in the tail
+    assert C.classify(-9, F.PREEMPT_TEXT) == "preempted"
+
+
+def test_preempted_is_retriable_everywhere():
+    """ONE source of truth for the retriable split: the taxonomy set,
+    the serve broker's import, and the stage policy default all agree
+    that `preempted` retries and `breakdown` never does."""
+    from bench_tpu_fem.serve.broker import (
+        RETRIABLE_CLASSES as BROKER_CLASSES,
+    )
+
+    assert "preempted" in C.RETRIABLE_CLASSES
+    assert "breakdown" not in C.RETRIABLE_CLASSES
+    assert BROKER_CLASSES is C.RETRIABLE_CLASSES
+    pol = P.StagePolicy()
+    act = P.next_action("preempted", 1, pol)
+    assert act.kind == P.RETRY
+    assert P.next_action("breakdown", 1, pol).kind == P.GIVE_UP
+    assert "preempted" in C.TAXONOMY and "breakdown" in C.TAXONOMY
+
+
+def test_classify_breakdown_sentinel_texts():
+    assert C.classify_text("CG breakdown: non-finite residual") == \
+        "breakdown"
+    assert C.classify_text(
+        "failure_class': 'breakdown' breakdown_restarts 3") == "breakdown"
+    # breakdown evidence outranks the generic patterns
+    assert C.classify_text(
+        "CG breakdown detected; UNAVAILABLE collateral") == "breakdown"
+
+
+def test_preempted_stage_retries_and_completes(tmp_path):
+    """End-to-end through the runner: a stage killed by preemption (the
+    injected fleet notice) retries per policy and completes — never
+    enters the wedge probe loop."""
+    j = J.Journal(str(tmp_path / "j.jsonl"))
+    st = Stage(name="s1", command=lambda ctx: ["x"],
+               policy=P.StagePolicy(
+                   timeout_s=60,
+                   retry=P.RetryPolicy(max_attempts=2, backoff_s=1.0)))
+    r, ex, probe, sleep = make_runner(
+        [st], j, script={"s1": [F.preempted()]})
+    assert r.run() == 0
+    kinds = [e["kind"] for e in events(j, "action")]
+    assert kinds == [P.RETRY]
+    ends = events(j, "attempt_end")
+    assert ends[0]["failure_class"] == "preempted"
+    assert ends[1]["outcome"] == "ok"
+    assert probe.calls == 0  # no wedge probing for a preemption
+
+
+def test_journal_multi_writer_interleaving_safe(tmp_path):
+    """The multi-writer property (ISSUE 9 satellite): serve metrics and
+    harness stage records appended CONCURRENTLY to one round file must
+    interleave without corrupting each other — every record lands on its
+    own line, parses, and both consumers' torn-tail recovery still
+    works. Randomized over writer schedules."""
+    import threading
+
+    path = str(tmp_path / "round.jsonl")
+    rng = random.Random(1234)
+    n_per = 40
+
+    def harness_writer():
+        j = J.Journal(path)
+        for i in range(n_per):
+            j.append({"event": "attempt_start", "stage": f"h{i}",
+                      "attempt": 1})
+            if rng.random() < 0.3:
+                os.sched_yield()
+
+    def serve_writer():
+        from bench_tpu_fem.serve.metrics import Metrics
+
+        m = Metrics(path)
+        for i in range(n_per):
+            m.request(f"r{i}", {"degree": 2}, i, scale=1.0)
+            if i % 2 == 0:
+                m.response(f"r{i}", True, 0.01)
+
+    ts = [threading.Thread(target=harness_writer),
+          threading.Thread(target=serve_writer),
+          threading.Thread(target=harness_writer)]
+    [t.start() for t in ts]
+    [t.join() for t in ts]
+
+    records, corrupt = J.read_records(path)
+    assert corrupt == []  # no interleaved/torn bytes mid-file
+    stages = [r["stage"] for r in records
+              if r.get("event") == "attempt_start"]
+    assert len(stages) == 2 * n_per
+    reqs = [r["id"] for r in records if r.get("event") == "serve_request"]
+    assert sorted(reqs) == sorted(f"r{i}" for i in range(n_per))
+
+    # BOTH consumers' folds survive a torn tail on the shared file
+    from bench_tpu_fem.harness.chaos import tear_journal_tail
+    from bench_tpu_fem.serve.recovery import fold_outstanding
+
+    tear_journal_tail(path, rid="r1")  # a torn response for r1
+    plan = fold_outstanding(path)
+    outstanding = {r["id"] for r in plan.outstanding}
+    assert outstanding == {f"r{i}" for i in range(1, n_per, 2)} | {"r1"}
+    state = J.replay(path)
+    # two harness writers shared stage names: 2 attempts each, none lost
+    assert sum(state.attempts.values()) == 2 * n_per
+    assert state.corrupt == []
+
+
+def test_journal_seq_monotonic_across_concurrent_writers(tmp_path):
+    """Best-effort seq monotonicity (the PR-3 contract) holds under
+    concurrency in the common case; what MUST hold absolutely is that
+    no append ever clobbers another's bytes (O_APPEND single-write) —
+    counted exactly above; here: seqs never go backwards within one
+    writer's own stream."""
+    import threading
+
+    path = str(tmp_path / "seq.jsonl")
+
+    def writer(tag):
+        j = J.Journal(path)
+        last = -1
+        for i in range(30):
+            rec = j.append({"event": "probe", "ok": True, "w": tag})
+            assert rec["seq"] >= last
+            last = rec["seq"]
+
+    ts = [threading.Thread(target=writer, args=(t,)) for t in range(3)]
+    [t.start() for t in ts]
+    [t.join() for t in ts]
+    records, corrupt = J.read_records(path)
+    assert corrupt == [] and len(records) == 90
+
+
+def test_stage_ckpt_every_env_injection(tmp_path):
+    """Stage.ckpt_every routes the durable-checkpoint opt-in into the
+    child env (BENCH_CHECKPOINT_EVERY + a round-stable per-stage dir) so
+    a retried/resumed attempt restores instead of restarting — without
+    overriding an operator's explicit env."""
+    captured = {}
+
+    def fake_run(cmd, timeout_s, env=None, cwd=None):
+        captured.update(env or {})
+        from bench_tpu_fem.harness.runner import SubprocessResult
+
+        return SubprocessResult(0, "ok", False, 0.1)
+
+    j = J.Journal(str(tmp_path / "j.jsonl"))
+    st = Stage(name="dfl", command=lambda ctx: ["x"], ckpt_every=10)
+    r = Runner([st], j, probe=None, sleep=lambda s: None,
+               log=lambda m: None, cwd=str(tmp_path), round_tag="r99")
+    import bench_tpu_fem.harness.runner as runner_mod
+
+    orig = runner_mod.run_subprocess
+    runner_mod.run_subprocess = fake_run
+    try:
+        assert r.run() == 0
+    finally:
+        runner_mod.run_subprocess = orig
+    assert captured["BENCH_CHECKPOINT_EVERY"] == "10"
+    assert captured["BENCH_CHECKPOINT_DIR"] == os.path.join(
+        str(tmp_path), ".ckpt", "r99", "dfl")

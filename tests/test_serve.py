@@ -352,7 +352,11 @@ def test_backpressure_under_fault_injection(tmp_path, solver_f32_d2):
     the whole incident."""
     journal = str(tmp_path / "SERVE_incident.jsonl")
     metrics = Metrics(journal)
-    broker = _mini_broker(metrics, solve_timeout_s=1.0, window_s=0.05)
+    # retry_max=0: this test pins the CLIENT-visible classification
+    # contract; the broker-internal bounded retry (on by default) is
+    # covered by test_broker_internal_retry_*
+    broker = _mini_broker(metrics, solve_timeout_s=1.0, window_s=0.05,
+                          retry_max=0)
     spec = SPECS[1]
     broker.cache.get_or_build(spec_cache_key(spec, 4),
                               lambda: solver_f32_d2)
@@ -386,9 +390,14 @@ def test_backpressure_under_fault_injection(tmp_path, solver_f32_d2):
 
 @pytest.fixture(scope="module")
 def solver_slow():
-    """A solve long enough (~150 iteration boundaries) that requests
-    arriving during it are deterministically admissible mid-solve."""
-    return build_solver(SolveSpec(degree=2, ndofs=2500, nreps=600),
+    """A solve long enough (~60 iteration boundaries, ~0.6 s) that
+    requests arriving during it are deterministically admissible
+    mid-solve — while staying INSIDE the healthy numerical regime: the
+    old 600-iterations-on-2500-dofs spec rode the post-floor f32 noise
+    amplification (beta > 1 sustained) all the way to inf/NaN iterates,
+    which baseline served as ok:true and the ISSUE-9 breakdown sentinel
+    now correctly refuses to."""
+    return build_solver(SolveSpec(degree=2, ndofs=12000, nreps=240),
                         bucket=4)
 
 
@@ -447,6 +456,57 @@ def test_broker_continuous_midsolve_admission_beats_fixed_window(
     cont = lg.check_journal_continuous(jc)
     assert cont["midsolve_admissions"] == replay["midsolve_admissions"]
     assert cont["retires"] == 2 and cont["corrupt_lines"] == 0
+
+
+def test_broker_midadmission_crash_requeues_not_loses(tmp_path,
+                                                      solver_slow):
+    """ISSUE-9 review hardening: a retriable crash INSIDE cont_admit —
+    after the request left the queue, before it reached a lane or
+    `members` — must put the request back on the queue, not strand it:
+    the resumed attempt re-admits it and every request is answered
+    exactly once, with no duplicate admit/retire journal records."""
+    spec = solver_slow.spec
+
+    class _AdmitCrashOnce:
+        def __init__(self, inner):
+            self._inner = inner
+            self.crashed = False
+
+        def __getattr__(self, name):
+            return getattr(self._inner, name)
+
+        def cont_admit(self, state, lane, scale):
+            if not self.crashed:
+                self.crashed = True
+                raise RuntimeError("injected fault mid-admission")
+            return self._inner.cont_admit(state, lane, scale)
+
+    from bench_tpu_fem.harness.faults import FakeSleep
+
+    wrapper = _AdmitCrashOnce(solver_slow)
+    journal = str(tmp_path / "admitcrash.jsonl")
+    metrics = Metrics(journal)
+    broker = Broker(ExecutableCache(), metrics, queue_max=64, nrhs_max=4,
+                    window_s=0.01, solve_timeout_s=60.0, continuous=True,
+                    retry_max=2, retry_backoff_s=0.001, sleep=FakeSleep())
+    broker.cache.get_or_build(spec_cache_key(spec, 4), lambda: wrapper)
+    p1 = broker.submit(spec, 1.0)
+    time.sleep(0.12)  # p1's batch is mid-solve: p2 admits mid-solve
+    p2 = broker.submit(spec, 2.0)
+    outs = [broker.wait(p, 60) for p in (p1, p2)]
+    broker.shutdown()
+    assert wrapper.crashed  # the fault fired on p2's first admission
+    assert all(o["ok"] for o in outs), outs
+    np.testing.assert_allclose(outs[1]["xnorm"], 2.0 * outs[0]["xnorm"],
+                               rtol=1e-7)
+    assert metrics.broker_retries == 1
+    rep = replay_serve(journal)
+    # exactly-once all the way down: one response per request, one
+    # admit record per admission, no re-journaled retires on resume
+    assert rep["responses_ok"] == 2 and rep["responses_failed"] == 0
+    assert rep["retires"] == 2
+    assert rep["midsolve_admissions"] == 1
+    assert rep["corrupt_lines"] == 0
 
 
 def test_metrics_padding_waste_and_warm_latency(tmp_path):
@@ -683,3 +743,327 @@ def test_metrics_prometheus_exposition_and_lifecycle(served_broker):
     t2 = urllib.request.urlopen(url + "/metrics?format=prometheus",
                                 timeout=30).read().decode()
     assert "benchfem_serve_requests_total" in t2
+
+
+# ---------------------------------------------------------------------------
+# fault tolerance (ISSUE 9): recovery, internal retry, exactly-once,
+# breakdown sentinels
+# ---------------------------------------------------------------------------
+
+from bench_tpu_fem.harness.chaos import (  # noqa: E402
+    BoundaryCrashHook,
+    install_boundary_hook,
+    tear_journal_tail,
+)
+from bench_tpu_fem.serve.recovery import (  # noqa: E402
+    fold_outstanding,
+    verify_exactly_once,
+)
+
+
+def _spec_dict(spec):
+    return {"degree": spec.degree, "ndofs": spec.ndofs,
+            "nreps": spec.nreps, "precision": spec.precision,
+            "geom_perturb_fact": spec.geom_perturb_fact}
+
+
+def test_fold_outstanding_torn_tail_and_id_resume(tmp_path):
+    """The reader half of the exactly-once contract: requested-but-not-
+    responded requests fold out in admission order; a TORN response (the
+    crash-mid-write bytes) does NOT count as answered — the fsync never
+    returned, so the client was never released."""
+    journal = str(tmp_path / "SERVE_g1.jsonl")
+    m = Metrics(journal)
+    sd = _spec_dict(SPECS[1])
+    m.request("r1", sd, 1, scale=1.0)
+    m.request("r2", sd, 2, scale=2.0)
+    m.request("r7", sd, 3, scale=4.0)
+    m.response("r1", True, 0.1)
+    m.shed("r5", 9)
+    tear_journal_tail(journal, rid="r2")  # torn response for r2
+    plan = fold_outstanding(journal)
+    assert [r["id"] for r in plan.outstanding] == ["r2", "r7"]
+    assert plan.outstanding[0]["scale"] == 2.0
+    assert plan.max_numeric_id == 7
+    assert plan.requests == 3 and plan.responses == 1 and plan.shed == 1
+
+
+def test_verify_exactly_once_flags_losses_and_duplicates():
+    req = lambda i: {"event": "serve_request", "id": i}  # noqa: E731
+    resp = lambda i: {"event": "serve_response", "id": i}  # noqa: E731
+    good = [req("a"), req("b"), resp("a"), resp("b")]
+    assert verify_exactly_once(good)["ok"]
+    lost = verify_exactly_once([req("a"), req("b"), resp("a")])
+    assert not lost["ok"] and lost["lost"] == ["b"]
+    dup = verify_exactly_once(good + [resp("a")])
+    assert not dup["ok"] and dup["duplicates"] == ["a"]
+    shed = verify_exactly_once([req("a"), {"event": "serve_shed",
+                                           "id": "a"}])
+    assert shed["ok"]  # shed is answered-by-contract (503 went out)
+
+
+def test_broker_recover_replays_exactly_once(tmp_path, solver_f32_d2):
+    """The writer half: a crashed generation's journal replays into a
+    fresh broker — outstanding requests answered under their ORIGINAL
+    ids, fresh ids resume PAST the journaled ones, and the whole-journal
+    exactly-once verdict holds across both generations."""
+    journal = str(tmp_path / "SERVE_incident.jsonl")
+    m1 = Metrics(journal)
+    sd = _spec_dict(SPECS[1])
+    m1.request("r1", sd, 1, scale=1.0)
+    m1.request("r2", sd, 2, scale=2.0)
+    m1.request("r3", sd, 3, scale=4.0)
+    m1.response("r1", True, 0.1)          # answered pre-crash
+    tear_journal_tail(journal, rid="r3")  # crash tore r3's response
+
+    m2 = Metrics(journal)
+    broker = _mini_broker(m2)
+    broker.cache.get_or_build(spec_cache_key(SPECS[1], 4),
+                              lambda: solver_f32_d2)
+    rec = broker.recover(journal)
+    assert rec["replayed"] == 2 and rec["skipped"] == 0
+    outs = [broker.wait(p, 60) for p in rec["pending"]]
+    fresh = broker.submit(SPECS[1])
+    out_f = broker.wait(fresh, 60)
+    broker.shutdown()
+    assert all(o["ok"] for o in outs), outs
+    assert out_f["ok"] and fresh.id == "r4"  # past max journaled id
+    verdict = verify_exactly_once(journal)
+    assert verdict["ok"], verdict
+    snap = m2.snapshot()
+    assert snap["recovery_runs"] == 1
+    assert snap["recovered_requests"] == 2
+
+
+def test_broker_recover_skips_unrebuildable_spec(tmp_path,
+                                                 solver_f32_d2):
+    """A journal record too damaged to rebuild its SolveSpec is counted
+    `skipped`, never crashes the recovery, and the rest still replays —
+    and the skipped id still gets a TERMINAL failure response, so the
+    exactly-once ledger closes instead of reading it as LOST forever."""
+    journal = str(tmp_path / "SERVE_damaged.jsonl")
+    m1 = Metrics(journal)
+    m1.request("r1", {"degree": 99}, 1, scale=1.0)  # validate() fails
+    m1.request("r2", _spec_dict(SPECS[1]), 2, scale=1.0)
+    broker = _mini_broker(Metrics(journal))
+    broker.cache.get_or_build(spec_cache_key(SPECS[1], 4),
+                              lambda: solver_f32_d2)
+    rec = broker.recover(journal)
+    outs = [broker.wait(p, 60) for p in rec["pending"]]
+    broker.shutdown()
+    assert rec["replayed"] == 1 and rec["skipped"] == 1
+    assert outs[0]["ok"] and outs[0]["id"] == "r2"
+    verdict = verify_exactly_once(journal)
+    assert verdict["ok"], verdict
+    with open(journal, encoding="utf-8") as fh:
+        records = [json.loads(ln) for ln in fh]
+    terminal = [r for r in records
+                if r.get("event") == "serve_response"
+                and r.get("id") == "r1"]
+    assert len(terminal) == 1
+    assert terminal[0]["failure_class"] == "unsupported"
+    assert terminal[0]["retriable"] is False
+
+
+def test_broker_internal_retry_absorbs_transient(tmp_path,
+                                                 solver_f32_d2):
+    """A retriable solve fault (OOM here) is retried INSIDE the broker
+    with backoff+jitter: the client sees ok:true, the journal carries
+    the serve_retry record, /metrics counts it."""
+    from bench_tpu_fem.harness.faults import FakeSleep
+
+    journal = str(tmp_path / "SERVE_retry.jsonl")
+    metrics = Metrics(journal)
+    sleeper = FakeSleep()
+    broker = _mini_broker(metrics, retry_max=2, retry_backoff_s=0.05,
+                          sleep=sleeper)
+    broker.cache.get_or_build(spec_cache_key(SPECS[1], 4),
+                              lambda: solver_f32_d2)
+    engine_mod.FAULT_HOOK = FaultySolveHook(["oom"])
+    try:
+        out = broker.wait(broker.submit(SPECS[1]), 60)
+    finally:
+        engine_mod.FAULT_HOOK = None
+        broker.shutdown()
+    assert out["ok"], out
+    assert metrics.broker_retries == 1
+    assert len(sleeper.waits) == 1 and sleeper.waits[0] >= 0.05
+    rep = replay_serve(journal)
+    assert rep["broker_retries"] == 1
+    assert rep["responses_ok"] == 1 and rep["responses_failed"] == 0
+
+
+def test_broker_internal_retry_backoff_grows_with_jitter(
+        tmp_path, solver_f32_d2):
+    import random
+
+    sleeper_waits = []
+
+    class _Sleep:
+        def __call__(self, s):
+            sleeper_waits.append(s)
+
+    broker = _mini_broker(Metrics(), retry_max=3, retry_backoff_s=0.1,
+                          retry_jitter=0.5, sleep=_Sleep(),
+                          rng=random.Random(7))
+    broker.cache.get_or_build(spec_cache_key(SPECS[1], 4),
+                              lambda: solver_f32_d2)
+    engine_mod.FAULT_HOOK = FaultySolveHook(["oom", "oom", "oom"])
+    try:
+        out = broker.wait(broker.submit(SPECS[1]), 60)
+    finally:
+        engine_mod.FAULT_HOOK = None
+        broker.shutdown()
+    assert out["ok"], out
+    assert len(sleeper_waits) == 3
+    # exponential base doubles; jitter stays within [1, 1.5)x
+    for i, w in enumerate(sleeper_waits):
+        base = 0.1 * 2 ** i
+        assert base <= w < base * 1.5 + 1e-9, (i, w)
+
+
+def test_broker_deterministic_failure_never_retried(solver_f32_d2):
+    from bench_tpu_fem.harness.faults import FakeSleep
+
+    sleeper = FakeSleep()
+    metrics = Metrics()
+    broker = _mini_broker(metrics, retry_max=3, sleep=sleeper)
+    broker.cache.get_or_build(spec_cache_key(SPECS[1], 4),
+                              lambda: solver_f32_d2)
+    engine_mod.FAULT_HOOK = FaultySolveHook(["mosaic"])
+    try:
+        out = broker.wait(broker.submit(SPECS[1]), 60)
+    finally:
+        engine_mod.FAULT_HOOK = None
+        broker.shutdown()
+    assert not out["ok"] and out["failure_class"] == "mosaic_reject"
+    assert metrics.broker_retries == 0 and sleeper.waits == []
+
+
+def test_broker_preempted_classified_retriable(solver_f32_d2):
+    """The `preempted` class end-to-end through the serve stack: the
+    real worker-restart notice (which embeds UNAVAILABLE) must classify
+    preempted — not tunnel_wedge — and read retriable."""
+    broker = _mini_broker(Metrics(), retry_max=0)
+    broker.cache.get_or_build(spec_cache_key(SPECS[1], 4),
+                              lambda: solver_f32_d2)
+    engine_mod.FAULT_HOOK = FaultySolveHook(["preempt"])
+    try:
+        out = broker.wait(broker.submit(SPECS[1]), 60)
+    finally:
+        engine_mod.FAULT_HOOK = None
+        broker.shutdown()
+    assert not out["ok"]
+    assert out["failure_class"] == "preempted"
+    assert out["retriable"] is True
+
+
+def test_worker_crash_resumes_boundary_checkpoint(tmp_path, solver_slow):
+    """The SIGKILL-adjacent worker-thread crash: BOUNDARY_HOOK raises
+    mid-batch inside the solve thread; the broker's retry re-enters
+    _solve_continuous FROM the parked boundary checkpoint (journaled
+    serve_retry resumed=true) and the request is answered ok — iterates
+    survive, the batch is not restarted at iteration 0."""
+    journal = str(tmp_path / "SERVE_crash.jsonl")
+    metrics = Metrics(journal)
+    broker = Broker(ExecutableCache(), metrics, queue_max=64, nrhs_max=4,
+                    window_s=0.01, solve_timeout_s=60.0, retry_max=2,
+                    retry_backoff_s=0.001)
+    broker.cache.get_or_build(spec_cache_key(solver_slow.spec, 4),
+                              lambda: solver_slow)
+    hook = BoundaryCrashHook(crash_at=[4])
+    prev = install_boundary_hook(hook)
+    try:
+        out = broker.wait(broker.submit(solver_slow.spec), 120)
+    finally:
+        install_boundary_hook(prev)
+        broker.shutdown()
+    assert out["ok"], out
+    assert hook.crashes == [4]
+    assert metrics.broker_retries == 1
+    assert metrics.batch_resumes == 1  # resumed, not restarted
+    rep = replay_serve(journal)
+    assert rep["batch_resumes"] == 1
+    # the crash landed at boundary 4, so the request still ran its FULL
+    # budget across the two attempts (iters_run is per-lane truth)
+    assert out["iters_run"] == solver_slow.spec.nreps
+
+
+def test_worker_crash_at_boundary_zero_no_duplicate_admits(
+        tmp_path, solver_slow):
+    """A crash BEFORE the first in-loop park (boundary 0, right after
+    cont_init journaled the members' serve_admit records): the retry
+    must resume from the boundary-0 checkpoint, NOT re-run cont_init —
+    re-running would journal every member's serve_admit a second time
+    and double-count those lanes in journal replay."""
+    journal = str(tmp_path / "SERVE_crash0.jsonl")
+    metrics = Metrics(journal)
+    broker = Broker(ExecutableCache(), metrics, queue_max=64, nrhs_max=4,
+                    window_s=0.01, solve_timeout_s=60.0, retry_max=2,
+                    retry_backoff_s=0.001)
+    broker.cache.get_or_build(spec_cache_key(solver_slow.spec, 4),
+                              lambda: solver_slow)
+    hook = BoundaryCrashHook(crash_at=[0])
+    prev = install_boundary_hook(hook)
+    try:
+        pend = broker.submit(solver_slow.spec)
+        out = broker.wait(pend, 120)
+    finally:
+        install_boundary_hook(prev)
+        broker.shutdown()
+    assert out["ok"], out
+    assert metrics.batch_resumes == 1  # resumed, even at boundary 0
+    with open(journal, encoding="utf-8") as fh:
+        records = [json.loads(ln) for ln in fh]
+    admits = [r for r in records if r.get("event") == "serve_admit"
+              and r.get("id") == pend.id]
+    assert len(admits) == 1, admits  # journaled exactly once
+
+
+def test_respond_exactly_once_under_race(solver_f32_d2):
+    """_respond hardening (ISSUE 9 satellite): N racing responders — the
+    _fail_batch path vs a late worker retire — produce exactly ONE
+    response; the losers' payloads are dropped and metrics count once."""
+    metrics = Metrics()
+    broker = _mini_broker(metrics)
+    try:
+        from bench_tpu_fem.serve.broker import PendingRequest
+
+        pending = PendingRequest("rx", SPECS[1], 1.0, time.monotonic())
+        wins = []
+        barrier = threading.Barrier(8)
+
+        def responder(i):
+            barrier.wait()
+            wins.append(broker._respond(pending, {
+                "ok": i % 2 == 0, "id": "rx",
+                "failure_class": None if i % 2 == 0 else "timeout"}))
+
+        ts = [threading.Thread(target=responder, args=(i,))
+              for i in range(8)]
+        [t.start() for t in ts]
+        [t.join() for t in ts]
+        assert sum(wins) == 1  # exactly one claim won
+        assert pending.done.is_set()
+        assert metrics.completed + metrics.failed == 1
+    finally:
+        broker.shutdown()
+
+
+def test_breakdown_sentinel_nan_scale_lane_local(solver_f32_d2):
+    """Injected NaN (the chaos fault): the poisoned lane answers
+    failure_class='breakdown' (never ok:true with a NaN norm); its
+    batch-mates are unaffected and stay exactly linear."""
+    broker = _mini_broker(Metrics())
+    broker.cache.get_or_build(spec_cache_key(SPECS[1], 4),
+                              lambda: solver_f32_d2)
+    pend = [broker.submit(SPECS[1], scale=s)
+            for s in (1.0, float("nan"), 2.0)]
+    outs = [broker.wait(p, 60) for p in pend]
+    broker.shutdown()
+    assert not outs[1]["ok"]
+    assert outs[1]["failure_class"] == "breakdown"
+    assert outs[1]["retriable"] is False
+    assert outs[0]["ok"] and outs[2]["ok"]
+    np.testing.assert_allclose(outs[2]["xnorm"], 2.0 * outs[0]["xnorm"],
+                               rtol=1e-6)
