@@ -125,6 +125,19 @@ class BenchConfig:
         os.environ.get("BENCH_CHECKPOINT_EVERY", "0") or 0))
     checkpoint_dir: str = field(default_factory=lambda: os.environ.get(
         "BENCH_CHECKPOINT_DIR", ""))
+    # Convergence telemetry (ISSUE 10): capture the per-iteration
+    # residual-norm history inside the CG loop (la.cg capture=True —
+    # device-buffered, no host sync on the hot path) and stamp the
+    # `convergence` evidence block + the paired time-to-rtol metric
+    # next to GDoF/s (obs.convergence). Routes fused whole-solve
+    # engines to the capture-able unfused loop with
+    # `convergence_gate_reason` recorded (same discipline as durable
+    # checkpointing). False (the default) leaves the hot path
+    # untouched — bitwise the pre-capture solve. Env default:
+    # BENCH_CONVERGENCE=1 (harness stages opt in without payload
+    # changes).
+    convergence: bool = field(default_factory=lambda: bool(int(
+        os.environ.get("BENCH_CONVERGENCE", "0") or 0)))
 
 
 @dataclass
@@ -239,6 +252,48 @@ CHECKPOINT_GATE_REASON = (
     "durable checkpointing (checkpoint_every > 0): the fused whole-solve "
     "engine exposes no iteration boundary; running the unfused "
     "checkpointable loop (la.checkpoint)")
+
+# The recorded reason every fused-engine CG branch stamps when
+# convergence capture is requested (ISSUE 10): the whole-solve engines
+# bake the recurrence into ONE kernel chain with no per-iteration
+# residual to buffer, so the driver runs the capture-able unfused loop
+# instead (same structure as the checkpoint gate above).
+CONVERGENCE_GATE_REASON = (
+    "convergence capture (convergence=True): the fused whole-solve "
+    "engine exposes no per-iteration residual to buffer; running the "
+    "unfused capture-able loop (la.cg capture=True)")
+
+
+def _fence_scalar(out) -> None:
+    """The drivers' warm-up hard fence (one scalar fetch), tolerant of
+    tuple results — a convergence-captured solve returns (x, info) or
+    (x, hist). Plain tuples fence their first element; DF results (a
+    NamedTuple, not a plain tuple) fence their hi channel."""
+    if type(out) is tuple:
+        out = out[0]
+    arr = out.hi if hasattr(out, "hi") else out
+    float(arr[(0,) * arr.ndim])
+
+
+def stamp_convergence(extra: dict, info, *, wall_s: float,
+                      iters_run: int, nrhs: int = 1) -> None:
+    """Fold a captured residual history (the info dict the capture-mode
+    solvers return) into the `convergence` + `time_to_rtol_s` stamps
+    (obs.convergence). Batched histories fold lane 0 (scale 1.0 — the
+    one-shot problem verbatim). Telemetry must never sink a benchmark:
+    failures stamp `convergence_error` instead of raising."""
+    from ..obs.convergence import convergence_stamp
+
+    try:
+        hist = np.asarray(info["rnorm_history"], dtype=np.float64)
+        lane = None
+        if hist.ndim == 2:
+            lane = 0
+            hist = hist[:, 0]
+        convergence_stamp(extra, hist, wall_s=wall_s, iters_run=iters_run,
+                          nrhs=nrhs, lane=lane)
+    except Exception as exc:
+        extra["convergence_error"] = exc_str(exc)
 
 
 def checkpoint_fingerprint(cfg: BenchConfig, kind: str,
@@ -679,6 +734,12 @@ def _run_benchmark_folded_df(cfg: BenchConfig) -> BenchmarkResults:
         res.extra["checkpoint_gate_reason"] = (
             "folded-df pipeline has no checkpointable loop form; "
             "snapshots disabled for this run")
+    if cfg.convergence:
+        # same seam: the folded df CG's residual rides the kernel chain
+        # with no per-iteration buffer to capture into (recorded)
+        res.extra["convergence_gate_reason"] = (
+            "folded-df pipeline has no capture-able loop form; "
+            "convergence capture disabled for this run")
 
     # Host-assembled f64 RHS (the reference assembles its RHS on the CPU
     # too), split into df channels and folded per channel. The oracle
@@ -880,6 +941,22 @@ def _run_benchmark_df64(cfg: BenchConfig) -> BenchmarkResults:
             # whole-solve executable with no boundary to snapshot at
             engine = False
             res.extra["checkpoint_gate_reason"] = CHECKPOINT_GATE_REASON
+        # convergence capture (ISSUE 10): rides the unfused df loop
+        # (cg_solve_df capture=True); the fused df ring gates off with
+        # the reason recorded — same discipline as the f32 driver
+        conv = cfg.convergence and cfg.use_cg and not ckpt
+        if cfg.convergence and cfg.use_cg and ckpt:
+            res.extra["convergence_gate_reason"] = (
+                "convergence capture is not wired through the "
+                "checkpointable chunked loop; capture disabled for "
+                "this checkpointed run")
+        if cfg.convergence and not cfg.use_cg:
+            res.extra["convergence_gate_reason"] = (
+                "convergence capture applies to CG solves only (action "
+                "runs carry no residual); capture disabled")
+        if conv and engine:
+            engine = False
+            res.extra["convergence_gate_reason"] = CONVERGENCE_GATE_REASON
         compile_opts = scoped_vmem_options(kib) if engine else None
         record_engine(res.extra, engine, ENGINE_FORM_NAMES.get(form, form))
 
@@ -895,7 +972,8 @@ def _run_benchmark_df64(cfg: BenchConfig) -> BenchmarkResults:
 
         def _unfused():
             if cfg.use_cg:
-                return lambda A, b: cg_solve_df(A, b, cfg.nreps)
+                return lambda A, b: cg_solve_df(A, b, cfg.nreps,
+                                                capture=conv)
             return lambda A, b: action_df(A, b, cfg.nreps)
 
         run_ck = ck_store = None
@@ -906,7 +984,7 @@ def _run_benchmark_df64(cfg: BenchConfig) -> BenchmarkResults:
                 _make_checkpointed_cg_df(cfg, res, obs, op, u))
             with obs.phase("transfer"):
                 warm = run_ck(save=False)
-                float(warm.hi[(0,) * warm.hi.ndim])
+                _fence_scalar(warm)
                 del warm
             fn = None
         else:
@@ -947,12 +1025,16 @@ def _run_benchmark_df64(cfg: BenchConfig) -> BenchmarkResults:
                         fn = compile_lowered(_lower(_unfused()))
             with obs.phase("transfer"):
                 warm = fn(op, u)
-                float(warm.hi[(0,) * warm.hi.ndim])
+                _fence_scalar(warm)
                 del warm
 
     y = obs.timed_reps(run_ck if run_ck is not None
                        else (lambda: fn(op, u)))
     res.mat_free_time = obs.elapsed()
+    conv_info = None
+    if conv and run_ck is None:
+        # convergence-captured df solve: fetch the history once, here
+        y, conv_info = y
 
     # Norms on device: L2 via the compensated df dot (f64-class); Linf on
     # the f32-rounded hi+lo (|.|max to ~f32 relative accuracy — casting to
@@ -981,6 +1063,9 @@ def _run_benchmark_df64(cfg: BenchConfig) -> BenchmarkResults:
                          ck_saves["n"])
     stamp_breakdown(res.extra, res.ynorm)
     stamp_observability(cfg, res, obs, "df32")
+    if conv_info is not None:
+        stamp_convergence(res.extra, conv_info,
+                          wall_s=res.mat_free_time, iters_run=cfg.nreps)
 
     if cfg.mat_comp:
         # assembled-CSR oracle in true f64 (host path; oracle runs are
@@ -1047,13 +1132,28 @@ def _finish_batched(cfg: BenchConfig, res: BenchmarkResults, n, op, u,
             def engine_run(A, Bv):
                 return kron_cg_solve_batched(A, Bv, cfg.nreps)
 
+    # convergence capture (ISSUE 10): per-lane history through
+    # cg_solve_batched(capture=True); the fused batched ring gates off
+    # with the reason recorded (same discipline as the single-RHS gate)
+    conv = cfg.convergence and cfg.use_cg
+    if cfg.convergence and not cfg.use_cg:
+        res.extra["convergence_gate_reason"] = (
+            "convergence capture applies to CG solves only (action "
+            "runs carry no residual); capture disabled")
+    if conv and engine:
+        engine = False
+        engine_run = None
+        planned_form = "unfused"
+        res.extra["convergence_gate_reason"] = CONVERGENCE_GATE_REASON
+
     if not engine:
         record_engine(res.extra, False, error=BATCHED_UNFUSED_REASON)
 
     if cfg.use_cg:
         def run(A, Bv):
             return cg_solve_batched(apply_one(A), Bv,
-                                    jnp.zeros_like(Bv), cfg.nreps)
+                                    jnp.zeros_like(Bv), cfg.nreps,
+                                    capture=conv)
     else:
         def run(A, Bv):
             def _rep(i, Y):
@@ -1068,7 +1168,8 @@ def _finish_batched(cfg: BenchConfig, res: BenchmarkResults, n, op, u,
     # with its true routing stamps replayed from the entry meta).
     obs = BenchObserver(cfg)
     key = _exec_cache_key(cfg, n, planned_form,
-                          "cg" if cfg.use_cg else "action")
+                          ("cg+conv" if conv else "cg") if cfg.use_cg
+                          else "action")
     fn = _exec_cache_get(cfg, key, res)
     from_cache = fn is not None
     with obs.phase("compile"):
@@ -1088,11 +1189,14 @@ def _finish_batched(cfg: BenchConfig, res: BenchmarkResults, n, op, u,
         _exec_cache_put(cfg, key, fn, res)
     with obs.phase("transfer"):
         warm = fn(op, B)
-        float(warm[(0,) * warm.ndim])
+        _fence_scalar(warm)
         del warm
 
     Y = obs.timed_reps(lambda: fn(op, B))
     elapsed = obs.elapsed()
+    conv_info = None
+    if conv:
+        Y, conv_info = Y
 
     res.mat_free_time = elapsed
     y0 = Y[0]
@@ -1103,6 +1207,9 @@ def _finish_batched(cfg: BenchConfig, res: BenchmarkResults, n, op, u,
     res.gdof_per_second = (
         res.ndofs_global * cfg.nreps * cfg.nrhs / (1e9 * elapsed))
     stamp_observability(cfg, res, obs)
+    if conv_info is not None:
+        stamp_convergence(res.extra, conv_info, wall_s=elapsed,
+                          iters_run=cfg.nreps, nrhs=cfg.nrhs)
 
     if cfg.mat_comp and oracle_args is not None:
         t, dm, bc_grid, b_host, G_host = oracle_args
@@ -1133,6 +1240,12 @@ def _finish_batched_df(cfg: BenchConfig, res: BenchmarkResults, n, op, u,
 
     stamp_nrhs(res.extra, cfg.nrhs, cfg.checkpoint_every)
     record_engine(res.extra, False, error=BATCHED_UNFUSED_REASON)
+    if cfg.convergence:
+        # the batched df path vmaps the WHOLE per-lane df solve; its
+        # capture form is not wired (recorded, never silent)
+        res.extra["convergence_gate_reason"] = (
+            "batched df32 (vmapped whole-solve) has no wired capture "
+            "form; convergence capture disabled for this run")
     scales = jnp.asarray(batch_scales(cfg.nrhs), jnp.float32)
     sb = scales.reshape((-1,) + (1,) * u.hi.ndim)
     B = DF(sb * u.hi[None], sb * u.lo[None])
@@ -1383,15 +1496,36 @@ def _run_benchmark(cfg: BenchConfig) -> BenchmarkResults:
             apply_fn = unfused_apply
             res.extra["checkpoint_gate_reason"] = CHECKPOINT_GATE_REASON
             record_engine(res.extra, False)
+        # Convergence capture (ISSUE 10): the history buffer rides the
+        # unfused la.cg loop; fused whole-solve engines gate off with
+        # the reason recorded (same discipline as the checkpoint gate).
+        conv = cfg.convergence and cfg.use_cg and not ckpt
+        if cfg.convergence and cfg.use_cg and ckpt:
+            conv = False
+            res.extra["convergence_gate_reason"] = (
+                "convergence capture is not wired through the "
+                "checkpointable chunked loop; capture disabled for "
+                "this checkpointed run")
+        if cfg.convergence and not cfg.use_cg:
+            res.extra["convergence_gate_reason"] = (
+                "convergence capture applies to CG solves only (action "
+                "runs carry no residual); capture disabled")
+        if conv and engine:
+            engine = False
+            apply_fn = unfused_apply
+            res.extra["convergence_gate_reason"] = CONVERGENCE_GATE_REASON
+            record_engine(res.extra, False)
         # Executable-cache key: the PLANNED engine form (what the plan
         # functions deterministically pick for this config), so a repeat
         # of the same config finds the executable its first compile
         # produced — even when that compile fell back (the fallback
         # executable is stored under the planned key, the final routing
-        # stamps replay from the entry's meta).
+        # stamps replay from the entry's meta). A capture-mode solve
+        # lowers a DIFFERENT output signature (x, info) — its key must
+        # never collide with the plain solve's.
         exec_key = _exec_cache_key(
             cfg, n, res.extra.get("cg_engine_form", "unfused"),
-            "cg" if cfg.use_cg else "action")
+            ("cg+conv" if conv else "cg") if cfg.use_cg else "action")
         obs = BenchObserver(cfg)
         run_ck = ck_store = ck_saves = None
         ck_restored = 0
@@ -1450,7 +1584,7 @@ def _run_benchmark(cfg: BenchConfig) -> BenchmarkResults:
                 with obs.phase("compile"):
                     fn = compile_lowered(jax.jit(
                         lambda A, b, x0: cg_solve(apply_fn(A), b, x0,
-                                                  cfg.nreps)
+                                                  cfg.nreps, capture=conv)
                     ).lower(op, u, jnp.zeros_like(u)), fallback_opts)
             if not from_cache:
                 _exec_cache_put(cfg, exec_key, fn, res)
@@ -1517,7 +1651,7 @@ def _run_benchmark(cfg: BenchConfig) -> BenchmarkResults:
         # save a few seconds of device time — net slower at every
         # benchmark size we run.
         with obs.phase("transfer"):
-            float(warm[(0,) * warm.ndim])
+            _fence_scalar(warm)
             del warm
 
     if run_ck is not None:
@@ -1526,6 +1660,12 @@ def _run_benchmark(cfg: BenchConfig) -> BenchmarkResults:
         y = obs.timed_reps(lambda: fn(op, u, jnp.zeros_like(u))
                            if cfg.use_cg else fn(op, u))
     elapsed = obs.elapsed()
+    conv_info = None
+    if conv:
+        # convergence-captured solve: (x, info) — the history is
+        # fetched HERE, once, outside the timed region (conv implies
+        # the unfused capture loop compiled above; ckpt forces conv off)
+        y, conv_info = y
 
     res.mat_free_time = elapsed
     from ..la.vector import norm, norm_linf
@@ -1544,6 +1684,9 @@ def _run_benchmark(cfg: BenchConfig) -> BenchmarkResults:
                          ck_saves["n"])
     stamp_observability(cfg, res, obs,
                         "f32" if cfg.float_bits == 32 else "f64")
+    if conv_info is not None:
+        stamp_convergence(res.extra, conv_info, wall_s=elapsed,
+                          iters_run=cfg.nreps)
 
     if cfg.mat_comp:
         z = _mat_comp_oracle(cfg, t, dm, bc_grid, b_host, G_host)

@@ -74,4 +74,11 @@ def results_json(cfg: BenchConfig, res: BenchmarkResults) -> str:
                 "checkpoint_restore_skipped", "checkpoint_restore_error"):
         if key in res.extra:
             root["output"][key] = res.extra[key]
+    # convergence stamp (ISSUE 10): the folded residual-history block +
+    # the paired time-to-rtol metric next to gdof_per_second, or the
+    # recorded reason capture was gated off
+    for key in ("convergence", "time_to_rtol_s",
+                "convergence_gate_reason", "convergence_error"):
+        if key in res.extra:
+            root["output"][key] = res.extra[key]
     return json.dumps(root)

@@ -100,6 +100,17 @@ def build_parser() -> argparse.ArgumentParser:
                         "(unset: the chunked loop runs but writes "
                         "nothing — the measured-overhead A/B arm). Env "
                         "default: BENCH_CHECKPOINT_DIR.")
+    p.add_argument("--convergence", action="store_true", default=None,
+                   help="Convergence telemetry (ISSUE 10): capture the "
+                        "per-iteration CG residual history on device "
+                        "(no host sync in the loop) and stamp the "
+                        "`convergence` block — iterations/time-to-rtol "
+                        "at the 1e-2..1e-8 ladder, stagnation/restart "
+                        "counts — plus the paired time_to_rtol_s metric "
+                        "next to GDoF/s. Routes fused whole-solve "
+                        "engines to the capture-able unfused loop "
+                        "(reason recorded). Env default: "
+                        "BENCH_CONVERGENCE=1.")
     return p
 
 
@@ -220,6 +231,9 @@ def main(argv: list[str] | None = None) -> int:
            else {"checkpoint_every": args.checkpoint_every}),
         **({} if args.checkpoint_dir is None
            else {"checkpoint_dir": args.checkpoint_dir}),
+        # None = fall back to the BENCH_CONVERGENCE env default
+        **({} if args.convergence is None
+           else {"convergence": True}),
     )
 
     obs_journal = None
@@ -259,6 +273,15 @@ def main(argv: list[str] | None = None) -> int:
             "phase_share": res.extra.get("phase_share"),
             "timing": res.extra.get("timing"),
             "cg_engine_form": res.extra.get("cg_engine_form"),
+            # convergence telemetry (ISSUE 10): the paired metric +
+            # the folded block ride the journal record too, so
+            # `python -m bench_tpu_fem.obs trend` can render the
+            # convergence curve from the journal alone. Presence-gated
+            # like results_json: a non-capture run's record must not
+            # carry dead null fields.
+            **{k: res.extra[k] for k in
+               ("convergence", "time_to_rtol_s", "collectives_per_iter")
+               if k in res.extra},
         })
         print(f"*** Writing Chrome trace to: {args.trace} "
               f"(journal: {obs_journal.path})")

@@ -10,7 +10,7 @@ from functools import partial
 
 import numpy as np
 
-from ..bench.driver import record_engine
+from ..bench.driver import _fence_scalar, record_engine
 from ..la.cg import cg_solve
 from ..obs import trace as obs_trace
 from ..obs.trace import BenchObserver
@@ -68,9 +68,18 @@ def _resolve_overlap_mode(cfg, extra: dict, supported: bool,
     return False
 
 
-def make_sharded_fns(op, dgrid, nreps: int):
+def make_sharded_fns(op, dgrid, nreps: int, capture: bool = False):
     """Build jittable sharded callables: one operator apply, one full CG
-    solve, and a masked global norm — each a single shard_map computation."""
+    solve, and a masked global norm — each a single shard_map computation.
+
+    ``capture=True`` (ISSUE 10) runs the CG with the per-iteration
+    residual-history buffer (la.cg capture=True): the history derives
+    from the psum'd owned-dof dots, so it is replicated across shards
+    and returned alongside the solution as a replicated `(nreps + 1,)`
+    array — `cg_fn` then returns ``(x, hist)``. The VMA checker cannot
+    infer that the gathered scalars are replicated (the
+    dist_cg_solve_df_local precedent), so the capture form runs with
+    check_vma off."""
     import jax
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
@@ -95,18 +104,23 @@ def make_sharded_fns(op, dgrid, nreps: int):
         jax.shard_map,
         mesh=dgrid.mesh,
         in_specs=(spec, spec, spec),
-        out_specs=spec,
+        out_specs=(spec, rep) if capture else spec,
+        **({"check_vma": False} if capture else {}),
     )
     def cg_fn(b, G, bc):
         bl, Gl, bcl = _local(b), _local(G), _local(bc)
-        x = cg_solve(
+        out = cg_solve(
             lambda v: op.apply_local(v, Gl, bcl),
             bl,
             jnp.zeros_like(bl),
             nreps,
             dot=owned_dot(owned_mask(bl.shape).astype(bl.dtype)),
+            capture=capture,
         )
-        return x[None, None, None]
+        if capture:
+            x, info = out
+            return x[None, None, None], info["rnorm_history"]
+        return out[None, None, None]
 
     @partial(
         jax.shard_map,
@@ -325,6 +339,8 @@ def run_distributed(cfg, res, dtype):
     # branches (the xla path has no engine and therefore no overlap form)
     overlap_on = False
     base_form = None
+    # convergence capture routing (ISSUE 10), resolved in the CG branch
+    conv_on = False
     res.ncells_global = global_ncells(n)
     res.ndofs_global = global_ndofs(n, cfg.degree)
     obs = BenchObserver(cfg, run="dist")
@@ -485,6 +501,10 @@ def run_distributed(cfg, res, dtype):
                     "form is unsupported")
             record_engine(res.extra, False, error=BATCHED_UNFUSED_REASON)
             stamp_nrhs(res.extra, cfg.nrhs, cfg.checkpoint_every)
+            if cfg.convergence:
+                res.extra["convergence_gate_reason"] = (
+                    "batched sharded CG has no wired capture form; "
+                    "convergence capture disabled for this run")
             if kron:
                 from .kron import make_kron_batched_cg_fn
 
@@ -508,6 +528,11 @@ def run_distributed(cfg, res, dtype):
                 res.extra["checkpoint_gate_reason"] = (
                     CHECKPOINT_GATE_REASON)
                 overlap_on = False
+            if cfg.convergence:
+                res.extra["convergence_gate_reason"] = (
+                    "convergence capture is not wired through the "
+                    "checkpointable chunked loop; capture disabled for "
+                    "this checkpointed run")
             run_ck, ck_store, ck_restored, ck_saves = (
                 _make_dist_checkpointed_cg(cfg, res, obs, op, dgrid, u,
                                            kron))
@@ -522,6 +547,37 @@ def run_distributed(cfg, res, dtype):
                 res.extra["checkpoint_gate_reason"] = (
                     "sharded folded (pallas) backend has no checkpointable "
                     "unfused form; snapshots disabled for this run")
+            # convergence capture (ISSUE 10): the history buffer rides
+            # the unfused sharded CG (la.cg capture through the psum'd
+            # owned-dof dots); the fused/overlap engine forms gate off
+            # with the reason recorded — the checkpoint-gate discipline
+            if cfg.convergence:
+                if folded:
+                    res.extra["convergence_gate_reason"] = (
+                        "sharded folded (pallas) backend has no "
+                        "capture-able unfused CG form; convergence "
+                        "capture disabled for this run")
+                else:
+                    from ..bench.driver import CONVERGENCE_GATE_REASON
+
+                    conv_on = True
+                    if res.extra.get("cg_engine"):
+                        record_engine(res.extra, False)
+                        res.extra["convergence_gate_reason"] = (
+                            CONVERGENCE_GATE_REASON)
+                        overlap_on = False
+                    if kron:
+                        from .kron import make_kron_sharded_fns
+
+                        _, cg_fn, _ = make_kron_sharded_fns(
+                            op, dgrid, cfg.nreps, engine=False,
+                            capture=True)
+                        # the unfused kron loop fits the default scoped
+                        # limit (the raised request was the ring's)
+                        compile_opts = None
+                    else:
+                        _, cg_fn, _ = make_sharded_fns(
+                            op, dgrid, cfg.nreps, capture=True)
 
             def _rebuild_cg(eng, ovl):
                 if kron:
@@ -574,6 +630,13 @@ def run_distributed(cfg, res, dtype):
             # dispatch in the timed region; the optimization_barrier ties
             # the input to the loop carry so the invariant apply can never
             # be hoisted out of the timed loop).
+            if cfg.convergence:
+                # same recorded gate as the single-chip driver: capture
+                # was requested but action runs carry no residual
+                res.extra["convergence_gate_reason"] = (
+                    "convergence capture applies to CG solves only "
+                    "(action runs carry no residual); capture disabled")
+
             def _compile_action(ap, opts):
                 def _rep(i, y, x, a):
                     xx, _ = jax.lax.optimization_barrier((x, y))
@@ -616,12 +679,17 @@ def run_distributed(cfg, res, dtype):
         with obs.phase("transfer"):
             warm = (run_ck(save=False) if run_ck is not None
                     else fn(run_input, *run_args))
-            float(warm[(0,) * warm.ndim])
+            _fence_scalar(warm)
             del warm
 
     y = obs.timed_reps(run_ck if run_ck is not None
                        else (lambda: fn(run_input, *run_args)))
     elapsed = obs.elapsed()
+    conv_hist = None
+    if conv_on:
+        # capture cg_fn returns (x, replicated history); the history is
+        # fetched once, here, outside the timed region
+        y, conv_hist = y
 
     if cfg.nrhs > 1:
         # lane 0 (scale 1.0) is the one-shot problem verbatim: norms and
@@ -640,6 +708,7 @@ def run_distributed(cfg, res, dtype):
     from ..bench.driver import (
         stamp_breakdown,
         stamp_checkpoint,
+        stamp_convergence,
         stamp_observability,
     )
 
@@ -649,6 +718,9 @@ def run_distributed(cfg, res, dtype):
                          ck_saves["n"])
     stamp_observability(cfg, res, obs,
                         "f32" if cfg.float_bits == 32 else "f64")
+    if conv_hist is not None:
+        stamp_convergence(res.extra, {"rnorm_history": conv_hist},
+                          wall_s=elapsed, iters_run=cfg.nreps)
     if cfg.use_cg and cfg.nrhs == 1 and run_ck is None:
         _stamp_collectives(res.extra, cfg.nreps, elapsed, cg_fn, u,
                            *cg_args)
@@ -742,6 +814,12 @@ def _run_distributed_folded_df(cfg, res):
     # the sharded folded df pipeline is deliberately unfused (dist.folded
     # df section) — no fused engine form exists for it yet
     record_engine(res.extra, False)
+    if cfg.convergence:
+        # the folded df CG's residual rides the kernel chain — no
+        # per-iteration buffer to capture into (recorded, never silent)
+        res.extra["convergence_gate_reason"] = (
+            "sharded folded-df pipeline has no capture-able loop form; "
+            "convergence capture disabled for this run")
 
     # Host-assembled f64 RHS split into df channels and sharded per
     # channel. O(global-dof) host arrays — accepted on this path (the
@@ -893,6 +971,7 @@ def run_distributed_df64(cfg, res):
         from .kron_df import resolve_df_engine, resolve_df_overlap
 
         u_run = u
+        conv_on = False
         if cfg.nrhs > 1:
             # batched multi-RHS sharded df: vmapped unfused local df
             # solve + compensated psum dots (dist.kron_df); the fused
@@ -910,6 +989,10 @@ def run_distributed_df64(cfg, res):
                     "--cg; batched sharded df action is unsupported")
             record_engine(res.extra, False, error=BATCHED_UNFUSED_REASON)
             stamp_nrhs(res.extra, cfg.nrhs, cfg.checkpoint_every)
+            if cfg.convergence:
+                res.extra["convergence_gate_reason"] = (
+                    "batched sharded df CG has no wired capture form; "
+                    "convergence capture disabled for this run")
             _, _, norm_fn, norms_from = make_kron_df_sharded_fns(
                 op, dgrid, cfg.nreps, engine=False)
             sc = jnp.asarray(batch_scales(cfg.nrhs), jnp.float32)
@@ -934,6 +1017,21 @@ def run_distributed_df64(cfg, res):
                 _resolve_overlap_mode(cfg, res.extra, ovl_ok, ovl_gate))
             record_engine(res.extra, engine,
                           base_form + ("_overlap" if overlap_on else ""))
+            # convergence capture (ISSUE 10): rides the unfused sharded
+            # df loop; the fused df ring gates off, reason recorded
+            conv_on = cfg.convergence and cfg.use_cg
+            if cfg.convergence and not cfg.use_cg:
+                res.extra["convergence_gate_reason"] = (
+                    "convergence capture applies to CG solves only "
+                    "(action runs carry no residual); capture disabled")
+            if conv_on and engine:
+                from ..bench.driver import CONVERGENCE_GATE_REASON
+
+                engine = False
+                overlap_on = False
+                record_engine(res.extra, False)
+                res.extra["convergence_gate_reason"] = (
+                    CONVERGENCE_GATE_REASON)
         opts = (scoped_vmem_options(dist_df_engine_plan(op)[1])
                 if engine else None)
         from ..la.df64 import df_zeros_like
@@ -942,7 +1040,8 @@ def run_distributed_df64(cfg, res):
 
         def _build(eng, ovl=False):
             a_fn, c_fn, n_fn, n_from = make_kron_df_sharded_fns(
-                op, dgrid, cfg.nreps, engine=eng, overlap=ovl
+                op, dgrid, cfg.nreps, engine=eng, overlap=ovl,
+                capture=conv_on and not eng,
             )
             if cfg.use_cg:
                 built["cg_fn"] = c_fn
@@ -988,11 +1087,15 @@ def run_distributed_df64(cfg, res):
                     norm_fn, norms_from, fn = _build(False)
         with obs.phase("transfer"):
             warm = fn(u_run, op)
-            float(warm.hi[(0,) * warm.hi.ndim])
+            _fence_scalar(warm)
             del warm
 
     y = obs.timed_reps(lambda: fn(u_run, op))
     res.mat_free_time = obs.elapsed()
+    conv_hist = None
+    if conv_on:
+        # capture cg_fn returns ((hi, lo), replicated history)
+        y, conv_hist = y
 
     if cfg.nrhs > 1:
         # lane 0 (scale 1.0) is the one-shot problem verbatim; GDoF/s
@@ -1006,9 +1109,12 @@ def run_distributed_df64(cfg, res):
         res.ndofs_global * cfg.nreps * cfg.nrhs
         / (1e9 * res.mat_free_time)
     )
-    from ..bench.driver import stamp_observability
+    from ..bench.driver import stamp_convergence, stamp_observability
 
     stamp_observability(cfg, res, obs, "df32")
+    if conv_hist is not None:
+        stamp_convergence(res.extra, {"rnorm_history": conv_hist},
+                          wall_s=res.mat_free_time, iters_run=cfg.nreps)
     if cfg.use_cg and cfg.nrhs == 1 and built.get("cg_fn") is not None:
         _stamp_collectives(res.extra, cfg.nreps, res.mat_free_time,
                            built["cg_fn"], u, op)

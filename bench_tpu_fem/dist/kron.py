@@ -321,7 +321,8 @@ def resolve_kron_overlap(op: DistKronLaplacian) -> tuple[bool, str | None]:
 
 def make_kron_sharded_fns(op: DistKronLaplacian, dgrid, nreps: int,
                           engine: bool | None = None,
-                          overlap: bool = False):
+                          overlap: bool = False,
+                          capture: bool = False):
     """Jittable sharded callables (apply, CG, norm) over (Dx,Dy,Dz,Lx,Ly,Lz)
     grid blocks — same contract as dist.folded.make_folded_sharded_fns.
     The operator rides along as a replicated pytree argument.
@@ -339,7 +340,14 @@ def make_kron_sharded_fns(op: DistKronLaplacian, dgrid, nreps: int,
     state, one y-boundary ppermute off the critical path, ONE stacked
     psum per iteration) — requires the engine; callers gate via
     resolve_kron_overlap and record the form as `halo_overlap` /
-    `ext2d_overlap`."""
+    `ext2d_overlap`.
+
+    `capture=True` (ISSUE 10) runs the UNFUSED CG with the
+    per-iteration residual-history buffer (la.cg capture=True; the
+    psum'd dots make the history replicated) — `cg_fn` then returns
+    ``(x, hist)`` with the `(nreps + 1,)` history replicated. Requires
+    engine=False (the fused rings have no per-iteration residual to
+    buffer; the drivers gate and record the reason)."""
     from jax.sharding import PartitionSpec as P
 
     from ..la.cg import cg_solve
@@ -361,6 +369,10 @@ def make_kron_sharded_fns(op: DistKronLaplacian, dgrid, nreps: int,
     if overlap and not engine:
         raise ValueError("the overlapped kron CG form rides the fused "
                          "engine; pass engine=True (or let it resolve)")
+    if capture and engine:
+        raise ValueError("convergence capture rides the unfused CG "
+                         "loop; pass engine=False (the drivers gate "
+                         "the fused forms and record the reason)")
 
     def _local(a):
         return a[0, 0, 0]
@@ -373,7 +385,8 @@ def make_kron_sharded_fns(op: DistKronLaplacian, dgrid, nreps: int,
         return A.apply_local(_local(x))[None, None, None]
 
     @partial(jax.shard_map, mesh=dgrid.mesh, in_specs=(spec, rep),
-             out_specs=spec, check_vma=False if engine else vma)
+             out_specs=(spec, rep) if capture else spec,
+             check_vma=False if (engine or capture) else vma)
     def cg_fn(b, A):
         bl = _local(b)
         if engine:
@@ -381,14 +394,20 @@ def make_kron_sharded_fns(op: DistKronLaplacian, dgrid, nreps: int,
                      else dist_kron_cg_solve_local)
             return solve(A, bl, nreps)[None, None, None]
         coeffs = A.local_coeffs()  # hoisted: sliced once, reused every iter
-        x = cg_solve(
+        out = cg_solve(
             lambda v: A.apply_local(v, coeffs),
             bl,
             jnp.zeros_like(bl),
             nreps,
             dot=owned_dot(owned_mask(bl.shape).astype(bl.dtype)),
+            capture=capture,
         )
-        return x[None, None, None]
+        if capture:
+            # history derives from the psum'd dots — replicated; the
+            # VMA checker cannot infer that (check_vma off above)
+            x, info = out
+            return x[None, None, None], info["rnorm_history"]
+        return out[None, None, None]
 
     @partial(jax.shard_map, mesh=dgrid.mesh, in_specs=spec, out_specs=rep)
     def norm_fn(x):

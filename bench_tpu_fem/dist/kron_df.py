@@ -387,10 +387,16 @@ def df_dot_dist(a: DF, b: DF, mask, dshape) -> DF:
 
 
 def dist_cg_solve_df_local(op: DistKronLaplacianDF, b: DF,
-                           nreps: int) -> DF:
+                           nreps: int, capture: bool = False):
     """Per-shard fixed-iteration df CG (inside shard_map): the
     ops.kron_df.cg_solve_df recurrence with distributed compensated dots
-    and the same past-the-floor freeze guard."""
+    and the same past-the-floor freeze guard.
+
+    ``capture=True`` (ISSUE 10) carries the `(nreps + 1,)` f32 buffer of
+    the carried squared residual norms' hi channels (the
+    ops.kron_df.cg_solve_df capture contract; the gathered compensated
+    dots make every entry identical on all shards) and returns
+    ``(x, hist)``."""
     mask = owned_mask(b.hi.shape)
     coeffs = op.local_coeffs()  # hoisted out of the loop
     floor = jnp.float32(1e-24)
@@ -401,8 +407,11 @@ def dist_cg_solve_df_local(op: DistKronLaplacianDF, b: DF,
     rnorm0 = dot(b, b)
     rnorm0_hi = rnorm0.hi
 
-    def body(_, state):
-        x, r, p, rnorm, done = state
+    def body(i, state):
+        if capture:
+            x, r, p, rnorm, done, hist = state
+        else:
+            x, r, p, rnorm, done = state
         y = op.apply_local(p, coeffs)
         alpha = df_div(rnorm, dot(p, y))
         x1 = df_axpy(x, alpha, p)
@@ -417,8 +426,11 @@ def dist_cg_solve_df_local(op: DistKronLaplacianDF, b: DF,
                 lambda nw, o: jnp.where(done, o, nw), new, old
             )
 
-        return (keep(x1, x), keep(r1, r), keep(p1, p),
-                keep(rnorm1, rnorm), done1)
+        rnorm_keep = keep(rnorm1, rnorm)
+        out = (keep(x1, x), keep(r1, r), keep(p1, p), rnorm_keep, done1)
+        if capture:
+            out = out + (hist.at[i + 1].set(rnorm_keep.hi),)
+        return out
 
     # `done` is derived from the gathered dot, which shard_map's VMA
     # system marks device-varying (the values are in fact identical on
@@ -426,6 +438,11 @@ def dist_cg_solve_df_local(op: DistKronLaplacianDF, b: DF,
     # must carry the same varying annotation for the loop types to match.
     done0 = jax.lax.pcast(jnp.asarray(False), AXIS_NAMES, to="varying")
     state = (df_zeros_like(b), b, b, rnorm0, done0)
+    if capture:
+        state = state + (
+            jnp.zeros((nreps + 1,), jnp.float32).at[0].set(rnorm0.hi),)
+        x, _, _, _, _, hist = jax.lax.fori_loop(0, nreps, body, state)
+        return x, hist
     x, *_ = jax.lax.fori_loop(0, nreps, body, state)
     return x
 
@@ -462,7 +479,8 @@ def resolve_df_overlap(op: DistKronLaplacianDF) -> tuple[bool, str | None]:
 
 def make_kron_df_sharded_fns(op: DistKronLaplacianDF, dgrid, nreps: int,
                              engine: bool | None = None,
-                             overlap: bool = False):
+                             overlap: bool = False,
+                             capture: bool = False):
     """Jittable sharded callables over DF grid blocks (hi/lo each
     (Dx,Dy,Dz,Lx,Ly,Lz)): (apply, CG, l2norm) — the df twin of
     dist.kron.make_kron_sharded_fns.
@@ -501,6 +519,10 @@ def make_kron_df_sharded_fns(op: DistKronLaplacianDF, dgrid, nreps: int,
     if overlap and not engine:
         raise ValueError("the overlapped df CG form rides the fused "
                          "engine; pass engine=True (or let it resolve)")
+    if capture and engine:
+        raise ValueError("convergence capture rides the unfused df CG "
+                         "loop; pass engine=False (the drivers gate "
+                         "the fused forms and record the reason)")
 
     def _local(a):
         return DF(a.hi[0, 0, 0], a.lo[0, 0, 0])
@@ -518,7 +540,8 @@ def make_kron_df_sharded_fns(op: DistKronLaplacianDF, dgrid, nreps: int,
         return _wrap(A.apply_local(_local(x)))
 
     @partial(jax.shard_map, mesh=dgrid.mesh, in_specs=(spec, rep),
-             out_specs=spec, check_vma=not engine)
+             out_specs=(spec, rep) if capture else spec,
+             check_vma=False if capture else not engine)
     def cg_fn(b, A):
         if engine:
             from .kron_cg_df import (
@@ -529,6 +552,13 @@ def make_kron_df_sharded_fns(op: DistKronLaplacianDF, dgrid, nreps: int,
             solve = (dist_kron_df_cg_solve_local_overlap if overlap
                      else dist_kron_df_cg_solve_local)
             return _wrap(solve(A, _local(b), nreps))
+        if capture:
+            # the history derives from the gathered compensated dots —
+            # replicated, but the VMA system cannot infer it (the same
+            # reason norm_fn below runs check_vma=False)
+            x, hist = dist_cg_solve_df_local(A, _local(b), nreps,
+                                             capture=True)
+            return _wrap(x), hist
         return _wrap(dist_cg_solve_df_local(A, _local(b), nreps))
 
     # check_vma off: the gathered compensated fold is genuinely replicated

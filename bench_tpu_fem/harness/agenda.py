@@ -449,6 +449,18 @@ def make_stages(round_tag: str = DEFAULT_ROUND) -> dict[str, Stage]:
         # invariant via `--smoke` in CI (multihost gloo lane).
         _script("scale", ["scripts/weak_scaling.py", "--local-dofs",
                           "2000000", "--nreps", "200"], 2400),
+        # Convergence telemetry on hardware (ISSUE 10): the flagship
+        # problem with per-iteration residual capture — stamps the
+        # `convergence` block + the paired time-to-rtol metric with the
+        # `hardware` evidence label (the CPU lanes only ever produce
+        # cpu-measured times). Capture rides the unfused loop (the
+        # fused engine gates off, reason recorded), so this is a paired
+        # A/B point next to `ab12`, not a flagship-rate claim.
+        _py("conv", _bench_code("CONV12.5M:", dict(
+            ndofs_global=12_500_000, degree=3, qmode=1, float_bits=32,
+            nreps=1000, use_cg=True, convergence=True),
+            tail_expr=', "time_to_rtol",'
+                      ' res.extra.get("time_to_rtol_s")'), 1800),
         _py("dfeng", _bench_code("DFENG12.5M:", dict(
             ndofs_global=12_500_000, degree=3, qmode=1, float_bits=64,
             nreps=200, use_cg=True, f64_impl="df32"),
@@ -531,7 +543,7 @@ ALIASES = {
 AGENDAS = {
     "round6": ["health", "serve", "chaos", "fusedbatch", "dfacc",
                "pertdf", "foldeng", "dfext2d", "scale", "dfeng", "bench",
-               "dflarge", "pert100", "deg7probe", "matrix"],
+               "conv", "dflarge", "pert100", "deg7probe", "matrix"],
 }
 
 

@@ -102,6 +102,7 @@ def cg_solve(
     dot: Callable[[jnp.ndarray, jnp.ndarray], jnp.ndarray] | None = None,
     dot3: Callable | None = None,
     sentinel: bool = False,
+    capture: bool = False,
 ):
     """Solve A x = b; returns x after `max_iter` iterations (rtol=0) or until
     ||r||/||r0|| < rtol. Early termination freezes the state rather than
@@ -124,7 +125,20 @@ def cg_solve(
     norms — a stall signature). All sentinels are jit-safe select
     arithmetic on the scalars the loop already computes: no host sync
     anywhere on the hot path, and on a healthy solve every selected
-    value is bit-identical to the unguarded loop."""
+    value is bit-identical to the unguarded loop.
+
+    With `capture=True` (ISSUE 10: convergence telemetry) the loop
+    carries a PREALLOCATED `(max_iter + 1,)` device buffer of the
+    squared residual norms — `rnorm_history[0] = <r0, r0>`,
+    `rnorm_history[k]` the CARRIED rnorm after iteration k (a frozen
+    iteration repeats its held value, so the history is exactly what the
+    recurrence saw) — written in the fori_loop body with a dynamic
+    index store: NO host sync anywhere on the hot path; the history is
+    fetched once, after the solve, by whoever stamps it
+    (obs.convergence). Returns `(x, info)` with
+    `info["rnorm_history"]`. With `capture=False` (the default) this
+    function is the pre-capture code path unchanged — the bitwise
+    contract tests/test_convergence.py pins."""
     if dot is None:
         dot = inner_product
 
@@ -133,7 +147,7 @@ def cg_solve(
     p = r
     rnorm0 = dot(p, r)
 
-    def body(_, state):
+    def body(i, state):
         x, r, p, rnorm, done, info = state
         y = apply_A(p)
         if dot3 is None:
@@ -199,19 +213,30 @@ def cg_solve(
         else:
             hold = done
         keep = lambda new, old: jnp.where(hold, old, new)
+        rnorm_keep = keep(rnorm_new, rnorm)
+        if capture:
+            # in-loop dynamic index store into the preallocated device
+            # buffer — the jit-safe, no-host-sync capture discipline
+            info = dict(info)
+            info["rnorm_history"] = (
+                info["rnorm_history"].at[i + 1].set(rnorm_keep))
         return (
             keep(x1, x),
             keep(r1, r),
             keep(p1, p),
-            keep(rnorm_new, rnorm),
+            rnorm_keep,
             new_done,
             info,
         )
 
-    state = (x0, r, p, rnorm0, jnp.asarray(False),
-             _sentinel_zero() if sentinel else {})
+    info0 = _sentinel_zero() if sentinel else {}
+    if capture:
+        info0 = dict(info0)
+        info0["rnorm_history"] = (
+            jnp.zeros((max_iter + 1,), rnorm0.dtype).at[0].set(rnorm0))
+    state = (x0, r, p, rnorm0, jnp.asarray(False), info0)
     x, _, _, _, _, info = jax.lax.fori_loop(0, max_iter, body, state)
-    if sentinel:
+    if sentinel or capture:
         return x, {k: v for k, v in info.items() if k != "stag_run"}
     return x
 
@@ -252,6 +277,7 @@ def cg_solve_batched(
     batch_apply: Callable[[jnp.ndarray], jnp.ndarray] | None = None,
     dot3: Callable | None = None,
     sentinel: bool = False,
+    capture: bool = False,
 ):
     """Multi-RHS CG over a (nrhs, ...) stack: solve A x_i = b_i for every
     RHS in ONE static loop — the serving-layer batch primitive (each
@@ -284,7 +310,13 @@ def cg_solve_batched(
     `(X, info)` with (nrhs,) arrays: `breakdown_restarts`, `nonfinite`
     (that lane froze at its last finite iterate), `stag_max`. Lane
     sentinels are independent: one poisoned lane never perturbs — or
-    stalls — its batch-mates."""
+    stalls — its batch-mates.
+
+    With `capture=True` the loop carries a `(max_iter + 1, nrhs)`
+    preallocated residual-history buffer (per-lane squared norms, same
+    discipline and return contract as `cg_solve(capture=True)` — no
+    host sync on the hot path; `capture=False` is the pre-capture code
+    path unchanged)."""
     if dot is None:
         dot = batched_dot
     if batch_apply is None:
@@ -298,7 +330,7 @@ def cg_solve_batched(
     done0 = rnorm0 == jnp.zeros((), rnorm0.dtype)
     nrhs = rnorm0.shape[0]
 
-    def body(_, state):
+    def body(i, state):
         X, R, P, rnorm, done, info = state
         Y = batch_apply(P)
         if dot3 is None:
@@ -358,11 +390,16 @@ def cg_solve_batched(
         def keep1(new, old):
             return jnp.where(hold, old, new)
 
+        rnorm_keep = keep1(rnorm_new, rnorm)
+        if capture:
+            info = dict(info)
+            info["rnorm_history"] = (
+                info["rnorm_history"].at[i + 1].set(rnorm_keep))
         return (
             keep(X1, X),
             keep(R1, R),
             keep(P1, P),
-            keep1(rnorm_new, rnorm),
+            rnorm_keep,
             new_done,
             info,
         )
@@ -375,9 +412,13 @@ def cg_solve_batched(
                  "stag_max": jnp.zeros((nrhs,), i32)}
     else:
         info0 = {}
+    if capture:
+        info0 = dict(info0)
+        info0["rnorm_history"] = (
+            jnp.zeros((max_iter + 1, nrhs), rnorm0.dtype).at[0].set(rnorm0))
     state = (X0, R, P, rnorm0, done0, info0)
     X, _, _, _, _, info = jax.lax.fori_loop(0, max_iter, body, state)
-    if sentinel:
+    if sentinel or capture:
         return X, {k: v for k, v in info.items() if k != "stag_run"}
     return X
 

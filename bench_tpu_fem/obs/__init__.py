@@ -22,9 +22,26 @@ number a benchmark, serve or harness run emits can be *attributed*:
     bytes-in-use around timed regions on hardware, process-RSS fallback
     on CPU — stamped into bench records and the serve ``/metrics``.
 
+``obs.convergence`` (ISSUE 10)
+    Convergence telemetry: folds the solvers' jit-safe in-loop residual
+    histories (``la.cg`` / ``ops.kron_df`` ``capture=True``) into the
+    ``convergence`` evidence block — iterations/time-to-rtol at the
+    1e-2..1e-8 ladder, stagnation/restart counts — and the paired
+    ``time_to_rtol_s`` metric next to GDoF/s (ROADMAP item 4).
+
+``obs.regress`` (ISSUE 10)
+    Regression sentinel: schema-tolerant round-trend loader (wedge
+    rounds as labelled gaps), Mann-Whitney/bootstrap baseline
+    comparison (advisory), deterministic-counter hard gates (the CI
+    ``perfgate`` lane), and the serve SLO burn-rate fold shared with
+    ``serve.metrics``.
+
 ``python -m bench_tpu_fem.obs`` renders a journal + exported trace into
 a report (span tree, timer table, roofline table) and validates the
-trace JSON (rc 1 on schema violations) — see ``obs.report``.
+trace JSON (rc 1 on schema violations); ``... obs trend`` renders the
+round trajectory / convergence curves / SLO state, and ``... obs gate``
+compares two perfgate snapshots (rc 1 on a gated counter regression) —
+see ``obs.report``.
 
 Evidence discipline (ROADMAP item 8): every stamp carries its evidence
 label — a CPU-measured share or an analytic design estimate is never

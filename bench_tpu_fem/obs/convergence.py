@@ -1,0 +1,169 @@
+"""Convergence telemetry (ISSUE 10): fold a CG residual history into
+the `convergence` evidence block + the paired time-to-rtol metric.
+
+The capture side lives in the solvers (`la.cg.cg_solve(capture=True)`,
+`cg_solve_batched(capture=True)`, `ops.kron_df.cg_solve_df(capture=True)`
+and the dist twins): a preallocated device buffer of the CARRIED squared
+residual norms, written inside the fori_loop body — no host sync on the
+hot path. This module is the host-side fold, run ONCE after the solve:
+
+* **iterations-to-rtol** at the ladder 1e-2..1e-8: the first iteration k
+  with ||r_k|| / ||r_0|| < rtol. The paper's framing (unpreconditioned
+  CG, fixed `nreps`) makes iteration count — not per-iteration speed —
+  the wall-clock driver at scale; ROADMAP item 4 asks for this paired
+  with GDoF/s.
+* **time-to-rtol**: iterations-to-rtol x the measured per-iteration
+  wall (solve wall / iterations run). GDoF/s answers "how fast is one
+  iteration"; time-to-rtol answers "how fast is a SOLVE" — both ride
+  every CG bench record once capture is on.
+* **stagnation / restart counts**: longest run of non-decreasing
+  residual norms (a stall signature) and the count of iterations whose
+  residual norm GREW (the graceful-restart / conjugacy-loss signature —
+  the history-level view of the `sentinel=True` in-loop counters).
+* a **decimated curve** (<= `CURVE_POINTS` `[iteration, rel_residual]`
+  pairs) for rendering (`python -m bench_tpu_fem.obs trend`) — the full
+  history is NOT stamped (a 1000-iteration record would bloat every
+  journal line ~20 KB; the fold keeps the curve's shape and both
+  endpoints).
+
+Evidence discipline (ROADMAP item 8): iteration counts are measured
+wherever the solve ran (they are a property of the arithmetic, not the
+clock); the TIMES carry the platform label — `cpu-measured` off-TPU
+(hardware-armed: the same capture runs on the chip the moment the
+tunnel lives), `hardware` on it.
+"""
+
+from __future__ import annotations
+
+import math
+
+import numpy as np
+
+#: the iterations-to-rtol ladder (relative RESIDUAL NORM, not its square)
+RTOL_LADDER = (1e-2, 1e-3, 1e-4, 1e-5, 1e-6, 1e-7, 1e-8)
+
+#: max [iteration, rel_residual] pairs stamped for curve rendering
+CURVE_POINTS = 64
+
+
+def rtol_key(rtol: float) -> str:
+    """Ladder dict key: '1e-02' .. '1e-08' (stable, sortable)."""
+    return f"{rtol:.0e}"
+
+
+def rel_residuals(hist) -> np.ndarray:
+    """||r_k|| / ||r_0|| from a squared-norm history (hist[0] = rnorm0).
+    A zero rnorm0 (the batched padding-lane convention) folds to an
+    all-zero curve — 'converged at iteration 0', never a div-by-zero."""
+    h = np.asarray(hist, dtype=np.float64)
+    if h.size == 0 or h[0] <= 0.0:
+        return np.zeros_like(h)
+    with np.errstate(invalid="ignore"):
+        return np.sqrt(np.maximum(h, 0.0) / h[0])
+
+
+def iters_to_rtol(hist, ladder=RTOL_LADDER) -> dict[str, int | None]:
+    """First iteration k with rel residual < rtol, per ladder rung
+    (None = not reached within the captured budget). Monotone by
+    construction of the scan (first crossing wins; later stagnation or
+    growth does not un-cross)."""
+    rel = rel_residuals(hist)
+    out: dict[str, int | None] = {}
+    for rtol in ladder:
+        below = np.nonzero(rel < rtol)[0]
+        out[rtol_key(rtol)] = int(below[0]) if below.size else None
+    return out
+
+
+def stagnation_stats(hist) -> dict[str, int]:
+    """History-level stall/restart signatures: `stagnation_max_run` is
+    the longest consecutive run of iterations whose residual norm did
+    not decrease; `restarts` counts iterations whose residual norm GREW
+    (finite growth — the conjugacy-loss / graceful-restart signature;
+    non-finite entries are counted separately as `nonfinite_iters`)."""
+    h = np.asarray(hist, dtype=np.float64)
+    stag_run = stag_max = restarts = nonfinite = 0
+    for k in range(1, h.size):
+        if not math.isfinite(h[k]):
+            nonfinite += 1
+            continue
+        if h[k] >= h[k - 1]:
+            stag_run += 1
+            stag_max = max(stag_max, stag_run)
+            if h[k] > h[k - 1]:
+                restarts += 1
+        else:
+            stag_run = 0
+    return {"stagnation_max_run": stag_max, "restarts": restarts,
+            "nonfinite_iters": nonfinite}
+
+
+def decimate_curve(hist, max_points: int = CURVE_POINTS) -> list:
+    """<= max_points `[iteration, rel_residual]` pairs, endpoints always
+    included (stride-sampled — convergence curves are smooth enough that
+    uniform decimation keeps the story)."""
+    rel = rel_residuals(hist)
+    n = rel.size
+    if n == 0:
+        return []
+    idx = np.unique(np.linspace(0, n - 1, min(max_points, n)).astype(int))
+    return [[int(k), float(rel[k])] for k in idx]
+
+
+def fold_history(hist, *, wall_s: float, iters_run: int,
+                 evidence: str) -> dict:
+    """One solve's residual history -> the `convergence` block (see
+    `convergence_stamp` for the stamped shape). `wall_s` is the measured
+    solve wall for `iters_run` iterations; time-to-rtol multiplies the
+    iteration count by the per-iteration wall."""
+    h = np.asarray(hist, dtype=np.float64)
+    iters = iters_to_rtol(h)
+    per_iter_s = wall_s / max(int(iters_run), 1)
+    time_to = {k: (round(v * per_iter_s, 6) if v is not None else None)
+               for k, v in iters.items()}
+    rel = rel_residuals(h)
+    block = {
+        "iters_run": int(iters_run),
+        "rnorm0": float(h[0]) if h.size else 0.0,
+        "final_rel_residual": float(rel[-1]) if rel.size else 0.0,
+        "iters_to_rtol": iters,
+        "time_to_rtol_s": time_to,
+        "per_iter_s": round(per_iter_s, 9),
+        "curve": decimate_curve(h),
+        "evidence": evidence,
+    }
+    block.update(stagnation_stats(h))
+    return block
+
+
+def _evidence() -> str:
+    """Platform label for the TIME side of the block (iteration counts
+    are platform-independent measurements; the clock is not)."""
+    import sys
+
+    jax = sys.modules.get("jax")
+    try:
+        backend = jax.default_backend() if jax is not None else "cpu"
+    except Exception:
+        backend = "cpu"
+    return ("hardware" if backend == "tpu"
+            else "cpu-measured (time-to-rtol hardware-armed: same capture "
+                 "re-runs on chip)")
+
+
+def convergence_stamp(extra: dict, hist, *, wall_s: float, iters_run: int,
+                      nrhs: int = 1, lane: int | None = None,
+                      evidence: str | None = None) -> None:
+    """Stamp the `convergence` block + the top-level `time_to_rtol_s`
+    paired metric (next to `gdof_per_second` on every record). For
+    batched solves pass lane 0's history (`hist[:, 0]` — the scale-1.0
+    one-shot problem) with `nrhs`/`lane` recording what was folded."""
+    block = fold_history(hist, wall_s=wall_s, iters_run=iters_run,
+                         evidence=evidence or _evidence())
+    if nrhs > 1:
+        block["nrhs"] = int(nrhs)
+        block["lane"] = int(lane or 0)
+    extra["convergence"] = block
+    # the paired metric, surfaced at top level so GDoF/s and
+    # time-to-rtol read off one record side by side (ROADMAP item 4)
+    extra["time_to_rtol_s"] = block["time_to_rtol_s"]

@@ -1,0 +1,518 @@
+"""Regression sentinel (ISSUE 10): watch the benchmark's own history.
+
+PR 8 gave every number *attribution* (roofline placement, phase spans,
+memory telemetry); this module watches numbers *over time* — three
+layers, stdlib + numpy only:
+
+**Round trend loader** (`load_trend`): schema-tolerant folding of the
+committed round artifacts — ``BENCH_r*.json`` (five generations of
+schema: r01's bare metric line, r02/r03's enriched parse, r04's
+error-stamped zero, r05's ``parsed: null`` tunnel wedge, plus the
+``*_measured`` provenance sidecars), ``MULTICHIP_r*.json`` and the
+``MEASURE_r*.jsonl`` harness journals — into one per-round trend table.
+The honesty rule (satellite): a wedged round is a **labelled gap**
+(``status: "gap"`` with its ``failure_class`` from the tail, via
+`harness.classify`), NEVER a zero-throughput data point — averaging a
+wedge into a trajectory would manufacture a regression out of an
+infrastructure failure.
+
+**Statistical comparison** (`classify_timing`): current vs pinned
+baseline over the ``--timing-reps`` per-rep wall distributions
+(``timing.walls_s``, stamped by BenchObserver). Mann-Whitney U (rank
+sum, tie-corrected normal approximation) for significance + bootstrap
+CI on the median + a relative effect-size threshold, classifying
+``improved`` / ``neutral`` / ``regressed`` (``insufficient-data`` below
+3 reps a side). Wall-clock on shared CI hosts is noisy, so this
+classification is **advisory** — it prints, it never gates.
+
+**Deterministic-counter gating** (`gate_counters`): the counters that
+are noise-free on CPU for a pinned workload — trace-level
+``collectives_per_iter``, executable-cache compile counts and
+request-weighted hit-rate, shed/failed/lost request counts, journal
+corruption, record-contract booleans — gate HARD (any violation is the
+CI perfgate lane's rc 1). A collective that sneaks back into the
+overlapped iteration or a recompile that reappears in a warm serve run
+is a real regression no matter what the clock says.
+
+Serve SLO tracking lives in the shared `burn_rates` fold here (consumed
+live by `serve.metrics.Metrics.snapshot` and offline by
+`python -m bench_tpu_fem.obs trend` over a serve journal's request
+lifecycles).
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import math
+import os
+import re
+
+import numpy as np
+
+# --------------------------------------------------------------------------
+# Round trend loader.
+
+_ROUND_BENCH = re.compile(r"BENCH_r(\d+)\.json$")
+_ROUND_SIDE = re.compile(r"BENCH_r(\d+)_([a-z_]+)\.json$")
+_ROUND_MULTI = re.compile(r"MULTICHIP_r(\d+)\.json$")
+_ROUND_JOURNAL = re.compile(r"MEASURE_r(\d+)\.jsonl$")
+
+
+def _read_json(path: str):
+    try:
+        with open(path) as fh:
+            return json.load(fh), None
+    except (OSError, json.JSONDecodeError) as exc:
+        return None, str(exc)
+
+
+def _classify(text: str, rc=None) -> str:
+    from ..harness.classify import classify_text
+
+    # rc 124/-9 are the harness/timeout(1) kill signatures: the tail
+    # decides wedge-vs-timeout exactly as the runner's adjudication does
+    return classify_text(text or "", timed_out=rc in (124, -9))
+
+
+def _bench_row(path: str, rnd: int) -> dict:
+    """One BENCH_rNN.json -> one trend row. The loader must accept every
+    schema generation committed to date AND refuse to fabricate data:
+    no parse / an error-stamped parse is a labelled gap."""
+    row = {"round": rnd, "source": os.path.basename(path), "kind": "bench"}
+    d, err = _read_json(path)
+    if d is None:
+        row.update(status="gap", failure_class="transient",
+                   detail=f"unreadable artifact: {err}")
+        return row
+    parsed = d.get("parsed") if isinstance(d, dict) else None
+    rc = d.get("rc") if isinstance(d, dict) else None
+    tail = d.get("tail", "") if isinstance(d, dict) else ""
+    if not isinstance(parsed, dict):
+        # r05 shape: rc=124, parsed null — the tunnel wedged and the
+        # round produced NO number. A labelled gap, never a zero.
+        tail_lines = (tail or "").strip().splitlines()
+        row.update(status="gap", failure_class=_classify(tail, rc),
+                   detail=tail_lines[-1][:200] if tail_lines
+                   else f"no parsed payload (rc={rc})")
+        return row
+    if parsed.get("error") or (parsed.get("value", 0.0) == 0.0
+                               and "error" in parsed):
+        # r04 shape: the end-of-round bench.py saw a wedged tunnel and
+        # stamped an error line (value 0.0) — also a labelled gap
+        row.update(status="gap",
+                   failure_class=parsed.get(
+                       "failure_class", _classify(parsed.get("error", ""),
+                                                 rc)),
+                   detail=str(parsed.get("error", ""))[:200])
+        return row
+    if not isinstance(parsed.get("value"), (int, float)):
+        # a parse with no usable number is a gap too — "measured" rows
+        # must always carry a real value (the renderer formats it)
+        row.update(status="gap", failure_class=_classify(tail, rc),
+                   detail="parsed payload carries no numeric value")
+        return row
+    row.update(status="measured",
+               metric=parsed.get("metric"),
+               value=parsed.get("value"),
+               unit=parsed.get("unit"),
+               vs_baseline=parsed.get("vs_baseline"))
+    for key in ("backend", "ndofs_global", "nreps", "cg_wall_s"):
+        if key in parsed:
+            row[key] = parsed[key]
+    return row
+
+
+def _side_row(path: str, rnd: int, tag: str) -> dict | None:
+    """Provenance sidecars (BENCH_r04_measured.json et al.): mid-round
+    measurements kept because the end-of-round capture may only see a
+    wedged tunnel. A `flagship` dict loads as a measured row labelled
+    with its provenance; anything else is skipped (prewedge notes are
+    narrative, not trend points)."""
+    d, _ = _read_json(path)
+    if not isinstance(d, dict) or not isinstance(d.get("flagship"), dict):
+        return None
+    f = d["flagship"]
+    if not isinstance(f.get("value"), (int, float)) or f.get("value") == 0:
+        return None
+    return {"round": rnd, "source": os.path.basename(path),
+            "kind": "bench", "status": "measured",
+            "metric": f.get("metric"), "value": f.get("value"),
+            "unit": f.get("unit"), "vs_baseline": f.get("vs_baseline"),
+            "provenance": (d.get("provenance") or "")[:200] or
+            f"mid-round sidecar ({tag})"}
+
+
+def _multichip_row(path: str, rnd: int) -> dict:
+    row = {"round": rnd, "source": os.path.basename(path),
+           "kind": "multichip"}
+    d, err = _read_json(path)
+    if d is None:
+        row.update(status="gap", failure_class="transient",
+                   detail=f"unreadable artifact: {err}")
+        return row
+    if d.get("skipped"):
+        row.update(status="skipped",
+                   detail=str(d.get("tail", ""))[:120])
+    elif d.get("ok"):
+        row.update(status="measured", n_devices=d.get("n_devices"))
+    else:
+        row.update(status="gap",
+                   failure_class=_classify(str(d.get("tail", "")),
+                                           d.get("rc")),
+                   detail=str(d.get("tail", ""))[:200])
+    return row
+
+
+def _journal_row(path: str, rnd: int) -> dict:
+    """Fold a round's harness journal into stage completion counts +
+    per-stage failure classes (the round's execution story next to its
+    numbers)."""
+    from ..harness.journal import replay
+
+    row = {"round": rnd, "source": os.path.basename(path),
+           "kind": "journal"}
+    try:
+        st = replay(path)
+    except Exception as exc:
+        row.update(status="gap", failure_class="transient",
+                   detail=f"journal replay failed: {exc}")
+        return row
+    failed_classes = sorted({
+        str(rec.get("failure_class", "transient"))
+        for rec in st.failed.values()})
+    row.update(status="measured",
+               stages_completed=len(st.completed),
+               stages_failed=len(st.failed),
+               failed_classes=failed_classes,
+               corrupt_lines=len(st.corrupt))
+    return row
+
+
+def load_trend(root: str = ".") -> dict:
+    """Fold every round artifact under `root` into the trend table:
+    ``{"rows": [...], "gaps": N, "measured": N}`` with rows sorted by
+    (round, kind, source). Wedge rounds appear as labelled gaps."""
+    rows: list[dict] = []
+    for path in sorted(glob.glob(os.path.join(root, "BENCH_r*.json"))):
+        name = os.path.basename(path)
+        m = _ROUND_BENCH.match(name)
+        if m:
+            rows.append(_bench_row(path, int(m.group(1))))
+            continue
+        m = _ROUND_SIDE.match(name)
+        if m:
+            side = _side_row(path, int(m.group(1)), m.group(2))
+            if side is not None:
+                rows.append(side)
+    for path in sorted(glob.glob(os.path.join(root, "MULTICHIP_r*.json"))):
+        m = _ROUND_MULTI.match(os.path.basename(path))
+        if m:
+            rows.append(_multichip_row(path, int(m.group(1))))
+    for path in sorted(glob.glob(os.path.join(root, "MEASURE_r*.jsonl"))):
+        m = _ROUND_JOURNAL.match(os.path.basename(path))
+        if m:
+            rows.append(_journal_row(path, int(m.group(1))))
+    rows.sort(key=lambda r: (r.get("round", 0), r.get("kind", ""),
+                             r.get("source", "")))
+    return {
+        "rows": rows,
+        "measured": sum(1 for r in rows if r.get("status") == "measured"),
+        "gaps": sum(1 for r in rows if r.get("status") == "gap"),
+    }
+
+
+# --------------------------------------------------------------------------
+# Statistical comparison: Mann-Whitney U + bootstrap CI on the median.
+
+
+def _rankdata(values: np.ndarray) -> np.ndarray:
+    """Average ranks (1-based) with ties sharing their mean rank — the
+    standard Mann-Whitney treatment."""
+    order = np.argsort(values, kind="mergesort")
+    ranks = np.empty(len(values), dtype=np.float64)
+    sv = values[order]
+    i = 0
+    while i < len(sv):
+        j = i
+        while j + 1 < len(sv) and sv[j + 1] == sv[i]:
+            j += 1
+        ranks[order[i:j + 1]] = (i + j) / 2.0 + 1.0
+        i = j + 1
+    return ranks
+
+
+def mann_whitney_u(a, b) -> tuple[float, float]:
+    """Two-sided Mann-Whitney U via the tie-corrected normal
+    approximation (with continuity correction). Returns ``(U, p)`` with
+    U the statistic of sample ``a``. Exactness is not needed here: the
+    classifier pairs the p-value with an effect-size threshold and a
+    bootstrap CI, and the known-answer tests pin this implementation
+    against hand-computed values."""
+    a = np.asarray(a, dtype=np.float64)
+    b = np.asarray(b, dtype=np.float64)
+    n1, n2 = len(a), len(b)
+    if n1 == 0 or n2 == 0:
+        return 0.0, 1.0
+    combined = np.concatenate([a, b])
+    ranks = _rankdata(combined)
+    r1 = float(ranks[:n1].sum())
+    u1 = r1 - n1 * (n1 + 1) / 2.0
+    mean = n1 * n2 / 2.0
+    n = n1 + n2
+    # tie correction on the variance
+    _, counts = np.unique(combined, return_counts=True)
+    tie_term = float(((counts ** 3 - counts).sum()))
+    var = n1 * n2 / 12.0 * ((n + 1) - tie_term / (n * (n - 1))) \
+        if n > 1 else 0.0
+    if var <= 0:
+        # all values identical: no evidence of a shift
+        return u1, 1.0
+    z = (u1 - mean - math.copysign(0.5, u1 - mean)) / math.sqrt(var) \
+        if u1 != mean else 0.0
+    p = 2.0 * (1.0 - 0.5 * (1.0 + math.erf(abs(z) / math.sqrt(2.0))))
+    return u1, min(max(p, 0.0), 1.0)
+
+
+def bootstrap_median_ci(values, n_boot: int = 2000, alpha: float = 0.05,
+                        seed: int = 0) -> tuple[float, float]:
+    """Percentile bootstrap CI on the median (deterministic seed — the
+    sentinel must produce the same verdict on the same input)."""
+    v = np.asarray(values, dtype=np.float64)
+    if v.size == 0:
+        return 0.0, 0.0
+    rng = np.random.default_rng(seed)
+    meds = np.median(
+        v[rng.integers(0, v.size, size=(n_boot, v.size))], axis=1)
+    return (float(np.quantile(meds, alpha / 2.0)),
+            float(np.quantile(meds, 1.0 - alpha / 2.0)))
+
+
+def classify_timing(current, baseline, *, alpha: float = 0.05,
+                    effect_threshold: float = 0.05, n_boot: int = 2000,
+                    lower_is_better: bool = True,
+                    min_reps: int = 3) -> dict:
+    """Classify current vs baseline per-rep wall distributions:
+    ``improved`` / ``neutral`` / ``regressed`` / ``insufficient-data``.
+
+    A shift must clear BOTH bars to leave neutral: Mann-Whitney p <
+    alpha (the distributions genuinely differ) AND the relative median
+    shift beyond `effect_threshold` (a statistically-real 1% wobble is
+    not a regression worth a red build). Bootstrap CIs on both medians
+    ride along as evidence. Advisory by design — wall-clock gates would
+    flake on shared CI hosts; the deterministic counters are the hard
+    gate (`gate_counters`)."""
+    cur = np.asarray(current, dtype=np.float64)
+    base = np.asarray(baseline, dtype=np.float64)
+    out: dict = {
+        "n_current": int(cur.size), "n_baseline": int(base.size),
+        "alpha": alpha, "effect_threshold": effect_threshold,
+    }
+    if cur.size < min_reps or base.size < min_reps:
+        out.update(classification="insufficient-data", p_value=None,
+                   detail=f"need >= {min_reps} reps a side "
+                          f"(have {cur.size} vs {base.size})")
+        return out
+    med_c, med_b = float(np.median(cur)), float(np.median(base))
+    _, p = mann_whitney_u(cur, base)
+    shift = (med_c - med_b) / med_b if med_b else 0.0
+    out.update(
+        median_current=med_c, median_baseline=med_b,
+        rel_median_shift=round(shift, 6), p_value=round(p, 6),
+        ci_current=[round(x, 6) for x in
+                    bootstrap_median_ci(cur, n_boot=n_boot, seed=1)],
+        ci_baseline=[round(x, 6) for x in
+                     bootstrap_median_ci(base, n_boot=n_boot, seed=2)],
+    )
+    significant = p < alpha and abs(shift) > effect_threshold
+    if not significant:
+        out["classification"] = "neutral"
+    else:
+        worse = shift > 0 if lower_is_better else shift < 0
+        out["classification"] = "regressed" if worse else "improved"
+    return out
+
+
+# --------------------------------------------------------------------------
+# Deterministic-counter gating (the CI perfgate's hard contract).
+
+#: snapshot keys where an INCREASE over baseline is a regression
+#: (noise-free on CPU for a pinned workload)
+LOWER_IS_BETTER_COUNTERS = (
+    "compiles", "recompiles", "shed_total", "responses_failed",
+    "failed", "corrupt_lines", "lost",
+)
+#: snapshot keys where a DECREASE below baseline is a regression
+HIGHER_IS_BETTER_COUNTERS = (
+    "cache_hit_rate_requests", "responses_ok", "completed",
+)
+#: contract booleans: baseline True -> current must stay True
+CONTRACT_FLAGS = ("record_contract_ok", "trace_valid")
+
+
+def gate_counters(current: dict, baseline: dict) -> list[str]:
+    """Compare two perf-snapshot counter dicts; returns the violation
+    list (empty = gate passes). Only keys PRESENT IN THE BASELINE gate —
+    a baseline that never measured a counter cannot fail it — and every
+    violation names the counter, both values and the direction, so the
+    rc-1 line is actionable on its own."""
+    violations: list[str] = []
+    cc = current.get("collectives_per_iter")
+    cb = baseline.get("collectives_per_iter")
+    if isinstance(cb, dict):
+        if not isinstance(cc, dict):
+            violations.append(
+                "collectives_per_iter: baseline has trace-level counts "
+                "but current measured none (tracer off or stamp lost)")
+        else:
+            for op, n in sorted(cb.items()):
+                got = cc.get(op, 0)
+                if got > n:
+                    violations.append(
+                        f"collectives_per_iter[{op}]: {got} > baseline "
+                        f"{n} — a collective crept into the iteration")
+            for op in sorted(set(cc) - set(cb)):
+                violations.append(
+                    f"collectives_per_iter[{op}]: {cc[op]} new "
+                    "collective absent from baseline")
+    for key in LOWER_IS_BETTER_COUNTERS:
+        if key in baseline and key in current:
+            if float(current[key]) > float(baseline[key]):
+                violations.append(
+                    f"{key}: {current[key]} > baseline {baseline[key]}")
+    for key in HIGHER_IS_BETTER_COUNTERS:
+        if key in baseline and key in current:
+            if float(current[key]) < float(baseline[key]) - 1e-12:
+                violations.append(
+                    f"{key}: {current[key]} < baseline {baseline[key]}")
+    for key in CONTRACT_FLAGS:
+        if baseline.get(key) is True and current.get(key) is not True:
+            violations.append(f"{key}: baseline held the contract, "
+                              f"current reads {current.get(key)!r}")
+    return violations
+
+
+#: the bench-record fields the perfgate requires on every stamped record
+#: (the PR-8 attribution contract + the PR-10 convergence contract)
+RECORD_REQUIRED = ("roofline", "phase_share", "timing",
+                   "peak_memory_bytes")
+
+
+def check_record_contract(output: dict,
+                          require_convergence: bool = False) -> list[str]:
+    """Schema check of one bench record's observability stamps (the
+    `results_json` output dict or a journal `bench_record`)."""
+    errs: list[str] = []
+    for key in RECORD_REQUIRED:
+        if output.get(key) is None:
+            errs.append(f"bench record missing {key!r}")
+    rl = output.get("roofline")
+    if isinstance(rl, dict) and not rl.get("intensity_flop_per_byte", 0) > 0:
+        errs.append("roofline.intensity_flop_per_byte must be > 0")
+    timing = output.get("timing")
+    if isinstance(timing, dict):
+        if not timing.get("reps", 0) >= 1:
+            errs.append("timing.reps must be >= 1")
+        walls = timing.get("walls_s")
+        if not (isinstance(walls, list)
+                and len(walls) == timing.get("reps")):
+            errs.append("timing.walls_s must carry the full per-rep "
+                        "distribution (len == reps)")
+    if require_convergence:
+        conv = output.get("convergence")
+        if not isinstance(conv, dict):
+            errs.append("bench record missing the convergence block "
+                        "(run with convergence capture on)")
+        else:
+            for key in ("iters_to_rtol", "time_to_rtol_s", "iters_run",
+                        "evidence"):
+                if key not in conv:
+                    errs.append(f"convergence block missing {key!r}")
+    return errs
+
+
+# --------------------------------------------------------------------------
+# Serve SLO: latency objective + multi-window burn rates.
+
+#: (window seconds, label) — the standard fast/slow burn pair: the fast
+#: window catches a fire, the slow window confirms it is not a blip
+SLO_WINDOWS = ((300.0, "fast"), (3600.0, "slow"))
+
+
+def burn_rates(samples, *, objective_s: float, target: float = 0.99,
+               windows=SLO_WINDOWS, now: float | None = None) -> dict:
+    """Fold ``(ts, latency_s, ok)`` samples into per-window error-budget
+    burn rates. A sample violates the SLO when it failed OR overran the
+    latency objective; burn rate = violation_rate / (1 - target) (1.0 =
+    burning budget exactly as fast as the SLO allows; >1 on BOTH
+    windows = alert). Flat keys so the Prometheus flattener exposes
+    every value as its own series."""
+    samples = [(float(t), float(lat), bool(ok)) for t, lat, ok in samples]
+    if now is None:
+        now = max((t for t, _, _ in samples), default=0.0)
+    budget = max(1.0 - target, 1e-9)
+    out: dict = {
+        "objective_s": float(objective_s),
+        "target": float(target),
+        "samples": len(samples),
+    }
+    alert = bool(samples)
+    for win, label in windows:
+        in_win = [(t, lat, ok) for t, lat, ok in samples
+                  if t >= now - win]
+        n = len(in_win)
+        viol = sum(1 for _, lat, ok in in_win
+                   if not ok or lat > objective_s)
+        rate = viol / n if n else 0.0
+        burn = rate / budget
+        out[f"{label}_window_s"] = float(win)
+        out[f"{label}_requests"] = n
+        out[f"{label}_violations"] = viol
+        out[f"{label}_burn_rate"] = round(burn, 4)
+        alert = alert and burn > 1.0
+    out["alert"] = alert
+    return out
+
+
+def fold_slo(records, *, objective_s: float, target: float = 0.99,
+             windows=SLO_WINDOWS, now: float | None = None) -> dict:
+    """SLO state from journaled request lifecycles: every
+    ``serve_response`` record is one sample (its journal ``ts`` is the
+    response wall-clock instant, ``latency_s`` the enqueue->respond
+    latency, ``ok`` the outcome). The offline twin of the live
+    `serve.metrics.Metrics` SLO snapshot — both fold through
+    `burn_rates`, so the journal replays the exact /metrics story."""
+    samples = [(rec.get("ts", 0.0), rec.get("latency_s", 0.0),
+                bool(rec.get("ok")))
+               for rec in records if rec.get("event") == "serve_response"]
+    return burn_rates(samples, objective_s=objective_s, target=target,
+                      windows=windows, now=now)
+
+
+# --------------------------------------------------------------------------
+# Perf-snapshot gating (the obs CLI `gate` subcommand's engine).
+
+
+def gate_snapshots(current: dict, baseline: dict, *,
+                   alpha: float = 0.05,
+                   effect_threshold: float = 0.05) -> dict:
+    """Compare two perfgate snapshots (scripts/perfgate.py output):
+    hard-gate the deterministic counters, advisory-classify the timing
+    distributions. ``{"violations": [...], "timing": {...}, "ok": bool}``
+    — ok is the COUNTER verdict only (timing never gates)."""
+    violations = gate_counters(current.get("counters", {}),
+                               baseline.get("counters", {}))
+    violations += check_record_contract(
+        current.get("bench", {}),
+        require_convergence=bool(
+            (baseline.get("bench") or {}).get("convergence")))
+    timing: dict = {}
+    for name in ("bench", "dist"):
+        cur_t = ((current.get(name) or {}).get("timing") or {})
+        base_t = ((baseline.get(name) or {}).get("timing") or {})
+        if cur_t.get("walls_s") and base_t.get("walls_s"):
+            timing[name] = classify_timing(
+                cur_t["walls_s"], base_t["walls_s"], alpha=alpha,
+                effect_threshold=effect_threshold)
+    return {"violations": violations, "timing": timing,
+            "ok": not violations}

@@ -3,8 +3,12 @@
     python -m bench_tpu_fem.obs [--journal MEASURE_r06.jsonl]
                                 [--trace trace.json]
                                 [--json] [--validate-only]
+    python -m bench_tpu_fem.obs trend [--root .] [--journal X.jsonl]
+                                [--slo-objective S] [--json]
+    python -m bench_tpu_fem.obs gate --current cur.json
+                                --baseline base.json [--json]
 
-Sections (text mode):
+Sections (text mode, default command):
 
   * trace validation — schema check of the Chrome trace-event JSON
     (``obs.trace.validate_chrome_trace``); ANY violation exits rc 1
@@ -17,6 +21,17 @@ Sections (text mode):
   * roofline table — every journal record carrying a ``roofline`` stamp
     (``bench_record`` events, weak-scaling rows), one line per record
     with intensity / fraction / bound / evidence.
+
+``trend`` (ISSUE 10) renders the regression sentinel's view: the
+per-round trajectory from the committed BENCH_r*/MULTICHIP_r*/
+MEASURE_r* artifacts (wedge rounds as LABELLED GAPS, never zeros),
+convergence curves + time-to-rtol ladders from a journal's
+``bench_record`` events, and SLO burn-rate state from a serve journal's
+request lifecycles. rc 0 — the trend is a report, not a gate.
+
+``gate`` compares two perfgate snapshots (scripts/perfgate.py):
+deterministic counters gate HARD (rc 1 on any violation), the
+Mann-Whitney/bootstrap timing classification prints as advisory.
 
 ``--json`` emits the folded report as one JSON object instead.
 """
@@ -211,7 +226,210 @@ def build_report(journal_path: str | None, trace_path: str | None) -> dict:
     }
 
 
+# --------------------------------------------------------------------------
+# `trend`: the regression sentinel's rendered view (ISSUE 10).
+
+
+def render_trend_rows(rows: list[dict]) -> str:
+    """Round trajectory, one line per artifact. Gaps render as
+    `GAP [failure_class]` — never as zeros (the satellite contract)."""
+    if not rows:
+        return "(no round artifacts found)"
+    out = [f"{'round':<6s} {'kind':<10s} {'status':<9s} "
+           f"{'value':>10s}  detail"]
+    for r in rows:
+        rnd = f"r{r.get('round', 0):02d}"
+        if r.get("status") == "measured":
+            if r.get("kind") == "bench":
+                # the loader guarantees a numeric value on measured
+                # bench rows; `or 0.0` defends the renderer against
+                # hand-built rows anyway (a crash here would take the
+                # whole trend down for one odd artifact)
+                val = f"{r.get('value') or 0.0:10.4f}"
+                detail = (f"{r.get('unit', '')}"
+                          f" vs_baseline {r.get('vs_baseline')}")
+                if r.get("provenance"):
+                    detail += f" [{r['source']}]"
+            elif r.get("kind") == "journal":
+                val = f"{r.get('stages_completed', 0):>10d}"
+                detail = (f"stages ok, {r.get('stages_failed', 0)} failed"
+                          + (f" {r.get('failed_classes')}"
+                             if r.get("failed_classes") else ""))
+            else:
+                val = f"{'ok':>10s}"
+                detail = f"n_devices {r.get('n_devices')}"
+        elif r.get("status") == "gap":
+            val = f"{'GAP':>10s}"
+            detail = (f"[{r.get('failure_class', '?')}] "
+                      f"{r.get('detail', '')}")
+        else:
+            val = f"{r.get('status', '?'):>10s}"
+            detail = r.get("detail", "")
+        out.append(f"{rnd:<6s} {r.get('kind', '?'):<10s} "
+                   f"{r.get('status', '?'):<9s} {val}  {detail[:90]}")
+    return "\n".join(out)
+
+
+_SPARK = " .:-=+*#%@"
+
+
+def render_convergence(records: list[dict]) -> str:
+    """Convergence curves from a journal's `bench_record` events: the
+    decimated rel-residual curve as a -log10 sparkline plus the
+    iters/time-to-rtol ladder."""
+    lines: list[str] = []
+    for rec in records:
+        conv = rec.get("convergence") or (
+            (rec.get("result") or {}).get("convergence"))
+        if not isinstance(conv, dict):
+            continue
+        curve = conv.get("curve") or []
+        spark = ""
+        for _, rel in curve:
+            if rel <= 0:
+                depth = 8.0
+            else:
+                import math as _math
+
+                depth = min(max(-_math.log10(max(rel, 1e-16)), 0.0), 8.0)
+            spark += _SPARK[min(int(depth / 8.0 * (len(_SPARK) - 1)),
+                                len(_SPARK) - 1)]
+        lines.append(
+            f"{rec.get('event', '?')}: iters_run="
+            f"{conv.get('iters_run')} final_rel="
+            f"{conv.get('final_rel_residual') or 0.0:.3e} "
+            f"stag_max={conv.get('stagnation_max_run')} "
+            f"restarts={conv.get('restarts')} [{conv.get('evidence')}]")
+        lines.append(f"  |{spark}|  (depth: ' '=1e0 .. '@'=1e-8)")
+        iters = conv.get("iters_to_rtol") or {}
+        times = conv.get("time_to_rtol_s") or {}
+        lines.append("  " + "  ".join(
+            f"{k}:{iters[k]} it/"
+            + (f"{times.get(k):.3g}s" if times.get(k) is not None
+               else "-")
+            if iters[k] is not None else f"{k}:-"
+            for k in sorted(iters)))
+    return "\n".join(lines) if lines else "(no convergence-stamped records)"
+
+
+def render_slo(slo: dict) -> str:
+    lines = [f"objective {slo.get('objective_s')}s @ target "
+             f"{slo.get('target')} over {slo.get('samples')} responses"]
+    for label in ("fast", "slow"):
+        if f"{label}_burn_rate" in slo:
+            lines.append(
+                f"  {label:<5s} window {slo[f'{label}_window_s']:>7.0f}s: "
+                f"{slo[f'{label}_violations']}/{slo[f'{label}_requests']} "
+                f"violations, burn rate {slo[f'{label}_burn_rate']}")
+    lines.append(f"  alert: {slo.get('alert')}")
+    return "\n".join(lines)
+
+
+def trend_main(argv=None) -> int:
+    from .regress import fold_slo, load_trend
+
+    p = argparse.ArgumentParser(
+        prog="python -m bench_tpu_fem.obs trend",
+        description="Regression-sentinel trend view: round trajectory "
+                    "(wedge rounds as labelled gaps), convergence "
+                    "curves, serve SLO state.")
+    p.add_argument("--root", default=".",
+                   help="directory holding BENCH_r*/MULTICHIP_r*/"
+                        "MEASURE_r* artifacts")
+    p.add_argument("--journal", default="",
+                   help="journal with bench_record convergence stamps "
+                        "and/or serve_response lifecycles")
+    p.add_argument("--slo-objective", type=float, default=1.0,
+                   help="latency objective (seconds) for the SLO fold")
+    p.add_argument("--slo-target", type=float, default=0.99,
+                   help="SLO availability target (fraction)")
+    p.add_argument("--json", action="store_true")
+    args = p.parse_args(argv)
+
+    trend = load_trend(args.root)
+    records: list[dict] = []
+    slo = None
+    if args.journal:
+        from ..harness.journal import read_records
+
+        records, corrupt = read_records(args.journal)
+        if corrupt:
+            trend["corrupt_journal_lines"] = len(corrupt)
+        if any(r.get("event") == "serve_response" for r in records):
+            slo = fold_slo(records, objective_s=args.slo_objective,
+                           target=args.slo_target)
+    if args.json:
+        out = dict(trend)
+        out["slo"] = slo
+        # same lookup as render_convergence: the block may ride at top
+        # level or nested under `result` (weak-scaling-style records)
+        out["convergence_records"] = [
+            r for r in records
+            if isinstance(r.get("convergence"), dict)
+            or isinstance((r.get("result") or {}).get("convergence"),
+                          dict)]
+        print(json.dumps(out))
+        return 0
+    print("== round trajectory")
+    print(render_trend_rows(trend["rows"]))
+    print(f"   ({trend['measured']} measured, {trend['gaps']} labelled "
+          "gaps — a wedged round is a gap, never a zero)")
+    if args.journal:
+        print("== convergence")
+        print(render_convergence(records))
+        if slo is not None:
+            print("== serve SLO")
+            print(render_slo(slo))
+    return 0
+
+
+def gate_main(argv=None) -> int:
+    from .regress import gate_snapshots
+
+    p = argparse.ArgumentParser(
+        prog="python -m bench_tpu_fem.obs gate",
+        description="Perfgate: deterministic counters gate hard (rc 1 "
+                    "on violation); Mann-Whitney/bootstrap timing "
+                    "classification is advisory.")
+    p.add_argument("--current", required=True,
+                   help="perfgate snapshot JSON (scripts/perfgate.py)")
+    p.add_argument("--baseline", required=True,
+                   help="pinned baseline snapshot JSON")
+    p.add_argument("--alpha", type=float, default=0.05)
+    p.add_argument("--effect-threshold", type=float, default=0.05)
+    p.add_argument("--json", action="store_true")
+    args = p.parse_args(argv)
+    with open(args.current) as fh:
+        current = json.load(fh)
+    with open(args.baseline) as fh:
+        baseline = json.load(fh)
+    verdict = gate_snapshots(current, baseline, alpha=args.alpha,
+                             effect_threshold=args.effect_threshold)
+    if args.json:
+        print(json.dumps(verdict))
+    else:
+        status = "OK" if verdict["ok"] else (
+            f"REGRESSED ({len(verdict['violations'])} violations)")
+        print(f"== perfgate: {status}")
+        for v in verdict["violations"]:
+            print(f"   GATE {v}")
+        for name, t in sorted(verdict["timing"].items()):
+            print(f"   timing[{name}] (advisory): "
+                  f"{t.get('classification')} "
+                  f"(p={t.get('p_value')}, shift="
+                  f"{t.get('rel_median_shift')})")
+    return 0 if verdict["ok"] else 1
+
+
 def main(argv=None) -> int:
+    if argv is None:
+        argv = sys.argv[1:]
+    # subcommand dispatch (trend/gate); everything else is the original
+    # render/validate CLI
+    if argv and argv[0] == "trend":
+        return trend_main(argv[1:])
+    if argv and argv[0] == "gate":
+        return gate_main(argv[1:])
     p = argparse.ArgumentParser(
         prog="python -m bench_tpu_fem.obs",
         description="Render a journal + Chrome trace into a report "

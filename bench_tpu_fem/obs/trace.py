@@ -504,8 +504,10 @@ class BenchObserver:
         block_until_ready can return before the device work drains;
         fetching one scalar is a hard fence — 4-byte transfer, one
         slice kernel, negligible vs the timed work). Double-float
-        results fence through their ``hi`` component. Returns the last
-        rep's result; ``elapsed()`` is the median wall."""
+        results fence through their ``hi`` component; tuple results
+        (e.g. a convergence-captured solve returning ``(x, info)``)
+        fence through their first element. Returns the last rep's
+        result; ``elapsed()`` is the median wall."""
         jax = sys.modules["jax"]  # the drivers imported it long ago
         out = None
         with self.solve_region():
@@ -513,7 +515,8 @@ class BenchObserver:
                 t0 = time.perf_counter()
                 out = call()
                 jax.block_until_ready(out)
-                arr = out.hi if hasattr(out, "hi") else out
+                arr = out[0] if isinstance(out, (tuple, list)) else out
+                arr = arr.hi if hasattr(arr, "hi") else arr
                 float(arr[(0,) * arr.ndim])
                 self.rep(time.perf_counter() - t0)
         return out
@@ -551,6 +554,10 @@ class BenchObserver:
             "min_s": round(min(self.walls), 6) if self.walls else 0.0,
             "median_s": round(self.elapsed(), 6),
             "max_s": round(max(self.walls), 6) if self.walls else 0.0,
+            # the raw per-rep distribution (ISSUE 10): the regression
+            # sentinel's Mann-Whitney/bootstrap comparison consumes the
+            # full sample, not the 3-point summary
+            "walls_s": [round(w, 6) for w in self.walls],
         }
         if self.warmup_s is not None:
             timing["warmup_s"] = round(self.warmup_s, 6)

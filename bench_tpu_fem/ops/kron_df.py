@@ -138,7 +138,8 @@ def build_kron_laplacian_df(
     )
 
 
-def cg_solve_df(op: KronLaplacianDF, b: DF, max_iter: int) -> DF:
+def cg_solve_df(op: KronLaplacianDF, b: DF, max_iter: int,
+                capture: bool = False):
     """Fixed-iteration CG in df arithmetic (x0 = 0, rtol = 0 — reference
     cg.hpp:89-169 semantics), scalars (alpha, beta, rnorm) carried as DF.
 
@@ -149,11 +150,22 @@ def cg_solve_df(op: KronLaplacianDF, b: DF, max_iter: int) -> DF:
     realistic budget. Once the recurrence residual drops below the floor
     (rnorm <= 1e-24 * rnorm0, i.e. rel residual ~1e-12), the state
     freezes, mirroring la.cg.cg_solve's rtol freeze. Benchmark-size runs
-    never converge that far and are unaffected."""
+    never converge that far and are unaffected.
+
+    With `capture=True` (ISSUE 10) the loop carries a preallocated
+    `(max_iter + 1,)` f32 buffer of the carried squared residual norms'
+    HI channels (the lo channel is ~1e-7 relative — irrelevant to an
+    iterations-to-rtol ladder that stops at 1e-8 of the NORM, i.e. 1e-16
+    of the square) and returns `(x, {"rnorm_history": ...})` — the
+    `la.cg.cg_solve(capture=True)` contract. `capture=False` (default)
+    is the pre-capture code path unchanged."""
     floor = jnp.float32(1e-24)
 
-    def body(_, state):
-        x, r, p, rnorm, done = state
+    def body(i, state):
+        if capture:
+            x, r, p, rnorm, done, hist = state
+        else:
+            x, r, p, rnorm, done = state
         y = op.apply(p)
         alpha = df_div(rnorm, df_dot(p, y))
         x1 = df_axpy(x, alpha, p)
@@ -168,13 +180,21 @@ def cg_solve_df(op: KronLaplacianDF, b: DF, max_iter: int) -> DF:
                 lambda n, o: jnp.where(done, o, n), new, old
             )
 
-        return (keep(x1, x), keep(r1, r), keep(p1, p),
-                keep(rnorm1, rnorm), done1)
+        rnorm_keep = keep(rnorm1, rnorm)
+        out = (keep(x1, x), keep(r1, r), keep(p1, p), rnorm_keep, done1)
+        if capture:
+            out = out + (hist.at[i + 1].set(rnorm_keep.hi),)
+        return out
 
     x0 = df_zeros_like(b)
     rnorm0 = df_dot(b, b)
     rnorm0_hi = rnorm0.hi
     state = (x0, b, b, rnorm0, jnp.asarray(False))
+    if capture:
+        state = state + (
+            jnp.zeros((max_iter + 1,), jnp.float32).at[0].set(rnorm0.hi),)
+        x, _, _, _, _, hist = jax.lax.fori_loop(0, max_iter, body, state)
+        return x, {"rnorm_history": hist}
     x, *_ = jax.lax.fori_loop(0, max_iter, body, state)
     return x
 

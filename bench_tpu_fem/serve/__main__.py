@@ -45,6 +45,15 @@ def main(argv=None) -> int:
     p.add_argument("--journal", default="",
                    help="metrics JSONL journal path (crash-safe, "
                         "harness.journal format)")
+    p.add_argument("--slo-objective", type=float, default=2.0,
+                   help="latency SLO objective (seconds): /metrics "
+                        "exposes fast/slow-window error-budget burn "
+                        "rates against it (JSON `slo` block + "
+                        "benchfem_serve_slo_* Prometheus series). "
+                        "0 disables SLO tracking.")
+    p.add_argument("--slo-target", type=float, default=0.99,
+                   help="SLO availability target (fraction of requests "
+                        "inside the objective)")
     p.add_argument("--warmup", default="",
                    help="comma-separated degrees to prebuild at startup "
                         "(with --ndofs/--nreps/--precision), e.g. '1,3,6'")
@@ -78,7 +87,11 @@ def main(argv=None) -> int:
     from .metrics import Metrics
     from .server import make_server
 
-    metrics = Metrics(args.journal or None)
+    metrics = Metrics(
+        args.journal or None,
+        slo_objective_s=args.slo_objective or None,
+        slo_target=args.slo_target,
+    )
     broker = Broker(
         ExecutableCache(), metrics,
         queue_max=args.queue_max, nrhs_max=args.nrhs_max,

@@ -61,6 +61,7 @@ story, uncontaminated by compile stalls.
 from __future__ import annotations
 
 import threading
+import time
 from collections import deque
 
 from ..harness.journal import Journal, read_records
@@ -72,10 +73,25 @@ _LATENCY_WINDOW = 4096
 class Metrics:
     """Thread-safe counters + optional journal. Every mutator journals
     first (evidence before bookkeeping — a crash mid-increment still
-    leaves the record)."""
+    leaves the record).
 
-    def __init__(self, journal_path: str | None = None):
+    ``slo_objective_s`` (ISSUE 10) arms SLO tracking: every response
+    becomes a timestamped sample, and `snapshot()` folds the samples
+    into latency-objective burn rates over the fast/slow windows
+    (obs.regress.burn_rates — the SAME fold `python -m bench_tpu_fem.obs
+    trend` runs offline over the journal's serve_response lifecycles, so
+    the live /metrics story and the journal replay cannot diverge).
+    None (the default) leaves the snapshot exactly as before."""
+
+    def __init__(self, journal_path: str | None = None,
+                 slo_objective_s: float | None = None,
+                 slo_target: float = 0.99):
         self.journal = Journal(journal_path) if journal_path else None
+        self.slo_objective_s = slo_objective_s
+        self.slo_target = slo_target
+        # (wall ts, latency, ok) samples for the burn-rate windows;
+        # bounded like every other metrics series
+        self._slo_samples: deque = deque(maxlen=_LATENCY_WINDOW)
         self._lock = threading.Lock()
         self.requests_total = 0
         self.shed_total = 0
@@ -212,6 +228,7 @@ class Metrics:
                 self.failed_by_class[fc] = (
                     self.failed_by_class.get(fc, 0) + 1)
             self.latencies.append(latency_s)
+            self._slo_samples.append((time.time(), latency_s, ok))
             if cache == "hit":
                 self.latencies_warm.append(latency_s)
 
@@ -307,6 +324,18 @@ class Metrics:
             # device-memory telemetry (obs.memory): allocator stats on
             # hardware, labelled process-RSS proxy on CPU
             out["memory"] = memory
+        if self.slo_objective_s is not None:
+            # SLO burn-rate state (ISSUE 10): a flat numeric sub-dict,
+            # so the Prometheus flattener exposes every field as its
+            # own benchfem_serve_slo_* series
+            from ..obs.regress import burn_rates
+
+            with self._lock:
+                samples = list(self._slo_samples)
+            out["slo"] = burn_rates(samples,
+                                    objective_s=self.slo_objective_s,
+                                    target=self.slo_target,
+                                    now=time.time())
         return out
 
 

@@ -1,0 +1,192 @@
+#!/usr/bin/env python
+"""Perfgate snapshot collector (ISSUE 10): one CPU-pinned pass over the
+stack's deterministic observability surface, folded into a JSON snapshot
+`python -m bench_tpu_fem.obs gate` compares against the pinned baseline.
+
+    JAX_PLATFORMS=cpu python scripts/perfgate.py --out /tmp/cur.json
+    python -m bench_tpu_fem.obs gate --current /tmp/cur.json \
+        --baseline PERFGATE_BASELINE.json
+
+Three in-process measurements (no subprocesses, no network):
+
+  * **bench**: a small traced single-chip CG run with convergence
+    capture on and ``--timing-reps`` > 1 — contributes the record
+    contract (roofline/phase/timing/memory/convergence stamps), the
+    per-rep wall distribution (advisory Mann-Whitney input) and the
+    convergence block.
+  * **dist**: the same problem on 2 virtual CPU devices with the span
+    tracer enabled — contributes the trace-level
+    ``collectives_per_iter`` counts (the overlapped-CG one-psum
+    contract's counter: noise-free, gates hard) and a second timing
+    distribution.
+  * **serve**: an in-process broker round (warmup + ramped requests) —
+    contributes compile counts, request-weighted cache hit-rate,
+    shed/failed counts and the SLO burn-rate state from the journaled
+    request lifecycles.
+
+The counters land in ``snapshot["counters"]`` (the hard gate);
+wall-clock distributions stay inside the per-section ``timing`` blocks
+(advisory). Deterministic on CPU for this pinned workload: the same
+code must produce the same counters — a drift IS the regression.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--out", required=True, help="snapshot JSON path")
+    p.add_argument("--ndofs", type=int, default=4096)
+    p.add_argument("--nreps", type=int, default=20)
+    p.add_argument("--timing-reps", type=int, default=5)
+    p.add_argument("--requests", type=int, default=16,
+                   help="serve requests fired through the broker")
+    p.add_argument("--slo-objective", type=float, default=5.0,
+                   help="latency objective for the serve SLO fold "
+                        "(generous: CPU solves are slow; the gate is "
+                        "on counters, the SLO state is evidence)")
+    args = p.parse_args(argv)
+
+    # hermetic CPU with 2 virtual devices BEFORE any backend init: the
+    # dist leg needs a device grid, and a wedged TPU tunnel must never
+    # hang the gate
+    from bench_tpu_fem.utils.hermetic import force_host_cpu_devices
+
+    force_host_cpu_devices(2)
+    import jax
+    import jax.numpy as jnp
+
+    from bench_tpu_fem.bench.driver import (
+        BenchConfig,
+        BenchmarkResults,
+        run_benchmark,
+    )
+    from bench_tpu_fem.dist.driver import run_distributed
+    from bench_tpu_fem.obs import trace as obs_trace
+    from bench_tpu_fem.obs.regress import check_record_contract
+
+    tracer = obs_trace.enable(fresh=True)
+
+    # -- bench leg: record contract + timing distribution + convergence
+    cfg = BenchConfig(ndofs_global=args.ndofs, degree=3, qmode=1,
+                      float_bits=32, nreps=args.nreps, use_cg=True,
+                      timing_reps=args.timing_reps, convergence=True)
+    res = run_benchmark(cfg)
+    bench = {k: res.extra.get(k) for k in (
+        "roofline", "phase_share", "phase_s", "timing",
+        "peak_memory_bytes", "convergence", "time_to_rtol_s",
+        "cg_engine_form")}
+    bench["gdof_per_second"] = res.gdof_per_second
+
+    # -- dist leg: trace-level collective counts (the hard counter)
+    dcfg = BenchConfig(ndofs_global=args.ndofs, degree=3, qmode=1,
+                       float_bits=32, nreps=args.nreps, use_cg=True,
+                       ndevices=2, timing_reps=args.timing_reps)
+    dres = BenchmarkResults(nreps=dcfg.nreps)
+    run_distributed(dcfg, dres, jnp.float32)
+    dist = {k: dres.extra.get(k) for k in (
+        "timing", "collectives_per_iter", "cg_engine_form",
+        "per_iter_s")}
+
+    # -- serve leg: broker round with journaled lifecycles + SLO
+    from bench_tpu_fem.obs.regress import fold_slo
+    from bench_tpu_fem.serve.broker import Broker
+    from bench_tpu_fem.serve.cache import ExecutableCache
+    from bench_tpu_fem.serve.engine import SolveSpec
+    from bench_tpu_fem.serve.metrics import Metrics
+
+    journal_path = args.out + ".serve.jsonl"
+    try:
+        os.unlink(journal_path)
+    except OSError:
+        pass
+    cache = ExecutableCache()
+    metrics = Metrics(journal_path, slo_objective_s=args.slo_objective)
+    broker = Broker(cache, metrics, queue_max=64, nrhs_max=4,
+                    window_s=0.05)
+    spec = SolveSpec(degree=3, ndofs=4000, nreps=30)
+    broker.warmup([spec])
+    compiles_after_warmup = cache.stats()["compiles"]
+    pendings = []
+    import time as _time
+
+    for i in range(args.requests):
+        pendings.append(broker.submit(spec, scale=float(2 ** (i % 3))))
+        _time.sleep(0.01)  # ramped arrivals: spans solve boundaries
+    results = [broker.wait(pr, 120.0) for pr in pendings]
+    # the continuous batch's serve_batch record (which carries the
+    # hit/miss accounting) lands AFTER the last retire answers the
+    # final wait — settle before snapshotting or the hit-rate counter
+    # reads racy
+    deadline = _time.monotonic() + 10.0
+    while (metrics.cache_hit_requests + metrics.cache_miss_requests
+           < args.requests and _time.monotonic() < deadline):
+        _time.sleep(0.05)
+    snap = metrics.snapshot(cache_stats=cache.stats())
+    broker.shutdown()
+    from bench_tpu_fem.harness.journal import read_records
+
+    records, corrupt = read_records(journal_path)
+    serve = {
+        "ok_responses": sum(1 for r in results if r.get("ok")),
+        "metrics": snap,
+        "slo": fold_slo(records, objective_s=args.slo_objective),
+        "corrupt_lines": len(corrupt),
+    }
+
+    # -- trace validity + record contract (contract booleans gate)
+    from bench_tpu_fem.obs.trace import validate_chrome_trace
+
+    trace_violations = validate_chrome_trace(tracer.chrome_trace())
+    record_errs = check_record_contract(bench, require_convergence=True)
+
+    counters = {
+        "collectives_per_iter": dist.get("collectives_per_iter"),
+        "compiles": snap["cache"]["compiles"],
+        "recompiles": snap["cache"]["compiles"] - compiles_after_warmup,
+        "cache_hit_rate_requests": snap["cache_hit_rate_requests"],
+        "shed_total": snap["shed_total"],
+        "responses_failed": snap["failed"],
+        "completed": snap["completed"],
+        "corrupt_lines": len(corrupt),
+        "record_contract_ok": not record_errs,
+        "trace_valid": not trace_violations,
+    }
+    snapshot = {
+        "workload": {"ndofs": args.ndofs, "nreps": args.nreps,
+                     "timing_reps": args.timing_reps,
+                     "requests": args.requests,
+                     "platform": jax.default_backend()},
+        "bench": bench,
+        "dist": dist,
+        "serve": serve,
+        "counters": counters,
+        "record_contract_errors": record_errs,
+        "trace_violations": trace_violations[:5],
+    }
+    with open(args.out, "w") as fh:
+        json.dump(snapshot, fh, indent=1, sort_keys=True)
+    print(f"perfgate snapshot -> {args.out}")
+    print(json.dumps(counters, sort_keys=True))
+    # the collector itself fails loud when the contracts are broken
+    # (the gate would catch it against any sane baseline, but a broken
+    # contract should not need a baseline to be visible)
+    if record_errs or trace_violations:
+        print("CONTRACT VIOLATIONS:", record_errs + trace_violations[:5])
+        return 1
+    if serve["ok_responses"] != args.requests:
+        print(f"serve leg lost requests: {serve['ok_responses']}"
+              f"/{args.requests}")
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

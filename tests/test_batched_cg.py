@@ -54,6 +54,8 @@ def test_nrhs1_matches_cg_solve_f32():
                                rtol=1e-7, atol=1e-7)
 
 
+@pytest.mark.slow  # round-10 fast-lane rebalance: 18 s (the f32
+# nrhs=1 anchor above keeps the fast-lane parity signal)
 def test_nrhs1_matches_cg_solve_df():
     """df32 anchor: vmapped cg_solve_df lane == the scalar df solve,
     <= 1e-13 relative (measured bitwise; the optimization_barrier

@@ -103,6 +103,8 @@ def test_chunked_rtol_matches_cg_solve():
     assert bool(state.done)
 
 
+@pytest.mark.slow  # round-10 fast-lane rebalance: 29 s, the lane's
+# heaviest case (the f32 chunked-bitwise case above keeps fast signal)
 def test_df_chunked_loop_bitwise_cg_solve_df():
     """The df twin: chunked make_df_cg_ckpt_step == ops.kron_df's
     cg_solve_df, bitwise on both channels, through a host round-trip."""

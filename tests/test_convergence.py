@@ -1,0 +1,457 @@
+"""Convergence telemetry suite (ISSUE 10): the capture contracts.
+
+The two acceptance-critical properties live here:
+
+- **capture OFF is bitwise the pre-PR solve**: `_reference_cg_solve`
+  below is the pre-capture loop body VERBATIM (frozen at the PR-9
+  state); `cg_solve()` with capture unset must produce bit-identical
+  iterates. Same for the df twin.
+- **capture ON adds no per-iteration host sync**: trace-asserted — the
+  captured solve lowers to ONE jitted computation whose jaxpr contains
+  no host-callback/infeed primitives, and the history comes back as a
+  device array written by in-loop dynamic-index stores.
+
+Plus: history correctness against a per-iteration python replica,
+per-lane batched capture isolation, the obs.convergence fold
+(iters-to-rtol ladder, stagnation/restart counts, time-to-rtol), driver
+integration (stamp shape + gate reasons), and the `--timing-reps`
+parity satellite across the single-chip and dist drivers.
+"""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bench_tpu_fem.bench.driver import (
+    BenchConfig,
+    BenchmarkResults,
+    run_benchmark,
+)
+from bench_tpu_fem.la.cg import cg_solve, cg_solve_batched
+from bench_tpu_fem.la.vector import inner_product
+from bench_tpu_fem.obs.convergence import (
+    RTOL_LADDER,
+    decimate_curve,
+    fold_history,
+    iters_to_rtol,
+    rel_residuals,
+    rtol_key,
+    stagnation_stats,
+)
+
+
+def _reference_cg_solve(apply_A, b, x0, max_iter, rtol=0.0, dot=None):
+    """The PRE-capture `la.cg.cg_solve` loop, frozen verbatim (sentinel
+    and dot3 paths elided — they are separately pinned): the bitwise
+    oracle for the disabled path."""
+    if dot is None:
+        dot = inner_product
+
+    y = apply_A(x0)
+    r = b - y
+    p = r
+    rnorm0 = dot(p, r)
+
+    def body(_, state):
+        x, r, p, rnorm, done = state
+        y = apply_A(p)
+        pdot = dot(p, y)
+        alpha = rnorm / pdot
+        x1 = x + alpha * p
+        r1 = r - alpha * y
+        rnorm_new = dot(r1, r1)
+        beta = rnorm_new / rnorm
+        p1 = beta * p + r1
+        new_done = jnp.logical_or(done, rnorm_new / rnorm0 < rtol * rtol)
+        new_done = jnp.logical_or(
+            new_done, rnorm_new == jnp.zeros((), rnorm_new.dtype))
+        keep = lambda new, old: jnp.where(done, old, new)  # noqa: E731
+        return (keep(x1, x), keep(r1, r), keep(p1, p),
+                keep(rnorm_new, rnorm), new_done)
+
+    state = (x0, r, p, rnorm0, jnp.asarray(False))
+    x, *_ = jax.lax.fori_loop(0, max_iter, body, state)
+    return x
+
+
+def _spd_problem(n=48, dtype=jnp.float32, seed=0):
+    rng = np.random.default_rng(seed)
+    d = np.linspace(1.0, 25.0, n)
+    A = np.diag(d) + 0.05 * np.eye(n, k=1) + 0.05 * np.eye(n, k=-1)
+    b = rng.standard_normal(n)
+    Aj = jnp.asarray(A, dtype)
+    return (lambda v: Aj @ v), jnp.asarray(b, dtype)
+
+
+# --------------------------------------------------------------------------
+# The bitwise disabled-path contract.
+
+
+@pytest.mark.parametrize("iters", [7, 40])
+def test_capture_off_bitwise_pre_pr_solve(iters):
+    apply_A, b = _spd_problem()
+    x0 = jnp.zeros_like(b)
+    ref = jax.jit(lambda bb, xx: _reference_cg_solve(
+        apply_A, bb, xx, iters))(b, x0)
+    got = jax.jit(lambda bb, xx: cg_solve(apply_A, bb, xx, iters))(b, x0)
+    assert np.array_equal(np.asarray(ref), np.asarray(got)), \
+        "capture-off cg_solve drifted from the pre-PR loop"
+
+
+def test_capture_off_bitwise_with_rtol_freeze():
+    apply_A, b = _spd_problem()
+    x0 = jnp.zeros_like(b)
+    ref = _reference_cg_solve(apply_A, b, x0, 60, rtol=1e-5)
+    got = cg_solve(apply_A, b, x0, 60, rtol=1e-5)
+    assert np.array_equal(np.asarray(ref), np.asarray(got))
+
+
+def test_df_capture_off_bitwise_and_on_matches():
+    from bench_tpu_fem.elements.tables import build_operator_tables
+    from bench_tpu_fem.mesh.box import create_box_mesh
+    from bench_tpu_fem.ops.kron_df import (
+        build_kron_laplacian_df,
+        cg_solve_df,
+        device_rhs_uniform_df,
+    )
+
+    t = build_operator_tables(2, 1, "gll")
+    mesh = create_box_mesh((3, 3, 3))
+    op = build_kron_laplacian_df(mesh, 2, 1, tables=t)
+    u = device_rhs_uniform_df(t, mesh.n)
+    x_plain = cg_solve_df(op, u, 25)
+    x_cap, info = cg_solve_df(op, u, 25, capture=True)
+    assert np.array_equal(np.asarray(x_plain.hi), np.asarray(x_cap.hi))
+    assert np.array_equal(np.asarray(x_plain.lo), np.asarray(x_cap.lo))
+    hist = np.asarray(info["rnorm_history"])
+    assert hist.shape == (26,)
+    assert hist[0] > 0 and np.all(np.isfinite(hist))
+    # df solves this small converge fast: the history must actually fall
+    assert hist[-1] < hist[0] * 1e-6
+
+
+# --------------------------------------------------------------------------
+# Capture correctness + the no-host-sync trace assertion.
+
+
+def test_capture_history_matches_python_replica():
+    apply_A, b = _spd_problem()
+    x0 = jnp.zeros_like(b)
+    iters = 30
+    x_cap, info = jax.jit(lambda bb, xx: cg_solve(
+        apply_A, bb, xx, iters, capture=True))(b, x0)
+    hist = np.asarray(info["rnorm_history"], np.float64)
+
+    # python replica of the recurrence, collecting rnorm per iteration
+    x = np.zeros_like(np.asarray(b))
+    r = np.asarray(b, np.float32).copy()
+    p = r.copy()
+    A = np.asarray(jax.jit(jax.jacfwd(apply_A))(jnp.zeros_like(b)))
+    expected = [float(np.dot(r, r))]
+    rnorm = np.float32(np.dot(p, r))
+    for _ in range(iters):
+        y = (A @ p).astype(np.float32)
+        alpha = np.float32(rnorm / np.float32(np.dot(p, y)))
+        x = (x + alpha * p).astype(np.float32)
+        r = (r - alpha * y).astype(np.float32)
+        rnorm1 = np.float32(np.dot(r, r))
+        beta = np.float32(rnorm1 / rnorm)
+        p = (beta * p + r).astype(np.float32)
+        rnorm = rnorm1
+        expected.append(float(rnorm))
+    # same recurrence, same precision class: the histories agree to f32
+    # rounding (the device dot reassociates vs np.dot)
+    np.testing.assert_allclose(hist, expected, rtol=2e-4)
+    # and the capture-on solution is bitwise the capture-off one
+    x_off = jax.jit(lambda bb, xx: cg_solve(apply_A, bb, xx, iters))(b, x0)
+    assert np.array_equal(np.asarray(x_off), np.asarray(x_cap))
+
+
+_HOST_SYNC_PRIMS = ("callback", "infeed", "outfeed", "host",
+                    "python_callback", "io_callback", "debug_callback")
+
+
+def _assert_no_host_sync(jaxpr) -> int:
+    """Walk a closed jaxpr; fail on any host-callback primitive. Returns
+    the eqn count walked (sanity: the walk saw the loop body)."""
+    seen = 0
+
+    def walk(jx):
+        nonlocal seen
+        for eqn in jx.eqns:
+            seen += 1
+            name = eqn.primitive.name
+            assert not any(h in name for h in _HOST_SYNC_PRIMS), \
+                f"host-sync primitive {name!r} inside the captured solve"
+            for v in eqn.params.values():
+                if hasattr(v, "jaxpr"):
+                    inner = v.jaxpr
+                    walk(inner if hasattr(inner, "eqns") else inner.jaxpr)
+                elif isinstance(v, (list, tuple)):
+                    for it in v:
+                        if hasattr(it, "jaxpr"):
+                            inner = it.jaxpr
+                            walk(inner if hasattr(inner, "eqns")
+                                 else inner.jaxpr)
+
+    walk(jaxpr.jaxpr)
+    return seen
+
+
+def test_capture_on_no_per_iteration_host_sync():
+    apply_A, b = _spd_problem()
+    x0 = jnp.zeros_like(b)
+    jaxpr = jax.make_jaxpr(
+        lambda bb, xx: cg_solve(apply_A, bb, xx, 20, capture=True))(b, x0)
+    assert _assert_no_host_sync(jaxpr) > 0
+    # one jitted call end to end; the history arrives as a DEVICE array
+    # (fetched by the caller once, after the solve)
+    x, info = jax.jit(lambda bb, xx: cg_solve(
+        apply_A, bb, xx, 20, capture=True))(b, x0)
+    assert isinstance(info["rnorm_history"], jax.Array)
+    # the whole solve is one fori_loop (jax lowers a static-trip
+    # fori_loop to scan, a dynamic one to while): exactly one loop eqn
+    top = [e.primitive.name for e in jaxpr.jaxpr.eqns]
+    assert top.count("while") + top.count("scan") == 1, top
+
+
+def test_batched_capture_per_lane_and_padding():
+    apply_A, b = _spd_problem()
+    B = jnp.stack([b, 2.0 * b, jnp.zeros_like(b)])
+    X, info = cg_solve_batched(apply_A, B, jnp.zeros_like(B), 25,
+                               capture=True)
+    hist = np.asarray(info["rnorm_history"])
+    assert hist.shape == (26, 3)
+    # lane 1 is an exact power-of-two scale of lane 0: histories scale
+    # by 4 exactly at iteration 0 and track throughout
+    assert hist[0, 1] == pytest.approx(4.0 * hist[0, 0], rel=1e-6)
+    # padding lane: born frozen, history all zero
+    assert np.all(hist[:, 2] == 0.0)
+    # lane solutions are bitwise the capture-off batch
+    X_off = cg_solve_batched(apply_A, B, jnp.zeros_like(B), 25)
+    assert np.array_equal(np.asarray(X_off), np.asarray(X))
+    # and rel_residuals of the padding lane folds to zeros, not NaN
+    assert np.all(rel_residuals(hist[:, 2]) == 0.0)
+
+
+def test_capture_composes_with_sentinel():
+    apply_A, b = _spd_problem()
+    x, info = cg_solve(apply_A, b, jnp.zeros_like(b), 15, sentinel=True,
+                       capture=True)
+    assert set(info) == {"breakdown_restarts", "nonfinite", "stag_max",
+                        "rnorm_history"}
+    assert np.asarray(info["rnorm_history"]).shape == (16,)
+
+
+# --------------------------------------------------------------------------
+# The obs.convergence fold.
+
+
+def test_iters_to_rtol_ladder_and_keys():
+    # squared norms: rel residual sqrt(h/h0) = 10^-k at index k
+    hist = [10.0 ** (-2 * k) for k in range(9)]
+    out = iters_to_rtol(hist)
+    assert list(out) == [rtol_key(r) for r in RTOL_LADDER]
+    # rel(k) = 10^-k; first index BELOW 1e-2 is k=3 (10^-3 < 10^-2)
+    assert out["1e-02"] == 3
+    assert out["1e-08"] is None  # rel(8)=1e-8 is NOT < 1e-8
+    hist.append(1e-18)
+    assert iters_to_rtol(hist)["1e-08"] == 9
+
+
+def test_stagnation_and_restart_counts():
+    #          drop   stall  grow   drop  drop
+    hist = [100.0, 50.0, 50.0, 60.0, 30.0, 10.0]
+    st = stagnation_stats(hist)
+    assert st["restarts"] == 1          # the 50 -> 60 growth
+    assert st["stagnation_max_run"] == 2  # 50->50 (stall) then ->60
+    assert st["nonfinite_iters"] == 0
+    st2 = stagnation_stats([100.0, float("nan"), 50.0])
+    assert st2["nonfinite_iters"] == 1
+
+
+def test_fold_history_time_to_rtol_pairs_iters():
+    hist = [10.0 ** (-2 * k) for k in range(10)]
+    block = fold_history(hist, wall_s=0.9, iters_run=9,
+                         evidence="cpu-measured")
+    per_iter = 0.9 / 9
+    for key, it in block["iters_to_rtol"].items():
+        t = block["time_to_rtol_s"][key]
+        if it is None:
+            assert t is None
+        else:
+            assert t == pytest.approx(it * per_iter, abs=1e-6)
+    assert block["evidence"] == "cpu-measured"
+    assert block["final_rel_residual"] == pytest.approx(1e-9)
+
+
+def test_decimate_curve_keeps_endpoints():
+    hist = np.geomspace(1.0, 1e-12, 1001)
+    curve = decimate_curve(hist, max_points=64)
+    assert len(curve) <= 64
+    assert curve[0][0] == 0 and curve[-1][0] == 1000
+    assert curve[0][1] == pytest.approx(1.0)
+
+
+# --------------------------------------------------------------------------
+# Driver integration: stamps, gate reasons, timing-reps parity.
+
+
+def _small_cfg(**kw):
+    base = dict(ndofs_global=4000, degree=3, qmode=1, float_bits=32,
+                nreps=25, use_cg=True)
+    base.update(kw)
+    return BenchConfig(**base)
+
+
+def test_driver_stamps_convergence_block():
+    res = run_benchmark(_small_cfg(convergence=True))
+    conv = res.extra["convergence"]
+    assert conv["iters_run"] == 25
+    assert conv["rnorm0"] > 0
+    assert "cpu-measured" in conv["evidence"]
+    assert res.extra["time_to_rtol_s"] == conv["time_to_rtol_s"]
+    # the ladder is monotone where reached: tighter rtol, later iteration
+    reached = [v for v in conv["iters_to_rtol"].values() if v is not None]
+    assert reached == sorted(reached)
+    # per_iter consistency with the paired metric (stamp rounds to 9dp)
+    assert conv["per_iter_s"] == pytest.approx(
+        res.mat_free_time / 25, abs=1e-8)
+    # the record (results_json) carries both stamps
+    from bench_tpu_fem.bench.reporting import results_json
+    import json as _json
+
+    out = _json.loads(results_json(_small_cfg(convergence=True), res))
+    assert "convergence" in out["output"]
+    assert "time_to_rtol_s" in out["output"]
+
+
+def test_driver_disabled_path_stamps_nothing():
+    res = run_benchmark(_small_cfg())
+    assert "convergence" not in res.extra
+    assert "convergence_gate_reason" not in res.extra
+    assert "time_to_rtol_s" not in res.extra
+
+
+def test_driver_action_and_checkpoint_gate_reasons():
+    res = run_benchmark(_small_cfg(use_cg=False, convergence=True))
+    assert "CG solves only" in res.extra["convergence_gate_reason"]
+    assert "convergence" not in res.extra
+    res2 = run_benchmark(_small_cfg(convergence=True, checkpoint_every=5))
+    assert "checkpointable" in res2.extra["convergence_gate_reason"]
+    assert "convergence" not in res2.extra
+    # the checkpointed solve itself still ran + stamped
+    assert res2.extra["checkpoint"]["every"] == 5
+
+
+def test_driver_df32_convergence_stamp():
+    res = run_benchmark(_small_cfg(float_bits=64, f64_impl="df32",
+                                   nreps=20, convergence=True))
+    conv = res.extra["convergence"]
+    assert conv["iters_run"] == 20
+    # the history must show real convergence progress
+    assert 0.0 <= conv["final_rel_residual"] < 0.5
+
+
+def test_driver_batched_convergence_lane0():
+    res = run_benchmark(_small_cfg(nrhs=2, nreps=20, convergence=True))
+    conv = res.extra["convergence"]
+    assert conv["nrhs"] == 2 and conv["lane"] == 0
+    assert conv["iters_run"] == 20
+
+
+def test_env_opt_in(monkeypatch):
+    monkeypatch.setenv("BENCH_CONVERGENCE", "1")
+    assert BenchConfig(ndofs_global=1000).convergence is True
+    monkeypatch.delenv("BENCH_CONVERGENCE")
+    assert BenchConfig(ndofs_global=1000).convergence is False
+
+
+@pytest.mark.parametrize("kind", [
+    "kron",
+    # the df dist leg is 17 s of compile: slow lane (kron keeps the
+    # fast-lane dist-capture signal)
+    pytest.param("df", marks=pytest.mark.slow)])
+def test_dist_driver_convergence_stamp(kind):
+    from bench_tpu_fem.dist.driver import (
+        run_distributed,
+        run_distributed_df64,
+    )
+
+    if kind == "kron":
+        cfg = BenchConfig(ndofs_global=4096, degree=2, qmode=1,
+                          float_bits=32, nreps=12, use_cg=True,
+                          ndevices=2, convergence=True)
+        res = BenchmarkResults(nreps=cfg.nreps)
+        run_distributed(cfg, res, jnp.float32)
+    else:
+        cfg = BenchConfig(ndofs_global=4096, degree=2, qmode=1,
+                          float_bits=64, nreps=12, use_cg=True,
+                          ndevices=2, f64_impl="df32", convergence=True)
+        res = BenchmarkResults(nreps=cfg.nreps)
+        run_distributed_df64(cfg, res)
+    conv = res.extra["convergence"]
+    assert conv["iters_run"] == 12
+    assert res.extra["time_to_rtol_s"] == conv["time_to_rtol_s"]
+    assert np.isfinite(res.ynorm) and res.ynorm > 0
+
+
+def test_dist_capture_history_matches_single_chip():
+    """The sharded captured history IS the solve's own residual story:
+    the same global problem on 1 vs 2 shards produces closely-tracking
+    histories (psum'd dots vs single-device dots — f32 reassociation
+    noise only)."""
+    from bench_tpu_fem.dist.driver import run_distributed
+
+    hists = []
+    for nd in (1, 2):
+        cfg = BenchConfig(ndofs_global=4096, degree=2, qmode=1,
+                          float_bits=32, nreps=10, use_cg=True,
+                          ndevices=nd, convergence=True)
+        res = BenchmarkResults(nreps=cfg.nreps)
+        run_distributed(cfg, res, jnp.float32)
+        curve = dict((k, v) for k, v in res.extra["convergence"]["curve"])
+        hists.append(curve)
+    k_common = sorted(set(hists[0]) & set(hists[1]))
+    a = np.array([hists[0][k] for k in k_common])
+    b = np.array([hists[1][k] for k in k_common])
+    np.testing.assert_allclose(a, b, rtol=1e-3)
+
+
+@pytest.mark.slow  # 3 driver compiles (~21 s): the satellite's parity
+# proof runs in the CI slow lane; the fast lane keeps the per-driver
+# timing stamps via the convergence-stamp tests above
+def test_timing_reps_parity_across_drivers():
+    """Satellite: ALL three driver paths (single-chip bench, dist f32,
+    dist df) stamp the SAME per-rep timing contract — reps,
+    min/median/max, the full walls_s distribution — and GDoF/s divides
+    the median. No path has a recorded-reason gap: every timed region
+    runs through BenchObserver.timed_reps."""
+    from bench_tpu_fem.dist.driver import (
+        run_distributed,
+        run_distributed_df64,
+    )
+
+    res1 = run_benchmark(_small_cfg(nreps=10, timing_reps=3))
+    cfg2 = BenchConfig(ndofs_global=4096, degree=2, qmode=1,
+                       float_bits=32, nreps=10, use_cg=True, ndevices=2,
+                       timing_reps=3)
+    res2 = BenchmarkResults(nreps=cfg2.nreps)
+    run_distributed(cfg2, res2, jnp.float32)
+    cfg3 = dataclasses.replace(cfg2, float_bits=64, f64_impl="df32")
+    res3 = BenchmarkResults(nreps=cfg3.nreps)
+    run_distributed_df64(cfg3, res3)
+    for res, ndofs in ((res1, res1.ndofs_global),
+                       (res2, res2.ndofs_global),
+                       (res3, res3.ndofs_global)):
+        t = res.extra["timing"]
+        assert t["reps"] == 3
+        assert len(t["walls_s"]) == 3
+        assert t["min_s"] <= t["median_s"] <= t["max_s"]
+        assert t["median_s"] == pytest.approx(
+            sorted(t["walls_s"])[1], abs=1e-5)
+        assert res.gdof_per_second == pytest.approx(
+            ndofs * 10 / (1e9 * res.mat_free_time), rel=1e-6)

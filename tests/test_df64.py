@@ -93,7 +93,11 @@ def _setup(n=(6, 6, 6), degree=3, qmode=1):
 
 @pytest.mark.parametrize(
     "degree,qmode",
-    [(1, 0), (3, 1), pytest.param(6, 1, marks=pytest.mark.slow)])
+    [(1, 0),
+     # degree-3 case slow-marked in the round-10 fast-lane rebalance
+     # (12 s; degree 1 keeps the fast parity signal)
+     pytest.param(3, 1, marks=pytest.mark.slow),
+     pytest.param(6, 1, marks=pytest.mark.slow)])
 def test_df64_apply_matches_f64(degree, qmode):
     op64, b64, opdf, bdf = _setup((4, 3, 3), degree, qmode)
     y64 = np.asarray(op64.apply(b64), np.float64)

@@ -129,6 +129,7 @@ def test_engine_cg_against_csr_oracle():
     np.testing.assert_allclose(x.ravel(), z, atol=2e-4 * scale)
 
 
+@pytest.mark.slow  # round-10 fast-lane rebalance: 13 s interpret-mode
 def test_engine_cg_pallas_update_matches_default():
     """The chunked pallas x/r update (shared with the kron engine, for
     >=130M-dof capacity) must reproduce the fused-XLA update on folded

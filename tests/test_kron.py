@@ -42,7 +42,11 @@ def test_kron_matrix_matches_oracle(degree, qmode, rule):
     assert np.abs(A_oracle - A_kron).max() / scale < 1e-13
 
 
-@pytest.mark.parametrize("degree,qmode", [(1, 1), (2, 0), (3, 1), (5, 1), (7, 1)])
+@pytest.mark.parametrize(
+    "degree,qmode",
+    [(1, 1), (2, 0), (3, 1), (5, 1),
+     # degree-7 slow-marked in the round-10 fast-lane rebalance (8 s)
+     pytest.param(7, 1, marks=pytest.mark.slow)])
 def test_kron_apply_matches_xla(degree, qmode):
     """Operator apply (including Dirichlet pass-through and the folded input
     mask) agrees with the general path on a uniform mesh."""

@@ -16,7 +16,10 @@ jax.config.update("jax_enable_x64", True)
 
 
 @pytest.mark.parametrize(
-    "degree,qmode", [(1, 0), (3, 0), (3, 1), (5, 1), (6, 1), (7, 1)]
+    "degree,qmode", [(1, 0), (3, 0), (3, 1), (5, 1), (6, 1),
+                     # degree-7 slow-marked in the round-10 fast-lane
+                     # rebalance (10 s interpret; 1-6 keep fast signal)
+                     pytest.param(7, 1, marks=pytest.mark.slow)]
 )
 def test_pallas_cell_apply_matches_xla(degree, qmode):
     n = (2, 2, 2)

@@ -1,0 +1,376 @@
+"""Regression-sentinel suite (ISSUE 10): trend loader honesty (wedge
+rounds are labelled gaps, never zeros), Mann-Whitney/bootstrap
+known-answer classification, deterministic-counter gating, record
+contracts, SLO burn-rate folds, and the obs CLI trend/gate rc
+semantics. Stdlib + numpy — seconds-fast."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from bench_tpu_fem.obs.regress import (
+    bootstrap_median_ci,
+    burn_rates,
+    check_record_contract,
+    classify_timing,
+    fold_slo,
+    gate_counters,
+    gate_snapshots,
+    load_trend,
+    mann_whitney_u,
+)
+from bench_tpu_fem.obs.report import gate_main, trend_main
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# --------------------------------------------------------------------------
+# Trend loader: the committed artifacts + synthetic schema corners.
+
+
+def test_committed_rounds_fold_with_labelled_gaps():
+    trend = load_trend(ROOT)
+    rows = trend["rows"]
+    bench = {(-r["round"], r["source"]): r for r in rows
+             if r["kind"] == "bench"}
+    by_round = {}
+    for r in rows:
+        if r["kind"] == "bench" and "_" not in r["source"].split("r")[1]:
+            by_round[r["round"]] = r
+    # r01-r03 measured with real values
+    for rnd, val in ((1, 2.7888), (2, 6.1982), (3, 6.2936)):
+        assert by_round[rnd]["status"] == "measured"
+        assert by_round[rnd]["value"] == pytest.approx(val)
+    # r04 canonical artifact: error-stamped zero -> labelled gap
+    assert by_round[4]["status"] == "gap"
+    assert by_round[4]["failure_class"] == "tunnel_wedge"
+    # r05: rc=124, parsed null -> labelled gap, class from the tail
+    assert by_round[5]["status"] == "gap"
+    assert by_round[5]["failure_class"] == "tunnel_wedge"
+    # the satellite contract: NO gap round ever reads as a zero point
+    for r in rows:
+        if r["status"] == "measured" and r["kind"] == "bench":
+            assert r["value"] > 0
+    # the r04 mid-round sidecar loads as measured evidence
+    sidecars = [r for r in rows if r.get("provenance")]
+    assert any(r["round"] == 4 and r["value"] == pytest.approx(9.2809)
+               for r in sidecars)
+    assert trend["gaps"] >= 2
+    assert bench  # sanity: the dict comprehension above found rows
+
+
+def test_loader_synthetic_wedge_and_unreadable(tmp_path):
+    # r07: the r05 shape (rc 124, parsed null, wedge tail)
+    (tmp_path / "BENCH_r07.json").write_text(json.dumps({
+        "n": 7, "rc": 124, "parsed": None,
+        "tail": "# attempt 1 failed (device init/probe exceeded 180s "
+                "(TPU tunnel unavailable/wedged))"}))
+    # r08: healthy
+    (tmp_path / "BENCH_r08.json").write_text(json.dumps({
+        "n": 8, "rc": 0, "tail": "",
+        "parsed": {"metric": "m", "value": 10.5, "unit": "GDoF/s",
+                   "vs_baseline": 2.6}}))
+    # r09: unreadable json
+    (tmp_path / "BENCH_r09.json").write_text("{truncated")
+    # r07 multichip: oom tail
+    (tmp_path / "MULTICHIP_r07.json").write_text(json.dumps({
+        "n_devices": 8, "rc": 1, "ok": False, "skipped": False,
+        "tail": "RESOURCE_EXHAUSTED: Out of memory"}))
+    trend = load_trend(str(tmp_path))
+    rows = {(r["round"], r["kind"]): r for r in trend["rows"]}
+    assert rows[(7, "bench")]["status"] == "gap"
+    assert rows[(7, "bench")]["failure_class"] == "tunnel_wedge"
+    assert rows[(8, "bench")]["status"] == "measured"
+    assert rows[(8, "bench")]["value"] == 10.5
+    assert rows[(9, "bench")]["status"] == "gap"
+    assert rows[(7, "multichip")]["failure_class"] == "oom"
+
+
+def test_loader_folds_harness_journal(tmp_path):
+    from bench_tpu_fem.harness.journal import Journal
+
+    j = Journal(str(tmp_path / "MEASURE_r07.jsonl"))
+    j.append({"event": "attempt_start", "stage": "ab12"})
+    j.append({"event": "attempt_end", "stage": "ab12", "outcome": "ok"})
+    j.append({"event": "attempt_start", "stage": "dfacc"})
+    j.append({"event": "attempt_end", "stage": "dfacc",
+              "outcome": "failed", "failure_class": "accuracy_fail"})
+    trend = load_trend(str(tmp_path))
+    row = [r for r in trend["rows"] if r["kind"] == "journal"][0]
+    assert row["stages_completed"] == 1
+    assert row["stages_failed"] == 1
+    assert row["failed_classes"] == ["accuracy_fail"]
+
+
+# --------------------------------------------------------------------------
+# Mann-Whitney + bootstrap: known answers.
+
+
+def test_mann_whitney_known_values():
+    # complete separation, tiny n: U of the smaller-ranked sample is 0
+    u, p = mann_whitney_u([1.0, 2.0], [3.0, 4.0])
+    assert u == 0.0
+    assert 0.0 < p < 1.0
+    # identical samples: no evidence (ties collapse the variance)
+    _, p_same = mann_whitney_u([5.0] * 6, [5.0] * 6)
+    assert p_same == 1.0
+    # symmetry: swapping the samples keeps the two-sided p
+    a = [1.0, 2.0, 3.0, 4.0, 5.0, 6.0, 7.0, 8.0]
+    b = [5.5, 6.5, 7.5, 8.5, 9.5, 10.5, 11.5, 12.5]
+    _, p_ab = mann_whitney_u(a, b)
+    _, p_ba = mann_whitney_u(b, a)
+    assert p_ab == pytest.approx(p_ba)
+    # a large clean shift IS significant
+    assert p_ab < 0.05
+    # hand-checked rank sum: a=[1,3], b=[2,4] -> ranks a={1,3}, R1=4,
+    # U1 = 4 - 3 = 1 (of a max 4)
+    u2, _ = mann_whitney_u([1.0, 3.0], [2.0, 4.0])
+    assert u2 == 1.0
+
+
+def test_bootstrap_ci_contains_median_and_is_deterministic():
+    rng = np.random.default_rng(3)
+    v = 1.0 + 0.1 * rng.standard_normal(20)
+    lo, hi = bootstrap_median_ci(v, seed=7)
+    assert lo <= float(np.median(v)) <= hi
+    assert (lo, hi) == bootstrap_median_ci(v, seed=7)  # same verdict
+    assert (lo, hi) != bootstrap_median_ci(v, seed=8)
+
+
+def test_classify_timing_known_answer_distributions():
+    rng = np.random.default_rng(0)
+    base = list(1.0 + 0.02 * rng.standard_normal(10))
+    assert classify_timing([x * 1.25 for x in base],
+                           base)["classification"] == "regressed"
+    assert classify_timing([x * 0.8 for x in base],
+                           base)["classification"] == "improved"
+    # same distribution, fresh noise: neutral
+    other = list(1.0 + 0.02 * rng.standard_normal(10))
+    assert classify_timing(other, base)["classification"] == "neutral"
+    # statistically real but tiny shift stays neutral (effect threshold)
+    tiny = [x * 1.01 for x in base]
+    assert classify_timing(tiny, base,
+                           effect_threshold=0.05)["classification"] \
+        == "neutral"
+    out = classify_timing([1.0, 1.1], base)
+    assert out["classification"] == "insufficient-data"
+    # rate mode: HIGHER is better — a drop regresses
+    assert classify_timing([x * 0.8 for x in base], base,
+                           lower_is_better=False)["classification"] \
+        == "regressed"
+
+
+# --------------------------------------------------------------------------
+# Deterministic-counter gates + record contract.
+
+
+def test_gate_counters_all_rule_classes():
+    base = {"collectives_per_iter": {"psum": 1, "ppermute": 2},
+            "compiles": 2, "cache_hit_rate_requests": 0.95,
+            "record_contract_ok": True}
+    # clean pass (equal or better)
+    cur_ok = {"collectives_per_iter": {"psum": 1, "ppermute": 1},
+              "compiles": 2, "cache_hit_rate_requests": 1.0,
+              "record_contract_ok": True}
+    assert gate_counters(cur_ok, base) == []
+    # every violation class fires, each naming its counter
+    cur_bad = {"collectives_per_iter": {"psum": 2, "ppermute": 2,
+                                        "all_gather": 1},
+               "compiles": 3, "cache_hit_rate_requests": 0.5,
+               "record_contract_ok": False}
+    v = gate_counters(cur_bad, base)
+    joined = "\n".join(v)
+    assert "collectives_per_iter[psum]" in joined
+    assert "all_gather" in joined  # new collective absent from baseline
+    assert "compiles: 3 > baseline 2" in joined
+    assert "cache_hit_rate_requests" in joined
+    assert "record_contract_ok" in joined
+    # counters the baseline never measured cannot gate
+    assert gate_counters({"compiles": 99}, {}) == []
+    # a current that LOST the collective counts while the baseline had
+    # them is itself a violation (the tracer went dark)
+    v2 = gate_counters({}, {"collectives_per_iter": {"psum": 1}})
+    assert any("measured none" in s for s in v2)
+
+
+def test_check_record_contract():
+    good = {"roofline": {"intensity_flop_per_byte": 1.2},
+            "phase_share": {"compile": 0.5, "transfer": 0.2,
+                            "solve": 0.3},
+            "timing": {"reps": 3, "walls_s": [0.1, 0.1, 0.1]},
+            "peak_memory_bytes": 1000,
+            "convergence": {"iters_to_rtol": {}, "time_to_rtol_s": {},
+                            "iters_run": 5, "evidence": "cpu-measured"}}
+    assert check_record_contract(good) == []
+    assert check_record_contract(good, require_convergence=True) == []
+    missing_walls = dict(good, timing={"reps": 3})
+    assert any("walls_s" in e for e in
+               check_record_contract(missing_walls))
+    no_conv = {k: v for k, v in good.items() if k != "convergence"}
+    assert check_record_contract(no_conv) == []
+    assert any("convergence" in e for e in
+               check_record_contract(no_conv, require_convergence=True))
+
+
+# --------------------------------------------------------------------------
+# SLO burn rates.
+
+
+def test_burn_rates_windows_and_alert():
+    now = 10_000.0
+    # 100 requests in the fast window: 3 violations (1 slow, 2 failed)
+    samples = [(now - i, 0.1, True) for i in range(97)]
+    samples += [(now - 1, 5.0, True), (now - 2, 0.1, False),
+                (now - 3, 0.2, False)]
+    slo = burn_rates(samples, objective_s=1.0, target=0.99, now=now)
+    assert slo["fast_requests"] == 100
+    assert slo["fast_violations"] == 3
+    assert slo["fast_burn_rate"] == pytest.approx(3.0)
+    assert slo["alert"] is True  # both windows burn > 1
+    # outside-the-window samples don't count
+    old = [(now - 7200, 99.0, False)] * 50
+    slo2 = burn_rates(samples + old, objective_s=1.0, target=0.99,
+                      now=now)
+    assert slo2["fast_violations"] == 3
+    assert slo2["slow_requests"] == 100  # 1h window excludes the old
+    # healthy traffic: no alert
+    slo3 = burn_rates([(now - i, 0.1, True) for i in range(50)],
+                      objective_s=1.0, now=now)
+    assert slo3["alert"] is False
+    assert slo3["fast_burn_rate"] == 0.0
+
+
+def test_fold_slo_from_journal_records():
+    recs = [{"event": "serve_response", "ts": 100.0 + i,
+             "latency_s": 0.2, "ok": True} for i in range(8)]
+    recs.append({"event": "serve_response", "ts": 109.0,
+                 "latency_s": 3.0, "ok": True})
+    recs.append({"event": "other", "ts": 110.0})
+    slo = fold_slo(recs, objective_s=1.0, target=0.9)
+    assert slo["samples"] == 9
+    assert slo["fast_violations"] == 1
+    assert slo["fast_burn_rate"] == pytest.approx((1 / 9) / 0.1,
+                                                  abs=1e-3)
+
+
+# --------------------------------------------------------------------------
+# Snapshot gating + CLI rc semantics.
+
+
+def _snapshot(**over):
+    snap = {
+        "bench": {
+            "roofline": {"intensity_flop_per_byte": 2.0},
+            "phase_share": {"compile": 0.6, "transfer": 0.1,
+                            "solve": 0.3},
+            "timing": {"reps": 5,
+                       "walls_s": [0.10, 0.11, 0.10, 0.12, 0.11]},
+            "peak_memory_bytes": 10_000,
+            "convergence": {"iters_to_rtol": {"1e-02": 3},
+                            "time_to_rtol_s": {"1e-02": 0.01},
+                            "iters_run": 20,
+                            "evidence": "cpu-measured"},
+        },
+        "dist": {"timing": {"reps": 5,
+                            "walls_s": [0.2, 0.21, 0.2, 0.22, 0.2]}},
+        "counters": {"collectives_per_iter": {"psum": 2},
+                     "compiles": 1, "recompiles": 0,
+                     "cache_hit_rate_requests": 1.0, "shed_total": 0,
+                     "responses_failed": 0, "corrupt_lines": 0,
+                     "record_contract_ok": True, "trace_valid": True},
+    }
+    snap.update(over)
+    return snap
+
+
+def test_gate_snapshots_green_and_red():
+    base = _snapshot()
+    assert gate_snapshots(_snapshot(), base)["ok"] is True
+    bad = _snapshot()
+    bad["counters"] = dict(bad["counters"], compiles=4)
+    verdict = gate_snapshots(bad, base)
+    assert verdict["ok"] is False
+    assert any("compiles" in v for v in verdict["violations"])
+    # timing classification rides along as advisory
+    assert verdict["timing"]["bench"]["classification"] in (
+        "neutral", "improved", "regressed")
+
+
+def test_gate_cli_rc_semantics(tmp_path, capsys):
+    cur = tmp_path / "cur.json"
+    base = tmp_path / "base.json"
+    base.write_text(json.dumps(_snapshot()))
+    cur.write_text(json.dumps(_snapshot()))
+    assert gate_main(["--current", str(cur), "--baseline",
+                      str(base)]) == 0
+    bad = _snapshot()
+    bad["counters"] = dict(bad["counters"],
+                           collectives_per_iter={"psum": 3})
+    cur.write_text(json.dumps(bad))
+    assert gate_main(["--current", str(cur), "--baseline",
+                      str(base)]) == 1
+    out = capsys.readouterr().out
+    assert "REGRESSED" in out and "psum" in out
+
+
+def test_trend_cli_renders_committed_rounds(capsys):
+    assert trend_main(["--root", ROOT]) == 0
+    out = capsys.readouterr().out
+    assert "GAP" in out and "[tunnel_wedge]" in out
+    assert "2.7888" in out  # r01 flagship
+    assert "labelled" in out
+
+
+def test_trend_cli_json_and_journal(tmp_path, capsys):
+    from bench_tpu_fem.harness.journal import Journal
+
+    jp = tmp_path / "run.jsonl"
+    j = Journal(str(jp))
+    j.append({"event": "bench_record", "gdof_per_second": 1.0,
+              "convergence": {"iters_run": 10, "final_rel_residual": 1e-3,
+                              "stagnation_max_run": 0, "restarts": 0,
+                              "evidence": "cpu-measured",
+                              "curve": [[0, 1.0], [10, 1e-3]],
+                              "iters_to_rtol": {"1e-02": 5},
+                              "time_to_rtol_s": {"1e-02": 0.5}}})
+    j.append({"event": "serve_response", "latency_s": 0.2, "ok": True})
+    assert trend_main(["--root", str(tmp_path), "--journal", str(jp),
+                       "--slo-objective", "1.0"]) == 0
+    out = capsys.readouterr().out
+    assert "convergence" in out and "serve SLO" in out
+    assert trend_main(["--root", str(tmp_path), "--journal", str(jp),
+                       "--json"]) == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["slo"]["samples"] == 1
+    assert payload["convergence_records"][0]["convergence"][
+        "iters_run"] == 10
+
+
+# --------------------------------------------------------------------------
+# Live serve SLO parity: snapshot vs journal fold (one burn_rates fold).
+
+
+def test_metrics_slo_snapshot_and_journal_parity(tmp_path):
+    from bench_tpu_fem.harness.journal import read_records
+    from bench_tpu_fem.serve.metrics import Metrics, prometheus_text
+
+    jp = str(tmp_path / "serve.jsonl")
+    m = Metrics(jp, slo_objective_s=0.5, slo_target=0.9)
+    for lat, ok in ((0.1, True), (0.2, True), (0.8, True), (0.3, False)):
+        m.response(f"r{lat}", ok, lat)
+    snap = m.snapshot()
+    assert snap["slo"]["fast_violations"] == 2
+    assert snap["slo"]["objective_s"] == 0.5
+    records, corrupt = read_records(jp)
+    assert not corrupt
+    offline = fold_slo(records, objective_s=0.5, target=0.9)
+    # the live snapshot and the journal replay run the SAME fold
+    assert offline["fast_violations"] == snap["slo"]["fast_violations"]
+    assert offline["samples"] == snap["slo"]["samples"]
+    prom = prometheus_text(snap)
+    assert "benchfem_serve_slo_fast_burn_rate" in prom
+    assert "benchfem_serve_slo_alert" in prom
+    # default Metrics: no slo key, snapshot unchanged
+    assert "slo" not in Metrics().snapshot()

@@ -235,6 +235,8 @@ def test_engine_continuous_admit_retire_roundtrip(solver_f32):
     np.testing.assert_allclose(xn1, 2.0 * base, rtol=1e-7)
 
 
+@pytest.mark.slow  # round-10 fast-lane rebalance: 18 s; still runs in
+# the serve CI lane (its marker filter selects on `serve` alone)
 def test_engine_matches_one_shot_df32():
     """df32 serving parity (<= 1e-13): the vmapped lane equals the
     scalar cg_solve_df result. df32 continuous batching is
