@@ -138,6 +138,26 @@ class BenchConfig:
     # changes).
     convergence: bool = field(default_factory=lambda: bool(int(
         os.environ.get("BENCH_CONVERGENCE", "0") or 0)))
+    # Preconditioned CG (ISSUE 11): "none" (the default — bitwise the
+    # pre-PR solve) | "jacobi" (matrix-free diagonal) | "chebyshev"
+    # (fixed polynomial in D^-1 A, power-method interval) | "pmg"
+    # (p-multigrid V-cycle across the degree family). PCG runs the
+    # unfused <r, z> loop; fused whole-solve engines gate off with the
+    # reason recorded (la.precond.PRECOND_GATE_REASONS), and paths
+    # without a wired preconditioner (folded layout, action runs,
+    # checkpointed loops) record theirs. Every preconditioned record
+    # stamps the `precond` evidence block (kind, setup wall, setup
+    # applies, per-iteration apply cost). Env default: BENCH_PRECOND.
+    precond: str = field(default_factory=lambda: (
+        os.environ.get("BENCH_PRECOND", "none") or "none"))
+    # s-step (communication-avoiding) CG (ISSUE 11): batch the
+    # reductions of `s_step` iterations into ONE stacked reduction
+    # (la.sstep — sharded: one psum per outer step, i.e. < 1 collective
+    # per iteration). 1 (the default) is the standard recurrence; on a
+    # breakdown the drivers re-run the standard loop and record
+    # `s_step_fallback_reason`. Env default: BENCH_S_STEP.
+    s_step: int = field(default_factory=lambda: int(
+        os.environ.get("BENCH_S_STEP", "1") or 1))
 
 
 @dataclass
@@ -262,6 +282,98 @@ CONVERGENCE_GATE_REASON = (
     "convergence capture (convergence=True): the fused whole-solve "
     "engine exposes no per-iteration residual to buffer; running the "
     "unfused capture-able loop (la.cg capture=True)")
+
+
+def stamp_precond(extra: dict, cfg: BenchConfig, bundle=None,
+                  gate_reason: str | None = None) -> None:
+    """The ISSUE-11 precond/s-step evidence stamps, written by every
+    driver branch that saw a `--precond`/`--s-step` request: the
+    `precond` block records what RAN (kind "none" + the gate reason
+    when the request could not be served — never silent), `s_step` the
+    requested batching factor (its own gate/fallback reasons ride
+    separate keys). Also folds the per-iteration precond cost into the
+    roofline stamp when one exists (obs.roofline.precond_cost)."""
+    if cfg.precond != "none" or gate_reason is not None:
+        block = {"requested": cfg.precond,
+                 "kind": bundle.kind if bundle is not None else "none"}
+        if bundle is not None:
+            block.update(bundle.stamp())
+        if gate_reason:
+            block["gate_reason"] = gate_reason
+            extra["precond_gate_reason"] = gate_reason
+        extra["precond"] = block
+    if cfg.s_step > 1:
+        extra["s_step"] = int(cfg.s_step)
+
+
+def resolve_precond_bundle(cfg: BenchConfig, op, u, mesh=None):
+    """Build the requested preconditioner for a single-chip grid-layout
+    operator, or return the recorded gate reason: ``(bundle | None,
+    gate_reason | None)``. Setup cost (diagonal assembly wall,
+    power-method applies, pmg level builds) is measured into the bundle
+    and stamped — a PCG record always answers what its setup cost."""
+    import time as _time
+
+    from ..la.precond import (
+        PRECOND_GATE_REASONS,
+        build_chebyshev_bundle,
+        build_jacobi_bundle,
+        op_jacobi_dinv,
+    )
+
+    kind = cfg.precond
+    if kind not in ("jacobi", "chebyshev", "pmg"):
+        raise ValueError(f"unknown precond {kind!r}: expected none | "
+                         "jacobi | chebyshev | pmg")
+    if kind == "pmg":
+        if mesh is None or cfg.use_gauss:
+            return None, (
+                "p-multigrid needs the GLL node family (endpoint nodes "
+                "carry the Dirichlet transfer) and a grid-layout "
+                "operator; precond disabled for this run")
+        if cfg.degree < 2:
+            return None, ("p-multigrid needs degree >= 2 (no coarser "
+                          "level below degree 1); precond disabled")
+        from ..la.pmg import build_pmg_bundle
+
+        backend = "kron" if hasattr(op, "Kd") else "xla"
+        return build_pmg_bundle(mesh, cfg.degree, cfg.qmode, 2.0,
+                                u.dtype, backend), None
+    t0 = _time.monotonic()
+    dinv = op_jacobi_dinv(op)
+    if dinv is None:
+        return None, PRECOND_GATE_REASONS["folded"]
+    import jax
+
+    jax.block_until_ready(dinv)
+    diag_s = _time.monotonic() - t0
+    if kind == "jacobi":
+        return build_jacobi_bundle(dinv, setup_s=diag_s), None
+    return build_chebyshev_bundle(op.apply, dinv, dinv.shape, u.dtype,
+                                  setup_s_diag=diag_s), None
+
+
+def precond_compile_form(bundle, apply_fn):
+    """How a bundle enters the solver COMPILE: ``(pargs, factory)``
+    with `factory(A, *pargs) -> precond callable`. Jacobi/Chebyshev
+    pass their O(N) diagonal as an executable ARGUMENT (the driver's
+    no-HLO-constants rule); the pmg V-cycle closes over its level
+    hierarchy (coarse-level state is a small fraction of the fine
+    problem, and pmg is CPU-proof scale today — the hardware-sized
+    plumbing is a recorded remainder)."""
+    from ..la.precond import make_chebyshev
+
+    if bundle.kind == "jacobi":
+        return ((bundle.state["dinv"],),
+                lambda A, d: (lambda rr: d * rr))
+    if bundle.kind == "chebyshev":
+        lmax = bundle.params["lmax"]
+        lmin = bundle.params["lmin"]
+        steps = bundle.params["steps"]
+        return ((bundle.state["dinv"],),
+                lambda A, d: make_chebyshev(apply_fn(A), d, lmax, lmin,
+                                            steps))
+    return (), lambda A: bundle.apply
 
 
 def _fence_scalar(out) -> None:
@@ -740,6 +852,16 @@ def _run_benchmark_folded_df(cfg: BenchConfig) -> BenchmarkResults:
         res.extra["convergence_gate_reason"] = (
             "folded-df pipeline has no capture-able loop form; "
             "convergence capture disabled for this run")
+    if cfg.precond != "none":
+        from ..la.precond import PRECOND_GATE_REASONS
+
+        stamp_precond(res.extra, cfg,
+                      gate_reason=PRECOND_GATE_REASONS["folded"])
+    if cfg.s_step > 1:
+        res.extra["s_step"] = int(cfg.s_step)
+        res.extra["s_step_gate_reason"] = (
+            "folded-df pipeline has no s-step form; running the "
+            "standard recurrence")
 
     # Host-assembled f64 RHS (the reference assembles its RHS on the CPU
     # too), split into df channels and folded per channel. The oracle
@@ -957,6 +1079,51 @@ def _run_benchmark_df64(cfg: BenchConfig) -> BenchmarkResults:
         if conv and engine:
             engine = False
             res.extra["convergence_gate_reason"] = CONVERGENCE_GATE_REASON
+        # Preconditioning (ISSUE 11) on the df path: Jacobi only — the
+        # f32 inverse diagonal scales both df channels (la.precond.
+        # make_jacobi_df; a preconditioner's own rounding reshapes M,
+        # never the answer). Apply-based preconditioners and s-step
+        # have no df forms (recorded remainders).
+        pre_df = None
+        if cfg.s_step > 1:
+            res.extra["s_step"] = int(cfg.s_step)
+            res.extra["s_step_gate_reason"] = (
+                "s-step has no df (double-float) form; running the "
+                "standard df recurrence")
+        if cfg.precond != "none":
+            from ..la.precond import (
+                PRECOND_GATE_REASONS,
+                build_jacobi_bundle,
+                jacobi_dinv_uniform,
+                make_jacobi_df,
+            )
+
+            gate = None
+            bundle = None
+            if not cfg.use_cg:
+                gate = PRECOND_GATE_REASONS["action"]
+            elif ckpt:
+                gate = PRECOND_GATE_REASONS["checkpoint"]
+            elif cfg.precond != "jacobi":
+                gate = ("df (double-float) paths support jacobi "
+                        f"preconditioning only ({cfg.precond} has no df "
+                        "form); precond disabled for this run")
+            else:
+                import time as _time
+
+                import jax.numpy as _jnp
+
+                t0 = _time.monotonic()
+                dinv32 = jacobi_dinv_uniform(t, n, 2.0, _jnp.float32)
+                jax.block_until_ready(dinv32)
+                bundle = build_jacobi_bundle(
+                    dinv32, setup_s=_time.monotonic() - t0)
+                pre_df = make_jacobi_df(dinv32)
+                if engine:
+                    engine = False
+                    res.extra["precond_gate_reason"] = (
+                        PRECOND_GATE_REASONS["engine"])
+            stamp_precond(res.extra, cfg, bundle=bundle, gate_reason=gate)
         compile_opts = scoped_vmem_options(kib) if engine else None
         record_engine(res.extra, engine, ENGINE_FORM_NAMES.get(form, form))
 
@@ -972,8 +1139,12 @@ def _run_benchmark_df64(cfg: BenchConfig) -> BenchmarkResults:
 
         def _unfused():
             if cfg.use_cg:
+                # pre_df (a small dinv closure) rides the lowered
+                # computation as a constant: df runs are CPU-proof
+                # scale today (the hardware precond stage runs f32)
                 return lambda A, b: cg_solve_df(A, b, cfg.nreps,
-                                                capture=conv)
+                                                capture=conv,
+                                                precond=pre_df)
             return lambda A, b: action_df(A, b, cfg.nreps)
 
         run_ck = ck_store = None
@@ -1146,10 +1317,63 @@ def _finish_batched(cfg: BenchConfig, res: BenchmarkResults, n, op, u,
         planned_form = "unfused"
         res.extra["convergence_gate_reason"] = CONVERGENCE_GATE_REASON
 
+    # Preconditioning (ISSUE 11) on the batched paths: Jacobi only (an
+    # elementwise diagonal broadcasts across lanes for free; the
+    # apply-based preconditioners have no batched cost model yet —
+    # recorded remainder). s-step has no batched form (recorded).
+    pdinv = None
+    if cfg.s_step > 1:
+        from ..la.sstep import SSTEP_GATE_REASON
+
+        res.extra["s_step_gate_reason"] = SSTEP_GATE_REASON
+        res.extra["s_step"] = int(cfg.s_step)
+    if cfg.precond != "none" and cfg.use_cg:
+        from ..la.precond import build_jacobi_bundle, op_jacobi_dinv
+
+        gate = None
+        bundle = None
+        if cfg.precond != "jacobi":
+            gate = (f"batched (nrhs>1) paths support jacobi "
+                    f"preconditioning only ({cfg.precond} has no "
+                    "batched cost model); precond disabled")
+        else:
+            import time as _time
+
+            t0 = _time.monotonic()
+            pdinv = op_jacobi_dinv(op)
+            if pdinv is None:
+                from ..la.precond import PRECOND_GATE_REASONS
+
+                gate = PRECOND_GATE_REASONS["folded"]
+            else:
+                jax.block_until_ready(pdinv)
+                bundle = build_jacobi_bundle(
+                    pdinv, setup_s=_time.monotonic() - t0)
+                if engine:
+                    from ..la.precond import PRECOND_GATE_REASONS
+
+                    engine = False
+                    engine_run = None
+                    planned_form = "unfused"
+                    res.extra["precond_gate_reason"] = (
+                        PRECOND_GATE_REASONS["engine"])
+        stamp_precond(res.extra, cfg, bundle=bundle, gate_reason=gate)
+    elif cfg.precond != "none":
+        from ..la.precond import PRECOND_GATE_REASONS
+
+        stamp_precond(res.extra, cfg,
+                      gate_reason=PRECOND_GATE_REASONS["action"])
+
     if not engine:
         record_engine(res.extra, False, error=BATCHED_UNFUSED_REASON)
 
-    if cfg.use_cg:
+    if cfg.use_cg and pdinv is not None:
+        def run(A, Bv, d):
+            return cg_solve_batched(apply_one(A), Bv,
+                                    jnp.zeros_like(Bv), cfg.nreps,
+                                    capture=conv,
+                                    precond=lambda R: d[None] * R)
+    elif cfg.use_cg:
         def run(A, Bv):
             return cg_solve_batched(apply_one(A), Bv,
                                     jnp.zeros_like(Bv), cfg.nreps,
@@ -1167,9 +1391,11 @@ def _finish_batched(cfg: BenchConfig, res: BenchmarkResults, n, op, u,
     # Mosaic-reject fallback executable is stored under the planned key
     # with its true routing stamps replayed from the entry meta).
     obs = BenchObserver(cfg)
-    key = _exec_cache_key(cfg, n, planned_form,
-                          ("cg+conv" if conv else "cg") if cfg.use_cg
-                          else "action")
+    batch_extra = (pdinv,) if pdinv is not None else ()
+    batch_kind = ("cg+conv" if conv else "cg") if cfg.use_cg else "action"
+    if pdinv is not None:
+        batch_kind += "+jacobi"
+    key = _exec_cache_key(cfg, n, planned_form, batch_kind)
     fn = _exec_cache_get(cfg, key, res)
     from_cache = fn is not None
     with obs.phase("compile"):
@@ -1184,15 +1410,16 @@ def _finish_batched(cfg: BenchConfig, res: BenchmarkResults, n, op, u,
             except Exception as exc:
                 record_engine(res.extra, False, error=exc)
         if fn is None:
-            fn = compile_lowered(jax.jit(run).lower(op, B), compile_opts)
+            fn = compile_lowered(
+                jax.jit(run).lower(op, B, *batch_extra), compile_opts)
     if not from_cache:
         _exec_cache_put(cfg, key, fn, res)
     with obs.phase("transfer"):
-        warm = fn(op, B)
+        warm = fn(op, B, *batch_extra)
         _fence_scalar(warm)
         del warm
 
-    Y = obs.timed_reps(lambda: fn(op, B))
+    Y = obs.timed_reps(lambda: fn(op, B, *batch_extra))
     elapsed = obs.elapsed()
     conv_info = None
     if conv:
@@ -1246,6 +1473,15 @@ def _finish_batched_df(cfg: BenchConfig, res: BenchmarkResults, n, op, u,
         res.extra["convergence_gate_reason"] = (
             "batched df32 (vmapped whole-solve) has no wired capture "
             "form; convergence capture disabled for this run")
+    if cfg.precond != "none":
+        stamp_precond(res.extra, cfg, gate_reason=(
+            "batched df32 (vmapped whole-solve) has no wired "
+            "preconditioner; precond disabled for this run"))
+    if cfg.s_step > 1:
+        res.extra["s_step"] = int(cfg.s_step)
+        res.extra["s_step_gate_reason"] = (
+            "batched df32 has no s-step form; running the standard "
+            "recurrence")
     scales = jnp.asarray(batch_scales(cfg.nrhs), jnp.float32)
     sb = scales.reshape((-1,) + (1,) * u.hi.ndim)
     B = DF(sb * u.hi[None], sb * u.lo[None])
@@ -1515,6 +1751,61 @@ def _run_benchmark(cfg: BenchConfig) -> BenchmarkResults:
             apply_fn = unfused_apply
             res.extra["convergence_gate_reason"] = CONVERGENCE_GATE_REASON
             record_engine(res.extra, False)
+        # Preconditioning + s-step routing (ISSUE 11). Resolution order:
+        # action runs and checkpointed loops gate both features with
+        # recorded reasons; precond wins over s-step when both are
+        # requested (no communication-avoiding PCG form exists — the
+        # combination is a recorded remainder); either feature routes a
+        # fused engine to the unfused loop, checkpoint-gate style.
+        pbundle = None
+        sstep_on = False
+        if cfg.precond != "none" or cfg.s_step > 1:
+            from ..la.precond import PRECOND_GATE_REASONS
+            from ..la.sstep import SSTEP_GATE_REASON
+
+            if not cfg.use_cg:
+                stamp_precond(res.extra, cfg,
+                              gate_reason=(PRECOND_GATE_REASONS["action"]
+                                           if cfg.precond != "none"
+                                           else None))
+                if cfg.s_step > 1:
+                    res.extra["s_step_gate_reason"] = (
+                        "s-step applies to CG solves only; running the "
+                        "standard action loop")
+            elif ckpt:
+                stamp_precond(
+                    res.extra, cfg,
+                    gate_reason=(PRECOND_GATE_REASONS["checkpoint"]
+                                 if cfg.precond != "none" else None))
+                if cfg.s_step > 1:
+                    res.extra["s_step_gate_reason"] = (
+                        "s-step is not wired through the checkpointable "
+                        "chunked loop; running the standard recurrence")
+            else:
+                gate = None
+                if cfg.precond != "none":
+                    pbundle, gate = resolve_precond_bundle(cfg, op, u,
+                                                           mesh=mesh)
+                sstep_on = cfg.s_step > 1 and pbundle is None
+                if cfg.s_step > 1 and pbundle is not None:
+                    res.extra["s_step_gate_reason"] = (
+                        "s-step with preconditioning has no "
+                        "communication-avoiding PCG form; running the "
+                        "preconditioned recurrence")
+                stamp_precond(res.extra, cfg, bundle=pbundle,
+                              gate_reason=gate)
+                if (pbundle is not None or sstep_on) and engine:
+                    engine = False
+                    apply_fn = unfused_apply
+                    record_engine(res.extra, False)
+                    res.extra.setdefault(
+                        "precond_gate_reason" if pbundle is not None
+                        else "s_step_gate_reason",
+                        PRECOND_GATE_REASONS["engine"] if pbundle
+                        is not None else
+                        "s-step rides the unfused loop; the fused "
+                        "whole-solve engine bakes the standard "
+                        "recurrence")
         # Executable-cache key: the PLANNED engine form (what the plan
         # functions deterministically pick for this config), so a repeat
         # of the same config finds the executable its first compile
@@ -1523,9 +1814,22 @@ def _run_benchmark(cfg: BenchConfig) -> BenchmarkResults:
         # stamps replay from the entry's meta). A capture-mode solve
         # lowers a DIFFERENT output signature (x, info) — its key must
         # never collide with the plain solve's.
+        cg_extra = ()
+        pfactory = None
+        if pbundle is not None:
+            # computed HERE (after all engine gating) so the chebyshev
+            # factory closes over the apply that actually runs, and so
+            # an exec-cache HIT still has its dinv argument list
+            cg_extra, pfactory = precond_compile_form(pbundle, apply_fn)
+        cg_kind = ("cg+conv" if conv else "cg") if cfg.use_cg else "action"
+        if pbundle is not None:
+            # a preconditioned executable's signature (extra dinv args,
+            # different recurrence) must never collide with the bare one
+            cg_kind += f"+{pbundle.kind}"
+        if sstep_on:
+            cg_kind += f"+s{cfg.s_step}"
         exec_key = _exec_cache_key(
-            cfg, n, res.extra.get("cg_engine_form", "unfused"),
-            ("cg+conv" if conv else "cg") if cfg.use_cg else "action")
+            cfg, n, res.extra.get("cg_engine_form", "unfused"), cg_kind)
         obs = BenchObserver(cfg)
         run_ck = ck_store = ck_saves = None
         ck_restored = 0
@@ -1582,14 +1886,31 @@ def _run_benchmark(cfg: BenchConfig) -> BenchmarkResults:
                         apply_fn = unfused_apply
             if fn is None:
                 with obs.phase("compile"):
-                    fn = compile_lowered(jax.jit(
-                        lambda A, b, x0: cg_solve(apply_fn(A), b, x0,
-                                                  cfg.nreps, capture=conv)
-                    ).lower(op, u, jnp.zeros_like(u)), fallback_opts)
+                    if sstep_on:
+                        from ..la.sstep import sstep_cg_solve
+
+                        fn = compile_lowered(jax.jit(
+                            lambda A, b, x0: sstep_cg_solve(
+                                apply_fn(A), b, x0, cfg.nreps,
+                                cfg.s_step, capture=conv)
+                        ).lower(op, u, jnp.zeros_like(u)), fallback_opts)
+                    elif pbundle is not None:
+                        fn = compile_lowered(jax.jit(
+                            lambda A, b, x0, *ps: cg_solve(
+                                apply_fn(A), b, x0, cfg.nreps,
+                                capture=conv, precond=pfactory(A, *ps))
+                        ).lower(op, u, jnp.zeros_like(u), *cg_extra),
+                            fallback_opts)
+                    else:
+                        fn = compile_lowered(jax.jit(
+                            lambda A, b, x0: cg_solve(
+                                apply_fn(A), b, x0, cfg.nreps,
+                                capture=conv)
+                        ).lower(op, u, jnp.zeros_like(u)), fallback_opts)
             if not from_cache:
                 _exec_cache_put(cfg, exec_key, fn, res)
             with obs.phase("transfer"):
-                warm = fn(op, u, jnp.zeros_like(u))
+                warm = fn(op, u, jnp.zeros_like(u), *cg_extra)
         else:
             # All nreps applies in one jitted fori_loop: same semantics as
             # the reference's per-rep launches (y = A u each rep, same input,
@@ -1657,11 +1978,36 @@ def _run_benchmark(cfg: BenchConfig) -> BenchmarkResults:
     if run_ck is not None:
         y = obs.timed_reps(run_ck)
     else:
-        y = obs.timed_reps(lambda: fn(op, u, jnp.zeros_like(u))
+        y = obs.timed_reps(lambda: fn(op, u, jnp.zeros_like(u), *cg_extra)
                            if cfg.use_cg else fn(op, u))
     elapsed = obs.elapsed()
     conv_info = None
-    if conv:
+    if sstep_on:
+        # s-step solves always return (x, info); a breakdown (monomial
+        # Gram projection went non-SPD) falls back GRACEFULLY to the
+        # standard recurrence with the reason recorded — never a silent
+        # half-converged answer
+        y, ss_info = y
+        if bool(np.asarray(ss_info["breakdown"])):
+            from ..la.sstep import SSTEP_FALLBACK_REASON
+
+            res.extra["s_step_fallback_reason"] = SSTEP_FALLBACK_REASON
+            with obs.phase("compile"):
+                fn = compile_lowered(jax.jit(
+                    lambda A, b, x0: cg_solve(apply_fn(A), b, x0,
+                                              cfg.nreps, capture=conv)
+                ).lower(op, u, jnp.zeros_like(u)), fallback_opts)
+            with obs.phase("transfer"):
+                warm = fn(op, u, jnp.zeros_like(u))
+                _fence_scalar(warm)
+                del warm
+            y = obs.timed_reps(lambda: fn(op, u, jnp.zeros_like(u)))
+            elapsed = obs.elapsed()
+            if conv:
+                y, conv_info = y
+        elif conv:
+            conv_info = ss_info
+    elif conv:
         # convergence-captured solve: (x, info) — the history is
         # fetched HERE, once, outside the timed region (conv implies
         # the unfused capture loop compiled above; ckpt forces conv off)

@@ -81,4 +81,10 @@ def results_json(cfg: BenchConfig, res: BenchmarkResults) -> str:
                 "convergence_gate_reason", "convergence_error"):
         if key in res.extra:
             root["output"][key] = res.extra[key]
+    # preconditioning + s-step stamps (ISSUE 11): what ran, what it
+    # cost to build, and why a request was gated or fell back
+    for key in ("precond", "precond_gate_reason", "s_step",
+                "s_step_gate_reason", "s_step_fallback_reason"):
+        if key in res.extra:
+            root["output"][key] = res.extra[key]
     return json.dumps(root)

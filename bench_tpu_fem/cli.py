@@ -71,6 +71,24 @@ def build_parser() -> argparse.ArgumentParser:
                         "where supported; unsupported configs record the "
                         "gate reason and run synchronously. Single-chip "
                         "runs ignore this.")
+    p.add_argument("--precond", default=None,
+                   choices=["none", "jacobi", "chebyshev", "pmg"],
+                   help="CG preconditioner (ISSUE 11): matrix-free "
+                        "jacobi diagonal, chebyshev polynomial in "
+                        "D^-1 A, or a p-multigrid V-cycle across the "
+                        "degree family. 'none' (default) is bitwise "
+                        "the unpreconditioned solve; unsupported paths "
+                        "record precond_gate_reason. Every "
+                        "preconditioned record stamps setup cost + "
+                        "per-iteration applies; time_to_rtol_s "
+                        "adjudicates (run with --convergence).")
+    p.add_argument("--s-step", type=int, default=None, dest="s_step",
+                   help="s-step (communication-avoiding) CG: batch the "
+                        "reductions of N iterations into one stacked "
+                        "reduction (sharded: ONE psum per N "
+                        "iterations). 1 = standard recurrence; "
+                        "breakdown falls back with "
+                        "s_step_fallback_reason recorded.")
     p.add_argument("--log-level", default="info")
     p.add_argument("--profile", default="",
                    help="Write a jax.profiler trace of the timed region to "
@@ -234,6 +252,10 @@ def main(argv: list[str] | None = None) -> int:
         # None = fall back to the BENCH_CONVERGENCE env default
         **({} if args.convergence is None
            else {"convergence": True}),
+        # None = fall back to the BENCH_PRECOND / BENCH_S_STEP env
+        # defaults (harness stages opt in without payload changes)
+        **({} if args.precond is None else {"precond": args.precond}),
+        **({} if args.s_step is None else {"s_step": max(args.s_step, 1)}),
     )
 
     obs_journal = None

@@ -141,15 +141,16 @@ def make_sharded_fns(op, dgrid, nreps: int, capture: bool = False):
 
 def make_sharded_batched_cg(op, dgrid, nreps: int):
     """Batched multi-RHS sharded CG for the general-geometry (xla)
-    operator: vmapped local apply + owned-dof-masked psum'd batched dot
-    (see dist.kron.make_kron_batched_cg_fn for the kron twin and the
-    design note)."""
+    operator: vmapped local apply + the fused owned-dof dot trio — ONE
+    stacked (3, nrhs) psum per iteration (see
+    dist.kron.make_kron_batched_cg_fn for the kron twin and the
+    PR 7/PR 10 batched-remainder note)."""
     import jax
     import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
 
     from ..la.cg import cg_solve_batched
-    from .halo import owned_batched_dot
+    from .halo import owned_batched_dot, owned_batched_dot3
 
     bspec = P(None, *AXIS_NAMES)
     spec = P(*AXIS_NAMES)
@@ -164,10 +165,116 @@ def make_sharded_batched_cg(op, dgrid, nreps: int):
         X = cg_solve_batched(
             lambda v: op.apply_local(v, Gl, bcl), Bl,
             jnp.zeros_like(Bl), nreps, dot=owned_batched_dot(mask),
+            dot3=owned_batched_dot3(mask),
         )
         return X[:, None, None, None]
 
     return cg_fn
+
+
+def make_sharded_dinv_fn(op, dgrid):
+    """Sharded matrix-free Jacobi inverse diagonal for the
+    general-geometry (xla) operator: one shard_map pass — local
+    basis-squared contraction + fold, seams completed by the ghost-plane
+    collectives (la.precond.jacobi_dinv_dist_local). Returns a callable
+    of (G, bc) producing the (Dx,Dy,Dz,Lx,Ly,Lz) dinv blocks, sharded
+    exactly like the solve vectors."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from ..la.precond import jacobi_dinv_dist_local
+
+    spec = P(*AXIS_NAMES)
+
+    @partial(jax.shard_map, mesh=dgrid.mesh, in_specs=(spec, spec),
+             out_specs=spec, check_vma=False)
+    def dinv_fn(G, bc):
+        Gl, bcl = G[0, 0, 0], bc[0, 0, 0]
+        d = jacobi_dinv_dist_local(Gl, op.phi0, op.dphi1, bcl, op.kappa,
+                                   op.n_local, op.degree)
+        return d[None, None, None]
+
+    return dinv_fn
+
+
+def make_sharded_pcg_fn(op, dgrid, nreps: int, kind: str,
+                        cheb: tuple | None = None, capture: bool = False):
+    """Sharded preconditioned CG for the general-geometry (xla)
+    operator — dist.kron.make_kron_pcg_fn's twin: the <r, z> recurrence
+    with the owned-dof <p, A p> psum and ONE stacked psum for the
+    (<r, z>, <r, r>) pair (two psums per iteration, the synchronous
+    count). `dinv` rides as a sharded argument (make_sharded_dinv_fn's
+    output)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from ..la.cg import cg_solve
+    from ..la.precond import make_chebyshev
+    from .halo import owned_pair_dot
+
+    spec = P(*AXIS_NAMES)
+    rep = P()
+
+    @partial(jax.shard_map, mesh=dgrid.mesh,
+             in_specs=(spec, spec, spec, spec),
+             out_specs=(spec, rep) if capture else spec, check_vma=False)
+    def pcg_fn(b, G, bc, dinv):
+        bl, Gl, bcl, dl = (b[0, 0, 0], G[0, 0, 0], bc[0, 0, 0],
+                           dinv[0, 0, 0])
+        apply_l = lambda v: op.apply_local(v, Gl, bcl)  # noqa: E731
+        mask = owned_mask(bl.shape).astype(bl.dtype)
+        if kind == "chebyshev":
+            lmax, lmin, steps = cheb
+            precond = make_chebyshev(apply_l, dl, lmax, lmin, steps)
+        else:
+            precond = lambda rr: dl * rr  # noqa: E731
+        out = cg_solve(
+            apply_l, bl, jnp.zeros_like(bl), nreps,
+            dot=owned_dot(owned_mask(bl.shape).astype(bl.dtype)),
+            precond=precond, dotpair=owned_pair_dot(mask),
+            capture=capture,
+        )
+        if capture:
+            x, info = out
+            return x[None, None, None], info["rnorm_history"]
+        return out[None, None, None]
+
+    return pcg_fn
+
+
+def make_sharded_sstep_cg(op, dgrid, nreps: int, s: int,
+                          capture: bool = False):
+    """Sharded s-step CG for the general-geometry (xla) operator —
+    dist.kron.make_kron_sstep_cg_fn's twin: ONE stacked Gram psum per s
+    iterations (the below-one-reduction contract); `(x, info)` with the
+    replicated breakdown flag for the driver's recorded fallback."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from ..la.sstep import sstep_cg_solve
+    from .halo import owned_gram
+
+    spec = P(*AXIS_NAMES)
+    rep = P()
+    info_spec = {"breakdown": rep, "iters": rep}
+    if capture:
+        info_spec = dict(info_spec, rnorm_history=rep)
+
+    @partial(jax.shard_map, mesh=dgrid.mesh, in_specs=(spec, spec, spec),
+             out_specs=(spec, info_spec), check_vma=False)
+    def sstep_fn(b, G, bc):
+        bl, Gl, bcl = b[0, 0, 0], G[0, 0, 0], bc[0, 0, 0]
+        mask = owned_mask(bl.shape).astype(bl.dtype)
+        x, info = sstep_cg_solve(
+            lambda v: op.apply_local(v, Gl, bcl), bl,
+            jnp.zeros_like(bl), nreps, s,
+            gram=owned_gram(mask), dot=owned_dot(mask), capture=capture,
+        )
+        return x[None, None, None], info
+
+    return sstep_fn
 
 
 def batch_sharded_rhs(u, nrhs: int, dgrid):
@@ -341,6 +448,8 @@ def run_distributed(cfg, res, dtype):
     base_form = None
     # convergence capture routing (ISSUE 10), resolved in the CG branch
     conv_on = False
+    # s-step routing (ISSUE 11), resolved in the CG branch
+    sstep_dist = False
     res.ncells_global = global_ncells(n)
     res.ndofs_global = global_ndofs(n, cfg.degree)
     obs = BenchObserver(cfg, run="dist")
@@ -505,6 +614,17 @@ def run_distributed(cfg, res, dtype):
                 res.extra["convergence_gate_reason"] = (
                     "batched sharded CG has no wired capture form; "
                     "convergence capture disabled for this run")
+            if cfg.precond != "none":
+                from ..bench.driver import stamp_precond
+
+                stamp_precond(res.extra, cfg, gate_reason=(
+                    "batched sharded CG has no wired preconditioner; "
+                    "precond disabled for this run"))
+            if cfg.s_step > 1:
+                res.extra["s_step"] = int(cfg.s_step)
+                res.extra["s_step_gate_reason"] = (
+                    "batched sharded CG has no s-step form; running the "
+                    "fused-dot3 single-reduction recurrence")
             if kron:
                 from .kron import make_kron_batched_cg_fn
 
@@ -533,6 +653,18 @@ def run_distributed(cfg, res, dtype):
                     "convergence capture is not wired through the "
                     "checkpointable chunked loop; capture disabled for "
                     "this checkpointed run")
+            if cfg.precond != "none":
+                from ..bench.driver import stamp_precond
+                from ..la.precond import PRECOND_GATE_REASONS
+
+                stamp_precond(
+                    res.extra, cfg,
+                    gate_reason=PRECOND_GATE_REASONS["checkpoint"])
+            if cfg.s_step > 1:
+                res.extra["s_step"] = int(cfg.s_step)
+                res.extra["s_step_gate_reason"] = (
+                    "s-step is not wired through the checkpointable "
+                    "chunked loop; running the standard recurrence")
             run_ck, ck_store, ck_restored, ck_saves = (
                 _make_dist_checkpointed_cg(cfg, res, obs, op, dgrid, u,
                                            kron))
@@ -578,6 +710,141 @@ def run_distributed(cfg, res, dtype):
                     else:
                         _, cg_fn, _ = make_sharded_fns(
                             op, dgrid, cfg.nreps, capture=True)
+
+            # Preconditioning + s-step (ISSUE 11) on the sharded kron /
+            # xla paths: the PCG twin runs the unfused local apply with
+            # the owned-dof psum dot and ONE stacked psum for the
+            # (<r,z>, <r,r>) pair; s-step batches s iterations'
+            # reductions into ONE Gram psum (< 1 reduction/iteration,
+            # trace-gated). Folded backend, pmg, and precond+s-step
+            # combinations gate with recorded reasons.
+            if cfg.precond != "none" or cfg.s_step > 1:
+                from ..bench.driver import stamp_precond
+                from ..la.precond import PRECOND_GATE_REASONS
+
+                pre_kind = cfg.precond if cfg.precond != "none" else None
+                want_sstep = cfg.s_step > 1
+                pre_gate = None
+                if folded:
+                    if pre_kind:
+                        pre_gate = PRECOND_GATE_REASONS["folded"]
+                        pre_kind = None
+                    if want_sstep:
+                        want_sstep = False
+                        res.extra["s_step"] = int(cfg.s_step)
+                        res.extra["s_step_gate_reason"] = (
+                            "sharded folded (pallas) backend has no "
+                            "s-step form; running the standard "
+                            "recurrence")
+                elif pre_kind == "pmg":
+                    pre_gate = (
+                        "sharded p-multigrid transfers are not wired "
+                        "(single-chip only today); precond disabled "
+                        "for this run")
+                    pre_kind = None
+                if cfg.precond != "none" and pre_kind is None:
+                    stamp_precond(res.extra, cfg, gate_reason=pre_gate)
+                if pre_kind and want_sstep:
+                    want_sstep = False
+                    res.extra["s_step"] = int(cfg.s_step)
+                    res.extra["s_step_gate_reason"] = (
+                        "s-step with preconditioning has no "
+                        "communication-avoiding PCG form; running the "
+                        "preconditioned recurrence")
+                if (pre_kind or want_sstep) and res.extra.get("cg_engine"):
+                    record_engine(res.extra, False)
+                    overlap_on = False
+                    if pre_kind:
+                        res.extra.setdefault(
+                            "precond_gate_reason",
+                            PRECOND_GATE_REASONS["engine"])
+                    else:
+                        res.extra.setdefault(
+                            "s_step_gate_reason",
+                            "s-step rides the unfused sharded loop; the "
+                            "fused engine bakes the standard recurrence")
+                    if kron:
+                        compile_opts = None
+                if pre_kind:
+                    import time as _time
+
+                    from ..la.precond import (
+                        CHEB_LMIN_FRACTION,
+                        CHEB_STEPS,
+                        POWER_ITERS,
+                        PrecondBundle,
+                    )
+
+                    t0 = _time.monotonic()
+                    if kron:
+                        from ..la.precond import jacobi_dinv_uniform_host
+
+                        np_dt = (np.float32 if dtype == jnp.float32
+                                 else np.float64)
+                        dinv_host = jacobi_dinv_uniform_host(
+                            t, n, 2.0, np_dt)
+                        dinv = jax.device_put(jnp.asarray(
+                            shard_grid_blocks(dinv_host, n, cfg.degree,
+                                              dgrid.dshape)), sharding)
+                    else:
+                        dinv = jax.jit(make_sharded_dinv_fn(op, dgrid))(
+                            op.G, op.bc_mask)
+                    jax.block_until_ready(dinv)
+                    cheb = None
+                    setup_applies = 0
+                    if pre_kind == "chebyshev":
+                        # the SAME estimator as the single-chip driver
+                        # (la.precond.estimate_lmax: fixed-seed start,
+                        # deterministic), driven through the SHARDED
+                        # apply and the masked psum norm so the interval
+                        # — and therefore the polynomial — is identical
+                        # on every shard
+                        from ..la.precond import estimate_lmax
+
+                        lmax = estimate_lmax(
+                            lambda v: apply_fn(v, *apply_args), dinv,
+                            u.shape, u.dtype,
+                            norm_fn=lambda v: norm_fn(v, *norm_args)[0])
+                        cheb = (lmax, lmax / CHEB_LMIN_FRACTION,
+                                CHEB_STEPS)
+                        setup_applies = POWER_ITERS
+                    if kron:
+                        from .kron import make_kron_pcg_fn
+
+                        cg_fn = make_kron_pcg_fn(
+                            op, dgrid, cfg.nreps, pre_kind, cheb=cheb,
+                            capture=conv_on)
+                        cg_args = (op, dinv)
+                    else:
+                        cg_fn = make_sharded_pcg_fn(
+                            op, dgrid, cfg.nreps, pre_kind, cheb=cheb,
+                            capture=conv_on)
+                        cg_args = (op.G, op.bc_mask, dinv)
+                    bundle = PrecondBundle(
+                        kind=pre_kind, apply=None,
+                        setup_s=_time.monotonic() - t0,
+                        setup_applies=setup_applies,
+                        applies_per_iter=(CHEB_STEPS - 1
+                                          if cheb is not None else 0),
+                        params=({"steps": cheb[2],
+                                 "lmax": round(cheb[0], 6),
+                                 "lmin": round(cheb[1], 8)}
+                                if cheb is not None else {}))
+                    stamp_precond(res.extra, cfg, bundle=bundle)
+                    pcg_on = True
+                elif want_sstep:
+                    if kron:
+                        from .kron import make_kron_sstep_cg_fn
+
+                        cg_fn = make_kron_sstep_cg_fn(
+                            op, dgrid, cfg.nreps, cfg.s_step,
+                            capture=conv_on)
+                    else:
+                        cg_fn = make_sharded_sstep_cg(
+                            op, dgrid, cfg.nreps, cfg.s_step,
+                            capture=conv_on)
+                    res.extra["s_step"] = int(cfg.s_step)
+                    sstep_dist = True
 
             def _rebuild_cg(eng, ovl):
                 if kron:
@@ -636,6 +903,17 @@ def run_distributed(cfg, res, dtype):
                 res.extra["convergence_gate_reason"] = (
                     "convergence capture applies to CG solves only "
                     "(action runs carry no residual); capture disabled")
+            if cfg.precond != "none":
+                from ..bench.driver import stamp_precond
+                from ..la.precond import PRECOND_GATE_REASONS
+
+                stamp_precond(res.extra, cfg,
+                              gate_reason=PRECOND_GATE_REASONS["action"])
+            if cfg.s_step > 1:
+                res.extra["s_step"] = int(cfg.s_step)
+                res.extra["s_step_gate_reason"] = (
+                    "s-step applies to CG solves only; running the "
+                    "standard action loop")
 
             def _compile_action(ap, opts):
                 def _rep(i, y, x, a):
@@ -686,7 +964,38 @@ def run_distributed(cfg, res, dtype):
                        else (lambda: fn(run_input, *run_args)))
     elapsed = obs.elapsed()
     conv_hist = None
-    if conv_on:
+    if sstep_dist:
+        # s-step solves return (x, info) with a replicated breakdown
+        # flag; a breakdown re-runs the standard sharded recurrence
+        # with the reason recorded (the graceful-fallback contract)
+        y, ss_info = y
+        if bool(np.asarray(ss_info["breakdown"])):
+            from ..la.sstep import SSTEP_FALLBACK_REASON
+
+            res.extra["s_step_fallback_reason"] = SSTEP_FALLBACK_REASON
+            if kron:
+                from .kron import make_kron_sharded_fns as _mk
+
+                _, cg_fn, _ = _mk(op, dgrid, cfg.nreps, engine=False,
+                                  capture=conv_on)
+            else:
+                _, cg_fn, _ = make_sharded_fns(op, dgrid, cfg.nreps,
+                                               capture=conv_on)
+            cg_args = ((op,) if kron else (op.G, op.bc_mask))
+            with obs.phase("compile"):
+                fn = compile_lowered(jax.jit(cg_fn).lower(u, *cg_args))
+            with obs.phase("transfer"):
+                warm = fn(u, *cg_args)
+                _fence_scalar(warm)
+                del warm
+            run_args = cg_args
+            y = obs.timed_reps(lambda: fn(run_input, *run_args))
+            elapsed = obs.elapsed()
+            if conv_on:
+                y, conv_hist = y
+        elif conv_on:
+            conv_hist = ss_info["rnorm_history"]
+    elif conv_on:
         # capture cg_fn returns (x, replicated history); the history is
         # fetched once, here, outside the timed region
         y, conv_hist = y

@@ -161,6 +161,52 @@ def owned_dot3(weight: jnp.ndarray):
     return dot3
 
 
+def owned_pair_dot(weight: jnp.ndarray):
+    """Fused (<r, z>, <r, r>) pair over owned dofs in ONE stacked psum —
+    the `cg_solve(precond=, dotpair=)` hook (ISSUE 11): the
+    preconditioned recurrence needs both post-update reductions, and
+    stacking them keeps the sharded PCG at TWO psums per iteration
+    (<p,Ap> + this pair), the synchronous bare loop's count."""
+    def pair(r, z):
+        rw = r * weight
+        st = psum_all(jnp.stack([jnp.sum(rw * z), jnp.sum(rw * r)]))
+        return st[0], st[1]
+
+    return pair
+
+
+def owned_batched_dot3(weight: jnp.ndarray):
+    """Batched fused dot trio (la.cg.batched_dot3's distributed twin):
+    ONE stacked (3, nrhs) psum carries every lane's [<p,y>, <r,y>,
+    <y,y>] — closing the PR 7/PR 10 remainder where the batched sharded
+    paths still psum'd two separate (nrhs,) dots per iteration. Same
+    reassociated recurrence (la.cg.onered_scalars per lane), same
+    standing parity envelope as the single-RHS overlap forms."""
+    def dot3(P, Y, R):
+        axes = tuple(range(1, P.ndim))
+        Yw = Y * weight[None]
+        return psum_all(jnp.stack([
+            jnp.sum(P * Yw, axis=axes),
+            jnp.sum(R * Yw, axis=axes),
+            jnp.sum(Y * Yw, axis=axes),
+        ]))
+
+    return dot3
+
+
+def owned_gram(weight: jnp.ndarray):
+    """Gram matrix of a basis stack over owned dofs in ONE stacked psum
+    (la.sstep.local_gram's distributed twin): the s-step outer
+    iteration's ONLY reduction — (2s+1)^2 scalars for s CG iterations,
+    i.e. 1/s reductions per iteration, the below-one-psum contract."""
+    def gram(V):
+        Vw = V * weight[None]
+        axes = tuple(range(1, V.ndim))
+        return psum_all(jnp.tensordot(Vw, V, axes=(axes, axes)))
+
+    return gram
+
+
 def psum_stack(*partials):
     """ONE psum carrying several already-reduced local scalar partials
     (the overlap engines stack the kernel's in-kernel <p, A p> partial

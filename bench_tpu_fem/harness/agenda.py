@@ -461,6 +461,25 @@ def make_stages(round_tag: str = DEFAULT_ROUND) -> dict[str, Stage]:
             nreps=1000, use_cg=True, convergence=True),
             tail_expr=', "time_to_rtol",'
                       ' res.extra.get("time_to_rtol_s")'), 1800),
+        # Preconditioning on hardware (ISSUE 11): the flagship problem
+        # with Jacobi PCG + convergence capture — the A/B point against
+        # `conv` above that flips the CPU-measured time-to-rtol win to
+        # a hardware number (PCG rides the unfused loop; the engine
+        # gate is recorded, so this is a paired convergence claim, not
+        # a flagship-rate claim). The chebyshev arm stamps its
+        # power-method setup cost + per-iteration apply multiplier.
+        _py("precond", _bench_code("PRECOND12.5M:", dict(
+            ndofs_global=12_500_000, degree=3, qmode=1, float_bits=32,
+            nreps=1000, use_cg=True, convergence=True,
+            precond="jacobi"),
+            tail_expr=', "time_to_rtol",'
+                      ' res.extra.get("time_to_rtol_s")'), 1800),
+        _py("precondcheb", _bench_code("PRECONDCHEB12.5M:", dict(
+            ndofs_global=12_500_000, degree=3, qmode=1, float_bits=32,
+            nreps=400, use_cg=True, convergence=True,
+            precond="chebyshev"),
+            tail_expr=', "time_to_rtol",'
+                      ' res.extra.get("time_to_rtol_s")'), 1800),
         _py("dfeng", _bench_code("DFENG12.5M:", dict(
             ndofs_global=12_500_000, degree=3, qmode=1, float_bits=64,
             nreps=200, use_cg=True, f64_impl="df32"),
@@ -533,6 +552,7 @@ def make_stages(round_tag: str = DEFAULT_ROUND) -> dict[str, Stage]:
 # Composite measure_all stage names -> granular harness stages.
 ALIASES = {
     "ab12": ["ab12", "ab12base"],
+    "precond": ["precond", "precondcheb"],
     "large": ["large100", "large128", "large200", "large300"],
     "dfeng": ["dfeng", "dfunf"],
     "dflarge": ["dflarge100", "dflarge150"],
@@ -543,7 +563,8 @@ ALIASES = {
 AGENDAS = {
     "round6": ["health", "serve", "chaos", "fusedbatch", "dfacc",
                "pertdf", "foldeng", "dfext2d", "scale", "dfeng", "bench",
-               "conv", "dflarge", "pert100", "deg7probe", "matrix"],
+               "conv", "precond", "dflarge", "pert100", "deg7probe",
+               "matrix"],
 }
 
 

@@ -84,6 +84,33 @@ def stacked_dot3(p: jnp.ndarray, y: jnp.ndarray,
                       inner_product(y, y)])
 
 
+def onered_floor(dtype) -> jnp.ndarray:
+    """Squared-relative-residual freeze floor for the single-reduction
+    recurrence (squared rel 1e-13 f32 ~ rel residual 3e-7; 1e-28
+    f64-width) — the same discipline as ops.kron_df.cg_solve_df's
+    df-floor freeze. Applied ONLY on dot3 paths: the default
+    two-reduction loop self-stabilises and stays bit-frozen."""
+    import numpy as _np
+
+    val = 1e-13 if _np.dtype(dtype) == _np.float32 else 1e-28
+    return jnp.asarray(val, dtype)
+
+
+#: consecutive recurrence-residual growths that freeze a dot3 solve.
+#: The single-reduction recurrence LOSES STABILITY once rounding breaks
+#: conjugacy (measured on a 2197-dof kron problem: the f32 recurrence
+#: bottoms at rel 3e-3 around iteration 20 then grows monotonically to
+#: 8e3 by iteration 60; f64 bottoms at 1e-7 then climbs the same way —
+#: the two-reduction loop self-stabilises at 4e-7 on the same budget).
+#: True CG residual norms DO grow transiently (the early iterations of
+#: the same curve alternate up/down), so a single growth must not
+#: freeze; sustained growth is the divergence signature. Freezing at
+#: the current iterate a few steps past the minimum is the graceful
+#: endpoint — the steepest-descent-restart philosophy of
+#: onered_scalars' clamp, extended to the slow-divergence mode.
+ONERED_GROW_MAX = 4
+
+
 def _sentinel_zero() -> dict:
     """Fresh device-scalar sentinel carry (see `cg_solve(sentinel=)`)."""
     i32 = jnp.int32
@@ -103,6 +130,8 @@ def cg_solve(
     dot3: Callable | None = None,
     sentinel: bool = False,
     capture: bool = False,
+    precond: Callable | None = None,
+    dotpair: Callable | None = None,
 ):
     """Solve A x = b; returns x after `max_iter` iterations (rtol=0) or until
     ||r||/||r0|| < rtol. Early termination freezes the state rather than
@@ -138,7 +167,27 @@ def cg_solve(
     (obs.convergence). Returns `(x, info)` with
     `info["rnorm_history"]`. With `capture=False` (the default) this
     function is the pre-capture code path unchanged — the bitwise
-    contract tests/test_convergence.py pins."""
+    contract tests/test_convergence.py pins.
+
+    With `precond=` (ISSUE 11) the loop runs PRECONDITIONED CG: the
+    <r, z> recurrence with z = precond(r) ~= M^{-1} r (M fixed SPD —
+    la.precond builds Jacobi / Chebyshev / p-MG appliers). The routing
+    is a pure python branch to a SEPARATE body (`_pcg_solve`), so
+    `precond=None` is the pre-PR solve BIT-FOR-BIT (pinned against a
+    frozen replica, the PR-10 discipline); sentinel/capture/rtol/dot
+    compose with precond, `dot3` does not (the fused-trio recurrence is
+    an unpreconditioned-form identity). `dotpair(r, z) -> (<r,z>,
+    <r,r>)` optionally fuses the two post-update reductions into one
+    stacked pass (sharded: dist.halo.owned_pair_dot, ONE psum)."""
+    if precond is not None:
+        if dot3 is not None:
+            raise ValueError(
+                "precond= and dot3= are mutually exclusive: the fused "
+                "single-reduction trio is an identity of the "
+                "UNpreconditioned recurrence")
+        return _pcg_solve(apply_A, b, x0, max_iter, rtol=rtol, dot=dot,
+                          precond=precond, dotpair=dotpair,
+                          sentinel=sentinel, capture=capture)
     if dot is None:
         dot = inner_product
 
@@ -189,6 +238,23 @@ def cg_solve(
         # standing bitwise contracts are untouched.
         new_done = jnp.logical_or(
             new_done, rnorm_new == jnp.zeros((), rnorm_new.dtype))
+        if dot3 is not None:
+            # single-reduction stability guards (see onered_floor /
+            # ONERED_GROW_MAX): freeze at the dtype floor, and freeze
+            # on SUSTAINED recurrence-residual growth — the divergence
+            # signature of the reassociated recurrence once rounding
+            # breaks conjugacy
+            new_done = jnp.logical_or(
+                new_done, rnorm_new <= onered_floor(rnorm_new.dtype)
+                * rnorm0)
+            info = dict(info)
+            live = jnp.logical_not(done)
+            grew = jnp.logical_and(live, rnorm_new > rnorm)
+            run = jnp.where(grew, info["onered_grow_run"] + 1,
+                            jnp.zeros((), jnp.int32))
+            info["onered_grow_run"] = run
+            new_done = jnp.logical_or(new_done,
+                                      run >= jnp.int32(ONERED_GROW_MAX))
         if sentinel:
             bad_r = jnp.logical_not(jnp.isfinite(rnorm_new))
             live = jnp.logical_not(done)
@@ -234,8 +300,107 @@ def cg_solve(
         info0 = dict(info0)
         info0["rnorm_history"] = (
             jnp.zeros((max_iter + 1,), rnorm0.dtype).at[0].set(rnorm0))
+    if dot3 is not None:
+        info0 = dict(info0)
+        info0["onered_grow_run"] = jnp.zeros((), jnp.int32)
     state = (x0, r, p, rnorm0, jnp.asarray(False), info0)
     x, _, _, _, _, info = jax.lax.fori_loop(0, max_iter, body, state)
+    if sentinel or capture:
+        return x, {k: v for k, v in info.items()
+                   if k not in ("stag_run", "onered_grow_run")}
+    return x
+
+
+def _pcg_solve(apply_A, b, x0, max_iter, rtol, dot, precond, dotpair,
+               sentinel, capture):
+    """Preconditioned CG (the <r, z> recurrence; ISSUE 11). Separate
+    body from `cg_solve` BY DESIGN: the unpreconditioned path must stay
+    bit-frozen, and the PCG loop carries one extra vector (z) and one
+    extra scalar (<r, z>) it has no business threading through.
+
+    Same freeze/sentinel/capture discipline as `cg_solve`: early
+    termination freezes rather than exits (static trip count), the
+    capture buffer holds the carried <r, r> (the ladder folds RESIDUAL
+    norms — preconditioned and bare histories stay comparable), and the
+    sentinels guard <p, A p> <= 0 exactly as the bare loop does (an
+    indefinite M^{-1} surfaces there too: alpha/beta zero, the next
+    direction restarts from z)."""
+    if dot is None:
+        dot = inner_product
+    if dotpair is None:
+        def dotpair(r_, z_):
+            return dot(r_, z_), dot(r_, r_)
+
+    y = apply_A(x0)
+    r = b - y
+    z = precond(r)
+    p = z
+    rz0, rnorm0 = dotpair(r, z)
+
+    def body(i, state):
+        x, r, p, rz, rnorm, done, info = state
+        y = apply_A(p)
+        pdot = dot(p, y)
+        alpha = rz / pdot
+        if sentinel:
+            ok_p = jnp.logical_and(pdot > 0, jnp.isfinite(pdot))
+            alpha = jnp.where(ok_p, alpha, jnp.zeros((), alpha.dtype))
+        x1 = x + alpha * p
+        r1 = r - alpha * y
+        z1 = precond(r1)
+        rz_new, rnorm_new = dotpair(r1, z1)
+        beta = rz_new / rz
+        if sentinel:
+            beta = jnp.where(ok_p, beta, jnp.zeros((), beta.dtype))
+        p1 = beta * p + z1
+        new_done = jnp.logical_or(done, rnorm_new / rnorm0 < rtol * rtol)
+        # exact-zero residual = exact convergence: freeze (beta would
+        # synthesize NaN from 0/0 next iteration — the cg_solve guard)
+        new_done = jnp.logical_or(
+            new_done, rnorm_new == jnp.zeros((), rnorm_new.dtype))
+        if sentinel:
+            bad_r = jnp.logical_not(jnp.isfinite(rnorm_new))
+            live = jnp.logical_not(done)
+            info = dict(info)
+            info["breakdown_restarts"] = info["breakdown_restarts"] + (
+                jnp.logical_and(live, jnp.logical_not(ok_p))
+                .astype(jnp.int32))
+            info["nonfinite"] = jnp.logical_or(
+                info["nonfinite"], jnp.logical_and(live, bad_r))
+            no_prog = jnp.logical_and(rnorm_new >= rnorm,
+                                      jnp.logical_not(bad_r))
+            stag = jnp.where(jnp.logical_and(live, no_prog),
+                             info["stag_run"] + 1,
+                             jnp.zeros((), jnp.int32))
+            info["stag_run"] = stag
+            info["stag_max"] = jnp.maximum(info["stag_max"], stag)
+            new_done = jnp.logical_or(new_done, bad_r)
+            hold = jnp.logical_or(done, bad_r)
+        else:
+            hold = done
+        keep = lambda new, old: jnp.where(hold, old, new)  # noqa: E731
+        rnorm_keep = keep(rnorm_new, rnorm)
+        if capture:
+            info = dict(info)
+            info["rnorm_history"] = (
+                info["rnorm_history"].at[i + 1].set(rnorm_keep))
+        return (
+            keep(x1, x),
+            keep(r1, r),
+            keep(p1, p),
+            keep(rz_new, rz),
+            rnorm_keep,
+            new_done,
+            info,
+        )
+
+    info0 = _sentinel_zero() if sentinel else {}
+    if capture:
+        info0 = dict(info0)
+        info0["rnorm_history"] = (
+            jnp.zeros((max_iter + 1,), rnorm0.dtype).at[0].set(rnorm0))
+    state = (x0, r, p, rz0, rnorm0, jnp.asarray(False), info0)
+    x, _, _, _, _, _, info = jax.lax.fori_loop(0, max_iter, body, state)
     if sentinel or capture:
         return x, {k: v for k, v in info.items() if k != "stag_run"}
     return x
@@ -278,6 +443,8 @@ def cg_solve_batched(
     dot3: Callable | None = None,
     sentinel: bool = False,
     capture: bool = False,
+    precond: Callable | None = None,
+    dotpair: Callable | None = None,
 ):
     """Multi-RHS CG over a (nrhs, ...) stack: solve A x_i = b_i for every
     RHS in ONE static loop — the serving-layer batch primitive (each
@@ -316,7 +483,25 @@ def cg_solve_batched(
     preallocated residual-history buffer (per-lane squared norms, same
     discipline and return contract as `cg_solve(capture=True)` — no
     host sync on the hot path; `capture=False` is the pre-capture code
-    path unchanged)."""
+    path unchanged).
+
+    With `precond=` (ISSUE 11) every lane runs the preconditioned
+    <r, z> recurrence (`precond` maps the whole (nrhs, ...) residual
+    stack — a Jacobi dinv broadcasts, an operator-based M^{-1} vmaps);
+    routed to a separate body so `precond=None` stays the pre-PR code
+    path bit-for-bit. `dotpair(R, Z) -> ((nrhs,) <r,z>, (nrhs,) <r,r>)`
+    optionally fuses the two post-update reductions (sharded: one
+    stacked psum)."""
+    if precond is not None:
+        if dot3 is not None:
+            raise ValueError(
+                "precond= and dot3= are mutually exclusive: the fused "
+                "single-reduction trio is an identity of the "
+                "UNpreconditioned recurrence")
+        return _pcg_solve_batched(
+            apply_A, B, X0, max_iter, rtol=rtol, dot=dot,
+            batch_apply=batch_apply, precond=precond, dotpair=dotpair,
+            sentinel=sentinel, capture=capture)
     if dot is None:
         dot = batched_dot
     if batch_apply is None:
@@ -363,6 +548,20 @@ def cg_solve_batched(
         # untouched.
         new_done = jnp.logical_or(
             new_done, rnorm_new == jnp.zeros((), rnorm_new.dtype))
+        if dot3 is not None:
+            # per-lane single-reduction stability guards (see
+            # onered_floor / ONERED_GROW_MAX)
+            new_done = jnp.logical_or(
+                new_done, rnorm_new <= onered_floor(rnorm_new.dtype)
+                * rnorm0)
+            info = dict(info)
+            live = jnp.logical_not(done)
+            grew = jnp.logical_and(live, rnorm_new > rnorm)
+            run = jnp.where(grew, info["onered_grow_run"] + 1,
+                            jnp.zeros((nrhs,), jnp.int32))
+            info["onered_grow_run"] = run
+            new_done = jnp.logical_or(new_done,
+                                      run >= jnp.int32(ONERED_GROW_MAX))
         if sentinel:
             bad_r = jnp.logical_not(jnp.isfinite(rnorm_new))
             live = jnp.logical_not(done)
@@ -416,8 +615,114 @@ def cg_solve_batched(
         info0 = dict(info0)
         info0["rnorm_history"] = (
             jnp.zeros((max_iter + 1, nrhs), rnorm0.dtype).at[0].set(rnorm0))
+    if dot3 is not None:
+        info0 = dict(info0)
+        info0["onered_grow_run"] = jnp.zeros((nrhs,), jnp.int32)
     state = (X0, R, P, rnorm0, done0, info0)
     X, _, _, _, _, info = jax.lax.fori_loop(0, max_iter, body, state)
+    if sentinel or capture:
+        return X, {k: v for k, v in info.items()
+                   if k not in ("stag_run", "onered_grow_run")}
+    return X
+
+
+def _pcg_solve_batched(apply_A, B, X0, max_iter, rtol, dot, batch_apply,
+                       precond, dotpair, sentinel, capture):
+    """Batched preconditioned CG — `_pcg_solve` vectorised across the
+    lane axis with `cg_solve_batched`'s frozen-lane discipline (padding
+    lanes born frozen, per-lane freeze on convergence/exact zero, lane
+    algebra independent)."""
+    if dot is None:
+        dot = batched_dot
+    if batch_apply is None:
+        batch_apply = jax.vmap(apply_A)
+    if dotpair is None:
+        def dotpair(R_, Z_):
+            return dot(R_, Z_), dot(R_, R_)
+
+    Y = batch_apply(X0)
+    R = B - Y
+    Z = precond(R)
+    P = Z
+    rz0, rnorm0 = dotpair(R, Z)
+    done0 = rnorm0 == jnp.zeros((), rnorm0.dtype)
+    nrhs = rnorm0.shape[0]
+
+    def body(i, state):
+        X, R, P, rz, rnorm, done, info = state
+        Y = batch_apply(P)
+        pdot = dot(P, Y)
+        alpha = rz / pdot
+        if sentinel:
+            ok_p = jnp.logical_and(pdot > 0, jnp.isfinite(pdot))
+            alpha = jnp.where(ok_p, alpha, jnp.zeros((), alpha.dtype))
+        X1 = X + _bcast(alpha, X) * P
+        R1 = R - _bcast(alpha, R) * Y
+        Z1 = precond(R1)
+        rz_new, rnorm_new = dotpair(R1, Z1)
+        beta = rz_new / rz
+        if sentinel:
+            beta = jnp.where(ok_p, beta, jnp.zeros((), beta.dtype))
+        P1 = _bcast(beta, P) * P + Z1
+        new_done = jnp.logical_or(done, rnorm_new / rnorm0 < rtol * rtol)
+        new_done = jnp.logical_or(
+            new_done, rnorm_new == jnp.zeros((), rnorm_new.dtype))
+        if sentinel:
+            bad_r = jnp.logical_not(jnp.isfinite(rnorm_new))
+            live = jnp.logical_not(done)
+            info = dict(info)
+            info["breakdown_restarts"] = info["breakdown_restarts"] + (
+                jnp.logical_and(live, jnp.logical_not(ok_p))
+                .astype(jnp.int32))
+            info["nonfinite"] = jnp.logical_or(
+                info["nonfinite"], jnp.logical_and(live, bad_r))
+            no_prog = jnp.logical_and(rnorm_new >= rnorm,
+                                      jnp.logical_not(bad_r))
+            stag = jnp.where(jnp.logical_and(live, no_prog),
+                             info["stag_run"] + 1,
+                             jnp.zeros((nrhs,), jnp.int32))
+            info["stag_run"] = stag
+            info["stag_max"] = jnp.maximum(info["stag_max"], stag)
+            new_done = jnp.logical_or(new_done, bad_r)
+            hold = jnp.logical_or(done, bad_r)
+        else:
+            hold = done
+
+        def keep(new, old):
+            return jnp.where(_bcast(hold, old), old, new)
+
+        def keep1(new, old):
+            return jnp.where(hold, old, new)
+
+        rnorm_keep = keep1(rnorm_new, rnorm)
+        if capture:
+            info = dict(info)
+            info["rnorm_history"] = (
+                info["rnorm_history"].at[i + 1].set(rnorm_keep))
+        return (
+            keep(X1, X),
+            keep(R1, R),
+            keep(P1, P),
+            keep1(rz_new, rz),
+            rnorm_keep,
+            new_done,
+            info,
+        )
+
+    if sentinel:
+        i32 = jnp.int32
+        info0 = {"breakdown_restarts": jnp.zeros((nrhs,), i32),
+                 "nonfinite": jnp.zeros((nrhs,), bool),
+                 "stag_run": jnp.zeros((nrhs,), i32),
+                 "stag_max": jnp.zeros((nrhs,), i32)}
+    else:
+        info0 = {}
+    if capture:
+        info0 = dict(info0)
+        info0["rnorm_history"] = (
+            jnp.zeros((max_iter + 1, nrhs), rnorm0.dtype).at[0].set(rnorm0))
+    state = (X0, R, P, rz0, rnorm0, done0, info0)
+    X, _, _, _, _, _, info = jax.lax.fori_loop(0, max_iter, body, state)
     if sentinel or capture:
         return X, {k: v for k, v in info.items() if k != "stag_run"}
     return X

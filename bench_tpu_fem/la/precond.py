@@ -1,0 +1,386 @@
+"""Matrix-free preconditioners for the CG stack (ROADMAP item 3).
+
+The reference benchmark (PAPER.md L5, cg.hpp) runs *unpreconditioned* CG,
+so at scale iteration count — not GDoF/s — dominates wall-clock. Since
+PR 10 every CG record stamps `time_to_rtol_s` next to `gdof_per_second`,
+making preconditioning directly measurable: a preconditioner wins iff it
+reduces iterations-to-rtol by more than its per-iteration cost multiplier.
+
+Three matrix-free preconditioners, all reusing existing machinery:
+
+* **Jacobi** — the operator diagonal WITHOUT the matrix. Two routes,
+  cross-checked against the assembled-CSR diagonal
+  (fem.assemble.csr_diag_inv, the `--mat_comp` oracle seam):
+  - uniform (kron) meshes: diag of a Kronecker sum is the Kronecker sum
+    of 1D diagonals — three O(N^(1/3)) host vectors, outer-broadcast on
+    device (`jacobi_dinv_uniform`);
+  - general geometry: the sum-factorised basis-SQUARED contraction over
+    the weighted geometry tensor G (`jacobi_dinv_general`) — the same
+    separable structure as the operator apply, with per-axis squared
+    (phi^2, dphi^2) and mixed (phi*dphi) 1D tables, folded per cell
+    into the dof grid by the existing ops.laplacian.fold_cells scatter.
+  Dirichlet rows carry a unit diagonal (assemble_csr semantics), so the
+  inverse is finite everywhere.
+
+* **Chebyshev** — a fixed-degree polynomial in the Jacobi-scaled
+  operator D^{-1}A, applied with `CHEB_STEPS` extra operator applies per
+  PCG iteration (any engine form of the apply composes — it is just a
+  callable). The eigenvalue interval comes from a few power-method
+  applies (`estimate_lmax`, deterministic seed) with the standard
+  smoothing convention lmin = lmax / CHEB_LMIN_FRACTION. Fixed step
+  count => a FIXED SPD linear operator, so plain (non-flexible) PCG
+  stays valid.
+
+* **p-multigrid** — la.pmg: V-cycle across the degree family with
+  Chebyshev smoothing and a bottom-level Chebyshev coarse solve,
+  exposed through the same bundle contract.
+
+Evidence discipline: every constructed preconditioner returns a
+`PrecondBundle` carrying its setup wall, setup operator-apply count and
+parameters — the driver stamps these (`precond` block) so a PCG record
+always answers "what did the preconditioner cost to build and what does
+it cost per iteration" (obs.roofline.precond_cost).
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Callable
+
+import numpy as np
+
+#: Chebyshev polynomial degree (extra operator applies per PCG iteration)
+CHEB_STEPS = 3
+#: assumed lower eigenvalue bound as a fraction of the estimated upper
+#: bound — the standard smoothing-interval convention (hypre/MFEM use
+#: lmax/30-ish; the polynomial stays positive below lmin, so a true
+#: lambda_min under the assumed one costs efficiency, never SPD-ness)
+CHEB_LMIN_FRACTION = 30.0
+#: safety factor on the power-method estimate (an UNDER-estimated lmax
+#: makes the Chebyshev polynomial change sign inside the spectrum)
+LMAX_SAFETY = 1.05
+#: power-method applies for the eigenvalue bound estimate
+POWER_ITERS = 10
+
+
+@dataclass
+class PrecondBundle:
+    """One constructed preconditioner: `apply(r) -> z ~= M^{-1} r` plus
+    the evidence the driver stamps. `state` is the pytree of device
+    arrays the apply closes over (dinv, pmg levels) — kept visible so
+    drivers can pass it as an executable ARGUMENT instead of baking
+    O(N) arrays into the HLO as constants."""
+
+    kind: str
+    apply: Callable
+    setup_s: float = 0.0
+    setup_applies: int = 0
+    applies_per_iter: int = 0
+    params: dict = field(default_factory=dict)
+    state: dict = field(default_factory=dict)
+
+    def stamp(self) -> dict:
+        """The `precond` evidence block (bench records / journal)."""
+        return {
+            "kind": self.kind,
+            "setup_s": round(float(self.setup_s), 6),
+            "setup_applies": int(self.setup_applies),
+            "applies_per_iter": int(self.applies_per_iter),
+            **{k: v for k, v in self.params.items()},
+        }
+
+
+# ---------------------------------------------------------------------------
+# Jacobi: the matrix-free operator diagonal.
+# ---------------------------------------------------------------------------
+
+
+def kron_diag_1d(t, n: tuple[int, int, int], with_bc: bool = True):
+    """Per-axis 1D diagonals ([dK_x, dK_y, dK_z], [dM_x, dM_y, dM_z],
+    masks) of the assembled (and, with `with_bc`, column-masked) 1D
+    matrices — O(N^(1/3)) host work, f64. diag(A (x) B) = diag(A) (x)
+    diag(B), so these three pairs ARE the uniform operator's diagonal."""
+    from ..ops.kron import axis_matrices_1d
+
+    Ks, Ms, masks = axis_matrices_1d(t, n, with_bc=with_bc)
+    dK = [np.ascontiguousarray(np.diagonal(K)) for K in Ks]
+    dM = [np.ascontiguousarray(np.diagonal(M)) for M in Ms]
+    return dK, dM, masks
+
+
+def jacobi_dinv_uniform(t, n: tuple[int, int, int], kappa: float, dtype):
+    """(NX, NY, NZ) inverse diagonal of the uniform (kron) operator,
+    computed ON DEVICE from the three 1D diagonal pairs (no O(N) host
+    array — the kron path's sizing rationale). Dirichlet dofs read 1.0
+    (their effective row is the identity pass-through)."""
+    import jax
+    import jax.numpy as jnp
+
+    dK, dM, masks = kron_diag_1d(t, n)
+    dKj = [jnp.asarray(d, dtype) for d in dK]
+    dMj = [jnp.asarray(d, dtype) for d in dM]
+    mj = [jnp.asarray(m, dtype) for m in masks]
+
+    def build():
+        d = kappa * (
+            dKj[0][:, None, None] * dMj[1][None, :, None] * dMj[2][None, None, :]
+            + dMj[0][:, None, None] * dKj[1][None, :, None] * dMj[2][None, None, :]
+            + dMj[0][:, None, None] * dMj[1][None, :, None] * dKj[2][None, None, :]
+        )
+        notbc = mj[0][:, None, None] * mj[1][None, :, None] * mj[2][None, None, :]
+        one = jnp.ones((), d.dtype)
+        return jnp.where(notbc > 0, one / jnp.where(notbc > 0, d, one), one)
+
+    return jax.jit(build)()
+
+
+def jacobi_dinv_general(G, phi0, dphi1, bc_mask, kappa,
+                        n: tuple[int, int, int], degree: int):
+    """(NX, NY, NZ) inverse diagonal of the general-geometry operator via
+    the sum-factorised basis-squared contraction: d_e[i] = kappa *
+    sum_q sum_ab G[c, ab, q] D_a[q, i] D_b[q, i] separates per axis into
+    squared (phi^2 / dphi^2) and mixed (phi*dphi) 1D tables (the
+    off-diagonal G components appear twice by symmetry), one einsum per
+    packed component, folded into the dof grid by the SAME overlap-add
+    scatter the operator apply uses (ops.laplacian.fold_cells) — an
+    independent path from the assembled-matrix diagonal, which the
+    oracle tests cross-check at machine precision. Runs wherever G
+    lives (device jnp or host np via jnp.asarray); `G` is the PLAIN
+    (ncells, 6, nq, nq, nq) layout (the pallas blocked layout is not
+    accepted — callers on that path gate with a recorded reason)."""
+    import jax.numpy as jnp
+
+    from ..ops.laplacian import fold_cells
+
+    grid = kappa * fold_cells(jacobi_diag_cells(G, phi0, dphi1), n, degree)
+    one = jnp.ones((), grid.dtype)
+    bc = jnp.asarray(bc_mask)
+    return jnp.where(bc, one, one / jnp.where(bc, one, grid))
+
+
+def jacobi_diag_cells(G, phi0, dphi1):
+    """(ncells, nd, nd, nd) per-cell diagonal contributions — the
+    basis-squared contraction shared by the single-chip and sharded
+    (seam-folded) diagonal assemblies."""
+    import jax.numpy as jnp
+
+    G = jnp.asarray(G)
+    phi = jnp.asarray(phi0, G.dtype)  # (nq, nd)
+    dphi = jnp.asarray(dphi1, G.dtype) @ phi  # collocation chain, as the apply
+    P2, D2, PD = phi * phi, dphi * dphi, phi * dphi
+
+    def term(ab, Ax, Ay, Az, w):
+        return w * jnp.einsum("cxyz,xi,yj,zk->cijk", G[:, ab], Ax, Ay, Az)
+
+    return (
+        term(0, D2, P2, P2, 1.0) + term(3, P2, D2, P2, 1.0)
+        + term(5, P2, P2, D2, 1.0) + term(1, PD, PD, P2, 2.0)
+        + term(2, PD, P2, PD, 2.0) + term(4, P2, PD, PD, 2.0)
+    )
+
+
+def jacobi_dinv_dist_local(G_local, phi0, dphi1, bc_local, kappa,
+                           n_local: tuple[int, int, int], degree: int):
+    """Sharded inverse diagonal, called INSIDE shard_map on one shard's
+    block: local per-cell contributions folded into the local grid, seam
+    partials completed by the existing ghost-plane collectives (partial
+    sums on ghost planes accumulate to their owners via
+    reverse_scatter_add, then owners refresh the ghosts via halo_refresh
+    so shared planes read identically on every shard)."""
+    import jax.numpy as jnp
+
+    from ..dist.halo import halo_refresh, reverse_scatter_add
+    from ..ops.laplacian import fold_cells
+
+    grid = kappa * fold_cells(jacobi_diag_cells(G_local, phi0, dphi1),
+                              n_local, degree)
+    grid = halo_refresh(reverse_scatter_add(grid))
+    one = jnp.ones((), grid.dtype)
+    return jnp.where(bc_local, one, one / jnp.where(bc_local, one, grid))
+
+
+def jacobi_dinv_uniform_host(t, n: tuple[int, int, int], kappa: float,
+                             np_dtype) -> np.ndarray:
+    """Host (numpy) twin of jacobi_dinv_uniform for the sharded kron
+    driver, which slices the global grid into overlapping local blocks
+    (dist.operator.shard_grid_blocks) before device_put — O(N) host
+    memory, acceptable at the CPU-proof and precond-stage scales (the
+    flagship-capacity runs are unpreconditioned)."""
+    dK, dM, masks = kron_diag_1d(t, n)
+    d = kappa * (
+        dK[0][:, None, None] * dM[1][None, :, None] * dM[2][None, None, :]
+        + dM[0][:, None, None] * dK[1][None, :, None] * dM[2][None, None, :]
+        + dM[0][:, None, None] * dM[1][None, :, None] * dK[2][None, None, :]
+    )
+    notbc = (masks[0][:, None, None] * masks[1][None, :, None]
+             * masks[2][None, None, :]) > 0
+    return np.where(notbc, 1.0 / np.where(notbc, d, 1.0),
+                    1.0).astype(np_dtype)
+
+
+def op_jacobi_dinv(op):
+    """Matrix-free inverse diagonal straight from an operator's own
+    state (duck-typed — no driver re-plumbing of tables/meshes):
+
+    * kron (`Kd`/`Md` banded diagonals): the centre band IS the 1D main
+      diagonal, so diag(A) is three outer products — O(N) device work;
+    * xla Laplacian (plain-layout `G`): the basis-squared contraction
+      (`jacobi_dinv_general`);
+    * anything else (folded layout, pallas blocked G): None — the
+      caller gates with a recorded reason.
+    """
+    import jax.numpy as jnp
+
+    if hasattr(op, "Kd") and hasattr(op, "notbc1d"):
+        P = (op.Kd[0].shape[0] - 1) // 2
+        dK = [kd[P] for kd in op.Kd]
+        dM = [md[P] for md in op.Md]
+        d = op.kappa * (
+            dK[0][:, None, None] * dM[1][None, :, None] * dM[2][None, None, :]
+            + dM[0][:, None, None] * dK[1][None, :, None] * dM[2][None, None, :]
+            + dM[0][:, None, None] * dM[1][None, :, None] * dK[2][None, None, :]
+        )
+        mx, my, mz = op.notbc1d
+        notbc = (mx[:, None, None] * my[None, :, None]
+                 * mz[None, None, :]) > 0
+        one = jnp.ones((), d.dtype)
+        return jnp.where(notbc, one / jnp.where(notbc, d, one), one)
+    if getattr(op, "backend", "") == "xla" and hasattr(op, "G"):
+        return jacobi_dinv_general(op.G, op.phi0, op.dphi1, op.bc_mask,
+                                   op.kappa, op.n, op.degree)
+    return None
+
+
+def make_jacobi(dinv) -> Callable:
+    """z = D^{-1} r — one elementwise stream, no extra operator applies."""
+    return lambda r: dinv * r
+
+
+def make_jacobi_df(dinv) -> Callable:
+    """df twin: both channels scaled by the f32 inverse diagonal. The
+    scaling is an APPROXIMATE df product (no compensation terms) — a
+    preconditioner's own rounding only reshapes M, never the answer, so
+    the cheap elementwise form is the right one."""
+    from .df64 import DF
+
+    return lambda r: DF(dinv * r.hi, dinv * r.lo)
+
+
+# ---------------------------------------------------------------------------
+# Chebyshev: fixed polynomial in the Jacobi-scaled operator.
+# ---------------------------------------------------------------------------
+
+
+def make_chebyshev(apply_A: Callable, dinv, lmax: float,
+                   lmin: float | None = None,
+                   steps: int = CHEB_STEPS) -> Callable:
+    """z = q(D^{-1} A) D^{-1} r with q the degree-`steps` Chebyshev
+    polynomial minimising the error on [lmin, lmax] — the classical
+    semi-iteration recurrence, unrolled at trace time (steps is small
+    and static). Fixed steps => a fixed SPD operator (q > 0 on (0,
+    lmax]), so plain PCG needs no flexible variant. Costs `steps - 1`
+    extra operator applies per PCG iteration plus `steps` diagonal
+    streams; the caller stamps that via PrecondBundle."""
+    if lmin is None:
+        lmin = lmax / CHEB_LMIN_FRACTION
+    theta = 0.5 * (lmax + lmin)
+    delta = 0.5 * (lmax - lmin)
+    sigma = theta / delta
+
+    def apply(r):
+        rhat = dinv * r
+        rho = 1.0 / sigma
+        d = rhat / theta
+        z = d
+        for _ in range(steps - 1):
+            res = rhat - dinv * apply_A(z)
+            rho1 = 1.0 / (2.0 * sigma - rho)
+            d = (rho1 * rho) * d + (2.0 * rho1 / delta) * res
+            z = z + d
+            rho = rho1
+        return z
+
+    return apply
+
+
+def estimate_lmax(apply_A: Callable, dinv, shape, dtype,
+                  iters: int = POWER_ITERS, seed: int = 0,
+                  norm_fn: Callable | None = None) -> float:
+    """Upper eigenvalue bound of D^{-1} A by `iters` power-method
+    applies from a fixed-seed start (deterministic — the same problem
+    always estimates the same interval), inflated by LMAX_SAFETY.
+    `norm_fn` overrides the 2-norm for sharded callers (owned-dof psum
+    dot under shard_map); the host loop is setup-phase work, counted
+    into the bundle's setup_applies by the caller."""
+    import jax
+    import jax.numpy as jnp
+
+    if norm_fn is None:
+        def norm_fn(v):
+            return jnp.sqrt(jnp.sum(v * v))
+
+    step = jax.jit(lambda v: apply_A(dinv * v))
+    nrm = jax.jit(norm_fn)
+    rng = np.random.RandomState(seed)
+    v = jnp.asarray(rng.rand(*shape), dtype)
+    lmax = 1.0
+    for _ in range(iters):
+        w = step(v)
+        wn = float(nrm(w))
+        vn = float(nrm(v))
+        if not (np.isfinite(wn) and wn > 0.0 and vn > 0.0):
+            break
+        lmax = wn / vn
+        v = w / wn
+    return float(lmax) * LMAX_SAFETY
+
+
+# ---------------------------------------------------------------------------
+# Bundle factories (the driver-facing seam).
+# ---------------------------------------------------------------------------
+
+
+def build_jacobi_bundle(dinv, *, setup_s: float,
+                        extra_params: dict | None = None) -> PrecondBundle:
+    return PrecondBundle(
+        kind="jacobi", apply=make_jacobi(dinv), setup_s=setup_s,
+        setup_applies=0, applies_per_iter=0,
+        params=dict(extra_params or {}), state={"dinv": dinv})
+
+
+def build_chebyshev_bundle(apply_A: Callable, dinv, shape, dtype, *,
+                           steps: int = CHEB_STEPS,
+                           setup_s_diag: float = 0.0) -> PrecondBundle:
+    """Jacobi diagonal + power-method interval + Chebyshev apply in one
+    bundle. `setup_s_diag` is the already-paid diagonal-assembly wall so
+    the stamped setup cost covers the WHOLE construction."""
+    t0 = time.monotonic()
+    lmax = estimate_lmax(apply_A, dinv, shape, dtype)
+    lmin = lmax / CHEB_LMIN_FRACTION
+    setup_s = (time.monotonic() - t0) + setup_s_diag
+    return PrecondBundle(
+        kind="chebyshev",
+        apply=make_chebyshev(apply_A, dinv, lmax, lmin, steps),
+        setup_s=setup_s, setup_applies=POWER_ITERS,
+        applies_per_iter=steps - 1,
+        params={"steps": steps, "lmax": round(lmax, 6),
+                "lmin": round(lmin, 8)},
+        state={"dinv": dinv})
+
+
+#: the recorded reason a driver stamps when a requested preconditioner
+#: cannot run on a path (folded layouts, fused engines, action runs) —
+#: classified `unsupported` by the harness taxonomy, never silent
+PRECOND_GATE_REASONS = {
+    "engine": ("preconditioned CG (precond != none): the fused "
+               "whole-solve engine bakes the unpreconditioned "
+               "recurrence; running the unfused preconditioned loop"),
+    "action": ("preconditioning applies to CG solves only (action runs "
+               "have no residual equation); precond disabled"),
+    "folded": ("preconditioning is unsupported on the folded (pallas) "
+               "vector layout; precond disabled for this run"),
+    "checkpoint": ("durable checkpointing (checkpoint_every > 0) does "
+                   "not carry the preconditioned recurrence; precond "
+                   "disabled for this checkpointed run"),
+}

@@ -1,0 +1,196 @@
+"""s-step (communication-avoiding) CG: one stacked reduction per s
+iterations (Carson & Demmel 2014; the natural extension of la.cg's
+single-reduction recurrence below ONE psum per iteration).
+
+Standard CG needs two global reductions per iteration; PR 7's fused
+recurrence (la.cg.onered_scalars) brought that to one. Going BELOW one
+requires restructuring: over s iterations every iterate stays inside the
+2s+1-dimensional Krylov space
+
+    V = [p, A p, ..., A^s p,  r, A r, ..., A^{s-1} r]
+
+so all the inner products of s iterations are entries of the Gram matrix
+G = V^T V — computable with ONE stacked reduction (sharded: one psum of
+the (2s+1, 2s+1) block). The s iterations then run as scalar recurrences
+on (2s+1,)-coefficient vectors against G (no collectives at all), and
+the full vectors x/r/p are reconstructed from V once per outer step.
+
+Costs and caveats, stamped honestly:
+
+* the R-basis applies are EXTRA operator work — 2s-1 applies per s
+  iterations vs s for standard CG (the classical CA-CG flop trade; halo
+  exchanges ride each apply, so MOVEMENT collectives scale with applies
+  while REDUCTIONS drop to 1/s per iteration — the trace-level counter
+  the tests and the perfgate pin).
+* the monomial basis conditions like kappa(A)^s: small s (2-4) only,
+  and f32 parity vs standard CG sits inside the repo's standing fused-
+  engine envelope (2e-5 * scale), not at bitwise.
+* breakdown (a non-SPD Gram projection, pdot <= 0, or a non-finite
+  norm) FREEZES the state at the last good outer boundary and raises
+  the `breakdown` flag in info; the drivers re-run the one-reduction
+  recurrence and record `s_step_fallback_reason` — graceful, never
+  silent, never NaN.
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import numpy as np
+
+
+def shift_matrix(s: int) -> np.ndarray:
+    """(2s+1, 2s+1) monomial-basis shift B with A (V c) = V (B c) for
+    every coefficient vector the s inner iterations produce: columns
+    0..s-1 shift the P-chain up one power, columns s+1..2s-1 the
+    R-chain. The top powers (P_s, R_{s-1}) have zero columns — the
+    recurrences never apply A to a vector carrying weight there (p_j
+    spans only P_0..P_j, R_0..R_{j-1} for j < s, by induction on the CG
+    update)."""
+    m = 2 * s + 1
+    B = np.zeros((m, m))
+    for i in range(s):
+        B[i + 1, i] = 1.0
+    for i in range(s - 1):
+        B[s + 1 + i + 1, s + 1 + i] = 1.0
+    return B
+
+
+def local_gram(V):
+    """Default (single-chip) Gram matrix of the (2s+1, ...) basis stack:
+    one reduction pass. The sharded twin is dist.halo.owned_gram (masked
+    partials + ONE psum)."""
+    import jax.numpy as jnp
+
+    Vf = V.reshape(V.shape[0], -1)
+    return Vf @ Vf.T
+
+
+def sstep_cg_solve(
+    apply_A: Callable,
+    b,
+    x0,
+    max_iter: int,
+    s: int,
+    gram: Callable | None = None,
+    dot: Callable | None = None,
+    capture: bool = False,
+):
+    """Solve A x = b with the s-step recurrence; returns `(x, info)` with
+    info = {"breakdown": bool scalar, "iters": completed iterations
+    [, "rnorm_history": (max_iter + 1,) when capture]} — the drivers
+    check `breakdown` once, after the solve, and fall back.
+
+    Benchmark semantics (rtol = 0): exactly `max_iter` iterations unless
+    breakdown freezes the state earlier; max_iter need not divide by s —
+    the last outer step freezes its excess inner iterations with the
+    repo's standing keep discipline. `dot` (default la.vector
+    inner_product; sharded: owned-dof psum dot) computes the two
+    out-of-loop reductions (<r0,r0> and the init residual); `gram` the
+    in-loop stacked one."""
+    import jax
+    import jax.numpy as jnp
+
+    from .vector import inner_product
+
+    if s < 1:
+        raise ValueError("s-step CG needs s >= 1")
+    if gram is None:
+        gram = local_gram
+    if dot is None:
+        dot = inner_product
+
+    m = 2 * s + 1
+    B = jnp.asarray(shift_matrix(s), b.dtype)
+    e_p = jnp.zeros((m,), b.dtype).at[0].set(1.0)
+    e_r = jnp.zeros((m,), b.dtype).at[s + 1].set(1.0)
+    zero = jnp.zeros((), b.dtype)
+
+    y0 = apply_A(x0)
+    r0 = b - y0
+    rnorm0 = dot(r0, r0)
+    nouter = -(-max_iter // s)
+
+    def body(k, state):
+        x, r, p, rnorm, iters, done, bad, hist = state
+        # --- basis: 2s-1 applies, NO reductions
+        Vs = [p]
+        for _ in range(s):
+            Vs.append(apply_A(Vs[-1]))
+        Rs = [r]
+        for _ in range(s - 1):
+            Rs.append(apply_A(Rs[-1]))
+        V = jnp.stack(Vs + Rs)
+        # --- the outer step's ONE stacked reduction
+        G = gram(V)
+
+        # --- s inner iterations: scalar recurrences against G
+        pc, rc, xc = e_p, e_r, jnp.zeros((m,), b.dtype)
+        rn = rnorm
+        bad1 = bad
+        hist1 = hist
+        for j in range(s):
+            live = jnp.logical_and(
+                jnp.logical_not(done),
+                jnp.logical_not(bad1))
+            live = jnp.logical_and(live, k * s + j < max_iter)
+            wc = B @ pc
+            Gw = G @ wc
+            pdot = pc @ Gw
+            ok = jnp.logical_and(pdot > zero, jnp.isfinite(pdot))
+            alpha0 = jnp.where(ok, rn / jnp.where(ok, pdot, 1.0), zero)
+            rc1 = rc - alpha0 * wc
+            rn1 = rc1 @ (G @ rc1)
+            ok_r = jnp.logical_and(jnp.isfinite(rn1), rn1 >= zero)
+            upd = jnp.logical_and(live, jnp.logical_and(ok, ok_r))
+            bad1 = jnp.logical_or(
+                bad1, jnp.logical_and(live, jnp.logical_not(
+                    jnp.logical_and(ok, ok_r))))
+            alpha = jnp.where(upd, alpha0, zero)
+            xc = xc + alpha * pc
+            beta = jnp.where(upd, rn1 / rn, zero)
+            rc = jnp.where(upd, rc - alpha * wc, rc)
+            pc = jnp.where(upd, rc + beta * pc, pc)
+            rn = jnp.where(upd, rn1, rn)
+            if capture:
+                # a frozen inner iteration repeats its held value (the
+                # capture discipline); indices past max_iter on the last
+                # partial outer step are dropped by the OOB-scatter rule
+                hist1 = hist1.at[k * s + j + 1].set(rn)
+
+        # --- reconstruct full vectors once per outer step
+        comb = lambda c: jnp.tensordot(c, V, axes=(0, 0))  # noqa: E731
+        hold = jnp.logical_or(done, bad1)
+        keep = lambda new, old: jnp.where(hold, old, new)  # noqa: E731
+        x1 = keep(x + comb(xc), x)
+        r1 = keep(comb(rc), r)
+        p1 = keep(comb(pc), p)
+        rnorm1 = keep(rn, rnorm)
+        iters1 = jnp.where(hold, iters,
+                           jnp.minimum(iters + s, max_iter))
+        done1 = jnp.logical_or(done, rnorm1 == zero)
+        return (x1, r1, p1, rnorm1, iters1, done1, bad1, hist1)
+
+    hist0 = (jnp.zeros((max_iter + 1,), b.dtype).at[0].set(rnorm0)
+             if capture else jnp.zeros((0,), b.dtype))
+    state = (x0, r0, r0, rnorm0, jnp.zeros((), jnp.int32),
+             rnorm0 == zero, jnp.asarray(False), hist0)
+    x, _, _, _, iters, _, bad, hist = jax.lax.fori_loop(
+        0, nouter, body, state)
+    info = {"breakdown": bad, "iters": iters}
+    if capture:
+        info["rnorm_history"] = hist
+    return x, info
+
+
+#: recorded reason when a breakdown routed an s-step run back to the
+#: one-reduction recurrence (la.cg) — the graceful fallback contract
+SSTEP_FALLBACK_REASON = (
+    "s-step CG breakdown (ill-conditioned monomial Gram projection or "
+    "non-SPD step): re-ran the one-reduction recurrence")
+
+#: recorded reason when --s-step is requested on a path without an
+#: s-step form (fused engines, batched stacks, df, folded layout)
+SSTEP_GATE_REASON = (
+    "s-step CG is unsupported on this path (no communication-avoiding "
+    "form); running the standard recurrence")

@@ -163,6 +163,15 @@ def convergence_stamp(extra: dict, hist, *, wall_s: float, iters_run: int,
     if nrhs > 1:
         block["nrhs"] = int(nrhs)
         block["lane"] = int(lane or 0)
+    # ISSUE 11: label the block with the preconditioner / s-step that
+    # PRODUCED this history (read from the record's own stamps, written
+    # by the drivers before the fold) — preconditioned and bare curves
+    # must never compare silently; consumers (obs.regress) treat a
+    # label mismatch as an apples-to-oranges gap, not a regression
+    pre = extra.get("precond")
+    block["precond"] = (pre.get("kind", "none")
+                        if isinstance(pre, dict) else "none")
+    block["s_step"] = int(extra.get("s_step", 1) or 1)
     extra["convergence"] = block
     # the paired metric, surfaced at top level so GDoF/s and
     # time-to-rtol read off one record side by side (ROADMAP item 4)

@@ -294,12 +294,20 @@ def render_convergence(records: list[dict]) -> str:
                 depth = min(max(-_math.log10(max(rel, 1e-16)), 0.0), 8.0)
             spark += _SPARK[min(int(depth / 8.0 * (len(_SPARK) - 1)),
                                 len(_SPARK) - 1)]
+        # ISSUE 11: the precond/s-step labels render ON the row —
+        # preconditioned and bare curves must never read as one series
+        label = ""
+        if conv.get("precond", "none") != "none":
+            label += f" precond={conv['precond']}"
+        if int(conv.get("s_step", 1) or 1) > 1:
+            label += f" s_step={conv['s_step']}"
         lines.append(
             f"{rec.get('event', '?')}: iters_run="
             f"{conv.get('iters_run')} final_rel="
             f"{conv.get('final_rel_residual') or 0.0:.3e} "
             f"stag_max={conv.get('stagnation_max_run')} "
-            f"restarts={conv.get('restarts')} [{conv.get('evidence')}]")
+            f"restarts={conv.get('restarts')}{label} "
+            f"[{conv.get('evidence')}]")
         lines.append(f"  |{spark}|  (depth: ' '=1e0 .. '@'=1e-8)")
         iters = conv.get("iters_to_rtol") or {}
         times = conv.get("time_to_rtol_s") or {}
@@ -413,6 +421,10 @@ def gate_main(argv=None) -> int:
         print(f"== perfgate: {status}")
         for v in verdict["violations"]:
             print(f"   GATE {v}")
+        if verdict.get("label_mismatch"):
+            # ISSUE 11: an apples-to-oranges precond/s-step comparison
+            # is a LABELLED gap, never a silent pass or a violation
+            print(f"   LABEL GAP {verdict['label_mismatch']}")
         for name, t in sorted(verdict["timing"].items()):
             print(f"   timing[{name}] (advisory): "
                   f"{t.get('classification')} "

@@ -285,5 +285,41 @@ def roofline_stamp(extra: dict, *, degree: int, qmode: int,
                      "cpu-run vs chip peaks (placement on the roofline, "
                      "not a throughput claim)"),
     }
+    pc = precond_cost(extra, model, precision)
+    if pc is not None:
+        rl["precond_cost"] = pc
     extra["roofline"] = rl
     return rl
+
+
+def precond_cost(extra: dict, model: dict,
+                 precision: str = "f32") -> dict | None:
+    """ISSUE 11: fold the preconditioner's per-iteration cost into the
+    roofline stamp, from the driver's own `precond` block. The model is
+    analytic and honest about what it counts: `applies_per_iter` extra
+    operator applies (each at the running form's per-dof cost — an
+    upper bound for p-MG, whose coarse-level applies are cheaper) plus
+    one diagonal stream read + one vector write per precond apply
+    (Jacobi's whole cost; also the Chebyshev/pmg smoother scaling
+    streams), so `iter_cost_multiplier` says how much more HBM traffic
+    one PCG iteration moves than a bare iteration — the number
+    time-to-rtol must beat via iteration count."""
+    pre = extra.get("precond")
+    if not isinstance(pre, dict) or pre.get("kind", "none") == "none":
+        return None
+    applies = int(pre.get("applies_per_iter", 0))
+    itemsize = 4 if precision == "f32" else 8  # df32 pairs / f64
+    base_pd = float(model.get("hbm_bytes_per_dof", 0.0)) or 1.0
+    # per precond apply: read dinv + read r + write z
+    stream_pd = 3.0 * itemsize * max(applies, 1)
+    extra_pd = applies * base_pd + stream_pd
+    return {
+        "kind": pre.get("kind"),
+        "setup_applies": int(pre.get("setup_applies", 0)),
+        "setup_s": pre.get("setup_s"),
+        "applies_per_iter": applies,
+        "extra_hbm_bytes_per_dof": round(extra_pd, 2),
+        "iter_cost_multiplier": round(1.0 + extra_pd / base_pd, 3),
+        "evidence": "analytic (design estimate; time_to_rtol_s "
+                    "adjudicates the measured trade)",
+    }

@@ -139,7 +139,7 @@ def build_kron_laplacian_df(
 
 
 def cg_solve_df(op: KronLaplacianDF, b: DF, max_iter: int,
-                capture: bool = False):
+                capture: bool = False, precond=None):
     """Fixed-iteration CG in df arithmetic (x0 = 0, rtol = 0 — reference
     cg.hpp:89-169 semantics), scalars (alpha, beta, rnorm) carried as DF.
 
@@ -158,7 +158,14 @@ def cg_solve_df(op: KronLaplacianDF, b: DF, max_iter: int,
     iterations-to-rtol ladder that stops at 1e-8 of the NORM, i.e. 1e-16
     of the square) and returns `(x, {"rnorm_history": ...})` — the
     `la.cg.cg_solve(capture=True)` contract. `capture=False` (default)
-    is the pre-capture code path unchanged."""
+    is the pre-capture code path unchanged.
+
+    With `precond=` (ISSUE 11: a DF -> DF callable, e.g. a Jacobi
+    diagonal scaling of both channels) the loop is routed to the df
+    <r, z> twin `_pcg_solve_df` — a separate body, so `precond=None`
+    stays this pre-PR code path bit-for-bit (the la.cg discipline)."""
+    if precond is not None:
+        return _pcg_solve_df(op, b, max_iter, precond, capture=capture)
     floor = jnp.float32(1e-24)
 
     def body(i, state):
@@ -194,6 +201,60 @@ def cg_solve_df(op: KronLaplacianDF, b: DF, max_iter: int,
         state = state + (
             jnp.zeros((max_iter + 1,), jnp.float32).at[0].set(rnorm0.hi),)
         x, _, _, _, _, hist = jax.lax.fori_loop(0, max_iter, body, state)
+        return x, {"rnorm_history": hist}
+    x, *_ = jax.lax.fori_loop(0, max_iter, body, state)
+    return x
+
+
+def _pcg_solve_df(op: KronLaplacianDF, b: DF, max_iter: int, precond,
+                  capture: bool = False):
+    """Preconditioned CG in df arithmetic (the <r, z> recurrence of
+    la.cg._pcg_solve with DF scalars): z = precond(r), alpha = <r,z> /
+    <p,Ap>, beta = <r1,z1> / <r,z>. Carries BOTH <r,z> (the recurrence)
+    and <r,r> (the residual-floor freeze + capture buffer — the ladder
+    folds residual norms, so preconditioned and bare df histories stay
+    comparable). Same df floor freeze as `cg_solve_df`."""
+    floor = jnp.float32(1e-24)
+
+    def body(i, state):
+        if capture:
+            x, r, p, rz, rnorm, done, hist = state
+        else:
+            x, r, p, rz, rnorm, done = state
+        y = op.apply(p)
+        alpha = df_div(rz, df_dot(p, y))
+        x1 = df_axpy(x, alpha, p)
+        r1 = df_sub(r, df_scale(y, alpha))
+        z1 = precond(r1)
+        rz1 = df_dot(r1, z1)
+        rnorm1 = df_dot(r1, r1)
+        beta = df_div(rz1, rz)
+        p1 = df_add(df_scale(p, beta), z1)
+        done1 = jnp.logical_or(done, rnorm1.hi <= floor * rnorm0_hi)
+
+        def keep(new, old):
+            return jax.tree_util.tree_map(
+                lambda n, o: jnp.where(done, o, n), new, old
+            )
+
+        rnorm_keep = keep(rnorm1, rnorm)
+        out = (keep(x1, x), keep(r1, r), keep(p1, p), keep(rz1, rz),
+               rnorm_keep, done1)
+        if capture:
+            out = out + (hist.at[i + 1].set(rnorm_keep.hi),)
+        return out
+
+    x0 = df_zeros_like(b)
+    z0 = precond(b)
+    rz0 = df_dot(b, z0)
+    rnorm0 = df_dot(b, b)
+    rnorm0_hi = rnorm0.hi
+    state = (x0, b, z0, rz0, rnorm0, jnp.asarray(False))
+    if capture:
+        state = state + (
+            jnp.zeros((max_iter + 1,), jnp.float32).at[0].set(rnorm0.hi),)
+        x, _, _, _, _, _, hist = jax.lax.fori_loop(0, max_iter, body,
+                                                   state)
         return x, {"rnorm_history": hist}
     x, *_ = jax.lax.fori_loop(0, max_iter, body, state)
     return x

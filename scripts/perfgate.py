@@ -48,6 +48,10 @@ def main(argv=None) -> int:
     p.add_argument("--timing-reps", type=int, default=5)
     p.add_argument("--requests", type=int, default=16,
                    help="serve requests fired through the broker")
+    p.add_argument("--pcg-nreps", type=int, default=150,
+                   help="iteration budget of the precond legs (must "
+                        "cross rtol 1e-6 on the fixed-seed perturbed "
+                        "problem for both arms)")
     p.add_argument("--slo-objective", type=float, default=5.0,
                    help="latency objective for the serve SLO fold "
                         "(generous: CPU solves are slow; the gate is "
@@ -94,6 +98,44 @@ def main(argv=None) -> int:
     dist = {k: dres.extra.get(k) for k in (
         "timing", "collectives_per_iter", "cg_engine_form",
         "per_iter_s")}
+
+    # -- precond legs (ISSUE 11): the fixed-seed perturbed-geometry
+    # degree-3 problem, bare vs Jacobi-PCG, convergence capture on —
+    # iterations-to-1e-6 are DETERMINISTIC counters on CPU (properties
+    # of the arithmetic, not the clock) and gate hard: an iteration
+    # increase on either arm is a solver regression, and Jacobi must
+    # stay strictly below bare (the ISSUE-11 acceptance, collected
+    # fresh every gate run). time_to_rtol_s rides as evidence
+    # (advisory: wall-clock never gates on shared CI).
+    pc_iters = {}
+    pcg = {}
+    for kind in ("none", "jacobi"):
+        pcfg = BenchConfig(ndofs_global=args.ndofs, degree=3, qmode=1,
+                           float_bits=32, nreps=args.pcg_nreps,
+                           use_cg=True, geom_perturb_fact=0.2,
+                           convergence=True, precond=kind)
+        pres = run_benchmark(pcfg)
+        conv = pres.extra.get("convergence") or {}
+        pc_iters[kind] = (conv.get("iters_to_rtol") or {}).get("1e-06")
+        pcg[kind] = {
+            "iters_to_rtol": conv.get("iters_to_rtol"),
+            "time_to_rtol_s": pres.extra.get("time_to_rtol_s"),
+            "precond": pres.extra.get("precond"),
+            "precond_cost": (pres.extra.get("roofline") or {}).get(
+                "precond_cost"),
+        }
+
+    # -- s-step leg: 2 virtual devices, s=2 — the below-one-reduction
+    # contract as a hard counter (reductions per loop body / s)
+    scfg = BenchConfig(ndofs_global=args.ndofs, degree=3, qmode=1,
+                       float_bits=32, nreps=args.nreps, use_cg=True,
+                       ndevices=2, s_step=2)
+    sres = BenchmarkResults(nreps=scfg.nreps)
+    run_distributed(scfg, sres, jnp.float32)
+    ss_counts = sres.extra.get("collectives_per_iter") or {}
+    sstep = {"collectives_per_iter": ss_counts,
+             "s_step": sres.extra.get("s_step"),
+             "fallback": sres.extra.get("s_step_fallback_reason")}
 
     # -- serve leg: broker round with journaled lifecycles + SLO
     from bench_tpu_fem.obs.regress import fold_slo
@@ -147,8 +189,20 @@ def main(argv=None) -> int:
     trace_violations = validate_chrome_trace(tracer.chrome_trace())
     record_errs = check_record_contract(bench, require_convergence=True)
 
+    sstep_reductions_per_iter = (
+        float(ss_counts["reductions"]) / float(scfg.s_step)
+        if isinstance(ss_counts.get("reductions"), int) else None)
     counters = {
         "collectives_per_iter": dist.get("collectives_per_iter"),
+        # ISSUE 11: deterministic convergence counters on the fixed-seed
+        # perturbed problem + the s-step communication contract; the
+        # labels make a future precond-config change a LABELLED
+        # apples-to-oranges gap instead of a phantom regression
+        "iters_to_1e-06_none": pc_iters.get("none"),
+        "iters_to_1e-06_jacobi": pc_iters.get("jacobi"),
+        "precond_label": "none+jacobi",
+        "s_step_label": f"s{scfg.s_step}",
+        "sstep_reductions_per_iter": sstep_reductions_per_iter,
         "compiles": snap["cache"]["compiles"],
         "recompiles": snap["cache"]["compiles"] - compiles_after_warmup,
         "cache_hit_rate_requests": snap["cache_hit_rate_requests"],
@@ -163,9 +217,12 @@ def main(argv=None) -> int:
         "workload": {"ndofs": args.ndofs, "nreps": args.nreps,
                      "timing_reps": args.timing_reps,
                      "requests": args.requests,
+                     "pcg_nreps": args.pcg_nreps,
                      "platform": jax.default_backend()},
         "bench": bench,
         "dist": dist,
+        "pcg": pcg,
+        "sstep": sstep,
         "serve": serve,
         "counters": counters,
         "record_contract_errors": record_errs,
@@ -184,6 +241,22 @@ def main(argv=None) -> int:
     if serve["ok_responses"] != args.requests:
         print(f"serve leg lost requests: {serve['ok_responses']}"
               f"/{args.requests}")
+        return 1
+    # ISSUE-11 acceptance, asserted by the collector itself: both
+    # precond arms must CROSS 1e-6, Jacobi strictly below bare, and the
+    # sharded s-step loop strictly below one reduction per iteration
+    if pc_iters.get("none") is None or pc_iters.get("jacobi") is None:
+        print(f"precond legs did not cross rtol 1e-6: {pc_iters} "
+              f"(raise --pcg-nreps)")
+        return 1
+    if not pc_iters["jacobi"] < pc_iters["none"]:
+        print("jacobi PCG did not reduce iterations-to-1e-6: "
+              f"{pc_iters}")
+        return 1
+    if not (sstep_reductions_per_iter is not None
+            and sstep_reductions_per_iter < 1.0):
+        print("s-step leg did not go below one reduction per "
+              f"iteration: {sstep}")
         return 1
     return 0
 

@@ -344,11 +344,21 @@ def test_sharded_batched_cg_vs_global_oracle():
     scales = [1.0, 2.0, 4.0]
     B_global = np.stack([s * b for s in scales]).astype(np.float32)
 
-    # global oracle: the single-chip batched solve
-    X_ref = jax.jit(
-        lambda A, Bv: cg_solve_batched(A.apply, Bv,
-                                       jnp.zeros_like(Bv), nreps)
-    )(op_ref, jnp.asarray(B_global))
+    # global oracle: the single-chip batched solve running the SAME
+    # single-reduction recurrence the sharded path now uses (ISSUE 11
+    # closed the batched-dist remainder: one stacked dot3 psum per
+    # iteration) — so this comparison measures SHARDING parity alone,
+    # not recurrence reassociation drift
+    from bench_tpu_fem.la.cg import batched_dot3
+
+    def oracle(nr):
+        return jax.jit(
+            lambda A, Bv: cg_solve_batched(A.apply, Bv,
+                                           jnp.zeros_like(Bv), nr,
+                                           dot3=batched_dot3)
+        )(op_ref, jnp.asarray(B_global))
+
+    X_ref = oracle(nreps)
 
     bspec = P(None, *AXIS_NAMES)
     sharding = NamedSharding(dgrid.mesh, bspec)
@@ -357,19 +367,52 @@ def test_sharded_batched_cg_vs_global_oracle():
         for i in range(len(scales))])
     Bs = jax.device_put(jnp.asarray(blocks), sharding)
 
+    # SHORT-budget trajectory parity (the overlap-test discipline: the
+    # reassociated recurrence amplifies the psum-vs-local association
+    # seed chaotically with depth, so elementwise parity is only
+    # meaningful over a few iterations)
+    X_ref2 = oracle(2)
+    cg_fn2 = make_kron_batched_cg_fn(op, dgrid, 2)
+    Xs2 = jax.jit(cg_fn2)(Bs, op)
+    for lane in range(len(scales)):
+        x_lane = unshard_grid_blocks(
+            np.asarray(Xs2[lane], np.float64), n, degree, dgrid.dshape)
+        x_ref = np.asarray(X_ref2[lane], np.float64)
+        rel = np.linalg.norm(x_lane - x_ref) / np.linalg.norm(x_ref)
+        # measured ~5e-6 (a few f32 ulps per iteration of psum-vs-local
+        # association drift — the overlap-engine envelope class)
+        assert rel < 2e-5, (
+            f"lane {lane}: sharded batched CG diverged from the global "
+            f"oracle at 2 iterations (rel {rel:.3e})")
+
+    # FULL-budget convergence-quality parity: at 12 iterations the two
+    # same-recurrence implementations' trajectories have decorrelated
+    # at the element level, but both must have converged equally far —
+    # per-lane achieved residual within 2x of the oracle's
     cg_fn = make_kron_batched_cg_fn(op, dgrid, nreps)
     Xs = jax.jit(cg_fn)(Bs, op)
+
+    def rel_res(x_lane, lane):
+        y = np.asarray(op_ref.apply(jnp.asarray(x_lane, jnp.float32)),
+                       np.float64)
+        bl = B_global[lane].astype(np.float64)
+        return (np.linalg.norm(y - bl) / np.linalg.norm(bl))
+
     for lane in range(len(scales)):
         x_lane = unshard_grid_blocks(
             np.asarray(Xs[lane], np.float64), n, degree, dgrid.dshape)
-        # f32 reassociation accuracy: the sharded dots psum in a
-        # different association than the global oracle's (same class of
-        # tolerance as test_dist_kron_cg's CG comparisons)
-        np.testing.assert_allclose(
-            x_lane, np.asarray(X_ref[lane], np.float64),
-            rtol=1e-4, atol=2e-5,
-            err_msg=f"lane {lane}: sharded batched CG diverged from "
-                    "the global oracle")
+        got = rel_res(x_lane, lane)
+        want = rel_res(np.asarray(X_ref[lane], np.float64), lane)
+        assert got < 2.0 * want + 1e-6, (
+            f"lane {lane}: sharded batched CG converged to {got:.3e} "
+            f"vs the oracle's {want:.3e}")
+
+    # the satellite's trace contract: ONE stacked psum per iteration
+    # (the fused dot3), no separate per-dot psums left in the loop
+    from bench_tpu_fem.analysis.capture import loop_collective_counts
+
+    counts = loop_collective_counts(cg_fn, Bs, op)
+    assert counts.get("reductions") == 1, counts
 
 
 def test_driver_batched_lane0_matches_one_shot():

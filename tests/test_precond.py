@@ -1,0 +1,447 @@
+"""Preconditioned CG (ISSUE 11): the matrix-free Jacobi diagonal against
+the assembled-CSR oracle, PCG-vs-CG same-answer parity, the
+`precond=None` bitwise pin against a frozen pre-PR replica, p-multigrid
+transfer identities, and the driver-level acceptance measurement
+(Jacobi and Chebyshev each reduce iterations-to-1e-6 on the fixed-seed
+perturbed problem, stamped through the convergence block)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bench_tpu_fem.elements.tables import build_operator_tables
+from bench_tpu_fem.fem.assemble import (
+    assemble_csr,
+    csr_diag_inv,
+    element_stiffness_matrices,
+)
+from bench_tpu_fem.fem.geometry import geometry_factors
+from bench_tpu_fem.la.cg import cg_solve
+from bench_tpu_fem.la.precond import (
+    build_chebyshev_bundle,
+    jacobi_dinv_general,
+    jacobi_dinv_uniform,
+    jacobi_dinv_uniform_host,
+    make_jacobi,
+    op_jacobi_dinv,
+)
+from bench_tpu_fem.mesh import create_box_mesh, dof_grid_shape
+from bench_tpu_fem.mesh.dofmap import boundary_dof_marker, cell_dofmap
+from bench_tpu_fem.ops import build_laplacian
+
+KAPPA = 2.0
+
+
+def _problem(degree, pert, n=(3, 3, 3), seed=3, dtype=jnp.float64):
+    mesh = create_box_mesh(n, geom_perturb_fact=pert)
+    backend = "kron" if pert == 0.0 else "xla"
+    op = build_laplacian(mesh, degree, 1, dtype=dtype, backend=backend,
+                         kappa=KAPPA)
+    bc = boundary_dof_marker(n, degree)
+    rng = np.random.RandomState(seed)
+    b_np = np.where(bc, 0.0, rng.randn(*dof_grid_shape(n, degree)))
+    np_dt = np.float32 if dtype == jnp.float32 else np.float64
+    return mesh, op, jnp.asarray(b_np.astype(np_dt))
+
+
+def _csr_dinv(degree, pert, n=(3, 3, 3)):
+    t = build_operator_tables(degree, 1, "gll")
+    mesh = create_box_mesh(n, geom_perturb_fact=pert)
+    dm = cell_dofmap(n, degree)
+    bc = boundary_dof_marker(n, degree)
+    corners = mesh.cell_corners.reshape(-1, 2, 2, 2, 3)
+    G, _ = geometry_factors(corners, t.pts1d, t.wts1d, compute_G=True)
+    A = assemble_csr(element_stiffness_matrices(t, G, KAPPA), dm,
+                     bc.ravel())
+    return csr_diag_inv(A).reshape(dof_grid_shape(n, degree)), t, mesh
+
+
+# ---------------------------------------------------------------------------
+# Jacobi diagonal: matrix-free vs the assembled-matrix oracle.
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("degree,pert", [
+    (1, 0.0), (1, 0.2), (3, 0.0), (3, 0.2),
+    # degree 6 builds (nq^3, nd^3)-scale 3D tables in the CSR oracle —
+    # ~16 s each; the fast lane carries degrees 1 and 3
+    pytest.param(6, 0.0, marks=pytest.mark.slow),
+    pytest.param(6, 0.15, marks=pytest.mark.slow),
+])
+def test_jacobi_diag_matches_csr_oracle(degree, pert):
+    """The sum-factorised basis-squared contraction must reproduce the
+    assembled CSR diagonal at machine precision — an independent
+    discretisation path (full 3D tables vs separable contraction)."""
+    dref, t, mesh = _csr_dinv(degree, pert)
+    op = build_laplacian(mesh, degree, 1, dtype=jnp.float64,
+                         backend="xla", kappa=KAPPA)
+    dgen = np.asarray(jacobi_dinv_general(
+        op.G, t.phi0, t.dphi1, op.bc_mask, KAPPA, mesh.n, degree))
+    np.testing.assert_allclose(dgen, dref, rtol=1e-13)
+
+
+@pytest.mark.parametrize("degree", [
+    1, 3, pytest.param(6, marks=pytest.mark.slow)])
+def test_jacobi_diag_uniform_routes_agree(degree):
+    """On a uniform mesh the three routes — 1D-diagonal kron route
+    (device and host twins) and the operator-introspecting
+    `op_jacobi_dinv` — must all equal the CSR oracle."""
+    dref, t, mesh = _csr_dinv(degree, 0.0)
+    duni = np.asarray(jacobi_dinv_uniform(t, mesh.n, KAPPA, jnp.float64))
+    np.testing.assert_allclose(duni, dref, rtol=1e-13)
+    dhost = jacobi_dinv_uniform_host(t, mesh.n, KAPPA, np.float64)
+    np.testing.assert_allclose(dhost, dref, rtol=1e-13)
+    op = build_laplacian(mesh, degree, 1, dtype=jnp.float64,
+                         backend="kron", kappa=KAPPA)
+    dop = np.asarray(op_jacobi_dinv(op))
+    np.testing.assert_allclose(dop, dref, rtol=1e-13)
+
+
+# ---------------------------------------------------------------------------
+# PCG correctness: same answer, fewer iterations, bitwise-off contract.
+# ---------------------------------------------------------------------------
+
+
+def test_pcg_matches_cg_tight_rtol_f64():
+    """Jacobi-PCG and bare CG solve the SAME system: run both to a
+    tight rtol and the answers must agree far below it."""
+    _, op, b = _problem(3, 0.2, n=(4, 4, 4))
+    dinv = op_jacobi_dinv(op)
+    x0 = jnp.zeros_like(b)
+    xs = jax.jit(lambda b, x0: cg_solve(op.apply, b, x0, 400,
+                                        rtol=1e-10))(b, x0)
+    xp = jax.jit(lambda b, x0: cg_solve(
+        op.apply, b, x0, 400, rtol=1e-10,
+        precond=make_jacobi(dinv)))(b, x0)
+    rel = (np.linalg.norm(np.asarray(xp - xs))
+           / np.linalg.norm(np.asarray(xs)))
+    assert rel < 1e-9, rel
+
+
+def test_pcg_matches_cg_f32():
+    """f32 twin at a looser rtol (the f32 floor)."""
+    _, op, b = _problem(3, 0.2, n=(4, 4, 4), dtype=jnp.float32)
+    dinv = op_jacobi_dinv(op)
+    x0 = jnp.zeros_like(b)
+    xs = jax.jit(lambda b, x0: cg_solve(op.apply, b, x0, 300,
+                                        rtol=1e-5))(b, x0)
+    xp = jax.jit(lambda b, x0: cg_solve(
+        op.apply, b, x0, 300, rtol=1e-5,
+        precond=make_jacobi(dinv)))(b, x0)
+    rel = (np.linalg.norm(np.asarray(xp - xs, np.float64))
+           / np.linalg.norm(np.asarray(xs, np.float64)))
+    assert rel < 1e-3, rel
+
+
+def test_pcg_sentinel_and_capture_compose():
+    """sentinel+capture ride the PCG loop: healthy solve, zero
+    breakdown counters, history starts at <r0,r0> and is monotone-ish
+    to the captured budget."""
+    _, op, b = _problem(3, 0.2)
+    dinv = op_jacobi_dinv(op)
+    x, info = jax.jit(lambda b: cg_solve(
+        op.apply, b, jnp.zeros_like(b), 30, precond=make_jacobi(dinv),
+        sentinel=True, capture=True))(b)
+    assert int(info["breakdown_restarts"]) == 0
+    assert not bool(info["nonfinite"])
+    h = np.asarray(info["rnorm_history"])
+    assert h.shape == (31,)
+    np.testing.assert_allclose(
+        h[0], float(jnp.vdot(b, b)), rtol=1e-12)
+    assert h[-1] < h[0]
+    assert np.isfinite(np.asarray(x)).all()
+
+
+def test_precond_dot3_mutually_exclusive():
+    from bench_tpu_fem.la.cg import stacked_dot3
+
+    _, op, b = _problem(1, 0.0)
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        cg_solve(op.apply, b, jnp.zeros_like(b), 4,
+                 precond=lambda r: r, dot3=stacked_dot3)
+
+
+def test_chebyshev_preconditioner_is_symmetric():
+    """<M r1, r2> == <r1, M r2>: the fixed Chebyshev polynomial is a
+    symmetric operator — the property plain (non-flexible) PCG needs."""
+    _, op, b = _problem(3, 0.2)
+    dinv = op_jacobi_dinv(op)
+    bundle = build_chebyshev_bundle(op.apply, dinv, dinv.shape,
+                                    jnp.float64)
+    rng = np.random.RandomState(5)
+    bc = np.asarray(op.bc_mask)
+    r1 = jnp.asarray(np.where(bc, 0.0, rng.randn(*bc.shape)))
+    r2 = jnp.asarray(np.where(bc, 0.0, rng.randn(*bc.shape)))
+    a = float(jnp.vdot(bundle.apply(r1), r2))
+    c = float(jnp.vdot(r1, bundle.apply(r2)))
+    assert abs(a - c) / abs(a) < 1e-12, (a, c)
+    assert bundle.params["lmax"] > bundle.params["lmin"] > 0
+
+
+# ---------------------------------------------------------------------------
+# precond=None bitwise pin: the frozen pre-ISSUE-11 replica.
+# ---------------------------------------------------------------------------
+
+
+def _frozen_pre_pr_cg_solve(apply_A, b, x0, max_iter):
+    """The pre-ISSUE-11 `la.cg.cg_solve` plain loop, frozen VERBATIM
+    (rtol=0, no sentinel/capture/dot3 — the benchmark recurrence).
+    `cg_solve(precond=None)` must reproduce it bit-for-bit."""
+    from bench_tpu_fem.la.vector import inner_product
+
+    dot = inner_product
+    y = apply_A(x0)
+    r = b - y
+    p = r
+    rnorm0 = dot(p, r)
+
+    def body(i, state):
+        x, r, p, rnorm, done = state
+        y = apply_A(p)
+        pdot = dot(p, y)
+        alpha = rnorm / pdot
+        x1 = x + alpha * p
+        r1 = r - alpha * y
+        rnorm_new = dot(r1, r1)
+        beta = rnorm_new / rnorm
+        p1 = beta * p + r1
+        new_done = jnp.logical_or(done, rnorm_new / rnorm0 < 0.0)
+        new_done = jnp.logical_or(
+            new_done, rnorm_new == jnp.zeros((), rnorm_new.dtype))
+        keep = lambda new, old: jnp.where(done, old, new)  # noqa: E731
+        return (keep(x1, x), keep(r1, r), keep(p1, p),
+                keep(rnorm_new, rnorm), new_done)
+
+    state = (x0, r, p, rnorm0, jnp.asarray(False))
+    x, *_ = jax.lax.fori_loop(0, max_iter, body, state)
+    return x
+
+
+def test_precond_none_bitwise_pre_pr_solve():
+    """The PR-10 discipline extended to ISSUE 11: `precond=None` is the
+    pre-PR solve BIT-FOR-BIT (the PCG routing is a pure python branch
+    to a separate body)."""
+    _, op, b = _problem(3, 0.2, dtype=jnp.float32)
+    x0 = jnp.zeros_like(b)
+    got = jax.jit(lambda b, x0: cg_solve(op.apply, b, x0, 25,
+                                         precond=None))(b, x0)
+    want = jax.jit(lambda b, x0: _frozen_pre_pr_cg_solve(
+        op.apply, b, x0, 25))(b, x0)
+    assert np.array_equal(np.asarray(got), np.asarray(want))
+
+
+# ---------------------------------------------------------------------------
+# p-multigrid: transfer identities + it actually preconditions.
+# ---------------------------------------------------------------------------
+
+
+def test_pmg_restriction_prolongation_identity():
+    """Interpolation restriction after prolongation is EXACTLY the
+    identity on the coarse space (a degree-p_c polynomial interpolated
+    up and sampled back is lossless), in 1D and through the 3D tensor
+    application."""
+    from bench_tpu_fem.elements.lagrange import gll_nodes
+    from bench_tpu_fem.la.pmg import (
+        prolongation_1d,
+        restriction_interp_1d,
+        tensor3_apply,
+    )
+
+    for pf, pc, nc in [(4, 2, 3), (3, 1, 2), (6, 3, 2)]:
+        Pm = prolongation_1d(gll_nodes(pf), gll_nodes(pc), nc)
+        Rm = restriction_interp_1d(gll_nodes(pf), gll_nodes(pc), nc)
+        np.testing.assert_allclose(Rm @ Pm, np.eye(Pm.shape[1]),
+                                   atol=1e-12)
+    # 3D: prolongate a random coarse grid, interpolate back
+    Pm = prolongation_1d(gll_nodes(4), gll_nodes(2), 2)
+    Rm = restriction_interp_1d(gll_nodes(4), gll_nodes(2), 2)
+    rng = np.random.RandomState(0)
+    vc = jnp.asarray(rng.randn(5, 5, 5))
+    Pj, Rj = jnp.asarray(Pm), jnp.asarray(Rm)
+    back = tensor3_apply(tensor3_apply(vc, Pj, Pj, Pj), Rj, Rj, Rj)
+    np.testing.assert_allclose(np.asarray(back), np.asarray(vc),
+                               atol=1e-12)
+
+
+@pytest.mark.slow  # 3-level hierarchy + power-method compiles (~25 s)
+def test_pmg_vcycle_symmetric_and_preconditions():
+    """The V-cycle is a symmetric operator and cuts iterations-to-rtol
+    on the perturbed problem (the spectral-equivalence sanity check)."""
+    from bench_tpu_fem.la.pmg import build_pmg_bundle
+    from bench_tpu_fem.obs.convergence import iters_to_rtol
+
+    mesh, op, b = _problem(4, 0.2, n=(3, 3, 3))
+    bundle = build_pmg_bundle(mesh, 4, 1, KAPPA, jnp.float64, "xla")
+    assert bundle.params["levels"] == [4, 2, 1]
+    rng = np.random.RandomState(5)
+    bc = np.asarray(op.bc_mask)
+    r1 = jnp.asarray(np.where(bc, 0.0, rng.randn(*bc.shape)))
+    r2 = jnp.asarray(np.where(bc, 0.0, rng.randn(*bc.shape)))
+    a = float(jnp.vdot(bundle.apply(r1), r2))
+    c = float(jnp.vdot(r1, bundle.apply(r2)))
+    assert abs(a - c) / abs(a) < 1e-12, (a, c)
+    _, ib = jax.jit(lambda b: cg_solve(op.apply, b, jnp.zeros_like(b),
+                                       120, capture=True))(b)
+    _, ip = jax.jit(lambda b: cg_solve(op.apply, b, jnp.zeros_like(b),
+                                       120, capture=True,
+                                       precond=bundle.apply))(b)
+    i_bare = iters_to_rtol(np.asarray(ib["rnorm_history"]))["1e-06"]
+    i_pmg = iters_to_rtol(np.asarray(ip["rnorm_history"]))["1e-06"]
+    assert i_pmg is not None and i_bare is not None
+    assert i_pmg < i_bare, (i_pmg, i_bare)
+
+
+# ---------------------------------------------------------------------------
+# Driver-level acceptance: iterations drop on the fixed-seed perturbed
+# problem, stamped through the convergence block.
+# ---------------------------------------------------------------------------
+
+
+def _acceptance_cfg(**kw):
+    from bench_tpu_fem.bench.driver import BenchConfig
+
+    return BenchConfig(ndofs_global=4096, degree=3, qmode=1,
+                       float_bits=32, nreps=150, use_cg=True,
+                       geom_perturb_fact=0.2, convergence=True, **kw)
+
+
+def test_driver_jacobi_and_chebyshev_reduce_iters():
+    """THE acceptance measurement (CPU): on the fixed-seed
+    perturbed-geometry degree-3 problem, Jacobi and Chebyshev PCG each
+    reduce iterations-to-rtol-1e-6 vs unpreconditioned CG, stamped via
+    the convergence block with the precond label and setup cost."""
+    from bench_tpu_fem.bench.driver import run_benchmark
+
+    res0 = run_benchmark(_acceptance_cfg())
+    i0 = res0.extra["convergence"]["iters_to_rtol"]["1e-06"]
+    assert i0 is not None
+    assert res0.extra["convergence"]["precond"] == "none"
+    for kind in ("jacobi", "chebyshev"):
+        r = run_benchmark(_acceptance_cfg(precond=kind))
+        conv = r.extra["convergence"]
+        ik = conv["iters_to_rtol"]["1e-06"]
+        assert ik is not None and ik < i0, (kind, ik, i0)
+        assert conv["precond"] == kind
+        pre = r.extra["precond"]
+        assert pre["kind"] == kind
+        assert pre["setup_s"] >= 0.0
+        assert r.extra["roofline"]["precond_cost"]["kind"] == kind
+        assert r.extra["time_to_rtol_s"]["1e-06"] is not None
+        # solution parity with the bare solve (same system)
+        assert abs(r.ynorm - res0.ynorm) / res0.ynorm < 1e-4
+
+
+def test_driver_precond_gate_reasons():
+    """Requests that cannot be served record their gate reason, never
+    silently: action runs, and precond on the fused-gated batched df
+    path, both stamp `precond` blocks with kind 'none' + reason."""
+    from bench_tpu_fem.bench.driver import BenchConfig, run_benchmark
+
+    cfg = BenchConfig(ndofs_global=1000, degree=2, qmode=1,
+                      float_bits=32, nreps=3, use_cg=False,
+                      precond="jacobi")
+    res = run_benchmark(cfg)
+    assert res.extra["precond"]["kind"] == "none"
+    assert "precond_gate_reason" in res.extra
+    assert "CG solves only" in res.extra["precond_gate_reason"]
+
+
+@pytest.mark.slow  # interpret-mode df solve + a second full compile
+def test_df_pcg_parity_and_driver_stamp():
+    """df twin: cg_solve_df(precond=jacobi) converges to the same
+    answer as the bare df solve (both at the df floor), and the df
+    driver stamps the precond block."""
+    from bench_tpu_fem.elements.tables import build_operator_tables
+    from bench_tpu_fem.la.df64 import df_to_f64
+    from bench_tpu_fem.la.precond import make_jacobi_df
+    from bench_tpu_fem.ops.kron_df import (
+        build_kron_laplacian_df,
+        cg_solve_df,
+        device_rhs_uniform_df,
+    )
+
+    prev = jax.config.jax_enable_x64
+    jax.config.update("jax_enable_x64", False)
+    try:
+        n = (4, 4, 4)
+        mesh = create_box_mesh(n)
+        t = build_operator_tables(3, 1, "gll")
+        op = build_kron_laplacian_df(mesh, 3, 1, "gll", kappa=KAPPA,
+                                     tables=t)
+        u = device_rhs_uniform_df(t, mesh.n)
+        dinv32 = jacobi_dinv_uniform(t, n, KAPPA, jnp.float32)
+        x0 = jax.jit(lambda u: cg_solve_df(op, u, 200))(u)
+        x1 = jax.jit(lambda u: cg_solve_df(
+            op, u, 200, precond=make_jacobi_df(dinv32)))(u)
+    finally:
+        jax.config.update("jax_enable_x64", prev)
+    a = np.asarray(df_to_f64(x0))
+    c = np.asarray(df_to_f64(x1))
+    rel = np.linalg.norm(a - c) / np.linalg.norm(a)
+    assert rel < 1e-11, rel
+
+
+@pytest.mark.slow  # sharded compiles on the 8-virtual-device mesh
+def test_sharded_pcg_parity_and_psum_count():
+    """Sharded kron PCG (jacobi + chebyshev): parity vs the single-chip
+    PCG of the same global problem, and the trace-level contract — TWO
+    psums per iteration (the <p,Ap> dot + the fused (<r,z>, <r,r>)
+    pair), the synchronous bare loop's count."""
+    from bench_tpu_fem.analysis.capture import loop_collective_counts
+    from bench_tpu_fem.dist.kron import (
+        build_dist_kron,
+        make_kron_pcg_fn,
+        make_kron_rhs_fn,
+    )
+    from bench_tpu_fem.dist.mesh import make_device_grid
+    from bench_tpu_fem.dist.operator import (
+        shard_grid_blocks,
+        unshard_grid_blocks,
+    )
+    from jax.sharding import NamedSharding, PartitionSpec as P
+    from bench_tpu_fem.dist.mesh import AXIS_NAMES
+
+    degree, n, nreps = 3, (4, 4, 4), 8
+    dgrid = make_device_grid(dshape=(2, 2, 2))
+    op = build_dist_kron(n, dgrid, degree, 1, dtype=jnp.float32)
+    t = build_operator_tables(degree, 1, "gll")
+    b = jax.jit(make_kron_rhs_fn(op, dgrid, t))()
+
+    mesh = create_box_mesh(n)
+    op_ref = build_laplacian(mesh, degree, 1, dtype=jnp.float32,
+                             backend="kron", kappa=KAPPA)
+    dinv_ref = op_jacobi_dinv(op_ref)
+    from bench_tpu_fem.la.precond import jacobi_dinv_uniform_host
+
+    dinv_host = jacobi_dinv_uniform_host(t, n, KAPPA, np.float32)
+    np.testing.assert_allclose(np.asarray(dinv_ref), dinv_host,
+                               rtol=2e-7)
+    sharding = NamedSharding(dgrid.mesh, P(*AXIS_NAMES))
+    dinv = jax.device_put(jnp.asarray(
+        shard_grid_blocks(dinv_host, n, degree, dgrid.dshape)), sharding)
+
+    b_global = unshard_grid_blocks(np.asarray(b, np.float64), n, degree,
+                                   dgrid.dshape).astype(np.float32)
+    x_ref = jax.jit(lambda bb: cg_solve(
+        op_ref.apply, bb, jnp.zeros_like(bb), nreps,
+        precond=make_jacobi(dinv_ref)))(jnp.asarray(b_global))
+
+    pcg_fn = make_kron_pcg_fn(op, dgrid, nreps, "jacobi")
+    xs = jax.jit(pcg_fn)(b, op, dinv)
+    x_got = unshard_grid_blocks(np.asarray(xs, np.float64), n, degree,
+                                dgrid.dshape)
+    rel = (np.linalg.norm(x_got - np.asarray(x_ref, np.float64))
+           / np.linalg.norm(np.asarray(x_ref, np.float64)))
+    assert rel < 2e-5, rel
+
+    counts = loop_collective_counts(pcg_fn, b, op, dinv)
+    assert counts.get("reductions") == 2, counts
+
+    # chebyshev form traces with the same reduction count (the extra
+    # applies add ppermutes — movements — never reductions)
+    cheb_fn = make_kron_pcg_fn(op, dgrid, nreps, "chebyshev",
+                               cheb=(2.0, 2.0 / 30.0, 3))
+    counts_c = loop_collective_counts(cheb_fn, b, op, dinv)
+    assert counts_c.get("reductions") == 2, counts_c
+    assert counts_c.get("movements", 0) > counts.get("movements", 0)
