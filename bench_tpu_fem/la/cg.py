@@ -932,6 +932,192 @@ def fused_cg_solve_batched(engine: Callable, B: jnp.ndarray, nreps: int,
     return batched_cg_run(state, step, nreps).X
 
 
+# ---------------------------------------------------------------------------
+# df (double-float) batched checkpointable recurrence — closing the PR 6
+# remainder: df32 requests could not ride continuous batching because the
+# vmapped `cg_solve_df` recurrence was ONE whole-solve executable with no
+# iteration boundary. This is the same lane-major state machine as
+# `BatchedCGState`, carried in compensated (hi, lo) arithmetic: per-lane
+# algebra stays lane-local (the batched operator apply is lane-diagonal,
+# every scalar is a per-lane DF pair), so admit/retire remain pure
+# per-lane state edits and the frozen-lane `keep` discipline transfers
+# unchanged. The recurrence is the p-update-reassociated form (p = beta *
+# p_prev + r at the START of the iteration): the identical df op sequence
+# as `ops.kron_df.cg_solve_df` moved across the loop boundary, so the
+# vmapped whole-solve df executable stays the parity oracle (df-class
+# <= 1e-13, the standing serve convention). The df residual-floor freeze
+# (rnorm.hi <= 1e-24 * rnorm0.hi, rel residual ~1e-12 — see
+# cg_solve_df's docstring) is carried PER LANE next to each lane's own
+# iteration budget.
+# ---------------------------------------------------------------------------
+
+#: the df64 recurrence's per-lane squared-residual freeze floor
+#: (hi-channel, relative): rel residual ~1e-12, cg_solve_df's constant.
+DF_BATCH_FLOOR = 1e-24
+
+
+class BatchedCGStateDF(NamedTuple):
+    """One batched df CG solve at an iteration boundary: DF pytrees for
+    the lane-major vectors ((nrhs, ...) hi/lo pairs) and per-lane DF
+    scalar pairs ((nrhs,)) for the recurrence scalars. `rnorm0_hi` keeps
+    only the hi channel — it exists for the floor freeze and the
+    born-frozen padding convention (rnorm0 == 0), neither of which needs
+    the lo channel."""
+
+    X: object  # DF (nrhs, ...)
+    R: object  # DF
+    P: object  # DF
+    beta: object  # DF (nrhs,)
+    rnorm: object  # DF (nrhs,)
+    rnorm0_hi: jnp.ndarray  # (nrhs,) f32
+    done: jnp.ndarray
+    iters: jnp.ndarray
+
+
+def batched_dot_df(A, B):
+    """Per-lane <a, b> as DF (nrhs,) scalars: vmapped `df_dot`, so each
+    lane runs the exact compensated reduction order of the scalar df
+    solve — the parity contract's foundation."""
+    from .df64 import df_dot
+
+    return jax.vmap(df_dot)(A, B)
+
+
+def batched_cg_init_df(B) -> BatchedCGStateDF:
+    """Fresh df state for a padded DF RHS stack (x0 = 0; all-zero lanes
+    born frozen, the padding convention of `batched_cg_init`)."""
+    from .df64 import DF, df_zeros_like
+
+    rnorm0 = batched_dot_df(B, B)
+    nrhs = B.hi.shape[0]
+    zscal = DF(jnp.zeros((nrhs,), jnp.float32),
+               jnp.zeros((nrhs,), jnp.float32))
+    return BatchedCGStateDF(
+        X=df_zeros_like(B),
+        R=B,
+        P=df_zeros_like(B),
+        beta=zscal,
+        rnorm=rnorm0,
+        rnorm0_hi=rnorm0.hi,
+        done=rnorm0.hi == jnp.zeros((), rnorm0.hi.dtype),
+        iters=jnp.zeros((nrhs,), jnp.int32),
+    )
+
+
+def make_batched_cg_step_df(batch_apply: Callable, nreps: int) -> Callable:
+    """One iteration `state -> state` of the batched df recurrence.
+    `batch_apply` is the lane-major DF operator apply (e.g.
+    `jax.vmap(op.apply)` over a KronLaplacianDF). Frozen-lane discipline
+    as in `make_batched_cg_step`; a lane freezes on its own iteration
+    budget OR on the df residual floor (the cg_solve_df freeze guard,
+    per lane)."""
+    from .df64 import df_add, df_div, df_sub
+
+    def step(state: BatchedCGStateDF) -> BatchedCGStateDF:
+        X, R, P_prev, beta, rnorm, rnorm0_hi, done, iters = state
+        P = df_add(_df_scale_lanes(P_prev, beta), R)
+        Y = batch_apply(P)
+        pdot = batched_dot_df(P, Y)
+        alpha = df_div(rnorm, pdot)
+        X1 = df_add(X, _df_scale_lanes(P, alpha))
+        R1 = df_sub(R, _df_scale_lanes(Y, alpha))
+        rnorm1 = batched_dot_df(R1, R1)
+        beta1 = df_div(rnorm1, rnorm)
+        iters1 = iters + 1
+        floor = jnp.float32(DF_BATCH_FLOOR)
+        new_done = jnp.logical_or(done, iters1 >= jnp.int32(nreps))
+        new_done = jnp.logical_or(new_done, rnorm1.hi <= floor * rnorm0_hi)
+
+        def keep(new, old):
+            return jax.tree_util.tree_map(
+                lambda n, o: jnp.where(_bcast(done, o), o, n), new, old)
+
+        def keep1(new, old):
+            return jax.tree_util.tree_map(
+                lambda n, o: jnp.where(done, o, n), new, old)
+
+        return BatchedCGStateDF(
+            X=keep(X1, X),
+            R=keep(R1, R),
+            P=keep(P, P_prev),
+            beta=keep1(beta1, beta),
+            rnorm=keep1(rnorm1, rnorm),
+            rnorm0_hi=rnorm0_hi,
+            done=new_done,
+            iters=jnp.where(done, iters, iters1),
+        )
+
+    return step
+
+
+def _df_scale_lanes(A, s):
+    """Lane-major DF array times per-lane DF scalars: the batched
+    spelling of `df_scale(a, scalar)` — same elementwise df ops, the
+    scalar pair broadcast across each lane."""
+    from .df64 import DF, df_mul
+
+    shape = (-1,) + (1,) * (A.hi.ndim - 1)
+    return df_mul(A, DF(jnp.broadcast_to(s.hi.reshape(shape), A.hi.shape),
+                        jnp.broadcast_to(s.lo.reshape(shape),
+                                         A.hi.shape)))
+
+
+def batched_cg_admit_df(state: BatchedCGStateDF, lane,
+                        b) -> BatchedCGStateDF:
+    """Admit a DF RHS into one lane at an iteration boundary — the lane
+    restarts exactly as a fresh `batched_cg_init_df` lane (x0 = 0, its
+    own rnorm0/iters); every edit is lane-local."""
+    from .df64 import DF, df_dot
+
+    rn = df_dot(b, b)
+    zero_hi = jnp.zeros_like(b.hi)
+    zs = jnp.zeros((), jnp.float32)
+
+    def set_vec(A, new_hi, new_lo):
+        return DF(A.hi.at[lane].set(new_hi), A.lo.at[lane].set(new_lo))
+
+    def set_scal(s, new):
+        return DF(s.hi.at[lane].set(new.hi), s.lo.at[lane].set(new.lo))
+
+    return BatchedCGStateDF(
+        X=set_vec(state.X, zero_hi, zero_hi),
+        R=set_vec(state.R, b.hi, b.lo),
+        P=set_vec(state.P, zero_hi, zero_hi),
+        beta=set_scal(state.beta, DF(zs, zs)),
+        rnorm=set_scal(state.rnorm, rn),
+        rnorm0_hi=state.rnorm0_hi.at[lane].set(rn.hi),
+        done=state.done.at[lane].set(rn.hi == zs),
+        iters=state.iters.at[lane].set(jnp.zeros((), jnp.int32)),
+    )
+
+
+def batched_cg_retire_df(state: BatchedCGStateDF, lane) -> BatchedCGStateDF:
+    """Retire one lane: zero its df state and mark it born-frozen
+    (rnorm0 = 0, the padding convention), freeing the lane for a future
+    admit. Lane-local; live lanes bit-untouched."""
+    from .df64 import DF
+
+    zero_hi = jnp.zeros_like(state.X.hi[0])
+    zs = jnp.zeros((), jnp.float32)
+
+    def set_vec(A):
+        return DF(A.hi.at[lane].set(zero_hi), A.lo.at[lane].set(zero_hi))
+
+    def set_scal(s):
+        return DF(s.hi.at[lane].set(zs), s.lo.at[lane].set(zs))
+
+    return BatchedCGStateDF(
+        X=set_vec(state.X),
+        R=set_vec(state.R),
+        P=set_vec(state.P),
+        beta=set_scal(state.beta),
+        rnorm=set_scal(state.rnorm),
+        rnorm0_hi=state.rnorm0_hi.at[lane].set(zs),
+        done=state.done.at[lane].set(True),
+        iters=state.iters.at[lane].set(jnp.zeros((), jnp.int32)),
+    )
+
+
 def fused_cg_solve(
     engine: Callable,
     b: jnp.ndarray,

@@ -347,10 +347,19 @@ LOWER_IS_BETTER_COUNTERS = (
     # ISSUE 11: reductions per CG iteration of the sharded s-step loop
     # (trace-level, noise-free; an increase = a collective crept back)
     "sstep_reductions_per_iter",
+    # ISSUE 13 fleet counters: a standby replica that COMPILES instead
+    # of warming from the shared artifact store, or a lost/duplicated
+    # response in the fleet's exactly-once ledger, is a regression
+    "fleet_warm_replica_recompiles", "fleet_lost", "fleet_duplicates",
 )
 #: snapshot keys where a DECREASE below baseline is a regression
 HIGHER_IS_BETTER_COUNTERS = (
     "cache_hit_rate_requests", "responses_ok", "completed",
+    # ISSUE 13: the pinned imbalance schedule must keep stealing, the
+    # affinity router must keep hitting, and artifact warm loads must
+    # keep happening — a drop on any of these is the fleet logic
+    # silently degrading to single-device behaviour
+    "fleet_steals", "fleet_affinity_hit_rate", "fleet_warm_loads",
 )
 #: contract booleans: baseline True -> current must stay True
 CONTRACT_FLAGS = ("record_contract_ok", "trace_valid")

@@ -23,11 +23,19 @@ north star names:
   metrics.py  counters + crash-safe JSONL journal (harness.journal),
               with `replay_serve` folding a journal back into the
               incident summary
+  fleet.py    multi-device dispatch (ISSUE 13): per-device queues with
+              spec-aware affinity routing, work stealing, SLO-burn
+              spill, and standby journal adoption
+  artifacts.py  shared AOT executable-artifact store: serialized
+              compiled solvers keyed like cache.ExecutableKey, so
+              replicas warm from peers with zero recompiles
 
 Everything is stdlib + the existing jax stack: no new dependencies.
 """
 
+from .artifacts import ArtifactStore, ArtifactWarmCache
 from .broker import Broker, QueueFull, RETRIABLE_CLASSES
+from .fleet import FleetDispatcher
 from .cache import (
     NRHS_BUCKETS,
     ExecutableCache,
@@ -36,6 +44,7 @@ from .cache import (
     nrhs_bucket,
 )
 from .engine import (
+    ArtifactIncompatible,
     BatchResult,
     CompiledSolver,
     SolveSpec,
@@ -44,16 +53,21 @@ from .engine import (
     planned_engine_form,
     spec_cache_key,
 )
-from .metrics import Metrics, prometheus_text, replay_serve
+from .metrics import FleetMetrics, Metrics, prometheus_text, replay_serve
 from .recovery import RecoveryPlan, fold_outstanding, verify_exactly_once
 from .server import make_server
 
 __all__ = [
+    "ArtifactIncompatible",
+    "ArtifactStore",
+    "ArtifactWarmCache",
     "BatchResult",
     "Broker",
     "CompiledSolver",
     "ExecutableCache",
     "ExecutableKey",
+    "FleetDispatcher",
+    "FleetMetrics",
     "Metrics",
     "NRHS_BUCKETS",
     "QueueFull",

@@ -54,6 +54,31 @@ def main(argv=None) -> int:
     p.add_argument("--slo-target", type=float, default=0.99,
                    help="SLO availability target (fraction of requests "
                         "inside the objective)")
+    p.add_argument("--fleet", type=int, default=0,
+                   help="run a FLEET of N device lanes (ISSUE 13): "
+                        "per-device queues, spec-aware affinity "
+                        "routing, work stealing, SLO-burn spill. 0 "
+                        "(default) = single broker. On CPU the lanes "
+                        "pin to N virtual devices.")
+    p.add_argument("--artifacts", default="",
+                   help="shared AOT executable-artifact store directory "
+                        "(serve.artifacts): lanes publish compiled "
+                        "executables and warm misses from peers with "
+                        "zero recompiles")
+    p.add_argument("--adopt-journal", default="",
+                   help="standby adoption: fold this (dead primary's) "
+                        "write-ahead journal at startup and answer "
+                        "every admitted-but-unresponded request "
+                        "exactly once under its original id")
+    p.add_argument("--steal-threshold", type=int, default=4,
+                   help="fleet: queue-depth gap that triggers a steal "
+                        "pass (half the gap moves)")
+    p.add_argument("--spill-burn", type=float, default=1.0,
+                   help="fleet: fast-window SLO burn rate above which "
+                        "arrivals spill to a colder device (needs "
+                        "--slo-objective > 0)")
+    p.add_argument("--balance-interval-ms", type=float, default=20.0,
+                   help="fleet balancer tick; 0 disables stealing")
     p.add_argument("--warmup", default="",
                    help="comma-separated degrees to prebuild at startup "
                         "(with --ndofs/--nreps/--precision), e.g. '1,3,6'")
@@ -73,7 +98,10 @@ def main(argv=None) -> int:
     if os.environ.get("JAX_PLATFORMS", "") == "cpu":
         from ..utils.hermetic import force_host_cpu_devices
 
-        force_host_cpu_devices(1)
+        # fleet mode gets one virtual device per lane — the multi-device
+        # dispatch is CPU-provable on the same virtual-device mechanism
+        # the test suite uses
+        force_host_cpu_devices(max(1, args.fleet))
     import jax
 
     # Serving accepts mixed precision in one process: x64 on, so
@@ -87,18 +115,45 @@ def main(argv=None) -> int:
     from .metrics import Metrics
     from .server import make_server
 
-    metrics = Metrics(
-        args.journal or None,
-        slo_objective_s=args.slo_objective or None,
-        slo_target=args.slo_target,
-    )
-    broker = Broker(
-        ExecutableCache(), metrics,
-        queue_max=args.queue_max, nrhs_max=args.nrhs_max,
-        window_s=args.window_ms / 1000.0,
-        solve_timeout_s=args.solve_timeout,
-        continuous=not args.no_continuous,
-    )
+    store = None
+    if args.artifacts:
+        from .artifacts import ArtifactStore
+
+        store = ArtifactStore(args.artifacts)
+    if args.fleet:
+        from .fleet import FleetDispatcher
+
+        broker = FleetDispatcher(
+            args.fleet, journal_path=args.journal or None,
+            artifacts=store,
+            queue_max=args.queue_max, nrhs_max=args.nrhs_max,
+            window_s=args.window_ms / 1000.0,
+            solve_timeout_s=args.solve_timeout,
+            continuous=not args.no_continuous,
+            slo_objective_s=args.slo_objective or None,
+            slo_target=args.slo_target,
+            steal_threshold=args.steal_threshold,
+            balance_interval_s=args.balance_interval_ms / 1000.0,
+            spill_burn=args.spill_burn,
+        )
+    else:
+        metrics = Metrics(
+            args.journal or None,
+            slo_objective_s=args.slo_objective or None,
+            slo_target=args.slo_target,
+        )
+        cache = ExecutableCache()
+        if store is not None:
+            from .artifacts import ArtifactWarmCache
+
+            cache = ArtifactWarmCache(store)
+        broker = Broker(
+            cache, metrics,
+            queue_max=args.queue_max, nrhs_max=args.nrhs_max,
+            window_s=args.window_ms / 1000.0,
+            solve_timeout_s=args.solve_timeout,
+            continuous=not args.no_continuous,
+        )
     if args.warmup:
         degrees = [int(d) for d in args.warmup.split(",") if d.strip()]
         specs = [SolveSpec(degree=d, ndofs=args.ndofs, nreps=args.nreps,
@@ -106,12 +161,21 @@ def main(argv=None) -> int:
         print(f"warmup: compiling {len(specs)} executables "
               f"(degrees {degrees}, bucket {broker.nrhs_max})", flush=True)
         broker.warmup(specs)
-        print(f"warmup done: {broker.cache.stats()}", flush=True)
+        print("warmup done", flush=True)
+    if args.adopt_journal:
+        # standby adoption: answer the dead primary's outstanding
+        # requests exactly once before taking fresh traffic
+        rec = (broker.adopt_journal(args.adopt_journal)
+               if args.fleet else broker.recover(args.adopt_journal))
+        n = rec.get("routed", rec.get("replayed", 0))
+        print(f"adopted journal {args.adopt_journal}: {n} outstanding "
+              f"replayed, {rec['skipped']} skipped", flush=True)
 
     srv = make_server(broker, args.host, args.port)
     host, port = srv.server_address[:2]
     print(f"serving on http://{host}:{port} "
-          f"(queue_max={args.queue_max}, nrhs_max={broker.nrhs_max}, "
+          f"(fleet={args.fleet or 'off'}, queue_max={args.queue_max}, "
+          f"nrhs_max={broker.nrhs_max}, "
           f"window={args.window_ms}ms)", flush=True)
     try:
         srv.serve_forever()

@@ -1,0 +1,336 @@
+"""Shared AOT executable-artifact store (ISSUE 13): serialized compiled
+solvers on disk, keyed EXACTLY like the in-memory cache
+(`serve.cache.ExecutableKey`), so a fresh broker replica warms its LRU
+from a peer's published artifacts instead of recompiling — the
+compilation-cache half of the fleet story (AlpaServe-style placement
+needs executables to be portable across replicas; `jax.export`-class
+serialization is how production inference stacks ship them).
+
+Write protocol — the `harness.checkpoint` fsync discipline, applied to
+artifacts:
+
+    <keyhash>.art.tmp  <- MAGIC | payload_len | crc32 | npz payload
+    flush + fsync          (the bytes are durable)
+    os.replace -> <keyhash>.art   (atomic: readers see old or new,
+                                   never a torn file)
+    fsync(directory)       (the rename itself is durable)
+
+The npz payload carries ``__meta__`` (JSON: the full ExecutableKey, the
+solver spec, engine form, format/jax/backend pins, and a sha256 over the
+executable blobs — the CONTENT hash) plus one uint8 blob per serialized
+checkpoint executable (`serve.engine.CompiledSolver.export_artifact`).
+`get` validates magic + length + CRC + content hash + **key equality**
+(the embedded key must equal the requested key — a renamed, collided or
+repointed file is refused, counted `collisions`, never silently served),
+and treats anything torn/corrupt/incompatible as a MISS: a damaged
+artifact degrades to one recompile, never to a crash or a wrong
+executable.
+
+Trust boundary: artifact blobs deserialize through
+`jax.experimental.serialize_executable` (pickle-carried). The CRC and
+content hash protect INTEGRITY (torn writes, bit rot), not malice —
+load artifacts only from operator-owned stores, the same trust class as
+the checkpoint and journal files.
+
+`ArtifactWarmCache` is the drop-in `ExecutableCache` that consults the
+store between the LRU and the builder: hit -> LRU; miss -> artifact warm
+load (`warm_loads`, ZERO compiles); still missing -> builder (counted
+compile) and, with `publish=True`, the freshly built solver is published
+back so peers warm from it — "warms from peers instead of recompiling"
+is these counters staying truthful.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import io
+import json
+import os
+import struct
+import threading
+import time
+import zlib
+
+import numpy as np
+
+from .cache import ExecutableCache, ExecutableKey
+
+MAGIC = b"BTFARTE1"
+_HEADER = struct.Struct(">QI")  # payload length, crc32
+
+
+def key_dict(key: ExecutableKey) -> dict:
+    """The canonical JSON form of an ExecutableKey (tuples as lists —
+    the artifact meta's key field and the content-addressing input)."""
+    return {
+        "degree": key.degree,
+        "cell_shape": list(key.cell_shape),
+        "precision": key.precision,
+        "geom": key.geom,
+        "engine_form": key.engine_form,
+        "nrhs_bucket": key.nrhs_bucket,
+        "device_mesh": list(key.device_mesh),
+        "nreps": key.nreps,
+    }
+
+
+def key_from_dict(d: dict) -> ExecutableKey:
+    return ExecutableKey(
+        degree=int(d["degree"]),
+        cell_shape=tuple(int(c) for c in d["cell_shape"]),
+        precision=str(d["precision"]),
+        geom=str(d["geom"]),
+        engine_form=str(d["engine_form"]),
+        nrhs_bucket=int(d["nrhs_bucket"]),
+        device_mesh=tuple(int(c) for c in d["device_mesh"]),
+        nreps=int(d.get("nreps", 0)),
+    )
+
+
+def key_hash(key: ExecutableKey) -> str:
+    """Content address of a key: sha256 over its canonical JSON."""
+    blob = json.dumps(key_dict(key), sort_keys=True).encode()
+    return hashlib.sha256(blob).hexdigest()[:24]
+
+
+def _content_hash(fns: dict) -> str:
+    h = hashlib.sha256()
+    for name in sorted(fns):
+        h.update(name.encode())
+        h.update(fns[name])
+    return h.hexdigest()
+
+
+class ArtifactStore:
+    """Directory of durable executable artifacts, one file per
+    ExecutableKey. Thread-safe counters mirror the cache's evidence
+    discipline: puts/gets/hits/misses/corrupt/collisions."""
+
+    def __init__(self, root: str):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+        self._lock = threading.Lock()
+        self.puts = 0
+        self.gets = 0
+        self.hits = 0
+        self.misses = 0
+        self.corrupt = 0
+        self.collisions = 0
+
+    # -- write -------------------------------------------------------------
+
+    def put(self, key: ExecutableKey, artifact: dict) -> str:
+        """Durably publish one `export_artifact` payload under `key`;
+        returns the artifact path. Last-writer-wins (the payloads are
+        deterministic per key up to timing metadata)."""
+        meta = dict(artifact.get("meta") or {})
+        fns = artifact.get("fns") or {}
+        meta["key"] = key_dict(key)
+        meta["content_sha256"] = _content_hash(fns)
+        meta["published_ts"] = time.time()
+        buf = io.BytesIO()
+        blobs = {name: np.frombuffer(data, np.uint8)
+                 for name, data in fns.items()}
+        np.savez(buf, __meta__=np.frombuffer(
+            json.dumps(meta, sort_keys=True).encode(), np.uint8), **blobs)
+        payload = buf.getvalue()
+        path = os.path.join(self.root, f"{key_hash(key)}.art")
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as fh:
+            fh.write(MAGIC)
+            fh.write(_HEADER.pack(len(payload), zlib.crc32(payload)))
+            fh.write(payload)
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, path)
+        self._fsync_dir()
+        with self._lock:
+            self.puts += 1
+        return path
+
+    def put_solver(self, key: ExecutableKey, solver) -> str:
+        """Publish a live CompiledSolver (export + put)."""
+        return self.put(key, solver.export_artifact())
+
+    def _fsync_dir(self) -> None:
+        try:
+            fd = os.open(self.root, os.O_RDONLY)
+            try:
+                os.fsync(fd)
+            finally:
+                os.close(fd)
+        except OSError:
+            pass  # some filesystems refuse directory fsync; best-effort
+
+    # -- read --------------------------------------------------------------
+
+    def contains(self, key: ExecutableKey) -> bool:
+        """Cheap existence probe (no read, no validation — a torn file
+        still answers True here and degrades to a counted miss + one
+        compile at load time; the probe only steers bucket/affinity
+        preferences, never correctness)."""
+        return os.path.exists(
+            os.path.join(self.root, f"{key_hash(key)}.art"))
+
+    def get(self, key: ExecutableKey) -> dict | None:
+        """One validated artifact payload ({"meta", "fns"}) or None —
+        missing, torn, corrupt, content-hash-mismatched and
+        KEY-MISMATCHED (collision/rename defense) all read as a miss,
+        with the reason counted; a bad artifact can cost a recompile,
+        never correctness."""
+        with self._lock:
+            self.gets += 1
+        path = os.path.join(self.root, f"{key_hash(key)}.art")
+        out = self._read(path)
+        if out is None:
+            with self._lock:
+                self.misses += 1
+            return None
+        meta, fns = out
+        if key_from_dict(meta.get("key", {})) != key:
+            # the embedded key IS the identity — a file that hashed (or
+            # was renamed) onto this address but holds a different key
+            # must be refused, loudly counted
+            with self._lock:
+                self.collisions += 1
+                self.misses += 1
+            return None
+        with self._lock:
+            self.hits += 1
+        return {"meta": meta, "fns": fns}
+
+    def _read(self, path: str):
+        try:
+            with open(path, "rb") as fh:
+                if fh.read(len(MAGIC)) != MAGIC:
+                    self._count_corrupt()
+                    return None
+                head = fh.read(_HEADER.size)
+                if len(head) != _HEADER.size:
+                    self._count_corrupt()
+                    return None
+                length, crc = _HEADER.unpack(head)
+                payload = fh.read(length)
+            if len(payload) != length or zlib.crc32(payload) != crc:
+                self._count_corrupt()
+                return None
+            with np.load(io.BytesIO(payload)) as z:
+                meta = json.loads(bytes(z["__meta__"]).decode())
+                fns = {k: bytes(z[k]) for k in z.files if k != "__meta__"}
+        except FileNotFoundError:
+            return None
+        except (OSError, ValueError, KeyError, json.JSONDecodeError):
+            self._count_corrupt()
+            return None
+        if meta.get("content_sha256") != _content_hash(fns):
+            self._count_corrupt()
+            return None
+        return meta, fns
+
+    def _count_corrupt(self) -> None:
+        with self._lock:
+            self.corrupt += 1
+
+    def keys(self) -> list[ExecutableKey]:
+        """Every loadable artifact's embedded key (corrupt files are
+        skipped, already counted on read)."""
+        out = []
+        try:
+            names = os.listdir(self.root)
+        except OSError:
+            return []
+        for name in sorted(names):
+            if not name.endswith(".art"):
+                continue
+            got = self._read(os.path.join(self.root, name))
+            if got is not None:
+                try:
+                    out.append(key_from_dict(got[0].get("key", {})))
+                except (KeyError, TypeError, ValueError):
+                    self._count_corrupt()
+        return out
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "puts": self.puts,
+                "gets": self.gets,
+                "hits": self.hits,
+                "misses": self.misses,
+                "corrupt": self.corrupt,
+                "collisions": self.collisions,
+            }
+
+
+class ArtifactWarmCache(ExecutableCache):
+    """ExecutableCache that warms misses from an ArtifactStore before
+    falling back to the builder — the fleet lane's cache. Counter
+    contract: an LRU hit counts `hits`; an artifact load counts
+    `warm_loads` (the executable was deserialized, not compiled); only
+    a real builder invocation counts `compiles`. With `publish=True` a
+    built solver is published back to the store so PEER replicas warm
+    from this lane's compile."""
+
+    def __init__(self, store: ArtifactStore, *, capacity: int = 32,
+                 publish: bool = True, loader=None):
+        super().__init__(capacity=capacity)
+        self.store = store
+        self.publish = publish
+        # loader(meta, fns) -> executable; default rebuilds the host
+        # state from the artifact's own spec and installs the
+        # serialized executables (serve.engine.build_solver(artifact=))
+        self._loader = loader or _default_loader
+
+    def provisioned(self, key) -> bool:
+        return self.holds(key) or self.store.contains(key)
+
+    def get_or_build(self, key, builder, compile_s=None):
+        entry = self.lookup(key)
+        if entry is not None:
+            with self._lock:
+                self.hits += 1
+            return entry
+        with self._lock:
+            self.misses += 1
+        art = self.store.get(key)
+        if art is not None:
+            t0 = time.perf_counter()
+            try:
+                executable = self._loader(art["meta"], art["fns"])
+            except Exception:
+                # incompatible/damaged artifact: degrade to one build —
+                # the store already counted the miss class; never crash
+                # the serving path on bad artifact bytes
+                executable = None
+            if executable is not None:
+                return self.insert_warm(
+                    key, executable,
+                    load_s=time.perf_counter() - t0,
+                    meta={"source": "artifact",
+                          "published_ts": art["meta"].get(
+                              "published_ts")})
+        t0 = time.perf_counter()
+        executable = builder()
+        wall = time.perf_counter() - t0 if compile_s is None else compile_s
+        entry = self.insert(key, executable, compile_s=wall)
+        # insert() counted the compile; undo the double miss-count from
+        # our early-miss bookkeeping is NOT needed (insert doesn't count
+        # misses), but publish the build so peers warm from it
+        if self.publish:
+            try:
+                self.store.put(key, executable.export_artifact())
+            except Exception:
+                pass  # publication is best-effort; serving never blocks
+        return entry
+
+
+def _default_loader(meta: dict, fns: dict):
+    """Rebuild a CompiledSolver from an artifact: host-side setup from
+    the embedded spec + the serialized executables. Raises
+    ArtifactIncompatible on version/format mismatch (the caller's miss
+    signal)."""
+    from .engine import SolveSpec, build_solver
+
+    spec = SolveSpec(**meta["spec"])
+    return build_solver(spec, meta["bucket"],
+                        artifact={"meta": meta, "fns": fns})

@@ -195,6 +195,13 @@ class Broker:
             [(spec_cache_key(s, b), (lambda s=s: self._builder(s, b)))
              for s in specs])
 
+    def metrics_snapshot(self, memory: dict | None = None) -> dict:
+        """The /metrics snapshot (counters + cache stats + optional
+        memory telemetry) — the one entry point the HTTP front end
+        calls, shared by shape with FleetDispatcher.metrics_snapshot."""
+        return self.metrics.snapshot(cache_stats=self.cache.stats(),
+                                     memory=memory)
+
     def shutdown(self, timeout_s: float = 5.0) -> None:
         with self._cv:
             self._stop = True
@@ -209,6 +216,66 @@ class Broker:
                               "error": "broker shut down",
                               "failure_class": "transient",
                               "retriable": True})
+
+    # -- fleet side (ISSUE 13) ---------------------------------------------
+
+    def pending_count(self) -> int:
+        """Current queue depth (the fleet balancer's imbalance input)."""
+        with self._cv:
+            return len(self._queue)
+
+    def steal_requests(self, k: int) -> list:
+        """Pop up to k requests off the queue TAIL, returned in ARRIVAL
+        order (the oldest requests keep their place at their home lane,
+        where they will be served soonest, and the stolen set re-enqueues
+        FIFO at the destination — fairness survives the steal end to
+        end). The fleet balancer moves them to a colder lane via
+        adopt_pending. The requests' write-ahead records already exist —
+        stealing is a pure queue move, invisible to the exactly-once
+        ledger."""
+        stolen: list[PendingRequest] = []
+        with self._cv:
+            while self._queue and len(stolen) < k:
+                stolen.append(self._queue.pop())
+            self.metrics.set_queue_depth(len(self._queue))
+        stolen.reverse()  # popped newest-first; hand back arrival order
+        return stolen
+
+    def adopt_pending(self, reqs: list) -> None:
+        """Enqueue already-admitted requests (stolen from a peer lane or
+        replayed by a standby adoption): bypasses the queue_max cap (the
+        requests were admitted once — a full queue must not convert an
+        admitted request into a loss) and writes NO new serve_request
+        record (the WAL line already exists)."""
+        if not reqs:
+            return
+        with self._cv:
+            self._queue.extend(reqs)
+            self.metrics.set_queue_depth(len(self._queue))
+            self._cv.notify_all()
+
+    def _replay_request(self, req: dict) -> PendingRequest | None:
+        """Re-admit ONE journaled outstanding request under its ORIGINAL
+        id (the shared half of Broker.recover and the fleet's standby
+        adoption). Returns the pending, or None when the record is too
+        damaged to rebuild its spec — in which case the id is answered
+        with a TERMINAL `unsupported` response so the exactly-once
+        ledger closes instead of reading it as LOST forever."""
+        try:
+            spec = SolveSpec(**req["spec"])
+            spec.validate()
+        except Exception:
+            self.metrics.response(req["id"], False, 0.0,
+                                  failure_class="unsupported",
+                                  retriable=False)
+            return None
+        pending = PendingRequest(req["id"], spec,
+                                 float(req.get("scale", 1.0)),
+                                 time.monotonic())
+        with self._cv:
+            self._queue.append(pending)
+            self._cv.notify_all()
+        return pending
 
     # -- worker side -------------------------------------------------------
 
@@ -261,12 +328,15 @@ class Broker:
         return batch
 
     def _pick_bucket(self, spec: SolveSpec, live: int) -> int:
-        """Prefer the smallest ALREADY-COMPILED bucket that fits the
+        """Prefer the smallest ALREADY-PROVISIONED bucket that fits the
         batch (padding is cheap — dead lanes start frozen; a compile is
-        seconds), else the minimal bucket for the batch size."""
+        seconds), else the minimal bucket for the batch size.
+        "Provisioned" includes peer-published AOT artifacts (ISSUE 13):
+        a cold replica prefers the bucket it can warm-load with zero
+        recompiles over the minimal one it would have to compile."""
         for b in NRHS_BUCKETS:
-            if b >= live and self.cache.lookup(
-                    spec_cache_key(spec, b)) is not None:
+            if b >= live and self.cache.provisioned(
+                    spec_cache_key(spec, b)):
                 return b
         return nrhs_bucket(live)
 
@@ -701,33 +771,17 @@ class Broker:
                     self._next_id = max(self._next_id,
                                         plan.max_numeric_id + 1)
             for req in plan.outstanding:
-                try:
-                    spec = SolveSpec(**req["spec"])
-                    spec.validate()
-                except Exception:
-                    # a journal record too damaged to rebuild its spec:
-                    # counted, never crashes the recovery (the rest of
-                    # the outstanding set still replays). The id still
-                    # gets a TERMINAL failure response — leaving it
-                    # unanswered would hold the exactly-once ledger
-                    # (verify_exactly_once) open forever: the request
-                    # would read as LOST even though recovery behaved.
-                    # Deterministic (the spec can never rebuild), so
-                    # `unsupported`, never retriable.
-                    self.metrics.response(
-                        req["id"], False, 0.0,
-                        failure_class="unsupported", retriable=False)
+                # _replay_request answers unrebuildable records with a
+                # TERMINAL `unsupported` response (leaving them
+                # unanswered would hold the exactly-once ledger open
+                # forever) and bypasses admission control for the rest:
+                # these requests were ALREADY admitted (their WAL
+                # records prove it) — a full queue must not convert an
+                # admitted request into a loss.
+                pending = self._replay_request(req)
+                if pending is None:
                     skipped += 1
                     continue
-                pending = PendingRequest(req["id"], spec,
-                                         float(req.get("scale", 1.0)),
-                                         time.monotonic())
-                # replay bypasses admission control: these requests were
-                # ALREADY admitted (their WAL records prove it) — a full
-                # queue must not convert an admitted request into a loss
-                with self._cv:
-                    self._queue.append(pending)
-                    self._cv.notify_all()
                 replayed.append(pending)
             self.metrics.recovery(len(plan.outstanding), len(replayed),
                                   skipped, plan.corrupt)

@@ -83,6 +83,11 @@ class ExecutableCache:
         self.misses = 0
         self.evictions = 0
         self.compiles = 0
+        # AOT warm loads (ISSUE 13): entries installed from a serialized
+        # peer artifact — a deserialization, NOT a compile, so the
+        # "zero recompiles on a warm replica" contract stays a truthful
+        # counter read (compiles counts builder calls only)
+        self.warm_loads = 0
 
     def lookup(self, key: ExecutableKey) -> CacheEntry | None:
         """Counter-free peek (the broker uses it to prefer an
@@ -92,6 +97,25 @@ class ExecutableCache:
             if entry is not None:
                 self._entries.move_to_end(key)
             return entry
+
+    def holds(self, key: ExecutableKey) -> bool:
+        """Counter-free, LRU-order-free IN-MEMORY peek. The fleet's
+        affinity probe uses this instead of `lookup`: a routing probe
+        must not refresh the key's recency in lanes the request is not
+        even routed to (a probe-refreshed never-served entry would
+        out-survive entries the lane actually serves at eviction
+        time)."""
+        with self._lock:
+            return key in self._entries
+
+    def provisioned(self, key: ExecutableKey) -> bool:
+        """Can this cache produce `key` WITHOUT a compile? The plain
+        cache answers from the in-memory LRU; ArtifactWarmCache
+        (serve.artifacts) also answers yes for keys a peer published to
+        the shared store (a warm load, not a compile). The broker's
+        bucket preference consults this, so a cold replica prefers the
+        bucket its peers already compiled."""
+        return self.holds(key)
 
     def get(self, key: ExecutableKey) -> CacheEntry | None:
         """Counted lookup: a hit or a miss, no build (the driver's
@@ -114,6 +138,25 @@ class ExecutableCache:
                            meta=meta or {})
         with self._lock:
             self.compiles += 1
+            self._entries[key] = entry
+            self._entries.move_to_end(key)
+            while len(self._entries) > self.capacity:
+                self._entries.popitem(last=False)
+                self.evictions += 1
+        return entry
+
+    def insert_warm(self, key: ExecutableKey, executable,
+                    load_s: float = 0.0,
+                    meta: dict | None = None) -> CacheEntry:
+        """Insert an executable deserialized from a peer's AOT artifact:
+        counted `warm_loads`, NEVER `compiles` — no builder ran, no XLA
+        compile happened (serve.engine's artifact loader installs the
+        serialized PJRT executables directly)."""
+        meta = dict(meta or {})
+        meta.setdefault("source", "artifact")
+        entry = CacheEntry(key, executable, compile_s=load_s, meta=meta)
+        with self._lock:
+            self.warm_loads += 1
             self._entries[key] = entry
             self._entries.move_to_end(key)
             while len(self._entries) > self.capacity:
@@ -166,6 +209,7 @@ class ExecutableCache:
                 "misses": self.misses,
                 "evictions": self.evictions,
                 "compiles": self.compiles,
+                "warm_loads": self.warm_loads,
                 "hit_rate": (
                     self.hits / (self.hits + self.misses)
                     if (self.hits + self.misses) else 0.0

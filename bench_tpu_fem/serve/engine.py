@@ -15,11 +15,11 @@ operator ONCE and AOT-compiles the checkpointable batched CG machinery
   * every other f32/f64 spec runs the unfused vmapped composition
     (`la.cg.unfused_batch_engine`, bitwise the `cg_solve_batched`
     parity oracle per lane), recorded `"unfused"`;
-  * df32 pairs keep the whole-solve vmapped `cg_solve_df` executable —
-    no checkpoint boundary exists inside the df recurrence yet, so
-    continuous batching for df32 is planned-but-gated
-    (`continuous_gate_reason` records why; the broker falls back to
-    fixed-window one-shot batches for it).
+  * df32 pairs run the batched df checkpoint recurrence
+    (`la.cg.BatchedCGStateDF` — the PR 6 gate CLOSED: the df recurrence
+    now has iteration boundaries, so df32 requests ride continuous
+    batching like f32/f64; the vmapped whole-solve `cg_solve_df` stays
+    the parity oracle at df-class <= 1e-13).
 
 The checkpoint API (`cont_init` / `cont_step` / `cont_admit` /
 `cont_retire` / `cont_poll`) is what the broker's continuous batching
@@ -43,6 +43,20 @@ fused responses match it to the engine family's f32 reassociation
 accuracy (<= 5e-5 relative L2 — same convention as the kron engine
 suite), which is why the parity oracle is per-executable, not
 cross-path.
+
+AOT artifact seam (ISSUE 13): `CompiledSolver.export_artifact()`
+serializes the four checkpoint executables
+(`jax.experimental.serialize_executable` — the compiled PJRT
+executables themselves, not a re-lowerable recipe) so a broker replica
+can warm its cache from a peer's artifact instead of recompiling:
+`build_solver(spec, bucket, artifact=...)` runs ONLY the host-side
+problem setup (mesh/tables/RHS assembly — deterministic from the spec)
+and installs the deserialized executables, never invoking the XLA
+compile path. Artifacts are pickle-carried and version-pinned
+(jax/backend recorded; a mismatch raises `ArtifactIncompatible`, which
+loaders treat as a cache miss): load them only from operator-owned
+stores — the same trust boundary as the checkpoint files
+(serve.artifacts owns the bytes + integrity discipline).
 
 Evidence label: serving throughput numbers from this module are
 CPU-measured unless a round artifact says otherwise; the fused batched
@@ -133,6 +147,21 @@ class UnsupportedSpec(ValueError):
     by the harness taxonomy (deterministic: retrying cannot help)."""
 
 
+class ArtifactIncompatible(ValueError):
+    """An AOT artifact cannot load into this runtime (jax/backend/format
+    mismatch) — the loader treats it as a cache miss and rebuilds; never
+    a crash (serve.artifacts catches exactly this)."""
+
+
+#: artifact payload format tag: serialized PJRT executables, pickled
+#: (payload, in_tree, out_tree) triples per checkpoint function
+ARTIFACT_FORMAT = "pjrt-pickle-v1"
+
+#: the four checkpoint executables every servable solver carries (f32,
+#: f64 and — since ISSUE 13 — df32 all drive the same API)
+ARTIFACT_FNS = ("_init_fn", "_step_fn", "_admit_fn", "_retire_fn")
+
+
 def planned_engine_form(spec: SolveSpec, bucket: int) -> str:
     """The engine form the serving compile will pick for (spec, bucket)
     — a deterministic function of the spec, so it can be part of the
@@ -169,6 +198,15 @@ def spec_cache_key(spec: SolveSpec, bucket: int,
     )
 
 
+def _df_split_scales(pad: np.ndarray):
+    """Host-side Dekker split of f64 lane scales into (hi, lo) f32
+    arrays — the df-exact scaling input of the compiled df init/admit."""
+    hi = np.asarray(pad, np.float32)
+    lo = np.asarray(np.asarray(pad, np.float64)
+                    - np.asarray(hi, np.float64), np.float32)
+    return hi, lo
+
+
 @dataclass
 class BatchResult:
     """One executed batch: per-live-lane solution norms plus the
@@ -202,7 +240,8 @@ class CompiledSolver:
     lanes. df32 keeps the whole-solve vmapped executable
     (`continuous_gate_reason` records why)."""
 
-    def __init__(self, spec: SolveSpec, bucket: int):
+    def __init__(self, spec: SolveSpec, bucket: int,
+                 artifact: dict | None = None):
         import jax
         import jax.numpy as jnp
 
@@ -240,32 +279,80 @@ class CompiledSolver:
         self.continuous_gate_reason = None
         self.engine_form = "unfused"
         self.engine_fallback_reason = None
+        self.warm_source = None  # "artifact" when loaded, else None
         if spec.precision == "df32":
+            from ..la.cg import (
+                batched_cg_admit_df,
+                batched_cg_init_df,
+                batched_cg_retire_df,
+                batched_dot_df,
+                make_batched_cg_step_df,
+            )
             from ..la.df64 import DF, df_from_f64
-            from ..ops.kron_df import build_kron_laplacian_df, cg_solve_df
+            from ..ops.kron_df import build_kron_laplacian_df
 
-            # Whole-solve vmapped df executable: no iteration-boundary
-            # checkpoint exists inside the df recurrence yet, so df32
-            # continuous batching is planned-but-gated with the reason
-            # recorded (the broker serves df32 in fixed-window batches).
-            self.continuous_gate_reason = (
-                "df32 continuous batching unsupported: the vmapped "
-                "cg_solve_df recurrence is one whole-solve executable "
-                "with no iteration-boundary checkpoint (planned)")
+            # Batched df checkpoint recurrence (ISSUE 13 — the PR 6 gate
+            # closed): the same four-executable API as f32/f64, carried
+            # in compensated (hi, lo) arithmetic, so df32 batches admit
+            # and retire lanes at iteration boundaries like every other
+            # precision. The vmapped whole-solve cg_solve_df stays the
+            # parity oracle (<= 1e-13, tests/test_serve.py).
             self._op = build_kron_laplacian_df(
                 mesh, spec.degree, 1, "gll", kappa=2.0, tables=t)
             bdf = df_from_f64(b64)
             self._base = DF(jnp.asarray(bdf.hi), jnp.asarray(bdf.lo))
 
-            def run(A, Bhi, Blo):
-                return jax.vmap(
-                    lambda bh, bl: cg_solve_df(A, DF(bh, bl), nreps)
-                )(Bhi, Blo)
+            from ..la.cg import _df_scale_lanes
 
-            Bs = jax.ShapeDtypeStruct((self.bucket, *b64.shape),
-                                      np.dtype("float32"))
-            self._fn = compile_lowered(
-                jax.jit(run).lower(self._op, Bs, Bs), None)
+            def _init(base, shi, slo):
+                shape = (self.bucket, *base.hi.shape)
+                bb = DF(jnp.broadcast_to(base.hi[None], shape),
+                        jnp.broadcast_to(base.lo[None], shape))
+                # df-exact per-lane scaling: the f64 scale rides as its
+                # own (hi, lo) pair and multiplies in df arithmetic —
+                # the standing df linearity contract (any scale, ~1e-13)
+                B = _df_scale_lanes(bb, DF(shi, slo))
+                return batched_cg_init_df(B)
+
+            def _step(A, state):
+                step = make_batched_cg_step_df(jax.vmap(A.apply), nreps)
+                return jax.lax.fori_loop(
+                    0, self.iter_chunk, lambda _, s: step(s), state)
+
+            def _admit(base, state, lane, shi, slo):
+                from ..la.df64 import df_mul
+
+                b = df_mul(base, DF(jnp.broadcast_to(shi, base.hi.shape),
+                                    jnp.broadcast_to(slo, base.hi.shape)))
+                return batched_cg_admit_df(state, lane, b)
+
+            def _retire(state, lane):
+                d = batched_dot_df(state.X, state.X)
+                return (batched_cg_retire_df(state, lane),
+                        d.hi[lane], d.lo[lane])
+
+            f32 = np.dtype("float32")
+            base_s = jax.tree_util.tree_map(
+                lambda a: jax.ShapeDtypeStruct(a.shape, f32), self._base)
+            scales_s = jax.ShapeDtypeStruct((self.bucket,), f32)
+            lane_s = jax.ShapeDtypeStruct((), np.dtype(np.int32))
+            scal_s = jax.ShapeDtypeStruct((), f32)
+            if artifact is not None:
+                self._load_artifact(artifact)
+            else:
+                state_s = jax.eval_shape(_init, base_s, scales_s,
+                                         scales_s)
+                self._init_fn = compile_lowered(
+                    jax.jit(_init).lower(base_s, scales_s, scales_s),
+                    None)
+                self._step_fn = compile_lowered(
+                    jax.jit(_step).lower(self._op, state_s), None)
+                self._admit_fn = compile_lowered(
+                    jax.jit(_admit).lower(base_s, state_s, lane_s,
+                                          scal_s, scal_s), None)
+                self._retire_fn = compile_lowered(
+                    jax.jit(_retire).lower(state_s, lane_s), None)
+            self.supports_continuous = True
         else:
             from ..la.cg import (
                 batched_cg_admit,
@@ -325,43 +412,127 @@ class CompiledSolver:
             lane_s = jax.ShapeDtypeStruct((), np.dtype(np.int32))
             scale_s = jax.ShapeDtypeStruct((), npdt)
 
-            fused = self.engine_form == "one_kernel_batched"
-            step_opts = None
-            if fused and jax.default_backend() == "tpu":
-                from ..ops.kron_cg import engine_plan_batched
-                from ..utils.compilation import scoped_vmem_options
+            if artifact is not None:
+                self._load_artifact(artifact)
+            else:
+                fused = self.engine_form == "one_kernel_batched"
+                step_opts = None
+                if fused and jax.default_backend() == "tpu":
+                    from ..ops.kron_cg import engine_plan_batched
+                    from ..utils.compilation import scoped_vmem_options
 
-                grid = dof_grid_shape(n, spec.degree)
-                step_opts = scoped_vmem_options(
-                    engine_plan_batched(grid, spec.degree,
-                                        self.bucket)[1])
-            try:
-                self._step_fn = compile_lowered(
-                    jax.jit(_make_step(fused)).lower(self._op, state_s),
-                    step_opts)
-            except Exception as exc:
-                if not fused:
-                    raise
-                # Mosaic rejection of the fused batched ring (a drifted
-                # per-bucket tier): fall back to the unfused composition
-                # with the reason recorded — never silently (the cache
-                # key stays the PLANNED form; responses stamp the form
-                # that actually ran, same discipline as the driver).
-                self.engine_form = "unfused"
-                self.engine_fallback_reason = (
-                    f"{type(exc).__name__}: {exc}"[:500])
-                self._step_fn = compile_lowered(
-                    jax.jit(_make_step(False)).lower(self._op, state_s),
-                    None)
-            self._init_fn = compile_lowered(
-                jax.jit(_init).lower(base_s, scales_s), None)
-            self._admit_fn = compile_lowered(
-                jax.jit(_admit).lower(base_s, state_s, lane_s, scale_s),
-                None)
-            self._retire_fn = compile_lowered(
-                jax.jit(_retire).lower(state_s, lane_s), None)
+                    grid = dof_grid_shape(n, spec.degree)
+                    step_opts = scoped_vmem_options(
+                        engine_plan_batched(grid, spec.degree,
+                                            self.bucket)[1])
+                try:
+                    self._step_fn = compile_lowered(
+                        jax.jit(_make_step(fused)).lower(self._op,
+                                                         state_s),
+                        step_opts)
+                except Exception as exc:
+                    if not fused:
+                        raise
+                    # Mosaic rejection of the fused batched ring (a
+                    # drifted per-bucket tier): fall back to the unfused
+                    # composition with the reason recorded — never
+                    # silently (the cache key stays the PLANNED form;
+                    # responses stamp the form that actually ran, same
+                    # discipline as the driver).
+                    self.engine_form = "unfused"
+                    self.engine_fallback_reason = (
+                        f"{type(exc).__name__}: {exc}"[:500])
+                    self._step_fn = compile_lowered(
+                        jax.jit(_make_step(False)).lower(self._op,
+                                                         state_s),
+                        None)
+                self._init_fn = compile_lowered(
+                    jax.jit(_init).lower(base_s, scales_s), None)
+                self._admit_fn = compile_lowered(
+                    jax.jit(_admit).lower(base_s, state_s, lane_s,
+                                          scale_s), None)
+                self._retire_fn = compile_lowered(
+                    jax.jit(_retire).lower(state_s, lane_s), None)
             self.supports_continuous = True
         self.compile_s = time.perf_counter() - t0
+
+    # -- AOT artifact seam (ISSUE 13) ---------------------------------------
+
+    def export_artifact(self) -> dict:
+        """Serialize the four compiled checkpoint executables into an
+        artifact payload a peer replica loads with `build_solver(...,
+        artifact=...)` — the PJRT executables themselves, so the loader
+        never re-lowers or recompiles. Returns {"meta": ..., "fns":
+        {name: pickle bytes}}; serve.artifacts owns the on-disk bytes
+        (content hash + CRC + tmp->fsync->rename)."""
+        import pickle
+
+        import jax
+        from jax.experimental.serialize_executable import serialize
+
+        fns = {name: pickle.dumps(serialize(getattr(self, name)))
+               for name in ARTIFACT_FNS}
+        meta = {
+            "format": ARTIFACT_FORMAT,
+            "spec": {"degree": self.spec.degree, "ndofs": self.spec.ndofs,
+                     "nreps": self.spec.nreps,
+                     "precision": self.spec.precision,
+                     "geom_perturb_fact": self.spec.geom_perturb_fact},
+            "bucket": self.bucket,
+            "engine_form": self.engine_form,  # the ACHIEVED form
+            "engine_fallback_reason": self.engine_fallback_reason,
+            "compile_s": round(self.compile_s, 6),
+            "jax": jax.__version__,
+            "backend": jax.default_backend(),
+        }
+        return {"meta": meta, "fns": fns}
+
+    def _load_artifact(self, artifact: dict) -> None:
+        """Install a peer's serialized executables instead of compiling.
+        Version-pinned: a jax/backend/format mismatch raises
+        ArtifactIncompatible (the loader's cache-miss signal), never a
+        crash downstream of a half-installed solver."""
+        import pickle
+
+        import jax
+        from jax.experimental.serialize_executable import (
+            deserialize_and_load,
+        )
+
+        meta = artifact.get("meta") or {}
+        if meta.get("format") != ARTIFACT_FORMAT:
+            raise ArtifactIncompatible(
+                f"artifact format {meta.get('format')!r} != "
+                f"{ARTIFACT_FORMAT!r}")
+        if meta.get("jax") != jax.__version__ or (
+                meta.get("backend") != jax.default_backend()):
+            raise ArtifactIncompatible(
+                f"artifact pinned jax {meta.get('jax')}/"
+                f"{meta.get('backend')} but this runtime is "
+                f"{jax.__version__}/{jax.default_backend()}")
+        fns = artifact.get("fns") or {}
+        missing = [n for n in ARTIFACT_FNS if n not in fns]
+        if missing:
+            raise ArtifactIncompatible(f"artifact missing {missing}")
+        loaded = {}
+        for name in ARTIFACT_FNS:
+            try:
+                payload, in_tree, out_tree = pickle.loads(fns[name])
+                loaded[name] = deserialize_and_load(payload, in_tree,
+                                                    out_tree)
+            except ArtifactIncompatible:
+                raise
+            except Exception as exc:
+                raise ArtifactIncompatible(
+                    f"artifact executable {name} failed to load: "
+                    f"{type(exc).__name__}: {exc}"[:300]) from exc
+        for name, fn in loaded.items():
+            setattr(self, name, fn)
+        # the artifact records the form that actually compiled at the
+        # publisher (including a recorded fused->unfused fallback)
+        self.engine_form = meta.get("engine_form", self.engine_form)
+        self.engine_fallback_reason = meta.get("engine_fallback_reason")
+        self.warm_source = "artifact"
 
     def solve(self, scales) -> BatchResult:
         """Run one padded batch: `scales` (len <= bucket) are the live
@@ -377,29 +548,26 @@ class CompiledSolver:
 
         t0 = time.perf_counter()
         if self.spec.precision == "df32":
-            # df-exact per-lane scaling: the f64 scale splits into its
-            # own hi/lo pair and multiplies in df arithmetic, so s*b
-            # keeps df precision for ANY scale (a naive f32 s*hi drops
-            # the product's rounding error and would degrade the 1e-13
-            # linearity contract to ~1e-8 for non-power-of-two scales)
-            from ..la.df64 import DF, df_from_f64, df_scale
+            # whole-batch df solve through the SAME checkpoint
+            # executables continuous batching drives (init +
+            # ceil(nreps/chunk) chunk steps — the per-lane df recurrence
+            # of the vmapped cg_solve_df oracle, p-update reassociated;
+            # parity <= 1e-13). Scaling stays df-exact: the f64 scale
+            # splits into its own (hi, lo) pair host-side and multiplies
+            # in df arithmetic inside the compiled init (any scale keeps
+            # the 1e-13 linearity contract).
+            shi, slo = _df_split_scales(pad)
+            state = self._init_fn(self._base, shi, slo)
+            for _ in range(-(-self.spec.nreps // self.iter_chunk)):
+                state = self._step_fn(self._op, state)
+            from ..la.cg import batched_dot_df
 
-            sdf = df_from_f64(pad)
-            sb = DF(jnp.asarray(sdf.hi)[:, None, None, None],
-                    jnp.asarray(sdf.lo)[:, None, None, None])
-            shape = (self.bucket, *self._base.hi.shape)
-            base_b = DF(jnp.broadcast_to(self._base.hi[None], shape),
-                        jnp.broadcast_to(self._base.lo[None], shape))
-            Bdf = jax.jit(df_scale)(base_b, sb)
-            X = self._fn(self._op, Bdf.hi, Bdf.lo)
-            jax.block_until_ready(X)
-            from ..la.df64 import DF, df_dot, df_to_f64
-
-            xn = [
-                float(np.sqrt(max(float(df_to_f64(df_dot(
-                    DF(X.hi[i], X.lo[i]), DF(X.hi[i], X.lo[i])))), 0.0)))
-                for i in range(live)
-            ]
+            d = jax.jit(batched_dot_df)(state.X, state.X)
+            jax.block_until_ready(d)
+            dhi = np.asarray(d.hi, np.float64)
+            dlo = np.asarray(d.lo, np.float64)
+            xn = [float(np.sqrt(max(dhi[i] + dlo[i], 0.0)))
+                  for i in range(live)]
         else:
             # whole-batch solve through the SAME checkpoint executables
             # continuous batching drives (init + ceil(nreps/chunk) chunk
@@ -456,9 +624,12 @@ class CompiledSolver:
 
         if FAULT_HOOK is not None:
             FAULT_HOOK(self.spec, scales)
-        return self._init_fn(
-            self._base,
-            jnp.asarray(self._pad_scales(scales), self._base.dtype))
+        pad = self._pad_scales(scales)
+        if self.spec.precision == "df32":
+            shi, slo = _df_split_scales(pad)
+            return self._init_fn(self._base, shi, slo)
+        return self._init_fn(self._base,
+                             jnp.asarray(pad, self._base.dtype))
 
     def cont_step(self, state):
         """Advance every live lane by `iter_chunk` iterations (one
@@ -472,19 +643,37 @@ class CompiledSolver:
 
     def cont_admit(self, state, lane: int, scale: float):
         """Admit a request into a free lane at this boundary: the lane
-        restarts as scale * base RHS with its own iteration budget."""
+        restarts as scale * base RHS with its own iteration budget.
+        df32 splits the f64 scale host-side (df-exact scaling)."""
+        if self.spec.precision == "df32":
+            s64 = np.float64(scale)
+            shi = np.float32(s64)
+            slo = np.float32(s64 - np.float64(shi))
+            return self._admit_fn(self._base, state, np.int32(lane),
+                                  shi, slo)
         return self._admit_fn(self._base, state, np.int32(lane),
                               np.asarray(scale, self._base.dtype))
 
     def cont_retire(self, state, lane: int):
         """Retire a finished lane: returns (state with the lane freed,
         that lane's solution L2 norm — same reduction as the one-shot
-        driver's vdot)."""
+        driver's vdot; df32 folds the (hi, lo) dot pair in f64 on
+        host, the oracle's norm convention)."""
+        if self.spec.precision == "df32":
+            state, dhi, dlo = self._retire_fn(state, np.int32(lane))
+            return state, float(np.sqrt(max(
+                np.float64(dhi) + np.float64(dlo), 0.0)))
         state, xn = self._retire_fn(state, np.int32(lane))
         return state, float(xn)
 
 
-def build_solver(spec: SolveSpec, bucket: int | None = None) -> CompiledSolver:
+def build_solver(spec: SolveSpec, bucket: int | None = None,
+                 artifact: dict | None = None) -> CompiledSolver:
     """Build + AOT-compile a batched solver for the spec at the given
-    (or minimal) nrhs bucket."""
-    return CompiledSolver(spec, bucket or nrhs_bucket(1))
+    (or minimal) nrhs bucket. With `artifact` (an `export_artifact`
+    payload) the XLA compile path is skipped entirely: only the
+    host-side problem setup runs and the peer's serialized executables
+    are installed (raises ArtifactIncompatible on a version/format
+    mismatch — the caller's cache-miss signal)."""
+    return CompiledSolver(spec, bucket or nrhs_bucket(1),
+                          artifact=artifact)

@@ -1,0 +1,419 @@
+"""Fleet dispatcher (ISSUE 13): multi-device serving — per-device
+queues with spec-aware affinity routing, work stealing between devices,
+SLO-burn-driven spill, and standby journal adoption.
+
+One `DeviceLane` per (virtual or physical) device: its own `Broker`
+(continuous batching, PR 6), its own executable cache — an
+`ArtifactWarmCache` when a shared `ArtifactStore` is attached, so a lane
+facing a spec it never compiled warms from a peer's published artifact
+instead of recompiling — and its own `Metrics` stamped with the device
+label, all journaling into ONE shared file (O_APPEND-atomic appends,
+the chaos-proven multi-writer discipline), so the whole fleet incident
+replays from one journal and `verify_exactly_once` holds fleet-wide.
+
+Routing (the AlpaServe-shaped placement decision, CPU-provable):
+
+  1. **Affinity**: a request goes to a device whose in-memory cache
+     already holds its (spec, bucket) executable (any admissible
+     bucket), shortest queue among those; no holder -> coldest queue
+     (that lane becomes the spec's affinity home after one compile or
+     artifact warm load).
+  2. **Spill**: when the chosen lane's FAST-window SLO burn rate
+     exceeds `spill_burn` (default 1.0 — burning error budget faster
+     than the SLO allows), the request spills to the least-loaded lane
+     whose burn is below the threshold (journaled `fleet_spill`): the
+     PR 10 burn rate is a CONTROL SIGNAL here, not just an alert.
+  3. **Stealing**: a balancer rebalances queue depths — when
+     max - min >= `steal_threshold`, half the gap moves from the fat
+     queue's TAIL to the thin lane (`fleet_steal` journaled; FIFO
+     fairness survives — the oldest requests keep their home-lane
+     positions). Stolen work warms from the artifact store on arrival.
+
+Admission: the fleet only submits to a lane with queue room at decision
+time; when every lane is full the request sheds fleet-level (journaled
+``serve_shed`` with device "fleet", retriable) — and a racing fill that
+makes the chosen lane shed anyway propagates that lane's own shed, so
+the exactly-once ledger never records an admit after a shed.
+
+Standby adoption (`adopt_journal`): broker replication over the PR 9
+write-ahead journal. A standby fleet folds the dead primary's journal
+(`serve.recovery.fold_outstanding` — exactly-once-proven against torn
+tails), routes every admitted-but-unresponded request through the SAME
+affinity logic under its ORIGINAL id (the id-space handoff: fresh ids
+resume past every journaled id), and answers them; `fleet_adopt` is the
+journal record. The chaos schedule SIGKILLs the primary mid-incident
+and asserts `verify_exactly_once` over both generations.
+
+Evidence labels: every fleet number here is CPU-measured on virtual
+devices (`force_host_cpu_devices`); the `fleet` agenda stage re-runs
+the loadgen smoke on real hardware and re-stamps.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+
+from .broker import Broker, QueueFull
+from .cache import NRHS_BUCKETS, ExecutableCache, nrhs_bucket
+from .engine import SolveSpec, build_solver, spec_cache_key
+from .metrics import FleetMetrics, Metrics
+
+
+@dataclass
+class DeviceLane:
+    """One device's serving stack: broker + cache + labelled metrics."""
+
+    index: int
+    label: str
+    broker: Broker
+    cache: ExecutableCache
+    metrics: Metrics
+    device: object | None = None  # jax.Device when available
+
+
+def _jax_devices(n: int):
+    """Up to n distinct jax devices (None-padded when the platform
+    exposes fewer — lanes then share the default device, which keeps
+    the routing/stealing logic CPU-provable on any host)."""
+    try:
+        import jax
+
+        devs = list(jax.devices())
+    except Exception:
+        devs = []
+    return [devs[i] if i < len(devs) else None for i in range(n)]
+
+
+class FleetDispatcher:
+    """Spec-aware multi-device dispatcher over per-lane brokers. The
+    server front end drives it exactly like a Broker (`submit` / `wait`
+    / `metrics_snapshot` / `shutdown`)."""
+
+    def __init__(self, ndevices: int = 2, *,
+                 journal_path: str | None = None,
+                 artifacts=None,
+                 queue_max: int = 128, nrhs_max: int = 8,
+                 window_s: float = 0.025,
+                 solve_timeout_s: float = 120.0,
+                 continuous: bool = True,
+                 slo_objective_s: float | None = None,
+                 slo_target: float = 0.99,
+                 steal_threshold: int = 4,
+                 balance_interval_s: float = 0.02,
+                 spill_burn: float = 1.0,
+                 publish_artifacts: bool = True,
+                 builder=build_solver):
+        if ndevices < 1:
+            raise ValueError("ndevices must be >= 1")
+        self.artifacts = artifacts
+        self.steal_threshold = max(int(steal_threshold), 1)
+        self.spill_burn = float(spill_burn)
+        self.nrhs_max = min(nrhs_max, NRHS_BUCKETS[-1])
+        self.queue_max = queue_max
+        self.fleet_metrics = FleetMetrics(journal_path)
+        self._builder = builder
+        self.lanes: list[DeviceLane] = []
+        devices = _jax_devices(ndevices)
+        for i in range(ndevices):
+            label = f"dev{i}"
+            if artifacts is not None:
+                from .artifacts import ArtifactWarmCache
+
+                cache = ArtifactWarmCache(
+                    artifacts, publish=publish_artifacts,
+                    loader=self._lane_loader(devices[i]))
+            else:
+                cache = ExecutableCache()
+            metrics = Metrics(journal_path,
+                              slo_objective_s=slo_objective_s,
+                              slo_target=slo_target, device=label)
+            broker = Broker(cache, metrics, queue_max=queue_max,
+                            nrhs_max=nrhs_max, window_s=window_s,
+                            solve_timeout_s=solve_timeout_s,
+                            continuous=continuous,
+                            builder=self._lane_builder(devices[i]))
+            self.lanes.append(DeviceLane(i, label, broker, cache,
+                                         metrics, devices[i]))
+        # ONE fleet-wide id space (the lanes share a journal, so ids
+        # must never collide across lanes)
+        self._id_lock = threading.Lock()
+        self._next_id = 1
+        self._stop = False
+        self._balancer = None
+        if balance_interval_s and balance_interval_s > 0:
+            self.balance_interval_s = balance_interval_s
+            self._balancer = threading.Thread(
+                target=self._balance_loop, daemon=True,
+                name="fleet-balancer")
+            self._balancer.start()
+
+    # -- per-lane device pinning -------------------------------------------
+
+    def _lane_builder(self, device):
+        def build(spec, bucket):
+            if device is None:
+                return self._builder(spec, bucket)
+            import jax
+
+            with jax.default_device(device):
+                return self._builder(spec, bucket)
+
+        return build
+
+    def _lane_loader(self, device):
+        from .artifacts import _default_loader
+
+        def load(meta, fns):
+            if device is None:
+                return _default_loader(meta, fns)
+            import jax
+
+            with jax.default_device(device):
+                return _default_loader(meta, fns)
+
+        return load
+
+    # -- routing -----------------------------------------------------------
+
+    def _lane_holds(self, lane: DeviceLane, spec: SolveSpec) -> bool:
+        """Does the lane's IN-MEMORY cache hold an executable any batch
+        of this spec could run (any bucket up to the lane cap)? A
+        recency-free peek (`cache.holds`): a routing probe must not
+        refresh LRU order in lanes the request never reaches."""
+        for b in NRHS_BUCKETS:
+            if b > nrhs_bucket(self.nrhs_max):
+                break
+            if lane.cache.holds(spec_cache_key(spec, b)):
+                return True
+        return False
+
+    def _mint_id(self, req_id: str | None) -> str:
+        with self._id_lock:
+            if req_id is not None:
+                return req_id
+            rid = f"r{self._next_id}"
+            self._next_id += 1
+            return rid
+
+    def submit(self, spec: SolveSpec, scale: float = 1.0,
+               req_id: str | None = None):
+        """Route one request: affinity -> burn-spill -> shortest queue.
+        Raises QueueFull (fleet-level, journaled) when every lane is at
+        capacity. Returns the lane broker's PendingRequest."""
+        rid = self._mint_id(req_id)
+
+        def depth(ln):
+            return ln.broker.pending_count()
+
+        affine = [ln for ln in self.lanes if self._lane_holds(ln, spec)]
+        candidates = affine or list(self.lanes)
+        chosen = min(candidates, key=depth)
+        # burn-spill retarget: only to a colder lane WITH ROOM — the
+        # final placement must be settled BEFORE anything is journaled,
+        # or the spill record and the route record could name different
+        # lanes (a spill "to" a full lane would bounce right back to
+        # the burning one while the evidence claimed otherwise)
+        spill_from, burn = None, chosen.metrics.fast_burn_rate()
+        if burn > self.spill_burn and len(self.lanes) > 1:
+            colder = [ln for ln in self.lanes if ln is not chosen
+                      and ln.metrics.fast_burn_rate() <= self.spill_burn
+                      and depth(ln) < self.queue_max]
+            if colder:
+                spill_from, chosen = chosen, min(colder, key=depth)
+        if depth(chosen) >= self.queue_max:
+            # the chosen lane is full: fall over to ANY lane with room;
+            # none -> shed FLEET-level before any WAL record exists, so
+            # the ledger never sees an admit racing a shed
+            others = [ln for ln in self.lanes
+                      if depth(ln) < self.queue_max]
+            if not others:
+                self.fleet_metrics.shed(
+                    rid, sum(depth(ln) for ln in self.lanes))
+                raise QueueFull(
+                    f"fleet at capacity ({len(self.lanes)} lanes x "
+                    f"{self.queue_max})")
+            chosen = min(others, key=depth)
+            spill_from = None  # the burn retarget did not decide this
+        spill = spill_from is not None
+        # the affinity flag records the DECISION, so it reads off the
+        # affine set computed at decision time — a concurrent eviction
+        # between the probe and here must not flip the journaled flag
+        # (the perfgate pins the hit-rate as a hard counter)
+        affinity = chosen in affine
+        pending = chosen.broker.submit(spec, scale, req_id=rid)
+        if spill:
+            self.fleet_metrics.spill(rid, spill_from.label,
+                                     chosen.label, burn)
+        self.fleet_metrics.route(rid, chosen.label, affinity, spill,
+                                 depth(chosen))
+        return pending
+
+    def wait(self, pending, timeout_s: float | None = None) -> dict:
+        """Lane-agnostic (the pending carries its own event) — same
+        contract as Broker.wait."""
+        if pending.done.wait(timeout_s):
+            return pending.result
+        return {"ok": False, "id": pending.id,
+                "error": f"response wait exceeded {timeout_s}s",
+                "failure_class": "timeout", "retriable": True}
+
+    # -- warmup / artifacts ------------------------------------------------
+
+    def warmup(self, specs, bucket: int | None = None) -> list:
+        """Prebuild each spec on its affinity home (round-robin over
+        lanes). With an artifact store attached the builds publish, so
+        every OTHER lane can later warm the same spec with zero
+        compiles."""
+        out = []
+        for i, spec in enumerate(specs):
+            lane = self.lanes[i % len(self.lanes)]
+            out.extend(lane.broker.warmup([spec], bucket=bucket))
+        return out
+
+    # -- balancing ---------------------------------------------------------
+
+    def _balance_loop(self) -> None:
+        while not self._stop:
+            time.sleep(self.balance_interval_s)
+            try:
+                self.rebalance_once()
+            except Exception:
+                # the balancer must never die mid-incident; a failed
+                # pass retries on the next tick
+                pass
+
+    def rebalance_once(self) -> int:
+        """One stealing pass: move half the depth gap from the fattest
+        queue's tail to the thinnest lane when the gap reaches the
+        threshold. Returns the number of requests moved."""
+        if len(self.lanes) < 2:
+            return 0
+        depths = [(ln.broker.pending_count(), ln) for ln in self.lanes]
+        fat_d, fat = max(depths, key=lambda t: t[0])
+        thin_d, thin = min(depths, key=lambda t: t[0])
+        if fat is thin or fat_d - thin_d < self.steal_threshold:
+            return 0
+        stolen = fat.broker.steal_requests((fat_d - thin_d) // 2)
+        if not stolen:
+            return 0
+        thin.broker.adopt_pending(stolen)
+        self.fleet_metrics.steal(fat.label, thin.label, len(stolen))
+        return len(stolen)
+
+    # -- standby adoption (broker replication) -----------------------------
+
+    def adopt_journal(self, journal) -> dict:
+        """Adopt a dead primary's write-ahead journal: fold the
+        admitted-but-unresponded set (torn tails dropped by
+        read_records' rule), resume the id space past every journaled
+        id, and route each outstanding request through the normal
+        affinity logic under its ORIGINAL id. Returns {"plan",
+        "pending", "routed", "skipped"}; the exactly-once contract then
+        holds over the WHOLE journal — both generations.
+
+        Adoption before traffic is the standby PROTOCOL, not an
+        optimisation: even with zero outstanding requests the id-space
+        handoff is what keeps the standby's fresh ids from colliding
+        with the dead generation's in the shared journal (a collision
+        reads as a duplicate response in the exactly-once ledger —
+        the perfgate fleet leg pins exactly this)."""
+        from .recovery import RecoveryPlan, fold_outstanding
+
+        plan = (journal if isinstance(journal, RecoveryPlan)
+                else fold_outstanding(journal))
+        if plan.max_numeric_id:
+            with self._id_lock:
+                self._next_id = max(self._next_id,
+                                    plan.max_numeric_id + 1)
+        pending = []
+        skipped = 0
+        for req in plan.outstanding:
+            try:
+                spec = SolveSpec(**req["spec"])
+                spec.validate()
+                affine = [ln for ln in self.lanes
+                          if self._lane_holds(ln, spec)]
+                lane = min(affine or self.lanes,
+                           key=lambda ln: ln.broker.pending_count())
+            except Exception:
+                lane = self.lanes[0]  # terminal-answer path below
+            p = lane.broker._replay_request(req)
+            if p is None:
+                skipped += 1
+                continue
+            pending.append(p)
+        self.fleet_metrics.adopt(len(plan.outstanding), len(pending),
+                                 skipped, plan.corrupt)
+        return {"plan": plan, "pending": pending,
+                "routed": len(pending), "skipped": skipped}
+
+    # -- snapshot / shutdown -----------------------------------------------
+
+    def metrics_snapshot(self, memory: dict | None = None) -> dict:
+        """Fleet /metrics: aggregated totals (the Broker snapshot's
+        vocabulary, so existing consumers keep working), a `fleet`
+        block (routing/steal/spill counters + artifact-store stats) and
+        a per-lane `lanes` list."""
+        lane_snaps = []
+        for ln in self.lanes:
+            snap = ln.metrics.snapshot(cache_stats=ln.cache.stats())
+            snap["device"] = ln.label
+            snap["queue_depth"] = ln.broker.pending_count()
+            lane_snaps.append(snap)
+        sum_keys = ("requests_total", "shed_total", "completed",
+                    "failed", "batches", "midsolve_admissions",
+                    "padded_lanes_total", "broker_retries",
+                    "batch_resumes", "recovery_runs",
+                    "recovered_requests", "queue_depth")
+        out: dict = {k: sum(s.get(k, 0) for s in lane_snaps)
+                     for k in sum_keys}
+        # fleet-level sheds (every lane full) count into the top-level
+        # shed_total next to the lanes' own admission-control sheds —
+        # the perfgate shed gate must see fleet-mode shedding too
+        out["shed_total"] += self.fleet_metrics.sheds
+        cache_keys = ("entries", "hits", "misses", "evictions",
+                      "compiles", "warm_loads")
+        out["cache"] = {k: sum(s["cache"].get(k, 0) for s in lane_snaps)
+                        for k in cache_keys}
+        hit = sum(s["cache"].get("hits", 0) for s in lane_snaps)
+        miss = sum(s["cache"].get("misses", 0) for s in lane_snaps)
+        out["cache"]["hit_rate"] = hit / (hit + miss) if hit + miss else 0.0
+        breq = [(s["cache_hit_rate_requests"],
+                 s["requests_total"]) for s in lane_snaps]
+        tot = sum(n for _, n in breq)
+        out["cache_hit_rate_requests"] = (
+            sum(r * n for r, n in breq) / tot if tot else 0.0)
+        lat = sorted(x for ln in self.lanes
+                     for x in ln.metrics.latency_samples())
+        from .metrics import _pct
+
+        out["latency_p50_s"] = _pct(lat, 0.50)
+        out["latency_p95_s"] = _pct(lat, 0.95)
+        out["latency_p99_s"] = _pct(lat, 0.99)
+        fleet = self.fleet_metrics.snapshot()
+        fleet["devices"] = len(self.lanes)
+        if self.artifacts is not None:
+            fleet["artifacts"] = self.artifacts.stats()
+        out["fleet"] = fleet
+        out["lanes"] = [
+            {"device": s["device"], "queue_depth": s["queue_depth"],
+             "requests_total": s["requests_total"],
+             "completed": s["completed"], "failed": s["failed"],
+             "batches": s["batches"],
+             "mean_live_lanes": s["mean_live_lanes"],
+             "midsolve_admissions": s["midsolve_admissions"],
+             "cache": s["cache"],
+             **({"slo": s["slo"]} if "slo" in s else {})}
+            for s in lane_snaps]
+        if memory is not None:
+            out["memory"] = memory
+        return out
+
+    def shutdown(self, timeout_s: float = 5.0) -> None:
+        self._stop = True
+        if self._balancer is not None:
+            self._balancer.join(timeout_s)
+        for ln in self.lanes:
+            ln.broker.shutdown(timeout_s)

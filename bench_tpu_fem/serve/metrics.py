@@ -85,10 +85,15 @@ class Metrics:
 
     def __init__(self, journal_path: str | None = None,
                  slo_objective_s: float | None = None,
-                 slo_target: float = 0.99):
+                 slo_target: float = 0.99,
+                 device: str | None = None):
         self.journal = Journal(journal_path) if journal_path else None
         self.slo_objective_s = slo_objective_s
         self.slo_target = slo_target
+        # fleet lanes stamp their device label on every journal record
+        # (ISSUE 13): per-device occupancy/affinity stories replay from
+        # the one shared journal file
+        self.device = device
         # (wall ts, latency, ok) samples for the burn-rate windows;
         # bounded like every other metrics series
         self._slo_samples: deque = deque(maxlen=_LATENCY_WINDOW)
@@ -120,6 +125,8 @@ class Metrics:
 
     def _journal(self, rec: dict) -> None:
         if self.journal is not None:
+            if self.device is not None:
+                rec = {**rec, "device": self.device}
             self.journal.append(rec)
 
     # -- events ------------------------------------------------------------
@@ -263,6 +270,35 @@ class Metrics:
         with self._lock:
             self.queue_depth = depth
 
+    def latency_samples(self) -> list:
+        """Copy of the bounded response-latency window (the fleet
+        snapshot merges lanes' samples for fleet-wide percentiles)."""
+        with self._lock:
+            return list(self.latencies)
+
+    def fast_burn_rate(self) -> float:
+        """Fast-window SLO burn rate as a CONTROL SIGNAL (ISSUE 13): the
+        fleet dispatcher spills arrivals away from a lane whose
+        fast-window burn exceeds 1 (the PR 10 alert input becomes a
+        routing input). 0.0 when SLO tracking is unarmed. Cached for
+        250 ms so the per-submit routing cost stays negligible."""
+        if self.slo_objective_s is None:
+            return 0.0
+        now = time.time()
+        with self._lock:
+            cached = getattr(self, "_burn_cache", None)
+            if cached is not None and now - cached[0] < 0.25:
+                return cached[1]
+            samples = list(self._slo_samples)
+        from ..obs.regress import burn_rates
+
+        burn = burn_rates(samples, objective_s=self.slo_objective_s,
+                          target=self.slo_target,
+                          now=now)["fast_burn_rate"]
+        with self._lock:
+            self._burn_cache = (now, burn)
+        return burn
+
     # -- snapshot ----------------------------------------------------------
 
     def snapshot(self, cache_stats: dict | None = None,
@@ -339,6 +375,112 @@ class Metrics:
         return out
 
 
+class FleetMetrics:
+    """Fleet-level counters + journal events (ISSUE 13): routing
+    decisions, steals, spills and standby adoptions, on the SAME shared
+    journal file as the lanes' serve records (harness.journal appends
+    are O_APPEND-atomic across writers, the chaos-proven multi-writer
+    discipline).
+
+    Record schema (all lines also carry the journal's v/seq/ts):
+
+      {"event": "fleet_route", "id": ..., "device": D,
+                "affinity": bool, "spill": bool, "queue_depth": N}
+      {"event": "fleet_steal", "src": D1, "dst": D2, "count": K}
+      {"event": "fleet_spill", "id": ..., "src": D1, "dst": D2,
+                "fast_burn": ...}
+      {"event": "fleet_adopt", "outstanding": N, "routed": N,
+                "skipped": N, "corrupt_lines": N}
+
+    Affinity hit-rate is ROUTING-decision-weighted: hits / routed, a hit
+    being a request sent to a device whose cache (or warm source)
+    already held its (spec, bucket) executable at decision time."""
+
+    def __init__(self, journal_path: str | None = None):
+        self.journal = Journal(journal_path) if journal_path else None
+        self._lock = threading.Lock()
+        self.routed = 0
+        self.affinity_hits = 0
+        self.affinity_misses = 0
+        self.steals = 0  # requests moved between device queues
+        self.steal_events = 0  # balancer passes that moved anything
+        self.spills = 0  # burn-rate-driven reroutes away from hot lanes
+        self.sheds = 0  # fleet-level sheds (every lane at capacity)
+        self.adoptions = 0  # standby journal adoptions
+        self.adopted_requests = 0
+
+    def _journal(self, rec: dict) -> None:
+        if self.journal is not None:
+            self.journal.append(rec)
+
+    def route(self, req_id: str, device: str, affinity: bool,
+              spill: bool, queue_depth: int) -> None:
+        self._journal({"event": "fleet_route", "id": req_id,
+                       "device": device, "affinity": bool(affinity),
+                       "spill": bool(spill),
+                       "queue_depth": int(queue_depth)})
+        with self._lock:
+            self.routed += 1
+            if affinity:
+                self.affinity_hits += 1
+            else:
+                self.affinity_misses += 1
+            if spill:
+                self.spills += 1
+
+    def steal(self, src: str, dst: str, count: int) -> None:
+        self._journal({"event": "fleet_steal", "src": src, "dst": dst,
+                       "count": int(count)})
+        with self._lock:
+            self.steals += int(count)
+            self.steal_events += 1
+
+    def spill(self, req_id: str, src: str, dst: str,
+              fast_burn: float) -> None:
+        self._journal({"event": "fleet_spill", "id": req_id, "src": src,
+                       "dst": dst, "fast_burn": round(float(fast_burn),
+                                                      4)})
+
+    def shed(self, req_id: str, queue_depth: int) -> None:
+        """Fleet-level shed (every lane at capacity): journaled BEFORE
+        any write-ahead record exists for the id, COUNTED so /metrics
+        shed_total and the perfgate shed gate see fleet-mode sheds —
+        a journal-only record would hide a shedding regression from
+        every live counter."""
+        self._journal({"event": "serve_shed", "id": req_id,
+                       "failure_class": "transient", "device": "fleet",
+                       "queue_depth": int(queue_depth)})
+        with self._lock:
+            self.sheds += 1
+
+    def adopt(self, outstanding: int, routed: int, skipped: int,
+              corrupt: int) -> None:
+        self._journal({"event": "fleet_adopt",
+                       "outstanding": int(outstanding),
+                       "routed": int(routed), "skipped": int(skipped),
+                       "corrupt_lines": int(corrupt)})
+        with self._lock:
+            self.adoptions += 1
+            self.adopted_requests += int(routed)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            routed = self.routed
+            return {
+                "routed": routed,
+                "affinity_hits": self.affinity_hits,
+                "affinity_misses": self.affinity_misses,
+                "affinity_hit_rate": (
+                    self.affinity_hits / routed if routed else 0.0),
+                "steals": self.steals,
+                "steal_events": self.steal_events,
+                "spills": self.spills,
+                "sheds": self.sheds,
+                "adoptions": self.adoptions,
+                "adopted_requests": self.adopted_requests,
+            }
+
+
 # --------------------------------------------------------------------------
 # Prometheus text exposition (GET /metrics content negotiation).
 
@@ -350,6 +492,10 @@ _PROM_COUNTERS = frozenset({
     "padded_lanes_total", "midsolve_admissions",
     "broker_retries", "batch_resumes", "recovery_runs",
     "recovered_requests",
+    # fleet block leaves (flattened as fleet_<leaf>): monotone counters
+    "fleet_routed", "fleet_affinity_hits", "fleet_affinity_misses",
+    "fleet_steals", "fleet_steal_events", "fleet_spills", "fleet_sheds",
+    "fleet_adoptions", "fleet_adopted_requests",
 })
 
 
@@ -441,6 +587,10 @@ def replay_serve(journal_path: str) -> dict:
         "live_lane_boundaries": 0, "boundaries_total": 0,
         "broker_retries": 0, "batch_resumes": 0, "recovery_runs": 0,
         "recovered_requests": 0,
+        # fleet events (ISSUE 13): routing/steal/spill/adoption evidence
+        "fleet_routed": 0, "fleet_affinity_hits": 0, "fleet_steals": 0,
+        "fleet_steal_events": 0, "fleet_spills": 0, "fleet_adoptions": 0,
+        "requests_by_device": {},
     }
     warm_lat: list[float] = []
     occupancy: list[dict] = []  # (seq, iter, live) — occupancy over time
@@ -448,6 +598,10 @@ def replay_serve(journal_path: str) -> dict:
         ev = rec.get("event")
         if ev == "serve_request":
             out["requests"] += 1
+            dev = rec.get("device")
+            if dev is not None:
+                out["requests_by_device"][dev] = (
+                    out["requests_by_device"].get(dev, 0) + 1)
         elif ev == "serve_shed":
             out["shed"] += 1
             fc = rec.get("failure_class", "transient")
@@ -486,6 +640,17 @@ def replay_serve(journal_path: str) -> dict:
         elif ev == "serve_recover":
             out["recovery_runs"] += 1
             out["recovered_requests"] += int(rec.get("replayed", 0))
+        elif ev == "fleet_route":
+            out["fleet_routed"] += 1
+            if rec.get("affinity"):
+                out["fleet_affinity_hits"] += 1
+            if rec.get("spill"):
+                out["fleet_spills"] += 1
+        elif ev == "fleet_steal":
+            out["fleet_steal_events"] += 1
+            out["fleet_steals"] += int(rec.get("count", 0))
+        elif ev == "fleet_adopt":
+            out["fleet_adoptions"] += 1
         elif ev == "serve_response":
             if rec.get("ok"):
                 out["responses_ok"] += 1
@@ -507,6 +672,9 @@ def replay_serve(journal_path: str) -> dict:
     out["mean_live_lanes"] = (
         out["live_lane_boundaries"] / out["boundaries_total"]
         if out["boundaries_total"] else 0.0)
+    out["fleet_affinity_hit_rate"] = (
+        out["fleet_affinity_hits"] / out["fleet_routed"]
+        if out["fleet_routed"] else 0.0)
     warm = sorted(warm_lat)
     out["latency_warm_p50_s"] = _pct(warm, 0.50)
     out["latency_warm_p95_s"] = _pct(warm, 0.95)

@@ -81,6 +81,16 @@ def fold_outstanding(path_or_records) -> RecoveryPlan:
         elif ev == "serve_shed" and rid:
             plan.shed += 1
             shed.add(rid)
+            # shed ids advance the id-space handoff too: a fleet-level
+            # shed journals a fleet-minted id with NO serve_request
+            # record, and a standby that re-minted it would journal a
+            # serve_request whose id sits in the shed set — a later
+            # crash would then read that admitted request as shed (not
+            # outstanding, not lost): a silent, ledger-clean loss
+            m = _NUMERIC_ID.match(str(rid))
+            if m:
+                plan.max_numeric_id = max(plan.max_numeric_id,
+                                          int(m.group(1)))
     plan.outstanding = [req for rid, req in requested.items()
                        if rid not in answered and rid not in shed]
     return plan

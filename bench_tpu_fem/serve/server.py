@@ -1,5 +1,10 @@
 """Localhost HTTP/JSON front end over the broker (stdlib http.server).
 
+The same handler fronts a single Broker or a FleetDispatcher
+(serve.fleet) — both expose submit/wait/metrics_snapshot/shutdown; the
+fleet's /metrics additionally carries the `fleet` block (routing/steal/
+spill counters, artifact-store stats) and the per-lane `lanes` list.
+
 Endpoints:
 
   POST /solve    {"degree": 3, "ndofs": 50000, "nreps": 30,
@@ -75,9 +80,7 @@ def make_handler(broker: Broker, request_timeout_s: float = 300.0,
                 from ..obs.memory import memory_summary
                 from .metrics import prometheus_text
 
-                snap = broker.metrics.snapshot(
-                    cache_stats=broker.cache.stats(),
-                    memory=memory_summary())
+                snap = broker.metrics_snapshot(memory=memory_summary())
                 accept = (self.headers.get("Accept", "") or "").lower()
                 fmt = (parse_qs(url.query).get("format", [""])[0]
                        or "").lower()
@@ -151,6 +154,13 @@ def make_server(broker: Broker, host: str = "127.0.0.1", port: int = 0,
     `server.server_address[1]`). The caller owns serve_forever/shutdown
     — tests run it on a thread, the CLI blocks on it."""
     handler = make_handler(broker, request_timeout_s, quiet)
-    srv = ThreadingHTTPServer((host, port), handler)
-    srv.daemon_threads = True
-    return srv
+
+    class _Server(ThreadingHTTPServer):
+        daemon_threads = True
+        # stdlib default backlog is 5: a fleet loadgen's 32-connection
+        # burst overflows it and reads as connection resets at the
+        # client — raise it to the broker's own admission scale (the
+        # QUEUE stays the single backpressure point, not the socket)
+        request_queue_size = 128
+
+    return _Server((host, port), handler)

@@ -22,6 +22,16 @@ stack and asserts the recovery invariants:
      right after a snapshot (``CHAOS_CKPT_KILL_AFTER``); the resumed run
      must match the uninterrupted solve BITWISE (the la.checkpoint
      restore proof, end-to-end through a real process death).
+  5. standby adoption (ISSUE 13) — a PRIMARY FLEET (2 device lanes +
+     shared artifact store) is SIGKILL'd mid-incident; the parent tears
+     the journal tail, then a STANDBY fleet adopts the journal
+     (``FleetDispatcher.adopt_journal``: the PR 9 fold + id-space
+     handoff), warms its executables from the artifact store with ZERO
+     compiles, answers every outstanding request, and
+     ``verify_exactly_once`` must hold over BOTH generations.
+
+``--legs`` selects a subset (generations,crash,nan,preempt,standby) —
+the CI fleet lane runs ``--legs standby`` next to the loadgen smoke.
 
 All CPU (``JAX_PLATFORMS=cpu`` is pinned — this is a software-recovery
 proof, not a hardware measurement; snapshot/restore on real HBM stays
@@ -235,6 +245,164 @@ def run_generations(quick: bool) -> int:
 
 
 # ---------------------------------------------------------------------------
+# standby adoption (leg 5, ISSUE 13)
+# ---------------------------------------------------------------------------
+
+
+def fleet_child(journal: str, artdir: str, generation: int,
+                nreq: int) -> int:
+    """One FLEET generation against the shared journal + artifact
+    store. Gen 1 (the primary) warms, publishes artifacts, submits a
+    burst and prints INFLIGHT (the kill cue). Gen >= 2 (the standby)
+    ADOPTS the journal first — answering the dead primary's outstanding
+    requests under their original ids, executables warmed from the
+    artifact store — then serves fresh traffic and reports cache
+    counters for the parent's zero-recompile assertion."""
+    _pin_cpu()
+    import threading
+
+    from bench_tpu_fem.serve import (
+        ArtifactStore,
+        FleetDispatcher,
+        SolveSpec,
+    )
+
+    store = ArtifactStore(artdir)
+    fleet = FleetDispatcher(2, journal_path=journal, artifacts=store,
+                            queue_max=256, nrhs_max=4, window_s=0.02,
+                            solve_timeout_s=120.0, steal_threshold=4,
+                            balance_interval_s=0.02)
+    spec = SolveSpec(**SPEC_KW)
+    pending = []
+    if generation >= 2:
+        rec = fleet.adopt_journal(journal)
+        log(f"standby gen{generation}: adopted {rec['routed']} "
+            f"outstanding ({rec['skipped']} skipped, "
+            f"{rec['plan'].corrupt} corrupt)")
+        pending.extend(rec["pending"])
+    else:
+        fleet.warmup([spec])
+    log(f"fleet gen{generation}: submitting {nreq} requests")
+    for i in range(nreq):
+        pending.append(fleet.submit(spec, scale=2.0 ** (i % 3)))
+    print("INFLIGHT", len(pending), flush=True)
+    waits = []
+    for p in pending:
+        t = threading.Thread(target=lambda p=p: waits.append(
+            fleet.wait(p, 180)), daemon=True)
+        t.start()
+        t.join(240)
+    snap = fleet.metrics_snapshot()
+    fleet.shutdown()
+    print("SNAPSHOT", json.dumps(snap), flush=True)
+    bad = [w for w in waits if not w.get("ok")]
+    print("SERVED", len(waits) - len(bad), "FAILED", len(bad), flush=True)
+    return 0
+
+
+def run_standby(quick: bool) -> int:
+    """Leg 5: kill-the-primary mid-incident; the standby fleet must
+    adopt the journal, warm from the artifact store with zero compiles,
+    and answer every outstanding request exactly once."""
+    from bench_tpu_fem.harness.chaos import tear_journal_tail
+    from bench_tpu_fem.serve.recovery import (
+        fold_outstanding,
+        verify_exactly_once,
+    )
+    from bench_tpu_fem.harness.journal import read_records
+
+    tmp = tempfile.mkdtemp(prefix="chaos_standby_")
+    journal = os.path.join(tmp, "FLEET_chaos.jsonl")
+    artdir = os.path.join(tmp, "artifacts")
+    nreq = 6 if quick else 16
+
+    # the primary: killed mid-incident
+    child = subprocess.Popen(
+        [sys.executable, "-u", __file__, "--fleet-child", "1",
+         "--journal", journal, "--artifacts", artdir,
+         "--nreq", str(nreq)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=CHILD_ENV, cwd=ROOT, start_new_session=True)
+    killed = False
+    hung = threading.Event()
+
+    def _watchdog():
+        hung.set()
+        try:
+            os.killpg(child.pid, signal.SIGKILL)
+        except (OSError, ProcessLookupError):
+            pass
+
+    wd = threading.Timer(300, _watchdog)
+    wd.start()
+    try:
+        for line in child.stdout:  # type: ignore[union-attr]
+            print("  primary|", line.rstrip(), flush=True)
+            if line.startswith("INFLIGHT"):
+                time.sleep(0.2)  # let batches reach mid-solve
+                os.killpg(child.pid, signal.SIGKILL)
+                killed = True
+                break
+            if hung.is_set():
+                break
+    finally:
+        wd.cancel()
+    child.wait(30)
+    if hung.is_set() and not killed:
+        return fail("primary hung without output for 300 s")
+    if not killed:
+        return fail("primary never reported INFLIGHT (kill cue missed)")
+    log(f"primary SIGKILL'd (rc {child.returncode})")
+
+    outstanding = fold_outstanding(journal).outstanding
+    log(f"journal holds {len(outstanding)} admitted-unresponded requests")
+    if not outstanding:
+        return fail("SIGKILL left no outstanding requests — nothing "
+                    "for the standby to adopt")
+    # the crash-mid-write bytes: a torn response must not count answered
+    tear_journal_tail(journal, rid=outstanding[0]["id"])
+
+    # the standby: adopt + serve fresh traffic
+    out = subprocess.run(
+        [sys.executable, "-u", __file__, "--fleet-child", "2",
+         "--journal", journal, "--artifacts", artdir, "--nreq", "2"],
+        capture_output=True, text=True, timeout=600, env=CHILD_ENV,
+        cwd=ROOT)
+    print("  standby|", out.stdout.strip().replace("\n", "\n  standby| "),
+          flush=True)
+    if out.returncode != 0:
+        return fail(f"standby exited rc {out.returncode}")
+    snap = None
+    for line in out.stdout.splitlines():
+        if line.startswith("SNAPSHOT "):
+            snap = json.loads(line[len("SNAPSHOT "):])
+    if snap is None:
+        return fail("standby reported no metrics snapshot")
+    fleet = snap.get("fleet") or {}
+    if fleet.get("adoptions", 0) < 1 or fleet.get(
+            "adopted_requests", 0) < 1:
+        return fail(f"standby adoption not counted: {fleet}")
+    cache = snap.get("cache") or {}
+    if cache.get("compiles", 0) != 0:
+        return fail("standby COMPILED instead of warming from the "
+                    f"artifact store: {cache}")
+    if cache.get("warm_loads", 0) < 1:
+        return fail(f"standby never warm-loaded an artifact: {cache}")
+
+    verdict = verify_exactly_once(journal)
+    log(f"exactly-once verdict (both generations): {verdict}")
+    if not verdict["ok"]:
+        return fail(f"exactly-once violated across generations: "
+                    f"lost={verdict['lost']} "
+                    f"duplicates={verdict['duplicates']}")
+    records, _ = read_records(journal)
+    if not any(r.get("event") == "fleet_adopt" for r in records):
+        return fail("no fleet_adopt record in the journal")
+    log("leg 5 (kill-primary -> standby adoption, zero recompiles) OK")
+    return 0
+
+
+# ---------------------------------------------------------------------------
 # in-process legs
 # ---------------------------------------------------------------------------
 
@@ -386,17 +554,34 @@ def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("--quick", action="store_true",
                    help="bound the soak to ~60 s (the CI chaos lane)")
+    p.add_argument("--legs", default="",
+                   help="comma-separated subset of "
+                        "generations,crash,nan,preempt,standby "
+                        "(default: all)")
     p.add_argument("--serve-child", type=int, default=0,
                    help=argparse.SUPPRESS)  # internal: generation driver
+    p.add_argument("--fleet-child", type=int, default=0,
+                   help=argparse.SUPPRESS)  # internal: standby driver
     p.add_argument("--journal", default="", help=argparse.SUPPRESS)
+    p.add_argument("--artifacts", default="", help=argparse.SUPPRESS)
     p.add_argument("--nreq", type=int, default=8, help=argparse.SUPPRESS)
     args = p.parse_args(argv)
     if args.serve_child:
         return serve_child(args.journal, args.serve_child, args.nreq)
+    if args.fleet_child:
+        return fleet_child(args.journal, args.artifacts,
+                           args.fleet_child, args.nreq)
+    legs = {"generations": run_generations, "crash": run_worker_crash,
+            "nan": run_nan_injection, "preempt": run_preemption,
+            "standby": run_standby}
+    selected = ([s.strip() for s in args.legs.split(",") if s.strip()]
+                or list(legs))
+    unknown = [s for s in selected if s not in legs]
+    if unknown:
+        return fail(f"unknown legs {unknown} (choose from {list(legs)})")
     t0 = time.monotonic()
-    for leg in (run_generations, run_worker_crash, run_nan_injection,
-                run_preemption):
-        rc = leg(args.quick)
+    for name in selected:
+        rc = legs[name](args.quick)
         if rc:
             return rc
     log(f"CHAOS SOAK OK ({time.monotonic() - t0:.1f}s)")

@@ -23,6 +23,12 @@ Three in-process measurements (no subprocesses, no network):
     contributes compile counts, request-weighted cache hit-rate,
     shed/failed counts and the SLO burn-rate state from the journaled
     request lifecycles.
+  * **fleet** (ISSUE 13): a 2-lane fleet with a shared artifact store
+    on a PINNED hang-and-rebalance schedule — contributes the
+    deterministic steal count, routing-weighted affinity hit-rate,
+    warm-load counts, the standby replica's recompile count (== 0, the
+    shared-artifact acceptance) and the journal's exactly-once ledger
+    (lost/duplicates == 0).
 
 The counters land in ``snapshot["counters"]`` (the hard gate);
 wall-clock distributions stay inside the per-section ``timing`` blocks
@@ -183,6 +189,73 @@ def main(argv=None) -> int:
         "corrupt_lines": len(corrupt),
     }
 
+    # -- fleet leg (ISSUE 13): deterministic routing/steal/warm counters.
+    # A 2-lane fleet with a shared artifact store and the balancer on
+    # MANUAL (balance_interval_s=0): lane0 warms + publishes one spec;
+    # its first solve is scripted to hang (FaultySolveHook) while 6 more
+    # requests queue behind it, so ONE manual rebalance pass moves
+    # EXACTLY (6-0)//2 = 3 requests to lane1, which warm-loads the
+    # executable from the store (zero compiles). Then a STANDBY fleet on
+    # the same store serves its first request — the warm-replica
+    # recompiles == 0 acceptance counter. All counts are deterministic
+    # functions of this pinned schedule, so they gate hard.
+    import shutil
+
+    import bench_tpu_fem.serve.engine as serve_engine
+    from bench_tpu_fem.harness.faults import FaultySolveHook
+    from bench_tpu_fem.serve.artifacts import ArtifactStore
+    from bench_tpu_fem.serve.fleet import FleetDispatcher
+    from bench_tpu_fem.serve.recovery import verify_exactly_once
+
+    fleet_journal = args.out + ".fleet.jsonl"
+    artdir = args.out + ".artifacts"
+    for path in (fleet_journal,):
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+    shutil.rmtree(artdir, ignore_errors=True)
+    fspec = SolveSpec(degree=3, ndofs=4000, nreps=30)
+    store = ArtifactStore(artdir)
+    primary = FleetDispatcher(2, journal_path=fleet_journal,
+                              artifacts=store, queue_max=64, nrhs_max=4,
+                              window_s=0.01, balance_interval_s=0)
+    primary.warmup([fspec])
+    serve_engine.FAULT_HOOK = FaultySolveHook(["hang"], hang_s=1.5)
+    try:
+        fpend = [primary.submit(fspec, scale=1.0)]
+        _time.sleep(0.4)  # lane0's worker is inside the hung solve
+        fpend += [primary.submit(fspec, scale=float(2 ** (i % 3)))
+                  for i in range(6)]
+        moved = primary.rebalance_once()
+        fouts = [primary.wait(p, 120.0) for p in fpend]
+    finally:
+        serve_engine.FAULT_HOOK = None
+    fsnap = primary.metrics_snapshot()
+    primary.shutdown()
+    # standby replica: same store, fresh caches — its first matching
+    # request must be served from a warm artifact load, zero compiles.
+    # Adoption BEFORE traffic is the standby protocol even with nothing
+    # outstanding: it hands off the id space, so fresh ids never
+    # collide with the dead generation's in the shared journal (the
+    # exactly-once ledger's duplicate check would catch exactly that)
+    standby = FleetDispatcher(2, journal_path=fleet_journal,
+                              artifacts=store, queue_max=64, nrhs_max=4,
+                              window_s=0.01, balance_interval_s=0)
+    standby.adopt_journal(fleet_journal)
+    sout = standby.wait(standby.submit(fspec, scale=2.0), 120.0)
+    ssnap = standby.metrics_snapshot()
+    standby.shutdown()
+    fleet_ledger = verify_exactly_once(fleet_journal)
+    fleet_leg = {
+        "ok_responses": sum(1 for o in fouts if o.get("ok")),
+        "moved": moved,
+        "primary": {"fleet": fsnap["fleet"], "cache": fsnap["cache"]},
+        "standby": {"ok": bool(sout.get("ok")),
+                    "fleet": ssnap["fleet"], "cache": ssnap["cache"]},
+        "exactly_once": fleet_ledger,
+    }
+
     # -- trace validity + record contract (contract booleans gate)
     from bench_tpu_fem.obs.trace import validate_chrome_trace
 
@@ -212,6 +285,20 @@ def main(argv=None) -> int:
         "corrupt_lines": len(corrupt),
         "record_contract_ok": not record_errs,
         "trace_valid": not trace_violations,
+        # ISSUE 13 fleet counters: deterministic functions of the
+        # pinned hang-and-rebalance schedule above. steals pins the
+        # balancer's half-the-gap move; affinity is routing-decision-
+        # weighted (every request routed to the lane already holding
+        # the executable); warm-replica recompiles == 0 is THE shared-
+        # artifact acceptance; lost/duplicates come from the journal's
+        # exactly-once ledger over both fleets.
+        "fleet_steals": fsnap["fleet"]["steals"],
+        "fleet_affinity_hit_rate": fsnap["fleet"]["affinity_hit_rate"],
+        "fleet_warm_loads": (fsnap["cache"]["warm_loads"]
+                             + ssnap["cache"]["warm_loads"]),
+        "fleet_warm_replica_recompiles": ssnap["cache"]["compiles"],
+        "fleet_lost": len(fleet_ledger["lost"]),
+        "fleet_duplicates": len(fleet_ledger["duplicates"]),
     }
     snapshot = {
         "workload": {"ndofs": args.ndofs, "nreps": args.nreps,
@@ -224,6 +311,7 @@ def main(argv=None) -> int:
         "pcg": pcg,
         "sstep": sstep,
         "serve": serve,
+        "fleet": fleet_leg,
         "counters": counters,
         "record_contract_errors": record_errs,
         "trace_violations": trace_violations[:5],
@@ -257,6 +345,22 @@ def main(argv=None) -> int:
             and sstep_reductions_per_iter < 1.0):
         print("s-step leg did not go below one reduction per "
               f"iteration: {sstep}")
+        return 1
+    # ISSUE-13 acceptance, asserted by the collector itself: the
+    # imbalanced schedule must steal, the warm replica must not compile,
+    # and the fleet journal's exactly-once ledger must close
+    if fleet_leg["ok_responses"] != len(fouts) or not sout.get("ok"):
+        print(f"fleet leg lost requests: {fleet_leg}")
+        return 1
+    if counters["fleet_steals"] < 1:
+        print(f"fleet leg never stole under imbalance: {fleet_leg}")
+        return 1
+    if counters["fleet_warm_replica_recompiles"] != 0:
+        print("standby replica COMPILED instead of warming from the "
+              f"artifact store: {fleet_leg['standby']}")
+        return 1
+    if not fleet_ledger["ok"]:
+        print(f"fleet exactly-once ledger violated: {fleet_ledger}")
         return 1
     return 0
 

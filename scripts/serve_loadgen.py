@@ -17,6 +17,14 @@ Profiles:
                    batch keeps finding compatible queued work to admit
                    at its iteration boundaries.
 
+Fleet mode (ISSUE 13): `--fleet` switches to a worker-pool driver with
+a deterministically imbalanced degree schedule (`--weights`) against a
+`python -m bench_tpu_fem.serve --fleet N` server, reporting per-device
+occupancy, steal counts and affinity hit-rate from the /metrics fleet
+block; `--assert-affinity 0.9`, `--assert-steals` and
+`--assert-no-lost` (client accounting + the server journal's
+exactly-once ledger) fail rc 1 — the >= 640-request fleet acceptance.
+
 Journal assertions (CI serve lane): when the server journals to a file
 this loadgen can read (--journal), --assert-continuous parses it
 (plain JSONL, stdlib json) and fails the run unless it records
@@ -70,6 +78,62 @@ def _post(url: str, body: dict, timeout_s: float):
                    "failure_class": "transient", "retriable": True}
 
 
+def _pct(vals, q):
+    return (vals[min(len(vals) - 1, int(q * len(vals)))]
+            if vals else 0.0)
+
+
+def _record_response(out: dict, code: int, resp: dict,
+                     elapsed_s: float) -> None:
+    """Shared per-response bookkeeping (caller holds the lock):
+    completed/failed counts, engine-form histogram, client + server
+    latency samples, cache hits."""
+    out["latency_s"].append(round(elapsed_s, 4))
+    if code == 200 and resp.get("ok"):
+        out["completed"] += 1
+        form = resp.get("cg_engine_form", "unknown")
+        out["engine_forms"][form] = out["engine_forms"].get(form, 0) + 1
+        # the server's own span for THIS response (its
+        # enqueue->respond lifecycle total): the same request
+        # population as the client percentiles, which is what makes a
+        # percentile-vs-percentile consistency check sound
+        if isinstance(resp.get("latency_s"), (int, float)):
+            out["server_latency_s"].append(float(resp["latency_s"]))
+        if resp.get("cache") == "hit":
+            out["cache_hits"] += 1
+    else:
+        out["failed"] += 1
+        fc = resp.get("failure_class", "transient")
+        out["failed_by_class"][fc] = out["failed_by_class"].get(fc, 0) + 1
+
+
+def _finish_summary(out: dict, requests: int, t0: float,
+                    url: str) -> dict:
+    """Shared summary tail: wall clock, lost-request accounting (a
+    worker thread that died uncounted must not read as a green run),
+    client + server latency percentiles, and the /metrics fetch."""
+    out["wall_s"] = round(time.monotonic() - t0, 3)
+    lost = requests - out["completed"] - out["failed"]
+    if lost:
+        out["failed"] += lost
+        out["failed_by_class"]["lost"] = lost
+    lat = sorted(out.pop("latency_s"))
+    srv = sorted(out.pop("server_latency_s"))
+    out["latency_p50_s"] = _pct(lat, 0.50)
+    out["latency_p95_s"] = _pct(lat, 0.95)
+    out["latency_p99_s"] = _pct(lat, 0.99)
+    out["latency_max_s"] = lat[-1] if lat else 0.0
+    out["server_latency_p50_s"] = _pct(srv, 0.50)
+    out["server_latency_p95_s"] = _pct(srv, 0.95)
+    out["server_latency_p99_s"] = _pct(srv, 0.99)
+    try:
+        with urllib.request.urlopen(url + "/metrics", timeout=30) as r:
+            out["metrics"] = json.loads(r.read())
+    except OSError as exc:
+        out["metrics"] = {"error": str(exc)}
+    return out
+
+
 def run_load(url: str, requests: int = 64, concurrency: int = 16,
              degrees=(1, 2, 3), ndofs: int = 4000, nreps: int = 15,
              precision: str = "f32", timeout_s: float = 120.0,
@@ -100,27 +164,8 @@ def run_load(url: str, requests: int = 64, concurrency: int = 16,
                 time.sleep(1.0)
                 code, resp = _post(url, body, timeout_s)
             with lock:
-                out["latency_s"].append(round(time.monotonic() - t0, 4))
-                if code == 200 and resp.get("ok"):
-                    out["completed"] += 1
-                    form = resp.get("cg_engine_form", "unknown")
-                    out["engine_forms"][form] = (
-                        out["engine_forms"].get(form, 0) + 1)
-                    # the server's own span for THIS response (its
-                    # enqueue->respond lifecycle total): the same
-                    # request population as the client percentiles,
-                    # which is what makes a percentile-vs-percentile
-                    # consistency check sound
-                    if isinstance(resp.get("latency_s"), (int, float)):
-                        out["server_latency_s"].append(
-                            float(resp["latency_s"]))
-                    if resp.get("cache") == "hit":
-                        out["cache_hits"] += 1
-                else:
-                    out["failed"] += 1
-                    fc = resp.get("failure_class", "transient")
-                    out["failed_by_class"][fc] = (
-                        out["failed_by_class"].get(fc, 0) + 1)
+                _record_response(out, code, resp,
+                                 time.monotonic() - t0)
 
     t0 = time.monotonic()
     threads = [threading.Thread(target=fire, args=(i,))
@@ -131,38 +176,121 @@ def run_load(url: str, requests: int = 64, concurrency: int = 16,
             time.sleep(stagger_ms / 1000.0)
     for t in threads:
         t.join()
-    out["wall_s"] = round(time.monotonic() - t0, 3)
-    # accounting invariant: every request ends as completed or failed —
-    # a worker thread that died uncounted would break this, and a run
-    # that lost requests must not exit 0
-    lost = requests - out["completed"] - out["failed"]
-    if lost:
-        out["failed"] += lost
-        out["failed_by_class"]["lost"] = lost
-    lat = sorted(out.pop("latency_s"))
-    srv = sorted(out.pop("server_latency_s"))
+    return _finish_summary(out, requests, t0, url)
 
-    def pct(vals, q):
-        return (vals[min(len(vals) - 1, int(q * len(vals)))]
-                if vals else 0.0)
 
-    # client-side latency percentiles (the serving SLO view: includes
-    # HTTP + queue + solve) next to the percentiles of the server's own
-    # per-response spans for the SAME requests — the population the
-    # consistency check main() can assert against
-    out["latency_p50_s"] = pct(lat, 0.50)
-    out["latency_p95_s"] = pct(lat, 0.95)
-    out["latency_p99_s"] = pct(lat, 0.99)
-    out["latency_max_s"] = lat[-1] if lat else 0.0
-    out["server_latency_p50_s"] = pct(srv, 0.50)
-    out["server_latency_p95_s"] = pct(srv, 0.95)
-    out["server_latency_p99_s"] = pct(srv, 0.99)
-    try:
-        with urllib.request.urlopen(url + "/metrics", timeout=30) as r:
-            out["metrics"] = json.loads(r.read())
-    except OSError as exc:
-        out["metrics"] = {"error": str(exc)}
+def run_fleet_load(url: str, requests: int = 640, concurrency: int = 32,
+                   degrees=(1, 2, 3), weights=(4, 1, 1),
+                   ndofs: int = 4000, nreps: int = 15,
+                   precision: str = "f32",
+                   timeout_s: float = 120.0) -> dict:
+    """The fleet acceptance load (ISSUE 13): >= 10x the 64-request
+    smoke, mixed degrees under an IMBALANCED deterministic schedule
+    (`weights` — the hot degree's affinity lane backs up, which is what
+    work stealing feeds on), driven by a bounded WORKER POOL (a
+    thread-per-request model at 640+ requests would measure the
+    client's scheduler, not the server). Reports per-device occupancy,
+    steal counts and the affinity hit-rate straight from the server's
+    /metrics fleet block — the journaled fleet evidence, not a
+    client-side guess."""
+    degrees = list(degrees)
+    weights = list(weights)[:len(degrees)] or [1]
+    # deterministic imbalanced degree schedule: index i maps into the
+    # weight wheel (e.g. 4,1,1 -> d0 d0 d0 d0 d1 d2 ...)
+    wheel = [d for d, w in zip(degrees, weights) for _ in range(max(w, 1))]
+    lock = threading.Lock()
+    out = {"completed": 0, "failed": 0, "shed_retried": 0,
+           "failed_by_class": {}, "engine_forms": {}, "latency_s": [],
+           "server_latency_s": [], "cache_hits": 0}
+    counter = {"next": 0}
+
+    def worker():
+        while True:
+            with lock:
+                i = counter["next"]
+                if i >= requests:
+                    return
+                counter["next"] += 1
+            body = {"degree": wheel[i % len(wheel)], "ndofs": ndofs,
+                    "nreps": nreps, "precision": precision,
+                    "scale": float(1 + (i % 4))}
+            t0 = time.monotonic()
+            code, resp = _post(url, body, timeout_s)
+            if code != 200 and resp.get("retriable"):
+                with lock:
+                    out["shed_retried"] += 1
+                time.sleep(1.0)
+                code, resp = _post(url, body, timeout_s)
+            with lock:
+                _record_response(out, code, resp,
+                                 time.monotonic() - t0)
+
+    t0 = time.monotonic()
+    threads = [threading.Thread(target=worker)
+               for _ in range(concurrency)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    out = _finish_summary(out, requests, t0, url)
+    fleet = (out["metrics"] or {}).get("fleet") or {}
+    lanes = (out["metrics"] or {}).get("lanes") or []
+    out["fleet"] = {
+        "devices": fleet.get("devices"),
+        "affinity_hit_rate": fleet.get("affinity_hit_rate"),
+        "steals": fleet.get("steals"),
+        "steal_events": fleet.get("steal_events"),
+        "spills": fleet.get("spills"),
+        "occupancy_by_device": {
+            ln.get("device"): {
+                "requests_total": ln.get("requests_total"),
+                "completed": ln.get("completed"),
+                "mean_live_lanes": ln.get("mean_live_lanes"),
+                "midsolve_admissions": ln.get("midsolve_admissions"),
+            } for ln in lanes},
+        "warm_loads": sum((ln.get("cache") or {}).get("warm_loads", 0)
+                          for ln in lanes),
+        "compiles": sum((ln.get("cache") or {}).get("compiles", 0)
+                        for ln in lanes),
+    }
     return out
+
+
+def check_journal_exactly_once(journal_path: str) -> dict:
+    """Stdlib fold of the server journal's exactly-once ledger (the
+    --assert-no-lost evidence): every serve_request id must carry
+    EXACTLY one serve_response (or a shed). Mirrors
+    serve.recovery.verify_exactly_once without importing the repo —
+    the loadgen stays standalone."""
+    requested, shed = [], set()
+    responses: dict = {}
+    corrupt = 0
+    with open(journal_path) as fh:
+        lines = fh.read().splitlines()
+    for i, line in enumerate(lines):
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            if i != len(lines) - 1:
+                corrupt += 1  # torn tail tolerated
+            continue
+        ev, rid = rec.get("event"), rec.get("id")
+        if not rid:
+            continue
+        if ev == "serve_request":
+            requested.append(rid)
+        elif ev == "serve_response":
+            responses[rid] = responses.get(rid, 0) + 1
+        elif ev == "serve_shed":
+            shed.add(rid)
+    lost = [r for r in requested if r not in responses and r not in shed]
+    dup = sorted(r for r, n in responses.items() if n > 1)
+    return {"ok": not lost and not dup, "requested": len(requested),
+            "responded": sum(responses.values()), "lost": lost[:16],
+            "duplicates": dup[:16], "corrupt_lines": corrupt}
 
 
 def check_journal_continuous(journal_path: str) -> dict:
@@ -248,6 +376,30 @@ def main(argv=None) -> int:
                         "arrivals so the queue spans solve boundaries")
     p.add_argument("--stagger-ms", type=float, default=30.0,
                    help="ramp profile inter-arrival gap")
+    p.add_argument("--fleet", action="store_true",
+                   help="fleet acceptance mode (ISSUE 13): worker-pool "
+                        "driver with a deterministically IMBALANCED "
+                        "degree schedule (--weights), reporting "
+                        "per-device occupancy, steal counts and "
+                        "affinity hit-rate from the /metrics fleet "
+                        "block")
+    p.add_argument("--weights", default="4,1,1",
+                   help="fleet mode: per-degree arrival weights (the "
+                        "imbalance that makes the hot lane back up)")
+    p.add_argument("--assert-affinity", type=float, default=None,
+                   metavar="RATE",
+                   help="fleet: fail unless the measured affinity "
+                        "hit-rate exceeds RATE (the acceptance bar is "
+                        "0.9)")
+    p.add_argument("--assert-steals", action="store_true",
+                   help="fleet: fail unless steal count > 0 (the "
+                        "imbalanced schedule must actually trigger "
+                        "work stealing)")
+    p.add_argument("--assert-no-lost", action="store_true",
+                   help="fail unless the run lost zero requests AND "
+                        "the server journal's exactly-once ledger "
+                        "holds (no lost, no duplicate responses; "
+                        "requires --journal)")
     p.add_argument("--journal", default="",
                    help="the SERVER's journal path (for --assert-*)")
     p.add_argument("--assert-continuous", action="store_true",
@@ -264,13 +416,59 @@ def main(argv=None) -> int:
                         "server's), and warm responses surface in the "
                         "/metrics latency_warm_* table")
     args = p.parse_args(argv)
-    summary = run_load(
-        args.url, requests=args.requests, concurrency=args.concurrency,
-        degrees=[int(d) for d in args.degrees.split(",") if d.strip()],
-        ndofs=args.ndofs, nreps=args.nreps, precision=args.precision,
-        timeout_s=args.timeout, profile=args.profile,
-        stagger_ms=args.stagger_ms)
+    degrees = [int(d) for d in args.degrees.split(",") if d.strip()]
+    if args.fleet:
+        summary = run_fleet_load(
+            args.url, requests=args.requests,
+            concurrency=args.concurrency, degrees=degrees,
+            weights=[int(w) for w in args.weights.split(",")
+                     if w.strip()],
+            ndofs=args.ndofs, nreps=args.nreps,
+            precision=args.precision, timeout_s=args.timeout)
+    else:
+        summary = run_load(
+            args.url, requests=args.requests,
+            concurrency=args.concurrency, degrees=degrees,
+            ndofs=args.ndofs, nreps=args.nreps,
+            precision=args.precision,
+            timeout_s=args.timeout, profile=args.profile,
+            stagger_ms=args.stagger_ms)
     rc = 0 if summary["failed"] == 0 else 1
+    if args.assert_affinity is not None:
+        rate = (summary.get("fleet") or {}).get("affinity_hit_rate")
+        if not isinstance(rate, (int, float)) or \
+                rate <= args.assert_affinity:
+            summary["assert_affinity"] = (
+                f"FAIL: affinity hit-rate {rate} <= "
+                f"{args.assert_affinity}")
+            rc = 1
+        else:
+            summary["assert_affinity"] = "ok"
+    if args.assert_steals:
+        steals = (summary.get("fleet") or {}).get("steals")
+        if not steals:
+            summary["assert_steals"] = (
+                f"FAIL: no steals under the imbalanced schedule "
+                f"(steals={steals})")
+            rc = 1
+        else:
+            summary["assert_steals"] = "ok"
+    if args.assert_no_lost:
+        if not args.journal:
+            summary["assert_no_lost"] = "FAIL: --journal required"
+            rc = 1
+        else:
+            lost_client = summary["failed_by_class"].get("lost", 0)
+            ledger = check_journal_exactly_once(args.journal)
+            summary["journal_exactly_once"] = ledger
+            if lost_client or not ledger["ok"]:
+                summary["assert_no_lost"] = (
+                    f"FAIL: client lost {lost_client}, ledger "
+                    f"lost={ledger['lost']} "
+                    f"duplicates={ledger['duplicates']}")
+                rc = 1
+            else:
+                summary["assert_no_lost"] = "ok"
     if args.assert_continuous:
         if not args.journal:
             summary["assert_continuous"] = "FAIL: --journal required"

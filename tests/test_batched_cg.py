@@ -317,6 +317,8 @@ def test_sharded_batched_dot_vs_global_oracle():
     np.testing.assert_allclose(got, want, rtol=5e-6)
 
 
+@pytest.mark.slow  # round-12 fast-lane rebalance (ISSUE 13): 7-10 s each,
+# moved so the new fleet tests fit with >=100 s headroom
 def test_sharded_batched_cg_vs_global_oracle():
     """Batched sharded CG (make_kron_batched_cg_fn: vmapped local apply
     + psum'd batched dots) against the single-chip batched solve of the

@@ -75,6 +75,8 @@ def test_dist_apply_matches_single_device(dshape, degree, qmode):
     )
 
 
+@pytest.mark.slow  # round-12 fast-lane rebalance (ISSUE 13): 7-10 s each,
+# moved so the new fleet tests fit with >=100 s headroom
 def test_dist_cg_matches_single_device():
     from bench_tpu_fem.dist.operator import (
         build_dist_laplacian,
@@ -109,6 +111,8 @@ def test_dist_cg_matches_single_device():
     np.testing.assert_allclose(x, x_ref, rtol=1e-10, atol=1e-12)
 
 
+@pytest.mark.slow  # round-12 fast-lane rebalance (ISSUE 13): 7-10 s each,
+# moved so the new fleet tests fit with >=100 s headroom
 def test_dist_e2e_driver_golden():
     """Full distributed driver on 8 virtual devices reproduces the golden
     y_norm (weak-scaled config has a different mesh, so use mat_comp instead:

@@ -1,0 +1,397 @@
+"""Serving-fleet suite (ISSUE 13, bench_tpu_fem.serve.fleet +
+serve.artifacts): spec-aware affinity routing, deterministic work
+stealing, SLO-burn spill, artifact warm loads with zero recompiles, and
+in-process standby adoption with the id-space handoff.
+
+The subprocess SIGKILL standby case and the artifact torn/corrupt/
+collision cases live in tests/test_serve.py (the satellite's home); this
+file owns the dispatcher behaviour. Everything is CPU on the hermetic
+8-virtual-device platform; fleet numbers printed here are CPU-measured
+by construction (the `fleet` agenda stage re-measures on hardware).
+"""
+
+import json
+import time
+
+import numpy as np
+import pytest
+
+import bench_tpu_fem.serve.engine as engine_mod
+from bench_tpu_fem.harness.faults import FaultySolveHook
+from bench_tpu_fem.serve import (
+    ArtifactStore,
+    ArtifactWarmCache,
+    FleetDispatcher,
+    QueueFull,
+    SolveSpec,
+    build_solver,
+    replay_serve,
+    spec_cache_key,
+    verify_exactly_once,
+)
+
+pytestmark = [pytest.mark.fleet, pytest.mark.serve]
+
+SPEC1 = SolveSpec(degree=1, ndofs=2500, nreps=12)
+SPEC2 = SolveSpec(degree=2, ndofs=2500, nreps=12)
+
+
+@pytest.fixture(scope="module")
+def published(tmp_path_factory):
+    """One compiled solver per degree, published to a shared artifact
+    store — every fleet in this module warms from it (seconds of
+    compile paid once per module, ~0.2 s per warm load after)."""
+    store = ArtifactStore(str(tmp_path_factory.mktemp("artifacts")))
+    solvers = {}
+    for spec in (SPEC1, SPEC2):
+        s = build_solver(spec, bucket=4)
+        store.put(spec_cache_key(spec, 4), s.export_artifact())
+        solvers[spec.degree] = s
+    return store, solvers
+
+
+def _fleet(tmp_path, store, name="FLEET.jsonl", **kw):
+    defaults = dict(queue_max=64, nrhs_max=4, window_s=0.01,
+                    solve_timeout_s=60.0, balance_interval_s=0)
+    defaults.update(kw)
+    return (FleetDispatcher(2, journal_path=str(tmp_path / name),
+                            artifacts=store, **defaults),
+            str(tmp_path / name))
+
+
+# ---------------------------------------------------------------------------
+# routing
+# ---------------------------------------------------------------------------
+
+
+def test_affinity_routes_to_holding_lane(tmp_path, published):
+    """Each spec's requests land on the lane whose cache holds its
+    executable; affinity hit-rate is routing-decision-weighted and the
+    journal replays the same story."""
+    store, _ = published
+    fleet, journal = _fleet(tmp_path, store)
+    # seed affinity homes: degree 1 -> dev0, degree 2 -> dev1 (warm
+    # loads from the module store, zero compiles)
+    fleet.warmup([SPEC1, SPEC2])
+    assert sum(ln.cache.stats()["compiles"] for ln in fleet.lanes) == 0
+    assert sum(ln.cache.stats()["warm_loads"] for ln in fleet.lanes) == 2
+    pend = [fleet.submit((SPEC1, SPEC2)[i % 2], scale=float(1 + i % 3))
+            for i in range(12)]
+    outs = [fleet.wait(p, 60) for p in pend]
+    snap = fleet.metrics_snapshot()
+    fleet.shutdown()
+    assert all(o["ok"] for o in outs), outs
+    assert snap["fleet"]["affinity_hit_rate"] == 1.0
+    by_dev = {ln["device"]: ln["requests_total"] for ln in snap["lanes"]}
+    assert by_dev == {"dev0": 6, "dev1": 6}
+    v = verify_exactly_once(journal)
+    assert v["ok"], v
+    rep = replay_serve(journal)
+    assert rep["fleet_routed"] == 12
+    assert rep["fleet_affinity_hit_rate"] == 1.0
+    assert set(rep["requests_by_device"]) == {"dev0", "dev1"}
+
+
+def test_cold_spec_routes_to_coldest_lane_and_becomes_home(
+        tmp_path, published):
+    """A spec nobody holds routes to the shortest queue (affinity
+    miss); after that lane provisions it (artifact warm or compile),
+    subsequent requests are affinity hits to the SAME lane."""
+    store, _ = published
+    fleet, _ = _fleet(tmp_path, store)
+    out1 = fleet.wait(fleet.submit(SPEC2, 1.0), 60)
+    out2 = fleet.wait(fleet.submit(SPEC2, 2.0), 60)
+    snap = fleet.metrics_snapshot()
+    fleet.shutdown()
+    assert out1["ok"] and out2["ok"]
+    f = snap["fleet"]
+    assert f["affinity_misses"] == 1 and f["affinity_hits"] == 1
+    # one lane took both (the second followed the first's warm cache)
+    assert sorted(ln["requests_total"] for ln in snap["lanes"]) == [0, 2]
+    np.testing.assert_allclose(out2["xnorm"], 2.0 * out1["xnorm"],
+                               rtol=1e-7)
+
+
+def test_fleet_full_sheds_fleet_level(tmp_path, published):
+    """Every lane at capacity -> fleet-level QueueFull with a journaled
+    serve_shed (device 'fleet') BEFORE any WAL record exists — the
+    ledger can never see an admit racing a shed."""
+    store, _ = published
+    fleet, journal = _fleet(tmp_path, store, queue_max=1)
+    engine_mod.FAULT_HOOK = FaultySolveHook(["hang", "hang"], hang_s=2.0)
+    try:
+        fleet.warmup([SPEC1, SPEC2])
+        first = [fleet.submit(SPEC1), fleet.submit(SPEC2)]
+        time.sleep(0.3)  # both lane workers inside hung solves
+        fleet.submit(SPEC1)  # fills dev0's queue (depth 1)
+        fleet.submit(SPEC2)  # fills dev1's queue
+        with pytest.raises(QueueFull):
+            fleet.submit(SPEC1)
+        outs = [fleet.wait(p, 60) for p in first]
+        assert all(o["ok"] for o in outs)
+    finally:
+        engine_mod.FAULT_HOOK = None
+        fleet.shutdown()
+    with open(journal, encoding="utf-8") as fh:
+        sheds = [json.loads(ln) for ln in fh if '"serve_shed"' in ln]
+    assert len(sheds) == 1 and sheds[0]["device"] == "fleet"
+    assert verify_exactly_once(journal)["ok"]
+
+
+# ---------------------------------------------------------------------------
+# stealing
+# ---------------------------------------------------------------------------
+
+
+def test_steal_moves_half_the_gap_deterministically(tmp_path, published):
+    """The perfgate schedule, in-process: lane0's worker is held in a
+    scripted hang while 6 same-spec requests queue behind it; ONE
+    manual rebalance pass moves exactly (6-0)//2 = 3 requests to lane1,
+    which warm-loads the executable from the store — every request
+    still answers exactly once, steal counts journaled."""
+    store, _ = published
+    fleet, journal = _fleet(tmp_path, store)
+    fleet.warmup([SPEC1])
+    engine_mod.FAULT_HOOK = FaultySolveHook(["hang"], hang_s=1.5)
+    try:
+        pend = [fleet.submit(SPEC1, scale=1.0)]
+        time.sleep(0.4)  # lane0's worker entered the hung solve
+        pend += [fleet.submit(SPEC1, scale=float(2 ** (i % 3)))
+                 for i in range(6)]
+        assert fleet.lanes[0].broker.pending_count() == 6
+        moved = fleet.rebalance_once()
+        assert moved == 3
+        outs = [fleet.wait(p, 60) for p in pend]
+    finally:
+        engine_mod.FAULT_HOOK = None
+    snap = fleet.metrics_snapshot()
+    fleet.shutdown()
+    assert all(o["ok"] for o in outs), outs
+    assert snap["fleet"]["steals"] == 3
+    assert snap["fleet"]["steal_events"] == 1
+    # the thin lane warmed from the store, never compiled
+    assert fleet.lanes[1].cache.stats()["compiles"] == 0
+    assert fleet.lanes[1].cache.stats()["warm_loads"] == 1
+    rep = replay_serve(journal)
+    assert rep["fleet_steals"] == 3 and rep["fleet_steal_events"] == 1
+    assert verify_exactly_once(journal)["ok"]
+
+
+def test_steal_requests_arrival_order(tmp_path, published):
+    """Tail-stealing hands back the stolen set in ARRIVAL order, so the
+    destination serves the oldest stolen request first — FIFO fairness
+    survives the move end to end, not just at the source."""
+    store, _ = published
+    fleet, _ = _fleet(tmp_path, store)
+    fleet.warmup([SPEC1])
+    engine_mod.FAULT_HOOK = FaultySolveHook(["hang"], hang_s=1.2)
+    try:
+        pend = [fleet.submit(SPEC1, scale=1.0)]
+        time.sleep(0.4)  # lane0's worker entered the hung solve
+        pend += [fleet.submit(SPEC1) for _ in range(4)]  # r2..r5 queue
+        stolen = fleet.lanes[0].broker.steal_requests(2)
+        # the NEWEST two, in arrival order (r4 before r5)
+        assert [p.id for p in stolen] == ["r4", "r5"]
+        fleet.lanes[1].broker.adopt_pending(stolen)
+        outs = [fleet.wait(p, 60) for p in pend]
+    finally:
+        engine_mod.FAULT_HOOK = None
+    fleet.shutdown()
+    assert all(o["ok"] for o in outs), outs
+
+
+def test_shed_id_advances_standby_id_space(tmp_path):
+    """A fleet-level shed journals a fleet-minted id with NO
+    serve_request record; the id-space handoff must still resume past
+    it, or a standby re-mints the id and a later crash reads that
+    admitted request as shed — a silent, ledger-clean loss."""
+    from bench_tpu_fem.serve import FleetMetrics, Metrics
+    from bench_tpu_fem.serve.recovery import fold_outstanding
+
+    journal = str(tmp_path / "SHED.jsonl")
+    m = Metrics(journal, device="dev0")
+    m.request("r1", {"degree": 1}, 1, scale=1.0)
+    fm = FleetMetrics(journal)
+    fm.shed("r7", 4)  # fleet-minted, never admitted anywhere
+    assert fm.sheds == 1
+    plan = fold_outstanding(journal)
+    assert plan.max_numeric_id == 7  # past the SHED id, not just r1
+
+
+def test_steal_below_threshold_is_a_noop(tmp_path, published):
+    store, _ = published
+    fleet, _ = _fleet(tmp_path, store, steal_threshold=8)
+    fleet.warmup([SPEC1])
+    engine_mod.FAULT_HOOK = FaultySolveHook(["hang"], hang_s=1.0)
+    try:
+        pend = [fleet.submit(SPEC1)]
+        time.sleep(0.3)
+        pend += [fleet.submit(SPEC1) for _ in range(4)]
+        assert fleet.rebalance_once() == 0  # gap 4 < threshold 8
+        outs = [fleet.wait(p, 60) for p in pend]
+    finally:
+        engine_mod.FAULT_HOOK = None
+    fleet.shutdown()
+    assert all(o["ok"] for o in outs)
+    assert fleet.fleet_metrics.steals == 0
+
+
+# ---------------------------------------------------------------------------
+# SLO-burn spill (the PR 10 burn rate as a control signal)
+# ---------------------------------------------------------------------------
+
+
+def test_spill_on_fast_burn_over_one(tmp_path, published):
+    """A lane whose fast-window burn rate exceeds 1 stops receiving
+    arrivals: the router spills to the colder lane (journaled
+    fleet_spill) even though the hot lane holds the executable."""
+    store, _ = published
+    fleet, journal = _fleet(tmp_path, store, slo_objective_s=0.5)
+    fleet.warmup([SPEC1])  # affinity home: dev0
+    hot = fleet.lanes[0].metrics
+    # poison dev0's fast window: objective-violating samples (the same
+    # samples real slow responses would leave; deterministic — no
+    # timing race, the window is 5 min wide)
+    for i in range(20):
+        hot.response(f"slow{i}", True, 5.0)
+    assert hot.fast_burn_rate() > 1.0
+    out = fleet.wait(fleet.submit(SPEC1, 2.0), 60)
+    snap = fleet.metrics_snapshot()
+    fleet.shutdown()
+    assert out["ok"]
+    assert snap["fleet"]["spills"] == 1
+    # the spill landed on dev1 (which warm-loaded from the store)
+    assert fleet.lanes[1].metrics.completed >= 1
+    with open(journal, encoding="utf-8") as fh:
+        spills = [json.loads(ln) for ln in fh if '"fleet_spill"' in ln]
+    assert len(spills) == 1
+    assert spills[0]["src"] == "dev0" and spills[0]["dst"] == "dev1"
+    assert spills[0]["fast_burn"] > 1.0
+
+
+def test_no_spill_when_unarmed(tmp_path, published):
+    """Without an SLO objective the burn rate reads 0.0 and routing is
+    pure affinity — the control signal is opt-in."""
+    store, _ = published
+    fleet, _ = _fleet(tmp_path, store)  # slo_objective_s=None
+    fleet.warmup([SPEC1])
+    assert fleet.lanes[0].metrics.fast_burn_rate() == 0.0
+    out = fleet.wait(fleet.submit(SPEC1), 60)
+    fleet.shutdown()
+    assert out["ok"]
+    assert fleet.fleet_metrics.spills == 0
+
+
+# ---------------------------------------------------------------------------
+# standby adoption (in-process; the SIGKILL subprocess case is in
+# tests/test_serve.py)
+# ---------------------------------------------------------------------------
+
+
+def test_standby_adoption_id_handoff_and_exactly_once(
+        tmp_path, published):
+    """A standby fleet adopting a dead primary's journal answers every
+    outstanding request under its ORIGINAL id, routes by affinity
+    (warm-loading from the store, zero compiles), resumes the id space
+    past every journaled id, and the whole-journal exactly-once verdict
+    holds across both generations."""
+    from bench_tpu_fem.harness.chaos import tear_journal_tail
+    from bench_tpu_fem.serve import Metrics
+
+    store, _ = published
+    journal = str(tmp_path / "FLEET_incident.jsonl")
+    sd = {"degree": SPEC1.degree, "ndofs": SPEC1.ndofs,
+          "nreps": SPEC1.nreps, "precision": SPEC1.precision,
+          "geom_perturb_fact": SPEC1.geom_perturb_fact}
+    m1 = Metrics(journal, device="dev0")
+    m1.request("r1", sd, 1, scale=1.0)
+    m1.request("r2", sd, 2, scale=2.0)
+    m1.request("r5", sd, 3, scale=4.0)
+    m1.response("r1", True, 0.1)          # answered pre-crash
+    tear_journal_tail(journal, rid="r5")  # crash tore r5's response
+
+    standby = FleetDispatcher(2, journal_path=journal, artifacts=store,
+                              queue_max=64, nrhs_max=4, window_s=0.01,
+                              balance_interval_s=0)
+    rec = standby.adopt_journal(journal)
+    assert rec["routed"] == 2 and rec["skipped"] == 0
+    outs = [standby.wait(p, 60) for p in rec["pending"]]
+    fresh = standby.submit(SPEC1)
+    out_f = standby.wait(fresh, 60)
+    standby.shutdown()
+    assert all(o["ok"] for o in outs), outs
+    assert {o["id"] for o in outs} == {"r2", "r5"}
+    np.testing.assert_allclose(outs[1]["xnorm"], 2.0 * outs[0]["xnorm"],
+                               rtol=1e-7)
+    assert out_f["ok"] and fresh.id == "r6"  # past max journaled id
+    assert sum(ln.cache.stats()["compiles"]
+               for ln in standby.lanes) == 0  # warmed, never compiled
+    v = verify_exactly_once(journal)
+    assert v["ok"], v
+    assert standby.fleet_metrics.adoptions == 1
+    assert standby.fleet_metrics.adopted_requests == 2
+
+
+def test_adoption_answers_unrebuildable_spec_terminally(tmp_path,
+                                                        published):
+    from bench_tpu_fem.serve import Metrics
+
+    store, _ = published
+    journal = str(tmp_path / "FLEET_damaged.jsonl")
+    m1 = Metrics(journal)
+    m1.request("r1", {"degree": 99}, 1, scale=1.0)  # validate() fails
+    standby = FleetDispatcher(2, journal_path=journal, artifacts=store,
+                              queue_max=64, nrhs_max=4,
+                              balance_interval_s=0)
+    rec = standby.adopt_journal(journal)
+    standby.shutdown()
+    assert rec["routed"] == 0 and rec["skipped"] == 1
+    v = verify_exactly_once(journal)
+    assert v["ok"], v  # the terminal response closed the ledger
+
+
+# ---------------------------------------------------------------------------
+# artifact warm cache counters
+# ---------------------------------------------------------------------------
+
+
+def test_warm_cache_counters_and_incompatible_fallback(tmp_path,
+                                                       published):
+    """ArtifactWarmCache: LRU hit -> hits; store hit -> warm_loads
+    (never compiles); incompatible artifact -> counted build through
+    the real builder (degradation, not a crash)."""
+    store, solvers = published
+    key = spec_cache_key(SPEC1, 4)
+    cache = ArtifactWarmCache(store, publish=False)
+    built = []
+
+    def builder():
+        built.append(1)
+        return solvers[1]
+
+    e1 = cache.get_or_build(key, builder)
+    assert e1.executable.warm_source == "artifact"
+    assert built == [] and cache.stats()["warm_loads"] == 1
+    assert cache.stats()["compiles"] == 0
+    # LRU hit on repeat
+    cache.get_or_build(key, builder)
+    assert cache.stats()["hits"] == 1
+    # a key the store lacks builds (counted)
+    key2 = spec_cache_key(SPEC2, 2)
+    cache.get_or_build(key2, lambda: solvers[2])
+    assert cache.stats()["compiles"] == 1
+    # provisioned(): in-memory OR store-backed, without counter noise
+    assert cache.provisioned(key) and cache.provisioned(
+        spec_cache_key(SPEC2, 4))
+    st = cache.stats()
+    # an incompatible artifact (wrong jax pin) degrades to a build
+    bad_store = ArtifactStore(str(tmp_path / "bad"))
+    art = solvers[1].export_artifact()
+    art["meta"]["jax"] = "0.0.0-not-this-runtime"
+    key3 = spec_cache_key(SPEC1, 2)
+    bad_store.put(key3, art)
+    cache2 = ArtifactWarmCache(bad_store, publish=False)
+    cache2.get_or_build(key3, lambda: solvers[1])
+    assert cache2.stats()["warm_loads"] == 0
+    assert cache2.stats()["compiles"] == 1
+    assert st["warm_loads"] == 1  # first cache untouched

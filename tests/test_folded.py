@@ -87,6 +87,8 @@ def test_folded_apply_multiblock():
     )
 
 
+@pytest.mark.slow  # round-12 fast-lane rebalance (ISSUE 13): 7-10 s each,
+# moved so the new fleet tests fit with >=100 s headroom
 def test_folded_cg_matches_grid_cg():
     from bench_tpu_fem.la.cg import cg_solve
 

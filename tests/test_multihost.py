@@ -70,6 +70,8 @@ def _launch_pair(port: int, argv=None):
     return procs, outs
 
 
+@pytest.mark.slow  # round-12 fast-lane rebalance (ISSUE 13): 7-10 s each,
+# moved so the new fleet tests fit with >=100 s headroom
 def test_two_process_golden_config_y_norm_matches():
     # one retry on a fresh port: _free_port closes its probe socket
     # before the coordinator rebinds, so a concurrent process can steal

@@ -237,11 +237,13 @@ def test_engine_continuous_admit_retire_roundtrip(solver_f32):
 
 @pytest.mark.slow  # round-10 fast-lane rebalance: 18 s; still runs in
 # the serve CI lane (its marker filter selects on `serve` alone)
-def test_engine_matches_one_shot_df32():
-    """df32 serving parity (<= 1e-13): the vmapped lane equals the
-    scalar cg_solve_df result. df32 continuous batching is
-    planned-but-gated: the solver records the reason and the broker
-    falls back to fixed-window batches for it."""
+def test_engine_matches_one_shot_df32_continuous():
+    """df32 serving parity (<= 1e-13) through the batched df CHECKPOINT
+    recurrence (ISSUE 13 — the PR 6 continuous gate CLOSED): the
+    whole-solve vmapped cg_solve_df stays the parity oracle, and the
+    checkpoint API (admit into a freed lane mid-state, retire with the
+    df-folded norm) holds the same df-class parity — df32 requests now
+    ride continuous batching like f32/f64."""
     import jax
 
     from bench_tpu_fem.la.df64 import df_dot, df_to_f64
@@ -249,10 +251,10 @@ def test_engine_matches_one_shot_df32():
 
     spec = SolveSpec(degree=2, ndofs=2000, nreps=12, precision="df32")
     s = build_solver(spec, bucket=2)
-    assert not s.supports_continuous
-    assert "checkpoint" in s.continuous_gate_reason
+    assert s.supports_continuous  # the gate reason is GONE: landed
+    assert s.continuous_gate_reason is None
     r = s.solve([1.0, 2.0])
-    assert r.extra["continuous_gate_reason"] == s.continuous_gate_reason
+    assert "continuous_gate_reason" not in r.extra
     assert r.extra["cg_engine_form"] == "unfused"
     x_ref = jax.jit(lambda A, b: cg_solve_df(A, b, spec.nreps))(
         s._op, s._base)
@@ -260,6 +262,48 @@ def test_engine_matches_one_shot_df32():
         float(df_to_f64(jax.jit(df_dot)(x_ref, x_ref))), 0.0)))
     np.testing.assert_allclose(r.xnorms[0], ref_norm, rtol=1e-13)
     np.testing.assert_allclose(r.xnorms[1], 2.0 * ref_norm, rtol=1e-13)
+    # df-exact linearity for a NON-power-of-two scale (the df scaling
+    # contract: the f64 scale rides as its own hi/lo pair)
+    r3 = s.solve([1.0, 3.7])
+    np.testing.assert_allclose(r3.xnorms[1], 3.7 * r3.xnorms[0],
+                               rtol=1e-12)
+    # checkpoint API roundtrip: retire a finished lane, admit a new
+    # scale into it mid-state, run to ITS budget — per-lane df parity
+    st = s.cont_init([1.0, 2.0])
+    nch = -(-spec.nreps // s.iter_chunk)
+    for _ in range(nch):
+        st = s.cont_step(st)
+    iters, done = s.cont_poll(st)
+    assert bool(done[0]) and int(iters[0]) == spec.nreps
+    st, xn0 = s.cont_retire(st, 0)
+    np.testing.assert_allclose(xn0, ref_norm, rtol=1e-13)
+    st = s.cont_admit(st, 0, 4.0)
+    for _ in range(nch):
+        st = s.cont_step(st)
+    st, xn4 = s.cont_retire(st, 0)
+    np.testing.assert_allclose(xn4, 4.0 * ref_norm, rtol=1e-13)
+    # the in-flight lane 1 was never perturbed
+    st, xn1 = s.cont_retire(st, 1)
+    np.testing.assert_allclose(xn1, 2.0 * ref_norm, rtol=1e-13)
+
+
+@pytest.mark.slow  # df32 compile ~8 s; runs in the serve CI lane
+def test_broker_serves_df32_continuously(tmp_path):
+    """End-to-end: a df32 batch through the broker runs CONTINUOUS
+    (responses stamp continuous=true, mid-solve admissions possible) —
+    the fleet-facing acceptance of the closed PR 6 gate."""
+    spec = SolveSpec(degree=1, ndofs=2000, nreps=12, precision="df32")
+    metrics = Metrics(str(tmp_path / "df.jsonl"))
+    broker = _mini_broker(metrics)
+    try:
+        pend = [broker.submit(spec, scale=s) for s in (1.0, 2.0)]
+        outs = [broker.wait(p, 60) for p in pend]
+    finally:
+        broker.shutdown()
+    assert all(o["ok"] for o in outs), outs
+    assert all(o["continuous"] for o in outs)
+    np.testing.assert_allclose(outs[1]["xnorm"], 2.0 * outs[0]["xnorm"],
+                               rtol=1e-12)
 
 
 # ---------------------------------------------------------------------------
@@ -1050,6 +1094,212 @@ def test_respond_exactly_once_under_race(solver_f32_d2):
         assert metrics.completed + metrics.failed == 1
     finally:
         broker.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# fleet satellites (ISSUE 13): primary-SIGKILL -> standby adoption across
+# generations, and the artifact store's corruption discipline
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow  # real SIGKILL through a subprocess: ~25 s (compile +
+# kill + standby adoption); runs in the serve and slow CI lanes
+def test_primary_sigkill_standby_adoption_exactly_once(tmp_path):
+    """The ISSUE-13 chaos acceptance, as a test: a PRIMARY broker
+    process is SIGKILL'd mid-incident, the parent tears the journal
+    tail (the crash-mid-write bytes), and a STANDBY fleet adopts the
+    journal — answering every admitted-but-unresponded request exactly
+    once under its ORIGINAL id, warming its executable from the shared
+    artifact store with zero compiles — and `verify_exactly_once` holds
+    over BOTH generations including the torn tail."""
+    import os
+    import signal
+    import subprocess
+    import sys
+
+    from bench_tpu_fem.serve import ArtifactStore, FleetDispatcher
+    from bench_tpu_fem.serve.recovery import fold_outstanding
+
+    journal = str(tmp_path / "GEN_incident.jsonl")
+    artdir = str(tmp_path / "artifacts")
+    child_src = """
+import os, sys, threading
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+from bench_tpu_fem.utils.hermetic import force_host_cpu_devices
+force_host_cpu_devices(2)
+from bench_tpu_fem.serve import ArtifactStore, FleetDispatcher, SolveSpec
+store = ArtifactStore(sys.argv[2])
+fleet = FleetDispatcher(2, journal_path=sys.argv[1], artifacts=store,
+                        queue_max=64, nrhs_max=4, window_s=0.02,
+                        balance_interval_s=0.02)
+# degree-2 at this size stays inside the healthy numerical
+spec = SolveSpec(degree=2, ndofs=2500, nreps=400)
+fleet.warmup([spec])
+pend = [fleet.submit(spec, scale=2.0 ** (i % 3)) for i in range(6)]
+print("INFLIGHT", len(pend), flush=True)
+for p in pend:
+    fleet.wait(p, 120)
+"""
+    env = {**os.environ, "JAX_PLATFORMS": "cpu"}
+    child = subprocess.Popen(
+        [sys.executable, "-u", "-c", child_src, journal, artdir],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env, start_new_session=True)
+    killed = False
+    try:
+        for line in child.stdout:
+            if line.startswith("INFLIGHT"):
+                time.sleep(0.2)  # let batches reach mid-solve
+                os.killpg(child.pid, signal.SIGKILL)
+                killed = True
+                break
+    finally:
+        if not killed:
+            os.killpg(child.pid, signal.SIGKILL)
+    child.wait(30)
+    assert killed, "primary never reported INFLIGHT"
+
+    outstanding = fold_outstanding(journal).outstanding
+    assert outstanding, "SIGKILL landed after the incident ended"
+    from bench_tpu_fem.harness.chaos import tear_journal_tail
+
+    tear_journal_tail(journal, rid=outstanding[0]["id"])
+    # the torn response must NOT count as answered
+    still = fold_outstanding(journal).outstanding
+    assert outstanding[0]["id"] in [r["id"] for r in still]
+
+    # generation 2: the standby fleet adopts on the SAME journal
+    store = ArtifactStore(artdir)
+    standby = FleetDispatcher(2, journal_path=journal, artifacts=store,
+                              queue_max=64, nrhs_max=4, window_s=0.02,
+                              balance_interval_s=0)
+    rec = standby.adopt_journal(journal)
+    assert rec["routed"] == len(still) and rec["skipped"] == 0
+    outs = [standby.wait(p, 120) for p in rec["pending"]]
+    fresh = standby.wait(standby.submit(
+        SolveSpec(degree=2, ndofs=2500, nreps=400)), 120)
+    standby.shutdown()
+    assert all(o["ok"] for o in outs), outs
+    assert fresh["ok"]
+    # the standby warmed from the primary's published artifact: the
+    # warm-replica recompiles == 0 acceptance
+    assert sum(ln.cache.stats()["compiles"]
+               for ln in standby.lanes) == 0
+    assert sum(ln.cache.stats()["warm_loads"]
+               for ln in standby.lanes) >= 1
+    verdict = verify_exactly_once(journal)
+    assert verdict["ok"], verdict
+
+
+def _fake_artifact(tag=b"exe-bytes"):
+    return {"meta": {"format": "pjrt-pickle-v1", "spec": {"degree": 3},
+                     "bucket": 4, "engine_form": "unfused",
+                     "jax": "x", "backend": "cpu"},
+            "fns": {"_init_fn": tag, "_step_fn": tag + b"2",
+                    "_admit_fn": tag + b"3", "_retire_fn": tag + b"4"}}
+
+
+def test_artifact_store_roundtrip_and_keys(tmp_path):
+    from bench_tpu_fem.serve import ArtifactStore
+
+    store = ArtifactStore(str(tmp_path / "art"))
+    key = _key(1)
+    assert store.get(key) is None and not store.contains(key)
+    store.put(key, _fake_artifact())
+    assert store.contains(key)
+    art = store.get(key)
+    assert art["fns"]["_step_fn"] == b"exe-bytes2"
+    assert art["meta"]["key"]["degree"] == key.degree
+    assert store.keys() == [key]
+    st = store.stats()
+    assert st["puts"] == 1 and st["hits"] == 1 and st["misses"] == 1
+    assert st["corrupt"] == 0 and st["collisions"] == 0
+
+
+def test_artifact_store_torn_and_corrupt_read_as_miss(tmp_path):
+    """The checkpoint-store discipline: a torn write (truncated file),
+    flipped payload bytes, and a stranded .tmp all read as counted
+    MISSES — a damaged artifact costs one recompile, never a crash or
+    a wrong executable."""
+    from bench_tpu_fem.serve import ArtifactStore
+
+    store = ArtifactStore(str(tmp_path / "art"))
+    key = _key(2)
+    path = store.put(key, _fake_artifact())
+    blob = open(path, "rb").read()
+    # torn tail: the bytes a crash strands mid-write
+    with open(path, "wb") as fh:
+        fh.write(blob[: len(blob) // 2])
+    assert store.get(key) is None
+    assert store.stats()["corrupt"] == 1
+    # flipped byte inside the payload: CRC refuses it
+    bad = bytearray(blob)
+    bad[-3] ^= 0xFF
+    with open(path, "wb") as fh:
+        fh.write(bytes(bad))
+    assert store.get(key) is None
+    assert store.stats()["corrupt"] == 2
+    # a stranded .tmp next to a healthy artifact is invisible
+    with open(path, "wb") as fh:
+        fh.write(blob)
+    open(path + ".tmp", "wb").write(b"garbage")
+    assert store.get(key) is not None
+    assert [k for k in store.keys()] == [key]
+    # content-hash mismatch (blob swapped for another key's bytes at
+    # the same length) also refuses
+    art = _fake_artifact(tag=b"OTHERBYTE")
+    store2 = ArtifactStore(str(tmp_path / "art2"))
+    p2 = store2.put(key, art)
+    raw = open(p2, "rb").read()
+    swapped = raw.replace(b"OTHERBYTE2", b"TAMPERED!2")
+    assert swapped != raw
+    with open(p2, "wb") as fh:
+        fh.write(swapped)
+    assert store2.get(key) is None  # CRC or content hash refuses
+
+
+def test_artifact_store_key_collision_refused(tmp_path):
+    """A file sitting at key B's content address but holding key A's
+    artifact (a rename, a copy, or a hash collision) is REFUSED on
+    read: the embedded key is the identity, the filename is just an
+    address."""
+    import os
+    import shutil
+
+    from bench_tpu_fem.serve import ArtifactStore
+    from bench_tpu_fem.serve.artifacts import key_hash
+
+    store = ArtifactStore(str(tmp_path / "art"))
+    key_a, key_b = _key(1), _key(2)
+    path_a = store.put(key_a, _fake_artifact())
+    path_b = os.path.join(store.root, f"{key_hash(key_b)}.art")
+    shutil.copyfile(path_a, path_b)
+    assert store.contains(key_b)  # the cheap probe is fooled...
+    assert store.get(key_b) is None  # ...the validated read is not
+    assert store.stats()["collisions"] == 1
+    assert store.get(key_a) is not None  # the real key still serves
+
+
+def test_engine_artifact_roundtrip_f32(solver_f32_d2):
+    """export_artifact -> build_solver(artifact=): the loaded solver
+    reproduces the compiled one's responses bitwise (same executables,
+    deserialized) with warm_source recorded, and a version-pinned
+    mismatch raises ArtifactIncompatible (the loader's miss signal)."""
+    from bench_tpu_fem.serve import ArtifactIncompatible
+
+    art = solver_f32_d2.export_artifact()
+    assert set(art["fns"]) == {"_init_fn", "_step_fn", "_admit_fn",
+                               "_retire_fn"}
+    warm = build_solver(solver_f32_d2.spec, solver_f32_d2.bucket,
+                        artifact=art)
+    assert warm.warm_source == "artifact"
+    a = solver_f32_d2.solve([1.0, 2.5])
+    b = warm.solve([1.0, 2.5])
+    assert a.xnorms == b.xnorms  # bitwise: identical executables
+    bad = {"meta": {**art["meta"], "jax": "0.0.0"}, "fns": art["fns"]}
+    with pytest.raises(ArtifactIncompatible):
+        build_solver(solver_f32_d2.spec, solver_f32_d2.bucket,
+                     artifact=bad)
 
 
 def test_breakdown_sentinel_nan_scale_lane_local(solver_f32_d2):
