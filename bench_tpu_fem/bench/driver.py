@@ -158,6 +158,22 @@ class BenchConfig:
     # `s_step_fallback_reason`. Env default: BENCH_S_STEP.
     s_step: int = field(default_factory=lambda: int(
         os.environ.get("BENCH_S_STEP", "1") or 1))
+    # SDC boundary audit + corruption-aware rollback (ISSUE 14): rides
+    # the iteration-boundary checkpointed loop (checkpoint_every > 0 —
+    # the audit cadence IS the durable-snapshot cadence, so a detected
+    # corruption always has an audited-clean snapshot to roll back to).
+    # Every boundary recomputes the true residual
+    # (la.checkpoint.true_residual_sq) and compares it to the carried
+    # rnorm; exceedance journals/stamps an `sdc` event, rolls back to
+    # the last durable snapshot and re-runs; a SECOND detection on the
+    # re-run adjudicates deterministic (RuntimeError classified `sdc`,
+    # never retried) vs transient (one rollback, stamped, run
+    # completes). The deterministic seeded injector is the CHAOS_SDC
+    # env seam (harness.faults.sdc_env_plan); audit off (the default)
+    # and CHAOS_SDC unset are the pre-PR checkpointed loop bit-for-bit.
+    # Env default: BENCH_SDC_AUDIT=1.
+    sdc_audit: bool = field(default_factory=lambda: bool(int(
+        os.environ.get("BENCH_SDC_AUDIT", "0") or 0)))
 
 
 @dataclass
@@ -448,6 +464,31 @@ def stamp_checkpoint(extra: dict, cfg: BenchConfig, store,
     }
 
 
+def stamp_sdc(extra: dict, stats: dict | None) -> None:
+    """SDC audit evidence stamp (ISSUE 14): every audited checkpointed
+    run records its boundary-check count, worst clean drift vs the
+    envelope, injected/detected/rolled-back counts and the adjudication
+    verdict — the stamp-label-gate contract (ROADMAP item 7; on-chip
+    detection economics are hardware-armed)."""
+    if stats is None:
+        return
+    import jax
+
+    # a deterministic verdict never reaches the stamp (the loop raises
+    # on the second same-attempt detection): any recorded detection
+    # here was adjudicated transient by its completed rollback re-run
+    verdict = ("transient" if stats.get("detections", 0) >= 1
+               else "clean")
+    extra["sdc"] = {
+        **{k: stats[k] for k in ("audited", "envelope", "checks",
+                                 "drift_max", "injected", "detections",
+                                 "rollbacks", "restored_iteration")},
+        "adjudication": verdict,
+        "evidence": ("hardware" if jax.default_backend() == "tpu"
+                     else "cpu-measured"),
+    }
+
+
 def stamp_breakdown(extra: dict, ynorm) -> None:
     """Breakdown sentinel stamp (ISSUE 9), shared by every driver: a
     NaN/Inf solution must carry a recorded failure class, never pose as
@@ -504,18 +545,94 @@ def open_checkpoint(cfg: BenchConfig, res: BenchmarkResults, state_s,
 
 def checkpointed_loop(state, run_chunk, *, store, restored_it: int,
                       nreps: int, k: int, kind: str, saves: dict,
-                      save: bool):
+                      save: bool, audit=None, envelope: float = 0.0,
+                      inject=None, reinit=None, template=None,
+                      sdc: dict | None = None):
     """Advance a restored (or fresh) iteration-boundary CG state to
     ``nreps``, snapshotting at every boundary when a store is given —
     the one loop all three checkpointed paths run. ``state_to_host``
     fetches the carry (the boundary host sync the enabled path pays and
-    the disabled path provably does not)."""
+    the disabled path provably does not).
+
+    With ``audit`` (ISSUE 14: SDC defense) every boundary is
+    true-residual-audited BEFORE its snapshot is trusted enough to
+    save: ``audit(state) -> drift`` recomputes ``‖b − A x‖`` and
+    compares it to the carried rnorm; drift past ``envelope`` is
+    corruption — the loop rolls back to the last durable snapshot
+    (every saved snapshot passed its own audit, so the rollback target
+    is audited-clean; no store/snapshot -> ``reinit()`` restarts at
+    iteration 0) and re-runs. A SECOND detection adjudicates
+    deterministic: RuntimeError carrying the `sdc` classifier
+    signature, never retried at this level. ``inject`` is the
+    CHAOS_SDC seam (harness.faults.sdc_env_plan): one seeded host-side
+    bit flip of the solution iterate when the loop crosses the
+    scripted iteration (``once`` controls whether a rollback re-run
+    sees it again — the transient-vs-deterministic models). ``sdc``
+    accumulates the evidence counters the caller stamps. audit=None
+    and inject=None are the pre-PR loop exactly."""
     from ..la.checkpoint import state_to_host
 
     it = restored_it
+    inj_fired = False
+    # adjudication is PER SOLVE ATTEMPT (this call): "detected again on
+    # the re-run" means twice within one rollback chain — a later,
+    # independent run (a second timing rep) that hits its own transient
+    # upset adjudicates fresh. The caller's `sdc` dict still
+    # accumulates totals across calls for the evidence stamp.
+    detections_here = 0
     while it < nreps:
         state = run_chunk(state)
-        it = min(it + k, nreps)
+        prev_it, it = it, min(it + k, nreps)
+        if inject is not None and prev_it < inject["iteration"] <= it \
+                and not (inject.get("once", True) and inj_fired):
+            import jax.numpy as jnp
+
+            from ..harness.faults import flip_host_bit
+
+            host_x = flip_host_bit(np.asarray(state.x),
+                                   inject.get("index", -1),
+                                   inject.get("bit"))
+            state = state._replace(x=jnp.asarray(host_x))
+            inj_fired = True
+            if sdc is not None:
+                sdc["injected"] = sdc.get("injected", 0) + 1
+        if audit is not None:
+            drift = audit(state)
+            if sdc is not None:
+                sdc["checks"] = sdc.get("checks", 0) + 1
+                sdc["drift_max"] = max(sdc.get("drift_max", 0.0), drift)
+            if drift > envelope:
+                detections_here += 1
+                if sdc is not None:
+                    sdc["detections"] = sdc.get("detections", 0) + 1
+                if detections_here >= 2:
+                    raise RuntimeError(
+                        "silent data corruption detected again after "
+                        f"checkpoint rollback (true-residual audit "
+                        f"drift {drift:.3e} > envelope {envelope:.1e} "
+                        f"at iteration {it}): deterministic fault, "
+                        "failure_class sdc")
+                snap = store.latest() if store is not None else None
+                # only a snapshot strictly BEFORE the detection point
+                # is a rollback target: a stale completed snapshot from
+                # an earlier run of the same store (a prior timing rep)
+                # would otherwise "roll" the solve FORWARD past nreps
+                if (snap is not None and template is not None
+                        and int(snap[0]) < it):
+                    from ..la.checkpoint import state_from_host
+
+                    s_it, arrays, _meta = snap
+                    state = state_from_host(template, arrays)
+                    it = int(s_it)
+                else:
+                    # nothing durable yet: iteration 0 IS the last
+                    # trustworthy checkpoint
+                    state = reinit()
+                    it = 0
+                if sdc is not None:
+                    sdc["rollbacks"] = sdc.get("rollbacks", 0) + 1
+                    sdc["restored_iteration"] = it
+                continue
         if save and store is not None:
             store.save(it, state_to_host(state),
                        meta={"kind": kind, "nreps": nreps})
@@ -563,16 +680,58 @@ def _make_checkpointed_cg(cfg: BenchConfig, res: BenchmarkResults, obs,
             cfg, res, state_s, "bench_cg", nreps)
     saves = {"n": 0}
 
+    # SDC boundary audit (ISSUE 14): true residual recomputed per
+    # boundary, compared to the carried rnorm against the
+    # per-precision envelope; CHAOS_SDC arms the seeded injector.
+    audit_fn = None
+    inject = None
+    sdc_stats = None
+    envelope = 0.0
+    if cfg.sdc_audit:
+        from ..harness.faults import sdc_env_plan
+        from ..la.checkpoint import true_residual_sq
+        from ..ops.abft import RESIDUAL_ENVELOPE
+
+        envelope = RESIDUAL_ENVELOPE[
+            "f32" if cfg.float_bits == 32 else "f64"]
+        tr_fn = jax.jit(lambda A, s: true_residual_sq(apply_fn(A), u,
+                                                      s.x))
+
+        def audit_fn(s):
+            tr = float(np.asarray(tr_fn(op, s)))
+            rn = float(np.asarray(s.rnorm))
+            rn0 = float(np.asarray(s.rnorm0))
+            if rn0 <= 0.0 or not (np.isfinite(tr) and np.isfinite(rn)):
+                # non-finite is the breakdown sentinel's class, not
+                # sdc's (finite-but-inconsistent by construction)
+                return 0.0
+            return float(abs(np.sqrt(max(tr, 0.0))
+                             - np.sqrt(max(rn, 0.0))) / np.sqrt(rn0))
+
+        inject = sdc_env_plan()
+        sdc_stats = {"audited": True, "envelope": envelope,
+                     "checks": 0, "drift_max": 0.0, "injected": 0,
+                     "detections": 0, "rollbacks": 0,
+                     "restored_iteration": None}
+
     def run(save: bool = True):
         state = start_state if start_state is not None else init_fn(op, u)
+        # audit/injection ride the REAL run only: the save=False
+        # warm-up exists to pay compile/transfer, and a once-shot
+        # injection consumed there would leave the measured run with
+        # nothing to detect
         state = checkpointed_loop(
             state, lambda s: run_fn(op, s), store=store,
             restored_it=restored_it, nreps=nreps, k=k, kind="bench_cg",
-            saves=saves, save=save)
+            saves=saves, save=save,
+            audit=audit_fn if save else None, envelope=envelope,
+            inject=inject if save else None,
+            reinit=lambda: init_fn(op, u), template=state_s,
+            sdc=sdc_stats)
         jax.block_until_ready(state.x)
         return state.x
 
-    return run, store, restored_it, saves
+    return run, store, restored_it, saves, sdc_stats
 
 
 def batch_scales(nrhs: int) -> np.ndarray:
@@ -852,6 +1011,10 @@ def _run_benchmark_folded_df(cfg: BenchConfig) -> BenchmarkResults:
         res.extra["convergence_gate_reason"] = (
             "folded-df pipeline has no capture-able loop form; "
             "convergence capture disabled for this run")
+    if cfg.sdc_audit:
+        res.extra["sdc_gate_reason"] = (
+            "folded-df pipeline has no checkpointable boundary for the "
+            "SDC audit to ride; audit disabled for this run")
     if cfg.precond != "none":
         from ..la.precond import PRECOND_GATE_REASONS
 
@@ -1063,6 +1226,15 @@ def _run_benchmark_df64(cfg: BenchConfig) -> BenchmarkResults:
             # whole-solve executable with no boundary to snapshot at
             engine = False
             res.extra["checkpoint_gate_reason"] = CHECKPOINT_GATE_REASON
+        if cfg.sdc_audit:
+            # the df checkpointed loop carries (hi, lo) pairs the f32
+            # boundary audit is not wired through (the serve layer's
+            # df retire audit covers df32 detection); recorded, never
+            # silent
+            res.extra["sdc_gate_reason"] = (
+                "the SDC boundary audit is not wired through the df "
+                "(double-float) checkpointed loop; df32 detection runs "
+                "in the serve layer's retire-time audit")
         # convergence capture (ISSUE 10): rides the unfused df loop
         # (cg_solve_df capture=True); the fused df ring gates off with
         # the reason recorded — same discipline as the f32 driver
@@ -1725,6 +1897,14 @@ def _run_benchmark(cfg: BenchConfig) -> BenchmarkResults:
         if engine:
             apply_fn = lambda A: partial(engine_apply, A)  # noqa: E731
         ckpt = cfg.use_cg and cfg.checkpoint_every > 0
+        if cfg.sdc_audit and not ckpt:
+            # the boundary audit rides the checkpointed loop (its
+            # cadence IS the rollback cadence) — asking for it without
+            # one records why it did not run, never silently
+            res.extra["sdc_gate_reason"] = (
+                "the SDC boundary audit rides the iteration-boundary "
+                "checkpointed CG loop; set --checkpoint-every > 0 (and "
+                "--cg) to arm it")
         if ckpt and engine:
             # durable checkpointing needs iteration boundaries; the
             # fused whole-solve engines have none (CHECKPOINT_GATE_REASON)
@@ -1831,13 +2011,13 @@ def _run_benchmark(cfg: BenchConfig) -> BenchmarkResults:
         exec_key = _exec_cache_key(
             cfg, n, res.extra.get("cg_engine_form", "unfused"), cg_kind)
         obs = BenchObserver(cfg)
-        run_ck = ck_store = ck_saves = None
+        run_ck = ck_store = ck_saves = ck_sdc = None
         ck_restored = 0
         if ckpt:
             # the iteration-boundary loop (bitwise cg_solve — the body
             # is verbatim) with durable snapshots at each boundary; the
             # warm-up pays compile/transfer without writing snapshots
-            run_ck, ck_store, ck_restored, ck_saves = (
+            run_ck, ck_store, ck_restored, ck_saves, ck_sdc = (
                 _make_checkpointed_cg(cfg, res, obs, op, apply_fn, u,
                                       fallback_opts))
             with obs.phase("transfer"):
@@ -2028,6 +2208,7 @@ def _run_benchmark(cfg: BenchConfig) -> BenchmarkResults:
     if run_ck is not None:
         stamp_checkpoint(res.extra, cfg, ck_store, ck_restored,
                          ck_saves["n"])
+        stamp_sdc(res.extra, ck_sdc)
     stamp_observability(cfg, res, obs,
                         "f32" if cfg.float_bits == 32 else "f64")
     if conv_info is not None:

@@ -87,4 +87,10 @@ def results_json(cfg: BenchConfig, res: BenchmarkResults) -> str:
                 "s_step_gate_reason", "s_step_fallback_reason"):
         if key in res.extra:
             root["output"][key] = res.extra[key]
+    # SDC defense stamp (ISSUE 14): boundary-audit verdicts (checks,
+    # worst clean drift vs envelope, injections, detections, rollback
+    # adjudication) or the recorded reason the audit was gated off
+    for key in ("sdc", "sdc_gate_reason"):
+        if key in res.extra:
+            root["output"][key] = res.extra[key]
     return json.dumps(root)

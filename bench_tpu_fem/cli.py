@@ -118,6 +118,15 @@ def build_parser() -> argparse.ArgumentParser:
                         "(unset: the chunked loop runs but writes "
                         "nothing — the measured-overhead A/B arm). Env "
                         "default: BENCH_CHECKPOINT_DIR.")
+    p.add_argument("--sdc-audit", action="store_true", default=None,
+                   help="SDC defense (ISSUE 14): true-residual-audit "
+                        "every checkpoint boundary (rides "
+                        "--checkpoint-every > 0); an exceedance rolls "
+                        "back to the last durable snapshot and re-runs "
+                        "— a second detection is the deterministic "
+                        "`sdc` verdict. CHAOS_SDC=iter=N[,bit=B,"
+                        "index=I,once=0|1] arms the seeded injector. "
+                        "Env default: BENCH_SDC_AUDIT.")
     p.add_argument("--convergence", action="store_true", default=None,
                    help="Convergence telemetry (ISSUE 10): capture the "
                         "per-iteration CG residual history on device "
@@ -249,6 +258,8 @@ def main(argv: list[str] | None = None) -> int:
            else {"checkpoint_every": args.checkpoint_every}),
         **({} if args.checkpoint_dir is None
            else {"checkpoint_dir": args.checkpoint_dir}),
+        # None = fall back to the BENCH_SDC_AUDIT env default
+        **({} if args.sdc_audit is None else {"sdc_audit": True}),
         # None = fall back to the BENCH_CONVERGENCE env default
         **({} if args.convergence is None
            else {"convergence": True}),

@@ -31,6 +31,17 @@ scripted fault in ``scripts/chaos_soak.py``'s schedules:
                         strands); recovery must drop exactly that line
                         (``read_records``' torn-tail rule) and replay
                         the request it failed to answer.
+  silent corruption     ``harness.faults.SdcInjectionHook`` installed as
+                        ``serve.engine.SDC_HOOK`` (ISSUE 14) bit-flips
+                        one lane's iterates at scripted continuous-
+                        batching boundaries — FINITE and wrong, so the
+                        breakdown sentinel never fires; the broker's
+                        retire-time audit must detect it, roll the lane
+                        back once (the re-run adjudicates), answer
+                        ``failure_class: "sdc"`` on a second detection,
+                        and the fleet's windowed quarantine must
+                        isolate the lane with an exactly-once queue
+                        drain and a self-test readmission.
 
 The soak invariant the schedules are judged against is
 ``serve.recovery.verify_exactly_once`` over the WHOLE journal — all
@@ -107,4 +118,17 @@ def install_boundary_hook(hook):
 
     prev = _engine.BOUNDARY_HOOK
     _engine.BOUNDARY_HOOK = hook
+    return prev
+
+
+def install_sdc_hook(hook):
+    """Install/uninstall helper for the silent-corruption seam
+    (``serve.engine.SDC_HOOK``, ISSUE 14) — same try/finally pairing as
+    `install_boundary_hook`. The hook (harness.faults.SdcInjectionHook)
+    is called after every continuous-batching cont_step and may hand a
+    bit-flipped state back to the solve."""
+    from ..serve import engine as _engine
+
+    prev = _engine.SDC_HOOK
+    _engine.SDC_HOOK = hook
     return prev

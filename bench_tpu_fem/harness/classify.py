@@ -38,6 +38,17 @@ anything else):
                  guard set (NaN freeze at the last finite iterate,
                  steepest-descent restart, stagnation counters) is the
                  opt-in `cg_solve(sentinel=True)` carry.
+  sdc            silent data corruption: an ABFT / true-residual audit
+                 caught a FINITE-but-inconsistent value (ISSUE 14 —
+                 the mercurial-core class the breakdown sentinels
+                 cannot see). Adjudicated by RE-RUN, not by evidence:
+                 a single detection is presumed transient (a cosmic
+                 ray, a marginal core under a voltage droop) and the
+                 solve rolls back to its last durable checkpoint and
+                 re-runs ONCE; detected AGAIN on the re-run =
+                 deterministic fault (a bad core, a wrong executable),
+                 never retried — the serve fleet quarantines the lane
+                 instead (serve.fleet).
   unsupported    a capability/plan gate declined the configuration
                  (folded_df_plan, engine_plan tiers) — not a fault, but a
                  recorded fallback still carries a class.
@@ -61,6 +72,7 @@ TAXONOMY = (
     "timeout",
     "preempted",
     "breakdown",
+    "sdc",
     "unsupported",
     "transient",
 )
@@ -70,6 +82,13 @@ TAXONOMY = (
 # broker's internal retry and the chaos invariants read this set;
 # StagePolicy.retry_on is deliberately narrower (oom and tunnel_wedge
 # have their own ladder/probe handling there, not a plain retry).
+# `sdc` is deliberately NOT here: membership means "tell the client to
+# resubmit", and an sdc-classified failure surfaces only AFTER its
+# rollback re-run adjudicated it deterministic — advertising it
+# retriable would relaunder corruption through client retries. The ONE
+# adjudication re-run is owned by the layers themselves
+# (harness.policy's explicit sdc branch; the serve broker's internal
+# retry special-cases it the same way).
 RETRIABLE_CLASSES = frozenset(
     {"transient", "timeout", "oom", "tunnel_wedge", "preempted"})
 
@@ -89,6 +108,16 @@ _ACCURACY_PAT = re.compile(
 _BREAKDOWN_PAT = re.compile(
     r"CG breakdown|breakdown_restarts|non-?finite residual"
     r"|failure_class.{0,4}breakdown|\bCGBreakdown\b"
+)
+# SDC audit exceedance reports (ISSUE 14): the audited drivers/serve
+# phrase every detection with one of these signatures. Checked BEFORE
+# the breakdown patterns — an SDC report mentions the residual audit,
+# and the classes are disjoint by construction (sdc = finite but
+# inconsistent; breakdown = non-finite).
+_SDC_PAT = re.compile(
+    r"[Ss]ilent data corruption|\bSDC\b|sdc_detected"
+    r"|failure_class.{0,4}sdc|ABFT (?:check|audit)"
+    r"|(?:true-)?residual audit (?:drift|exceed|failed)"
 )
 # Real preemptible-fleet eviction notices: the Cloud TPU maintenance-
 # event phrasing, the libtpu/gRPC worker-restart ABORTED text, the GCE
@@ -124,6 +153,8 @@ def classify_text(text: str, timed_out: bool = False) -> str:
     # child that printed an OOM then hung in teardown is an OOM.
     if _ACCURACY_PAT.search(text):
         return "accuracy_fail"
+    if _SDC_PAT.search(text):
+        return "sdc"
     if _BREAKDOWN_PAT.search(text):
         return "breakdown"
     if _OOM_PAT.search(text):

@@ -46,6 +46,15 @@ PREEMPT_TEXT = (
     "The TPU worker at address 10.0.0.7:8471 restarted unexpectedly "
     "(maintenance event: the instance was preempted)."
 )
+# The SDC audit's own exceedance report (ISSUE 14): what the audited
+# drivers/serve raise when a finite-but-inconsistent solve is detected
+# AGAIN after rollback — the deterministic-fault adjudication. The
+# phrasing is the classifier's `sdc` signature.
+SDC_TEXT = (
+    "RuntimeError: silent data corruption detected again after "
+    "checkpoint rollback (true-residual audit drift 3.2e-01 > envelope "
+    "1.0e-03): deterministic fault, failure_class sdc"
+)
 
 
 def ok(out: str = "STAGE OK", wall_s: float = 1.0) -> SubprocessResult:
@@ -175,7 +184,119 @@ class FaultySolveHook:
             raise RuntimeError(ACCURACY_TEXT)
         if outcome == "preempt":
             raise RuntimeError(PREEMPT_TEXT)
+        if outcome == "sdc":
+            raise RuntimeError(SDC_TEXT)
         if outcome == "hang":
             self.sleep(self.hang_s)
             return
         raise RuntimeError(f"Traceback: injected {outcome} fault")
+
+
+# ---------------------------------------------------------------------------
+# Silent-data-corruption injection (ISSUE 14): the CHAOS_SDC seam.
+#
+# A mercurial core flips a bit and the value stays FINITE — so the
+# injector must too: one seeded XOR of a finite-exponent bit in one
+# element of live solver state, deterministic, and BITWISE OFF when not
+# armed (the off path runs zero extra code). Two seams share the model:
+# the audited CG loop takes `la.cg.SdcInject` (jit-safe, in-loop), and
+# the host-visible boundaries (the driver's checkpointed loop, the serve
+# broker's continuous batches) take the numpy flip below.
+# ---------------------------------------------------------------------------
+
+
+def sdc_env_plan(env: dict | None = None) -> dict | None:
+    """Parse the ``CHAOS_SDC`` environment seam into an injection plan,
+    or None when unarmed. Format: ``iter=8[,bit=26][,index=-1][,once=0]``
+    — flip `bit` of element `index` (−1 = largest magnitude) of the
+    solve state once the loop crosses iteration `iter`; ``once=1`` (the
+    default) fires a single time ever (the TRANSIENT fault model — a
+    rollback re-run comes back clean), ``once=0`` re-fires on every
+    crossing (the DETERMINISTIC model — the re-run detects again and
+    the adjudication goes terminal)."""
+    import os
+
+    raw = (env if env is not None else os.environ).get("CHAOS_SDC", "")
+    if not raw:
+        return None
+    plan = {"iteration": None, "bit": None, "index": -1, "once": True}
+    for part in raw.split(","):
+        key, _, val = part.strip().partition("=")
+        if key in ("iter", "iteration"):
+            plan["iteration"] = int(val)
+        elif key == "bit":
+            plan["bit"] = int(val)
+        elif key == "index":
+            plan["index"] = int(val)
+        elif key == "once":
+            plan["once"] = bool(int(val))
+    if plan["iteration"] is None:
+        raise ValueError(f"CHAOS_SDC={raw!r}: needs iter=<N>")
+    return plan
+
+
+def flip_host_bit(arr, index: int = -1, bit: int | None = None):
+    """XOR one bit of one element of a host numpy array (returns a
+    copy): the mercurial-core model applied at a host-visible boundary.
+    ``index`` < 0 flips the largest-magnitude element (guaranteed above
+    any scale-normalised audit envelope); ``bit`` None picks the
+    per-dtype finite-exponent default (ops.abft.DEFAULT_FLIP_BIT)."""
+    import numpy as np
+
+    out = np.array(arr, copy=True)
+    flat = out.reshape(-1)
+    if bit is None:
+        # the canonical per-dtype default lives with the detector
+        # (ops.abft): the injector must corrupt exactly the way the
+        # detector is judged against, so there is ONE set of constants
+        from ..ops.abft import default_flip_bit
+
+        bit = default_flip_bit(flat.dtype)
+    idx = int(np.argmax(np.abs(flat))) if index < 0 else int(index)
+    udt = np.uint32 if flat.dtype.itemsize == 4 else np.uint64
+    word = flat[idx:idx + 1].view(udt)
+    word ^= udt(1) << udt(bit)
+    return out
+
+
+class SdcInjectionHook:
+    """Scripted ``serve.engine.SDC_HOOK``: at each scripted boundary
+    index (counting SDC_HOOK calls across the broker's continuous
+    batches, the BoundaryCrashHook convention) it bit-flips lane
+    ``lane``'s solution iterate in the batched CG state and hands the
+    corrupted state back to the solve — finite, wrong, and invisible to
+    everything except the retire-time audit. Works on both the f32/f64
+    `BatchedCGState` and the df `BatchedCGStateDF` (hi channel). Calls
+    and firings are recorded for assertions."""
+
+    def __init__(self, corrupt_at, lane: int = 0, index: int = -1,
+                 bit: int | None = None):
+        self.corrupt_at = set(int(b) for b in corrupt_at)
+        self.lane = int(lane)
+        self.index = index
+        self.bit = bit
+        self.calls = 0
+        self.fired: list[int] = []
+
+    def __call__(self, spec, boundary_iter, state):
+        i = self.calls
+        self.calls += 1
+        if i not in self.corrupt_at:
+            return None
+        self.corrupt_at.discard(i)
+        self.fired.append(i)
+        import jax.numpy as jnp
+        import numpy as np
+
+        X = state.X
+        if hasattr(X, "hi"):  # df (hi, lo) pair: corrupt the hi channel
+            hi = np.asarray(X.hi)
+            lane_flat = flip_host_bit(hi[self.lane], self.index, self.bit)
+            hi = np.array(hi, copy=True)
+            hi[self.lane] = lane_flat
+            return state._replace(X=type(X)(jnp.asarray(hi), X.lo))
+        host = np.asarray(X)
+        lane_flat = flip_host_bit(host[self.lane], self.index, self.bit)
+        host = np.array(host, copy=True)
+        host[self.lane] = lane_flat
+        return state._replace(X=jnp.asarray(host))

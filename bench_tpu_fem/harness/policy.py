@@ -115,6 +115,22 @@ def next_action(
             return Action(REPROBE, wait_s=policy.retry.backoff(attempt),
                           reason="tunnel wedge: re-probe + bounded backoff")
         return Action(GIVE_UP, reason="tunnel wedge (probing disabled)")
+    if failure_class == "sdc":
+        # SDC adjudication (ISSUE 14): the re-run IS the verdict. One
+        # detection is presumed a transient upset (the checkpointed
+        # drivers roll back to the last durable snapshot, so the retry
+        # resumes, not restarts); a SECOND detection on the re-run is a
+        # deterministic fault — a bad core or a wrong executable — and
+        # retrying it again would just launder corruption into the
+        # measurement record. The fleet's response to the deterministic
+        # verdict is lane quarantine (serve.fleet), not another retry.
+        if attempt < 2:
+            return Action(RETRY, wait_s=policy.retry.backoff(attempt),
+                          reason="sdc: single detection — rollback "
+                                 "re-run adjudicates transient vs "
+                                 "deterministic")
+        return Action(GIVE_UP, reason="sdc detected again on the re-run: "
+                                      "deterministic fault, never retried")
     if failure_class in policy.retry_on and attempt < policy.retry.max_attempts:
         return Action(RETRY, wait_s=policy.retry.backoff(attempt),
                       reason=f"{failure_class}: retry "

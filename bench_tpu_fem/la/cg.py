@@ -120,6 +120,53 @@ def _sentinel_zero() -> dict:
             "stag_max": jnp.zeros((), i32)}
 
 
+class SdcInject(NamedTuple):
+    """Deterministic seeded bit-flip injection into the audited loop's
+    operator output (ISSUE 14 — the CHAOS_SDC fault model, jit-safe):
+    at iteration `iteration` one bit of one element of ``y = A p`` is
+    XOR-flipped (`bit` None = the per-dtype finite-exponent default,
+    `index` < 0 = the largest-magnitude element). The injector exists so
+    detection RATES are measured, not assumed; `inject=None` paths are
+    bitwise the uninjected loop."""
+
+    iteration: int
+    bit: int | None = None
+    index: int = -1
+
+
+class CGAudit(NamedTuple):
+    """SDC audit configuration for `cg_solve(audit=)` (ISSUE 14).
+
+    ``every=K`` arms the periodic TRUE-RESIDUAL audit: every K
+    iterations the loop recomputes ``‖b − A x‖`` from scratch (one
+    extra apply under `lax.cond`, so off-cadence iterations pay
+    nothing) and compares it against the carried recurrence rnorm,
+    normalised by ``‖r0‖``, against a drift envelope calibrated per
+    precision (ops.abft.RESIDUAL_ENVELOPE). ``every=0`` disables it.
+
+    ``w``/``aw`` arm the per-apply ABFT check: ``aw = A w`` precomputed
+    once (ops.abft.checksum_vectors), then every audited apply compares
+    ``⟨w, A p⟩`` against ``⟨aw, p⟩`` (the operator-symmetry identity),
+    Cauchy–Schwarz-normalised, against ``abft_envelope``.
+
+    Exceedance on either check is CORRUPTION — the `sdc` failure class,
+    distinct from the non-finite `breakdown` class: these values are
+    finite but inconsistent. Detection freezes the solve at the last
+    audited-good iterate (the recovery layer rolls back to a durable
+    checkpoint); the verdicts ride the loop carry as device scalars
+    (the PR-10 capture discipline — no host sync on the hot path) and
+    come back in the info dict: `sdc_detected`, `sdc_iter` (first
+    detection, -1 = clean), `sdc_abft_checks`/`sdc_resid_checks`,
+    `sdc_abft_max`/`sdc_drift_max`."""
+
+    every: int = 8
+    envelope: float | None = None
+    w: object = None
+    aw: object = None
+    abft_envelope: float | None = None
+    inject: object = None  # SdcInject | None
+
+
 def cg_solve(
     apply_A: Callable[[jnp.ndarray], jnp.ndarray],
     b: jnp.ndarray,
@@ -132,6 +179,7 @@ def cg_solve(
     capture: bool = False,
     precond: Callable | None = None,
     dotpair: Callable | None = None,
+    audit: CGAudit | None = None,
 ):
     """Solve A x = b; returns x after `max_iter` iterations (rtol=0) or until
     ||r||/||r0|| < rtol. Early termination freezes the state rather than
@@ -178,7 +226,26 @@ def cg_solve(
     compose with precond, `dot3` does not (the fused-trio recurrence is
     an unpreconditioned-form identity). `dotpair(r, z) -> (<r,z>,
     <r,r>)` optionally fuses the two post-update reductions into one
-    stacked pass (sharded: dist.halo.owned_pair_dot, ONE psum)."""
+    stacked pass (sharded: dist.halo.owned_pair_dot, ONE psum).
+
+    With `audit=` (ISSUE 14: SDC defense) the loop runs the AUDITED
+    recurrence (`_audited_cg_solve`, a separate body — `audit=None` is
+    the pre-PR solve bit-for-bit, the same routing discipline):
+    periodic true-residual recompute + optional per-apply ABFT check
+    (see `CGAudit`), verdicts carried as device scalars, corruption
+    freezing the solve at the last audited-good iterate. Returns
+    `(x, info)`. Composes with sentinel/capture/rtol/dot; `dot3` and
+    `precond` do not (the audit identities are identities of the
+    unpreconditioned two-reduction form)."""
+    if audit is not None:
+        if dot3 is not None or precond is not None:
+            raise ValueError(
+                "audit= composes with sentinel/capture only: the ABFT "
+                "and true-residual identities are identities of the "
+                "unpreconditioned two-reduction recurrence")
+        return _audited_cg_solve(apply_A, b, x0, max_iter, rtol=rtol,
+                                 dot=dot, audit=audit, sentinel=sentinel,
+                                 capture=capture)
     if precond is not None:
         if dot3 is not None:
             raise ValueError(
@@ -404,6 +471,178 @@ def _pcg_solve(apply_A, b, x0, max_iter, rtol, dot, precond, dotpair,
     if sentinel or capture:
         return x, {k: v for k, v in info.items() if k != "stag_run"}
     return x
+
+
+def _audited_cg_solve(apply_A, b, x0, max_iter, rtol, dot, audit,
+                      sentinel, capture):
+    """SDC-audited CG (ISSUE 14). Separate body from `cg_solve` BY
+    DESIGN (the `_pcg_solve` discipline): the unaudited path must stay
+    bit-frozen, and the audit carries scalars (verdict flags, check
+    counters, drift maxima) the plain loop has no business threading.
+
+    The RECURRENCE is `cg_solve`'s plain loop verbatim — same ops, same
+    order — so on a clean solve the returned x is bitwise the unaudited
+    solve's (the audit computations are pure observers). Detection
+    freezes the state exactly as the non-finite sentinel does: the
+    detected iteration's updates are discarded, every later iteration
+    holds, and the caller (driver checkpoint rollback / serve lane
+    re-admit) owns recovery. The injection seam (`CGAudit.inject`) is
+    the deterministic mercurial-core model: one seeded bit flip in the
+    operator output, `inject=None` bitwise off."""
+    from ..ops.abft import (
+        abft_envelope,
+        abft_residual,
+        default_flip_bit,
+        flip_bit,
+        residual_envelope,
+    )
+
+    if dot is None:
+        dot = inner_product
+    dtype = b.dtype
+    every = int(audit.every)
+    env = jnp.asarray(audit.envelope if audit.envelope is not None
+                      else residual_envelope(dtype), dtype)
+    abft_on = audit.w is not None and audit.aw is not None
+    if abft_on:
+        aenv = jnp.asarray(
+            audit.abft_envelope if audit.abft_envelope is not None
+            else abft_envelope(dtype), dtype)
+        ww = dot(audit.w, audit.w)
+    inject = audit.inject
+    if inject is not None:
+        inj_bit = (inject.bit if inject.bit is not None
+                   else default_flip_bit(dtype))
+
+    y = apply_A(x0)
+    r = b - y
+    p = r
+    rnorm0 = dot(p, r)
+    sq0 = jnp.sqrt(rnorm0)
+    zero = jnp.zeros((), dtype)
+
+    def body(i, state):
+        x, r, p, rnorm, done, info = state
+        y = apply_A(p)
+        if inject is not None:
+            # the mercurial core: one finite bit flip at the scripted
+            # iteration (computed unconditionally, selected by `where` —
+            # the loop stays one fused body)
+            y = jnp.where(i == jnp.int32(inject.iteration),
+                          flip_bit(y, inject.index, inj_bit), y)
+        info = dict(info)
+        live = jnp.logical_not(done)
+        detected = jnp.asarray(False)
+        if abft_on:
+            # per-apply ABFT: <w, A p> must equal <A w, p> (symmetry)
+            # to rounding, normalised by the Cauchy-Schwarz scale (the
+            # raw sums may cancel arbitrarily); ww hoisted out of the
+            # loop
+            aerr = abft_residual(audit.w, audit.aw, p, y, dot, ww=ww)
+            info["sdc_abft_checks"] = (info["sdc_abft_checks"]
+                                       + live.astype(jnp.int32))
+            info["sdc_abft_max"] = jnp.maximum(
+                info["sdc_abft_max"], jnp.where(live, aerr, zero))
+            detected = jnp.logical_or(
+                detected, jnp.logical_and(live, aerr > aenv))
+        pdot = dot(p, y)
+        alpha = rnorm / pdot
+        if sentinel:
+            ok_p = jnp.logical_and(pdot > 0, jnp.isfinite(pdot))
+            alpha = jnp.where(ok_p, alpha, jnp.zeros((), alpha.dtype))
+        x1 = x + alpha * p
+        r1 = r - alpha * y
+        rnorm_new = dot(r1, r1)
+        beta = rnorm_new / rnorm
+        if sentinel:
+            beta = jnp.where(ok_p, beta, jnp.zeros((), beta.dtype))
+        p1 = beta * p + r1
+        if every > 0:
+            # periodic true-residual audit: recompute ||b - A x|| from
+            # scratch under lax.cond (off-cadence iterations pay no
+            # extra apply) and compare against the carried rnorm — a
+            # corruption of the carried state breaks this identity and
+            # STAYS broken, so the cadence bounds detection latency,
+            # not detection itself
+            do_check = jnp.logical_and(
+                live, (i + 1) % jnp.int32(every) == 0)
+
+            def _check(_):
+                rr = b - apply_A(x1)
+                tr = dot(rr, rr)
+                return jnp.abs(
+                    jnp.sqrt(jnp.maximum(tr, zero))
+                    - jnp.sqrt(jnp.maximum(rnorm_new, zero))) / sq0
+
+            drift = jax.lax.cond(do_check, _check, lambda _: zero, None)
+            info["sdc_resid_checks"] = (info["sdc_resid_checks"]
+                                        + do_check.astype(jnp.int32))
+            info["sdc_drift_max"] = jnp.maximum(info["sdc_drift_max"],
+                                                drift)
+            detected = jnp.logical_or(detected, drift > env)
+        first = jnp.logical_and(detected,
+                                jnp.logical_not(info["sdc_detected"]))
+        info["sdc_iter"] = jnp.where(first, jnp.asarray(i, jnp.int32),
+                                     info["sdc_iter"])
+        info["sdc_detected"] = jnp.logical_or(info["sdc_detected"],
+                                              detected)
+        new_done = jnp.logical_or(done, rnorm_new / rnorm0 < rtol * rtol)
+        new_done = jnp.logical_or(new_done, rnorm_new == zero)
+        # corruption freezes the solve: the detected iteration's updates
+        # are DISCARDED (the ABFT check fired on this iteration's own
+        # corrupted apply — the held state is the last audited-good
+        # iterate) and the loop runs out its static trip count frozen
+        new_done = jnp.logical_or(new_done, detected)
+        if sentinel:
+            bad_r = jnp.logical_not(jnp.isfinite(rnorm_new))
+            info["breakdown_restarts"] = info["breakdown_restarts"] + (
+                jnp.logical_and(live, jnp.logical_not(ok_p))
+                .astype(jnp.int32))
+            info["nonfinite"] = jnp.logical_or(
+                info["nonfinite"], jnp.logical_and(live, bad_r))
+            no_prog = jnp.logical_and(rnorm_new >= rnorm,
+                                      jnp.logical_not(bad_r))
+            stag = jnp.where(jnp.logical_and(live, no_prog),
+                             info["stag_run"] + 1,
+                             jnp.zeros((), jnp.int32))
+            info["stag_run"] = stag
+            info["stag_max"] = jnp.maximum(info["stag_max"], stag)
+            new_done = jnp.logical_or(new_done, bad_r)
+            hold = jnp.logical_or(jnp.logical_or(done, bad_r), detected)
+        else:
+            hold = jnp.logical_or(done, detected)
+        keep = lambda new, old: jnp.where(hold, old, new)  # noqa: E731
+        rnorm_keep = keep(rnorm_new, rnorm)
+        if capture:
+            info["rnorm_history"] = (
+                info["rnorm_history"].at[i + 1].set(rnorm_keep))
+        return (
+            keep(x1, x),
+            keep(r1, r),
+            keep(p1, p),
+            rnorm_keep,
+            new_done,
+            info,
+        )
+
+    info0 = _sentinel_zero() if sentinel else {}
+    if capture:
+        info0 = dict(info0)
+        info0["rnorm_history"] = (
+            jnp.zeros((max_iter + 1,), rnorm0.dtype).at[0].set(rnorm0))
+    i32 = jnp.int32
+    info0 = dict(info0)
+    info0.update(
+        sdc_detected=jnp.asarray(False),
+        sdc_iter=jnp.asarray(-1, i32),
+        sdc_abft_checks=jnp.zeros((), i32),
+        sdc_resid_checks=jnp.zeros((), i32),
+        sdc_drift_max=zero,
+        sdc_abft_max=zero,
+    )
+    state = (x0, r, p, rnorm0, jnp.asarray(False), info0)
+    x, _, _, _, _, info = jax.lax.fori_loop(0, max_iter, body, state)
+    return x, {k: v for k, v in info.items() if k != "stag_run"}
 
 
 def batched_dot(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:

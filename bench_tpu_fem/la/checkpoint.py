@@ -128,6 +128,21 @@ def cg_ckpt_run(state, step: Callable, k: int):
     return jax.lax.fori_loop(0, k, lambda _, s: step(s), state)
 
 
+def true_residual_sq(apply_A: Callable, b, x, dot: Callable | None = None):
+    """The SDC audit's ground truth (ISSUE 14): ``‖b − A x‖²``
+    recomputed from scratch. At an iteration boundary this must agree
+    with the carried ``state.rnorm`` to rounding — a silent corruption
+    of the checkpointable carry (a bit-flipped x, r or p) breaks the
+    identity and stays broken, which is what the driver's
+    boundary-audited checkpointed loop (bench.driver) compares against
+    the per-precision envelope before trusting a snapshot enough to
+    save it."""
+    if dot is None:
+        dot = inner_product
+    r = b - apply_A(x)
+    return dot(r, r)
+
+
 # ---------------------------------------------------------------------------
 # df twin: ops.kron_df.cg_solve_df at iteration boundaries.
 # ---------------------------------------------------------------------------

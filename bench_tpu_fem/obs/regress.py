@@ -351,6 +351,10 @@ LOWER_IS_BETTER_COUNTERS = (
     # of warming from the shared artifact store, or a lost/duplicated
     # response in the fleet's exactly-once ledger, is a regression
     "fleet_warm_replica_recompiles", "fleet_lost", "fleet_duplicates",
+    # ISSUE 14 SDC counters on the deterministic injected schedule: a
+    # missed injection (injected - detected) or a false positive on the
+    # clean fixed-seed solves is a detector regression — both pin at 0
+    "sdc_missed", "sdc_false_positives",
 )
 #: snapshot keys where a DECREASE below baseline is a regression
 HIGHER_IS_BETTER_COUNTERS = (
@@ -360,6 +364,11 @@ HIGHER_IS_BETTER_COUNTERS = (
     # keep happening — a drop on any of these is the fleet logic
     # silently degrading to single-device behaviour
     "fleet_steals", "fleet_affinity_hit_rate", "fleet_warm_loads",
+    # ISSUE 14: every injection on the pinned schedule must keep being
+    # detected — a drop here is a SUPPRESSED detector (the regression
+    # probe the CI perfgate lane injects), the worst failure mode this
+    # subsystem can have
+    "sdc_detected",
 )
 #: contract booleans: baseline True -> current must stay True
 CONTRACT_FLAGS = ("record_contract_ok", "trace_valid")

@@ -1,0 +1,145 @@
+"""Algorithm-based fault tolerance (ABFT) for the matrix-free apply
+(ISSUE 14): checksum vectors, drift envelopes and the bit-flip model —
+the detection vocabulary every SDC seam shares.
+
+Silent data corruption ("mercurial cores", Hochschild et al., HotOS
+2021) returns FINITE-but-wrong values: none of the existing defenses
+see it — the breakdown sentinels catch non-finite values, the CRC
+machinery catches torn bytes, but a bit-flipped apply that stays finite
+sails through CG unchecked. A matrix-free iterative solver has two free
+invariants that close the hole (Huang & Abraham, 1984):
+
+* **Operator linearity / symmetry** — for any checksum vector ``w``,
+  ``⟨w, A p⟩ == ⟨A^T w, p⟩`` exactly in real arithmetic, and ``A^T w =
+  A w`` for the symmetric Laplacian, so ONE precomputed apply
+  (``aw = A w``) turns every subsequent audited apply into one extra
+  dot: compute ``⟨w, y⟩`` next to the recurrence's own dots and compare
+  against ``⟨aw, p⟩``. A corruption of any output element by ``δ``
+  shifts ``⟨w, y⟩`` by ``w_i·δ`` while ``⟨aw, p⟩`` is untouched.
+* **The CG true-residual identity** — the recurrence's carried
+  ``rnorm`` tracks ``‖b − A x‖²`` to rounding; a corruption of the
+  carried state (x, r, p) breaks the identity and stays broken, so a
+  periodic recompute of the true residual catches what the per-apply
+  check cannot (a flip BETWEEN applies).
+
+Both comparisons are scale-normalised and judged against a drift
+envelope calibrated per precision (below): rounding drift is bounded by
+``O(eps·sqrt(n))`` relative to the Cauchy–Schwarz scale of the
+operands, so the envelopes sit orders of magnitude above clean-solve
+drift (zero false positives on the fixed-seed perfgate solves) and
+orders of magnitude below any corruption that could perturb the
+answer's leading digits.
+
+The exceedance class is ``sdc`` (harness.classify) — distinct from the
+non-finite ``breakdown`` class by construction: these checks fire on
+finite-but-inconsistent values.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+# --------------------------------------------------------------------------
+# Drift envelopes, calibrated per precision.
+#
+# True-residual audit: |sqrt(true) - sqrt(carried)| / sqrt(rnorm0).
+# Clean-solve drift measured on the fixed-seed perfgate problems:
+# O(1e-6) f32 (eps 6e-8 times a ~benchmark-budget iteration count),
+# O(1e-14) f64, O(1e-13) for the df carried hi channel. The envelopes
+# keep >= 2 orders of headroom above clean drift on each side.
+RESIDUAL_ENVELOPE = {
+    "f32": 1e-3,
+    "f64": 1e-9,
+    "df32": 1e-8,
+}
+
+# Per-apply ABFT check: |<w, y> - <aw, p>| / (||w||·||y||). The error of
+# either dot is bounded by O(eps·sqrt(n)) of the Cauchy-Schwarz scale
+# (the sums themselves may cancel arbitrarily — the interior rows of a
+# Laplacian applied to the ones vector cancel to ~0 — which is why the
+# comparison must NOT normalise by |<aw, p>| itself).
+ABFT_ENVELOPE = {
+    "f32": 1e-4,
+    "f64": 1e-10,
+}
+
+
+def residual_envelope(dtype) -> float:
+    """True-residual drift envelope for a jnp/np dtype."""
+    return (RESIDUAL_ENVELOPE["f32"]
+            if jnp.dtype(dtype) == jnp.dtype(jnp.float32)
+            else RESIDUAL_ENVELOPE["f64"])
+
+
+def abft_envelope(dtype) -> float:
+    """Per-apply ABFT envelope for a jnp/np dtype."""
+    return (ABFT_ENVELOPE["f32"]
+            if jnp.dtype(dtype) == jnp.dtype(jnp.float32)
+            else ABFT_ENVELOPE["f64"])
+
+
+def checksum_vectors(apply_A, like):
+    """The ABFT checksum pair ``(w, aw)`` for a symmetric matrix-free
+    operator: ``w`` the ones vector (every output element weighs into
+    the check equally) and ``aw = A w`` computed ONCE up front — the
+    precomputed ``A^T w`` of the classic row-checksum scheme, by
+    symmetry. One setup apply buys an audit on every subsequent apply."""
+    w = jnp.ones_like(like)
+    return w, apply_A(w)
+
+
+def abft_residual(w, aw, p, y, dot, ww=None) -> jnp.ndarray:
+    """Scale-normalised ABFT residual of one audited apply ``y = A p``:
+    ``|<w, y> - <aw, p>| / (||w||·||y|| + tiny)``. jit-safe device
+    scalar — the audited CG loop carries its max, no host sync. Pass a
+    precomputed ``ww = <w, w>`` to hoist the loop-invariant reduction
+    out of the loop body (la.cg does)."""
+    wy = dot(w, y)
+    awp = dot(aw, p)
+    if ww is None:
+        ww = dot(w, w)
+    scale = jnp.sqrt(ww * dot(y, y))
+    tiny = jnp.asarray(jnp.finfo(scale.dtype).tiny, scale.dtype)
+    return jnp.abs(wy - awp) / (scale + tiny)
+
+
+# --------------------------------------------------------------------------
+# The bit-flip fault model (shared with harness.faults — the injector
+# must corrupt exactly the way the detector is judged against).
+
+
+def _uint_dtype(dtype):
+    return jnp.uint32 if jnp.dtype(dtype).itemsize == 4 else jnp.uint64
+
+
+#: default flipped bit: exponent bit 3 of the f32 layout (bit 26) — a
+#: 2^±8 scale change, large enough that any audited check sees it and
+#: FINITE for every value the solves produce (an exponent-MSB flip
+#: would overflow to inf and be caught by the breakdown sentinel
+#: instead — the point of SDC is that the value stays finite).
+DEFAULT_FLIP_BIT = 26
+#: the f64 twin (exponent bit 3 of the f64 layout: 2^±8 as well)
+DEFAULT_FLIP_BIT_F64 = 55
+
+
+def default_flip_bit(dtype) -> int:
+    return (DEFAULT_FLIP_BIT
+            if jnp.dtype(dtype).itemsize == 4 else DEFAULT_FLIP_BIT_F64)
+
+
+def flip_bit(y: jnp.ndarray, index, bit: int) -> jnp.ndarray:
+    """XOR one bit of one element of a device array (jit-safe): the
+    mercurial-core fault model. ``index`` indexes the FLATTENED array
+    and may be traced; ``index < 0`` flips the element of largest
+    magnitude (guaranteed above any scale-normalised envelope)."""
+    import jax
+
+    flat = y.reshape(-1)
+    udt = _uint_dtype(flat.dtype)
+    idx = jnp.where(jnp.asarray(index) < 0,
+                    jnp.argmax(jnp.abs(flat)).astype(jnp.int32),
+                    jnp.asarray(index, jnp.int32))
+    word = jax.lax.bitcast_convert_type(flat[idx], udt)
+    flipped = jax.lax.bitcast_convert_type(
+        word ^ jnp.asarray(1, udt) << jnp.asarray(bit, udt), flat.dtype)
+    return flat.at[idx].set(flipped).reshape(y.shape)

@@ -79,6 +79,16 @@ def main(argv=None) -> int:
                         "--slo-objective > 0)")
     p.add_argument("--balance-interval-ms", type=float, default=20.0,
                    help="fleet balancer tick; 0 disables stealing")
+    p.add_argument("--audit", action="store_true",
+                   help="SDC defense (ISSUE 14): true-residual-audit "
+                        "every retiring lane; an exceedance rolls the "
+                        "lane back once then answers failure_class sdc")
+    p.add_argument("--quarantine-threshold", type=int, default=0,
+                   help="fleet lane quarantine: detections inside the "
+                        "window that trip a lane out of routing "
+                        "(0 = never; requires --audit and --fleet)")
+    p.add_argument("--quarantine-window", type=float, default=60.0,
+                   help="quarantine trip window, seconds")
     p.add_argument("--warmup", default="",
                    help="comma-separated degrees to prebuild at startup "
                         "(with --ndofs/--nreps/--precision), e.g. '1,3,6'")
@@ -135,6 +145,9 @@ def main(argv=None) -> int:
             steal_threshold=args.steal_threshold,
             balance_interval_s=args.balance_interval_ms / 1000.0,
             spill_burn=args.spill_burn,
+            audit=args.audit,
+            quarantine_threshold=args.quarantine_threshold,
+            quarantine_window_s=args.quarantine_window,
         )
     else:
         metrics = Metrics(
@@ -153,6 +166,7 @@ def main(argv=None) -> int:
             window_s=args.window_ms / 1000.0,
             solve_timeout_s=args.solve_timeout,
             continuous=not args.no_continuous,
+            audit=args.audit,
         )
     if args.warmup:
         degrees = [int(d) for d in args.warmup.split(",") if d.strip()]

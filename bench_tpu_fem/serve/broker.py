@@ -86,6 +86,10 @@ class PendingRequest:
     done: threading.Event = field(default_factory=threading.Event)
     result: dict | None = None
     answered: bool = False
+    # SDC adjudication state (ISSUE 14): how many corruption-detected
+    # rollback re-runs this request has consumed. One is the budget —
+    # a second detection is the deterministic verdict.
+    sdc_retries: int = 0
     lc: Lifecycle = field(default_factory=Lifecycle)
     # claim lock: PER REQUEST, not broker-global — the exactly-once
     # contract only needs responders to the SAME request serialized;
@@ -110,7 +114,8 @@ class Broker:
                  window_s: float = 0.025, solve_timeout_s: float = 120.0,
                  continuous: bool = True, builder=build_solver,
                  retry_max: int = 1, retry_backoff_s: float = 0.05,
-                 retry_jitter: float = 0.5, sleep=time.sleep, rng=None):
+                 retry_jitter: float = 0.5, sleep=time.sleep, rng=None,
+                 audit: bool = False):
         self.cache = cache or ExecutableCache()
         self.metrics = metrics or Metrics()
         self.queue_max = queue_max
@@ -131,6 +136,13 @@ class Broker:
         self.retry_max = max(int(retry_max), 0)
         self.retry_backoff_s = retry_backoff_s
         self.retry_jitter = retry_jitter
+        # SDC retire-time audit (ISSUE 14): when armed, every live lane
+        # is true-residual-audited BEFORE its retirement; an exceedance
+        # rolls the lane back once (the re-run adjudicates transient vs
+        # deterministic) then answers `failure_class: "sdc"`. Off (the
+        # default) is the pre-PR retire path exactly — no extra
+        # compiled calls anywhere.
+        self.audit = bool(audit)
         self._sleep = sleep
         self._rng = rng or random.Random()
         self._queue: deque[PendingRequest] = deque()
@@ -435,8 +447,13 @@ class Broker:
                 # classes fail straight through — retrying them burns
                 # queue capacity for the same answer. The solve thread
                 # has EXITED here (unlike the timeout path), so a
-                # resumed attempt races nobody.
-                if cls in RETRIABLE_CLASSES and attempt < self.retry_max:
+                # resumed attempt races nobody. `sdc` gets the SAME
+                # internal retry (the re-run IS the adjudication,
+                # ISSUE 14) while staying outside RETRIABLE_CLASSES —
+                # a batch that fails sdc AGAIN answers the client
+                # retriable:false, matching the audit path's verdict.
+                if (cls in RETRIABLE_CLASSES or cls == "sdc") \
+                        and attempt < self.retry_max:
                     attempt += 1
                     wait = self.retry_backoff_s * (2 ** (attempt - 1))
                     wait *= 1.0 + self.retry_jitter * self._rng.random()
@@ -589,6 +606,13 @@ class Broker:
             if _engine.BOUNDARY_HOOK is not None:
                 _engine.BOUNDARY_HOOK(spec, boundary_iter)
             state = solver.cont_step(state)
+            if _engine.SDC_HOOK is not None:
+                # corruption seam (ISSUE 14): the hook may hand back a
+                # bit-flipped state — finite, wrong, invisible to
+                # everything except the retire-time audit below
+                mutated = _engine.SDC_HOOK(spec, boundary_iter, state)
+                if mutated is not None:
+                    state = mutated
             boundary_iter += solver.iter_chunk
             iters, done = solver.cont_poll(state)
             live = sum(1 for p in lanes if p is not None)
@@ -599,6 +623,52 @@ class Broker:
             for lane, p in enumerate(lanes):
                 if p is None or not bool(done[lane]):
                     continue
+                if self.audit and hasattr(solver, "audit_lane"):
+                    try:
+                        verdict = solver.audit_lane(state, lane, p.scale)
+                    except Exception:
+                        verdict = None  # the audit must never sink a solve
+                    if verdict is not None and not verdict["ok"]:
+                        action = ("rollback" if p.sdc_retries < 1
+                                  else "terminal")
+                        self.metrics.sdc(p.id, lane, verdict["drift"],
+                                         verdict["envelope"], action)
+                        if action == "rollback":
+                            # corruption-aware rollback (ISSUE 14): the
+                            # lane's durable checkpoint is its
+                            # write-ahead record — discard the corrupted
+                            # iterates and re-run the lane from scratch;
+                            # the re-run IS the transient-vs-
+                            # deterministic adjudication. Lane-local:
+                            # batch-mates never notice.
+                            p.sdc_retries += 1
+                            state, _ = solver.cont_retire(state, lane)
+                            state = solver.cont_admit(state, lane,
+                                                      p.scale)
+                            park()
+                            continue
+                        # detected AGAIN on the re-run: deterministic
+                        # fault — answer terminally, never retried (the
+                        # fleet's quarantine watches these)
+                        state, _ = solver.cont_retire(state, lane)
+                        lanes[lane] = None
+                        live -= 1
+                        served += 1
+                        self.metrics.retire(p.id, lane, boundary_iter,
+                                            int(iters[lane]), live)
+                        park()
+                        self._respond(p, {
+                            "ok": False, "id": p.id,
+                            "error": (
+                                "silent data corruption: true-residual "
+                                f"audit drift {verdict['drift']:.3e} > "
+                                f"envelope {verdict['envelope']:.1e} "
+                                "again after rollback (deterministic)"),
+                            "failure_class": "sdc", "retriable": False,
+                            "spec": spec_d(), "continuous": True,
+                            "sdc_drift": verdict["drift"],
+                            "iters_run": int(iters[lane])})
+                        continue
                 state, xnorm = solver.cont_retire(state, lane)
                 lanes[lane] = None
                 live -= 1

@@ -88,6 +88,15 @@ FAULT_HOOK = None
 # never consumes a FaultySolveHook script out from under existing tests.
 BOUNDARY_HOOK = None
 
+# Silent-data-corruption seam (ISSUE 14): when set, called as
+# SDC_HOOK(spec, boundary_iter, state) -> state|None after every
+# continuous-batching cont_step — returning a state hands the solve a
+# CORRUPTED carry (the mercurial-core model: one finite bit flip,
+# harness.faults.SdcInjectionHook), which is invisible to everything
+# except the retire-time audit. None leaves the state untouched; the
+# unarmed path runs zero extra code.
+SDC_HOOK = None
+
 _PRECISIONS = ("f32", "f64", "df32")
 
 # Admission cap on problem size: a single oversized request must be
@@ -665,6 +674,76 @@ class CompiledSolver:
                 np.float64(dhi) + np.float64(dlo), 0.0)))
         state, xn = self._retire_fn(state, np.int32(lane))
         return state, float(xn)
+
+    # -- SDC retire-time audit (ISSUE 14) -----------------------------------
+
+    def audit_lane(self, state, lane: int, scale: float) -> dict:
+        """True-residual audit of ONE lane at an iteration boundary,
+        BEFORE it retires: recompute ``‖scale·b − A x‖`` from scratch
+        (one apply — off the hot path, only audited retires pay it) and
+        compare against the lane's carried recurrence rnorm, normalised
+        by ``‖r0‖``, against the per-precision drift envelope
+        (ops.abft.RESIDUAL_ENVELOPE). A silent corruption of the lane's
+        carry breaks the identity and stays broken; the broker maps an
+        exceedance to the `sdc` failure class with rollback/terminal
+        adjudication. Returns {"ok", "drift", "envelope"} — a dead or
+        padding lane (rnorm0 == 0) audits trivially ok."""
+        import jax
+
+        from ..ops.abft import RESIDUAL_ENVELOPE
+
+        if getattr(self, "_audit_fn", None) is None:
+            import jax.numpy as jnp
+
+            if self.spec.precision == "df32":
+                from ..la.df64 import DF, df_dot, df_mul, df_sub
+
+                def _aud(op, base, state, lane, shi, slo):
+                    x = DF(state.X.hi[lane], state.X.lo[lane])
+                    y = op.apply(x)
+                    bl = df_mul(base, DF(
+                        jnp.broadcast_to(shi, base.hi.shape),
+                        jnp.broadcast_to(slo, base.hi.shape)))
+                    rr = df_sub(bl, y)
+                    return (df_dot(rr, rr).hi, state.rnorm.hi[lane],
+                            state.rnorm0_hi[lane])
+            else:
+                from ..la.vector import inner_product
+
+                def _aud(op, base, state, lane, scale):
+                    x = state.X[lane]
+                    rr = scale * base - op.apply(x)
+                    return (inner_product(rr, rr), state.rnorm[lane],
+                            state.rnorm0[lane])
+
+            self._audit_fn = jax.jit(_aud)
+        if self.spec.precision == "df32":
+            s64 = np.float64(scale)
+            shi = np.float32(s64)
+            slo = np.float32(s64 - np.float64(shi))
+            tr, carried, rn0 = self._audit_fn(
+                self._op, self._base, state, np.int32(lane), shi, slo)
+        else:
+            tr, carried, rn0 = self._audit_fn(
+                self._op, self._base, state, np.int32(lane),
+                np.asarray(scale, self._base.dtype))
+        tr = float(np.asarray(tr))
+        carried = float(np.asarray(carried))
+        rn0 = float(np.asarray(rn0))
+        env = RESIDUAL_ENVELOPE[self.spec.precision]
+        if rn0 <= 0.0:
+            return {"ok": True, "drift": 0.0, "envelope": env}
+        if not (np.isfinite(tr) and np.isfinite(carried)):
+            # non-finite is the BREAKDOWN sentinel's class, not sdc's
+            # (sdc = finite but inconsistent, by construction): audit
+            # trivially ok and let the retire-time xnorm check answer
+            # `breakdown` as it always has
+            return {"ok": True, "drift": 0.0, "envelope": env,
+                    "nonfinite": True}
+        drift = abs(np.sqrt(max(tr, 0.0)) - np.sqrt(max(carried, 0.0))) \
+            / np.sqrt(rn0)
+        return {"ok": bool(drift <= env), "drift": float(drift),
+                "envelope": env}
 
 
 def build_solver(spec: SolveSpec, bucket: int | None = None,

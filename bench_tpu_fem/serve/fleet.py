@@ -63,7 +63,10 @@ from .metrics import FleetMetrics, Metrics
 
 @dataclass
 class DeviceLane:
-    """One device's serving stack: broker + cache + labelled metrics."""
+    """One device's serving stack: broker + cache + labelled metrics.
+    ``quarantined`` (ISSUE 14) takes the lane out of the routing pool —
+    a device whose windowed SDC-detection counter tripped serves no new
+    traffic until a known-answer self-test readmits it."""
 
     index: int
     label: str
@@ -71,6 +74,7 @@ class DeviceLane:
     cache: ExecutableCache
     metrics: Metrics
     device: object | None = None  # jax.Device when available
+    quarantined: bool = False
 
 
 def _jax_devices(n: int):
@@ -104,12 +108,26 @@ class FleetDispatcher:
                  balance_interval_s: float = 0.02,
                  spill_burn: float = 1.0,
                  publish_artifacts: bool = True,
-                 builder=build_solver):
+                 builder=build_solver,
+                 audit: bool = False,
+                 quarantine_threshold: int = 0,
+                 quarantine_window_s: float = 60.0):
         if ndevices < 1:
             raise ValueError("ndevices must be >= 1")
         self.artifacts = artifacts
         self.steal_threshold = max(int(steal_threshold), 1)
         self.spill_burn = float(spill_burn)
+        # SDC lane quarantine (ISSUE 14): with `audit` on, every lane
+        # broker true-residual-audits retiring lanes; a lane whose
+        # detections inside `quarantine_window_s` reach
+        # `quarantine_threshold` is quarantined (0 = never). Quarantine
+        # drains the lane's queue to healthy peers through the
+        # steal/adopt machinery (exactly-once: pure queue moves) and
+        # the lane rejoins only through a passing known-answer
+        # self-test (`run_selftest`).
+        self.audit = bool(audit)
+        self.quarantine_threshold = int(quarantine_threshold)
+        self.quarantine_window_s = float(quarantine_window_s)
         self.nrhs_max = min(nrhs_max, NRHS_BUCKETS[-1])
         self.queue_max = queue_max
         self.fleet_metrics = FleetMetrics(journal_path)
@@ -133,7 +151,8 @@ class FleetDispatcher:
                             nrhs_max=nrhs_max, window_s=window_s,
                             solve_timeout_s=solve_timeout_s,
                             continuous=continuous,
-                            builder=self._lane_builder(devices[i]))
+                            builder=self._lane_builder(devices[i]),
+                            audit=audit)
             self.lanes.append(DeviceLane(i, label, broker, cache,
                                          metrics, devices[i]))
         # ONE fleet-wide id space (the lanes share a journal, so ids
@@ -207,8 +226,19 @@ class FleetDispatcher:
         def depth(ln):
             return ln.broker.pending_count()
 
-        affine = [ln for ln in self.lanes if self._lane_holds(ln, spec)]
-        candidates = affine or list(self.lanes)
+        # quarantined lanes are out of the routing pool entirely
+        # (ISSUE 14): a corruption-tripped device serves no new traffic
+        # until its self-test readmits it. Every lane quarantined =
+        # fleet-level shed (retriable — the fleet is degraded, not gone)
+        pool = [ln for ln in self.lanes if not ln.quarantined]
+        if not pool:
+            self.fleet_metrics.shed(
+                rid, sum(depth(ln) for ln in self.lanes))
+            raise QueueFull(
+                f"every lane quarantined ({len(self.lanes)} of "
+                f"{len(self.lanes)}) — self-test readmission pending")
+        affine = [ln for ln in pool if self._lane_holds(ln, spec)]
+        candidates = affine or pool
         chosen = min(candidates, key=depth)
         # burn-spill retarget: only to a colder lane WITH ROOM — the
         # final placement must be settled BEFORE anything is journaled,
@@ -216,17 +246,17 @@ class FleetDispatcher:
         # lanes (a spill "to" a full lane would bounce right back to
         # the burning one while the evidence claimed otherwise)
         spill_from, burn = None, chosen.metrics.fast_burn_rate()
-        if burn > self.spill_burn and len(self.lanes) > 1:
-            colder = [ln for ln in self.lanes if ln is not chosen
+        if burn > self.spill_burn and len(pool) > 1:
+            colder = [ln for ln in pool if ln is not chosen
                       and ln.metrics.fast_burn_rate() <= self.spill_burn
                       and depth(ln) < self.queue_max]
             if colder:
                 spill_from, chosen = chosen, min(colder, key=depth)
         if depth(chosen) >= self.queue_max:
-            # the chosen lane is full: fall over to ANY lane with room;
-            # none -> shed FLEET-level before any WAL record exists, so
-            # the ledger never sees an admit racing a shed
-            others = [ln for ln in self.lanes
+            # the chosen lane is full: fall over to ANY healthy lane
+            # with room; none -> shed FLEET-level before any WAL record
+            # exists, so the ledger never sees an admit racing a shed
+            others = [ln for ln in pool
                       if depth(ln) < self.queue_max]
             if not others:
                 self.fleet_metrics.shed(
@@ -278,6 +308,7 @@ class FleetDispatcher:
         while not self._stop:
             time.sleep(self.balance_interval_s)
             try:
+                self.quarantine_scan()
                 self.rebalance_once()
             except Exception:
                 # the balancer must never die mid-incident; a failed
@@ -286,11 +317,14 @@ class FleetDispatcher:
 
     def rebalance_once(self) -> int:
         """One stealing pass: move half the depth gap from the fattest
-        queue's tail to the thinnest lane when the gap reaches the
-        threshold. Returns the number of requests moved."""
-        if len(self.lanes) < 2:
+        queue's tail to the thinnest HEALTHY lane when the gap reaches
+        the threshold (a quarantined lane neither gives nor receives —
+        its queue was already drained at the trip). Returns the number
+        of requests moved."""
+        healthy = [ln for ln in self.lanes if not ln.quarantined]
+        if len(healthy) < 2:
             return 0
-        depths = [(ln.broker.pending_count(), ln) for ln in self.lanes]
+        depths = [(ln.broker.pending_count(), ln) for ln in healthy]
         fat_d, fat = max(depths, key=lambda t: t[0])
         thin_d, thin = min(depths, key=lambda t: t[0])
         if fat is thin or fat_d - thin_d < self.steal_threshold:
@@ -301,6 +335,83 @@ class FleetDispatcher:
         thin.broker.adopt_pending(stolen)
         self.fleet_metrics.steal(fat.label, thin.label, len(stolen))
         return len(stolen)
+
+    # -- SDC lane quarantine (ISSUE 14) ------------------------------------
+
+    def quarantine_scan(self) -> int:
+        """One quarantine pass (run by the balancer thread, callable
+        manually): any healthy lane whose SDC detections inside the
+        trailing window reach the threshold trips into quarantine.
+        Returns the number of lanes tripped this pass."""
+        if self.quarantine_threshold <= 0:
+            return 0
+        tripped = 0
+        for ln in self.lanes:
+            if ln.quarantined:
+                continue
+            n = ln.metrics.sdc_recent(self.quarantine_window_s)
+            if n >= self.quarantine_threshold:
+                self._quarantine(ln, n)
+                tripped += 1
+        return tripped
+
+    def _quarantine(self, lane: DeviceLane, window_events: int) -> None:
+        """Trip one lane: mark it out of the routing pool and drain its
+        QUEUED requests to the least-loaded healthy lane through the
+        existing steal/adopt machinery — the requests' write-ahead
+        records already exist, so the drain is a pure queue move the
+        exactly-once ledger never sees (zero lost, zero duplicates by
+        construction). The batch already IN FLIGHT on the lane runs out
+        normally (its members answer through the audit/rollback path).
+        With no healthy peer the queue stays put — a degraded lane
+        still beats a lost request — and the journal records drained=0."""
+        lane.quarantined = True
+        healthy = [ln for ln in self.lanes if not ln.quarantined]
+        drained: list = []
+        if healthy:
+            drained = lane.broker.steal_requests(
+                lane.broker.pending_count())
+            if drained:
+                tgt = min(healthy,
+                          key=lambda ln: ln.broker.pending_count())
+                tgt.broker.adopt_pending(drained)
+        self.fleet_metrics.quarantine(lane.label, len(drained),
+                                      window_events)
+
+    def run_selftest(self, lane_index: int, spec: SolveSpec,
+                     scale: float = 1.0, timeout_s: float = 120.0,
+                     expect_xnorm: float | None = None,
+                     rel_tol: float = 1e-5) -> dict:
+        """Known-answer self-test of one (typically quarantined) lane:
+        submit a canonical solve DIRECTLY to the lane's broker
+        (bypassing routing — the test must run on the suspect device)
+        under the fleet's audited retire path. Pass = the response is
+        ok (the true-residual audit held end-to-end) and, when
+        `expect_xnorm` is given, the solution norm matches the known
+        answer. A passing test readmits the lane (`fleet_readmit`
+        journaled); a failing one keeps it quarantined. The test
+        request rides the normal WAL/response ledger, so the journal
+        stays exactly-once over self-tests too."""
+        lane = self.lanes[lane_index]
+        rid = self._mint_id(None)
+        pending = lane.broker.submit(spec, scale, req_id=rid)
+        out = lane.broker.wait(pending, timeout_s)
+        ok = bool(out.get("ok"))
+        if ok and expect_xnorm is not None:
+            got = out.get("xnorm", float("nan"))
+            ok = abs(got - expect_xnorm) <= rel_tol * abs(expect_xnorm)
+        self.fleet_metrics.selftest(lane.label, rid, ok)
+        if ok and lane.quarantined:
+            # readmission resets the lane's detection WINDOW (not its
+            # monotone counters): without this the balancer's next
+            # quarantine_scan re-trips the lane on the pre-quarantine
+            # detections still inside the window, silently undoing the
+            # readmit it just journaled
+            lane.metrics.sdc_reset_window()
+            lane.quarantined = False
+            self.fleet_metrics.readmit(lane.label, rid)
+        return {"ok": ok, "response": out,
+                "quarantined": lane.quarantined}
 
     # -- standby adoption (broker replication) -----------------------------
 
@@ -333,9 +444,11 @@ class FleetDispatcher:
             try:
                 spec = SolveSpec(**req["spec"])
                 spec.validate()
-                affine = [ln for ln in self.lanes
+                pool = ([ln for ln in self.lanes if not ln.quarantined]
+                        or self.lanes)
+                affine = [ln for ln in pool
                           if self._lane_holds(ln, spec)]
-                lane = min(affine or self.lanes,
+                lane = min(affine or pool,
                            key=lambda ln: ln.broker.pending_count())
             except Exception:
                 lane = self.lanes[0]  # terminal-answer path below
@@ -366,7 +479,8 @@ class FleetDispatcher:
                     "failed", "batches", "midsolve_admissions",
                     "padded_lanes_total", "broker_retries",
                     "batch_resumes", "recovery_runs",
-                    "recovered_requests", "queue_depth")
+                    "recovered_requests", "queue_depth",
+                    "sdc_detected", "sdc_rollbacks", "sdc_terminal")
         out: dict = {k: sum(s.get(k, 0) for s in lane_snaps)
                      for k in sum_keys}
         # fleet-level sheds (every lane full) count into the top-level
@@ -394,6 +508,11 @@ class FleetDispatcher:
         out["latency_p99_s"] = _pct(lat, 0.99)
         fleet = self.fleet_metrics.snapshot()
         fleet["devices"] = len(self.lanes)
+        # current quarantine state (a gauge, not a counter: the trip
+        # history lives in quarantines/readmits above)
+        fleet["quarantined_lanes"] = [ln.label for ln in self.lanes
+                                      if ln.quarantined]
+        fleet["quarantined"] = len(fleet["quarantined_lanes"])
         if self.artifacts is not None:
             fleet["artifacts"] = self.artifacts.stats()
         out["fleet"] = fleet

@@ -122,6 +122,12 @@ class Metrics:
         self.batch_resumes = 0  # retries that resumed a boundary checkpoint
         self.recovery_runs = 0  # Broker.recover invocations
         self.recovered_requests = 0  # admitted-unresponded requests replayed
+        # SDC defense accounting (ISSUE 14): retire-time audit verdicts
+        self.sdc_detected = 0  # audit exceedances (finite-but-wrong lanes)
+        self.sdc_rollbacks = 0  # detections answered by a lane re-run
+        self.sdc_terminal = 0  # detected AGAIN on the re-run: deterministic
+        # detection timestamps for the fleet's windowed quarantine trip
+        self._sdc_times: deque = deque(maxlen=_LATENCY_WINDOW)
 
     def _journal(self, rec: dict) -> None:
         if self.journal is not None:
@@ -239,6 +245,46 @@ class Metrics:
             if cache == "hit":
                 self.latencies_warm.append(latency_s)
 
+    def sdc(self, req_id: str, lane: int, drift: float, envelope: float,
+            action: str) -> None:
+        """One retire-time SDC audit exceedance (ISSUE 14): the lane's
+        carried rnorm and its recomputed true residual disagree past
+        the per-precision envelope. ``action`` is the adjudication step
+        taken — "rollback" (first detection: the lane re-runs from its
+        write-ahead record, the serve layer's durable checkpoint) or
+        "terminal" (detected again on the re-run: deterministic fault,
+        the request answers `failure_class: "sdc"`). The timestamps
+        feed the fleet's windowed lane-quarantine trip."""
+        self._journal({"event": "serve_sdc", "id": req_id,
+                       "lane": int(lane), "drift": float(drift),
+                       "envelope": float(envelope), "action": action})
+        with self._lock:
+            self.sdc_detected += 1
+            if action == "rollback":
+                self.sdc_rollbacks += 1
+            elif action == "terminal":
+                self.sdc_terminal += 1
+            self._sdc_times.append(time.time())
+
+    def sdc_recent(self, window_s: float, now: float | None = None) -> int:
+        """Detections inside the trailing window — the fleet's
+        quarantine-trip input (serve.fleet.quarantine_scan)."""
+        if now is None:
+            now = time.time()
+        with self._lock:
+            return sum(1 for t in self._sdc_times if t >= now - window_s)
+
+    def sdc_reset_window(self) -> None:
+        """Clear the windowed detection timestamps. The fleet calls
+        this at READMISSION: a lane that just passed its self-test must
+        start with a clean window — otherwise the balancer's next scan
+        re-trips it on the pre-quarantine detections still inside the
+        window, silently undoing the readmit. The monotone counters
+        (sdc_detected et al.) are untouched — history is evidence, the
+        window is a control signal."""
+        with self._lock:
+            self._sdc_times.clear()
+
     def retry(self, spec_dict: dict, failure_class: str, attempt: int,
               wait_s: float, resumed: bool) -> None:
         """One broker-internal retry of a retriable-failed batch
@@ -353,6 +399,11 @@ class Metrics:
                 "batch_resumes": self.batch_resumes,
                 "recovery_runs": self.recovery_runs,
                 "recovered_requests": self.recovered_requests,
+                # SDC defense (ISSUE 14): audit exceedances + how each
+                # was adjudicated (rollback re-run vs terminal)
+                "sdc_detected": self.sdc_detected,
+                "sdc_rollbacks": self.sdc_rollbacks,
+                "sdc_terminal": self.sdc_terminal,
             }
         if cache_stats is not None:
             out["cache"] = cache_stats
@@ -408,6 +459,12 @@ class FleetMetrics:
         self.sheds = 0  # fleet-level sheds (every lane at capacity)
         self.adoptions = 0  # standby journal adoptions
         self.adopted_requests = 0
+        # lane quarantine (ISSUE 14): corruption-tripped isolation
+        self.quarantines = 0  # lanes tripped into quarantine
+        self.quarantine_drained = 0  # queued requests drained to peers
+        self.readmits = 0  # lanes readmitted after a passing self-test
+        self.selftests = 0  # known-answer self-tests run
+        self.selftests_failed = 0  # self-tests that kept the lane out
 
     def _journal(self, rec: dict) -> None:
         if self.journal is not None:
@@ -453,6 +510,38 @@ class FleetMetrics:
         with self._lock:
             self.sheds += 1
 
+    def quarantine(self, device: str, drained: int,
+                   window_events: int) -> None:
+        """One lane tripped into quarantine (ISSUE 14): its windowed
+        SDC-detection counter crossed the threshold; `drained` queued
+        requests moved to healthy lanes through the steal/adopt
+        machinery (pure queue moves — the exactly-once ledger never
+        sees them)."""
+        self._journal({"event": "fleet_quarantine", "device": device,
+                       "drained": int(drained),
+                       "window_events": int(window_events)})
+        with self._lock:
+            self.quarantines += 1
+            self.quarantine_drained += int(drained)
+
+    def selftest(self, device: str, req_id: str, ok: bool) -> None:
+        """One known-answer self-test on a quarantined lane (the test
+        request itself rides the normal WAL/response ledger)."""
+        self._journal({"event": "fleet_selftest", "device": device,
+                       "id": req_id, "ok": bool(ok)})
+        with self._lock:
+            self.selftests += 1
+            if not ok:
+                self.selftests_failed += 1
+
+    def readmit(self, device: str, req_id: str) -> None:
+        """A quarantined lane passed its self-test and rejoined the
+        routing pool."""
+        self._journal({"event": "fleet_readmit", "device": device,
+                       "id": req_id})
+        with self._lock:
+            self.readmits += 1
+
     def adopt(self, outstanding: int, routed: int, skipped: int,
               corrupt: int) -> None:
         self._journal({"event": "fleet_adopt",
@@ -478,6 +567,11 @@ class FleetMetrics:
                 "sheds": self.sheds,
                 "adoptions": self.adoptions,
                 "adopted_requests": self.adopted_requests,
+                "quarantines": self.quarantines,
+                "quarantine_drained": self.quarantine_drained,
+                "readmits": self.readmits,
+                "selftests": self.selftests,
+                "selftests_failed": self.selftests_failed,
             }
 
 
@@ -492,10 +586,14 @@ _PROM_COUNTERS = frozenset({
     "padded_lanes_total", "midsolve_admissions",
     "broker_retries", "batch_resumes", "recovery_runs",
     "recovered_requests",
+    # SDC defense (ISSUE 14): detection + adjudication counters
+    "sdc_detected", "sdc_rollbacks", "sdc_terminal",
     # fleet block leaves (flattened as fleet_<leaf>): monotone counters
     "fleet_routed", "fleet_affinity_hits", "fleet_affinity_misses",
     "fleet_steals", "fleet_steal_events", "fleet_spills", "fleet_sheds",
     "fleet_adoptions", "fleet_adopted_requests",
+    "fleet_quarantines", "fleet_quarantine_drained", "fleet_readmits",
+    "fleet_selftests", "fleet_selftests_failed",
 })
 
 
@@ -587,6 +685,11 @@ def replay_serve(journal_path: str) -> dict:
         "live_lane_boundaries": 0, "boundaries_total": 0,
         "broker_retries": 0, "batch_resumes": 0, "recovery_runs": 0,
         "recovered_requests": 0,
+        # SDC defense (ISSUE 14): detections + adjudications + lane
+        # quarantine/readmission evidence
+        "sdc_detected": 0, "sdc_rollbacks": 0, "sdc_terminal": 0,
+        "fleet_quarantines": 0, "fleet_quarantine_drained": 0,
+        "fleet_readmits": 0, "fleet_selftests": 0,
         # fleet events (ISSUE 13): routing/steal/spill/adoption evidence
         "fleet_routed": 0, "fleet_affinity_hits": 0, "fleet_steals": 0,
         "fleet_steal_events": 0, "fleet_spills": 0, "fleet_adoptions": 0,
@@ -651,6 +754,19 @@ def replay_serve(journal_path: str) -> dict:
             out["fleet_steals"] += int(rec.get("count", 0))
         elif ev == "fleet_adopt":
             out["fleet_adoptions"] += 1
+        elif ev == "serve_sdc":
+            out["sdc_detected"] += 1
+            if rec.get("action") == "rollback":
+                out["sdc_rollbacks"] += 1
+            elif rec.get("action") == "terminal":
+                out["sdc_terminal"] += 1
+        elif ev == "fleet_quarantine":
+            out["fleet_quarantines"] += 1
+            out["fleet_quarantine_drained"] += int(rec.get("drained", 0))
+        elif ev == "fleet_readmit":
+            out["fleet_readmits"] += 1
+        elif ev == "fleet_selftest":
+            out["fleet_selftests"] += 1
         elif ev == "serve_response":
             if rec.get("ok"):
                 out["responses_ok"] += 1

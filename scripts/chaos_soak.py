@@ -30,8 +30,17 @@ stack and asserts the recovery invariants:
      compiles, answers every outstanding request, and
      ``verify_exactly_once`` must hold over BOTH generations.
 
-``--legs`` selects a subset (generations,crash,nan,preempt,standby) —
-the CI fleet lane runs ``--legs standby`` next to the loadgen smoke.
+  6. silent data corruption (ISSUE 14) — a scripted bit flip corrupts
+     one lane's iterates mid-serve (``SDC_HOOK``: finite, wrong,
+     invisible to the breakdown sentinel); the retire-time
+     true-residual audit must detect it, the corruption-aware rollback
+     must re-run the lane and answer OK, two windowed detections must
+     QUARANTINE the lane with its queue drained exactly-once to the
+     healthy peer, and a known-answer self-test must readmit it
+     (``serve_sdc``/``fleet_quarantine``/``fleet_readmit`` journaled).
+
+``--legs`` selects a subset (generations,crash,nan,preempt,standby,sdc)
+— the CI fleet lane runs ``--legs standby`` next to the loadgen smoke.
 
 All CPU (``JAX_PLATFORMS=cpu`` is pinned — this is a software-recovery
 proof, not a hardware measurement; snapshot/restore on real HBM stays
@@ -490,6 +499,104 @@ def run_nan_injection(quick: bool) -> int:
     return 0
 
 
+def run_sdc(quick: bool) -> int:
+    """Leg 6 (ISSUE 14): injected silent corruption mid-serve ->
+    retire-time true-residual audit detection -> corruption-aware lane
+    rollback (the re-run answers ok) -> windowed lane quarantine with
+    an exactly-once queue drain -> known-answer self-test readmission.
+    The injected values are FINITE — nothing here trips the breakdown
+    sentinel; only the audit sees it."""
+    _pin_cpu()
+    import bench_tpu_fem.serve.engine as engine_mod
+    from bench_tpu_fem.harness.chaos import install_sdc_hook
+    from bench_tpu_fem.harness.faults import FaultySolveHook, SdcInjectionHook
+    from bench_tpu_fem.harness.journal import read_records
+    from bench_tpu_fem.serve import FleetDispatcher, SolveSpec
+    from bench_tpu_fem.serve.recovery import verify_exactly_once
+
+    tmp = tempfile.mkdtemp(prefix="chaos_sdc_")
+    journal = os.path.join(tmp, "SDC_chaos.jsonl")
+    fleet = FleetDispatcher(2, journal_path=journal, queue_max=64,
+                            nrhs_max=2, window_s=0.02,
+                            solve_timeout_s=120.0, balance_interval_s=0,
+                            audit=True, quarantine_threshold=2,
+                            quarantine_window_s=300.0)
+    spec = SolveSpec(degree=1, ndofs=2000, nreps=12)
+    try:
+        fleet.warmup([spec])  # affinity home: lane 0 (round-robin)
+        # two corruptions on lane 0's device, one per request: each is
+        # detected at retire, rolled back (the lane re-runs from its
+        # write-ahead record) and the re-run answers OK — detection
+        # without rollback would fail these waits
+        hook = SdcInjectionHook(corrupt_at=[2, 8], lane=0)
+        prev = install_sdc_hook(hook)
+        try:
+            o1 = fleet.wait(fleet.submit(spec, 1.0), 180)
+            o2 = fleet.wait(fleet.submit(spec, 2.0), 180)
+        finally:
+            install_sdc_hook(prev)
+        if not (o1.get("ok") and o2.get("ok")):
+            return fail(f"sdc leg: rollback did not recover: {o1} {o2}")
+        if len(hook.fired) != 2:
+            return fail(f"sdc leg: injector fired {hook.fired}, wanted 2")
+        m0 = fleet.lanes[0].metrics
+        if m0.sdc_detected != 2 or m0.sdc_rollbacks != 2:
+            return fail(f"sdc leg: detections {m0.sdc_detected} "
+                        f"rollbacks {m0.sdc_rollbacks}, wanted 2/2")
+        if abs(o2["xnorm"] - 2.0 * o1["xnorm"]) > 1e-5 * abs(o2["xnorm"]):
+            return fail(f"sdc leg: recovered answers broke linearity: "
+                        f"{o1['xnorm']} {o2['xnorm']}")
+        # queue work behind a held lane 0, then trip the quarantine:
+        # the drain must move the queued requests to lane 1 through the
+        # steal/adopt machinery and every one must still answer exactly
+        # once
+        engine_mod.FAULT_HOOK = FaultySolveHook(["hang"], hang_s=1.5)
+        try:
+            pend = [fleet.submit(spec, 1.0)]
+            time.sleep(0.4)  # lane 0's worker entered the held solve
+            pend += [fleet.submit(spec, float(2 ** (i % 3)))
+                     for i in range(4)]
+            tripped = fleet.quarantine_scan()
+            if tripped != 1 or not fleet.lanes[0].quarantined:
+                return fail(f"sdc leg: quarantine did not trip "
+                            f"(tripped={tripped})")
+            outs = [fleet.wait(p, 180) for p in pend]
+        finally:
+            engine_mod.FAULT_HOOK = None
+        if not all(o.get("ok") for o in outs):
+            return fail(f"sdc leg: drained requests lost: {outs}")
+        # fresh traffic routes around the quarantined lane
+        o3 = fleet.wait(fleet.submit(spec, 4.0), 180)
+        if not o3.get("ok"):
+            return fail(f"sdc leg: routing around quarantine failed: {o3}")
+        # known-answer self-test (the injector is exhausted — the lane
+        # is genuinely healthy again) readmits the lane
+        st = fleet.run_selftest(0, spec, expect_xnorm=o1["xnorm"])
+        if not st["ok"] or fleet.lanes[0].quarantined:
+            return fail(f"sdc leg: self-test readmission failed: {st}")
+        snap = fleet.metrics_snapshot()
+    finally:
+        fleet.shutdown()
+    f = snap["fleet"]
+    if f["quarantines"] != 1 or f["readmits"] != 1:
+        return fail(f"sdc leg: quarantine counters wrong: {f}")
+    verdict = verify_exactly_once(journal)
+    if not verdict["ok"]:
+        return fail(f"sdc leg: exactly-once violated across the drain: "
+                    f"lost={verdict['lost']} "
+                    f"duplicates={verdict['duplicates']}")
+    records, _ = read_records(journal)
+    evs = [r.get("event") for r in records]
+    for needed in ("serve_sdc", "fleet_quarantine", "fleet_selftest",
+                   "fleet_readmit"):
+        if needed not in evs:
+            return fail(f"sdc leg: no {needed} record in the journal")
+    drained = [r for r in records if r.get("event") == "fleet_quarantine"]
+    log(f"leg 6 (injected SDC -> detect -> rollback -> quarantine "
+        f"[drained {drained[0].get('drained')}] -> self-test readmit) OK")
+    return 0
+
+
 def run_preemption(quick: bool) -> int:
     """Leg 4: preemption mid-CG — SIGKILL right after a durable
     snapshot, resume, compare BITWISE with the uninterrupted solve."""
@@ -556,7 +663,7 @@ def main(argv=None) -> int:
                    help="bound the soak to ~60 s (the CI chaos lane)")
     p.add_argument("--legs", default="",
                    help="comma-separated subset of "
-                        "generations,crash,nan,preempt,standby "
+                        "generations,crash,nan,preempt,standby,sdc "
                         "(default: all)")
     p.add_argument("--serve-child", type=int, default=0,
                    help=argparse.SUPPRESS)  # internal: generation driver
@@ -573,7 +680,7 @@ def main(argv=None) -> int:
                            args.fleet_child, args.nreq)
     legs = {"generations": run_generations, "crash": run_worker_crash,
             "nan": run_nan_injection, "preempt": run_preemption,
-            "standby": run_standby}
+            "standby": run_standby, "sdc": run_sdc}
     selected = ([s.strip() for s in args.legs.split(",") if s.strip()]
                 or list(legs))
     unknown = [s for s in selected if s not in legs]

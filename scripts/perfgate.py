@@ -29,6 +29,15 @@ Three in-process measurements (no subprocesses, no network):
     warm-load counts, the standby replica's recompile count (== 0, the
     shared-artifact acceptance) and the journal's exactly-once ledger
     (lost/duplicates == 0).
+  * **sdc** (ISSUE 14): detection counters on a deterministic injected
+    bit-flip schedule — the serve retire-time audit at f32/f64/df32
+    (clean lanes audited for false positives, a flipped lane for
+    detection) plus the driver's boundary-audited checkpointed loop
+    (clean run zero detections; injected run detects, rolls back to
+    the durable snapshot and finishes BITWISE equal to the clean run).
+    detected == injected, missed == 0, false_positives == 0 pin in the
+    baseline, and `sdc_detected` sits in the HIGHER table so a
+    suppressed detector gates rc 1.
 
 The counters land in ``snapshot["counters"]`` (the hard gate);
 wall-clock distributions stay inside the per-section ``timing`` blocks
@@ -71,6 +80,11 @@ def main(argv=None) -> int:
 
     force_host_cpu_devices(2)
     import jax
+
+    # x64 on (the test suite's configuration): the sdc leg audits an
+    # f64 serve solver; f32/df32 paths pin their dtypes explicitly and
+    # are unaffected
+    jax.config.update("jax_enable_x64", True)
     import jax.numpy as jnp
 
     from bench_tpu_fem.bench.driver import (
@@ -256,6 +270,68 @@ def main(argv=None) -> int:
         "exactly_once": fleet_ledger,
     }
 
+    # -- sdc leg (ISSUE 14): detection counters on a DETERMINISTIC
+    # injected schedule. Two halves: (1) the serve retire-time audit at
+    # all three servable precisions — solve a clean 2-lane batch, audit
+    # every lane (false positives), then bit-flip lane 0's iterate (the
+    # mercurial-core model, harness.faults) and audit again (detection;
+    # the untouched batch-mate must stay clean); (2) the driver's
+    # boundary-audited checkpointed loop — one clean run (zero
+    # detections over every boundary check) and one CHAOS_SDC-injected
+    # run whose single detection must roll back to the durable snapshot
+    # and finish BITWISE EQUAL to the clean run. detected == injected
+    # and false_positives == 0 gate hard; a suppressed detector is the
+    # worst regression this subsystem can have (the CI lane probes
+    # exactly that).
+    from bench_tpu_fem.harness.faults import SdcInjectionHook
+    from bench_tpu_fem.serve.engine import build_solver
+
+    sdc_injected = sdc_detected = sdc_falsep = 0
+    sdc_serve = {}
+    for precision in ("f32", "f64", "df32"):
+        pspec = SolveSpec(degree=1, ndofs=2000, nreps=12,
+                          precision=precision)
+        solver = build_solver(pspec, bucket=2)
+        st = solver.cont_init([1.0, 2.0])
+        for _ in range(-(-pspec.nreps // solver.iter_chunk)):
+            st = solver.cont_step(st)
+        clean = [solver.audit_lane(st, lane, sc)
+                 for lane, sc in ((0, 1.0), (1, 2.0))]
+        sdc_falsep += sum(1 for v in clean if not v["ok"])
+        hook = SdcInjectionHook(corrupt_at=[0], lane=0)
+        st_bad = hook(pspec, 0, st)
+        sdc_injected += 1
+        bad = solver.audit_lane(st_bad, 0, 1.0)
+        if not bad["ok"]:
+            sdc_detected += 1
+        mate = solver.audit_lane(st_bad, 1, 2.0)
+        sdc_falsep += 0 if mate["ok"] else 1
+        sdc_serve[precision] = {
+            "clean_drift": [v["drift"] for v in clean],
+            "injected_drift": bad["drift"], "envelope": bad["envelope"],
+            "detected": not bad["ok"], "mate_clean": mate["ok"]}
+
+    ck_kw = dict(ndofs_global=args.ndofs, degree=2, qmode=1,
+                 float_bits=32, nreps=args.nreps, use_cg=True,
+                 checkpoint_every=5, sdc_audit=True)
+    clean_ck = run_benchmark(BenchConfig(
+        **ck_kw, checkpoint_dir=args.out + ".ck.clean"))
+    os.environ["CHAOS_SDC"] = f"iter={args.nreps // 2},once=1"
+    try:
+        inj_ck = run_benchmark(BenchConfig(
+            **ck_kw, checkpoint_dir=args.out + ".ck.inj"))
+    finally:
+        del os.environ["CHAOS_SDC"]
+    clean_stamp = clean_ck.extra["sdc"]
+    inj_stamp = inj_ck.extra["sdc"]
+    sdc_falsep += clean_stamp["detections"]
+    sdc_injected += inj_stamp["injected"]
+    sdc_detected += inj_stamp["detections"]
+    sdc_rollback_bitwise = inj_ck.ynorm == clean_ck.ynorm
+    sdc_leg = {"serve": sdc_serve, "driver_clean": clean_stamp,
+               "driver_injected": inj_stamp,
+               "rollback_bitwise": sdc_rollback_bitwise}
+
     # -- trace validity + record contract (contract booleans gate)
     from bench_tpu_fem.obs.trace import validate_chrome_trace
 
@@ -299,6 +375,15 @@ def main(argv=None) -> int:
         "fleet_warm_replica_recompiles": ssnap["cache"]["compiles"],
         "fleet_lost": len(fleet_ledger["lost"]),
         "fleet_duplicates": len(fleet_ledger["duplicates"]),
+        # ISSUE 14 SDC counters: deterministic functions of the pinned
+        # injected schedule (3 serve-audit flips + 1 driver boundary
+        # flip). detected must track injected exactly; missed and
+        # false_positives pin at 0 (LOWER tables), detected in the
+        # HIGHER table so a SUPPRESSED detector gates rc 1.
+        "sdc_injected": sdc_injected,
+        "sdc_detected": sdc_detected,
+        "sdc_missed": sdc_injected - sdc_detected,
+        "sdc_false_positives": sdc_falsep,
     }
     snapshot = {
         "workload": {"ndofs": args.ndofs, "nreps": args.nreps,
@@ -312,6 +397,7 @@ def main(argv=None) -> int:
         "sstep": sstep,
         "serve": serve,
         "fleet": fleet_leg,
+        "sdc": sdc_leg,
         "counters": counters,
         "record_contract_errors": record_errs,
         "trace_violations": trace_violations[:5],
@@ -361,6 +447,22 @@ def main(argv=None) -> int:
         return 1
     if not fleet_ledger["ok"]:
         print(f"fleet exactly-once ledger violated: {fleet_ledger}")
+        return 1
+    # ISSUE-14 acceptance, asserted by the collector itself: every
+    # injected SDC detected, zero false positives on the clean
+    # fixed-seed solves (all three precisions), and the rollback run's
+    # answer BITWISE equal to the uninjected one
+    if sdc_detected != sdc_injected:
+        print(f"sdc leg MISSED injections: detected {sdc_detected} of "
+              f"{sdc_injected}: {sdc_leg}")
+        return 1
+    if sdc_falsep != 0:
+        print(f"sdc leg false positives on clean solves: {sdc_falsep}: "
+              f"{sdc_leg}")
+        return 1
+    if not sdc_rollback_bitwise:
+        print("sdc rollback run diverged from the clean run "
+              f"(ynorm {inj_ck.ynorm!r} vs {clean_ck.ynorm!r})")
         return 1
     return 0
 

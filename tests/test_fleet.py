@@ -395,3 +395,141 @@ def test_warm_cache_counters_and_incompatible_fallback(tmp_path,
     assert cache2.stats()["warm_loads"] == 0
     assert cache2.stats()["compiles"] == 1
     assert st["warm_loads"] == 1  # first cache untouched
+
+
+# ---------------------------------------------------------------------------
+# SDC lane quarantine (ISSUE 14)
+# ---------------------------------------------------------------------------
+
+
+def test_quarantine_trip_drain_exactly_once_and_readmit(tmp_path,
+                                                        published):
+    """The full lane-quarantine machine: two windowed audit detections
+    trip the lane, its QUEUED requests drain to the healthy peer
+    through the steal/adopt machinery (exactly-once: pure queue moves),
+    fresh traffic routes around it, and a passing known-answer
+    self-test readmits it — fleet_quarantine / fleet_selftest /
+    fleet_readmit journaled."""
+    from bench_tpu_fem.harness.faults import SdcInjectionHook
+
+    store, _ = published
+    fleet, journal = _fleet(tmp_path, store, audit=True,
+                            quarantine_threshold=2,
+                            quarantine_window_s=300.0)
+    fleet.warmup([SPEC1])  # affinity home: dev0
+    hook = SdcInjectionHook(corrupt_at=[2, 8], lane=0)
+    prev = engine_mod.SDC_HOOK
+    engine_mod.SDC_HOOK = hook
+    try:
+        o1 = fleet.wait(fleet.submit(SPEC1, 1.0), 60)
+        o2 = fleet.wait(fleet.submit(SPEC1, 2.0), 60)
+    finally:
+        engine_mod.SDC_HOOK = prev
+    # both recovered through rollback; two detections on dev0
+    assert o1["ok"] and o2["ok"]
+    assert fleet.lanes[0].metrics.sdc_detected == 2
+    # hold dev0's worker and queue work behind it, then trip: the
+    # drain must move the queued requests and they must all answer
+    engine_mod.FAULT_HOOK = FaultySolveHook(["hang"], hang_s=1.2)
+    try:
+        pend = [fleet.submit(SPEC1, 1.0)]
+        time.sleep(0.4)
+        pend += [fleet.submit(SPEC1, float(2 ** (i % 3)))
+                 for i in range(4)]
+        assert fleet.quarantine_scan() == 1
+        assert fleet.lanes[0].quarantined
+        outs = [fleet.wait(p, 60) for p in pend]
+    finally:
+        engine_mod.FAULT_HOOK = None
+    assert all(o["ok"] for o in outs), outs
+    # fresh traffic avoids the quarantined lane entirely
+    before = fleet.lanes[1].metrics.requests_total
+    o3 = fleet.wait(fleet.submit(SPEC1, 4.0), 60)
+    assert o3["ok"]
+    assert fleet.lanes[1].metrics.requests_total == before + 1
+    # self-test (injector exhausted: genuinely healthy) readmits
+    st = fleet.run_selftest(0, SPEC1, expect_xnorm=o1["xnorm"])
+    assert st["ok"] and not fleet.lanes[0].quarantined
+    # readmission reset the detection window: the balancer's very next
+    # scan must NOT re-trip the lane on the pre-quarantine detections
+    # (the review-hardened regression — with the balancer thread on,
+    # a stale window silently undid every readmit within one tick)
+    assert fleet.quarantine_scan() == 0
+    assert not fleet.lanes[0].quarantined
+    snap = fleet.metrics_snapshot()
+    fleet.shutdown()
+    f = snap["fleet"]
+    assert f["quarantines"] == 1 and f["readmits"] == 1
+    assert f["quarantine_drained"] == 4 and f["quarantined"] == 0
+    assert verify_exactly_once(journal)["ok"]
+    rep = replay_serve(journal)
+    assert rep["fleet_quarantines"] == 1 and rep["fleet_readmits"] == 1
+    assert rep["fleet_quarantine_drained"] == 4
+    assert rep["sdc_detected"] == 2
+
+
+def test_quarantine_failed_selftest_keeps_lane_out(tmp_path, published):
+    """A self-test that detects corruption AGAIN (the corrupting hook
+    covers the test solve too) keeps the lane quarantined
+    (fleet_selftest ok=false); only a clean pass readmits."""
+    from bench_tpu_fem.harness.faults import SdcInjectionHook
+
+    store, _ = published
+    fleet, journal = _fleet(tmp_path, store, audit=True,
+                            quarantine_threshold=1,
+                            quarantine_window_s=300.0)
+    fleet.warmup([SPEC1])
+    hook = SdcInjectionHook(corrupt_at=[2, 5], lane=0)
+    prev = engine_mod.SDC_HOOK
+    engine_mod.SDC_HOOK = hook
+    try:
+        out = fleet.wait(fleet.submit(SPEC1, 1.0), 60)
+        assert fleet.quarantine_scan() == 1
+        # the bad core is STILL bad during the self-test: detection on
+        # the test solve (and its rollback re-run) fails it
+        hook.corrupt_at.update([8, 11])
+        st1 = fleet.run_selftest(0, SPEC1)
+    finally:
+        engine_mod.SDC_HOOK = prev
+    assert not out["ok"] and out["failure_class"] == "sdc"
+    assert not st1["ok"] and fleet.lanes[0].quarantined
+    # the fault clears; a clean self-test readmits
+    st2 = fleet.run_selftest(0, SPEC1)
+    assert st2["ok"] and not fleet.lanes[0].quarantined
+    snap = fleet.metrics_snapshot()
+    fleet.shutdown()
+    f = snap["fleet"]
+    assert f["selftests"] == 2 and f["selftests_failed"] == 1
+    assert f["readmits"] == 1
+    assert verify_exactly_once(journal)["ok"]
+
+
+def test_every_lane_quarantined_sheds_fleet_level(tmp_path, published):
+    """Routing never targets a quarantined lane; with every lane
+    quarantined the fleet sheds (retriable — degraded, not gone) with
+    the journaled serve_shed BEFORE any WAL record."""
+    store, _ = published
+    fleet, journal = _fleet(tmp_path, store, audit=True,
+                            quarantine_threshold=1)
+    fleet.warmup([SPEC1])
+    for ln in fleet.lanes:
+        ln.quarantined = True
+    with pytest.raises(QueueFull, match="quarantined"):
+        fleet.submit(SPEC1)
+    assert fleet.fleet_metrics.sheds == 1
+    # rebalancing is a no-op across quarantined lanes
+    assert fleet.rebalance_once() == 0
+    fleet.shutdown()
+    assert verify_exactly_once(journal)["ok"]
+
+
+def test_quarantine_disabled_by_default(tmp_path, published):
+    """threshold 0 (the default): the scan never trips, whatever the
+    detection counters say — quarantine is opt-in."""
+    store, _ = published
+    fleet, _ = _fleet(tmp_path, store, audit=True)
+    fleet.lanes[0].metrics.sdc("rX", 0, 1.0, 1e-3, "rollback")
+    fleet.lanes[0].metrics.sdc("rY", 0, 1.0, 1e-3, "rollback")
+    assert fleet.quarantine_scan() == 0
+    assert not fleet.lanes[0].quarantined
+    fleet.shutdown()

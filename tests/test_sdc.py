@@ -1,0 +1,419 @@
+"""SDC defense-in-depth suite (ISSUE 14): the audited CG recurrence
+(la.cg audit= — per-apply ABFT + periodic true-residual checks), the
+bit-flip fault model (ops.abft / harness.faults), the `sdc` taxonomy
+class with its re-run adjudication (harness.classify / harness.policy),
+and the driver's boundary-audited checkpointed loop with
+corruption-aware rollback (bench.driver + CHAOS_SDC).
+
+Standing bitwise contracts (the PR-10/11 routing discipline):
+`audit=None` is the pre-PR solve BIT-FOR-BIT (frozen-replica pin), a
+CLEAN audited solve returns the unaudited x bitwise (the audit
+computations are pure observers), and the injector-off paths run zero
+extra code.
+
+The serve-layer halves (retire-time audit, broker rollback, fleet lane
+quarantine) live in tests/test_serve.py and tests/test_fleet.py.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from bench_tpu_fem.la.cg import CGAudit, SdcInject, cg_solve
+from bench_tpu_fem.ops.abft import (
+    ABFT_ENVELOPE,
+    RESIDUAL_ENVELOPE,
+    checksum_vectors,
+    default_flip_bit,
+    flip_bit,
+)
+
+# ---------------------------------------------------------------------------
+# Self-contained SPD operator: a 1D Laplacian stencil apply — fast to
+# trace, matrix-free, symmetric (the ABFT identity's requirement), with
+# a deterministic RHS. The audit is operator-generic; the real
+# sum-factorized operators are exercised through the driver/serve legs.
+# ---------------------------------------------------------------------------
+
+
+def _problem(n=256, dtype=jnp.float32, seed=0):
+    def apply_A(x):
+        y = 2.0 * x
+        y = y.at[:-1].add(-x[1:])
+        y = y.at[1:].add(-x[:-1])
+        return y.astype(dtype)
+
+    b = jnp.asarray(np.random.default_rng(seed).standard_normal(n), dtype)
+    return apply_A, b
+
+
+# ---------------------------------------------------------------------------
+# audit=None bitwise pin: the frozen pre-ISSUE-14 replica.
+# ---------------------------------------------------------------------------
+
+
+def _frozen_pre_pr_cg_solve(apply_A, b, x0, max_iter):
+    """The pre-ISSUE-14 `la.cg.cg_solve` plain loop, frozen VERBATIM
+    (rtol=0, no sentinel/capture/dot3/precond — the benchmark
+    recurrence). `cg_solve(audit=None)` must reproduce it bit-for-bit."""
+    from bench_tpu_fem.la.vector import inner_product
+
+    dot = inner_product
+    y = apply_A(x0)
+    r = b - y
+    p = r
+    rnorm0 = dot(p, r)
+
+    def body(i, state):
+        x, r, p, rnorm, done = state
+        y = apply_A(p)
+        pdot = dot(p, y)
+        alpha = rnorm / pdot
+        x1 = x + alpha * p
+        r1 = r - alpha * y
+        rnorm_new = dot(r1, r1)
+        beta = rnorm_new / rnorm
+        p1 = beta * p + r1
+        new_done = jnp.logical_or(done, rnorm_new / rnorm0 < 0.0)
+        new_done = jnp.logical_or(
+            new_done, rnorm_new == jnp.zeros((), rnorm_new.dtype))
+        keep = lambda new, old: jnp.where(done, old, new)  # noqa: E731
+        return (keep(x1, x), keep(r1, r), keep(p1, p),
+                keep(rnorm_new, rnorm), new_done)
+
+    state = (x0, r, p, rnorm0, jnp.asarray(False))
+    x, *_ = jax.lax.fori_loop(0, max_iter, body, state)
+    return x
+
+
+def test_audit_none_bitwise_pre_pr_solve():
+    """The routing discipline: `audit=None` is a pure python branch
+    away from the audited body — the default solve is the pre-PR loop
+    BIT-FOR-BIT."""
+    apply_A, b = _problem()
+    x0 = jnp.zeros_like(b)
+    got = jax.jit(lambda b, x0: cg_solve(apply_A, b, x0, 40,
+                                         audit=None))(b, x0)
+    want = jax.jit(lambda b, x0: _frozen_pre_pr_cg_solve(
+        apply_A, b, x0, 40))(b, x0)
+    assert np.array_equal(np.asarray(got), np.asarray(want))
+
+
+# ---------------------------------------------------------------------------
+# clean audited solves: bitwise x, zero detections, envelope headroom.
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.float64])
+def test_audited_clean_solve_bitwise_with_headroom(dtype):
+    """A clean audited solve returns the unaudited x BITWISE (the
+    audit computations are pure observers of the same recurrence), no
+    detection fires, and the measured clean drift sits >= 50x under
+    both envelopes — the zero-false-positive margin the perfgate
+    counters pin."""
+    apply_A, b = _problem(dtype=dtype)
+    x0 = jnp.zeros_like(b)
+    plain = jax.jit(lambda b, x0: cg_solve(apply_A, b, x0, 60))(b, x0)
+    w, aw = checksum_vectors(apply_A, b)
+    aud = CGAudit(every=5, w=w, aw=aw)
+    xa, info = jax.jit(lambda b, x0: cg_solve(apply_A, b, x0, 60,
+                                              audit=aud))(b, x0)
+    assert np.array_equal(np.asarray(plain), np.asarray(xa))
+    assert not bool(info["sdc_detected"])
+    assert int(info["sdc_iter"]) == -1
+    assert int(info["sdc_abft_checks"]) == 60
+    assert int(info["sdc_resid_checks"]) == 12
+    key = "f32" if dtype == jnp.float32 else "f64"
+    assert float(info["sdc_drift_max"]) < RESIDUAL_ENVELOPE[key] / 50
+    assert float(info["sdc_abft_max"]) < ABFT_ENVELOPE[key] / 50
+
+
+def test_audit_composes_with_sentinel_and_capture():
+    """sentinel + capture + audit in one loop: all three info families
+    come back, the capture history matches the plain captured solve's,
+    and nothing detects on a clean problem."""
+    apply_A, b = _problem()
+    x0 = jnp.zeros_like(b)
+    w, aw = checksum_vectors(apply_A, b)
+    aud = CGAudit(every=4, w=w, aw=aw)
+    xa, info = jax.jit(lambda b, x0: cg_solve(
+        apply_A, b, x0, 30, audit=aud, sentinel=True,
+        capture=True))(b, x0)
+    _, plain_info = jax.jit(lambda b, x0: cg_solve(
+        apply_A, b, x0, 30, capture=True))(b, x0)
+    assert not bool(info["sdc_detected"])
+    assert int(info["breakdown_restarts"]) == 0
+    assert not bool(info["nonfinite"])
+    np.testing.assert_array_equal(np.asarray(info["rnorm_history"]),
+                                  np.asarray(plain_info["rnorm_history"]))
+
+
+def test_audit_rejects_dot3_and_precond():
+    apply_A, b = _problem()
+    x0 = jnp.zeros_like(b)
+    aud = CGAudit(every=4)
+    with pytest.raises(ValueError, match="audit"):
+        cg_solve(apply_A, b, x0, 10, audit=aud,
+                 dot3=lambda p, y, r: jnp.zeros((3,), b.dtype))
+    with pytest.raises(ValueError, match="audit"):
+        cg_solve(apply_A, b, x0, 10, audit=aud, precond=lambda r: r)
+
+
+# ---------------------------------------------------------------------------
+# detection: the injected bit flip is caught, the frozen state is the
+# last audited-good iterate, and the threat is real (checks off = the
+# corruption sails through, finite and wrong).
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.float64])
+def test_abft_detects_at_injection_iteration(dtype):
+    """The per-apply ABFT check catches the flip AT the corrupted
+    apply's own iteration (zero detection latency), and the solve
+    freezes at the pre-corruption iterate — finite, consistent with
+    the truncated-budget plain solve."""
+    apply_A, b = _problem(dtype=dtype)
+    x0 = jnp.zeros_like(b)
+    w, aw = checksum_vectors(apply_A, b)
+    aud = CGAudit(every=0, w=w, aw=aw, inject=SdcInject(iteration=12))
+    xi, info = jax.jit(lambda b, x0: cg_solve(apply_A, b, x0, 60,
+                                              audit=aud))(b, x0)
+    assert bool(info["sdc_detected"])
+    assert int(info["sdc_iter"]) == 12
+    xi = np.asarray(xi)
+    assert np.isfinite(xi).all()
+    # frozen at the last audited-good iterate: bitwise the plain solve
+    # truncated at the detection iteration
+    want = jax.jit(lambda b, x0: cg_solve(apply_A, b, x0, 12))(b, x0)
+    assert np.array_equal(xi, np.asarray(want))
+
+
+def test_residual_audit_detects_within_cadence():
+    """Without the per-apply check, the periodic true-residual audit
+    catches the corruption at the next boundary — cadence bounds
+    detection LATENCY, not detection."""
+    apply_A, b = _problem()
+    x0 = jnp.zeros_like(b)
+    aud = CGAudit(every=5, inject=SdcInject(iteration=12))
+    _, info = jax.jit(lambda b, x0: cg_solve(apply_A, b, x0, 60,
+                                             audit=aud))(b, x0)
+    assert bool(info["sdc_detected"])
+    # first boundary at or after the flip: iterations 12..16
+    assert 12 <= int(info["sdc_iter"]) < 17
+    assert float(info["sdc_drift_max"]) > RESIDUAL_ENVELOPE["f32"]
+
+
+def test_unaudited_corruption_sails_through_finite():
+    """The threat model: with every check off, the injected flip ships
+    a FINITE but wrong answer — nothing the breakdown sentinel (or any
+    pre-ISSUE-14 defense) can see. This is why the audit exists."""
+    apply_A, b = _problem()
+    x0 = jnp.zeros_like(b)
+    plain = jax.jit(lambda b, x0: cg_solve(apply_A, b, x0, 60))(b, x0)
+    aud = CGAudit(every=0, inject=SdcInject(iteration=12))
+    xo, info = jax.jit(lambda b, x0: cg_solve(apply_A, b, x0, 60,
+                                              audit=aud))(b, x0)
+    xo = np.asarray(xo)
+    assert np.isfinite(xo).all()
+    assert not np.array_equal(xo, np.asarray(plain))
+    assert not bool(info["sdc_detected"])
+    # and the same solve under sentinel=True ALSO misses it: finite
+    # corruption is invisible to the non-finite guards
+    _, sinfo = jax.jit(lambda b, x0: cg_solve(
+        apply_A, b, x0, 60, audit=CGAudit(
+            every=0, inject=SdcInject(iteration=12)),
+        sentinel=True))(b, x0)
+    assert not bool(sinfo["nonfinite"])
+
+
+# ---------------------------------------------------------------------------
+# the bit-flip fault model itself.
+# ---------------------------------------------------------------------------
+
+
+def test_flip_bit_finite_single_element_involution():
+    """flip_bit: exactly one element changes, stays finite (the
+    default bit is a mid-exponent bit — a 2^±8 scale, never inf), the
+    argmax convention picks the largest element, and flipping twice is
+    the identity (XOR)."""
+    for dtype in (jnp.float32, jnp.float64):
+        y = jnp.asarray(np.random.default_rng(1).standard_normal(64),
+                        dtype)
+        bit = default_flip_bit(dtype)
+        f = jax.jit(lambda y: flip_bit(y, -1, bit))(y)
+        diff = np.asarray(f) != np.asarray(y)
+        assert diff.sum() == 1
+        idx = int(np.argmax(diff))
+        assert idx == int(np.argmax(np.abs(np.asarray(y))))
+        assert np.isfinite(np.asarray(f)).all()
+        ff = jax.jit(lambda y: flip_bit(flip_bit(y, 7, bit), 7, bit))(y)
+        assert np.array_equal(np.asarray(ff), np.asarray(y))
+
+
+def test_flip_host_bit_matches_model():
+    from bench_tpu_fem.harness.faults import flip_host_bit
+
+    a = np.array([0.5, -4.0, 2.0], np.float64)
+    f = flip_host_bit(a)
+    assert np.isfinite(f).all()
+    assert (f != a).sum() == 1 and f[0] == a[0] and f[2] == a[2]
+    # explicit index + bit
+    f2 = flip_host_bit(a, index=0, bit=55)
+    assert f2[0] != a[0] and (f2[1:] == a[1:]).all()
+
+
+# ---------------------------------------------------------------------------
+# taxonomy + adjudication policy.
+# ---------------------------------------------------------------------------
+
+
+def test_sdc_taxonomy_and_classifier_patterns():
+    from bench_tpu_fem.harness.classify import (
+        RETRIABLE_CLASSES,
+        TAXONOMY,
+        classify_exception,
+        classify_text,
+    )
+    from bench_tpu_fem.harness.faults import SDC_TEXT
+
+    assert "sdc" in TAXONOMY
+    # NOT client-retriable: an sdc-classified failure surfaces only
+    # after its rollback re-run adjudicated it deterministic — the one
+    # adjudication retry is owned by policy/broker, not by clients
+    assert "sdc" not in RETRIABLE_CLASSES
+    assert classify_text(SDC_TEXT) == "sdc"
+    assert classify_text("silent data corruption: drift 3e-1") == "sdc"
+    assert classify_text('{"failure_class": "sdc"}') == "sdc"
+    assert classify_text("ABFT check exceeded the envelope") == "sdc"
+    # disjoint from breakdown: non-finite stays breakdown
+    assert classify_text(
+        "non-finite residual norm (nan): CG breakdown") == "breakdown"
+    assert classify_exception(
+        RuntimeError("true-residual audit drift 2.1e-01 > envelope")
+    ) == "sdc"
+
+
+def test_sdc_policy_adjudicates_by_rerun():
+    """One detection -> RETRY (the rollback re-run is the
+    adjudication); a second -> GIVE_UP, deterministic, never retried."""
+    from bench_tpu_fem.harness.policy import GIVE_UP, RETRY, StagePolicy, next_action
+
+    p = StagePolicy()
+    a1 = next_action("sdc", 1, p)
+    assert a1.kind == RETRY and "adjudicat" in a1.reason
+    a2 = next_action("sdc", 2, p)
+    assert a2.kind == GIVE_UP and "deterministic" in a2.reason
+
+
+def test_chaos_sdc_env_plan_parse():
+    from bench_tpu_fem.harness.faults import sdc_env_plan
+
+    assert sdc_env_plan({"CHAOS_SDC": ""}) is None
+    assert sdc_env_plan({}) is None
+    plan = sdc_env_plan({"CHAOS_SDC": "iter=8"})
+    assert plan == {"iteration": 8, "bit": None, "index": -1,
+                    "once": True}
+    plan = sdc_env_plan({"CHAOS_SDC": "iter=3,bit=22,index=5,once=0"})
+    assert plan == {"iteration": 3, "bit": 22, "index": 5, "once": False}
+    with pytest.raises(ValueError, match="iter"):
+        sdc_env_plan({"CHAOS_SDC": "bit=22"})
+
+
+# ---------------------------------------------------------------------------
+# driver: boundary-audited checkpointed loop + corruption-aware rollback.
+# ---------------------------------------------------------------------------
+
+_DRIVER_KW = dict(ndofs_global=4000, degree=2, qmode=1, float_bits=32,
+                  nreps=24, use_cg=True, checkpoint_every=6)
+
+
+def _bench(tmp_path, name, sdc_audit=False, **over):
+    from bench_tpu_fem.bench.driver import BenchConfig, run_benchmark
+
+    kw = {**_DRIVER_KW, **over}
+    return run_benchmark(BenchConfig(
+        **kw, checkpoint_dir=str(tmp_path / name), sdc_audit=sdc_audit))
+
+
+@pytest.mark.slow  # 3 checkpointed compiles ~20 s
+def test_driver_audited_clean_checkpointed_bitwise(tmp_path):
+    """A clean audited checkpointed run equals the unaudited one
+    bitwise and stamps a clean `sdc` evidence block (checks counted,
+    worst drift recorded against the envelope)."""
+    ref = _bench(tmp_path, "ref")
+    clean = _bench(tmp_path, "clean", sdc_audit=True)
+    assert clean.ynorm == ref.ynorm
+    stamp = clean.extra["sdc"]
+    assert stamp["adjudication"] == "clean"
+    assert stamp["detections"] == 0 and stamp["rollbacks"] == 0
+    assert stamp["checks"] == 4  # nreps 24 / every 6
+    assert stamp["drift_max"] < stamp["envelope"] / 50
+    assert stamp["evidence"] == "cpu-measured"
+    # unaudited runs carry no sdc stamp at all (bitwise-off contract
+    # extends to the record schema)
+    assert "sdc" not in ref.extra
+
+
+@pytest.mark.slow  # 2 checkpointed compiles + rollback re-run ~25 s
+def test_driver_rollback_transient_bitwise(tmp_path, monkeypatch):
+    """CHAOS_SDC once-shot flip mid-solve: ONE detection, ONE rollback
+    to the last durable snapshot, and the finished run is BITWISE the
+    uninjected solve — corruption recovered, not laundered."""
+    ref = _bench(tmp_path, "ref")
+    monkeypatch.setenv("CHAOS_SDC", "iter=12,once=1")
+    tr = _bench(tmp_path, "tr", sdc_audit=True)
+    stamp = tr.extra["sdc"]
+    assert stamp["adjudication"] == "transient"
+    assert stamp["injected"] == 1
+    assert stamp["detections"] == 1 and stamp["rollbacks"] == 1
+    assert stamp["restored_iteration"] == 6  # the pre-flip boundary
+    assert tr.ynorm == ref.ynorm
+
+
+@pytest.mark.slow  # timing_reps=2 checkpointed run + reference ~25 s
+def test_driver_independent_reps_adjudicate_fresh(tmp_path, monkeypatch):
+    """Adjudication is per solve ATTEMPT, not per process: two timing
+    reps each hitting their own once-shot transient upset both recover
+    (one detection + one rollback each — never misread as 'detected
+    again' across reps), and a stale completed snapshot from rep 1 is
+    never a rollback target for rep 2 (it would roll the solve FORWARD
+    past nreps). The review-hardened regression."""
+    from bench_tpu_fem.bench.driver import BenchConfig, run_benchmark
+
+    ref = _bench(tmp_path, "ref")
+    monkeypatch.setenv("CHAOS_SDC", "iter=12,once=1")
+    res = run_benchmark(BenchConfig(
+        **_DRIVER_KW, timing_reps=2,
+        checkpoint_dir=str(tmp_path / "reps"), sdc_audit=True))
+    stamp = res.extra["sdc"]
+    assert stamp["adjudication"] == "transient"
+    assert stamp["injected"] == 2  # one per rep (inj_fired is per call)
+    assert stamp["detections"] == 2 and stamp["rollbacks"] == 2
+    assert res.ynorm == ref.ynorm
+
+
+@pytest.mark.slow  # checkpointed compile + 2 detections ~15 s
+def test_driver_deterministic_detection_terminal(tmp_path, monkeypatch):
+    """A flip that REFIRES on the rollback re-run (once=0 — the bad-core
+    model) is detected again and the run goes terminal with the `sdc`
+    classifier signature — never a silently corrupted measurement."""
+    from bench_tpu_fem.harness.classify import classify_exception
+
+    monkeypatch.setenv("CHAOS_SDC", "iter=12,once=0")
+    with pytest.raises(RuntimeError, match="silent data corruption") as ei:
+        _bench(tmp_path, "det", sdc_audit=True)
+    assert classify_exception(ei.value) == "sdc"
+
+
+def test_driver_sdc_gate_reason_without_checkpoint():
+    """sdc_audit without an iteration-boundary loop records WHY it did
+    not run (the recorded-gate discipline), never silently."""
+    from bench_tpu_fem.bench.driver import BenchConfig, run_benchmark
+
+    res = run_benchmark(BenchConfig(
+        ndofs_global=4000, degree=2, qmode=1, float_bits=32, nreps=6,
+        use_cg=True, sdc_audit=True))
+    assert "checkpoint" in res.extra["sdc_gate_reason"]
+    assert "sdc" not in res.extra

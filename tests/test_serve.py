@@ -1319,3 +1319,181 @@ def test_breakdown_sentinel_nan_scale_lane_local(solver_f32_d2):
     assert outs[0]["ok"] and outs[2]["ok"]
     np.testing.assert_allclose(outs[2]["xnorm"], 2.0 * outs[0]["xnorm"],
                                rtol=1e-6)
+
+
+# ---------------------------------------------------------------------------
+# SDC defense (ISSUE 14): retire-time audit + corruption-aware rollback
+# + the df32 lane-isolation extension of PR 9's breakdown tests.
+# ---------------------------------------------------------------------------
+
+
+def test_broker_audit_rollback_recovers(tmp_path, solver_f32_d2):
+    """A finite bit flip in one lane's iterates (the SDC_HOOK seam —
+    invisible to the breakdown sentinel) is caught by the retire-time
+    true-residual audit; the lane rolls back to its write-ahead record
+    (the serve layer's durable checkpoint) and the re-run answers OK —
+    corruption recovered, never laundered into a response."""
+    from bench_tpu_fem.harness.faults import SdcInjectionHook
+
+    metrics = Metrics(str(tmp_path / "SDC_roll.jsonl"))
+    broker = _mini_broker(metrics, audit=True)
+    broker.cache.get_or_build(spec_cache_key(SPECS[1], 4),
+                              lambda: solver_f32_d2)
+    hook = SdcInjectionHook(corrupt_at=[2], lane=0)
+    prev = engine_mod.SDC_HOOK
+    engine_mod.SDC_HOOK = hook
+    try:
+        pend = [broker.submit(SPECS[1], scale=s) for s in (1.0, 2.0)]
+        outs = [broker.wait(p, 60) for p in pend]
+    finally:
+        engine_mod.SDC_HOOK = prev
+        broker.shutdown()
+    assert hook.fired == [2]
+    assert all(o["ok"] for o in outs), outs
+    np.testing.assert_allclose(outs[1]["xnorm"], 2.0 * outs[0]["xnorm"],
+                               rtol=1e-6)
+    assert metrics.sdc_detected == 1 and metrics.sdc_rollbacks == 1
+    assert metrics.sdc_terminal == 0
+    rep = replay_serve(str(tmp_path / "SDC_roll.jsonl"))
+    assert rep["sdc_detected"] == 1 and rep["sdc_rollbacks"] == 1
+    from bench_tpu_fem.serve import verify_exactly_once
+
+    assert verify_exactly_once(str(tmp_path / "SDC_roll.jsonl"))["ok"]
+
+
+def test_broker_audit_terminal_sdc_lane_local(tmp_path, solver_f32_d2):
+    """Corruption detected AGAIN on the rollback re-run (the bad-core
+    model): the lane answers failure_class='sdc', retriable=False —
+    deterministic, distinct from `breakdown` — while its batch-mate
+    retires normally and stays exactly linear."""
+    import math
+
+    from bench_tpu_fem.harness.faults import SdcInjectionHook
+
+    metrics = Metrics(str(tmp_path / "SDC_term.jsonl"))
+    broker = _mini_broker(metrics, audit=True)
+    broker.cache.get_or_build(spec_cache_key(SPECS[1], 4),
+                              lambda: solver_f32_d2)
+    hook = SdcInjectionHook(corrupt_at=[2, 5], lane=0)
+    prev = engine_mod.SDC_HOOK
+    engine_mod.SDC_HOOK = hook
+    try:
+        pend = [broker.submit(SPECS[1], scale=s) for s in (1.0, 2.0)]
+        outs = [broker.wait(p, 60) for p in pend]
+    finally:
+        engine_mod.SDC_HOOK = prev
+        broker.shutdown()
+    poisoned, mate = outs
+    assert not poisoned["ok"]
+    assert poisoned["failure_class"] == "sdc"
+    assert poisoned["retriable"] is False
+    assert "silent data corruption" in poisoned["error"]
+    assert mate["ok"] and math.isfinite(mate["xnorm"])
+    assert metrics.sdc_detected == 2
+    assert metrics.sdc_rollbacks == 1 and metrics.sdc_terminal == 1
+
+
+def test_broker_audit_off_finite_corruption_ships(solver_f32_d2):
+    """The threat model at the serve seam: with the audit OFF (the
+    pre-ISSUE-14 broker), the same finite bit flip ships as ok:true
+    with a wrong norm — silently. This is the hole the audit closes;
+    the assertion documents it so the defense's value stays measured,
+    not assumed."""
+    import math
+
+    from bench_tpu_fem.harness.faults import SdcInjectionHook
+
+    broker = _mini_broker(Metrics())  # audit=False: pre-PR behavior
+    broker.cache.get_or_build(spec_cache_key(SPECS[1], 4),
+                              lambda: solver_f32_d2)
+    hook = SdcInjectionHook(corrupt_at=[2], lane=0)
+    prev = engine_mod.SDC_HOOK
+    engine_mod.SDC_HOOK = hook
+    try:
+        pend = [broker.submit(SPECS[1], scale=s) for s in (1.0, 2.0)]
+        outs = [broker.wait(p, 60) for p in pend]
+    finally:
+        engine_mod.SDC_HOOK = prev
+        broker.shutdown()
+    assert all(o["ok"] for o in outs)  # both "succeed"...
+    assert all(math.isfinite(o["xnorm"]) for o in outs)  # ...finite...
+    # ...but the corrupted lane's answer broke the exact-linearity
+    # contract: finite-but-wrong sailed through
+    assert abs(outs[1]["xnorm"] - 2.0 * outs[0]["xnorm"]) > 1e-3 * abs(
+        outs[1]["xnorm"])
+
+
+@pytest.mark.slow  # df32 compile ~8 s; runs in the serve CI lane
+def test_df32_poisoned_and_sdc_lanes_lane_local(tmp_path):
+    """PR 9's lane-local breakdown isolation extended to the df32
+    continuous-batching path (the ISSUE-14 satellite): in one df32
+    batch, a NaN-poisoned lane answers `breakdown`, an SDC-flagged lane
+    (finite bit flip in the hi channel, detected twice through the df
+    retire audit) answers `sdc`, and the remaining lane retires
+    normally with its df-class linearity intact."""
+    import math
+
+    from bench_tpu_fem.harness.faults import SdcInjectionHook
+
+    spec = SolveSpec(degree=1, ndofs=2000, nreps=12, precision="df32")
+    metrics = Metrics(str(tmp_path / "SDC_df.jsonl"))
+    broker = _mini_broker(metrics, audit=True)
+    # lane 0 = sdc target (corrupted at its retire boundary and again
+    # on the re-run), lane 1 = NaN-poisoned, lane 2 = healthy
+    hook = SdcInjectionHook(corrupt_at=[2, 5], lane=0)
+    prev = engine_mod.SDC_HOOK
+    engine_mod.SDC_HOOK = hook
+    try:
+        pend = [broker.submit(spec, scale=s)
+                for s in (1.0, float("nan"), 2.0)]
+        outs = [broker.wait(p, 120) for p in pend]
+        # a clean reference for the healthy lane's answer
+        ref = broker.wait(broker.submit(spec, scale=1.0), 120)
+    finally:
+        engine_mod.SDC_HOOK = prev
+        broker.shutdown()
+    sdc_lane, nan_lane, healthy = outs
+    assert not sdc_lane["ok"] and sdc_lane["failure_class"] == "sdc"
+    assert sdc_lane["retriable"] is False
+    assert not nan_lane["ok"] and nan_lane["failure_class"] == "breakdown"
+    assert healthy["ok"] and math.isfinite(healthy["xnorm"])
+    assert ref["ok"]
+    np.testing.assert_allclose(healthy["xnorm"], 2.0 * ref["xnorm"],
+                               rtol=1e-12)
+    assert metrics.sdc_detected == 2 and metrics.sdc_terminal == 1
+
+
+def test_artifact_jax_pin_mismatch_exactly_one_rebuild(tmp_path,
+                                                      solver_f32_d2):
+    """The PR 12 remainder, proven (the ISSUE-14 satellite): an
+    artifact whose jax pin mismatches this runtime degrades to exactly
+    ONE counted rebuild — never a crash, never the stale executable,
+    and never a second rebuild (the LRU holds the fresh one)."""
+    from bench_tpu_fem.serve import ArtifactStore, ArtifactWarmCache
+
+    store = ArtifactStore(str(tmp_path / "pins"))
+    art = solver_f32_d2.export_artifact()
+    art["meta"]["jax"] = "9.9.9-not-this-runtime"
+    key = spec_cache_key(SPECS[1], 4)
+    store.put(key, art)
+    cache = ArtifactWarmCache(store, publish=False)
+    built = []
+
+    def builder():
+        built.append(1)
+        return solver_f32_d2
+
+    entry = cache.get_or_build(key, builder)
+    # exactly one rebuild: the mismatched artifact was refused (never
+    # installed — warm_source stays None) and the builder ran once
+    assert built == [1]
+    st = cache.stats()
+    assert st["compiles"] == 1 and st["warm_loads"] == 0
+    assert entry.executable.warm_source is None
+    # the refusal is a MISS-class store read, and the rebuilt solver
+    # actually serves (right answers, not just no crash)
+    r = entry.executable.solve([1.0, 2.0])
+    np.testing.assert_allclose(r.xnorms[1], 2.0 * r.xnorms[0], rtol=1e-6)
+    # a repeat is an LRU hit: still exactly one rebuild ever
+    cache.get_or_build(key, builder)
+    assert built == [1] and cache.stats()["hits"] == 1
