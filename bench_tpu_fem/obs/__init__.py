@@ -36,6 +36,16 @@ number a benchmark, serve or harness run emits can be *attributed*:
     ``perfgate`` lane), and the serve SLO burn-rate fold shared with
     ``serve.metrics``.
 
+``obs.reqtrace`` (ISSUE 15)
+    Request-scoped tracing for the serve fleet: per-request phase
+    decomposition (queue/compile/solve/audit/retry/respond summing to
+    ``latency_s``), bounded exemplar ring (K slowest + every anomalous
+    request, head-sampled normals by deterministic id hash),
+    ``fold_reqtrace`` journal replay with live parity, and the
+    ``python -m bench_tpu_fem.obs reqtrace`` Perfetto timeline render
+    (one track per device lane, phase children, control-plane
+    instants).
+
 ``python -m bench_tpu_fem.obs`` renders a journal + exported trace into
 a report (span tree, timer table, roofline table) and validates the
 trace JSON (rc 1 on schema violations); ``... obs trend`` renders the
@@ -48,6 +58,12 @@ label — a CPU-measured share or an analytic design estimate is never
 presented as a hardware measurement.
 """
 
+from .reqtrace import (  # noqa: F401
+    ExemplarRing,
+    ReqTrace,
+    fold_reqtrace,
+    summarize_phases,
+)
 from .trace import (  # noqa: F401
     BenchObserver,
     Lifecycle,

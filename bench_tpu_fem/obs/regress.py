@@ -355,6 +355,11 @@ LOWER_IS_BETTER_COUNTERS = (
     # missed injection (injected - detected) or a false positive on the
     # clean fixed-seed solves is a detector regression — both pin at 0
     "sdc_missed", "sdc_false_positives",
+    # ISSUE 15 request-trace counters on the pinned serve schedule: an
+    # incomplete trace is a lost phase stamp (the CI probe injects
+    # exactly that), and an anomalous request on the CLEAN pinned
+    # schedule (no injection, no SLO breach) is a serving regression
+    "reqtrace_incomplete", "reqtrace_anomalous",
 )
 #: snapshot keys where a DECREASE below baseline is a regression
 HIGHER_IS_BETTER_COUNTERS = (
@@ -369,9 +374,19 @@ HIGHER_IS_BETTER_COUNTERS = (
     # probe the CI perfgate lane injects), the worst failure mode this
     # subsystem can have
     "sdc_detected",
+    # ISSUE 15: every OK response on the pinned schedule must carry a
+    # complete phase decomposition — a rate below the pinned 1.0 means
+    # a stamp went missing somewhere in the request path
+    "reqtrace_complete_rate",
 )
 #: contract booleans: baseline True -> current must stay True
 CONTRACT_FLAGS = ("record_contract_ok", "trace_valid")
+
+#: counters whose VALUE is timing-derived (advisory — phase-share drift
+#: never gates, per the ISSUE 15 contract) but whose PRESENCE is the
+#: contract: a baseline that measured them and a current that reads
+#: None means tracing silently turned off, which DOES gate.
+MEASURED_ONLY_COUNTERS = ("reqtrace_queue_share_p99",)
 
 
 def comparable_labels(current: dict, baseline: dict) -> bool:
@@ -448,13 +463,26 @@ def gate_counters(current: dict, baseline: dict) -> list[str]:
                     f"{key}: {current[key]} > baseline {baseline[key]}")
     for key in HIGHER_IS_BETTER_COUNTERS:
         if key in baseline and key in current:
-            if float(current[key]) < float(baseline[key]) - 1e-12:
+            if baseline[key] is None:
+                continue  # a baseline that measured nothing cannot gate
+            if current[key] is None:
+                violations.append(
+                    f"{key}: baseline measured {baseline[key]} but "
+                    "current measured nothing (stamp lost)")
+            elif float(current[key]) < float(baseline[key]) - 1e-12:
                 violations.append(
                     f"{key}: {current[key]} < baseline {baseline[key]}")
     for key in CONTRACT_FLAGS:
         if baseline.get(key) is True and current.get(key) is not True:
             violations.append(f"{key}: baseline held the contract, "
                               f"current reads {current.get(key)!r}")
+    for key in MEASURED_ONLY_COUNTERS:
+        if key in baseline and baseline[key] is not None \
+                and key in current and current[key] is None:
+            violations.append(
+                f"{key}: baseline measured {baseline[key]} but current "
+                "measured nothing (request tracing off or stamp lost) "
+                "— the value is advisory, its presence is the contract")
     return violations
 
 

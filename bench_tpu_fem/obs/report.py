@@ -7,6 +7,8 @@
                                 [--slo-objective S] [--json]
     python -m bench_tpu_fem.obs gate --current cur.json
                                 --baseline base.json [--json]
+    python -m bench_tpu_fem.obs reqtrace --journal serve.jsonl
+                                [--out trace.json] [--json]
 
 Sections (text mode, default command):
 
@@ -366,9 +368,16 @@ def trend_main(argv=None) -> int:
         if any(r.get("event") == "serve_response" for r in records):
             slo = fold_slo(records, objective_s=args.slo_objective,
                            target=args.slo_target)
+    reqtrace = None
+    if records and any(r.get("event") == "serve_response"
+                       for r in records):
+        from .reqtrace import fold_reqtrace
+
+        reqtrace = fold_reqtrace(records)
     if args.json:
         out = dict(trend)
         out["slo"] = slo
+        out["reqtrace"] = reqtrace
         # same lookup as render_convergence: the block may ride at top
         # level or nested under `result` (weak-scaling-style records)
         out["convergence_records"] = [
@@ -388,6 +397,19 @@ def trend_main(argv=None) -> int:
         if slo is not None:
             print("== serve SLO")
             print(render_slo(slo))
+        if reqtrace is not None:
+            # serve phase shares next to the SLO block (ISSUE 15): a
+            # journal that predates phase stamps renders as a LABELLED
+            # GAP, never as a zero row (the PR 10 wedge-honesty rule)
+            from .reqtrace import render_phases
+
+            print("== serve phases")
+            if reqtrace.get("status") == "ok":
+                print(render_phases(reqtrace))
+            else:
+                print(f"   GAP [{reqtrace.get('reason', '?')}] — "
+                      "phase shares unavailable for this journal; a "
+                      "missing stamp is a gap, never a zero")
     return 0
 
 
@@ -442,6 +464,10 @@ def main(argv=None) -> int:
         return trend_main(argv[1:])
     if argv and argv[0] == "gate":
         return gate_main(argv[1:])
+    if argv and argv[0] == "reqtrace":
+        from .reqtrace import reqtrace_main
+
+        return reqtrace_main(argv[1:])
     p = argparse.ArgumentParser(
         prog="python -m bench_tpu_fem.obs",
         description="Render a journal + Chrome trace into a report "

@@ -1,0 +1,525 @@
+"""Request-scoped tracing for the serve fleet (ISSUE 15).
+
+PRs 8/10/12/13 made the *solver* and the *history* observable; a served
+request still answered with one opaque ``latency_s``. This module is the
+per-request lifecycle tracer the broker/fleet thread through every
+request when ``reqtrace`` is armed:
+
+``ReqTrace``
+    Monotonic-clock phase accounting for ONE request. The whole request
+    lifetime is partitioned into consecutive half-open intervals by
+    ``cut(phase)`` calls — each cut attributes the time since the
+    previous cut to a named phase — so the phase decomposition sums to
+    the total BY CONSTRUCTION (``queue_s + compile_s + solve_s +
+    audit_s + retry_s + respond_s ≈ latency_s``; the only slack is
+    per-phase rounding). Instant events (steal-moved, SDC rollback,
+    quarantine drain) and routing/occupancy metadata ride along for the
+    exemplar/timeline render.
+
+``ExemplarRing``
+    Bounded tail-based sampling: full traces are kept for the K slowest
+    requests plus EVERY anomalous one (SLO violation, retry, sdc,
+    breakdown, steal-moved, quarantine-drained); normal traffic is
+    head-sampled by a deterministic id hash (``head_sampled`` — crc32,
+    never RNG: the same incident samples the same requests on every
+    replay).
+
+``fold_reqtrace``
+    The offline twin of the live ``/metrics`` ``reqtrace`` block: folds
+    a serve journal's ``serve_response`` phase stamps back into the
+    same per-phase percentiles through the SAME ``summarize_phases``
+    fold, so live and replay cannot diverge (the PR 10 ``fold_slo``
+    discipline). A journal whose responses predate phase stamps is a
+    LABELLED GAP (``status: "gap"``), never a zero row.
+
+``python -m bench_tpu_fem.obs reqtrace``
+    Renders a serve journal as a Perfetto-loadable Chrome trace: one
+    process per device, one track per lane, request slices with their
+    phase children laid end to end, and steal / spill / quarantine /
+    rollback / retry as instant events. The emitted JSON passes
+    ``obs.trace.validate_chrome_trace`` (rc 1 otherwise).
+
+Tracing OFF is the pre-PR code path: no ``ReqTrace`` is allocated, no
+``serve_phase`` record is journaled, no extra fsync or host sync runs.
+Phase data on the wire is ADDITIVE fields on the existing WAL records,
+so ``serve.recovery.fold_outstanding`` / ``verify_exactly_once`` replay
+mixed old/new-schema journals unchanged (pinned by test).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+import zlib
+
+__all__ = [
+    "PHASES", "REQUIRED_OK", "ReqTrace", "ExemplarRing", "head_sampled",
+    "summarize_phases", "fold_reqtrace", "render_phases",
+    "journal_to_chrome", "reqtrace_main", "merge_exemplars",
+]
+
+#: canonical phase order: every request's lifetime partitions into these
+#: (phases that never happened simply carry no segment / read 0.0)
+PHASES = ("queue", "compile", "solve", "audit", "retry", "respond")
+
+#: phases every OK response must have cut at least once — the
+#: trace-complete contract (audit/retry are conditional by design)
+REQUIRED_OK = ("queue", "compile", "solve", "respond")
+
+#: fault-injection seam for the CI incomplete-trace probe: a phase name
+#: here makes every cut() of that phase silently vanish (the time is
+#: lost, the segment unrecorded) — exactly the shape of a lost stamp.
+#: Settable via the REQTRACE_DROP_PHASE env var (read at import) or by
+#: tests monkeypatching the module attribute.
+DROP_PHASE: str | None = os.environ.get("REQTRACE_DROP_PHASE") or None
+
+
+class ReqTrace:
+    """Phase accounting for one request. Thread-safe (cuts come from
+    the submit thread, the batching worker and the disposable solve
+    thread); ``cut`` is rare (~10 per request) so the lock is noise.
+
+    ``t0`` should be the broker's enqueue instant so the trace total
+    and the journaled ``latency_s`` share one origin."""
+
+    __slots__ = ("req_id", "_clock", "t0", "_last", "phase_s",
+                 "timeline", "events", "meta", "retries", "_lock")
+
+    def __init__(self, req_id: str, t0: float | None = None,
+                 clock=time.monotonic):
+        self.req_id = req_id
+        self._clock = clock
+        self.t0 = clock() if t0 is None else float(t0)
+        self._last = self.t0
+        self.phase_s: dict[str, float] = {}
+        self.timeline: list = []  # (phase, start_rel_s, dur_s)
+        self.events: list[dict] = []
+        self.meta: dict = {}
+        self.retries = 0
+        self._lock = threading.Lock()
+
+    def cut(self, phase: str, now: float | None = None) -> float:
+        """Close the open interval, attributing it to ``phase``.
+        Returns the cut instant. Honors the DROP_PHASE probe seam."""
+        if now is None:
+            now = self._clock()
+        with self._lock:
+            dt = max(now - self._last, 0.0)
+            if phase == DROP_PHASE:
+                # the injected lost stamp: time vanishes, segment
+                # unrecorded — breaks BOTH the phase sum and the
+                # completeness contract, which is the point
+                self._last = now
+                return now
+            self.phase_s[phase] = self.phase_s.get(phase, 0.0) + dt
+            self.timeline.append((phase, round(self._last - self.t0, 6),
+                                  round(dt, 6)))
+            self._last = now
+        return now
+
+    def event(self, name: str, **attrs) -> None:
+        """Instant event (steal_moved, sdc_rollback, quarantine_drained,
+        retry ...) at now, relative to the trace origin."""
+        rec = {"name": name, "t_s": round(self._clock() - self.t0, 6)}
+        if attrs:
+            rec.update(attrs)
+        with self._lock:
+            self.events.append(rec)
+
+    def annotate(self, **attrs) -> None:
+        """Merge metadata under the trace lock. Meta writers span
+        threads (the fleet's submit thread stamps the route cause while
+        the lane worker may already be answering), and ``export`` copies
+        the dict under the same lock — unlocked writers could race that
+        copy."""
+        with self._lock:
+            self.meta.update(attrs)
+
+    def annotate_default(self, key: str, value) -> None:
+        """setdefault twin of ``annotate`` (first writer wins)."""
+        with self._lock:
+            self.meta.setdefault(key, value)
+
+    def total_s(self) -> float:
+        with self._lock:
+            return self._last - self.t0
+
+    def decomposition(self) -> dict[str, float]:
+        """``{"<phase>_s": seconds, ..., "total_s": seconds}`` over the
+        phases that recorded at least one segment. Sums to total within
+        per-phase rounding (6 decimals)."""
+        with self._lock:
+            out = {f"{p}_s": round(self.phase_s[p], 6)
+                   for p in PHASES if p in self.phase_s}
+            out["total_s"] = round(self._last - self.t0, 6)
+        return out
+
+    def complete(self) -> bool:
+        """Every REQUIRED_OK phase recorded a segment — the contract an
+        OK response's trace must meet (a dropped stamp fails it)."""
+        with self._lock:
+            return all(p in self.phase_s for p in REQUIRED_OK)
+
+    def export(self) -> dict:
+        """Full exemplar payload (bounded: the timeline is one entry
+        per cut, the events one per instant)."""
+        with self._lock:
+            return {
+                "id": self.req_id,
+                "phase_s": {f"{p}_s": round(self.phase_s[p], 6)
+                            for p in PHASES if p in self.phase_s},
+                "timeline": [list(seg) for seg in self.timeline[:64]],
+                "events": list(self.events[:64]),
+                "meta": dict(self.meta),
+                "retries": self.retries,
+                "complete": all(p in self.phase_s for p in REQUIRED_OK),
+            }
+
+
+def head_sampled(req_id: str, every: int) -> bool:
+    """Deterministic head-sampling verdict for NORMAL traffic: true for
+    ~1/every of the id space, by crc32 — never RNG, so a replayed
+    incident samples exactly the same requests."""
+    if every <= 1:
+        return True
+    return zlib.crc32(str(req_id).encode()) % every == 0
+
+
+class ExemplarRing:
+    """Bounded full-trace retention: the K slowest requests (min-heap
+    by latency), EVERY anomalous request (bounded deque — tail-based
+    sampling), and a head-sampled slice of normal traffic. Anomaly
+    counts are monotone (evidence); the ring is a window (control)."""
+
+    def __init__(self, k_slowest: int = 8, max_anomalous: int = 64,
+                 max_sampled: int = 32, head_every: int = 16):
+        from collections import deque
+
+        self.k_slowest = max(int(k_slowest), 1)
+        self.head_every = max(int(head_every), 1)
+        self._slow: list = []  # (latency, seq, exemplar) min-heap
+        self._anom = deque(maxlen=max(int(max_anomalous), 1))
+        self._sampled = deque(maxlen=max(int(max_sampled), 1))
+        self._seq = 0
+        self.counts: dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    def offer(self, exemplar: dict) -> None:
+        import heapq
+
+        lat = float(exemplar.get("latency_s", 0.0))
+        tags = list(exemplar.get("anomalies") or [])
+        with self._lock:
+            self._seq += 1
+            item = (lat, self._seq, exemplar)
+            if len(self._slow) < self.k_slowest:
+                heapq.heappush(self._slow, item)
+            elif lat > self._slow[0][0]:
+                heapq.heapreplace(self._slow, item)
+            if tags:
+                for t in tags:
+                    self.counts[t] = self.counts.get(t, 0) + 1
+                self._anom.append(exemplar)
+            elif head_sampled(exemplar.get("id", ""), self.head_every):
+                self._sampled.append(exemplar)
+
+    def anomalous_total(self) -> int:
+        with self._lock:
+            return sum(self.counts.values())
+
+    def snapshot(self, limit: int = 16) -> dict:
+        """Bounded JSON-able view for /metrics (the Prometheus
+        flattener skips the lists; the counts ride separately)."""
+        with self._lock:
+            slowest = [e for _, _, e in
+                       sorted(self._slow, reverse=True)][:limit]
+            return {"slowest": slowest,
+                    "anomalous": list(self._anom)[-limit:],
+                    "sampled": list(self._sampled)[-limit:]}
+
+
+def merge_exemplars(snapshots: list[dict], k_slowest: int = 8,
+                    limit: int = 16) -> dict:
+    """Fold per-lane ring snapshots into one fleet view (slowest
+    re-ranked across lanes; anomalous/sampled concatenated, bounded)."""
+    slowest: list[dict] = []
+    anomalous: list[dict] = []
+    sampled: list[dict] = []
+    for snap in snapshots:
+        slowest.extend(snap.get("slowest") or [])
+        anomalous.extend(snap.get("anomalous") or [])
+        sampled.extend(snap.get("sampled") or [])
+    slowest.sort(key=lambda e: -float(
+        (e.get("phase_s") or {}).get("total_s",
+                                     e.get("latency_s", 0.0)) or 0.0))
+    return {"slowest": slowest[:min(k_slowest, limit)],
+            "anomalous": anomalous[-limit:],
+            "sampled": sampled[-limit:]}
+
+
+# --------------------------------------------------------------------------
+# The shared phase fold: live /metrics and the journal replay both run
+# EXACTLY this, which is what makes the parity test structural.
+
+
+def _pct(sorted_vals: list, q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    i = min(len(sorted_vals) - 1, int(q * len(sorted_vals)))
+    return float(sorted_vals[i])
+
+
+def summarize_phases(samples) -> dict:
+    """Fold ``(latency_s, {"<phase>_s": v, ...})`` samples into
+    per-phase percentiles, aggregate shares and the queue-share of the
+    p99 tail. A phase a response never entered contributes 0.0 to that
+    response's column (the decomposition stays a partition)."""
+    samples = [(float(lat), dict(ph or {})) for lat, ph in samples]
+    n = len(samples)
+    out: dict = {"n": n, "phases": {}, "queue_share_p99": None}
+    if not n:
+        return out
+    lats = sorted(lat for lat, _ in samples)
+    total = sum(lats)
+    for p in PHASES:
+        vals = sorted(ph.get(f"{p}_s", 0.0) for _, ph in samples)
+        psum = sum(vals)
+        out["phases"][p] = {
+            "p50_s": round(_pct(vals, 0.50), 6),
+            "p95_s": round(_pct(vals, 0.95), 6),
+            "p99_s": round(_pct(vals, 0.99), 6),
+            "share": round(psum / total, 4) if total > 0 else 0.0,
+        }
+    thr = _pct(lats, 0.99)
+    tail = [(lat, ph) for lat, ph in samples if lat >= thr]
+    tail_total = sum(lat for lat, _ in tail)
+    if tail and tail_total > 0:
+        out["queue_share_p99"] = round(
+            sum(ph.get("queue_s", 0.0) for _, ph in tail) / tail_total, 4)
+    return out
+
+
+def fold_reqtrace(path_or_records) -> dict:
+    """Fold a serve journal back into the live ``reqtrace`` block's
+    story: per-phase percentiles (``summarize_phases`` — the same fold
+    /metrics runs), trace-complete counts, anomaly counts and the
+    queue-share of the p99 tail.
+
+    Old journals (responses without ``phase_s``) return ``status:
+    "gap"`` with a reason — a round that predates phase stamps is a
+    labelled gap, never a zero row (the PR 10 wedge-honesty rule)."""
+    if isinstance(path_or_records, str):
+        from ..harness.journal import read_records
+
+        records, _ = read_records(path_or_records)
+    else:
+        records = list(path_or_records)
+    responses = [r for r in records if r.get("event") == "serve_response"]
+    traced = [r for r in responses if isinstance(r.get("phase_s"), dict)]
+    if not responses:
+        return {"status": "empty", "responses": 0, "traced": 0,
+                "reason": "journal carries no serve_response records"}
+    if not traced:
+        return {"status": "gap", "responses": len(responses), "traced": 0,
+                "reason": "no phase stamps (reqtrace off or journal "
+                          "predates request tracing)"}
+    samples = [(float(r.get("latency_s", 0.0)), r["phase_s"])
+               for r in traced]
+    out = {"status": "ok", "responses": len(responses),
+           "traced": len(traced)}
+    out.update(summarize_phases(samples))
+    complete = sum(1 for r in traced
+                   if r.get("ok") and r.get("trace_complete") is True)
+    incomplete = sum(1 for r in traced
+                     if r.get("ok") and r.get("trace_complete") is False)
+    out["trace_complete"] = complete
+    out["trace_incomplete"] = incomplete
+    judged = complete + incomplete
+    out["trace_complete_rate"] = (round(complete / judged, 6)
+                                  if judged else None)
+    anomalies: dict[str, int] = {}
+    for r in traced:
+        for tag in r.get("anomalies") or []:
+            anomalies[tag] = anomalies.get(tag, 0) + 1
+    out["anomalies"] = anomalies
+    return out
+
+
+def render_phases(fold: dict) -> str:
+    """Text table of a fold (or the live reqtrace block): p50/p95/p99
+    + aggregate share per phase, completeness and anomaly tail."""
+    phases = fold.get("phases") or {}
+    if not phases:
+        return "(no phase-stamped responses)"
+    lines = [f"{'phase':<9s} {'p50 (s)':>10s} {'p95 (s)':>10s} "
+             f"{'p99 (s)':>10s} {'share':>7s}"]
+    for p in PHASES:
+        row = phases.get(p)
+        if row is None:
+            continue
+        lines.append(f"{p:<9s} {row['p50_s']:>10.4f} {row['p95_s']:>10.4f} "
+                     f"{row['p99_s']:>10.4f} {row['share']:>7.3f}")
+    comp = fold.get("trace_complete", 0)
+    incomp = fold.get("trace_incomplete", 0)
+    rate = fold.get("trace_complete_rate")
+    qshare = fold.get("queue_share_p99")
+    lines.append(
+        f"trace-complete {comp}/{comp + incomp}"
+        + (f" (rate {rate})" if rate is not None else "")
+        + (f"  queue-share of p99 tail {qshare}" if qshare is not None
+           else "")
+        + f"  anomalies {fold.get('anomalies') or {}}")
+    return "\n".join(lines)
+
+
+# --------------------------------------------------------------------------
+# Perfetto timeline render: the serve journal's WAL records already
+# carry wall-clock `ts` per event, and every traced response carries its
+# phase decomposition — enough to rebuild the whole incident as one
+# Chrome trace without ever journaling full timelines per request.
+
+#: instant-event names per journal record kind (src/device picks track)
+_INSTANT_EVENTS = {
+    "fleet_steal": "steal",
+    "fleet_spill": "spill",
+    "fleet_quarantine": "quarantine",
+    "fleet_readmit": "readmit",
+    "fleet_selftest": "selftest",
+    "serve_sdc": "sdc",
+    "serve_retry": "retry",
+}
+
+
+def journal_to_chrome(records) -> dict:
+    """Chrome trace-event JSON from a serve journal: one process per
+    device label, one thread per lane, request slices ('X') with phase
+    children laid end to end, control-plane records as instants."""
+    records = [r for r in records if isinstance(r, dict)]
+    responses = [r for r in records
+                 if r.get("event") == "serve_response"
+                 and isinstance(r.get("phase_s"), dict)
+                 and isinstance(r.get("ts"), (int, float))]
+    lane_of: dict[str, int] = {}
+    dev_of: dict[str, str] = {}
+    devices: list[str] = []
+
+    def _dev(label) -> str:
+        label = str(label or "serve")
+        if label not in devices:
+            devices.append(label)
+        return label
+
+    for r in records:
+        if r.get("event") in ("serve_admit", "serve_retire") and r.get("id"):
+            lane_of.setdefault(str(r["id"]), int(r.get("lane", 0)))
+            dev_of.setdefault(str(r["id"]), _dev(r.get("device")))
+    ts_floor = [float(r["ts"]) for r in records
+                if isinstance(r.get("ts"), (int, float))]
+    ts_floor += [float(r["ts"]) - float(r.get("latency_s", 0.0))
+                 for r in responses]
+    epoch = min(ts_floor) if ts_floor else 0.0
+    events: list[dict] = []
+    for r in responses:
+        rid = str(r.get("id"))
+        lat = float(r.get("latency_s", 0.0))
+        dev = _dev(r.get("device") or dev_of.get(rid))
+        pid = devices.index(dev) + 1
+        tid = lane_of.get(rid, 0)
+        t0 = float(r["ts"]) - epoch - lat
+        args = {"id": rid, "ok": bool(r.get("ok")),
+                "cache": r.get("cache"),
+                "trace_complete": r.get("trace_complete")}
+        if r.get("failure_class"):
+            args["failure_class"] = r["failure_class"]
+        if r.get("anomalies"):
+            args["anomalies"] = r["anomalies"]
+        events.append({"name": f"req {rid}", "cat": "reqtrace",
+                       "ph": "X", "ts": round(max(t0, 0.0) * 1e6, 3),
+                       "dur": round(lat * 1e6, 3), "pid": pid,
+                       "tid": tid, "args": args})
+        cursor = max(t0, 0.0)
+        for p in PHASES:
+            dur = float(r["phase_s"].get(f"{p}_s", 0.0))
+            if dur <= 0.0:
+                continue
+            events.append({"name": p, "cat": "reqtrace.phase", "ph": "X",
+                           "ts": round(cursor * 1e6, 3),
+                           "dur": round(dur * 1e6, 3),
+                           "pid": pid, "tid": tid,
+                           "args": {"id": rid, "phase": p}})
+            cursor += dur
+    for r in records:
+        name = _INSTANT_EVENTS.get(r.get("event"))
+        if name is None or not isinstance(r.get("ts"), (int, float)):
+            continue
+        dev = _dev(r.get("src") or r.get("device"))
+        args = {k: v for k, v in r.items()
+                if k in ("id", "ids", "src", "dst", "count", "action",
+                         "failure_class", "drained", "fast_burn",
+                         "attempt", "resumed")}
+        events.append({"name": name, "cat": "reqtrace.event", "ph": "i",
+                       "ts": round(max(float(r["ts"]) - epoch, 0.0) * 1e6,
+                                   3),
+                       "pid": devices.index(dev) + 1, "tid": 0,
+                       "s": "p", "args": args})
+    meta = [{"name": "process_name", "ph": "M", "ts": 0, "pid": i + 1,
+             "tid": 0, "args": {"name": f"device {dev}"}}
+            for i, dev in enumerate(devices)]
+    return {"traceEvents": meta + events, "displayTimeUnit": "ms"}
+
+
+def reqtrace_main(argv=None) -> int:
+    """``python -m bench_tpu_fem.obs reqtrace``: fold + render a serve
+    journal's request traces. rc 1 when the emitted Chrome trace would
+    violate the Perfetto schema (the CI contract)."""
+    import argparse
+
+    p = argparse.ArgumentParser(
+        prog="python -m bench_tpu_fem.obs reqtrace",
+        description="Render a serve journal's request-scoped traces: "
+                    "phase-percentile table + Perfetto timeline (one "
+                    "track per device lane, request slices with phase "
+                    "children, control-plane instants).")
+    p.add_argument("--journal", required=True,
+                   help="serve journal (harness.journal JSONL)")
+    p.add_argument("--out", default="",
+                   help="write the Chrome trace-event JSON here "
+                        "(loads in Perfetto / chrome://tracing)")
+    p.add_argument("--json", action="store_true",
+                   help="emit the fold as one JSON object")
+    args = p.parse_args(argv)
+    from ..harness.journal import read_records
+    from .trace import validate_chrome_trace
+
+    records, corrupt = read_records(args.journal)
+    fold = fold_reqtrace(records)
+    trace = journal_to_chrome(records)
+    violations = validate_chrome_trace(trace)
+    if args.out:
+        with open(args.out, "w") as fh:
+            json.dump(trace, fh)
+    n_req = sum(1 for ev in trace["traceEvents"]
+                if ev.get("cat") == "reqtrace")
+    if args.json:
+        out = dict(fold)
+        out["trace_events"] = len(trace["traceEvents"])
+        out["request_slices"] = n_req
+        out["trace_violations"] = violations[:10]
+        out["corrupt_lines"] = len(corrupt)
+        print(json.dumps(out))
+    else:
+        print("== request phases")
+        if fold.get("status") == "ok":
+            print(render_phases(fold))
+        else:
+            print(f"   {fold.get('status', '?').upper()} "
+                  f"[{fold.get('reason', '')}] — a journal without "
+                  "phase stamps is a labelled gap, never zeros")
+        print(f"== timeline: {n_req} request slices, "
+              f"{len(trace['traceEvents'])} events"
+              + (f" -> {args.out}" if args.out else ""))
+        for v in violations[:10]:
+            print(f"   TRACE VIOLATION {v}")
+    return 1 if violations else 0

@@ -89,6 +89,18 @@ def main(argv=None) -> int:
                         "(0 = never; requires --audit and --fleet)")
     p.add_argument("--quarantine-window", type=float, default=60.0,
                    help="quarantine trip window, seconds")
+    p.add_argument("--reqtrace", action="store_true",
+                   help="request-scoped tracing (ISSUE 15): every "
+                        "response carries a phase decomposition "
+                        "(queue/compile/solve/audit/retry/respond "
+                        "summing to latency_s), /metrics exposes "
+                        "per-phase percentiles + the exemplar ring, "
+                        "and the journal replays the same story "
+                        "(python -m bench_tpu_fem.obs reqtrace). Off "
+                        "(default): no traces, no serve_phase records, "
+                        "no extra fsyncs — only the reqtrace-"
+                        "independent per-(spec,bucket) latency split "
+                        "remains.")
     p.add_argument("--warmup", default="",
                    help="comma-separated degrees to prebuild at startup "
                         "(with --ndofs/--nreps/--precision), e.g. '1,3,6'")
@@ -148,6 +160,7 @@ def main(argv=None) -> int:
             audit=args.audit,
             quarantine_threshold=args.quarantine_threshold,
             quarantine_window_s=args.quarantine_window,
+            reqtrace=args.reqtrace,
         )
     else:
         metrics = Metrics(
@@ -167,6 +180,7 @@ def main(argv=None) -> int:
             solve_timeout_s=args.solve_timeout,
             continuous=not args.no_continuous,
             audit=args.audit,
+            reqtrace=args.reqtrace,
         )
     if args.warmup:
         degrees = [int(d) for d in args.warmup.split(",") if d.strip()]

@@ -52,10 +52,11 @@ from dataclasses import dataclass, field
 # in the taxonomy is deterministic — the ONE split, shared with the
 # stage-retry policy and the chaos invariants (harness.classify owns it).
 from ..harness.classify import RETRIABLE_CLASSES, classify_exception
+from ..obs.reqtrace import ReqTrace
 from ..obs.trace import Lifecycle, span
 from .cache import NRHS_BUCKETS, ExecutableCache, nrhs_bucket
 from .engine import SolveSpec, build_solver, spec_cache_key
-from .metrics import Metrics
+from .metrics import Metrics, spec_latency_key
 
 
 class QueueFull(Exception):
@@ -91,6 +92,10 @@ class PendingRequest:
     # a second detection is the deterministic verdict.
     sdc_retries: int = 0
     lc: Lifecycle = field(default_factory=Lifecycle)
+    # request-scoped phase trace (ISSUE 15): populated ONLY when the
+    # broker was built with reqtrace=True — None is the pre-PR path
+    # (zero allocations, zero clock reads beyond the Lifecycle marks)
+    rt: ReqTrace | None = None
     # claim lock: PER REQUEST, not broker-global — the exactly-once
     # contract only needs responders to the SAME request serialized;
     # a global lock would funnel every response in the broker through
@@ -115,7 +120,7 @@ class Broker:
                  continuous: bool = True, builder=build_solver,
                  retry_max: int = 1, retry_backoff_s: float = 0.05,
                  retry_jitter: float = 0.5, sleep=time.sleep, rng=None,
-                 audit: bool = False):
+                 audit: bool = False, reqtrace: bool = False):
         self.cache = cache or ExecutableCache()
         self.metrics = metrics or Metrics()
         self.queue_max = queue_max
@@ -143,6 +148,14 @@ class Broker:
         # default) is the pre-PR retire path exactly — no extra
         # compiled calls anywhere.
         self.audit = bool(audit)
+        # Request-scoped tracing (ISSUE 15): when armed, every request
+        # carries a ReqTrace whose consecutive cuts partition its
+        # lifetime into queue/compile/solve/audit/retry/respond — the
+        # decomposition rides as ADDITIVE fields on the existing WAL
+        # records (plus one serve_phase record per batch execution).
+        # Off (the default) is the pre-PR code path: no trace object,
+        # no new journal records, no extra fsyncs or host syncs.
+        self.reqtrace = bool(reqtrace)
         self._sleep = sleep
         self._rng = rng or random.Random()
         self._queue: deque[PendingRequest] = deque()
@@ -177,6 +190,10 @@ class Broker:
                 raise QueueFull(
                     f"queue at capacity ({depth}/{self.queue_max})")
             pending = PendingRequest(rid, spec, float(scale), time.monotonic())
+            if self.reqtrace:
+                # the trace origin IS the enqueue instant, so the phase
+                # sum and the journaled latency_s share one origin
+                pending.rt = ReqTrace(rid, t0=pending.enqueued)
             self._queue.append(pending)
             # the write-ahead admitted-request record (ISSUE 9): journaled
             # (fsynced, Journal.append) BEFORE the client gets its future
@@ -284,6 +301,9 @@ class Broker:
         pending = PendingRequest(req["id"], spec,
                                  float(req.get("scale", 1.0)),
                                  time.monotonic())
+        if self.reqtrace:
+            pending.rt = ReqTrace(pending.id, t0=pending.enqueued)
+            pending.rt.annotate(replayed=True)
         with self._cv:
             self._queue.append(pending)
             self._cv.notify_all()
@@ -379,6 +399,8 @@ class Broker:
         cache_hit = self.cache.lookup(key) is not None
         for p in batch:
             p.lc.mark("admit")  # window-seeded members enter the batch
+            if p.rt is not None:
+                p.rt.cut("queue")  # queue wait ends at batch formation
         # `members` grows with mid-solve admissions: the timeout/failure
         # paths below must answer every request the solve ever owned
         # (_respond skips the already-answered ones).
@@ -406,8 +428,33 @@ class Broker:
                         entry = self.cache.get_or_build(
                             key, lambda: self._builder(spec, bucket))
                         solver = entry.executable
+                        if self.reqtrace:
+                            # cache resolution settled: hit (already in
+                            # memory) / artifact-warm (peer AOT load) /
+                            # compile — the serve_phase record is the
+                            # one phase boundary with no WAL record
+                            source = (
+                                "hit" if cache_hit else
+                                "artifact-warm"
+                                if getattr(solver, "warm_source",
+                                           None) == "artifact"
+                                else "compile")
+                            for p in members:
+                                if p.rt is not None and not p.answered:
+                                    p.rt.annotate_default("cache_source",
+                                                          source)
+                            info = getattr(solver, "trace_info", None)
+                            self.metrics.phase_event(
+                                [p.id for p in members], "execute",
+                                cache_source=source, bucket=bucket,
+                                attempt=attempt,
+                                **(info() if callable(info) else {}))
                         for p in members:
                             p.lc.mark("solve")
+                            if p.rt is not None and not p.answered:
+                                # compile/cache-resolution window ends:
+                                # the executable is in hand
+                                p.rt.cut("compile")
                         if self.continuous and getattr(
                                 solver, "supports_continuous", False):
                             box["summary"] = self._solve_continuous(
@@ -433,6 +480,9 @@ class Broker:
                 msg = (f"solve exceeded {self.solve_timeout_s}s "
                        f"(spec {_spec_dict(spec)}); batch abandoned")
                 for p in members:
+                    if p.rt is not None and not p.answered:
+                        # the abandoned wait was spent inside the solve
+                        p.rt.cut("solve")
                     self._respond(p, {
                         "ok": False, "id": p.id, "error": msg,
                         "failure_class": "timeout", "retriable": True})
@@ -479,6 +529,15 @@ class Broker:
                         self.metrics.retry(_spec_dict(spec), cls, attempt,
                                            wait, resumed)
                     self._sleep(wait)
+                    for p in members:
+                        if p.rt is not None and not p.answered:
+                            # the failed attempt + its backoff are the
+                            # retry segment; the next attempt's cache
+                            # re-resolution re-opens compile
+                            p.rt.retries += 1
+                            p.rt.event("retry", failure_class=cls,
+                                       attempt=attempt, resumed=resumed)
+                            p.rt.cut("retry")
                     continue
                 self._fail_batch(members, exc, bucket=bucket,
                                  cache_hit=cache_hit)
@@ -500,6 +559,9 @@ class Broker:
         self.metrics.batch(_spec_dict(spec), live, res.nrhs_bucket,
                            cache_hit, res.wall_s, res.gdof_per_second)
         for lane, p in enumerate(batch):
+            if p.rt is not None and not p.answered:
+                p.rt.cut("solve")
+                p.rt.annotate(lane=lane, batch_mates=live - 1)
             if not math.isfinite(res.xnorms[lane]):
                 # breakdown sentinel, one-shot path (incl. df32): same
                 # contract as the continuous retire check above
@@ -623,11 +685,20 @@ class Broker:
             for lane, p in enumerate(lanes):
                 if p is None or not bool(done[lane]):
                     continue
+                if p.rt is not None:
+                    # the lane's solve occupancy ends at THIS boundary;
+                    # occupancy metadata rides for the exemplar render
+                    p.rt.cut("solve")
+                    p.rt.annotate(lane=lane,
+                                  iters_run=int(iters[lane]),
+                                  batch_mates=live - 1)
                 if self.audit and hasattr(solver, "audit_lane"):
                     try:
                         verdict = solver.audit_lane(state, lane, p.scale)
                     except Exception:
                         verdict = None  # the audit must never sink a solve
+                    if p.rt is not None:
+                        p.rt.cut("audit")  # retire-time audit window
                     if verdict is not None and not verdict["ok"]:
                         action = ("rollback" if p.sdc_retries < 1
                                   else "terminal")
@@ -641,6 +712,12 @@ class Broker:
                             # the re-run IS the transient-vs-
                             # deterministic adjudication. Lane-local:
                             # batch-mates never notice.
+                            if p.rt is not None:
+                                p.rt.event("sdc_rollback", lane=lane,
+                                           drift=verdict["drift"])
+                                # the re-run is a retry segment: solve
+                                # time re-opens after this cut
+                                p.rt.cut("retry")
                             p.sdc_retries += 1
                             state, _ = solver.cont_retire(state, lane)
                             state = solver.cont_admit(state, lane,
@@ -722,6 +799,14 @@ class Broker:
                     lane = free.pop(0)
                     p.lc.mark("admit")
                     p.lc.mark("solve")  # admitted into an in-flight solve
+                    if p.rt is not None:
+                        p.rt.cut("queue")
+                        # the executable is already resolved: the
+                        # compile window of a mid-solve admission is
+                        # the admission itself (~0)
+                        p.rt.cut("compile")
+                        p.rt.annotate_default("cache_source", "hit")
+                        p.rt.annotate(midsolve=True)
                     try:
                         state = solver.cont_admit(state, lane, p.scale)
                     except BaseException:
@@ -766,6 +851,8 @@ class Broker:
                            bucket or nrhs_bucket(len(batch)), cache_hit,
                            0.0, 0.0)
         for p in batch:
+            if p.rt is not None and not p.answered:
+                p.rt.cut("solve")  # the failure landed inside the solve
             self._respond(p, {
                 "ok": False, "id": p.id,
                 "error": f"{type(exc).__name__}: {exc}"[:500],
@@ -792,17 +879,29 @@ class Broker:
             pending.answered = True
             # the lifecycle marks ARE the latency accounting: total and
             # the per-stage breakdown ride on every response/journal line
-            pending.lc.mark("respond")
+            t_resp = pending.lc.mark("respond")
             lifecycle = pending.lc.breakdown()
             result["latency_s"] = latency = lifecycle.get("total_s", 0.0)
             result["lifecycle_s"] = lifecycle
+            phase = exemplar = None
+            if pending.rt is not None:
+                # the final cut closes the partition at the SAME instant
+                # the lifecycle stamps respond, so the phase sum and
+                # latency_s share both endpoints (epsilon = rounding)
+                pending.rt.cut("respond", now=t_resp)
+                phase = pending.rt.decomposition()
+                result["phase_s"] = phase
+                exemplar = pending.rt.export()
             pending.result = result
             self.metrics.response(
                 pending.id, bool(result.get("ok")), latency,
                 failure_class=result.get("failure_class"),
                 retriable=result.get("retriable"),
                 cache=result.get("cache"),
-                lifecycle=lifecycle)
+                lifecycle=lifecycle, phase_s=phase, trace=exemplar,
+                spec_key=spec_latency_key(
+                    _spec_dict(pending.spec),
+                    result.get("nrhs_bucket", 0)))
             pending.done.set()
         return True
 

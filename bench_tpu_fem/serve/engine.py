@@ -465,6 +465,21 @@ class CompiledSolver:
             self.supports_continuous = True
         self.compile_s = time.perf_counter() - t0
 
+    def trace_info(self) -> dict:
+        """Compact solver identity for request tracing (ISSUE 15): the
+        serve_phase journal record and the request exemplars carry this
+        so a trace names the engine that actually ran — achieved form,
+        compile wall, whether the executables came from a peer artifact,
+        and the boundary cadence the solve occupancy is measured in."""
+        return {
+            "engine_form": self.engine_form,
+            "precision": self.spec.precision,
+            "compile_s": round(self.compile_s, 6),
+            "warm_source": self.warm_source,
+            "iter_chunk": self.iter_chunk,
+            "supports_continuous": self.supports_continuous,
+        }
+
     # -- AOT artifact seam (ISSUE 13) ---------------------------------------
 
     def export_artifact(self) -> dict:

@@ -111,7 +111,8 @@ class FleetDispatcher:
                  builder=build_solver,
                  audit: bool = False,
                  quarantine_threshold: int = 0,
-                 quarantine_window_s: float = 60.0):
+                 quarantine_window_s: float = 60.0,
+                 reqtrace: bool = False):
         if ndevices < 1:
             raise ValueError("ndevices must be >= 1")
         self.artifacts = artifacts
@@ -126,6 +127,12 @@ class FleetDispatcher:
         # the lane rejoins only through a passing known-answer
         # self-test (`run_selftest`).
         self.audit = bool(audit)
+        # Request-scoped tracing (ISSUE 15): lane brokers allocate a
+        # ReqTrace per request, the dispatcher stamps the ROUTING CAUSE
+        # (affinity-hit / cold-home / spill) on it, and the control
+        # plane (steal / quarantine drain) marks moved requests with
+        # instant events — the per-request "why was it slow" story.
+        self.reqtrace = bool(reqtrace)
         self.quarantine_threshold = int(quarantine_threshold)
         self.quarantine_window_s = float(quarantine_window_s)
         self.nrhs_max = min(nrhs_max, NRHS_BUCKETS[-1])
@@ -152,7 +159,7 @@ class FleetDispatcher:
                             solve_timeout_s=solve_timeout_s,
                             continuous=continuous,
                             builder=self._lane_builder(devices[i]),
-                            audit=audit)
+                            audit=audit, reqtrace=reqtrace)
             self.lanes.append(DeviceLane(i, label, broker, cache,
                                          metrics, devices[i]))
         # ONE fleet-wide id space (the lanes share a journal, so ids
@@ -272,12 +279,20 @@ class FleetDispatcher:
         # between the probe and here must not flip the journaled flag
         # (the perfgate pins the hit-rate as a hard counter)
         affinity = chosen in affine
+        cause = ("spill" if spill
+                 else "affinity-hit" if affinity else "cold-home")
         pending = chosen.broker.submit(spec, scale, req_id=rid)
+        if pending.rt is not None:
+            # annotate() takes the trace lock: the lane worker may
+            # already be answering this request on another thread
+            pending.rt.annotate(route={"device": chosen.label,
+                                       "cause": cause})
         if spill:
             self.fleet_metrics.spill(rid, spill_from.label,
                                      chosen.label, burn)
         self.fleet_metrics.route(rid, chosen.label, affinity, spill,
-                                 depth(chosen))
+                                 depth(chosen),
+                                 cause=cause if self.reqtrace else None)
         return pending
 
     def wait(self, pending, timeout_s: float | None = None) -> dict:
@@ -332,8 +347,16 @@ class FleetDispatcher:
         stolen = fat.broker.steal_requests((fat_d - thin_d) // 2)
         if not stolen:
             return 0
+        for p in stolen:
+            if getattr(p, "rt", None) is not None:
+                # steal-moved is an anomaly tag (ISSUE 15): the moved
+                # request's full trace is always kept in the exemplar
+                # ring, and the timeline renders the move as an instant
+                p.rt.event("steal_moved", src=fat.label, dst=thin.label)
         thin.broker.adopt_pending(stolen)
-        self.fleet_metrics.steal(fat.label, thin.label, len(stolen))
+        self.fleet_metrics.steal(fat.label, thin.label, len(stolen),
+                                 ids=[p.id for p in stolen]
+                                 if self.reqtrace else None)
         return len(stolen)
 
     # -- SDC lane quarantine (ISSUE 14) ------------------------------------
@@ -374,6 +397,10 @@ class FleetDispatcher:
             if drained:
                 tgt = min(healthy,
                           key=lambda ln: ln.broker.pending_count())
+                for p in drained:
+                    if getattr(p, "rt", None) is not None:
+                        p.rt.event("quarantine_drained",
+                                   src=lane.label, dst=tgt.label)
                 tgt.broker.adopt_pending(drained)
         self.fleet_metrics.quarantine(lane.label, len(drained),
                                       window_events)
@@ -506,6 +533,43 @@ class FleetDispatcher:
         out["latency_p50_s"] = _pct(lat, 0.50)
         out["latency_p95_s"] = _pct(lat, 0.95)
         out["latency_p99_s"] = _pct(lat, 0.99)
+        # per-(spec, bucket) split merged across lanes (ISSUE 15): the
+        # same bounded keys, fleet-wide percentiles
+        by_key: dict[str, list] = {}
+        for ln in self.lanes:
+            for k, v in ln.metrics.latency_key_samples().items():
+                by_key.setdefault(k, []).extend(v)
+        if by_key:
+            out["latency_by_spec"] = {
+                k: {"n": len(sv), "p50_s": _pct(sv, 0.50),
+                    "p95_s": _pct(sv, 0.95), "p99_s": _pct(sv, 0.99)}
+                for k, sv in sorted(
+                    (k, sorted(v)) for k, v in by_key.items())}
+        # fleet-wide request-trace fold (ISSUE 15): lanes' phase windows
+        # merged through the SAME summarize_phases fold the journal
+        # replay runs — the loadgen's phase-share table reads this block
+        trace_samples = [s for ln in self.lanes
+                         for s in ln.metrics.trace_samples()]
+        if trace_samples:
+            from ..obs.reqtrace import merge_exemplars, summarize_phases
+
+            rq = summarize_phases(trace_samples)
+            complete = sum(ln.metrics.trace_complete for ln in self.lanes)
+            incomplete = sum(ln.metrics.trace_incomplete
+                             for ln in self.lanes)
+            judged = complete + incomplete
+            rq["trace_complete"] = complete
+            rq["trace_incomplete"] = incomplete
+            rq["trace_complete_rate"] = (
+                round(complete / judged, 6) if judged else None)
+            anomalies: dict[str, int] = {}
+            for ln in self.lanes:
+                for tag, n in dict(ln.metrics.exemplars.counts).items():
+                    anomalies[tag] = anomalies.get(tag, 0) + n
+            rq["anomalies"] = anomalies
+            rq["exemplars"] = merge_exemplars(
+                [ln.metrics.exemplars.snapshot() for ln in self.lanes])
+            out["reqtrace"] = rq
         fleet = self.fleet_metrics.snapshot()
         fleet["devices"] = len(self.lanes)
         # current quarantine state (a gauge, not a counter: the trip

@@ -65,9 +65,26 @@ import time
 from collections import deque
 
 from ..harness.journal import Journal, read_records
+from ..obs.reqtrace import ExemplarRing, summarize_phases
 
 # Bounded latency window: serving metrics must not grow without bound.
 _LATENCY_WINDOW = 4096
+
+# Per-(spec, bucket) latency split (ISSUE 15 satellite): bounded key
+# count so the /metrics JSON and the Prometheus label cardinality can
+# never grow with the spec space — keys beyond the cap pool into
+# "_other" (still bounded, still honest about existing).
+_SPEC_KEYS_MAX = 16
+
+
+def spec_latency_key(spec_dict: dict, bucket) -> str:
+    """The per-(spec, bucket) latency-split key: compact, deterministic,
+    label-safe. Rides as an ADDITIVE field on serve_response records."""
+    return (f"d{spec_dict.get('degree')}"
+            f":n{spec_dict.get('ndofs')}"
+            f":r{spec_dict.get('nreps')}"
+            f":{spec_dict.get('precision', 'f32')}"
+            f":b{int(bucket or 0)}")
 
 
 class Metrics:
@@ -128,6 +145,20 @@ class Metrics:
         self.sdc_terminal = 0  # detected AGAIN on the re-run: deterministic
         # detection timestamps for the fleet's windowed quarantine trip
         self._sdc_times: deque = deque(maxlen=_LATENCY_WINDOW)
+        # request-scoped tracing (ISSUE 15): per-phase bounded window of
+        # (latency, phase decomposition) samples, trace-completeness
+        # counters and the exemplar ring (K slowest + every anomalous +
+        # deterministic head-sampled normals). All empty/zero until the
+        # first traced response arrives — with tracing off the snapshot
+        # never grows a reqtrace block. (The per-(spec, bucket) latency
+        # split below is DELIBERATELY reqtrace-independent: spec_key is
+        # an additive field on records the broker writes anyway.)
+        self._trace_samples: deque = deque(maxlen=_LATENCY_WINDOW)
+        self.trace_complete = 0
+        self.trace_incomplete = 0
+        self.exemplars = ExemplarRing()
+        # per-(spec, bucket) latency windows (bounded key count)
+        self._lat_by_key: dict[str, deque] = {}
 
     def _journal(self, rec: dict) -> None:
         if self.journal is not None:
@@ -218,7 +249,10 @@ class Metrics:
                  failure_class: str | None = None,
                  retriable: bool | None = None,
                  cache: str | None = None,
-                 lifecycle: dict | None = None) -> None:
+                 lifecycle: dict | None = None,
+                 phase_s: dict | None = None,
+                 trace: dict | None = None,
+                 spec_key: str | None = None) -> None:
         rec = {"event": "serve_response", "id": req_id, "ok": ok,
                "latency_s": round(latency_s, 6)}
         if cache is not None:
@@ -228,6 +262,22 @@ class Metrics:
             # respond deltas, obs.trace.Lifecycle) — queue wait vs solve
             # time attribution per response, replayable from the journal
             rec["lifecycle_s"] = lifecycle
+        if spec_key is not None:
+            # per-(spec, bucket) latency split key (ADDITIVE — old
+            # readers ignore it; replay folds stay exactly-once-safe)
+            rec["spec_key"] = spec_key
+        tags: list[str] = []
+        if phase_s is not None:
+            # the phase decomposition (ISSUE 15): additive fields on the
+            # EXISTING serve_response WAL record — fold_reqtrace rebuilds
+            # the live per-phase percentiles from exactly these
+            rec["phase_s"] = phase_s
+            tags = self._anomaly_tags(ok, latency_s, failure_class,
+                                      phase_s, trace)
+            if tags:
+                rec["anomalies"] = tags
+            if ok and trace is not None:
+                rec["trace_complete"] = bool(trace.get("complete"))
         if not ok:
             rec["failure_class"] = failure_class or "transient"
             rec["retriable"] = bool(retriable)
@@ -244,6 +294,72 @@ class Metrics:
             self._slo_samples.append((time.time(), latency_s, ok))
             if cache == "hit":
                 self.latencies_warm.append(latency_s)
+            if spec_key is not None:
+                win = self._lat_by_key.get(spec_key)
+                if win is None:
+                    if len(self._lat_by_key) >= _SPEC_KEYS_MAX:
+                        spec_key = "_other"  # bounded cardinality
+                    win = self._lat_by_key.setdefault(
+                        spec_key, deque(maxlen=_LATENCY_WINDOW))
+                win.append(latency_s)
+            if phase_s is not None:
+                # the window stores the journal's rounded values so the
+                # live fold and fold_reqtrace see identical samples
+                self._trace_samples.append((round(latency_s, 6), phase_s))
+                if ok and trace is not None:
+                    if trace.get("complete"):
+                        self.trace_complete += 1
+                    else:
+                        self.trace_incomplete += 1
+        if trace is not None:
+            ex = dict(trace)
+            ex["latency_s"] = round(latency_s, 6)
+            ex["ok"] = ok
+            if failure_class:
+                ex["failure_class"] = failure_class
+            ex["anomalies"] = tags
+            if self.device is not None:
+                ex["device"] = self.device
+            self.exemplars.offer(ex)
+
+    def _anomaly_tags(self, ok: bool, latency_s: float,
+                      failure_class: str | None, phase_s: dict,
+                      trace: dict | None) -> list[str]:
+        """The tail-based sampling predicate (ISSUE 15): a response is
+        anomalous when it violated the SLO, retried, hit sdc/breakdown,
+        was steal-moved or quarantine-drained, or failed outright —
+        every such trace is kept in full, never sampled away."""
+        tags: list[str] = []
+        if self.slo_objective_s is not None \
+                and latency_s > self.slo_objective_s:
+            tags.append("slo_violation")
+        events = {e.get("name") for e in (trace or {}).get("events", [])}
+        if phase_s.get("retry_s", 0.0) > 0.0 or "retry" in events \
+                or (trace or {}).get("retries", 0):
+            tags.append("retry")
+        if failure_class == "sdc" or "sdc_rollback" in events:
+            tags.append("sdc")
+        if failure_class == "breakdown":
+            tags.append("breakdown")
+        if "steal_moved" in events:
+            tags.append("steal_moved")
+        if "quarantine_drained" in events:
+            tags.append("quarantine_drained")
+        if not ok and failure_class not in ("sdc", "breakdown"):
+            tags.append("failed")
+        return tags
+
+    def phase_event(self, ids: list, phase: str, **fields) -> None:
+        """One ``serve_phase`` journal record (ISSUE 15): phase
+        boundaries that have NO existing WAL record today (batch
+        execution start with its cache-resolution source). Carries
+        ``ids`` (plural — deliberately NOT ``id``, so the exactly-once
+        ledger folds never see it). Only the reqtrace-armed broker
+        calls this: tracing off journals no serve_phase records (and no
+        phase fields — the off path's only schema delta is the
+        reqtrace-independent spec_key field on serve_response)."""
+        self._journal({"event": "serve_phase", "phase": phase,
+                       "ids": [str(i) for i in ids][:64], **fields})
 
     def sdc(self, req_id: str, lane: int, drift: float, envelope: float,
             action: str) -> None:
@@ -321,6 +437,19 @@ class Metrics:
         snapshot merges lanes' samples for fleet-wide percentiles)."""
         with self._lock:
             return list(self.latencies)
+
+    def trace_samples(self) -> list:
+        """Copy of the bounded (latency, phase decomposition) window —
+        the fleet snapshot merges lanes' samples through the SAME
+        summarize_phases fold the single-broker snapshot runs."""
+        with self._lock:
+            return list(self._trace_samples)
+
+    def latency_key_samples(self) -> dict:
+        """Per-(spec, bucket) latency windows as plain lists (fleet
+        merge input)."""
+        with self._lock:
+            return {k: list(v) for k, v in self._lat_by_key.items()}
 
     def fast_burn_rate(self) -> float:
         """Fast-window SLO burn rate as a CONTROL SIGNAL (ISSUE 13): the
@@ -411,6 +540,35 @@ class Metrics:
             # device-memory telemetry (obs.memory): allocator stats on
             # hardware, labelled process-RSS proxy on CPU
             out["memory"] = memory
+        with self._lock:
+            by_key = {k: sorted(v) for k, v in self._lat_by_key.items()}
+            trace_samples = list(self._trace_samples)
+            trace_complete = self.trace_complete
+            trace_incomplete = self.trace_incomplete
+        if by_key:
+            # per-(spec, bucket) split (ISSUE 15 satellite): one slow
+            # degree-7 spec can no longer hide a degree-1 tail
+            # regression inside the pooled latency_* windows. Bounded
+            # keys (the _other pool), flattened to LABELLED Prometheus
+            # series by prometheus_text.
+            out["latency_by_spec"] = {
+                k: {"n": len(v), "p50_s": _pct(v, 0.50),
+                    "p95_s": _pct(v, 0.95), "p99_s": _pct(v, 0.99)}
+                for k, v in sorted(by_key.items())}
+        if trace_samples or trace_complete or trace_incomplete:
+            # request-scoped tracing (ISSUE 15): per-phase percentiles
+            # via the SAME fold fold_reqtrace runs over the journal —
+            # live and replay cannot diverge. Absent entirely until the
+            # first traced response (tracing-off snapshot unchanged).
+            rq = summarize_phases(trace_samples)
+            judged = trace_complete + trace_incomplete
+            rq["trace_complete"] = trace_complete
+            rq["trace_incomplete"] = trace_incomplete
+            rq["trace_complete_rate"] = (
+                round(trace_complete / judged, 6) if judged else None)
+            rq["anomalies"] = dict(self.exemplars.counts)
+            rq["exemplars"] = self.exemplars.snapshot()
+            out["reqtrace"] = rq
         if self.slo_objective_s is not None:
             # SLO burn-rate state (ISSUE 10): a flat numeric sub-dict,
             # so the Prometheus flattener exposes every field as its
@@ -471,11 +629,17 @@ class FleetMetrics:
             self.journal.append(rec)
 
     def route(self, req_id: str, device: str, affinity: bool,
-              spill: bool, queue_depth: int) -> None:
-        self._journal({"event": "fleet_route", "id": req_id,
-                       "device": device, "affinity": bool(affinity),
-                       "spill": bool(spill),
-                       "queue_depth": int(queue_depth)})
+              spill: bool, queue_depth: int,
+              cause: str | None = None) -> None:
+        rec = {"event": "fleet_route", "id": req_id,
+               "device": device, "affinity": bool(affinity),
+               "spill": bool(spill), "queue_depth": int(queue_depth)}
+        if cause is not None:
+            # routing-decision cause (ISSUE 15, ADDITIVE): affinity-hit
+            # / cold-home / spill — the per-request "why did it land
+            # here" the reqtrace timeline renders
+            rec["cause"] = cause
+        self._journal(rec)
         with self._lock:
             self.routed += 1
             if affinity:
@@ -485,9 +649,17 @@ class FleetMetrics:
             if spill:
                 self.spills += 1
 
-    def steal(self, src: str, dst: str, count: int) -> None:
-        self._journal({"event": "fleet_steal", "src": src, "dst": dst,
-                       "count": int(count)})
+    def steal(self, src: str, dst: str, count: int,
+              ids: list | None = None) -> None:
+        rec = {"event": "fleet_steal", "src": src, "dst": dst,
+               "count": int(count)}
+        if ids:
+            # moved request ids (ISSUE 15, ADDITIVE, bounded): lets the
+            # reqtrace render pin steal instants to the right requests.
+            # Deliberately "ids", never "id": the exactly-once ledger
+            # folds key on "id" and must not see queue moves.
+            rec["ids"] = [str(i) for i in ids][:64]
+        self._journal(rec)
         with self._lock:
             self.steals += int(count)
             self.steal_events += 1
@@ -588,6 +760,8 @@ _PROM_COUNTERS = frozenset({
     "recovered_requests",
     # SDC defense (ISSUE 14): detection + adjudication counters
     "sdc_detected", "sdc_rollbacks", "sdc_terminal",
+    # request tracing (ISSUE 15): completeness counters
+    "reqtrace_trace_complete", "reqtrace_trace_incomplete",
     # fleet block leaves (flattened as fleet_<leaf>): monotone counters
     "fleet_routed", "fleet_affinity_hits", "fleet_affinity_misses",
     "fleet_steals", "fleet_steal_events", "fleet_spills", "fleet_sheds",
@@ -595,6 +769,14 @@ _PROM_COUNTERS = frozenset({
     "fleet_quarantines", "fleet_quarantine_drained", "fleet_readmits",
     "fleet_selftests", "fleet_selftests_failed",
 })
+
+#: flattened-name prefixes that are monotone counters (dynamic leaves:
+#: the anomaly tag set is small and fixed, but spelled per tag)
+_PROM_COUNTER_PREFIXES = ("reqtrace_anomalies_",)
+
+#: how deep the flattener follows nested dicts (reqtrace -> phases ->
+#: queue -> p50_s is depth 4; anything deeper is a schema smell)
+_PROM_MAX_DEPTH = 4
 
 
 def _prom_name(key: str) -> str:
@@ -613,45 +795,49 @@ def prometheus_text(snapshot: dict) -> str:
     """Render a metrics snapshot as Prometheus text exposition format
     (version 0.0.4 — what a standard scrape expects): one
     ``# HELP``/``# TYPE`` header per metric, ``benchfem_serve_``-prefixed
-    names, labelled series for the per-class failure counts, and the
-    cache/memory sub-dicts flattened. Non-numeric leaves (e.g. the
-    memory source label) become ``_info``-style labelled gauges."""
+    names, labelled series for the per-class failure counts and the
+    per-(spec, bucket) latency split, and nested sub-dicts (cache,
+    memory, fleet, reqtrace — including reqtrace.phases.<phase>.<q>)
+    flattened recursively into underscore-joined gauge names.
+
+    Cardinality is bounded by construction: the phase set is fixed, the
+    anomaly tag set is fixed, spec keys are capped (_SPEC_KEYS_MAX +
+    "_other") and ride as LABELS of a fixed series family, lists (the
+    exemplar ring, the per-lane array) are never emitted, and
+    non-numeric leaves collapse into one ``_info`` labelled gauge."""
     lines: list[str] = []
 
     def emit(key: str, value) -> None:
         name = _prom_name(key)
-        kind = "counter" if key in _PROM_COUNTERS else "gauge"
+        kind = ("counter" if key in _PROM_COUNTERS
+                or key.startswith(_PROM_COUNTER_PREFIXES) else "gauge")
         lines.append(f"# HELP {name} serve metrics snapshot field "
                      f"{key!r}")
         lines.append(f"# TYPE {name} {kind}")
         lines.append(f"{name} {float(value):g}")
 
-    for key, value in snapshot.items():
+    def emit_labelled(key: str, label: str, rows: dict, help_text: str,
+                      kind: str = "gauge") -> None:
+        name = _prom_name(key)
+        lines.append(f"# HELP {name} {help_text}")
+        lines.append(f"# TYPE {name} {kind}")
+        for lv, v in sorted(rows.items()):
+            lines.append(f'{name}{{{label}="{_prom_escape(lv)}"}} '
+                         f"{float(v):g}")
+
+    def emit_tree(key: str, value, depth: int) -> None:
         if isinstance(value, bool):
             emit(key, int(value))
         elif isinstance(value, (int, float)):
             emit(key, value)
-        elif key == "failed_by_class" and isinstance(value, dict):
-            name = _prom_name("failed_by_class")
-            lines.append(f"# HELP {name} failed responses by harness "
-                         "failure class")
-            lines.append(f"# TYPE {name} counter")
-            for fc, n in sorted(value.items()):
-                lines.append(
-                    f'{name}{{failure_class="{_prom_escape(fc)}"}} '
-                    f"{float(n):g}")
-        elif isinstance(value, dict):
-            # cache/memory sub-dicts: numeric leaves flatten to
-            # <prefix><key>_<leaf>; string leaves become one labelled
-            # info gauge
+        elif isinstance(value, dict) and depth < _PROM_MAX_DEPTH:
             info = {}
             for leaf, lv in value.items():
-                if isinstance(lv, bool):
-                    emit(f"{key}_{leaf}", int(lv))
-                elif isinstance(lv, (int, float)):
-                    emit(f"{key}_{leaf}", lv)
-                else:
+                if isinstance(lv, (bool, int, float, dict)):
+                    emit_tree(f"{key}_{leaf}", lv, depth + 1)
+                elif isinstance(lv, str):
                     info[leaf] = lv
+                # lists / None: JSON-only (exemplars, quarantined_lanes)
             if info:
                 name = _prom_name(f"{key}_info")
                 lab = ",".join(f'{k}="{_prom_escape(v)}"'
@@ -659,6 +845,29 @@ def prometheus_text(snapshot: dict) -> str:
                 lines.append(f"# HELP {name} non-numeric {key} fields")
                 lines.append(f"# TYPE {name} gauge")
                 lines.append(f"{name}{{{lab}}} 1")
+
+    for key, value in snapshot.items():
+        if key == "failed_by_class" and isinstance(value, dict):
+            emit_labelled("failed_by_class", "failure_class", value,
+                          "failed responses by harness failure class",
+                          kind="counter")
+        elif key == "latency_by_spec" and isinstance(value, dict):
+            # per-(spec, bucket) percentiles as LABELLED series: the
+            # spec key is a label value, never a metric name, so the
+            # metric-name space stays fixed and the label cardinality
+            # is bounded by the window's key cap
+            for q in ("n", "p50_s", "p95_s", "p99_s"):
+                emit_labelled(
+                    f"latency_by_spec_{q}", "spec",
+                    {k: row.get(q, 0.0) for k, row in value.items()},
+                    f"per-(spec,bucket) response latency {q} "
+                    "(bounded key set; overflow pools into _other)")
+        elif key == "reqtrace" and isinstance(value, dict):
+            emit_tree("reqtrace",
+                      {k: v for k, v in value.items()
+                       if k != "exemplars"}, 0)
+        else:
+            emit_tree(key, value, 0)
     return "\n".join(lines) + "\n"
 
 
@@ -694,6 +903,10 @@ def replay_serve(journal_path: str) -> dict:
         "fleet_routed": 0, "fleet_affinity_hits": 0, "fleet_steals": 0,
         "fleet_steal_events": 0, "fleet_spills": 0, "fleet_adoptions": 0,
         "requests_by_device": {},
+        # request tracing (ISSUE 15): serve_phase records + responses
+        # carrying a phase decomposition (fold_reqtrace owns the full
+        # percentile fold; these are the incident-summary counts)
+        "phase_events": 0, "traced_responses": 0,
     }
     warm_lat: list[float] = []
     occupancy: list[dict] = []  # (seq, iter, live) — occupancy over time
@@ -767,7 +980,11 @@ def replay_serve(journal_path: str) -> dict:
             out["fleet_readmits"] += 1
         elif ev == "fleet_selftest":
             out["fleet_selftests"] += 1
+        elif ev == "serve_phase":
+            out["phase_events"] += 1
         elif ev == "serve_response":
+            if isinstance(rec.get("phase_s"), dict):
+                out["traced_responses"] += 1
             if rec.get("ok"):
                 out["responses_ok"] += 1
                 if rec.get("cache") == "hit":

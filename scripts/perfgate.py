@@ -19,10 +19,14 @@ Three in-process measurements (no subprocesses, no network):
     ``collectives_per_iter`` counts (the overlapped-CG one-psum
     contract's counter: noise-free, gates hard) and a second timing
     distribution.
-  * **serve**: an in-process broker round (warmup + ramped requests) —
-    contributes compile counts, request-weighted cache hit-rate,
-    shed/failed counts and the SLO burn-rate state from the journaled
-    request lifecycles.
+  * **serve**: an in-process broker round (warmup + ramped requests,
+    request tracing armed) — contributes compile counts,
+    request-weighted cache hit-rate, shed/failed counts, the SLO
+    burn-rate state from the journaled request lifecycles, and the
+    ISSUE-15 reqtrace counters (trace-complete rate pinned 1.0,
+    anomaly count pinned 0, queue-share-of-p99 presence-gated with an
+    advisory value) with the live /metrics block asserted EQUAL to the
+    journal's fold_reqtrace replay.
   * **fleet** (ISSUE 13): a 2-lane fleet with a shared artifact store
     on a PINNED hang-and-rebalance schedule — contributes the
     deterministic steal count, routing-weighted affinity hit-rate,
@@ -171,8 +175,11 @@ def main(argv=None) -> int:
         pass
     cache = ExecutableCache()
     metrics = Metrics(journal_path, slo_objective_s=args.slo_objective)
+    # reqtrace armed (ISSUE 15): the pinned schedule's trace-complete
+    # rate, anomaly count and queue-share-of-p99 join the gated
+    # counters, and the journal fold must reproduce the live block
     broker = Broker(cache, metrics, queue_max=64, nrhs_max=4,
-                    window_s=0.05)
+                    window_s=0.05, reqtrace=True)
     spec = SolveSpec(degree=3, ndofs=4000, nreps=30)
     broker.warmup([spec])
     compiles_after_warmup = cache.stats()["compiles"]
@@ -196,10 +203,16 @@ def main(argv=None) -> int:
     from bench_tpu_fem.harness.journal import read_records
 
     records, corrupt = read_records(journal_path)
+    from bench_tpu_fem.obs.reqtrace import fold_reqtrace
+
+    rq_live = snap.get("reqtrace") or {}
+    rq_fold = fold_reqtrace(records)
     serve = {
         "ok_responses": sum(1 for r in results if r.get("ok")),
         "metrics": snap,
         "slo": fold_slo(records, objective_s=args.slo_objective),
+        "reqtrace_fold": {k: v for k, v in rq_fold.items()
+                          if k != "exemplars"},
         "corrupt_lines": len(corrupt),
     }
 
@@ -359,6 +372,24 @@ def main(argv=None) -> int:
         "responses_failed": snap["failed"],
         "completed": snap["completed"],
         "corrupt_lines": len(corrupt),
+        # ISSUE 15 request-trace counters on the pinned serve schedule:
+        # completeness and the anomaly count are DETERMINISTIC (every OK
+        # response must stamp all four required phases; the clean
+        # schedule injects nothing, breaches nothing) and gate hard.
+        # queue_share_p99 is timing-derived: its VALUE stays advisory
+        # (never gated), its PRESENCE is the contract (tracing on) —
+        # obs.regress.MEASURED_ONLY_COUNTERS.
+        "reqtrace_complete_rate": rq_live.get("trace_complete_rate"),
+        "reqtrace_incomplete": rq_live.get("trace_incomplete"),
+        # slo_violation is EXCLUDED from the gated sum: it is the one
+        # timing-derived tag (latency vs the objective on a shared CI
+        # host), and timing never gates. The deterministic tags (retry,
+        # sdc, breakdown, steal_moved, quarantine_drained, failed) pin
+        # at 0 on this clean uninjected schedule.
+        "reqtrace_anomalous": sum(
+            n for tag, n in (rq_live.get("anomalies") or {}).items()
+            if tag != "slo_violation"),
+        "reqtrace_queue_share_p99": rq_live.get("queue_share_p99"),
         "record_contract_ok": not record_errs,
         "trace_valid": not trace_violations,
         # ISSUE 13 fleet counters: deterministic functions of the
@@ -416,6 +447,19 @@ def main(argv=None) -> int:
         print(f"serve leg lost requests: {serve['ok_responses']}"
               f"/{args.requests}")
         return 1
+    # ISSUE-15 acceptance, asserted by the collector itself: every
+    # response carries a complete decomposition, and the journal fold
+    # reproduces the live /metrics reqtrace block EXACTLY (live-vs-
+    # replay parity — both sides run obs.reqtrace.summarize_phases)
+    if rq_live.get("trace_complete_rate") != 1.0:
+        print(f"reqtrace leg incomplete traces: {rq_live}")
+        return 1
+    for key in ("phases", "trace_complete", "trace_incomplete",
+                "trace_complete_rate", "queue_share_p99", "anomalies"):
+        if rq_fold.get(key) != rq_live.get(key):
+            print(f"reqtrace live-vs-replay parity broken on {key!r}: "
+                  f"live {rq_live.get(key)} vs fold {rq_fold.get(key)}")
+            return 1
     # ISSUE-11 acceptance, asserted by the collector itself: both
     # precond arms must CROSS 1e-6, Jacobi strictly below bare, and the
     # sharded s-step loop strictly below one reduction per iteration

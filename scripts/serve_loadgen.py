@@ -83,12 +83,64 @@ def _pct(vals, q):
             if vals else 0.0)
 
 
+#: phase order of the server's decomposition (obs.reqtrace.PHASES —
+#: re-spelled here because the loadgen must stay stdlib-standalone)
+PHASES = ("queue", "compile", "solve", "audit", "retry", "respond")
+
+#: |sum(phases) - latency_s| tolerance: the server rounds each phase to
+#: a microsecond, so six phases bound the honest slack well under this
+PHASE_SUM_EPS_S = 2e-3
+
+
+#: stamps every OK response's decomposition must carry (a dropped stamp
+#: whose phase happened to be cheap would otherwise slip under eps)
+REQUIRED_PHASES_OK = ("queue_s", "compile_s", "solve_s", "respond_s")
+
+
+def check_phase_sum(resp: dict, eps_s: float = PHASE_SUM_EPS_S):
+    """Per-response decomposition check (--assert-phase-sum): the phase
+    fields must sum to latency_s within eps, and an OK response must
+    carry every canonical stamp (queue/compile/solve/respond — a LOST
+    stamp is a violation even when the lost time is under eps). Returns
+    None when the response is consistent, an error string otherwise; a
+    response with NO phase_s returns "untraced" (the caller decides
+    whether that is a failure — with the assert armed, it is)."""
+    ph = resp.get("phase_s")
+    if not isinstance(ph, dict):
+        return "untraced"
+    lat = resp.get("latency_s")
+    if not isinstance(lat, (int, float)):
+        return "response carries phase_s but no latency_s"
+    if resp.get("ok"):
+        missing = [k for k in REQUIRED_PHASES_OK if k not in ph]
+        if missing:
+            return f"decomposition missing stamp(s) {missing} in {ph}"
+    total = sum(v for k, v in ph.items()
+                if k != "total_s" and isinstance(v, (int, float)))
+    if abs(total - lat) > eps_s:
+        return (f"phase sum {total:.6f}s != latency {lat:.6f}s "
+                f"(|diff| {abs(total - lat):.6f} > eps {eps_s}) in {ph}")
+    return None
+
+
 def _record_response(out: dict, code: int, resp: dict,
                      elapsed_s: float) -> None:
     """Shared per-response bookkeeping (caller holds the lock):
     completed/failed counts, engine-form histogram, client + server
-    latency samples, cache hits."""
+    latency samples, cache hits, phase-decomposition audit."""
     out["latency_s"].append(round(elapsed_s, 4))
+    verdict = check_phase_sum(resp)
+    if verdict == "untraced":
+        out["untraced_responses"] += 1
+    elif verdict is None:
+        out["traced_responses"] += 1
+    else:
+        out["traced_responses"] += 1
+        if len(out["phase_sum_violations"]) < 16:
+            out["phase_sum_violations"].append(
+                f"{resp.get('id', '?')}: {verdict}")
+        else:
+            out["phase_sum_violations_truncated"] = True
     if code == 200 and resp.get("ok"):
         out["completed"] += 1
         form = resp.get("cg_engine_form", "unknown")
@@ -148,7 +200,9 @@ def run_load(url: str, requests: int = 64, concurrency: int = 16,
     lock = threading.Lock()
     out = {"completed": 0, "failed": 0, "shed_retried": 0,
            "failed_by_class": {}, "engine_forms": {}, "latency_s": [],
-           "server_latency_s": [], "cache_hits": 0}
+           "server_latency_s": [], "cache_hits": 0,
+           "traced_responses": 0, "untraced_responses": 0,
+           "phase_sum_violations": []}
     sem = threading.Semaphore(concurrency)
 
     def fire(i: int):
@@ -201,7 +255,9 @@ def run_fleet_load(url: str, requests: int = 640, concurrency: int = 32,
     lock = threading.Lock()
     out = {"completed": 0, "failed": 0, "shed_retried": 0,
            "failed_by_class": {}, "engine_forms": {}, "latency_s": [],
-           "server_latency_s": [], "cache_hits": 0}
+           "server_latency_s": [], "cache_hits": 0,
+           "traced_responses": 0, "untraced_responses": 0,
+           "phase_sum_violations": []}
     counter = {"next": 0}
 
     def worker():
@@ -324,6 +380,36 @@ def check_journal_continuous(journal_path: str) -> dict:
             "corrupt_lines": corrupt}
 
 
+def render_phase_table(metrics: dict) -> str:
+    """Phase-share table (p50/p95/p99 per phase) from the server's
+    /metrics ``reqtrace`` block (single broker and fleet snapshots both
+    expose it at top level; fleet merges its lanes). Returns "" when the
+    server is not tracing — the caller prints nothing rather than
+    zeros."""
+    rq = (metrics or {}).get("reqtrace") or {}
+    phases = rq.get("phases") or {}
+    if not phases:
+        return ""
+    lines = [f"{'phase':<9s} {'p50 (s)':>10s} {'p95 (s)':>10s} "
+             f"{'p99 (s)':>10s} {'share':>7s}"]
+    for p in PHASES:
+        row = phases.get(p)
+        if not isinstance(row, dict):
+            continue
+        lines.append(
+            f"{p:<9s} {row.get('p50_s', 0.0):>10.4f} "
+            f"{row.get('p95_s', 0.0):>10.4f} "
+            f"{row.get('p99_s', 0.0):>10.4f} "
+            f"{row.get('share', 0.0):>7.3f}")
+    comp = rq.get("trace_complete", 0)
+    incomp = rq.get("trace_incomplete", 0)
+    lines.append(f"trace-complete {comp}/{comp + incomp} "
+                 f"(rate {rq.get('trace_complete_rate')})  "
+                 f"queue-share of p99 tail {rq.get('queue_share_p99')}  "
+                 f"anomalies {rq.get('anomalies') or {}}")
+    return "\n".join(lines)
+
+
 def check_latency_consistency(summary: dict,
                               slack_s: float = 0.05) -> str:
     """Client percentiles vs the server's own per-response spans for the
@@ -408,6 +494,11 @@ def main(argv=None) -> int:
     p.add_argument("--expect-fused", action="store_true",
                    help="fail unless every 200 response carried a "
                         "fused (non-'unfused') cg_engine_form")
+    p.add_argument("--assert-phase-sum", action="store_true",
+                   help="fail unless every response carried a phase "
+                        "decomposition (server run with --reqtrace) "
+                        "summing to latency_s within epsilon "
+                        f"({PHASE_SUM_EPS_S}s)")
     p.add_argument("--assert-latency", action="store_true",
                    help="fail unless each client-side latency "
                         "percentile dominates the matching percentile "
@@ -491,11 +582,34 @@ def main(argv=None) -> int:
             rc = 1
         else:
             summary["expect_fused"] = "ok"
+    if args.assert_phase_sum:
+        bad = summary.get("phase_sum_violations") or []
+        untraced = summary.get("untraced_responses", 0)
+        if bad:
+            summary["assert_phase_sum"] = (
+                f"FAIL: {len(bad)} decomposition(s) do not sum to "
+                f"latency: {bad[:4]}")
+            rc = 1
+        elif untraced or not summary.get("traced_responses"):
+            summary["assert_phase_sum"] = (
+                f"FAIL: {untraced} response(s) carried no phase_s "
+                "(server not running --reqtrace, or stamps lost)")
+            rc = 1
+        else:
+            summary["assert_phase_sum"] = "ok"
     if args.assert_latency:
         verdict = check_latency_consistency(summary)
         summary["assert_latency"] = verdict
         if verdict != "ok":
             rc = 1
+    # phase-share table (ISSUE 15): rendered to stderr so stdout stays
+    # the one machine-readable JSON line; silent when the server is not
+    # tracing (no zeros-as-data)
+    table = render_phase_table(summary.get("metrics") or {})
+    if table:
+        print("== server phase shares (p50/p95/p99 per phase)",
+              file=sys.stderr)
+        print(table, file=sys.stderr)
     print(json.dumps(summary))
     return rc
 

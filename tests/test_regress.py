@@ -348,6 +348,40 @@ def test_trend_cli_json_and_journal(tmp_path, capsys):
         "iters_run"] == 10
 
 
+def test_wedge_honesty_extends_to_phase_stamps(tmp_path, capsys):
+    """ISSUE 15 satellite: the wedge-honesty rule now covers phase
+    stamps. Every COMMITTED round journal predates request tracing —
+    fold_reqtrace must label them gaps (or empty), never fabricate a
+    zero-phase table, and `obs trend` must render the serve-phase block
+    as `GAP [...]` for a pre-ISSUE-15 serve journal while the round
+    trajectory keeps its own wedge gaps."""
+    import glob
+
+    from bench_tpu_fem.harness.journal import Journal, read_records
+    from bench_tpu_fem.obs.reqtrace import fold_reqtrace
+
+    for path in glob.glob(os.path.join(ROOT, "MEASURE_r*.jsonl")):
+        fold = fold_reqtrace(read_records(path)[0])
+        assert fold["status"] in ("empty", "gap"), (path, fold)
+        assert "phases" not in fold  # never zeros
+    # an old-schema SERVE journal (the PR 9/10 serve_response shape)
+    jp = tmp_path / "old_serve.jsonl"
+    j = Journal(str(jp))
+    j.append({"event": "serve_request", "id": "r1", "spec": {}})
+    j.append({"event": "serve_response", "id": "r1", "ok": True,
+              "latency_s": 0.4,
+              "lifecycle_s": {"queue_wait_s": 0.1, "total_s": 0.4}})
+    assert trend_main(["--root", ROOT, "--journal", str(jp)]) == 0
+    out = capsys.readouterr().out
+    assert "[tunnel_wedge]" in out  # round gaps still labelled
+    assert "== serve phases" in out
+    assert "GAP [" in out  # the phase block gaps, never zeros
+    assert trend_main(["--root", str(tmp_path), "--journal", str(jp),
+                       "--json"]) == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["reqtrace"]["status"] == "gap"
+
+
 # --------------------------------------------------------------------------
 # Live serve SLO parity: snapshot vs journal fold (one burn_rates fold).
 

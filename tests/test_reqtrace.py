@@ -1,0 +1,675 @@
+"""Request-scoped tracing suite (ISSUE 15, bench_tpu_fem.obs.reqtrace +
+the serve-stack threading).
+
+Contract map:
+
+- **Partition exactness**: consecutive cuts partition a request's
+  lifetime, so the phase decomposition sums to the total by
+  construction (`queue + compile + solve + audit + retry + respond ≈
+  latency_s`, asserted within epsilon on every live response).
+- **Tracing off is the pre-PR path**: no `phase_s` on responses, no
+  `serve_phase` journal records, the journal's event set unchanged, and
+  the exactly-once ledger replays MIXED old/new-schema journals.
+- **Live-vs-replay parity**: `/metrics`'s `reqtrace` block and
+  `fold_reqtrace` over the journal run the same `summarize_phases`
+  fold and must agree exactly.
+- **Tail-based exemplars**: the ring keeps the K slowest plus EVERY
+  anomalous request; normal traffic head-samples by deterministic id
+  hash (never RNG).
+- **Wedge honesty** (the PR 10 discipline extended): a journal that
+  predates phase stamps folds to a LABELLED GAP, never zeros.
+- **Gating**: trace-complete rate / incomplete count / anomaly count
+  gate hard in obs.regress; queue-share-of-p99 is presence-gated with
+  an advisory value.
+"""
+
+import json
+import math
+import time
+
+import pytest
+
+import bench_tpu_fem.obs.reqtrace as reqtrace_mod
+import bench_tpu_fem.serve.engine as engine_mod
+from bench_tpu_fem.harness.faults import FaultySolveHook
+from bench_tpu_fem.harness.journal import read_records
+from bench_tpu_fem.obs.reqtrace import (
+    PHASES,
+    ExemplarRing,
+    ReqTrace,
+    fold_reqtrace,
+    head_sampled,
+    journal_to_chrome,
+    merge_exemplars,
+    render_phases,
+    summarize_phases,
+)
+from bench_tpu_fem.obs.trace import validate_chrome_trace
+from bench_tpu_fem.serve import (
+    Broker,
+    ExecutableCache,
+    Metrics,
+    SolveSpec,
+    replay_serve,
+)
+from bench_tpu_fem.serve.metrics import prometheus_text, spec_latency_key
+from bench_tpu_fem.serve.recovery import (
+    fold_outstanding,
+    verify_exactly_once,
+)
+
+pytestmark = pytest.mark.reqtrace
+
+SPEC = SolveSpec(degree=1, ndofs=2000, nreps=12)
+
+#: the journal event vocabulary the PRE-PR serve stack emits — the
+#: tracing-off pin asserts the set is unchanged
+PRE_PR_EVENTS = {"serve_request", "serve_shed", "serve_admit",
+                 "serve_retire", "serve_batch", "serve_response",
+                 "serve_retry", "serve_recover", "serve_sdc"}
+
+
+# ---------------------------------------------------------------------------
+# ReqTrace unit semantics (no solver, synthetic clock)
+# ---------------------------------------------------------------------------
+
+def _fake_clock(times):
+    it = iter(times)
+    return lambda: next(it)
+
+
+def test_cut_partition_sums_exactly():
+    """Consecutive cuts partition [t0, last-cut]: the decomposition sums
+    to total_s exactly (same floats, no clock reads in between)."""
+    rt = ReqTrace("r1", t0=10.0,
+                  clock=_fake_clock([10.5, 10.75, 12.0, 12.25, 12.5]))
+    rt.cut("queue")
+    rt.cut("compile")
+    rt.cut("solve")
+    rt.cut("audit")
+    rt.cut("respond")
+    d = rt.decomposition()
+    assert d["queue_s"] == 0.5 and d["compile_s"] == 0.25
+    assert d["solve_s"] == 1.25 and d["audit_s"] == 0.25
+    assert d["respond_s"] == 0.25
+    assert d["total_s"] == 2.5
+    parts = sum(v for k, v in d.items() if k != "total_s")
+    assert parts == pytest.approx(d["total_s"], abs=1e-9)
+    assert rt.complete()
+    # repeated cuts ACCUMULATE (a rolled-back lane re-enters solve)
+    rt2 = ReqTrace("r2", t0=0.0, clock=_fake_clock([1.0, 2.0, 5.0]))
+    rt2.cut("solve")
+    rt2.cut("retry")
+    rt2.cut("solve")
+    assert rt2.decomposition()["solve_s"] == 4.0
+    assert not rt2.complete()  # queue/compile/respond never stamped
+
+
+def test_drop_phase_seam_breaks_sum_and_completeness(monkeypatch):
+    """The CI incomplete-trace probe's seam: a dropped stamp loses the
+    phase AND its time, so both the epsilon sum and complete() fail."""
+    monkeypatch.setattr(reqtrace_mod, "DROP_PHASE", "compile")
+    rt = ReqTrace("r1", t0=0.0, clock=_fake_clock([1.0, 3.0, 4.0, 4.5]))
+    rt.cut("queue")
+    rt.cut("compile")  # vanishes: 2.0 s of wall lost
+    rt.cut("solve")
+    rt.cut("respond")
+    d = rt.decomposition()
+    assert "compile_s" not in d
+    assert not rt.complete()
+    parts = sum(v for k, v in d.items() if k != "total_s")
+    assert d["total_s"] - parts == pytest.approx(2.0)
+
+
+def test_head_sampling_is_deterministic_id_hash():
+    """Head sampling must be a pure function of the id (replay picks the
+    same requests) and roughly 1/every over an id population."""
+    verdicts = [head_sampled(f"r{i}", 16) for i in range(2048)]
+    assert verdicts == [head_sampled(f"r{i}", 16) for i in range(2048)]
+    rate = sum(verdicts) / len(verdicts)
+    assert 0.03 < rate < 0.12  # ~1/16 with hash slop
+    assert head_sampled("anything", 1)  # every=1 keeps everything
+
+
+def test_exemplar_ring_k_slowest_plus_every_anomalous():
+    ring = ExemplarRing(k_slowest=3, max_anomalous=64, head_every=10 ** 9)
+    for i in range(50):
+        ring.offer({"id": f"r{i}", "latency_s": float(i), "anomalies": []})
+    ring.offer({"id": "bad1", "latency_s": 0.001,
+                "anomalies": ["breakdown"]})
+    ring.offer({"id": "bad2", "latency_s": 0.002,
+                "anomalies": ["retry", "slo_violation"]})
+    snap = ring.snapshot()
+    # tail-based: the K slowest survive 50 normals...
+    assert [e["id"] for e in snap["slowest"]] == ["r49", "r48", "r47"]
+    # ...and EVERY anomalous one is kept regardless of latency
+    assert {e["id"] for e in snap["anomalous"]} == {"bad1", "bad2"}
+    assert ring.counts == {"breakdown": 1, "retry": 1,
+                           "slo_violation": 1}
+    assert ring.anomalous_total() == 3
+    # head_every astronomically large -> no sampled normals
+    assert snap["sampled"] == []
+    merged = merge_exemplars([snap, snap], k_slowest=3)
+    assert [e["id"] for e in merged["slowest"]] == ["r49", "r49", "r48"]
+
+
+def test_summarize_phases_percentiles_and_queue_share():
+    samples = [(1.0, {"queue_s": 0.5, "solve_s": 0.5})] * 99
+    samples.append((10.0, {"queue_s": 9.0, "solve_s": 1.0}))
+    out = summarize_phases(samples)
+    assert out["n"] == 100
+    assert out["phases"]["queue"]["p50_s"] == 0.5
+    assert out["phases"]["queue"]["p99_s"] == 9.0
+    # the p99 tail is the one slow request: queue share 9/10
+    assert out["queue_share_p99"] == pytest.approx(0.9)
+    # a phase nobody entered reads 0.0, never crashes the fold
+    assert out["phases"]["audit"]["p99_s"] == 0.0
+    assert "(no phase" not in render_phases(
+        {"phases": out["phases"], "trace_complete": 1,
+         "trace_incomplete": 0, "anomalies": {}})
+
+
+# ---------------------------------------------------------------------------
+# wedge honesty: old-schema journals are labelled gaps (PR 10 rule)
+# ---------------------------------------------------------------------------
+
+def test_fold_reqtrace_old_schema_journal_is_labelled_gap():
+    """A pre-ISSUE-15 journal (responses without phase_s) folds to a
+    LABELLED gap — never a zero-phase table (the committed round
+    journals predate phase stamps; averaging zeros in would fabricate
+    a latency story that was never measured)."""
+    old = [{"event": "serve_request", "id": "r1", "spec": {}, "ts": 1.0},
+           {"event": "serve_response", "id": "r1", "ok": True,
+            "latency_s": 0.5, "ts": 2.0}]
+    fold = fold_reqtrace(old)
+    assert fold["status"] == "gap"
+    assert fold["responses"] == 1 and fold["traced"] == 0
+    assert "phase" in fold["reason"]
+    assert "phases" not in fold  # no fabricated zeros
+    assert fold_reqtrace([])["status"] == "empty"
+    # the committed round journals themselves (if present) must fold
+    # without crashing and without fabricating phase rows
+    import glob
+
+    for path in glob.glob("MEASURE_r*.jsonl"):
+        f = fold_reqtrace(read_records(path)[0])
+        assert f["status"] in ("empty", "gap"), (path, f)
+
+
+def test_trend_renders_phase_gap_for_old_journal(tmp_path, capsys):
+    """`obs trend` renders the serve-phase block as a labelled GAP for
+    journals that predate phase stamps, and as a table when they carry
+    them."""
+    from bench_tpu_fem.harness.journal import Journal
+    from bench_tpu_fem.obs.report import trend_main
+
+    old = tmp_path / "old.jsonl"
+    j = Journal(str(old))
+    j.append({"event": "serve_request", "id": "r1", "spec": {}})
+    j.append({"event": "serve_response", "id": "r1", "ok": True,
+              "latency_s": 0.5})
+    assert trend_main(["--root", str(tmp_path), "--journal",
+                       str(old)]) == 0
+    out = capsys.readouterr().out
+    assert "== serve phases" in out and "GAP [" in out
+    new = tmp_path / "new.jsonl"
+    j2 = Journal(str(new))
+    j2.append({"event": "serve_request", "id": "r1", "spec": {}})
+    j2.append({"event": "serve_response", "id": "r1", "ok": True,
+               "latency_s": 0.5, "trace_complete": True,
+               "phase_s": {"queue_s": 0.1, "compile_s": 0.05,
+                           "solve_s": 0.3, "respond_s": 0.05,
+                           "total_s": 0.5}})
+    assert trend_main(["--root", str(tmp_path), "--journal",
+                       str(new)]) == 0
+    out = capsys.readouterr().out
+    assert "== serve phases" in out and "queue" in out
+    assert "GAP [" not in out
+
+
+# ---------------------------------------------------------------------------
+# regression-sentinel gating (the perfgate counter contract)
+# ---------------------------------------------------------------------------
+
+def test_gate_counters_reqtrace_semantics():
+    from bench_tpu_fem.obs.regress import gate_counters
+
+    base = {"reqtrace_complete_rate": 1.0, "reqtrace_incomplete": 0,
+            "reqtrace_anomalous": 0, "reqtrace_queue_share_p99": 0.4}
+    # clean current passes; the ADVISORY queue share may drift freely
+    assert gate_counters({**base, "reqtrace_queue_share_p99": 0.9},
+                         base) == []
+    # a lost stamp gates (both directions)
+    v = gate_counters({**base, "reqtrace_complete_rate": 0.9,
+                       "reqtrace_incomplete": 1}, base)
+    assert any("reqtrace_complete_rate" in x for x in v)
+    assert any("reqtrace_incomplete" in x for x in v)
+    # anomalies on the clean pinned schedule gate
+    assert gate_counters({**base, "reqtrace_anomalous": 2}, base)
+    # queue share: value advisory, PRESENCE contractual
+    v = gate_counters({**base, "reqtrace_queue_share_p99": None}, base)
+    assert any("reqtrace_queue_share_p99" in x for x in v)
+    # tracing silently off (rate None) also gates
+    assert gate_counters({**base, "reqtrace_complete_rate": None}, base)
+    # a baseline that never measured reqtrace cannot gate it
+    assert gate_counters(base, {}) == []
+
+
+# ---------------------------------------------------------------------------
+# Metrics: synthetic responses drive the windows / ring / flattener
+# ---------------------------------------------------------------------------
+
+def _synth_response(m, rid, latency, phase, ok=True, spec_key=None,
+                    events=(), failure_class=None, complete=True,
+                    retries=0):
+    m.response(rid, ok, latency, failure_class=failure_class,
+               retriable=False if failure_class else None,
+               phase_s=phase,
+               trace={"id": rid, "phase_s": phase, "timeline": [],
+                      "events": [{"name": e} for e in events],
+                      "meta": {}, "retries": retries,
+                      "complete": complete},
+               spec_key=spec_key)
+
+
+def test_metrics_reqtrace_block_and_prometheus_nesting():
+    """The /metrics reqtrace block folds the phase windows, and the
+    Prometheus flattener walks the nested phase dicts into bounded
+    underscore-joined gauges (no exemplar lists, valid exposition)."""
+    import re
+
+    m = Metrics(slo_objective_s=1.0)
+    ph = {"queue_s": 0.2, "compile_s": 0.1, "solve_s": 0.6,
+          "respond_s": 0.1, "total_s": 1.0}
+    for i in range(8):
+        _synth_response(m, f"r{i}", 1.0, ph,
+                        spec_key="d1:n2000:r12:f32:b4")
+    _synth_response(m, "slow", 3.0, {**ph, "solve_s": 2.6,
+                                     "total_s": 3.0},
+                    spec_key="d7:n2000:r12:f32:b4")  # SLO breach
+    _synth_response(m, "bad", 0.5, ph, ok=False,
+                    failure_class="breakdown", complete=False)
+    snap = m.snapshot()
+    rq = snap["reqtrace"]
+    assert rq["trace_complete"] == 9  # judged over OK responses only
+    assert rq["trace_incomplete"] == 0
+    assert rq["anomalies"] == {"slo_violation": 1, "breakdown": 1}
+    assert {e["id"] for e in rq["exemplars"]["anomalous"]} == \
+        {"slow", "bad"}
+    assert rq["phases"]["solve"]["p99_s"] == pytest.approx(2.6)
+    # per-(spec, bucket) split: the slow degree-7 spec no longer hides
+    # inside the pooled window
+    by = snap["latency_by_spec"]
+    assert by["d1:n2000:r12:f32:b4"]["p99_s"] == pytest.approx(1.0)
+    assert by["d7:n2000:r12:f32:b4"]["p50_s"] == pytest.approx(3.0)
+    text = prometheus_text(snap)
+    assert "benchfem_serve_reqtrace_phases_solve_p99_s" in text
+    assert "benchfem_serve_reqtrace_trace_complete" in text
+    assert ("benchfem_serve_reqtrace_anomalies_slo_violation" in text)
+    assert ('benchfem_serve_latency_by_spec_p99_s{spec='
+            '"d7:n2000:r12:f32:b4"}' in text)
+    # exemplar payloads never leak into the exposition
+    assert "slowest" not in text and "timeline" not in text
+    sample = re.compile(
+        r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[^}]*\})? -?[0-9.eE+-]+$")
+    for line in text.strip().splitlines():
+        assert line.startswith("#") or sample.match(line), line
+
+
+def test_latency_by_spec_key_cap_bounds_cardinality():
+    m = Metrics()
+    for i in range(40):
+        m.response(f"r{i}", True, 0.1,
+                   spec_key=f"d{i}:n1000:r5:f32:b1")
+    snap = m.snapshot()
+    by = snap["latency_by_spec"]
+    from bench_tpu_fem.serve.metrics import _SPEC_KEYS_MAX
+
+    assert len(by) <= _SPEC_KEYS_MAX + 1
+    assert "_other" in by and by["_other"]["n"] == 40 - _SPEC_KEYS_MAX
+    # Prometheus label cardinality stays bounded with it
+    text = prometheus_text(snap)
+    assert text.count("benchfem_serve_latency_by_spec_p50_s{") <= \
+        _SPEC_KEYS_MAX + 1
+    assert spec_latency_key({"degree": 3, "ndofs": 50_000, "nreps": 30,
+                             "precision": "f32"}, 8) == \
+        "d3:n50000:r30:f32:b8"
+
+
+def test_tracing_off_metrics_snapshot_unchanged():
+    """Tracing off: no reqtrace block, no spec windows beyond what the
+    caller feeds — the pre-PR snapshot key set."""
+    m = Metrics()
+    m.response("r1", True, 0.1, cache="hit")
+    snap = m.snapshot()
+    assert "reqtrace" not in snap and "latency_by_spec" not in snap
+    assert "benchfem_serve_reqtrace" not in prometheus_text(snap)
+
+
+# ---------------------------------------------------------------------------
+# loadgen satellite: phase table + --assert-phase-sum
+# ---------------------------------------------------------------------------
+
+def test_loadgen_phase_sum_and_table():
+    import scripts.serve_loadgen as lg
+
+    good = {"id": "r1", "ok": True, "latency_s": 1.0,
+            "phase_s": {"queue_s": 0.3, "compile_s": 0.1,
+                        "solve_s": 0.5, "respond_s": 0.1,
+                        "total_s": 1.0}}
+    assert lg.check_phase_sum(good) is None
+    bad = dict(good)
+    bad["phase_s"] = {**good["phase_s"], "solve_s": 0.2}
+    assert "phase sum" in lg.check_phase_sum(bad)
+    assert lg.check_phase_sum({"latency_s": 1.0}) == "untraced"
+    # a LOST stamp fails even when its phase was too cheap to move the
+    # sum (the CI drop-phase probe's exact shape)
+    lost = dict(good)
+    lost["phase_s"] = {k: v for k, v in good["phase_s"].items()
+                       if k != "compile_s"}
+    lost["latency_s"] = 0.9
+    assert "missing stamp" in lg.check_phase_sum(lost)
+    out = {"completed": 0, "failed": 0, "failed_by_class": {},
+           "engine_forms": {}, "latency_s": [], "server_latency_s": [],
+           "cache_hits": 0, "traced_responses": 0,
+           "untraced_responses": 0, "phase_sum_violations": []}
+    lg._record_response(out, 200, {**good, "ok": True}, 1.0)
+    lg._record_response(out, 200, {**bad, "ok": True, "id": "r2"}, 1.0)
+    lg._record_response(out, 200, {"ok": True, "latency_s": 1.0}, 1.0)
+    assert out["traced_responses"] == 2
+    assert out["untraced_responses"] == 1
+    assert len(out["phase_sum_violations"]) == 1
+    assert "r2" in out["phase_sum_violations"][0]
+    table = lg.render_phase_table(
+        {"reqtrace": {"phases": {"queue": {"p50_s": 0.1, "p95_s": 0.2,
+                                           "p99_s": 0.3, "share": 0.4}},
+                      "trace_complete": 4, "trace_incomplete": 0,
+                      "trace_complete_rate": 1.0,
+                      "queue_share_p99": 0.4, "anomalies": {}}})
+    assert "queue" in table and "trace-complete 4/4" in table
+    assert lg.render_phase_table({}) == ""  # tracing off: no zeros
+
+
+# ---------------------------------------------------------------------------
+# Perfetto render
+# ---------------------------------------------------------------------------
+
+def test_journal_to_chrome_schema_and_tracks():
+    records = [
+        {"event": "serve_request", "id": "r1", "ts": 100.0},
+        {"event": "serve_admit", "id": "r1", "lane": 2,
+         "device": "dev1", "ts": 100.2},
+        {"event": "fleet_steal", "src": "dev0", "dst": "dev1",
+         "count": 1, "ids": ["r1"], "ts": 100.1},
+        {"event": "serve_sdc", "id": "r1", "lane": 2, "action":
+         "rollback", "ts": 100.4},
+        {"event": "serve_response", "id": "r1", "ok": True,
+         "latency_s": 0.6, "device": "dev1", "ts": 100.6,
+         "trace_complete": True, "anomalies": ["steal_moved"],
+         "phase_s": {"queue_s": 0.2, "compile_s": 0.05, "solve_s": 0.3,
+                     "respond_s": 0.05, "total_s": 0.6}},
+    ]
+    trace = journal_to_chrome(records)
+    assert validate_chrome_trace(trace) == []
+    evs = trace["traceEvents"]
+    req = [e for e in evs if e["name"] == "req r1"]
+    assert len(req) == 1 and req[0]["tid"] == 2  # one track per lane
+    names = {e["name"] for e in evs}
+    assert {"queue", "compile", "solve", "respond"} <= names  # children
+    assert {"steal", "sdc"} <= names  # control-plane instants
+    assert any(e["ph"] == "M" for e in evs)  # device track naming
+    # phase children stay inside the request slice
+    lo = req[0]["ts"]
+    hi = lo + req[0]["dur"]
+    for e in evs:
+        if e.get("cat") == "reqtrace.phase":
+            assert lo - 1 <= e["ts"] and e["ts"] + e["dur"] <= hi + 1
+
+
+# ---------------------------------------------------------------------------
+# live broker integration (one compile, shared across cases)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def traced_broker(tmp_path_factory):
+    jp = str(tmp_path_factory.mktemp("rt") / "serve.jsonl")
+    metrics = Metrics(jp, slo_objective_s=30.0)
+    broker = Broker(ExecutableCache(), metrics, queue_max=64,
+                    nrhs_max=4, window_s=0.02, reqtrace=True)
+    broker.warmup([SPEC])
+    yield broker, metrics, jp
+    broker.shutdown()
+
+
+def _settle(metrics, n, timeout=10.0):
+    deadline = time.monotonic() + timeout
+    while (metrics.cache_hit_requests + metrics.cache_miss_requests < n
+           and time.monotonic() < deadline):
+        time.sleep(0.05)
+
+
+def test_live_phase_sum_completeness_and_replay_parity(traced_broker):
+    """The tentpole acceptance on a live continuous-batching broker:
+    every response's decomposition sums to latency_s within epsilon,
+    every trace is complete, serve_phase records land, and
+    fold_reqtrace over the journal reproduces the live /metrics block
+    EXACTLY."""
+    broker, metrics, jp = traced_broker
+    pend = []
+    for i in range(8):
+        pend.append(broker.submit(SPEC, scale=float(1 + i % 3)))
+        time.sleep(0.01)  # ramped: some admissions land mid-solve
+    outs = [broker.wait(p, 120.0) for p in pend]
+    _settle(metrics, 8)
+    assert all(o["ok"] for o in outs), outs
+    for o in outs:
+        ph = o["phase_s"]
+        parts = sum(v for k, v in ph.items() if k != "total_s")
+        assert abs(parts - o["latency_s"]) < 1e-3, (ph, o["latency_s"])
+        assert {"queue_s", "compile_s", "solve_s", "respond_s"} <= \
+            set(ph), ph
+    snap = metrics.snapshot(cache_stats=broker.cache.stats())
+    rq = snap["reqtrace"]
+    assert rq["trace_complete_rate"] == 1.0
+    assert rq["trace_incomplete"] == 0
+    records, corrupt = read_records(jp)
+    assert not corrupt
+    fold = fold_reqtrace(records)
+    assert fold["status"] == "ok"
+    for key in ("phases", "trace_complete", "trace_incomplete",
+                "trace_complete_rate", "queue_share_p99", "anomalies"):
+        assert fold[key] == rq[key], (key, fold[key], rq[key])
+    # serve_phase records journaled (the cache-resolution boundary)
+    rep = replay_serve(jp)
+    assert rep["phase_events"] >= 1
+    assert rep["traced_responses"] >= 8
+    # additive fields keep the exactly-once ledger replayable
+    assert verify_exactly_once(jp)["ok"]
+    # spec_key additive field rides every response
+    resp = [r for r in records if r.get("event") == "serve_response"]
+    assert all(r.get("spec_key", "").startswith("d1:n2000") for r in resp)
+    # the Perfetto render of the live journal validates
+    assert validate_chrome_trace(journal_to_chrome(records)) == []
+
+
+def test_retry_segment_and_anomaly(tmp_path):
+    """A retriable solve fault (broker-internal retry) shows up as a
+    retry phase segment and tags the trace anomalous — its full trace
+    is in the exemplar ring no matter how fast it was."""
+    jp = str(tmp_path / "retry.jsonl")
+    metrics = Metrics(jp)
+    broker = Broker(ExecutableCache(), metrics, queue_max=16,
+                    nrhs_max=2, window_s=0.01, retry_backoff_s=0.01,
+                    reqtrace=True)
+    broker.warmup([SPEC])
+    engine_mod.FAULT_HOOK = FaultySolveHook(["oom"])
+    try:
+        out = broker.wait(broker.submit(SPEC, 2.0), 120.0)
+    finally:
+        engine_mod.FAULT_HOOK = None
+    _settle(metrics, 1)
+    broker.shutdown()
+    assert out["ok"], out
+    assert out["phase_s"].get("retry_s", 0.0) > 0.0, out["phase_s"]
+    parts = sum(v for k, v in out["phase_s"].items() if k != "total_s")
+    assert abs(parts - out["latency_s"]) < 1e-3
+    snap = metrics.snapshot()
+    assert snap["reqtrace"]["anomalies"].get("retry") == 1
+    ex = snap["reqtrace"]["exemplars"]["anomalous"]
+    assert any(e.get("id") == out["id"] for e in ex)
+    fold = fold_reqtrace(jp)
+    assert fold["anomalies"].get("retry") == 1
+
+
+def test_breakdown_anomaly_is_exemplared(traced_broker):
+    """A poisoned lane (NaN scale -> breakdown) keeps its full trace:
+    breakdown is in the tail-based always-keep set."""
+    broker, metrics, jp = traced_broker
+    out = broker.wait(broker.submit(SPEC, float("nan")), 120.0)
+    assert not out["ok"] and out["failure_class"] == "breakdown"
+    assert "phase_s" in out
+    parts = sum(v for k, v in out["phase_s"].items() if k != "total_s")
+    assert abs(parts - out["latency_s"]) < 1e-3
+    snap = metrics.snapshot()
+    assert snap["reqtrace"]["anomalies"].get("breakdown", 0) >= 1
+    assert any(e.get("failure_class") == "breakdown"
+               for e in snap["reqtrace"]["exemplars"]["anomalous"])
+
+
+def test_tracing_off_is_pre_pr_journal_and_response(tmp_path):
+    """The tracing-off pin: responses carry NO phase_s, the journal's
+    event vocabulary is the pre-PR set (no serve_phase), and a MIXED
+    old/new-schema journal replays exactly-once."""
+    jp = str(tmp_path / "off.jsonl")
+    metrics = Metrics(jp)
+    broker = Broker(ExecutableCache(), metrics, queue_max=16,
+                    nrhs_max=2, window_s=0.01, reqtrace=False)
+    broker.warmup([SPEC])
+    out = broker.wait(broker.submit(SPEC, 2.0), 120.0)
+    _settle(metrics, 1)
+    broker.shutdown()
+    assert out["ok"] and "phase_s" not in out
+    records, _ = read_records(jp)
+    events = {r.get("event") for r in records}
+    assert events <= PRE_PR_EVENTS, events - PRE_PR_EVENTS
+    assert all("phase_s" not in r for r in records)
+    assert fold_reqtrace(records)["status"] == "gap"
+    # mixed-schema replay: append a traced generation's records to the
+    # untraced journal — the exactly-once ledger and the recovery fold
+    # read both schemas as one incident
+    mixed = list(records) + [
+        {"event": "serve_request", "id": "g2-1", "spec": {
+            "degree": 1, "ndofs": 2000, "nreps": 12,
+            "precision": "f32", "geom_perturb_fact": 0.0},
+         "scale": 1.0, "ts": 10.0},
+        {"event": "serve_phase", "phase": "execute", "ids": ["g2-1"],
+         "cache_source": "hit", "ts": 10.1},
+        {"event": "serve_response", "id": "g2-1", "ok": True,
+         "latency_s": 0.2, "ts": 10.2, "trace_complete": True,
+         "spec_key": "d1:n2000:r12:f32:b2",
+         "phase_s": {"queue_s": 0.05, "compile_s": 0.01,
+                     "solve_s": 0.1, "respond_s": 0.04,
+                     "total_s": 0.2}},
+    ]
+    assert verify_exactly_once(mixed)["ok"]
+    plan = fold_outstanding(mixed)
+    assert plan.outstanding == []  # serve_phase never reads as a request
+    # and one UNANSWERED new-schema request still replays
+    mixed.append({"event": "serve_request", "id": "g2-2", "spec": {
+        "degree": 1, "ndofs": 2000, "nreps": 12, "precision": "f32",
+        "geom_perturb_fact": 0.0}, "scale": 2.0, "ts": 11.0})
+    plan2 = fold_outstanding(mixed)
+    assert [r["id"] for r in plan2.outstanding] == ["g2-2"]
+
+
+def test_reqtrace_cli_renders_and_validates(traced_broker, tmp_path,
+                                            capsys):
+    from bench_tpu_fem.obs.reqtrace import reqtrace_main
+
+    _, _, jp = traced_broker
+    out_path = str(tmp_path / "trace.json")
+    rc = reqtrace_main(["--journal", jp, "--out", out_path, "--json"])
+    assert rc == 0
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["status"] == "ok"
+    assert payload["trace_violations"] == []
+    assert payload["request_slices"] >= 8
+    with open(out_path) as fh:
+        assert validate_chrome_trace(json.load(fh)) == []
+    # text mode
+    assert reqtrace_main(["--journal", jp]) == 0
+    text = capsys.readouterr().out
+    assert "request phases" in text and "queue" in text
+
+
+# ---------------------------------------------------------------------------
+# fleet threading (route cause, steal-moved exemplars, merged block)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow
+def test_fleet_reqtrace_route_cause_steal_and_merge(tmp_path):
+    """Fleet integration: every fleet_route record carries its CAUSE,
+    stolen requests are steal_moved-tagged exemplars, and the fleet
+    /metrics merges the lanes' phase windows into one reqtrace block
+    the loadgen table can read."""
+    from bench_tpu_fem.serve.fleet import FleetDispatcher
+
+    jp = str(tmp_path / "fleet.jsonl")
+    fleet = FleetDispatcher(2, journal_path=jp, queue_max=64,
+                            nrhs_max=4, window_s=0.01,
+                            balance_interval_s=0, reqtrace=True)
+    fleet.warmup([SPEC])
+    engine_mod.FAULT_HOOK = FaultySolveHook(["hang"], hang_s=1.5)
+    try:
+        pend = [fleet.submit(SPEC, scale=1.0)]
+        time.sleep(0.4)  # lane0's worker is inside the hung solve
+        pend += [fleet.submit(SPEC, scale=float(2 ** (i % 3)))
+                 for i in range(6)]
+        moved = fleet.rebalance_once()
+        outs = [fleet.wait(p, 120.0) for p in pend]
+    finally:
+        engine_mod.FAULT_HOOK = None
+    deadline = time.monotonic() + 10.0
+    while (sum(ln.metrics.completed for ln in fleet.lanes) < 7
+           and time.monotonic() < deadline):
+        time.sleep(0.05)
+    snap = fleet.metrics_snapshot()
+    fleet.shutdown()
+    assert all(o["ok"] for o in outs)
+    assert moved == 3  # the pinned half-the-gap move
+    records, _ = read_records(jp)
+    routes = [r for r in records if r.get("event") == "fleet_route"]
+    assert routes and all(
+        r.get("cause") in ("affinity-hit", "cold-home", "spill")
+        for r in routes)
+    steals = [r for r in records if r.get("event") == "fleet_steal"]
+    assert steals and len(steals[0]["ids"]) == 3
+    # stolen requests are anomalous exemplars fleet-wide
+    rq = snap["reqtrace"]
+    assert rq["anomalies"].get("steal_moved") == 3
+    stolen_ids = set(steals[0]["ids"])
+    assert stolen_ids <= {e.get("id")
+                          for e in rq["exemplars"]["anomalous"]}
+    assert rq["trace_complete_rate"] == 1.0
+    # every phase sum still closes under steal + continuous admission
+    for o in outs:
+        parts = sum(v for k, v in o["phase_s"].items()
+                    if k != "total_s")
+        assert abs(parts - o["latency_s"]) < 1e-3
+    # journaled anomalies replay identically
+    fold = fold_reqtrace(records)
+    assert fold["anomalies"].get("steal_moved") == 3
+    # merged per-spec split present fleet-wide
+    assert any(k.startswith("d1:n2000") for k in snap["latency_by_spec"])
+
+
+def test_phase_sum_asserts_on_math_not_luck():
+    """The --assert-phase-sum epsilon is rounding slack, not a fudge
+    factor: six phases rounded to a microsecond bound the honest
+    discrepancy at 3e-6 — three orders under the assert epsilon."""
+    import scripts.serve_loadgen as lg
+
+    worst = 6 * 0.5e-6
+    assert worst < lg.PHASE_SUM_EPS_S / 100
+    assert not math.isclose(lg.PHASE_SUM_EPS_S, 0.0)
+    assert set(lg.PHASES) == set(PHASES)
