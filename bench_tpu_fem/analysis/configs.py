@@ -825,93 +825,45 @@ class ConfigSpec:
     min_devices: int = 1
 
 
+#: drive-key -> trace-only drive function (the registry's AnalysisRef
+#: rows name the key; this table is the only analysis-side coupling)
+_DRIVES: dict[str, Callable[..., ConfigResult]] = {
+    "kron_engine": drive_kron_engine,
+    "kron_update_pass": drive_kron_update_pass,
+    "kron_3stage": drive_kron_3stage,
+    "folded_engine": drive_folded_engine,
+    "folded_apply": drive_folded_fused_apply,
+    "kron_df_engine": drive_kron_df_engine,
+    "kron_df_update_pass": drive_kron_df_update_pass,
+    "folded_df_apply": drive_folded_df_apply,
+    "serve_batched_apply": drive_serve_batched_apply,
+    "serve_batched_kron_3stage": drive_serve_batched_kron_3stage,
+    "kron_batched_engine": drive_kron_batched_engine,
+    "dist_kron_engine": drive_dist_kron_engine,
+    "dist_kron_engine_3d": drive_dist_kron_engine_3d,
+    "dist_kron_df": drive_dist_kron_df,
+    "dist_folded_engine": drive_dist_folded_engine,
+    "dist_kron_overlap": drive_dist_kron_overlap,
+    "dist_kron_df_overlap": drive_dist_kron_df_overlap,
+    "dist_folded_overlap": drive_dist_folded_overlap,
+}
+
+
 def _matrix() -> list[ConfigSpec]:
+    """The shipped-config matrix, derived from the engine registry's
+    declarative rows (engines.registry.analysis_plan — one source of
+    truth with the driver routing and the serve capability table). The
+    registry parity test pins the rendered names against the frozen
+    pre-registry list."""
+    from ..engines.registry import analysis_plan
+
     specs: list[ConfigSpec] = []
-    # kron f32 engine: plan cross-check degrees {1, 3, 6} + the shipped
-    # degree-4 case and the Mosaic-reject chunked retry forms.
-    for d in (1, 3, 4, 6):
+    for ref in analysis_plan():
+        fn = _DRIVES[ref.drive]
         specs.append(ConfigSpec(
-            f"kron_engine_d{d}", lambda d=d: drive_kron_engine(d, False)))
-    for d in (3, 4):
-        specs.append(ConfigSpec(
-            f"kron_engine_d{d}_chunked",
-            lambda d=d: drive_kron_engine(d, True)))
-    specs.append(ConfigSpec("kron_update_pass", drive_kron_update_pass))
-    specs.append(ConfigSpec("kron_3stage_d3", drive_kron_3stage))
-    # folded f32: engine + fused apply, both geometry modes, degrees
-    # {1, 3, 6} (+4, the forced-corner boundary case).
-    for geom in ("g", "corner"):
-        for d in (1, 3, 4, 6):
-            specs.append(ConfigSpec(
-                f"folded_engine_{geom}_d{d}",
-                lambda g=geom, d=d: drive_folded_engine(g, d)))
-            specs.append(ConfigSpec(
-                f"folded_apply_{geom}_d{d}",
-                lambda g=geom, d=d: drive_folded_fused_apply(g, d)))
-    # kron df engine, degrees {1, 3, 6} + degree-4 + chunked forms.
-    for d in (1, 3, 4, 6):
-        specs.append(ConfigSpec(
-            f"kron_df_engine_d{d}",
-            lambda d=d: drive_kron_df_engine(d, False)))
-    for d in (3, 4):
-        specs.append(ConfigSpec(
-            f"kron_df_engine_d{d}_chunked",
-            lambda d=d: drive_kron_df_engine(d, True)))
-    specs.append(ConfigSpec("kron_df_update_pass", drive_kron_df_update_pass))
-    # folded df apply, both geometry modes, degrees {1, 3, 6}.
-    for geom in ("g", "corner"):
-        for d in (1, 3, 6):
-            specs.append(ConfigSpec(
-                f"folded_df_apply_{geom}_d{d}",
-                lambda g=geom, d=d: drive_folded_df_apply(g, d)))
-    # serve-layer batched (vmapped) applies, degrees {1, 3, 6} + the
-    # uniform kron twin (ISSUE 5: the batched configs run through the
-    # same R1-R5 engine as the one-shot forms).
-    for d in (1, 3, 6):
-        specs.append(ConfigSpec(
-            f"serve_batched_apply_corner_d{d}",
-            lambda d=d: drive_serve_batched_apply("corner", d)))
-    specs.append(ConfigSpec("serve_batched_kron_3stage_d3",
-                            drive_serve_batched_kron_3stage))
-    # the nrhs-native fused batched engine (ISSUE 6): the serve-bucket
-    # sweep at degree 3 (every bucket the broker pads to at this size)
-    # plus the degree plan-estimator cross-check at nrhs=4.
-    for d, r in ((1, 4), (3, 2), (3, 4), (3, 8), (3, 16), (6, 4)):
-        specs.append(ConfigSpec(
-            f"kron_batched_engine_d{d}_r{r}",
-            lambda d=d, r=r: drive_kron_batched_engine(d, r)))
-    # distributed forms (8 virtual CPU devices).
-    for d in (3, 5):
-        specs.append(ConfigSpec(
-            f"dist_kron_engine_d{d}",
-            lambda d=d: drive_dist_kron_engine(d), min_devices=4))
-    specs.append(ConfigSpec("dist_kron_engine_ext2d",
-                            drive_dist_kron_engine_3d, min_devices=8))
-    specs.append(ConfigSpec("dist_kron_df_halo",
-                            lambda: drive_dist_kron_df((4, 1, 1)),
-                            min_devices=4))
-    specs.append(ConfigSpec("dist_kron_df_ext2d",
-                            lambda: drive_dist_kron_df((2, 2, 2)),
-                            min_devices=8))
-    specs.append(ConfigSpec("dist_folded_engine", drive_dist_folded_engine,
-                            min_devices=2))
-    # communication-overlapped engine forms (ISSUE 7): the full
-    # overlapped CG loops traced end to end, so R5 covers the carried-
-    # halo exchanges and the single fused reduction per iteration.
-    specs.append(ConfigSpec(
-        "dist_kron_overlap_d3",
-        lambda: drive_dist_kron_overlap(3, False), min_devices=4))
-    specs.append(ConfigSpec(
-        "dist_kron_overlap_ext2d",
-        lambda: drive_dist_kron_overlap(3, True), min_devices=8))
-    specs.append(ConfigSpec("dist_kron_df_overlap_halo",
-                            lambda: drive_dist_kron_df_overlap((4, 1, 1)),
-                            min_devices=4))
-    specs.append(ConfigSpec("dist_kron_df_overlap_ext2d",
-                            lambda: drive_dist_kron_df_overlap((2, 2, 2)),
-                            min_devices=8))
-    specs.append(ConfigSpec("dist_folded_overlap",
-                            drive_dist_folded_overlap, min_devices=2))
+            ref.name,
+            (lambda fn=fn, args=tuple(ref.args): fn(*args)),
+            min_devices=ref.min_devices))
     return specs
 
 

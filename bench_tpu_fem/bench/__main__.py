@@ -1,0 +1,64 @@
+"""bench package CLI.
+
+    python -m bench_tpu_fem.bench engines [--json]
+
+``engines`` renders the declarative engine registry
+(bench_tpu_fem.engines.registry): every routable engine slice with its
+capability predicate, VMEM plan reference, gate-reason vocabulary and
+tunable defaults — plus the tuned-vs-default state when a tuning DB is
+armed ($BTF_TUNING_DB, engines.autotune). The benchmark CLI itself is
+``python -m bench_tpu_fem.cli`` (single-chip) and
+``python -m bench_tpu_fem`` (dist); this module is the registry's
+inspection surface, not a runner.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m bench_tpu_fem.bench",
+        description=__doc__.splitlines()[0])
+    sub = p.add_subparsers(dest="cmd", required=True)
+    eng = sub.add_parser(
+        "engines", help="render the declarative engine registry")
+    eng.add_argument("--json", action="store_true",
+                     help="machine-readable rows instead of the table")
+    args = p.parse_args(argv)
+
+    from ..engines.autotune import default_tuning_db
+    from ..engines.registry import (
+        ENGINE_SPECS,
+        GATE_REASONS,
+        render_registry,
+    )
+
+    db = default_tuning_db()
+    if args.json:
+        rows = []
+        for s in ENGINE_SPECS:
+            rows.append({
+                "name": s.name, "forms": list(s.forms),
+                "precision": s.precision, "geometry": s.geometry,
+                "sharding": s.sharding, "backend": s.backend,
+                "nrhs": s.nrhs, "enabler": s.enabler, "plan": s.plan,
+                "gate_slugs": list(s.gate_slugs),
+                "tunables": list(s.tunables),
+                "defaults": dict(s.defaults),
+            })
+        print(json.dumps({
+            "engines": rows,
+            "gate_reasons": dict(sorted(GATE_REASONS.items())),
+            "tuning_db": (db.stats() if db is not None else None),
+        }, sort_keys=True))
+    else:
+        print(render_registry(tuning_db=db))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -269,35 +269,30 @@ def stamp_observability(cfg: BenchConfig, res: BenchmarkResults,
 ENGINE_FORM_NAMES = {"one": "one_kernel", "chunked": "chunked",
                      "one_batched": "one_kernel_batched"}
 
+from ..engines.registry import GATE_REASONS, gate_reason
+
 # The recorded reason every nrhs>1 branch WITHOUT a fused batched form
 # stamps (classified `unsupported` by the harness taxonomy). Since the
 # nrhs-native kron engine (ops.kron_cg.kron_cg_solve_batched) landed,
 # single-chip uniform f32 CG batches run fused where the per-bucket VMEM
 # plan admits them; every other batched branch (action, folded, df,
 # sharded, over-budget buckets) still runs the unfused vmapped apply and
-# records this.
-BATCHED_UNFUSED_REASON = (
-    "batched multi-RHS (nrhs>1): fused batching is unsupported on this "
-    "path (no batched engine form); running the unfused vmapped apply")
+# records this. Text owned by the registry vocabulary (engines.registry)
+# — every stamped reason must be a registered constant.
+BATCHED_UNFUSED_REASON = GATE_REASONS["batched-unfused"]
 
 # The recorded reason every fused-engine branch stamps when durable
 # checkpointing is requested (ISSUE 9): the whole-solve engines bake
 # nreps into ONE executable and expose no iteration boundary to snapshot
 # at, so the driver runs the unfused checkpointable loop instead.
-CHECKPOINT_GATE_REASON = (
-    "durable checkpointing (checkpoint_every > 0): the fused whole-solve "
-    "engine exposes no iteration boundary; running the unfused "
-    "checkpointable loop (la.checkpoint)")
+CHECKPOINT_GATE_REASON = GATE_REASONS["checkpoint-engine"]
 
 # The recorded reason every fused-engine CG branch stamps when
 # convergence capture is requested (ISSUE 10): the whole-solve engines
 # bake the recurrence into ONE kernel chain with no per-iteration
 # residual to buffer, so the driver runs the capture-able unfused loop
 # instead (same structure as the checkpoint gate above).
-CONVERGENCE_GATE_REASON = (
-    "convergence capture (convergence=True): the fused whole-solve "
-    "engine exposes no per-iteration residual to buffer; running the "
-    "unfused capture-able loop (la.cg capture=True)")
+CONVERGENCE_GATE_REASON = GATE_REASONS["convergence-engine"]
 
 
 def stamp_precond(extra: dict, cfg: BenchConfig, bundle=None,
@@ -343,13 +338,9 @@ def resolve_precond_bundle(cfg: BenchConfig, op, u, mesh=None):
                          "jacobi | chebyshev | pmg")
     if kind == "pmg":
         if mesh is None or cfg.use_gauss:
-            return None, (
-                "p-multigrid needs the GLL node family (endpoint nodes "
-                "carry the Dirichlet transfer) and a grid-layout "
-                "operator; precond disabled for this run")
+            return None, GATE_REASONS["precond-pmg-family"]
         if cfg.degree < 2:
-            return None, ("p-multigrid needs degree >= 2 (no coarser "
-                          "level below degree 1); precond disabled")
+            return None, GATE_REASONS["precond-pmg-degree"]
         from ..la.pmg import build_pmg_bundle
 
         backend = "kron" if hasattr(op, "Kd") else "xla"
@@ -755,9 +746,7 @@ def stamp_nrhs(extra: dict, nrhs: int, checkpoint_every: int = 0) -> None:
     extra["nrhs"] = int(nrhs)
     extra["nrhs_bucket"] = nrhs_bucket(int(nrhs))
     if checkpoint_every > 0:
-        extra["checkpoint_gate_reason"] = (
-            "batched (nrhs>1) bench paths run whole-batch executables "
-            "with no iteration boundary; snapshots disabled for this run")
+        extra["checkpoint_gate_reason"] = GATE_REASONS["checkpoint-batched"]
 
 
 def _exec_cache_key(cfg: BenchConfig, n, form: str, kind: str):
@@ -769,21 +758,32 @@ def _exec_cache_key(cfg: BenchConfig, n, form: str, kind: str):
     unpadded (benchmark work must equal accounted work — padding lanes
     would burn unmeasured bandwidth), so executables of different
     widths within one bucket must not collide."""
-    from ..serve.cache import ExecutableKey
+    from ..engines.registry import EngineSpec, bench_engine_form
 
     precision = ("f32" if cfg.float_bits == 32
                  else ("df32" if cfg.f64_impl == "df32" else "f64"))
-    return ExecutableKey(
+    return EngineSpec.cache_key(
         degree=cfg.degree,
         cell_shape=tuple(int(c) for c in n),
         precision=precision,
         geom="perturbed" if cfg.geom_perturb_fact != 0.0 else "uniform",
-        engine_form=(f"{cfg.backend}|{form}|{kind}|q{cfg.qmode}"
-                     f"|{'gauss' if cfg.use_gauss else 'gll'}"),
+        engine_form=bench_engine_form(cfg.backend, form, kind, cfg.qmode,
+                                      cfg.use_gauss),
         nrhs_bucket=int(cfg.nrhs),
         device_mesh=(cfg.ndevices,),
         nreps=cfg.nreps,
     )
+
+
+def _stamp_tuning(key, res: BenchmarkResults):
+    """Tuned build parameters for this executable key (engines.autotune).
+    Stamps the tuning evidence block (source=db with the entry's label
+    and round when tuned, source=default with a registered reason
+    otherwise) into the results, and returns the tuned params dict or
+    None — defaults run with the reason journaled, never silently."""
+    from ..engines.autotune import tuning_stamp
+
+    return tuning_stamp(res.extra, key)
 
 
 def _exec_cache_get(cfg: BenchConfig, key, res: BenchmarkResults):
@@ -881,20 +881,14 @@ def resolve_backend(backend: str, float_bits: int, uniform: bool = False,
       kernel);
     - otherwise 'xla' (einsum path; Mosaic has no f64, CPU runs use einsum,
       interpret-mode Pallas is for tests).
+
+    The decision table lives in engines.registry (one source of truth
+    for routing, serve capability checks, and the analysis matrix);
+    this is a thin delegate kept for the existing call sites.
     """
-    import jax
+    from ..engines.registry import resolve_backend as _resolve
 
-    if backend != "auto":
-        return backend
-    if uniform:
-        return "kron"
-    if float_bits == 32 and jax.default_backend() == "tpu":
-        from ..ops.folded import pallas_geom_constraint
-
-        nq = degree + qmode + 1
-        if pallas_geom_constraint(degree, nq, 4)[0]:
-            return "pallas"
-    return "xla"
+    return _resolve(backend, float_bits, uniform, degree, qmode)
 
 
 def run_benchmark(cfg: BenchConfig) -> BenchmarkResults:
@@ -972,22 +966,19 @@ def _run_benchmark_folded_df(cfg: BenchConfig) -> BenchmarkResults:
 
     if cfg.backend not in ("auto", "pallas"):
         raise ValueError(
-            "perturbed f64_impl='df32' runs the folded pallas-df path; "
-            f"--backend {cfg.backend} is not supported with it")
+            gate_reason("df-backend-folded", backend=cfg.backend))
     if cfg.nrhs > 1:
         # the folded df pipeline has no batched form (its kernels are
         # not vmap-batchable today): recorded emulation fallback — the
         # emulated path batches through _finish_batched
         return _df64_emulated_fallback(
-            cfg, "batched multi-RHS (nrhs>1) is unsupported on the "
-                 "folded df pipeline; XLA-emulated batched fallback")
+            cfg, gate_reason("df-batched-folded"))
     n, rule, t, mesh = _mesh_setup(cfg)
     supported, _, kib = folded_df_plan(cfg.degree, t.nq)
     if not supported:
         return _df64_emulated_fallback(
-            cfg,
-            f"folded-df plan: degree {cfg.degree} qmode {cfg.qmode} "
-            "exceeds the df VMEM model (no 128-lane folded df kernel)")
+            cfg, gate_reason("df-plan-unsupported", degree=cfg.degree,
+                             qmode=cfg.qmode))
     ndofs_global = global_ndofs(n, cfg.degree)
     res = BenchmarkResults(
         ncells_global=mesh.ncells, ndofs_global=ndofs_global, nreps=cfg.nreps
@@ -1003,18 +994,14 @@ def _run_benchmark_folded_df(cfg: BenchConfig) -> BenchmarkResults:
         # composition yet (its seam-fold state rides the kernel chain):
         # recorded, runs the standard whole-solve executable
         res.extra["checkpoint_gate_reason"] = (
-            "folded-df pipeline has no checkpointable loop form; "
-            "snapshots disabled for this run")
+            GATE_REASONS["checkpoint-folded-df"])
     if cfg.convergence:
         # same seam: the folded df CG's residual rides the kernel chain
         # with no per-iteration buffer to capture into (recorded)
         res.extra["convergence_gate_reason"] = (
-            "folded-df pipeline has no capture-able loop form; "
-            "convergence capture disabled for this run")
+            GATE_REASONS["convergence-folded-df"])
     if cfg.sdc_audit:
-        res.extra["sdc_gate_reason"] = (
-            "folded-df pipeline has no checkpointable boundary for the "
-            "SDC audit to ride; audit disabled for this run")
+        res.extra["sdc_gate_reason"] = GATE_REASONS["sdc-folded-df"]
     if cfg.precond != "none":
         from ..la.precond import PRECOND_GATE_REASONS
 
@@ -1022,9 +1009,7 @@ def _run_benchmark_folded_df(cfg: BenchConfig) -> BenchmarkResults:
                       gate_reason=PRECOND_GATE_REASONS["folded"])
     if cfg.s_step > 1:
         res.extra["s_step"] = int(cfg.s_step)
-        res.extra["s_step_gate_reason"] = (
-            "folded-df pipeline has no s-step form; running the "
-            "standard recurrence")
+        res.extra["s_step_gate_reason"] = GATE_REASONS["sstep-folded-df"]
 
     # Host-assembled f64 RHS (the reference assembles its RHS on the CPU
     # too), split into df channels and folded per channel. The oracle
@@ -1058,7 +1043,7 @@ def _run_benchmark_folded_df(cfg: BenchConfig) -> BenchmarkResults:
             # a Mosaic/XLA rejection of the folded df kernels must not
             # sink the benchmark: recorded emulation fallback
             return _df64_emulated_fallback(
-                cfg, "folded-df compile failed: " + exc_str(exc))
+                cfg, gate_reason("df-compile-failed", error=exc_str(exc)))
         with obs.phase("transfer"):
             warm = fn(op, u)
             float(warm.hi[(0,) * warm.hi.ndim])
@@ -1167,9 +1152,7 @@ def _run_benchmark_df64(cfg: BenchConfig) -> BenchmarkResults:
     if cfg.geom_perturb_fact != 0.0:
         return _run_benchmark_folded_df(cfg)
     if cfg.backend not in ("auto", "kron"):
-        raise ValueError("f64_impl='df32' runs the kron path on uniform "
-                         f"meshes; --backend {cfg.backend} is not "
-                         "supported with it")
+        raise ValueError(gate_reason("df-backend-kron", backend=cfg.backend))
     n, rule, t, mesh = _mesh_setup(cfg)
     if not mesh.is_uniform:
         raise ValueError("f64_impl='df32' requires a uniform (unperturbed) "
@@ -1231,23 +1214,17 @@ def _run_benchmark_df64(cfg: BenchConfig) -> BenchmarkResults:
             # boundary audit is not wired through (the serve layer's
             # df retire audit covers df32 detection); recorded, never
             # silent
-            res.extra["sdc_gate_reason"] = (
-                "the SDC boundary audit is not wired through the df "
-                "(double-float) checkpointed loop; df32 detection runs "
-                "in the serve layer's retire-time audit")
+            res.extra["sdc_gate_reason"] = GATE_REASONS["sdc-df"]
         # convergence capture (ISSUE 10): rides the unfused df loop
         # (cg_solve_df capture=True); the fused df ring gates off with
         # the reason recorded — same discipline as the f32 driver
         conv = cfg.convergence and cfg.use_cg and not ckpt
         if cfg.convergence and cfg.use_cg and ckpt:
             res.extra["convergence_gate_reason"] = (
-                "convergence capture is not wired through the "
-                "checkpointable chunked loop; capture disabled for "
-                "this checkpointed run")
+                GATE_REASONS["convergence-checkpoint"])
         if cfg.convergence and not cfg.use_cg:
             res.extra["convergence_gate_reason"] = (
-                "convergence capture applies to CG solves only (action "
-                "runs carry no residual); capture disabled")
+                GATE_REASONS["convergence-action"])
         if conv and engine:
             engine = False
             res.extra["convergence_gate_reason"] = CONVERGENCE_GATE_REASON
@@ -1259,9 +1236,7 @@ def _run_benchmark_df64(cfg: BenchConfig) -> BenchmarkResults:
         pre_df = None
         if cfg.s_step > 1:
             res.extra["s_step"] = int(cfg.s_step)
-            res.extra["s_step_gate_reason"] = (
-                "s-step has no df (double-float) form; running the "
-                "standard df recurrence")
+            res.extra["s_step_gate_reason"] = GATE_REASONS["sstep-df"]
         if cfg.precond != "none":
             from ..la.precond import (
                 PRECOND_GATE_REASONS,
@@ -1277,9 +1252,7 @@ def _run_benchmark_df64(cfg: BenchConfig) -> BenchmarkResults:
             elif ckpt:
                 gate = PRECOND_GATE_REASONS["checkpoint"]
             elif cfg.precond != "jacobi":
-                gate = ("df (double-float) paths support jacobi "
-                        f"preconditioning only ({cfg.precond} has no df "
-                        "form); precond disabled for this run")
+                gate = gate_reason("precond-df", precond=cfg.precond)
             else:
                 import time as _time
 
@@ -1481,8 +1454,7 @@ def _finish_batched(cfg: BenchConfig, res: BenchmarkResults, n, op, u,
     conv = cfg.convergence and cfg.use_cg
     if cfg.convergence and not cfg.use_cg:
         res.extra["convergence_gate_reason"] = (
-            "convergence capture applies to CG solves only (action "
-            "runs carry no residual); capture disabled")
+            GATE_REASONS["convergence-action"])
     if conv and engine:
         engine = False
         engine_run = None
@@ -1505,9 +1477,7 @@ def _finish_batched(cfg: BenchConfig, res: BenchmarkResults, n, op, u,
         gate = None
         bundle = None
         if cfg.precond != "jacobi":
-            gate = (f"batched (nrhs>1) paths support jacobi "
-                    f"preconditioning only ({cfg.precond} has no "
-                    "batched cost model); precond disabled")
+            gate = gate_reason("precond-batched", precond=cfg.precond)
         else:
             import time as _time
 
@@ -1568,6 +1538,11 @@ def _finish_batched(cfg: BenchConfig, res: BenchmarkResults, n, op, u,
     if pdinv is not None:
         batch_kind += "+jacobi"
     key = _exec_cache_key(cfg, n, planned_form, batch_kind)
+    tuned = _stamp_tuning(key, res)
+    if tuned and engine and tuned.get("window_kib"):
+        # tuned scoped-VMEM window beats the plan's static estimate;
+        # compile-option only, numerics untouched
+        engine_opts = scoped_vmem_options(int(tuned["window_kib"]))
     fn = _exec_cache_get(cfg, key, res)
     from_cache = fn is not None
     with obs.phase("compile"):
@@ -1643,17 +1618,13 @@ def _finish_batched_df(cfg: BenchConfig, res: BenchmarkResults, n, op, u,
         # the batched df path vmaps the WHOLE per-lane df solve; its
         # capture form is not wired (recorded, never silent)
         res.extra["convergence_gate_reason"] = (
-            "batched df32 (vmapped whole-solve) has no wired capture "
-            "form; convergence capture disabled for this run")
+            GATE_REASONS["convergence-batched-df"])
     if cfg.precond != "none":
-        stamp_precond(res.extra, cfg, gate_reason=(
-            "batched df32 (vmapped whole-solve) has no wired "
-            "preconditioner; precond disabled for this run"))
+        stamp_precond(res.extra, cfg,
+                      gate_reason=GATE_REASONS["precond-batched-df"])
     if cfg.s_step > 1:
         res.extra["s_step"] = int(cfg.s_step)
-        res.extra["s_step_gate_reason"] = (
-            "batched df32 has no s-step form; running the standard "
-            "recurrence")
+        res.extra["s_step_gate_reason"] = GATE_REASONS["sstep-batched-df"]
     scales = jnp.asarray(batch_scales(cfg.nrhs), jnp.float32)
     sb = scales.reshape((-1,) + (1,) * u.hi.ndim)
     B = DF(sb * u.hi[None], sb * u.lo[None])
@@ -1668,6 +1639,7 @@ def _finish_batched_df(cfg: BenchConfig, res: BenchmarkResults, n, op, u,
     obs = BenchObserver(cfg)
     key = _exec_cache_key(cfg, n, "unfused",
                           "cg" if cfg.use_cg else "action")
+    _stamp_tuning(key, res)
     fn = _exec_cache_get(cfg, key, res)
     if fn is None:
         with obs.phase("compile"):
@@ -1901,10 +1873,7 @@ def _run_benchmark(cfg: BenchConfig) -> BenchmarkResults:
             # the boundary audit rides the checkpointed loop (its
             # cadence IS the rollback cadence) — asking for it without
             # one records why it did not run, never silently
-            res.extra["sdc_gate_reason"] = (
-                "the SDC boundary audit rides the iteration-boundary "
-                "checkpointed CG loop; set --checkpoint-every > 0 (and "
-                "--cg) to arm it")
+            res.extra["sdc_gate_reason"] = GATE_REASONS["sdc-no-checkpoint"]
         if ckpt and engine:
             # durable checkpointing needs iteration boundaries; the
             # fused whole-solve engines have none (CHECKPOINT_GATE_REASON)
@@ -1919,13 +1888,10 @@ def _run_benchmark(cfg: BenchConfig) -> BenchmarkResults:
         if cfg.convergence and cfg.use_cg and ckpt:
             conv = False
             res.extra["convergence_gate_reason"] = (
-                "convergence capture is not wired through the "
-                "checkpointable chunked loop; capture disabled for "
-                "this checkpointed run")
+                GATE_REASONS["convergence-checkpoint"])
         if cfg.convergence and not cfg.use_cg:
             res.extra["convergence_gate_reason"] = (
-                "convergence capture applies to CG solves only (action "
-                "runs carry no residual); capture disabled")
+                GATE_REASONS["convergence-action"])
         if conv and engine:
             engine = False
             apply_fn = unfused_apply
@@ -1950,8 +1916,7 @@ def _run_benchmark(cfg: BenchConfig) -> BenchmarkResults:
                                            else None))
                 if cfg.s_step > 1:
                     res.extra["s_step_gate_reason"] = (
-                        "s-step applies to CG solves only; running the "
-                        "standard action loop")
+                        GATE_REASONS["sstep-action"])
             elif ckpt:
                 stamp_precond(
                     res.extra, cfg,
@@ -1959,8 +1924,7 @@ def _run_benchmark(cfg: BenchConfig) -> BenchmarkResults:
                                  if cfg.precond != "none" else None))
                 if cfg.s_step > 1:
                     res.extra["s_step_gate_reason"] = (
-                        "s-step is not wired through the checkpointable "
-                        "chunked loop; running the standard recurrence")
+                        GATE_REASONS["sstep-checkpoint"])
             else:
                 gate = None
                 if cfg.precond != "none":
@@ -1969,9 +1933,7 @@ def _run_benchmark(cfg: BenchConfig) -> BenchmarkResults:
                 sstep_on = cfg.s_step > 1 and pbundle is None
                 if cfg.s_step > 1 and pbundle is not None:
                     res.extra["s_step_gate_reason"] = (
-                        "s-step with preconditioning has no "
-                        "communication-avoiding PCG form; running the "
-                        "preconditioned recurrence")
+                        GATE_REASONS["sstep-precond"])
                 stamp_precond(res.extra, cfg, bundle=pbundle,
                               gate_reason=gate)
                 if (pbundle is not None or sstep_on) and engine:
@@ -1982,10 +1944,7 @@ def _run_benchmark(cfg: BenchConfig) -> BenchmarkResults:
                         "precond_gate_reason" if pbundle is not None
                         else "s_step_gate_reason",
                         PRECOND_GATE_REASONS["engine"] if pbundle
-                        is not None else
-                        "s-step rides the unfused loop; the fused "
-                        "whole-solve engine bakes the standard "
-                        "recurrence")
+                        is not None else GATE_REASONS["sstep-engine"])
         # Executable-cache key: the PLANNED engine form (what the plan
         # functions deterministically pick for this config), so a repeat
         # of the same config finds the executable its first compile
@@ -2010,6 +1969,11 @@ def _run_benchmark(cfg: BenchConfig) -> BenchmarkResults:
             cg_kind += f"+s{cfg.s_step}"
         exec_key = _exec_cache_key(
             cfg, n, res.extra.get("cg_engine_form", "unfused"), cg_kind)
+        tuned = _stamp_tuning(exec_key, res)
+        if tuned and engine and tuned.get("window_kib"):
+            # tuned scoped-VMEM window beats the plan's static estimate;
+            # compile-option only, numerics untouched
+            compile_opts = scoped_vmem_options(int(tuned["window_kib"]))
         obs = BenchObserver(cfg)
         run_ck = ck_store = ck_saves = ck_sdc = None
         ck_restored = 0

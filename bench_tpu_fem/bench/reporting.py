@@ -93,4 +93,9 @@ def results_json(cfg: BenchConfig, res: BenchmarkResults) -> str:
     for key in ("sdc", "sdc_gate_reason"):
         if key in res.extra:
             root["output"][key] = res.extra[key]
+    # tuning stamp (ISSUE 16): which build parameters ran — source=db
+    # with the entry's evidence label and round, or source=default with
+    # the registered fallback reason (never silent defaults)
+    if "tuning" in res.extra:
+        root["output"]["tuning"] = res.extra["tuning"]
     return json.dumps(root)

@@ -11,6 +11,7 @@ from functools import partial
 import numpy as np
 
 from ..bench.driver import _fence_scalar, record_engine
+from ..engines.registry import GATE_REASONS
 from ..la.cg import cg_solve
 from ..obs import trace as obs_trace
 from ..obs.trace import BenchObserver
@@ -600,31 +601,23 @@ def run_distributed(cfg, res, dtype):
             from ..bench.driver import BATCHED_UNFUSED_REASON, stamp_nrhs
 
             if not cfg.use_cg:
-                raise ValueError(
-                    "batched multi-RHS (nrhs>1) sharded runs require "
-                    "--cg; batched sharded action is unsupported")
+                raise ValueError(GATE_REASONS["batched-sharded-action"])
             if folded:
-                raise ValueError(
-                    "batched multi-RHS sharded CG supports the kron and "
-                    "xla backends; the folded (pallas) sharded batch "
-                    "form is unsupported")
+                raise ValueError(GATE_REASONS["batched-sharded-folded"])
             record_engine(res.extra, False, error=BATCHED_UNFUSED_REASON)
             stamp_nrhs(res.extra, cfg.nrhs, cfg.checkpoint_every)
             if cfg.convergence:
                 res.extra["convergence_gate_reason"] = (
-                    "batched sharded CG has no wired capture form; "
-                    "convergence capture disabled for this run")
+                    GATE_REASONS["convergence-batched-sharded"])
             if cfg.precond != "none":
                 from ..bench.driver import stamp_precond
 
                 stamp_precond(res.extra, cfg, gate_reason=(
-                    "batched sharded CG has no wired preconditioner; "
-                    "precond disabled for this run"))
+                    GATE_REASONS["precond-batched-sharded"]))
             if cfg.s_step > 1:
                 res.extra["s_step"] = int(cfg.s_step)
                 res.extra["s_step_gate_reason"] = (
-                    "batched sharded CG has no s-step form; running the "
-                    "fused-dot3 single-reduction recurrence")
+                    GATE_REASONS["sstep-batched-sharded"])
             if kron:
                 from .kron import make_kron_batched_cg_fn
 
@@ -650,9 +643,7 @@ def run_distributed(cfg, res, dtype):
                 overlap_on = False
             if cfg.convergence:
                 res.extra["convergence_gate_reason"] = (
-                    "convergence capture is not wired through the "
-                    "checkpointable chunked loop; capture disabled for "
-                    "this checkpointed run")
+                    GATE_REASONS["convergence-checkpoint"])
             if cfg.precond != "none":
                 from ..bench.driver import stamp_precond
                 from ..la.precond import PRECOND_GATE_REASONS
@@ -663,8 +654,7 @@ def run_distributed(cfg, res, dtype):
             if cfg.s_step > 1:
                 res.extra["s_step"] = int(cfg.s_step)
                 res.extra["s_step_gate_reason"] = (
-                    "s-step is not wired through the checkpointable "
-                    "chunked loop; running the standard recurrence")
+                    GATE_REASONS["sstep-checkpoint"])
             run_ck, ck_store, ck_restored, ck_saves = (
                 _make_dist_checkpointed_cg(cfg, res, obs, op, dgrid, u,
                                            kron))
@@ -677,8 +667,7 @@ def run_distributed(cfg, res, dtype):
                 # apply yet — recorded, runs the standard whole-solve
                 # executable with snapshots disabled
                 res.extra["checkpoint_gate_reason"] = (
-                    "sharded folded (pallas) backend has no checkpointable "
-                    "unfused form; snapshots disabled for this run")
+                    GATE_REASONS["checkpoint-folded-sharded"])
             # convergence capture (ISSUE 10): the history buffer rides
             # the unfused sharded CG (la.cg capture through the psum'd
             # owned-dof dots); the fused/overlap engine forms gate off
@@ -686,9 +675,7 @@ def run_distributed(cfg, res, dtype):
             if cfg.convergence:
                 if folded:
                     res.extra["convergence_gate_reason"] = (
-                        "sharded folded (pallas) backend has no "
-                        "capture-able unfused CG form; convergence "
-                        "capture disabled for this run")
+                        GATE_REASONS["convergence-folded-sharded"])
                 else:
                     from ..bench.driver import CONVERGENCE_GATE_REASON
 
@@ -733,14 +720,9 @@ def run_distributed(cfg, res, dtype):
                         want_sstep = False
                         res.extra["s_step"] = int(cfg.s_step)
                         res.extra["s_step_gate_reason"] = (
-                            "sharded folded (pallas) backend has no "
-                            "s-step form; running the standard "
-                            "recurrence")
+                            GATE_REASONS["sstep-folded-sharded"])
                 elif pre_kind == "pmg":
-                    pre_gate = (
-                        "sharded p-multigrid transfers are not wired "
-                        "(single-chip only today); precond disabled "
-                        "for this run")
+                    pre_gate = GATE_REASONS["precond-pmg-sharded"]
                     pre_kind = None
                 if cfg.precond != "none" and pre_kind is None:
                     stamp_precond(res.extra, cfg, gate_reason=pre_gate)
@@ -748,9 +730,7 @@ def run_distributed(cfg, res, dtype):
                     want_sstep = False
                     res.extra["s_step"] = int(cfg.s_step)
                     res.extra["s_step_gate_reason"] = (
-                        "s-step with preconditioning has no "
-                        "communication-avoiding PCG form; running the "
-                        "preconditioned recurrence")
+                        GATE_REASONS["sstep-precond"])
                 if (pre_kind or want_sstep) and res.extra.get("cg_engine"):
                     record_engine(res.extra, False)
                     overlap_on = False
@@ -761,8 +741,7 @@ def run_distributed(cfg, res, dtype):
                     else:
                         res.extra.setdefault(
                             "s_step_gate_reason",
-                            "s-step rides the unfused sharded loop; the "
-                            "fused engine bakes the standard recurrence")
+                            GATE_REASONS["sstep-engine-sharded"])
                     if kron:
                         compile_opts = None
                 if pre_kind:
@@ -901,8 +880,7 @@ def run_distributed(cfg, res, dtype):
                 # same recorded gate as the single-chip driver: capture
                 # was requested but action runs carry no residual
                 res.extra["convergence_gate_reason"] = (
-                    "convergence capture applies to CG solves only "
-                    "(action runs carry no residual); capture disabled")
+                    GATE_REASONS["convergence-action"])
             if cfg.precond != "none":
                 from ..bench.driver import stamp_precond
                 from ..la.precond import PRECOND_GATE_REASONS
@@ -912,8 +890,7 @@ def run_distributed(cfg, res, dtype):
             if cfg.s_step > 1:
                 res.extra["s_step"] = int(cfg.s_step)
                 res.extra["s_step_gate_reason"] = (
-                    "s-step applies to CG solves only; running the "
-                    "standard action loop")
+                    GATE_REASONS["sstep-action"])
 
             def _compile_action(ap, opts):
                 def _rep(i, y, x, a):
@@ -1111,9 +1088,10 @@ def _run_distributed_folded_df(cfg, res):
     t = build_operator_tables(cfg.degree, cfg.qmode, rule)
     supported, _, kib = folded_df_plan(cfg.degree, t.nq)
     if not supported:
-        return fallback(
-            f"folded-df plan: degree {cfg.degree} qmode {cfg.qmode} "
-            "exceeds the df VMEM model (no 128-lane folded df kernel)")
+        from ..engines.registry import gate_reason
+
+        return fallback(gate_reason("df-plan-unsupported",
+                                    degree=cfg.degree, qmode=cfg.qmode))
     mesh = create_box_mesh(n, cfg.geom_perturb_fact)
     res.ncells_global = global_ncells(n)
     res.ndofs_global = global_ndofs(n, cfg.degree)
@@ -1127,8 +1105,7 @@ def _run_distributed_folded_df(cfg, res):
         # the folded df CG's residual rides the kernel chain — no
         # per-iteration buffer to capture into (recorded, never silent)
         res.extra["convergence_gate_reason"] = (
-            "sharded folded-df pipeline has no capture-able loop form; "
-            "convergence capture disabled for this run")
+            GATE_REASONS["convergence-folded-df-sharded"])
 
     # Host-assembled f64 RHS split into df channels and sharded per
     # channel. O(global-dof) host arrays — accepted on this path (the
@@ -1178,7 +1155,10 @@ def _run_distributed_folded_df(cfg, res):
                 fn = compile_lowered(low, compile_opts,
                                      cpu_extra=CPU_DF_DIST_OPTIONS)
         except Exception as exc:
-            return fallback("folded-df compile failed: " + exc_str(exc))
+            from ..engines.registry import gate_reason
+
+            return fallback(gate_reason("df-compile-failed",
+                                        error=exc_str(exc)))
         with obs.phase("transfer"):
             warm = fn(u, *run_args)
             float(warm.hi[(0,) * warm.hi.ndim])
@@ -1235,9 +1215,10 @@ def run_distributed_df64(cfg, res):
     if cfg.geom_perturb_fact != 0.0:
         return _run_distributed_folded_df(cfg, res)
     if cfg.backend not in ("auto", "kron"):
-        raise ValueError("f64_impl='df32' runs the kron path on uniform "
-                         f"meshes; --backend {cfg.backend} is not "
-                         "supported with it")
+        from ..engines.registry import gate_reason
+
+        raise ValueError(gate_reason("df-backend-kron",
+                                     backend=cfg.backend))
     dgrid = make_device_grid(cfg.ndevices)
     n = compute_mesh_size_sharded(cfg.ndofs_global, cfg.degree, dgrid.dshape)
     rule = "gauss" if cfg.use_gauss else "gll"
@@ -1293,15 +1274,12 @@ def run_distributed_df64(cfg, res):
             from .kron_df import make_kron_df_batched_cg_fn
 
             if not cfg.use_cg:
-                raise ValueError(
-                    "batched multi-RHS (nrhs>1) sharded df runs require "
-                    "--cg; batched sharded df action is unsupported")
+                raise ValueError(GATE_REASONS["batched-sharded-df-action"])
             record_engine(res.extra, False, error=BATCHED_UNFUSED_REASON)
             stamp_nrhs(res.extra, cfg.nrhs, cfg.checkpoint_every)
             if cfg.convergence:
                 res.extra["convergence_gate_reason"] = (
-                    "batched sharded df CG has no wired capture form; "
-                    "convergence capture disabled for this run")
+                    GATE_REASONS["convergence-batched-df-sharded"])
             _, _, norm_fn, norms_from = make_kron_df_sharded_fns(
                 op, dgrid, cfg.nreps, engine=False)
             sc = jnp.asarray(batch_scales(cfg.nrhs), jnp.float32)
@@ -1331,8 +1309,7 @@ def run_distributed_df64(cfg, res):
             conv_on = cfg.convergence and cfg.use_cg
             if cfg.convergence and not cfg.use_cg:
                 res.extra["convergence_gate_reason"] = (
-                    "convergence capture applies to CG solves only "
-                    "(action runs carry no residual); capture disabled")
+                    GATE_REASONS["convergence-action"])
             if conv_on and engine:
                 from ..bench.driver import CONVERGENCE_GATE_REASON
 

@@ -637,12 +637,12 @@ def resolve_folded_overlap(op: DistFoldedLaplacian) -> tuple[bool, str | None]:
     diverge from the routing."""
     from .folded_cg import supports_dist_folded_overlap
 
+    from ..engines.registry import GATE_REASONS
+
     if not resolve_folded_engine(op):
-        return False, ("overlap form rides the fused folded engine; the "
-                       "engine is unavailable here (per-shard input ring "
-                       "past MAX_RING_BLOCKS or non-f32)")
+        return False, GATE_REASONS["overlap-engine-folded"]
     if not supports_dist_folded_overlap(op):
-        return False, "folded overlap plan gate"
+        return False, GATE_REASONS["overlap-plan-folded"]
     return True, None
 
 

@@ -308,14 +308,12 @@ def resolve_kron_overlap(op: DistKronLaplacian) -> tuple[bool, str | None]:
     diverge from the routing."""
     from .kron_cg import supports_dist_kron_overlap
 
+    from ..engines.registry import GATE_REASONS
+
     if not resolve_kron_engine(op):
-        return False, ("overlap form rides the fused engine; the engine "
-                       "is unavailable here (non-pallas impl or ring "
-                       "past every scoped-VMEM tier)")
+        return False, GATE_REASONS["overlap-engine-kron"]
     if not supports_dist_kron_overlap(op):
-        return False, ("ext2d overlap keeps the whole-slab r update as "
-                       "one XLA pass; this shard is past the whole-"
-                       "vector fusion wall (PALLAS_UPDATE_MIN_DOFS)")
+        return False, GATE_REASONS["overlap-fusion-wall-kron"]
     return True, None
 
 

@@ -466,14 +466,12 @@ def resolve_df_overlap(op: DistKronLaplacianDF) -> tuple[bool, str | None]:
     routing."""
     from .kron_cg_df import supports_dist_df_overlap
 
+    from ..engines.registry import GATE_REASONS
+
     if not resolve_df_engine(op):
-        return False, ("overlap form rides the fused df engine; the "
-                       "engine is unavailable here (non-TPU backend or "
-                       "ring past every scoped-VMEM tier)")
+        return False, GATE_REASONS["overlap-engine-df"]
     if not supports_dist_df_overlap(op):
-        return False, ("df overlap keeps the whole-slab df r update as "
-                       "one XLA pass; this shard is past the whole-"
-                       "vector fusion wall (PALLAS_UPDATE_MIN_DOFS)")
+        return False, GATE_REASONS["overlap-fusion-wall-df"]
     return True, None
 
 

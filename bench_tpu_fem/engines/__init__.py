@@ -1,0 +1,59 @@
+"""Declarative engine registry + on-chip autotuner (ISSUE 16).
+
+`registry.py` is the single source of truth for the engine matrix the
+drivers grew implicitly: one `EngineSpec` table (form x precision x
+geometry x sharding x nrhs policy, each with its capability predicate,
+VMEM plan ref and analysis-config refs) plus the full gate-reason
+vocabulary — `bench/driver.py` routing, `dist/driver.py` routing,
+`serve/engine.py` capability checks, the exec-cache/artifact key
+construction and the `analysis/configs.py` list are all DERIVED from it.
+
+`autotune.py` is the deterministic sweep harness on top: candidate
+tile/window/iter-chunk/nreps parameters generated from the registry's
+VMEM plans, filtered by the analysis byte budgets (CPU-provable),
+persisted in a durable tuning database keyed exactly like the
+executable cache, consumed by driver and serve builds with a recorded
+`tuning` evidence stamp.
+"""
+
+from .registry import (
+    ENGINE_SPECS,
+    GATE_REASONS,
+    EngineSpec,
+    analysis_plan,
+    bench_engine_form,
+    gate_reason,
+    is_registered_reason,
+    make_cache_key,
+    planned_engine_form,
+    resolve_backend,
+    specs,
+)
+from .autotune import (
+    TuningDB,
+    default_tuning_db,
+    generate_candidates,
+    run_sweep,
+    tuning_lookup,
+    tuning_stamp,
+)
+
+__all__ = [
+    "ENGINE_SPECS",
+    "GATE_REASONS",
+    "EngineSpec",
+    "TuningDB",
+    "analysis_plan",
+    "bench_engine_form",
+    "default_tuning_db",
+    "gate_reason",
+    "generate_candidates",
+    "is_registered_reason",
+    "make_cache_key",
+    "planned_engine_form",
+    "resolve_backend",
+    "run_sweep",
+    "specs",
+    "tuning_lookup",
+    "tuning_stamp",
+]

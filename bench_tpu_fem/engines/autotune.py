@@ -1,0 +1,456 @@
+"""On-chip autotuner with a persisted, trend-gated tuning database
+(ISSUE 16): a deterministic sweep harness that, per (degree, engine,
+precision, sharding) slice, explores tile/window/iter-chunk/nreps
+candidates generated from the registry's VMEM plans, filters them
+through the analysis byte/VMEM budgets (CPU-provable — no hardware
+needed to PROVE a candidate fits), scores them, and persists winners in
+a durable tuning database keyed EXACTLY like the executable cache
+(`serve.cache.ExecutableKey`, sha-addressed via
+`serve.artifacts.key_hash`).
+
+Database file format — the `harness.checkpoint` / `serve.artifacts`
+write-and-validate discipline applied to tuning state:
+
+    <path>.tmp  <- MAGIC | payload_len | crc32 | JSON payload
+    flush + fsync, os.replace -> <path>, fsync(directory)
+
+The JSON payload is `{"version": 1, "entries": {key_hash: entry}}`;
+every entry embeds its FULL key dict, and `lookup` re-validates
+`key_hash(embedded key) == address` AND embedded key == requested key —
+a renamed, collided or repointed entry is refused (counted
+`collisions`), a torn or bit-flipped file reads as an empty DB (counted
+`corrupt`), and both degrade to ONE counted fallback-to-defaults, never
+a crash or a silently wrong tile plan.
+
+Evidence contract: every winner carries a round-stamp plus a
+cpu-measured / design-estimate / hardware label; consumers stamp a
+`tuning` evidence block (source=db/default, the label, and a REGISTERED
+fallback reason when defaults are in effect) that `obs/regress.py`
+trend-tracks and the perfgate counters (`tuning_db_hits`,
+`tuning_fallbacks`, label presence) gate.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import struct
+import threading
+import zlib
+
+from . import registry
+
+MAGIC = b"BTFTUNE1"
+_HEADER = struct.Struct(">QI")  # payload length, crc32
+DB_VERSION = 1
+
+#: environment knob the drivers/serve consult for the process-wide DB
+DB_ENV = "BTF_TUNING_DB"
+
+#: evidence labels a tuning entry may carry (the measurement-hygiene
+#: vocabulary, ROADMAP item 7)
+LABELS = ("cpu-measured", "design-estimate", "hardware")
+
+
+def _key_dict(key) -> dict:
+    from ..serve.artifacts import key_dict
+
+    return key_dict(key)
+
+
+def _key_hash(key) -> str:
+    from ..serve.artifacts import key_hash
+
+    return key_hash(key)
+
+
+class TuningDB:
+    """One durable tuning database file. Thread-safe; counters mirror
+    the artifact store's evidence discipline: lookups / hits /
+    fallbacks / corrupt / collisions / puts. A missing, torn, corrupt
+    or version-mismatched file behaves as an empty DB (every lookup a
+    counted fallback), never a crash."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._lock = threading.Lock()
+        self.lookups = 0
+        self.hits = 0
+        self.fallbacks = 0
+        self.corrupt = 0
+        self.collisions = 0
+        self.puts = 0
+        self._entries: dict[str, dict] = {}
+        self._loaded_ok = self._load()
+
+    # -- read ---------------------------------------------------------------
+
+    def _load(self) -> bool:
+        """Validate + load the DB file into memory. Magic, header
+        length, CRC, JSON shape and version are all checked; any
+        failure counts `corrupt` once and leaves the DB empty."""
+        try:
+            with open(self.path, "rb") as fh:
+                if fh.read(len(MAGIC)) != MAGIC:
+                    return self._count_corrupt()
+                head = fh.read(_HEADER.size)
+                if len(head) != _HEADER.size:
+                    return self._count_corrupt()
+                length, crc = _HEADER.unpack(head)
+                payload = fh.read(length + 1)
+        except FileNotFoundError:
+            return True  # absent is a legitimate empty DB, not corrupt
+        except OSError:
+            return self._count_corrupt()
+        if len(payload) != length or zlib.crc32(payload) != crc:
+            return self._count_corrupt()
+        try:
+            doc = json.loads(payload.decode())
+        except (ValueError, UnicodeDecodeError):
+            return self._count_corrupt()
+        if not isinstance(doc, dict) or doc.get("version") != DB_VERSION:
+            return self._count_corrupt()
+        entries = doc.get("entries")
+        if not isinstance(entries, dict):
+            return self._count_corrupt()
+        self._entries = entries
+        return True
+
+    def _count_corrupt(self) -> bool:
+        with self._lock:
+            self.corrupt += 1
+        self._entries = {}
+        return False
+
+    def lookup(self, key) -> dict | None:
+        """The validated tuning entry for `key`, or None (counted
+        fallback). The embedded key must equal the requested key — a
+        hash-addressed entry holding a different key is a collision,
+        refused and counted, exactly like the artifact store."""
+        from ..serve.artifacts import key_from_dict
+
+        with self._lock:
+            self.lookups += 1
+        entry = self._entries.get(_key_hash(key))
+        if entry is None:
+            with self._lock:
+                self.fallbacks += 1
+            return None
+        try:
+            embedded = key_from_dict(entry.get("key", {}))
+        except (KeyError, TypeError, ValueError):
+            with self._lock:
+                self.corrupt += 1
+                self.fallbacks += 1
+            return None
+        if embedded != key:
+            with self._lock:
+                self.collisions += 1
+                self.fallbacks += 1
+            return None
+        with self._lock:
+            self.hits += 1
+        return entry
+
+    def entries(self) -> list[dict]:
+        """Every loaded entry (already validated at load time)."""
+        return list(self._entries.values())
+
+    # -- write --------------------------------------------------------------
+
+    def put(self, key, params: dict, *, score: float, label: str,
+            engine: str, round_stamp: str, source: str = "sweep",
+            extra: dict | None = None) -> dict:
+        """Record one winner under `key` and durably rewrite the DB
+        (tmp + fsync + os.replace + directory fsync — the
+        harness.checkpoint discipline). Labels outside the evidence
+        vocabulary are refused loudly: an unlabelled winner would evade
+        the perfgate label-presence counter."""
+        if label not in LABELS:
+            raise ValueError(
+                f"tuning label {label!r} not in {LABELS} — every entry "
+                "must carry a measurement-hygiene label")
+        entry = {
+            "key": _key_dict(key),
+            "engine": engine,
+            "params": dict(params),
+            "score": float(score),
+            "label": label,
+            "round": round_stamp,
+            "source": source,
+        }
+        if extra:
+            entry.update(extra)
+        with self._lock:
+            self._entries[_key_hash(key)] = entry
+            self.puts += 1
+        self._write()
+        return entry
+
+    def _write(self) -> None:
+        payload = json.dumps(
+            {"version": DB_VERSION, "entries": self._entries},
+            sort_keys=True).encode()
+        d = os.path.dirname(self.path) or "."
+        os.makedirs(d, exist_ok=True)
+        tmp = self.path + ".tmp"
+        with open(tmp, "wb") as fh:
+            fh.write(MAGIC)
+            fh.write(_HEADER.pack(len(payload), zlib.crc32(payload)))
+            fh.write(payload)
+            fh.flush()
+            os.fsync(fh.fileno())
+        os.replace(tmp, self.path)
+        try:
+            fd = os.open(d, os.O_RDONLY)
+            try:
+                os.fsync(fd)
+            finally:
+                os.close(fd)
+        except OSError:
+            pass  # some filesystems refuse directory fsync; best-effort
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "entries": len(self._entries),
+                "lookups": self.lookups,
+                "hits": self.hits,
+                "fallbacks": self.fallbacks,
+                "corrupt": self.corrupt,
+                "collisions": self.collisions,
+                "puts": self.puts,
+                "labels_ok": all(
+                    e.get("label") in LABELS
+                    for e in self._entries.values()),
+            }
+
+
+# Process-wide default DB, resolved from $BTF_TUNING_DB once per path —
+# the drivers and the serve engine consult this; tests and perfgate point
+# it at their own temp files via the env var.
+_DEFAULT: TuningDB | None = None
+_DEFAULT_LOCK = threading.Lock()
+
+
+def default_tuning_db() -> TuningDB | None:
+    """The env-configured process DB, or None when tuning is disabled
+    (no $BTF_TUNING_DB). Re-resolved when the env var changes path, so
+    a test/perfgate leg can swap databases mid-process."""
+    global _DEFAULT
+    path = os.environ.get(DB_ENV)
+    if not path:
+        return None
+    with _DEFAULT_LOCK:
+        if _DEFAULT is None or _DEFAULT.path != path:
+            _DEFAULT = TuningDB(path)
+        return _DEFAULT
+
+
+def reset_default_db() -> None:
+    """Drop the cached process DB (tests use this to force a re-read of
+    a file they rewrote outside the API)."""
+    global _DEFAULT
+    with _DEFAULT_LOCK:
+        _DEFAULT = None
+
+
+# ---------------------------------------------------------------------------
+# Candidate generation + the deterministic sweep
+# ---------------------------------------------------------------------------
+
+#: the scoped-VMEM request ladder candidates sweep over, in KiB
+#: (0 = the Mosaic default tier — analysis.budgets.scoped_limit_bytes(None));
+#: the non-zero rungs are the tiers the shipped plans actually request
+WINDOW_TIERS_KIB = (0, 32768, 65536, 98304)
+
+def generate_candidates(*, degree: int, grid_shape, nrhs_bucket: int = 1,
+                        nreps: int = 30) -> list[dict]:
+    """Deterministic tile/window/iter-chunk/nreps candidate set for one
+    (degree, grid) slice, generated from the registry's VMEM plan: the
+    plan's achieved form seeds the form axis, the scoped-VMEM tier
+    ladder (the same rungs the shipped plans request) is the window
+    axis, and iteration chunks sweep the powers of two up to the solve
+    length. Pure and ordered — identical inputs always yield the
+    identical candidate list (the perfgate autotune leg pins the sweep
+    byte-for-byte)."""
+    from ..ops.kron_cg import engine_plan
+
+    form, kib = engine_plan(tuple(grid_shape), degree)
+    windows = sorted({int(kib or 0), *WINDOW_TIERS_KIB})
+    chunks = [c for c in (1, 2, 4, 8) if c <= max(1, nreps)]
+    out = []
+    for w in windows:
+        for c in chunks:
+            out.append({
+                "plan_form": form,
+                "window_kib": int(w),
+                "iter_chunk": int(c),
+                "nreps": int(nreps),
+            })
+    return out
+
+
+def _candidate_cost(cand: dict, *, degree: int, grid_shape,
+                    nrhs_bucket: int) -> float:
+    """Deterministic design-estimate cost model, used to RANK admitted
+    candidates on CPU (hardware runs replace it with measured wall
+    time): iteration-boundary sync cost amortises with larger chunks
+    while continuous-batching latency grows with them (U-shaped,
+    minimised at the registry default), and a smaller admitted scoped
+    tier beats a larger one (less VMEM pressure for the same fit)."""
+    chunk = max(1, cand["iter_chunk"])
+    boundary_cost = 1.0 / chunk
+    batching_cost = chunk / 16.0
+    tier_cost = cand["window_kib"] / (1024.0 * 1024.0)  # prefer small tiers
+    return boundary_cost + batching_cost + tier_cost
+
+
+def _fits_budget(cand: dict, *, degree: int, grid_shape) -> bool:
+    """CPU-provable admission filter: the engine's VMEM byte estimate
+    must fit the candidate's scoped-VMEM tier (analysis.budgets — the
+    same byte model rules.R2 cross-checks against captures)."""
+    from ..analysis.budgets import scoped_limit_bytes
+    from ..ops.kron_cg import engine_vmem_bytes
+
+    limit = scoped_limit_bytes(cand["window_kib"] or None)
+    return engine_vmem_bytes(tuple(grid_shape), degree) <= limit
+
+
+def run_sweep(db: TuningDB, *, degree: int, ndofs: int, precision: str,
+              geom: str, nrhs_bucket: int = 1, nreps: int = 30,
+              device_mesh=(1, 1, 1), round_stamp: str = "r06",
+              time_candidates: bool = False) -> dict:
+    """One deterministic autotune sweep for a (degree, engine,
+    precision, sharding) slice: generate candidates from the registry
+    plan, drop the ones the analysis budgets refuse (each drop
+    recorded — no silent truncation), score the rest, persist the
+    winner. On CPU the score is the design-estimate cost model (label
+    `design-estimate`) unless `time_candidates` asks for interpret-mode
+    timing (label `cpu-measured`); on TPU the label is `hardware`.
+    Returns {key, winner, candidates, rejected, label}."""
+    import time as _time
+
+    import jax
+
+    from ..mesh.dofmap import dof_grid_shape
+    from ..mesh.sizing import compute_mesh_size
+
+    n = compute_mesh_size(ndofs, degree)
+    grid = dof_grid_shape(n, degree)
+    form = registry.planned_engine_form(
+        precision, geom, ndofs, degree, nrhs_bucket)
+    key = registry.make_cache_key(
+        degree=degree, cell_shape=n, precision=precision, geom=geom,
+        engine_form=form, nrhs_bucket=nrhs_bucket,
+        device_mesh=device_mesh, nreps=nreps)
+
+    cands = generate_candidates(degree=degree, grid_shape=grid,
+                                nrhs_bucket=nrhs_bucket, nreps=nreps)
+    admitted, rejected = [], []
+    for c in cands:
+        (admitted if _fits_budget(c, degree=degree, grid_shape=grid)
+         else rejected).append(c)
+    if not admitted:
+        # every candidate over budget: record the registry default as
+        # the (design-estimate) winner rather than leaving the slice
+        # silently untuned under a sweep that claims to have run
+        admitted = [{"plan_form": form, "window_kib": 0,
+                     "iter_chunk": registry.DEFAULT_ITER_CHUNK,
+                     "nreps": nreps}]
+
+    on_tpu = jax.default_backend() == "tpu"
+    label = "hardware" if on_tpu else (
+        "cpu-measured" if time_candidates else "design-estimate")
+    scored = []
+    for c in admitted:
+        if on_tpu or time_candidates:
+            # measured path: one tiny timed apply per candidate through
+            # the existing harness timing discipline (compile excluded)
+            t0 = _time.perf_counter()
+            _probe_candidate(c, degree=degree, ndofs=ndofs,
+                             precision=precision, geom=geom)
+            score = _time.perf_counter() - t0
+        else:
+            score = _candidate_cost(c, degree=degree, grid_shape=grid,
+                                    nrhs_bucket=nrhs_bucket)
+        scored.append((score, c))
+    best_score, winner = min(scored, key=lambda sc: sc[0])
+    engine_name = ("kron_fused_batched" if form == "one_kernel_batched"
+                   else ("kron_fused" if geom == "uniform" else
+                         "xla_unfused"))
+    entry = db.put(key, winner, score=best_score, label=label,
+                   engine=engine_name, round_stamp=round_stamp)
+    return {"key": _key_dict(key), "winner": winner,
+            "score": best_score, "label": label, "entry": entry,
+            "candidates": len(admitted), "rejected": len(rejected)}
+
+
+def _probe_candidate(cand: dict, *, degree: int, ndofs: int,
+                     precision: str, geom: str) -> None:
+    """One warm apply at the candidate's shape — the measured-path
+    probe. Deliberately tiny (the sweep is a ranking, not a benchmark);
+    the full timing path re-validates winners in the agenda stage."""
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ..mesh.dofmap import dof_grid_shape
+    from ..mesh.sizing import compute_mesh_size
+    from ..ops.kron import build_kron_laplacian
+    from ..mesh.box import create_box_mesh
+
+    n = compute_mesh_size(ndofs, degree)
+    mesh = create_box_mesh(n, geom_perturb_fact=0.0)
+    op = build_kron_laplacian(mesh, degree, qmode=1, dtype=jnp.float32)
+    grid = dof_grid_shape(n, degree)
+    x = jnp.asarray(np.linspace(0.0, 1.0, int(np.prod(grid)),
+                                dtype=np.float32).reshape(grid))
+    y = op.apply(x)
+    y.block_until_ready()
+
+
+# ---------------------------------------------------------------------------
+# Build-time consumption (drivers + serve fleet)
+# ---------------------------------------------------------------------------
+
+def tuning_lookup(key, db: TuningDB | None = None
+                  ) -> tuple[dict | None, dict]:
+    """(entry-or-None, tuning evidence stamp) for one executable key.
+    The stamp ALWAYS exists — source=db with the entry's label and
+    round when tuned, source=default with a REGISTERED fallback reason
+    otherwise — so the journal records why defaults ran, never silence.
+    """
+    if db is None:
+        db = default_tuning_db()
+    if db is None:
+        return None, {
+            "source": "default",
+            "label": "design-estimate",
+            "fallback_reason": registry.gate_reason("tuning-disabled"),
+        }
+    entry = db.lookup(key)
+    if entry is None:
+        slug = ("tuning-db-invalid"
+                if (db.corrupt or db.collisions) else
+                "tuning-entry-missing")
+        return None, {
+            "source": "default",
+            "label": "design-estimate",
+            "fallback_reason": registry.gate_reason(slug),
+        }
+    return entry, {
+        "source": "db",
+        "label": entry.get("label"),
+        "round": entry.get("round"),
+        "params": dict(entry.get("params", {})),
+    }
+
+
+def tuning_stamp(extra: dict, key, db: TuningDB | None = None) -> dict | None:
+    """Look up tuned parameters for `key` and stamp the `tuning`
+    evidence block into `extra` (the drivers' results.extra / the serve
+    solver's batch extra). Returns the entry's params dict when tuned,
+    else None (defaults in effect, reason recorded)."""
+    entry, stamp = tuning_lookup(key, db)
+    extra["tuning"] = stamp
+    return dict(entry["params"]) if entry else None

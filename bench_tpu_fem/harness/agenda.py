@@ -320,6 +320,38 @@ assert res.extra.get("cg_engine_form") == "ext2d_overlap", res.extra
 """
 
 
+AUTOTUNE = """
+import json, os
+import jax
+round_tag = os.environ.get('MEASURE_ROUND', 'r06')
+os.environ.setdefault('BTF_TUNING_DB',
+                      os.path.join(os.getcwd(), f'TUNING_{round_tag}.db'))
+from bench_tpu_fem.engines.autotune import LABELS, default_tuning_db, run_sweep
+db = default_tuning_db()
+on_tpu = jax.default_backend() == 'tpu'
+ndofs = 50_000 if on_tpu else 2000
+sweeps = []
+for degree, bucket in ((3, 2), (3, 4), (3, 8), (6, 4)):
+    out = run_sweep(db, degree=degree, ndofs=ndofs, precision='f32',
+                    geom='uniform', nrhs_bucket=bucket, nreps=30,
+                    round_stamp=round_tag, time_candidates=on_tpu)
+    sweeps.append({'degree': degree, 'bucket': bucket,
+                   'label': out['label'], 'winner': out['winner'],
+                   'rejected': out['rejected']})
+stats = db.stats()
+assert stats['labels_ok'], stats
+# consumption check: a serve build must read its swept entry back with
+# the tuning evidence stamped (source=db + registered label)
+from bench_tpu_fem.serve.engine import CompiledSolver, SolveSpec
+sol = CompiledSolver(SolveSpec(degree=3, ndofs=ndofs, nreps=30), 4)
+assert sol.tuning['source'] == 'db', sol.tuning
+assert sol.tuning['label'] in LABELS, sol.tuning
+print(json.dumps({'metric': 'autotune',
+                  'autotune_db': os.environ['BTF_TUNING_DB'],
+                  'sweeps': sweeps, 'stats': stats,
+                  'consumed': sol.tuning}))
+"""
+
 FUSEDBATCH = PRE + """
 # The nrhs-native fused batched kron engine (ISSUE 6) on hardware:
 # batched GDoF/s at the serve buckets vs the unfused vmapped fallback,
@@ -433,6 +465,13 @@ def make_stages(round_tag: str = DEFAULT_ROUND) -> dict[str, Stage]:
         # hang on a wedged tunnel).
         _script("chaos", ["scripts/chaos_soak.py", "--quick"], 600,
                 env={"JAX_PLATFORMS": "cpu"}),
+        # On-chip autotune sweep (ISSUE 16): persist hardware-labelled
+        # tuning winners per (degree, bucket) slice into the round's
+        # tuning DB BEFORE the bench stages run, so their builds consume
+        # measured parameters (CPU runs label design-estimate; the
+        # evidence stamp records which). The parse line journals the
+        # swept winners + the consumption check's stamp.
+        _py("autotune", AUTOTUNE, 900, parse=last_json_line),
         # The fused batched engine on hardware (ISSUE 6): batched
         # GDoF/s at serve buckets 2/4/8 + the unfused A/B — converts
         # the per-bucket VMEM tiers from design estimates to
@@ -561,7 +600,7 @@ ALIASES = {
 # Round-6 default agenda, ordered by value-per-minute under wedge risk
 # (measure_all's ordering, expanded through ALIASES).
 AGENDAS = {
-    "round6": ["health", "serve", "chaos", "fusedbatch", "dfacc",
+    "round6": ["health", "serve", "chaos", "autotune", "fusedbatch", "dfacc",
                "pertdf", "foldeng", "dfext2d", "scale", "dfeng", "bench",
                "conv", "precond", "dflarge", "pert100", "deg7probe",
                "matrix"],

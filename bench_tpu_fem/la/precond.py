@@ -369,18 +369,15 @@ def build_chebyshev_bundle(apply_A: Callable, dinv, shape, dtype, *,
         state={"dinv": dinv})
 
 
+from ..engines.registry import GATE_REASONS as _GATE_REASONS
+
 #: the recorded reason a driver stamps when a requested preconditioner
 #: cannot run on a path (folded layouts, fused engines, action runs) —
 #: classified `unsupported` by the harness taxonomy, never silent
+#: (texts owned by the registry vocabulary, engines.registry)
 PRECOND_GATE_REASONS = {
-    "engine": ("preconditioned CG (precond != none): the fused "
-               "whole-solve engine bakes the unpreconditioned "
-               "recurrence; running the unfused preconditioned loop"),
-    "action": ("preconditioning applies to CG solves only (action runs "
-               "have no residual equation); precond disabled"),
-    "folded": ("preconditioning is unsupported on the folded (pallas) "
-               "vector layout; precond disabled for this run"),
-    "checkpoint": ("durable checkpointing (checkpoint_every > 0) does "
-                   "not carry the preconditioned recurrence; precond "
-                   "disabled for this checkpointed run"),
+    "engine": _GATE_REASONS["precond-engine"],
+    "action": _GATE_REASONS["precond-action"],
+    "folded": _GATE_REASONS["precond-folded"],
+    "checkpoint": _GATE_REASONS["precond-checkpoint"],
 }

@@ -183,14 +183,13 @@ def sstep_cg_solve(
     return x, info
 
 
+from ..engines.registry import GATE_REASONS as _GATE_REASONS
+
 #: recorded reason when a breakdown routed an s-step run back to the
 #: one-reduction recurrence (la.cg) — the graceful fallback contract
-SSTEP_FALLBACK_REASON = (
-    "s-step CG breakdown (ill-conditioned monomial Gram projection or "
-    "non-SPD step): re-ran the one-reduction recurrence")
+#: (text owned by the registry vocabulary, engines.registry)
+SSTEP_FALLBACK_REASON = _GATE_REASONS["sstep-breakdown"]
 
 #: recorded reason when --s-step is requested on a path without an
 #: s-step form (fused engines, batched stacks, df, folded layout)
-SSTEP_GATE_REASON = (
-    "s-step CG is unsupported on this path (no communication-avoiding "
-    "form); running the standard recurrence")
+SSTEP_GATE_REASON = _GATE_REASONS["sstep-unsupported"]

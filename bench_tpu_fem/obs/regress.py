@@ -360,6 +360,10 @@ LOWER_IS_BETTER_COUNTERS = (
     # exactly that), and an anomalous request on the CLEAN pinned
     # schedule (no injection, no SLO breach) is a serving regression
     "reqtrace_incomplete", "reqtrace_anomalous",
+    # ISSUE 16 autotuner counters on the pinned CPU sweep: a fallback on
+    # a key the sweep just tuned means the DB round-trip broke (torn
+    # write, key drift, corrupt load) — pinned at 0 on the perfgate leg
+    "tuning_fallbacks",
 )
 #: snapshot keys where a DECREASE below baseline is a regression
 HIGHER_IS_BETTER_COUNTERS = (
@@ -378,9 +382,18 @@ HIGHER_IS_BETTER_COUNTERS = (
     # complete phase decomposition — a rate below the pinned 1.0 means
     # a stamp went missing somewhere in the request path
     "reqtrace_complete_rate",
+    # ISSUE 16: every build on the pinned autotune leg must keep finding
+    # its swept entry — a drop means lookups silently stopped consulting
+    # the tuning DB (the exact regression the injected probe simulates)
+    "tuning_db_hits",
 )
 #: contract booleans: baseline True -> current must stay True
-CONTRACT_FLAGS = ("record_contract_ok", "trace_valid")
+CONTRACT_FLAGS = ("record_contract_ok", "trace_valid",
+                  # ISSUE 16: every tuning-DB entry must carry a
+                  # registered provenance label (cpu-measured /
+                  # design-estimate / hardware) — an unlabeled entry is
+                  # evidence without provenance
+                  "tuning_labels_ok")
 
 #: counters whose VALUE is timing-derived (advisory — phase-share drift
 #: never gates, per the ISSUE 15 contract) but whose PRESENCE is the

@@ -322,6 +322,50 @@ def render_convergence(records: list[dict]) -> str:
     return "\n".join(lines) if lines else "(no convergence-stamped records)"
 
 
+def fold_tuning(records: list[dict]) -> dict:
+    """Tuning-evidence rollup for the trend view: every journaled
+    `tuning` stamp (engines.autotune — drivers and serve builds write
+    one per executable-key lookup), counted by source and provenance
+    label. A journal with no stamps folds to a LABELLED GAP, never a
+    zero row (the wedge-honesty rule)."""
+    stamps: list[dict] = []
+    for r in records:
+        for holder in (r, r.get("result") or {}, r.get("extra") or {},
+                       (r.get("result") or {}).get("extra") or {}):
+            t = holder.get("tuning") if isinstance(holder, dict) else None
+            if isinstance(t, dict) and t.get("source"):
+                stamps.append(t)
+                break
+    if not stamps:
+        return {"status": "gap", "reason": "no-tuning-stamps"}
+    by_label: dict[str, int] = {}
+    by_reason: dict[str, int] = {}
+    hits = 0
+    for t in stamps:
+        by_label[t.get("label") or "?"] = (
+            by_label.get(t.get("label") or "?", 0) + 1)
+        if t.get("source") == "db":
+            hits += 1
+        else:
+            reason = t.get("fallback_reason") or "?"
+            by_reason[reason] = by_reason.get(reason, 0) + 1
+    return {"status": "ok", "stamps": len(stamps), "db_hits": hits,
+            "fallbacks": len(stamps) - hits, "labels": by_label,
+            "fallback_reasons": by_reason}
+
+
+def render_tuning(fold: dict) -> str:
+    """The trend's tuning table: db-hit/fallback split, provenance
+    labels, and the registered fallback reasons with counts."""
+    lines = [f"stamps {fold['stamps']}: {fold['db_hits']} tuned (db), "
+             f"{fold['fallbacks']} defaults (reason recorded)"]
+    lines.append("  labels: " + ", ".join(
+        f"{k}={v}" for k, v in sorted(fold["labels"].items())))
+    for reason, n in sorted(fold["fallback_reasons"].items()):
+        lines.append(f"  fallback x{n}: {reason[:80]}")
+    return "\n".join(lines)
+
+
 def render_slo(slo: dict) -> str:
     lines = [f"objective {slo.get('objective_s')}s @ target "
              f"{slo.get('target')} over {slo.get('samples')} responses"]
@@ -374,10 +418,12 @@ def trend_main(argv=None) -> int:
         from .reqtrace import fold_reqtrace
 
         reqtrace = fold_reqtrace(records)
+    tuning = fold_tuning(records) if records else None
     if args.json:
         out = dict(trend)
         out["slo"] = slo
         out["reqtrace"] = reqtrace
+        out["tuning"] = tuning
         # same lookup as render_convergence: the block may ride at top
         # level or nested under `result` (weak-scaling-style records)
         out["convergence_records"] = [
@@ -410,6 +456,16 @@ def trend_main(argv=None) -> int:
                 print(f"   GAP [{reqtrace.get('reason', '?')}] — "
                       "phase shares unavailable for this journal; a "
                       "missing stamp is a gap, never a zero")
+        # autotuner evidence (ISSUE 16): tuned-vs-default split with
+        # provenance labels; a journal that never stamped tuning
+        # renders as a LABELLED GAP, never a zero table
+        print("== tuning")
+        if tuning and tuning.get("status") == "ok":
+            print(render_tuning(tuning))
+        else:
+            reason = (tuning or {}).get("reason", "no-tuning-stamps")
+            print(f"   GAP [{reason}] — no tuning stamps in this "
+                  "journal; a missing stamp is a gap, never a zero")
     return 0
 
 

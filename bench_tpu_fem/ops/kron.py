@@ -294,10 +294,9 @@ def build_kron_laplacian(
     are assembled host-side in f64 and cast once; total operator state is
     O(N) — there is no geometry tensor."""
     if not mesh.is_uniform:
-        raise ValueError(
-            "kron backend requires an unperturbed (uniform) box mesh; "
-            "use the xla/pallas backends for perturbed geometry"
-        )
+        from ..engines.registry import GATE_REASONS
+
+        raise ValueError(GATE_REASONS["kron-perturbed"])
     t = tables or build_operator_tables(degree, qmode, rule)
     Ks, Ms, masks = axis_matrices_1d(t, mesh.n)
     P = degree

@@ -134,21 +134,22 @@ class SolveSpec:
         return "perturbed" if self.geom_perturb_fact != 0.0 else "uniform"
 
     def validate(self) -> None:
+        from ..engines.registry import GATE_REASONS, gate_reason
+
         if not 1 <= self.degree <= 7:
             raise UnsupportedSpec(f"degree {self.degree} unsupported (1-7)")
         if self.precision not in _PRECISIONS:
             raise UnsupportedSpec(
-                f"precision {self.precision!r} unsupported {_PRECISIONS}")
+                gate_reason("serve-precision", precision=repr(self.precision),
+                            precisions=_PRECISIONS))
         if self.precision == "df32" and self.geom != "uniform":
-            raise UnsupportedSpec(
-                "df32 serving requires a uniform mesh (the kron df path); "
-                "perturbed f64-class serving is unsupported here")
+            raise UnsupportedSpec(GATE_REASONS["serve-df32-perturbed"])
         if self.ndofs <= 0 or self.nreps <= 0:
             raise UnsupportedSpec("ndofs and nreps must be positive")
         if self.ndofs > MAX_NDOFS:
             raise UnsupportedSpec(
-                f"ndofs {self.ndofs} exceeds the serving cap "
-                f"{MAX_NDOFS} (engine.MAX_NDOFS) — unsupported")
+                gate_reason("serve-ndofs-cap", ndofs=self.ndofs,
+                            cap=MAX_NDOFS))
 
 
 class UnsupportedSpec(ValueError):
@@ -177,25 +178,22 @@ def planned_engine_form(spec: SolveSpec, bucket: int) -> str:
     cache key: the fused nrhs-native kron ring for f32 uniform specs
     whose bucket fits the per-bucket VMEM plan
     (ops.kron_cg.engine_plan_batched), else the unfused vmapped
-    composition. Unified vocabulary (bench.driver.record_engine)."""
-    if spec.precision == "f32" and spec.geom == "uniform":
-        from ..mesh.dofmap import dof_grid_shape
-        from ..mesh.sizing import compute_mesh_size
-        from ..ops.kron_cg import engine_plan_batched
+    composition. Unified vocabulary (bench.driver.record_engine). The
+    decision table lives in engines.registry; this is a thin delegate
+    kept for the existing call sites."""
+    from ..engines.registry import planned_engine_form as _planned
 
-        n = compute_mesh_size(spec.ndofs, spec.degree)
-        grid = dof_grid_shape(n, spec.degree)
-        if engine_plan_batched(grid, spec.degree, bucket)[0] != "unfused":
-            return "one_kernel_batched"
-    return "unfused"
+    return _planned(spec.precision, spec.geom, spec.ndofs, spec.degree,
+                    bucket)
 
 
 def spec_cache_key(spec: SolveSpec, bucket: int,
                    device_mesh: tuple = (1, 1, 1)) -> ExecutableKey:
+    from ..engines.registry import EngineSpec
     from ..mesh.sizing import compute_mesh_size
 
     cells = compute_mesh_size(spec.ndofs, spec.degree)
-    return ExecutableKey(
+    return EngineSpec.cache_key(
         degree=spec.degree,
         cell_shape=tuple(int(c) for c in cells),
         precision=spec.precision,
@@ -283,7 +281,17 @@ class CompiledSolver:
         b64 = np.asarray(b_host, np.float64)
 
         nreps = spec.nreps
-        self.iter_chunk = min(ITER_CHUNK, nreps)
+        # Tuned build parameters (engines.autotune): the per-key tuning
+        # DB may carry a swept iter_chunk; defaults run with the reason
+        # recorded in the tuning evidence stamp (never silently).
+        from ..engines.autotune import tuning_stamp
+
+        _tux: dict = {}
+        tuned = tuning_stamp(_tux, self.key)
+        self.tuning = _tux["tuning"]
+        chunk = (int(tuned["iter_chunk"])
+                 if tuned and tuned.get("iter_chunk") else ITER_CHUNK)
+        self.iter_chunk = min(chunk, nreps)
         self.supports_continuous = False
         self.continuous_gate_reason = None
         self.engine_form = "unfused"
@@ -376,9 +384,9 @@ class CompiledSolver:
 
             dtype = jnp.float64 if spec.precision == "f64" else jnp.float32
             if spec.precision == "f64" and not jax.config.jax_enable_x64:
-                raise UnsupportedSpec(
-                    "precision 'f64' needs jax_enable_x64 (the serve CLI "
-                    "enables it; in-process callers must)")
+                from ..engines.registry import GATE_REASONS
+
+                raise UnsupportedSpec(GATE_REASONS["serve-f64-x64"])
             # Uniform meshes take the exact Kronecker fast path; general
             # (perturbed) geometry the einsum operator.
             backend = "kron" if spec.geom == "uniform" else "xla"
@@ -505,6 +513,7 @@ class CompiledSolver:
             "bucket": self.bucket,
             "engine_form": self.engine_form,  # the ACHIEVED form
             "engine_fallback_reason": self.engine_fallback_reason,
+            "tuning": self.tuning,
             "compile_s": round(self.compile_s, 6),
             "jax": jax.__version__,
             "backend": jax.default_backend(),

@@ -345,6 +345,65 @@ def main(argv=None) -> int:
                "driver_injected": inj_stamp,
                "rollback_bitwise": sdc_rollback_bitwise}
 
+    # -- autotune leg (ISSUE 16): a deterministic CPU sweep persists a
+    # tuning DB, then a driver build AND a serve build consume the
+    # swept entries — the tuning stamps must read source=db with a
+    # registered provenance label, and the hit/fallback deltas pin in
+    # the counters (an injected probe that zeroes the hits gates rc 1).
+    from bench_tpu_fem.engines.autotune import (
+        DB_ENV,
+        default_tuning_db,
+        reset_default_db,
+        run_sweep,
+    )
+    from bench_tpu_fem.serve.engine import CompiledSolver, SolveSpec
+
+    at_ndofs, at_nreps, at_bucket = 2000, 8, 2
+    os.environ[DB_ENV] = args.out + ".tuningdb"
+    reset_default_db()
+    tdb = default_tuning_db()
+    at_spec = SolveSpec(degree=3, ndofs=at_ndofs, nreps=at_nreps)
+    sweep = run_sweep(tdb, degree=3, ndofs=at_ndofs, precision="f32",
+                      geom="uniform", nrhs_bucket=at_bucket,
+                      nreps=at_nreps, round_stamp="r06")
+    # driver slice: run once untuned to learn the planned engine form
+    # (its stamp records the registered entry-missing reason), seed the
+    # sweep winner under the driver's exact executable key, rerun —
+    # the second build must consume the entry (source=db)
+    from bench_tpu_fem.bench.driver import _exec_cache_key
+    from bench_tpu_fem.mesh.sizing import compute_mesh_size
+
+    at_cfg = BenchConfig(ndofs_global=at_ndofs, degree=3, qmode=1,
+                         float_bits=32, nreps=at_nreps, use_cg=True)
+    res_pre = run_benchmark(at_cfg)
+    pre_stamp = res_pre.extra.get("tuning")
+    at_key = _exec_cache_key(
+        at_cfg, compute_mesh_size(at_ndofs, 3),
+        res_pre.extra.get("cg_engine_form", "unfused"), "cg")
+    tdb.put(at_key, sweep["winner"], score=sweep["score"],
+            label=sweep["label"], engine="kron_fused",
+            round_stamp="r06")
+    s0 = tdb.stats()
+    res_tuned = run_benchmark(at_cfg)
+    driver_stamp = res_tuned.extra.get("tuning")
+    solver_tuned = CompiledSolver(at_spec, at_bucket)
+    serve_stamp = solver_tuned.tuning
+    s1 = tdb.stats()
+    # the persisted file round-trips: a FRESH process-equivalent load
+    # (reset + re-read) must serve the same entries
+    reset_default_db()
+    tdb2 = default_tuning_db()
+    roundtrip_ok = len(tdb2.entries()) == len(tdb.entries()) >= 2
+    autotune_leg = {
+        "sweep": sweep, "pre_stamp": pre_stamp,
+        "driver_stamp": driver_stamp, "serve_stamp": serve_stamp,
+        "db_stats": s1, "roundtrip_ok": roundtrip_ok,
+    }
+    tuning_db_hits = s1["hits"] - s0["hits"]
+    tuning_fallbacks = s1["fallbacks"] - s0["fallbacks"]
+    del os.environ[DB_ENV]
+    reset_default_db()
+
     # -- trace validity + record contract (contract booleans gate)
     from bench_tpu_fem.obs.trace import validate_chrome_trace
 
@@ -415,6 +474,15 @@ def main(argv=None) -> int:
         "sdc_detected": sdc_detected,
         "sdc_missed": sdc_injected - sdc_detected,
         "sdc_false_positives": sdc_falsep,
+        # ISSUE 16 autotuner counters on the pinned sweep-then-consume
+        # schedule: both consumers (driver rerun + serve build) must
+        # find their swept entry (hits in the HIGHER table — the
+        # injected probe zeroes them), zero fallbacks after tuning
+        # (LOWER table), and every DB entry must carry a registered
+        # provenance label (contract flag).
+        "tuning_db_hits": tuning_db_hits,
+        "tuning_fallbacks": tuning_fallbacks,
+        "tuning_labels_ok": s1["labels_ok"],
     }
     snapshot = {
         "workload": {"ndofs": args.ndofs, "nreps": args.nreps,
@@ -429,6 +497,7 @@ def main(argv=None) -> int:
         "serve": serve,
         "fleet": fleet_leg,
         "sdc": sdc_leg,
+        "autotune": autotune_leg,
         "counters": counters,
         "record_contract_errors": record_errs,
         "trace_violations": trace_violations[:5],
@@ -507,6 +576,29 @@ def main(argv=None) -> int:
     if not sdc_rollback_bitwise:
         print("sdc rollback run diverged from the clean run "
               f"(ynorm {inj_ck.ynorm!r} vs {clean_ck.ynorm!r})")
+        return 1
+    # ISSUE-16 acceptance, asserted by the collector itself: the
+    # pre-tune stamp records the registered entry-missing reason, both
+    # consumers read source=db with a registered label, zero fallbacks
+    # after tuning, and the persisted file round-trips a fresh load
+    from bench_tpu_fem.engines.autotune import LABELS
+
+    if (pre_stamp or {}).get("source") != "default":
+        print(f"autotune leg pre-tune stamp not default: {pre_stamp}")
+        return 1
+    for who, stamp in (("driver", driver_stamp), ("serve", serve_stamp)):
+        if (stamp or {}).get("source") != "db" \
+                or (stamp or {}).get("label") not in LABELS:
+            print(f"autotune leg {who} build did not consume the swept "
+                  f"entry: {stamp}")
+            return 1
+    if tuning_db_hits < 2 or tuning_fallbacks != 0:
+        print(f"autotune leg hit/fallback drift: hits {tuning_db_hits} "
+              f"fallbacks {tuning_fallbacks}: {autotune_leg}")
+        return 1
+    if not s1["labels_ok"] or not roundtrip_ok:
+        print(f"autotune leg DB label/round-trip contract broken: "
+              f"{autotune_leg}")
         return 1
     return 0
 

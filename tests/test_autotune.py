@@ -1,0 +1,340 @@
+"""The persisted tuning database + deterministic sweep (ISSUE 16):
+durability, torn/bit-flipped/version-mismatched files degrading to ONE
+counted fallback, embedded-key collision refusal, the evidence-stamp
+contract (source=db/default, registered fallback reasons, label
+vocabulary), sweep determinism, and serve-key identity."""
+
+import json
+import os
+import struct
+import zlib
+
+import pytest
+
+from bench_tpu_fem.engines import autotune, registry
+from bench_tpu_fem.engines.autotune import (
+    DB_ENV,
+    DB_VERSION,
+    LABELS,
+    MAGIC,
+    TuningDB,
+    default_tuning_db,
+    generate_candidates,
+    reset_default_db,
+    run_sweep,
+    tuning_lookup,
+    tuning_stamp,
+)
+from bench_tpu_fem.engines.registry import is_registered_reason, make_cache_key
+
+
+def _key(nrhs_bucket=4, nreps=30, **over):
+    kw = dict(degree=3, cell_shape=(8, 8, 8), precision="f32",
+              geom="uniform", engine_form="one_kernel_batched",
+              nrhs_bucket=nrhs_bucket, device_mesh=(1, 1, 1), nreps=nreps)
+    kw.update(over)
+    return make_cache_key(**kw)
+
+
+def _put(db, key, **over):
+    kw = dict(params={"iter_chunk": 2, "window_kib": 0},
+              score=0.5, label="design-estimate", engine="kron_fused_batched",
+              round_stamp="r06")
+    kw.update(over)
+    return db.put(key, kw.pop("params"), **kw)
+
+
+@pytest.fixture
+def db_path(tmp_path):
+    return str(tmp_path / "tune.db")
+
+
+@pytest.fixture(autouse=True)
+def _clean_env(monkeypatch):
+    monkeypatch.delenv(DB_ENV, raising=False)
+    reset_default_db()
+    yield
+    reset_default_db()
+
+
+# ---------------------------------------------------------------------------
+# Durability + degradation (satellite f)
+# ---------------------------------------------------------------------------
+
+def test_put_survives_reload(db_path):
+    db = TuningDB(db_path)
+    k = _key()
+    _put(db, k)
+    fresh = TuningDB(db_path)
+    entry = fresh.lookup(k)
+    assert entry is not None
+    assert entry["params"] == {"iter_chunk": 2, "window_kib": 0}
+    assert entry["label"] == "design-estimate"
+    assert entry["round"] == "r06"
+    s = fresh.stats()
+    assert s["entries"] == 1 and s["hits"] == 1 and s["fallbacks"] == 0
+    assert s["corrupt"] == 0 and s["labels_ok"]
+
+
+def test_missing_file_is_empty_not_corrupt(db_path):
+    db = TuningDB(db_path)
+    assert db.stats()["corrupt"] == 0
+    assert db.lookup(_key()) is None
+    assert db.stats()["fallbacks"] == 1
+
+
+def test_truncated_file_degrades_to_counted_fallback(db_path):
+    db = TuningDB(db_path)
+    _put(db, _key())
+    size = os.path.getsize(db_path)
+    with open(db_path, "rb") as fh:
+        blob = fh.read()
+    # tear the file mid-payload (a crashed writer without the tmp+rename
+    # discipline would leave exactly this)
+    with open(db_path, "wb") as fh:
+        fh.write(blob[:size // 2])
+    torn = TuningDB(db_path)
+    assert torn.stats()["corrupt"] == 1
+    assert torn.entries() == []
+    assert torn.lookup(_key()) is None  # counted fallback, no crash
+    s = torn.stats()
+    assert s["corrupt"] == 1 and s["fallbacks"] == 1
+
+
+def test_bitflipped_payload_degrades_to_counted_fallback(db_path):
+    db = TuningDB(db_path)
+    _put(db, _key())
+    with open(db_path, "rb") as fh:
+        blob = bytearray(fh.read())
+    blob[-3] ^= 0x40  # flip one payload bit: CRC must refuse the file
+    with open(db_path, "wb") as fh:
+        fh.write(bytes(blob))
+    flipped = TuningDB(db_path)
+    assert flipped.stats()["corrupt"] == 1
+    assert flipped.lookup(_key()) is None
+    # the consumer-facing stamp records the registered invalid-DB reason
+    entry, stamp = tuning_lookup(_key(), flipped)
+    assert entry is None and stamp["source"] == "default"
+    assert is_registered_reason(stamp["fallback_reason"]) == \
+        "tuning-db-invalid"
+
+
+def test_bad_magic_and_version_mismatch_degrade(db_path):
+    payload = json.dumps({"version": DB_VERSION + 1, "entries": {}}).encode()
+    with open(db_path, "wb") as fh:
+        fh.write(MAGIC)
+        fh.write(struct.pack(">QI", len(payload), zlib.crc32(payload)))
+        fh.write(payload)
+    assert TuningDB(db_path).stats()["corrupt"] == 1  # future version
+    with open(db_path, "wb") as fh:
+        fh.write(b"NOTATUNE" + b"\x00" * 16)
+    assert TuningDB(db_path).stats()["corrupt"] == 1  # wrong magic
+
+
+def test_embedded_key_collision_is_refused_and_counted(db_path):
+    from bench_tpu_fem.serve.artifacts import key_hash
+
+    db = TuningDB(db_path)
+    k1, k2 = _key(), _key(nrhs_bucket=8)
+    _put(db, k1)
+    # simulate a repointed/renamed entry: k2's address now holds an entry
+    # whose embedded key is still k1 — the lookup must refuse it
+    db._entries[key_hash(k2)] = db._entries[key_hash(k1)]
+    assert db.lookup(k2) is None
+    s = db.stats()
+    assert s["collisions"] == 1 and s["fallbacks"] == 1
+    assert db.lookup(k1) is not None  # the honest entry still serves
+
+
+def test_put_refuses_unregistered_label(db_path):
+    db = TuningDB(db_path)
+    with pytest.raises(ValueError, match="label"):
+        _put(db, _key(), label="vibes")
+    assert db.stats()["entries"] == 0
+
+
+def test_stats_flags_unlabelled_entries(db_path):
+    db = TuningDB(db_path)
+    _put(db, _key())
+    assert db.stats()["labels_ok"]
+    next(iter(db._entries.values())).pop("label")
+    assert not db.stats()["labels_ok"]
+
+
+# ---------------------------------------------------------------------------
+# The evidence-stamp contract
+# ---------------------------------------------------------------------------
+
+def test_stamp_without_db_records_disabled_reason():
+    extra = {}
+    assert tuning_stamp(extra, _key(), db=None) is None
+    t = extra["tuning"]
+    assert t["source"] == "default"
+    assert is_registered_reason(t["fallback_reason"]) == "tuning-disabled"
+
+
+def test_stamp_on_miss_records_entry_missing(db_path):
+    db = TuningDB(db_path)
+    extra = {}
+    assert tuning_stamp(extra, _key(), db) is None
+    assert is_registered_reason(
+        extra["tuning"]["fallback_reason"]) == "tuning-entry-missing"
+
+
+def test_stamp_on_hit_carries_label_round_params(db_path):
+    db = TuningDB(db_path)
+    k = _key()
+    _put(db, k, label="cpu-measured", round_stamp="r07")
+    extra = {}
+    params = tuning_stamp(extra, k, db)
+    assert params == {"iter_chunk": 2, "window_kib": 0}
+    t = extra["tuning"]
+    assert t["source"] == "db" and t["label"] == "cpu-measured"
+    assert t["round"] == "r07" and t["params"] == params
+    assert t["label"] in LABELS
+
+
+def test_default_db_env_reresolution(tmp_path, monkeypatch):
+    p1, p2 = str(tmp_path / "a.db"), str(tmp_path / "b.db")
+    assert default_tuning_db() is None  # env unset -> tuning disabled
+    monkeypatch.setenv(DB_ENV, p1)
+    db1 = default_tuning_db()
+    assert db1 is not None and db1.path == p1
+    assert default_tuning_db() is db1  # cached per path
+    monkeypatch.setenv(DB_ENV, p2)
+    assert default_tuning_db().path == p2  # re-resolved on path change
+    # reset forces a re-read of a file rewritten outside the API
+    TuningDB(p2).put(_key(), {"iter_chunk": 8}, score=1.0,
+                     label="design-estimate", engine="kron_fused_batched",
+                     round_stamp="r06")
+    reset_default_db()
+    assert default_tuning_db().lookup(_key()) is not None
+
+
+# ---------------------------------------------------------------------------
+# Candidate generation + the deterministic sweep
+# ---------------------------------------------------------------------------
+
+def test_generate_candidates_is_deterministic_and_ordered():
+    a = generate_candidates(degree=3, grid_shape=(25, 25, 25), nreps=30)
+    b = generate_candidates(degree=3, grid_shape=(25, 25, 25), nreps=30)
+    assert a == b and len(a) > 0
+    for c in a:
+        assert set(c) == {"plan_form", "window_kib", "iter_chunk", "nreps"}
+        assert c["window_kib"] in {0, *autotune.WINDOW_TIERS_KIB} or \
+            c["window_kib"] > 0
+    # short solves never get chunks longer than the solve
+    short = generate_candidates(degree=3, grid_shape=(25, 25, 25), nreps=2)
+    assert all(c["iter_chunk"] <= 2 for c in short)
+
+
+def test_run_sweep_deterministic_and_persisted(db_path):
+    db = TuningDB(db_path)
+    kw = dict(degree=3, ndofs=2000, precision="f32", geom="uniform",
+              nrhs_bucket=4, nreps=8, round_stamp="r06")
+    s1 = run_sweep(db, **kw)
+    s2 = run_sweep(db, **kw)
+    assert s1["winner"] == s2["winner"]
+    assert s1["score"] == s2["score"]
+    assert s1["key"] == s2["key"]
+    assert s1["label"] == "design-estimate"  # CPU, un-timed
+    assert s1["candidates"] + s1["rejected"] > 0
+    # idempotent persistence: the same slice holds ONE entry
+    assert db.stats()["entries"] == 1
+    # and the winner is consumable from a cold reload
+    fresh = TuningDB(db_path)
+    from bench_tpu_fem.serve.artifacts import key_from_dict
+
+    entry = fresh.lookup(key_from_dict(s1["key"]))
+    assert entry is not None and entry["params"] == s1["winner"]
+    assert entry["round"] == "r06" and entry["label"] in LABELS
+
+
+def test_sweep_key_is_exactly_the_serve_cache_key(db_path):
+    """The sweep keys its winner precisely how serve keys its compiles —
+    a serve build finds the tuned entry with no re-mapping layer."""
+    from bench_tpu_fem.serve.artifacts import key_from_dict
+    from bench_tpu_fem.serve.engine import SolveSpec, spec_cache_key
+
+    db = TuningDB(db_path)
+    out = run_sweep(db, degree=3, ndofs=2000, precision="f32",
+                    geom="uniform", nrhs_bucket=4, nreps=8)
+    spec = SolveSpec(degree=3, ndofs=2000, nreps=8)
+    assert key_from_dict(out["key"]) == spec_cache_key(spec, 4)
+    assert db.lookup(spec_cache_key(spec, 4)) is not None
+
+
+def test_serve_solver_consumes_tuned_entry(db_path, monkeypatch):
+    """End-to-end consumption: sweep -> persist -> CompiledSolver build
+    picks the tuned iter_chunk and stamps source=db."""
+    from bench_tpu_fem.serve.engine import CompiledSolver, SolveSpec
+
+    monkeypatch.setenv(DB_ENV, db_path)
+    reset_default_db()
+    db = default_tuning_db()
+    run_sweep(db, degree=3, ndofs=2000, precision="f32", geom="uniform",
+              nrhs_bucket=2, nreps=8)
+    sol = CompiledSolver(SolveSpec(degree=3, ndofs=2000, nreps=8), 2)
+    assert sol.tuning["source"] == "db"
+    assert sol.tuning["label"] in LABELS
+    assert sol.iter_chunk == min(
+        sol.tuning["params"]["iter_chunk"], 8)
+    # an untuned spec on the same DB records the registered miss reason
+    sol2 = CompiledSolver(SolveSpec(degree=2, ndofs=1000, nreps=8), 2)
+    assert sol2.tuning["source"] == "default"
+    assert is_registered_reason(
+        sol2.tuning["fallback_reason"]) == "tuning-entry-missing"
+
+
+def test_bench_driver_consumes_tuned_entry(db_path, monkeypatch):
+    """Driver-side consumption: pre-run journals the miss, seeding the
+    driver's own key flips the stamp to source=db on the rerun."""
+    from bench_tpu_fem.bench.driver import (
+        BenchConfig,
+        _exec_cache_key,
+        run_benchmark,
+    )
+    from bench_tpu_fem.mesh.sizing import compute_mesh_size
+
+    monkeypatch.setenv(DB_ENV, db_path)
+    reset_default_db()
+    db = default_tuning_db()
+    cfg = BenchConfig(ndofs_global=500, degree=2, qmode=1, float_bits=32,
+                      nreps=2, use_cg=True)
+    pre = run_benchmark(cfg)
+    assert pre.extra["tuning"]["source"] == "default"
+    assert is_registered_reason(
+        pre.extra["tuning"]["fallback_reason"]) == "tuning-entry-missing"
+    n = compute_mesh_size(cfg.ndofs_global, cfg.degree)
+    k = _exec_cache_key(cfg, n, pre.extra.get("cg_engine_form", "unfused"),
+                        "cg")
+    db.put(k, {"iter_chunk": 2, "window_kib": 0}, score=0.1,
+           label="design-estimate", engine="kron_fused", round_stamp="r06")
+    tuned = run_benchmark(cfg)
+    t = tuned.extra["tuning"]
+    assert t["source"] == "db" and t["label"] == "design-estimate"
+    assert t["params"]["iter_chunk"] == 2
+
+
+# ---------------------------------------------------------------------------
+# Trend surface: the obs fold never renders zeros for absent evidence
+# ---------------------------------------------------------------------------
+
+def test_fold_tuning_gap_vs_stamps(db_path):
+    from bench_tpu_fem.obs.report import fold_tuning
+
+    gap = fold_tuning([{"metric": "bench", "extra": {}}])
+    assert gap["status"] == "gap" and gap["reason"] == "no-tuning-stamps"
+
+    db = TuningDB(db_path)
+    k = _key()
+    _put(db, k)
+    hit, miss = {}, {}
+    tuning_stamp(hit, k, db)
+    tuning_stamp(miss, _key(nrhs_bucket=16), db)
+    fold = fold_tuning([{"extra": hit}, {"extra": miss}])
+    assert fold["status"] == "ok"
+    assert fold["stamps"] == 2 and fold["db_hits"] == 1
+    assert fold["fallbacks"] == 1
+    assert fold["labels"].get("design-estimate", 0) >= 1
+    assert all(is_registered_reason(r) for r in fold["fallback_reasons"])

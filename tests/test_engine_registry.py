@@ -1,0 +1,407 @@
+"""The declarative engine registry (ISSUE 16): gate-reason vocabulary
+hygiene, registry-vs-legacy routing parity (frozen replicas of the
+pre-registry if/else chains), the one cache-key helper's collision
+guarantees, and the analysis-matrix derivation."""
+
+import ast
+import os
+
+import pytest
+
+from bench_tpu_fem.engines import registry
+from bench_tpu_fem.engines.registry import (
+    ENGINE_SPECS,
+    GATE_REASONS,
+    EngineSpec,
+    analysis_plan,
+    bench_engine_form,
+    gate_reason,
+    is_registered_reason,
+    make_cache_key,
+    planned_engine_form,
+    resolve_backend,
+    spec,
+    specs,
+)
+
+PKG_ROOT = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "bench_tpu_fem")
+
+# The stamped-evidence keys whose values MUST come from the registered
+# vocabulary. engine_fallback_reason / cg_engine_error deliberately stay
+# out: they carry raw exception text (failure taxonomy, not routing).
+REASON_KEY_SUFFIXES = ("_gate_reason",)
+REASON_KEYS_EXACT = ("s_step_fallback_reason", "f64_df32_fallback_reason")
+
+
+def _is_reason_key(name) -> bool:
+    if not isinstance(name, str):
+        return False
+    if name in REASON_KEYS_EXACT:
+        return True
+    return (name.endswith(REASON_KEY_SUFFIXES)
+            and name != "engine_fallback_reason")
+
+
+# ---------------------------------------------------------------------------
+# Vocabulary hygiene
+# ---------------------------------------------------------------------------
+
+def test_no_freetext_reason_literals_left_in_source():
+    """AST sweep over the whole package: no stamped gate/fallback reason
+    may be a plain string literal any more — every site routes through
+    GATE_REASONS / gate_reason / a registry-derived constant (satellite
+    a: the ~117 free-text strings are centralized)."""
+    offenders = []
+    for dirpath, _dirnames, filenames in os.walk(PKG_ROOT):
+        for fn in filenames:
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            with open(path, encoding="utf-8") as fh:
+                tree = ast.parse(fh.read(), filename=path)
+            for node in ast.walk(tree):
+                if not isinstance(node, ast.Assign):
+                    continue
+                for tgt in node.targets:
+                    if not (isinstance(tgt, ast.Subscript)
+                            and isinstance(tgt.slice, ast.Constant)
+                            and _is_reason_key(tgt.slice.value)):
+                        continue
+                    v = node.value
+                    if isinstance(v, ast.Constant) and isinstance(
+                            v.value, str):
+                        offenders.append(
+                            f"{path}:{node.lineno} "
+                            f"[{tgt.slice.value}] = {v.value[:60]!r}")
+    assert not offenders, (
+        "free-text reason literals remain (register them in "
+        "engines.registry.GATE_REASONS):\n" + "\n".join(offenders))
+
+
+def test_module_reason_constants_are_registered():
+    """The driver-layer reason constants are registry lookups — their
+    values must round-trip through is_registered_reason."""
+    from bench_tpu_fem.bench.driver import (
+        BATCHED_UNFUSED_REASON,
+        CHECKPOINT_GATE_REASON,
+        CONVERGENCE_GATE_REASON,
+    )
+    from bench_tpu_fem.la.precond import PRECOND_GATE_REASONS
+    from bench_tpu_fem.la.sstep import SSTEP_FALLBACK_REASON, SSTEP_GATE_REASON
+
+    consts = [BATCHED_UNFUSED_REASON, CHECKPOINT_GATE_REASON,
+              CONVERGENCE_GATE_REASON, SSTEP_FALLBACK_REASON,
+              SSTEP_GATE_REASON, *PRECOND_GATE_REASONS.values()]
+    for text in consts:
+        assert is_registered_reason(text), f"unregistered: {text!r}"
+
+
+def test_gate_reason_templates_and_matcher():
+    inst = gate_reason("df-backend-kron", backend="pallas")
+    assert "pallas" in inst
+    assert is_registered_reason(inst) == "df-backend-kron"
+    # constants match themselves, and only themselves
+    assert (is_registered_reason(GATE_REASONS["kron-perturbed"])
+            == "kron-perturbed")
+    assert is_registered_reason("totally free text nobody registered") is None
+    assert is_registered_reason(None) is None
+    # a half-formatted template must fail loudly, never reach a journal
+    with pytest.raises(KeyError):
+        gate_reason("df-plan-unsupported", degree=3)  # missing qmode
+
+
+def test_every_spec_gate_slug_is_registered():
+    for s in ENGINE_SPECS:
+        for slug in s.gate_slugs:
+            assert slug in GATE_REASONS, (s.name, slug)
+        for t in s.tunables:
+            assert t in s.defaults, (s.name, t)
+
+
+def test_journaled_reasons_register_end_to_end():
+    """Run real driver configs whose feature requests gate off on the
+    CPU path and check every stamped reason is vocabulary — the runtime
+    half of the hygiene sweep (satellite a)."""
+    from bench_tpu_fem.bench.driver import BenchConfig, run_benchmark
+
+    # action run + convergence + precond + s-step: three gates at once
+    cfg = BenchConfig(ndofs_global=500, degree=2, qmode=1, float_bits=32,
+                      nreps=2, use_cg=False, convergence=True,
+                      precond="jacobi", s_step=4)
+    res = run_benchmark(cfg)
+    stamped = {k: v for k, v in res.extra.items() if _is_reason_key(k)}
+    assert stamped, "expected gated features to stamp reasons"
+    for k, text in stamped.items():
+        assert is_registered_reason(text), (k, text)
+    # the tuning stamp's fallback reason is registered too (no DB armed)
+    tuning = res.extra.get("tuning")
+    assert tuning is not None and tuning["source"] == "default"
+    assert is_registered_reason(tuning["fallback_reason"]) is not None
+
+
+# ---------------------------------------------------------------------------
+# Registry-vs-legacy routing parity (frozen replicas)
+# ---------------------------------------------------------------------------
+
+def _legacy_resolve_backend(backend, float_bits, uniform=False,
+                            degree=3, qmode=1):
+    """Frozen replica of bench.driver.resolve_backend as it shipped
+    before the registry (PR <= 15). Do not edit: the parity sweep pins
+    the registry resolver against this."""
+    import jax
+
+    if backend != "auto":
+        return backend
+    if uniform:
+        return "kron"
+    if float_bits == 32 and jax.default_backend() == "tpu":
+        from bench_tpu_fem.ops.folded import pallas_geom_constraint
+
+        nq = degree + qmode + 1
+        if pallas_geom_constraint(degree, nq, 4)[0]:
+            return "pallas"
+    return "xla"
+
+
+def _legacy_planned_engine_form(precision, geom, ndofs, degree, bucket):
+    """Frozen replica of serve.engine.planned_engine_form pre-registry."""
+    if precision == "f32" and geom == "uniform":
+        from bench_tpu_fem.mesh.dofmap import dof_grid_shape
+        from bench_tpu_fem.mesh.sizing import compute_mesh_size
+        from bench_tpu_fem.ops.kron_cg import engine_plan_batched
+
+        n = compute_mesh_size(ndofs, degree)
+        grid = dof_grid_shape(n, degree)
+        if engine_plan_batched(grid, degree, bucket)[0] != "unfused":
+            return "one_kernel_batched"
+    return "unfused"
+
+
+def test_resolve_backend_parity_sweep():
+    for backend in ("auto", "kron", "pallas", "xla"):
+        for float_bits in (32, 64):
+            for uniform in (False, True):
+                for degree in (1, 3, 4, 6):
+                    for qmode in (1, 2):
+                        want = _legacy_resolve_backend(
+                            backend, float_bits, uniform, degree, qmode)
+                        got = resolve_backend(
+                            backend, float_bits, uniform, degree, qmode)
+                        assert got == want, (
+                            backend, float_bits, uniform, degree, qmode)
+
+
+def test_planned_engine_form_parity_sweep():
+    for precision in ("f32", "f64", "df32"):
+        for geom in ("uniform", "perturbed"):
+            for ndofs in (500, 2000, 50_000):
+                for degree in (1, 3, 6):
+                    for bucket in (1, 2, 4, 8):
+                        want = _legacy_planned_engine_form(
+                            precision, geom, ndofs, degree, bucket)
+                        got = planned_engine_form(
+                            precision, geom, ndofs, degree, bucket)
+                        assert got == want, (
+                            precision, geom, ndofs, degree, bucket)
+
+
+def test_serve_planned_form_wrapper_parity():
+    from bench_tpu_fem.serve.engine import SolveSpec
+    from bench_tpu_fem.serve.engine import (
+        planned_engine_form as serve_planned,
+    )
+
+    for ndofs in (500, 50_000):
+        for bucket in (1, 4):
+            spec_ = SolveSpec(degree=3, ndofs=ndofs, nreps=10)
+            assert serve_planned(spec_, bucket) == planned_engine_form(
+                "f32", "uniform", ndofs, 3, bucket)
+
+
+def test_bench_engine_form_packing():
+    assert bench_engine_form("kron", "one", "cg", 1, False) == \
+        "kron|one|cg|q1|gll"
+    assert bench_engine_form("xla", "unfused", "action", 2, True) == \
+        "xla|unfused|action|q2|gauss"
+    # variant axes never alias: every distinct input tuple packs distinct
+    seen = {}
+    for backend in ("kron", "xla", "pallas"):
+        for form in ("one", "chunked", "unfused"):
+            for kind in ("cg", "action", "cg+conv", "cg+precond:jacobi"):
+                for qmode in (1, 2):
+                    for gauss in (False, True):
+                        packed = bench_engine_form(
+                            backend, form, kind, qmode, gauss)
+                        key = (backend, form, kind, qmode, gauss)
+                        assert packed not in seen or seen[packed] == key
+                        seen[packed] = key
+    assert len(seen) == 3 * 3 * 4 * 2 * 2
+
+
+# ---------------------------------------------------------------------------
+# The one cache-key helper: structure + collision guarantees (satellite b)
+# ---------------------------------------------------------------------------
+
+def test_cache_key_roundtrip_and_hash_stability():
+    from bench_tpu_fem.serve.artifacts import key_dict, key_from_dict, key_hash
+
+    k = make_cache_key(degree=3, cell_shape=(8, 8, 8), precision="f32",
+                       geom="uniform", engine_form="one_kernel_batched",
+                       nrhs_bucket=4, device_mesh=(1, 1, 1), nreps=30)
+    assert key_from_dict(key_dict(k)) == k
+    assert key_hash(k) == key_hash(key_from_dict(key_dict(k)))
+    # EngineSpec.cache_key and the module alias are the same function
+    k2 = EngineSpec.cache_key(degree=3, cell_shape=(8, 8, 8),
+                              precision="f32", geom="uniform",
+                              engine_form="one_kernel_batched",
+                              nrhs_bucket=4, device_mesh=(1, 1, 1),
+                              nreps=30)
+    assert k2 == k
+
+
+def test_bench_and_serve_keys_never_collide():
+    """Bench-driver exec-cache keys and serve cache/artifact keys for
+    the SAME logical slice live in disjoint key spaces: the bench side
+    packs backend|form|kind|qmode|rule into engine_form and uses the
+    exact nrhs + (ndevices,) mesh; serve uses the planned-form
+    vocabulary + bucket + (1,1,1). No pair may hash-collide."""
+    from bench_tpu_fem.serve.artifacts import key_hash
+
+    degree, cells, nreps = 3, (8, 8, 8), 30
+    serve_keys = [
+        make_cache_key(degree=degree, cell_shape=cells, precision="f32",
+                       geom="uniform", engine_form=form, nrhs_bucket=b,
+                       device_mesh=(1, 1, 1), nreps=nreps)
+        for form in ("one_kernel_batched", "unfused")
+        for b in (1, 2, 4, 8)]
+    bench_keys = [
+        make_cache_key(degree=degree, cell_shape=cells, precision="f32",
+                       geom="uniform",
+                       engine_form=bench_engine_form(
+                           "kron", form, kind, 1, False),
+                       nrhs_bucket=nrhs, device_mesh=(1,), nreps=nreps)
+        for form in ("one", "chunked", "unfused")
+        for kind in ("cg", "action")
+        for nrhs in (1, 2, 4, 8)]
+    hashes = [key_hash(k) for k in serve_keys + bench_keys]
+    assert len(set(hashes)) == len(hashes)
+    # variant markers (precond / s-step / conv) keep bench keys apart too
+    variants = [
+        make_cache_key(degree=degree, cell_shape=cells, precision="f32",
+                       geom="uniform",
+                       engine_form=bench_engine_form(
+                           "kron", "unfused", kind, 1, False),
+                       nrhs_bucket=1, device_mesh=(1,), nreps=nreps)
+        for kind in ("cg", "cg+conv", "cg+precond:jacobi", "cg+sstep:4")]
+    vh = [key_hash(k) for k in variants]
+    assert len(set(vh)) == len(vh)
+
+
+def test_driver_exec_cache_key_goes_through_registry_helper():
+    from bench_tpu_fem.bench.driver import BenchConfig, _exec_cache_key
+    from bench_tpu_fem.serve.cache import ExecutableKey
+
+    cfg = BenchConfig(ndofs_global=2000, degree=3, qmode=1, float_bits=32,
+                      nreps=8, use_cg=True)
+    k = _exec_cache_key(cfg, (8, 8, 8), "one", "cg")
+    assert isinstance(k, ExecutableKey)
+    assert k.engine_form == bench_engine_form("auto", "one", "cg", 1, False)
+    assert k.nrhs_bucket == 1 and k.device_mesh == (1,)
+
+
+# ---------------------------------------------------------------------------
+# Registry rows + analysis-matrix derivation
+# ---------------------------------------------------------------------------
+
+# The exact shipped-config list as of PR 15, BEFORE the matrix became a
+# registry derivation. Frozen: analysis_plan() must render precisely
+# this, in this order (downstream journals key on these names).
+FROZEN_ANALYSIS_NAMES = [
+    "kron_engine_d1", "kron_engine_d3", "kron_engine_d4", "kron_engine_d6",
+    "kron_engine_d3_chunked", "kron_engine_d4_chunked", "kron_update_pass",
+    "kron_3stage_d3", "folded_engine_g_d1", "folded_apply_g_d1",
+    "folded_engine_g_d3", "folded_apply_g_d3", "folded_engine_g_d4",
+    "folded_apply_g_d4", "folded_engine_g_d6", "folded_apply_g_d6",
+    "folded_engine_corner_d1", "folded_apply_corner_d1",
+    "folded_engine_corner_d3", "folded_apply_corner_d3",
+    "folded_engine_corner_d4", "folded_apply_corner_d4",
+    "folded_engine_corner_d6", "folded_apply_corner_d6",
+    "kron_df_engine_d1", "kron_df_engine_d3", "kron_df_engine_d4",
+    "kron_df_engine_d6", "kron_df_engine_d3_chunked",
+    "kron_df_engine_d4_chunked", "kron_df_update_pass",
+    "folded_df_apply_g_d1", "folded_df_apply_g_d3", "folded_df_apply_g_d6",
+    "folded_df_apply_corner_d1", "folded_df_apply_corner_d3",
+    "folded_df_apply_corner_d6", "serve_batched_apply_corner_d1",
+    "serve_batched_apply_corner_d3", "serve_batched_apply_corner_d6",
+    "serve_batched_kron_3stage_d3", "kron_batched_engine_d1_r4",
+    "kron_batched_engine_d3_r2", "kron_batched_engine_d3_r4",
+    "kron_batched_engine_d3_r8", "kron_batched_engine_d3_r16",
+    "kron_batched_engine_d6_r4", "dist_kron_engine_d3",
+    "dist_kron_engine_d5", "dist_kron_engine_ext2d", "dist_kron_df_halo",
+    "dist_kron_df_ext2d", "dist_folded_engine", "dist_kron_overlap_d3",
+    "dist_kron_overlap_ext2d", "dist_kron_df_overlap_halo",
+    "dist_kron_df_overlap_ext2d", "dist_folded_overlap",
+]
+
+
+def test_analysis_plan_matches_frozen_matrix():
+    plan = analysis_plan()
+    assert [r.name for r in plan] == FROZEN_ANALYSIS_NAMES
+    # ref'd drive keys must all resolve in analysis.configs._DRIVES
+    from bench_tpu_fem.analysis.configs import _DRIVES
+
+    for r in plan:
+        assert r.drive in _DRIVES, r.name
+
+
+def test_shipped_configs_render_from_registry():
+    from bench_tpu_fem.analysis.configs import config_names
+
+    assert config_names() == FROZEN_ANALYSIS_NAMES
+
+
+def test_specs_filtering_and_lookup():
+    names = [s.name for s in ENGINE_SPECS]
+    assert len(names) == len(set(names))
+    f32_single = specs(precision="f32", sharding="single")
+    assert {s.name for s in f32_single} >= {
+        "kron_fused", "kron_fused_batched", "folded_fused"}
+    # "any" rows match every filter value
+    assert any(s.name == "xla_unfused" for s in specs(precision="df32"))
+    assert spec("kron_fused").backend == "kron"
+    with pytest.raises(KeyError):
+        spec("no_such_engine")
+
+
+def test_no_capability_chains_left_in_routing():
+    """The drivers' backend resolution is the registry's — the legacy
+    if/else chain may not exist anymore (both drivers delegate)."""
+    import inspect
+
+    from bench_tpu_fem.bench import driver as bench_driver
+
+    src = inspect.getsource(bench_driver.resolve_backend)
+    fn = ast.parse(src.lstrip()).body[0]
+    stmts = [s for s in fn.body
+             if not (isinstance(s, ast.Expr)
+                     and isinstance(s.value, ast.Constant))]
+    code = "\n".join(ast.unparse(s) for s in stmts)
+    assert "pallas_geom_constraint" not in code  # the legacy chain is gone
+    assert "import resolve_backend as _resolve" in code
+    assert bench_driver.resolve_backend("auto", 32, uniform=True) == "kron"
+    assert registry.resolve_backend("auto", 32, uniform=True) == "kron"
+
+
+def test_render_registry_and_cli():
+    text = registry.render_registry()
+    assert "engine registry" in text
+    for s in ENGINE_SPECS:
+        assert f"[{s.name}]" in text
+    for slug in GATE_REASONS:
+        assert slug in text
+
+    from bench_tpu_fem.bench.__main__ import main as bench_main
+
+    assert bench_main(["engines", "--json"]) == 0
