@@ -815,6 +815,96 @@ def drive_dist_folded_overlap() -> ConfigResult:
 
 
 # ---------------------------------------------------------------------------
+# bf16 mixed-precision drives (ISSUE 17)
+# ---------------------------------------------------------------------------
+
+def _bf16_plan(grid_shape, degree) -> PlanCheck:
+    from ..ops.bf16 import engine_vmem_bytes_bf16
+
+    return PlanCheck(
+        "ops.bf16.engine_vmem_bytes_bf16",
+        engine_vmem_bytes_bf16(grid_shape, degree),
+        scoped_limit_bytes(None),
+        notes="bf16-stream design estimate: f32 ring at half width, "
+              "re-quantised to the (16, 128) bf16 tile; unfused until "
+              "the hardware bf16 stage lands a fused ring")
+
+
+def drive_bf16_apply(degree: int) -> ConfigResult:
+    import jax
+    import jax.numpy as jnp
+
+    from bench_tpu_fem.mesh.box import create_box_mesh
+    from bench_tpu_fem.mesh.sizing import compute_mesh_size
+    from bench_tpu_fem.ops.bf16 import to_bf16
+    from bench_tpu_fem.ops.kron import build_kron_laplacian
+
+    nc = compute_mesh_size(DEFAULT_NDOFS, degree)
+    mesh = create_box_mesh(nc)
+    op = to_bf16(build_kron_laplacian(mesh, degree, qmode=1,
+                                      dtype=jnp.float32))
+    shape = tuple(int(a.shape[0]) for a in op.inner.notbc1d)
+    with CaptureSession() as s:
+        jax.eval_shape(op.apply, _f32(shape))
+    return ConfigResult(
+        f"bf16_apply_d{degree}",
+        {"engine": "kron_bf16", "degree": degree, "dtype": "bf16"},
+        s.kernels, plan=_bf16_plan(shape, degree))
+
+
+def drive_bf16_apply_perturbed(degree: int) -> ConfigResult:
+    import jax
+    import jax.numpy as jnp
+
+    from bench_tpu_fem.mesh.box import create_box_mesh
+    from bench_tpu_fem.mesh.sizing import compute_mesh_size
+    from bench_tpu_fem.ops.bf16 import to_bf16
+    from bench_tpu_fem.ops.laplacian import build_laplacian
+
+    nc = compute_mesh_size(DEFAULT_NDOFS, degree)
+    mesh = create_box_mesh(nc, geom_perturb_fact=0.1)
+    op = to_bf16(build_laplacian(mesh, degree, 1, "gll",
+                                 dtype=jnp.float32, backend="xla"))
+    shape = tuple(int(v) for v in op.inner.bc_mask.shape)
+    with CaptureSession() as s:
+        jax.eval_shape(op.apply, _f32(shape))
+    return ConfigResult(
+        f"bf16_apply_perturbed_d{degree}",
+        {"engine": "xla_bf16", "degree": degree, "dtype": "bf16"},
+        s.kernels, plan=_bf16_plan(shape, degree))
+
+
+def drive_bf16_refine(degree: int) -> ConfigResult:
+    import jax
+    import jax.numpy as jnp
+
+    from bench_tpu_fem.engines.registry import DEFAULT_REFINE_INNER_ITERS
+    from bench_tpu_fem.la.refine import _correct, _residual
+    from bench_tpu_fem.mesh.box import create_box_mesh
+    from bench_tpu_fem.mesh.sizing import compute_mesh_size
+    from bench_tpu_fem.ops.bf16 import to_bf16
+    from bench_tpu_fem.ops.kron import build_kron_laplacian
+
+    nc = compute_mesh_size(DEFAULT_NDOFS, degree)
+    mesh = create_box_mesh(nc)
+    op_hi = build_kron_laplacian(mesh, degree, qmode=1, dtype=jnp.float32)
+    op_lo = to_bf16(op_hi)
+    shape = tuple(int(a.shape[0]) for a in op_hi.notbc1d)
+    x = _f32(shape)
+    with CaptureSession() as s:
+        jax.eval_shape(lambda o, xx, bb: _residual(o, xx, bb),
+                       op_hi, x, x)
+        jax.eval_shape(
+            lambda o, rr: _correct(o, rr, DEFAULT_REFINE_INNER_ITERS),
+            op_lo, x)
+    return ConfigResult(
+        f"bf16_refine_d{degree}",
+        {"engine": "bf16_refine", "degree": degree, "dtype": "bf16",
+         "inner_iters": DEFAULT_REFINE_INNER_ITERS},
+        s.kernels, plan=_bf16_plan(shape, degree))
+
+
+# ---------------------------------------------------------------------------
 # Registry
 # ---------------------------------------------------------------------------
 
@@ -846,6 +936,9 @@ _DRIVES: dict[str, Callable[..., ConfigResult]] = {
     "dist_kron_overlap": drive_dist_kron_overlap,
     "dist_kron_df_overlap": drive_dist_kron_df_overlap,
     "dist_folded_overlap": drive_dist_folded_overlap,
+    "bf16_apply": drive_bf16_apply,
+    "bf16_apply_perturbed": drive_bf16_apply_perturbed,
+    "bf16_refine": drive_bf16_refine,
 }
 
 

@@ -98,4 +98,12 @@ def results_json(cfg: BenchConfig, res: BenchmarkResults) -> str:
     # the registered fallback reason (never silent defaults)
     if "tuning" in res.extra:
         root["output"]["tuning"] = res.extra["tuning"]
+    # mixed-precision ladder stamps (ISSUE 17): which precision rung
+    # ran, the refinement evidence block (inner/outer split, rel
+    # history, per-precision byte model) or the registered reason
+    # refinement/bf16 was gated or demoted on this config
+    for key in ("precision", "refine", "refine_gate_reason",
+                "bf16_gate_reason"):
+        if key in res.extra:
+            root["output"][key] = res.extra[key]
     return json.dumps(root)

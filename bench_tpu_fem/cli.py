@@ -82,6 +82,18 @@ def build_parser() -> argparse.ArgumentParser:
                         "preconditioned record stamps setup cost + "
                         "per-iteration applies; time_to_rtol_s "
                         "adjudicates (run with --convergence).")
+    p.add_argument("--precision", default=None,
+                   choices=["auto", "bf16", "bf16-refine"],
+                   help="Mixed-precision speed ladder (ISSUE 17): "
+                        "'bf16' streams every hot-loop operator apply "
+                        "at bfloat16 (half the f32 HBM bytes, f32 "
+                        "accumulate, bf16-class answers); 'bf16-refine' "
+                        "wraps the same bf16 hot loop in the iterative-"
+                        "refinement outer correction (la.refine) and "
+                        "returns f64-class answers, stamping the "
+                        "`refine` evidence block. Requires --float 32. "
+                        "'auto' (default) keeps the --float/--f64_impl "
+                        "precision. Env default: BENCH_PRECISION.")
     p.add_argument("--s-step", type=int, default=None, dest="s_step",
                    help="s-step (communication-avoiding) CG: batch the "
                         "reductions of N iterations into one stacked "
@@ -153,6 +165,12 @@ def main(argv: list[str] | None = None) -> int:
     # the reference (main.cpp:192-196) — even if a value equals its default.
     if args.ndofs is not None and args.ndofs_global is not None:
         raise SystemExit("Conflicting options 'ndofs' and 'ndofs_global'")
+    if (args.precision in ("bf16", "bf16-refine")
+            and args.float_bits != 32):
+        # the registered bf16-float-bits gate, surfaced at parse time
+        raise SystemExit(
+            f"--precision {args.precision} requires --float 32 (bf16 "
+            f"streams the f32-assembled operator at bfloat16)")
     if args.nrhs < 1:
         raise SystemExit("Invalid nrhs. Must be >= 1.")
     # Early serve-bucket audit (satellite, ISSUE 6): the benchmark
@@ -267,6 +285,8 @@ def main(argv: list[str] | None = None) -> int:
         # defaults (harness stages opt in without payload changes)
         **({} if args.precond is None else {"precond": args.precond}),
         **({} if args.s_step is None else {"s_step": max(args.s_step, 1)}),
+        # None = fall back to the BENCH_PRECISION env default
+        **({} if args.precision is None else {"precision": args.precision}),
     )
 
     obs_journal = None

@@ -430,6 +430,10 @@ def run_distributed(cfg, res, dtype):
         qmode=cfg.qmode,
     )
     res.extra["backend"] = backend
+    if getattr(cfg, "precision", "auto").startswith("bf16"):
+        # bf16 streaming is single-chip today (ISSUE 17): the sharded
+        # f32 path runs, with the registered reason recorded
+        res.extra["bf16_gate_reason"] = GATE_REASONS["bf16-sharded"]
     kron = backend == "kron"
     if kron and cfg.geom_perturb_fact != 0.0:
         # Mirror build_kron_laplacian's single-chip guard: an explicit

@@ -264,30 +264,61 @@ def reset_default_db() -> None:
 #: the non-zero rungs are the tiers the shipped plans actually request
 WINDOW_TIERS_KIB = (0, 32768, 65536, 98304)
 
+#: refinement inner-iteration budgets the bf16-refine sweep ranks
+#: (la.refine inner CG length per outer; the registry default 16 sits
+#: mid-ladder — the U-shaped cost model prefers it until hardware
+#: timing says otherwise)
+REFINE_INNER_LADDER = (8, 16, 24, 32)
+
+
 def generate_candidates(*, degree: int, grid_shape, nrhs_bucket: int = 1,
-                        nreps: int = 30) -> list[dict]:
+                        nreps: int = 30, precision: str = "f32",
+                        refine: bool = False) -> list[dict]:
     """Deterministic tile/window/iter-chunk/nreps candidate set for one
     (degree, grid) slice, generated from the registry's VMEM plan: the
     plan's achieved form seeds the form axis, the scoped-VMEM tier
     ladder (the same rungs the shipped plans request) is the window
     axis, and iteration chunks sweep the powers of two up to the solve
-    length. Pure and ordered — identical inputs always yield the
-    identical candidate list (the perfgate autotune leg pins the sweep
-    byte-for-byte)."""
-    from ..ops.kron_cg import engine_plan
+    length. bf16 slices (ISSUE 17) take their form from the bf16 plan
+    and quantise every window rung to the (16, 128) bf16 tile quantum
+    (4 KiB — ops.bf16), adding the engine's own VMEM-estimate rung so
+    the ladder brackets the real footprint; `refine` crosses the set
+    with the inner-iteration budgets of the refinement ladder. Pure and
+    ordered — identical inputs always yield the identical candidate
+    list (the perfgate autotune leg pins the sweep byte-for-byte)."""
+    if precision == "bf16":
+        from ..ops.bf16 import (
+            engine_plan_bf16,
+            engine_vmem_bytes_bf16,
+            quantize_to_bf16_tile,
+        )
 
-    form, kib = engine_plan(tuple(grid_shape), degree)
-    windows = sorted({int(kib or 0), *WINDOW_TIERS_KIB})
+        form, kib = engine_plan_bf16(tuple(grid_shape), degree)
+        est_kib = quantize_to_bf16_tile(
+            engine_vmem_bytes_bf16(tuple(grid_shape), degree)) // 1024
+        windows = sorted({
+            (quantize_to_bf16_tile(int(w) * 1024) // 1024) if w else 0
+            for w in (int(kib or 0), est_kib, *WINDOW_TIERS_KIB)})
+    else:
+        from ..ops.kron_cg import engine_plan
+
+        form, kib = engine_plan(tuple(grid_shape), degree)
+        windows = sorted({int(kib or 0), *WINDOW_TIERS_KIB})
     chunks = [c for c in (1, 2, 4, 8) if c <= max(1, nreps)]
+    inner_ladder = REFINE_INNER_LADDER if refine else (None,)
     out = []
     for w in windows:
         for c in chunks:
-            out.append({
-                "plan_form": form,
-                "window_kib": int(w),
-                "iter_chunk": int(c),
-                "nreps": int(nreps),
-            })
+            for ri in inner_ladder:
+                cand = {
+                    "plan_form": form,
+                    "window_kib": int(w),
+                    "iter_chunk": int(c),
+                    "nreps": int(nreps),
+                }
+                if ri is not None:
+                    cand["refine_inner_iters"] = int(ri)
+                out.append(cand)
     return out
 
 
@@ -303,24 +334,40 @@ def _candidate_cost(cand: dict, *, degree: int, grid_shape,
     boundary_cost = 1.0 / chunk
     batching_cost = chunk / 16.0
     tier_cost = cand["window_kib"] / (1024.0 * 1024.0)  # prefer small tiers
-    return boundary_cost + batching_cost + tier_cost
+    cost = boundary_cost + batching_cost + tier_cost
+    ri = cand.get("refine_inner_iters")
+    if ri:
+        # refinement inner budget (ISSUE 17): too few inners means more
+        # hi-precision outers (each a full-width apply), too many wastes
+        # bf16 iterations past the mantissa floor — U-shaped, minimised
+        # at the registry default of 16 until hardware timing replaces
+        # this estimate
+        cost += 0.25 * (ri / 16.0 + 16.0 / max(1, ri))
+    return cost
 
 
-def _fits_budget(cand: dict, *, degree: int, grid_shape) -> bool:
+def _fits_budget(cand: dict, *, degree: int, grid_shape,
+                 precision: str = "f32") -> bool:
     """CPU-provable admission filter: the engine's VMEM byte estimate
     must fit the candidate's scoped-VMEM tier (analysis.budgets — the
-    same byte model rules.R2 cross-checks against captures)."""
+    same byte model rules.R2 cross-checks against captures). bf16 uses
+    its own half-width, (16, 128)-tile-quantised estimate (ops.bf16)."""
     from ..analysis.budgets import scoped_limit_bytes
-    from ..ops.kron_cg import engine_vmem_bytes
 
     limit = scoped_limit_bytes(cand["window_kib"] or None)
+    if precision == "bf16":
+        from ..ops.bf16 import engine_vmem_bytes_bf16
+
+        return engine_vmem_bytes_bf16(tuple(grid_shape), degree) <= limit
+    from ..ops.kron_cg import engine_vmem_bytes
+
     return engine_vmem_bytes(tuple(grid_shape), degree) <= limit
 
 
 def run_sweep(db: TuningDB, *, degree: int, ndofs: int, precision: str,
               geom: str, nrhs_bucket: int = 1, nreps: int = 30,
               device_mesh=(1, 1, 1), round_stamp: str = "r06",
-              time_candidates: bool = False) -> dict:
+              time_candidates: bool = False, refine: bool = False) -> dict:
     """One deterministic autotune sweep for a (degree, engine,
     precision, sharding) slice: generate candidates from the registry
     plan, drop the ones the analysis budgets refuse (each drop
@@ -340,16 +387,22 @@ def run_sweep(db: TuningDB, *, degree: int, ndofs: int, precision: str,
     grid = dof_grid_shape(n, degree)
     form = registry.planned_engine_form(
         precision, geom, ndofs, degree, nrhs_bucket)
+    if refine:
+        # refinement keys get their own engine_form slot so a swept
+        # refine_inner_iters can never leak into a plain bf16 build
+        form = "refine"
     key = registry.make_cache_key(
         degree=degree, cell_shape=n, precision=precision, geom=geom,
         engine_form=form, nrhs_bucket=nrhs_bucket,
         device_mesh=device_mesh, nreps=nreps)
 
     cands = generate_candidates(degree=degree, grid_shape=grid,
-                                nrhs_bucket=nrhs_bucket, nreps=nreps)
+                                nrhs_bucket=nrhs_bucket, nreps=nreps,
+                                precision=precision, refine=refine)
     admitted, rejected = [], []
     for c in cands:
-        (admitted if _fits_budget(c, degree=degree, grid_shape=grid)
+        (admitted if _fits_budget(c, degree=degree, grid_shape=grid,
+                                  precision=precision)
          else rejected).append(c)
     if not admitted:
         # every candidate over budget: record the registry default as
@@ -357,7 +410,10 @@ def run_sweep(db: TuningDB, *, degree: int, ndofs: int, precision: str,
         # silently untuned under a sweep that claims to have run
         admitted = [{"plan_form": form, "window_kib": 0,
                      "iter_chunk": registry.DEFAULT_ITER_CHUNK,
-                     "nreps": nreps}]
+                     "nreps": nreps,
+                     **({"refine_inner_iters":
+                         registry.DEFAULT_REFINE_INNER_ITERS}
+                        if refine else {})}]
 
     on_tpu = jax.default_backend() == "tpu"
     label = "hardware" if on_tpu else (
@@ -376,9 +432,14 @@ def run_sweep(db: TuningDB, *, degree: int, ndofs: int, precision: str,
                                     nrhs_bucket=nrhs_bucket)
         scored.append((score, c))
     best_score, winner = min(scored, key=lambda sc: sc[0])
-    engine_name = ("kron_fused_batched" if form == "one_kernel_batched"
-                   else ("kron_fused" if geom == "uniform" else
-                         "xla_unfused"))
+    if precision == "bf16":
+        engine_name = ("bf16_refine" if refine
+                       else ("kron_bf16" if geom == "uniform"
+                             else "xla_bf16"))
+    else:
+        engine_name = ("kron_fused_batched" if form == "one_kernel_batched"
+                       else ("kron_fused" if geom == "uniform" else
+                             "xla_unfused"))
     entry = db.put(key, winner, score=best_score, label=label,
                    engine=engine_name, round_stamp=round_stamp)
     return {"key": _key_dict(key), "winner": winner,

@@ -234,6 +234,35 @@ GATE_REASONS: dict[str, str] = {
     "serve-f64-x64": (
         "precision 'f64' needs jax_enable_x64 (the serve CLI enables it; "
         "in-process callers must)"),
+    # -- bf16 / mixed-precision refinement gates (ISSUE 17) -----------------
+    "bf16-fused": (
+        "bf16 has no fused Mosaic ring yet (the bf16 agenda stage arms the "
+        "hardware path); running the unfused bf16-stream / f32-accumulate "
+        "composition"),
+    "bf16-float-bits": (
+        "bf16 precision streams the f32-assembled operator at bfloat16; "
+        "--float {bits} is unsupported with it (use --float 32)"),
+    "bf16-backend": (
+        "bf16 streaming wraps the kron (uniform) and xla (perturbed) "
+        "operators; --backend {backend} is not supported with it"),
+    "bf16-sharded": (
+        "bf16 precision is single-chip today (no sharded bf16-stream "
+        "form); running the sharded f32 path with the reason recorded"),
+    "checkpoint-bf16": (
+        "durable checkpointing is not wired through the bf16-stream loop; "
+        "snapshots disabled for this run"),
+    "refine-action": (
+        "iterative refinement applies to CG solves only (action runs solve "
+        "nothing); refine disabled"),
+    "refine-batched": (
+        "batched multi-RHS (nrhs>1) has no iterative-refinement form; "
+        "refine disabled for this run"),
+    "convergence-refine": (
+        "convergence capture rides the refinement outer loop's own "
+        "rel-residual history; per-iteration inner capture disabled"),
+    "precond-bf16": (
+        "bf16 paths support jacobi preconditioning only ({precond} has "
+        "no bf16 form); precond disabled for this run"),
     # -- tuning-database fallback reasons (engines.autotune) ----------------
     "tuning-disabled": (
         "tuning lookup disabled (no tuning database configured); registry "
@@ -299,7 +328,7 @@ ENGINE_FORM_NAMES = {
 ALL_FORMS = ("one_kernel", "chunked", "one_kernel_batched", "halo",
              "ext2d", "halo_overlap", "ext2d_overlap", "unfused")
 
-PRECISIONS = ("f32", "f64", "df32")
+PRECISIONS = ("f32", "f64", "df32", "bf16")
 GEOMETRIES = ("uniform", "perturbed")
 
 
@@ -462,6 +491,7 @@ def _plans():
                                      "dist_df_engine_plan"),
         "dist_folded": lambda: _imp("..dist.folded_cg",
                                     "dist_folded_engine_plan"),
+        "bf16": lambda: _imp("..ops.bf16", "engine_plan_bf16"),
     }
 
 
@@ -479,6 +509,13 @@ _PLANS = _plans()
 #: serve's continuous-batching iteration chunk (iterations per compiled
 #: step call) — the registry default the autotuner may override per key
 DEFAULT_ITER_CHUNK = 4
+
+#: inner-CG budget per refinement outer iteration (la.refine) — the
+#: registry default the autotuner may override per key: each outer
+#: contracts the error by roughly the bf16 inner solve's accuracy, so
+#: a larger budget buys fewer (hi-precision) outers at more (bf16)
+#: inners — exactly the trade the sweep adjudicates by time_to_rtol
+DEFAULT_REFINE_INNER_ITERS = 16
 
 ENGINE_SPECS: tuple[EngineSpec, ...] = (
     EngineSpec(
@@ -617,6 +654,46 @@ ENGINE_SPECS: tuple[EngineSpec, ...] = (
                     "sstep-folded-sharded", "batched-sharded-folded",
                     "convergence-folded-df-sharded"),
         notes="distributed folded general-geometry engine"),
+    EngineSpec(
+        name="kron_bf16",
+        forms=("unfused",),
+        precision="bf16", geometry="uniform", sharding="single",
+        backend="kron", nrhs="1",
+        plan="bf16",
+        analysis=(("bf16_apply_d{d}", "bf16_apply", "d:(3,)", {}),),
+        gate_slugs=("bf16-fused", "bf16-float-bits", "checkpoint-bf16",
+                    "sstep-unsupported", "precond-bf16"),
+        tunables=("iter_chunk", "window_kib"),
+        defaults={"iter_chunk": DEFAULT_ITER_CHUNK, "window_kib": 0},
+        notes="bf16-stream / f32-accumulate kron apply (half HBM bytes; "
+              "16x128-tile VMEM quantum)"),
+    EngineSpec(
+        name="xla_bf16",
+        forms=("unfused",),
+        precision="bf16", geometry="perturbed", sharding="single",
+        backend="xla", nrhs="1",
+        plan="bf16",
+        analysis=(("bf16_apply_perturbed_d{d}", "bf16_apply_perturbed",
+                   "d:(3,)", {}),),
+        gate_slugs=("bf16-fused", "bf16-backend", "bf16-float-bits",
+                    "checkpoint-bf16", "sstep-unsupported", "precond-bf16"),
+        notes="bf16-stream perturbed-geometry einsum apply (G streamed "
+              "at bfloat16, f32 accumulate)"),
+    EngineSpec(
+        name="bf16_refine",
+        forms=("unfused",),
+        precision="bf16", geometry="any", sharding="single",
+        backend="any", nrhs="1",
+        plan="bf16",
+        analysis=(("bf16_refine_d{d}", "bf16_refine", "d:(3,)", {}),),
+        gate_slugs=("refine-action", "refine-batched", "convergence-refine",
+                    "bf16-sharded", "bf16-float-bits", "precond-bf16"),
+        tunables=("refine_inner_iters", "iter_chunk"),
+        defaults={"refine_inner_iters": DEFAULT_REFINE_INNER_ITERS,
+                  "iter_chunk": DEFAULT_ITER_CHUNK},
+        notes="mixed-precision iterative refinement / flexible PCG: bf16 "
+              "hot-loop applies, hi-precision outer correction to "
+              "f64-class rtol (la.refine)"),
     EngineSpec(
         name="xla_unfused",
         forms=("unfused",),
@@ -815,6 +892,12 @@ def analysis_plan() -> tuple[AnalysisRef, ...]:
                     ((2, 2, 2),), min_devices=8))
     add(AnalysisRef("dist_folded_overlap", "dist_folded_overlap",
                     min_devices=2))
+    # bf16 mixed-precision rows (ISSUE 17): stream-parity applies on
+    # both geometry paths + the refinement driver traced end to end.
+    add(AnalysisRef("bf16_apply_d3", "bf16_apply", (3,)))
+    add(AnalysisRef("bf16_apply_perturbed_d3", "bf16_apply_perturbed",
+                    (3,)))
+    add(AnalysisRef("bf16_refine_d3", "bf16_refine", (3,)))
     return tuple(rows)
 
 

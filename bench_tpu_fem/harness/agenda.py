@@ -373,6 +373,64 @@ print("FUSEDBATCH unfused4:", res2.gdof_per_second, res2.extra)
 """
 
 
+BF16 = PRE + """
+# bf16 mixed-precision speed ladder (ISSUE 17) on hardware: the plain
+# bf16-stream apply A/B against f32 at the same solve (the halved byte
+# model becomes a measured GDoF/s ratio), the refinement ladder's
+# time_to_rtol_s at f64-class accuracy, and a hardware-labelled bf16
+# tuning sweep the builds consume (source=db). CPU runs keep every
+# assertion at small sizes with the labels recording the provenance.
+import json, os
+on_tpu = jax.default_backend() == 'tpu'
+ndofs = 12_500_000 if on_tpu else 2000
+refine_ndofs = 1_000_000 if on_tpu else 2000
+nreps = 200 if on_tpu else 30
+round_tag = os.environ.get('MEASURE_ROUND', 'r06')
+os.environ.setdefault('BTF_TUNING_DB',
+                      os.path.join(os.getcwd(), f'TUNING_{round_tag}.db'))
+from bench_tpu_fem.engines.autotune import default_tuning_db, run_sweep
+db = default_tuning_db()
+sw = run_sweep(db, degree=3, ndofs=ndofs, precision='bf16',
+               geom='uniform', nreps=nreps, round_stamp=round_tag,
+               time_candidates=on_tpu)
+swr = run_sweep(db, degree=3, ndofs=refine_ndofs, precision='bf16',
+                geom='uniform', nreps=nreps, round_stamp=round_tag,
+                refine=True)
+# seed the driver's exec key with the sweep winner so the refine run
+# below consumes it (source=db) — same bridge perfgate exercises
+from bench_tpu_fem.bench.driver import _exec_cache_key
+from bench_tpu_fem.mesh.sizing import compute_mesh_size
+out = {'metric': 'bf16', 'sweep_label': sw['label'],
+       'refine_winner': swr['winner']}
+for prec in ('auto', 'bf16'):
+    cfg = BenchConfig(ndofs_global=ndofs, degree=3, qmode=1,
+                      float_bits=32, nreps=nreps, use_cg=True,
+                      precision=prec)
+    res, w = timed_res(cfg)
+    out[prec] = {'gdof_s': res.gdof_per_second,
+                 'hbm_bytes_per_dof':
+                     res.extra['roofline']['hbm_bytes_per_dof'],
+                 'wall_s': w}
+# the byte-model claim the roofline carries: bf16 streams exactly half
+assert out['bf16']['hbm_bytes_per_dof'] * 2 == \\
+    out['auto']['hbm_bytes_per_dof'], out
+rcfg = BenchConfig(ndofs_global=refine_ndofs, degree=3, qmode=1,
+                   float_bits=32, nreps=nreps, use_cg=True,
+                   precision='bf16-refine', precond='jacobi')
+rkey = _exec_cache_key(rcfg, compute_mesh_size(refine_ndofs, 3),
+                       'unfused', 'cg+refine')
+db.put(rkey, swr['winner'], score=swr['score'], label=swr['label'],
+       round_stamp=round_tag, engine='bf16_refine')
+rres, w = timed_res(rcfg)
+st = rres.extra['refine']
+assert st['converged'] and st['achieved_rel'] <= 1e-10, st
+assert rres.extra['tuning']['source'] == 'db', rres.extra['tuning']
+out['refine'] = dict(st, time_to_rtol_s=rres.extra.get('time_to_rtol_s'),
+                     tuning=rres.extra.get('tuning'), wall_s=w)
+print(json.dumps(out))
+"""
+
+
 SERVE_SMOKE = """
 import os
 if os.environ.get('JAX_PLATFORMS', '') == 'cpu':
@@ -477,6 +535,11 @@ def make_stages(round_tag: str = DEFAULT_ROUND) -> dict[str, Stage]:
         # the per-bucket VMEM tiers from design estimates to
         # measurements the moment the tunnel lives.
         _py("fusedbatch", FUSEDBATCH, 2400),
+        # bf16 speed ladder on hardware (ISSUE 17): plain bf16-stream
+        # A/B vs f32 (the halved byte model becomes a measured GDoF/s
+        # ratio), refinement time_to_rtol_s at f64-class accuracy, and
+        # hardware-labelled bf16 tuning sweeps the builds consume.
+        _py("bf16", BF16, 2400, parse=last_json_line),
         _py("dfacc", DFACC, 1800, provides="dfacc"),
         _py("pertdf", PERTDF, 2400, gate="dfacc"),
         _py("foldeng", FOLDENG, 2400),
@@ -600,7 +663,8 @@ ALIASES = {
 # Round-6 default agenda, ordered by value-per-minute under wedge risk
 # (measure_all's ordering, expanded through ALIASES).
 AGENDAS = {
-    "round6": ["health", "serve", "chaos", "autotune", "fusedbatch", "dfacc",
+    "round6": ["health", "serve", "chaos", "autotune", "fusedbatch", "bf16",
+               "dfacc",
                "pertdf", "foldeng", "dfext2d", "scale", "dfeng", "bench",
                "conv", "precond", "dflarge", "pert100", "deg7probe",
                "matrix"],

@@ -1,0 +1,169 @@
+"""Mixed-precision iterative refinement / flexible PCG (ISSUE 17).
+
+The speed ladder's driver: run the HOT LOOP — every CG operator apply —
+on the bf16-stream / f32-accumulate operator (ops.bf16.Bf16Operator, HBM
+bytes halved), and recover f64-class answers with a cheap high-precision
+outer correction loop:
+
+    r_k = b - A_hi x_k          (one hi-precision apply per OUTER)
+    d_k ~ A_lo^{-1} r_k         (inner_iters of [P]CG on the bf16 op)
+    x_{k+1} = x_k + d_k         (hi-precision axpy)
+
+Classic iterative refinement with an approximate inner solver: each
+outer contracts the error by roughly the inner solve's relative
+accuracy (bf16 mantissa ~ 2-3 decimal digits with a few Jacobi-PCG
+digits on top), so rel 1e-10 arrives in a handful of outers while the
+per-iteration bandwidth bill stays at bf16 width. bf16 keeps f32's
+exponent range, so no loss scaling: a 1e-10 residual is still a normal
+bf16 number and the inner solve sees it at full (mantissa-limited)
+fidelity.
+
+Composes with la.precond Jacobi as FLEXIBLE PCG: the inner solve takes
+a diag-inverse and runs preconditioned CG on the bf16 operator (the
+preconditioner is f32 outer-loop state, not a streamed operand), so the
+creative endpoint — bf16 bandwidth, Jacobi iteration counts, f64-class
+answers — is one config.
+
+Evidence contract: `RefineResult.stamp()` carries the inner/outer
+iteration split, the rel-residual history, and `time_to_rtol_s` — the
+end-to-end adjudicator for cheaper-but-weaker iterations (a precision
+that halves bytes but doubles iterations must still win THIS number).
+All numbers are cpu-measured until the harness `bf16` agenda stage
+re-runs them on hardware. `refine=None` paths touch nothing here: this
+module is additive, and la.cg's solve bodies are byte-identical to
+pre-PR (the frozen-replica pin).
+"""
+
+from __future__ import annotations
+
+import time
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from .cg import cg_solve
+from .vector import inner_product
+
+
+class RefineResult(NamedTuple):
+    """One refinement solve: the answer plus the evidence split the
+    driver stamps (outer/inner iterations, rel history, time-to-rtol)."""
+
+    x: jnp.ndarray
+    outer_iters: int
+    inner_iters: int            # inner CG budget per outer
+    inner_iters_total: int      # outer_iters * inner_iters (all bf16)
+    rel_history: tuple          # ||r_k|| / ||b|| per outer check
+    achieved_rel: float
+    converged: bool
+    preconditioned: bool
+    wall_s: float
+    time_to_rtol_s: float | None
+
+    def stamp(self) -> dict:
+        """The `refine` evidence stamp (record extra["refine"])."""
+        return {
+            "outer_iters": self.outer_iters,
+            "inner_iters": self.inner_iters,
+            "inner_iters_total": self.inner_iters_total,
+            "rel_history": [float(f"{v:.3e}") for v in self.rel_history],
+            "achieved_rel": float(self.achieved_rel),
+            "converged": bool(self.converged),
+            "preconditioned": bool(self.preconditioned),
+            "wall_s": round(float(self.wall_s), 6),
+            "time_to_rtol_s": (round(float(self.time_to_rtol_s), 6)
+                               if self.time_to_rtol_s is not None
+                               else None),
+        }
+
+
+@jax.jit
+def _residual(op_hi, x, b):
+    """(r, <r,r>) in the hi-precision operator's dtype — the one
+    non-bf16 apply per outer iteration."""
+    r = b - op_hi.apply(x)
+    return r, inner_product(r, r)
+
+
+@partial(jax.jit, static_argnames=("inner_iters",))
+def _correct(op_lo, r32, inner_iters):
+    return cg_solve(op_lo.apply, r32, jnp.zeros_like(r32), inner_iters)
+
+
+@partial(jax.jit, static_argnames=("inner_iters",))
+def _correct_pc(op_lo, r32, dinv, inner_iters):
+    return cg_solve(op_lo.apply, r32, jnp.zeros_like(r32), inner_iters,
+                    precond=lambda z: dinv * z)
+
+
+@jax.jit
+def _axpy(x, d):
+    return x + jnp.asarray(d, x.dtype)
+
+
+def refine_solve(
+    op_hi,
+    op_lo,
+    b: jnp.ndarray,
+    *,
+    rtol: float = 1e-10,
+    max_outer: int = 60,
+    inner_iters: int = 16,
+    dinv: jnp.ndarray | None = None,
+) -> RefineResult:
+    """Solve A x = b to `rtol` relative residual with ALL hot-loop
+    applies on `op_lo` (the bf16-stream operator) and one `op_hi` apply
+    per outer for the residual correction.
+
+    `op_hi` sets the answer class: an f64-leaf operator (CPU x64 / TPU
+    with x64) gives f64-class outer arithmetic; f32 gives f32-floor
+    answers. `dinv` (la.precond Jacobi diag-inverse, f32) arms the
+    flexible-PCG inner solve. The loop is host-driven — each step is one
+    compiled call, reused across outers — and the per-outer host sync is
+    the rel-residual check itself, so the evidence timing is honest."""
+    hi_dtype = b.dtype
+    bnorm2 = float(_norm2(b))
+    bnorm = bnorm2 ** 0.5 if bnorm2 > 0.0 else 1.0
+    x = jnp.zeros_like(b)
+    pre = dinv is not None
+    hist: list = []
+    t0 = time.perf_counter()
+    time_to_rtol = None
+    converged = False
+    outer = 0
+    for outer in range(max_outer):
+        r, rn2 = _residual(op_hi, x, b)
+        rel = float(rn2) ** 0.5 / bnorm
+        hist.append(rel)
+        if rel <= rtol:
+            if time_to_rtol is None:
+                time_to_rtol = time.perf_counter() - t0
+            converged = True
+            break
+        r32 = jnp.asarray(r, jnp.float32)
+        if pre:
+            d = _correct_pc(op_lo, r32, dinv, inner_iters)
+        else:
+            d = _correct(op_lo, r32, inner_iters)
+        x = _axpy(x, d)
+    wall = time.perf_counter() - t0
+    n_out = outer if converged else max_outer
+    return RefineResult(
+        x=x,
+        outer_iters=n_out,
+        inner_iters=int(inner_iters),
+        inner_iters_total=n_out * int(inner_iters),
+        rel_history=tuple(hist),
+        achieved_rel=float(hist[-1]) if hist else float("inf"),
+        converged=converged,
+        preconditioned=pre,
+        wall_s=wall,
+        time_to_rtol_s=time_to_rtol,
+    )
+
+
+@jax.jit
+def _norm2(b):
+    return inner_product(b, b)

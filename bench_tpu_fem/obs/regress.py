@@ -364,6 +364,12 @@ LOWER_IS_BETTER_COUNTERS = (
     # a key the sweep just tuned means the DB round-trip broke (torn
     # write, key drift, corrupt load) — pinned at 0 on the perfgate leg
     "tuning_fallbacks",
+    # ISSUE 17 mixed-precision refinement on the fixed-seed perfgate
+    # problem: the outer/inner iteration counts are DETERMINISTIC on
+    # CPU (fixed seed, fixed ladder) — an increase means the bf16 inner
+    # solve got weaker or the outer correction regressed (the exact
+    # failure the CI refinement-regression probe injects)
+    "refine_outer_iters", "refine_inner_iters_total",
 )
 #: snapshot keys where a DECREASE below baseline is a regression
 HIGHER_IS_BETTER_COUNTERS = (
@@ -386,6 +392,12 @@ HIGHER_IS_BETTER_COUNTERS = (
     # its swept entry — a drop means lookups silently stopped consulting
     # the tuning DB (the exact regression the injected probe simulates)
     "tuning_db_hits",
+    # ISSUE 17 bf16 speed ladder: the refinement solve must keep
+    # reaching f64-class rtol with every hot-loop apply at bf16
+    # (bf16_parity_ok = 1), and the calibrated bf16 envelopes must keep
+    # their measured headroom multiple over the clean-solve floor — a
+    # drop means the envelope drifted toward false positives
+    "bf16_parity_ok", "bf16_envelope_headroom",
 )
 #: contract booleans: baseline True -> current must stay True
 CONTRACT_FLAGS = ("record_contract_ok", "trace_valid",

@@ -32,7 +32,7 @@ import os
 __all__ = [
     "df_flops_per_dof", "DF_BYTES_PER_DOF", "folded_cell_flops",
     "folded_g_stream_bytes_per_cell", "cost_model", "machine_peaks",
-    "roofline_stamp",
+    "roofline_stamp", "refine_byte_model",
 ]
 
 _ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
@@ -189,7 +189,12 @@ def cost_model(*, family: str, degree: int, qmode: int = 1,
                 flops = int(flops * 0.6)  # no XLA x/r update tail
                 hbm = 8 * 4
         else:
-            itemsize = 8 if precision == "f64" else 4
+            # bf16 (ISSUE 17): IDENTICAL stream counts to f32 at
+            # itemsize 2 — exactly half the f32 HBM bytes (the pinned
+            # cross-check in tests/test_bf16.py); f32-accumulate keeps
+            # the flop count unchanged.
+            itemsize = (8 if precision == "f64"
+                        else (2 if precision == "bf16" else 4))
             flops = kron_f32_flops_per_dof(P, use_cg)
             if precision == "f64":
                 flops *= _EMULATED_F64_FLOP_MULT
@@ -201,7 +206,8 @@ def cost_model(*, family: str, degree: int, qmode: int = 1,
                 streams += UNFUSED_EXTRA_STREAMS
             hbm = streams * itemsize
     else:  # folded / xla: general geometry
-        itemsize = 8 if precision == "f64" else 4
+        itemsize = (8 if precision == "f64"
+                    else (2 if precision == "bf16" else 4))
         dof_per_cell = P**3  # interior share: (nP+1)^3 / n^3 -> P^3
         gmode = "corner" if geom == "corner" else "g"
         cell_f = folded_cell_flops(P, nq, gmode)
@@ -212,8 +218,13 @@ def cost_model(*, family: str, degree: int, qmode: int = 1,
             cell_f *= _EMULATED_F64_FLOP_MULT
             note = ("analytic-design-estimate (emulated-f64 flop "
                     "multiplier is a measured-ratio proxy)")
-        geom_stream = (FOLDED_CORNER_VALUES_PER_CELL * 4 if gmode == "corner"
-                       else folded_g_stream_bytes_per_cell(nq))
+        # bf16 streams the geometry factors at half width too; every
+        # other precision keeps the committed 4-byte G stream.
+        g_item = 2 if precision == "bf16" else 4
+        geom_stream = (FOLDED_CORNER_VALUES_PER_CELL * g_item
+                       if gmode == "corner"
+                       else folded_g_stream_bytes_per_cell(
+                           nq, itemsize=g_item))
         vec_streams = (KRON_F32_CG_STREAMS if use_cg
                        else KRON_F32_ACTION_STREAMS)
         if use_cg and not fused:
@@ -225,6 +236,10 @@ def cost_model(*, family: str, degree: int, qmode: int = 1,
             hbm += 2 * 4 + 2 * itemsize
             note = ("analytic-design-estimate (xla einsum path: folded "
                     "dataflow + gather/scatter overhead, crudest model)")
+    if precision == "bf16":
+        note += ("; bf16-stream operands at itemsize 2 (half the f32 "
+                 "bytes), f32-accumulate flops unchanged, int32 "
+                 "gather traffic stays 4-byte")
     flops = int(flops)
     hbm = int(hbm)
     return {
@@ -232,6 +247,37 @@ def cost_model(*, family: str, degree: int, qmode: int = 1,
         "hbm_bytes_per_dof": hbm,
         "intensity_flop_per_byte": round(flops / hbm, 4) if hbm else 0.0,
         "model": note,
+    }
+
+
+def refine_byte_model(*, family: str, degree: int, qmode: int = 1,
+                      geom: str = "uniform", inner_iters_total: int,
+                      outer_iters: int,
+                      outer_precision: str = "f64") -> dict:
+    """Combined HBM byte model of ONE mixed-precision refinement solve
+    (ISSUE 17): ``inner_iters_total`` bf16 CG iterations plus one
+    hi-precision residual apply per outer check. Per-dof bytes split by
+    precision so the evidence stamp shows where the bandwidth bill
+    lands (the bf16 fraction is the ladder's whole point); labelled
+    design-estimate like every cost_model number."""
+    inner = cost_model(family=family, degree=degree, qmode=qmode,
+                       precision="bf16", geom=geom, use_cg=True)
+    outer = cost_model(family=family, degree=degree, qmode=qmode,
+                       precision=outer_precision, geom=geom,
+                       use_cg=False)
+    inner_b = inner["hbm_bytes_per_dof"] * int(inner_iters_total)
+    outer_b = outer["hbm_bytes_per_dof"] * int(outer_iters)
+    total = inner_b + outer_b
+    return {
+        "inner_precision": "bf16",
+        "outer_precision": outer_precision,
+        "inner_iters_total": int(inner_iters_total),
+        "outer_applies": int(outer_iters),
+        "inner_hbm_bytes_per_dof": int(inner_b),
+        "outer_hbm_bytes_per_dof": int(outer_b),
+        "total_hbm_bytes_per_dof": int(total),
+        "bf16_byte_fraction": round(inner_b / total, 4) if total else 0.0,
+        "model": "analytic-design-estimate (refinement inner+outer split)",
     }
 
 

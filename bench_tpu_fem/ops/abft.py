@@ -47,10 +47,23 @@ import jax.numpy as jnp
 # O(1e-6) f32 (eps 6e-8 times a ~benchmark-budget iteration count),
 # O(1e-14) f64, O(1e-13) for the df carried hi channel. The envelopes
 # keep >= 2 orders of headroom above clean drift on each side.
+#
+# bf16 (ISSUE 17): the bf16-stream / f32-accumulate recurrence STALLS
+# at its 8-bit-mantissa floor, so carried-vs-true drift on a CLEAN
+# solve is O(1e-2..1e-1) (measured 2.7e-2 at 13^3 dofs, 6.4e-2 at
+# 19^3, 1.1e-1 at 25^3 on the fixed-seed calibration problems) — a
+# bf16 run audited against the f32 tier (1e-3) FALSE-POSITIVES on the
+# first clean audit, forcing audits off and letting real flips sail
+# through: the threat tests/test_bf16.py pins. The bf16 tier sits
+# >= 50x above the measured clean floor and adjudicates GROSS carry
+# corruption only (a 2^±8 carry flip lands O(1e2)); per-APPLY flip
+# detection at bf16 is the ABFT check's job (below), whose clean floor
+# is orders smaller.
 RESIDUAL_ENVELOPE = {
     "f32": 1e-3,
     "f64": 1e-9,
     "df32": 1e-8,
+    "bf16": 5.0,
 }
 
 # Per-apply ABFT check: |<w, y> - <aw, p>| / (||w||·||y||). The error of
@@ -58,23 +71,40 @@ RESIDUAL_ENVELOPE = {
 # (the sums themselves may cancel arbitrarily — the interior rows of a
 # Laplacian applied to the ones vector cancel to ~0 — which is why the
 # comparison must NOT normalise by |<aw, p>| itself).
+# bf16 per-apply clean floor: the Cauchy–Schwarz-normalised mismatch
+# averages the per-element bf16 rounding across the dof count, measured
+# 6.2e-5 (13^3), 3.3e-5 (19^3), 9.0e-6 (25^3) on the fixed-seed
+# calibration problems; an early-iteration exponent-bit flip of the
+# apply output lands 4.7e-3 at the 13^3 calibration size (the ones-
+# checksum dilutes a single-element hit ~1/sqrt(n), so the per-apply
+# check discriminates at small-to-moderate n; beyond that, carry
+# corruption falls to the gross-drift tier above and the hardware
+# agenda stage re-calibrates). 3.5e-3 keeps >= 50x headroom over the
+# 13^3 clean floor while sitting under the measured flip signal.
 ABFT_ENVELOPE = {
     "f32": 1e-4,
     "f64": 1e-10,
+    "bf16": 3.5e-3,
 }
 
 
 def residual_envelope(dtype) -> float:
     """True-residual drift envelope for a jnp/np dtype."""
+    dt = jnp.dtype(dtype)
+    if dt == jnp.dtype(jnp.bfloat16):
+        return RESIDUAL_ENVELOPE["bf16"]
     return (RESIDUAL_ENVELOPE["f32"]
-            if jnp.dtype(dtype) == jnp.dtype(jnp.float32)
+            if dt == jnp.dtype(jnp.float32)
             else RESIDUAL_ENVELOPE["f64"])
 
 
 def abft_envelope(dtype) -> float:
     """Per-apply ABFT envelope for a jnp/np dtype."""
+    dt = jnp.dtype(dtype)
+    if dt == jnp.dtype(jnp.bfloat16):
+        return ABFT_ENVELOPE["bf16"]
     return (ABFT_ENVELOPE["f32"]
-            if jnp.dtype(dtype) == jnp.dtype(jnp.float32)
+            if dt == jnp.dtype(jnp.float32)
             else ABFT_ENVELOPE["f64"])
 
 
@@ -109,7 +139,10 @@ def abft_residual(w, aw, p, y, dot, ww=None) -> jnp.ndarray:
 
 
 def _uint_dtype(dtype):
-    return jnp.uint32 if jnp.dtype(dtype).itemsize == 4 else jnp.uint64
+    size = jnp.dtype(dtype).itemsize
+    if size == 2:
+        return jnp.uint16
+    return jnp.uint32 if size == 4 else jnp.uint64
 
 
 #: default flipped bit: exponent bit 3 of the f32 layout (bit 26) — a
@@ -120,11 +153,17 @@ def _uint_dtype(dtype):
 DEFAULT_FLIP_BIT = 26
 #: the f64 twin (exponent bit 3 of the f64 layout: 2^±8 as well)
 DEFAULT_FLIP_BIT_F64 = 55
+#: the bf16 twin (exponent bit 3 of the bf16 layout — bf16 shares f32's
+#: 8-bit exponent at bits 14..7, so exponent bit 3 is bit 10: the same
+#: finite 2^±8 scale change as the f32/f64 defaults)
+DEFAULT_FLIP_BIT_BF16 = 10
 
 
 def default_flip_bit(dtype) -> int:
-    return (DEFAULT_FLIP_BIT
-            if jnp.dtype(dtype).itemsize == 4 else DEFAULT_FLIP_BIT_F64)
+    size = jnp.dtype(dtype).itemsize
+    if size == 2:
+        return DEFAULT_FLIP_BIT_BF16
+    return DEFAULT_FLIP_BIT if size == 4 else DEFAULT_FLIP_BIT_F64
 
 
 def flip_bit(y: jnp.ndarray, index, bit: int) -> jnp.ndarray:

@@ -1,0 +1,143 @@
+"""bf16-stream / f32-accumulate operator wrapper (ISSUE 17).
+
+The roofline stamps place the sum-factorised apply firmly HBM-bound, so
+halving streamed bytes is the most direct GDoF/s lever left: store the
+operator's streamed operands (banded factor diagonals for the kron fast
+path, the geometry tensor G for the perturbed einsum path) as bfloat16
+and let every contraction accumulate in f32. bf16 keeps f32's 8-bit
+exponent — only mantissa is sacrificed — so no loss-scaling is needed:
+residuals at 1e-10 still round to normal bf16 numbers, which is exactly
+why the iterative-refinement outer loop (la.refine) can run its hot-loop
+applies at bf16 bandwidth and still hand back f64-class answers.
+
+Mechanically `Bf16Operator` wraps ANY existing operator pytree
+(ops.kron.KronLaplacian uniform fast path, ops.laplacian.Laplacian
+einsum path for perturbed geometry): construction rounds every floating
+leaf to bfloat16 — the HBM-resident copy IS bf16, so the streamed-byte
+claim is structural, not a compiler hope — and `apply` upcasts operands
+and input to the f32 accumulator dtype around the wrapped apply. On TPU,
+XLA fuses the widening converts into the contractions so HBM traffic
+stays at bf16 width; on CPU the same graph is the bit-exact oracle for
+what the chip computes. The bandwidth halving itself is labelled
+design-estimate until the harness `bf16` agenda stage measures it on
+hardware (obs.roofline carries the byte model).
+
+VMEM planning: bf16 tiles on TPU are (16, 128) sublane x lane (f32 is
+(8, 128)) — see analysis/fixtures.py fixture_r1_bf16 — so every window
+estimate here is quantised UP to the 4 KiB bf16 tile quantum before the
+tier ladder runs. There is no fused bf16 Mosaic ring yet: the plan
+always routes the unfused composition (engines.registry gates the fused
+form with a registered reason), but the quantised window numbers are
+what the autotuner sweeps and what the hardware stage will check.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+#: bf16 Mosaic tile (sublane, lane) — double the f32 sublane count, so a
+#: bf16 tile is the same 4 KiB footprint as an f32 (8, 128) tile but
+#: holds twice the elements (the packing that halves streamed bytes).
+BF16_TILE = (16, 128)
+
+#: bytes per bf16 tile: 16 * 128 * 2 = 4 KiB — the VMEM window quantum
+#: every bf16 plan rounds up to.
+BF16_TILE_BYTES = BF16_TILE[0] * BF16_TILE[1] * 2
+
+
+def quantize_to_bf16_tile(nbytes: int) -> int:
+    """Round a VMEM window estimate UP to the bf16 (16, 128) tile
+    quantum (Mosaic allocates whole tiles; a 1-byte overhang costs a
+    full 4 KiB tile)."""
+    q = BF16_TILE_BYTES
+    return max(q, -(-int(nbytes) // q) * q)
+
+
+def engine_vmem_bytes_bf16(grid_shape, degree: int) -> int:
+    """Design-estimate VMEM footprint of a (future) fused bf16 kron
+    ring: the f32 ring's vector windows at half width, re-quantised to
+    the bf16 tile. Labelled design-estimate until the hardware `bf16`
+    agenda stage checks it on chip."""
+    from .kron_cg import engine_vmem_bytes
+
+    return quantize_to_bf16_tile(engine_vmem_bytes(grid_shape, degree) // 2)
+
+
+def engine_plan_bf16(grid_shape, degree: int) -> tuple[str, int | None]:
+    """(form, scoped_vmem_kib) for a bf16 single-chip solve — the
+    registry's plan contract (ops.kron_cg.engine_plan). No fused bf16
+    Mosaic ring exists yet, so the achieved form is always the unfused
+    streamed composition; the quantised window estimate still rides the
+    plan so the autotuner's candidate ladder and the hardware stage
+    agree on the tile-quantised footprint."""
+    del grid_shape, degree  # footprint via engine_vmem_bytes_bf16
+    return "unfused", None
+
+
+def _to_bf16_leaf(a):
+    if isinstance(a, (jnp.ndarray, np.ndarray)) and jnp.issubdtype(
+            jnp.asarray(a).dtype, jnp.floating):
+        return jnp.asarray(a, jnp.bfloat16)
+    return a
+
+
+def _widen_leaf(a, dtype):
+    if isinstance(a, (jnp.ndarray, np.ndarray)) and jnp.issubdtype(
+            jnp.asarray(a).dtype, jnp.floating):
+        return jnp.asarray(a, dtype)
+    return a
+
+
+@partial(
+    jax.tree_util.register_dataclass,
+    data_fields=["inner"],
+    meta_fields=["accum"],
+)
+@dataclass(frozen=True)
+class Bf16Operator:
+    """bf16-stream / f32-accumulate wrapper around an operator pytree.
+
+    `inner` is the wrapped operator with every floating leaf already
+    rounded to bfloat16 (the device-resident state — what HBM streams).
+    `apply` rounds the input to bf16 (the streamed width of the vector),
+    widens operands + input to the `accum` dtype, and runs the wrapped
+    apply — contractions accumulate at f32, the standard mixed-precision
+    contract. Dirichlet rows pass the bf16-rounded input through (the
+    wrapped operator's own blend), consistent with "every streamed value
+    is bf16-width"."""
+
+    inner: object
+    accum: str = "float32"
+
+    def apply(self, x_grid: jnp.ndarray) -> jnp.ndarray:
+        acc = jnp.dtype(self.accum)
+        xb = jnp.asarray(x_grid, jnp.bfloat16)
+        hi = jax.tree_util.tree_map(lambda a: _widen_leaf(a, acc),
+                                    self.inner)
+        return hi.apply(jnp.asarray(xb, acc))
+
+
+def to_bf16(op) -> Bf16Operator:
+    """Wrap an operator pytree (KronLaplacian / Laplacian / ...) as a
+    bf16-stream operator: every floating leaf rounds to bfloat16 ONCE at
+    construction (integer/bool leaves — bc masks — pass through), so the
+    wrapped state genuinely lives at half width."""
+    inner = jax.tree_util.tree_map(_to_bf16_leaf, op)
+    return Bf16Operator(inner=inner)
+
+
+def bf16_dinv(op) -> jnp.ndarray | None:
+    """Jacobi diag-inverse for a bf16-wrapped operator, computed from
+    the WIDENED operand state (f32): the preconditioner is outer-loop
+    state, not a streamed hot-loop operand, so it keeps f32 accuracy —
+    the la.precond composition the refinement driver feeds cg_solve."""
+    from ..la.precond import op_jacobi_dinv
+
+    wide = jax.tree_util.tree_map(
+        lambda a: _widen_leaf(a, jnp.dtype("float32")), op.inner)
+    return op_jacobi_dinv(wide)

@@ -97,7 +97,7 @@ BOUNDARY_HOOK = None
 # unarmed path runs zero extra code.
 SDC_HOOK = None
 
-_PRECISIONS = ("f32", "f64", "df32")
+_PRECISIONS = ("f32", "f64", "df32", "bf16")
 
 # Admission cap on problem size: a single oversized request must be
 # REFUSED (classified `unsupported`, 422) rather than allowed to grind
@@ -393,6 +393,17 @@ class CompiledSolver:
             self._op = build_laplacian(
                 mesh, spec.degree, 1, "gll", kappa=2.0, dtype=dtype,
                 tables=t, backend=backend)
+            if spec.precision == "bf16":
+                # bf16 serving (ISSUE 17): round the HBM-resident
+                # operator state to bfloat16 ONCE — every batched /
+                # continuous hot-loop apply streams half-width operands
+                # with f32 accumulation (vectors and scales stay f32, so
+                # the checkpoint API is untouched). bf16-class answers;
+                # always the unfused form (registry plans no fused bf16
+                # ring yet).
+                from ..ops.bf16 import to_bf16
+
+                self._op = to_bf16(self._op)
             self._base = jnp.asarray(b64, dtype)
             self.engine_form = planned_engine_form(spec, self.bucket)
 

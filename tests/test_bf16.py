@@ -1,0 +1,562 @@
+"""bf16 mixed-precision speed ladder (ISSUE 17): the bf16-stream /
+f32-accumulate operator wrapper on both geometry paths, the
+iterative-refinement driver that recovers f64-class answers over the
+bf16 hot loop, the calibrated bf16 SDC envelope tier (and the THREAT it
+closes: a bf16 run audited against the f32 tier false-positives on the
+first clean audit), the halved-byte roofline model, the registry-routed
+driver/serve precision axis with its cache-key slice, and the autotune
+bf16 ladder with TuningDB consumption on both the driver and serve
+sides.
+
+Standing frozen pins: the f32/df32 driver paths are byte-identical to
+the pre-PR routing (precision="auto" never enters bf16 code — asserted
+on stamps), and every new gate records a REGISTERED reason.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from bench_tpu_fem.elements import build_operator_tables
+from bench_tpu_fem.la.cg import CGAudit, SdcInject, cg_solve
+from bench_tpu_fem.la.refine import refine_solve
+from bench_tpu_fem.mesh import boundary_dof_marker, create_box_mesh
+from bench_tpu_fem.ops import build_laplacian
+from bench_tpu_fem.ops.abft import (
+    ABFT_ENVELOPE,
+    RESIDUAL_ENVELOPE,
+    abft_envelope,
+    checksum_vectors,
+    default_flip_bit,
+    residual_envelope,
+)
+from bench_tpu_fem.ops.bf16 import (
+    BF16_TILE_BYTES,
+    Bf16Operator,
+    bf16_dinv,
+    engine_plan_bf16,
+    engine_vmem_bytes_bf16,
+    quantize_to_bf16_tile,
+    to_bf16,
+)
+
+# ---------------------------------------------------------------------------
+# fixed-seed problems: the 13^3-dof calibration size (mesh (4,4,4),
+# degree 3) the envelope tiers were measured on.
+# ---------------------------------------------------------------------------
+
+
+def _problem(n=(4, 4, 4), degree=3, qmode=1, perturb=0.0, seed=7,
+             dtype=jnp.float32):
+    mesh = create_box_mesh(n, geom_perturb_fact=perturb)
+    backend = "kron" if perturb == 0.0 else "xla"
+    op = build_laplacian(mesh, degree, qmode, dtype=dtype, backend=backend)
+    bc = boundary_dof_marker(n, degree)
+    b = np.random.RandomState(seed).randn(*bc.shape)
+    b[np.asarray(bc)] = 0.0
+    return op, jnp.asarray(b, dtype)
+
+
+# ---------------------------------------------------------------------------
+# the wrapper: half-width resident state, f32 accumulation, parity.
+# ---------------------------------------------------------------------------
+
+
+def test_bf16_state_is_half_width():
+    """to_bf16 rounds every floating leaf to bfloat16 ONCE — the
+    HBM-resident state genuinely lives at half width (the streamed-byte
+    claim is structural), while integer/bool leaves (bc masks, dofmaps)
+    pass through untouched."""
+    op, _ = _problem()
+    lo = to_bf16(op)
+    f32_b = lo_b = 0
+    for a, al in zip(jax.tree_util.tree_leaves(op),
+                     jax.tree_util.tree_leaves(lo.inner)):
+        a, al = jnp.asarray(a), jnp.asarray(al)
+        if jnp.issubdtype(a.dtype, jnp.floating):
+            assert al.dtype == jnp.bfloat16
+            f32_b += a.size * a.dtype.itemsize
+            lo_b += al.size * al.dtype.itemsize
+        else:
+            assert al.dtype == a.dtype
+    assert f32_b > 0 and lo_b * 2 == f32_b
+
+
+@pytest.mark.parametrize("perturb", [0.0, 0.1])
+def test_bf16_apply_parity_both_geometry_paths(perturb):
+    """The bf16-stream apply tracks the f32 apply to bf16-class
+    accuracy (~8-bit mantissa => O(1e-2) relative) on BOTH operand
+    structures — the kron fast path and the perturbed-geometry einsum
+    path — and returns the f32 accumulator dtype."""
+    op, b = _problem(perturb=perturb)
+    lo = to_bf16(op)
+    y32 = np.asarray(jax.jit(op.apply)(b))
+    ylo = np.asarray(jax.jit(lo.apply)(b))
+    assert ylo.dtype == np.float32
+    rel = np.linalg.norm(ylo - y32) / np.linalg.norm(y32)
+    assert 0 < rel < 2e-2, rel
+    # not a no-op wrapper: the rounding is real
+    assert not np.array_equal(ylo, y32)
+
+
+def test_bf16_jacobi_dinv_is_f32_outer_state():
+    """The Jacobi diag-inverse is outer-loop state, not a streamed
+    operand: computed from the WIDENED state at f32, positive on the
+    interior, exactly 1 on Dirichlet rows (the blend convention)."""
+    op, _ = _problem()
+    d = bf16_dinv(to_bf16(op))
+    assert d is not None and jnp.asarray(d).dtype == jnp.float32
+    assert bool(jnp.all(jnp.isfinite(d))) and bool(jnp.all(d > 0))
+
+
+# ---------------------------------------------------------------------------
+# iterative refinement: f64-class answers over the bf16 hot loop.
+# ---------------------------------------------------------------------------
+
+
+def test_refine_reaches_f64_class_rtol():
+    """The ladder's headline: ALL hot-loop applies at bf16 bandwidth,
+    one f64 apply per outer, and the answer lands at 1e-10 relative
+    residual — 8 orders below where the plain bf16 recurrence stalls."""
+    op64, b64 = _problem(dtype=jnp.float64)
+    op32, _ = _problem(dtype=jnp.float32)
+    lo = to_bf16(op32)
+    res = refine_solve(op64, lo, b64, rtol=1e-10,
+                       dinv=bf16_dinv(lo))
+    assert res.converged and res.achieved_rel <= 1e-10
+    st = res.stamp()
+    assert st["preconditioned"] and st["inner_iters_total"] == \
+        st["outer_iters"] * st["inner_iters"]
+    assert st["rel_history"][0] == 1.0 and st["rel_history"][-1] <= 1e-10
+    assert st["time_to_rtol_s"] is not None and st["time_to_rtol_s"] > 0
+    # true f64 residual agrees with the stamped achieved_rel's class
+    r = np.asarray(b64) - np.asarray(op64.apply(res.x))
+    r[np.abs(np.asarray(b64)) == 0.0] = 0.0
+    true_rel = np.linalg.norm(
+        np.where(np.asarray(b64) == 0, 0.0, r)) / np.linalg.norm(
+            np.asarray(b64))
+    assert true_rel < 1e-9, true_rel
+
+
+def test_plain_bf16_cg_stalls_where_refinement_does_not():
+    """The threat the ladder answers: plain CG on the bf16 operator
+    stalls orders of magnitude short of 1e-10 — refinement is what
+    buys the accuracy back, not iteration count."""
+    op32, b = _problem()
+    lo = to_bf16(op32)
+    x = cg_solve(lo.apply, b, jnp.zeros_like(b), 200)
+    r = np.asarray(b) - np.asarray(op32.apply(x))
+    rel = np.linalg.norm(np.where(np.asarray(b) == 0, 0.0, r)) \
+        / np.linalg.norm(np.asarray(b))
+    assert rel > 1e-6, rel  # bf16-class, nowhere near 1e-10
+
+
+# ---------------------------------------------------------------------------
+# the calibrated bf16 SDC envelope tier + the threat test (satellite 1).
+# ---------------------------------------------------------------------------
+
+
+def test_envelope_tier_selection_by_dtype():
+    assert residual_envelope(jnp.bfloat16) == RESIDUAL_ENVELOPE["bf16"]
+    assert abft_envelope(jnp.bfloat16) == ABFT_ENVELOPE["bf16"]
+    assert default_flip_bit(jnp.bfloat16) == 10
+    # the tier ordering that makes the threat real: bf16 clean drift
+    # sits far above the f32 envelope
+    assert RESIDUAL_ENVELOPE["bf16"] > 1e3 * RESIDUAL_ENVELOPE["f32"]
+    assert ABFT_ENVELOPE["bf16"] > ABFT_ENVELOPE["f32"]
+
+
+def test_threat_f32_tier_false_positives_on_clean_bf16_solve():
+    """THE threat test (ISSUE 17 satellite): a CLEAN bf16 solve audited
+    against the f32 envelope tier FALSE-POSITIVES — the stalled bf16
+    recurrence's carried-vs-true drift (measured 2.7e-2 at this 13^3
+    calibration size) dwarfs the f32 tier (1e-3). The calibrated bf16
+    tier passes the same clean solve with headroom, and a real injected
+    flip is still DETECTED under the bf16 tier — the tier loosens to
+    the bf16 floor without opening a hole."""
+    op32, b = _problem()
+    lo = to_bf16(op32)
+    x0 = jnp.zeros_like(b)
+    w, aw = checksum_vectors(lo.apply, b)
+
+    def run(audit):
+        return jax.jit(lambda b, x0: cg_solve(
+            lo.apply, b, x0, 60, audit=audit))(b, x0)
+
+    # (a) clean solve, f32 tiers: the residual audit trips on drift
+    _, info_f32 = run(CGAudit(every=5, w=w, aw=aw,
+                              envelope=RESIDUAL_ENVELOPE["f32"],
+                              abft_envelope=ABFT_ENVELOPE["f32"]))
+    assert bool(info_f32["sdc_detected"])  # the false positive
+    assert float(info_f32["sdc_drift_max"]) > RESIDUAL_ENVELOPE["f32"]
+
+    # (b) same clean solve, calibrated bf16 tiers: no detection, and
+    # the measured drift sits under the envelopes with headroom
+    _, info = run(CGAudit(every=5, w=w, aw=aw,
+                          envelope=RESIDUAL_ENVELOPE["bf16"],
+                          abft_envelope=ABFT_ENVELOPE["bf16"]))
+    assert not bool(info["sdc_detected"])
+    assert float(info["sdc_drift_max"]) < RESIDUAL_ENVELOPE["bf16"] / 10
+    assert float(info["sdc_abft_max"]) < ABFT_ENVELOPE["bf16"] / 10
+
+    # (c) injected exponent-bit flip on the FIRST apply, bf16 tiers:
+    # the mid-exponent flip lands in the grow direction (2^+8 on the
+    # largest output element — signal 2.1e-2 here, the calibration
+    # comment's flip class) and the per-apply ABFT check catches it at
+    # its own iteration. Late-iteration SHRINK flips of one element
+    # dilute to ~|y_i|/(sqrt(n)·||y||) — the documented discrimination
+    # limit of the ones-checksum; gross carried-state corruption is the
+    # residual audit's job.
+    _, info_flip = run(CGAudit(every=5, w=w, aw=aw,
+                               envelope=RESIDUAL_ENVELOPE["bf16"],
+                               abft_envelope=ABFT_ENVELOPE["bf16"],
+                               inject=SdcInject(iteration=0)))
+    assert bool(info_flip["sdc_detected"])
+    assert int(info_flip["sdc_iter"]) == 0
+    assert float(info_flip["sdc_abft_max"]) > ABFT_ENVELOPE["bf16"]
+
+
+# ---------------------------------------------------------------------------
+# roofline byte model (satellite 2): bf16 kron streams EXACTLY half.
+# ---------------------------------------------------------------------------
+
+
+def test_cost_model_bf16_half_bytes():
+    from bench_tpu_fem.obs.roofline import cost_model
+
+    for degree in (1, 3, 6):
+        f32 = cost_model(family="kron", degree=degree, precision="f32")
+        bf = cost_model(family="kron", degree=degree, precision="bf16")
+        # identical stream structure at half itemsize: EXACTLY half
+        assert bf["hbm_bytes_per_dof"] * 2 == f32["hbm_bytes_per_dof"]
+        # flops are f32-accumulate: unchanged
+        assert bf["flops_per_dof"] == f32["flops_per_dof"]
+        assert "bf16" in bf["model"]
+    # xla/perturbed: data+geometry halve but the int32 gather traffic
+    # stays 4-byte, so bf16 lands strictly between half and full
+    fx = cost_model(family="xla", degree=3, geom="perturbed",
+                    precision="f32")
+    bx = cost_model(family="xla", degree=3, geom="perturbed",
+                    precision="bf16")
+    assert fx["hbm_bytes_per_dof"] / 2 < bx["hbm_bytes_per_dof"] \
+        < fx["hbm_bytes_per_dof"]
+
+
+def test_refine_byte_model_split():
+    from bench_tpu_fem.obs.roofline import cost_model, refine_byte_model
+
+    m = refine_byte_model(family="kron", degree=3, inner_iters_total=176,
+                          outer_iters=12)
+    inner = cost_model(family="kron", degree=3, precision="bf16")
+    outer = cost_model(family="kron", degree=3, precision="f64",
+                       use_cg=False)
+    assert m["inner_hbm_bytes_per_dof"] == \
+        inner["hbm_bytes_per_dof"] * 176
+    assert m["outer_hbm_bytes_per_dof"] == \
+        outer["hbm_bytes_per_dof"] * 12
+    assert m["total_hbm_bytes_per_dof"] == \
+        m["inner_hbm_bytes_per_dof"] + m["outer_hbm_bytes_per_dof"]
+    assert 0.9 < m["bf16_byte_fraction"] < 1.0
+    assert "design-estimate" in m["model"]
+
+
+def test_bf16_vmem_plan_tile_quantised():
+    """bf16 VMEM plans quantise to the (16, 128) 4 KiB tile quantum —
+    the packing the autotune ladder and the hardware stage agree on."""
+    assert quantize_to_bf16_tile(1) == BF16_TILE_BYTES
+    assert quantize_to_bf16_tile(BF16_TILE_BYTES) == BF16_TILE_BYTES
+    assert quantize_to_bf16_tile(BF16_TILE_BYTES + 1) == \
+        2 * BF16_TILE_BYTES
+    grid = (13, 13, 13)
+    assert engine_plan_bf16(grid, 3) == ("unfused", None)
+    assert engine_vmem_bytes_bf16(grid, 3) % BF16_TILE_BYTES == 0
+
+
+# ---------------------------------------------------------------------------
+# driver routing (tentpole): registry-resolved, gates registered,
+# evidence stamped; the f32/auto path never enters bf16 code.
+# ---------------------------------------------------------------------------
+
+
+def _bench(ndofs=2000, use_cg=True, **kw):
+    from bench_tpu_fem.bench.driver import BenchConfig, run_benchmark
+
+    cfg = BenchConfig(ndofs_global=ndofs, degree=3, qmode=1,
+                      float_bits=32, nreps=3, use_cg=use_cg, **kw)
+    return run_benchmark(cfg)
+
+
+def test_driver_bf16_plain_routing_and_stamps():
+    from bench_tpu_fem.engines.registry import is_registered_reason
+
+    res = _bench(precision="bf16")
+    ex = res.extra
+    assert ex["precision"] == "bf16" and ex["backend"] == "kron"
+    # no fused bf16 ring: the registered reason rides the engine stamp
+    assert is_registered_reason(ex["cg_engine_error"]) == "bf16-fused"
+    assert ex["roofline"]["hbm_bytes_per_dof"] == 30
+    assert np.isfinite(res.gdof_per_second) and res.gdof_per_second > 0
+
+
+def test_driver_auto_path_untouched_by_bf16():
+    """Frozen pin: precision='auto' stamps NOTHING from the bf16 axis
+    and keeps the f32 byte model — the pre-PR path byte-for-byte."""
+    res = _bench()  # precision defaults to auto
+    ex = res.extra
+    assert ex.get("precision") in (None, "auto")
+    assert "refine" not in ex and "bf16_gate_reason" not in ex
+    assert ex["roofline"]["hbm_bytes_per_dof"] == 60
+
+
+def test_driver_bf16_refine_stamps_evidence():
+    from bench_tpu_fem.engines.registry import is_registered_reason
+
+    res = _bench(precision="bf16-refine", precond="jacobi",
+                 convergence=True)
+    ex = res.extra
+    st = ex["refine"]
+    assert st["converged"] and st["achieved_rel"] <= 1e-10
+    assert ex["time_to_rtol_s"] == st["time_to_rtol_s"] > 0
+    assert st["byte_model"]["bf16_byte_fraction"] > 0.9
+    # convergence capture defers to the refinement rel history
+    assert is_registered_reason(ex["convergence_gate_reason"]) == \
+        "convergence-refine"
+    assert ex["tuning"]["source"] == "default"
+
+
+def test_results_json_carries_refine_stamp():
+    """The CLI's one-line JSON record whitelists the ISSUE 17 stamps:
+    precision, the refine evidence block and the gate reasons — the
+    verify drive's contract."""
+    import json
+
+    from bench_tpu_fem.bench.driver import BenchConfig
+    from bench_tpu_fem.bench.reporting import results_json
+
+    cfg = BenchConfig(ndofs_global=2000, degree=3, qmode=1,
+                      float_bits=32, nreps=3, use_cg=True,
+                      precision="bf16-refine", precond="jacobi")
+    from bench_tpu_fem.bench.driver import run_benchmark
+
+    res = run_benchmark(cfg)
+    out = json.loads(results_json(cfg, res))["output"]
+    assert out["precision"] == "bf16-refine"
+    assert out["refine"]["achieved_rel"] <= 1e-10
+    assert out["time_to_rtol_s"] == out["refine"]["time_to_rtol_s"]
+    # gate reasons ride too (demoted refine records why)
+    cfg2 = BenchConfig(ndofs_global=500, degree=2, qmode=1,
+                       float_bits=32, nreps=2, use_cg=False,
+                       precision="bf16-refine")
+    res2 = run_benchmark(cfg2)
+    out2 = json.loads(results_json(cfg2, res2))["output"]
+    assert "refine_gate_reason" in out2 and "refine" not in out2
+
+
+def test_driver_bf16_perturbed_routes_xla():
+    res = _bench(precision="bf16-refine", geom_perturb_fact=0.1,
+                 precond="jacobi")
+    ex = res.extra
+    assert ex["backend"] == "xla"
+    assert ex["refine"]["achieved_rel"] <= 1e-10
+    assert ex["refine"]["byte_model"]["inner_precision"] == "bf16"
+
+
+def test_driver_bf16_gates_are_registered():
+    from bench_tpu_fem.bench.driver import BenchConfig, run_benchmark
+    from bench_tpu_fem.engines.registry import is_registered_reason
+
+    # float-bits conflict: bf16 requires the f32 accumulate path
+    with pytest.raises(ValueError) as ei:
+        run_benchmark(BenchConfig(ndofs_global=500, degree=2, qmode=1,
+                                  float_bits=64, nreps=2, use_cg=True,
+                                  precision="bf16"))
+    assert is_registered_reason(str(ei.value)) == "bf16-float-bits"
+    # pallas backend: no bf16 Mosaic kernels
+    with pytest.raises(ValueError) as ei:
+        run_benchmark(BenchConfig(ndofs_global=500, degree=2, qmode=1,
+                                  float_bits=32, nreps=2, use_cg=True,
+                                  precision="bf16", backend="pallas"))
+    assert is_registered_reason(str(ei.value)) == "bf16-backend"
+    # demotion gates stamp (never raise): refine under action/batched,
+    # non-jacobi precond
+    res = _bench(precision="bf16-refine", use_cg=False)
+    assert is_registered_reason(
+        res.extra["refine_gate_reason"]) == "refine-action"
+    assert "refine" not in res.extra
+    res = _bench(precision="bf16-refine", nrhs=2)
+    assert is_registered_reason(
+        res.extra["refine_gate_reason"]) == "refine-batched"
+    res = _bench(precision="bf16", precond="ssor")
+    assert is_registered_reason(
+        res.extra["precond_gate_reason"]) == "precond-bf16"
+
+
+def test_registry_bf16_rows_and_analysis_refs():
+    from bench_tpu_fem.engines.registry import (
+        DEFAULT_REFINE_INNER_ITERS,
+        analysis_plan,
+        specs,
+    )
+
+    rows = {s.name: s for s in specs(precision="bf16")}
+    assert {"kron_bf16", "xla_bf16", "bf16_refine"} <= set(rows)
+    assert rows["kron_bf16"].backend == "kron"
+    assert rows["xla_bf16"].backend == "xla"
+    assert rows["bf16_refine"].defaults["refine_inner_iters"] == \
+        DEFAULT_REFINE_INNER_ITERS == 16
+    names = [r.name for r in analysis_plan()]
+    assert names[-3:] == ["bf16_apply_d3", "bf16_apply_perturbed_d3",
+                          "bf16_refine_d3"]
+
+
+# ---------------------------------------------------------------------------
+# serve: bf16 capability + cache-key slice + retire-time audit tier.
+# ---------------------------------------------------------------------------
+
+
+def test_serve_bf16_solver_and_audit_tier():
+    from bench_tpu_fem.serve.engine import (
+        CompiledSolver,
+        SolveSpec,
+        spec_cache_key,
+    )
+
+    spec = SolveSpec(degree=3, ndofs=500, nreps=10, precision="bf16")
+    key = spec_cache_key(spec, 1)
+    assert key.precision == "bf16"
+    assert key.engine_form == "unfused"  # never the fused batched ring
+    assert key != spec_cache_key(
+        SolveSpec(degree=3, ndofs=500, nreps=10), 1)
+    solver = CompiledSolver(spec, 1)
+    state = solver.cont_init(np.ones(solver.bucket))
+    for _ in range(6):
+        state = solver.cont_step(state)
+    audit = solver.audit_lane(state, 0, 1.0)
+    assert audit["envelope"] == RESIDUAL_ENVELOPE["bf16"]
+    assert audit["ok"] and audit["drift"] < audit["envelope"]
+    # the same clean lane would FALSE-POSITIVE under the f32 tier —
+    # the drift really is bf16-class
+    assert audit["drift"] > RESIDUAL_ENVELOPE["f32"]
+
+
+# ---------------------------------------------------------------------------
+# autotune (satellite 6): the bf16 ladder + TuningDB consumption.
+# ---------------------------------------------------------------------------
+
+
+def test_autotune_bf16_candidates_quantised():
+    from bench_tpu_fem.engines.autotune import (
+        REFINE_INNER_LADDER,
+        generate_candidates,
+    )
+
+    cands = generate_candidates(degree=3, grid_shape=(13, 13, 13),
+                                precision="bf16")
+    assert cands and all(c["plan_form"] == "unfused" for c in cands)
+    for c in cands:
+        # every non-default window rung is a whole number of 4 KiB
+        # bf16 tiles; the 0 rung (default tier) survives as 0
+        assert c["window_kib"] == 0 or \
+            (c["window_kib"] * 1024) % BF16_TILE_BYTES == 0
+        assert "refine_inner_iters" not in c
+    rcands = generate_candidates(degree=3, grid_shape=(13, 13, 13),
+                                 precision="bf16", refine=True)
+    assert len(rcands) == len(cands) * len(REFINE_INNER_LADDER)
+    assert {c["refine_inner_iters"] for c in rcands} == \
+        set(REFINE_INNER_LADDER)
+
+
+def test_autotune_bf16_sweep_and_db_consumption(tmp_path, monkeypatch):
+    """End-to-end consumption: a bf16 refine sweep persists its winner,
+    the DRIVER's bf16-refine run consumes it (tuning source=db, the
+    swept inner-iteration budget in effect), and the SERVE build
+    consumes its own bf16 key."""
+    from bench_tpu_fem.engines import autotune
+    from bench_tpu_fem.engines.autotune import (
+        TuningDB,
+        default_tuning_db,
+        run_sweep,
+    )
+
+    db_path = str(tmp_path / "tuning.db")
+    monkeypatch.setenv(autotune.DB_ENV, db_path)
+    autotune.reset_default_db()
+    try:
+        db = default_tuning_db()
+        assert isinstance(db, TuningDB)
+        sw = run_sweep(db, degree=3, ndofs=2000, precision="bf16",
+                       geom="uniform", nreps=3, round_stamp="t",
+                       refine=True)
+        assert sw["winner"]["refine_inner_iters"] in (8, 16, 24, 32)
+        assert sw["key"]["precision"] == "bf16"
+
+        # driver consumption at the exec key
+        from bench_tpu_fem.bench.driver import (
+            BenchConfig,
+            _exec_cache_key,
+            run_benchmark,
+        )
+        from bench_tpu_fem.mesh.sizing import compute_mesh_size
+
+        cfg = BenchConfig(ndofs_global=2000, degree=3, qmode=1,
+                          float_bits=32, nreps=3, use_cg=True,
+                          precision="bf16-refine", precond="jacobi")
+        key = _exec_cache_key(cfg, compute_mesh_size(2000, 3),
+                              "unfused", "cg+refine")
+        db.put(key, sw["winner"], score=sw["score"], label=sw["label"],
+               round_stamp="t", engine="bf16_refine")
+        res = run_benchmark(cfg)
+        assert res.extra["tuning"]["source"] == "db"
+        assert res.extra["refine"]["inner_iters"] == \
+            sw["winner"]["refine_inner_iters"]
+
+        # serve consumption at the spec key
+        from bench_tpu_fem.serve.engine import (
+            CompiledSolver,
+            SolveSpec,
+            spec_cache_key,
+        )
+
+        spec = SolveSpec(degree=3, ndofs=500, nreps=10,
+                         precision="bf16")
+        skey = spec_cache_key(spec, 1)
+        db.put(skey, {"plan_form": "unfused", "window_kib": 4,
+                      "iter_chunk": 2, "nreps": 10},
+               score=1.0, label=sw["label"], round_stamp="t",
+               engine="kron_bf16")
+        solver = CompiledSolver(spec, 1)
+        assert solver.tuning["source"] == "db"
+    finally:
+        monkeypatch.delenv(autotune.DB_ENV, raising=False)
+        autotune.reset_default_db()
+
+
+# ---------------------------------------------------------------------------
+# CLI surface (satellite 5): the flag exists, validates, and the
+# engines listing renders the bf16 rows.
+# ---------------------------------------------------------------------------
+
+
+def test_cli_precision_flag_validation():
+    from bench_tpu_fem.cli import build_parser, main
+
+    args = build_parser().parse_args(
+        ["--ndofs", "2000", "--precision", "bf16-refine"])
+    assert args.precision == "bf16-refine"
+    # parse-time surfacing of the bf16-float-bits gate: main() refuses
+    # before any benchmark work starts
+    with pytest.raises(SystemExit, match="float 32"):
+        main(["--ndofs", "2000", "--precision", "bf16", "--float", "64"])
+
+
+def test_engines_listing_includes_bf16_rows(capsys):
+    from bench_tpu_fem.bench.__main__ import main as bench_main
+
+    assert bench_main(["engines"]) == 0
+    out = capsys.readouterr().out
+    for name in ("kron_bf16", "xla_bf16", "bf16_refine"):
+        assert f"[{name}]" in out

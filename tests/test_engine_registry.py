@@ -299,6 +299,35 @@ def test_bench_and_serve_keys_never_collide():
     assert len(set(vh)) == len(vh)
 
 
+def test_bf16_keys_never_collide(ndofs=2000):
+    """ISSUE 17: the bf16 precision axis and the refine solve kind are
+    their own key slices — no bf16 exec/serve key may hash-collide with
+    the f32/df32 key for the same logical problem, and the refine kind
+    stays apart from the plain-cg kind at every precision."""
+    from bench_tpu_fem.serve.artifacts import key_hash
+
+    degree, cells, nreps = 3, (8, 8, 8), 30
+    keys = []
+    for precision in ("f32", "df32", "bf16"):
+        for backend in ("kron", "xla"):
+            for kind in ("cg", "cg+conv", "action", "cg+jacobi",
+                         "cg+refine", "action+jacobi"):
+                keys.append(make_cache_key(
+                    degree=degree, cell_shape=cells, precision=precision,
+                    geom="uniform",
+                    engine_form=bench_engine_form(
+                        backend, "unfused", kind, 1, False),
+                    nrhs_bucket=1, device_mesh=(1,), nreps=nreps))
+        # serve-side slices for the same precision
+        keys.append(make_cache_key(
+            degree=degree, cell_shape=cells, precision=precision,
+            geom="uniform", engine_form="unfused", nrhs_bucket=1,
+            device_mesh=(1, 1, 1), nreps=nreps))
+    hashes = [key_hash(k) for k in keys]
+    assert len(set(hashes)) == len(hashes)
+    assert len(set(keys)) == len(keys)
+
+
 def test_driver_exec_cache_key_goes_through_registry_helper():
     from bench_tpu_fem.bench.driver import BenchConfig, _exec_cache_key
     from bench_tpu_fem.serve.cache import ExecutableKey
@@ -343,6 +372,9 @@ FROZEN_ANALYSIS_NAMES = [
     "dist_kron_df_ext2d", "dist_folded_engine", "dist_kron_overlap_d3",
     "dist_kron_overlap_ext2d", "dist_kron_df_overlap_halo",
     "dist_kron_df_overlap_ext2d", "dist_folded_overlap",
+    # ISSUE 17 (bf16 ladder): the only additions since the freeze —
+    # appended, never interleaved, so pre-existing journal keys hold.
+    "bf16_apply_d3", "bf16_apply_perturbed_d3", "bf16_refine_d3",
 ]
 
 
