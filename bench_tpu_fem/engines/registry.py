@@ -273,6 +273,11 @@ GATE_REASONS: dict[str, str] = {
     "tuning-db-invalid": (
         "tuning database failed validation (magic/CRC/version/key "
         "equality); counted fallback, registry defaults in effect"),
+    # -- overload brownout (ISSUE 18) ---------------------------------------
+    "brownout-precision": (
+        "brownout level {level}: sustained SLO burn stepped this request "
+        "down the registry precision ladder ({from_p} -> {to_p}); the "
+        "response carries degraded provenance until hysteresis clears"),
 }
 
 # Template slugs contain {field} placeholders; everything else is a
@@ -740,6 +745,20 @@ def specs(**filters) -> list[EngineSpec]:
 
 def spec(name: str) -> EngineSpec:
     return _BY_NAME[name]
+
+
+def degradation_ladder(start: str = "f32") -> tuple:
+    """The brownout precision ladder (ISSUE 18): rung 0 is the fleet's
+    normal serving precision, each further rung a cheaper precision the
+    fleet may step down to under sustained SLO burn. A rung exists ONLY
+    because a registry row explicitly serves that precision — the fleet
+    carries zero hand-wired capability branches; deregistering the bf16
+    row removes the rung with no fleet change. Today: f32 -> bf16 (the
+    bf16_refine row — half-bandwidth applies, refined answers)."""
+    ladder = [start]
+    if any(s.precision == "bf16" for s in ENGINE_SPECS):
+        ladder.append("bf16")
+    return tuple(ladder)
 
 
 # ---------------------------------------------------------------------------

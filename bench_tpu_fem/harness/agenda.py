@@ -523,6 +523,15 @@ def make_stages(round_tag: str = DEFAULT_ROUND) -> dict[str, Stage]:
         # hang on a wedged tunnel).
         _script("chaos", ["scripts/chaos_soak.py", "--quick"], 600,
                 env={"JAX_PLATFORMS": "cpu"}),
+        # Overload resilience (ISSUE 18): the chaos script's overload
+        # leg alone — deadline refusals with retry hints, the held-
+        # straggler hedge rescue under the exactly-once ledger, and the
+        # brownout ladder step/recover cycle. CPU-pinned like the chaos
+        # stage (a control-plane proof; thresholds re-arm on hardware
+        # through the same journal evidence labels).
+        _script("overload", ["scripts/chaos_soak.py", "--quick",
+                             "--legs", "overload"], 600,
+                env={"JAX_PLATFORMS": "cpu"}),
         # On-chip autotune sweep (ISSUE 16): persist hardware-labelled
         # tuning winners per (degree, bucket) slice into the round's
         # tuning DB BEFORE the bench stages run, so their builds consume
@@ -663,7 +672,8 @@ ALIASES = {
 # Round-6 default agenda, ordered by value-per-minute under wedge risk
 # (measure_all's ordering, expanded through ALIASES).
 AGENDAS = {
-    "round6": ["health", "serve", "chaos", "autotune", "fusedbatch", "bf16",
+    "round6": ["health", "serve", "chaos", "overload", "autotune",
+               "fusedbatch", "bf16",
                "dfacc",
                "pertdf", "foldeng", "dfext2d", "scale", "dfeng", "bench",
                "conv", "precond", "dflarge", "pert100", "deg7probe",

@@ -121,6 +121,20 @@ def install_boundary_hook(hook):
     return prev
 
 
+def install_fault_hook(hook):
+    """Install/uninstall helper for the scripted-solve-fault seam
+    (``serve.engine.FAULT_HOOK`` — FaultySolveHook, HeldSolveHook) —
+    same try/finally pairing as `install_boundary_hook`. The hook runs
+    at the top of every compiled-solver execution and may raise a
+    classified fault, sleep past a deadline, or block until released
+    (the ISSUE 18 deterministic straggler)."""
+    from ..serve import engine as _engine
+
+    prev = _engine.FAULT_HOOK
+    _engine.FAULT_HOOK = hook
+    return prev
+
+
 def install_sdc_hook(hook):
     """Install/uninstall helper for the silent-corruption seam
     (``serve.engine.SDC_HOOK``, ISSUE 14) — same try/finally pairing as

@@ -20,6 +20,19 @@ anything else):
                  dependent stages stay gated across resumes.
   timeout        the stage overran its budget with no wedge signature —
                  re-probe decides whether it was really a wedge.
+  deadline_exceeded
+                 the CLIENT's latency budget ran out before the serve
+                 stack burned (or finished) a solve (ISSUE 18): the
+                 broker refuses at admission / batch formation when the
+                 remaining ``SolveSpec.deadline_s`` budget is gone or
+                 the predicted completion time exceeds it. Disjoint from
+                 ``timeout`` (the HARNESS killed an overrunning child)
+                 and from ``tunnel_wedge``'s uppercase gRPC
+                 DEADLINE_EXCEEDED transport artifact — this class is
+                 the serve layer's own lowercase refusal text. Policy:
+                 retriable with backoff (the work was never attempted;
+                 resubmitting with a fresh budget — ideally after the
+                 shed's ``retry_after_s`` hint — is always safe).
   preempted      the TPU worker/VM was preempted out from under the run
                  (the maintenance/eviction notices preemptible fleets
                  emit). Retriable by definition — the work was fine, the
@@ -70,6 +83,7 @@ TAXONOMY = (
     "mosaic_reject",
     "accuracy_fail",
     "timeout",
+    "deadline_exceeded",
     "preempted",
     "breakdown",
     "sdc",
@@ -90,7 +104,8 @@ TAXONOMY = (
 # (harness.policy's explicit sdc branch; the serve broker's internal
 # retry special-cases it the same way).
 RETRIABLE_CLASSES = frozenset(
-    {"transient", "timeout", "oom", "tunnel_wedge", "preempted"})
+    {"transient", "timeout", "oom", "tunnel_wedge", "preempted",
+     "deadline_exceeded"})
 
 # Pattern tables, first hit wins within a class. All matched case-
 # sensitively except where the compiled regex says otherwise: the strings
@@ -131,6 +146,14 @@ _PREEMPT_PAT = re.compile(
     r"|instance was (?:preempted|terminated)"
     r"|[Ee]victed pod|TerminationByKubernetes"
 )
+# Serve-layer deadline refusals (ISSUE 18): the broker's own lowercase
+# phrasing. Deliberately DISJOINT from the wedge table's uppercase gRPC
+# DEADLINE_EXCEEDED transport code (case-sensitive on both sides) and
+# from every breakdown/timeout signature — a test pins the disjointness.
+_DEADLINE_PAT = re.compile(
+    r"deadline_exceeded|deadline budget"
+    r"|past its deadline|exceeds .{0,40}remaining deadline"
+)
 _WEDGE_PAT = re.compile(
     r"tunnel (?:unavailable|wedged|down)|TPU tunnel|DEADLINE_EXCEEDED"
     r"|UNAVAILABLE|device init/probe exceeded|[Ww]edged"
@@ -161,6 +184,8 @@ def classify_text(text: str, timed_out: bool = False) -> str:
         return "oom"
     if _MOSAIC_PAT.search(text):
         return "mosaic_reject"
+    if _DEADLINE_PAT.search(text):
+        return "deadline_exceeded"
     if _PREEMPT_PAT.search(text):
         return "preempted"
     if _WEDGE_PAT.search(text):

@@ -192,6 +192,40 @@ class FaultySolveHook:
         raise RuntimeError(f"Traceback: injected {outcome} fault")
 
 
+class HeldSolveHook:
+    """Deterministic straggler (ISSUE 18 hedged dispatch): install as
+    ``serve.engine.FAULT_HOOK`` and the first ``hold`` solver executions
+    BLOCK on an Event until the test calls ``release()`` — a lane that
+    is alive but arbitrarily slow, which is exactly the tail hedging
+    rescues. Unlike FaultySolveHook's "hang" (a fixed sleep), the
+    straggler's duration is under TEST control: hedge the queued victim,
+    assert the hedge wins on the healthy lane, THEN release the
+    straggler and assert its late retire loses the claim CAS cleanly.
+    Executions past the hold count pass through untouched. ``waited``
+    records each held call's (spec degree, lane count) for assertions;
+    ``timeout_s`` bounds the block so a test bug cannot wedge the
+    suite."""
+
+    def __init__(self, hold: int = 1, timeout_s: float = 60.0):
+        import threading as _threading
+
+        self.hold = int(hold)
+        self.timeout_s = timeout_s
+        self.release_evt = _threading.Event()
+        self.held = 0
+        self.waited: list[tuple[int, int]] = []
+
+    def release(self) -> None:
+        self.release_evt.set()
+
+    def __call__(self, spec, scales) -> None:
+        if self.held >= self.hold:
+            return
+        self.held += 1
+        self.waited.append((getattr(spec, "degree", -1), len(scales)))
+        self.release_evt.wait(self.timeout_s)
+
+
 # ---------------------------------------------------------------------------
 # Silent-data-corruption injection (ISSUE 14): the CHAOS_SDC seam.
 #

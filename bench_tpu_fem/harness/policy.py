@@ -86,7 +86,12 @@ class StagePolicy:
     # are. `preempted` retries by definition — the work was fine, the
     # machine went away; with durable CG checkpoints the retry resumes
     # from the last snapshot instead of iteration 0.
-    retry_on: tuple[str, ...] = ("transient", "timeout", "preempted")
+    # `deadline_exceeded` (ISSUE 18) retries WITH BACKOFF: the serve
+    # layer refused before burning a solve, so resubmitting is always
+    # safe — and the backoff is the point, since the refusal means the
+    # fleet was overloaded right now.
+    retry_on: tuple[str, ...] = ("transient", "timeout", "preempted",
+                                 "deadline_exceeded")
     # Bounded wedge recovery: how many probe×backoff rounds one stage may
     # spend waiting for the tunnel before the agenda aborts (wedges last
     # hours; the watch daemon re-arms at that horizon instead).

@@ -370,6 +370,11 @@ LOWER_IS_BETTER_COUNTERS = (
     # solve got weaker or the outer correction regressed (the exact
     # failure the CI refinement-regression probe injects)
     "refine_outer_iters", "refine_inner_iters_total",
+    # ISSUE 18 overload counters on the pinned perfgate schedule: a
+    # LATE deadline response (the early-refusal machinery failed) or a
+    # duplicate response across a hedge pair (the claim CAS failed) is
+    # the overload subsystem's worst regression — both pin at 0
+    "deadline_exceeded_late", "hedge_duplicates",
 )
 #: snapshot keys where a DECREASE below baseline is a regression
 HIGHER_IS_BETTER_COUNTERS = (
@@ -398,6 +403,13 @@ HIGHER_IS_BETTER_COUNTERS = (
     # their measured headroom multiple over the clean-solve floor — a
     # drop means the envelope drifted toward false positives
     "bf16_parity_ok", "bf16_envelope_headroom",
+    # ISSUE 18: the pinned overload schedule must keep shedding EARLY
+    # (before burning a solve), the forced straggler must keep being
+    # rescued by its hedge, and the forced burn must keep engaging the
+    # brownout ladder — a drop on any of these is a silently disarmed
+    # overload controller (the suppressed-brownout CI probe injects
+    # exactly that)
+    "deadline_exceeded_early", "hedge_wins", "brownout_steps",
 )
 #: contract booleans: baseline True -> current must stay True
 CONTRACT_FLAGS = ("record_contract_ok", "trace_valid",

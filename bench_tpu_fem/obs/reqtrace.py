@@ -389,6 +389,13 @@ _INSTANT_EVENTS = {
     "fleet_selftest": "selftest",
     "serve_sdc": "sdc",
     "serve_retry": "retry",
+    # overload resilience (ISSUE 18): hedge-pair lifecycle + brownout
+    # ladder transitions — journaled only when the controllers are
+    # armed, rendered as control-plane instants like the rest
+    "serve_hedge_fired": "hedge_fired",
+    "serve_hedge_won": "hedge_won",
+    "serve_hedge_cancelled": "hedge_cancelled",
+    "fleet_brownout": "brownout_step",
 }
 
 
@@ -435,6 +442,10 @@ def journal_to_chrome(records) -> dict:
             args["failure_class"] = r["failure_class"]
         if r.get("anomalies"):
             args["anomalies"] = r["anomalies"]
+        if r.get("degraded"):
+            # brownout provenance (ISSUE 18): the slice says which
+            # precision rung actually computed the answer
+            args["degraded"] = r["degraded"]
         events.append({"name": f"req {rid}", "cat": "reqtrace",
                        "ph": "X", "ts": round(max(t0, 0.0) * 1e6, 3),
                        "dur": round(lat * 1e6, 3), "pid": pid,
@@ -458,7 +469,8 @@ def journal_to_chrome(records) -> dict:
         args = {k: v for k, v in r.items()
                 if k in ("id", "ids", "src", "dst", "count", "action",
                          "failure_class", "drained", "fast_burn",
-                         "attempt", "resumed")}
+                         "attempt", "resumed", "wait_s", "level",
+                         "from", "to")}
         events.append({"name": name, "cat": "reqtrace.event", "ph": "i",
                        "ts": round(max(float(r["ts"]) - epoch, 0.0) * 1e6,
                                    3),

@@ -89,6 +89,35 @@ def main(argv=None) -> int:
                         "(0 = never; requires --audit and --fleet)")
     p.add_argument("--quarantine-window", type=float, default=60.0,
                    help="quarantine trip window, seconds")
+    p.add_argument("--hedge", action="store_true",
+                   help="hedged dispatch (ISSUE 18, fleet only): a "
+                        "request queued past its per-spec hedge delay "
+                        "(live p95, or --hedge-delay-ms) is "
+                        "speculatively re-enqueued on a second healthy "
+                        "lane; first retire wins, the loser cancels at "
+                        "its next boundary, the exactly-once ledger "
+                        "never sees duplicates")
+    p.add_argument("--hedge-budget", type=float, default=0.05,
+                   help="hedged-dispatch budget: cap hedges at this "
+                        "fraction of routed requests (load-shifted "
+                        "duplicates stay bounded)")
+    p.add_argument("--hedge-delay-ms", type=float, default=0.0,
+                   help="fixed hedge delay override in ms; 0 (default) "
+                        "= per-spec live p95 from the latency windows")
+    p.add_argument("--brownout", action="store_true",
+                   help="brownout degradation ladder (ISSUE 18, fleet "
+                        "only): sustained fast+slow SLO burn steps "
+                        "arrivals down the registry precision ladder "
+                        "(f32 -> bf16); responses carry `degraded` "
+                        "provenance, hysteresis steps back up when the "
+                        "burn clears")
+    p.add_argument("--brownout-burn", type=float, default=1.0,
+                   help="brownout engage threshold: step down when BOTH "
+                        "fast and slow burn rates exceed this")
+    p.add_argument("--brownout-clear", type=float, default=0.5,
+                   help="brownout hysteresis: step back up only when "
+                        "both burn rates fall below this (must be < "
+                        "--brownout-burn)")
     p.add_argument("--reqtrace", action="store_true",
                    help="request-scoped tracing (ISSUE 15): every "
                         "response carries a phase decomposition "
@@ -161,6 +190,13 @@ def main(argv=None) -> int:
             quarantine_threshold=args.quarantine_threshold,
             quarantine_window_s=args.quarantine_window,
             reqtrace=args.reqtrace,
+            hedge=args.hedge,
+            hedge_budget=args.hedge_budget,
+            hedge_delay_s=(args.hedge_delay_ms / 1000.0
+                           if args.hedge_delay_ms else None),
+            brownout=args.brownout,
+            brownout_burn=args.brownout_burn,
+            brownout_clear_burn=args.brownout_clear,
         )
     else:
         metrics = Metrics(

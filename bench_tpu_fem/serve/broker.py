@@ -60,8 +60,19 @@ from .metrics import Metrics, spec_latency_key
 
 
 class QueueFull(Exception):
-    """Admission control shed the request (bounded queue at capacity).
-    Retriable by contract: the server maps it to 503 + Retry-After."""
+    """Admission control shed the request (bounded queue at capacity,
+    or — ISSUE 18 — a predictive deadline refusal). Retriable by
+    contract: the server maps it to 503 + Retry-After.
+
+    ``failure_class`` distinguishes the capacity shed ("transient")
+    from the deadline refusal ("deadline_exceeded"); ``retry_after_s``
+    is the predicted-queue-time hint when the predictor had one."""
+
+    def __init__(self, msg: str, *, failure_class: str = "transient",
+                 retry_after_s: float | None = None):
+        super().__init__(msg)
+        self.failure_class = failure_class
+        self.retry_after_s = retry_after_s
 
 
 @dataclass
@@ -91,6 +102,16 @@ class PendingRequest:
     # rollback re-runs this request has consumed. One is the budget —
     # a second detection is the deterministic verdict.
     sdc_retries: int = 0
+    # Overload resilience (ISSUE 18): the ABSOLUTE monotonic deadline
+    # (enqueue instant + spec.deadline_s; None = unbounded) every phase
+    # boundary checks against; the hedge-pair state (the SAME object is
+    # enqueued on a second lane — `hedged` marks it, `hedge_dst` the
+    # lane the copy landed on, for win attribution); and the brownout
+    # provenance stamp the responding lane merges into the result.
+    deadline: float | None = None
+    hedged: bool = False
+    hedge_dst: str | None = None
+    degraded: dict | None = None
     lc: Lifecycle = field(default_factory=Lifecycle)
     # request-scoped phase trace (ISSUE 15): populated ONLY when the
     # broker was built with reqtrace=True — None is the pre-PR path
@@ -169,9 +190,13 @@ class Broker:
     # -- client side -------------------------------------------------------
 
     def submit(self, spec: SolveSpec, scale: float = 1.0,
-               req_id: str | None = None) -> PendingRequest:
+               req_id: str | None = None,
+               degraded: dict | None = None) -> PendingRequest:
         """Admit one request or shed it (QueueFull). Never blocks on the
-        solve — the caller waits on the returned PendingRequest."""
+        solve — the caller waits on the returned PendingRequest.
+        ``degraded`` (ISSUE 18) is the fleet's brownout provenance
+        stamp: attached BEFORE the request is visible to any responder,
+        so every response under brownout carries it race-free."""
         with self._cv:
             if req_id is None:
                 # id minting under the queue lock: recover() bumps the
@@ -189,7 +214,47 @@ class Broker:
                 self.metrics.shed(rid, depth)
                 raise QueueFull(
                     f"queue at capacity ({depth}/{self.queue_max})")
+            if spec.deadline_s is not None:
+                # predictive admission control (ISSUE 18): refuse to
+                # seat a request whose predicted completion (queue wait
+                # + p95 solve, folded from the live per-spec latency
+                # windows) exceeds its whole budget — shed NOW, before
+                # the WAL record, before any work, with the prediction
+                # inputs journaled so the decision replays from the
+                # serve_shed line alone. No prediction (cold windows) =
+                # no predictive shed: never refuse on thin evidence.
+                pred = self.metrics.predict_completion(_spec_dict(spec))
+                if pred is not None:
+                    queue_wait = (depth / max(self.nrhs_max, 1)) \
+                        * pred["p50_s"]
+                    predicted = queue_wait + pred["p95_s"]
+                    if predicted > spec.deadline_s:
+                        retry_after = round(max(queue_wait,
+                                                pred["p50_s"]), 3)
+                        controller = {
+                            "decision": "predictive_shed",
+                            "deadline_s": spec.deadline_s,
+                            "queue_depth": depth,
+                            "nrhs_max": self.nrhs_max,
+                            "queue_wait_s": round(queue_wait, 6),
+                            "predicted_s": round(predicted, 6),
+                            "prediction": pred}
+                        self.metrics.shed(
+                            rid, depth,
+                            failure_class="deadline_exceeded",
+                            controller=controller,
+                            retry_after_s=retry_after)
+                        raise QueueFull(
+                            f"predicted completion {predicted:.3f}s "
+                            "exceeds the remaining deadline budget "
+                            f"{spec.deadline_s:.3f}s",
+                            failure_class="deadline_exceeded",
+                            retry_after_s=retry_after)
             pending = PendingRequest(rid, spec, float(scale), time.monotonic())
+            if spec.deadline_s is not None:
+                pending.deadline = pending.enqueued + spec.deadline_s
+            if degraded is not None:
+                pending.degraded = degraded
             if self.reqtrace:
                 # the trace origin IS the enqueue instant, so the phase
                 # sum and the journaled latency_s share one origin
@@ -252,6 +317,13 @@ class Broker:
         """Current queue depth (the fleet balancer's imbalance input)."""
         with self._cv:
             return len(self._queue)
+
+    def peek_queued(self) -> list:
+        """Snapshot of the queued requests, arrival order (the fleet's
+        hedge scan reads wait times off it; the requests stay queued —
+        a hedge is an ADDITIONAL enqueue elsewhere, never a move)."""
+        with self._cv:
+            return list(self._queue)
 
     def steal_requests(self, k: int) -> list:
         """Pop up to k requests off the queue TAIL, returned in ARRIVAL
@@ -391,7 +463,59 @@ class Broker:
             self.metrics.set_queue_depth(len(self._queue))
             self._cv.notify_all()
 
+    def _screen_batch(self, batch: list, boundary: int = 0) -> list:
+        """Deadline/hedge screening at a phase boundary (ISSUE 18):
+        batch formation and every mid-solve admission poll. Hedge-pair
+        losers (the other lane already won the claim CAS) are dropped
+        with a serve_hedge_cancelled record; members whose budget is
+        already gone — or whose predicted solve time exceeds what
+        remains — are answered ``deadline_exceeded`` WITHOUT burning a
+        solve lane, controller inputs journaled. Requests with no
+        deadline and no hedge pass through untouched: the unarmed path
+        is bitwise pre-PR."""
+        kept = []
+        now = time.monotonic()
+        for p in batch:
+            if p.hedged and p.answered:
+                self.metrics.hedge_cancel(p.id, -1, boundary)
+                continue
+            if p.deadline is not None and not p.answered:
+                remaining = p.deadline - now
+                if remaining <= 0:
+                    self._respond(p, {
+                        "ok": False, "id": p.id,
+                        "error": (f"request {p.id} is past its deadline "
+                                  f"({-remaining:.3f}s over) at batch "
+                                  "formation; answered without a solve"),
+                        "failure_class": "deadline_exceeded",
+                        "retriable": True,
+                        "controller": {"decision": "expired_in_queue",
+                                       "boundary": boundary,
+                                       "over_s": round(-remaining, 6)}})
+                    continue
+                pred = self.metrics.predict_completion(
+                    _spec_dict(p.spec))
+                if pred is not None and pred["p95_s"] > remaining:
+                    self._respond(p, {
+                        "ok": False, "id": p.id,
+                        "error": (f"predicted solve p95 "
+                                  f"{pred['p95_s']:.3f}s exceeds the "
+                                  f"remaining deadline budget "
+                                  f"{remaining:.3f}s"),
+                        "failure_class": "deadline_exceeded",
+                        "retriable": True,
+                        "controller": {"decision": "predicted_over_budget",
+                                       "boundary": boundary,
+                                       "remaining_s": round(remaining, 6),
+                                       "prediction": pred}})
+                    continue
+            kept.append(p)
+        return kept
+
     def _execute(self, batch: list) -> None:
+        batch = self._screen_batch(batch)
+        if not batch:
+            return
         spec = batch[0].spec
         live = len(batch)
         bucket = self._pick_bucket(spec, live)
@@ -683,7 +807,20 @@ class Broker:
             dead_lane_boundaries += bucket - live
             now = time.monotonic()
             for lane, p in enumerate(lanes):
-                if p is None or not bool(done[lane]):
+                if p is None:
+                    continue
+                if p.hedged and p.answered:
+                    # hedge-pair loser (ISSUE 18): the copy on the
+                    # other lane won the claim CAS — cancelled at THIS
+                    # boundary (the next one after the win), lane
+                    # freed, no second response ever journaled
+                    state, _ = solver.cont_retire(state, lane)
+                    lanes[lane] = None
+                    live -= 1
+                    self.metrics.hedge_cancel(p.id, lane, boundary_iter)
+                    park()
+                    continue
+                if not bool(done[lane]):
                     continue
                 if p.rt is not None:
                     # the lane's solve occupancy ends at THIS boundary;
@@ -794,7 +931,9 @@ class Broker:
             park()
             free = [i for i, p in enumerate(lanes) if p is None]
             if free and now < admit_deadline:
-                polled = self._poll_compatible(spec, len(free))
+                polled = self._screen_batch(
+                    self._poll_compatible(spec, len(free)),
+                    boundary=boundary_iter)
                 for j, p in enumerate(polled):
                     lane = free.pop(0)
                     p.lc.mark("admit")
@@ -883,11 +1022,29 @@ class Broker:
             lifecycle = pending.lc.breakdown()
             result["latency_s"] = latency = lifecycle.get("total_s", 0.0)
             result["lifecycle_s"] = lifecycle
+            # late-deadline detection (ISSUE 18): a REAL response going
+            # out past the request's declared deadline — the counter
+            # the whole overload subsystem exists to pin at zero. The
+            # broker's own early refusals are deadline-classed and
+            # deliberately excluded (they are the subsystem working).
+            deadline_late = (
+                pending.deadline is not None
+                and t_resp > pending.deadline
+                and result.get("failure_class") != "deadline_exceeded")
+            if deadline_late:
+                result["deadline_late"] = True
+            if pending.degraded is not None:
+                # brownout provenance (ISSUE 18): the answer was
+                # computed on a stepped-down precision rung — stamped
+                # on the response AND the journal record
+                result["degraded"] = pending.degraded
             phase = exemplar = None
             if pending.rt is not None:
                 # the final cut closes the partition at the SAME instant
                 # the lifecycle stamps respond, so the phase sum and
                 # latency_s share both endpoints (epsilon = rounding)
+                if pending.degraded is not None:
+                    pending.rt.annotate(degraded=pending.degraded)
                 pending.rt.cut("respond", now=t_resp)
                 phase = pending.rt.decomposition()
                 result["phase_s"] = phase
@@ -901,7 +1058,17 @@ class Broker:
                 lifecycle=lifecycle, phase_s=phase, trace=exemplar,
                 spec_key=spec_latency_key(
                     _spec_dict(pending.spec),
-                    result.get("nrhs_bucket", 0)))
+                    result.get("nrhs_bucket", 0)),
+                deadline_late=deadline_late,
+                controller=result.get("controller"),
+                degraded=pending.degraded)
+            if pending.hedged and pending.hedge_dst is not None \
+                    and self.metrics.device == pending.hedge_dst:
+                # the SPECULATIVE copy answered first: the hedge
+                # rescued this request. Attribution journaled AFTER the
+                # response record — the ledger sees exactly one
+                # response; this line is the win accounting.
+                self.metrics.hedge_won(pending.id, pending.hedge_dst)
             pending.done.set()
         return True
 

@@ -128,6 +128,14 @@ class SolveSpec:
     nreps: int = 30
     precision: str = "f32"
     geom_perturb_fact: float = 0.0
+    # Client latency budget in seconds (ISSUE 18), None = unbounded.
+    # compare=False keeps it OUT of batch compatibility (`p.spec ==
+    # spec`), the executable cache key and the frozen-dataclass hash —
+    # a deadline changes when a request is ABANDONED, never what is
+    # computed. It is also excluded from the journaled spec dict
+    # (broker._spec_dict): a crash-replayed request has, by definition,
+    # outlived any budget it carried.
+    deadline_s: float | None = field(default=None, compare=False)
 
     @property
     def geom(self) -> str:
@@ -146,6 +154,8 @@ class SolveSpec:
             raise UnsupportedSpec(GATE_REASONS["serve-df32-perturbed"])
         if self.ndofs <= 0 or self.nreps <= 0:
             raise UnsupportedSpec("ndofs and nreps must be positive")
+        if self.deadline_s is not None and not self.deadline_s > 0:
+            raise UnsupportedSpec("deadline_s must be positive when given")
         if self.ndofs > MAX_NDOFS:
             raise UnsupportedSpec(
                 gate_reason("serve-ndofs-cap", ndofs=self.ndofs,

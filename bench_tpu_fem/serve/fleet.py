@@ -55,7 +55,7 @@ import threading
 import time
 from dataclasses import dataclass
 
-from .broker import Broker, QueueFull
+from .broker import Broker, QueueFull, _spec_dict
 from .cache import NRHS_BUCKETS, ExecutableCache, nrhs_bucket
 from .engine import SolveSpec, build_solver, spec_cache_key
 from .metrics import FleetMetrics, Metrics
@@ -112,7 +112,14 @@ class FleetDispatcher:
                  audit: bool = False,
                  quarantine_threshold: int = 0,
                  quarantine_window_s: float = 60.0,
-                 reqtrace: bool = False):
+                 reqtrace: bool = False,
+                 hedge: bool = False,
+                 hedge_budget: float = 0.05,
+                 hedge_delay_s: float | None = None,
+                 brownout: bool = False,
+                 brownout_burn: float = 1.0,
+                 brownout_clear_burn: float = 0.5,
+                 brownout_windows=None):
         if ndevices < 1:
             raise ValueError("ndevices must be >= 1")
         self.artifacts = artifacts
@@ -135,6 +142,35 @@ class FleetDispatcher:
         self.reqtrace = bool(reqtrace)
         self.quarantine_threshold = int(quarantine_threshold)
         self.quarantine_window_s = float(quarantine_window_s)
+        # Overload resilience (ISSUE 18). Hedged dispatch: the balancer
+        # re-enqueues the SAME PendingRequest of a request queued past
+        # its per-spec hedge delay (live p95 fold, or the override) on
+        # a second healthy lane under a bounded hedge budget — no new
+        # WAL record, so the exactly-once ledger cannot see a duplicate
+        # by construction; first retire wins the per-request claim CAS,
+        # the loser cancels at its next boundary. Brownout: sustained
+        # fast+slow SLO burn steps the fleet down the registry's
+        # precision degradation ladder, with hysteresis
+        # (clear < brownout_clear_burn on BOTH windows) on recovery.
+        # Both default OFF: the unarmed fleet is bitwise pre-PR.
+        self.hedge = bool(hedge)
+        self.hedge_budget = float(hedge_budget)
+        self.hedge_delay_s = hedge_delay_s
+        self.brownout = bool(brownout)
+        self.brownout_burn = float(brownout_burn)
+        self.brownout_clear_burn = float(brownout_clear_burn)
+        # burn-window override (seconds, label) tuples — injectable for
+        # the state-machine tests; None = obs.regress.SLO_WINDOWS
+        self.brownout_windows = brownout_windows
+        self.slo_objective_s = slo_objective_s
+        self.slo_target = float(slo_target)
+        from ..engines.registry import degradation_ladder
+
+        self._ladder = degradation_ladder()
+        self._overload_lock = threading.Lock()
+        self._brownout_level = 0
+        self._brownout_engaged_at: float | None = None
+        self._brownout_residency_s = 0.0
         self.nrhs_max = min(nrhs_max, NRHS_BUCKETS[-1])
         self.queue_max = queue_max
         self.fleet_metrics = FleetMetrics(journal_path)
@@ -229,6 +265,13 @@ class FleetDispatcher:
         Raises QueueFull (fleet-level, journaled) when every lane is at
         capacity. Returns the lane broker's PendingRequest."""
         rid = self._mint_id(req_id)
+        # brownout rewrite (ISSUE 18) BEFORE the affinity probe: under
+        # an engaged brownout level the request runs on the stepped-down
+        # registry rung, so affinity must see the precision it will
+        # actually execute at
+        degraded = None
+        if self.brownout:
+            degraded, spec = self._brownout_spec(spec)
 
         def depth(ln):
             return ln.broker.pending_count()
@@ -239,11 +282,14 @@ class FleetDispatcher:
         # fleet-level shed (retriable — the fleet is degraded, not gone)
         pool = [ln for ln in self.lanes if not ln.quarantined]
         if not pool:
-            self.fleet_metrics.shed(
-                rid, sum(depth(ln) for ln in self.lanes))
+            total = sum(depth(ln) for ln in self.lanes)
+            hint, ctl = self._shed_hint(spec, total)
+            self.fleet_metrics.shed(rid, total, retry_after_s=hint,
+                                    controller=ctl)
             raise QueueFull(
                 f"every lane quarantined ({len(self.lanes)} of "
-                f"{len(self.lanes)}) — self-test readmission pending")
+                f"{len(self.lanes)}) — self-test readmission pending",
+                retry_after_s=hint)
         affine = [ln for ln in pool if self._lane_holds(ln, spec)]
         candidates = affine or pool
         chosen = min(candidates, key=depth)
@@ -266,11 +312,14 @@ class FleetDispatcher:
             others = [ln for ln in pool
                       if depth(ln) < self.queue_max]
             if not others:
-                self.fleet_metrics.shed(
-                    rid, sum(depth(ln) for ln in self.lanes))
+                total = sum(depth(ln) for ln in self.lanes)
+                hint, ctl = self._shed_hint(spec, total)
+                self.fleet_metrics.shed(rid, total, retry_after_s=hint,
+                                        controller=ctl)
                 raise QueueFull(
                     f"fleet at capacity ({len(self.lanes)} lanes x "
-                    f"{self.queue_max})")
+                    f"{self.queue_max})",
+                    retry_after_s=hint)
             chosen = min(others, key=depth)
             spill_from = None  # the burn retarget did not decide this
         spill = spill_from is not None
@@ -281,7 +330,8 @@ class FleetDispatcher:
         affinity = chosen in affine
         cause = ("spill" if spill
                  else "affinity-hit" if affinity else "cold-home")
-        pending = chosen.broker.submit(spec, scale, req_id=rid)
+        pending = chosen.broker.submit(spec, scale, req_id=rid,
+                                       degraded=degraded)
         if pending.rt is not None:
             # annotate() takes the trace lock: the lane worker may
             # already be answering this request on another thread
@@ -325,6 +375,8 @@ class FleetDispatcher:
             try:
                 self.quarantine_scan()
                 self.rebalance_once()
+                self.hedge_scan()
+                self.brownout_scan()
             except Exception:
                 # the balancer must never die mid-incident; a failed
                 # pass retries on the next tick
@@ -358,6 +410,174 @@ class FleetDispatcher:
                                  ids=[p.id for p in stolen]
                                  if self.reqtrace else None)
         return len(stolen)
+
+    # -- overload resilience (ISSUE 18) ------------------------------------
+
+    def _shed_hint(self, spec: SolveSpec, depth: int):
+        """Predicted-queue-time retry hint for a fleet-level shed: the
+        first lane with a live per-spec prediction supplies the fold.
+        Returns (retry_after_s, controller_inputs) or (None, None) when
+        no lane has evidence — a blind hint is worse than none."""
+        sd = _spec_dict(spec)
+        for ln in self.lanes:
+            pred = ln.metrics.predict_completion(sd)
+            if pred is not None:
+                wait = (depth / max(len(self.lanes) * self.nrhs_max, 1)
+                        ) * pred["p50_s"]
+                hint = round(max(wait, pred["p50_s"]), 3)
+                return hint, {"decision": "shed_retry_hint",
+                              "queue_depth": depth,
+                              "predicted_wait_s": round(wait, 6),
+                              "prediction": pred}
+        return None, None
+
+    def _brownout_spec(self, spec: SolveSpec):
+        """Apply the engaged brownout level to one arriving request:
+        rewrite its precision to the current registry-ladder rung and
+        return the provenance stamp every response under brownout
+        carries. Requests not at the ladder's base precision (explicit
+        f64/df32 clients) pass through untouched — the ladder degrades
+        the DEFAULT serving tier, never a client's explicit ask for
+        more precision."""
+        with self._overload_lock:
+            level = self._brownout_level
+        if level <= 0 or spec.precision != self._ladder[0]:
+            return None, spec
+        from dataclasses import replace
+
+        from ..engines.registry import gate_reason
+
+        rung = self._ladder[min(level, len(self._ladder) - 1)]
+        degraded = {"from": spec.precision, "to": rung, "level": level,
+                    "reason": gate_reason("brownout-precision",
+                                          level=level,
+                                          from_p=spec.precision,
+                                          to_p=rung)}
+        return degraded, replace(spec, precision=rung)
+
+    def hedge_scan(self, now: float | None = None) -> int:
+        """One hedged-dispatch pass (run by the balancer, callable
+        manually with an injected clock): enqueue a speculative copy of
+        any request queued longer than its per-spec hedge delay (p95 of
+        the live latency fold, or the ``hedge_delay_s`` override) on a
+        different healthy lane. The copy IS the same PendingRequest
+        object — no new WAL record, so the exactly-once ledger cannot
+        see a duplicate by construction; first retire wins the claim
+        CAS, the loser cancels at its next boundary. Bounded budget:
+        at most ``hedge_budget`` of routed requests ever hedge (floor
+        one, so a cold fleet can still prove the mechanism). Returns
+        the number of hedges fired this pass."""
+        if not self.hedge:
+            return 0
+        if now is None:
+            now = time.monotonic()
+        healthy = [ln for ln in self.lanes if not ln.quarantined]
+        if len(healthy) < 2:
+            return 0
+        fired = 0
+        for src in healthy:
+            for p in src.broker.peek_queued():
+                if p.hedged or p.answered:
+                    continue
+                wait = now - p.enqueued
+                pred = src.metrics.predict_completion(_spec_dict(p.spec))
+                if self.hedge_delay_s is not None:
+                    delay, delay_source = self.hedge_delay_s, "override"
+                elif pred is not None:
+                    delay, delay_source = pred["p95_s"], "p95"
+                else:
+                    continue  # no delay evidence: never hedge blind
+                if wait <= delay:
+                    continue
+                allowed = max(1, int(self.hedge_budget
+                                     * self.fleet_metrics.routed))
+                if self.fleet_metrics.hedges_fired >= allowed:
+                    return fired  # budget spent: end the whole pass
+                others = [ln for ln in healthy if ln is not src
+                          and ln.broker.pending_count() < self.queue_max]
+                if not others:
+                    return fired
+                tgt = min(others,
+                          key=lambda ln: ln.broker.pending_count())
+                p.hedged = True
+                p.hedge_dst = tgt.label
+                inputs = {"wait_s": round(wait, 6),
+                          "delay_s": round(delay, 6),
+                          "delay_source": delay_source,
+                          "budget": {
+                              "allowed": allowed,
+                              "fired": self.fleet_metrics.hedges_fired,
+                              "routed": self.fleet_metrics.routed,
+                              "fraction": self.hedge_budget}}
+                if pred is not None:
+                    inputs["prediction"] = pred
+                if getattr(p, "rt", None) is not None:
+                    p.rt.event("hedge_fired", src=src.label,
+                               dst=tgt.label)
+                tgt.broker.adopt_pending([p])
+                self.fleet_metrics.hedge_fired(p.id, src.label,
+                                               tgt.label, wait, inputs)
+                fired += 1
+        return fired
+
+    def brownout_scan(self, now: float | None = None) -> str | None:
+        """One brownout pass (run by the balancer, callable manually
+        with an injected wall clock): pool every lane's SLO samples
+        through the SAME obs.regress.burn_rates fold the /metrics slo
+        block runs, then drive the ladder state machine — step DOWN one
+        registry rung when BOTH fast and slow windows burn past
+        ``brownout_burn``, step UP one rung only when BOTH fall below
+        ``brownout_clear_burn`` (the hysteresis band between the two
+        thresholds holds the level steady). Every transition journals
+        its burn-rate inputs. Returns "step", "recover" or None."""
+        if not self.brownout or self.slo_objective_s is None:
+            return None
+        samples: list = []
+        for ln in self.lanes:
+            samples.extend(ln.metrics.slo_samples())
+        if not samples:
+            return None
+        from ..obs.regress import burn_rates
+
+        kw = {}
+        if self.brownout_windows is not None:
+            kw["windows"] = self.brownout_windows
+        rates = burn_rates(samples, objective_s=self.slo_objective_s,
+                           target=self.slo_target,
+                           now=time.time() if now is None else now,
+                           **kw)
+        fast = rates["fast_burn_rate"]
+        slow = rates["slow_burn_rate"]
+        inputs = {"fast_burn": round(fast, 4),
+                  "slow_burn": round(slow, 4),
+                  "engage_burn": self.brownout_burn,
+                  "clear_burn": self.brownout_clear_burn,
+                  "samples": len(samples),
+                  "objective_s": self.slo_objective_s,
+                  "target": self.slo_target}
+        with self._overload_lock:
+            level = self._brownout_level
+            if (fast > self.brownout_burn and slow > self.brownout_burn
+                    and level < len(self._ladder) - 1):
+                self._brownout_level = level + 1
+                if level == 0:
+                    self._brownout_engaged_at = time.monotonic()
+                self.fleet_metrics.brownout(
+                    "step", level + 1, self._ladder[level],
+                    self._ladder[level + 1], inputs)
+                return "step"
+            if (level > 0 and fast < self.brownout_clear_burn
+                    and slow < self.brownout_clear_burn):
+                self._brownout_level = level - 1
+                if level == 1 and self._brownout_engaged_at is not None:
+                    self._brownout_residency_s += (
+                        time.monotonic() - self._brownout_engaged_at)
+                    self._brownout_engaged_at = None
+                self.fleet_metrics.brownout(
+                    "recover", level - 1, self._ladder[level],
+                    self._ladder[level - 1], inputs)
+                return "recover"
+        return None
 
     # -- SDC lane quarantine (ISSUE 14) ------------------------------------
 
@@ -507,7 +727,9 @@ class FleetDispatcher:
                     "padded_lanes_total", "broker_retries",
                     "batch_resumes", "recovery_runs",
                     "recovered_requests", "queue_depth",
-                    "sdc_detected", "sdc_rollbacks", "sdc_terminal")
+                    "sdc_detected", "sdc_rollbacks", "sdc_terminal",
+                    "deadline_exceeded_early", "deadline_exceeded_late",
+                    "hedge_wins", "hedge_cancels")
         out: dict = {k: sum(s.get(k, 0) for s in lane_snaps)
                      for k in sum_keys}
         # fleet-level sheds (every lane full) count into the top-level
@@ -577,6 +799,22 @@ class FleetDispatcher:
         fleet["quarantined_lanes"] = [ln.label for ln in self.lanes
                                       if ln.quarantined]
         fleet["quarantined"] = len(fleet["quarantined_lanes"])
+        if self.brownout:
+            # brownout residency (ISSUE 18): the current ladder level
+            # (a gauge — the step/recover history is the counters
+            # above) and the cumulative time spent engaged
+            with self._overload_lock:
+                level = self._brownout_level
+                residency = self._brownout_residency_s
+                if self._brownout_engaged_at is not None:
+                    residency += (time.monotonic()
+                                  - self._brownout_engaged_at)
+            fleet["brownout"] = {
+                "level": level,
+                "precision": self._ladder[
+                    min(level, len(self._ladder) - 1)],
+                "ladder": list(self._ladder),
+                "residency_s": round(residency, 3)}
         if self.artifacts is not None:
             fleet["artifacts"] = self.artifacts.stats()
         out["fleet"] = fleet

@@ -33,6 +33,24 @@ Record schema (all lines also carry the journal's v/seq/ts):
   {"event": "serve_recover",  "outstanding": N, "replayed": N,
                               "skipped": N, "corrupt_lines": N}
 
+Overload-resilience records (ISSUE 18 — journaled ONLY when the hedging
+/ brownout controllers are armed; the tracing-off vocabulary pin stays
+byte-identical, and deadline refusals reuse the EXISTING serve_shed /
+serve_response kinds with additive fields):
+
+  {"event": "serve_hedge_fired",     "id": ..., "src": D1, "dst": D2,
+                                     "wait_s": ..., "inputs": {...}}
+  {"event": "serve_hedge_won",       "id": ..., "dst": D2}
+  {"event": "serve_hedge_cancelled", "id": ..., "lane": L, "iter": K}
+  {"event": "fleet_brownout",        "action": "step"|"recover",
+                                     "level": N, "from": "f32",
+                                     "to": "bf16", "inputs": {...}}
+
+Every ``inputs`` dict carries the controller decision's full evidence
+(prediction fold, burn rates, thresholds, budget state) so the decision
+REPLAYS deterministically from the journal alone — the reqtrace
+route-cause discipline applied to control decisions.
+
 serve_request is the broker's WRITE-AHEAD admitted-request record
 (fsynced before the client gets its future back; `scale` makes it
 replayable), serve_response its visibility fence (fsynced before
@@ -75,6 +93,11 @@ _LATENCY_WINDOW = 4096
 # never grow with the spec space — keys beyond the cap pool into
 # "_other" (still bounded, still honest about existing).
 _SPEC_KEYS_MAX = 16
+
+# Minimum per-spec latency samples before the completion-time predictor
+# speaks (ISSUE 18): below this the admission controller treats the
+# distribution as unknown and never sheds predictively.
+_PREDICT_MIN_SAMPLES = 4
 
 
 def spec_latency_key(spec_dict: dict, bucket) -> str:
@@ -139,6 +162,15 @@ class Metrics:
         self.batch_resumes = 0  # retries that resumed a boundary checkpoint
         self.recovery_runs = 0  # Broker.recover invocations
         self.recovered_requests = 0  # admitted-unresponded requests replayed
+        # Overload resilience (ISSUE 18): deadline + hedge accounting.
+        # early = answered/shed `deadline_exceeded` WITHOUT burning a
+        # solve (the budget was gone, or the predictor said it would
+        # be); late = a real response delivered PAST its deadline — the
+        # count the whole subsystem exists to hold at zero.
+        self.deadline_exceeded_early = 0
+        self.deadline_exceeded_late = 0
+        self.hedge_wins = 0  # hedged requests rescued by the copy
+        self.hedge_cancels = 0  # loser copies retired without response
         # SDC defense accounting (ISSUE 14): retire-time audit verdicts
         self.sdc_detected = 0  # audit exceedances (finite-but-wrong lanes)
         self.sdc_rollbacks = 0  # detections answered by a lane re-run
@@ -181,12 +213,26 @@ class Metrics:
             self.queue_depth = queue_depth
 
     def shed(self, req_id: str, queue_depth: int,
-             failure_class: str = "transient") -> None:
-        self._journal({"event": "serve_shed", "id": req_id,
-                       "failure_class": failure_class,
-                       "queue_depth": queue_depth})
+             failure_class: str = "transient",
+             controller: dict | None = None,
+             retry_after_s: float | None = None) -> None:
+        """``controller`` (ISSUE 18, ADDITIVE) journals the admission
+        controller's decision inputs — the prediction fold, the deadline
+        budget — so an early deadline shed replays deterministically
+        from this one record. ``retry_after_s`` is the
+        predicted-queue-time hint handed back to the shed client."""
+        rec = {"event": "serve_shed", "id": req_id,
+               "failure_class": failure_class,
+               "queue_depth": queue_depth}
+        if controller is not None:
+            rec["controller"] = controller
+        if retry_after_s is not None:
+            rec["retry_after_s"] = round(float(retry_after_s), 3)
+        self._journal(rec)
         with self._lock:
             self.shed_total += 1
+            if failure_class == "deadline_exceeded":
+                self.deadline_exceeded_early += 1
 
     def admit(self, req_id: str, lane: int, boundary: int,
               midsolve: bool, live: int) -> None:
@@ -252,11 +298,27 @@ class Metrics:
                  lifecycle: dict | None = None,
                  phase_s: dict | None = None,
                  trace: dict | None = None,
-                 spec_key: str | None = None) -> None:
+                 spec_key: str | None = None,
+                 deadline_late: bool = False,
+                 controller: dict | None = None,
+                 degraded: dict | None = None) -> None:
         rec = {"event": "serve_response", "id": req_id, "ok": ok,
                "latency_s": round(latency_s, 6)}
         if cache is not None:
             rec["cache"] = cache
+        if deadline_late:
+            # ISSUE 18 (ADDITIVE): this response went out PAST its
+            # declared deadline — the late counter the perfgate pins
+            # at zero
+            rec["deadline_late"] = True
+        if controller is not None:
+            # controller decision inputs (early deadline refusals at
+            # batch formation / admission): replayable evidence
+            rec["controller"] = controller
+        if degraded is not None:
+            # brownout provenance stamp (ISSUE 18): the answer was
+            # computed on a stepped-down precision rung
+            rec["degraded"] = degraded
         if lifecycle:
             # the request's lifecycle breakdown (enqueue->admit->solve->
             # respond deltas, obs.trace.Lifecycle) — queue wait vs solve
@@ -290,6 +352,10 @@ class Metrics:
                 fc = failure_class or "transient"
                 self.failed_by_class[fc] = (
                     self.failed_by_class.get(fc, 0) + 1)
+                if fc == "deadline_exceeded":
+                    self.deadline_exceeded_early += 1
+            if deadline_late:
+                self.deadline_exceeded_late += 1
             self.latencies.append(latency_s)
             self._slo_samples.append((time.time(), latency_s, ok))
             if cache == "hit":
@@ -401,6 +467,25 @@ class Metrics:
         with self._lock:
             self._sdc_times.clear()
 
+    def hedge_won(self, req_id: str, dst: str) -> None:
+        """The speculative hedge copy answered first (ISSUE 18): the
+        straggler's victim was rescued by the lane the hedge landed on.
+        Journaled AFTER the winning serve_response — the ledger sees
+        exactly one response; this record is the attribution."""
+        self._journal({"event": "serve_hedge_won", "id": req_id,
+                       "dst": dst})
+        with self._lock:
+            self.hedge_wins += 1
+
+    def hedge_cancel(self, req_id: str, lane: int, boundary: int) -> None:
+        """The losing copy of a hedge pair was dropped at its next
+        boundary WITHOUT a response (the claim CAS was already won by
+        the other lane)."""
+        self._journal({"event": "serve_hedge_cancelled", "id": req_id,
+                       "lane": int(lane), "iter": int(boundary)})
+        with self._lock:
+            self.hedge_cancels += 1
+
     def retry(self, spec_dict: dict, failure_class: str, attempt: int,
               wait_s: float, resumed: bool) -> None:
         """One broker-internal retry of a retriable-failed batch
@@ -450,6 +535,35 @@ class Metrics:
         merge input)."""
         with self._lock:
             return {k: list(v) for k, v in self._lat_by_key.items()}
+
+    def slo_samples(self) -> list:
+        """Copy of the (wall ts, latency, ok) SLO sample window — the
+        fleet's brownout controller pools lanes' samples through the
+        SAME burn_rates fold the per-lane snapshot runs."""
+        with self._lock:
+            return list(self._slo_samples)
+
+    def predict_completion(self, spec_dict: dict) -> dict | None:
+        """Per-spec completion-time estimate (ISSUE 18): fold the
+        per-(spec, bucket) latency windows — the SAME windows the
+        latency_by_spec snapshot split reads — merged across buckets
+        (the bucket is unknown at admission time). Returns
+        ``{"samples", "p50_s", "p95_s"}`` or None below
+        ``_PREDICT_MIN_SAMPLES`` (unknown distribution: the admission
+        controller never sheds predictively on thin evidence). The
+        returned dict IS the controller's journaled decision input."""
+        prefix = (f"d{spec_dict.get('degree')}"
+                  f":n{spec_dict.get('ndofs')}"
+                  f":r{spec_dict.get('nreps')}"
+                  f":{spec_dict.get('precision', 'f32')}:b")
+        with self._lock:
+            merged = [v for k, win in self._lat_by_key.items()
+                      if k.startswith(prefix) for v in win]
+        if len(merged) < _PREDICT_MIN_SAMPLES:
+            return None
+        s = sorted(merged)
+        return {"samples": len(s), "p50_s": round(_pct(s, 0.50), 6),
+                "p95_s": round(_pct(s, 0.95), 6)}
 
     def fast_burn_rate(self) -> float:
         """Fast-window SLO burn rate as a CONTROL SIGNAL (ISSUE 13): the
@@ -533,6 +647,12 @@ class Metrics:
                 "sdc_detected": self.sdc_detected,
                 "sdc_rollbacks": self.sdc_rollbacks,
                 "sdc_terminal": self.sdc_terminal,
+                # overload resilience (ISSUE 18): the early/late deadline
+                # split and the hedge win/cancel ledger
+                "deadline_exceeded_early": self.deadline_exceeded_early,
+                "deadline_exceeded_late": self.deadline_exceeded_late,
+                "hedge_wins": self.hedge_wins,
+                "hedge_cancels": self.hedge_cancels,
             }
         if cache_stats is not None:
             out["cache"] = cache_stats
@@ -623,6 +743,10 @@ class FleetMetrics:
         self.readmits = 0  # lanes readmitted after a passing self-test
         self.selftests = 0  # known-answer self-tests run
         self.selftests_failed = 0  # self-tests that kept the lane out
+        # overload resilience (ISSUE 18): hedge + brownout controllers
+        self.hedges_fired = 0  # speculative copies enqueued
+        self.brownout_steps = 0  # precision-ladder step-downs
+        self.brownout_recoveries = 0  # hysteresis-gated step-ups
 
     def _journal(self, rec: dict) -> None:
         if self.journal is not None:
@@ -670,17 +794,57 @@ class FleetMetrics:
                        "dst": dst, "fast_burn": round(float(fast_burn),
                                                       4)})
 
-    def shed(self, req_id: str, queue_depth: int) -> None:
+    def shed(self, req_id: str, queue_depth: int,
+             failure_class: str = "transient",
+             retry_after_s: float | None = None,
+             controller: dict | None = None) -> None:
         """Fleet-level shed (every lane at capacity): journaled BEFORE
         any write-ahead record exists for the id, COUNTED so /metrics
         shed_total and the perfgate shed gate see fleet-mode sheds —
         a journal-only record would hide a shedding regression from
-        every live counter."""
-        self._journal({"event": "serve_shed", "id": req_id,
-                       "failure_class": "transient", "device": "fleet",
-                       "queue_depth": int(queue_depth)})
+        every live counter. ``retry_after_s`` (ISSUE 18, ADDITIVE) is
+        the predicted-queue-time hint handed to the shed client;
+        ``controller`` journals the prediction inputs behind it."""
+        rec = {"event": "serve_shed", "id": req_id,
+               "failure_class": failure_class, "device": "fleet",
+               "queue_depth": int(queue_depth)}
+        if retry_after_s is not None:
+            rec["retry_after_s"] = round(float(retry_after_s), 3)
+        if controller is not None:
+            rec["controller"] = controller
+        self._journal(rec)
         with self._lock:
             self.sheds += 1
+
+    def hedge_fired(self, req_id: str, src: str, dst: str,
+                    wait_s: float, inputs: dict) -> None:
+        """One speculative hedge copy enqueued on a second healthy lane
+        (ISSUE 18). ``inputs`` carries the controller's full decision
+        evidence — observed queue wait, the per-spec hedge delay and
+        where it came from (p95 fold or override), the hedge-budget
+        state — so the fire decision replays from this record alone."""
+        self._journal({"event": "serve_hedge_fired", "id": req_id,
+                       "src": src, "dst": dst,
+                       "wait_s": round(float(wait_s), 6),
+                       "inputs": inputs})
+        with self._lock:
+            self.hedges_fired += 1
+
+    def brownout(self, action: str, level: int, from_precision: str,
+                 to_precision: str, inputs: dict) -> None:
+        """One brownout-ladder transition (ISSUE 18): ``action`` is
+        "step" (sustained fast+slow burn stepped the fleet DOWN a
+        registry precision rung) or "recover" (hysteresis cleared and
+        the fleet stepped back UP). ``inputs`` journals the burn rates
+        and thresholds that drove the decision."""
+        self._journal({"event": "fleet_brownout", "action": action,
+                       "level": int(level), "from": from_precision,
+                       "to": to_precision, "inputs": inputs})
+        with self._lock:
+            if action == "step":
+                self.brownout_steps += 1
+            elif action == "recover":
+                self.brownout_recoveries += 1
 
     def quarantine(self, device: str, drained: int,
                    window_events: int) -> None:
@@ -744,6 +908,9 @@ class FleetMetrics:
                 "readmits": self.readmits,
                 "selftests": self.selftests,
                 "selftests_failed": self.selftests_failed,
+                "hedges_fired": self.hedges_fired,
+                "brownout_steps": self.brownout_steps,
+                "brownout_recoveries": self.brownout_recoveries,
             }
 
 
@@ -760,6 +927,11 @@ _PROM_COUNTERS = frozenset({
     "recovered_requests",
     # SDC defense (ISSUE 14): detection + adjudication counters
     "sdc_detected", "sdc_rollbacks", "sdc_terminal",
+    # overload resilience (ISSUE 18): deadline split + hedge ledger
+    "deadline_exceeded_early", "deadline_exceeded_late",
+    "hedge_wins", "hedge_cancels",
+    "fleet_hedges_fired", "fleet_brownout_steps",
+    "fleet_brownout_recoveries",
     # request tracing (ISSUE 15): completeness counters
     "reqtrace_trace_complete", "reqtrace_trace_incomplete",
     # fleet block leaves (flattened as fleet_<leaf>): monotone counters
@@ -907,6 +1079,11 @@ def replay_serve(journal_path: str) -> dict:
         # carrying a phase decomposition (fold_reqtrace owns the full
         # percentile fold; these are the incident-summary counts)
         "phase_events": 0, "traced_responses": 0,
+        # overload resilience (ISSUE 18): early/late deadline split,
+        # hedge pair lifecycle and brownout transitions
+        "deadline_exceeded_early": 0, "deadline_exceeded_late": 0,
+        "hedges_fired": 0, "hedge_wins": 0, "hedge_cancels": 0,
+        "brownout_steps": 0, "brownout_recoveries": 0,
     }
     warm_lat: list[float] = []
     occupancy: list[dict] = []  # (seq, iter, live) — occupancy over time
@@ -923,6 +1100,8 @@ def replay_serve(journal_path: str) -> dict:
             fc = rec.get("failure_class", "transient")
             out["failed_by_class"][fc] = (
                 out["failed_by_class"].get(fc, 0) + 1)
+            if fc == "deadline_exceeded":
+                out["deadline_exceeded_early"] += 1
         elif ev == "serve_admit":
             out["admits"] += 1
             if rec.get("midsolve"):
@@ -982,9 +1161,22 @@ def replay_serve(journal_path: str) -> dict:
             out["fleet_selftests"] += 1
         elif ev == "serve_phase":
             out["phase_events"] += 1
+        elif ev == "serve_hedge_fired":
+            out["hedges_fired"] += 1
+        elif ev == "serve_hedge_won":
+            out["hedge_wins"] += 1
+        elif ev == "serve_hedge_cancelled":
+            out["hedge_cancels"] += 1
+        elif ev == "fleet_brownout":
+            if rec.get("action") == "step":
+                out["brownout_steps"] += 1
+            elif rec.get("action") == "recover":
+                out["brownout_recoveries"] += 1
         elif ev == "serve_response":
             if isinstance(rec.get("phase_s"), dict):
                 out["traced_responses"] += 1
+            if rec.get("deadline_late"):
+                out["deadline_exceeded_late"] += 1
             if rec.get("ok"):
                 out["responses_ok"] += 1
                 if rec.get("cache") == "hit":
@@ -994,6 +1186,8 @@ def replay_serve(journal_path: str) -> dict:
                 fc = rec.get("failure_class", "transient")
                 out["failed_by_class"][fc] = (
                     out["failed_by_class"].get(fc, 0) + 1)
+                if fc == "deadline_exceeded":
+                    out["deadline_exceeded_early"] += 1
     out["mean_batch_occupancy"] = (
         out["lanes_total"] / out["batches"] if out["batches"] else 0.0)
     batched = out["cache_hits"] + out["cache_misses"]

@@ -113,6 +113,9 @@ def make_handler(broker: Broker, request_timeout_s: float = 300.0,
                     raise ValueError(
                         f"request body must be a JSON object, got "
                         f"{type(req).__name__}")
+                # client deadline propagation (ISSUE 18): wire-level
+                # milliseconds (the gRPC idiom), seconds inside
+                deadline_ms = req.get("deadline_ms")
                 spec = SolveSpec(
                     degree=int(req.get("degree", 3)),
                     ndofs=int(req.get("ndofs", 50_000)),
@@ -120,6 +123,8 @@ def make_handler(broker: Broker, request_timeout_s: float = 300.0,
                     precision=str(req.get("precision", "f32")),
                     geom_perturb_fact=float(
                         req.get("geom_perturb_fact", 0.0)),
+                    deadline_s=(float(deadline_ms) / 1000.0
+                                if deadline_ms is not None else None),
                 )
                 scale = float(req.get("scale", 1.0))
             except (ValueError, TypeError, json.JSONDecodeError) as exc:
@@ -131,10 +136,22 @@ def make_handler(broker: Broker, request_timeout_s: float = 300.0,
             try:
                 pending = broker.submit(spec, scale)
             except QueueFull as exc:
-                self._send(503, {"ok": False, "error": str(exc),
-                                 "failure_class": "transient",
-                                 "retriable": True},
-                           {"Retry-After": RETRY_AFTER_S})
+                # the shed carries its own class + retry hint when the
+                # admission controller computed one (ISSUE 18): a
+                # deadline refusal reads deadline_exceeded, and the
+                # Retry-After header is the predicted-queue-time fold
+                # instead of the blind constant
+                retry_after = getattr(exc, "retry_after_s", None)
+                body = {"ok": False, "error": str(exc),
+                        "failure_class": getattr(exc, "failure_class",
+                                                 "transient"),
+                        "retriable": True}
+                if retry_after is not None:
+                    body["retry_after_s"] = retry_after
+                self._send(503, body,
+                           {"Retry-After": (retry_after
+                                            if retry_after is not None
+                                            else RETRY_AFTER_S)})
                 return
             result = broker.wait(pending, request_timeout_s)
             if result.get("ok"):

@@ -39,8 +39,19 @@ stack and asserts the recovery invariants:
      healthy peer, and a known-answer self-test must readmit it
      (``serve_sdc``/``fleet_quarantine``/``fleet_readmit`` journaled).
 
-``--legs`` selects a subset (generations,crash,nan,preempt,standby,sdc)
-— the CI fleet lane runs ``--legs standby`` next to the loadgen smoke.
+  7. overload (ISSUE 18) — a 2-lane fleet under deliberate overload
+     with deadline propagation, predictive admission, hedged dispatch
+     and the brownout ladder armed: an impossible budget is refused
+     EARLY with a predicted-queue-time retry hint, an expired-in-queue
+     request is answered without burning a solve (zero LATE misses), a
+     straggler-stuck request is hedge-rescued by the healthy lane with
+     the exactly-once ledger holding across the hedge pair, and
+     sustained SLO burn steps the fleet down the registry precision
+     ladder (``degraded`` provenance) then back up on hysteresis.
+
+``--legs`` selects a subset
+(generations,crash,nan,preempt,standby,sdc,overload) — the CI fleet
+lane runs ``--legs standby`` next to the loadgen smoke.
 
 All CPU (``JAX_PLATFORMS=cpu`` is pinned — this is a software-recovery
 proof, not a hardware measurement; snapshot/restore on real HBM stays
@@ -597,6 +608,166 @@ def run_sdc(quick: bool) -> int:
     return 0
 
 
+def run_overload(quick: bool) -> int:
+    """Leg 7 (ISSUE 18): deliberate overload against a 2-lane fleet with
+    deadline propagation, predictive admission, hedged dispatch and the
+    brownout ladder all armed. Invariants: a request whose predicted
+    completion exceeds its budget is REFUSED at admission (early, with a
+    predicted-queue-time retry hint); a request that expires in queue is
+    answered without burning a solve; zero LATE deadline misses; a
+    straggler-stuck request is hedge-rescued by the healthy lane (first
+    retire wins the claim CAS, the loser cancels — the exactly-once
+    ledger holds across the hedge pair); sustained SLO burn steps the
+    fleet down the registry precision ladder (responses stamped
+    `degraded`) and hysteresis steps it back up once the burn clears."""
+    _pin_cpu()
+    from bench_tpu_fem.harness.chaos import install_fault_hook
+    from bench_tpu_fem.harness.faults import HeldSolveHook
+    from bench_tpu_fem.harness.journal import read_records
+    from bench_tpu_fem.serve import FleetDispatcher, SolveSpec
+    from bench_tpu_fem.serve.broker import QueueFull
+    from bench_tpu_fem.serve.recovery import verify_exactly_once
+
+    tmp = tempfile.mkdtemp(prefix="chaos_overload_")
+    journal = os.path.join(tmp, "OVERLOAD_chaos.jsonl")
+    # tiny objective: every real solve violates it, so the burn fold
+    # reads sustained overload — the brownout trigger under test.
+    # spill_burn is parked out of the way (spill would re-route the
+    # affinity lane this leg deliberately backs up); custom short burn
+    # windows let the recovery phase age the samples out with an
+    # injected clock instead of a wall-clock wait.
+    fleet = FleetDispatcher(
+        2, journal_path=journal, queue_max=64, nrhs_max=2,
+        window_s=0.02, solve_timeout_s=120.0, balance_interval_s=0,
+        slo_objective_s=0.01, spill_burn=1e9,
+        hedge=True, hedge_budget=1.0, hedge_delay_s=0.05,
+        brownout=True, brownout_burn=0.5, brownout_clear_burn=0.25,
+        brownout_windows=((30.0, "fast"), (60.0, "slow")))
+    spec = SolveSpec(degree=1, ndofs=2000, nreps=12)
+    try:
+        fleet.warmup([spec])
+        # seed the per-spec latency windows: the predictor refuses to
+        # guess below its minimum sample count, so admission control is
+        # inert until real completions exist (no evidence, no shed)
+        for i in range(4):
+            o = fleet.wait(fleet.submit(spec, float(1 + i)), 180)
+            if not o.get("ok"):
+                return fail(f"overload leg: warm solve failed: {o}")
+
+        # -- predictive admission: an impossible budget is refused
+        # EARLY, before any solve burns, with a computed retry hint
+        import dataclasses
+
+        doomed = dataclasses.replace(spec, deadline_s=1e-4)
+        try:
+            fleet.submit(doomed, 1.0)
+            return fail("overload leg: impossible deadline was admitted")
+        except QueueFull as exc:
+            if exc.failure_class != "deadline_exceeded":
+                return fail(f"overload leg: predictive shed classified "
+                            f"{exc.failure_class!r}, wanted "
+                            f"deadline_exceeded")
+            if not exc.retry_after_s:
+                return fail("overload leg: predictive shed carried no "
+                            "retry_after_s hint")
+            log(f"predictive shed OK (retry_after_s={exc.retry_after_s})")
+
+        # -- straggler + hedge rescue + expired-in-queue: lane 0's
+        # worker blocks inside a held solve; the queue behind it builds
+        hook = HeldSolveHook(hold=1, timeout_s=120.0)
+        prev = install_fault_hook(hook)
+        try:
+            a = fleet.submit(spec, 1.0)     # enters the held solve
+            time.sleep(0.3)
+            b = fleet.submit(spec, 2.0)     # queues behind the straggler
+            c = fleet.submit(
+                dataclasses.replace(spec, deadline_s=0.5), 1.0)
+            time.sleep(0.6)                 # c expires; b over the delay
+            nh = fleet.hedge_scan()
+            if nh < 1:
+                return fail(f"overload leg: hedge_scan fired {nh} "
+                            "hedges, wanted >= 1")
+            ob = fleet.wait(b, 180)         # rescued on the healthy lane
+            oc = fleet.wait(c, 180)         # expired: answered, no solve
+            hook.release()
+            oa = fleet.wait(a, 180)         # the straggler retires late
+        finally:
+            install_fault_hook(prev)
+            hook.release()
+        if not (oa.get("ok") and ob.get("ok")):
+            return fail(f"overload leg: hedge rescue failed: {oa} {ob}")
+        if oc.get("ok") or oc.get("failure_class") != "deadline_exceeded":
+            return fail(f"overload leg: expired-in-queue request not "
+                        f"answered deadline_exceeded: {oc}")
+        if len(hook.waited) != 1:
+            return fail(f"overload leg: straggler hook held "
+                        f"{len(hook.waited)} solves, wanted 1")
+
+        # -- brownout: sustained burn steps the fleet down the registry
+        # precision ladder; responses carry degraded provenance
+        step = fleet.brownout_scan()
+        if step != "step":
+            return fail(f"overload leg: brownout did not engage ({step})")
+        od = fleet.wait(fleet.submit(spec, 1.0), 300)
+        if not od.get("ok"):
+            return fail(f"overload leg: brownout-degraded solve failed: "
+                        f"{od}")
+        deg = od.get("degraded")
+        if not deg or deg.get("to") != "bf16" or deg.get("from") != "f32":
+            return fail(f"overload leg: degraded response missing its "
+                        f"provenance stamp: {deg}")
+        # hysteresis recovery: age the burn windows out (injected clock)
+        rec = fleet.brownout_scan(now=time.time() + 3600.0)
+        if rec != "recover":
+            return fail(f"overload leg: brownout did not recover ({rec})")
+        oe = fleet.wait(fleet.submit(spec, 1.0), 180)
+        if not oe.get("ok") or oe.get("degraded"):
+            return fail(f"overload leg: post-recovery response still "
+                        f"degraded: {oe}")
+        snap = fleet.metrics_snapshot()
+    finally:
+        fleet.shutdown()
+
+    if snap.get("deadline_exceeded_late", 0) != 0:
+        return fail(f"overload leg: LATE deadline misses: "
+                    f"{snap['deadline_exceeded_late']}")
+    if snap.get("deadline_exceeded_early", 0) < 2:
+        return fail(f"overload leg: early sheds "
+                    f"{snap.get('deadline_exceeded_early')}, wanted >= 2")
+    if snap.get("hedge_wins", 0) < 1:
+        return fail(f"overload leg: no hedge win recorded: "
+                    f"{snap.get('hedge_wins')}")
+    f = snap["fleet"]
+    if f.get("hedges_fired", 0) < 1:
+        return fail(f"overload leg: hedges_fired {f.get('hedges_fired')}")
+    if f.get("brownout_steps") != 1 or f.get("brownout_recoveries") != 1:
+        return fail(f"overload leg: brownout counters wrong: {f}")
+    brown = f.get("brownout") or {}
+    if brown.get("level") != 0 or brown.get("residency_s", 0) <= 0:
+        return fail(f"overload leg: brownout state after recovery: "
+                    f"{brown}")
+    verdict = verify_exactly_once(journal)
+    if not verdict["ok"]:
+        return fail(f"overload leg: exactly-once violated across the "
+                    f"hedge pair: lost={verdict['lost']} "
+                    f"duplicates={verdict['duplicates']}")
+    records, _ = read_records(journal)
+    evs = [r.get("event") for r in records]
+    for needed in ("serve_hedge_fired", "serve_hedge_won",
+                   "fleet_brownout"):
+        if needed not in evs:
+            return fail(f"overload leg: no {needed} record in the "
+                        "journal")
+    sheds = [r for r in records if r.get("event") == "serve_shed"
+             and r.get("failure_class") == "deadline_exceeded"]
+    if not sheds or not sheds[0].get("controller"):
+        return fail("overload leg: deadline shed journaled without its "
+                    "controller inputs (not replayable)")
+    log("leg 7 (overload: predictive shed -> hedge rescue -> "
+        "brownout step/recover, exactly-once incl. hedge pair) OK")
+    return 0
+
+
 def run_preemption(quick: bool) -> int:
     """Leg 4: preemption mid-CG — SIGKILL right after a durable
     snapshot, resume, compare BITWISE with the uninterrupted solve."""
@@ -663,8 +834,8 @@ def main(argv=None) -> int:
                    help="bound the soak to ~60 s (the CI chaos lane)")
     p.add_argument("--legs", default="",
                    help="comma-separated subset of "
-                        "generations,crash,nan,preempt,standby,sdc "
-                        "(default: all)")
+                        "generations,crash,nan,preempt,standby,sdc,"
+                        "overload (default: all)")
     p.add_argument("--serve-child", type=int, default=0,
                    help=argparse.SUPPRESS)  # internal: generation driver
     p.add_argument("--fleet-child", type=int, default=0,
@@ -680,7 +851,8 @@ def main(argv=None) -> int:
                            args.fleet_child, args.nreq)
     legs = {"generations": run_generations, "crash": run_worker_crash,
             "nan": run_nan_injection, "preempt": run_preemption,
-            "standby": run_standby, "sdc": run_sdc}
+            "standby": run_standby, "sdc": run_sdc,
+            "overload": run_overload}
     selected = ([s.strip() for s in args.legs.split(",") if s.strip()]
                 or list(legs))
     unknown = [s for s in selected if s not in legs]

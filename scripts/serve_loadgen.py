@@ -189,13 +189,19 @@ def _finish_summary(out: dict, requests: int, t0: float,
 def run_load(url: str, requests: int = 64, concurrency: int = 16,
              degrees=(1, 2, 3), ndofs: int = 4000, nreps: int = 15,
              precision: str = "f32", timeout_s: float = 120.0,
-             profile: str = "burst", stagger_ms: float = 30.0) -> dict:
+             profile: str = "burst", stagger_ms: float = 30.0,
+             deadline_ms: float | None = None,
+             burst: tuple | None = None) -> dict:
     """Fire `requests` mixed-degree solves with a bounded worker pool;
     retriable failures (shed 503s) get ONE retry after the server's
-    Retry-After hint. `profile="ramp"` staggers thread starts by
-    `stagger_ms` so arrivals straddle solve boundaries (the queue stays
-    non-empty while batches are in flight — what continuous batching
-    feeds on). Returns the summary dict main() prints."""
+    Retry-After hint (the body's `retry_after_s` when the admission
+    controller computed one, else 1s). `profile="ramp"` staggers thread
+    starts by `stagger_ms` so arrivals straddle solve boundaries (the
+    queue stays non-empty while batches are in flight — what continuous
+    batching feeds on). `deadline_ms` stamps every request with a
+    client deadline (ISSUE 18 propagation); `burst=(N_ms, M)` fires
+    M-request bursts every N ms — the overload arrival shape that makes
+    deadline sheds and hedges observable."""
     degrees = list(degrees)
     lock = threading.Lock()
     out = {"completed": 0, "failed": 0, "shed_retried": 0,
@@ -210,12 +216,18 @@ def run_load(url: str, requests: int = 64, concurrency: int = 16,
             body = {"degree": degrees[i % len(degrees)], "ndofs": ndofs,
                     "nreps": nreps, "precision": precision,
                     "scale": float(1 + (i % 4))}
+            if deadline_ms is not None:
+                body["deadline_ms"] = deadline_ms
             t0 = time.monotonic()
             code, resp = _post(url, body, timeout_s)
             if code != 200 and resp.get("retriable"):
                 with lock:
                     out["shed_retried"] += 1
-                time.sleep(1.0)
+                # honour the server's predicted-queue-time hint when it
+                # sent one (deadline-aware sheds do); blind 1s otherwise
+                hint = resp.get("retry_after_s")
+                time.sleep(float(hint) if isinstance(hint, (int, float))
+                           and 0 < hint <= 30 else 1.0)
                 code, resp = _post(url, body, timeout_s)
             with lock:
                 _record_response(out, code, resp,
@@ -224,9 +236,13 @@ def run_load(url: str, requests: int = 64, concurrency: int = 16,
     t0 = time.monotonic()
     threads = [threading.Thread(target=fire, args=(i,))
                for i in range(requests)]
-    for t in threads:
+    for k, t in enumerate(threads):
         t.start()
-        if profile == "ramp":
+        if burst is not None:
+            gap_ms, per_burst = burst
+            if (k + 1) % max(per_burst, 1) == 0:
+                time.sleep(gap_ms / 1000.0)
+        elif profile == "ramp":
             time.sleep(stagger_ms / 1000.0)
     for t in threads:
         t.join()
@@ -237,7 +253,8 @@ def run_fleet_load(url: str, requests: int = 640, concurrency: int = 32,
                    degrees=(1, 2, 3), weights=(4, 1, 1),
                    ndofs: int = 4000, nreps: int = 15,
                    precision: str = "f32",
-                   timeout_s: float = 120.0) -> dict:
+                   timeout_s: float = 120.0,
+                   deadline_ms: float | None = None) -> dict:
     """The fleet acceptance load (ISSUE 13): >= 10x the 64-request
     smoke, mixed degrees under an IMBALANCED deterministic schedule
     (`weights` — the hot degree's affinity lane backs up, which is what
@@ -270,12 +287,16 @@ def run_fleet_load(url: str, requests: int = 640, concurrency: int = 32,
             body = {"degree": wheel[i % len(wheel)], "ndofs": ndofs,
                     "nreps": nreps, "precision": precision,
                     "scale": float(1 + (i % 4))}
+            if deadline_ms is not None:
+                body["deadline_ms"] = deadline_ms
             t0 = time.monotonic()
             code, resp = _post(url, body, timeout_s)
             if code != 200 and resp.get("retriable"):
                 with lock:
                     out["shed_retried"] += 1
-                time.sleep(1.0)
+                hint = resp.get("retry_after_s")
+                time.sleep(float(hint) if isinstance(hint, (int, float))
+                           and 0 < hint <= 30 else 1.0)
                 code, resp = _post(url, body, timeout_s)
             with lock:
                 _record_response(out, code, resp,
@@ -410,6 +431,41 @@ def render_phase_table(metrics: dict) -> str:
     return "\n".join(lines)
 
 
+def render_overload_table(metrics: dict) -> str:
+    """Overload-resilience table (ISSUE 18) from the /metrics snapshot:
+    early-vs-late deadline shed split, hedge win rate, brownout
+    residency. Returns "" when the server shows no overload signals —
+    the caller prints nothing rather than zeros-as-data."""
+    m = metrics or {}
+    fleet = m.get("fleet") or {}
+    early = int(m.get("deadline_exceeded_early", 0) or 0)
+    late = int(m.get("deadline_exceeded_late", 0) or 0)
+    wins = int(m.get("hedge_wins", 0) or 0)
+    cancels = int(m.get("hedge_cancels", 0) or 0)
+    fired = int(fleet.get("hedges_fired", 0) or 0)
+    brown = fleet.get("brownout") or {}
+    steps = int(fleet.get("brownout_steps", 0) or 0)
+    if not any((early, late, wins, cancels, fired, steps, brown)):
+        return ""
+    total = early + late
+    lines = [f"{'deadline sheds':<22s} {total:>6d}  "
+             f"(early {early}, late {late} — early means the budget "
+             "was refused BEFORE a solve burned)"]
+    if fired or wins or cancels:
+        rate = wins / fired if fired else 0.0
+        lines.append(f"{'hedges':<22s} {fired:>6d}  "
+                     f"(wins {wins}, cancelled {cancels}, "
+                     f"win rate {rate:.3f})")
+    if steps or brown:
+        lines.append(
+            f"{'brownout':<22s} {steps:>6d} step(s)  "
+            f"(level {brown.get('level', 0)}, "
+            f"precision {brown.get('precision', '?')}, "
+            f"residency {brown.get('residency_s', 0.0)}s, "
+            f"recoveries {fleet.get('brownout_recoveries', 0)})")
+    return "\n".join(lines)
+
+
 def check_latency_consistency(summary: dict,
                               slack_s: float = 0.05) -> str:
     """Client percentiles vs the server's own per-response spans for the
@@ -462,6 +518,23 @@ def main(argv=None) -> int:
                         "arrivals so the queue spans solve boundaries")
     p.add_argument("--stagger-ms", type=float, default=30.0,
                    help="ramp profile inter-arrival gap")
+    p.add_argument("--deadline-ms", type=float, default=None,
+                   help="stamp every request with a client deadline "
+                        "(ISSUE 18): the server refuses work it "
+                        "predicts cannot finish inside the budget "
+                        "(deadline_exceeded, 503 + retry_after_s) and "
+                        "answers already-expired queued requests "
+                        "without burning a solve")
+    p.add_argument("--burst", default="",
+                   metavar="N:M",
+                   help="overload arrival shape: fire M-request bursts "
+                        "every N ms (overrides --profile pacing); e.g. "
+                        "500:8 = 8 at a time, twice a second")
+    p.add_argument("--assert-deadline", action="store_true",
+                   help="fail unless the server's /metrics reports "
+                        "deadline_exceeded_late == 0 (every deadline "
+                        "miss was refused EARLY — before a solve "
+                        "burned — never discovered after)")
     p.add_argument("--fleet", action="store_true",
                    help="fleet acceptance mode (ISSUE 13): worker-pool "
                         "driver with a deterministically IMBALANCED "
@@ -508,6 +581,13 @@ def main(argv=None) -> int:
                         "/metrics latency_warm_* table")
     args = p.parse_args(argv)
     degrees = [int(d) for d in args.degrees.split(",") if d.strip()]
+    burst = None
+    if args.burst:
+        try:
+            n_ms, m = args.burst.split(":")
+            burst = (float(n_ms), int(m))
+        except ValueError:
+            p.error(f"--burst wants N:M (ms:count), got {args.burst!r}")
     if args.fleet:
         summary = run_fleet_load(
             args.url, requests=args.requests,
@@ -515,7 +595,8 @@ def main(argv=None) -> int:
             weights=[int(w) for w in args.weights.split(",")
                      if w.strip()],
             ndofs=args.ndofs, nreps=args.nreps,
-            precision=args.precision, timeout_s=args.timeout)
+            precision=args.precision, timeout_s=args.timeout,
+            deadline_ms=args.deadline_ms)
     else:
         summary = run_load(
             args.url, requests=args.requests,
@@ -523,8 +604,31 @@ def main(argv=None) -> int:
             ndofs=args.ndofs, nreps=args.nreps,
             precision=args.precision,
             timeout_s=args.timeout, profile=args.profile,
-            stagger_ms=args.stagger_ms)
+            stagger_ms=args.stagger_ms,
+            deadline_ms=args.deadline_ms, burst=burst)
     rc = 0 if summary["failed"] == 0 else 1
+    if args.assert_deadline:
+        # an overload run EXPECTS early deadline sheds — they are the
+        # feature working, not a loadgen failure. Tolerate the
+        # deadline-classed refusals in the rc, then pin the real
+        # contract: zero LATE deadline misses on the server.
+        ddl = summary["failed_by_class"].get("deadline_exceeded", 0)
+        if summary["failed"] - ddl == 0:
+            rc = 0
+        m = summary.get("metrics") or {}
+        late = m.get("deadline_exceeded_late")
+        if "error" in m or not isinstance(late, (int, float)):
+            summary["assert_deadline"] = (
+                "FAIL: /metrics carries no deadline_exceeded_late "
+                "counter (server predates deadline propagation?)")
+            rc = 1
+        elif late > 0:
+            summary["assert_deadline"] = (
+                f"FAIL: {int(late)} response(s) completed PAST their "
+                "deadline — the budget check missed them")
+            rc = 1
+        else:
+            summary["assert_deadline"] = "ok"
     if args.assert_affinity is not None:
         rate = (summary.get("fleet") or {}).get("affinity_hit_rate")
         if not isinstance(rate, (int, float)) or \
@@ -610,6 +714,13 @@ def main(argv=None) -> int:
         print("== server phase shares (p50/p95/p99 per phase)",
               file=sys.stderr)
         print(table, file=sys.stderr)
+    # overload-resilience table (ISSUE 18): same stderr contract —
+    # stdout stays the one machine-readable JSON line
+    overload = render_overload_table(summary.get("metrics") or {})
+    if overload:
+        print("== overload resilience (deadline/hedge/brownout)",
+              file=sys.stderr)
+        print(overload, file=sys.stderr)
     print(json.dumps(summary))
     return rc
 

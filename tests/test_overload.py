@@ -1,0 +1,397 @@
+"""Overload-resilience suite (ISSUE 18, bench_tpu_fem.serve.broker +
+serve.fleet): deadline propagation through every phase boundary,
+predictive admission control with journaled decision inputs, hedged
+dispatch under the exactly-once claim CAS, and the brownout degradation
+ladder's hysteresis state machine.
+
+The deterministic straggler is ``harness.faults.HeldSolveHook`` on the
+``serve.engine.FAULT_HOOK`` seam — a solve that blocks until released,
+so queue-wait windows are script-controlled, not load-dependent. The
+brownout state machine is driven with hand-seeded SLO samples and an
+injected wall clock through the SAME ``obs.regress.burn_rates`` fold
+the live /metrics block runs. Everything is CPU; the live-fleet rescue
+story also runs in CI via the chaos-soak ``overload`` leg and the
+perfgate overload counters.
+
+The tracing-off pin here is the suite's contract with every pre-PR
+consumer: an UNARMED broker's journal vocabulary and response payloads
+are bitwise pre-PR — no new event kinds, no controller/degraded/
+retry_after_s keys anywhere.
+"""
+
+import threading
+import time
+from dataclasses import replace
+
+import pytest
+
+from bench_tpu_fem.harness.chaos import install_fault_hook
+from bench_tpu_fem.harness.classify import classify, classify_text
+from bench_tpu_fem.harness.faults import HeldSolveHook
+from bench_tpu_fem.harness.journal import read_records
+from bench_tpu_fem.harness.policy import RETRY, StagePolicy, next_action
+from bench_tpu_fem.serve import (
+    RETRIABLE_CLASSES,
+    Broker,
+    ExecutableCache,
+    FleetDispatcher,
+    Metrics,
+    QueueFull,
+    SolveSpec,
+    build_solver,
+    replay_serve,
+    spec_cache_key,
+    verify_exactly_once,
+)
+from bench_tpu_fem.serve.broker import PendingRequest
+
+pytestmark = [pytest.mark.serve]
+
+SPEC = SolveSpec(degree=1, ndofs=2000, nreps=12)
+
+#: the journal event vocabulary the PRE-PR serve stack emits — the
+#: unarmed-path pin asserts the default broker's set is unchanged
+#: (same pin as tests/test_reqtrace.py)
+PRE_PR_EVENTS = {"serve_request", "serve_shed", "serve_admit",
+                 "serve_retire", "serve_batch", "serve_response",
+                 "serve_retry", "serve_recover", "serve_sdc"}
+
+
+@pytest.fixture(scope="module")
+def solver2():
+    """One compiled bucket-2 solver shared by every broker in this
+    module (seconds of compile paid once)."""
+    return build_solver(SPEC, bucket=2)
+
+
+def _broker(tmp_path, solver2, name="OVERLOAD.jsonl", **kw):
+    defaults = dict(queue_max=64, nrhs_max=2, window_s=0.03,
+                    solve_timeout_s=60.0)
+    defaults.update(kw)
+    journal = str(tmp_path / name)
+    broker = Broker(ExecutableCache(), Metrics(journal), **defaults)
+    broker.cache.get_or_build(spec_cache_key(SPEC, 2), lambda: solver2)
+    return broker, journal
+
+
+# ---------------------------------------------------------------------------
+# deadline propagation
+# ---------------------------------------------------------------------------
+
+def test_expired_in_queue_answered_without_solve(tmp_path, solver2):
+    """A request whose whole budget elapses while it waits behind a
+    held straggler is answered ``deadline_exceeded`` at the next phase
+    boundary WITHOUT burning a solve — the straggler itself (no
+    deadline) still completes normally."""
+    broker, _ = _broker(tmp_path, solver2)
+    hook = HeldSolveHook(hold=1, timeout_s=30.0)
+    prev = install_fault_hook(hook)
+    try:
+        a = broker.submit(SPEC, scale=1.0)
+        t_end = time.monotonic() + 5
+        while not hook.held and time.monotonic() < t_end:
+            time.sleep(0.005)
+        assert hook.held == 1  # a's execution started (and blocked)
+        c = broker.submit(replace(SPEC, deadline_s=0.25), scale=2.0)
+        time.sleep(0.35)  # c's whole budget burns in the queue
+        hook.release()
+        out_c = broker.wait(c, 30)
+        out_a = broker.wait(a, 30)
+    finally:
+        install_fault_hook(prev)
+        hook.release()
+        broker.shutdown()
+    assert out_a["ok"], out_a
+    assert not out_c["ok"]
+    assert out_c["failure_class"] == "deadline_exceeded"
+    assert out_c["retriable"] is True
+    assert out_c["controller"]["decision"] == "expired_in_queue"
+    assert out_c["controller"]["over_s"] > 0
+    # only the straggler ever reached the solver: the expired request
+    # was answered from the screen, not computed-then-discarded
+    assert hook.held == 1
+    snap = broker.metrics.snapshot()
+    assert snap["deadline_exceeded_early"] == 1
+    assert snap["deadline_exceeded_late"] == 0
+
+
+def test_predictive_shed_journals_decision_and_replays(tmp_path, solver2):
+    """Predictive admission: with warm latency windows, a request whose
+    predicted completion exceeds its budget is refused at submit —
+    before the WAL record — with the prediction inputs journaled so the
+    decision recomputes from the serve_shed line alone, and the journal
+    fold reproduces the early-shed count."""
+    broker, journal = _broker(tmp_path, solver2, name="PREDICT.jsonl")
+    try:
+        for s in (1.0, 2.0, 3.0, 4.0):  # >= _PREDICT_MIN_SAMPLES
+            out = broker.wait(broker.submit(SPEC, scale=s), 60)
+            assert out["ok"], out
+        with pytest.raises(QueueFull) as ei:
+            broker.submit(replace(SPEC, deadline_s=1e-4))
+    finally:
+        broker.shutdown()
+    exc = ei.value
+    assert exc.failure_class == "deadline_exceeded"
+    assert exc.retry_after_s is not None and exc.retry_after_s > 0
+    records, corrupt = read_records(journal)
+    assert not corrupt
+    sheds = [r for r in records if r.get("event") == "serve_shed"]
+    assert len(sheds) == 1
+    assert sheds[0]["failure_class"] == "deadline_exceeded"
+    assert sheds[0]["retry_after_s"] > 0
+    ctl = sheds[0]["controller"]
+    assert ctl["decision"] == "predictive_shed"
+    assert ctl["prediction"]["samples"] >= 4
+    # the journaled inputs alone reproduce the verdict
+    recomputed = ctl["queue_wait_s"] + ctl["prediction"]["p95_s"]
+    assert abs(recomputed - ctl["predicted_s"]) < 1e-3
+    assert ctl["predicted_s"] > ctl["deadline_s"]
+    fold = replay_serve(journal)
+    assert fold["deadline_exceeded_early"] == 1
+    snap = broker.metrics.snapshot()
+    assert snap["deadline_exceeded_early"] == 1
+    assert snap["deadline_exceeded_late"] == 0
+
+
+# ---------------------------------------------------------------------------
+# hedged dispatch: the claim CAS is the exactly-once proof
+# ---------------------------------------------------------------------------
+
+def test_hedge_pair_claim_race_exactly_once(tmp_path):
+    """A hedge pair is the SAME PendingRequest on two lanes — force the
+    retire race both lanes' responders can hit and pin the claim CAS:
+    exactly one winner per round, exactly one serve_response per id in
+    the shared journal, and hedge-win attribution ONLY when the
+    speculative destination lane won."""
+    journal = str(tmp_path / "RACE.jsonl")
+    kw = dict(queue_max=8, nrhs_max=2, window_s=0.02, solve_timeout_s=10.0)
+    b0 = Broker(ExecutableCache(), Metrics(journal, device="dev0"), **kw)
+    b1 = Broker(ExecutableCache(), Metrics(journal, device="dev1"), **kw)
+    rounds, wins_dev1 = 25, 0
+    try:
+        for i in range(rounds):
+            p = PendingRequest(f"race{i}", SPEC, 1.0, time.monotonic())
+            p.hedged = True
+            p.hedge_dst = "dev1"  # the speculative copy's lane
+            barrier = threading.Barrier(2)
+            outcomes = {}
+
+            def retire(name, br, p=p, barrier=barrier, outcomes=outcomes):
+                res = {"ok": True, "id": p.id, "xnorm": 1.0}
+                barrier.wait()
+                outcomes[name] = br._respond(p, res)
+
+            ts = [threading.Thread(target=retire, args=("dev0", b0)),
+                  threading.Thread(target=retire, args=("dev1", b1))]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join()
+            assert sorted(outcomes.values()) == [False, True], outcomes
+            wins_dev1 += int(outcomes["dev1"])
+    finally:
+        b0.shutdown()
+        b1.shutdown()
+    records, corrupt = read_records(journal)
+    assert not corrupt
+    resp_ids = [r["id"] for r in records
+                if r.get("event") == "serve_response"]
+    assert len(resp_ids) == rounds  # one response per race, never two
+    assert len(set(resp_ids)) == rounds
+    won = [r for r in records if r.get("event") == "serve_hedge_won"]
+    assert len(won) == wins_dev1
+    assert all(r["dst"] == "dev1" for r in won)
+    assert b0.metrics.snapshot()["hedge_wins"] == 0
+    assert b1.metrics.snapshot()["hedge_wins"] == wins_dev1
+
+
+@pytest.mark.slow
+@pytest.mark.fleet
+def test_straggler_lane_hedge_rescue_e2e(tmp_path):
+    """Live two-lane rescue: a request queued behind a held straggler
+    is hedged onto the healthy lane after the fixed delay override,
+    answered there while its home lane is still blocked, and the whole
+    journal stays exactly-once (the hedge is the same request object —
+    no second WAL record exists to duplicate)."""
+    journal = str(tmp_path / "HEDGE.jsonl")
+    fleet = FleetDispatcher(2, journal_path=journal, queue_max=32,
+                            nrhs_max=2, window_s=0.02,
+                            solve_timeout_s=60.0, balance_interval_s=0,
+                            hedge=True, hedge_budget=1.0,
+                            hedge_delay_s=0.05)
+    hook = HeldSolveHook(hold=1, timeout_s=30.0)
+    try:
+        fleet.warmup([SPEC])
+        for s in (1.0, 2.0):
+            assert fleet.wait(fleet.submit(SPEC, scale=s), 60)["ok"]
+        prev = install_fault_hook(hook)
+        try:
+            a = fleet.submit(SPEC, scale=3.0)  # held mid-execution
+            t_end = time.monotonic() + 5
+            while not hook.held and time.monotonic() < t_end:
+                time.sleep(0.005)
+            assert hook.held == 1
+            b = fleet.submit(SPEC, scale=4.0)  # affinity: same lane
+            time.sleep(0.12)  # > the 50 ms hedge delay override
+            assert fleet.hedge_scan() == 1
+            out_b = fleet.wait(b, 30)
+            assert out_b["ok"], out_b  # rescued on the second lane
+            hook.release()
+            out_a = fleet.wait(a, 30)
+            assert out_a["ok"], out_a
+        finally:
+            install_fault_hook(prev)
+            hook.release()
+    finally:
+        fleet.shutdown()
+    snap = fleet.metrics_snapshot()
+    assert snap["hedge_wins"] >= 1
+    assert snap["fleet"]["hedges_fired"] == 1
+    assert snap["deadline_exceeded_late"] == 0
+    ledger = verify_exactly_once(journal)
+    assert ledger["ok"], ledger
+    kinds = {r.get("event") for r in read_records(journal)[0]}
+    assert "serve_hedge_fired" in kinds
+    assert "serve_hedge_won" in kinds
+
+
+# ---------------------------------------------------------------------------
+# brownout ladder
+# ---------------------------------------------------------------------------
+
+@pytest.mark.fleet
+def test_brownout_ladder_hysteresis_and_degradation(tmp_path):
+    """The ladder state machine on hand-seeded SLO samples and an
+    injected clock: burn past the engage threshold on BOTH windows
+    steps down one registry rung; the hysteresis band holds; clearing
+    BOTH windows below the clear threshold recovers. Transitions
+    journal their burn inputs; the degraded-spec rewrite only touches
+    the ladder's base precision."""
+    journal = str(tmp_path / "BROWN.jsonl")
+    fleet = FleetDispatcher(
+        2, journal_path=journal, balance_interval_s=0,
+        slo_objective_s=0.01, brownout=True,
+        brownout_burn=2.0, brownout_clear_burn=1.0,
+        brownout_windows=((30.0, "fast"), (60.0, "slow")))
+    try:
+        now = time.time()
+
+        def seed(viol, total):
+            # target 0.99 -> budget 0.01: burn = (viol/total) / 0.01
+            m = fleet.lanes[0].metrics
+            with m._lock:
+                m._slo_samples.clear()
+                for i in range(total):
+                    bad = i < viol
+                    m._slo_samples.append(
+                        (now - 1.0, 0.5 if bad else 0.001, not bad))
+
+        assert fleet.brownout_scan(now=now) is None  # no samples: hold
+        seed(10, 200)  # burn 5.0 > 2.0 on both windows
+        assert fleet.brownout_scan(now=now) == "step"
+        degraded, dspec = fleet._brownout_spec(SPEC)
+        assert dspec.precision == "bf16"
+        assert degraded["from"] == "f32" and degraded["to"] == "bf16"
+        assert degraded["level"] == 1 and degraded["reason"]
+        # an explicit high-precision ask is never degraded
+        f64 = replace(SPEC, precision="f64")
+        assert fleet._brownout_spec(f64) == (None, f64)
+        time.sleep(0.02)  # measurable residency
+        seed(3, 200)  # burn 1.5: inside the hysteresis band
+        assert fleet.brownout_scan(now=now) is None
+        seed(1, 200)  # burn 0.5 < 1.0 on both windows
+        assert fleet.brownout_scan(now=now) == "recover"
+        assert fleet._brownout_spec(SPEC) == (None, SPEC)
+        assert fleet.brownout_scan(now=now) is None  # level 0: hold
+        snap = fleet.metrics_snapshot()
+    finally:
+        fleet.shutdown()
+    assert snap["fleet"]["brownout_steps"] == 1
+    assert snap["fleet"]["brownout_recoveries"] == 1
+    bo = snap["fleet"]["brownout"]
+    assert bo["level"] == 0
+    assert bo["ladder"] == ["f32", "bf16"]
+    assert bo["residency_s"] > 0
+    records, corrupt = read_records(journal)
+    assert not corrupt
+    trans = [r for r in records if r.get("event") == "fleet_brownout"]
+    assert [r["action"] for r in trans] == ["step", "recover"]
+    assert trans[0]["from"] == "f32" and trans[0]["to"] == "bf16"
+    assert trans[0]["inputs"]["fast_burn"] == 5.0
+    assert trans[0]["inputs"]["engage_burn"] == 2.0
+    assert trans[1]["inputs"]["fast_burn"] == 0.5
+
+
+# ---------------------------------------------------------------------------
+# the unarmed path is bitwise pre-PR
+# ---------------------------------------------------------------------------
+
+def test_unarmed_path_bitwise_pre_pr(tmp_path, solver2):
+    """A default broker (no deadlines, no hedging, no brownout) emits
+    exactly the pre-PR journal vocabulary and response payloads: no new
+    event kinds, no controller/degraded/retry_after_s/deadline_late
+    keys anywhere, zeroed overload counters, and an unarmed fleet
+    snapshot carries no brownout gauge."""
+    broker, journal = _broker(tmp_path, solver2, name="OFF.jsonl")
+    try:
+        outs = [broker.wait(broker.submit(SPEC, scale=1.0 + i), 60)
+                for i in range(3)]
+    finally:
+        broker.shutdown()
+    assert all(o["ok"] for o in outs)
+    forbidden = {"controller", "degraded", "retry_after_s",
+                 "deadline_late"}
+    for o in outs:
+        assert not (forbidden & o.keys()), o
+    records, corrupt = read_records(journal)
+    assert not corrupt
+    kinds = {r.get("event") for r in records}
+    assert kinds <= PRE_PR_EVENTS, kinds - PRE_PR_EVENTS
+    for r in records:
+        assert not (forbidden & r.keys()), r
+    snap = broker.metrics.snapshot()
+    assert snap["deadline_exceeded_early"] == 0
+    assert snap["deadline_exceeded_late"] == 0
+    assert snap["hedge_wins"] == 0
+    assert snap["hedge_cancels"] == 0
+    fleet = FleetDispatcher(2, balance_interval_s=0)
+    try:
+        fsnap = fleet.metrics_snapshot()
+    finally:
+        fleet.shutdown()
+    assert "brownout" not in fsnap["fleet"]
+    assert fsnap["fleet"]["hedges_fired"] == 0
+
+
+# ---------------------------------------------------------------------------
+# taxonomy + retry-policy pins
+# ---------------------------------------------------------------------------
+
+def test_deadline_taxonomy_disjoint_and_retry_policy():
+    """`deadline_exceeded` is its own class: the broker's lowercase
+    phrasings classify to it, the uppercase gRPC DEADLINE_EXCEEDED
+    transport code stays a tunnel wedge (case-sensitive on both sides),
+    a silent harness deadline kill stays a plain `timeout`, and the
+    retry policy backs off and retries deadline refusals."""
+    assert classify_text(
+        "predicted completion 1.935s exceeds the remaining deadline "
+        "budget 0.300s") == "deadline_exceeded"
+    assert classify_text(
+        "request r7 is past its deadline (0.12s over) at batch "
+        "formation; answered without a solve") == "deadline_exceeded"
+    assert classify_text(
+        '{"failure_class": "deadline_exceeded"}') == "deadline_exceeded"
+    # content outranks the kill reason, as for every other class
+    assert classify_text("request r7 is past its deadline",
+                         timed_out=True) == "deadline_exceeded"
+    # the gRPC transport code in a tunnel probe is NOT a serve deadline
+    assert classify_text(
+        "RPC error: DEADLINE_EXCEEDED while probing the TPU "
+        "tunnel") == "tunnel_wedge"
+    assert classify_text("", timed_out=True) == "timeout"
+    assert classify(None, "", timed_out=True) == "timeout"
+    pol = StagePolicy()
+    assert "deadline_exceeded" in pol.retry_on
+    act = next_action("deadline_exceeded", 1, pol)
+    assert act.kind == RETRY and act.wait_s > 0
+    assert "deadline_exceeded" in RETRIABLE_CLASSES
