@@ -448,6 +448,7 @@ compiles0 = cache.stats()['compiles']
 srv = make_server(broker); host, port = srv.server_address[:2]
 threading.Thread(target=srv.serve_forever, daemon=True).start()
 results = []
+results_lock = threading.Lock()
 def fire(i):
     spec = specs[i % 3]
     body = json.dumps({'degree': spec.degree, 'ndofs': spec.ndofs,
@@ -455,7 +456,9 @@ def fire(i):
     req = urllib.request.Request(f'http://{host}:{port}/solve',
                                  data=body, method='POST')
     with urllib.request.urlopen(req, timeout=120) as r:
-        results.append(json.loads(r.read()))
+        rec = json.loads(r.read())
+    with results_lock:
+        results.append(rec)
 threads = [threading.Thread(target=fire, args=(i,)) for i in range(64)]
 # ramp arrivals: the queue must span solve boundaries so continuous
 # batching has mid-solve work to admit (ISSUE 6 acceptance)

@@ -1,0 +1,61 @@
+"""benchfem-lint: the project-native static contract analyzer.
+
+Eighteen PRs of discipline — registered gate-reason vocabulary,
+additive-only journal schemas, evidence labels, lock-guarded serve
+state — enforced by a pluggable AST engine instead of scattered one-off
+tests and reviewer memory:
+
+  BF-RACE001/002   guarded-by race rules (lock inference + thread-entry
+                   reachability; module-global fan-outs)
+  BF-JRNL001..004  journal event-schema registry vs
+                   LINT_JOURNAL_SCHEMA.json (additive-only)
+  BF-VOCAB001      free-text gate-reason literals
+  BF-CNTR001/002   regress gating tables vs perfgate-emitted counters
+  BF-EVID001/002   provenance labels on evidence stamps
+  BF-JIT001        host constructs inside jit-compiled functions
+  BF-META001       unparsable source
+  BF-BASE001       corrupt baseline (degraded, fail-closed)
+
+    python -m bench_tpu_fem.lint [--json] [--baseline LINT_BASELINE.json]
+                                 [--emit-schema] [paths...]
+
+Library entry: `run_lint(paths)` returns sorted findings;
+`python -m bench_tpu_fem.lint` is the CI gate (exit 1 on any finding
+not matched by the committed baseline).
+"""
+
+from __future__ import annotations
+
+from . import jit_rules, journal_schema, races, vocab  # noqa: F401 (register)
+from .baseline import (  # noqa: F401
+    Baseline,
+    apply_baseline,
+    load_baseline,
+    save_baseline,
+)
+from .engine import (  # noqa: F401
+    LINT_VERSION,
+    RULE_CATALOG,
+    Finding,
+    LintContext,
+    checkers,
+    load_context,
+)
+from .journal_schema import (  # noqa: F401
+    build_schema,
+    extract_sites,
+    load_schema,
+    merge_schema,
+    save_schema,
+)
+
+
+def run_lint(paths: list[str] | None = None, root: str | None = None,
+             schema_path: str = "") -> list[Finding]:
+    """Run every registered rule over `paths` (default: the package +
+    scripts/perfgate.py). Returns findings sorted by path/line/rule."""
+    ctx, findings = load_context(paths, root=root, schema_path=schema_path)
+    for checker in checkers():
+        findings.extend(checker(ctx))
+    findings.sort(key=lambda f: (f.path, f.line, f.rule, f.key))
+    return findings

@@ -1,0 +1,133 @@
+"""CLI: the benchfem-lint gate.
+
+    python -m bench_tpu_fem.lint                          # full tree
+    python -m bench_tpu_fem.lint --baseline LINT_BASELINE.json
+    python -m bench_tpu_fem.lint --json report.json
+    python -m bench_tpu_fem.lint path/to/file.py          # scoped scan
+    python -m bench_tpu_fem.lint --emit-schema            # (re)register
+
+Exit 0 = no findings beyond the committed baseline; 1 otherwise — every
+rc-1 line names rule id + file:line, the perfgate discipline. Scoped
+scans (explicit paths) skip the whole-tree cross-checks (BF-JRNL003
+orphans, BF-CNTR both directions) that only mean something over the
+full package.
+
+--emit-schema regenerates LINT_JOURNAL_SCHEMA.json from the tree,
+merging ADDITIVELY into the committed file: new events/fields land,
+removals are refused with rc 1 (hand-edit the registry in the change
+that retires the consumers, or fix the emitter).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+from . import (
+    RULE_CATALOG,
+    apply_baseline,
+    build_schema,
+    extract_sites,
+    load_baseline,
+    load_context,
+    merge_schema,
+    run_lint,
+    save_schema,
+)
+from .engine import repo_root
+from .journal_schema import SCHEMA_BASENAME
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="python -m bench_tpu_fem.lint")
+    ap.add_argument("paths", nargs="*",
+                    help="files/dirs to scan (default: the package + "
+                         "scripts/perfgate.py; explicit paths disable "
+                         "the whole-tree cross-checks)")
+    ap.add_argument("--baseline", default="", metavar="PATH",
+                    help="LINT_BASELINE.json — findings matching a "
+                         "committed entry are suppressed")
+    ap.add_argument("--json", default="", metavar="PATH",
+                    help="write the machine-readable report here "
+                         "('-' = stdout)")
+    ap.add_argument("--schema", default="", metavar="PATH",
+                    help=f"journal schema registry (default: "
+                         f"<repo>/{SCHEMA_BASENAME})")
+    ap.add_argument("--emit-schema", action="store_true",
+                    help="regenerate the journal schema registry "
+                         "(additive merge; refuses removals)")
+    ap.add_argument("--root", default="", help=argparse.SUPPRESS)
+    args = ap.parse_args(argv)
+
+    root = args.root or repo_root()
+    schema_path = args.schema or os.path.join(root, SCHEMA_BASENAME)
+
+    if args.emit_schema:
+        return _emit_schema(args.paths or None, root, schema_path)
+
+    findings = run_lint(args.paths or None, root=root,
+                        schema_path=schema_path)
+    suppressed, stale = [], []
+    if args.baseline:
+        bl = load_baseline(args.baseline)
+        findings, suppressed, stale = apply_baseline(findings, bl)
+
+    if args.json:
+        report = {
+            "lint_version": 1,
+            "findings": [f.as_dict() for f in findings],
+            "suppressed": [f.as_dict() for f in suppressed],
+            "stale_baseline_keys": stale,
+            "rules": dict(sorted(RULE_CATALOG.items())),
+        }
+        text = json.dumps(report, indent=1, sort_keys=True)
+        if args.json == "-":
+            print(text)
+        else:
+            with open(args.json, "w", encoding="utf-8") as fh:
+                fh.write(text + "\n")
+
+    for f in findings:
+        print(f.render())
+    if suppressed:
+        print(f"benchfem-lint: {len(suppressed)} finding(s) suppressed "
+              f"by baseline {args.baseline}")
+    for key in stale:
+        print(f"benchfem-lint: stale baseline entry (fixed — remove "
+              f"it): {key}")
+    errors = [f for f in findings if f.severity == "error"]
+    warnings = [f for f in findings if f.severity != "error"]
+    print(f"benchfem-lint: {len(errors)} error(s), "
+          f"{len(warnings)} warning(s)"
+          + (" beyond baseline" if args.baseline else ""))
+    return 1 if findings else 0
+
+
+def _emit_schema(paths, root: str, schema_path: str) -> int:
+    from .journal_schema import load_schema
+
+    ctx, findings = load_context(paths, root=root, schema_path=schema_path)
+    sites, unresolved = extract_sites(ctx)
+    for f in findings + unresolved:
+        print(f.render())
+    if unresolved:
+        print("benchfem-lint: refusing to emit a schema over "
+              "unresolvable sites")
+        return 1
+    fresh = build_schema(sites)
+    merged, refusals = merge_schema(load_schema(schema_path), fresh)
+    for r in refusals:
+        print(f"benchfem-lint: schema refusal: {r}")
+    if refusals:
+        return 1
+    save_schema(schema_path, merged)
+    n_ev = len(merged.get("events", {}))
+    print(f"benchfem-lint: {schema_path}: {n_ev} events over "
+          f"{len(sites)} sites")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,105 @@
+"""LINT_BASELINE.json: the zero-NEW-findings gate.
+
+The baseline is the adoption ramp: findings whose stable `key` matches
+a committed entry are suppressed (each entry carries a `why` note — a
+baseline without prose is just a mute button), anything else fails the
+gate. Keys deliberately omit line numbers so unrelated edits do not
+churn the file.
+
+Durability discipline matches the tuning DB: tmp file + flush + fsync +
+`os.replace` + directory fsync on save; a torn/corrupt file DEGRADES to
+an empty baseline (every finding shows as new — fail-closed) plus a
+BF-BASE001 warning naming the corruption, never a crash.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass, field
+
+from .engine import Finding
+
+BASELINE_VERSION = 1
+BASELINE_BASENAME = "LINT_BASELINE.json"
+
+
+@dataclass
+class Baseline:
+    path: str
+    entries: list[dict] = field(default_factory=list)
+    corrupt: str = ""  # non-empty: why the load degraded
+
+    @property
+    def keys(self) -> set[str]:
+        return {e.get("key", "") for e in self.entries}
+
+
+def load_baseline(path: str) -> Baseline:
+    if not os.path.exists(path):
+        return Baseline(path=path)
+    try:
+        with open(path, encoding="utf-8") as fh:
+            data = json.load(fh)
+        if not isinstance(data, dict) or \
+                not isinstance(data.get("entries"), list):
+            raise ValueError("not a baseline object")
+        entries = []
+        for e in data["entries"]:
+            if not isinstance(e, dict) or not e.get("key"):
+                raise ValueError(f"malformed entry: {e!r}")
+            if not e.get("why"):
+                raise ValueError(
+                    f"baseline entry {e.get('key')!r} has no 'why' — "
+                    "a waiver without prose is a mute button")
+            entries.append(e)
+        return Baseline(path=path, entries=entries)
+    except (OSError, ValueError) as exc:
+        return Baseline(path=path, corrupt=str(exc))
+
+
+def save_baseline(baseline: Baseline) -> None:
+    data = {"version": BASELINE_VERSION,
+            "entries": sorted(baseline.entries,
+                              key=lambda e: e.get("key", ""))}
+    tmp = baseline.path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as fh:
+        json.dump(data, fh, indent=1, sort_keys=True)
+        fh.write("\n")
+        fh.flush()
+        os.fsync(fh.fileno())
+    os.replace(tmp, baseline.path)
+    dfd = os.open(os.path.dirname(os.path.abspath(baseline.path)) or ".",
+                  os.O_RDONLY)
+    try:
+        os.fsync(dfd)
+    finally:
+        os.close(dfd)
+
+
+def apply_baseline(findings: list[Finding], baseline: Baseline
+                   ) -> tuple[list[Finding], list[Finding], list[str]]:
+    """(new, suppressed, stale_keys). Stale keys — entries matching no
+    current finding — are reported so the baseline shrinks as fixes
+    land (they never fail the gate: a stale waiver is progress)."""
+    new: list[Finding] = []
+    suppressed: list[Finding] = []
+    keys = baseline.keys
+    if baseline.corrupt:
+        new = list(findings)
+        new.append(Finding(
+            "BF-BASE001", "warning", os.path.basename(baseline.path), 1,
+            f"baseline unreadable ({baseline.corrupt}); degraded to "
+            "empty — every finding gates as new until the file is "
+            "restored",
+            key="BF-BASE001:corrupt"))
+        return new, [], []
+    hit: set[str] = set()
+    for f in findings:
+        if f.key in keys:
+            suppressed.append(f)
+            hit.add(f.key)
+        else:
+            new.append(f)
+    stale = sorted(keys - hit)
+    return new, suppressed, stale

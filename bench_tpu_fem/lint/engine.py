@@ -1,0 +1,329 @@
+"""Core of benchfem-lint: source loading, comment directives, findings.
+
+The engine walks Python sources (plus the agenda's EMBEDDED stage-code
+string constants — `harness/agenda.py` ships thread fan-outs inside
+triple-quoted module constants executed via `_py` stages, and those must
+not dodge the race rules), parses each into an AST, extracts the comment
+map (tokenize-accurate, so string literals containing '#' cannot fake a
+directive), and hands a `LintContext` to every registered rule.
+
+Comment directives (the annotation syntax the README documents):
+
+  # guarded-by: _lock          attribute is protected by self._lock
+                               (attach to the assignment / field line)
+  # lint: thread-entry         this function runs on a worker thread
+                               even though no threading.Thread(target=..)
+                               site names it statically (HTTP handlers,
+                               cache-builder callbacks)
+  # lint: allow(BF-RACE001)    suppress one rule on this line, in place
+                               (prefer a LINT_BASELINE.json entry with a
+                               `why` when the waiver needs prose)
+
+Findings carry a stable `key` (rule + path + semantic anchor, no line
+number) so LINT_BASELINE.json entries survive unrelated line drift.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import os
+import re
+import tokenize
+from dataclasses import dataclass, field
+
+LINT_VERSION = 1
+
+#: rule id -> one-line description (the README's rule catalog renders
+#: from this; --json embeds it so reports are self-describing). Seeded
+#: with the rules the engine/baseline layers emit themselves; checker
+#: modules add theirs at registration.
+RULE_CATALOG: dict[str, str] = {
+    "BF-META001": "source file failed to parse (nothing below can be "
+                  "checked)",
+    "BF-BASE001": "baseline file unreadable — degraded to empty "
+                  "(fail-closed)",
+}
+
+_CHECKERS: list = []
+
+
+def rule(rule_ids: dict[str, str]):
+    """Register a checker function emitting the given rule ids."""
+
+    def deco(fn):
+        RULE_CATALOG.update(rule_ids)
+        _CHECKERS.append(fn)
+        return fn
+
+    return deco
+
+
+def checkers() -> list:
+    return list(_CHECKERS)
+
+
+@dataclass
+class Finding:
+    rule: str
+    severity: str  # "error" | "warning"
+    path: str  # repo-relative display path (may carry ::EMBEDDED)
+    line: int  # 1-based line in the REAL file
+    message: str
+    key: str = ""  # stable baseline identity (no line numbers)
+
+    def __post_init__(self):
+        if not self.key:
+            self.key = f"{self.rule}:{self.path}"
+
+    def as_dict(self) -> dict:
+        return {"rule": self.rule, "severity": self.severity,
+                "path": self.path, "line": self.line,
+                "message": self.message, "key": self.key}
+
+    def render(self) -> str:
+        return (f"{self.path}:{self.line}: {self.rule} "
+                f"{self.severity}: {self.message}")
+
+
+@dataclass
+class Source:
+    """One parsed compilation unit: a real .py file or an embedded
+    stage-code string hoisted out of one."""
+
+    path: str  # display path ("pkg/mod.py" or "pkg/mod.py::NAME")
+    file: str  # the real file on disk
+    text: str
+    tree: ast.Module
+    line_offset: int = 0  # embedded: AST line N is file line N+offset
+    comments: dict[int, str] = field(default_factory=dict)
+
+    def real_line(self, node_or_line) -> int:
+        n = getattr(node_or_line, "lineno", node_or_line)
+        return int(n) + self.line_offset
+
+    def comment(self, lineno: int) -> str:
+        """Comment text on this AST line (source-local numbering)."""
+        return self.comments.get(lineno, "")
+
+
+_ALLOW_RE = re.compile(r"lint:\s*allow\(([A-Z0-9_,\- ]+)\)")
+_GUARDED_RE = re.compile(r"guarded-by:\s*([A-Za-z_][A-Za-z0-9_]*)")
+_ENTRY_RE = re.compile(r"lint:\s*thread-entry")
+
+
+def allow_on(src: Source, node, rule_id: str) -> bool:
+    """True when the node's line (or the line above it) carries a
+    `# lint: allow(RULE)` waiver for this rule."""
+    for ln in (node.lineno, node.lineno - 1):
+        m = _ALLOW_RE.search(src.comment(ln))
+        if m and rule_id in {s.strip() for s in m.group(1).split(",")}:
+            return True
+    return False
+
+
+def guarded_by_annotation(src: Source, lineno: int) -> str | None:
+    m = _GUARDED_RE.search(src.comment(lineno))
+    return m.group(1) if m else None
+
+
+def thread_entry_annotation(src: Source, node) -> bool:
+    for ln in (node.lineno, node.lineno - 1):
+        if _ENTRY_RE.search(src.comment(ln)):
+            return True
+    return False
+
+
+def _comment_map(text: str) -> dict[int, str]:
+    out: dict[int, str] = {}
+    try:
+        for tok in tokenize.generate_tokens(io.StringIO(text).readline):
+            if tok.type == tokenize.COMMENT:
+                out[tok.start[0]] = tok.string
+    except (tokenize.TokenError, IndentationError):
+        pass
+    return out
+
+
+def _embedded_sources(path: str, file: str, tree: ast.Module) -> list[Source]:
+    """Module-level UPPERCASE string constants that parse as Python with
+    at least one import — the agenda's `_py` stage sources. Linted as
+    virtual files `<path>::<NAME>` with line numbers mapped back."""
+    out = []
+    for node in tree.body:
+        if not (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)):
+            continue
+        name = node.targets[0].id
+        if name != name.upper():
+            continue
+        val = node.value
+        if not (isinstance(val, ast.Constant) and isinstance(val.value, str)
+                and "import" in val.value):
+            continue
+        try:
+            subtree = ast.parse(val.value)
+        except SyntaxError:
+            continue  # f-string template / shell text, not stage code
+        if not any(isinstance(n, (ast.Import, ast.ImportFrom))
+                   for n in ast.walk(subtree)):
+            continue
+        out.append(Source(path=f"{path}::{name}", file=file,
+                          text=val.value, tree=subtree,
+                          line_offset=val.lineno - 1,
+                          comments=_comment_map(val.value)))
+    return out
+
+
+def _iter_py_files(path: str):
+    if os.path.isfile(path):
+        yield path
+        return
+    for root, dirs, files in os.walk(path):
+        dirs[:] = sorted(d for d in dirs
+                         if d not in ("__pycache__", ".git"))
+        for fn in sorted(files):
+            if fn.endswith(".py"):
+                yield os.path.join(root, fn)
+
+
+def repo_root() -> str:
+    """The tree the default scan covers: the repo checkout holding the
+    package (parent of bench_tpu_fem/)."""
+    pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return os.path.dirname(pkg)
+
+
+def default_paths(root: str) -> list[str]:
+    """The full-tree scan: the package (minus this linter's own sources
+    and the analysis fixture corpus, both of which stage deliberate
+    violations) plus the perfgate collector (the counter-emission side
+    of the BF-CNTR cross-check)."""
+    out = [os.path.join(root, "bench_tpu_fem")]
+    pg = os.path.join(root, "scripts", "perfgate.py")
+    if os.path.exists(pg):
+        out.append(pg)
+    return out
+
+
+_DEFAULT_EXCLUDE = (os.path.join("bench_tpu_fem", "lint") + os.sep,
+                    os.path.join("bench_tpu_fem", "analysis", "fixtures.py"))
+
+
+@dataclass
+class LintContext:
+    sources: list[Source]
+    root: str
+    full_scan: bool  # default paths -> whole-tree cross-checks armed
+    schema_path: str = ""
+
+    def source_by_suffix(self, suffix: str) -> Source | None:
+        for src in self.sources:
+            if src.path.endswith(suffix):
+                return src
+        return None
+
+
+def load_context(paths: list[str] | None, root: str | None = None,
+                 schema_path: str = "") -> tuple[LintContext, list[Finding]]:
+    root = root or repo_root()
+    full = not paths
+    scan = [os.path.abspath(p) for p in (paths or default_paths(root))]
+    sources: list[Source] = []
+    findings: list[Finding] = []
+    for path in scan:
+        for file in _iter_py_files(path):
+            rel = os.path.relpath(file, root)
+            if full and any(rel.startswith(ex) or rel == ex
+                            for ex in _DEFAULT_EXCLUDE):
+                continue
+            try:
+                with open(file, encoding="utf-8") as fh:
+                    text = fh.read()
+                tree = ast.parse(text)
+            except (OSError, SyntaxError) as exc:
+                findings.append(Finding(
+                    "BF-META001", "error", rel,
+                    getattr(exc, "lineno", 1) or 1,
+                    f"source failed to parse: {exc}",
+                    key=f"BF-META001:{rel}"))
+                continue
+            src = Source(path=rel, file=file, text=text, tree=tree,
+                         comments=_comment_map(text))
+            sources.append(src)
+            sources.extend(_embedded_sources(rel, file, tree))
+    ctx = LintContext(sources=sources, root=root, full_scan=full,
+                      schema_path=schema_path)
+    return ctx, findings
+
+
+# -------------------------------------------------------------------------
+# Shared AST helpers used by more than one rule module.
+
+def dotted_name(node) -> str:
+    """'threading.Lock' for Attribute/Name chains, '' otherwise."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def const_str_keys(d: ast.Dict) -> list[str]:
+    return [k.value for k in d.keys
+            if isinstance(k, ast.Constant) and isinstance(k.value, str)]
+
+
+def resolve_dict_arg(fn_node, call: ast.Call):
+    """Resolve a call's first argument to (ast.Dict, extra_keys, open_).
+
+    Handles the project's two journaling shapes: a literal dict argument,
+    and `rec = {...}; rec["k"] = v; ...; emit(rec)` where later subscript
+    stores contribute OPTIONAL fields. Returns (None, [], False) when
+    the argument cannot be resolved statically.
+    """
+    if not call.args:
+        return None, [], False
+    arg = call.args[0]
+    if isinstance(arg, ast.Dict):
+        return arg, [], any(k is None for k in arg.keys)
+    if not isinstance(arg, ast.Name):
+        return None, [], False
+    target, extra, open_ = None, [], False
+    for node in ast.walk(fn_node):
+        if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id == arg.id
+                and node.lineno < call.lineno):
+            if isinstance(node.value, ast.Dict):
+                target = node.value
+                open_ = any(k is None for k in node.value.keys)
+            else:
+                target, open_ = None, True
+        elif (isinstance(node, ast.Assign)
+                and isinstance(node.targets[0], ast.Subscript)
+                and isinstance(node.targets[0].value, ast.Name)
+                and node.targets[0].value.id == arg.id
+                and node.lineno < call.lineno):
+            sl = node.targets[0].slice
+            if isinstance(sl, ast.Constant) and isinstance(sl.value, str):
+                extra.append(sl.value)
+            else:
+                open_ = True
+    # rec.update(...) makes the field set dynamic
+    for node in ast.walk(fn_node):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in ("update", "setdefault")
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id == arg.id
+                and node.lineno < call.lineno):
+            if node.func.attr == "update":
+                open_ = True
+            elif node.args and isinstance(node.args[0], ast.Constant) \
+                    and isinstance(node.args[0].value, str):
+                extra.append(node.args[0].value)
+    return target, extra, open_

@@ -1,0 +1,153 @@
+"""BF-JIT001: host-side constructs inside jit-compiled functions.
+
+A function decorated with `@jax.jit` (or `@partial(jax.jit, ...)`, or
+wrapped via `f2 = jax.jit(f)`) traces ONCE; host clock reads, `.item()`
+materialization and Python branches on traced arguments either freeze a
+stale value into the executable or abort tracing on hardware after the
+CPU tests passed — the "interpret mode accepted it" failure class, one
+layer up from the Mosaic checks `bench_tpu_fem.analysis` runs.
+
+Flagged inside a jitted function (and its nested helpers):
+  * host clock calls: time.time / time.monotonic / time.perf_counter /
+    time.process_time — a traced clock read is a constant;
+  * `.item()` / `float(tracer)`-style host materialization (`.item()`
+    only: float()/int() casts on scalars are legal on concrete values
+    and the tracer aborts loudly on them anyway);
+  * `if`/`while` tests on a BARE parameter compared to a numeric
+    constant — the classic tracer branch. Parameters named by
+    `static_argnames`/`static_argnums` are exempt (they are Python
+    values at trace time), as are `is None` sentinel checks.
+
+The convergence capture (`obs/convergence.py`) is the reason the rule
+exists: its in-loop residual capture had to be rebuilt jit-safe, and
+nothing but review memory kept host clocks out of the hot loops since.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .engine import Finding, LintContext, allow_on, dotted_name, rule
+
+_CLOCK_CALLS = ("time.time", "time.monotonic", "time.perf_counter",
+                "time.process_time")
+
+
+def _jit_decorated(node) -> tuple[bool, set[str], set[int]]:
+    """(is_jitted, static_argnames, static_argnums) from decorators."""
+    static_names: set[str] = set()
+    static_nums: set[int] = set()
+    jitted = False
+    for dec in node.decorator_list:
+        name = dotted_name(dec)
+        if name.split(".")[-1] == "jit":
+            jitted = True
+            continue
+        if isinstance(dec, ast.Call):
+            fname = dotted_name(dec.func).split(".")[-1]
+            inner = dec.args and dotted_name(dec.args[0]).split(".")[-1]
+            if fname == "jit" or (fname == "partial" and inner == "jit"):
+                jitted = True
+                for kw in dec.keywords:
+                    if kw.arg == "static_argnames":
+                        for leaf in ast.walk(kw.value):
+                            if isinstance(leaf, ast.Constant) and \
+                                    isinstance(leaf.value, str):
+                                static_names.add(leaf.value)
+                    elif kw.arg == "static_argnums":
+                        for leaf in ast.walk(kw.value):
+                            if isinstance(leaf, ast.Constant) and \
+                                    isinstance(leaf.value, int):
+                                static_nums.add(leaf.value)
+    return jitted, static_names, static_nums
+
+
+def _wrapped_defs(tree: ast.Module) -> set[str]:
+    """Names of functions passed through jax.jit(f) somewhere."""
+    out = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and \
+                dotted_name(node.func).split(".")[-1] == "jit" and \
+                node.args and isinstance(node.args[0], ast.Name):
+            out.add(node.args[0].id)
+    return out
+
+
+def _tracer_params(node, static_names: set[str],
+                   static_nums: set[int]) -> set[str]:
+    params = [a.arg for a in node.args.posonlyargs + node.args.args]
+    out = set()
+    for i, p in enumerate(params):
+        if p in ("self", "cls") or p in static_names or i in static_nums:
+            continue
+        out.add(p)
+    return out
+
+
+@rule({
+    "BF-JIT001": "host clock / .item() / tracer branch inside a "
+                 "jit-compiled function",
+})
+def check_jit(ctx: LintContext):
+    findings: list[Finding] = []
+    for src in ctx.sources:
+        wrapped = _wrapped_defs(src.tree)
+        for node in ast.walk(src.tree):
+            if not isinstance(node, (ast.FunctionDef,
+                                     ast.AsyncFunctionDef)):
+                continue
+            jitted, snames, snums = _jit_decorated(node)
+            if not jitted and node.name not in wrapped:
+                continue
+            tracers = _tracer_params(node, snames, snums)
+            findings.extend(_scan_jitted(src, node, tracers))
+    return findings
+
+
+def _scan_jitted(src, fn, tracers: set[str]):
+    findings = []
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            name = dotted_name(node.func)
+            bad = None
+            if name in _CLOCK_CALLS:
+                bad = (f"host clock {name}() traces to a constant — "
+                       "capture timestamps outside the jitted region")
+            elif isinstance(node.func, ast.Attribute) and \
+                    node.func.attr == "item" and not node.args:
+                bad = (".item() forces a host sync inside the traced "
+                       "region — keep reductions on-device and "
+                       "materialize after the jit boundary")
+            if bad and not allow_on(src, node, "BF-JIT001"):
+                findings.append(Finding(
+                    "BF-JIT001", "error", src.path, src.real_line(node),
+                    f"in jitted `{fn.name}`: {bad}",
+                    key=f"BF-JIT001:{src.path}:{fn.name}:"
+                        f"{name or 'item'}"))
+        elif isinstance(node, (ast.If, ast.While)):
+            pname = _tracer_branch(node.test, tracers)
+            if pname and not allow_on(src, node, "BF-JIT001"):
+                findings.append(Finding(
+                    "BF-JIT001", "error", src.path, src.real_line(node),
+                    f"in jitted `{fn.name}`: Python branch on traced "
+                    f"argument '{pname}' — use lax.cond/lax.select, or "
+                    "mark the argument static",
+                    key=f"BF-JIT001:{src.path}:{fn.name}:if-{pname}"))
+    return findings
+
+
+def _tracer_branch(test: ast.AST, tracers: set[str]) -> str | None:
+    """`if x:` / `if x > 0:` on a bare tracer parameter; `is None`
+    sentinel checks are host-legal and skipped."""
+    if isinstance(test, ast.Name) and test.id in tracers:
+        return test.id
+    if isinstance(test, ast.Compare) and \
+            isinstance(test.left, ast.Name) and \
+            test.left.id in tracers and len(test.ops) == 1:
+        if isinstance(test.ops[0], (ast.Is, ast.IsNot)):
+            return None
+        cmp = test.comparators[0]
+        if isinstance(cmp, ast.Constant) and \
+                isinstance(cmp.value, (int, float)):
+            return test.left.id
+    return None

@@ -1,0 +1,349 @@
+"""BF-JRNL: the journal event-schema registry.
+
+Every journaled event in the tree flows through `Journal.append` (the
+harness transport) or `Metrics._journal`/`FleetMetrics._journal` (the
+serve wrappers around it). This module statically extracts every such
+call site's event name + field set and checks them against the committed
+`LINT_JOURNAL_SCHEMA.json` registry:
+
+  BF-JRNL001  a site emits an event or field the registry has never
+              seen (run `python -m bench_tpu_fem.lint --emit-schema`
+              to register it — evolution is additive)
+  BF-JRNL002  a site DROPPED a field the registry lists as required
+              for its event (two sites emitting the same event with
+              incompatible field sets surface as one of them dropping
+              the other's required fields)
+  BF-JRNL003  the registry carries an event no site emits any more —
+              removals are schema edits, never silent code deletions
+              (additive-only evolution; full-tree scans only)
+  BF-JRNL004  a site the extractor cannot resolve statically (dynamic
+              event name, non-literal record) — the coverage self-check
+              that makes "the schema covers every site" a theorem
+              rather than a hope
+
+Per-site field classification: the record literal's constant keys are
+GUARANTEED; later `rec["k"] = ...` stores before the emit are OPTIONAL
+(they are almost always conditional — controller stamps, retry hints);
+`**spread`/`rec.update(...)` marks the site OPEN (extra fields allowed,
+e.g. `serve_phase`'s free-form per-phase payload). The journal envelope
+(`v`/`seq`/`ts` stamped by `Journal.append`, `device` by the metrics
+wrappers) is registered once, not per event.
+
+The registry file itself evolves through `merge_schema`: new events and
+new fields land additively; an event losing a required field or
+vanishing outright is REFUSED at generation time so the committed file
+can only ever grow (the tuning-DB durability discipline applies on
+write: tmp + fsync + os.replace + directory fsync).
+"""
+
+from __future__ import annotations
+
+import ast
+import json
+import os
+
+from .engine import (
+    Finding,
+    LintContext,
+    Source,
+    allow_on,
+    resolve_dict_arg,
+    rule,
+)
+
+SCHEMA_VERSION = 1
+SCHEMA_BASENAME = "LINT_JOURNAL_SCHEMA.json"
+#: fields the transport/wrappers stamp on every record
+ENVELOPE_FIELDS = ("v", "seq", "ts", "device")
+
+#: receivers whose .append IS journalling (vs list.append everywhere)
+_JOURNAL_RECEIVERS = ("journal", "_journal", "jrnl")
+#: the transport itself (stamps the envelope; not an event site)
+_TRANSPORT_SUFFIX = os.path.join("harness", "journal.py")
+
+
+class Site:
+    __slots__ = ("event", "guaranteed", "optional", "open", "src", "line")
+
+    def __init__(self, event, guaranteed, optional, open_, src, line):
+        self.event = event
+        self.guaranteed = frozenset(guaranteed)
+        self.optional = frozenset(optional)
+        self.open = open_
+        self.src = src
+        self.line = line
+
+
+def _is_journal_call(call: ast.Call) -> bool:
+    fn = call.func
+    if not isinstance(fn, ast.Attribute):
+        return False
+    if fn.attr == "_journal":
+        return True
+    if fn.attr != "append":
+        return False
+    recv = fn.value
+    if isinstance(recv, ast.Name):
+        return recv.id in _JOURNAL_RECEIVERS
+    if isinstance(recv, ast.Attribute):
+        return recv.attr in _JOURNAL_RECEIVERS
+    return False
+
+
+def _enclosing_functions(tree: ast.Module):
+    """(scope_node, call) for every journal call; scope is the tightest
+    enclosing def (or the module) — the region variable-assigned
+    records are resolved in."""
+    out = []
+
+    def walk(node, scope):
+        for child in ast.iter_child_nodes(node):
+            child_scope = scope
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                child_scope = child
+            if isinstance(child, ast.Call) and _is_journal_call(child):
+                out.append((child_scope, child))
+            walk(child, child_scope)
+
+    walk(tree, tree)
+    return out
+
+
+def _is_forwarder(scope, call: ast.Call) -> bool:
+    """`def _journal(self, rec): self.journal.append(rec)` — a wrapper
+    forwarding its caller's record to the transport. The real schema
+    sites are its callers (matched through the `_journal` attr), so the
+    forwarder itself is transport, not an event site."""
+    if not isinstance(scope, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        return False
+    if not (call.args and isinstance(call.args[0], ast.Name)):
+        return False
+    params = {a.arg for a in scope.args.posonlyargs + scope.args.args}
+    return call.args[0].id in params
+
+
+def extract_sites(ctx: LintContext) -> tuple[list[Site], list[Finding]]:
+    sites: list[Site] = []
+    unresolved: list[Finding] = []
+    for src in ctx.sources:
+        if src.file.endswith(_TRANSPORT_SUFFIX) and "::" not in src.path:
+            continue
+        for scope, call in _enclosing_functions(src.tree):
+            if call.args and isinstance(call.args[0], ast.Call) and \
+                    isinstance(call.args[0].func, ast.Name) and \
+                    call.args[0].func.id == "error_record":
+                continue  # the taxonomy validator owns that shape
+            d, extra, open_ = resolve_dict_arg(scope, call)
+            if d is None and _is_forwarder(scope, call):
+                continue
+            if d is None:
+                if allow_on(src, call, "BF-JRNL004"):
+                    continue
+                unresolved.append(Finding(
+                    "BF-JRNL004", "error", src.path, src.real_line(call),
+                    "journal emit site not statically resolvable (the "
+                    "schema registry cannot cover it); emit a literal "
+                    "record or annotate `# lint: allow(BF-JRNL004)` "
+                    "with a reason",
+                    key=f"BF-JRNL004:{src.path}:"
+                        f"{getattr(scope, 'name', '<module>')}"))
+                continue
+            event = None
+            guaranteed = []
+            for k, v in zip(d.keys, d.values):
+                if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                    guaranteed.append(k.value)
+                    if k.value == "event" and \
+                            isinstance(v, ast.Constant) and \
+                            isinstance(v.value, str):
+                        event = v.value
+            if "event" not in guaranteed:
+                continue  # not an event record (stage bookkeeping etc.)
+            if event is None:
+                if allow_on(src, call, "BF-JRNL004"):
+                    continue
+                unresolved.append(Finding(
+                    "BF-JRNL004", "error", src.path, src.real_line(call),
+                    "journal event name is not a string literal — the "
+                    "registry cannot cover a dynamic event",
+                    key=f"BF-JRNL004:{src.path}:"
+                        f"{getattr(scope, 'name', '<module>')}"))
+                continue
+            sites.append(Site(event, set(guaranteed) - {"event"},
+                              set(extra), open_, src, call.lineno))
+    return sites, unresolved
+
+
+def build_schema(sites: list[Site]) -> dict:
+    """Fold sites into the registry shape: per event, required = fields
+    every site guarantees, optional = everything else any site may
+    stamp, open = any site sprays dynamic fields."""
+    events: dict[str, dict] = {}
+    for s in sites:
+        ev = events.setdefault(s.event, {"required": None,
+                                         "optional": set(), "open": False})
+        req = set(s.guaranteed)
+        ev["required"] = req if ev["required"] is None \
+            else ev["required"] & req
+        ev["optional"] |= s.guaranteed | s.optional
+        ev["open"] = ev["open"] or s.open
+    out = {}
+    for name, ev in sorted(events.items()):
+        req = sorted(ev["required"] or ())
+        opt = sorted(ev["optional"] - set(req))
+        entry = {"required": req, "optional": opt}
+        if ev["open"]:
+            entry["open"] = True
+        out[name] = entry
+    return {"version": SCHEMA_VERSION,
+            "envelope": list(ENVELOPE_FIELDS),
+            "events": out}
+
+
+def load_schema(path: str) -> dict | None:
+    try:
+        with open(path, encoding="utf-8") as fh:
+            data = json.load(fh)
+    except (OSError, ValueError):
+        return None
+    if not isinstance(data, dict) or "events" not in data:
+        return None
+    return data
+
+
+def save_schema(path: str, schema: dict) -> None:
+    """Tuning-DB durability discipline: tmp + fsync + atomic replace +
+    directory fsync, so a torn write can never half-update the
+    committed registry."""
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as fh:
+        json.dump(schema, fh, indent=1, sort_keys=True)
+        fh.write("\n")
+        fh.flush()
+        os.fsync(fh.fileno())
+    os.replace(tmp, path)
+    dfd = os.open(os.path.dirname(os.path.abspath(path)) or ".",
+                  os.O_RDONLY)
+    try:
+        os.fsync(dfd)
+    finally:
+        os.close(dfd)
+
+
+def merge_schema(old: dict | None, new: dict) -> tuple[dict, list[str]]:
+    """Additive-only evolution. Returns (merged, refusals): events and
+    fields may be ADDED; an event present in `old` but absent from
+    `new`, or a required field the new tree no longer guarantees, is a
+    refusal — the generation step fails rather than silently shrinking
+    the registry."""
+    if not old:
+        return new, []
+    refusals: list[str] = []
+    merged_events: dict[str, dict] = {}
+    old_events = old.get("events", {})
+    new_events = new.get("events", {})
+    for name in sorted(set(old_events) | set(new_events)):
+        o, n = old_events.get(name), new_events.get(name)
+        if n is None:
+            refusals.append(
+                f"event '{name}' is registered but the tree no longer "
+                "emits it (removal is a hand edit, not a regeneration)")
+            merged_events[name] = o
+            continue
+        if o is None:
+            merged_events[name] = n
+            continue
+        lost = sorted(set(o.get("required", ())) - set(n["required"]))
+        if lost:
+            refusals.append(
+                f"event '{name}' dropped required field(s) "
+                f"{', '.join(lost)} — journal consumers replay old "
+                "rounds; required fields only grow")
+        req = sorted(set(o.get("required", ())) | set())
+        opt = sorted((set(o.get("optional", ())) | set(n["required"])
+                      | set(n["optional"])) - set(req))
+        entry = {"required": req, "optional": opt}
+        if o.get("open") or n.get("open"):
+            entry["open"] = True
+        merged_events[name] = entry
+    return {"version": SCHEMA_VERSION,
+            "envelope": list(ENVELOPE_FIELDS),
+            "events": merged_events}, refusals
+
+
+def _site_findings(site: Site, schema: dict) -> list[Finding]:
+    src: Source = site.src
+    events = schema.get("events", {})
+    entry = events.get(site.event)
+    where = f"{src.path}:{site.line}"
+    node_like = type("N", (), {"lineno": site.line})
+    if allow_on(src, node_like, "BF-JRNL001") or \
+            allow_on(src, node_like, "BF-JRNL002"):
+        return []
+    if entry is None:
+        return [Finding(
+            "BF-JRNL001", "error", src.path, src.real_line(site.line),
+            f"event '{site.event}' is not in the committed "
+            f"{SCHEMA_BASENAME}; run `python -m bench_tpu_fem.lint "
+            "--emit-schema` to register it",
+            key=f"BF-JRNL001:{src.path}:{site.event}")]
+    out: list[Finding] = []
+    missing = sorted(set(entry.get("required", ())) - site.guaranteed)
+    if missing:
+        out.append(Finding(
+            "BF-JRNL002", "error", src.path, src.real_line(site.line),
+            f"event '{site.event}' emitted without required field(s) "
+            f"{', '.join(missing)} (registered required: "
+            f"{', '.join(entry.get('required', ()))}) at {where}",
+            key=f"BF-JRNL002:{src.path}:{site.event}:"
+                + ",".join(missing)))
+    known = set(entry.get("required", ())) | set(entry.get("optional", ())) \
+        | set(ENVELOPE_FIELDS)
+    unknown = sorted((site.guaranteed | site.optional) - known)
+    if unknown:
+        out.append(Finding(
+            "BF-JRNL001", "error", src.path, src.real_line(site.line),
+            f"event '{site.event}' emits unregistered field(s) "
+            f"{', '.join(unknown)}; regenerate the schema "
+            "(additive) with --emit-schema",
+            key=f"BF-JRNL001:{src.path}:{site.event}:"
+                + ",".join(unknown)))
+    return out
+
+
+@rule({
+    "BF-JRNL001": "journal event/field not registered in "
+                  "LINT_JOURNAL_SCHEMA.json",
+    "BF-JRNL002": "journal site drops a field its event registers as "
+                  "required",
+    "BF-JRNL003": "registered journal event no longer emitted anywhere "
+                  "(additive-only schema)",
+    "BF-JRNL004": "journal emit site not statically resolvable "
+                  "(schema-coverage self-check)",
+})
+def check_journal_schema(ctx: LintContext):
+    sites, findings = extract_sites(ctx)
+    schema_path = ctx.schema_path or os.path.join(ctx.root, SCHEMA_BASENAME)
+    schema = load_schema(schema_path)
+    if schema is None:
+        if sites:
+            findings.append(Finding(
+                "BF-JRNL001", "error", SCHEMA_BASENAME, 1,
+                f"committed schema registry missing/unreadable at "
+                f"{schema_path} but the tree journals "
+                f"{len(sites)} event sites; generate it with "
+                "--emit-schema",
+                key="BF-JRNL001:schema-missing"))
+        return findings
+    for site in sites:
+        findings.extend(_site_findings(site, schema))
+    if ctx.full_scan:
+        emitted = {s.event for s in sites}
+        for name in sorted(set(schema.get("events", {})) - emitted):
+            findings.append(Finding(
+                "BF-JRNL003", "error", SCHEMA_BASENAME, 1,
+                f"event '{name}' is registered but no site emits it — "
+                "either restore the emitter or hand-edit the registry "
+                "in the same change that retires its consumers",
+                key=f"BF-JRNL003:{name}"))
+    return findings

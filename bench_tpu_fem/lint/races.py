@@ -1,0 +1,679 @@
+"""BF-RACE: guarded-by race rules.
+
+BF-RACE001 — per-class guarded-attribute analysis. For every class the
+scan sees, the rule (a) collects its lock-like fields (`threading.Lock`
+/ `RLock` / `Condition`, assigned in a method or declared as a dataclass
+`field(default_factory=threading.Lock)`), (b) infers the guarded set of
+each lock as the attributes WRITTEN at least once inside a
+`with self.<lock>:` body anywhere in the class (read-only config that
+merely appears under a lock is not state the lock protects), plus any
+attribute annotated `# guarded-by: <lock>`, then (c) flags reads and
+writes of guarded attributes outside the lock in methods reachable from
+a thread entry point. Entry points are `threading.Thread(target=...)`
+sites anywhere in the scan (worker/balancer loops, disposable solve
+threads, closures handed to Thread) plus functions annotated
+`# lint: thread-entry` (HTTP handler surface, cache-builder callbacks —
+call paths a static graph cannot see). Reachability propagates through
+`self.method()` calls, bare same-module calls, and one level of typed
+attribute calls (`self.metrics.batch()` follows into `Metrics.batch`
+when `__init__` assigned `self.metrics = Metrics(...)`).
+
+Construction is exempt: `__init__`/`__post_init__` and methods whose
+only intra-class callers are exempt methods run before the object is
+published to other threads.
+
+One level of cross-object checking rides the same type inference: a
+read/write of `self.<attr>.<field>` where `<attr>`'s inferred class
+guards `<field>` is flagged unless the access sits inside
+`with self.<attr>.<lock>:` — the shape of the fleet-reads-FleetMetrics
+counters bug this rule was built to catch.
+
+BF-RACE002 — module-scope fan-out: a module-global mutated inside a
+function that a module-level `threading.Thread(target=...)` site starts,
+without holding a module-level lock. This is the agenda stage-code
+shape (`SERVE_SMOKE`'s 64-thread `fire` loop appending to a shared
+list), which the engine lints through the embedded-source extractor.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+
+from .engine import (
+    Finding,
+    LintContext,
+    Source,
+    allow_on,
+    dotted_name,
+    guarded_by_annotation,
+    rule,
+    thread_entry_annotation,
+)
+
+LOCK_FACTORIES = ("Lock", "RLock", "Condition")
+#: method names that mutate their receiver in place
+MUTATORS = frozenset((
+    "append", "extend", "add", "insert", "remove", "discard", "pop",
+    "popleft", "appendleft", "clear", "update", "setdefault",
+    "heappush", "heapreplace", "sort",
+))
+EXEMPT_METHODS = frozenset((
+    "__init__", "__post_init__", "__repr__", "__str__", "__del__",
+))
+
+
+def _is_lock_factory(call: ast.AST) -> bool:
+    if not isinstance(call, ast.Call):
+        return False
+    name = dotted_name(call.func)
+    return name.split(".")[-1] in LOCK_FACTORIES and (
+        "." not in name or name.startswith("threading."))
+
+
+@dataclass
+class Access:
+    attr: str  # "x" for self.x, "metrics.x" for self.metrics.x
+    line: int
+    write: bool
+    held: frozenset  # lock path strings held at the access
+    fn: ast.AST  # enclosing function node
+
+
+@dataclass
+class ClassInfo:
+    name: str
+    src: Source
+    node: ast.ClassDef
+    locks: set[str] = field(default_factory=set)
+    methods: dict[str, ast.AST] = field(default_factory=dict)
+    attr_types: dict[str, str] = field(default_factory=dict)
+    annotated: dict[str, str] = field(default_factory=dict)
+    accesses: list[Access] = field(default_factory=list)
+    # intra-class call sites: (callee name, locks held, caller fn node)
+    calls: list[tuple] = field(default_factory=list)
+    # attr -> lock name it was written under at least once
+    written_under: dict[str, str] = field(default_factory=dict)
+
+    def guard_of(self, attr: str) -> str | None:
+        return self.annotated.get(attr) or self.written_under.get(attr)
+
+
+def _self_attr(node: ast.AST) -> str | None:
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and node.value.id == "self":
+        return node.attr
+    return None
+
+
+def _self_attr2(node: ast.AST) -> str | None:
+    """'metrics.x' for self.metrics.x, else None."""
+    if isinstance(node, ast.Attribute):
+        base = _self_attr(node.value)
+        if base is not None:
+            return f"{base}.{node.attr}"
+    return None
+
+
+class _MethodWalker(ast.NodeVisitor):
+    """Records self-attribute accesses with the lock set held at each,
+    resetting the held set inside nested defs (a closure body runs on
+    whatever thread calls it, not under the locks of its definition
+    site)."""
+
+    def __init__(self, info: ClassInfo, fn: ast.AST):
+        self.info = info
+        self.fn = fn
+        self.held: tuple[str, ...] = ()
+        self._writes: set[int] = set()  # id() of nodes in store context
+
+    # -- lock scopes -----------------------------------------------------
+    def visit_With(self, node: ast.With):
+        added = []
+        for item in node.items:
+            path = dotted_name(item.context_expr)
+            if path.startswith("self."):
+                added.append(path)
+        for expr in (i.context_expr for i in node.items):
+            self.visit(expr)
+        self.held = self.held + tuple(added)
+        for stmt in node.body:
+            self.visit(stmt)
+        if added:
+            self.held = self.held[:len(self.held) - len(added)]
+
+    # -- nested functions: fresh lock context, same recorder -------------
+    def visit_FunctionDef(self, node: ast.FunctionDef):
+        if node is self.fn:
+            self.generic_visit(node)
+            return
+        _MethodWalker(self.info, node).visit(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node: ast.Lambda):
+        outer = self.held
+        self.held = ()
+        self.generic_visit(node)
+        self.held = outer
+
+    # -- access classification -------------------------------------------
+    def _record(self, node: ast.Attribute, write: bool):
+        attr = _self_attr(node) or _self_attr2(node)
+        if attr is None:
+            return
+        self.info.accesses.append(Access(
+            attr=attr, line=node.lineno, write=write,
+            held=frozenset(self.held), fn=self.fn))
+
+    def visit_Assign(self, node: ast.Assign):
+        for tgt in node.targets:
+            self._mark_store(tgt)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign):
+        self._mark_store(node.target)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign):
+        if node.target is not None:
+            self._mark_store(node.target)
+        self.generic_visit(node)
+
+    def visit_Delete(self, node: ast.Delete):
+        for tgt in node.targets:
+            self._mark_store(tgt)
+        self.generic_visit(node)
+
+    def _mark_store(self, tgt: ast.AST):
+        if isinstance(tgt, ast.Attribute):
+            self._writes.add(id(tgt))
+        elif isinstance(tgt, ast.Subscript) and \
+                isinstance(tgt.value, ast.Attribute):
+            self._writes.add(id(tgt.value))
+        elif isinstance(tgt, (ast.Tuple, ast.List)):
+            for el in tgt.elts:
+                self._mark_store(el)
+
+    def visit_Call(self, node: ast.Call):
+        # self.attr.append(...) is a WRITE of self.attr; self.m(...) is
+        # a call edge (not an attribute access of m)
+        fn = node.func
+        if isinstance(fn, ast.Attribute):
+            if fn.attr in MUTATORS and isinstance(fn.value, ast.Attribute):
+                self._record(fn.value, write=True)
+                for arg in list(node.args) + [k.value for k in node.keywords]:
+                    self.visit(arg)
+                return
+            if _self_attr(fn) is not None or _self_attr2(fn) is not None:
+                # method call: skip the func chain, visit args only.
+                # self.m() call sites also feed caller-held-lock
+                # propagation (the called-under-lock helper pattern)
+                if _self_attr(fn) is not None:
+                    self.info.calls.append(
+                        (fn.attr, frozenset(self.held), self.fn))
+                for arg in list(node.args) + [k.value for k in node.keywords]:
+                    self.visit(arg)
+                return
+        self.generic_visit(node)
+
+    def visit_Attribute(self, node: ast.Attribute):
+        attr2 = _self_attr2(node)
+        if attr2 is not None:
+            self._record(node, write=id(node) in self._writes)
+            return  # don't double-record the inner self.attr read
+        if _self_attr(node) is not None:
+            self._record(node, write=id(node) in self._writes)
+            return
+        self.generic_visit(node)
+
+
+def _collect_class(src: Source, node: ast.ClassDef,
+                   class_names: set[str]) -> ClassInfo:
+    info = ClassInfo(name=node.name, src=src, node=node)
+    is_dataclass = any("dataclass" in dotted_name(d) or
+                       (isinstance(d, ast.Call) and
+                        "dataclass" in dotted_name(d.func))
+                       for d in node.decorator_list)
+    for stmt in node.body:
+        if isinstance(stmt, ast.AnnAssign) and \
+                isinstance(stmt.target, ast.Name):
+            name = stmt.target.id
+            ann = ast.unparse(stmt.annotation) if stmt.annotation else ""
+            val = stmt.value
+            if is_dataclass and isinstance(val, ast.Call) and \
+                    dotted_name(val.func).endswith("field"):
+                for kw in val.keywords:
+                    if kw.arg == "default_factory" and \
+                            dotted_name(kw.value).split(".")[-1] \
+                            in LOCK_FACTORIES:
+                        info.locks.add(name)
+            if any(lk in ann for lk in LOCK_FACTORIES):
+                info.locks.add(name)
+            g = guarded_by_annotation(src, stmt.lineno)
+            if g:
+                info.annotated[name] = g
+        elif isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            info.methods[stmt.name] = stmt
+    # lock fields + attribute types + guarded-by comments in methods
+    for meth in info.methods.values():
+        for sub in ast.walk(meth):
+            if isinstance(sub, ast.Assign) and len(sub.targets) == 1:
+                attr = _self_attr(sub.targets[0])
+                if attr is None:
+                    continue
+                if _is_lock_factory(sub.value):
+                    info.locks.add(attr)
+                g = guarded_by_annotation(src, sub.lineno)
+                if g:
+                    info.annotated[attr] = g
+                for cand in _constructor_classes(sub.value):
+                    if cand in class_names:
+                        info.attr_types[attr] = cand
+    return info
+
+
+def _constructor_classes(value: ast.AST):
+    """Class names a `self.x = ...` value may construct: direct calls
+    plus `arg or ClassName(...)` fallbacks."""
+    stack = [value]
+    while stack:
+        v = stack.pop()
+        if isinstance(v, ast.Call):
+            name = dotted_name(v.func).split(".")[-1]
+            if name and name[0].isupper():
+                yield name
+        elif isinstance(v, ast.BoolOp):
+            stack.extend(v.values)
+        elif isinstance(v, ast.IfExp):
+            stack.extend((v.body, v.orelse))
+
+
+def _infer_guards(info: ClassInfo):
+    for acc in info.accesses:
+        if "." in acc.attr or not acc.write:
+            continue
+        for lock_path in acc.held:
+            lock = lock_path[len("self."):]
+            if lock in info.locks and acc.attr not in info.locks:
+                info.written_under.setdefault(acc.attr, lock)
+
+
+# -------------------------------------------------------------------------
+# Thread-entry reachability over a package-wide call graph.
+
+def _fn_index(ctx: LintContext):
+    """(source, class_name|None, fn_node) for every def in the scan,
+    plus name indexes for edge resolution."""
+    fns = []
+    by_class: dict[tuple[str, str], ast.AST] = {}
+    module_fns: dict[tuple[str, str], ast.AST] = {}
+    for src in ctx.sources:
+        in_class: set[int] = set()
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.ClassDef):
+                for stmt in node.body:
+                    if isinstance(stmt,
+                                  (ast.FunctionDef, ast.AsyncFunctionDef)):
+                        by_class[(node.name, stmt.name)] = stmt
+                        # closures inherit the enclosing class — their
+                        # bodies reference the method's `self`
+                        for sub in ast.walk(stmt):
+                            if isinstance(sub, (ast.FunctionDef,
+                                                ast.AsyncFunctionDef)):
+                                fns.append((src, node.name, sub))
+                                in_class.add(id(sub))
+        for node in src.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                module_fns[(src.path, node.name)] = node
+        # nested defs (closures) outside classes get their own nodes
+        for node in ast.walk(src.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and id(node) not in in_class:
+                fns.append((src, None, node))
+    # dedupe by node identity
+    seen, out = set(), []
+    for rec in fns:
+        if id(rec[2]) not in seen:
+            seen.add(id(rec[2]))
+            out.append(rec)
+    return out, by_class, module_fns
+
+
+def _thread_targets(src: Source, fn_node: ast.AST, cls: str | None,
+                    local_defs: dict[str, ast.AST]):
+    """Entry designators found inside one function: ('method', cls, name)
+    or ('node', def_node)."""
+    for node in ast.walk(fn_node):
+        if not (isinstance(node, ast.Call)
+                and dotted_name(node.func).split(".")[-1] == "Thread"):
+            continue
+        for kw in node.keywords:
+            if kw.arg != "target":
+                continue
+            tattr = _self_attr(kw.value)
+            if tattr is not None and cls is not None:
+                yield ("method", cls, tattr)
+            elif isinstance(kw.value, ast.Name):
+                if kw.value.id in local_defs:
+                    yield ("node", local_defs[kw.value.id])
+                else:
+                    yield ("modfn", src.path, kw.value.id)
+
+
+def _reachable_fns(ctx: LintContext, classes: list[ClassInfo]
+                   ) -> tuple[set[int], set[int]]:
+    fns, by_class, module_fns = _fn_index(ctx)
+    attr_types = {(c.name): c.attr_types for c in classes}
+    class_of_fn = {id(f): c for (s, c, f) in fns}
+    src_of_fn = {id(f): s for (s, c, f) in fns}
+
+    def local_defs(fn_node):
+        return {sub.name: sub for sub in ast.walk(fn_node)
+                if sub is not fn_node and isinstance(
+                    sub, (ast.FunctionDef, ast.AsyncFunctionDef))}
+
+    # --- seed entries ---
+    entries: list[ast.AST] = []
+    for src, cls, fn in fns:
+        if thread_entry_annotation(src, fn):
+            entries.append(fn)
+        for tgt in _thread_targets(src, fn, cls, local_defs(fn)):
+            if tgt[0] == "method" and (tgt[1], tgt[2]) in by_class:
+                entries.append(by_class[(tgt[1], tgt[2])])
+            elif tgt[0] == "node":
+                entries.append(tgt[1])
+            elif tgt[0] == "modfn" and (tgt[1], tgt[2]) in module_fns:
+                entries.append(module_fns[(tgt[1], tgt[2])])
+    # module-level Thread(...) sites (embedded stage code)
+    for src in ctx.sources:
+        mdefs = {n.name: n for n in src.tree.body
+                 if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))}
+        fn_ids = {id(sub) for fn in mdefs.values() for sub in ast.walk(fn)}
+        for node in ast.walk(src.tree):
+            if id(node) in fn_ids:
+                continue
+            if isinstance(node, ast.Call) and \
+                    dotted_name(node.func).split(".")[-1] == "Thread":
+                for kw in node.keywords:
+                    if kw.arg == "target" and \
+                            isinstance(kw.value, ast.Name) and \
+                            kw.value.id in mdefs:
+                        entries.append(mdefs[kw.value.id])
+
+    # --- edges ---
+    def edges(fn_node):
+        cls = class_of_fn.get(id(fn_node))
+        src = src_of_fn.get(id(fn_node))
+        ldefs = local_defs(fn_node)
+        for node in ast.walk(fn_node):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            a1 = _self_attr(f)
+            if a1 is not None and cls is not None:
+                tgt = by_class.get((cls, a1))
+                if tgt is not None:
+                    yield tgt
+                continue
+            a2 = _self_attr2(f)
+            if a2 is not None and cls is not None:
+                base, meth = a2.split(".", 1)
+                typ = attr_types.get(cls, {}).get(base)
+                if typ is not None:
+                    tgt = by_class.get((typ, meth))
+                    if tgt is not None:
+                        yield tgt
+                continue
+            if isinstance(f, ast.Name):
+                if f.id in ldefs:
+                    yield ldefs[f.id]
+                elif src is not None and (src.path, f.id) in module_fns:
+                    yield module_fns[(src.path, f.id)]
+                else:
+                    # cross-module bare call: match by name (an
+                    # over-approximation — it can only widen the set of
+                    # methods the rule checks)
+                    for (path, name), tgt in module_fns.items():
+                        if name == f.id:
+                            yield tgt
+
+    reachable: set[int] = set()
+    work = list(entries)
+    while work:
+        fn = work.pop()
+        if id(fn) in reachable:
+            continue
+        reachable.add(id(fn))
+        for tgt in edges(fn):
+            if id(tgt) not in reachable:
+                work.append(tgt)
+    return reachable, {id(e) for e in entries}
+
+
+def _caller_held(info: ClassInfo, method_of_fn: dict[int, str],
+                 entry_ids: set[int]) -> dict[str, frozenset]:
+    """Locks provably held at EVERY intra-class call site of a method —
+    the called-under-lock helper pattern (`Broker._gather` holds `_cv`
+    around `_take_compatible`, which touches `_queue` with no `with` of
+    its own). A method that is itself a thread entry (Thread target or
+    `# lint: thread-entry`) never inherits: it has an unlocked caller
+    the static graph can't see. Fixpoint so helpers of helpers inherit
+    transitively; the sets only grow, so it converges."""
+    held: dict[str, frozenset] = {}
+    for _ in range(len(info.methods) + 1):
+        changed = False
+        sites: dict[str, list[frozenset]] = {}
+        for callee, h, fn in info.calls:
+            if callee not in info.methods:
+                continue
+            caller = method_of_fn.get(id(fn))
+            eff = h | held.get(caller, frozenset())
+            sites.setdefault(callee, []).append(eff)
+        for m, hs in sites.items():
+            if id(info.methods[m]) in entry_ids:
+                continue
+            common = frozenset.intersection(*hs)
+            if common != held.get(m, frozenset()):
+                held[m] = common
+                changed = True
+        if not changed:
+            break
+    return held
+
+
+def _construction_only(info: ClassInfo) -> set[str]:
+    """Methods only ever called (intra-class) from exempt methods —
+    the `__init__ -> _load -> _count_corrupt` chains run before the
+    object escapes to other threads."""
+    callers: dict[str, set[str]] = {m: set() for m in info.methods}
+    for mname, meth in info.methods.items():
+        for node in ast.walk(meth):
+            if isinstance(node, ast.Call):
+                callee = _self_attr(node.func)
+                if callee in callers:
+                    callers[callee].add(mname)
+    exempt = set(EXEMPT_METHODS)
+    changed = True
+    while changed:
+        changed = False
+        for m, cs in callers.items():
+            if m in exempt or not cs:
+                continue
+            if all(c in exempt for c in cs):
+                exempt.add(m)
+                changed = True
+    return exempt - EXEMPT_METHODS | {m for m in info.methods
+                                      if m in EXEMPT_METHODS}
+
+
+@rule({
+    "BF-RACE001": "guarded attribute accessed outside its lock on a "
+                  "thread-reachable path",
+    "BF-RACE002": "module-global mutated in a threading.Thread target "
+                  "without a module-level lock",
+})
+def check_races(ctx: LintContext):
+    classes: list[ClassInfo] = []
+    class_names: set[str] = set()
+    for src in ctx.sources:
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.ClassDef):
+                class_names.add(node.name)
+    for src in ctx.sources:
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.ClassDef):
+                classes.append(_collect_class(src, node, class_names))
+    for info in classes:
+        for meth in info.methods.values():
+            _MethodWalker(info, meth).visit(meth)
+        _infer_guards(info)
+    guards_by_class = {c.name: c for c in classes}
+    reachable, entry_ids = _reachable_fns(ctx, classes)
+
+    findings: list[Finding] = []
+    for info in classes:
+        if not info.locks and not info.annotated:
+            continue
+        exempt = _construction_only(info)
+        method_of_fn = {}
+        for mname, meth in info.methods.items():
+            for sub in ast.walk(meth):
+                if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    method_of_fn[id(sub)] = mname
+        caller_held = _caller_held(info, method_of_fn, entry_ids)
+        for acc in info.accesses:
+            mname = method_of_fn.get(id(acc.fn))
+            if mname in exempt:
+                continue
+            if id(acc.fn) not in reachable:
+                continue
+            # only the method body proper inherits caller-held locks —
+            # a closure defined inside it may run on any thread
+            inherited = caller_held.get(mname, frozenset()) \
+                if acc.fn is info.methods.get(mname) else frozenset()
+            eff_held = acc.held | inherited
+            if "." in acc.attr:
+                base, attr2 = acc.attr.split(".", 1)
+                typ = info.attr_types.get(base)
+                other = guards_by_class.get(typ) if typ else None
+                lock = other.guard_of(attr2) if other else None
+                if lock is None or attr2 in (other.locks if other else ()):
+                    continue
+                need = f"self.{base}.{lock}"
+                if any(h == need for h in eff_held):
+                    continue
+                node_like = type("N", (), {"lineno": acc.line})
+                if allow_on(info.src, node_like, "BF-RACE001"):
+                    continue
+                findings.append(Finding(
+                    "BF-RACE001", "error", info.src.path,
+                    info.src.real_line(acc.line),
+                    f"{typ}.{attr2} is guarded by {typ}.{lock} but "
+                    f"{'written' if acc.write else 'read'} via "
+                    f"self.{base} without holding it "
+                    f"(in {info.name}.{mname}); take the lock or go "
+                    f"through a locked accessor",
+                    key=f"BF-RACE001:{info.src.path}:"
+                        f"{info.name}.{mname}:{typ}.{attr2}"))
+                continue
+            lock = info.guard_of(acc.attr)
+            if lock is None or acc.attr in info.locks:
+                continue
+            if any(h == f"self.{lock}" for h in eff_held):
+                continue
+            node_like = type("N", (), {"lineno": acc.line})
+            if allow_on(info.src, node_like, "BF-RACE001"):
+                continue
+            findings.append(Finding(
+                "BF-RACE001", "error", info.src.path,
+                info.src.real_line(acc.line),
+                f"{info.name}.{acc.attr} is guarded by "
+                f"{info.name}.{lock} but "
+                f"{'written' if acc.write else 'read'} without holding "
+                f"it in {info.name}.{mname} (thread-reachable)",
+                key=f"BF-RACE001:{info.src.path}:"
+                    f"{info.name}.{mname}:{acc.attr}"))
+
+    findings.extend(_check_module_globals(ctx))
+    return findings
+
+
+def _check_module_globals(ctx: LintContext):
+    findings = []
+    for src in ctx.sources:
+        gnames: set[str] = set()
+        glocks: set[str] = set()
+        mdefs: dict[str, ast.AST] = {}
+        for node in src.tree.body:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                mdefs[node.name] = node
+            elif isinstance(node, ast.Assign):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        gnames.add(tgt.id)
+                        if _is_lock_factory(node.value):
+                            glocks.add(tgt.id)
+        if not mdefs:
+            continue
+        fn_ids = {id(sub) for fn in mdefs.values() for sub in ast.walk(fn)}
+        targets: set[str] = set()
+        for node in ast.walk(src.tree):
+            if id(node) in fn_ids:
+                continue
+            if isinstance(node, ast.Call) and \
+                    dotted_name(node.func).split(".")[-1] == "Thread":
+                for kw in node.keywords:
+                    if kw.arg == "target" and \
+                            isinstance(kw.value, ast.Name) and \
+                            kw.value.id in mdefs:
+                        targets.add(kw.value.id)
+        for tname in sorted(targets):
+            findings.extend(_scan_thread_target(
+                src, tname, mdefs[tname], gnames, glocks))
+    return findings
+
+
+def _scan_thread_target(src: Source, tname: str, fn: ast.AST,
+                        gnames: set[str], glocks: set[str]):
+    held_locks: list[str] = []
+    findings = []
+
+    def visit(node):
+        if isinstance(node, ast.With):
+            names = [dotted_name(i.context_expr) for i in node.items]
+            locks = [n for n in names if n in glocks]
+            held_locks.extend(locks)
+            for stmt in node.body:
+                visit(stmt)
+            for _ in locks:
+                held_locks.pop()
+            return
+        mutated = None
+        if isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr in MUTATORS and \
+                isinstance(node.func.value, ast.Name) and \
+                node.func.value.id in gnames:
+            mutated = node.func.value.id
+        elif isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Subscript) and \
+                        isinstance(tgt.value, ast.Name) and \
+                        tgt.value.id in gnames:
+                    mutated = tgt.value.id
+        if mutated is not None and not held_locks and \
+                not allow_on(src, node, "BF-RACE002"):
+            findings.append(Finding(
+                "BF-RACE002", "error", src.path, src.real_line(node),
+                f"thread target {tname}() mutates module-global "
+                f"'{mutated}' without a lock "
+                f"({len(glocks) or 'no'} module-level lock(s) "
+                f"declared); wrap the mutation in `with <lock>:`",
+                key=f"BF-RACE002:{src.path}:{tname}:{mutated}"))
+        for child in ast.iter_child_nodes(node):
+            visit(child)
+
+    for stmt in fn.body:
+        visit(stmt)
+    return findings

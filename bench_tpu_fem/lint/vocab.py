@@ -1,0 +1,245 @@
+"""BF-VOCAB / BF-CNTR / BF-EVID: vocabulary and evidence hygiene.
+
+BF-VOCAB001 — free-text gate-reason literals. Gate/fallback reasons are
+a registered vocabulary (`engines/registry.py:GATE_REASONS`, rendered
+through `gate_reason(slug, **fmt)`), so downstream reports can group by
+reason and the analysis tests can pin the set. A plain string literal
+assigned into a `*_gate_reason` / `s_step_fallback_reason` /
+`f64_df32_fallback_reason` slot bypasses the registry. This generalizes
+(and replaces) the AST sweep that lived in tests/test_engine_registry.py
+— package-wide, same key patterns.
+
+BF-CNTR001/002 — the perfgate counter cross-check, both directions.
+The gating tables in `obs/regress.py` (LOWER_IS_BETTER_COUNTERS,
+HIGHER_IS_BETTER_COUNTERS, CONTRACT_FLAGS, MEASURED_ONLY_COUNTERS) and
+the counters `scripts/perfgate.py` actually collects must agree:
+  * BF-CNTR001: a table references a counter no module emits (the gate
+    can never fire — dead discipline);
+  * BF-CNTR002: perfgate collects a counter no table gates and no
+    registered exemption covers (`ADVISORY_COUNTERS` in obs/regress.py,
+    the label keys `comparable_labels` consumes, the specially-gated
+    `collectives_per_iter`/`iters_to_*` families) — an ungated counter
+    silently drifts, which is exactly what ROADMAP item 7 forbids.
+Both directions run only on full-tree scans (they need both files).
+
+BF-EVID001/002 — evidence provenance labels. Every numeric evidence
+stamp carries a cpu-measured / design-estimate / hardware label
+(`engines/autotune.py:LABELS`, extended by the obs conventions
+cpu-host / cpu-interpret / hardware-armed). BF-EVID001 flags a
+label/evidence/measured value outside the registered stems;
+BF-EVID002 flags a stamp-shaped dict (carries a `score` — the
+autotuner's evidence shape) with no label at all.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from .engine import (
+    Finding,
+    LintContext,
+    allow_on,
+    rule,
+)
+
+# ---- BF-VOCAB001 ---------------------------------------------------------
+
+REASON_KEY_SUFFIXES = ("_gate_reason",)
+REASON_KEYS_EXACT = ("s_step_fallback_reason", "f64_df32_fallback_reason")
+#: the vocabulary's own home may of course assign literals
+_VOCAB_EXEMPT_SUFFIX = "engines/registry.py"
+
+
+def is_reason_key(key: str) -> bool:
+    if key in REASON_KEYS_EXACT:
+        return True
+    return key.endswith(REASON_KEY_SUFFIXES) and key != "engine_fallback_reason"
+
+
+# ---- BF-EVID -------------------------------------------------------------
+
+#: registered provenance stems; composite labels extend a stem with a
+#: parenthesized qualifier ("cpu-measured (time-to-rtol ...)")
+LABEL_STEMS = ("cpu-measured", "design-estimate", "hardware",
+               "cpu-host", "cpu-interpret", "analytic-design-estimate")
+_LABEL_KEYS = ("label", "evidence", "measured")
+
+
+def _label_ok(text: str) -> bool:
+    return any(text == stem or text.startswith(stem + " ")
+               or text.startswith(stem + "-armed")
+               or text.startswith(stem + " (")
+               for stem in LABEL_STEMS)
+
+
+def _label_leaves(value: ast.AST):
+    """String-constant leaves of a label expression (IfExp branches,
+    BoolOp fallbacks). Dynamic parts yield nothing — runtime contracts
+    (autotune put()'s LABELS check) own those."""
+    stack = [value]
+    while stack:
+        v = stack.pop()
+        if isinstance(v, ast.Constant) and isinstance(v.value, str):
+            yield v
+        elif isinstance(v, ast.IfExp):
+            stack.extend((v.body, v.orelse))
+        elif isinstance(v, ast.BoolOp):
+            stack.extend(v.values)
+
+
+@rule({
+    "BF-VOCAB001": "free-text gate-reason literal outside "
+                   "engines/registry.py:GATE_REASONS",
+    "BF-EVID001": "provenance label outside the registered "
+                  "cpu-measured/design-estimate/hardware vocabulary",
+    "BF-EVID002": "evidence stamp (score-bearing dict) without a "
+                  "provenance label",
+})
+def check_vocab(ctx: LintContext):
+    findings: list[Finding] = []
+    for src in ctx.sources:
+        exempt_vocab = src.path.replace("\\", "/").endswith(
+            _VOCAB_EXEMPT_SUFFIX)
+        for node in ast.walk(src.tree):
+            # -- reason literals: res.extra["x_gate_reason"] = "text"
+            if isinstance(node, ast.Assign) and not exempt_vocab:
+                for tgt in node.targets:
+                    if not (isinstance(tgt, ast.Subscript)
+                            and isinstance(tgt.slice, ast.Constant)
+                            and isinstance(tgt.slice.value, str)
+                            and is_reason_key(tgt.slice.value)):
+                        continue
+                    if isinstance(node.value, ast.Constant) and \
+                            isinstance(node.value.value, str) and \
+                            not allow_on(src, node, "BF-VOCAB001"):
+                        findings.append(Finding(
+                            "BF-VOCAB001", "error", src.path,
+                            src.real_line(node),
+                            f"free-text reason literal assigned to "
+                            f"'{tgt.slice.value}'; register a slug in "
+                            "GATE_REASONS and render it with "
+                            "gate_reason(...)",
+                            key=f"BF-VOCAB001:{src.path}:"
+                                f"{tgt.slice.value}"))
+            # -- dict-literal reason fields + evidence labels/stamps
+            if isinstance(node, ast.Dict):
+                keys = {}
+                for k, v in zip(node.keys, node.values):
+                    if isinstance(k, ast.Constant) and \
+                            isinstance(k.value, str):
+                        keys[k.value] = v
+                for key, v in keys.items():
+                    if key in _LABEL_KEYS:
+                        for leaf in _label_leaves(v):
+                            if not _label_ok(leaf.value) and \
+                                    not allow_on(src, leaf, "BF-EVID001"):
+                                findings.append(Finding(
+                                    "BF-EVID001", "error", src.path,
+                                    src.real_line(leaf),
+                                    f"'{key}' value "
+                                    f"{leaf.value!r} is outside the "
+                                    "registered provenance stems "
+                                    f"({', '.join(LABEL_STEMS)})",
+                                    key=f"BF-EVID001:{src.path}:"
+                                        f"{leaf.value}"))
+                has_spread = len(node.keys) != len(keys)
+                if "score" in keys and not has_spread and \
+                        not any(k in keys for k in _LABEL_KEYS) and \
+                        not allow_on(src, node, "BF-EVID002"):
+                    # a **spread may carry the label — skip those
+                    findings.append(Finding(
+                        "BF-EVID002", "error", src.path,
+                        src.real_line(node),
+                        "score-bearing evidence stamp has no "
+                        "label/evidence field — numbers carry their "
+                        "provenance (cpu-measured / design-estimate / "
+                        "hardware)",
+                        key=f"BF-EVID002:{src.path}:"
+                            + ",".join(sorted(keys))))
+    findings.extend(_check_counters(ctx))
+    return findings
+
+
+# ---- BF-CNTR -------------------------------------------------------------
+
+_TABLE_NAMES = ("LOWER_IS_BETTER_COUNTERS", "HIGHER_IS_BETTER_COUNTERS",
+                "CONTRACT_FLAGS", "MEASURED_ONLY_COUNTERS")
+_ADVISORY_NAME = "ADVISORY_COUNTERS"
+#: gated by dedicated gate_counters logic rather than the tables
+_SPECIALLY_GATED = ("collectives_per_iter",)
+#: configuration-identity labels comparable_labels() consumes
+_LABEL_COUNTERS = ("precond_label", "s_step_label")
+
+
+def _tuple_of_strs(node: ast.AST) -> list[str] | None:
+    if isinstance(node, (ast.Tuple, ast.List)):
+        out = []
+        for el in node.elts:
+            if isinstance(el, ast.Constant) and isinstance(el.value, str):
+                out.append(el.value)
+        return out
+    return None
+
+
+def _check_counters(ctx: LintContext):
+    regress = ctx.source_by_suffix("obs/regress.py") or \
+        ctx.source_by_suffix("obs\\regress.py")
+    perfgate = ctx.source_by_suffix("perfgate.py")
+    if not ctx.full_scan or regress is None or perfgate is None:
+        return []
+    tables: dict[str, list[str]] = {}
+    advisory: list[str] = []
+    for node in regress.tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name):
+            name = node.targets[0].id
+            vals = _tuple_of_strs(node.value)
+            if vals is None:
+                continue
+            if name in _TABLE_NAMES:
+                tables[name] = vals
+            elif name == _ADVISORY_NAME:
+                advisory = vals
+    gated = {c for vals in tables.values() for c in vals}
+    counters_keys: list[str] = []
+    counters_line = 1
+    for node in ast.walk(perfgate.tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 and \
+                isinstance(node.targets[0], ast.Name) and \
+                node.targets[0].id == "counters" and \
+                isinstance(node.value, ast.Dict):
+            counters_line = node.lineno
+            for k in node.value.keys:
+                if isinstance(k, ast.Constant) and isinstance(k.value, str):
+                    counters_keys.append(k.value)
+    # every string constant anywhere else in the scan = emission evidence
+    emitted: set[str] = set(counters_keys)
+    for src in ctx.sources:
+        if src is regress:
+            continue
+        for node in ast.walk(src.tree):
+            if isinstance(node, ast.Constant) and \
+                    isinstance(node.value, str):
+                emitted.add(node.value)
+    findings = []
+    for tname, vals in sorted(tables.items()):
+        for counter in vals:
+            if counter not in emitted:
+                findings.append(Finding(
+                    "BF-CNTR001", "error", regress.path, 1,
+                    f"{tname} gates '{counter}' but no module emits "
+                    "it — the gate can never fire; drop the row or "
+                    "restore the emitter",
+                    key=f"BF-CNTR001:{counter}"))
+    ungated_ok = gated | set(advisory) | set(_SPECIALLY_GATED) \
+        | set(_LABEL_COUNTERS)
+    for counter in counters_keys:
+        if counter in ungated_ok or counter.startswith("iters_to_"):
+            continue
+        findings.append(Finding(
+            "BF-CNTR002", "error", perfgate.path, counters_line,
+            f"perfgate collects '{counter}' but no obs/regress.py "
+            "table gates it and ADVISORY_COUNTERS does not exempt it "
+            "— stamp, label, gate (ROADMAP item 7)",
+            key=f"BF-CNTR002:{counter}"))
+    return findings

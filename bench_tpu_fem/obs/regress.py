@@ -410,6 +410,10 @@ HIGHER_IS_BETTER_COUNTERS = (
     # overload controller (the suppressed-brownout CI probe injects
     # exactly that)
     "deadline_exceeded_early", "hedge_wins", "brownout_steps",
+    # ... and, once browned out, the hysteresis band must keep stepping
+    # the fleet back UP when the burn clears — a recovery count of zero
+    # on the pinned schedule is a ladder stuck at reduced precision
+    "brownout_recoveries",
 )
 #: contract booleans: baseline True -> current must stay True
 CONTRACT_FLAGS = ("record_contract_ok", "trace_valid",
@@ -424,6 +428,15 @@ CONTRACT_FLAGS = ("record_contract_ok", "trace_valid",
 #: contract: a baseline that measured them and a current that reads
 #: None means tracing silently turned off, which DOES gate.
 MEASURED_ONLY_COUNTERS = ("reqtrace_queue_share_p99",)
+
+#: counters collected as experiment INPUTS, not outcomes — they ride in
+#: the snapshot as evidence (how many SDC faults the probe injected) but
+#: no table direction makes sense for them. benchfem-lint's BF-CNTR002
+#: cross-check consumes this registry: a counter perfgate collects must
+#: be gated by a table above, specially gated (collectives_per_iter,
+#: iters_to_*), a configuration label, or registered here — anything
+#: else is silent drift.
+ADVISORY_COUNTERS = ("sdc_injected",)
 
 
 def comparable_labels(current: dict, baseline: dict) -> bool:

@@ -328,8 +328,8 @@ def roofline_stamp(extra: dict, *, degree: int, qmode: int,
         "bound": "bandwidth" if ceil_bw <= ceil_fl else "compute",
         "peaks": peaks,
         "evidence": ("hardware" if on_tpu else
-                     "cpu-run vs chip peaks (placement on the roofline, "
-                     "not a throughput claim)"),
+                     "cpu-measured (vs chip design peaks — placement on "
+                     "the roofline, not a throughput claim)"),
     }
     pc = precond_cost(extra, model, precision)
     if pc is not None:
@@ -366,6 +366,6 @@ def precond_cost(extra: dict, model: dict,
         "applies_per_iter": applies,
         "extra_hbm_bytes_per_dof": round(extra_pd, 2),
         "iter_cost_multiplier": round(1.0 + extra_pd / base_pd, 3),
-        "evidence": "analytic (design estimate; time_to_rtol_s "
+        "evidence": "analytic-design-estimate (time_to_rtol_s "
                     "adjudicates the measured trade)",
     }

@@ -489,9 +489,10 @@ class FleetDispatcher:
                     continue  # no delay evidence: never hedge blind
                 if wait <= delay:
                     continue
-                allowed = max(1, int(self.hedge_budget
-                                     * self.fleet_metrics.routed))
-                if self.fleet_metrics.hedges_fired >= allowed:
+                routed, hedges_fired = \
+                    self.fleet_metrics.hedge_budget_state()
+                allowed = max(1, int(self.hedge_budget * routed))
+                if hedges_fired >= allowed:
                     return fired  # budget spent: end the whole pass
                 others = [ln for ln in healthy if ln is not src
                           and ln.broker.pending_count() < self.queue_max]
@@ -506,8 +507,8 @@ class FleetDispatcher:
                           "delay_source": delay_source,
                           "budget": {
                               "allowed": allowed,
-                              "fired": self.fleet_metrics.hedges_fired,
-                              "routed": self.fleet_metrics.routed,
+                              "fired": hedges_fired,
+                              "routed": routed,
                               "fraction": self.hedge_budget}}
                 if pred is not None:
                     inputs["prediction"] = pred

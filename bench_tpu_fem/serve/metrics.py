@@ -830,6 +830,14 @@ class FleetMetrics:
         with self._lock:
             self.hedges_fired += 1
 
+    def hedge_budget_state(self) -> tuple[int, int]:
+        """(routed, hedges_fired) read under the lock — the hedge
+        controller's budget inputs, taken as one consistent snapshot so
+        the balancer thread never sees a routed/fired pair torn across
+        a concurrent route() or hedge_fired()."""
+        with self._lock:
+            return self.routed, self.hedges_fired
+
     def brownout(self, action: str, level: int, from_precision: str,
                  to_precision: str, inputs: dict) -> None:
         """One brownout-ladder transition (ISSUE 18): ``action`` is

@@ -1,0 +1,21 @@
+"""Fixture for the embedded-source extractor: a module-level UPPERCASE
+string constant holding stage code (the agenda `_py` shape) with the
+SERVE_SMOKE race inside — BF-RACE002 must fire at the virtual path
+`embedded_stage.py::STAGE_SRC` with file-accurate line numbers."""
+
+STAGE_SRC = """
+import threading
+
+hits = []
+
+
+def worker(i):
+    hits.append(i)
+
+
+threads = [threading.Thread(target=worker, args=(i,)) for i in range(4)]
+for t in threads:
+    t.start()
+for t in threads:
+    t.join()
+"""

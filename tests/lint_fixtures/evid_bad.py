@@ -1,0 +1,8 @@
+"""Positive fixture for BF-EVID001/002: a label outside the registered
+provenance stems, and a score-bearing stamp with no label at all."""
+
+
+def stamps():
+    mislabeled = {"score": 1.23, "label": "vibes"}
+    naked = {"score": 2.0, "best": True}
+    return mislabeled, naked

@@ -1,0 +1,12 @@
+"""Negative fixture for BF-EVID001/002: registered stems, composite
+qualifiers, and a **spread that may carry the label downstream."""
+
+
+def stamps(base, on_tpu):
+    measured = {"score": 1.23, "label": "cpu-measured"}
+    composite = {"score": 2.0,
+                 "evidence": "cpu-measured (time-to-rtol, 5 reps)"}
+    branchy = {"score": 3.0,
+               "label": "hardware" if on_tpu else "design-estimate"}
+    spread = {"score": 4.0, **base}
+    return measured, composite, branchy, spread

@@ -1,0 +1,15 @@
+"""Positive fixture for BF-JIT001: host clock, .item() sync, and a
+Python branch on a traced argument inside a jitted function."""
+
+import time
+
+import jax
+
+
+@jax.jit
+def step(x, n):
+    t0 = time.time()
+    if n > 3:
+        x = x + 1
+    r = (x * x).sum().item()
+    return x, t0, r

@@ -1,0 +1,23 @@
+"""Negative fixture for BF-JIT001: static arguments may branch, `is
+None` sentinels are host-legal, and host clocks outside the jitted
+region are fine."""
+
+import time
+from functools import partial
+
+import jax
+
+
+@partial(jax.jit, static_argnames=("n",))
+def step(x, n, y=None):
+    if n > 3:
+        x = x + 1
+    if y is None:
+        y = x
+    return x + y
+
+
+def host_wrapper(x):
+    t0 = time.time()
+    out = step(x, 4)
+    return out, time.time() - t0

@@ -1,0 +1,15 @@
+"""Journal-schema fixture: two sites of one event — a literal record
+and the `rec = {...}; rec["k"] = ...` conditional-field shape. The
+tests pair this file with purpose-built schema registries."""
+
+
+def emit(journal, wall_s):
+    journal.append({"event": "fixture_solve", "id": "r1",
+                    "wall_s": wall_s})
+
+
+def emit_optional(journal, ok):
+    rec = {"event": "fixture_solve", "id": "r2", "wall_s": 0.0}
+    if ok:
+        rec["ok"] = True
+    journal.append(rec)

@@ -1,0 +1,17 @@
+"""Positive fixture for BF-RACE002: module-level thread fan-out
+mutating a shared global with no lock (the SERVE_SMOKE shape)."""
+
+import threading
+
+results = []
+
+
+def fire(i):
+    results.append(i * i)
+
+
+threads = [threading.Thread(target=fire, args=(i,)) for i in range(8)]
+for t in threads:
+    t.start()
+for t in threads:
+    t.join()

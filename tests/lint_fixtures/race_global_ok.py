@@ -1,0 +1,19 @@
+"""Negative fixture for BF-RACE002: the same fan-out with the mutation
+under a module-level lock — zero findings expected."""
+
+import threading
+
+results = []
+results_lock = threading.Lock()
+
+
+def fire(i):
+    with results_lock:
+        results.append(i * i)
+
+
+threads = [threading.Thread(target=fire, args=(i,)) for i in range(8)]
+for t in threads:
+    t.start()
+for t in threads:
+    t.join()

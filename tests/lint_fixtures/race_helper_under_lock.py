@@ -1,0 +1,35 @@
+"""Negative fixture for caller-held-lock propagation: a helper with no
+`with` of its own touches guarded state, but every one of its call
+sites holds the lock (the `Broker._gather` -> `_take_compatible`
+shape). Zero findings expected."""
+
+import threading
+
+
+class Queue:
+    def __init__(self):
+        self._cv = threading.Condition()
+        self._items = []
+        self._t = threading.Thread(target=self._loop, daemon=True)
+
+    def put(self, item):
+        with self._cv:
+            self._items.append(item)
+            self._cv.notify_all()
+
+    def _loop(self):
+        while True:
+            with self._cv:
+                batch = self._drain()
+            if batch:
+                return
+
+    def _drain(self):
+        # no lock here: both callers hold self._cv
+        out = list(self._items)
+        self._items.clear()
+        return out
+
+    def flush(self):
+        with self._cv:
+            return self._drain()

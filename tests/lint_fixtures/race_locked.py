@@ -1,0 +1,25 @@
+"""Negative fixture for BF-RACE001: same shape as race_pr14.py but the
+thread-reachable stamp takes the lock — zero findings expected."""
+
+import threading
+
+
+class RouteTrace:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._ann = {}
+        self._t = threading.Thread(target=self._balancer_loop,
+                                   daemon=True)
+
+    def annotate(self, **kv):
+        with self._lock:
+            for k, v in kv.items():
+                self._ann[k] = v
+
+    def _balancer_loop(self):
+        while True:
+            self.annotate(route="lane0", affinity=True)
+
+    def complete(self, wall_s):
+        with self._lock:
+            self._ann["wall_s"] = wall_s

@@ -1,0 +1,35 @@
+"""The PR 14 route-stamp race, frozen as a lint fixture.
+
+The balancer thread stamps routing metadata through `annotate()` while
+`complete()` (request thread) writes the same dict under the trace
+lock. Pre-fix `annotate()` skipped the lock — BF-RACE001 must fire on
+both stores in its body, forever. Never "fix" this file: it is the
+regression test for the detector, not for the race.
+"""
+
+import threading
+
+
+class RouteTrace:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._ann = {}
+        self._t = threading.Thread(target=self._balancer_loop,
+                                   daemon=True)
+
+    def annotate(self, **kv):
+        # pre-PR14 shape: stamps the shared dict with no lock
+        for k, v in kv.items():
+            self._ann[k] = v
+
+    def _balancer_loop(self):
+        while True:
+            self.annotate(route="lane0", affinity=True)
+
+    def complete(self, wall_s):
+        with self._lock:
+            self._ann["wall_s"] = wall_s
+
+    def snapshot(self):
+        with self._lock:
+            return dict(self._ann)

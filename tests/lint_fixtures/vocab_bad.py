@@ -1,0 +1,7 @@
+"""Positive fixture for BF-VOCAB001: a free-text gate-reason literal
+assigned straight into the stamped-evidence dict."""
+
+
+def stamp(extra):
+    extra["precond_gate_reason"] = "free text nobody registered"
+    extra["s_step_fallback_reason"] = "another loose string"

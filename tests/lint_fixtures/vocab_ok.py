@@ -1,0 +1,12 @@
+"""Negative fixture for BF-VOCAB001: reasons route through the
+registry renderer, and exempted keys carry raw text legally."""
+
+
+def gate_reason(slug, **fmt):
+    return slug.format(**fmt)
+
+
+def stamp(extra, exc):
+    extra["precond_gate_reason"] = gate_reason("precond-unsupported")
+    # exception text is failure taxonomy, not routing vocabulary
+    extra["engine_fallback_reason"] = "raw exception text is fine here"

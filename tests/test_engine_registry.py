@@ -49,32 +49,21 @@ def _is_reason_key(name) -> bool:
 # ---------------------------------------------------------------------------
 
 def test_no_freetext_reason_literals_left_in_source():
-    """AST sweep over the whole package: no stamped gate/fallback reason
-    may be a plain string literal any more — every site routes through
-    GATE_REASONS / gate_reason / a registry-derived constant (satellite
-    a: the ~117 free-text strings are centralized)."""
-    offenders = []
-    for dirpath, _dirnames, filenames in os.walk(PKG_ROOT):
-        for fn in filenames:
-            if not fn.endswith(".py"):
-                continue
-            path = os.path.join(dirpath, fn)
-            with open(path, encoding="utf-8") as fh:
-                tree = ast.parse(fh.read(), filename=path)
-            for node in ast.walk(tree):
-                if not isinstance(node, ast.Assign):
-                    continue
-                for tgt in node.targets:
-                    if not (isinstance(tgt, ast.Subscript)
-                            and isinstance(tgt.slice, ast.Constant)
-                            and _is_reason_key(tgt.slice.value)):
-                        continue
-                    v = node.value
-                    if isinstance(v, ast.Constant) and isinstance(
-                            v.value, str):
-                        offenders.append(
-                            f"{path}:{node.lineno} "
-                            f"[{tgt.slice.value}] = {v.value[:60]!r}")
+    """The package-wide AST sweep that used to live here migrated to
+    benchfem-lint (BF-VOCAB001 in bench_tpu_fem.lint.vocab) where CI
+    runs it as the lint gate; this is the thin zero-findings assertion
+    plus a key-predicate parity check so the two layers cannot drift."""
+    from bench_tpu_fem.lint import vocab
+    from bench_tpu_fem.lint import run_lint
+
+    # the lint rule and this module's stamped-evidence predicate agree
+    for key in ("x_gate_reason", "s_step_fallback_reason",
+                "f64_df32_fallback_reason", "engine_fallback_reason",
+                "not_a_reason"):
+        assert vocab.is_reason_key(key) == _is_reason_key(key), key
+
+    offenders = [f.render() for f in run_lint()
+                 if f.rule == "BF-VOCAB001"]
     assert not offenders, (
         "free-text reason literals remain (register them in "
         "engines.registry.GATE_REASONS):\n" + "\n".join(offenders))

@@ -1,0 +1,291 @@
+"""benchfem-lint (ISSUE 19): rule fixtures (positive + negative per
+rule, with the PR 14 route-stamp race frozen as the canonical BF-RACE001
+firing), baseline round-trip + torn-file degradation, additive-only
+journal-schema evolution, and the CLI's --json report shape."""
+
+import json
+import os
+
+from bench_tpu_fem.lint import (
+    Baseline,
+    apply_baseline,
+    build_schema,
+    extract_sites,
+    load_baseline,
+    load_context,
+    merge_schema,
+    run_lint,
+    save_baseline,
+    save_schema,
+)
+from bench_tpu_fem.lint.__main__ import main as lint_main
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+FIXTURES = os.path.join(HERE, "lint_fixtures")
+REPO = os.path.dirname(HERE)
+
+
+def _fx(name: str) -> str:
+    return os.path.join(FIXTURES, name)
+
+
+def _lint(name: str, **kw):
+    return run_lint([_fx(name)], **kw)
+
+
+# ---------------------------------------------------------------------------
+# BF-RACE001/002
+# ---------------------------------------------------------------------------
+
+def test_pr14_route_stamp_race_fires():
+    findings = _lint("race_pr14.py")
+    races = [f for f in findings if f.rule == "BF-RACE001"]
+    assert races, [f.render() for f in findings]
+    f = races[0]
+    assert f.path.endswith("race_pr14.py")
+    assert "RouteTrace._ann" in f.message
+    assert "annotate" in f.message
+    assert "_lock" in f.message
+    assert f.severity == "error"
+    # the unlocked stores sit in annotate()'s loop body
+    assert 20 <= f.line <= 26
+    # stable baseline identity: no line numbers in the key
+    assert f.key == ("BF-RACE001:" + f.path
+                     + ":RouteTrace.annotate:_ann")
+
+
+def test_locked_twin_is_clean():
+    assert _lint("race_locked.py") == []
+
+
+def test_helper_called_under_lock_is_clean():
+    # the Broker._gather -> _take_compatible shape: the helper has no
+    # `with` of its own but every call site holds the lock
+    assert _lint("race_helper_under_lock.py") == []
+
+
+def test_module_global_fanout_fires():
+    findings = _lint("race_global_bad.py")
+    assert [f.rule for f in findings] == ["BF-RACE002"]
+    f = findings[0]
+    assert "results" in f.message and "fire" in f.message
+    assert f.key.endswith(":fire:results")
+
+
+def test_module_global_fanout_with_lock_is_clean():
+    assert _lint("race_global_ok.py") == []
+
+
+def test_embedded_stage_source_is_linted():
+    findings = _lint("embedded_stage.py")
+    assert [f.rule for f in findings] == ["BF-RACE002"]
+    f = findings[0]
+    assert f.path.endswith("embedded_stage.py::STAGE_SRC")
+    # line numbers map back into the REAL file: the append sits past
+    # the module docstring and the constant's opening line
+    text = open(_fx("embedded_stage.py")).readlines()
+    assert "hits.append" in text[f.line - 1]
+
+
+# ---------------------------------------------------------------------------
+# BF-VOCAB / BF-EVID / BF-JIT
+# ---------------------------------------------------------------------------
+
+def test_vocab_literal_fires_both_key_shapes():
+    findings = _lint("vocab_bad.py")
+    keys = {f.key.split(":")[-1] for f in findings}
+    assert all(f.rule == "BF-VOCAB001" for f in findings)
+    assert keys == {"precond_gate_reason", "s_step_fallback_reason"}
+
+
+def test_vocab_registry_and_exempt_keys_are_clean():
+    assert _lint("vocab_ok.py") == []
+
+
+def test_evidence_rules_fire():
+    findings = _lint("evid_bad.py")
+    rules = sorted(f.rule for f in findings)
+    assert rules == ["BF-EVID001", "BF-EVID002"]
+    e1 = next(f for f in findings if f.rule == "BF-EVID001")
+    assert "'vibes'" in e1.message
+
+
+def test_evidence_negative_shapes_are_clean():
+    assert _lint("evid_ok.py") == []
+
+
+def test_jit_rules_fire():
+    findings = _lint("jit_bad.py")
+    assert all(f.rule == "BF-JIT001" for f in findings)
+    msgs = " | ".join(f.message for f in findings)
+    assert "time.time" in msgs
+    assert ".item()" in msgs
+    assert "'n'" in msgs  # the tracer branch
+    assert len(findings) == 3
+
+
+def test_jit_static_args_and_sentinels_are_clean():
+    assert _lint("jit_ok.py") == []
+
+
+# ---------------------------------------------------------------------------
+# Journal schema: extraction, gating, additive-only evolution
+# ---------------------------------------------------------------------------
+
+def test_journal_missing_schema_is_a_finding(tmp_path):
+    findings = _lint("journal_emit.py",
+                     schema_path=str(tmp_path / "none.json"))
+    assert [f.key for f in findings] == ["BF-JRNL001:schema-missing"]
+
+
+def test_journal_extraction_and_clean_roundtrip(tmp_path):
+    ctx, errs = load_context([_fx("journal_emit.py")])
+    assert errs == []
+    sites, unresolved = extract_sites(ctx)
+    assert unresolved == []
+    assert len(sites) == 2
+    schema = build_schema(sites)
+    ev = schema["events"]["fixture_solve"]
+    # required = intersection of guaranteed; the conditional
+    # rec["ok"] store is optional
+    assert ev["required"] == ["id", "wall_s"]
+    assert ev["optional"] == ["ok"]
+    path = str(tmp_path / "S.json")
+    save_schema(path, schema)
+    assert _lint("journal_emit.py", schema_path=path) == []
+
+
+def test_journal_dropped_required_field_fires(tmp_path):
+    schema = {"version": 1, "envelope": ["v", "seq", "ts", "device"],
+              "events": {"fixture_solve": {
+                  "required": ["id", "wall_s", "device_id"],
+                  "optional": ["ok"]}}}
+    path = str(tmp_path / "S.json")
+    save_schema(path, schema)
+    findings = _lint("journal_emit.py", schema_path=path)
+    assert findings and all(f.rule == "BF-JRNL002" for f in findings)
+    assert all("device_id" in f.message for f in findings)
+
+
+def test_journal_unregistered_event_and_field_fire(tmp_path):
+    schema = {"version": 1, "envelope": ["v", "seq", "ts", "device"],
+              "events": {"other_event": {"required": [],
+                                         "optional": []}}}
+    path = str(tmp_path / "S.json")
+    save_schema(path, schema)
+    findings = _lint("journal_emit.py", schema_path=path)
+    assert findings and all(f.rule == "BF-JRNL001" for f in findings)
+    assert all("fixture_solve" in f.message for f in findings)
+
+
+def test_merge_schema_is_additive_only():
+    old = {"version": 1, "envelope": ["v"],
+           "events": {"a": {"required": ["x"], "optional": []}}}
+    grown = {"version": 1, "envelope": ["v"],
+             "events": {"a": {"required": ["x", "y"], "optional": ["z"]},
+                        "b": {"required": ["id"], "optional": []}}}
+    merged, refusals = merge_schema(old, grown)
+    assert refusals == []
+    # new events land; required is PINNED to old, new guarantees join
+    # the optional set (promotion to required is a hand edit)
+    assert merged["events"]["a"]["required"] == ["x"]
+    assert merged["events"]["a"]["optional"] == ["y", "z"]
+    assert merged["events"]["b"]["required"] == ["id"]
+
+    dropped_event = {"version": 1, "envelope": ["v"], "events": {}}
+    merged2, refusals2 = merge_schema(old, dropped_event)
+    assert len(refusals2) == 1 and "'a'" in refusals2[0]
+    assert "a" in merged2["events"]  # the registry never shrinks
+
+    dropped_field = {"version": 1, "envelope": ["v"],
+                     "events": {"a": {"required": [], "optional": []}}}
+    merged3, refusals3 = merge_schema(old, dropped_field)
+    assert len(refusals3) == 1 and "x" in refusals3[0]
+    assert merged3["events"]["a"]["required"] == ["x"]
+
+
+# ---------------------------------------------------------------------------
+# Baseline: round-trip, suppression, torn-file degradation
+# ---------------------------------------------------------------------------
+
+def test_baseline_roundtrip(tmp_path):
+    path = str(tmp_path / "b.json")
+    bl = Baseline(path=path, entries=[
+        {"key": "BF-X:a", "why": "waived pending rework"}])
+    save_baseline(bl)
+    bl2 = load_baseline(path)
+    assert bl2.entries == bl.entries
+    assert not bl2.corrupt
+
+
+def test_baseline_suppresses_and_reports_stale(tmp_path):
+    findings = _lint("race_pr14.py")
+    keys = sorted({f.key for f in findings})
+    assert keys
+    bl = Baseline(path=str(tmp_path / "b.json"), entries=[
+        *({"key": k, "why": "frozen detector fixture"} for k in keys),
+        {"key": "BF-X:long-gone", "why": "fixed eons ago"}])
+    new, suppressed, stale = apply_baseline(findings, bl)
+    assert new == []
+    assert sorted({f.key for f in suppressed}) == keys
+    assert stale == ["BF-X:long-gone"]
+
+
+def test_torn_baseline_degrades_fail_closed(tmp_path):
+    path = str(tmp_path / "b.json")
+    with open(path, "w") as fh:
+        fh.write('{"version": 1, "entries": [{"key": "BF-')  # torn
+    bl = load_baseline(path)
+    assert bl.corrupt
+    findings = _lint("race_pr14.py")
+    new, suppressed, stale = apply_baseline(findings, bl)
+    assert suppressed == [] and stale == []
+    assert any(f.rule == "BF-BASE001" for f in new)
+    # every real finding still gates
+    assert {f.key for f in findings} <= {f.key for f in new}
+
+
+def test_baseline_entry_without_why_degrades(tmp_path):
+    path = str(tmp_path / "b.json")
+    with open(path, "w") as fh:
+        json.dump({"version": 1,
+                   "entries": [{"key": "BF-X:a"}]}, fh)
+    bl = load_baseline(path)
+    assert bl.corrupt and "why" in bl.corrupt
+
+
+# ---------------------------------------------------------------------------
+# CLI: exit codes and --json report shape
+# ---------------------------------------------------------------------------
+
+def test_cli_json_shape_and_rc1(tmp_path, capsys):
+    out = str(tmp_path / "report.json")
+    rc = lint_main([_fx("race_pr14.py"), "--json", out])
+    assert rc == 1
+    with open(out) as fh:
+        rep = json.load(fh)
+    assert set(rep) == {"lint_version", "findings", "suppressed",
+                        "stale_baseline_keys", "rules"}
+    assert any(f["rule"] == "BF-RACE001" for f in rep["findings"])
+    f0 = rep["findings"][0]
+    assert set(f0) == {"rule", "severity", "path", "line", "message",
+                      "key"}
+    assert rep["rules"]["BF-RACE001"]
+    text = capsys.readouterr().out
+    assert "BF-RACE001" in text
+    assert "race_pr14.py:" in text  # rc-1 output names file:line
+
+
+def test_cli_clean_fixture_rc0(capsys):
+    rc = lint_main([_fx("race_locked.py")])
+    assert rc == 0
+    assert "0 error(s)" in capsys.readouterr().out
+
+
+def test_cli_committed_tree_gates_clean_with_baseline(capsys):
+    """The acceptance criterion: the committed tree + committed
+    baseline + committed schema exit 0."""
+    rc = lint_main(["--baseline",
+                    os.path.join(REPO, "LINT_BASELINE.json")])
+    out = capsys.readouterr().out
+    assert rc == 0, out

@@ -336,7 +336,7 @@ def test_roofline_stamp_fields_and_measured_peaks(tmp_path):
         root=str(tmp_path))
     assert rl2["peaks"]["evidence"] == "measured:ROOFLINE_DF_r06.json"
     assert rl2["peaks"]["hbm_gbps"] == 700.0
-    assert rl2["evidence"].startswith("cpu-run")
+    assert rl2["evidence"].startswith("cpu-measured")
 
 
 # ---------------------------------------------------------------------------
