@@ -188,6 +188,16 @@ class BenchConfig:
     # gate reasons, never silently. Env default: BENCH_PRECISION.
     precision: str = field(default_factory=lambda: (
         os.environ.get("BENCH_PRECISION", "auto") or "auto"))
+    # Operator zoo (ISSUE 20): which weak form the benchmark runs.
+    # "poisson" (the default) is the flagship path, bit-for-bit the
+    # pre-zoo dispatch. The registry rows (forms.registry.FORMS — mass,
+    # helmholtz, varkappa, heat) run the general sum-factorised form
+    # action (forms.operators) on the single-chip unfused XLA path;
+    # every unsupported feature combination raises or records its
+    # REGISTERED form-* gate reason, never a silent fallback.
+    # Env default: BENCH_FORM.
+    form: str = field(default_factory=lambda: (
+        os.environ.get("BENCH_FORM", "poisson") or "poisson"))
 
 
 @dataclass
@@ -937,6 +947,8 @@ def run_benchmark(cfg: BenchConfig) -> BenchmarkResults:
     prev_x64 = jax.config.jax_enable_x64
     jax.config.update("jax_enable_x64", want_x64)
     try:
+        if cfg.form != "poisson":
+            return _run_benchmark_form(cfg)
         if cfg.precision != "auto":
             return _run_benchmark_bf16(cfg)
         if cfg.float_bits == 64 and cfg.f64_impl == "df32":
@@ -2630,3 +2642,159 @@ def _mat_comp_oracle(cfg, t, dm, bc_grid, b_host, G_host) -> np.ndarray:
         else:
             z = native.csr_spmv(A, u) if use_native else A @ u
     return z.reshape(b_host.shape)
+
+
+def _run_benchmark_form(cfg: BenchConfig) -> BenchmarkResults:
+    """Operator-zoo driver (ISSUE 20): run a forms.registry weak form —
+    mass (L2 projection), helmholtz (stiffness - k^2 mass, the first
+    non-SPD operator in the suite), varkappa (variable-coefficient
+    diffusion), heat (mass + dt stiffness) — through the general
+    sum-factorised form action (forms.operators) on the single-chip
+    unfused XLA path, with the SAME protocol as the flagship driver:
+    AOT compile outside the timed region, operator as a pytree
+    argument, fenced warm-up, and the assembled-CSR oracle behind
+    --mat_comp (fem.assemble.element_form_matrices — full 3D tables,
+    never the 1D factorised chain).
+
+    CG runs always carry the breakdown sentinels (la.cg sentinel=True):
+    helmholtz is genuinely indefinite at the registry shift, and the
+    sentinel counters + failure_class taxonomy are how a breakdown is
+    CLASSIFIED instead of crashing or shipping NaN. Unsupported feature
+    combinations raise (df32/bf16/sharded/batched/backend) or record
+    (checkpoint/s-step/precond) their REGISTERED form-* gate reasons —
+    never a silent fallback."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..fem.assemble import csr_cg_reference, element_form_matrices
+    from ..forms.operators import build_form_operator, kappa_at_quadrature
+    from ..forms.registry import form_spec
+
+    fspec = form_spec(cfg.form)  # unknown form -> ValueError (vocabulary)
+    if cfg.float_bits == 64 and cfg.f64_impl == "df32":
+        raise ValueError(gate_reason("form-df", form=cfg.form))
+    if cfg.precision != "auto":
+        raise ValueError(gate_reason("form-bf16", form=cfg.form))
+    if cfg.ndevices > 1:
+        raise ValueError(gate_reason("form-sharded", form=cfg.form))
+    if cfg.nrhs > 1:
+        raise ValueError(gate_reason("form-batched", form=cfg.form))
+    if cfg.backend not in ("auto", "xla"):
+        raise ValueError(gate_reason("form-backend", form=cfg.form,
+                                     backend=cfg.backend))
+
+    dtype = jnp.float64 if cfg.float_bits == 64 else jnp.float32
+    n, rule, t, mesh = _mesh_setup(cfg)
+    ndofs_global = global_ndofs(n, cfg.degree)
+    res = BenchmarkResults(
+        ncells_global=mesh.ncells, ndofs_global=ndofs_global,
+        nreps=cfg.nreps)
+    res.extra["backend"] = "xla"
+    res.extra["form"] = cfg.form
+    record_engine(res.extra, False)
+    if cfg.checkpoint_every > 0 or cfg.sdc_audit:
+        res.extra["checkpoint_gate_reason"] = gate_reason(
+            "form-checkpoint", form=cfg.form)
+    if cfg.s_step > 1:
+        res.extra["s_step"] = int(cfg.s_step)
+        res.extra["s_step_gate_reason"] = gate_reason("form-sstep",
+                                                      form=cfg.form)
+    if cfg.precond != "none":
+        stamp_precond(res.extra, cfg, gate_reason=(
+            gate_reason("helmholtz-precond") if cfg.form == "helmholtz"
+            else gate_reason("form-precond", form=cfg.form)))
+
+    # Host setup, kept local instead of _setup_problem: the form oracle
+    # needs wdetJ (the mass chain) next to G (the stiffness chain).
+    grid_shape = dof_grid_shape(n, cfg.degree)
+    bc_grid = boundary_dof_marker(n, cfg.degree)
+    with Timer("% Assemble RHS (host)"):
+        coords = dof_coordinates(mesh.vertices, cfg.degree, t.nodes1d)
+        f = default_source(coords).ravel()
+        dm = cell_dofmap(n, cfg.degree)
+        corners = mesh.cell_corners.reshape(-1, 2, 2, 2, 3)
+        bc_flat = bc_grid.ravel()
+        G_host, wdetJ = geometry_factors(
+            corners, t.pts1d, t.wts1d,
+            compute_G=cfg.mat_comp and fspec.grad_coeff != 0.0)
+        b_host = assemble_rhs(t, wdetJ, dm, f, bc_flat).reshape(grid_shape)
+
+    obs = BenchObserver(cfg)
+    with Timer("% Create matfree operator"):
+        op = build_form_operator(mesh, fspec, cfg.degree, cfg.qmode,
+                                 rule, dtype=dtype, tables=t)
+        u = jnp.asarray(b_host, dtype=dtype)
+
+    nreps = cfg.nreps
+    conv = cfg.convergence and cfg.use_cg
+    if cfg.use_cg:
+        def run(A, b, x0):
+            return cg_solve(A.apply, b, x0, nreps, sentinel=True,
+                            capture=conv)
+
+        with obs.phase("compile"):
+            fn = compile_lowered(
+                jax.jit(run).lower(op, u, jnp.zeros_like(u)), None)
+        with obs.phase("transfer"):
+            warm = fn(op, u, jnp.zeros_like(u))
+            _fence_scalar(warm)
+            del warm
+        y = obs.timed_reps(lambda: fn(op, u, jnp.zeros_like(u)))
+    else:
+        def run(A, x):
+            def _rep(i, y):
+                xx, _ = jax.lax.optimization_barrier((x, y))
+                return A.apply(xx)
+
+            return jax.lax.fori_loop(0, nreps, _rep, jnp.zeros_like(x))
+
+        with obs.phase("compile"):
+            fn = compile_lowered(jax.jit(run).lower(op, u), None)
+        with obs.phase("transfer"):
+            warm = fn(op, u)
+            _fence_scalar(warm)
+            del warm
+        y = obs.timed_reps(lambda: fn(op, u))
+    elapsed = obs.elapsed()
+    if cfg.use_cg:
+        y, info = y
+        # the sentinel verdicts are the helmholtz taxonomy evidence:
+        # restarts counted, a non-finite residual freezing the state is
+        # classified `breakdown` below (stamp_breakdown), never NaN out
+        res.extra["cg_sentinel"] = {
+            "breakdown_restarts": int(np.asarray(
+                info["breakdown_restarts"])),
+            "nonfinite": bool(np.asarray(info["nonfinite"])),
+            "stag_max": int(np.asarray(info["stag_max"]))}
+        if conv:
+            stamp_convergence(res.extra, info, wall_s=elapsed,
+                              iters_run=nreps)
+
+    res.mat_free_time = elapsed
+    from ..la.vector import norm, norm_linf
+
+    res.unorm = float(norm(u))
+    res.ynorm = float(norm(y))
+    res.unorm_linf = float(norm_linf(u))
+    res.ynorm_linf = float(norm_linf(y))
+    res.gdof_per_second = ndofs_global * nreps / (1e9 * elapsed)
+    stamp_breakdown(res.extra, res.ynorm)
+    stamp_observability(cfg, res, obs,
+                        "f32" if cfg.float_bits == 32 else "f64")
+
+    if cfg.mat_comp:
+        kq = (kappa_at_quadrature(corners, t.pts1d)
+              if fspec.coefficient == "varkappa" else None)
+        with Timer("% Assemble CSR (oracle)"):
+            elem = element_form_matrices(t, G_host, wdetJ,
+                                         fspec.grad_coeff,
+                                         fspec.mass_coeff, kq=kq)
+            A = assemble_csr(elem, dm, bc_flat)
+        ub = b_host.ravel()
+        with Timer("% CSR Matvec"):
+            z = (csr_cg_reference(A, ub, cfg.nreps) if cfg.use_cg
+                 else A @ ub)
+        e = np.asarray(y, dtype=np.float64).ravel() - z
+        res.znorm = float(np.linalg.norm(z))
+        res.enorm = float(np.linalg.norm(e))
+    return res

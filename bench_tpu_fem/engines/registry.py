@@ -278,6 +278,36 @@ GATE_REASONS: dict[str, str] = {
         "brownout level {level}: sustained SLO burn stepped this request "
         "down the registry precision ladder ({from_p} -> {to_p}); the "
         "response carries degraded provenance until hysteresis clears"),
+    # -- operator-zoo form gates (ISSUE 20) ---------------------------------
+    "form-df": (
+        "the {form} form has no double-float pipeline (df32 composes the "
+        "kron/pallas poisson engines only); use --float 64 native or f32"),
+    "form-sharded": (
+        "the {form} form is single-chip today (no sharded form action); "
+        "run with ndevices=1"),
+    "form-batched": (
+        "driver-side batched multi-RHS (nrhs>1) is poisson-only; the "
+        "{form} form serves batched lanes through the serve layer instead"),
+    "form-backend": (
+        "the {form} form runs the general sum-factorised einsum action; "
+        "--backend {backend} is not supported with it"),
+    "form-checkpoint": (
+        "durable checkpointing/SDC boundary audits are not wired through "
+        "the {form} form's CG loop; snapshots disabled for this run"),
+    "form-sstep": (
+        "s-step CG is poisson-only (the Gram projection assumes the "
+        "flagship SPD operator); running the standard recurrence for the "
+        "{form} form"),
+    "form-precond": (
+        "preconditioning is not wired through the {form} form's CG loop; "
+        "precond disabled for this run"),
+    "helmholtz-precond": (
+        "the helmholtz form is indefinite (stiffness - k^2 mass): the SPD "
+        "preconditioned-CG contract does not hold, precond disabled and "
+        "breakdown taxonomy armed"),
+    "form-bf16": (
+        "the {form} form has no bf16-stream/refinement ladder rung; use "
+        "f32 or f64 precision"),
 }
 
 # Template slugs contain {field} placeholders; everything else is a
@@ -386,13 +416,14 @@ class EngineSpec:
     @staticmethod
     def cache_key(*, degree: int, cell_shape, precision: str, geom: str,
                   engine_form: str, nrhs_bucket: int, device_mesh,
-                  nreps: int = 0):
+                  nreps: int = 0, form: str = "poisson"):
         """serve.cache.ExecutableKey construction — the single helper
         both the bench driver's exec-cache keys and the serve layer's
         cache/artifact keys derive from, so the two key spaces can never
         drift apart structurally (variants are distinguished INSIDE
         engine_form / nrhs_bucket / device_mesh, pinned by the collision
-        test)."""
+        test). `form` is the weak-form axis (ISSUE 20): executables for
+        different registry forms must never alias."""
         from ..serve.cache import ExecutableKey
 
         return ExecutableKey(
@@ -404,6 +435,7 @@ class EngineSpec:
             nrhs_bucket=int(nrhs_bucket),
             device_mesh=tuple(device_mesh),
             nreps=int(nreps),
+            form=str(form),
         )
 
 
@@ -699,6 +731,18 @@ ENGINE_SPECS: tuple[EngineSpec, ...] = (
         notes="mixed-precision iterative refinement / flexible PCG: bf16 "
               "hot-loop applies, hi-precision outer correction to "
               "f64-class rtol (la.refine)"),
+    EngineSpec(
+        name="forms_xla",
+        forms=("unfused",),
+        precision="any", geometry="any", sharding="single",
+        backend="xla", nrhs="1",
+        gate_slugs=("form-df", "form-sharded", "form-batched",
+                    "form-backend", "form-checkpoint", "form-sstep",
+                    "form-precond", "helmholtz-precond", "form-bf16"),
+        notes="operator-zoo weak forms (mass/helmholtz/varkappa/heat): the "
+              "general sum-factorised form action (forms.operators); every "
+              "unsupported form x engine combination stamps one of this "
+              "row's slugs"),
     EngineSpec(
         name="xla_unfused",
         forms=("unfused",),

@@ -14,6 +14,8 @@ from .assemble import (
     assemble_csr,
     assemble_rhs,
     csr_cg_reference,
+    element_form_matrices,
+    element_mass_matrices,
     element_stiffness_matrices,
 )
 from .source import default_source, interpolate
@@ -23,6 +25,8 @@ __all__ = [
     "assemble_csr",
     "assemble_rhs",
     "csr_cg_reference",
+    "element_form_matrices",
+    "element_mass_matrices",
     "element_stiffness_matrices",
     "default_source",
     "interpolate",

@@ -61,6 +61,48 @@ def element_stiffness_matrices(
     return A
 
 
+def element_mass_matrices(
+    tables: OperatorTables, wdetJ: np.ndarray
+) -> np.ndarray:
+    """M_e[c, i, j] = sum_q w*detJ(c, q) Phi_i(q) Phi_j(q).
+
+    The basis-squared counterpart of element_stiffness_matrices: the
+    oracle for the mass form and for the mass term of the shifted forms
+    (helmholtz, heat). wdetJ is the (ncells, nq, nq, nq) tensor from
+    bench_tpu_fem.fem.geometry.geometry_factors.
+    """
+    Phi = _phi_table_3d(tables)
+    w = np.asarray(wdetJ).reshape(np.shape(wdetJ)[0], -1)
+    return np.einsum("qi,cq,qj->cij", Phi, w, Phi, optimize=True)
+
+
+def element_form_matrices(
+    tables: OperatorTables,
+    G: np.ndarray | None,
+    wdetJ: np.ndarray | None,
+    grad_coeff: float,
+    mass_coeff: float,
+    kq: np.ndarray | None = None,
+) -> np.ndarray:
+    """Element matrices for a registry form (forms.registry.FormSpec):
+
+        A_e = grad_coeff * K_e(G_kappa) + mass_coeff * M_e(wdetJ)
+
+    with kappa(x_q) folded into G exactly as the device operator folds
+    it (a pointwise scale of the packed tensor). Chains with a zero
+    coefficient skip their tables entirely, mirroring the kernel's
+    static with_grad/with_mass flags.
+    """
+    A = None
+    if grad_coeff != 0.0:
+        Gk = G if kq is None else G * np.asarray(kq)[:, None]
+        A = grad_coeff * element_stiffness_matrices(tables, Gk, 1.0)
+    if mass_coeff != 0.0:
+        M = mass_coeff * element_mass_matrices(tables, wdetJ)
+        A = M if A is None else A + M
+    return A
+
+
 def assemble_csr(
     element_matrices: np.ndarray, dofmap: np.ndarray, bc_marker_flat: np.ndarray
 ) -> sp.csr_matrix:

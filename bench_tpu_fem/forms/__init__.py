@@ -1,0 +1,33 @@
+"""Operator zoo (ISSUE 20): declarative weak-form registry + the unified
+sum-factorised form action. See forms.registry for the rows and
+forms.operators for the kernel."""
+
+from .operators import (
+    FormOperator,
+    build_form_operator,
+    kappa_at_quadrature,
+)
+from .registry import (
+    FORM_NAMES,
+    FORMS,
+    HEAT_DT,
+    HEAT_RTOL,
+    HELMHOLTZ_KSQ,
+    FormSpec,
+    form_spec,
+    kappa_field,
+)
+
+__all__ = [
+    "FORM_NAMES",
+    "FORMS",
+    "FormOperator",
+    "FormSpec",
+    "HEAT_DT",
+    "HEAT_RTOL",
+    "HELMHOLTZ_KSQ",
+    "build_form_operator",
+    "form_spec",
+    "kappa_at_quadrature",
+    "kappa_field",
+]

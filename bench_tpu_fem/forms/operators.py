@@ -1,0 +1,200 @@
+"""Sum-factorised form actions sharing the ops.laplacian tensor machinery.
+
+One per-cell kernel serves every registry row: it interpolates to
+quadrature points once, then runs up to two independent contraction
+chains on the quadrature values,
+
+    y_q = grad_coeff * D^T (G . D u_q)        (the laplacian chain)
+        + mass_coeff * wdetJ (.) u_q          (the basis-squared chain)
+
+and back-interpolates once. The gradient chain is byte-for-byte the
+einsum sequence of ops.laplacian._sumfact_cell_apply (the Poisson path
+itself is NOT routed here — `form="poisson"` stays on the original
+operator, bitwise-pinned); the mass chain inserts a single diagonal
+scale at the quadrature points, exactly the reference's mass form
+(forms.hpp:23-42) expressed in the same tensors. Chains with a zero
+coefficient are compiled out via static flags, so the mass form never
+touches G and pure-stiffness forms never materialise wdetJ.
+
+Variable-coefficient kappa(x) is sampled at the physical quadrature
+points (trilinear corner map, host-side) and folded into the geometry
+tensor G: G already carries w*adj(J)adj(J)^T/det(J) per quadrature
+point, and kappa enters the integrand as a pointwise scale of exactly
+that tensor. On uniform meshes G is diagonal (G01=G02=G12=0), so the
+fold degenerates to a diagonal rescale of the kron-path factors — the
+perturbed and uniform paths share one code line.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..elements.tables import OperatorTables, build_operator_tables
+from ..mesh.box import BoxMesh
+from ..mesh.dofmap import boundary_dof_marker
+from ..ops.geometry import geometry_factors_jax
+from ..ops.laplacian import fold_cells, gather_cells
+from .registry import FormSpec, form_spec, kappa_field
+
+
+def _form_cell_apply(
+    u: jnp.ndarray,
+    G: jnp.ndarray | None,
+    wdetJ: jnp.ndarray | None,
+    phi0: jnp.ndarray,
+    dphi1: jnp.ndarray,
+    grad_coeff,
+    mass_coeff,
+    is_identity: bool,
+    with_grad: bool,
+    with_mass: bool,
+) -> jnp.ndarray:
+    """Unified per-cell form kernel: (C, nd, nd, nd) -> (C, nd, nd, nd).
+
+    precision=HIGHEST for the same reason as the laplacian kernel: TPU
+    matmuls default to bf16 passes, fatal to the mat_comp oracle
+    contract. The gradient chain mirrors _sumfact_cell_apply exactly;
+    the mass chain rides the shared interpolation, adding one diagonal
+    quadrature-point scale before the shared back-interpolation.
+    """
+    hi = jax.lax.Precision.HIGHEST
+    if not is_identity:
+        u = jnp.einsum("qi,eijk->eqjk", phi0, u, precision=hi)
+        u = jnp.einsum("rj,eqjk->eqrk", phi0, u, precision=hi)
+        u = jnp.einsum("sk,eqrk->eqrs", phi0, u, precision=hi)
+    y = None
+    if with_grad:
+        du0 = jnp.einsum("xi,eijk->exjk", dphi1, u, precision=hi)
+        du1 = jnp.einsum("yj,eijk->eiyk", dphi1, u, precision=hi)
+        du2 = jnp.einsum("zk,eijk->eijz", dphi1, u, precision=hi)
+        G0, G1, G2, G3, G4, G5 = (G[:, c] for c in range(6))
+        f0 = grad_coeff * (G0 * du0 + G1 * du1 + G2 * du2)
+        f1 = grad_coeff * (G1 * du0 + G3 * du1 + G4 * du2)
+        f2 = grad_coeff * (G2 * du0 + G4 * du1 + G5 * du2)
+        y = (
+            jnp.einsum("qi,eqjk->eijk", dphi1, f0, precision=hi)
+            + jnp.einsum("qj,eiqk->eijk", dphi1, f1, precision=hi)
+            + jnp.einsum("qk,eijq->eijk", dphi1, f2, precision=hi)
+        )
+    if with_mass:
+        m = mass_coeff * (wdetJ * u)
+        y = m if y is None else y + m
+    if not is_identity:
+        y = jnp.einsum("qi,eqjk->eijk", phi0, y, precision=hi)
+        y = jnp.einsum("qj,eiqk->eijk", phi0, y, precision=hi)
+        y = jnp.einsum("qk,eijq->eijk", phi0, y, precision=hi)
+    return y
+
+
+@partial(
+    jax.tree_util.register_dataclass,
+    data_fields=["G", "wdetJ", "phi0", "dphi1", "bc_mask",
+                 "grad_coeff", "mass_coeff"],
+    meta_fields=["n", "degree", "is_identity", "form",
+                 "with_grad", "with_mass", "spd"],
+)
+@dataclass(frozen=True)
+class FormOperator:
+    """Matrix-free weak-form operator state (pytree, xla backend).
+
+    Same grid-in/grid-out contract and Dirichlet handling as
+    ops.laplacian.Laplacian: input zeroed on constrained dofs, output
+    pass-through rows y[bc] = x[bc]. G is None for mass-only rows and
+    wdetJ None for gradient-only rows (the chains are compiled out, so
+    the dead operand never ships to device)."""
+
+    G: jnp.ndarray | None  # (ncells, 6, nq, nq, nq), kappa(x) pre-folded
+    wdetJ: jnp.ndarray | None  # (ncells, nq, nq, nq)
+    phi0: jnp.ndarray  # (nq, nd) interpolation matrix
+    dphi1: jnp.ndarray  # (nq, nq) collocation derivative
+    bc_mask: jnp.ndarray  # (NX, NY, NZ) bool Dirichlet marker
+    grad_coeff: jnp.ndarray  # scalar
+    mass_coeff: jnp.ndarray  # scalar
+    n: tuple[int, int, int]
+    degree: int
+    is_identity: bool
+    form: str
+    with_grad: bool
+    with_mass: bool
+    spd: bool
+
+    def apply(self, x_grid: jnp.ndarray) -> jnp.ndarray:
+        """y = A @ x on the dof grid, with Dirichlet pass-through rows."""
+        xm = jnp.where(self.bc_mask, 0, x_grid)
+        u = gather_cells(xm, self.n, self.degree)
+        y = _form_cell_apply(
+            u, self.G, self.wdetJ, self.phi0, self.dphi1,
+            self.grad_coeff, self.mass_coeff,
+            self.is_identity, self.with_grad, self.with_mass,
+        )
+        y_grid = fold_cells(y, self.n, self.degree)
+        return jnp.where(self.bc_mask, x_grid, y_grid)
+
+
+def kappa_at_quadrature(corners: np.ndarray, pts1d: np.ndarray) -> np.ndarray:
+    """kappa sampled at the PHYSICAL quadrature points: (ncells, nq, nq, nq).
+
+    The trilinear corner map x(xi) = sum_c N_c(xi) X_c is the same map
+    whose Jacobian feeds geometry_factors — sampling through it keeps
+    the coefficient consistent between uniform and perturbed meshes, and
+    between operator and oracle (both call this function)."""
+    corners = np.asarray(corners, np.float64).reshape(-1, 2, 2, 2, 3)
+    pts = np.asarray(pts1d, np.float64)
+    N = np.stack([1.0 - pts, pts], axis=1)  # (nq, 2) linear shapes
+    xq = np.einsum("eabci,xa,yb,zc->exyzi", corners, N, N, N)
+    return kappa_field(xq[..., 0], xq[..., 1], xq[..., 2])
+
+
+def build_form_operator(
+    mesh: BoxMesh,
+    form: str | FormSpec,
+    degree: int,
+    qmode: int,
+    rule: str = "gll",
+    dtype=jnp.float64,
+    tables: OperatorTables | None = None,
+) -> FormOperator:
+    """Assemble form-operator state from a registry row: tables host-side
+    (f64), geometry tensors on device — the forms counterpart of
+    ops.laplacian.build_laplacian, one build path for every row."""
+    spec = form_spec(form) if isinstance(form, str) else form
+    t = tables or build_operator_tables(degree, qmode, rule)
+    corners_np = np.asarray(mesh.cell_corners, np.float64).reshape(
+        -1, 2, 2, 2, 3)
+    corners = jnp.asarray(corners_np, dtype=dtype)
+    with_grad = spec.grad_coeff != 0.0
+    with_mass = spec.mass_coeff != 0.0
+    G_dev, wdetJ_dev = geometry_factors_jax(corners, t.pts1d, t.wts1d)
+    G = wdetJ = None
+    if with_grad:
+        G = G_dev
+        if spec.coefficient == "varkappa":
+            kq = jnp.asarray(
+                kappa_at_quadrature(corners_np, t.pts1d), dtype=dtype)
+            # fold kappa(x_q) into the geometry tensor: a pointwise scale
+            # of all 6 packed components (diagonal-only on uniform meshes)
+            G = G * kq[:, None]
+    if with_mass:
+        wdetJ = wdetJ_dev
+    bc = jnp.asarray(boundary_dof_marker(mesh.n, degree))
+    return FormOperator(
+        G=G,
+        wdetJ=wdetJ,
+        phi0=jnp.asarray(t.phi0, dtype=dtype),
+        dphi1=jnp.asarray(t.dphi1, dtype=dtype),
+        bc_mask=bc,
+        grad_coeff=jnp.asarray(spec.grad_coeff, dtype=dtype),
+        mass_coeff=jnp.asarray(spec.mass_coeff, dtype=dtype),
+        n=mesh.n,
+        degree=degree,
+        is_identity=t.is_identity,
+        form=spec.name,
+        with_grad=with_grad,
+        with_mass=with_mass,
+        spd=spec.spd,
+    )

@@ -1,0 +1,142 @@
+"""Declarative weak-form table (ISSUE 20): the operator zoo.
+
+Each row describes one bilinear form as quadrature-point coefficients of
+the two contraction chains the sum-factorised kernel knows how to run,
+
+    a(u, v) = grad_coeff * (kappa(x) grad u, grad v) + mass_coeff * (u, v)
+
+mirroring the reference's UFL form layer (poisson64.py -> FFCx kernels,
+forms.hpp:23-42) as data instead of generated code: a new PDE is a
+registry row plus (at most) a few quadrature-point lines, not a new
+operator class. The rows deliberately span the taxonomy the serving and
+solver layers care about:
+
+  * poisson    -- the seed benchmark (pure gradient chain, constant kappa)
+  * mass       -- L2 projection: basis-squared contraction, NO gradient
+                  chain (the degenerate row that proves the kernel's
+                  chains really are independently switchable)
+  * helmholtz  -- stiffness - k^2 * mass: the first non-SPD operator in
+                  the repo; CG on it exercises the breakdown sentinel /
+                  s_step fallback / failure_class taxonomy on a real
+                  indefinite shift instead of an injected NaN
+  * varkappa   -- variable-coefficient kappa(x), sampled at quadrature
+                  points and folded into the geometry tensor G (on
+                  uniform meshes G is diagonal, so the fold is exactly a
+                  diagonal rescale of the kron-path factors)
+  * heat       -- (u, v) + dt * (grad u, grad v): one implicit-Euler heat
+                  step; SPD, served with an rtol budget so warm-started
+                  lanes can retire early (workload/heat.py)
+
+`spd=False` rows must never claim CG convergence: the driver and serve
+layers stamp registered failure classes instead of crashing, and
+preconditioners gate off (GATE_REASONS["helmholtz-precond"]).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+# Helmholtz shift k^2. The unit-cube Dirichlet Laplacian's smallest
+# generalized eigenvalues are pi^2*(i^2+j^2+k^2) = {3,6,9,...}*pi^2
+# (29.6, 59.2, 88.8, ...), so k^2 = 100 puts several modes below the
+# shift: the discrete operator is genuinely indefinite at any mesh that
+# resolves those modes, not merely ill-conditioned.
+HELMHOLTZ_KSQ = 100.0
+
+# Implicit-Euler step of the heat workload: small enough that
+# (M + dt*K) stays mass-dominated and well-conditioned (warm starts
+# converge in a handful of iterations), large enough that the stiffness
+# chain contributes beyond rounding.
+HEAT_DT = 1e-3
+
+# Serve-side relative residual budget for heat steps: lanes freeze once
+# rnorm/rnorm0 < rtol^2 (la.cg.make_batched_cg_step), which is what
+# makes warm-start iteration savings observable at retire time.
+HEAT_RTOL = 1e-5
+
+# varkappa coefficient contrast: kappa(x) in [1-A, 1+A].
+VARKAPPA_AMPLITUDE = 0.5
+
+
+@dataclass(frozen=True)
+class FormSpec:
+    """One weak form as data.
+
+    grad_coeff   multiplies the gradient chain (kappa grad u, grad v);
+                 0.0 compiles the chain out entirely.
+    mass_coeff   multiplies the basis-squared chain (u, v); 0.0 compiles
+                 it out. Negative values (helmholtz) make the form
+                 indefinite.
+    spd          CG-safe flag: False routes the breakdown taxonomy and
+                 gates preconditioners off.
+    coefficient  "constant" or "varkappa" (kappa sampled at quadrature
+                 points via kappa_field and folded into G).
+    rtol         serve-side relative tolerance baked into the compiled
+                 CG step (0.0 = fixed iteration budget, the seed
+                 behaviour). Nonzero only where early retirement is the
+                 point (heat).
+    """
+
+    name: str
+    grad_coeff: float
+    mass_coeff: float
+    spd: bool
+    coefficient: str = "constant"
+    rtol: float = 0.0
+    description: str = ""
+
+
+FORMS: dict[str, FormSpec] = {
+    f.name: f
+    for f in (
+        FormSpec(
+            "poisson", 2.0, 0.0, True,
+            description="reference stiffness -div(kappa grad u), kappa=2 "
+                        "(the seed benchmark; routed through the original "
+                        "ops.laplacian path untouched)"),
+        FormSpec(
+            "mass", 0.0, 1.0, True,
+            description="L2 projection (u, v): basis-squared contraction, "
+                        "no gradient chain"),
+        FormSpec(
+            "helmholtz", 1.0, -HELMHOLTZ_KSQ, False,
+            description=f"indefinite shift (grad u, grad v) - k^2 (u, v), "
+                        f"k^2={HELMHOLTZ_KSQ:g}"),
+        FormSpec(
+            "varkappa", 1.0, 0.0, True, coefficient="varkappa",
+            description="variable-coefficient (kappa(x) grad u, grad v), "
+                        "kappa smooth positive in "
+                        f"[{1 - VARKAPPA_AMPLITUDE:g}, "
+                        f"{1 + VARKAPPA_AMPLITUDE:g}]"),
+        FormSpec(
+            "heat", HEAT_DT, 1.0, True, rtol=HEAT_RTOL,
+            description=f"implicit-Euler heat step (u, v) + dt (grad u, "
+                        f"grad v), dt={HEAT_DT:g} (workload/heat.py)"),
+    )
+}
+
+FORM_NAMES = tuple(FORMS)
+
+
+def form_spec(name: str) -> FormSpec:
+    """Look up a registry row; unknown names fail loud with the vocabulary."""
+    try:
+        return FORMS[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown form '{name}' (registered: {', '.join(FORM_NAMES)})"
+        ) from None
+
+
+def kappa_field(x, y, z):
+    """Deterministic smooth positive kappa(x) for the varkappa row.
+
+    Shared VERBATIM by the device operator build and the assembled-CSR
+    oracle — the parity contract compares two discretisations of the
+    same coefficient, so the coefficient itself must be one function.
+    """
+    return 1.0 + VARKAPPA_AMPLITUDE * (
+        np.sin(np.pi * x) * np.sin(np.pi * y) * np.sin(np.pi * z)
+    )

@@ -482,6 +482,61 @@ print('SERVE OK', {k: round(snap[k], 3) for k in (
 """
 
 
+FORMS = PRE + """
+# Operator zoo + heat workload (ISSUE 20), CPU-pinned like the serve
+# stages (the acceptance contract is CPU round-stamped; hardware
+# per-form rates ride the bench stages once the zoo lands there): the
+# per-form GDoF/s table beside the Poisson reference at one size, the
+# Helmholtz CG breakdown taxonomy stamped (classified, not crashed),
+# and a serve-side heat smoke — the temporally-correlated scale stream
+# through the live broker warm vs the same stream cold, iteration
+# savings asserted positive (scripts/perfgate.py's `forms` leg pins
+# the number; this stage proves the stack under the round's journal).
+import os
+if os.environ.get('JAX_PLATFORMS', '') == 'cpu':
+    from bench_tpu_fem.utils.hermetic import force_host_cpu_devices
+    force_host_cpu_devices(1)
+import json
+out = {'metric': 'forms', 'forms': {}}
+for form in ('poisson', 'mass', 'varkappa', 'helmholtz', 'heat'):
+    cfg = BenchConfig(ndofs_global=4096, degree=3, qmode=1,
+                      float_bits=64, nreps=30, use_cg=True, form=form)
+    res, w = timed_res(cfg)
+    entry = {'gdof_s': res.gdof_per_second, 'wall_s': round(w, 3)}
+    if form != 'poisson':
+        assert res.extra.get('form') == form, res.extra
+    if form == 'helmholtz':
+        sent = res.extra.get('cg_sentinel')
+        assert sent is not None, res.extra
+        entry['cg_sentinel'] = sent
+    out['forms'][form] = entry
+    print(f'FORM {form}:', res.gdof_per_second, res.extra)
+jax.config.update('jax_enable_x64', True)
+from bench_tpu_fem.serve import Broker, Metrics, SolveSpec
+from bench_tpu_fem.workload import heat_scale_stream, warm_pairs
+br = Broker(metrics=Metrics(), nrhs_max=2, window_s=0.01)
+spec = SolveSpec(degree=3, ndofs=4096, nreps=400, precision='f64',
+                 form='heat')
+pairs = warm_pairs(heat_scale_stream(10, seed=0, drift=0.01))
+def run_stream(warmed):
+    iters = []
+    for scale, wsc in pairs:
+        p = br.submit(spec, scale, warm_scale=wsc if warmed else 0.0)
+        r = br.wait(p, timeout_s=300)
+        assert r['ok'], r
+        iters.append(int(r['iters_run']))
+    return iters
+warm_iters = run_stream(True)
+cold_iters = run_stream(False)
+br.shutdown()
+saved = sum(cold_iters[1:]) - sum(warm_iters[1:])
+assert saved > 0, (warm_iters, cold_iters)
+out['heat_serve'] = {'iters_warm': warm_iters, 'iters_cold': cold_iters,
+                     'iters_saved': saved}
+print(json.dumps(out))
+"""
+
+
 def make_stages(round_tag: str = DEFAULT_ROUND) -> dict[str, Stage]:
     """All known stages by name. Gate topology: ``dfacc`` (the
     on-hardware df accuracy oracle) gates every df perf stage; the gate
@@ -535,6 +590,12 @@ def make_stages(round_tag: str = DEFAULT_ROUND) -> dict[str, Stage]:
         _script("overload", ["scripts/chaos_soak.py", "--quick",
                              "--legs", "overload"], 600,
                 env={"JAX_PLATFORMS": "cpu"}),
+        # Operator zoo + heat workload (ISSUE 20): per-form GDoF/s next
+        # to the Poisson reference, the Helmholtz breakdown taxonomy
+        # stamped, and the warm-vs-cold heat serve smoke. CPU-pinned
+        # (the warm-start savings contract is CPU round-stamped).
+        _py("forms", FORMS, 900, env={"JAX_PLATFORMS": "cpu"},
+            parse=last_json_line),
         # On-chip autotune sweep (ISSUE 16): persist hardware-labelled
         # tuning winners per (degree, bucket) slice into the round's
         # tuning DB BEFORE the bench stages run, so their builds consume
@@ -675,7 +736,7 @@ ALIASES = {
 # Round-6 default agenda, ordered by value-per-minute under wedge risk
 # (measure_all's ordering, expanded through ALIASES).
 AGENDAS = {
-    "round6": ["health", "serve", "chaos", "overload", "autotune",
+    "round6": ["health", "serve", "chaos", "overload", "forms", "autotune",
                "fusedbatch", "bf16",
                "dfacc",
                "pertdf", "foldeng", "dfext2d", "scale", "dfeng", "bench",

@@ -1138,6 +1138,73 @@ def batched_cg_admit(state: BatchedCGState, lane,
     )
 
 
+def batched_cg_init_warm(B: jnp.ndarray, X0: jnp.ndarray,
+                         batch_apply: Callable, rtol: float = 0.0,
+                         dot: Callable | None = None) -> BatchedCGState:
+    """Fresh state with per-lane warm starts (ISSUE 20, the heat
+    workload): x0 = X0, r0 = B - A x0. `rnorm0` is the COLD target
+    <B, B> — the rtol budget must measure convergence relative to the
+    problem, not relative to the already-small warm residual, or a warm
+    lane would be asked for the same relative reduction as a cold one
+    and save nothing. With X0 = 0 this is bitwise `batched_cg_init`
+    (A 0 = 0 exactly), so cold traffic through the warm path keeps the
+    cold trajectory. A lane whose warm residual already meets the rtol
+    budget is born frozen (zero iterations burned — the best case the
+    savings counter measures)."""
+    if dot is None:
+        dot = batched_dot
+    nrhs = B.shape[0]
+    R = B - batch_apply(X0)
+    rnorm0 = dot(B, B)
+    rnorm = dot(R, R)
+    zero = jnp.zeros((), rnorm.dtype)
+    done = jnp.logical_or(rnorm0 == zero, rnorm == zero)
+    if rtol > 0.0:
+        done = jnp.logical_or(
+            done, rnorm / rnorm0 < jnp.asarray(rtol * rtol, rnorm.dtype))
+    return BatchedCGState(
+        X=X0,
+        R=R,
+        P=jnp.zeros_like(B),
+        beta=jnp.zeros((nrhs,), B.dtype),
+        rnorm=rnorm,
+        rnorm0=rnorm0,
+        done=done,
+        iters=jnp.zeros((nrhs,), jnp.int32),
+    )
+
+
+def batched_cg_admit_warm(state: BatchedCGState, lane, b: jnp.ndarray,
+                          x0: jnp.ndarray, apply: Callable,
+                          rtol: float = 0.0) -> BatchedCGState:
+    """Admit one RHS with a warm start at an iteration boundary: the
+    lane restarts from x0 with r = b - A x0 and the COLD rnorm0 = <b, b>
+    (same convention as `batched_cg_init_warm`, so an admitted warm lane
+    is indistinguishable from the same request warm-started in a fresh
+    batch). With x0 = 0 this reproduces `batched_cg_admit` bitwise
+    (plus the admit-time rtol freeze, which a zero warm start can only
+    trip when b itself is zero). Every edit is lane-local."""
+    r = b - apply(x0)
+    rn0 = inner_product(b, b)
+    rn = inner_product(r, r)
+    zero = jnp.zeros((), rn.dtype)
+    done = jnp.logical_or(rn0 == zero, rn == zero)
+    if rtol > 0.0:
+        done = jnp.logical_or(
+            done, rn / rn0 < jnp.asarray(rtol * rtol, rn.dtype))
+    zerov = jnp.zeros_like(b)
+    return BatchedCGState(
+        X=state.X.at[lane].set(x0),
+        R=state.R.at[lane].set(r),
+        P=state.P.at[lane].set(zerov),
+        beta=state.beta.at[lane].set(jnp.zeros((), state.beta.dtype)),
+        rnorm=state.rnorm.at[lane].set(rn),
+        rnorm0=state.rnorm0.at[lane].set(rn0),
+        done=state.done.at[lane].set(done),
+        iters=state.iters.at[lane].set(jnp.zeros((), jnp.int32)),
+    )
+
+
 def batched_cg_retire(state: BatchedCGState, lane) -> BatchedCGState:
     """Retire one lane at an iteration boundary: zero its state and mark
     it born-frozen (rnorm0 = 0, the padding-lane convention), freeing

@@ -168,7 +168,8 @@ _ADVISORY_NAME = "ADVISORY_COUNTERS"
 #: gated by dedicated gate_counters logic rather than the tables
 _SPECIALLY_GATED = ("collectives_per_iter",)
 #: configuration-identity labels comparable_labels() consumes
-_LABEL_COUNTERS = ("precond_label", "s_step_label")
+_LABEL_COUNTERS = ("precond_label", "s_step_label",
+                   "heat_warm_start_label")
 
 
 def _tuple_of_strs(node: ast.AST) -> list[str] | None:

@@ -414,6 +414,11 @@ HIGHER_IS_BETTER_COUNTERS = (
     # the fleet back UP when the burn clears — a recovery count of zero
     # on the pinned schedule is a ladder stuck at reduced precision
     "brownout_recoveries",
+    # ISSUE 20: warm starts on the pinned 200-step heat stream must
+    # keep saving CG iterations over the cold twin — a shrink means the
+    # warm-start path silently degraded to cold solves (the exact state
+    # the CI BENCH_SUPPRESS_WARMSTART probe injects)
+    "heat_warm_start_iters_saved",
 )
 #: contract booleans: baseline True -> current must stay True
 CONTRACT_FLAGS = ("record_contract_ok", "trace_valid",
@@ -421,7 +426,12 @@ CONTRACT_FLAGS = ("record_contract_ok", "trace_valid",
                   # registered provenance label (cpu-measured /
                   # design-estimate / hardware) — an unlabeled entry is
                   # evidence without provenance
-                  "tuning_labels_ok")
+                  "tuning_labels_ok",
+                  # ISSUE 20: every zoo form's device action must keep
+                  # matching the CSR oracle at f64 on the fixed-seed
+                  # perturbed problem — arithmetic, not timing
+                  "form_parity_ok_mass", "form_parity_ok_helmholtz",
+                  "form_parity_ok_varkappa", "form_parity_ok_heat")
 
 #: counters whose VALUE is timing-derived (advisory — phase-share drift
 #: never gates, per the ISSUE 15 contract) but whose PRESENCE is the
@@ -441,9 +451,10 @@ ADVISORY_COUNTERS = ("sdc_injected",)
 
 def comparable_labels(current: dict, baseline: dict) -> bool:
     """Whether two counter dicts measured the SAME solver configuration
-    (precond kind + s-step factor). Absent labels compare as matching —
-    a pre-ISSUE-11 baseline that never stamped a label cannot mismatch."""
-    for key in ("precond_label", "s_step_label"):
+    (precond kind + s-step factor + heat-stream shape). Absent labels
+    compare as matching — a pre-ISSUE-11 baseline that never stamped a
+    label cannot mismatch."""
+    for key in ("precond_label", "s_step_label", "heat_warm_start_label"):
         cb, cc = baseline.get(key), current.get(key)
         if cb is not None and cc is not None and cb != cc:
             return False

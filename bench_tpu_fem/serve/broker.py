@@ -95,6 +95,11 @@ class PendingRequest:
     spec: SolveSpec
     scale: float
     enqueued: float
+    # warm-start hint (ISSUE 20, heat workload): the lane starts from
+    # x0 = warm_scale * xbase on solvers that support it; 0.0 (the
+    # default) is bitwise the cold admit, so every pre-zoo request is
+    # untouched
+    warm_scale: float = 0.0
     done: threading.Event = field(default_factory=threading.Event)
     result: dict | None = None
     answered: bool = False
@@ -128,9 +133,14 @@ class PendingRequest:
 
 
 def _spec_dict(spec: SolveSpec) -> dict:
-    return {"degree": spec.degree, "ndofs": spec.ndofs,
-            "nreps": spec.nreps, "precision": spec.precision,
-            "geom_perturb_fact": spec.geom_perturb_fact}
+    d = {"degree": spec.degree, "ndofs": spec.ndofs,
+         "nreps": spec.nreps, "precision": spec.precision,
+         "geom_perturb_fact": spec.geom_perturb_fact}
+    if spec.form != "poisson":
+        # additive: poisson journal records keep their pre-zoo bytes,
+        # and SolveSpec(**spec_dict) replays via the field default
+        d["form"] = spec.form
+    return d
 
 
 class Broker:
@@ -191,12 +201,15 @@ class Broker:
 
     def submit(self, spec: SolveSpec, scale: float = 1.0,
                req_id: str | None = None,
-               degraded: dict | None = None) -> PendingRequest:
+               degraded: dict | None = None,
+               warm_scale: float = 0.0) -> PendingRequest:
         """Admit one request or shed it (QueueFull). Never blocks on the
         solve — the caller waits on the returned PendingRequest.
         ``degraded`` (ISSUE 18) is the fleet's brownout provenance
         stamp: attached BEFORE the request is visible to any responder,
-        so every response under brownout carries it race-free."""
+        so every response under brownout carries it race-free.
+        ``warm_scale`` (ISSUE 20) seeds warm-start-capable solvers with
+        x0 = warm_scale * xbase; 0.0 is the cold path bitwise."""
         with self._cv:
             if req_id is None:
                 # id minting under the queue lock: recover() bumps the
@@ -250,7 +263,8 @@ class Broker:
                             f"{spec.deadline_s:.3f}s",
                             failure_class="deadline_exceeded",
                             retry_after_s=retry_after)
-            pending = PendingRequest(rid, spec, float(scale), time.monotonic())
+            pending = PendingRequest(rid, spec, float(scale), time.monotonic(),
+                                     warm_scale=float(warm_scale))
             if spec.deadline_s is not None:
                 pending.deadline = pending.enqueued + spec.deadline_s
             if degraded is not None:
@@ -265,7 +279,8 @@ class Broker:
             # back, carrying spec + scale so a crashed generation's
             # recovery can replay the request (serve.recovery)
             self.metrics.request(rid, _spec_dict(spec), len(self._queue),
-                                 scale=float(scale))
+                                 scale=float(scale),
+                                 warm_scale=float(warm_scale) or None)
             self._cv.notify_all()
         return pending
 
@@ -372,7 +387,9 @@ class Broker:
             return None
         pending = PendingRequest(req["id"], spec,
                                  float(req.get("scale", 1.0)),
-                                 time.monotonic())
+                                 time.monotonic(),
+                                 warm_scale=float(
+                                     req.get("warm_scale", 0.0)))
         if self.reqtrace:
             pending.rt = ReqTrace(pending.id, t0=pending.enqueued)
             pending.rt.annotate(replayed=True)
@@ -748,7 +765,12 @@ class Broker:
             (served, midsolve, boundaries, live_lane_boundaries,
              dead_lane_boundaries, boundary_iter, wall_accum) = resume["acct"]
         else:
-            state = solver.cont_init([p.scale for p in members])
+            if getattr(solver, "supports_warm", False):
+                state = solver.cont_init(
+                    [p.scale for p in members],
+                    warm_scales=[p.warm_scale for p in members])
+            else:
+                state = solver.cont_init([p.scale for p in members])
             lanes = [None] * bucket
             served = midsolve = boundaries = live_lane_boundaries = 0
             dead_lane_boundaries = 0
@@ -857,8 +879,13 @@ class Broker:
                                 p.rt.cut("retry")
                             p.sdc_retries += 1
                             state, _ = solver.cont_retire(state, lane)
-                            state = solver.cont_admit(state, lane,
-                                                      p.scale)
+                            if getattr(solver, "supports_warm", False):
+                                state = solver.cont_admit(
+                                    state, lane, p.scale,
+                                    warm_scale=p.warm_scale)
+                            else:
+                                state = solver.cont_admit(state, lane,
+                                                          p.scale)
                             park()
                             continue
                         # detected AGAIN on the re-run: deterministic
@@ -947,7 +974,13 @@ class Broker:
                         p.rt.annotate_default("cache_source", "hit")
                         p.rt.annotate(midsolve=True)
                     try:
-                        state = solver.cont_admit(state, lane, p.scale)
+                        if getattr(solver, "supports_warm", False):
+                            state = solver.cont_admit(
+                                state, lane, p.scale,
+                                warm_scale=p.warm_scale)
+                        else:
+                            state = solver.cont_admit(state, lane,
+                                                      p.scale)
                     except BaseException:
                         # p (and any requests polled after it) is out of
                         # the queue but in neither `members` nor a parked

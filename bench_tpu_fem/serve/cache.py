@@ -58,6 +58,7 @@ class ExecutableKey:
     nrhs_bucket: int
     device_mesh: tuple  # dshape, (1, 1, 1) for single-chip
     nreps: int = 0  # CG iterations baked into the loop
+    form: str = "poisson"  # weak-form axis (forms.registry, ISSUE 20)
 
 
 @dataclass

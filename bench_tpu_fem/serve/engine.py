@@ -115,19 +115,41 @@ MAX_NDOFS = 50_000_000
 # host round-trip (a (bucket,) iters/done fetch) stays negligible.
 ITER_CHUNK = 4
 
+# CI probe seam (ISSUE 20): a nonempty value forces every warm-start
+# scale to 0.0 at cont_init/cont_admit time — the suppressed-warm-start
+# regression the perfgate forms leg must catch (iterations saved drops
+# to 0, the HIGHER-gated counter fails rc 1). Never set in production.
+WARM_SUPPRESS_ENV = "BENCH_SUPPRESS_WARMSTART"
+
+# Iteration budget for the heat form's high-accuracy base solution
+# (x_base = A^{-1} b, computed once at build): warm starts are scaled
+# copies of it, so it must be converged well past the serve rtol.
+XBASE_ITERS = 200
+
+
+def _warm_suppressed() -> bool:
+    import os
+
+    return os.environ.get(WARM_SUPPRESS_ENV, "") not in ("", "0")
+
 
 @dataclass(frozen=True)
 class SolveSpec:
     """The request-compatibility key, pre-bucket. `nreps` is the CG
     iteration count (benchmark semantics: rtol=0, exactly nreps
     iterations — responses are comparable across requests only because
-    the iteration count is part of the spec)."""
+    the iteration count is part of the spec; rtol-budgeted forms like
+    heat treat nreps as the iteration CAP and may retire lanes early).
+    `form` is the weak-form axis (forms.registry, ISSUE 20): requests
+    for different forms must never share a batch or an executable, so it
+    participates in equality/hash and the cache key."""
 
     degree: int = 3
     ndofs: int = 50_000
     nreps: int = 30
     precision: str = "f32"
     geom_perturb_fact: float = 0.0
+    form: str = "poisson"
     # Client latency budget in seconds (ISSUE 18), None = unbounded.
     # compare=False keeps it OUT of batch compatibility (`p.spec ==
     # spec`), the executable cache key and the frozen-dataclass hash —
@@ -160,6 +182,21 @@ class SolveSpec:
             raise UnsupportedSpec(
                 gate_reason("serve-ndofs-cap", ndofs=self.ndofs,
                             cap=MAX_NDOFS))
+        if self.form != "poisson":
+            from ..forms.registry import FORM_NAMES
+
+            if self.form not in FORM_NAMES:
+                raise UnsupportedSpec(
+                    f"unknown form '{self.form}' "
+                    f"(registered: {', '.join(FORM_NAMES)})")
+            # form x engine gates (ISSUE 20): every unsupported
+            # combination stamps a registered reason, never free text
+            if self.precision == "df32":
+                raise UnsupportedSpec(
+                    gate_reason("form-df", form=self.form))
+            if self.precision == "bf16":
+                raise UnsupportedSpec(
+                    gate_reason("form-bf16", form=self.form))
 
 
 class UnsupportedSpec(ValueError):
@@ -190,7 +227,11 @@ def planned_engine_form(spec: SolveSpec, bucket: int) -> str:
     (ops.kron_cg.engine_plan_batched), else the unfused vmapped
     composition. Unified vocabulary (bench.driver.record_engine). The
     decision table lives in engines.registry; this is a thin delegate
-    kept for the existing call sites."""
+    kept for the existing call sites. Non-poisson forms always run the
+    general sum-factorised action (the forms_xla registry row), never a
+    fused poisson ring."""
+    if spec.form != "poisson":
+        return "unfused"
     from ..engines.registry import planned_engine_form as _planned
 
     return _planned(spec.precision, spec.geom, spec.ndofs, spec.degree,
@@ -212,6 +253,7 @@ def spec_cache_key(spec: SolveSpec, bucket: int,
         nrhs_bucket=bucket,
         device_mesh=tuple(device_mesh),
         nreps=spec.nreps,
+        form=spec.form,
     )
 
 
@@ -303,6 +345,7 @@ class CompiledSolver:
                  if tuned and tuned.get("iter_chunk") else ITER_CHUNK)
         self.iter_chunk = min(chunk, nreps)
         self.supports_continuous = False
+        self.supports_warm = False
         self.continuous_gate_reason = None
         self.engine_form = "unfused"
         self.engine_fallback_reason = None
@@ -383,7 +426,9 @@ class CompiledSolver:
         else:
             from ..la.cg import (
                 batched_cg_admit,
+                batched_cg_admit_warm,
                 batched_cg_init,
+                batched_cg_init_warm,
                 batched_cg_retire,
                 batched_cg_run,
                 make_batched_cg_step,
@@ -397,12 +442,31 @@ class CompiledSolver:
                 from ..engines.registry import GATE_REASONS
 
                 raise UnsupportedSpec(GATE_REASONS["serve-f64-x64"])
-            # Uniform meshes take the exact Kronecker fast path; general
-            # (perturbed) geometry the einsum operator.
-            backend = "kron" if spec.geom == "uniform" else "xla"
-            self._op = build_laplacian(
-                mesh, spec.degree, 1, "gll", kappa=2.0, dtype=dtype,
-                tables=t, backend=backend)
+            if spec.form != "poisson":
+                # Operator-zoo forms (ISSUE 20): the general
+                # sum-factorised form action, every geometry. The heat
+                # row additionally bakes its rtol into the compiled step
+                # (nreps becomes the iteration CAP) and precomputes the
+                # high-accuracy base solution warm starts scale.
+                from ..forms.operators import build_form_operator
+                from ..forms.registry import form_spec as _form_spec
+
+                fspec = _form_spec(spec.form)
+                self._op = build_form_operator(
+                    mesh, fspec, spec.degree, 1, "gll", dtype=dtype,
+                    tables=t)
+                self._rtol = float(fspec.rtol)
+                self.supports_warm = self._rtol > 0.0
+            else:
+                # Uniform meshes take the exact Kronecker fast path;
+                # general (perturbed) geometry the einsum operator.
+                backend = "kron" if spec.geom == "uniform" else "xla"
+                self._op = build_laplacian(
+                    mesh, spec.degree, 1, "gll", kappa=2.0, dtype=dtype,
+                    tables=t, backend=backend)
+                self._rtol = 0.0
+                self.supports_warm = False
+            rtol_v = self._rtol
             if spec.precision == "bf16":
                 # bf16 serving (ISSUE 17): round the HBM-resident
                 # operator state to bfloat16 ONCE — every batched /
@@ -424,19 +488,47 @@ class CompiledSolver:
                     return kron_batched_engine(A)
                 return unfused_batch_engine(jax.vmap(A.apply))
 
-            def _init(base, scales):
-                B = scales.reshape((-1,) + (1,) * base.ndim) * base[None]
-                return batched_cg_init(B)
+            if self.supports_warm:
+                # High-accuracy base solution x_base = A^{-1} b, solved
+                # once at build well past the serve rtol: a warm start
+                # is warm_scale * x_base (the previous heat step's
+                # solution under the RHS-as-scale protocol).
+                eng0 = unfused_batch_engine(jax.vmap(self._op.apply))
+                step0 = make_batched_cg_step(eng0, XBASE_ITERS,
+                                             rtol=rtol_v * 1e-2)
+                st0 = jax.jit(
+                    lambda s: batched_cg_run(s, step0, XBASE_ITERS))(
+                        batched_cg_init(self._base[None]))
+                self._xbase = st0.X[0]
+                self.xbase_iters = int(np.asarray(st0.iters)[0])
+
+                def _init(A, base, xb, scales, warms):
+                    shape = (-1,) + (1,) * base.ndim
+                    B = scales.reshape(shape) * base[None]
+                    X0 = warms.reshape(shape) * xb[None]
+                    return batched_cg_init_warm(
+                        B, X0, jax.vmap(A.apply), rtol=rtol_v)
+
+                def _admit(A, base, xb, state, lane, scale, warm):
+                    return batched_cg_admit_warm(
+                        state, lane, scale * base, warm * xb, A.apply,
+                        rtol=rtol_v)
+            else:
+                def _init(base, scales):
+                    B = (scales.reshape((-1,) + (1,) * base.ndim)
+                         * base[None])
+                    return batched_cg_init(B)
+
+                def _admit(base, state, lane, scale):
+                    return batched_cg_admit(state, lane, scale * base)
 
             def _make_step(fused):
                 def _step(A, state):
-                    step = make_batched_cg_step(_engine(A, fused), nreps)
+                    step = make_batched_cg_step(_engine(A, fused), nreps,
+                                                rtol=rtol_v)
                     return batched_cg_run(state, step, self.iter_chunk)
 
                 return _step
-
-            def _admit(base, state, lane, scale):
-                return batched_cg_admit(state, lane, scale * base)
 
             def _retire(state, lane):
                 x = state.X[lane]
@@ -446,7 +538,11 @@ class CompiledSolver:
             npdt = np.dtype(dtype)
             base_s = jax.ShapeDtypeStruct(b64.shape, npdt)
             scales_s = jax.ShapeDtypeStruct((self.bucket,), npdt)
-            state_s = jax.eval_shape(_init, base_s, scales_s)
+            if self.supports_warm:
+                state_s = jax.eval_shape(_init, self._op, base_s, base_s,
+                                         scales_s, scales_s)
+            else:
+                state_s = jax.eval_shape(_init, base_s, scales_s)
             lane_s = jax.ShapeDtypeStruct((), np.dtype(np.int32))
             scale_s = jax.ShapeDtypeStruct((), npdt)
 
@@ -484,11 +580,20 @@ class CompiledSolver:
                         jax.jit(_make_step(False)).lower(self._op,
                                                          state_s),
                         None)
-                self._init_fn = compile_lowered(
-                    jax.jit(_init).lower(base_s, scales_s), None)
-                self._admit_fn = compile_lowered(
-                    jax.jit(_admit).lower(base_s, state_s, lane_s,
-                                          scale_s), None)
+                if self.supports_warm:
+                    self._init_fn = compile_lowered(
+                        jax.jit(_init).lower(self._op, base_s, base_s,
+                                             scales_s, scales_s), None)
+                    self._admit_fn = compile_lowered(
+                        jax.jit(_admit).lower(self._op, base_s, base_s,
+                                              state_s, lane_s, scale_s,
+                                              scale_s), None)
+                else:
+                    self._init_fn = compile_lowered(
+                        jax.jit(_init).lower(base_s, scales_s), None)
+                    self._admit_fn = compile_lowered(
+                        jax.jit(_admit).lower(base_s, state_s, lane_s,
+                                              scale_s), None)
                 self._retire_fn = compile_lowered(
                     jax.jit(_retire).lower(state_s, lane_s), None)
             self.supports_continuous = True
@@ -525,12 +630,16 @@ class CompiledSolver:
 
         fns = {name: pickle.dumps(serialize(getattr(self, name)))
                for name in ARTIFACT_FNS}
-        meta = {
-            "format": ARTIFACT_FORMAT,
-            "spec": {"degree": self.spec.degree, "ndofs": self.spec.ndofs,
+        spec_meta = {"degree": self.spec.degree, "ndofs": self.spec.ndofs,
                      "nreps": self.spec.nreps,
                      "precision": self.spec.precision,
-                     "geom_perturb_fact": self.spec.geom_perturb_fact},
+                     "geom_perturb_fact": self.spec.geom_perturb_fact}
+        if self.spec.form != "poisson":
+            # additive: poisson artifacts keep their pre-zoo meta bytes
+            spec_meta["form"] = self.spec.form
+        meta = {
+            "format": ARTIFACT_FORMAT,
+            "spec": spec_meta,
             "bucket": self.bucket,
             "engine_form": self.engine_form,  # the ACHIEVED form
             "engine_fallback_reason": self.engine_fallback_reason,
@@ -627,8 +736,14 @@ class CompiledSolver:
             # continuous batching drives (init + ceil(nreps/chunk) chunk
             # steps — bitwise the one-fori_loop solve: the extra frozen
             # steps of the last chunk are per-lane no-ops)
-            state = self._init_fn(self._base,
-                                  jnp.asarray(pad, self._base.dtype))
+            if self.supports_warm:
+                state = self._init_fn(
+                    self._op, self._base, self._xbase,
+                    jnp.asarray(pad, self._base.dtype),
+                    jnp.zeros_like(jnp.asarray(pad, self._base.dtype)))
+            else:
+                state = self._init_fn(self._base,
+                                      jnp.asarray(pad, self._base.dtype))
             for _ in range(-(-self.spec.nreps // self.iter_chunk)):
                 state = self._step_fn(self._op, state)
             # vmapped scalar dot (la.cg.batched_dot): per lane the SAME
@@ -670,10 +785,16 @@ class CompiledSolver:
         pad[:live] = np.asarray(scales, np.float64)
         return pad
 
-    def cont_init(self, scales):
+    def cont_init(self, scales, warm_scales=None):
         """Fresh checkpoint state for the initial batch (padding lanes
         born frozen). Runs the fault-injection hook — the continuous
-        path must be as testable as the one-shot one."""
+        path must be as testable as the one-shot one.
+
+        `warm_scales` (warm-start solvers only, same length as
+        `scales`): per-lane multiplier on the precomputed base solution
+        used as the initial guess x0 = warm * xbase. Zero (the default,
+        and forced under BENCH_SUPPRESS_WARMSTART) reproduces the cold
+        init bitwise — A·0 is exactly zero, so R = B."""
         import jax.numpy as jnp
 
         if FAULT_HOOK is not None:
@@ -682,6 +803,15 @@ class CompiledSolver:
         if self.spec.precision == "df32":
             shi, slo = _df_split_scales(pad)
             return self._init_fn(self._base, shi, slo)
+        if self.supports_warm:
+            if warm_scales is None or _warm_suppressed():
+                wpad = np.zeros(self.bucket, np.float64)
+            else:
+                wpad = self._pad_scales(warm_scales)
+            return self._init_fn(
+                self._op, self._base, self._xbase,
+                jnp.asarray(pad, self._base.dtype),
+                jnp.asarray(wpad, self._base.dtype))
         return self._init_fn(self._base,
                              jnp.asarray(pad, self._base.dtype))
 
@@ -695,16 +825,28 @@ class CompiledSolver:
         retire/admit decision input (a (bucket,)-sized transfer)."""
         return np.asarray(state.iters), np.asarray(state.done)
 
-    def cont_admit(self, state, lane: int, scale: float):
+    def cont_admit(self, state, lane: int, scale: float,
+                   warm_scale: float = 0.0):
         """Admit a request into a free lane at this boundary: the lane
         restarts as scale * base RHS with its own iteration budget.
-        df32 splits the f64 scale host-side (df-exact scaling)."""
+        df32 splits the f64 scale host-side (df-exact scaling).
+
+        `warm_scale` (warm-start solvers only): the lane starts from
+        x0 = warm_scale * xbase instead of zero; 0.0 (the default, and
+        forced under BENCH_SUPPRESS_WARMSTART) is bitwise the cold
+        admit."""
         if self.spec.precision == "df32":
             s64 = np.float64(scale)
             shi = np.float32(s64)
             slo = np.float32(s64 - np.float64(shi))
             return self._admit_fn(self._base, state, np.int32(lane),
                                   shi, slo)
+        if self.supports_warm:
+            warm = 0.0 if _warm_suppressed() else float(warm_scale)
+            return self._admit_fn(
+                self._op, self._base, self._xbase, state, np.int32(lane),
+                np.asarray(scale, self._base.dtype),
+                np.asarray(warm, self._base.dtype))
         return self._admit_fn(self._base, state, np.int32(lane),
                               np.asarray(scale, self._base.dtype))
 

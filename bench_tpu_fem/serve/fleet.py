@@ -260,10 +260,12 @@ class FleetDispatcher:
             return rid
 
     def submit(self, spec: SolveSpec, scale: float = 1.0,
-               req_id: str | None = None):
+               req_id: str | None = None, warm_scale: float = 0.0):
         """Route one request: affinity -> burn-spill -> shortest queue.
         Raises QueueFull (fleet-level, journaled) when every lane is at
-        capacity. Returns the lane broker's PendingRequest."""
+        capacity. Returns the lane broker's PendingRequest.
+        ``warm_scale`` (ISSUE 20) rides through to the lane broker —
+        0.0 is the cold path bitwise on every solver."""
         rid = self._mint_id(req_id)
         # brownout rewrite (ISSUE 18) BEFORE the affinity probe: under
         # an engaged brownout level the request runs on the stepped-down
@@ -331,7 +333,8 @@ class FleetDispatcher:
         cause = ("spill" if spill
                  else "affinity-hit" if affinity else "cold-home")
         pending = chosen.broker.submit(spec, scale, req_id=rid,
-                                       degraded=degraded)
+                                       degraded=degraded,
+                                       warm_scale=warm_scale)
         if pending.rt is not None:
             # annotate() takes the trace lock: the lane worker may
             # already be answering this request on another thread

@@ -201,13 +201,19 @@ class Metrics:
     # -- events ------------------------------------------------------------
 
     def request(self, req_id: str, spec_dict: dict, queue_depth: int,
-                scale: float = 1.0) -> None:
+                scale: float = 1.0,
+                warm_scale: float | None = None) -> None:
         """The write-ahead admitted-request record: journaled (fsynced)
         before the submitting client gets its future back, carrying
-        everything a recovery replay needs (spec + scale)."""
-        self._journal({"event": "serve_request", "id": req_id,
-                       "spec": spec_dict, "scale": float(scale),
-                       "queue_depth": queue_depth})
+        everything a recovery replay needs (spec + scale). A non-zero
+        ``warm_scale`` (ISSUE 20, heat workload) rides as an ADDITIVE
+        field — cold requests keep their pre-zoo record bytes."""
+        rec = {"event": "serve_request", "id": req_id,
+               "spec": spec_dict, "scale": float(scale),
+               "queue_depth": queue_depth}
+        if warm_scale:
+            rec["warm_scale"] = float(warm_scale)
+        self._journal(rec)
         with self._lock:
             self.requests_total += 1
             self.queue_depth = queue_depth

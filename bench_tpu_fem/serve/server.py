@@ -125,8 +125,12 @@ def make_handler(broker: Broker, request_timeout_s: float = 300.0,
                         req.get("geom_perturb_fact", 0.0)),
                     deadline_s=(float(deadline_ms) / 1000.0
                                 if deadline_ms is not None else None),
+                    form=str(req.get("form", "poisson")),
                 )
                 scale = float(req.get("scale", 1.0))
+                # warm-start hint (ISSUE 20): the heat workload's
+                # previous-step scale; 0.0 (absent) is the cold path
+                warm_scale = float(req.get("warm_scale", 0.0))
             except (ValueError, TypeError, json.JSONDecodeError) as exc:
                 self._send(400, {"ok": False,
                                  "error": f"malformed request: {exc}",
@@ -134,7 +138,8 @@ def make_handler(broker: Broker, request_timeout_s: float = 300.0,
                                  "retriable": False})
                 return
             try:
-                pending = broker.submit(spec, scale)
+                pending = broker.submit(spec, scale,
+                                        warm_scale=warm_scale)
             except QueueFull as exc:
                 # the shed carries its own class + retry hint when the
                 # admission controller computed one (ISSUE 18): a

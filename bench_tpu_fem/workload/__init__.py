@@ -1,0 +1,22 @@
+"""Workload generators (ISSUE 20): temporally-correlated traffic for
+the serve layer and the implicit-Euler heat stepper that produces it.
+
+`traffic` owns the deterministic-seeded streams (scale random walks,
+mixed-spec request sequences) — same seed, same stream, byte for byte,
+so a load test replays exactly. `heat` owns the physics: the backward-
+Euler time stepper whose per-step CG solves are the workload's requests,
+and whose step-to-step solution continuity is WHY warm starts save
+iterations (the measured contract the perfgate pins).
+"""
+
+from .heat import HeatResult, run_heat, warm_start_savings
+from .traffic import heat_scale_stream, spec_mixture, warm_pairs
+
+__all__ = [
+    "HeatResult",
+    "run_heat",
+    "warm_start_savings",
+    "heat_scale_stream",
+    "spec_mixture",
+    "warm_pairs",
+]

@@ -1,0 +1,163 @@
+"""Backward-Euler heat stepper (ISSUE 20): the physics behind the
+temporally-correlated serve workload.
+
+Each time step of u_t = div(grad u) + f with homogeneous Dirichlet
+walls solves
+
+    (M + dt K) u^{n+1} = M u^n + dt b
+
+— exactly the registry's "heat" form row (grad_coeff = HEAT_DT,
+mass_coeff = 1) on the left, one mass-form apply on the right. The
+solve runs the SAME batched checkpointable CG the serve layer compiles
+(la.cg: one lane, rtol-frozen), so the per-step iteration counts
+measured here are the counts a served heat stream produces: warm runs
+seed each step's CG with the previous step's solution, cold runs start
+from zero, and the difference IS the warm-start savings the perfgate
+pins (scripts/perfgate.py `forms` leg, `heat_warm_start_iters_saved`).
+
+Everything is in-process and journal-free — the serve-side stream
+(workload.traffic + scripts/serve_loadgen.py --workload heat:N) is the
+end-to-end variant of the same physics under the RHS-as-scale protocol.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from ..forms.registry import HEAT_DT, HEAT_RTOL
+
+
+@dataclass
+class HeatResult:
+    """Per-step CG iteration accounting for one heat run."""
+
+    nsteps: int
+    warm: bool
+    rtol: float
+    dt: float
+    iters: list[int] = field(default_factory=list)
+    xnorms: list[float] = field(default_factory=list)
+
+    @property
+    def iters_total(self) -> int:
+        return int(sum(self.iters))
+
+    @property
+    def iters_after_first(self) -> list[int]:
+        """Steps 1..N-1 — the steps a warm start can help (step 0 has
+        no previous solution; warm and cold are identical there)."""
+        return self.iters[1:]
+
+
+def _build_problem(ndofs: int, degree: int, perturb: float, dtype):
+    """Mesh + heat/mass operators + assembled source RHS (host f64,
+    the oracle-precision convention every driver shares)."""
+    import jax.numpy as jnp
+
+    from ..elements.tables import build_operator_tables
+    from ..fem.assemble import assemble_rhs
+    from ..fem.geometry import geometry_factors
+    from ..fem.source import default_source
+    from ..forms.operators import build_form_operator
+    from ..forms.registry import form_spec
+    from ..mesh.box import create_box_mesh
+    from ..mesh.dofmap import (
+        boundary_dof_marker,
+        cell_dofmap,
+        dof_coordinates,
+        dof_grid_shape,
+    )
+    from ..mesh.sizing import compute_mesh_size
+
+    n = compute_mesh_size(ndofs, degree)
+    t = build_operator_tables(degree, 1, "gll")
+    mesh = create_box_mesh(n, geom_perturb_fact=perturb)
+    op_heat = build_form_operator(mesh, form_spec("heat"), degree, 1,
+                                  "gll", dtype=dtype, tables=t)
+    op_mass = build_form_operator(mesh, form_spec("mass"), degree, 1,
+                                  "gll", dtype=dtype, tables=t)
+    grid_shape = dof_grid_shape(n, degree)
+    bc_grid = boundary_dof_marker(n, degree)
+    coords = dof_coordinates(mesh.vertices, degree, t.nodes1d)
+    f = default_source(coords).ravel()
+    dm = cell_dofmap(n, degree)
+    corners = mesh.cell_corners.reshape(-1, 2, 2, 2, 3)
+    _, wdetJ = geometry_factors(corners, t.pts1d, t.wts1d,
+                                compute_G=False)
+    b = assemble_rhs(t, wdetJ, dm, f,
+                     bc_grid.ravel()).reshape(grid_shape)
+    return op_heat, op_mass, jnp.asarray(b, dtype)
+
+
+def run_heat(nsteps: int, ndofs: int = 4096, degree: int = 3,
+             perturb: float = 0.0, warm: bool = True,
+             rtol: float = HEAT_RTOL, max_iter: int = 200,
+             dtype=None) -> HeatResult:
+    """Run `nsteps` backward-Euler steps from u0 = 0 and return the
+    per-step CG iteration counts. `warm=True` seeds each step's CG
+    with the previous step's solution (x0 = u^n); `warm=False` starts
+    every step cold (x0 = 0) — same operators, same RHS sequence, same
+    rtol, so the iteration difference isolates the warm start.
+
+    Deterministic: no RNG anywhere (the forcing is the fixed benchmark
+    source), so two runs with the same arguments produce identical
+    iteration sequences.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from ..la.cg import (
+        batched_cg_init_warm,
+        batched_cg_run,
+        make_batched_cg_step,
+        unfused_batch_engine,
+    )
+
+    if dtype is None:
+        dtype = (jnp.float64 if jax.config.jax_enable_x64
+                 else jnp.float32)
+    if nsteps < 1:
+        raise ValueError(f"nsteps must be >= 1, got {nsteps}")
+    op_heat, op_mass, b = _build_problem(ndofs, degree, perturb, dtype)
+    dt = HEAT_DT
+
+    def _step(Ah, Am, u_n, x0, bb):
+        rhs = Am.apply(u_n) + dt * bb
+        st = batched_cg_init_warm(rhs[None], x0[None],
+                                  jax.vmap(Ah.apply), rtol=rtol)
+        step = make_batched_cg_step(
+            unfused_batch_engine(jax.vmap(Ah.apply)), max_iter,
+            rtol=rtol)
+        st = batched_cg_run(st, step, max_iter)
+        return st.X[0], st.iters[0]
+
+    step_fn = jax.jit(_step)
+    res = HeatResult(nsteps=nsteps, warm=warm, rtol=rtol, dt=dt)
+    u = jnp.zeros_like(b)
+    for _ in range(nsteps):
+        x0 = u if warm else jnp.zeros_like(b)
+        u, iters = step_fn(op_heat, op_mass, u, x0, b)
+        res.iters.append(int(np.asarray(iters)))
+        res.xnorms.append(float(jnp.sqrt(jnp.vdot(u, u).real)))
+    return res
+
+
+def warm_start_savings(nsteps: int, **kwargs) -> dict:
+    """Run the SAME heat time series warm and cold and fold the
+    iteration ledger the perfgate `forms` leg counters come from.
+    `heat_warm_start_iters_saved` is total cold minus total warm
+    iterations over the steps a warm start can influence (step 0
+    excluded: both runs are cold there by construction)."""
+    warm = run_heat(nsteps, warm=True, **kwargs)
+    cold = run_heat(nsteps, warm=False, **kwargs)
+    saved = sum(cold.iters_after_first) - sum(warm.iters_after_first)
+    return {
+        "nsteps": nsteps,
+        "iters_warm": warm.iters,
+        "iters_cold": cold.iters,
+        "iters_saved": int(saved),
+        "xnorm_final_warm": warm.xnorms[-1],
+        "xnorm_final_cold": cold.xnorms[-1],
+    }

@@ -1,0 +1,82 @@
+"""Deterministic temporally-correlated traffic streams (ISSUE 20).
+
+A serving benchmark is only as honest as its traffic: the warm-start
+savings the perfgate pins exist BECAUSE consecutive heat steps are
+correlated, so the generator must produce correlation that is (a)
+controlled — one drift knob, not an accident of the RNG — and (b)
+replayable — the same seed yields the same stream byte for byte, so a
+failed run re-executes on identical input (the same discipline as the
+chaos fault plans).
+
+Everything here is host-side numpy with an explicitly-seeded Generator;
+nothing touches jax or the wall clock.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+# Bounds on the scale random walk: the RHS-as-scale serve protocol is
+# linear in the scale, but a walk wandering to 1e6 (or 1e-6) would stop
+# resembling a physical time series and quietly change the xnorm
+# magnitudes every latency/SDC envelope was calibrated against.
+SCALE_MIN = 0.5
+SCALE_MAX = 2.0
+
+
+def heat_scale_stream(nsteps: int, seed: int = 0,
+                      drift: float = 0.01) -> np.ndarray:
+    """A bounded multiplicative random walk of RHS scales — the
+    temporally-correlated request stream of a heat time series under
+    the RHS-as-scale protocol: step k's RHS is scales[k] * b for the
+    canonical RHS b, and consecutive scales differ by O(drift).
+
+    Deterministic in (nsteps, seed, drift): numpy's PCG64 stream is
+    versioned and platform-stable, so a replay regenerates the exact
+    array (tests/test_workload.py pins this).
+    """
+    if nsteps < 1:
+        raise ValueError(f"nsteps must be >= 1, got {nsteps}")
+    rng = np.random.default_rng(seed)
+    scales = np.empty(nsteps, np.float64)
+    s = 1.0
+    for k in range(nsteps):
+        scales[k] = s
+        s = float(np.clip(s * (1.0 + drift * rng.standard_normal()),
+                          SCALE_MIN, SCALE_MAX))
+    return scales
+
+
+def warm_pairs(scales) -> list:
+    """Fold a scale stream into (scale, warm_scale) request pairs: the
+    warm hint for step k is step k-1's scale (the previous solution
+    under the RHS-as-scale protocol, x_{k-1} = scales[k-1] * xbase),
+    and step 0 is cold (warm 0.0 — bitwise the cold admit)."""
+    scales = np.asarray(scales, np.float64)
+    return [(float(s), float(scales[k - 1]) if k else 0.0)
+            for k, s in enumerate(scales)]
+
+
+def spec_mixture(nreq: int, seed: int = 0,
+                 forms=("poisson", "mass", "varkappa", "heat"),
+                 degrees=(1, 3), ndofs: int = 4096,
+                 nreps: int = 30) -> list[dict]:
+    """A deterministic mixed-spec request sequence: each entry is a
+    kwargs dict for serve.engine.SolveSpec (plus a "scale" key), drawn
+    form-and-degree uniform from the given sets. The mixture exercises
+    the executable cache's form axis (every (form, degree) pair is its
+    own ExecutableKey) and the broker's compatible-batch gathering
+    under heterogeneous traffic."""
+    if nreq < 1:
+        raise ValueError(f"nreq must be >= 1, got {nreq}")
+    rng = np.random.default_rng(seed)
+    out = []
+    for _ in range(nreq):
+        out.append({
+            "form": str(rng.choice(list(forms))),
+            "degree": int(rng.choice(list(degrees))),
+            "ndofs": int(ndofs),
+            "nreps": int(nreps),
+            "scale": float(rng.uniform(0.8, 1.2)),
+        })
+    return out

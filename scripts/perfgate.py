@@ -50,6 +50,13 @@ Three in-process measurements (no subprocesses, no network):
     one hedge fires and wins through the claim CAS, and the brownout
     ladder steps once under sustained burn then recovers on hysteresis
     (brownout_steps gates HIGHER — the CI probe zeroes it).
+  * **forms** (ISSUE 20): the operator zoo's device-action-vs-CSR
+    parity flags (mass/helmholtz/varkappa/heat on the fixed-seed
+    perturbed problem, pinned True) and a 200-step temporally-
+    correlated heat stream served warm-vs-cold through a 2-lane fleet
+    — ``heat_warm_start_iters_saved`` gates HIGHER (the CI
+    ``BENCH_SUPPRESS_WARMSTART=1`` probe zeroes it; the collector
+    itself refuses zero savings) over a closed exactly-once ledger.
 
 The counters land in ``snapshot["counters"]`` (the hard gate);
 wall-clock distributions stay inside the per-section ``timing`` blocks
@@ -569,6 +576,101 @@ def main(argv=None) -> int:
         "exactly_once": ov_ledger,
     }
 
+    # -- forms leg (ISSUE 20): the operator zoo's parity contract + the
+    # heat workload's warm-start savings, end to end through the fleet.
+    # Parity is deterministic arithmetic (each form's device action vs
+    # the CSR oracle assembled from the SAME tables/geometry on the
+    # fixed-seed perturbed problem — contract flags, pinned True); the
+    # savings counter is a deterministic function of the pinned
+    # 200-step temporally-correlated scale stream (HIGHER table — the
+    # CI suppression probe, BENCH_SUPPRESS_WARMSTART=1, zeroes it, and
+    # the collector itself refuses to snapshot zero savings).
+    import numpy as _np
+
+    from bench_tpu_fem.elements import build_operator_tables
+    from bench_tpu_fem.fem.assemble import (
+        assemble_csr,
+        element_form_matrices,
+    )
+    from bench_tpu_fem.fem.geometry import geometry_factors
+    from bench_tpu_fem.forms.operators import (
+        build_form_operator,
+        kappa_at_quadrature,
+    )
+    from bench_tpu_fem.forms.registry import form_spec
+    from bench_tpu_fem.mesh.box import create_box_mesh
+    from bench_tpu_fem.mesh.dofmap import (
+        boundary_dof_marker,
+        cell_dofmap,
+        dof_grid_shape,
+    )
+    from bench_tpu_fem.workload import heat_scale_stream, warm_pairs
+
+    form_parity = {}
+    fm_n, fm_degree, fm_perturb = (3, 2, 2), 3, 0.15
+    fm_mesh = create_box_mesh(fm_n, geom_perturb_fact=fm_perturb)
+    fm_t = build_operator_tables(fm_degree, 1, "gll")
+    fm_corners = fm_mesh.cell_corners.reshape(-1, 2, 2, 2, 3)
+    fm_G, fm_wdetJ = geometry_factors(fm_corners, fm_t.pts1d, fm_t.wts1d)
+    fm_dm = cell_dofmap(fm_n, fm_degree)
+    fm_bc = boundary_dof_marker(fm_n, fm_degree).ravel()
+    fm_rng = _np.random.default_rng(20)
+    fm_shape = dof_grid_shape(fm_n, fm_degree)
+    for fname in ("mass", "helmholtz", "varkappa", "heat"):
+        fs = form_spec(fname)
+        fop = build_form_operator(fm_mesh, fs, fm_degree, 1, "gll",
+                                  dtype=jnp.float64, tables=fm_t)
+        kq = (kappa_at_quadrature(fm_corners, fm_t.pts1d)
+              if fs.coefficient == "varkappa" else None)
+        fA = assemble_csr(
+            element_form_matrices(fm_t, fm_G, fm_wdetJ, fs.grad_coeff,
+                                  fs.mass_coeff, kq=kq), fm_dm, fm_bc)
+        fx = fm_rng.standard_normal(fA.shape[0])
+        fy = _np.asarray(fop.apply(jnp.asarray(
+            fx.reshape(fm_shape)))).ravel()
+        fref = fA @ fx
+        frel = float(_np.linalg.norm(fy - fref)
+                     / _np.linalg.norm(fref))
+        form_parity[fname] = {"rel": frel, "ok": frel < 1e-12}
+
+    forms_journal = args.out + ".forms.jsonl"
+    try:
+        os.unlink(forms_journal)
+    except OSError:
+        pass
+    heat_fleet = FleetDispatcher(2, journal_path=forms_journal,
+                                 queue_max=64, nrhs_max=2,
+                                 window_s=0.01, balance_interval_s=0)
+    heat_spec = SolveSpec(degree=3, ndofs=2000, nreps=400,
+                          precision="f64", form="heat")
+    heat_pairs = warm_pairs(heat_scale_stream(200, seed=0, drift=0.01))
+    try:
+        heat_iters = {}
+        for warmed in (True, False):
+            iters = []
+            for scale, wsc in heat_pairs:
+                hp = heat_fleet.submit(heat_spec, scale=scale,
+                                       warm_scale=wsc if warmed else 0.0)
+                hout = heat_fleet.wait(hp, 120.0)
+                if not hout.get("ok"):
+                    print(f"forms leg heat request failed: {hout}")
+                    return 1
+                iters.append(int(hout["iters_run"]))
+            heat_iters["warm" if warmed else "cold"] = iters
+    finally:
+        heat_fleet.shutdown()
+    heat_saved = (sum(heat_iters["cold"][1:])
+                  - sum(heat_iters["warm"][1:]))
+    heat_ledger = verify_exactly_once(forms_journal)
+    forms_leg = {
+        "parity": form_parity,
+        "heat": {"nsteps": len(heat_pairs),
+                 "iters_warm_total": sum(heat_iters["warm"]),
+                 "iters_cold_total": sum(heat_iters["cold"]),
+                 "iters_saved": heat_saved},
+        "exactly_once": heat_ledger,
+    }
+
     # -- trace validity + record contract (contract booleans gate)
     from bench_tpu_fem.obs.trace import validate_chrome_trace
 
@@ -673,6 +775,21 @@ def main(argv=None) -> int:
         "hedge_duplicates": len(ov_ledger["duplicates"]),
         "brownout_steps": ov_fleet["brownout_steps"],
         "brownout_recoveries": ov_fleet["brownout_recoveries"],
+        # ISSUE 20 operator-zoo counters: per-form parity vs the CSR
+        # oracle pins True (contract flags — arithmetic, not timing),
+        # and the 200-step heat stream's warm-start savings pin in the
+        # HIGHER table (a shrink is the warm path regressing; the CI
+        # probe suppresses warm hints and must gate rc 1). The label
+        # makes a future stream-config change a LABELLED gap instead of
+        # a phantom regression.
+        "form_parity_ok_mass": form_parity["mass"]["ok"],
+        "form_parity_ok_helmholtz": form_parity["helmholtz"]["ok"],
+        "form_parity_ok_varkappa": form_parity["varkappa"]["ok"],
+        "form_parity_ok_heat": form_parity["heat"]["ok"],
+        "heat_warm_start_iters_saved": heat_saved,
+        "heat_warm_start_label": (
+            f"heat{len(heat_pairs)}:d{heat_spec.degree}"
+            f":n{heat_spec.ndofs}:seed0:drift0.01"),
     }
     snapshot = {
         "workload": {"ndofs": args.ndofs, "nreps": args.nreps,
@@ -690,6 +807,7 @@ def main(argv=None) -> int:
         "autotune": autotune_leg,
         "bf16": bf16_leg,
         "overload": overload_leg,
+        "forms": forms_leg,
         "counters": counters,
         "record_contract_errors": record_errs,
         "trace_violations": trace_violations[:5],
@@ -853,6 +971,24 @@ def main(argv=None) -> int:
     if (ovd_out.get("degraded") or {}).get("to") != "bf16":
         print(f"overload leg degraded provenance missing: "
               f"{ovd_out.get('degraded')}")
+        return 1
+    # ISSUE-20 acceptance, asserted by the collector itself: every form
+    # matches the CSR oracle at f64, the 200-step heat stream's warm
+    # starts SAVE iterations (zero savings means the hints were
+    # suppressed or the warm path regressed — the exact state the CI
+    # BENCH_SUPPRESS_WARMSTART probe injects), and the stream's
+    # exactly-once ledger closes
+    bad_parity = {f: v for f, v in form_parity.items() if not v["ok"]}
+    if bad_parity:
+        print(f"forms leg parity broken vs the CSR oracle: {bad_parity}")
+        return 1
+    if heat_saved <= 0:
+        print(f"heat warm starts saved no iterations (saved="
+              f"{heat_saved}): warm hints suppressed or warm-start "
+              f"path regressed: {forms_leg['heat']}")
+        return 1
+    if not heat_ledger["ok"]:
+        print(f"forms exactly-once ledger violated: {heat_ledger}")
         return 1
     return 0
 

@@ -249,6 +249,107 @@ def run_load(url: str, requests: int = 64, concurrency: int = 16,
     return _finish_summary(out, requests, t0, url)
 
 
+def heat_stream(nsteps: int, seed: int = 0,
+                drift: float = 0.01) -> list:
+    """Deterministic temporally-correlated RHS-scale stream (stdlib
+    random — the loadgen must not import the repo or numpy; the repo's
+    workload.traffic generator is the in-process twin). Bounded
+    multiplicative walk, same clip bounds as workload.traffic."""
+    import random
+    rng = random.Random(seed)
+    scales, s = [], 1.0
+    for _ in range(nsteps):
+        scales.append(s)
+        s = min(2.0, max(0.5, s * (1.0 + drift * rng.gauss(0.0, 1.0))))
+    return scales
+
+
+def run_heat_workload(url: str, nsteps: int, degree: int = 3,
+                      ndofs: int = 4000, nreps: int = 200,
+                      precision: str = "f64", timeout_s: float = 120.0,
+                      seed: int = 0, drift: float = 0.01) -> dict:
+    """The heat-equation serve workload (ISSUE 20): drive the SAME
+    temporally-correlated scale stream through the server twice —
+    first WARM (each request carries warm_scale = the previous step's
+    scale, the previous solution under the RHS-as-scale protocol),
+    then COLD (warm_scale 0) — strictly sequentially, because step k's
+    warm hint IS step k-1's state. The per-step `iters_run` counts come
+    straight off the responses (journaled server-side as serve_retire),
+    so the savings are measured evidence, not a client-side model."""
+    scales = heat_stream(nsteps, seed=seed, drift=drift)
+    out = {"workload": "heat", "nsteps": nsteps, "seed": seed,
+           "drift": drift, "completed": 0, "failed": 0,
+           "failed_by_class": {}, "scales": scales,
+           "iters_warm": [], "iters_cold": []}
+
+    def drive(warm: bool) -> list:
+        iters, prev = [], 0.0
+        for s in scales:
+            body = {"degree": degree, "ndofs": ndofs, "nreps": nreps,
+                    "precision": precision, "form": "heat",
+                    "scale": s, "warm_scale": prev if warm else 0.0}
+            code, resp = _post(url, body, timeout_s)
+            if code != 200 and resp.get("retriable"):
+                hint = resp.get("retry_after_s")
+                time.sleep(float(hint) if isinstance(hint, (int, float))
+                           and 0 < hint <= 30 else 1.0)
+                code, resp = _post(url, body, timeout_s)
+            if code == 200 and resp.get("ok"):
+                out["completed"] += 1
+                iters.append(int(resp.get("iters_run", -1)))
+            else:
+                out["failed"] += 1
+                fc = resp.get("failure_class", "unknown")
+                out["failed_by_class"][fc] = \
+                    out["failed_by_class"].get(fc, 0) + 1
+                iters.append(-1)
+            prev = s
+        return iters
+
+    t0 = time.monotonic()
+    out["iters_warm"] = drive(True)
+    out["iters_cold"] = drive(False)
+    out["wall_s"] = round(time.monotonic() - t0, 3)
+    ok = [k for k in range(nsteps)
+          if out["iters_warm"][k] >= 0 and out["iters_cold"][k] >= 0]
+    # step 0 is cold in both passes by construction — savings count
+    # only the steps a warm hint can influence
+    out["iters_saved"] = sum(
+        out["iters_cold"][k] - out["iters_warm"][k]
+        for k in ok if k > 0)
+    try:
+        with urllib.request.urlopen(url + "/metrics", timeout=30) as r:
+            out["metrics"] = json.loads(r.read())
+    except OSError as exc:
+        out["metrics"] = {"error": str(exc)}
+    return out
+
+
+def render_heat_table(summary: dict, max_rows: int = 12) -> str:
+    """Warm-start savings table (stderr — stdout stays the one JSON
+    line): per-step cold vs warm iteration counts and the total."""
+    warm, cold = summary.get("iters_warm"), summary.get("iters_cold")
+    if not warm or not cold:
+        return ""
+    lines = [f"{'step':>5s} {'scale':>9s} {'cold':>6s} {'warm':>6s} "
+             f"{'saved':>6s}"]
+    for k in range(len(warm)):
+        if k == max_rows:
+            lines.append(f"{'...':>5s} ({len(warm) - max_rows} more "
+                         "steps)")
+            break
+        sc = summary["scales"][k]
+        c, w = cold[k], warm[k]
+        saved = (c - w) if (c >= 0 and w >= 0) else 0
+        lines.append(f"{k:>5d} {sc:>9.4f} {c:>6d} {w:>6d} {saved:>6d}")
+    tot_c = sum(i for i in cold if i >= 0)
+    tot_w = sum(i for i in warm if i >= 0)
+    lines.append(f"{'total':>5s} {'':>9s} {tot_c:>6d} {tot_w:>6d} "
+                 f"{summary.get('iters_saved', 0):>6d}"
+                 "  (step 0 excluded from saved: cold both passes)")
+    return "\n".join(lines)
+
+
 def run_fleet_load(url: str, requests: int = 640, concurrency: int = 32,
                    degrees=(1, 2, 3), weights=(4, 1, 1),
                    ndofs: int = 4000, nreps: int = 15,
@@ -535,6 +636,22 @@ def main(argv=None) -> int:
                         "deadline_exceeded_late == 0 (every deadline "
                         "miss was refused EARLY — before a solve "
                         "burned — never discovered after)")
+    p.add_argument("--workload", default="",
+                   metavar="NAME:N",
+                   help="serve a generated workload instead of the "
+                        "degree mix: 'heat:N' drives an N-step "
+                        "temporally-correlated heat stream twice "
+                        "(warm-hinted then cold) and reports the "
+                        "measured warm-start iteration savings "
+                        "(stderr table; stdout stays one JSON line)")
+    p.add_argument("--seed", type=int, default=0,
+                   help="workload stream seed (deterministic replay)")
+    p.add_argument("--drift", type=float, default=0.01,
+                   help="workload scale-walk step size (temporal "
+                        "correlation strength)")
+    p.add_argument("--assert-warm-savings", action="store_true",
+                   help="heat workload: fail unless the measured "
+                        "warm-start savings are positive")
     p.add_argument("--fleet", action="store_true",
                    help="fleet acceptance mode (ISSUE 13): worker-pool "
                         "driver with a deterministically IMBALANCED "
@@ -588,6 +705,36 @@ def main(argv=None) -> int:
             burst = (float(n_ms), int(m))
         except ValueError:
             p.error(f"--burst wants N:M (ms:count), got {args.burst!r}")
+    if args.workload:
+        try:
+            wname, wsteps = args.workload.split(":")
+            wsteps = int(wsteps)
+            if wname != "heat":
+                raise ValueError(wname)
+        except ValueError:
+            p.error(f"--workload wants heat:N, got {args.workload!r}")
+        summary = run_heat_workload(
+            args.url, wsteps, degree=degrees[0], ndofs=args.ndofs,
+            nreps=args.nreps, precision=args.precision,
+            timeout_s=args.timeout, seed=args.seed, drift=args.drift)
+        rc = 0 if summary["failed"] == 0 else 1
+        if args.assert_warm_savings:
+            if summary.get("iters_saved", 0) <= 0:
+                summary["assert_warm_savings"] = (
+                    f"FAIL: warm-start saved "
+                    f"{summary.get('iters_saved')} iterations (expected "
+                    "> 0 — was the warm hint dropped, or suppression "
+                    "left on?)")
+                rc = 1
+            else:
+                summary["assert_warm_savings"] = "ok"
+        table = render_heat_table(summary)
+        if table:
+            print("== heat workload: warm-start iteration savings",
+                  file=sys.stderr)
+            print(table, file=sys.stderr)
+        print(json.dumps(summary))
+        return rc
     if args.fleet:
         summary = run_fleet_load(
             args.url, requests=args.requests,

@@ -1,0 +1,224 @@
+"""Operator zoo (ISSUE 20): form actions vs the assembled-CSR oracle,
+the Helmholtz breakdown taxonomy, warm-start iteration savings, the
+driver's form gates, and the Poisson bitwise pin.
+
+The parity matrix is the acceptance contract: every registry form,
+uniform AND perturbed geometry, degrees {1, 3, 6}, device action vs the
+scipy CSR assembled from the same element matrices — relative error at
+f64 below 1e-12. The Poisson pin is the other half of the contract: the
+zoo must not have moved a single bit of the seed benchmark's kernel.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from bench_tpu_fem.bench.driver import BenchConfig, run_benchmark
+from bench_tpu_fem.elements import build_operator_tables
+from bench_tpu_fem.engines.registry import gate_reason
+from bench_tpu_fem.fem.assemble import assemble_csr, element_form_matrices
+from bench_tpu_fem.fem.geometry import geometry_factors
+from bench_tpu_fem.forms.operators import build_form_operator, kappa_at_quadrature
+from bench_tpu_fem.forms.registry import FORM_NAMES, form_spec
+from bench_tpu_fem.mesh.box import create_box_mesh
+from bench_tpu_fem.mesh.dofmap import boundary_dof_marker, cell_dofmap, dof_grid_shape
+
+
+def _parity_setup(form, degree, perturb, n=(3, 2, 2)):
+    """Device form action + assembled CSR from the same tables/geometry."""
+    fspec = form_spec(form)
+    mesh = create_box_mesh(n, geom_perturb_fact=perturb)
+    t = build_operator_tables(degree, 1, "gll")
+    op = build_form_operator(mesh, fspec, degree, 1, "gll",
+                             dtype=jnp.float64, tables=t)
+    corners = mesh.cell_corners.reshape(-1, 2, 2, 2, 3)
+    G, wdetJ = geometry_factors(corners, t.pts1d, t.wts1d)
+    kq = (kappa_at_quadrature(corners, t.pts1d)
+          if fspec.coefficient == "varkappa" else None)
+    elem = element_form_matrices(t, G, wdetJ, fspec.grad_coeff,
+                                 fspec.mass_coeff, kq=kq)
+    dm = cell_dofmap(n, degree)
+    bc = boundary_dof_marker(n, degree).ravel()
+    A = assemble_csr(elem, dm, bc)
+    return op, A, dof_grid_shape(n, degree)
+
+
+@pytest.mark.parametrize("degree", [1, 3, 6])
+@pytest.mark.parametrize("perturb", [0.0, 0.15])
+@pytest.mark.parametrize("form", ["mass", "helmholtz", "varkappa", "heat"])
+def test_form_action_matches_csr(form, degree, perturb):
+    op, A, grid_shape = _parity_setup(form, degree, perturb)
+    rng = np.random.default_rng(degree * 100 + int(perturb * 100))
+    x = rng.standard_normal(A.shape[0])
+    y_dev = np.asarray(op.apply(jnp.asarray(x.reshape(grid_shape)))).ravel()
+    # the CSR oracle keeps Dirichlet pass-through rows (unit diagonal),
+    # matching the operator's y[bc] = x[bc] contract
+    y_ref = A @ x
+    rel = np.linalg.norm(y_dev - y_ref) / np.linalg.norm(y_ref)
+    assert rel < 1e-12, (form, degree, perturb, rel)
+
+
+def test_mass_form_never_builds_gradient_tensors():
+    op, _, _ = _parity_setup("mass", 2, 0.0)
+    assert op.G is None and op.wdetJ is not None
+    assert op.with_mass and not op.with_grad
+
+
+def test_gradient_forms_never_build_wdetj():
+    op, _, _ = _parity_setup("varkappa", 2, 0.0)
+    assert op.wdetJ is None and op.G is not None
+
+
+def test_helmholtz_is_indefinite_on_resolving_mesh():
+    # k^2 = 100 sits above the first Dirichlet eigenvalue 3*pi^2 ~ 29.6:
+    # the assembled operator must have both signs in its spectrum.
+    _, A, _ = _parity_setup("helmholtz", 3, 0.0, n=(4, 4, 4))
+    eigs = np.linalg.eigvalsh(A.toarray())
+    assert eigs[0] < 0 < eigs[-1], (eigs[0], eigs[-1])
+
+
+def test_registry_names_stable():
+    assert FORM_NAMES == ("poisson", "mass", "helmholtz", "varkappa",
+                          "heat")
+    with pytest.raises(ValueError):
+        form_spec("biharmonic")
+
+
+# ---------------------------------------------------------------------------
+# Poisson bitwise pin: the zoo must not perturb the seed benchmark path.
+
+def _frozen_poisson_cell_apply(u, G, phi0, dphi1, kappa, is_identity):
+    """Byte-for-byte replica of the pre-zoo ops.laplacian einsum chain
+    (laplacian_gpu.hpp:174-421 as batched einsums). Frozen here on
+    purpose: if a refactor reorders one contraction in the live kernel,
+    this test fails bitwise, not approximately."""
+    hi = jax.lax.Precision.HIGHEST
+    if not is_identity:
+        u = jnp.einsum("qi,eijk->eqjk", phi0, u, precision=hi)
+        u = jnp.einsum("rj,eqjk->eqrk", phi0, u, precision=hi)
+        u = jnp.einsum("sk,eqrk->eqrs", phi0, u, precision=hi)
+    du0 = jnp.einsum("xi,eijk->exjk", dphi1, u, precision=hi)
+    du1 = jnp.einsum("yj,eijk->eiyk", dphi1, u, precision=hi)
+    du2 = jnp.einsum("zk,eijk->eijz", dphi1, u, precision=hi)
+    G0, G1, G2, G3, G4, G5 = (G[:, c] for c in range(6))
+    f0 = kappa * (G0 * du0 + G1 * du1 + G2 * du2)
+    f1 = kappa * (G1 * du0 + G3 * du1 + G4 * du2)
+    f2 = kappa * (G2 * du0 + G4 * du1 + G5 * du2)
+    y = (
+        jnp.einsum("qi,eqjk->eijk", dphi1, f0, precision=hi)
+        + jnp.einsum("qj,eiqk->eijk", dphi1, f1, precision=hi)
+        + jnp.einsum("qk,eijq->eijk", dphi1, f2, precision=hi)
+    )
+    if not is_identity:
+        y = jnp.einsum("qi,eqjk->eijk", phi0, y, precision=hi)
+        y = jnp.einsum("qj,eiqk->eijk", phi0, y, precision=hi)
+        y = jnp.einsum("qk,eijq->eijk", phi0, y, precision=hi)
+    return y
+
+
+@pytest.mark.parametrize("perturb", [0.0, 0.2])
+def test_poisson_kernel_bitwise_pinned(perturb):
+    from bench_tpu_fem.ops.laplacian import (
+        build_laplacian,
+        fold_cells,
+        gather_cells,
+    )
+
+    n, degree = (3, 2, 2), 3
+    mesh = create_box_mesh(n, geom_perturb_fact=perturb)
+    lap = build_laplacian(mesh, degree, 1, "gll", dtype=jnp.float64)
+    grid_shape = dof_grid_shape(n, degree)
+    rng = np.random.default_rng(7)
+    x = jnp.asarray(rng.standard_normal(grid_shape))
+    y_live = jax.jit(lap.apply)(x)
+
+    def frozen_apply(x_grid):
+        xm = jnp.where(lap.bc_mask, 0, x_grid)
+        u = gather_cells(xm, lap.n, lap.degree)
+        y = _frozen_poisson_cell_apply(u, lap.G, lap.phi0, lap.dphi1,
+                                       lap.kappa, lap.is_identity)
+        return jnp.where(lap.bc_mask, x_grid, fold_cells(y, lap.n, lap.degree))
+
+    y_frozen = jax.jit(frozen_apply)(x)
+    assert np.array_equal(np.asarray(y_live), np.asarray(y_frozen)), (
+        "poisson kernel output moved bitwise vs the frozen pre-zoo replica")
+
+
+# ---------------------------------------------------------------------------
+# Driver integration: taxonomy stamps and form gates.
+
+def _form_cfg(**kw):
+    base = dict(ndofs_global=2000, degree=3, qmode=1, float_bits=64,
+                nreps=30, use_cg=True)
+    base.update(kw)
+    return BenchConfig(**base)
+
+
+def test_driver_helmholtz_breakdown_classified_not_crashed():
+    res = run_benchmark(_form_cfg(form="helmholtz"))
+    sent = res.extra["cg_sentinel"]
+    assert set(sent) == {"breakdown_restarts", "nonfinite", "stag_max"}
+    # the indefinite shift must actually trip the taxonomy (otherwise
+    # this test proves nothing): restarts or stagnation, and never NaN
+    assert sent["breakdown_restarts"] > 0 or sent["stag_max"] > 0, sent
+    assert sent["nonfinite"] is False, sent
+    assert res.extra["form"] == "helmholtz"
+    assert np.isfinite(res.ynorm)
+
+
+def test_driver_form_parity_against_csr_oracle():
+    res = run_benchmark(_form_cfg(form="mass", use_cg=False, mat_comp=True))
+    assert res.enorm / res.znorm < 1e-12, (res.enorm, res.znorm)
+
+
+def test_driver_form_gates_raise_registered_reasons():
+    for kw, slug, fmt in [
+        (dict(f64_impl="df32"), "form-df", {"form": "mass"}),
+        (dict(ndevices=2), "form-sharded", {"form": "mass"}),
+        (dict(nrhs=2), "form-batched", {"form": "mass"}),
+        (dict(backend="pallas"), "form-backend",
+         {"form": "mass", "backend": "pallas"}),
+    ]:
+        with pytest.raises(ValueError) as ei:
+            run_benchmark(_form_cfg(form="mass", **kw))
+        assert gate_reason(slug, **fmt) in str(ei.value), (slug, ei.value)
+
+
+def test_driver_helmholtz_precond_gates_off_with_taxonomy_reason():
+    res = run_benchmark(_form_cfg(form="helmholtz", precond="jacobi"))
+    assert res.extra["precond_gate_reason"] == gate_reason(
+        "helmholtz-precond")
+
+
+def test_driver_spd_form_precond_gate_is_generic():
+    res = run_benchmark(_form_cfg(form="mass", precond="jacobi"))
+    assert res.extra["precond_gate_reason"] == gate_reason(
+        "form-precond", form="mass")
+
+
+# ---------------------------------------------------------------------------
+# Warm starts: the heat workload's iteration savings.
+
+def test_heat_warm_start_monotone_iteration_reduction():
+    from bench_tpu_fem.workload import run_heat
+
+    warm = run_heat(6, ndofs=1500, degree=2, warm=True)
+    cold = run_heat(6, ndofs=1500, degree=2, warm=False)
+    # step 0 is cold in both runs by construction
+    assert warm.iters[0] == cold.iters[0]
+    # every warm-started step must be no worse than its cold twin, and
+    # the series strictly better in total (the perfgate counter)
+    for k, (w, c) in enumerate(zip(warm.iters_after_first,
+                                   cold.iters_after_first)):
+        assert w <= c, (k + 1, warm.iters, cold.iters)
+    assert sum(warm.iters_after_first) < sum(cold.iters_after_first)
+
+
+def test_heat_run_is_deterministic():
+    from bench_tpu_fem.workload import run_heat
+
+    a = run_heat(3, ndofs=1000, degree=2)
+    b = run_heat(3, ndofs=1000, degree=2)
+    assert a.iters == b.iters
+    assert a.xnorms == b.xnorms

@@ -528,14 +528,15 @@ def test_round6_agenda_shape():
     names = A.resolve_stage_names(A.AGENDAS["round6"], stages)
     assert names[0] == "health" and stages["health"].critical
     # the CPU-provable software stages (serve smoke, chaos soak, the
-    # overload-resilience leg — ISSUE 18 — and the autotune sweep that
-    # persists the round's tuning DB — ISSUE 16) run before the
-    # hardware stages; the fused-batched hardware smoke is armed right
-    # after them (ISSUE 6/9)
-    assert names[:6] == ["health", "serve", "chaos", "overload",
-                         "autotune", "fusedbatch"]
+    # overload-resilience leg — ISSUE 18, the operator-zoo forms leg —
+    # ISSUE 20 — and the autotune sweep that persists the round's
+    # tuning DB — ISSUE 16) run before the hardware stages; the
+    # fused-batched hardware smoke is armed right after them (ISSUE 6/9)
+    assert names[:7] == ["health", "serve", "chaos", "overload",
+                         "forms", "autotune", "fusedbatch"]
     assert stages["chaos"].env["JAX_PLATFORMS"] == "cpu"
     assert stages["overload"].env["JAX_PLATFORMS"] == "cpu"
+    assert stages["forms"].env["JAX_PLATFORMS"] == "cpu"
     # the capacity ladders opt into durable checkpoints (ISSUE 9)
     assert stages["dflarge100"].ckpt_every > 0
     assert stages["dfacc"].provides_gate == "dfacc"

@@ -1,0 +1,166 @@
+"""Workload generators (ISSUE 20): deterministic traffic replay and the
+heat stream's serve-side contracts — exactly-once under the warm-started
+stream, additive journal fields, and warm-vs-cold iteration savings
+through the live broker.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+from bench_tpu_fem.serve import Broker, ExecutableCache, Metrics, SolveSpec
+from bench_tpu_fem.serve.recovery import verify_exactly_once
+from bench_tpu_fem.workload import heat_scale_stream, spec_mixture, warm_pairs
+from bench_tpu_fem.workload.traffic import SCALE_MAX, SCALE_MIN
+
+
+# ---------------------------------------------------------------------------
+# Traffic generator: deterministic replay.
+
+def test_heat_scale_stream_replays_bit_for_bit():
+    a = heat_scale_stream(64, seed=3, drift=0.02)
+    b = heat_scale_stream(64, seed=3, drift=0.02)
+    assert np.array_equal(a, b)
+    assert a[0] == 1.0
+    assert a.min() >= SCALE_MIN and a.max() <= SCALE_MAX
+
+
+def test_heat_scale_stream_seeds_differ():
+    a = heat_scale_stream(64, seed=0)
+    b = heat_scale_stream(64, seed=1)
+    assert not np.array_equal(a, b)
+
+
+def test_heat_scale_stream_is_temporally_correlated():
+    # consecutive steps differ by O(drift), not O(1): the property the
+    # warm-start savings depend on
+    s = heat_scale_stream(200, seed=0, drift=0.01)
+    rel = np.abs(np.diff(s)) / s[:-1]
+    assert rel.max() < 0.05, rel.max()
+
+
+def test_heat_scale_stream_rejects_empty():
+    with pytest.raises(ValueError):
+        heat_scale_stream(0)
+
+
+def test_warm_pairs_shift_scales_by_one_step():
+    pairs = warm_pairs([1.0, 1.1, 0.9])
+    assert pairs == [(1.0, 0.0), (1.1, 1.0), (0.9, 1.1)]
+
+
+def test_spec_mixture_replays_and_varies():
+    a = spec_mixture(32, seed=5)
+    assert a == spec_mixture(32, seed=5)
+    assert a != spec_mixture(32, seed=6)
+    forms = {d["form"] for d in a}
+    assert forms <= {"poisson", "mass", "varkappa", "heat"}
+    assert len(forms) > 1
+    # every entry must construct a valid spec (scale rides separately)
+    for d in a:
+        SolveSpec(**{k: v for k, v in d.items() if k != "scale"})
+
+
+# ---------------------------------------------------------------------------
+# Heat stream through the broker: exactly-once + warm-start savings.
+
+def _heat_broker(journal=None):
+    return Broker(ExecutableCache(), Metrics(journal), queue_max=64,
+                  nrhs_max=2, window_s=0.01, solve_timeout_s=120.0)
+
+
+HEAT_SPEC = SolveSpec(degree=3, ndofs=2000, nreps=400, precision="f64",
+                      form="heat")
+
+
+def _run_stream(broker, pairs, warmed):
+    outs = []
+    for scale, wsc in pairs:
+        p = broker.submit(HEAT_SPEC, scale,
+                          warm_scale=wsc if warmed else 0.0)
+        outs.append(broker.wait(p, 120))
+    return outs
+
+
+def test_heat_stream_exactly_once_with_warm_savings(tmp_path):
+    journal = str(tmp_path / "heat.jsonl")
+    pairs = warm_pairs(heat_scale_stream(8, seed=0, drift=0.01))
+    broker = _heat_broker(journal)
+    try:
+        warm_outs = _run_stream(broker, pairs, warmed=True)
+        cold_outs = _run_stream(broker, pairs, warmed=False)
+    finally:
+        broker.shutdown()
+    assert all(o["ok"] for o in warm_outs + cold_outs)
+    ledger = verify_exactly_once(journal)
+    assert ledger["ok"], ledger
+    assert ledger["responded"] == 2 * len(pairs)
+    # rtol-budgeted lanes retire early, and the warm hint must save
+    # iterations on every step after the first
+    warm_iters = [o["iters_run"] for o in warm_outs]
+    cold_iters = [o["iters_run"] for o in cold_outs]
+    assert warm_iters[0] == cold_iters[0]
+    assert sum(warm_iters[1:]) < sum(cold_iters[1:]), (warm_iters,
+                                                       cold_iters)
+    # warm and cold answer the same problem: xnorms agree to the rtol
+    for w, c in zip(warm_outs, cold_outs):
+        assert w["xnorm"] == pytest.approx(c["xnorm"], rel=1e-4)
+
+
+def test_heat_stream_journal_fields_are_additive(tmp_path):
+    journal = str(tmp_path / "heat.jsonl")
+    pairs = warm_pairs(heat_scale_stream(4, seed=1, drift=0.01))
+    broker = _heat_broker(journal)
+    try:
+        _run_stream(broker, pairs, warmed=True)
+    finally:
+        broker.shutdown()
+    reqs = [json.loads(line) for line in open(journal)
+            if json.loads(line).get("event") == "serve_request"]
+    assert len(reqs) == len(pairs)
+    # the form rides the journaled spec; warm_scale appears ONLY on
+    # warmed requests (step 0 is cold — its record must look exactly
+    # like a pre-zoo record modulo the spec's form entry)
+    assert all(r["spec"]["form"] == "heat" for r in reqs)
+    assert "warm_scale" not in reqs[0]
+    assert all("warm_scale" in r for r in reqs[1:])
+    for r, (_, wsc) in zip(reqs[1:], pairs[1:]):
+        assert r["warm_scale"] == pytest.approx(wsc)
+
+
+def test_poisson_journal_records_unchanged_by_zoo(tmp_path):
+    # pre-zoo traffic must journal byte-identically: no form key in the
+    # spec dict, no warm_scale field
+    journal = str(tmp_path / "poisson.jsonl")
+    broker = _heat_broker(journal)
+    try:
+        p = broker.submit(SolveSpec(degree=2, ndofs=2000, nreps=20), 1.0)
+        assert broker.wait(p, 120)["ok"]
+    finally:
+        broker.shutdown()
+    reqs = [json.loads(line) for line in open(journal)
+            if json.loads(line).get("event") == "serve_request"]
+    assert len(reqs) == 1
+    assert "form" not in reqs[0]["spec"]
+    assert "warm_scale" not in reqs[0]
+
+
+def test_warm_suppression_env_reproduces_cold(tmp_path, monkeypatch):
+    # the CI probe seam: BENCH_SUPPRESS_WARMSTART=1 must make a warmed
+    # stream solve with cold iteration counts (warm hints ignored)
+    pairs = warm_pairs(heat_scale_stream(4, seed=0, drift=0.01))
+    broker = _heat_broker()
+    try:
+        cold = [o["iters_run"]
+                for o in _run_stream(broker, pairs, warmed=False)]
+        monkeypatch.setenv("BENCH_SUPPRESS_WARMSTART", "1")
+        suppressed = [o["iters_run"]
+                      for o in _run_stream(broker, pairs, warmed=True)]
+        monkeypatch.delenv("BENCH_SUPPRESS_WARMSTART")
+        warm = [o["iters_run"]
+                for o in _run_stream(broker, pairs, warmed=True)]
+    finally:
+        broker.shutdown()
+    assert suppressed == cold, (suppressed, cold)
+    assert sum(warm[1:]) < sum(cold[1:]), (warm, cold)
